@@ -1,0 +1,36 @@
+"""incubator_predictionio_tpu — a TPU-native machine learning server.
+
+A brand-new framework with the capabilities of Apache PredictionIO
+(reference: /root/reference, bizreach/incubator-predictionio), rebuilt
+idiomatically on JAX/XLA for TPU:
+
+- ``data``     — event model, property aggregation, pluggable event/metadata/
+                 model storage (reference: data/src/main/scala/.../data/).
+- ``core``     — the DASE abstraction (DataSource / Preparator / Algorithm(s) /
+                 Serving), engine composition, metrics and evaluation
+                 (reference: core/src/main/scala/.../controller/).
+- ``workflow`` — train / evaluate runners and pytree checkpointing
+                 (reference: core/src/main/scala/.../workflow/).
+- ``servers``  — asyncio REST event server and prediction server
+                 (reference: data/.../api/EventServer.scala,
+                 core/.../workflow/CreateServer.scala).
+- ``parallel`` — device mesh / sharding / collective helpers (replaces Spark's
+                 cluster runtime with jax.sharding over TPU ICI/DCN).
+- ``ops``      — the JAX/XLA/Pallas compute kernels (ALS sweeps, top-k,
+                 naive bayes statistics) that replace Spark MLlib.
+- ``models``   — engine templates (recommendation, classification,
+                 similarproduct, ecommerce) mirroring the reference's
+                 examples/scala-parallel-* template families.
+- ``e2``       — standalone engine-building library (CategoricalNaiveBayes,
+                 MarkovChain, BinaryVectorizer, CrossValidation) mirroring
+                 the reference's e2/ module.
+- ``cli``      — the ``pio`` command line (reference: tools/.../Console.scala).
+"""
+
+__version__ = "0.1.0"
+
+BUILD_INFO = {
+    "name": "incubator-predictionio-tpu",
+    "version": __version__,
+    "compute_backend": "jax/xla (tpu-first)",
+}

@@ -1,0 +1,7 @@
+"""Event data model and storage (reference: data/src/main/scala/.../data/)."""
+
+from incubator_predictionio_tpu.data.datamap import DataMap, PropertyMap
+from incubator_predictionio_tpu.data.event import Event, validate_event
+from incubator_predictionio_tpu.data.bimap import BiMap
+
+__all__ = ["DataMap", "PropertyMap", "Event", "validate_event", "BiMap"]

@@ -1,0 +1,20 @@
+"""Shared utilities: time handling, the canonical JSON codec, logging."""
+
+from incubator_predictionio_tpu.utils.times import (
+    now_utc,
+    parse_iso8601,
+    format_iso8601,
+    to_millis,
+    from_millis,
+)
+from incubator_predictionio_tpu.utils.json_codec import extract, to_jsonable
+
+__all__ = [
+    "now_utc",
+    "parse_iso8601",
+    "format_iso8601",
+    "to_millis",
+    "from_millis",
+    "extract",
+    "to_jsonable",
+]

@@ -1,0 +1,51 @@
+"""UTC time helpers.
+
+The reference uses joda-time ``DateTime`` with a default zone of UTC
+(reference: data/.../storage/Event.scala:70 ``defaultTimeZone = DateTimeZone.UTC``)
+and ISO-8601 wire format for ``eventTime`` in the REST API. Here the canonical
+in-memory representation is a timezone-aware ``datetime.datetime``.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+
+
+def now_utc() -> datetime:
+    """Current time as a timezone-aware UTC datetime."""
+    return datetime.now(timezone.utc)
+
+
+def ensure_aware(dt: datetime) -> datetime:
+    """Interpret naive datetimes as UTC (the reference's default zone)."""
+    if dt.tzinfo is None:
+        return dt.replace(tzinfo=timezone.utc)
+    return dt
+
+
+def parse_iso8601(s: str) -> datetime:
+    """Parse an ISO-8601 timestamp, accepting the trailing-``Z`` form.
+
+    joda's ISO8601 parser (used by the reference event API) accepts
+    ``2004-12-13T21:39:45.618-07:00`` and ``...Z`` forms; ``fromisoformat``
+    in Python >= 3.11 covers both once ``Z`` is normalized.
+    """
+    if not isinstance(s, str):
+        raise ValueError(f"Cannot convert {s!r} to a datetime.")
+    dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
+    return ensure_aware(dt)
+
+
+def format_iso8601(dt: datetime) -> str:
+    """Format with milliseconds, matching the reference's wire format."""
+    dt = ensure_aware(dt)
+    return dt.isoformat(timespec="milliseconds")
+
+
+def to_millis(dt: datetime) -> int:
+    """Epoch milliseconds (joda ``DateTime.getMillis`` equivalent)."""
+    return int(ensure_aware(dt).timestamp() * 1000)
+
+
+def from_millis(ms: int) -> datetime:
+    return datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)

@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Multi-chip behavior is tested on a virtual 8-device CPU mesh, mirroring how
+the reference simulates its cluster with ``local[4]`` Spark
+(reference: core/src/test/.../workflow/BaseTest.scala:71-88). These env vars
+must be set before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_home(tmp_path, monkeypatch):
+    """Isolated PIO home directory for storage-backed tests."""
+    monkeypatch.setenv("PIO_HOME", str(tmp_path))
+    return tmp_path

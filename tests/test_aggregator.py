@@ -1,0 +1,86 @@
+"""$set/$unset/$delete replay (parity: data/src/test/.../storage/LEventAggregatorSpec.scala)."""
+
+from datetime import timedelta
+
+from incubator_predictionio_tpu.data.aggregator import (
+    aggregate_properties,
+    aggregate_properties_single,
+)
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.utils.times import parse_iso8601
+
+T0 = parse_iso8601("2020-01-01T00:00:00Z")
+
+
+def sev(name, entity_id, props, minutes):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity_id,
+        properties=DataMap(props),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def test_set_merge_right_biased_by_time():
+    # Deliberately out of order; aggregation must sort by event_time.
+    events = [
+        sev("$set", "u1", {"a": 1, "b": "old"}, 0),
+        sev("$set", "u1", {"b": "new", "c": True}, 10),
+    ]
+    pm = aggregate_properties_single(reversed(events))
+    assert pm is not None
+    assert pm.fields == {"a": 1, "b": "new", "c": True}
+    assert pm.first_updated == T0
+    assert pm.last_updated == T0 + timedelta(minutes=10)
+
+
+def test_unset_removes_keys():
+    events = [
+        sev("$set", "u1", {"a": 1, "b": 2}, 0),
+        sev("$unset", "u1", {"b": None}, 5),
+    ]
+    pm = aggregate_properties_single(events)
+    assert pm.fields == {"a": 1}
+
+
+def test_delete_resets_entity():
+    events = [
+        sev("$set", "u1", {"a": 1}, 0),
+        sev("$delete", "u1", {}, 5),
+    ]
+    assert aggregate_properties_single(events) is None
+    # set after delete resurrects with only the new props
+    events.append(sev("$set", "u1", {"z": 9}, 6))
+    pm = aggregate_properties_single(events)
+    assert pm.fields == {"z": 9}
+    # first/last track all special events, including the delete
+    assert pm.first_updated == T0
+    assert pm.last_updated == T0 + timedelta(minutes=6)
+
+
+def test_non_special_events_ignored():
+    events = [
+        sev("$set", "u1", {"a": 1}, 0),
+        sev("rate", "u1", {"rating": 5}, 1),
+    ]
+    pm = aggregate_properties_single(events)
+    assert pm.fields == {"a": 1}
+    assert pm.last_updated == T0
+
+
+def test_grouping_and_deleted_entities_filtered():
+    events = [
+        sev("$set", "u1", {"a": 1}, 0),
+        sev("$set", "u2", {"a": 2}, 0),
+        sev("$delete", "u2", {}, 1),
+    ]
+    out = aggregate_properties(events)
+    assert set(out) == {"u1"}
+    assert out["u1"].fields == {"a": 1}
+
+
+def test_unset_before_any_set():
+    events = [sev("$unset", "u1", {"a": None}, 0)]
+    assert aggregate_properties_single(events) is None

@@ -1,0 +1,41 @@
+"""BiMap behavior (parity: data/src/test/.../storage/BiMapSpec.scala)."""
+
+import pytest
+
+from incubator_predictionio_tpu.data.bimap import BiMap
+
+
+def test_string_int_dense_and_stable():
+    m = BiMap.string_int(["b", "a", "b", "c"])
+    assert len(m) == 3
+    assert m["b"] == 0 and m["a"] == 1 and m["c"] == 2
+
+
+def test_inverse_round_trip():
+    m = BiMap.string_int(["x", "y"])
+    inv = m.inverse
+    for k in m:
+        assert inv[m[k]] == k
+    # inverse is O(1) view; double inverse round-trips
+    assert inv.inverse.to_dict() == m.to_dict()
+
+
+def test_lookup_variants():
+    m = BiMap({"a": 1})
+    assert m("a") == 1
+    assert m.get("z") is None
+    assert m.get_or_else("z", 99) == 99
+    assert "a" in m and "z" not in m
+    with pytest.raises(KeyError):
+        m["z"]
+
+
+def test_unique_values_enforced():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_take():
+    m = BiMap.string_int(["a", "b", "c"])
+    t = m.take(2)
+    assert t.to_dict() == {"a": 0, "b": 1}

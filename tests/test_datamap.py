@@ -1,0 +1,72 @@
+"""DataMap/PropertyMap behavior (parity: data/src/test/.../storage/DataMapSpec.scala)."""
+
+import dataclasses
+from typing import Optional
+
+import pytest
+
+from incubator_predictionio_tpu.data.datamap import DataMap, DataMapError, PropertyMap
+from incubator_predictionio_tpu.utils.times import now_utc
+
+
+@dataclasses.dataclass
+class BasicProperty:
+    a: int
+    b: str
+    c: bool
+    d: list[str]
+    e: Optional[str] = None
+    f: float = 1.5
+
+
+def test_get_required_and_missing():
+    dm = DataMap({"a": 1, "b": "x"})
+    assert dm.get("a") == 1
+    assert dm.get("a", int) == 1
+    with pytest.raises(DataMapError):
+        dm.get("nope")
+
+
+def test_get_null_is_error_opt_is_none():
+    dm = DataMap({"a": None})
+    with pytest.raises(DataMapError):
+        dm.get("a")
+    assert dm.opt("a") is None
+    assert dm.opt("missing") is None
+
+
+def test_get_or_else():
+    dm = DataMap({"a": 7})
+    assert dm.get_or_else("a", 0, int) == 7
+    assert dm.get_or_else("z", 42, int) == 42
+
+
+def test_extract_dataclass():
+    dm = DataMap({"a": 3, "b": "hello", "c": True, "d": ["x", "y"]})
+    got = dm.extract(BasicProperty)
+    assert got == BasicProperty(a=3, b="hello", c=True, d=["x", "y"])
+
+
+def test_merge_right_biased_and_remove():
+    left = DataMap({"a": 1, "b": 2})
+    right = DataMap({"b": 3, "c": 4})
+    merged = left + right
+    assert merged.fields == {"a": 1, "b": 3, "c": 4}
+    removed = merged - {"a", "c"}
+    assert removed.fields == {"b": 3}
+
+
+def test_mapping_protocol_and_empty():
+    dm = DataMap({"k": "v"})
+    assert "k" in dm and len(dm) == 1 and list(dm) == ["k"]
+    assert not dm.is_empty
+    assert DataMap().is_empty
+    assert dm.key_set == frozenset({"k"})
+
+
+def test_property_map_carries_update_times():
+    t = now_utc()
+    pm = PropertyMap({"a": 1}, first_updated=t, last_updated=t)
+    assert pm.get("a") == 1
+    assert pm.first_updated == t and pm.last_updated == t
+    assert pm == PropertyMap({"a": 1}, first_updated=t, last_updated=t)

@@ -1,0 +1,93 @@
+"""Event model + validation matrix (parity: data/.../storage/Event.scala:112-167)."""
+
+import pytest
+
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    validate_event,
+)
+from incubator_predictionio_tpu.utils.times import parse_iso8601
+
+
+def ev(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+def test_valid_plain_event():
+    validate_event(
+        ev(
+            target_entity_type="item",
+            target_entity_id="i1",
+            properties=DataMap({"rating": 4.0}),
+        )
+    )
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        dict(event=""),
+        dict(entity_type=""),
+        dict(entity_id=""),
+        dict(target_entity_type="item"),  # target type without id
+        dict(target_entity_id="i1"),  # target id without type
+        dict(target_entity_type="", target_entity_id="i1"),
+        dict(event="$unset"),  # empty props
+        dict(event="$custom"),  # reserved prefix, not special
+        dict(event="pio_thing"),
+        dict(event="$set", target_entity_type="item", target_entity_id="i1"),
+        dict(entity_type="pio_user"),
+        dict(target_entity_type="pio_item", target_entity_id="i1"),
+        dict(properties=DataMap({"pio_weight": 1})),
+    ],
+)
+def test_invalid_events(bad):
+    with pytest.raises(EventValidationError):
+        validate_event(ev(**bad))
+
+
+def test_special_events_allowed():
+    validate_event(ev(event="$set", properties=DataMap({"a": 1})))
+    validate_event(ev(event="$unset", properties=DataMap({"a": 1})))
+    validate_event(ev(event="$delete"))
+    # built-in entity type may use the reserved prefix
+    validate_event(ev(entity_type="pio_pr"))
+
+
+def test_json_round_trip():
+    e = ev(
+        target_entity_type="item",
+        target_entity_id="i1",
+        properties=DataMap({"rating": 4.5}),
+        event_time=parse_iso8601("2014-09-09T16:17:42.937-08:00"),
+        tags=("a", "b"),
+        pr_id="pr-1",
+        event_id="abc123",
+    )
+    j = e.to_jsonable()
+    assert j["event"] == "rate"
+    assert j["entityType"] == "user"
+    assert j["targetEntityId"] == "i1"
+    back = Event.from_jsonable(j)
+    assert back.event == e.event
+    assert back.entity_id == e.entity_id
+    assert back.properties == e.properties
+    assert back.event_time == e.event_time
+    assert back.tags == e.tags
+    assert back.pr_id == "pr-1"
+    assert back.event_id == "abc123"
+
+
+def test_from_jsonable_rejects_malformed():
+    with pytest.raises(ValueError):
+        Event.from_jsonable({"entityType": "user", "entityId": "u1"})  # no event
+    with pytest.raises(ValueError):
+        Event.from_jsonable({"event": "rate", "entityType": 3, "entityId": "u1"})
+    with pytest.raises(ValueError):
+        Event.from_jsonable(
+            {"event": "rate", "entityType": "user", "entityId": "u1", "properties": []}
+        )

@@ -1,0 +1,1 @@
+"""The ``pio`` command line (reference: tools/.../console/Console.scala)."""

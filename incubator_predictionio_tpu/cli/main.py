@@ -1,0 +1,37 @@
+"""``pio`` CLI entry point — subcommands land as subsystems are built.
+
+Reference verb inventory (tools/.../console/Console.scala:153-600): version,
+status, app {new,list,show,delete,data-delete,channel-new,channel-delete},
+accesskey {new,list,delete}, train, eval, deploy, undeploy, eventserver,
+adminserver, dashboard, export, import, build, run, template.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from incubator_predictionio_tpu import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pio",
+        description="TPU-native PredictionIO-compatible machine learning server",
+    )
+    parser.add_argument("--version", action="version", version=f"pio-tpu {__version__}")
+    parser.add_subparsers(dest="command")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "command", None):
+        parser.print_help()
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

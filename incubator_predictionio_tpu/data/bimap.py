@@ -22,13 +22,11 @@ class BiMap(Generic[K, V]):
 
     __slots__ = ("_fwd", "_rev")
 
-    def __init__(self, forward: Dict[K, V], _rev: Optional[Dict[V, K]] = None):
+    def __init__(self, forward: Dict[K, V]):
         self._fwd: Dict[K, V] = dict(forward)
-        if _rev is None:
-            _rev = {v: k for k, v in self._fwd.items()}
-            if len(_rev) != len(self._fwd):
-                raise ValueError("BiMap values must be unique")
-        self._rev: Dict[V, K] = _rev
+        self._rev: Dict[V, K] = {v: k for k, v in self._fwd.items()}
+        if len(self._rev) != len(self._fwd):
+            raise ValueError("BiMap values must be unique")
 
     # -- constructors (BiMap.scala:140-196) --------------------------------
     @classmethod

@@ -10,6 +10,7 @@ a typed dataclass through the canonical JSON codec. PropertyMap adds the
 
 from __future__ import annotations
 
+import typing
 from datetime import datetime
 from typing import Any, Iterator, Mapping, Optional, Type, TypeVar
 
@@ -66,12 +67,23 @@ class DataMap(Mapping[str, Any]):
         if name not in self._fields:
             raise DataMapError(f"The field {name} is required.")
 
-    def get(self, name: str, as_: Optional[Type[T]] = None) -> Any:  # type: ignore[override]
+    def get(self, name: str, as_: Optional[Type[T]] = None) -> Any:
         """Mandatory typed get (DataMap.scala:77). Raises if missing.
 
-        Note: unlike ``dict.get``, a missing key is an *error* — this matches
-        the reference, where ``get[T]`` throws ``DataMapException``.
+        Unlike ``dict.get``, a missing key is an *error* — this matches the
+        reference, where ``get[T]`` throws ``DataMapException``. Generic
+        ``Mapping`` consumers needing default semantics should use
+        :meth:`get_or_else` / :meth:`opt`, or index ``dm.fields``.
+
+        The second argument is a *type*, never a default value; passing a
+        non-type raises immediately rather than being silently treated as a
+        missing-key fallback.
         """
+        if as_ is not None and not isinstance(as_, type) and not typing.get_origin(as_):
+            raise TypeError(
+                f"DataMap.get second argument must be a type, got {as_!r}; "
+                "use get_or_else(name, default) for default-value semantics"
+            )
         self.require(name)
         value = self._fields[name]
         if value is None:

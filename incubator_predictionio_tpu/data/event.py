@@ -16,6 +16,7 @@ from typing import Any, Optional, Sequence
 
 from incubator_predictionio_tpu.data.datamap import DataMap
 from incubator_predictionio_tpu.utils.times import (
+    ensure_aware,
     format_iso8601,
     now_utc,
     parse_iso8601,
@@ -61,6 +62,10 @@ class Event:
             object.__setattr__(self, "properties", DataMap(self.properties))
         if isinstance(self.tags, list):
             object.__setattr__(self, "tags", tuple(self.tags))
+        # Naive datetimes are interpreted as UTC (the reference's default
+        # zone, Event.scala:70) so ordering comparisons never mix aware/naive.
+        object.__setattr__(self, "event_time", ensure_aware(self.event_time))
+        object.__setattr__(self, "creation_time", ensure_aware(self.creation_time))
 
     def with_id(self, event_id: str) -> "Event":
         return dataclasses.replace(self, event_id=event_id)
@@ -72,16 +77,14 @@ class Event:
             "event": self.event,
             "entityType": self.entity_type,
             "entityId": self.entity_id,
+            "targetEntityType": self.target_entity_type,
+            "targetEntityId": self.target_entity_id,
             "properties": self.properties.to_jsonable(),
             "eventTime": format_iso8601(self.event_time),
             "tags": list(self.tags),
             "prId": self.pr_id,
             "creationTime": format_iso8601(self.creation_time),
         }
-        if self.target_entity_type is not None:
-            out["targetEntityType"] = self.target_entity_type
-        if self.target_entity_id is not None:
-            out["targetEntityId"] = self.target_entity_id
         return {k: v for k, v in out.items() if v is not None}
 
     @classmethod
@@ -110,13 +113,16 @@ class Event:
         if not isinstance(properties, dict):
             raise ValueError("field properties must be a JSON object")
 
+        # Absent/null times default to receive time; malformed values (e.g.
+        # empty strings) must fail loudly, as the reference's joda parser does.
         event_time = (
-            parse_iso8601(obj["eventTime"]) if "eventTime" in obj and obj["eventTime"]
+            parse_iso8601(obj["eventTime"])
+            if obj.get("eventTime") is not None
             else now_utc()
         )
         creation_time = (
             parse_iso8601(obj["creationTime"])
-            if "creationTime" in obj and obj["creationTime"]
+            if obj.get("creationTime") is not None
             else now_utc()
         )
         tags = obj.get("tags") or []

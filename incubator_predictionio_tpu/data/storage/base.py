@@ -1,0 +1,378 @@
+"""Storage SPI: metadata records and DAO contracts.
+
+Parity with the reference's storage traits:
+
+- ``Events``            ⇄ ``LEvents`` (data/.../storage/LEvents.scala:40-492).
+  The reference also has ``PEvents`` returning Spark RDDs
+  (PEvents.scala:38-189); on TPU there is no executor fan-out to feed, so the
+  parallel path is the same DAO streamed into device-sharded arrays by
+  ``parallel.ingest`` — the L/P split collapses by design.
+- ``Apps`` / ``AccessKeys`` / ``Channels`` / ``EngineInstances`` /
+  ``EvaluationInstances`` / ``Models`` ⇄ the metadata DAO traits of the same
+  names (data/.../storage/{Apps,AccessKeys,Channels,EngineInstances,
+  EvaluationInstances,Models}.scala).
+
+All DAOs are synchronous; the servers wrap them in thread executors (the
+reference's ``future*`` methods serve the same purpose over JVM futures).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import re
+import secrets
+from datetime import datetime
+from typing import Any, Dict, Iterator, Optional, Sequence
+
+from incubator_predictionio_tpu.data.datamap import PropertyMap
+from incubator_predictionio_tpu.data.event import Event
+
+#: Sentinel distinguishing "no filter" from "filter for absent" on target
+#: entity queries (the reference encodes this as Option[Option[String]],
+#: LEvents.scala:167-182).
+UNSET: Any = type("_Unset", (), {"__repr__": lambda s: "UNSET"})()
+
+
+# ---------------------------------------------------------------------------
+# Metadata records
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class App:
+    """Apps.scala:32 — an app has a unique integer ID and unique name."""
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AccessKey:
+    """AccessKeys.scala:35 — ``events`` is the allowlist; empty = all."""
+    key: str
+    appid: int
+    events: tuple[str, ...] = ()
+
+
+CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+CHANNEL_NAME_CONSTRAINT = (
+    "Only alphanumeric and - characters are allowed and max length is 16."
+)
+
+
+def is_valid_channel_name(name: str) -> bool:
+    """Channels.scala:54-57."""
+    return bool(CHANNEL_NAME_RE.match(name))
+
+
+@dataclasses.dataclass(frozen=True)
+class Channel:
+    """Channels.scala:32 — name unique within an app."""
+    id: int
+    name: str
+    appid: int
+
+    def __post_init__(self) -> None:
+        if not is_valid_channel_name(self.name):
+            raise ValueError(
+                f"Invalid channel name: {self.name}. {CHANNEL_NAME_CONSTRAINT}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineInstance:
+    """EngineInstances.scala:46 — one training run of an engine.
+
+    ``env``/``runtime_conf`` replace the reference's ``env``/``sparkConf``
+    (there is no Spark; runtime_conf carries mesh/XLA settings instead).
+    """
+    id: str
+    status: str
+    start_time: datetime
+    end_time: datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    runtime_conf: Dict[str, str] = dataclasses.field(default_factory=dict)
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaluationInstance:
+    """EvaluationInstances.scala:42 — one evaluation (tuning) run."""
+    id: str
+    status: str
+    start_time: datetime
+    end_time: datetime
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    runtime_conf: Dict[str, str] = dataclasses.field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """Models.scala:33 — a serialized model blob keyed by engine instance."""
+    id: str
+    models: bytes
+
+
+# ---------------------------------------------------------------------------
+# Event DAO
+# ---------------------------------------------------------------------------
+
+class Events(abc.ABC):
+    """Event CRUD + query DAO (LEvents.scala:40-492)."""
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Initialize the backing table/namespace for an app/channel."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Drop all events of an app/channel."""
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        """Release client connections."""
+
+    @abc.abstractmethod
+    def insert(
+        self, event: Event, app_id: int, channel_id: Optional[int] = None
+    ) -> str:
+        """Insert one event, returning its event ID (LEvents.futureInsert)."""
+
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]:
+        """Get an event by ID (LEvents.futureGet)."""
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        """Delete an event by ID (LEvents.futureDelete)."""
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Query events (LEvents.futureFind:167-182).
+
+        Results are ordered by event time ascending (descending when
+        ``reversed``); ``limit=None`` or ``-1`` means no limit;
+        ``target_entity_type=None`` (explicitly) matches only events *without*
+        a target entity, while leaving it ``UNSET`` applies no filter.
+        ``start_time`` is inclusive, ``until_time`` exclusive.
+        """
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """Aggregate special events into entity state
+        (LEvents.futureAggregateProperties:194-230)."""
+        from incubator_predictionio_tpu.data.aggregator import (
+            AGGREGATOR_EVENT_NAMES,
+            aggregate_properties,
+        )
+
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=AGGREGATOR_EVENT_NAMES,
+        )
+        result = aggregate_properties(events)
+        if required is not None:
+            result = {k: v for k, v in result.items() if k in required}
+        return result
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAOs
+# ---------------------------------------------------------------------------
+
+class Apps(abc.ABC):
+    """Apps.scala:44-76."""
+
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]:
+        """Insert; if ``app.id == 0`` an ID is generated. Returns the ID."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+def generate_access_key() -> str:
+    """Random URL-safe key (AccessKeys.scala:68 generates base64 of random
+    bytes with ``+``/``/``/``=`` stripped; token_urlsafe is the same idea)."""
+    return secrets.token_urlsafe(48).replace("-", "").replace("_", "")[:64]
+
+
+class AccessKeys(abc.ABC):
+    """AccessKeys.scala:47-76."""
+
+    @abc.abstractmethod
+    def insert(self, k: AccessKey) -> Optional[str]:
+        """Insert; generates the key when ``k.key`` is empty. Returns key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, k: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(abc.ABC):
+    """Channels.scala:70-95."""
+
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]:
+        """Insert; if ``channel.id == 0`` an ID is generated. Returns the ID."""
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> list[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(abc.ABC):
+    """EngineInstances.scala:75-115."""
+
+    @abc.abstractmethod
+    def insert(self, i: EngineInstance) -> str:
+        """Insert; generates and returns an ID when ``i.id`` is empty."""
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        """Latest COMPLETED instance by start time (EngineInstances.scala:82)."""
+
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, i: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class EvaluationInstances(abc.ABC):
+    """EvaluationInstances.scala:70-100."""
+
+    @abc.abstractmethod
+    def insert(self, i: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> list[EvaluationInstance]:
+        """EVALCOMPLETED instances, newest first (EvaluationInstances.scala:85)."""
+
+    @abc.abstractmethod
+    def update(self, i: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class Models(abc.ABC):
+    """Models.scala:40-60 — model blob store."""
+
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> None: ...
+
+
+class BaseStorageClient(abc.ABC):
+    """A connection to one storage source (Storage.scala:39-53)."""
+
+    prefix: str = ""
+
+    def __init__(self, config: "StorageClientConfig"):
+        self.config = config
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class StorageClientConfig:
+    """Storage.scala:62-66 — parsed ``PIO_STORAGE_SOURCES_<NAME>_*`` env."""
+    parallel: bool = False
+    test: bool = False
+    properties: Dict[str, str] = dataclasses.field(default_factory=dict)

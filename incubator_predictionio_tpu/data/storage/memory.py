@@ -1,0 +1,357 @@
+"""In-memory storage backend — the test backend and default for unit work.
+
+The reference gains the same capability through JDBC-against-test-DBs plus
+``StorageClientConfig.test`` (Storage.scala:62,78-81); here an explicit
+in-memory backend keeps the conformance suite hermetic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import uuid
+from datetime import datetime
+from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
+
+from incubator_predictionio_tpu.data.event import Event, new_event_id, validate_event
+from incubator_predictionio_tpu.data.storage import base
+from incubator_predictionio_tpu.data.storage.base import UNSET
+
+
+class StorageClient(base.BaseStorageClient):
+    """Holds all in-memory tables for one source."""
+
+    def __init__(self, config: base.StorageClientConfig):
+        super().__init__(config)
+        self.lock = threading.RLock()
+        # (app_id, channel_id) -> {event_id: Event}
+        self.events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+        self.apps: Dict[int, base.App] = {}
+        self.access_keys: Dict[str, base.AccessKey] = {}
+        self.channels: Dict[int, base.Channel] = {}
+        self.engine_instances: Dict[str, base.EngineInstance] = {}
+        self.evaluation_instances: Dict[str, base.EvaluationInstance] = {}
+        self.models: Dict[str, base.Model] = {}
+        self._counter = itertools.count(1)
+
+    def next_id(self) -> int:
+        return next(self._counter)
+
+    def close(self) -> None:
+        pass
+
+
+def _match(
+    e: Event,
+    start_time: Optional[datetime],
+    until_time: Optional[datetime],
+    entity_type: Optional[str],
+    entity_id: Optional[str],
+    event_names: Optional[Sequence[str]],
+    target_entity_type: Any,
+    target_entity_id: Any,
+) -> bool:
+    if start_time is not None and e.event_time < start_time:
+        return False
+    if until_time is not None and e.event_time >= until_time:
+        return False
+    if entity_type is not None and e.entity_type != entity_type:
+        return False
+    if entity_id is not None and e.entity_id != entity_id:
+        return False
+    if event_names is not None and e.event not in event_names:
+        return False
+    if target_entity_type is not UNSET and e.target_entity_type != target_entity_type:
+        return False
+    if target_entity_id is not UNSET and e.target_entity_id != target_entity_id:
+        return False
+    return True
+
+
+class MemoryEvents(base.Events):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def _table(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
+        return self.client.events.setdefault((app_id, channel_id), {})
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.client.lock:
+            self._table(app_id, channel_id)
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.client.lock:
+            self.client.events.pop((app_id, channel_id), None)
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        validate_event(event)
+        with self.client.lock:
+            eid = event.event_id or new_event_id()
+            self._table(app_id, channel_id)[eid] = event.with_id(eid)
+        return eid
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        with self.client.lock:
+            return self._table(app_id, channel_id).get(event_id)
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        with self.client.lock:
+            return self._table(app_id, channel_id).pop(event_id, None) is not None
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        with self.client.lock:
+            rows = list(self._table(app_id, channel_id).values())
+        rows = [
+            e for e in rows
+            if _match(e, start_time, until_time, entity_type, entity_id,
+                      event_names, target_entity_type, target_entity_id)
+        ]
+        rows.sort(key=lambda e: (e.event_time, e.event_id or ""), reverse=reversed)
+        if limit is not None and limit >= 0:
+            rows = rows[:limit]
+        return iter(rows)
+
+
+class MemoryApps(base.Apps):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, app: base.App) -> Optional[int]:
+        with self.client.lock:
+            app_id = app.id if app.id != 0 else self.client.next_id()
+            if app_id in self.client.apps:
+                return None
+            if any(a.name == app.name for a in self.client.apps.values()):
+                return None
+            self.client.apps[app_id] = base.App(app_id, app.name, app.description)
+            return app_id
+
+    def get(self, app_id: int) -> Optional[base.App]:
+        return self.client.apps.get(app_id)
+
+    def get_by_name(self, name: str) -> Optional[base.App]:
+        return next(
+            (a for a in self.client.apps.values() if a.name == name), None
+        )
+
+    def get_all(self) -> list[base.App]:
+        return list(self.client.apps.values())
+
+    def update(self, app: base.App) -> bool:
+        with self.client.lock:
+            if app.id not in self.client.apps:
+                return False
+            self.client.apps[app.id] = app
+            return True
+
+    def delete(self, app_id: int) -> bool:
+        with self.client.lock:
+            return self.client.apps.pop(app_id, None) is not None
+
+
+class MemoryAccessKeys(base.AccessKeys):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, k: base.AccessKey) -> Optional[str]:
+        with self.client.lock:
+            key = k.key or base.generate_access_key()
+            if key in self.client.access_keys:
+                return None
+            self.client.access_keys[key] = base.AccessKey(key, k.appid, tuple(k.events))
+            return key
+
+    def get(self, key: str) -> Optional[base.AccessKey]:
+        return self.client.access_keys.get(key)
+
+    def get_all(self) -> list[base.AccessKey]:
+        return list(self.client.access_keys.values())
+
+    def get_by_appid(self, appid: int) -> list[base.AccessKey]:
+        return [k for k in self.client.access_keys.values() if k.appid == appid]
+
+    def update(self, k: base.AccessKey) -> bool:
+        with self.client.lock:
+            if k.key not in self.client.access_keys:
+                return False
+            self.client.access_keys[k.key] = k
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self.client.lock:
+            return self.client.access_keys.pop(key, None) is not None
+
+
+class MemoryChannels(base.Channels):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, channel: base.Channel) -> Optional[int]:
+        with self.client.lock:
+            cid = channel.id if channel.id != 0 else self.client.next_id()
+            if cid in self.client.channels:
+                return None
+            if any(
+                c.appid == channel.appid and c.name == channel.name
+                for c in self.client.channels.values()
+            ):
+                return None
+            self.client.channels[cid] = base.Channel(cid, channel.name, channel.appid)
+            return cid
+
+    def get(self, channel_id: int) -> Optional[base.Channel]:
+        return self.client.channels.get(channel_id)
+
+    def get_by_appid(self, appid: int) -> list[base.Channel]:
+        return [c for c in self.client.channels.values() if c.appid == appid]
+
+    def delete(self, channel_id: int) -> bool:
+        with self.client.lock:
+            return self.client.channels.pop(channel_id, None) is not None
+
+
+class MemoryEngineInstances(base.EngineInstances):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, i: base.EngineInstance) -> str:
+        with self.client.lock:
+            iid = i.id or uuid.uuid4().hex
+            self.client.engine_instances[iid] = (
+                i if i.id else dataclasses.replace(i, id=iid)
+            )
+            return iid
+
+    def get(self, instance_id: str) -> Optional[base.EngineInstance]:
+        return self.client.engine_instances.get(instance_id)
+
+    def get_all(self) -> list[base.EngineInstance]:
+        return list(self.client.engine_instances.values())
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[base.EngineInstance]:
+        rows = [
+            i for i in self.client.engine_instances.values()
+            if i.status == "COMPLETED"
+            and i.engine_id == engine_id
+            and i.engine_version == engine_version
+            and i.engine_variant == engine_variant
+        ]
+        rows.sort(key=lambda i: i.start_time, reverse=True)
+        return rows
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[base.EngineInstance]:
+        rows = self.get_completed(engine_id, engine_version, engine_variant)
+        return rows[0] if rows else None
+
+    def update(self, i: base.EngineInstance) -> bool:
+        with self.client.lock:
+            if i.id not in self.client.engine_instances:
+                return False
+            self.client.engine_instances[i.id] = i
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self.client.lock:
+            return self.client.engine_instances.pop(instance_id, None) is not None
+
+
+class MemoryEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, i: base.EvaluationInstance) -> str:
+        with self.client.lock:
+            iid = i.id or uuid.uuid4().hex
+            self.client.evaluation_instances[iid] = (
+                i if i.id else dataclasses.replace(i, id=iid)
+            )
+            return iid
+
+    def get(self, instance_id: str) -> Optional[base.EvaluationInstance]:
+        return self.client.evaluation_instances.get(instance_id)
+
+    def get_all(self) -> list[base.EvaluationInstance]:
+        return list(self.client.evaluation_instances.values())
+
+    def get_completed(self) -> list[base.EvaluationInstance]:
+        rows = [
+            i for i in self.client.evaluation_instances.values()
+            if i.status == "EVALCOMPLETED"
+        ]
+        rows.sort(key=lambda i: i.start_time, reverse=True)
+        return rows
+
+    def update(self, i: base.EvaluationInstance) -> bool:
+        with self.client.lock:
+            if i.id not in self.client.evaluation_instances:
+                return False
+            self.client.evaluation_instances[i.id] = i
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self.client.lock:
+            return self.client.evaluation_instances.pop(instance_id, None) is not None
+
+
+class MemoryModels(base.Models):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, model: base.Model) -> None:
+        with self.client.lock:
+            self.client.models[model.id] = model
+
+    def get(self, model_id: str) -> Optional[base.Model]:
+        return self.client.models.get(model_id)
+
+    def delete(self, model_id: str) -> None:
+        with self.client.lock:
+            self.client.models.pop(model_id, None)
+
+
+#: DAO registry used by the Storage registry's reflective lookup
+#: (the equivalent of the reference's classname convention
+#: ``org.apache.predictionio.data.storage.<type>.<prefix><Iface>``,
+#: Storage.scala:286-303).
+DATA_OBJECTS = {
+    "Events": MemoryEvents,
+    "Apps": MemoryApps,
+    "AccessKeys": MemoryAccessKeys,
+    "Channels": MemoryChannels,
+    "EngineInstances": MemoryEngineInstances,
+    "EvaluationInstances": MemoryEvaluationInstances,
+    "Models": MemoryModels,
+}

@@ -1,0 +1,666 @@
+"""SQLite storage backend — the durable single-box backend.
+
+Parity target: the reference's JDBC driver, which implements the *full*
+backend surface (events + all metadata + model blobs) on PostgreSQL/MySQL
+(data/.../storage/jdbc/, 1393 LoC: JDBCLEvents, JDBCPEvents, JDBCApps,
+JDBCAccessKeys, JDBCChannels, JDBCEngineInstances, JDBCEvaluationInstances,
+JDBCModels, JDBCUtils). SQLite gives the same durability contract with zero
+service dependencies; the DAO layer is schema-compatible with a Postgres
+driver should one be added (SQL here is deliberately generic).
+
+Event rows store times as epoch-millis integers for fast range scans — the
+same role as the reference's indexed ``eventTime`` columns
+(jdbc/JDBCLEvents.scala:44-66).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sqlite3
+import threading
+import uuid
+from datetime import datetime
+from pathlib import Path
+from typing import Any, Dict, Iterator, Optional, Sequence
+
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event, new_event_id, validate_event
+from incubator_predictionio_tpu.data.storage import base
+from incubator_predictionio_tpu.data.storage.base import UNSET
+from incubator_predictionio_tpu.utils.times import from_millis, to_millis
+
+
+class StorageClient(base.BaseStorageClient):
+    """One SQLite database file (``:memory:`` supported for tests)."""
+
+    def __init__(self, config: base.StorageClientConfig):
+        super().__init__(config)
+        path = config.properties.get("PATH", "")
+        if not path or path == ":memory:":
+            self._path = ":memory:"
+        else:
+            p = Path(path).expanduser()
+            p.parent.mkdir(parents=True, exist_ok=True)
+            self._path = str(p)
+        self._local = threading.local()
+        self._memory_conn: Optional[sqlite3.Connection] = None
+        self._lock = threading.RLock()
+        self._init_schema()
+
+    @property
+    def conn(self) -> sqlite3.Connection:
+        # ":memory:" must share one connection; files get one per thread.
+        if self._path == ":memory:":
+            if self._memory_conn is None:
+                self._memory_conn = sqlite3.connect(
+                    ":memory:", check_same_thread=False
+                )
+            return self._memory_conn
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._local.conn = conn
+        return conn
+
+    @property
+    def lock(self) -> threading.RLock:
+        return self._lock
+
+    def _init_schema(self) -> None:
+        with self._lock, self.conn as c:
+            c.executescript(
+                """
+                CREATE TABLE IF NOT EXISTS events (
+                    id TEXT NOT NULL,
+                    app_id INTEGER NOT NULL,
+                    channel_id INTEGER NOT NULL DEFAULT -1,
+                    event TEXT NOT NULL,
+                    entity_type TEXT NOT NULL,
+                    entity_id TEXT NOT NULL,
+                    target_entity_type TEXT,
+                    target_entity_id TEXT,
+                    properties TEXT,
+                    event_time INTEGER NOT NULL,
+                    event_time_zone TEXT,
+                    tags TEXT,
+                    pr_id TEXT,
+                    creation_time INTEGER NOT NULL,
+                    PRIMARY KEY (id, app_id, channel_id)
+                );
+                CREATE INDEX IF NOT EXISTS idx_events_scan
+                    ON events (app_id, channel_id, event_time);
+                CREATE TABLE IF NOT EXISTS apps (
+                    id INTEGER PRIMARY KEY AUTOINCREMENT,
+                    name TEXT NOT NULL UNIQUE,
+                    description TEXT
+                );
+                CREATE TABLE IF NOT EXISTS access_keys (
+                    key TEXT PRIMARY KEY,
+                    app_id INTEGER NOT NULL,
+                    events TEXT NOT NULL
+                );
+                CREATE TABLE IF NOT EXISTS channels (
+                    id INTEGER PRIMARY KEY AUTOINCREMENT,
+                    name TEXT NOT NULL,
+                    app_id INTEGER NOT NULL,
+                    UNIQUE (app_id, name)
+                );
+                CREATE TABLE IF NOT EXISTS engine_instances (
+                    id TEXT PRIMARY KEY,
+                    status TEXT NOT NULL,
+                    start_time INTEGER NOT NULL,
+                    end_time INTEGER NOT NULL,
+                    engine_id TEXT NOT NULL,
+                    engine_version TEXT NOT NULL,
+                    engine_variant TEXT NOT NULL,
+                    engine_factory TEXT NOT NULL,
+                    batch TEXT,
+                    env TEXT,
+                    runtime_conf TEXT,
+                    data_source_params TEXT,
+                    preparator_params TEXT,
+                    algorithms_params TEXT,
+                    serving_params TEXT
+                );
+                CREATE TABLE IF NOT EXISTS evaluation_instances (
+                    id TEXT PRIMARY KEY,
+                    status TEXT NOT NULL,
+                    start_time INTEGER NOT NULL,
+                    end_time INTEGER NOT NULL,
+                    evaluation_class TEXT,
+                    engine_params_generator_class TEXT,
+                    batch TEXT,
+                    env TEXT,
+                    runtime_conf TEXT,
+                    evaluator_results TEXT,
+                    evaluator_results_html TEXT,
+                    evaluator_results_json TEXT
+                );
+                CREATE TABLE IF NOT EXISTS models (
+                    id TEXT PRIMARY KEY,
+                    models BLOB NOT NULL
+                );
+                """
+            )
+
+    def close(self) -> None:
+        if self._memory_conn is not None:
+            self._memory_conn.close()
+            self._memory_conn = None
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+def _chan(channel_id: Optional[int]) -> int:
+    return -1 if channel_id is None else channel_id
+
+
+def _row_to_event(row: Sequence[Any]) -> Event:
+    (eid, event, etype, entity_id, tetype, teid, props, etime, tags, pr_id,
+     ctime) = row
+    return Event(
+        event=event,
+        entity_type=etype,
+        entity_id=entity_id,
+        target_entity_type=tetype,
+        target_entity_id=teid,
+        properties=DataMap(json.loads(props) if props else {}),
+        event_time=from_millis(etime),
+        tags=tuple(json.loads(tags)) if tags else (),
+        pr_id=pr_id,
+        creation_time=from_millis(ctime),
+        event_id=eid,
+    )
+
+
+_EVENT_COLS = (
+    "id, event, entity_type, entity_id, target_entity_type, target_entity_id,"
+    " properties, event_time, tags, pr_id, creation_time"
+)
+
+
+class SQLiteEvents(base.Events):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return True  # single shared table, schema made at client init
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self.client.lock, self.client.conn as c:
+            c.execute(
+                "DELETE FROM events WHERE app_id = ? AND channel_id = ?",
+                (app_id, _chan(channel_id)),
+            )
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        validate_event(event)
+        eid = event.event_id or new_event_id()
+        with self.client.lock, self.client.conn as c:
+            c.execute(
+                "INSERT OR REPLACE INTO events VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    eid,
+                    app_id,
+                    _chan(channel_id),
+                    event.event,
+                    event.entity_type,
+                    event.entity_id,
+                    event.target_entity_type,
+                    event.target_entity_id,
+                    json.dumps(event.properties.to_jsonable()),
+                    to_millis(event.event_time),
+                    str(event.event_time.tzinfo or "UTC"),
+                    json.dumps(list(event.tags)),
+                    event.pr_id,
+                    to_millis(event.creation_time),
+                ),
+            )
+        return eid
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        with self.client.lock:
+            cur = self.client.conn.execute(
+                f"SELECT {_EVENT_COLS} FROM events "
+                "WHERE id = ? AND app_id = ? AND channel_id = ?",
+                (event_id, app_id, _chan(channel_id)),
+            )
+            row = cur.fetchone()
+        return _row_to_event(row) if row else None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        with self.client.lock, self.client.conn as c:
+            cur = c.execute(
+                "DELETE FROM events WHERE id = ? AND app_id = ? AND channel_id = ?",
+                (event_id, app_id, _chan(channel_id)),
+            )
+            return cur.rowcount > 0
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        # Same predicate assembly as jdbc/JDBCLEvents.scala:118-165.
+        where = ["app_id = ?", "channel_id = ?"]
+        params: list[Any] = [app_id, _chan(channel_id)]
+        if start_time is not None:
+            where.append("event_time >= ?")
+            params.append(to_millis(start_time))
+        if until_time is not None:
+            where.append("event_time < ?")
+            params.append(to_millis(until_time))
+        if entity_type is not None:
+            where.append("entity_type = ?")
+            params.append(entity_type)
+        if entity_id is not None:
+            where.append("entity_id = ?")
+            params.append(entity_id)
+        if event_names is not None:
+            names = list(event_names)
+            where.append(
+                "event IN (%s)" % ",".join("?" * len(names)) if names else "0"
+            )
+            params.extend(names)
+        if target_entity_type is not UNSET:
+            if target_entity_type is None:
+                where.append("target_entity_type IS NULL")
+            else:
+                where.append("target_entity_type = ?")
+                params.append(target_entity_type)
+        if target_entity_id is not UNSET:
+            if target_entity_id is None:
+                where.append("target_entity_id IS NULL")
+            else:
+                where.append("target_entity_id = ?")
+                params.append(target_entity_id)
+        sql = (
+            f"SELECT {_EVENT_COLS} FROM events WHERE " + " AND ".join(where)
+            + f" ORDER BY event_time {'DESC' if reversed else 'ASC'}, id"
+        )
+        if limit is not None and limit >= 0:
+            sql += " LIMIT ?"
+            params.append(limit)
+        with self.client.lock:
+            rows = self.client.conn.execute(sql, params).fetchall()
+        return (_row_to_event(r) for r in rows)
+
+
+class SQLiteApps(base.Apps):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, app: base.App) -> Optional[int]:
+        with self.client.lock, self.client.conn as c:
+            try:
+                if app.id != 0:
+                    c.execute(
+                        "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
+                        (app.id, app.name, app.description),
+                    )
+                    return app.id
+                cur = c.execute(
+                    "INSERT INTO apps (name, description) VALUES (?,?)",
+                    (app.name, app.description),
+                )
+                return cur.lastrowid
+            except sqlite3.IntegrityError:
+                return None
+
+    def get(self, app_id: int) -> Optional[base.App]:
+        row = self.client.conn.execute(
+            "SELECT id, name, description FROM apps WHERE id = ?", (app_id,)
+        ).fetchone()
+        return base.App(*row) if row else None
+
+    def get_by_name(self, name: str) -> Optional[base.App]:
+        row = self.client.conn.execute(
+            "SELECT id, name, description FROM apps WHERE name = ?", (name,)
+        ).fetchone()
+        return base.App(*row) if row else None
+
+    def get_all(self) -> list[base.App]:
+        rows = self.client.conn.execute(
+            "SELECT id, name, description FROM apps"
+        ).fetchall()
+        return [base.App(*r) for r in rows]
+
+    def update(self, app: base.App) -> bool:
+        with self.client.lock, self.client.conn as c:
+            cur = c.execute(
+                "UPDATE apps SET name = ?, description = ? WHERE id = ?",
+                (app.name, app.description, app.id),
+            )
+            return cur.rowcount > 0
+
+    def delete(self, app_id: int) -> bool:
+        with self.client.lock, self.client.conn as c:
+            return c.execute(
+                "DELETE FROM apps WHERE id = ?", (app_id,)
+            ).rowcount > 0
+
+
+class SQLiteAccessKeys(base.AccessKeys):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, k: base.AccessKey) -> Optional[str]:
+        key = k.key or base.generate_access_key()
+        with self.client.lock, self.client.conn as c:
+            try:
+                c.execute(
+                    "INSERT INTO access_keys (key, app_id, events) VALUES (?,?,?)",
+                    (key, k.appid, json.dumps(list(k.events))),
+                )
+                return key
+            except sqlite3.IntegrityError:
+                return None
+
+    @staticmethod
+    def _row(row: Sequence[Any]) -> base.AccessKey:
+        return base.AccessKey(row[0], row[1], tuple(json.loads(row[2])))
+
+    def get(self, key: str) -> Optional[base.AccessKey]:
+        row = self.client.conn.execute(
+            "SELECT key, app_id, events FROM access_keys WHERE key = ?", (key,)
+        ).fetchone()
+        return self._row(row) if row else None
+
+    def get_all(self) -> list[base.AccessKey]:
+        rows = self.client.conn.execute(
+            "SELECT key, app_id, events FROM access_keys"
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def get_by_appid(self, appid: int) -> list[base.AccessKey]:
+        rows = self.client.conn.execute(
+            "SELECT key, app_id, events FROM access_keys WHERE app_id = ?",
+            (appid,),
+        ).fetchall()
+        return [self._row(r) for r in rows]
+
+    def update(self, k: base.AccessKey) -> bool:
+        with self.client.lock, self.client.conn as c:
+            cur = c.execute(
+                "UPDATE access_keys SET app_id = ?, events = ? WHERE key = ?",
+                (k.appid, json.dumps(list(k.events)), k.key),
+            )
+            return cur.rowcount > 0
+
+    def delete(self, key: str) -> bool:
+        with self.client.lock, self.client.conn as c:
+            return c.execute(
+                "DELETE FROM access_keys WHERE key = ?", (key,)
+            ).rowcount > 0
+
+
+class SQLiteChannels(base.Channels):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, channel: base.Channel) -> Optional[int]:
+        with self.client.lock, self.client.conn as c:
+            try:
+                if channel.id != 0:
+                    c.execute(
+                        "INSERT INTO channels (id, name, app_id) VALUES (?,?,?)",
+                        (channel.id, channel.name, channel.appid),
+                    )
+                    return channel.id
+                cur = c.execute(
+                    "INSERT INTO channels (name, app_id) VALUES (?,?)",
+                    (channel.name, channel.appid),
+                )
+                return cur.lastrowid
+            except sqlite3.IntegrityError:
+                return None
+
+    def get(self, channel_id: int) -> Optional[base.Channel]:
+        row = self.client.conn.execute(
+            "SELECT id, name, app_id FROM channels WHERE id = ?", (channel_id,)
+        ).fetchone()
+        return base.Channel(*row) if row else None
+
+    def get_by_appid(self, appid: int) -> list[base.Channel]:
+        rows = self.client.conn.execute(
+            "SELECT id, name, app_id FROM channels WHERE app_id = ?", (appid,)
+        ).fetchall()
+        return [base.Channel(*r) for r in rows]
+
+    def delete(self, channel_id: int) -> bool:
+        with self.client.lock, self.client.conn as c:
+            return c.execute(
+                "DELETE FROM channels WHERE id = ?", (channel_id,)
+            ).rowcount > 0
+
+
+_EI_COLS = (
+    "id, status, start_time, end_time, engine_id, engine_version,"
+    " engine_variant, engine_factory, batch, env, runtime_conf,"
+    " data_source_params, preparator_params, algorithms_params, serving_params"
+)
+
+
+def _row_to_engine_instance(row: Sequence[Any]) -> base.EngineInstance:
+    return base.EngineInstance(
+        id=row[0],
+        status=row[1],
+        start_time=from_millis(row[2]),
+        end_time=from_millis(row[3]),
+        engine_id=row[4],
+        engine_version=row[5],
+        engine_variant=row[6],
+        engine_factory=row[7],
+        batch=row[8] or "",
+        env=json.loads(row[9]) if row[9] else {},
+        runtime_conf=json.loads(row[10]) if row[10] else {},
+        data_source_params=row[11] or "",
+        preparator_params=row[12] or "",
+        algorithms_params=row[13] or "",
+        serving_params=row[14] or "",
+    )
+
+
+class SQLiteEngineInstances(base.EngineInstances):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, i: base.EngineInstance) -> str:
+        iid = i.id or uuid.uuid4().hex
+        if not i.id:
+            i = dataclasses.replace(i, id=iid)
+        with self.client.lock, self.client.conn as c:
+            c.execute(
+                "INSERT OR REPLACE INTO engine_instances VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    i.id, i.status, to_millis(i.start_time), to_millis(i.end_time),
+                    i.engine_id, i.engine_version, i.engine_variant,
+                    i.engine_factory, i.batch, json.dumps(i.env),
+                    json.dumps(i.runtime_conf), i.data_source_params,
+                    i.preparator_params, i.algorithms_params, i.serving_params,
+                ),
+            )
+        return iid
+
+    def get(self, instance_id: str) -> Optional[base.EngineInstance]:
+        row = self.client.conn.execute(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE id = ?",
+            (instance_id,),
+        ).fetchone()
+        return _row_to_engine_instance(row) if row else None
+
+    def get_all(self) -> list[base.EngineInstance]:
+        rows = self.client.conn.execute(
+            f"SELECT {_EI_COLS} FROM engine_instances"
+        ).fetchall()
+        return [_row_to_engine_instance(r) for r in rows]
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[base.EngineInstance]:
+        rows = self.client.conn.execute(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE status = 'COMPLETED'"
+            " AND engine_id = ? AND engine_version = ? AND engine_variant = ?"
+            " ORDER BY start_time DESC",
+            (engine_id, engine_version, engine_variant),
+        ).fetchall()
+        return [_row_to_engine_instance(r) for r in rows]
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[base.EngineInstance]:
+        rows = self.get_completed(engine_id, engine_version, engine_variant)
+        return rows[0] if rows else None
+
+    def update(self, i: base.EngineInstance) -> bool:
+        if self.get(i.id) is None:
+            return False
+        self.insert(i)
+        return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self.client.lock, self.client.conn as c:
+            return c.execute(
+                "DELETE FROM engine_instances WHERE id = ?", (instance_id,)
+            ).rowcount > 0
+
+
+_EVI_COLS = (
+    "id, status, start_time, end_time, evaluation_class,"
+    " engine_params_generator_class, batch, env, runtime_conf,"
+    " evaluator_results, evaluator_results_html, evaluator_results_json"
+)
+
+
+def _row_to_evaluation_instance(row: Sequence[Any]) -> base.EvaluationInstance:
+    return base.EvaluationInstance(
+        id=row[0],
+        status=row[1],
+        start_time=from_millis(row[2]),
+        end_time=from_millis(row[3]),
+        evaluation_class=row[4] or "",
+        engine_params_generator_class=row[5] or "",
+        batch=row[6] or "",
+        env=json.loads(row[7]) if row[7] else {},
+        runtime_conf=json.loads(row[8]) if row[8] else {},
+        evaluator_results=row[9] or "",
+        evaluator_results_html=row[10] or "",
+        evaluator_results_json=row[11] or "",
+    )
+
+
+class SQLiteEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, i: base.EvaluationInstance) -> str:
+        iid = i.id or uuid.uuid4().hex
+        if not i.id:
+            i = dataclasses.replace(i, id=iid)
+        with self.client.lock, self.client.conn as c:
+            c.execute(
+                "INSERT OR REPLACE INTO evaluation_instances VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    i.id, i.status, to_millis(i.start_time), to_millis(i.end_time),
+                    i.evaluation_class, i.engine_params_generator_class, i.batch,
+                    json.dumps(i.env), json.dumps(i.runtime_conf),
+                    i.evaluator_results, i.evaluator_results_html,
+                    i.evaluator_results_json,
+                ),
+            )
+        return iid
+
+    def get(self, instance_id: str) -> Optional[base.EvaluationInstance]:
+        row = self.client.conn.execute(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances WHERE id = ?",
+            (instance_id,),
+        ).fetchone()
+        return _row_to_evaluation_instance(row) if row else None
+
+    def get_all(self) -> list[base.EvaluationInstance]:
+        rows = self.client.conn.execute(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances"
+        ).fetchall()
+        return [_row_to_evaluation_instance(r) for r in rows]
+
+    def get_completed(self) -> list[base.EvaluationInstance]:
+        rows = self.client.conn.execute(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances "
+            "WHERE status = 'EVALCOMPLETED' ORDER BY start_time DESC"
+        ).fetchall()
+        return [_row_to_evaluation_instance(r) for r in rows]
+
+    def update(self, i: base.EvaluationInstance) -> bool:
+        if self.get(i.id) is None:
+            return False
+        self.insert(i)
+        return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self.client.lock, self.client.conn as c:
+            return c.execute(
+                "DELETE FROM evaluation_instances WHERE id = ?", (instance_id,)
+            ).rowcount > 0
+
+
+class SQLiteModels(base.Models):
+    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
+                 prefix: str = ""):
+        self.client = client
+
+    def insert(self, model: base.Model) -> None:
+        with self.client.lock, self.client.conn as c:
+            c.execute(
+                "INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
+                (model.id, model.models),
+            )
+
+    def get(self, model_id: str) -> Optional[base.Model]:
+        row = self.client.conn.execute(
+            "SELECT id, models FROM models WHERE id = ?", (model_id,)
+        ).fetchone()
+        return base.Model(row[0], row[1]) if row else None
+
+    def delete(self, model_id: str) -> None:
+        with self.client.lock, self.client.conn as c:
+            c.execute("DELETE FROM models WHERE id = ?", (model_id,))
+
+
+DATA_OBJECTS = {
+    "Events": SQLiteEvents,
+    "Apps": SQLiteApps,
+    "AccessKeys": SQLiteAccessKeys,
+    "Channels": SQLiteChannels,
+    "EngineInstances": SQLiteEngineInstances,
+    "EvaluationInstances": SQLiteEvaluationInstances,
+    "Models": SQLiteModels,
+}

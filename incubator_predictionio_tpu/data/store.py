@@ -1,0 +1,143 @@
+"""Engine-facing event store facade.
+
+Parity: data/.../store/{LEventStore,PEventStore,Common}.scala — resolves
+human-facing app *names* (plus optional channel names) to internal IDs, then
+delegates to the event DAO. The reference splits this facade into a local
+(iterator) and a parallel (RDD) flavor; on TPU both collapse into one
+iterator-based API whose output feeds ``parallel.ingest`` for device sharding
+(see base.Events docstring for the rationale).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
+
+from incubator_predictionio_tpu.data.datamap import PropertyMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import Storage, UNSET
+
+
+class EventStoreError(Exception):
+    pass
+
+
+def _resolve(app_name: str, channel_name: Optional[str]) -> Tuple[int, Optional[int]]:
+    """appName(+channelName) → (appId, channelId) (store/Common.scala:34-55)."""
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise EventStoreError(
+            f"Invalid app name {app_name}. Please use a valid app name."
+        )
+    if channel_name is None:
+        return app.id, None
+    channels = Storage.get_meta_data_channels().get_by_appid(app.id)
+    for c in channels:
+        if c.name == channel_name:
+            return app.id, c.id
+    raise EventStoreError(
+        f"Invalid channel name {channel_name} for app {app_name}."
+    )
+
+
+class EventStore:
+    """Query API used by DataSources (PEventStore.scala:35-130)."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        app_id, channel_id = _resolve(app_name, channel_name)
+        return Storage.get_events().find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            reversed=reversed,
+        )
+
+    @staticmethod
+    def find_by_entity(
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+    ) -> Iterator[Event]:
+        """LEventStore.findByEntity:61 — newest-first by default."""
+        return EventStore.find(
+            app_name=app_name,
+            channel_name=channel_name,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            reversed=latest,
+        )
+
+    @staticmethod
+    def aggregate_properties(
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """PEventStore.aggregateProperties:99."""
+        app_id, channel_id = _resolve(app_name, channel_name)
+        return Storage.get_events().aggregate_properties(
+            app_id=app_id,
+            channel_id=channel_id,
+            entity_type=entity_type,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+
+    @staticmethod
+    def write(
+        events: Sequence[Event],
+        app_name: str,
+        channel_name: Optional[str] = None,
+    ) -> list[str]:
+        """Bulk insert (PEvents.write:184, used by `pio import`)."""
+        app_id, channel_id = _resolve(app_name, channel_name)
+        dao = Storage.get_events()
+        return [dao.insert(e, app_id, channel_id) for e in events]
+
+    @staticmethod
+    def delete(
+        event_ids: Sequence[str],
+        app_name: str,
+        channel_name: Optional[str] = None,
+    ) -> int:
+        app_id, channel_id = _resolve(app_name, channel_name)
+        dao = Storage.get_events()
+        return sum(1 for eid in event_ids if dao.delete(eid, app_id, channel_id))

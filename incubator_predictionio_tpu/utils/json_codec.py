@@ -167,8 +167,11 @@ def _extract(cls: Any, obj: Any, lenient: bool) -> Any:
     if hook is not None:
         return hook(obj)
 
-    if isinstance(obj, cls):
-        return obj
+    try:
+        if isinstance(obj, cls):
+            return obj
+    except TypeError:
+        pass  # non-class target (e.g. subscripted generic) — fall through
     raise ExtractionError(f"Unsupported extraction target {cls!r} for {obj!r}")
 
 

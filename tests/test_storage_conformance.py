@@ -1,0 +1,277 @@
+"""Storage conformance suite: one shared behavior spec × N backends.
+
+Mirrors the reference's pattern of running the identical spec against every
+backend (data/src/test/.../storage/LEventsSpec.scala:24-52, PEventsSpec.scala).
+"""
+
+from datetime import timedelta
+
+import pytest
+
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    StorageClientConfig,
+    UNSET,
+)
+from incubator_predictionio_tpu.data.storage import memory as memory_backend
+from incubator_predictionio_tpu.data.storage import sqlite as sqlite_backend
+from incubator_predictionio_tpu.utils.times import now_utc, parse_iso8601
+
+T0 = parse_iso8601("2021-06-01T00:00:00Z")
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def backend(request):
+    config = StorageClientConfig(test=True, properties={"PATH": ":memory:"})
+    mod = {"memory": memory_backend, "sqlite": sqlite_backend}[request.param]
+    client = mod.StorageClient(config)
+    yield mod, client, config
+    client.close()
+
+
+def dao(backend, iface):
+    mod, client, config = backend
+    return mod.DATA_OBJECTS[iface](client, config, prefix="test_")
+
+
+def ev(name="rate", eid="u1", minutes=0, target=None, props=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------------
+
+def test_event_crud(backend):
+    events = dao(backend, "Events")
+    events.init(1)
+    e = ev(target="i1", props={"rating": 5})
+    eid = events.insert(e, 1)
+    got = events.get(eid, 1)
+    assert got is not None
+    assert got.event_id == eid
+    assert got.entity_id == "u1"
+    assert got.target_entity_id == "i1"
+    assert got.properties.get("rating") in (5, 5.0)
+    assert got.event_time == e.event_time
+    assert events.delete(eid, 1)
+    assert events.get(eid, 1) is None
+    assert not events.delete(eid, 1)
+
+
+def test_event_channel_isolation(backend):
+    events = dao(backend, "Events")
+    events.init(1)
+    events.init(1, 7)
+    eid = events.insert(ev(), 1, 7)
+    assert events.get(eid, 1) is None
+    assert events.get(eid, 1, 7) is not None
+    assert list(events.find(app_id=1)) == []
+    assert len(list(events.find(app_id=1, channel_id=7))) == 1
+
+
+def test_event_app_isolation_and_remove(backend):
+    events = dao(backend, "Events")
+    events.init(1)
+    events.init(2)
+    events.insert(ev(), 1)
+    events.insert(ev(), 2)
+    events.remove(1)
+    assert list(events.find(app_id=1)) == []
+    assert len(list(events.find(app_id=2))) == 1
+
+
+def test_find_filters(backend):
+    events = dao(backend, "Events")
+    events.init(1)
+    events.insert(ev("rate", "u1", 0, target="i1"), 1)
+    events.insert(ev("buy", "u1", 10, target="i2"), 1)
+    events.insert(ev("rate", "u2", 20, target="i1"), 1)
+    events.insert(ev("$set", "u3", 30, props={"a": 1}), 1)
+
+    assert len(list(events.find(app_id=1))) == 4
+    assert len(list(events.find(app_id=1, event_names=["rate"]))) == 2
+    assert len(list(events.find(app_id=1, entity_id="u1"))) == 2
+    assert len(list(events.find(app_id=1, entity_type="user"))) == 4
+    # time range: start inclusive, until exclusive
+    got = list(
+        events.find(
+            app_id=1,
+            start_time=T0 + timedelta(minutes=10),
+            until_time=T0 + timedelta(minutes=30),
+        )
+    )
+    assert [e.event for e in got] == ["buy", "rate"]
+    # target entity filtering incl. explicit None
+    assert len(list(events.find(app_id=1, target_entity_id="i1"))) == 2
+    assert len(list(events.find(app_id=1, target_entity_type=None))) == 1
+    assert len(list(events.find(app_id=1, target_entity_type="item"))) == 3
+
+
+def test_find_order_limit_reversed(backend):
+    events = dao(backend, "Events")
+    events.init(1)
+    for m in (5, 0, 10):
+        events.insert(ev("rate", "u1", m), 1)
+    asc = [e.event_time for e in events.find(app_id=1)]
+    assert asc == sorted(asc)
+    desc = [e.event_time for e in events.find(app_id=1, reversed=True)]
+    assert desc == sorted(desc, reverse=True)
+    limited = list(events.find(app_id=1, limit=2))
+    assert len(limited) == 2
+    assert list(events.find(app_id=1, limit=-1)) and len(list(events.find(app_id=1, limit=-1))) == 3
+
+
+def test_aggregate_properties_via_dao(backend):
+    events = dao(backend, "Events")
+    events.init(1)
+    events.insert(ev("$set", "u1", 0, props={"a": 1, "b": 2}), 1)
+    events.insert(ev("$unset", "u1", 1, props={"b": None}), 1)
+    events.insert(ev("$set", "u2", 0, props={"a": 9}), 1)
+    events.insert(ev("$delete", "u2", 1), 1)
+    events.insert(ev("rate", "u1", 2, target="i1"), 1)
+    out = events.aggregate_properties(app_id=1, entity_type="user")
+    assert set(out) == {"u1"}
+    assert out["u1"].fields == {"a": 1}
+    out2 = events.aggregate_properties(app_id=1, entity_type="user", required=["zz"])
+    assert out2 == {}
+
+
+# ---------------------------------------------------------------------------
+# Metadata
+# ---------------------------------------------------------------------------
+
+def test_apps(backend):
+    apps = dao(backend, "Apps")
+    app_id = apps.insert(App(0, "myapp", "desc"))
+    assert app_id
+    assert apps.get(app_id).name == "myapp"
+    assert apps.get_by_name("myapp").id == app_id
+    assert apps.insert(App(0, "myapp")) is None  # duplicate name
+    assert apps.update(App(app_id, "renamed", None))
+    assert apps.get_by_name("renamed") is not None
+    assert len(apps.get_all()) == 1
+    assert apps.delete(app_id)
+    assert apps.get(app_id) is None
+
+
+def test_access_keys(backend):
+    keys = dao(backend, "AccessKeys")
+    k = keys.insert(AccessKey("", 1, ("rate", "buy")))
+    assert k and len(k) >= 32
+    assert keys.get(k).events == ("rate", "buy")
+    k2 = keys.insert(AccessKey("explicit-key", 1))
+    assert k2 == "explicit-key"
+    assert len(keys.get_by_appid(1)) == 2
+    assert keys.get_by_appid(2) == []
+    assert keys.update(AccessKey(k, 1, ()))
+    assert keys.get(k).events == ()
+    assert keys.delete(k)
+    assert keys.get(k) is None
+
+
+def test_channels(backend):
+    channels = dao(backend, "Channels")
+    cid = channels.insert(Channel(0, "chan-1", 1))
+    assert cid
+    assert channels.get(cid).name == "chan-1"
+    assert channels.insert(Channel(0, "chan-1", 1)) is None  # dup in app
+    assert channels.insert(Channel(0, "chan-1", 2)) is not None  # other app ok
+    assert [c.id for c in channels.get_by_appid(1)] == [cid]
+    assert channels.delete(cid)
+    assert channels.get(cid) is None
+    with pytest.raises(ValueError):
+        Channel(0, "bad name!", 1)
+    with pytest.raises(ValueError):
+        Channel(0, "x" * 17, 1)
+
+
+def test_engine_instances(backend):
+    instances = dao(backend, "EngineInstances")
+    t = now_utc()
+
+    def mk(status, start, variant="v1"):
+        return EngineInstance(
+            id="", status=status, start_time=start, end_time=start,
+            engine_id="e", engine_version="1", engine_variant=variant,
+            engine_factory="f", batch="b", env={"K": "V"},
+            runtime_conf={"mesh": "2x4"}, data_source_params="dsp",
+            preparator_params="pp", algorithms_params="ap", serving_params="sp",
+        )
+
+    i1 = instances.insert(mk("INIT", t))
+    assert instances.get(i1).status == "INIT"
+    assert instances.get(i1).env == {"K": "V"}
+    i2 = instances.insert(mk("COMPLETED", t + timedelta(minutes=1)))
+    i3 = instances.insert(mk("COMPLETED", t + timedelta(minutes=2)))
+    instances.insert(mk("COMPLETED", t + timedelta(minutes=3), variant="other"))
+    latest = instances.get_latest_completed("e", "1", "v1")
+    assert latest.id == i3
+    completed = instances.get_completed("e", "1", "v1")
+    assert [i.id for i in completed] == [i3, i2]
+    import dataclasses as dc
+    assert instances.update(dc.replace(instances.get(i1), status="COMPLETED"))
+    assert instances.get(i1).status == "COMPLETED"
+    assert instances.delete(i1)
+    assert instances.get(i1) is None
+    assert len(instances.get_all()) == 3
+
+
+def test_evaluation_instances(backend):
+    instances = dao(backend, "EvaluationInstances")
+    t = now_utc()
+
+    def mk(status, start):
+        return EvaluationInstance(
+            id="", status=status, start_time=start, end_time=start,
+            evaluation_class="Eval", engine_params_generator_class="Gen",
+            batch="b", evaluator_results="res",
+            evaluator_results_html="<p>", evaluator_results_json="{}",
+        )
+
+    i1 = instances.insert(mk("EVALUATING", t))
+    i2 = instances.insert(mk("EVALCOMPLETED", t + timedelta(minutes=1)))
+    i3 = instances.insert(mk("EVALCOMPLETED", t + timedelta(minutes=2)))
+    assert [i.id for i in instances.get_completed()] == [i3, i2]
+    assert instances.get(i1).evaluation_class == "Eval"
+    assert instances.delete(i2)
+    assert [i.id for i in instances.get_completed()] == [i3]
+
+
+def test_models(backend):
+    models = dao(backend, "Models")
+    blob = b"\x00\x01binary\xff"
+    models.insert(Model("m1", blob))
+    assert models.get("m1").models == blob
+    models.insert(Model("m1", b"new"))
+    assert models.get("m1").models == b"new"
+    models.delete("m1")
+    assert models.get("m1") is None
+
+
+def test_localfs_models(tmp_path):
+    from incubator_predictionio_tpu.data.storage import localfs
+
+    config = StorageClientConfig(properties={"PATH": str(tmp_path)})
+    client = localfs.StorageClient(config)
+    models = localfs.LocalFSModels(client, config, prefix="pio_")
+    models.insert(Model("m1", b"blob"))
+    assert (tmp_path / "pio_m1").exists()
+    assert models.get("m1").models == b"blob"
+    models.delete("m1")
+    assert models.get("m1") is None

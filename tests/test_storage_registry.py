@@ -1,0 +1,88 @@
+"""Storage registry env parsing (parity: Storage.scala:117-407)."""
+
+import pytest
+
+from incubator_predictionio_tpu.data.storage import (
+    App,
+    Storage,
+    StorageError,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_storage():
+    yield
+    Storage.reset()
+
+
+MEM_ENV = {
+    "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+    "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "pio_meta",
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio_event",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "pio_model",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+}
+
+
+def test_env_driven_memory_backend():
+    Storage.configure(MEM_ENV)
+    assert Storage.verify_all_data_objects()
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "a1"))
+    # same source yields same underlying client
+    assert Storage.get_meta_data_apps().get(app_id).name == "a1"
+
+
+def test_split_sources():
+    env = dict(MEM_ENV)
+    env["PIO_STORAGE_SOURCES_MEM2_TYPE"] = "memory"
+    env["PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE"] = "MEM2"
+    Storage.configure(env)
+    assert Storage.verify_all_data_objects()
+
+
+def test_unknown_backend_type():
+    Storage.configure({
+        **MEM_ENV, "PIO_STORAGE_SOURCES_MEM_TYPE": "hbase",
+    })
+    with pytest.raises(StorageError):
+        Storage.get_meta_data_apps()
+
+
+def test_missing_type():
+    Storage.configure({
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NOPE",
+        "PIO_STORAGE_SOURCES_NOPE_PATH": ":memory:",
+    })
+    with pytest.raises(StorageError):
+        Storage.get_meta_data_apps()
+
+
+def test_default_zero_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_HOME", str(tmp_path))
+    Storage.configure({})
+    assert Storage.verify_all_data_objects()
+    assert (tmp_path / "store" / "pio.db").exists()
+
+
+def test_event_store_facade():
+    Storage.configure(MEM_ENV)
+    from incubator_predictionio_tpu.data.event import Event
+    from incubator_predictionio_tpu.data.store import EventStore, EventStoreError
+
+    apps = Storage.get_meta_data_apps()
+    apps.insert(App(0, "facade-app"))
+    EventStore.write(
+        [Event(event="rate", entity_type="user", entity_id="u1",
+               target_entity_type="item", target_entity_id="i1")],
+        app_name="facade-app",
+    )
+    got = list(EventStore.find(app_name="facade-app", event_names=["rate"]))
+    assert len(got) == 1
+    with pytest.raises(EventStoreError):
+        list(EventStore.find(app_name="no-such-app"))
+    with pytest.raises(EventStoreError):
+        list(EventStore.find(app_name="facade-app", channel_name="nope"))

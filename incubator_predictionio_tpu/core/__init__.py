@@ -1,0 +1,54 @@
+"""The DASE abstraction: typed base contracts + the Engine controller.
+
+Reference layers L3 (core/src/main/scala/.../core/) and L4
+(core/src/main/scala/.../controller/) of SURVEY.md §1.
+"""
+
+from incubator_predictionio_tpu.core.base import (
+    Algorithm,
+    DataSource,
+    EmptyParams,
+    Params,
+    Preparator,
+    IdentityPreparator,
+    SanityCheck,
+    Serving,
+    FirstServing,
+    AverageServing,
+    StopAfterReadInterruption,
+    StopAfterPrepareInterruption,
+    doer,
+    params_class_of,
+)
+from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
+from incubator_predictionio_tpu.core.engine import Engine, EngineFactory
+from incubator_predictionio_tpu.core.metrics import (
+    Metric,
+    AverageMetric,
+    OptionAverageMetric,
+    StdevMetric,
+    OptionStdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from incubator_predictionio_tpu.core.evaluation import (
+    Evaluation,
+    MetricEvaluator,
+    MetricScores,
+)
+from incubator_predictionio_tpu.core.persistent_model import (
+    PersistentModel,
+    LocalFileSystemPersistentModel,
+)
+
+__all__ = [
+    "Algorithm", "DataSource", "EmptyParams", "Params", "Preparator",
+    "IdentityPreparator", "SanityCheck", "Serving", "FirstServing",
+    "AverageServing", "StopAfterReadInterruption",
+    "StopAfterPrepareInterruption", "doer", "params_class_of",
+    "EngineParams", "WorkflowParams", "Engine", "EngineFactory",
+    "Metric", "AverageMetric", "OptionAverageMetric", "StdevMetric",
+    "OptionStdevMetric", "SumMetric", "ZeroMetric",
+    "Evaluation", "MetricEvaluator", "MetricScores",
+    "PersistentModel", "LocalFileSystemPersistentModel",
+]

@@ -1,0 +1,249 @@
+"""Base SPI — the typed contracts every DASE component implements.
+
+Parity with reference L3 (core/.../core/Base{DataSource,Preparator,Algorithm,
+Serving,Evaluator}.scala, AbstractDoer.scala) and the user-facing L4
+controller bases (core/.../controller/{L,P,P2L}Algorithm.scala,
+{L,P}DataSource.scala, {L,P}Preparator.scala, LServing.scala).
+
+**The L/P split collapses by design.** The reference needs three algorithm
+flavors because a model is either driver-local (L), RDD-distributed (P), or
+trained-distributed-then-localized (P2L). On TPU every model is a pytree
+whose arrays live on the mesh; "local vs distributed" is a sharding
+annotation, not a class hierarchy. One ``Algorithm`` base therefore covers
+LAlgorithm:45 / PAlgorithm:47 / P2LAlgorithm:46, and one ``DataSource`` /
+``Preparator`` covers both flavors. This behavioral delta is intentional and
+documented (SURVEY.md §7 hard part (f)).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import inspect
+import typing
+from typing import Any, Generic, List, Optional, Sequence, Tuple, Type, TypeVar
+
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+TD = TypeVar("TD")  # training data
+EI = TypeVar("EI")  # evaluation info
+PD = TypeVar("PD")  # prepared data
+Q = TypeVar("Q")    # query
+P = TypeVar("P")    # predicted result
+A = TypeVar("A")    # actual result
+M = TypeVar("M")    # model
+R = TypeVar("R")    # metric result
+
+
+class Params:
+    """Marker base for component parameter dataclasses
+    (controller/Params.scala:32). Subclasses should be ``@dataclass``es."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    """controller/Params.scala EmptyParams."""
+
+
+class SanityCheck(abc.ABC):
+    """Data classes may implement this to participate in the train-time
+    sanity check (core/.../core/SanityCheck.scala; called from
+    Engine.scala:652-708)."""
+
+    @abc.abstractmethod
+    def sanity_check(self) -> None:
+        """Raise if the data is invalid."""
+
+
+class StopAfterReadInterruption(Exception):
+    """Engine.scala:668 — raised when WorkflowParams.stop_after_read."""
+
+
+class StopAfterPrepareInterruption(Exception):
+    """Engine.scala:689 — raised when WorkflowParams.stop_after_prepare."""
+
+
+# ---------------------------------------------------------------------------
+# Doer — component instantiation from Params (AbstractDoer.scala:33-60)
+# ---------------------------------------------------------------------------
+
+def doer(cls: Type[Any], params: Params) -> Any:
+    """Instantiate a component: try ctor(params), else no-arg ctor.
+
+    The reference does this reflectively over JVM constructors
+    (AbstractDoer.scala:40-59); here we inspect the Python signature once.
+    """
+    sig = inspect.signature(cls.__init__)
+    positional = [
+        p
+        for name, p in list(sig.parameters.items())[1:]  # skip self
+        if p.kind
+        in (inspect.Parameter.POSITIONAL_ONLY, inspect.Parameter.POSITIONAL_OR_KEYWORD)
+    ]
+    if positional:
+        return cls(params)
+    return cls()
+
+
+def params_class_of(cls: Type[Any]) -> Optional[Type[Params]]:
+    """The Params dataclass a component's constructor expects, if any.
+
+    Resolution order: explicit ``params_class`` attribute, then the type
+    annotation of the first constructor argument. Used by
+    ``Engine.jvalue_to_engine_params`` to type engine.json params the way the
+    reference recovers them from manifest class info
+    (WorkflowUtils.extractParams, core/.../workflow/WorkflowUtils.scala:134).
+    """
+    explicit = getattr(cls, "params_class", None)
+    if explicit is not None:
+        return explicit
+    try:
+        hints = typing.get_type_hints(cls.__init__)
+    except Exception:
+        hints = {}
+    sig = inspect.signature(cls.__init__)
+    for name, p in list(sig.parameters.items())[1:]:
+        if p.kind in (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        ):
+            hint = hints.get(name)
+            if isinstance(hint, type) and issubclass(hint, Params):
+                return hint
+            return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# DASE bases
+# ---------------------------------------------------------------------------
+
+class _Component:
+    """Common base: stores params like the reference's ctor convention."""
+
+    def __init__(self, params: Params = EmptyParams()):
+        self.params = params
+
+
+class DataSource(_Component, Generic[TD, EI, Q, A]):
+    """Reads training and evaluation data from the event store.
+
+    Parity: core/BaseDataSource.scala:43-54 + controller/{P,L}DataSource.scala.
+    """
+
+    def read_training(self, ctx: RuntimeContext) -> TD:
+        raise NotImplementedError
+
+    def read_eval(
+        self, ctx: RuntimeContext
+    ) -> List[Tuple[TD, EI, List[Tuple[Q, A]]]]:
+        """Evaluation data: (training set, eval info, (query, actual) pairs)
+        per fold (PDataSource.readEval:55). Default: no eval data."""
+        return []
+
+
+class Preparator(_Component, Generic[TD, PD]):
+    """Transforms training data into algorithm input
+    (core/BasePreparator.scala:44, controller/{P,L}Preparator.scala)."""
+
+    def prepare(self, ctx: RuntimeContext, training_data: TD) -> PD:
+        raise NotImplementedError
+
+
+class IdentityPreparator(Preparator[TD, TD]):
+    """Pass-through (controller/IdentityPreparator.scala:34,59)."""
+
+    def prepare(self, ctx: RuntimeContext, training_data: TD) -> TD:
+        return training_data
+
+
+class Algorithm(_Component, Generic[PD, M, Q, P]):
+    """Trains a model and answers queries.
+
+    Parity: core/BaseAlgorithm.scala:69-111 and all three controller
+    algorithm flavors (see module docstring). Models should be pytrees of
+    device arrays (+ host-side index maps such as BiMap); ``predict`` should
+    be wrapped in ``jax.jit`` by the implementation with the model donated /
+    device-resident so serving never re-stages weights.
+    """
+
+    def train(self, ctx: RuntimeContext, prepared_data: PD) -> M:
+        raise NotImplementedError
+
+    def predict(self, model: M, query: Q) -> P:
+        raise NotImplementedError
+
+    def batch_predict(
+        self, model: M, queries: Sequence[Tuple[int, Q]]
+    ) -> List[Tuple[int, P]]:
+        """Batch prediction for evaluation (BaseAlgorithm.batchPredictBase:81).
+
+        Default loops ``predict``; TPU implementations should override with a
+        single jitted batched call (the MXU wants one big matmul, not Q small
+        ones).
+        """
+        return [(qx, self.predict(model, q)) for qx, q in queries]
+
+    @property
+    def query_class(self) -> Optional[type]:
+        """Query dataclass for JSON extraction at the server edge
+        (BaseAlgorithm.queryClass via TypeToken, BaseAlgorithm.scala:117).
+
+        Resolution: explicit ``query_class_`` attribute, else the type
+        annotation of ``predict``'s query argument.
+        """
+        explicit = getattr(self, "query_class_", None)
+        if explicit is not None:
+            return explicit
+        try:
+            hints = typing.get_type_hints(self.predict)
+        except Exception:
+            return None
+        sig = inspect.signature(self.predict)
+        names = [n for n in sig.parameters if n != "self"]
+        if len(names) >= 2:
+            hint = hints.get(names[1])
+            if isinstance(hint, type):
+                return hint
+        return None
+
+
+class Serving(_Component, Generic[Q, P]):
+    """Combines per-algorithm predictions into the served result
+    (core/BaseServing.scala:41-53, controller/LServing.scala:30-54)."""
+
+    def supplement(self, query: Q) -> Q:
+        """Pre-process the query before algorithms see it (LServing.supplement:41)."""
+        return query
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        raise NotImplementedError
+
+
+class FirstServing(Serving[Q, P]):
+    """Serve the first algorithm's prediction (controller/LFirstServing.scala)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(Serving[Q, float]):
+    """Average numeric predictions (controller/LAverageServing.scala)."""
+
+    def serve(self, query: Q, predictions: Sequence[float]) -> float:
+        return sum(predictions) / len(predictions)
+
+
+class Evaluator(_Component, Generic[EI, Q, P, A, R]):
+    """Scores evaluation output (core/BaseEvaluator.scala:52)."""
+
+    def evaluate(
+        self,
+        ctx: RuntimeContext,
+        evaluation: Any,
+        engine_eval_data_set: Sequence[
+            Tuple[Any, Sequence[Tuple[EI, Sequence[Tuple[Q, P, A]]]]]
+        ],
+        params: Any,
+    ) -> R:
+        raise NotImplementedError

@@ -1,0 +1,278 @@
+"""Engine — the DASE composition and train/eval orchestration.
+
+Parity: controller/Engine.scala:83-832. The Engine holds *class maps* for
+each DASE slot (multiple named implementations; params select by name),
+instantiates components through :func:`doer`, and orchestrates:
+
+- ``train``  (Engine.scala:625-712): read → sanity → prepare → sanity →
+  per-algorithm train → sanity.
+- ``eval``   (Engine.scala:730-820): per eval-set train + per-algorithm
+  batch predict + serve join, with the *original* (unsupplemented) query
+  passed to ``serve``.
+- ``jvalue_to_engine_params`` (Engine.scala:357-420): engine.json variant →
+  typed EngineParams.
+- ``prepare_deploy`` (Engine.scala:199-269): restore checkpointed models into
+  servable (device-resident) form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+from incubator_predictionio_tpu.core import base
+from incubator_predictionio_tpu.core.base import (
+    Algorithm,
+    DataSource,
+    EmptyParams,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    doer,
+    params_class_of,
+)
+from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.utils import json_codec
+
+logger = logging.getLogger(__name__)
+
+
+def _as_class_map(spec: Any) -> Dict[str, type]:
+    """Accept a single class or a name→class dict (Engine.scala:500-560
+    companion constructors do the same normalization)."""
+    if isinstance(spec, dict):
+        return dict(spec)
+    return {"": spec}
+
+
+def _select(class_map: Dict[str, type], name: str, slot: str) -> type:
+    if name in class_map:
+        return class_map[name]
+    if name == "" and len(class_map) == 1:
+        return next(iter(class_map.values()))
+    raise ValueError(
+        f"{slot} has no component named {name!r} (registered: {sorted(class_map)})"
+    )
+
+
+def _sanity(obj: Any, skip: bool) -> None:
+    if skip:
+        return
+    if isinstance(obj, SanityCheck):
+        logger.info("%s supports data sanity check. Performing check.",
+                    type(obj).__name__)
+        obj.sanity_check()
+
+
+class Engine:
+    """The DASE engine (controller/Engine.scala:83)."""
+
+    def __init__(
+        self,
+        data_source_class_map: Any,
+        preparator_class_map: Any,
+        algorithm_class_map: Any,
+        serving_class_map: Any,
+    ):
+        self.data_source_class_map = _as_class_map(data_source_class_map)
+        self.preparator_class_map = _as_class_map(preparator_class_map)
+        self.algorithm_class_map = _as_class_map(algorithm_class_map)
+        self.serving_class_map = _as_class_map(serving_class_map)
+
+    # -- component instantiation ------------------------------------------
+    def _components(
+        self, engine_params: EngineParams
+    ) -> Tuple[DataSource, Preparator, List[Algorithm], Serving]:
+        ds_name, ds_params = engine_params.data_source_params
+        prep_name, prep_params = engine_params.preparator_params
+        serv_name, serv_params = engine_params.serving_params
+        data_source = doer(
+            _select(self.data_source_class_map, ds_name, "dataSource"), ds_params
+        )
+        preparator = doer(
+            _select(self.preparator_class_map, prep_name, "preparator"), prep_params
+        )
+        algo_list = [
+            doer(_select(self.algorithm_class_map, name, "algorithm"), params)
+            for name, params in (engine_params.algorithm_params_list or [("", EmptyParams())])
+        ]
+        serving = doer(
+            _select(self.serving_class_map, serv_name, "serving"), serv_params
+        )
+        return data_source, preparator, algo_list, serving
+
+    def algorithms(self, engine_params: EngineParams) -> List[Algorithm]:
+        return self._components(engine_params)[2]
+
+    def serving(self, engine_params: EngineParams) -> Serving:
+        return self._components(engine_params)[3]
+
+    # -- training (Engine.scala:625-712) ----------------------------------
+    def train(
+        self,
+        ctx: RuntimeContext,
+        engine_params: EngineParams,
+        params: Optional[WorkflowParams] = None,
+    ) -> List[Any]:
+        params = params or WorkflowParams()
+        data_source, preparator, algo_list, _ = self._components(engine_params)
+        logger.info("Engine.train: ds=%s prep=%s algos=%s",
+                    type(data_source).__name__, type(preparator).__name__,
+                    [type(a).__name__ for a in algo_list])
+
+        td = data_source.read_training(ctx)
+        _sanity(td, params.skip_sanity_check)
+        if params.stop_after_read:
+            raise StopAfterReadInterruption()
+
+        pd = preparator.prepare(ctx, td)
+        _sanity(pd, params.skip_sanity_check)
+        if params.stop_after_prepare:
+            raise StopAfterPrepareInterruption()
+
+        models = [algo.train(ctx, pd) for algo in algo_list]
+        for model in models:
+            _sanity(model, params.skip_sanity_check)
+        return models
+
+    # -- evaluation (Engine.scala:730-820) --------------------------------
+    def eval(
+        self,
+        ctx: RuntimeContext,
+        engine_params: EngineParams,
+        params: Optional[WorkflowParams] = None,
+    ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        """Returns [(eval_info, [(query, served_prediction, actual)])]."""
+        params = params or WorkflowParams()
+        data_source, preparator, algo_list, serving = self._components(engine_params)
+
+        eval_sets = data_source.read_eval(ctx)
+        out: List[Tuple[Any, List[Tuple[Any, Any, Any]]]] = []
+        for td, eval_info, qa_pairs in eval_sets:
+            pd = preparator.prepare(ctx, td)
+            models = [algo.train(ctx, pd) for algo in algo_list]
+
+            qa_indexed = list(enumerate(qa_pairs))
+            supplemented = [
+                (qx, serving.supplement(q)) for qx, (q, a) in qa_indexed
+            ]
+            # per-algorithm batch predict over the supplemented queries,
+            # joined back by query index, ordered by algorithm index
+            predictions_by_qx: Dict[int, List[Any]] = {
+                qx: [] for qx, _ in supplemented
+            }
+            for algo, model in zip(algo_list, models):
+                for qx, p in algo.batch_predict(model, supplemented):
+                    predictions_by_qx[qx].append(p)
+            qpa: List[Tuple[Any, Any, Any]] = []
+            for qx, (q, a) in qa_indexed:
+                ps = predictions_by_qx[qx]
+                assert len(ps) == len(algo_list), (
+                    "Must have one prediction per algorithm"
+                )
+                # serve sees the ORIGINAL query (Engine.scala:805-808)
+                qpa.append((q, serving.serve(q, ps), a))
+            out.append((eval_info, qpa))
+        return out
+
+    def batch_eval(
+        self,
+        ctx: RuntimeContext,
+        engine_params_list: Sequence[EngineParams],
+        params: Optional[WorkflowParams] = None,
+    ) -> List[Tuple[EngineParams, List[Tuple[Any, List[Tuple[Any, Any, Any]]]]]]:
+        """BaseEngine.batchEval:82 — evaluate every candidate EngineParams."""
+        return [
+            (ep, self.eval(ctx, ep, params)) for ep in engine_params_list
+        ]
+
+    # -- deploy-time model restoration (Engine.scala:199-269) --------------
+    def prepare_deploy(
+        self,
+        ctx: RuntimeContext,
+        engine_params: EngineParams,
+        engine_instance_id: str,
+        models: List[Any],
+        params: Optional[WorkflowParams] = None,
+    ) -> List[Any]:
+        """Turn checkpointed models into servable models.
+
+        Reference semantics: Unit models (non-serializable RDD models) are
+        silently *retrained* at deploy (Engine.scala:211-233); PersistentModel
+        manifests are loaded via their companion loader (:241-255). Here every
+        directly-checkpointable model passes through unchanged; PersistentModel
+        manifests load through ``PersistentModel.load``; and a ``RetrainMarker``
+        (the explicit replacement for the silent-Unit behavior) triggers
+        retraining.
+        """
+        from incubator_predictionio_tpu.core.persistent_model import (
+            PersistentModelManifest,
+            RetrainMarker,
+        )
+
+        algo_list = self.algorithms(engine_params)
+        if len(models) != len(algo_list):
+            raise ValueError(
+                f"{len(models)} models for {len(algo_list)} algorithms"
+            )
+        if any(isinstance(m, RetrainMarker) for m in models):
+            logger.info("Some models are retrain markers; retraining at deploy.")
+            trained = self.train(ctx, engine_params, params)
+        else:
+            trained = models
+        out: List[Any] = []
+        for algo, model in zip(algo_list, trained):
+            if isinstance(model, PersistentModelManifest):
+                algo_params = algo.params
+                out.append(model.load(algo_params, ctx))
+            else:
+                out.append(model)
+        return out
+
+    # -- engine.json params extraction (Engine.scala:357-420) ---------------
+    def jvalue_to_engine_params(
+        self, variant: Dict[str, Any], lenient: bool = True
+    ) -> EngineParams:
+        def one(slot: str, class_map: Dict[str, type], obj: Any) -> Tuple[str, Params]:
+            if obj is None:
+                return ("", EmptyParams())
+            name = obj.get("name", "") if isinstance(obj, dict) else ""
+            raw = obj.get("params", {}) if isinstance(obj, dict) else {}
+            cls = _select(class_map, name, slot)
+            pcls = params_class_of(cls)
+            if pcls is None:
+                return (name, EmptyParams() if not raw else raw)
+            return (name, json_codec.extract(pcls, raw, lenient=lenient))
+
+        algorithms = variant.get("algorithms")
+        algo_params: List[Tuple[str, Params]] = []
+        if algorithms:
+            for spec in algorithms:
+                algo_params.append(one("algorithm", self.algorithm_class_map, spec))
+        return EngineParams(
+            data_source_params=one(
+                "dataSource", self.data_source_class_map, variant.get("datasource")
+            ),
+            preparator_params=one(
+                "preparator", self.preparator_class_map, variant.get("preparator")
+            ),
+            algorithm_params_list=algo_params,
+            serving_params=one(
+                "serving", self.serving_class_map, variant.get("serving")
+            ),
+        )
+
+
+class EngineFactory:
+    """controller/EngineFactory.scala — subclass and implement ``apply``."""
+
+    def apply(self) -> Engine:
+        raise NotImplementedError
+
+    def engine_params(self, variant: Dict[str, Any]) -> EngineParams:
+        return self.apply().jvalue_to_engine_params(variant)

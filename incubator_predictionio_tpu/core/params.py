@@ -1,0 +1,83 @@
+"""EngineParams and WorkflowParams.
+
+Parity: controller/EngineParams.scala:35-152 (named per-component params
+bundle) and workflow/WorkflowParams.scala (run controls). ``sparkEnv`` is
+replaced by ``runtime_conf`` (mesh/XLA settings).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from incubator_predictionio_tpu.core.base import EmptyParams, Params
+from incubator_predictionio_tpu.utils import json_codec
+
+
+@dataclasses.dataclass
+class EngineParams:
+    """Named (component-name, params) for every DASE slot.
+
+    Component names select entries of the Engine's class maps; ``""`` selects
+    the single registered component (EngineParams.scala:55-83 uses the same
+    convention).
+    """
+
+    data_source_params: Tuple[str, Params] = ("", EmptyParams())
+    preparator_params: Tuple[str, Params] = ("", EmptyParams())
+    algorithm_params_list: List[Tuple[str, Params]] = dataclasses.field(
+        default_factory=list
+    )
+    serving_params: Tuple[str, Params] = ("", EmptyParams())
+
+    # -- builder API (EngineParams.Builder, EngineParams.scala:104-152) ----
+    def with_data_source(self, params: Params, name: str = "") -> "EngineParams":
+        return dataclasses.replace(self, data_source_params=(name, params))
+
+    def with_preparator(self, params: Params, name: str = "") -> "EngineParams":
+        return dataclasses.replace(self, preparator_params=(name, params))
+
+    def with_algorithms(
+        self, *named: Tuple[str, Params]
+    ) -> "EngineParams":
+        return dataclasses.replace(self, algorithm_params_list=list(named))
+
+    def with_serving(self, params: Params, name: str = "") -> "EngineParams":
+        return dataclasses.replace(self, serving_params=(name, params))
+
+    def to_jsonable(self) -> Dict[str, Any]:
+        def comp(pair: Tuple[str, Params]) -> Dict[str, Any]:
+            return {"name": pair[0], "params": json_codec.to_jsonable(pair[1])}
+
+        return {
+            "dataSourceParams": comp(self.data_source_params),
+            "preparatorParams": comp(self.preparator_params),
+            "algorithmParamsList": [comp(ap) for ap in self.algorithm_params_list],
+            "servingParams": comp(self.serving_params),
+        }
+
+    def key(self) -> str:
+        """Stable serialization, used by FastEvalEngine prefix caches."""
+        import json
+
+        return json.dumps(self.to_jsonable(), sort_keys=True)
+
+
+class EngineParamsGenerator:
+    """Holder of candidate EngineParams lists for tuning
+    (controller/EngineParamsGenerator.scala). Subclass and set
+    ``engine_params_list``."""
+
+    engine_params_list: List[EngineParams] = []
+
+
+@dataclasses.dataclass
+class WorkflowParams:
+    """workflow/WorkflowParams.scala — training run controls."""
+
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+    runtime_conf: Dict[str, str] = dataclasses.field(default_factory=dict)

@@ -136,6 +136,13 @@ class Storage:
         source = env.get(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE")
         if name and source:
             return name, source
+        if name or source:
+            # Half-configured repository is a misconfiguration, not a
+            # fall-through (Storage.scala errors on incomplete repo config).
+            raise StorageError(
+                f"Repository {repo} needs BOTH PIO_STORAGE_REPOSITORIES_{repo}"
+                f"_NAME and _SOURCE (got NAME={name!r}, SOURCE={source!r})"
+            )
         # Defaults: one SQLite source for everything (zero-config single box).
         return {
             MetaDataRepository: ("pio_meta", "DEFAULT"),

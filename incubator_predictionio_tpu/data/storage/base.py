@@ -198,7 +198,9 @@ class Events(abc.ABC):
         required: Optional[Sequence[str]] = None,
     ) -> Dict[str, PropertyMap]:
         """Aggregate special events into entity state
-        (LEvents.futureAggregateProperties:194-230)."""
+        (LEvents.futureAggregateProperties:194-230). ``required`` keeps only
+        entities that have ALL the named *properties* defined
+        (LEvents.scala:190,211-214)."""
         from incubator_predictionio_tpu.data.aggregator import (
             AGGREGATOR_EVENT_NAMES,
             aggregate_properties,
@@ -214,7 +216,10 @@ class Events(abc.ABC):
         )
         result = aggregate_properties(events)
         if required is not None:
-            result = {k: v for k, v in result.items() if k in required}
+            result = {
+                k: v for k, v in result.items()
+                if all(prop in v for prop in required)
+            }
         return result
 
 

@@ -3,12 +3,15 @@
 The reference gains the same capability through JDBC-against-test-DBs plus
 ``StorageClientConfig.test`` (Storage.scala:62,78-81); here an explicit
 in-memory backend keeps the conformance suite hermetic.
+
+Repository namespaces (``PIO_STORAGE_REPOSITORIES_<REPO>_NAME``) isolate
+tables exactly like the reference's namespaced HBase tables / JDBC table
+prefixes: each DAO operates on the per-namespace table set for its prefix.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import threading
 import uuid
 from datetime import datetime
@@ -19,12 +22,10 @@ from incubator_predictionio_tpu.data.storage import base
 from incubator_predictionio_tpu.data.storage.base import UNSET
 
 
-class StorageClient(base.BaseStorageClient):
-    """Holds all in-memory tables for one source."""
+class _Namespace:
+    """One repository namespace's tables."""
 
-    def __init__(self, config: base.StorageClientConfig):
-        super().__init__(config)
-        self.lock = threading.RLock()
+    def __init__(self) -> None:
         # (app_id, channel_id) -> {event_id: Event}
         self.events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
         self.apps: Dict[int, base.App] = {}
@@ -33,10 +34,27 @@ class StorageClient(base.BaseStorageClient):
         self.engine_instances: Dict[str, base.EngineInstance] = {}
         self.evaluation_instances: Dict[str, base.EvaluationInstance] = {}
         self.models: Dict[str, base.Model] = {}
-        self._counter = itertools.count(1)
+        self._next = 1
 
-    def next_id(self) -> int:
-        return next(self._counter)
+    def next_free_id(self, taken: Dict[int, Any]) -> int:
+        while self._next in taken:
+            self._next += 1
+        out = self._next
+        self._next += 1
+        return out
+
+
+class StorageClient(base.BaseStorageClient):
+    """Holds all in-memory namespaces for one source."""
+
+    def __init__(self, config: base.StorageClientConfig):
+        super().__init__(config)
+        self.lock = threading.RLock()
+        self.namespaces: Dict[str, _Namespace] = {}
+
+    def ns(self, prefix: str) -> _Namespace:
+        with self.lock:
+            return self.namespaces.setdefault(prefix, _Namespace())
 
     def close(self) -> None:
         pass
@@ -69,13 +87,16 @@ def _match(
     return True
 
 
-class MemoryEvents(base.Events):
+class _MemoryDAO:
     def __init__(self, client: StorageClient, config: base.StorageClientConfig,
                  prefix: str = ""):
         self.client = client
+        self.t = client.ns(prefix)
 
+
+class MemoryEvents(_MemoryDAO, base.Events):
     def _table(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
-        return self.client.events.setdefault((app_id, channel_id), {})
+        return self.t.events.setdefault((app_id, channel_id), {})
 
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self.client.lock:
@@ -84,7 +105,7 @@ class MemoryEvents(base.Events):
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self.client.lock:
-            self.client.events.pop((app_id, channel_id), None)
+            self.t.events.pop((app_id, channel_id), None)
         return True
 
     def close(self) -> None:
@@ -135,136 +156,137 @@ class MemoryEvents(base.Events):
         return iter(rows)
 
 
-class MemoryApps(base.Apps):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class MemoryApps(_MemoryDAO, base.Apps):
     def insert(self, app: base.App) -> Optional[int]:
         with self.client.lock:
-            app_id = app.id if app.id != 0 else self.client.next_id()
-            if app_id in self.client.apps:
+            if any(a.name == app.name for a in self.t.apps.values()):
                 return None
-            if any(a.name == app.name for a in self.client.apps.values()):
-                return None
-            self.client.apps[app_id] = base.App(app_id, app.name, app.description)
+            if app.id != 0:
+                if app.id in self.t.apps:
+                    return None
+                app_id = app.id
+            else:
+                app_id = self.t.next_free_id(self.t.apps)
+            self.t.apps[app_id] = base.App(app_id, app.name, app.description)
             return app_id
 
     def get(self, app_id: int) -> Optional[base.App]:
-        return self.client.apps.get(app_id)
+        with self.client.lock:
+            return self.t.apps.get(app_id)
 
     def get_by_name(self, name: str) -> Optional[base.App]:
-        return next(
-            (a for a in self.client.apps.values() if a.name == name), None
-        )
+        with self.client.lock:
+            return next(
+                (a for a in self.t.apps.values() if a.name == name), None
+            )
 
     def get_all(self) -> list[base.App]:
-        return list(self.client.apps.values())
+        with self.client.lock:
+            return list(self.t.apps.values())
 
     def update(self, app: base.App) -> bool:
         with self.client.lock:
-            if app.id not in self.client.apps:
+            if app.id not in self.t.apps:
                 return False
-            self.client.apps[app.id] = app
+            self.t.apps[app.id] = app
             return True
 
     def delete(self, app_id: int) -> bool:
         with self.client.lock:
-            return self.client.apps.pop(app_id, None) is not None
+            return self.t.apps.pop(app_id, None) is not None
 
 
-class MemoryAccessKeys(base.AccessKeys):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class MemoryAccessKeys(_MemoryDAO, base.AccessKeys):
     def insert(self, k: base.AccessKey) -> Optional[str]:
         with self.client.lock:
             key = k.key or base.generate_access_key()
-            if key in self.client.access_keys:
+            if key in self.t.access_keys:
                 return None
-            self.client.access_keys[key] = base.AccessKey(key, k.appid, tuple(k.events))
+            self.t.access_keys[key] = base.AccessKey(key, k.appid, tuple(k.events))
             return key
 
     def get(self, key: str) -> Optional[base.AccessKey]:
-        return self.client.access_keys.get(key)
+        with self.client.lock:
+            return self.t.access_keys.get(key)
 
     def get_all(self) -> list[base.AccessKey]:
-        return list(self.client.access_keys.values())
+        with self.client.lock:
+            return list(self.t.access_keys.values())
 
     def get_by_appid(self, appid: int) -> list[base.AccessKey]:
-        return [k for k in self.client.access_keys.values() if k.appid == appid]
+        with self.client.lock:
+            return [k for k in self.t.access_keys.values() if k.appid == appid]
 
     def update(self, k: base.AccessKey) -> bool:
         with self.client.lock:
-            if k.key not in self.client.access_keys:
+            if k.key not in self.t.access_keys:
                 return False
-            self.client.access_keys[k.key] = k
+            self.t.access_keys[k.key] = k
             return True
 
     def delete(self, key: str) -> bool:
         with self.client.lock:
-            return self.client.access_keys.pop(key, None) is not None
+            return self.t.access_keys.pop(key, None) is not None
 
 
-class MemoryChannels(base.Channels):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class MemoryChannels(_MemoryDAO, base.Channels):
     def insert(self, channel: base.Channel) -> Optional[int]:
         with self.client.lock:
-            cid = channel.id if channel.id != 0 else self.client.next_id()
-            if cid in self.client.channels:
-                return None
             if any(
                 c.appid == channel.appid and c.name == channel.name
-                for c in self.client.channels.values()
+                for c in self.t.channels.values()
             ):
                 return None
-            self.client.channels[cid] = base.Channel(cid, channel.name, channel.appid)
+            if channel.id != 0:
+                if channel.id in self.t.channels:
+                    return None
+                cid = channel.id
+            else:
+                cid = self.t.next_free_id(self.t.channels)
+            self.t.channels[cid] = base.Channel(cid, channel.name, channel.appid)
             return cid
 
     def get(self, channel_id: int) -> Optional[base.Channel]:
-        return self.client.channels.get(channel_id)
+        with self.client.lock:
+            return self.t.channels.get(channel_id)
 
     def get_by_appid(self, appid: int) -> list[base.Channel]:
-        return [c for c in self.client.channels.values() if c.appid == appid]
+        with self.client.lock:
+            return [c for c in self.t.channels.values() if c.appid == appid]
 
     def delete(self, channel_id: int) -> bool:
         with self.client.lock:
-            return self.client.channels.pop(channel_id, None) is not None
+            return self.t.channels.pop(channel_id, None) is not None
 
 
-class MemoryEngineInstances(base.EngineInstances):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class MemoryEngineInstances(_MemoryDAO, base.EngineInstances):
     def insert(self, i: base.EngineInstance) -> str:
         with self.client.lock:
             iid = i.id or uuid.uuid4().hex
-            self.client.engine_instances[iid] = (
+            self.t.engine_instances[iid] = (
                 i if i.id else dataclasses.replace(i, id=iid)
             )
             return iid
 
     def get(self, instance_id: str) -> Optional[base.EngineInstance]:
-        return self.client.engine_instances.get(instance_id)
+        with self.client.lock:
+            return self.t.engine_instances.get(instance_id)
 
     def get_all(self) -> list[base.EngineInstance]:
-        return list(self.client.engine_instances.values())
+        with self.client.lock:
+            return list(self.t.engine_instances.values())
 
     def get_completed(
         self, engine_id: str, engine_version: str, engine_variant: str
     ) -> list[base.EngineInstance]:
-        rows = [
-            i for i in self.client.engine_instances.values()
-            if i.status == "COMPLETED"
-            and i.engine_id == engine_id
-            and i.engine_version == engine_version
-            and i.engine_variant == engine_variant
-        ]
+        with self.client.lock:
+            rows = [
+                i for i in self.t.engine_instances.values()
+                if i.status == "COMPLETED"
+                and i.engine_id == engine_id
+                and i.engine_version == engine_version
+                and i.engine_variant == engine_variant
+            ]
         rows.sort(key=lambda i: i.start_time, reverse=True)
         return rows
 
@@ -276,76 +298,70 @@ class MemoryEngineInstances(base.EngineInstances):
 
     def update(self, i: base.EngineInstance) -> bool:
         with self.client.lock:
-            if i.id not in self.client.engine_instances:
+            if i.id not in self.t.engine_instances:
                 return False
-            self.client.engine_instances[i.id] = i
+            self.t.engine_instances[i.id] = i
             return True
 
     def delete(self, instance_id: str) -> bool:
         with self.client.lock:
-            return self.client.engine_instances.pop(instance_id, None) is not None
+            return self.t.engine_instances.pop(instance_id, None) is not None
 
 
-class MemoryEvaluationInstances(base.EvaluationInstances):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class MemoryEvaluationInstances(_MemoryDAO, base.EvaluationInstances):
     def insert(self, i: base.EvaluationInstance) -> str:
         with self.client.lock:
             iid = i.id or uuid.uuid4().hex
-            self.client.evaluation_instances[iid] = (
+            self.t.evaluation_instances[iid] = (
                 i if i.id else dataclasses.replace(i, id=iid)
             )
             return iid
 
     def get(self, instance_id: str) -> Optional[base.EvaluationInstance]:
-        return self.client.evaluation_instances.get(instance_id)
+        with self.client.lock:
+            return self.t.evaluation_instances.get(instance_id)
 
     def get_all(self) -> list[base.EvaluationInstance]:
-        return list(self.client.evaluation_instances.values())
+        with self.client.lock:
+            return list(self.t.evaluation_instances.values())
 
     def get_completed(self) -> list[base.EvaluationInstance]:
-        rows = [
-            i for i in self.client.evaluation_instances.values()
-            if i.status == "EVALCOMPLETED"
-        ]
+        with self.client.lock:
+            rows = [
+                i for i in self.t.evaluation_instances.values()
+                if i.status == "EVALCOMPLETED"
+            ]
         rows.sort(key=lambda i: i.start_time, reverse=True)
         return rows
 
     def update(self, i: base.EvaluationInstance) -> bool:
         with self.client.lock:
-            if i.id not in self.client.evaluation_instances:
+            if i.id not in self.t.evaluation_instances:
                 return False
-            self.client.evaluation_instances[i.id] = i
+            self.t.evaluation_instances[i.id] = i
             return True
 
     def delete(self, instance_id: str) -> bool:
         with self.client.lock:
-            return self.client.evaluation_instances.pop(instance_id, None) is not None
+            return self.t.evaluation_instances.pop(instance_id, None) is not None
 
 
-class MemoryModels(base.Models):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class MemoryModels(_MemoryDAO, base.Models):
     def insert(self, model: base.Model) -> None:
         with self.client.lock:
-            self.client.models[model.id] = model
+            self.t.models[model.id] = model
 
     def get(self, model_id: str) -> Optional[base.Model]:
-        return self.client.models.get(model_id)
+        with self.client.lock:
+            return self.t.models.get(model_id)
 
     def delete(self, model_id: str) -> None:
         with self.client.lock:
-            self.client.models.pop(model_id, None)
+            self.t.models.pop(model_id, None)
 
 
-#: DAO registry used by the Storage registry's reflective lookup
-#: (the equivalent of the reference's classname convention
-#: ``org.apache.predictionio.data.storage.<type>.<prefix><Iface>``,
-#: Storage.scala:286-303).
+#: DAO registry used by the Storage registry's lookup (the equivalent of the
+#: reference's classname convention, Storage.scala:286-303).
 DATA_OBJECTS = {
     "Events": MemoryEvents,
     "Apps": MemoryApps,

@@ -8,9 +8,15 @@ JDBCModels, JDBCUtils). SQLite gives the same durability contract with zero
 service dependencies; the DAO layer is schema-compatible with a Postgres
 driver should one be added (SQL here is deliberately generic).
 
-Event rows store times as epoch-millis integers for fast range scans — the
-same role as the reference's indexed ``eventTime`` columns
-(jdbc/JDBCLEvents.scala:44-66).
+Repository namespaces (``PIO_STORAGE_REPOSITORIES_<REPO>_NAME``) map to an
+``ns`` column in every table — the same isolation the reference gets from
+per-namespace table names (jdbc/JDBCUtils tableName). Event times are stored
+as epoch-millis integers for fast range scans (jdbc/JDBCLEvents.scala:44-66).
+
+Concurrency: one connection per thread for file databases (WAL), one shared
+connection for ``:memory:``; ALL statements — reads included — run under the
+client lock so no thread observes another's uncommitted transaction on the
+shared connection.
 """
 
 from __future__ import annotations
@@ -22,7 +28,7 @@ import threading
 import uuid
 from datetime import datetime
 from pathlib import Path
-from typing import Any, Dict, Iterator, Optional, Sequence
+from typing import Any, Iterator, Optional, Sequence
 
 from incubator_predictionio_tpu.data.datamap import DataMap
 from incubator_predictionio_tpu.data.event import Event, new_event_id, validate_event
@@ -45,6 +51,7 @@ class StorageClient(base.BaseStorageClient):
             self._path = str(p)
         self._local = threading.local()
         self._memory_conn: Optional[sqlite3.Connection] = None
+        self._all_conns: list[sqlite3.Connection] = []
         self._lock = threading.RLock()
         self._init_schema()
 
@@ -52,16 +59,19 @@ class StorageClient(base.BaseStorageClient):
     def conn(self) -> sqlite3.Connection:
         # ":memory:" must share one connection; files get one per thread.
         if self._path == ":memory:":
-            if self._memory_conn is None:
-                self._memory_conn = sqlite3.connect(
-                    ":memory:", check_same_thread=False
-                )
-            return self._memory_conn
+            with self._lock:
+                if self._memory_conn is None:
+                    self._memory_conn = sqlite3.connect(
+                        ":memory:", check_same_thread=False
+                    )
+                return self._memory_conn
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self._path)
             conn.execute("PRAGMA journal_mode=WAL")
             self._local.conn = conn
+            with self._lock:
+                self._all_conns.append(conn)
         return conn
 
     @property
@@ -73,6 +83,7 @@ class StorageClient(base.BaseStorageClient):
             c.executescript(
                 """
                 CREATE TABLE IF NOT EXISTS events (
+                    ns TEXT NOT NULL,
                     id TEXT NOT NULL,
                     app_id INTEGER NOT NULL,
                     channel_id INTEGER NOT NULL DEFAULT -1,
@@ -87,28 +98,36 @@ class StorageClient(base.BaseStorageClient):
                     tags TEXT,
                     pr_id TEXT,
                     creation_time INTEGER NOT NULL,
-                    PRIMARY KEY (id, app_id, channel_id)
+                    PRIMARY KEY (ns, id, app_id, channel_id)
                 );
                 CREATE INDEX IF NOT EXISTS idx_events_scan
-                    ON events (app_id, channel_id, event_time);
+                    ON events (ns, app_id, channel_id, event_time);
                 CREATE TABLE IF NOT EXISTS apps (
-                    id INTEGER PRIMARY KEY AUTOINCREMENT,
-                    name TEXT NOT NULL UNIQUE,
-                    description TEXT
+                    ns TEXT NOT NULL,
+                    id INTEGER NOT NULL,
+                    name TEXT NOT NULL,
+                    description TEXT,
+                    PRIMARY KEY (ns, id),
+                    UNIQUE (ns, name)
                 );
                 CREATE TABLE IF NOT EXISTS access_keys (
-                    key TEXT PRIMARY KEY,
+                    ns TEXT NOT NULL,
+                    key TEXT NOT NULL,
                     app_id INTEGER NOT NULL,
-                    events TEXT NOT NULL
+                    events TEXT NOT NULL,
+                    PRIMARY KEY (ns, key)
                 );
                 CREATE TABLE IF NOT EXISTS channels (
-                    id INTEGER PRIMARY KEY AUTOINCREMENT,
+                    ns TEXT NOT NULL,
+                    id INTEGER NOT NULL,
                     name TEXT NOT NULL,
                     app_id INTEGER NOT NULL,
-                    UNIQUE (app_id, name)
+                    PRIMARY KEY (ns, id),
+                    UNIQUE (ns, app_id, name)
                 );
                 CREATE TABLE IF NOT EXISTS engine_instances (
-                    id TEXT PRIMARY KEY,
+                    ns TEXT NOT NULL,
+                    id TEXT NOT NULL,
                     status TEXT NOT NULL,
                     start_time INTEGER NOT NULL,
                     end_time INTEGER NOT NULL,
@@ -122,10 +141,12 @@ class StorageClient(base.BaseStorageClient):
                     data_source_params TEXT,
                     preparator_params TEXT,
                     algorithms_params TEXT,
-                    serving_params TEXT
+                    serving_params TEXT,
+                    PRIMARY KEY (ns, id)
                 );
                 CREATE TABLE IF NOT EXISTS evaluation_instances (
-                    id TEXT PRIMARY KEY,
+                    ns TEXT NOT NULL,
+                    id TEXT NOT NULL,
                     status TEXT NOT NULL,
                     start_time INTEGER NOT NULL,
                     end_time INTEGER NOT NULL,
@@ -136,23 +157,30 @@ class StorageClient(base.BaseStorageClient):
                     runtime_conf TEXT,
                     evaluator_results TEXT,
                     evaluator_results_html TEXT,
-                    evaluator_results_json TEXT
+                    evaluator_results_json TEXT,
+                    PRIMARY KEY (ns, id)
                 );
                 CREATE TABLE IF NOT EXISTS models (
-                    id TEXT PRIMARY KEY,
-                    models BLOB NOT NULL
+                    ns TEXT NOT NULL,
+                    id TEXT NOT NULL,
+                    models BLOB NOT NULL,
+                    PRIMARY KEY (ns, id)
                 );
                 """
             )
 
     def close(self) -> None:
-        if self._memory_conn is not None:
-            self._memory_conn.close()
-            self._memory_conn = None
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        with self._lock:
+            if self._memory_conn is not None:
+                self._memory_conn.close()
+                self._memory_conn = None
+            for conn in self._all_conns:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            self._all_conns.clear()
+            self._local = threading.local()
 
 
 def _chan(channel_id: Optional[int]) -> int:
@@ -183,19 +211,30 @@ _EVENT_COLS = (
 )
 
 
-class SQLiteEvents(base.Events):
+class _SQLiteDAO:
     def __init__(self, client: StorageClient, config: base.StorageClientConfig,
                  prefix: str = ""):
         self.client = client
+        self.ns = prefix
 
+    def _query(self, sql: str, params: Sequence[Any]) -> list:
+        with self.client.lock:
+            return self.client.conn.execute(sql, params).fetchall()
+
+    def _query_one(self, sql: str, params: Sequence[Any]) -> Optional[Sequence[Any]]:
+        with self.client.lock:
+            return self.client.conn.execute(sql, params).fetchone()
+
+
+class SQLiteEvents(_SQLiteDAO, base.Events):
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         return True  # single shared table, schema made at client init
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self.client.lock, self.client.conn as c:
             c.execute(
-                "DELETE FROM events WHERE app_id = ? AND channel_id = ?",
-                (app_id, _chan(channel_id)),
+                "DELETE FROM events WHERE ns = ? AND app_id = ? AND channel_id = ?",
+                (self.ns, app_id, _chan(channel_id)),
             )
         return True
 
@@ -209,8 +248,9 @@ class SQLiteEvents(base.Events):
         with self.client.lock, self.client.conn as c:
             c.execute(
                 "INSERT OR REPLACE INTO events VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (
+                    self.ns,
                     eid,
                     app_id,
                     _chan(channel_id),
@@ -231,21 +271,20 @@ class SQLiteEvents(base.Events):
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
-        with self.client.lock:
-            cur = self.client.conn.execute(
-                f"SELECT {_EVENT_COLS} FROM events "
-                "WHERE id = ? AND app_id = ? AND channel_id = ?",
-                (event_id, app_id, _chan(channel_id)),
-            )
-            row = cur.fetchone()
+        row = self._query_one(
+            f"SELECT {_EVENT_COLS} FROM events "
+            "WHERE ns = ? AND id = ? AND app_id = ? AND channel_id = ?",
+            (self.ns, event_id, app_id, _chan(channel_id)),
+        )
         return _row_to_event(row) if row else None
 
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         with self.client.lock, self.client.conn as c:
             cur = c.execute(
-                "DELETE FROM events WHERE id = ? AND app_id = ? AND channel_id = ?",
-                (event_id, app_id, _chan(channel_id)),
+                "DELETE FROM events "
+                "WHERE ns = ? AND id = ? AND app_id = ? AND channel_id = ?",
+                (self.ns, event_id, app_id, _chan(channel_id)),
             )
             return cur.rowcount > 0
 
@@ -264,8 +303,8 @@ class SQLiteEvents(base.Events):
         reversed: bool = False,
     ) -> Iterator[Event]:
         # Same predicate assembly as jdbc/JDBCLEvents.scala:118-165.
-        where = ["app_id = ?", "channel_id = ?"]
-        params: list[Any] = [app_id, _chan(channel_id)]
+        where = ["ns = ?", "app_id = ?", "channel_id = ?"]
+        params: list[Any] = [self.ns, app_id, _chan(channel_id)]
         if start_time is not None:
             where.append("event_time >= ?")
             params.append(to_millis(start_time))
@@ -303,78 +342,74 @@ class SQLiteEvents(base.Events):
         if limit is not None and limit >= 0:
             sql += " LIMIT ?"
             params.append(limit)
-        with self.client.lock:
-            rows = self.client.conn.execute(sql, params).fetchall()
+        rows = self._query(sql, params)
         return (_row_to_event(r) for r in rows)
 
 
-class SQLiteApps(base.Apps):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class SQLiteApps(_SQLiteDAO, base.Apps):
     def insert(self, app: base.App) -> Optional[int]:
         with self.client.lock, self.client.conn as c:
             try:
                 if app.id != 0:
-                    c.execute(
-                        "INSERT INTO apps (id, name, description) VALUES (?,?,?)",
-                        (app.id, app.name, app.description),
-                    )
-                    return app.id
-                cur = c.execute(
-                    "INSERT INTO apps (name, description) VALUES (?,?)",
-                    (app.name, app.description),
+                    app_id = app.id
+                else:
+                    row = c.execute(
+                        "SELECT COALESCE(MAX(id), 0) + 1 FROM apps WHERE ns = ?",
+                        (self.ns,),
+                    ).fetchone()
+                    app_id = row[0]
+                c.execute(
+                    "INSERT INTO apps (ns, id, name, description) VALUES (?,?,?,?)",
+                    (self.ns, app_id, app.name, app.description),
                 )
-                return cur.lastrowid
+                return app_id
             except sqlite3.IntegrityError:
                 return None
 
     def get(self, app_id: int) -> Optional[base.App]:
-        row = self.client.conn.execute(
-            "SELECT id, name, description FROM apps WHERE id = ?", (app_id,)
-        ).fetchone()
+        row = self._query_one(
+            "SELECT id, name, description FROM apps WHERE ns = ? AND id = ?",
+            (self.ns, app_id),
+        )
         return base.App(*row) if row else None
 
     def get_by_name(self, name: str) -> Optional[base.App]:
-        row = self.client.conn.execute(
-            "SELECT id, name, description FROM apps WHERE name = ?", (name,)
-        ).fetchone()
+        row = self._query_one(
+            "SELECT id, name, description FROM apps WHERE ns = ? AND name = ?",
+            (self.ns, name),
+        )
         return base.App(*row) if row else None
 
     def get_all(self) -> list[base.App]:
-        rows = self.client.conn.execute(
-            "SELECT id, name, description FROM apps"
-        ).fetchall()
+        rows = self._query(
+            "SELECT id, name, description FROM apps WHERE ns = ?", (self.ns,)
+        )
         return [base.App(*r) for r in rows]
 
     def update(self, app: base.App) -> bool:
         with self.client.lock, self.client.conn as c:
             cur = c.execute(
-                "UPDATE apps SET name = ?, description = ? WHERE id = ?",
-                (app.name, app.description, app.id),
+                "UPDATE apps SET name = ?, description = ? WHERE ns = ? AND id = ?",
+                (app.name, app.description, self.ns, app.id),
             )
             return cur.rowcount > 0
 
     def delete(self, app_id: int) -> bool:
         with self.client.lock, self.client.conn as c:
             return c.execute(
-                "DELETE FROM apps WHERE id = ?", (app_id,)
+                "DELETE FROM apps WHERE ns = ? AND id = ?", (self.ns, app_id)
             ).rowcount > 0
 
 
-class SQLiteAccessKeys(base.AccessKeys):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class SQLiteAccessKeys(_SQLiteDAO, base.AccessKeys):
     def insert(self, k: base.AccessKey) -> Optional[str]:
         key = k.key or base.generate_access_key()
         with self.client.lock, self.client.conn as c:
             try:
                 c.execute(
-                    "INSERT INTO access_keys (key, app_id, events) VALUES (?,?,?)",
-                    (key, k.appid, json.dumps(list(k.events))),
+                    "INSERT INTO access_keys (ns, key, app_id, events) "
+                    "VALUES (?,?,?,?)",
+                    (self.ns, key, k.appid, json.dumps(list(k.events))),
                 )
                 return key
             except sqlite3.IntegrityError:
@@ -385,77 +420,85 @@ class SQLiteAccessKeys(base.AccessKeys):
         return base.AccessKey(row[0], row[1], tuple(json.loads(row[2])))
 
     def get(self, key: str) -> Optional[base.AccessKey]:
-        row = self.client.conn.execute(
-            "SELECT key, app_id, events FROM access_keys WHERE key = ?", (key,)
-        ).fetchone()
+        row = self._query_one(
+            "SELECT key, app_id, events FROM access_keys "
+            "WHERE ns = ? AND key = ?",
+            (self.ns, key),
+        )
         return self._row(row) if row else None
 
     def get_all(self) -> list[base.AccessKey]:
-        rows = self.client.conn.execute(
-            "SELECT key, app_id, events FROM access_keys"
-        ).fetchall()
+        rows = self._query(
+            "SELECT key, app_id, events FROM access_keys WHERE ns = ?",
+            (self.ns,),
+        )
         return [self._row(r) for r in rows]
 
     def get_by_appid(self, appid: int) -> list[base.AccessKey]:
-        rows = self.client.conn.execute(
-            "SELECT key, app_id, events FROM access_keys WHERE app_id = ?",
-            (appid,),
-        ).fetchall()
+        rows = self._query(
+            "SELECT key, app_id, events FROM access_keys "
+            "WHERE ns = ? AND app_id = ?",
+            (self.ns, appid),
+        )
         return [self._row(r) for r in rows]
 
     def update(self, k: base.AccessKey) -> bool:
         with self.client.lock, self.client.conn as c:
             cur = c.execute(
-                "UPDATE access_keys SET app_id = ?, events = ? WHERE key = ?",
-                (k.appid, json.dumps(list(k.events)), k.key),
+                "UPDATE access_keys SET app_id = ?, events = ? "
+                "WHERE ns = ? AND key = ?",
+                (k.appid, json.dumps(list(k.events)), self.ns, k.key),
             )
             return cur.rowcount > 0
 
     def delete(self, key: str) -> bool:
         with self.client.lock, self.client.conn as c:
             return c.execute(
-                "DELETE FROM access_keys WHERE key = ?", (key,)
+                "DELETE FROM access_keys WHERE ns = ? AND key = ?",
+                (self.ns, key),
             ).rowcount > 0
 
 
-class SQLiteChannels(base.Channels):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class SQLiteChannels(_SQLiteDAO, base.Channels):
     def insert(self, channel: base.Channel) -> Optional[int]:
         with self.client.lock, self.client.conn as c:
             try:
                 if channel.id != 0:
-                    c.execute(
-                        "INSERT INTO channels (id, name, app_id) VALUES (?,?,?)",
-                        (channel.id, channel.name, channel.appid),
-                    )
-                    return channel.id
-                cur = c.execute(
-                    "INSERT INTO channels (name, app_id) VALUES (?,?)",
-                    (channel.name, channel.appid),
+                    cid = channel.id
+                else:
+                    row = c.execute(
+                        "SELECT COALESCE(MAX(id), 0) + 1 FROM channels "
+                        "WHERE ns = ?",
+                        (self.ns,),
+                    ).fetchone()
+                    cid = row[0]
+                c.execute(
+                    "INSERT INTO channels (ns, id, name, app_id) VALUES (?,?,?,?)",
+                    (self.ns, cid, channel.name, channel.appid),
                 )
-                return cur.lastrowid
+                return cid
             except sqlite3.IntegrityError:
                 return None
 
     def get(self, channel_id: int) -> Optional[base.Channel]:
-        row = self.client.conn.execute(
-            "SELECT id, name, app_id FROM channels WHERE id = ?", (channel_id,)
-        ).fetchone()
+        row = self._query_one(
+            "SELECT id, name, app_id FROM channels WHERE ns = ? AND id = ?",
+            (self.ns, channel_id),
+        )
         return base.Channel(*row) if row else None
 
     def get_by_appid(self, appid: int) -> list[base.Channel]:
-        rows = self.client.conn.execute(
-            "SELECT id, name, app_id FROM channels WHERE app_id = ?", (appid,)
-        ).fetchall()
+        rows = self._query(
+            "SELECT id, name, app_id FROM channels WHERE ns = ? AND app_id = ?",
+            (self.ns, appid),
+        )
         return [base.Channel(*r) for r in rows]
 
     def delete(self, channel_id: int) -> bool:
         with self.client.lock, self.client.conn as c:
             return c.execute(
-                "DELETE FROM channels WHERE id = ?", (channel_id,)
+                "DELETE FROM channels WHERE ns = ? AND id = ?",
+                (self.ns, channel_id),
             ).rowcount > 0
 
 
@@ -486,11 +529,7 @@ def _row_to_engine_instance(row: Sequence[Any]) -> base.EngineInstance:
     )
 
 
-class SQLiteEngineInstances(base.EngineInstances):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class SQLiteEngineInstances(_SQLiteDAO, base.EngineInstances):
     def insert(self, i: base.EngineInstance) -> str:
         iid = i.id or uuid.uuid4().hex
         if not i.id:
@@ -498,39 +537,41 @@ class SQLiteEngineInstances(base.EngineInstances):
         with self.client.lock, self.client.conn as c:
             c.execute(
                 "INSERT OR REPLACE INTO engine_instances VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (
-                    i.id, i.status, to_millis(i.start_time), to_millis(i.end_time),
-                    i.engine_id, i.engine_version, i.engine_variant,
-                    i.engine_factory, i.batch, json.dumps(i.env),
-                    json.dumps(i.runtime_conf), i.data_source_params,
-                    i.preparator_params, i.algorithms_params, i.serving_params,
+                    self.ns, i.id, i.status, to_millis(i.start_time),
+                    to_millis(i.end_time), i.engine_id, i.engine_version,
+                    i.engine_variant, i.engine_factory, i.batch,
+                    json.dumps(i.env), json.dumps(i.runtime_conf),
+                    i.data_source_params, i.preparator_params,
+                    i.algorithms_params, i.serving_params,
                 ),
             )
         return iid
 
     def get(self, instance_id: str) -> Optional[base.EngineInstance]:
-        row = self.client.conn.execute(
-            f"SELECT {_EI_COLS} FROM engine_instances WHERE id = ?",
-            (instance_id,),
-        ).fetchone()
+        row = self._query_one(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE ns = ? AND id = ?",
+            (self.ns, instance_id),
+        )
         return _row_to_engine_instance(row) if row else None
 
     def get_all(self) -> list[base.EngineInstance]:
-        rows = self.client.conn.execute(
-            f"SELECT {_EI_COLS} FROM engine_instances"
-        ).fetchall()
+        rows = self._query(
+            f"SELECT {_EI_COLS} FROM engine_instances WHERE ns = ?", (self.ns,)
+        )
         return [_row_to_engine_instance(r) for r in rows]
 
     def get_completed(
         self, engine_id: str, engine_version: str, engine_variant: str
     ) -> list[base.EngineInstance]:
-        rows = self.client.conn.execute(
-            f"SELECT {_EI_COLS} FROM engine_instances WHERE status = 'COMPLETED'"
+        rows = self._query(
+            f"SELECT {_EI_COLS} FROM engine_instances "
+            "WHERE ns = ? AND status = 'COMPLETED'"
             " AND engine_id = ? AND engine_version = ? AND engine_variant = ?"
             " ORDER BY start_time DESC",
-            (engine_id, engine_version, engine_variant),
-        ).fetchall()
+            (self.ns, engine_id, engine_version, engine_variant),
+        )
         return [_row_to_engine_instance(r) for r in rows]
 
     def get_latest_completed(
@@ -548,7 +589,8 @@ class SQLiteEngineInstances(base.EngineInstances):
     def delete(self, instance_id: str) -> bool:
         with self.client.lock, self.client.conn as c:
             return c.execute(
-                "DELETE FROM engine_instances WHERE id = ?", (instance_id,)
+                "DELETE FROM engine_instances WHERE ns = ? AND id = ?",
+                (self.ns, instance_id),
             ).rowcount > 0
 
 
@@ -576,11 +618,7 @@ def _row_to_evaluation_instance(row: Sequence[Any]) -> base.EvaluationInstance:
     )
 
 
-class SQLiteEvaluationInstances(base.EvaluationInstances):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class SQLiteEvaluationInstances(_SQLiteDAO, base.EvaluationInstances):
     def insert(self, i: base.EvaluationInstance) -> str:
         iid = i.id or uuid.uuid4().hex
         if not i.id:
@@ -588,10 +626,11 @@ class SQLiteEvaluationInstances(base.EvaluationInstances):
         with self.client.lock, self.client.conn as c:
             c.execute(
                 "INSERT OR REPLACE INTO evaluation_instances VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (
-                    i.id, i.status, to_millis(i.start_time), to_millis(i.end_time),
-                    i.evaluation_class, i.engine_params_generator_class, i.batch,
+                    self.ns, i.id, i.status, to_millis(i.start_time),
+                    to_millis(i.end_time), i.evaluation_class,
+                    i.engine_params_generator_class, i.batch,
                     json.dumps(i.env), json.dumps(i.runtime_conf),
                     i.evaluator_results, i.evaluator_results_html,
                     i.evaluator_results_json,
@@ -600,23 +639,26 @@ class SQLiteEvaluationInstances(base.EvaluationInstances):
         return iid
 
     def get(self, instance_id: str) -> Optional[base.EvaluationInstance]:
-        row = self.client.conn.execute(
-            f"SELECT {_EVI_COLS} FROM evaluation_instances WHERE id = ?",
-            (instance_id,),
-        ).fetchone()
+        row = self._query_one(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances "
+            "WHERE ns = ? AND id = ?",
+            (self.ns, instance_id),
+        )
         return _row_to_evaluation_instance(row) if row else None
 
     def get_all(self) -> list[base.EvaluationInstance]:
-        rows = self.client.conn.execute(
-            f"SELECT {_EVI_COLS} FROM evaluation_instances"
-        ).fetchall()
+        rows = self._query(
+            f"SELECT {_EVI_COLS} FROM evaluation_instances WHERE ns = ?",
+            (self.ns,),
+        )
         return [_row_to_evaluation_instance(r) for r in rows]
 
     def get_completed(self) -> list[base.EvaluationInstance]:
-        rows = self.client.conn.execute(
+        rows = self._query(
             f"SELECT {_EVI_COLS} FROM evaluation_instances "
-            "WHERE status = 'EVALCOMPLETED' ORDER BY start_time DESC"
-        ).fetchall()
+            "WHERE ns = ? AND status = 'EVALCOMPLETED' ORDER BY start_time DESC",
+            (self.ns,),
+        )
         return [_row_to_evaluation_instance(r) for r in rows]
 
     def update(self, i: base.EvaluationInstance) -> bool:
@@ -628,31 +670,32 @@ class SQLiteEvaluationInstances(base.EvaluationInstances):
     def delete(self, instance_id: str) -> bool:
         with self.client.lock, self.client.conn as c:
             return c.execute(
-                "DELETE FROM evaluation_instances WHERE id = ?", (instance_id,)
+                "DELETE FROM evaluation_instances WHERE ns = ? AND id = ?",
+                (self.ns, instance_id),
             ).rowcount > 0
 
 
-class SQLiteModels(base.Models):
-    def __init__(self, client: StorageClient, config: base.StorageClientConfig,
-                 prefix: str = ""):
-        self.client = client
-
+class SQLiteModels(_SQLiteDAO, base.Models):
     def insert(self, model: base.Model) -> None:
         with self.client.lock, self.client.conn as c:
             c.execute(
-                "INSERT OR REPLACE INTO models (id, models) VALUES (?,?)",
-                (model.id, model.models),
+                "INSERT OR REPLACE INTO models (ns, id, models) VALUES (?,?,?)",
+                (self.ns, model.id, model.models),
             )
 
     def get(self, model_id: str) -> Optional[base.Model]:
-        row = self.client.conn.execute(
-            "SELECT id, models FROM models WHERE id = ?", (model_id,)
-        ).fetchone()
+        row = self._query_one(
+            "SELECT id, models FROM models WHERE ns = ? AND id = ?",
+            (self.ns, model_id),
+        )
         return base.Model(row[0], row[1]) if row else None
 
     def delete(self, model_id: str) -> None:
         with self.client.lock, self.client.conn as c:
-            c.execute("DELETE FROM models WHERE id = ?", (model_id,))
+            c.execute(
+                "DELETE FROM models WHERE ns = ? AND id = ?",
+                (self.ns, model_id),
+            )
 
 
 DATA_OBJECTS = {

@@ -1,0 +1,19 @@
+"""Device mesh, sharding and runtime context.
+
+This package replaces the reference's Spark cluster runtime: where the
+reference hands every DASE component a ``SparkContext``
+(core/.../core/BaseDataSource.scala:43, BaseAlgorithm.scala:69), this
+framework hands them a :class:`RuntimeContext` carrying a
+``jax.sharding.Mesh`` over the TPU slice plus run configuration. Collectives
+ride XLA (psum/all_gather/reduce_scatter over ICI/DCN) instead of Spark
+shuffles.
+"""
+
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.parallel.mesh import (
+    make_mesh,
+    mesh_shape_for,
+    device_count,
+)
+
+__all__ = ["RuntimeContext", "make_mesh", "mesh_shape_for", "device_count"]

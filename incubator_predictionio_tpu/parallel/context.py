@@ -1,0 +1,60 @@
+"""RuntimeContext — what every DASE component receives instead of a
+SparkContext (reference: WorkflowContext.scala builds the SparkContext; the
+``sc`` parameter threads through BaseDataSource/BasePreparator/BaseAlgorithm).
+
+Carries the device mesh, a deterministic PRNG stream, and run configuration.
+Construction is lazy: pure-host engines (event property work, tests of the
+controller wiring) never touch JAX at all.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class RuntimeContext:
+    def __init__(
+        self,
+        mesh: Optional[Any] = None,
+        seed: int = 0,
+        conf: Optional[Dict[str, Any]] = None,
+        model_parallelism: int = 1,
+    ):
+        self._mesh = mesh
+        self.seed = seed
+        self.conf: Dict[str, Any] = dict(conf or {})
+        self.model_parallelism = model_parallelism
+        self._rng_lock = threading.Lock()
+        self._rng_count = 0
+        self._rng_key = None
+
+    @property
+    def mesh(self):
+        """The device mesh, created on first use."""
+        if self._mesh is None:
+            from incubator_predictionio_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(model_parallelism=self.model_parallelism)
+        return self._mesh
+
+    def next_rng(self):
+        """A fresh jax PRNG key, deterministic in ``seed`` and call order."""
+        import jax
+
+        with self._rng_lock:
+            if self._rng_key is None:
+                self._rng_key = jax.random.key(self.seed)
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            self._rng_count += 1
+            return sub
+
+    def stop(self) -> None:
+        """SparkContext.stop parity — drop the mesh so serving processes can
+        release any compile caches tied to it (Engine.scala:258 stops sc once
+        models are local)."""
+        self._mesh = None
+
+    def __repr__(self) -> str:
+        mesh = self._mesh.shape if self._mesh is not None else "lazy"
+        return f"RuntimeContext(mesh={mesh}, seed={self.seed})"

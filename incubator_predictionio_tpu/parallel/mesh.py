@@ -1,0 +1,61 @@
+"""Mesh construction helpers.
+
+The standard mesh has two logical axes:
+
+- ``"dp"`` (data/batch axis)  — users/examples are sharded here.
+- ``"mp"`` (model axis)       — embedding tables / factor matrices here.
+
+This is the ALX layout for matrix factorization on TPU pods (PAPERS.md:
+"ALX: Large Scale Matrix Factorization on TPUs") and the general recipe of
+the scaling-book: pick a mesh, annotate shardings, let XLA insert the
+collectives. On a single chip both axes are 1 and everything compiles to the
+degenerate (no-collective) program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "mp"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def mesh_shape_for(
+    n_devices: int, model_parallelism: int = 1
+) -> tuple[int, int]:
+    """(dp, mp) factorization of ``n_devices``.
+
+    ``model_parallelism`` is a target; it is clamped to a divisor of
+    ``n_devices`` so the mesh always uses every device.
+    """
+    mp = max(1, min(model_parallelism, n_devices))
+    while n_devices % mp != 0:
+        mp -= 1
+    return n_devices // mp, mp
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    model_parallelism: int = 1,
+    axis_names: tuple[str, str] = (DATA_AXIS, MODEL_AXIS),
+) -> Mesh:
+    """Build the standard (dp, mp) mesh over the given (default: all) devices.
+
+    TPU note: jax.devices() ordering on a slice follows the physical torus,
+    so adjacent mesh coordinates are ICI neighbors; ``mp`` varies fastest,
+    keeping model-axis collectives (the all_gathers of factor shards in the
+    ALS sweep) on the innermost, fastest rings.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    dp, mp = mesh_shape_for(len(devs), model_parallelism)
+    import numpy as np
+
+    grid = np.array(devs[: dp * mp]).reshape(dp, mp)
+    return Mesh(grid, axis_names)

@@ -1,0 +1,11 @@
+"""Workflow runtime: train/evaluate drivers + model checkpointing.
+
+Parity: core/src/main/scala/.../workflow/ (CreateWorkflow, CoreWorkflow,
+EvaluationWorkflow, WorkflowContext). The reference's spark-submit process
+hop disappears: `pio train` runs the workflow in-process on the TPU host.
+"""
+
+from incubator_predictionio_tpu.workflow.workflow import CoreWorkflow
+from incubator_predictionio_tpu.workflow import checkpoint
+
+__all__ = ["CoreWorkflow", "checkpoint"]

@@ -1,0 +1,121 @@
+"""Model checkpointing — pytrees of device arrays → durable blobs.
+
+Replaces the reference's Kryo serialization of trained models into the
+MODELDATA repository (CoreWorkflow.scala:76-81, CreateServer.scala:73-87
+KryoInstantiator). Device arrays are converted to host numpy on save and
+restored as numpy on load; they migrate back to the TPU (with the serving
+sharding) the first time a jitted predict touches them, or explicitly via
+:func:`device_restore`.
+
+The reference's three model classes (SURVEY.md §5 checkpoint/resume):
+serializable models → stored as-is; RDD models → stored as Unit + silently
+retrained at deploy; PersistentModel → custom save/load. Here: pytrees are
+always storable, :class:`~...core.persistent_model.RetrainMarker` makes the
+retrain path explicit, and PersistentModel keeps its contract.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+from typing import Any, List, Optional
+
+from incubator_predictionio_tpu.core.persistent_model import (
+    PersistentModel,
+    PersistentModelManifest,
+)
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+logger = logging.getLogger(__name__)
+
+_FORMAT_VERSION = 1
+
+
+def _np(obj: Any):
+    import numpy as np
+
+    return np.asarray(obj)
+
+
+def _restore_array(arr: Any) -> Any:
+    return arr  # numpy; device transfer happens lazily at first jit use
+
+
+class _ModelPickler(pickle.Pickler):
+    """Pickler that converts jax Arrays to host numpy on the way out."""
+
+    def reducer_override(self, obj: Any):
+        try:
+            import jax
+        except Exception:  # pragma: no cover - jax always present
+            return NotImplemented
+        if isinstance(obj, jax.Array):
+            return (_restore_array, (_np(obj),))
+        return NotImplemented
+
+
+def dumps(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    _ModelPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(
+        (_FORMAT_VERSION, obj)
+    )
+    return buf.getvalue()
+
+
+def loads(data: bytes) -> Any:
+    version, obj = pickle.loads(data)
+    if version != _FORMAT_VERSION:
+        raise ValueError(f"Unsupported model blob version {version}")
+    return obj
+
+
+def serialize_models(
+    models: List[Any],
+    instance_id: str,
+    ctx: RuntimeContext,
+    algo_params: Optional[List[Any]] = None,
+) -> bytes:
+    """Make the model list durable (Engine.makeSerializableModels:286 +
+    CoreWorkflow kryo step). PersistentModels run their own ``save`` and are
+    replaced by manifests."""
+    out: List[Any] = []
+    algo_params = algo_params or [None] * len(models)
+    for model, params in zip(models, algo_params):
+        if isinstance(model, PersistentModel):
+            cls = type(model)
+            if model.save(instance_id, params, ctx):
+                out.append(
+                    PersistentModelManifest(
+                        class_path=f"{cls.__module__}.{cls.__qualname__}",
+                        instance_id=instance_id,
+                    )
+                )
+                continue
+            logger.info(
+                "%s.save returned False; falling back to default "
+                "checkpointing", cls.__name__,
+            )
+        out.append(model)
+    return dumps(out)
+
+
+def deserialize_models(data: bytes) -> List[Any]:
+    models = loads(data)
+    if not isinstance(models, list):
+        raise ValueError("Model blob does not contain a model list")
+    return models
+
+
+def device_restore(tree: Any, sharding: Optional[Any] = None) -> Any:
+    """Push every array leaf of a restored model back onto device, optionally
+    with a serving sharding (donated device-resident serving state)."""
+    import jax
+    import numpy as np
+
+    def put(leaf: Any) -> Any:
+        if isinstance(leaf, (np.ndarray, jax.Array)):
+            return jax.device_put(leaf, sharding) if sharding else jax.device_put(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(put, tree)

@@ -1,0 +1,201 @@
+"""CoreWorkflow — training and evaluation drivers.
+
+Parity: workflow/CoreWorkflow.scala:45-160 and EvaluationWorkflow.scala:30-43.
+``run_train``: register an INIT EngineInstance → build the RuntimeContext
+(the WorkflowContext/SparkContext step) → ``engine.train`` → checkpoint the
+models into MODELDATA → mark COMPLETED. ``run_evaluation``: register an
+EVALUATING EvaluationInstance → ``engine.batch_eval`` → evaluator → store
+one-liner/HTML/JSON results → EVALCOMPLETED.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import traceback
+from typing import Any, List, Optional, Sequence
+
+from incubator_predictionio_tpu.core.engine import Engine
+from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
+from incubator_predictionio_tpu.data.storage import (
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    Storage,
+)
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.utils import json_codec
+from incubator_predictionio_tpu.utils.times import now_utc
+from incubator_predictionio_tpu.workflow import checkpoint
+
+logger = logging.getLogger(__name__)
+
+
+def make_runtime_context(
+    workflow_params: Optional[WorkflowParams] = None,
+) -> RuntimeContext:
+    """WorkflowContext.scala parity — runtime_conf drives mesh/seed config."""
+    conf = dict((workflow_params.runtime_conf if workflow_params else {}) or {})
+    return RuntimeContext(
+        seed=int(conf.get("seed", 0)),
+        model_parallelism=int(conf.get("model_parallelism", 1)),
+        conf=conf,
+    )
+
+
+class CoreWorkflow:
+    TRAIN_STATUS_INIT = "INIT"
+    TRAIN_STATUS_TRAINING = "TRAINING"
+    TRAIN_STATUS_COMPLETED = "COMPLETED"
+    TRAIN_STATUS_ABORTED = "ABORTED"
+    EVAL_STATUS_EVALUATING = "EVALUATING"
+    EVAL_STATUS_COMPLETED = "EVALCOMPLETED"
+    EVAL_STATUS_ABORTED = "EVALABORTED"
+
+    @staticmethod
+    def run_train(
+        engine: Engine,
+        engine_params: EngineParams,
+        engine_id: str = "default",
+        engine_version: str = "NOT_VERSIONED",
+        engine_variant: str = "default",
+        engine_factory: str = "",
+        params: Optional[WorkflowParams] = None,
+        ctx: Optional[RuntimeContext] = None,
+        env: Optional[dict] = None,
+    ) -> str:
+        """Train, checkpoint, register. Returns the engine instance ID."""
+        params = params or WorkflowParams()
+        ctx = ctx or make_runtime_context(params)
+        instances = Storage.get_meta_data_engine_instances()
+        instance = EngineInstance(
+            id="",
+            status=CoreWorkflow.TRAIN_STATUS_INIT,
+            start_time=now_utc(),
+            end_time=now_utc(),
+            engine_id=engine_id,
+            engine_version=engine_version,
+            engine_variant=engine_variant,
+            engine_factory=engine_factory,
+            batch=params.batch,
+            env=dict(env or {}),
+            runtime_conf=dict(params.runtime_conf),
+            data_source_params=json_codec.dumps(engine_params.data_source_params),
+            preparator_params=json_codec.dumps(engine_params.preparator_params),
+            algorithms_params=json_codec.dumps(engine_params.algorithm_params_list),
+            serving_params=json_codec.dumps(engine_params.serving_params),
+        )
+        instance_id = instances.insert(instance)
+        instance = dataclasses.replace(instance, id=instance_id)
+        logger.info("Training engine instance %s", instance_id)
+        try:
+            instances.update(
+                dataclasses.replace(instance,
+                                    status=CoreWorkflow.TRAIN_STATUS_TRAINING)
+            )
+            models = engine.train(ctx, engine_params, params)
+            algo_params = [p for _n, p in engine_params.algorithm_params_list]
+            blob = checkpoint.serialize_models(
+                models, instance_id, ctx, algo_params=algo_params
+            )
+            Storage.get_model_data_models().insert(Model(instance_id, blob))
+            instances.update(
+                dataclasses.replace(
+                    instance,
+                    status=CoreWorkflow.TRAIN_STATUS_COMPLETED,
+                    end_time=now_utc(),
+                )
+            )
+            logger.info(
+                "Training completed; engine instance %s saved (%d bytes of models)",
+                instance_id, len(blob),
+            )
+        except Exception:
+            instances.update(
+                dataclasses.replace(
+                    instance,
+                    status=CoreWorkflow.TRAIN_STATUS_ABORTED,
+                    end_time=now_utc(),
+                )
+            )
+            raise
+        return instance_id
+
+    @staticmethod
+    def load_models(
+        instance_id: str,
+        engine: Optional[Engine] = None,
+        engine_params: Optional[EngineParams] = None,
+        ctx: Optional[RuntimeContext] = None,
+        params: Optional[WorkflowParams] = None,
+    ) -> List[Any]:
+        """Restore checkpointed models (CreateServer.scala:216-220 kryo invert
+        + Engine.prepareDeploy)."""
+        blob = Storage.get_model_data_models().get(instance_id)
+        if blob is None:
+            raise ValueError(f"No models stored for engine instance {instance_id}")
+        models = checkpoint.deserialize_models(blob.models)
+        if engine is not None and engine_params is not None:
+            ctx = ctx or make_runtime_context(params)
+            models = engine.prepare_deploy(
+                ctx, engine_params, instance_id, models, params
+            )
+        return models
+
+    @staticmethod
+    def run_evaluation(
+        evaluation: Any,
+        engine_params_list: Sequence[EngineParams],
+        evaluation_class: str = "",
+        engine_params_generator_class: str = "",
+        params: Optional[WorkflowParams] = None,
+        ctx: Optional[RuntimeContext] = None,
+        env: Optional[dict] = None,
+    ) -> tuple[str, Any]:
+        """Evaluate all candidates. Returns (evaluation instance id, result)."""
+        params = params or WorkflowParams()
+        ctx = ctx or make_runtime_context(params)
+        instances = Storage.get_meta_data_evaluation_instances()
+        instance = EvaluationInstance(
+            id="",
+            status=CoreWorkflow.EVAL_STATUS_EVALUATING,
+            start_time=now_utc(),
+            end_time=now_utc(),
+            evaluation_class=evaluation_class,
+            engine_params_generator_class=engine_params_generator_class,
+            batch=params.batch,
+            env=dict(env or {}),
+            runtime_conf=dict(params.runtime_conf),
+        )
+        instance_id = instances.insert(instance)
+        instance = dataclasses.replace(instance, id=instance_id)
+        try:
+            engine = evaluation.engine
+            evaluator = evaluation.evaluator
+            eval_data = engine.batch_eval(ctx, engine_params_list, params)
+            result = evaluator.evaluate(ctx, evaluation, eval_data, params)
+            instances.update(
+                dataclasses.replace(
+                    instance,
+                    status=CoreWorkflow.EVAL_STATUS_COMPLETED,
+                    end_time=now_utc(),
+                    evaluator_results=result.to_one_liner(),
+                    evaluator_results_html=result.to_html(),
+                    evaluator_results_json=json.dumps(result.to_jsonable()),
+                )
+            )
+            logger.info("Evaluation %s completed: %s", instance_id,
+                        result.to_one_liner())
+            return instance_id, result
+        except Exception:
+            logger.error("Evaluation %s aborted:\n%s", instance_id,
+                         traceback.format_exc())
+            instances.update(
+                dataclasses.replace(
+                    instance,
+                    status=CoreWorkflow.EVAL_STATUS_ABORTED,
+                    end_time=now_utc(),
+                )
+            )
+            raise

@@ -1,0 +1,207 @@
+"""Fake DASE engine whose outputs encode params/ids — the controllable
+fixture that lets tests assert exact train/eval wiring with no real ML.
+
+Parity: core/src/test/.../controller/SampleEngine.scala:29-174 (Engine0
+family: PDataSource0-4, PPreparator0-1, algorithms, serving).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from incubator_predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    EmptyParams,
+    Engine,
+    Metric,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DSP(Params):
+    id: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PP(Params):
+    id: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class AP(Params):
+    id: int = 0
+    mult: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SP(Params):
+    id: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingData:
+    ds_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalInfo:
+    ds_id: int
+    ex: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedData:
+    ds_id: int
+    pp_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    ds_id: int
+    pp_id: int
+    ap_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    qx: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    model: Model
+    qx: int
+    supplemented: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Actual:
+    qx: int
+
+
+class DataSource0(DataSource):
+    """Training + n_eval eval sets with n_q queries each."""
+
+    def __init__(self, params: DSP = DSP()):
+        super().__init__(params)
+
+    def read_training(self, ctx) -> TrainingData:
+        return TrainingData(ds_id=self.params.id)
+
+    def read_eval(self, ctx):
+        out = []
+        for ex in range(2):
+            qas = [(Query(qx), Actual(qx)) for qx in range(3)]
+            out.append((TrainingData(self.params.id), EvalInfo(self.params.id, ex), qas))
+        return out
+
+
+class FailingDataSource(DataSource):
+    def read_training(self, ctx):
+        raise RuntimeError("data source boom")
+
+
+class SanityFailDataSource(DataSource):
+    class TD(SanityCheck):
+        def sanity_check(self) -> None:
+            raise ValueError("sanity failed")
+
+    def read_training(self, ctx):
+        return SanityFailDataSource.TD()
+
+
+class NoArgDataSource(DataSource):
+    """Has a no-arg constructor — exercises the Doer fallback path."""
+
+    def __init__(self):
+        super().__init__(EmptyParams())
+
+    def read_training(self, ctx) -> TrainingData:
+        return TrainingData(ds_id=-99)
+
+
+class Preparator0(Preparator):
+    def __init__(self, params: PP = PP()):
+        super().__init__(params)
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(ds_id=td.ds_id, pp_id=self.params.id)
+
+
+class Algorithm0(Algorithm):
+    def __init__(self, params: AP = AP()):
+        super().__init__(params)
+
+    def train(self, ctx, pd: PreparedData) -> Model:
+        return Model(ds_id=pd.ds_id, pp_id=pd.pp_id, ap_id=self.params.id)
+
+    def predict(self, model: Model, query: Query) -> Prediction:
+        return Prediction(model=model, qx=query.qx)
+
+
+class Algorithm1(Algorithm):
+    def __init__(self, params: AP = AP()):
+        super().__init__(params)
+
+    def train(self, ctx, pd: PreparedData) -> Model:
+        return Model(ds_id=pd.ds_id, pp_id=pd.pp_id, ap_id=100 + self.params.id)
+
+    def predict(self, model: Model, query: Query) -> Prediction:
+        return Prediction(model=model, qx=query.qx)
+
+
+class Serving0(Serving):
+    def __init__(self, params: SP = SP()):
+        super().__init__(params)
+
+    def serve(self, query: Query, predictions) -> Prediction:
+        # first prediction wins; encode how many came in via qx passthrough
+        return predictions[0]
+
+
+class SupplementServing(Serving):
+    """Marks queries as supplemented; serve asserts algorithms saw the mark."""
+
+    def supplement(self, query: Query) -> Query:
+        return Query(qx=query.qx + 1000)
+
+    def serve(self, query: Query, predictions) -> Prediction:
+        # query must be the ORIGINAL (unsupplemented) one here
+        assert query.qx < 1000, "serve must receive the original query"
+        return predictions[0]
+
+
+def make_engine() -> Engine:
+    return Engine(
+        DataSource0,
+        Preparator0,
+        {"algo0": Algorithm0, "algo1": Algorithm1},
+        Serving0,
+    )
+
+
+def params(ds=1, pp=2, algos=(("algo0", AP(3)),), sp=4):
+    from incubator_predictionio_tpu.core import EngineParams
+
+    return EngineParams(
+        data_source_params=("", DSP(ds)),
+        preparator_params=("", PP(pp)),
+        algorithm_params_list=list(algos),
+        serving_params=("", SP(sp)),
+    )
+
+
+class QxMetric(Metric):
+    """Deterministic metric: mean of (prediction.model.ap_id)."""
+
+    def calculate(self, ctx, eval_data_set) -> float:
+        scores = [
+            p.model.ap_id for _info, qpas in eval_data_set for _q, p, _a in qpas
+        ]
+        return sum(scores) / len(scores) if scores else float("nan")

@@ -1,0 +1,182 @@
+"""Engine wiring tests (parity: core/src/test/.../controller/EngineTest.scala)."""
+
+import pytest
+
+from fake_engine import (
+    AP,
+    DSP,
+    PP,
+    SP,
+    Algorithm0,
+    Algorithm1,
+    DataSource0,
+    FailingDataSource,
+    Model,
+    NoArgDataSource,
+    Preparator0,
+    Prediction,
+    Query,
+    SanityFailDataSource,
+    Serving0,
+    SupplementServing,
+    make_engine,
+)
+from incubator_predictionio_tpu.core import (
+    EmptyParams,
+    Engine,
+    EngineParams,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+    doer,
+)
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+
+@pytest.fixture
+def ctx():
+    return RuntimeContext()
+
+
+def params(ds=1, pp=2, algos=(("algo0", AP(3)),), sp=4):
+    return EngineParams(
+        data_source_params=("", DSP(ds)),
+        preparator_params=("", PP(pp)),
+        algorithm_params_list=list(algos),
+        serving_params=("", SP(sp)),
+    )
+
+
+def test_train_single_algo(ctx):
+    models = make_engine().train(ctx, params())
+    assert models == [Model(ds_id=1, pp_id=2, ap_id=3)]
+
+
+def test_train_multi_algo_ordering(ctx):
+    ep = params(algos=[("algo0", AP(10)), ("algo1", AP(20)), ("algo0", AP(30))])
+    models = make_engine().train(ctx, ep)
+    assert models == [
+        Model(1, 2, 10),
+        Model(1, 2, 120),  # Algorithm1 encodes 100+id
+        Model(1, 2, 30),
+    ]
+
+
+def test_train_propagates_params(ctx):
+    models = make_engine().train(ctx, params(ds=7, pp=8, algos=[("algo0", AP(9))]))
+    assert models == [Model(7, 8, 9)]
+
+
+def test_unknown_algo_name(ctx):
+    with pytest.raises(ValueError, match="algorithm"):
+        make_engine().train(ctx, params(algos=[("nope", AP(1))]))
+
+
+def test_single_class_map_accepts_empty_name(ctx):
+    engine = Engine(DataSource0, Preparator0, Algorithm0, Serving0)
+    models = engine.train(ctx, params(algos=[("", AP(5))]))
+    assert models == [Model(1, 2, 5)]
+
+
+def test_stop_after_read_and_prepare(ctx):
+    e = make_engine()
+    with pytest.raises(StopAfterReadInterruption):
+        e.train(ctx, params(), WorkflowParams(stop_after_read=True))
+    with pytest.raises(StopAfterPrepareInterruption):
+        e.train(ctx, params(), WorkflowParams(stop_after_prepare=True))
+
+
+def test_sanity_check_runs_and_can_be_skipped(ctx):
+    engine = Engine(SanityFailDataSource, Preparator0, Algorithm0, Serving0)
+    ep = params(algos=[("", AP(1))])
+    with pytest.raises(ValueError, match="sanity failed"):
+        engine.train(ctx, ep)
+    # SanityFailDataSource's TD can't prepare (wrong type), so stop right after read
+    with pytest.raises(StopAfterReadInterruption):
+        engine.train(
+            ctx, ep,
+            WorkflowParams(skip_sanity_check=True, stop_after_read=True),
+        )
+
+
+def test_data_source_error_propagates(ctx):
+    engine = Engine(FailingDataSource, Preparator0, Algorithm0, Serving0)
+    with pytest.raises(RuntimeError, match="data source boom"):
+        engine.train(ctx, params(algos=[("", AP(1))]))
+
+
+def test_doer_no_arg_constructor(ctx):
+    got = doer(NoArgDataSource, EmptyParams())
+    assert isinstance(got, NoArgDataSource)
+    engine = Engine(NoArgDataSource, Preparator0, Algorithm0, Serving0)
+    models = engine.train(ctx, params(algos=[("", AP(1))]))
+    assert models[0].ds_id == -99
+
+
+def test_eval_shape_and_join(ctx):
+    ep = params(algos=[("algo0", AP(1)), ("algo1", AP(2))])
+    result = make_engine().eval(ctx, ep)
+    assert len(result) == 2  # two eval sets from DataSource0
+    for ex, (info, qpas) in enumerate(result):
+        assert info.ex == ex
+        assert len(qpas) == 3
+        for q, p, a in qpas:
+            assert isinstance(p, Prediction)
+            assert q.qx == a.qx  # join preserved the pairing
+            assert p.model.ap_id == 1  # Serving0 returns first algo's prediction
+
+
+def test_eval_serving_sees_original_query(ctx):
+    engine = Engine(DataSource0, Preparator0, {"algo0": Algorithm0}, SupplementServing)
+    result = engine.eval(ctx, params(algos=[("algo0", AP(1))]))
+    # algorithms saw the supplemented query (qx+1000)
+    for _info, qpas in result:
+        for q, p, _a in qpas:
+            assert p.qx == q.qx + 1000
+
+
+def test_batch_eval_per_candidate(ctx):
+    eps = [params(algos=[("algo0", AP(i))]) for i in (1, 2, 3)]
+    out = make_engine().batch_eval(RuntimeContext(), eps)
+    assert [ep.algorithm_params_list[0][1].id for ep, _ in out] == [1, 2, 3]
+    for _ep, data in out:
+        assert len(data) == 2
+
+
+def test_jvalue_to_engine_params():
+    engine = make_engine()
+    variant = {
+        "id": "default",
+        "engineFactory": "whatever",
+        "datasource": {"params": {"id": 11}},
+        "preparator": {"params": {"id": 12}},
+        "algorithms": [
+            {"name": "algo0", "params": {"id": 13, "mult": 2}},
+            {"name": "algo1", "params": {"id": 14}},
+        ],
+        "serving": {"params": {"id": 15}},
+    }
+    ep = engine.jvalue_to_engine_params(variant)
+    assert ep.data_source_params == ("", DSP(11))
+    assert ep.preparator_params == ("", PP(12))
+    assert ep.algorithm_params_list == [("algo0", AP(13, 2)), ("algo1", AP(14))]
+    assert ep.serving_params == ("", SP(15))
+
+
+def test_jvalue_missing_sections_default_empty():
+    # Missing sections fall back to EmptyParams (Engine.scala:361-380)
+    ep = make_engine().jvalue_to_engine_params({"id": "x"})
+    assert ep.data_source_params == ("", EmptyParams())
+    assert ep.algorithm_params_list == []
+
+
+def test_prepare_deploy_passthrough_and_retrain(ctx):
+    from incubator_predictionio_tpu.core.persistent_model import RetrainMarker
+
+    engine = make_engine()
+    ep = params()
+    models = engine.train(ctx, ep)
+    served = engine.prepare_deploy(ctx, ep, "inst1", models)
+    assert served == models
+    retrained = engine.prepare_deploy(ctx, ep, "inst1", [RetrainMarker()])
+    assert retrained == models

@@ -1,0 +1,105 @@
+"""FastEvalEngine prefix-cache behavior (parity: FastEvalEngineTest.scala)."""
+
+from fake_engine import (
+    AP,
+    DSP,
+    PP,
+    SP,
+    Algorithm0,
+    Algorithm1,
+    DataSource0,
+    Preparator0,
+    Serving0,
+)
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.core.fast_eval import (
+    FastEvalEngine,
+    FastEvalEngineWorkflow,
+)
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+CALLS = {"read": 0, "prepare": 0, "train": 0}
+
+
+class CountingDataSource(DataSource0):
+    def read_eval(self, ctx):
+        CALLS["read"] += 1
+        return super().read_eval(ctx)
+
+
+class CountingPreparator(Preparator0):
+    def prepare(self, ctx, td):
+        CALLS["prepare"] += 1
+        return super().prepare(ctx, td)
+
+
+class CountingAlgorithm(Algorithm0):
+    def train(self, ctx, pd):
+        CALLS["train"] += 1
+        return super().train(ctx, pd)
+
+
+def make_fast():
+    return FastEvalEngine(
+        CountingDataSource,
+        CountingPreparator,
+        {"algo": CountingAlgorithm, "algo1": Algorithm1},
+        Serving0,
+    )
+
+
+def ep(ds=1, pp=2, ap=3, sp=4):
+    return EngineParams(
+        data_source_params=("", DSP(ds)),
+        preparator_params=("", PP(pp)),
+        algorithm_params_list=[("algo", AP(ap))],
+        serving_params=("", SP(sp)),
+    )
+
+
+def reset():
+    CALLS.update(read=0, prepare=0, train=0)
+
+
+def test_serving_only_variation_reuses_everything():
+    reset()
+    engine = make_fast()
+    out = engine.batch_eval(RuntimeContext(), [ep(sp=1), ep(sp=2), ep(sp=3)])
+    assert len(out) == 3
+    assert CALLS["read"] == 1       # one data source prefix
+    assert CALLS["prepare"] == 2    # one per eval set (2 sets), computed once
+    assert CALLS["train"] == 2      # one per eval set, computed once
+
+
+def test_algo_variation_reuses_prepared_data():
+    reset()
+    engine = make_fast()
+    engine.batch_eval(RuntimeContext(), [ep(ap=1), ep(ap=2)])
+    assert CALLS["read"] == 1
+    assert CALLS["prepare"] == 2    # cached across algo variants
+    assert CALLS["train"] == 4      # 2 algo variants × 2 eval sets
+
+
+def test_data_source_variation_recomputes():
+    reset()
+    engine = make_fast()
+    engine.batch_eval(RuntimeContext(), [ep(ds=1), ep(ds=2)])
+    assert CALLS["read"] == 2
+    assert CALLS["prepare"] == 4
+    assert CALLS["train"] == 4
+
+
+def test_results_match_plain_engine():
+    reset()
+    from incubator_predictionio_tpu.core import Engine
+
+    plain = Engine(
+        CountingDataSource, CountingPreparator,
+        {"algo": CountingAlgorithm, "algo1": Algorithm1}, Serving0,
+    )
+    candidates = [ep(ap=1), ep(ap=2), ep(sp=9)]
+    fast_out = make_fast().batch_eval(RuntimeContext(), candidates)
+    plain_out = plain.batch_eval(RuntimeContext(), candidates)
+    assert [
+        [(info, qpas) for info, qpas in data] for _p, data in fast_out
+    ] == [[(info, qpas) for info, qpas in data] for _p, data in plain_out]

@@ -1,0 +1,122 @@
+"""Metric family + MetricEvaluator (parity: MetricTest.scala, MetricEvaluatorTest.scala)."""
+
+import math
+
+import pytest
+
+from fake_engine import AP, make_engine, params
+from incubator_predictionio_tpu.core import (
+    AverageMetric,
+    EngineParams,
+    MetricEvaluator,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from incubator_predictionio_tpu.core.evaluation import Evaluation
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+
+class ScoreMetric(AverageMetric):
+    def calculate_qpa(self, q, p, a) -> float:
+        return float(q)
+
+
+class OptScoreMetric(OptionAverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(q) if q is not None else None
+
+
+class StdevQ(StdevMetric):
+    def calculate_qpa(self, q, p, a) -> float:
+        return float(q)
+
+
+class OptStdevQ(OptionStdevMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(q) if q is not None else None
+
+
+class SumQ(SumMetric):
+    def calculate_qpa(self, q, p, a) -> float:
+        return float(q)
+
+
+CTX = RuntimeContext()
+
+
+def eds(*qs):
+    """One eval set whose 'queries' are the scores themselves."""
+    return [(None, [(q, None, None) for q in qs])]
+
+
+def test_average_metric():
+    assert ScoreMetric().calculate(CTX, eds(1, 2, 3, 6)) == 3.0
+    # across multiple eval sets
+    two_sets = eds(1, 2) + eds(3, 6)
+    assert ScoreMetric().calculate(CTX, two_sets) == 3.0
+
+
+def test_option_average_skips_none():
+    assert OptScoreMetric().calculate(CTX, eds(1, None, 3, None, 5)) == 3.0
+    assert math.isnan(OptScoreMetric().calculate(CTX, eds(None, None)))
+
+
+def test_stdev_metrics():
+    assert StdevQ().calculate(CTX, eds(2, 2, 2)) == 0.0
+    assert StdevQ().calculate(CTX, eds(1, 3)) == 1.0
+    assert OptStdevQ().calculate(CTX, eds(1, None, 3)) == 1.0
+
+
+def test_sum_and_zero():
+    assert SumQ().calculate(CTX, eds(1, 2, 3)) == 6.0
+    assert ZeroMetric().calculate(CTX, eds(1, 2)) == 0.0
+
+
+def test_compare_ordering():
+    m = ScoreMetric()
+    assert m.compare(2.0, 1.0) > 0
+    assert m.compare(1.0, 2.0) < 0
+    assert m.compare(1.0, 1.0) == 0
+
+
+def test_metric_evaluator_picks_best(tmp_path):
+    from fake_engine import QxMetric
+
+    engine = make_engine()
+    # candidates with ap_id 1, 5, 3 — QxMetric scores = ap_id, so best is 5
+    eps = [params(algos=[("algo0", AP(i))]) for i in (1, 5, 3)]
+    data = engine.batch_eval(CTX, eps)
+    best_json = tmp_path / "best.json"
+    evaluator = MetricEvaluator(QxMetric(), output_path=str(best_json))
+    result = evaluator.evaluate(CTX, None, data)
+    assert result.best_idx == 1
+    assert result.best_score.score == 5.0
+    assert result.best_engine_params.algorithm_params_list[0][1].id == 5
+    assert best_json.exists()
+    assert "5" in result.to_one_liner()
+    assert "<table" in result.to_html()
+    assert result.to_jsonable()["bestIdx"] == 1
+
+
+def test_evaluation_dsl_wiring():
+    from fake_engine import QxMetric
+
+    ev = Evaluation()
+    engine = make_engine()
+    ev.engine_metric = (engine, QxMetric())
+    eng, evaluator = ev.engine_evaluator
+    assert eng is engine
+    assert isinstance(evaluator, MetricEvaluator)
+    with pytest.raises(RuntimeError):
+        ev.engine = engine  # assign-once
+
+
+def test_evaluation_requires_assignment():
+    ev = Evaluation()
+    with pytest.raises(RuntimeError):
+        _ = ev.engine
+    with pytest.raises(RuntimeError):
+        _ = ev.evaluator

@@ -1,0 +1,112 @@
+"""SelfCleaningDataSource behavior (parity: core/SelfCleaningDataSource.scala)."""
+
+from datetime import timedelta
+
+import pytest
+
+from incubator_predictionio_tpu.core.self_cleaning import (
+    EventWindow,
+    SelfCleaningDataSource,
+    compress_properties,
+    parse_duration,
+)
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import App, Storage
+from incubator_predictionio_tpu.utils.times import now_utc
+
+
+def test_parse_duration():
+    assert parse_duration("30 days") == timedelta(days=30)
+    assert parse_duration("3600s") == timedelta(seconds=3600)
+    assert parse_duration("2h") == timedelta(hours=2)
+    assert parse_duration(90) == timedelta(seconds=90)
+    assert parse_duration(timedelta(minutes=1)) == timedelta(minutes=1)
+    with pytest.raises(ValueError):
+        parse_duration("banana")
+
+
+def ev(name, eid, minutes_ago, props=None, target=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=now_utc() - timedelta(minutes=minutes_ago),
+    )
+
+
+def test_compress_set_chains():
+    events = [
+        ev("$set", "u1", 30, {"a": 1, "b": "old"}),
+        ev("$set", "u1", 20, {"b": "new"}),
+        ev("$unset", "u1", 10, {"a": None}),
+        ev("rate", "u1", 5, {"r": 4}, target="i1"),
+        ev("$set", "u2", 15, {"x": 1}),
+    ]
+    out = compress_properties(events)
+    sets = [e for e in out if e.event == "$set"]
+    assert len(sets) == 2
+    u1_set = next(e for e in sets if e.entity_id == "u1")
+    assert u1_set.properties.fields == {"a": 1, "b": "new"}
+    # $unset and plain events pass through
+    assert sum(1 for e in out if e.event == "$unset") == 1
+    assert sum(1 for e in out if e.event == "rate") == 1
+
+
+class CleaningSource(SelfCleaningDataSource):
+    def __init__(self, app_name, window):
+        self.app_name = app_name
+        self.event_window = window
+
+
+@pytest.fixture
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+def test_clean_persisted_events(mem_storage):
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "cleanapp"))
+    dao = Storage.get_events()
+    dao.insert(ev("$set", "u1", minutes_ago=60 * 24 * 40, props={"stale": 1}), app_id)
+    dao.insert(ev("$set", "u1", minutes_ago=30, props={"a": 1}), app_id)
+    dao.insert(ev("$set", "u1", minutes_ago=20, props={"b": 2}), app_id)
+    dao.insert(ev("rate", "u1", minutes_ago=10, props={"r": 5}, target="i1"), app_id)
+    dup = ev("buy", "u1", minutes_ago=9, target="i2")
+    dao.insert(dup, app_id)
+    dao.insert(dup.with_id(None), app_id)  # duplicate content, new id
+
+    src = CleaningSource(
+        "cleanapp",
+        EventWindow(duration="30 days", remove_duplicates=True,
+                    compress_properties=True),
+    )
+    n = src.clean_persisted_events()
+    remaining = list(dao.find(app_id=app_id))
+    assert n == len(remaining) == 3  # merged $set + rate + one buy
+    merged = next(e for e in remaining if e.event == "$set")
+    assert merged.properties.fields == {"a": 1, "b": 2}  # stale event dropped
+    assert sum(1 for e in remaining if e.event == "buy") == 1
+
+
+def test_no_window_is_noop(mem_storage):
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "noopapp"))
+    dao = Storage.get_events()
+    dao.insert(ev("rate", "u1", 5, target="i1"), app_id)
+    src = CleaningSource("noopapp", None)
+    assert src.clean_persisted_events() == 0
+    assert len(list(dao.find(app_id=app_id))) == 1

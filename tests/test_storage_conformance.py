@@ -275,3 +275,49 @@ def test_localfs_models(tmp_path):
     assert models.get("m1").models == b"blob"
     models.delete("m1")
     assert models.get("m1") is None
+
+
+# ---------------------------------------------------------------------------
+# Review-fix regressions
+# ---------------------------------------------------------------------------
+
+def test_auto_id_skips_explicit_ids(backend):
+    apps = dao(backend, "Apps")
+    assert apps.insert(App(1, "explicit")) == 1
+    auto = apps.insert(App(0, "auto"))
+    assert auto is not None and auto != 1
+    channels = dao(backend, "Channels")
+    assert channels.insert(Channel(5, "chan-a", 1)) == 5
+    auto_c = channels.insert(Channel(0, "chan-b", 1))
+    assert auto_c is not None and auto_c != 5
+
+
+def test_namespace_isolation(backend):
+    mod, client, config = backend
+    apps_a = mod.DATA_OBJECTS["Apps"](client, config, prefix="nsA_")
+    apps_b = mod.DATA_OBJECTS["Apps"](client, config, prefix="nsB_")
+    assert apps_a.insert(App(0, "same-name")) is not None
+    assert apps_b.insert(App(0, "same-name")) is not None  # no cross-ns clash
+    assert apps_a.get_by_name("same-name") is not None
+    assert len(apps_a.get_all()) == 1
+    events_a = mod.DATA_OBJECTS["Events"](client, config, prefix="nsA_")
+    events_b = mod.DATA_OBJECTS["Events"](client, config, prefix="nsB_")
+    events_a.insert(ev(), 1)
+    assert list(events_b.find(app_id=1)) == []
+    assert len(list(events_a.find(app_id=1))) == 1
+
+
+def test_aggregate_required_filters_by_property_names(backend):
+    events = dao(backend, "Events")
+    events.init(1)
+    events.insert(ev("$set", "u1", 0, props={"rating": 5, "zip": "10001"}), 1)
+    events.insert(ev("$set", "u2", 0, props={"zip": "94305"}), 1)
+    out = events.aggregate_properties(app_id=1, entity_type="user",
+                                      required=["rating"])
+    assert set(out) == {"u1"}
+    out2 = events.aggregate_properties(app_id=1, entity_type="user",
+                                       required=["rating", "zip"])
+    assert set(out2) == {"u1"}
+    out3 = events.aggregate_properties(app_id=1, entity_type="user",
+                                       required=["zip"])
+    assert set(out3) == {"u1", "u2"}

@@ -86,3 +86,12 @@ def test_event_store_facade():
         list(EventStore.find(app_name="no-such-app"))
     with pytest.raises(EventStoreError):
         list(EventStore.find(app_name="facade-app", channel_name="nope"))
+
+
+def test_partial_repository_config_errors():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",  # NAME missing
+    })
+    with pytest.raises(StorageError, match="BOTH"):
+        Storage.get_meta_data_apps()

@@ -1,0 +1,110 @@
+"""CoreWorkflow train/eval lifecycle (parity: workflow/CoreWorkflow.scala,
+EvaluationWorkflowTest.scala)."""
+
+import numpy as np
+import pytest
+
+from fake_engine import AP, QxMetric, make_engine, params
+from incubator_predictionio_tpu.core import MetricEvaluator
+from incubator_predictionio_tpu.core.evaluation import Evaluation
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.workflow import CoreWorkflow, checkpoint
+
+
+@pytest.fixture(autouse=True)
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+def test_run_train_lifecycle():
+    engine = make_engine()
+    instance_id = CoreWorkflow.run_train(
+        engine, params(), engine_variant="v1", engine_factory="tests.fake"
+    )
+    instances = Storage.get_meta_data_engine_instances()
+    inst = instances.get(instance_id)
+    assert inst.status == "COMPLETED"
+    assert inst.engine_variant == "v1"
+    assert "algo0" in inst.algorithms_params
+    # models restorable
+    models = CoreWorkflow.load_models(instance_id)
+    assert models[0].ap_id == 3
+    # latest-completed resolution (what deploy uses)
+    latest = instances.get_latest_completed("default", "NOT_VERSIONED", "v1")
+    assert latest.id == instance_id
+
+
+def test_run_train_failure_marks_aborted():
+    from fake_engine import FailingDataSource, Preparator0, Algorithm0, Serving0
+    from incubator_predictionio_tpu.core import Engine
+
+    engine = Engine(FailingDataSource, Preparator0, Algorithm0, Serving0)
+    with pytest.raises(RuntimeError):
+        CoreWorkflow.run_train(engine, params(algos=[("", AP(1))]))
+    insts = Storage.get_meta_data_engine_instances().get_all()
+    assert [i.status for i in insts] == ["ABORTED"]
+
+
+def test_checkpoint_round_trip_with_jax_arrays():
+    import jax.numpy as jnp
+
+    model = {"w": jnp.arange(8, dtype=jnp.float32).reshape(2, 4),
+             "meta": {"name": "m", "ids": [1, 2, 3]}}
+    blob = checkpoint.dumps(model)
+    back = checkpoint.loads(blob)
+    assert isinstance(back["w"], np.ndarray)
+    np.testing.assert_array_equal(back["w"], np.arange(8, dtype=np.float32).reshape(2, 4))
+    assert back["meta"] == {"name": "m", "ids": [1, 2, 3]}
+    restored = checkpoint.device_restore(back)
+    import jax
+    assert isinstance(restored["w"], jax.Array)
+
+
+from incubator_predictionio_tpu.core.persistent_model import (
+    LocalFileSystemPersistentModel,
+)
+
+
+class MyModel(LocalFileSystemPersistentModel):
+    def __init__(self, value):
+        self.value = value
+
+
+def test_persistent_model_checkpoint(tmp_home):
+    from incubator_predictionio_tpu.core.persistent_model import (
+        PersistentModelManifest,
+    )
+    from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+    ctx = RuntimeContext()
+    blob = checkpoint.serialize_models([MyModel(42)], "inst-7", ctx)
+    stored = checkpoint.deserialize_models(blob)
+    assert isinstance(stored[0], PersistentModelManifest)
+    loaded = stored[0].load(None, ctx)
+    assert loaded.value == 42
+
+
+def test_run_evaluation_lifecycle():
+    engine = make_engine()
+    evaluation = Evaluation()
+    evaluation.engine_metric = (engine, QxMetric())
+    candidates = [params(algos=[("algo0", AP(i))]) for i in (1, 4, 2)]
+    instance_id, result = CoreWorkflow.run_evaluation(
+        evaluation, candidates, evaluation_class="tests.Eval"
+    )
+    assert result.best_score.score == 4.0
+    inst = Storage.get_meta_data_evaluation_instances().get(instance_id)
+    assert inst.status == "EVALCOMPLETED"
+    assert "4.0" in inst.evaluator_results
+    assert inst.evaluator_results_json
+    assert Storage.get_meta_data_evaluation_instances().get_completed()[0].id == instance_id

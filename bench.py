@@ -1,0 +1,86 @@
+"""Headline benchmark: `pio train` compute kernel on the flagship template.
+
+Measures ALS matrix-factorization training wall-clock at MovieLens-100K
+scale (943 users × 1682 items × 100k ratings, rank 64, 10 sweeps) on the
+default JAX device — the TPU under the driver. This is the north-star metric
+from BASELINE.md: the reference's `pio train` on the Recommendation template
+delegates to Spark MLlib ALS; the reference publishes no numbers, so the
+baseline is self-generated (BASELINE.md "to be measured").
+
+Baseline: the same solver on this host's CPU (JAX CPU backend, warm cache)
+measured at 3.79 s — our stand-in for the single-box Spark driver the
+reference CI validates against (tests/before_script.travis.sh:25-28; Spark
+1.4 itself cannot run in this offline image). ``vs_baseline`` > 1 means the
+TPU path is faster than that CPU reference.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+#: CPU-JAX warm wall-clock for the identical workload on this image's host
+#: (measured via `python bench.py --cpu`); the Spark-MLlib single-box number
+#: this proxies is historically far slower, so this is a conservative bar.
+CPU_BASELINE_S = 3.79
+
+N_USERS, N_ITEMS, NNZ = 943, 1682, 100_000
+RANK, ITERATIONS, L2 = 64, 10, 0.1
+
+
+def make_dataset():
+    rng = np.random.default_rng(7)
+    users = rng.integers(0, N_USERS, NNZ)
+    pop = rng.zipf(1.3, NNZ * 3) - 1
+    items = pop[pop < N_ITEMS][:NNZ].astype(np.int64)
+    users = users[: len(items)]
+    ratings = rng.integers(1, 6, len(items)).astype(np.float32)
+    return users, items, ratings
+
+
+def run(platform_cpu: bool = False) -> None:
+    if platform_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from incubator_predictionio_tpu.ops import als_train, rmse
+
+    users, items, ratings = make_dataset()
+
+    def train():
+        state, _ = als_train(
+            users, items, ratings, N_USERS, N_ITEMS,
+            rank=RANK, iterations=ITERATIONS, l2=L2, seed=0,
+        )
+        jax.block_until_ready(state.user_factors)
+        return state
+
+    t0 = time.perf_counter()
+    state = train()
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    state = train()
+    warm_s = time.perf_counter() - t0
+
+    fit = rmse(state, users, items, ratings)
+    print(
+        f"device={jax.devices()[0]} compile+first={compile_s:.2f}s "
+        f"warm={warm_s:.3f}s train_rmse={fit:.3f}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "als_ml100k_train_wall_s",
+        "value": round(warm_s, 3),
+        "unit": "s",
+        "vs_baseline": round(CPU_BASELINE_S / warm_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    run(platform_cpu="--cpu" in sys.argv)

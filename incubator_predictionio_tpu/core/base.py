@@ -184,6 +184,18 @@ class Algorithm(_Component, Generic[PD, M, Q, P]):
         """
         return [(qx, self.predict(model, q)) for qx, q in queries]
 
+    def prepare_model(self, ctx: RuntimeContext, model: M) -> M:
+        """Deploy-time hook: make a checkpoint-restored model servable.
+
+        Checkpoints hold host numpy arrays (workflow/checkpoint.py); without
+        this hook every predict would re-transfer weights host→device. TPU
+        implementations should ``jax.device_put`` their arrays here so
+        serving runs against device-resident state. Called by
+        ``Engine.prepare_deploy`` (the reference's equivalent moment is
+        CreateServer's model localization, CreateServer.scala:216-266).
+        """
+        return model
+
     @property
     def query_class(self) -> Optional[type]:
         """Query dataclass for JSON extraction at the server edge

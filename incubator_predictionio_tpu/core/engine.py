@@ -228,10 +228,8 @@ class Engine:
         out: List[Any] = []
         for algo, model in zip(algo_list, trained):
             if isinstance(model, PersistentModelManifest):
-                algo_params = algo.params
-                out.append(model.load(algo_params, ctx))
-            else:
-                out.append(model)
+                model = model.load(algo.params, ctx)
+            out.append(algo.prepare_model(ctx, model))
         return out
 
     # -- engine.json params extraction (Engine.scala:357-420) ---------------

@@ -99,6 +99,8 @@ class SelfCleaningDataSource:
     SelfCleaningDataSource.scala:269-301)."""
 
     app_name: str
+    #: optional channel the DataSource reads — cleaning targets the same one
+    channel_name: Optional[str] = None
     event_window: Optional[EventWindow] = None
 
     def _app_id(self) -> int:
@@ -106,6 +108,17 @@ class SelfCleaningDataSource:
         if app is None:
             raise ValueError(f"Invalid app name {self.app_name}")
         return app.id
+
+    def _channel_id(self) -> Optional[int]:
+        name = getattr(self, "channel_name", None)
+        if not name:
+            return None
+        for c in Storage.get_meta_data_channels().get_by_appid(self._app_id()):
+            if c.name == name:
+                return c.id
+        raise ValueError(
+            f"Invalid channel name {name} for app {self.app_name}"
+        )
 
     def get_cleaned_events(self, events: Iterable[Event]) -> List[Event]:
         """Pure transformation (cleanPEvents/compress/dedup)."""
@@ -129,11 +142,14 @@ class SelfCleaningDataSource:
             rows = unique
         return sorted(rows, key=lambda e: e.event_time)
 
-    def clean_persisted_events(self, channel_id: Optional[int] = None) -> int:
+    def clean_persisted_events(self, channel_id: Optional[int] = "__from_name__") -> int:
         """Clean + rewrite the persisted events (cleanPersistedPEvents:161,
-        wipe:209). Returns the cleaned event count."""
+        wipe:209) of the channel this DataSource reads (``channel_name``,
+        default channel when unset). Returns the cleaned event count."""
         if self.event_window is None:
             return 0
+        if channel_id == "__from_name__":
+            channel_id = self._channel_id()
         app_id = self._app_id()
         dao = Storage.get_events()
         before = list(dao.find(app_id=app_id, channel_id=channel_id))

@@ -1,0 +1,10 @@
+"""Engine templates — the framework's model zoo.
+
+Each subpackage is a complete DASE engine mirroring one of the reference's
+template families (SURVEY.md §2.6, examples/scala-parallel-*):
+
+- ``recommendation``  — explicit-rating ALS matrix factorization
+- ``classification``  — categorical NaiveBayes + optax logistic regression
+- ``similarproduct``  — implicit-feedback ALS, item-to-item queries
+- ``ecommerce``       — implicit ALS + serve-time business-rule filtering
+"""

@@ -1,0 +1,29 @@
+"""Recommendation template (explicit-rating ALS).
+
+Parity: examples/scala-parallel-recommendation/ — all four variants'
+capabilities in one engine: custom queries (creation-year filter), custom
+preparator hooks, custom serving, and filter-by-category style masks.
+"""
+
+from incubator_predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    ALSModel,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Query,
+    Rating,
+    RecommendationDataSource,
+    RecommendationEngine,
+    RecommendationPreparator,
+    RecommendationServing,
+    TrainingData,
+)
+
+__all__ = [
+    "ALSAlgorithm", "ALSAlgorithmParams", "ALSModel", "DataSourceParams",
+    "ItemScore", "PredictedResult", "Query", "Rating",
+    "RecommendationDataSource", "RecommendationEngine",
+    "RecommendationPreparator", "RecommendationServing", "TrainingData",
+]

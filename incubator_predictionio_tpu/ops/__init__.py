@@ -1,0 +1,24 @@
+"""TPU compute kernels — the replacement for Spark MLlib.
+
+Where the reference's engine templates call MLlib (ALS at
+examples/scala-parallel-recommendation/custom-query/src/main/scala/
+ALSAlgorithm.scala:25-31, NaiveBayes/RandomForest in
+examples/scala-parallel-classification/), this package provides JAX/XLA
+implementations designed for the MXU: batched normal-equation solves,
+one-big-matmul scoring, device top-k, segment-sum sufficient statistics.
+"""
+
+from incubator_predictionio_tpu.ops.sparse import PaddedRows, build_padded_rows
+from incubator_predictionio_tpu.ops.als import (
+    ALSState,
+    als_init,
+    als_sweep,
+    als_train,
+    rmse,
+)
+from incubator_predictionio_tpu.ops.topk import top_k_with_exclusions
+
+__all__ = [
+    "PaddedRows", "build_padded_rows", "ALSState", "als_init", "als_sweep",
+    "als_train", "rmse", "top_k_with_exclusions",
+]

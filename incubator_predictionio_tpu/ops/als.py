@@ -1,0 +1,256 @@
+"""Alternating Least Squares on TPU — the MLlib-ALS replacement.
+
+The reference's recommendation templates call Spark MLlib's shuffle-based ALS
+(examples/scala-parallel-recommendation/custom-query/src/main/scala/
+ALSAlgorithm.scala:25-31). This is the TPU-first redesign (ALX-style,
+PAPERS.md): factors live in dense device arrays; each half-sweep is
+
+  1. gather the *other* side's factors for every observed interaction
+     (degree-bucketed padded rows, see ops.sparse),
+  2. one big batched einsum builds all K×K normal-equation Grams at once
+     (bf16 inputs, f32 accumulation — MXU-shaped work),
+  3. a batched Cholesky-backed solve produces the new factors,
+  4. a masked scatter writes them back.
+
+Sharding: the padded-row batches shard across the whole mesh on the batch
+axis; factor tables are replicated (they are MBs even at ML-20M scale:
+270k×128 ≈ 138 MB total) so gathers are local and XLA inserts exactly one
+all-gather per half-sweep when the scatter output needs replication again.
+Model-parallel sharded factor tables (the full ALX layout for >100M-row
+embedding tables) ride the same bucket structure and are the designated
+extension on the ``mp`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from incubator_predictionio_tpu.ops.sparse import PaddedRows, build_padded_rows
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ALSState:
+    """Factor matrices (a pytree — checkpoints via workflow.checkpoint)."""
+
+    user_factors: Any  # [n_users, rank] f32
+    item_factors: Any  # [n_items, rank] f32
+
+
+def als_init(
+    key: jax.Array, n_users: int, n_items: int, rank: int, scale: float = 0.1
+) -> ALSState:
+    ku, ki = jax.random.split(key)
+    return ALSState(
+        user_factors=scale * jax.random.normal(ku, (n_users, rank), jnp.float32),
+        item_factors=scale * jax.random.normal(ki, (n_items, rank), jnp.float32),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("reg_nnz", "compute_dtype", "precision")
+)
+def _solve_bucket(
+    other_factors: jax.Array,  # [M, K] f32
+    cols: jax.Array,           # [B, D] int32
+    vals: jax.Array,           # [B, D] f32
+    mask: jax.Array,           # [B, D] f32 in {0, 1}
+    l2: float,
+    reg_nnz: bool = True,
+    compute_dtype: Any = jnp.float32,
+    precision: Any = jax.lax.Precision.HIGHEST,
+) -> jax.Array:
+    """Batched normal-equation solve for one degree bucket → [B, K].
+
+    Precision note: DEFAULT matmul precision truncates f32 einsum inputs to
+    bf16 passes, which stalls ALS convergence (the Gram matrices pick up
+    ~1e-2 error and the alternation stops improving around RMSE 0.6 on data
+    it should fit to <0.1). The Gram/rhs assembly therefore defaults to
+    HIGHEST (multi-pass f32 on the MXU); ``compute_dtype=bfloat16`` with
+    DEFAULT precision remains available as the fast low-precision mode for
+    early sweeps.
+    """
+    rank = other_factors.shape[1]
+    gathered = other_factors[cols]                      # [B, D, K]
+    masked = gathered * mask[..., None]
+    g16 = masked.astype(compute_dtype)
+    # Gram: mask appears once on one side (mask² == mask for 0/1)
+    gram = jnp.einsum(
+        "bdk,bdl->bkl", g16, gathered.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )                                                   # [B, K, K]
+    rhs = jnp.einsum(
+        "bd,bdk->bk", (vals * mask).astype(compute_dtype), g16,
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )                                                   # [B, K]
+    nnz = mask.sum(axis=-1)                             # [B]
+    # MLlib-style ALS-WR: lambda scaled by row nnz (reg_nnz=True)
+    lam = l2 * jnp.where(reg_nnz, jnp.maximum(nnz, 1.0), 1.0)
+    a = gram + lam[:, None, None] * jnp.eye(rank, dtype=jnp.float32)
+    # cho_solve over the batch: SPD systems, maps to MXU-friendly triangular ops
+    chol = jax.scipy.linalg.cho_factor(a)
+    sol = jax.scipy.linalg.cho_solve(chol, rhs[..., None])[..., 0]
+    # rows with zero observations keep zero factors
+    return jnp.where(nnz[:, None] > 0, sol, 0.0)
+
+
+@functools.partial(jax.jit, donate_argnames=("out",),
+                   static_argnames=())
+def _scatter_rows(out: jax.Array, row_ids: jax.Array, sol: jax.Array) -> jax.Array:
+    # Padding rows carry row_id -1. JAX scatter wraps negative indices
+    # numpy-style (-1 = last row!), so remap them to n (out of bounds) where
+    # mode="drop" genuinely drops them.
+    safe_ids = jnp.where(row_ids < 0, out.shape[0], row_ids)
+    return out.at[safe_ids].set(sol, mode="drop")
+
+
+def _update_side(
+    n_rows: int,
+    other_factors: jax.Array,
+    buckets: Sequence[PaddedRows],
+    l2: float,
+    reg_nnz: bool,
+    compute_dtype: Any,
+    precision: Any,
+) -> jax.Array:
+    rank = other_factors.shape[1]
+    out = jnp.zeros((n_rows, rank), jnp.float32)
+    for bucket in buckets:
+        sol = _solve_bucket(
+            other_factors,
+            jnp.asarray(bucket.cols),
+            jnp.asarray(bucket.vals),
+            jnp.asarray(bucket.mask),
+            l2,
+            reg_nnz=reg_nnz,
+            compute_dtype=compute_dtype,
+            precision=precision,
+        )
+        out = _scatter_rows(out, jnp.asarray(bucket.row_ids), sol)
+    return out
+
+
+def assert_no_split(buckets: Sequence[PaddedRows], side: str = "row") -> None:
+    """Raise if any row was split across padded rows (degree > max_width).
+
+    The scatter-set in the sweep keeps one arbitrary segment's solution for a
+    duplicated row id, which would be silently wrong — so it is an error
+    until the partial-Gram combining solver lands."""
+    ids = np.concatenate(
+        [np.asarray(b.row_ids)[np.asarray(b.row_ids) >= 0] for b in buckets]
+    ) if buckets else np.empty(0, np.int32)
+    if len(ids) != len(np.unique(ids)):
+        raise NotImplementedError(
+            f"a {side} exceeds the bucket max_width (its interactions were "
+            "split across solve rows); raise max_width or wait for the "
+            "sharded-split solver"
+        )
+
+
+def als_sweep(
+    state: ALSState,
+    user_buckets: Sequence[PaddedRows],
+    item_buckets: Sequence[PaddedRows],
+    l2: float = 0.1,
+    reg_nnz: bool = True,
+    compute_dtype: Any = jnp.float32,
+    precision: Any = jax.lax.Precision.HIGHEST,
+    validate: bool = True,
+) -> ALSState:
+    """One full ALS iteration: solve users against items, then items against
+    the *new* user factors (the classic alternation order).
+
+    ``validate`` checks the buckets contain no split rows (see
+    :func:`assert_no_split`); pass False when the caller has already
+    validated (als_train does, once, outside the sweep loop)."""
+    if validate:
+        assert_no_split(user_buckets, "user")
+        assert_no_split(item_buckets, "item")
+    new_users = _update_side(
+        state.user_factors.shape[0], state.item_factors, user_buckets,
+        l2, reg_nnz, compute_dtype, precision,
+    )
+    new_items = _update_side(
+        state.item_factors.shape[0], new_users, item_buckets,
+        l2, reg_nnz, compute_dtype, precision,
+    )
+    return ALSState(user_factors=new_users, item_factors=new_items)
+
+
+@jax.jit
+def _predict_coo(
+    user_factors: jax.Array, item_factors: jax.Array,
+    users: jax.Array, items: jax.Array,
+) -> jax.Array:
+    return jnp.sum(user_factors[users] * item_factors[items], axis=-1)
+
+
+def rmse(
+    state: ALSState,
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    chunk: int = 1 << 20,
+) -> float:
+    """Root-mean-square error over COO ratings (evaluation metric parity with
+    the reference recommendation template's eval)."""
+    users = np.asarray(users, np.int32)
+    items = np.asarray(items, np.int32)
+    ratings = np.asarray(ratings, np.float32)
+    total, n = 0.0, len(ratings)
+    for s in range(0, n, chunk):
+        pred = _predict_coo(
+            state.user_factors, state.item_factors,
+            jnp.asarray(users[s:s + chunk]), jnp.asarray(items[s:s + chunk]),
+        )
+        total += float(jnp.sum((pred - jnp.asarray(ratings[s:s + chunk])) ** 2))
+    return float(np.sqrt(total / max(n, 1)))
+
+
+def als_train(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    rank: int = 64,
+    iterations: int = 10,
+    l2: float = 0.1,
+    seed: int = 0,
+    reg_nnz: bool = True,
+    compute_dtype: Any = jnp.float32,
+    precision: Any = jax.lax.Precision.HIGHEST,
+    max_width: int = 1 << 16,
+    track_rmse: bool = False,
+) -> Tuple[ALSState, List[float]]:
+    """Full training: build padded buckets once, run ``iterations`` sweeps.
+
+    Raises if any row's degree exceeds ``max_width`` (row splitting across
+    solve batches — the multi-chip ALX path — is not wired into the solver
+    yet; 65k interactions per single user/item is beyond the single-chip
+    design point)."""
+    user_buckets = build_padded_rows(users, items, ratings, n_users,
+                                     max_width=max_width)
+    item_buckets = build_padded_rows(items, users, ratings, n_items,
+                                     max_width=max_width)
+    assert_no_split(user_buckets, "user")
+    assert_no_split(item_buckets, "item")
+
+    state = als_init(jax.random.key(seed), n_users, n_items, rank)
+    history: List[float] = []
+    for _ in range(iterations):
+        state = als_sweep(state, user_buckets, item_buckets, l2,
+                          reg_nnz=reg_nnz, compute_dtype=compute_dtype,
+                          precision=precision, validate=False)
+        if track_rmse:
+            history.append(rmse(state, users, items, ratings))
+    return state, history

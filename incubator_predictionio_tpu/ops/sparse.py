@@ -1,0 +1,130 @@
+"""Host-side sparse → static-shape padded structures.
+
+XLA wants static shapes; ratings matrices are ragged. The bridge is
+degree-bucketed padded neighbor lists: rows (users or items) are grouped into
+buckets by degree ceiling (powers of two), each bucket padded to its ceiling.
+This bounds padding waste at <2× while keeping the number of distinct
+compiled shapes at O(log max_degree) — the ALX paper's sharded-batch layout
+reduced to its single-host form (PAPERS.md: ALX §4).
+
+Construction is host-side numpy (it runs once per training read, off the
+device hot path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PaddedRows:
+    """One degree bucket of padded neighbor lists.
+
+    ``row_ids[i]`` is the original row index of padded row ``i``;
+    ``cols[i, :]`` / ``vals[i, :]`` are its neighbor column indices and
+    values, valid where ``mask[i, :] > 0``. Padding columns point at index 0
+    with mask 0 so gathers stay in-bounds.
+    """
+
+    row_ids: np.ndarray  # [B] int32
+    cols: np.ndarray     # [B, D] int32
+    vals: np.ndarray     # [B, D] float32
+    mask: np.ndarray     # [B, D] float32
+
+    @property
+    def width(self) -> int:
+        return int(self.cols.shape[1])
+
+    def pad_rows_to(self, multiple: int) -> "PaddedRows":
+        """Pad the batch dimension to a multiple (device-count divisibility).
+
+        Padding rows carry ``row_id = -1`` with zero mask; the ALS scatter
+        remaps negatives out of bounds and drops them (ops/als.py
+        ``_scatter_rows``)."""
+        b = self.row_ids.shape[0]
+        target = ((b + multiple - 1) // multiple) * multiple
+        if target == b:
+            return self
+        pad = target - b
+        return PaddedRows(
+            row_ids=np.concatenate([self.row_ids, np.full(pad, -1, np.int32)]),
+            cols=np.concatenate(
+                [self.cols, np.zeros((pad, self.width), np.int32)]
+            ),
+            vals=np.concatenate(
+                [self.vals, np.zeros((pad, self.width), np.float32)]
+            ),
+            mask=np.concatenate(
+                [self.mask, np.zeros((pad, self.width), np.float32)]
+            ),
+        )
+
+
+def build_padded_rows(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_rows: int,
+    min_width: int = 8,
+    max_width: int = 4096,
+    row_multiple: int = 8,
+) -> List[PaddedRows]:
+    """COO triplets → degree-bucketed :class:`PaddedRows`.
+
+    Rows with degree > ``max_width`` are *split* across multiple padded rows
+    of width ``max_width``, so no data is dropped for power users/items.
+    NOTE: the current ALS solver writes one solution per padded row
+    (scatter-set) and therefore cannot combine split rows — it validates and
+    raises on them (ops/als.py ``assert_no_split``). The split layout exists
+    for the future partial-Gram combining solver (the ALX multi-chip path);
+    until then keep ``max_width`` above the data's max degree.
+    """
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int32)
+    vals = np.asarray(vals, np.float32)
+    order = np.argsort(rows, kind="stable")
+    rows, cols, vals = rows[order], cols[order], vals[order]
+
+    row_ids_present, starts, counts = np.unique(
+        rows, return_index=True, return_counts=True
+    )
+
+    # assemble (row_id, start, length) segments, splitting heavy rows
+    segments: List[Tuple[int, int, int]] = []
+    for rid, start, count in zip(row_ids_present, starts, counts):
+        off = 0
+        while count - off > 0:
+            seg = min(count - off, max_width)
+            segments.append((int(rid), int(start + off), int(seg)))
+            off += seg
+
+    # bucket segments by power-of-two ceiling
+    buckets: dict[int, List[Tuple[int, int, int]]] = {}
+    for rid, start, seg in segments:
+        width = min_width
+        while width < seg:
+            width *= 2
+        buckets.setdefault(width, []).append((rid, start, seg))
+
+    out: List[PaddedRows] = []
+    for width in sorted(buckets):
+        segs = buckets[width]
+        b = len(segs)
+        r_ids = np.empty(b, np.int32)
+        c = np.zeros((b, width), np.int32)
+        v = np.zeros((b, width), np.float32)
+        m = np.zeros((b, width), np.float32)
+        for i, (rid, start, seg) in enumerate(segs):
+            r_ids[i] = rid
+            c[i, :seg] = cols[start:start + seg]
+            v[i, :seg] = vals[start:start + seg]
+            m[i, :seg] = 1.0
+        out.append(
+            PaddedRows(row_ids=r_ids, cols=c, vals=v, mask=m).pad_rows_to(
+                row_multiple
+            )
+        )
+    return out

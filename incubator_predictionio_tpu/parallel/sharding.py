@@ -1,0 +1,48 @@
+"""Sharding placement helpers for the training data structures.
+
+The single "sharding recipe" of the framework (scaling-book style): batch
+dimensions shard over the whole mesh (both axes flattened), factor/parameter
+tables are replicated (small) or sharded over ``mp`` (large). XLA/GSPMD
+propagates these placements through the jitted sweeps and inserts the
+collectives (all-gathers after scatter, psums in grads) on ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from incubator_predictionio_tpu.ops.sparse import PaddedRows
+from incubator_predictionio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded across every device (dp×mp flattened)."""
+    return NamedSharding(mesh, P((DATA_AXIS, MODEL_AXIS)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def model_sharding(mesh: Mesh) -> NamedSharding:
+    """Parameter tables sharded on rows over the model axis (ALX layout)."""
+    return NamedSharding(mesh, P(MODEL_AXIS))
+
+
+def shard_bucket(bucket: PaddedRows, mesh: Mesh) -> PaddedRows:
+    """Place one padded bucket with rows sharded over the mesh. The bucket
+    must have been built with ``row_multiple`` = device count."""
+    rows = batch_sharding(mesh)
+    return PaddedRows(
+        row_ids=jax.device_put(bucket.row_ids, rows),
+        cols=jax.device_put(bucket.cols, rows),
+        vals=jax.device_put(bucket.vals, rows),
+        mask=jax.device_put(bucket.mask, rows),
+    )
+
+
+def shard_buckets(buckets: Sequence[PaddedRows], mesh: Mesh) -> list[PaddedRows]:
+    return [shard_bucket(b, mesh) for b in buckets]

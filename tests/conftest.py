@@ -8,12 +8,21 @@ must be set before the first ``import jax`` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the first jax import anywhere in the test process. The
+# environment may pin JAX_PLATFORMS=axon (real TPU) via sitecustomize, which
+# registers the backend at interpreter start — so overriding the env var is
+# not enough; the config update below re-selects CPU before backends
+# initialize (they are lazy).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,170 @@
+"""End-to-end recommendation template: events → train → predict → eval.
+
+Parity: the reference's quickstart flow (tests/pio_tests/tests.py
+QuickStartTest) at unit scale.
+"""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams, MetricEvaluator
+from incubator_predictionio_tpu.core.evaluation import Evaluation
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import App, Storage
+from incubator_predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    PredictedResult,
+    Query,
+    RecommendationEngine,
+)
+from incubator_predictionio_tpu.models.recommendation.engine import PrecisionAtK
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+
+@pytest.fixture(autouse=True)
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+@pytest.fixture
+def seeded_app():
+    """Block-structured ratings: users uA* love items iA*, users uB* love
+    iB* — so recommendations are unambiguous."""
+    Storage.get_meta_data_apps().insert(App(0, "recapp"))
+    dao = Storage.get_events()
+    app_id = Storage.get_meta_data_apps().get_by_name("recapp").id
+    rng = np.random.default_rng(0)
+    events = []
+    for g, (users, items) in enumerate(
+        ((["uA%d" % i for i in range(8)], ["iA%d" % i for i in range(6)]),
+         (["uB%d" % i for i in range(8)], ["iB%d" % i for i in range(6)]))
+    ):
+        for u in users:
+            for it in items:
+                if rng.random() < 0.7:
+                    events.append(Event(
+                        event="rate", entity_type="user", entity_id=u,
+                        target_entity_type="item", target_entity_id=it,
+                        properties=DataMap({"rating": float(rng.integers(4, 6))}),
+                    ))
+        # cross-group low ratings
+        for u in users:
+            other = "iB0" if g == 0 else "iA0"
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=u,
+                target_entity_type="item", target_entity_id=other,
+                properties=DataMap({"rating": 1.0}),
+            ))
+    # one "buy" event (implicit 4.0)
+    events.append(Event(event="buy", entity_type="user", entity_id="uA0",
+                        target_entity_type="item", target_entity_id="iA5"))
+    # item metadata for the custom-query filter
+    for i in range(6):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id="iA%d" % i,
+            properties=DataMap({"creationYear": 1990 + i,
+                                "categories": ["groupA"]}),
+        ))
+    for e in events:
+        dao.insert(e, app_id)
+    return app_id
+
+
+def engine_params(eval_k=0, iters=10):
+    return EngineParams(
+        data_source_params=("", DataSourceParams(app_name="recapp",
+                                                 eval_k=eval_k)),
+        algorithm_params_list=[
+            ("als", ALSAlgorithmParams(rank=8, num_iterations=iters,
+                                       lambda_=0.05, seed=42))
+        ],
+    )
+
+
+def test_train_and_predict(seeded_app):
+    engine = RecommendationEngine().apply()
+    ctx = RuntimeContext()
+    models = engine.train(ctx, engine_params())
+    algo = engine.algorithms(engine_params())[0]
+    result = algo.predict(models[0], Query(user="uA1", num=3))
+    assert len(result.item_scores) == 3
+    # group-A user gets group-A items
+    assert all(s.item.startswith("iA") for s in result.item_scores)
+    # scores descending
+    scores = [s.score for s in result.item_scores]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_unknown_user_empty_result(seeded_app):
+    engine = RecommendationEngine().apply()
+    models = engine.train(RuntimeContext(), engine_params())
+    algo = engine.algorithms(engine_params())[0]
+    assert algo.predict(models[0], Query(user="ghost", num=3)).item_scores == ()
+
+
+def test_query_filters(seeded_app):
+    engine = RecommendationEngine().apply()
+    models = engine.train(RuntimeContext(), engine_params())
+    algo = engine.algorithms(engine_params())[0]
+    # creationYear filter: only iA3+ (1993+) qualify
+    r = algo.predict(models[0], Query(user="uA1", num=6, creation_year=1993))
+    assert r.item_scores
+    assert all(s.creation_year and s.creation_year >= 1993 for s in r.item_scores)
+    # category filter
+    r = algo.predict(models[0], Query(user="uB1", num=4,
+                                      categories=("groupA",)))
+    assert all(s.item.startswith("iA") for s in r.item_scores)
+    # whitelist / blacklist
+    r = algo.predict(models[0], Query(user="uA1", num=4,
+                                      whitelist=("iA0", "iA1")))
+    assert {s.item for s in r.item_scores} <= {"iA0", "iA1"}
+    r = algo.predict(models[0], Query(user="uA1", num=10, blacklist=("iA0",)))
+    assert "iA0" not in {s.item for s in r.item_scores}
+
+
+def test_full_workflow_train_store_reload(seeded_app):
+    engine = RecommendationEngine().apply()
+    iid = CoreWorkflow.run_train(engine, engine_params(),
+                                 engine_variant="rec-test")
+    models = CoreWorkflow.load_models(iid, engine, engine_params())
+    algo = engine.algorithms(engine_params())[0]
+    result = algo.predict(models[0], Query(user="uA2", num=2))
+    assert len(result.item_scores) == 2
+
+
+def test_batch_predict_matches_single(seeded_app):
+    engine = RecommendationEngine().apply()
+    models = engine.train(RuntimeContext(), engine_params())
+    algo = engine.algorithms(engine_params())[0]
+    queries = [(i, Query(user=u, num=3)) for i, u in
+               enumerate(["uA0", "uB0", "ghost"])]
+    batch = dict(algo.batch_predict(models[0], queries))
+    for qx, q in queries:
+        single = algo.predict(models[0], q)
+        assert [s.item for s in batch[qx].item_scores] == \
+               [s.item for s in single.item_scores]
+
+
+def test_evaluation_precision_at_k(seeded_app):
+    engine = RecommendationEngine().apply()
+    evaluation = Evaluation()
+    evaluation.engine_metric = (engine, PrecisionAtK(k=3))
+    iid, result = CoreWorkflow.run_evaluation(
+        evaluation, [engine_params(eval_k=2, iters=5)],
+    )
+    assert 0.0 <= result.best_score.score <= 1.0
+    # block structure should make precision decent
+    assert result.best_score.score > 0.2

@@ -106,6 +106,13 @@ class Engine:
         )
         return data_source, preparator, algo_list, serving
 
+    def components(
+        self, engine_params: EngineParams
+    ) -> Tuple[DataSource, Preparator, List[Algorithm], Serving]:
+        """Instantiate all DASE components once (deploy paths should call
+        this instead of algorithms()+serving() to avoid rebuilding)."""
+        return self._components(engine_params)
+
     def algorithms(self, engine_params: EngineParams) -> List[Algorithm]:
         return self._components(engine_params)[2]
 
@@ -262,6 +269,47 @@ class Engine:
             algorithm_params_list=algo_params,
             serving_params=one(
                 "serving", self.serving_class_map, variant.get("serving")
+            ),
+        )
+
+
+    def engine_params_from_instance(self, instance: Any) -> EngineParams:
+        """Reconstruct typed EngineParams from a stored EngineInstance
+        (Engine.engineInstanceToEngineParams, Engine.scala:422-470)."""
+        import json
+
+        def one(slot: str, class_map: Dict[str, type], raw: str) -> Tuple[str, Params]:
+            if not raw:
+                return ("", EmptyParams())
+            name, params_obj = json.loads(raw)
+            cls = _select(class_map, name, slot)
+            pcls = params_class_of(cls)
+            if pcls is None or not params_obj:
+                return (name, EmptyParams())
+            return (name, json_codec.extract(pcls, params_obj))
+
+        algo_list: List[Tuple[str, Params]] = []
+        if instance.algorithms_params:
+            for name, params_obj in json.loads(instance.algorithms_params):
+                cls = _select(self.algorithm_class_map, name, "algorithm")
+                pcls = params_class_of(cls)
+                algo_list.append(
+                    (name, json_codec.extract(pcls, params_obj))
+                    if pcls is not None and params_obj
+                    else (name, EmptyParams())
+                )
+        return EngineParams(
+            data_source_params=one(
+                "dataSource", self.data_source_class_map,
+                instance.data_source_params,
+            ),
+            preparator_params=one(
+                "preparator", self.preparator_class_map,
+                instance.preparator_params,
+            ),
+            algorithm_params_list=algo_list,
+            serving_params=one(
+                "serving", self.serving_class_map, instance.serving_params
             ),
         )
 

@@ -53,6 +53,8 @@ logger = logging.getLogger(__name__)
 
 @dataclasses.dataclass(frozen=True)
 class Query:
+    __camel_case__ = True  # wire format parity: creationYear, excludeSeen
+
     user: str
     num: int
     creation_year: Optional[int] = None  # custom-query variant filter
@@ -64,6 +66,8 @@ class Query:
 
 @dataclasses.dataclass(frozen=True)
 class ItemScore:
+    __camel_case__ = True
+
     item: str
     score: float
     creation_year: Optional[int] = None
@@ -71,6 +75,8 @@ class ItemScore:
 
 @dataclasses.dataclass(frozen=True)
 class PredictedResult:
+    __camel_case__ = True  # serves {"itemScores": [...]} like the reference
+
     item_scores: Tuple[ItemScore, ...]
 
 

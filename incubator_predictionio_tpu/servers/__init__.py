@@ -1,0 +1,7 @@
+"""HTTP servers: event ingestion, prediction serving, admin, dashboard.
+
+Parity: EventServer (data/.../api/EventServer.scala), PredictionServer
+(core/.../workflow/CreateServer.scala), AdminAPI (tools/.../admin/),
+Dashboard (tools/.../dashboard/) — rebuilt on the asyncio micro-framework
+(utils/http.py) with TPU-resident model state in the prediction server.
+"""

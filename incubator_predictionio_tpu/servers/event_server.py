@@ -1,0 +1,370 @@
+"""EventServer — REST event collection.
+
+Route/contract parity with data/.../api/EventServer.scala:148-530 on :7070:
+
+- ``GET  /``                        → ``{"status": "alive"}``
+- ``POST /events.json``             → 201 ``{"eventId": ...}``
+- ``GET  /events/<id>.json``        → 200 event | 404
+- ``DELETE /events/<id>.json``      → 200 ``{"message": "Found"}`` | 404
+- ``GET  /events.json``             → query (startTime/untilTime/entityType/
+  entityId/event/targetEntityType/targetEntityId/limit/reversed)
+- ``POST /batch/events.json``       → ≤50 events, per-event status list
+- ``GET  /stats.json``              → ingest counters (with ``--stats``)
+- ``POST /webhooks/<name>.json``    → JSON connector ingest (+ GET probe)
+- ``POST /webhooks/<name>.form``    → form connector ingest (+ GET probe)
+- ``GET  /plugins.json`` and ``/plugins/...`` plugin passthrough
+
+Auth (EventServer.scala:93-131): ``accessKey`` query param (with optional
+``channel``), or HTTP Basic where the username is the access key. 401
+missing/invalid key; 401 invalid channel. Per-event allowed-names check
+(:275) → 403.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+from incubator_predictionio_tpu.data import webhooks
+from incubator_predictionio_tpu.data.event import Event, EventValidationError
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.webhooks import ConnectorError
+from incubator_predictionio_tpu.servers.plugins import EventInfo, PluginContext
+from incubator_predictionio_tpu.servers.stats import Stats
+from incubator_predictionio_tpu.data.storage.base import UNSET as _UNSET_Q
+from incubator_predictionio_tpu.utils.http import (
+    HttpError,
+    HttpServer,
+    Request,
+    Response,
+    Router,
+)
+from incubator_predictionio_tpu.utils.times import parse_iso8601
+
+logger = logging.getLogger(__name__)
+
+#: EventServer.scala:71
+MAX_EVENTS_PER_BATCH = 50
+
+
+@dataclasses.dataclass
+class EventServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 7070
+    stats: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class AuthData:
+    """EventServer.scala:83 AuthData."""
+
+    app_id: int
+    channel_id: Optional[int]
+    events: Tuple[str, ...]
+
+
+class AuthError(HttpError):
+    """401/403 rejection, converted to a JSON response by the http layer."""
+
+
+class EventServer:
+    def __init__(
+        self,
+        config: Optional[EventServerConfig] = None,
+        plugin_context: Optional[PluginContext] = None,
+    ):
+        self.config = config or EventServerConfig()
+        config = self.config
+        self.events = Storage.get_events()
+        self.access_keys = Storage.get_meta_data_access_keys()
+        self.channels = Storage.get_meta_data_channels()
+        self.stats = Stats()
+        self.plugin_context = plugin_context or PluginContext()
+        self.router = self._build_router()
+        self.http = HttpServer(self.router, config.ip, config.port)
+
+    # -- auth (EventServer.scala:93-131) ------------------------------------
+    def _authenticate(self, request: Request) -> AuthData:
+        key = request.query.get("accessKey")
+        channel = request.query.get("channel")
+        if key is None:
+            auth = request.headers.get("authorization", "")
+            if auth.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(auth[6:]).decode("utf-8")
+                    key = decoded.strip().split(":")[0]
+                except Exception:
+                    raise AuthError(401, "Invalid accessKey.")
+        if not key:
+            raise AuthError(401, "Missing accessKey.")
+        k = self.access_keys.get(key)
+        if k is None:
+            raise AuthError(401, "Invalid accessKey.")
+        channel_id = None
+        if channel is not None:
+            channel_map = {
+                c.name: c.id for c in self.channels.get_by_appid(k.appid)
+            }
+            if channel not in channel_map:
+                raise AuthError(401, f"Invalid channel '{channel}'.")
+            channel_id = channel_map[channel]
+        return AuthData(k.appid, channel_id, tuple(k.events))
+
+    def _check_allowed(self, auth: AuthData, event_name: str) -> None:
+        if auth.events and event_name not in auth.events:
+            raise AuthError(403, f"{event_name} events are not allowed")
+
+    # -- single-event insert pipeline ---------------------------------------
+    def _insert(self, auth: AuthData, event: Event) -> str:
+        """Allowed-names check + blocker veto + insert + sniffers.
+
+        Validation errors surface as 400 from the *parse* step before this is
+        called; exceptions here (blocker vetoes, storage failures) are server
+        errors — 500, matching the reference's recover path
+        (EventServer.scala:409-412).
+        """
+        self._check_allowed(auth, event.event)
+        info = EventInfo(auth.app_id, auth.channel_id, event)
+        for blocker in self.plugin_context.input_blockers.values():
+            blocker.process(info, self.plugin_context)  # may raise to veto
+        event_id = self.events.insert(event, auth.app_id, auth.channel_id)
+        for sniffer in self.plugin_context.input_sniffers.values():
+            try:
+                sniffer.process(info, self.plugin_context)
+            except Exception:
+                logger.exception("input sniffer failed")
+        return event_id
+
+    def _ingest(self, auth: AuthData, event: Event) -> Response:
+        """Guarded insert shared by /events.json and the webhook routes so
+        403/500 outcomes get identical responses and stats booking."""
+        try:
+            event_id = self._insert(auth, event)
+        except AuthError as e:
+            self._book(auth, e.status, event.event)
+            raise
+        except Exception as e:
+            self._book(auth, 500, event.event)
+            return Response(500, {"message": str(e)})
+        self._book(auth, 201, event.event)
+        return Response(201, {"eventId": event_id})
+
+    @staticmethod
+    def _parse_event(item: Any) -> Event:
+        """JSON → validated Event; any failure here is a 400."""
+        from incubator_predictionio_tpu.data.event import validate_event
+
+        event = Event.from_jsonable(item)
+        validate_event(event)
+        return event
+
+    def _book(self, auth: AuthData, status: int, event_name: str) -> None:
+        if self.config.stats:
+            self.stats.update(auth.app_id, status, event_name)
+
+    # -- routes -------------------------------------------------------------
+    def _build_router(self) -> Router:
+        r = Router()
+
+        @r.get("/")
+        def alive(request: Request) -> Response:
+            return Response(200, {"status": "alive"})
+
+        @r.post("/events.json")
+        def create_event(request: Request) -> Response:
+            auth = self._authenticate(request)
+            try:
+                event = self._parse_event(request.json())
+            except (ValueError, EventValidationError) as e:
+                self._book(auth, 400, "<error>")
+                return Response(400, {"message": str(e)})
+            return self._ingest(auth, event)
+
+        @r.get("/events/{event_id}.json")
+        def get_event(request: Request) -> Response:
+            auth = self._authenticate(request)
+            event = self.events.get(
+                request.path_params["event_id"], auth.app_id, auth.channel_id
+            )
+            if event is None:
+                return Response(404, {"message": "Not Found"})
+            return Response(200, event.to_jsonable())
+
+        @r.delete("/events/{event_id}.json")
+        def delete_event(request: Request) -> Response:
+            auth = self._authenticate(request)
+            found = self.events.delete(
+                request.path_params["event_id"], auth.app_id, auth.channel_id
+            )
+            if not found:
+                return Response(404, {"message": "Not Found"})
+            return Response(200, {"message": "Found"})
+
+        @r.get("/events.json")
+        def find_events(request: Request) -> Response:
+            auth = self._authenticate(request)
+            q = request.query
+            try:
+                def time(name: str):
+                    return parse_iso8601(q[name]) if name in q else None
+
+                limit = int(q["limit"]) if "limit" in q else 20
+                reversed_ = q.get("reversed", "false").lower() == "true"
+                events = list(self.events.find(
+                    app_id=auth.app_id,
+                    channel_id=auth.channel_id,
+                    start_time=time("startTime"),
+                    until_time=time("untilTime"),
+                    entity_type=q.get("entityType"),
+                    entity_id=q.get("entityId"),
+                    event_names=[q["event"]] if "event" in q else None,
+                    target_entity_type=q.get("targetEntityType", _UNSET_Q),
+                    target_entity_id=q.get("targetEntityId", _UNSET_Q),
+                    limit=limit,
+                    reversed=reversed_,
+                ))
+            except ValueError as e:
+                return Response(400, {"message": str(e)})
+            if not events:
+                return Response(404, {"message": "Not Found"})
+            return Response(200, [e.to_jsonable() for e in events])
+
+        @r.post("/batch/events.json")
+        def batch_events(request: Request) -> Response:
+            auth = self._authenticate(request)
+            try:
+                items = request.json()
+            except ValueError as e:
+                return Response(400, {"message": str(e)})
+            if not isinstance(items, list):
+                return Response(400, {"message": "request body must be a JSON array"})
+            if len(items) > MAX_EVENTS_PER_BATCH:
+                return Response(400, {
+                    "message": (
+                        "Batch request must have less than or equal to "
+                        f"{MAX_EVENTS_PER_BATCH} events"
+                    )
+                })
+            results = []
+            for item in items:
+                try:
+                    event = self._parse_event(item)
+                except (ValueError, EventValidationError) as e:
+                    results.append({"status": 400, "message": str(e)})
+                    self._book(auth, 400, "<error>")
+                    continue
+                try:
+                    event_id = self._insert(auth, event)
+                    results.append({"status": 201, "eventId": event_id})
+                    self._book(auth, 201, event.event)
+                except AuthError as e:
+                    results.append({"status": e.status, "message": e.message})
+                    self._book(auth, e.status, event.event)
+                except Exception as e:  # per-event isolation (scala :409)
+                    results.append({"status": 500, "message": str(e)})
+                    self._book(auth, 500, event.event)
+            return Response(200, results)
+
+        @r.get("/stats.json")
+        def stats_route(request: Request) -> Response:
+            auth = self._authenticate(request)
+            if not self.config.stats:
+                return Response(404, {
+                    "message": "To see stats, launch Event Server with --stats argument."
+                })
+            return Response(200, self.stats.get(auth.app_id))
+
+        # -- webhooks (EventServer.scala webhooks routes + Webhooks.scala) --
+        @r.post("/webhooks/{name}.json")
+        def webhook_json(request: Request) -> Response:
+            auth = self._authenticate(request)
+            connector = webhooks.json_connector(request.path_params["name"])
+            if connector is None:
+                return Response(404, {
+                    "message": f"webhooks connection for {request.path_params['name']} is not supported."
+                })
+            try:
+                event_json = connector.to_event_json(request.json())
+                event = self._parse_event(event_json)
+            except (ConnectorError, ValueError, EventValidationError) as e:
+                self._book(auth, 400, "<error>")
+                return Response(400, {"message": str(e)})
+            return self._ingest(auth, event)
+
+        @r.get("/webhooks/{name}.json")
+        def webhook_json_probe(request: Request) -> Response:
+            self._authenticate(request)
+            if webhooks.json_connector(request.path_params["name"]) is None:
+                return Response(404, {"message": "Not Found"})
+            return Response(200, {"message": "Ok"})
+
+        @r.post("/webhooks/{name}.form")
+        def webhook_form(request: Request) -> Response:
+            auth = self._authenticate(request)
+            connector = webhooks.form_connector(request.path_params["name"])
+            if connector is None:
+                return Response(404, {
+                    "message": f"webhooks connection for {request.path_params['name']} is not supported."
+                })
+            try:
+                event_json = connector.to_event_json(request.form())
+                event = self._parse_event(event_json)
+            except (ConnectorError, ValueError, EventValidationError) as e:
+                self._book(auth, 400, "<error>")
+                return Response(400, {"message": str(e)})
+            return self._ingest(auth, event)
+
+        @r.get("/webhooks/{name}.form")
+        def webhook_form_probe(request: Request) -> Response:
+            self._authenticate(request)
+            if webhooks.form_connector(request.path_params["name"]) is None:
+                return Response(404, {"message": "Not Found"})
+            return Response(200, {"message": "Ok"})
+
+        @r.get("/plugins.json")
+        def plugins_list(request: Request) -> Response:
+            return Response(200, {
+                "plugins": {
+                    "inputblockers": {
+                        n: {"name": n} for n in self.plugin_context.input_blockers
+                    },
+                    "inputsniffers": {
+                        n: {"name": n} for n in self.plugin_context.input_sniffers
+                    },
+                }
+            })
+
+        @r.get("/plugins/{tail...}")
+        def plugins_rest(request: Request) -> Response:
+            parts = request.path_params["tail"].split("/")
+            plugin = self.plugin_context.plugin(parts[0])
+            if plugin is None:
+                return Response(404, {"message": "Not Found"})
+            return Response(
+                200,
+                plugin.handle_rest("/".join(parts[1:]), dict(request.query)),
+            )
+
+        return r
+
+    # -- lifecycle ----------------------------------------------------------
+    def start_background(self) -> int:
+        port = self.http.start_background()
+        logger.info("EventServer started on %s:%d", self.config.ip, port)
+        return port
+
+    async def serve_forever(self) -> None:
+        await self.http.serve_forever()
+
+    def stop(self) -> None:
+        self.http.stop()
+
+
+def create_event_server(
+    config: Optional[EventServerConfig] = None,
+) -> EventServer:
+    """EventServer.createEventServer:614."""
+    return EventServer(config)

@@ -1,0 +1,351 @@
+"""PredictionServer — query serving from TPU-resident model state.
+
+Parity: core/.../workflow/CreateServer.scala:115-725 on :8000:
+
+- ``GET  /``              → status (JSON or HTML): engine info, params,
+  request count, average/last serving seconds (:426-428,611-618)
+- ``POST /queries.json``  → supplement → predict(∀ algorithms) → serve with
+  the ORIGINAL query → optional feedback event → output plugins (:498-650)
+- ``POST /reload``        → hot-swap to the latest COMPLETED instance
+  (key-authed, :340-366)
+- ``POST /stop``          → shutdown (key-authed)
+- ``GET  /plugins.json``, ``/plugins/...`` engine-plugin passthrough
+
+The feedback loop posts a ``predict`` event (entityType ``pio_pr``) carrying
+engineInstanceId/query/prediction back to the EventServer with ``prId``
+(:534-604). The MasterActor deploy/undeploy lifecycle collapses into
+``PredictionServerLauncher`` semantics: resolve latest COMPLETED instance →
+restore models via ``Engine.prepare_deploy`` (device-resident) → bind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import secrets
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from incubator_predictionio_tpu.core.engine import Engine
+from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
+from incubator_predictionio_tpu.data.storage import EngineInstance, Storage
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.servers.plugins import PluginContext
+from incubator_predictionio_tpu.utils import json_codec
+from incubator_predictionio_tpu.utils.http import (
+    HttpError,
+    HttpServer,
+    Request,
+    Response,
+    Router,
+)
+from incubator_predictionio_tpu.utils.times import format_iso8601, now_utc
+from incubator_predictionio_tpu.workflow import CoreWorkflow
+from incubator_predictionio_tpu.workflow.workflow import make_runtime_context
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """CreateServer.scala:89-113 ServerConfig."""
+
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    engine_instance_id: Optional[str] = None  # default: latest COMPLETED
+    engine_id: str = "default"
+    engine_version: str = "NOT_VERSIONED"
+    engine_variant: str = "default"
+    event_server_ip: str = "0.0.0.0"
+    event_server_port: int = 7070
+    access_key: Optional[str] = None
+    feedback: bool = False
+    server_key: Optional[str] = None  # auth for /stop and /reload
+    verbose: bool = False
+
+
+class PredictionServer:
+    def __init__(
+        self,
+        engine: Engine,
+        config: Optional[ServerConfig] = None,
+        plugin_context: Optional[PluginContext] = None,
+        ctx: Optional[RuntimeContext] = None,
+    ):
+        self.engine = engine
+        self.config = config or ServerConfig()
+        config = self.config
+        self.plugin_context = plugin_context or PluginContext()
+        self.ctx = ctx or make_runtime_context(None)
+        self._lock = threading.Lock()
+        # serving state (swapped atomically on /reload)
+        self.engine_instance: Optional[EngineInstance] = None
+        self.engine_params: Optional[EngineParams] = None
+        self.algorithms: List[Any] = []
+        self.serving: Any = None
+        self.models: List[Any] = []
+        # latency bookkeeping (CreateServer.scala:426-428)
+        self.start_time = now_utc()
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.http = HttpServer(self._build_router(), config.ip, config.port)
+
+    # -- deploy lifecycle ---------------------------------------------------
+    def _resolve_instance(self) -> EngineInstance:
+        instances = Storage.get_meta_data_engine_instances()
+        if self.config.engine_instance_id:
+            instance = instances.get(self.config.engine_instance_id)
+            if instance is None:
+                raise ValueError(
+                    f"Invalid engine instance ID {self.config.engine_instance_id}."
+                )
+        else:
+            instance = instances.get_latest_completed(
+                self.config.engine_id,
+                self.config.engine_version,
+                self.config.engine_variant,
+            )
+            if instance is None:
+                raise ValueError(
+                    "No valid engine instance found for engine "
+                    f"{self.config.engine_id} {self.config.engine_version} "
+                    f"{self.config.engine_variant}."
+                )
+        return instance
+
+    def load_models(self) -> None:
+        """createServerActorWithEngine (:207-266): restore + prepare_deploy."""
+        instance = self._resolve_instance()
+        engine_params = self.engine.engine_params_from_instance(instance)
+        models = CoreWorkflow.load_models(
+            instance.id, self.engine, engine_params, ctx=self.ctx
+        )
+        _ds, _prep, algorithms, serving = self.engine.components(engine_params)
+        with self._lock:
+            self.engine_instance = instance
+            self.engine_params = engine_params
+            self.algorithms = algorithms
+            self.serving = serving
+            self.models = models
+        logger.info(
+            "Engine instance %s deployed (%d algorithms)",
+            instance.id, len(self.algorithms),
+        )
+
+    # -- query pipeline -----------------------------------------------------
+    def _handle_query(self, body: bytes) -> Any:
+        t0 = time.perf_counter()
+        with self._lock:
+            algorithms = self.algorithms
+            serving = self.serving
+            models = self.models
+            instance = self.engine_instance
+        if not algorithms or instance is None:
+            raise HttpError(503, "No engine instance deployed.")
+        query_class = algorithms[0].query_class
+        raw = json.loads(body.decode("utf-8"))
+        query = (
+            json_codec.extract(query_class, raw)
+            if query_class is not None else raw
+        )
+        supplemented = serving.supplement(query)
+        predictions = [
+            a.predict(m, supplemented) for a, m in zip(algorithms, models)
+        ]
+        # by design, serve sees the ORIGINAL query (CreateServer.scala:526)
+        prediction = serving.serve(query, predictions)
+        result = json_codec.to_jsonable(prediction)
+
+        if self.config.feedback:
+            result = self._feedback(instance, raw, result)
+
+        for blocker in self.plugin_context.output_blockers.values():
+            result = blocker.process(
+                instance.engine_variant, raw, result, self.plugin_context
+            )
+        for sniffer in self.plugin_context.output_sniffers.values():
+            try:
+                sniffer.process(
+                    instance.engine_variant, raw, result, self.plugin_context
+                )
+            except Exception:
+                logger.exception("output sniffer failed")
+
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.request_count += 1
+            self.avg_serving_sec = (
+                self.avg_serving_sec * (self.request_count - 1) + dt
+            ) / self.request_count
+            self.last_serving_sec = dt
+        return result
+
+    def _feedback(
+        self, instance: EngineInstance, query_json: Any, prediction_json: Any
+    ) -> Any:
+        """Post the predict event back to the EventServer (:534-604)."""
+        pr_id = prediction_json.get("prId") if isinstance(
+            prediction_json, dict) else None
+        if not pr_id:
+            pr_id = secrets.token_hex(32)
+        data = {
+            "event": "predict",
+            "eventTime": format_iso8601(now_utc()),
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {
+                "engineInstanceId": instance.id,
+                "query": query_json,
+                "prediction": prediction_json,
+            },
+        }
+        if isinstance(query_json, dict) and query_json.get("prId"):
+            data["prId"] = query_json["prId"]
+        url = (
+            f"http://{self.config.event_server_ip}:"
+            f"{self.config.event_server_port}/events.json"
+            f"?accessKey={self.config.access_key or ''}"
+        )
+
+        def post() -> None:
+            try:
+                req = urllib.request.Request(
+                    url, data=json.dumps(data).encode(),
+                    headers={"Content-Type": "application/json"}, method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    if resp.status != 201:
+                        logger.error(
+                            "Feedback event failed. Status code: %d. Data: %s",
+                            resp.status, data,
+                        )
+            except Exception as e:
+                logger.error("Feedback event failed: %s", e)
+
+        threading.Thread(target=post, daemon=True).start()
+        # inject prId into the served result when the prediction carries one
+        if isinstance(prediction_json, dict) and "prId" in prediction_json:
+            prediction_json = dict(prediction_json, prId=pr_id)
+        return prediction_json
+
+    # -- auth for /stop, /reload (common/.../KeyAuthentication.scala:34) ----
+    def _check_server_key(self, request: Request) -> None:
+        if self.config.server_key is None:
+            return
+        if request.query.get("accessKey") != self.config.server_key:
+            raise HttpError(401, "Invalid accessKey.")
+
+    # -- routes -------------------------------------------------------------
+    def _build_router(self) -> Router:
+        r = Router()
+
+        @r.get("/")
+        def status(request: Request) -> Response:
+            with self._lock:
+                instance = self.engine_instance
+                info = {
+                    "status": "alive",
+                    "engineInstanceId": instance.id if instance else None,
+                    "engineFactory": instance.engine_factory if instance else None,
+                    "engineVariant": instance.engine_variant if instance else None,
+                    "algorithms": [type(a).__name__ for a in self.algorithms],
+                    "startTime": format_iso8601(self.start_time),
+                    "requestCount": self.request_count,
+                    "avgServingSec": self.avg_serving_sec,
+                    "lastServingSec": self.last_serving_sec,
+                }
+            accept = request.headers.get("accept", "")
+            if "text/html" in accept:
+                rows = "".join(
+                    f"<tr><th>{k}</th><td>{v}</td></tr>" for k, v in info.items()
+                )
+                return Response(
+                    200,
+                    body=(
+                        "<html><head><title>PredictionIO-TPU Server</title>"
+                        f"</head><body><h1>Engine is deployed and running.</h1>"
+                        f"<table>{rows}</table></body></html>"
+                    ).encode(),
+                    content_type="text/html; charset=UTF-8",
+                )
+            return Response(200, info)
+
+        @r.post("/queries.json")
+        def queries(request: Request) -> Response:
+            try:
+                result = self._handle_query(request.body)
+            except HttpError:
+                raise
+            except (ValueError, KeyError) as e:
+                return Response(400, {"message": str(e)})
+            return Response(200, result)
+
+        @r.post("/reload")
+        def reload(request: Request) -> Response:
+            self._check_server_key(request)
+            self.load_models()
+            return Response(200, {"message": "Reloaded."})
+
+        @r.post("/stop")
+        def stop_route(request: Request) -> Response:
+            self._check_server_key(request)
+            threading.Timer(0.2, self.http.stop).start()
+            return Response(200, {"message": "Shutting down."})
+
+        @r.get("/plugins.json")
+        def plugins_list(request: Request) -> Response:
+            return Response(200, {
+                "plugins": {
+                    "outputblockers": {
+                        n: {"name": n}
+                        for n in self.plugin_context.output_blockers
+                    },
+                    "outputsniffers": {
+                        n: {"name": n}
+                        for n in self.plugin_context.output_sniffers
+                    },
+                }
+            })
+
+        @r.get("/plugins/{tail...}")
+        def plugins_rest(request: Request) -> Response:
+            parts = request.path_params["tail"].split("/")
+            plugin = self.plugin_context.plugin(parts[0])
+            if plugin is None:
+                return Response(404, {"message": "Not Found"})
+            return Response(
+                200, plugin.handle_rest("/".join(parts[1:]), dict(request.query))
+            )
+
+        return r
+
+    # -- lifecycle ----------------------------------------------------------
+    def start_background(self) -> int:
+        self.load_models()
+        port = self.http.start_background()
+        logger.info("PredictionServer started on %s:%d", self.config.ip, port)
+        return port
+
+    async def serve_forever(self) -> None:
+        self.load_models()
+        await self.http.serve_forever()
+
+    def stop(self) -> None:
+        self.http.stop()
+
+
+def undeploy(ip: str, port: int, server_key: Optional[str] = None) -> bool:
+    """POST /stop to a running server (commands/Engine.undeploy:341)."""
+    url = f"http://{ip}:{port}/stop"
+    if server_key:
+        url += f"?accessKey={server_key}"
+    try:
+        req = urllib.request.Request(url, method="POST", data=b"")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status == 200
+    except Exception:
+        return False

@@ -1,0 +1,317 @@
+"""Asyncio HTTP/1.1 micro-framework — the spray/akka replacement.
+
+The reference runs four spray-can servers (EventServer :7070, PredictionServer
+:8000, Dashboard :9000, AdminAPI :7071) on akka actors. Here one small
+dependency-free asyncio server underlies all of them: routed handlers, JSON
+helpers, keep-alive, and a thread-pool bridge for the synchronous storage
+DAOs (the moral equivalent of the reference's ``Future { ... }`` blocks
+around blocking storage calls, e.g. EventServer.scala:97).
+
+Deliberately minimal: Content-Length bodies (no chunked uploads), HTTP/1.1
+keep-alive, no TLS termination in-process (run behind a terminating proxy;
+the reference's SSLConfiguration keystore plays that role — see
+utils/ssl.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import logging
+import re
+import socket
+import threading
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qsl, unquote, urlsplit
+
+logger = logging.getLogger(__name__)
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+STATUS_TEXT = {
+    200: "OK", 201: "Created", 202: "Accepted", 204: "No Content",
+    301: "Moved Permanently", 302: "Found", 400: "Bad Request",
+    401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+    405: "Method Not Allowed", 413: "Payload Too Large",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+
+class HttpError(Exception):
+    """Raise from a handler to produce a JSON error response."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query: Dict[str, str],
+        headers: Dict[str, str],
+        body: bytes,
+        path_params: Optional[Dict[str, str]] = None,
+    ):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.path_params = path_params or {}
+
+    def json(self) -> Any:
+        if not self.body:
+            raise ValueError("Empty request body")
+        try:
+            return json.loads(self.body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"Invalid JSON body: {e}") from e
+
+    def form(self) -> Dict[str, str]:
+        return dict(parse_qsl(self.body.decode("utf-8", "replace")))
+
+
+class Response:
+    def __init__(
+        self,
+        status: int = 200,
+        json_body: Any = None,
+        body: Optional[bytes] = None,
+        content_type: str = "application/json; charset=UTF-8",
+        headers: Optional[Dict[str, str]] = None,
+    ):
+        self.status = status
+        if json_body is not None:
+            body = json.dumps(json_body).encode("utf-8")
+        self.body = body or b""
+        self.content_type = content_type
+        self.headers = headers or {}
+
+    def encode(self, keep_alive: bool) -> bytes:
+        reason = STATUS_TEXT.get(self.status, "Unknown")
+        lines = [
+            f"HTTP/1.1 {self.status} {reason}",
+            f"Content-Type: {self.content_type}",
+            f"Content-Length: {len(self.body)}",
+            "Connection: " + ("keep-alive" if keep_alive else "close"),
+            "Server: pio-tpu",
+        ]
+        for k, v in self.headers.items():
+            lines.append(f"{k}: {v}")
+        return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + self.body
+
+
+Handler = Callable[[Request], "Response | Awaitable[Response]"]
+
+
+class Router:
+    """Method + path routing with ``{param}`` segments and a catch-all
+    ``{tail...}`` form."""
+
+    def __init__(self) -> None:
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+
+    _PARAM_RE = re.compile(r"\{([a-zA-Z_][a-zA-Z0-9_]*)(\.\.\.)?\}")
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        regex = ["^"]
+        for part in pattern.split("/"):
+            if not part:
+                continue
+            regex.append("/")
+            # a segment may embed params: "{event_id}.json", "{name}.form"
+            pos = 0
+            for m in self._PARAM_RE.finditer(part):
+                regex.append(re.escape(part[pos:m.start()]))
+                if m.group(2):  # {tail...} catch-all
+                    regex.append(f"(?P<{m.group(1)}>.*)")
+                else:
+                    regex.append(f"(?P<{m.group(1)}>[^/]+?)")
+                pos = m.end()
+            regex.append(re.escape(part[pos:]))
+        if pattern.endswith("/") or pattern == "/":
+            regex.append("/?")
+        regex.append("$")
+        self._routes.append((method.upper(), re.compile("".join(regex)), handler))
+
+    def get(self, pattern: str):
+        return lambda h: (self.add("GET", pattern, h), h)[1]
+
+    def post(self, pattern: str):
+        return lambda h: (self.add("POST", pattern, h), h)[1]
+
+    def delete(self, pattern: str):
+        return lambda h: (self.add("DELETE", pattern, h), h)[1]
+
+    def resolve(
+        self, method: str, path: str
+    ) -> Tuple[Optional[Handler], Dict[str, str], bool]:
+        """(handler, params, path_exists)."""
+        path_matched = False
+        for m, pattern, handler in self._routes:
+            match = pattern.match(path)
+            if match:
+                path_matched = True
+                if m == method:
+                    return handler, {
+                        k: unquote(v) for k, v in match.groupdict().items()
+                    }, True
+        return None, {}, path_matched
+
+
+class HttpServer:
+    """One listening socket + a router. Synchronous handlers and the
+    ``sync()`` helper run on the default thread pool so blocking DAO work
+    never stalls the event loop."""
+
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0):
+        self.router = router
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # -- request cycle -----------------------------------------------------
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                except asyncio.LimitOverrunError:
+                    writer.write(Response(413, {"message": "headers too large"})
+                                 .encode(False))
+                    await writer.drain()
+                    return
+                if len(head) > MAX_HEADER_BYTES:
+                    writer.write(Response(413, {"message": "headers too large"})
+                                 .encode(False))
+                    await writer.drain()
+                    return
+                request, keep_alive = await self._read_request(reader, head)
+                if request is None:
+                    writer.write(Response(400, {"message": "bad request"})
+                                 .encode(False))
+                    await writer.drain()
+                    return
+                response = await self._dispatch(request)
+                writer.write(response.encode(keep_alive))
+                await writer.drain()
+                if not keep_alive:
+                    return
+        except Exception:
+            logger.exception("connection handler error")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(
+        self, reader: asyncio.StreamReader, head: bytes
+    ) -> Tuple[Optional[Request], bool]:
+        try:
+            text = head.decode("latin-1")
+            lines = text.split("\r\n")
+            method, target, _version = lines[0].split(" ", 2)
+            headers: Dict[str, str] = {}
+            for line in lines[1:]:
+                if not line:
+                    continue
+                name, _, value = line.partition(":")
+                headers[name.strip().lower()] = value.strip()
+            length = int(headers.get("content-length", "0") or "0")
+            if length < 0 or length > MAX_BODY_BYTES:
+                return None, False
+            body = await reader.readexactly(length) if length else b""
+            parts = urlsplit(target)
+            query = dict(parse_qsl(parts.query, keep_blank_values=True))
+            keep_alive = headers.get("connection", "keep-alive").lower() != "close"
+            return (
+                Request(method.upper(), parts.path or "/", query, headers, body),
+                keep_alive,
+            )
+        except (ValueError, asyncio.IncompleteReadError):
+            return None, False
+
+    async def _dispatch(self, request: Request) -> Response:
+        handler, params, path_exists = self.router.resolve(
+            request.method, request.path
+        )
+        if handler is None:
+            if path_exists:
+                return Response(405, {"message": "Method Not Allowed"})
+            return Response(404, {"message": "Not Found"})
+        request.path_params = params
+        try:
+            if inspect.iscoroutinefunction(handler):
+                result = await handler(request)
+            else:
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(None, handler, request)
+                if inspect.isawaitable(result):
+                    result = await result
+            return result
+        except HttpError as e:
+            return Response(e.status, {"message": e.message})
+        except Exception as e:
+            logger.exception("handler error for %s %s", request.method,
+                             request.path)
+            return Response(500, {"message": str(e)})
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port,
+            limit=MAX_HEADER_BYTES,
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+        logger.info("http server listening on %s:%d", self.host, self.port)
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    def start_background(self) -> int:
+        """Run the server on a daemon thread; returns the bound port."""
+
+        def _run() -> None:
+            try:
+                asyncio.run(self.serve_forever())
+            except asyncio.CancelledError:
+                pass  # normal stop() path
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("http server failed to start")
+        return self.port
+
+    def stop(self) -> None:
+        loop, server = self._loop, self._server
+        if loop is not None and server is not None:
+            try:
+                loop.call_soon_threadsafe(server.close)
+            except RuntimeError:
+                pass  # loop already closed (server stopped itself)
+
+
+async def sync(fn: Callable[..., Any], *args: Any) -> Any:
+    """Run a blocking callable on the thread pool (spray's detach())."""
+    return await asyncio.get_running_loop().run_in_executor(None, fn, *args)

@@ -204,18 +204,31 @@ def _extract_enum(cls: Type[enum.Enum], obj: Any) -> enum.Enum:
     raise ExtractionError(f"Cannot convert {obj!r} to {cls.__name__}")
 
 
+def snake_to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(part.title() for part in rest)
+
+
 def _extract_dataclass(cls: type, obj: Any, lenient: bool) -> Any:
     if isinstance(obj, cls):
         return obj
     if not isinstance(obj, dict):
         raise ExtractionError(f"Expected JSON object for {cls.__name__}, got {obj!r}")
     hints = typing.get_type_hints(cls)
+    # Classes with __camel_case__ speak the reference's camelCase wire format
+    # (e.g. itemScores/creationYear) while staying snake_case in Python.
+    camel = getattr(cls, "__camel_case__", False)
     kwargs = {}
     for f in dataclasses.fields(cls):
         if not f.init:
             continue
-        if f.name in obj:
-            kwargs[f.name] = _extract(hints.get(f.name, Any), obj[f.name], lenient)
+        key = f.name
+        if key not in obj and camel:
+            alt = snake_to_camel(f.name)
+            if alt in obj:
+                key = alt
+        if key in obj:
+            kwargs[f.name] = _extract(hints.get(f.name, Any), obj[key], lenient)
         elif f.default is not _MISSING or f.default_factory is not _MISSING:  # type: ignore[misc]
             continue  # use the dataclass default
         else:
@@ -238,8 +251,10 @@ def to_jsonable(obj: Any) -> Any:
     if isinstance(obj, enum.Enum):
         return obj.value
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        camel = getattr(type(obj), "__camel_case__", False)
         return {
-            f.name: to_jsonable(getattr(obj, f.name))
+            (snake_to_camel(f.name) if camel else f.name):
+                to_jsonable(getattr(obj, f.name))
             for f in dataclasses.fields(obj)
         }
     hook = getattr(obj, "to_jsonable", None)

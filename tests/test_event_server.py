@@ -1,0 +1,278 @@
+"""EventServer REST contract (parity: data/src/test/.../api/EventServiceSpec.scala
+and the integration suite's EventserverTest with malformed batches)."""
+
+import base64
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Channel, Storage
+from incubator_predictionio_tpu.servers.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+from incubator_predictionio_tpu.servers.plugins import EventServerPlugin, PluginContext
+
+
+class VetoBlocker(EventServerPlugin):
+    input_blocker = True
+
+    def process(self, event_info, context):
+        if event_info.event.event == "forbidden-event":
+            raise ValueError("vetoed by plugin")
+
+
+class CountingSniffer(EventServerPlugin):
+    input_sniffer = True
+
+    def __init__(self):
+        self.seen = []
+
+    def process(self, event_info, context):
+        self.seen.append(event_info.event.event)
+
+    def handle_rest(self, path, params):
+        return {"seen": len(self.seen)}
+
+
+@pytest.fixture(scope="module")
+def server():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    apps = Storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "srv-app"))
+    Storage.get_meta_data_access_keys().insert(AccessKey("testkey", app_id))
+    Storage.get_meta_data_access_keys().insert(
+        AccessKey("limitedkey", app_id, ("rate",))
+    )
+    Storage.get_meta_data_channels().insert(Channel(0, "mobile", app_id))
+    sniffer = CountingSniffer()
+    srv = EventServer(
+        EventServerConfig(ip="127.0.0.1", port=0, stats=True),
+        PluginContext([VetoBlocker(), sniffer]),
+    )
+    port = srv.start_background()
+    srv.test_port = port
+    srv.test_sniffer = sniffer
+    yield srv
+    srv.stop()
+    Storage.reset()
+
+
+def call(server, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{server.test_port}{path}"
+    data = None
+    req_headers = dict(headers or {})
+    if body is not None:
+        if isinstance(body, (dict, list)):
+            data = json.dumps(body).encode()
+            req_headers.setdefault("Content-Type", "application/json")
+        else:
+            data = body if isinstance(body, bytes) else body.encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=req_headers)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+EV = {"event": "rate", "entityType": "user", "entityId": "u1",
+      "targetEntityType": "item", "targetEntityId": "i1",
+      "properties": {"rating": 5}}
+
+
+def test_alive(server):
+    assert call(server, "GET", "/") == (200, {"status": "alive"})
+
+
+def test_auth_missing_invalid(server):
+    status, body = call(server, "POST", "/events.json", EV)
+    assert status == 401
+    status, _ = call(server, "POST", "/events.json?accessKey=wrong", EV)
+    assert status == 401
+
+
+def test_auth_basic_header(server):
+    creds = base64.b64encode(b"testkey:").decode()
+    status, body = call(server, "POST", "/events.json", EV,
+                        {"Authorization": f"Basic {creds}"})
+    assert status == 201 and "eventId" in body
+
+
+def test_create_get_delete_event(server):
+    status, body = call(server, "POST", "/events.json?accessKey=testkey", EV)
+    assert status == 201
+    eid = body["eventId"]
+    status, got = call(server, "GET", f"/events/{eid}.json?accessKey=testkey")
+    assert status == 200
+    assert got["event"] == "rate" and got["entityId"] == "u1"
+    assert got["properties"] == {"rating": 5}
+    status, body = call(server, "DELETE", f"/events/{eid}.json?accessKey=testkey")
+    assert status == 200 and body == {"message": "Found"}
+    status, _ = call(server, "GET", f"/events/{eid}.json?accessKey=testkey")
+    assert status == 404
+
+
+def test_malformed_event_400(server):
+    status, body = call(server, "POST", "/events.json?accessKey=testkey",
+                        {"entityType": "user"})
+    assert status == 400
+    status, body = call(server, "POST", "/events.json?accessKey=testkey",
+                        body=b"not json")
+    assert status == 400
+    # reserved-name violation
+    bad = dict(EV, event="$badname")
+    status, body = call(server, "POST", "/events.json?accessKey=testkey", bad)
+    assert status == 400
+
+
+def test_allowed_events_enforced(server):
+    status, _ = call(server, "POST", "/events.json?accessKey=limitedkey", EV)
+    assert status == 201
+    buy = dict(EV, event="buy")
+    status, body = call(server, "POST", "/events.json?accessKey=limitedkey", buy)
+    assert status == 403
+    assert "not allowed" in body["message"]
+
+
+def test_channel_auth_and_isolation(server):
+    status, body = call(
+        server, "POST", "/events.json?accessKey=testkey&channel=mobile",
+        dict(EV, entityId="chan-user"),
+    )
+    assert status == 201
+    status, _ = call(
+        server, "POST", "/events.json?accessKey=testkey&channel=nope", EV
+    )
+    assert status == 401
+    # event only visible in its channel
+    status, found = call(
+        server, "GET",
+        "/events.json?accessKey=testkey&channel=mobile&entityId=chan-user",
+    )
+    assert status == 200 and len(found) == 1
+    status, _ = call(
+        server, "GET", "/events.json?accessKey=testkey&entityId=chan-user"
+    )
+    assert status == 404
+
+
+def test_query_events(server):
+    for i in range(3):
+        call(server, "POST", "/events.json?accessKey=testkey",
+             dict(EV, entityId=f"qu{i}", event="view"))
+    status, found = call(server, "GET",
+                         "/events.json?accessKey=testkey&event=view")
+    assert status == 200 and len(found) >= 3
+    status, found = call(
+        server, "GET",
+        "/events.json?accessKey=testkey&event=view&limit=2&reversed=true",
+    )
+    assert status == 200 and len(found) == 2
+    status, _ = call(server, "GET",
+                     "/events.json?accessKey=testkey&event=nothing-here")
+    assert status == 404
+    status, _ = call(server, "GET",
+                     "/events.json?accessKey=testkey&startTime=garbage")
+    assert status == 400
+
+
+def test_batch_events(server):
+    batch = [
+        dict(EV, entityId="b1"),
+        {"entityType": "user"},  # malformed
+        dict(EV, entityId="b2", event="forbidden-event"),  # vetoed by plugin
+    ]
+    status, results = call(server, "POST",
+                           "/batch/events.json?accessKey=testkey", batch)
+    assert status == 200
+    assert results[0]["status"] == 201
+    assert results[1]["status"] == 400
+    assert results[2]["status"] == 500  # blocker veto surfaces per-event
+    # batch too large
+    status, body = call(server, "POST", "/batch/events.json?accessKey=testkey",
+                        [EV] * 51)
+    assert status == 400
+    assert "50" in body["message"]
+
+
+def test_stats(server):
+    status, body = call(server, "GET", "/stats.json?accessKey=testkey")
+    assert status == 200
+    assert body["appId"] == 1
+    assert any(s["status"] == 201 for s in body["status"])
+
+
+def test_webhook_segmentio(server):
+    payload = {
+        "version": "2", "type": "track", "userId": "seg-user",
+        "event": "Signed Up", "properties": {"plan": "Pro"},
+        "timestamp": "2020-02-02T02:02:02.000Z",
+    }
+    status, body = call(server, "POST",
+                        "/webhooks/segmentio.json?accessKey=testkey", payload)
+    assert status == 201
+    status, found = call(
+        server, "GET", "/events.json?accessKey=testkey&entityId=seg-user"
+    )
+    assert status == 200
+    assert found[0]["event"] == "track"
+    assert found[0]["properties"]["event"] == "Signed Up"
+    # probe + unknown connector
+    assert call(server, "GET",
+                "/webhooks/segmentio.json?accessKey=testkey")[0] == 200
+    assert call(server, "POST", "/webhooks/nope.json?accessKey=testkey",
+                payload)[0] == 404
+    # bad payload
+    status, _ = call(server, "POST",
+                     "/webhooks/segmentio.json?accessKey=testkey",
+                     {"type": "track"})
+    assert status == 400
+
+
+def test_webhook_mailchimp_form(server):
+    form = ("type=subscribe&fired_at=2009-03-26 21:35:57"
+            "&data[id]=8a25ff1d98&data[list_id]=a6b5da1054"
+            "&data[email]=api@mailchimp.com"
+            "&data[merges][EMAIL]=api@mailchimp.com"
+            "&data[merges][FNAME]=MailChimp")
+    status, body = call(
+        server, "POST", "/webhooks/mailchimp.form?accessKey=testkey",
+        body=form.encode(),
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+    )
+    assert status == 201
+    status, found = call(
+        server, "GET",
+        "/events.json?accessKey=testkey&entityId=api@mailchimp.com",
+    )
+    assert status == 200
+    assert found[0]["event"] == "subscribe"
+    assert found[0]["properties"]["merges"]["FNAME"] == "MailChimp"
+    assert found[0]["eventTime"].startswith("2009-03-26T21:35:57")
+
+
+def test_plugins_routes(server):
+    status, body = call(server, "GET", "/plugins.json")
+    assert status == 200
+    assert "VetoBlocker" in body["plugins"]["inputblockers"]
+    assert "CountingSniffer" in body["plugins"]["inputsniffers"]
+    status, body = call(server, "GET", "/plugins/CountingSniffer/anything")
+    assert status == 200 and body["seen"] >= 1
+    assert call(server, "GET", "/plugins/Nope/x")[0] == 404
+
+
+def test_unknown_route_and_method(server):
+    assert call(server, "GET", "/nope.json")[0] == 404
+    assert call(server, "DELETE", "/events.json?accessKey=testkey")[0] == 405

@@ -1,0 +1,175 @@
+"""PredictionServer contract: deploy, query, feedback loop, reload, stop.
+
+Parity: CreateServer.scala behavior incl. the feedback loop posting predict
+events back to a live EventServer.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fake_engine import AP, make_engine, params
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.servers.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+from incubator_predictionio_tpu.servers.plugins import EngineServerPlugin, PluginContext
+from incubator_predictionio_tpu.servers.prediction_server import (
+    PredictionServer,
+    ServerConfig,
+    undeploy,
+)
+from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class RewritingBlocker(EngineServerPlugin):
+    output_blocker = True
+
+    def process(self, variant, query, prediction, context):
+        if isinstance(prediction, dict):
+            prediction = dict(prediction, blocked_by="RewritingBlocker")
+        return prediction
+
+
+@pytest.fixture
+def stack():
+    """memory storage + trained engine + event server + prediction server."""
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    app_id = Storage.get_meta_data_apps().insert(App(0, "ps-app"))
+    Storage.get_meta_data_access_keys().insert(AccessKey("fbkey", app_id))
+
+    engine = make_engine()
+    CoreWorkflow.run_train(engine, params(ds=9, algos=[("algo0", AP(1))]),
+                           engine_variant="served")
+
+    es = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+    es_port = es.start_background()
+
+    ps = PredictionServer(
+        engine,
+        ServerConfig(
+            ip="127.0.0.1", port=0, engine_variant="served",
+            event_server_ip="127.0.0.1", event_server_port=es_port,
+            access_key="fbkey", feedback=True, server_key="sekrit",
+        ),
+        PluginContext([RewritingBlocker()]),
+    )
+    ps_port = ps.start_background()
+    yield ps, ps_port, es, es_port
+    ps.stop()
+    es.stop()
+    Storage.reset()
+
+
+def test_status_page(stack):
+    ps, port, _es, _esp = stack
+    status, body = call(port, "GET", "/")
+    assert status == 200
+    assert body["status"] == "alive"
+    assert body["engineVariant"] == "served"
+    assert body["algorithms"] == ["Algorithm0"]
+    assert body["requestCount"] == 0
+
+
+def test_query_pipeline_and_bookkeeping(stack):
+    ps, port, _es, _esp = stack
+    status, body = call(port, "POST", "/queries.json", {"qx": 5})
+    assert status == 200
+    # Prediction(model=Model(ds_id=9, pp_id=2, ap_id=1), qx=5)
+    assert body["qx"] == 5
+    assert body["model"]["ds_id"] == 9
+    assert body["blocked_by"] == "RewritingBlocker"  # output blocker ran
+    status, info = call(port, "GET", "/")
+    assert info["requestCount"] == 1
+    assert info["lastServingSec"] > 0
+
+
+def test_query_malformed_400(stack):
+    ps, port, _es, _esp = stack
+    status, body = call(port, "POST", "/queries.json", {"bogus": True})
+    assert status == 400
+
+
+def test_feedback_event_reaches_event_server(stack):
+    ps, port, _es, es_port = stack
+    call(port, "POST", "/queries.json", {"qx": 7})
+    deadline = time.time() + 5
+    found = []
+    while time.time() < deadline and not found:
+        status, got = call(
+            es_port, "GET",
+            "/events.json?accessKey=fbkey&event=predict",
+        )
+        if status == 200:
+            found = got
+        else:
+            time.sleep(0.05)
+    assert found, "feedback predict event never arrived"
+    ev = found[0]
+    assert ev["entityType"] == "pio_pr"
+    assert ev["properties"]["query"] == {"qx": 7}
+    assert ev["properties"]["engineInstanceId"]
+
+
+def test_reload_picks_up_new_instance(stack):
+    ps, port, _es, _esp = stack
+    # train a new instance with different params
+    CoreWorkflow.run_train(ps.engine, params(ds=42, algos=[("algo0", AP(2))]),
+                           engine_variant="served")
+    # unauthorized reload
+    assert call(port, "POST", "/reload")[0] == 401
+    status, _ = call(port, "POST", "/reload?accessKey=sekrit")
+    assert status == 200
+    status, body = call(port, "POST", "/queries.json", {"qx": 1})
+    assert body["model"]["ds_id"] == 42
+    assert body["model"]["ap_id"] == 2
+
+
+def test_stop_authed_and_shuts_down(stack):
+    ps, port, _es, _esp = stack
+    assert call(port, "POST", "/stop")[0] == 401
+    status, _ = call(port, "POST", "/stop?accessKey=sekrit")
+    assert status == 200
+    deadline = time.time() + 5
+    down = False
+    while time.time() < deadline and not down:
+        try:
+            call(port, "GET", "/")
+            time.sleep(0.05)
+        except Exception:
+            down = True
+    assert down
+    assert not undeploy("127.0.0.1", port)  # already down
+
+
+def test_plugins_listing(stack):
+    ps, port, _es, _esp = stack
+    status, body = call(port, "GET", "/plugins.json")
+    assert status == 200
+    assert "RewritingBlocker" in body["plugins"]["outputblockers"]

@@ -168,3 +168,19 @@ def test_evaluation_precision_at_k(seeded_app):
     assert 0.0 <= result.best_score.score <= 1.0
     # block structure should make precision decent
     assert result.best_score.score > 0.2
+
+
+def test_wire_format_parity():
+    """Reference clients speak camelCase (Engine.scala:23-28 JSON)."""
+    from incubator_predictionio_tpu.utils import json_codec
+
+    q = json_codec.extract(Query, {"user": "u1", "num": 4,
+                                   "creationYear": 1995})
+    assert q.creation_year == 1995
+    from incubator_predictionio_tpu.models.recommendation import ItemScore
+    out = json_codec.to_jsonable(
+        PredictedResult(item_scores=(ItemScore("i1", 1.5, 1990),))
+    )
+    assert out == {"itemScores": [
+        {"item": "i1", "score": 1.5, "creationYear": 1990}
+    ]}

@@ -1,0 +1,37 @@
+"""Quickstart evaluation objects (parity: the Evaluation.scala +
+EngineParamsList of the integration-test recommendation engine).
+
+Run with:
+    pio eval evaluation:evaluation evaluation:engine_params_generator
+"""
+
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.core.evaluation import Evaluation
+from incubator_predictionio_tpu.core.params import EngineParamsGenerator
+from incubator_predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    RecommendationEngine,
+)
+from incubator_predictionio_tpu.models.recommendation.engine import PrecisionAtK
+
+evaluation = Evaluation()
+evaluation.engine_metric = (RecommendationEngine().apply(), PrecisionAtK(k=5))
+
+
+class _Generator(EngineParamsGenerator):
+    engine_params_list = [
+        EngineParams(
+            data_source_params=(
+                "", DataSourceParams(app_name="MyApp1", eval_k=2)
+            ),
+            algorithm_params_list=[
+                ("als", ALSAlgorithmParams(rank=rank, num_iterations=8,
+                                           lambda_=0.05, seed=3))
+            ],
+        )
+        for rank in (4, 8)
+    ]
+
+
+engine_params_generator = _Generator()

@@ -1,0 +1,312 @@
+"""CLI command implementations.
+
+Parity: tools/.../console/Pio.scala:42-351 and tools/.../commands/
+{App,AccessKey,Engine,Management,Export,Import}.scala — app/key/channel
+CRUD, engine resolution from engine.json, train/eval/deploy drivers,
+events export/import, end-to-end status validation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import logging
+import os
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    Channel,
+    Storage,
+    is_valid_channel_name,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class CommandError(Exception):
+    """User-facing command failure (exit code 1)."""
+
+
+# ---------------------------------------------------------------------------
+# app / accesskey / channel (commands/App.scala, commands/AccessKey.scala)
+# ---------------------------------------------------------------------------
+
+def app_new(name: str, app_id: int = 0, description: Optional[str] = None,
+            access_key: str = "") -> Dict[str, Any]:
+    apps = Storage.get_meta_data_apps()
+    if apps.get_by_name(name) is not None:
+        raise CommandError(f"App {name} already exists. Aborting.")
+    new_id = apps.insert(App(app_id, name, description))
+    if new_id is None:
+        raise CommandError(f"Unable to create new app: {name}")
+    Storage.get_events().init(new_id)
+    key = Storage.get_meta_data_access_keys().insert(
+        AccessKey(access_key, new_id, ())
+    )
+    print(f"Initialized Event Store for this app ID: {new_id}.")
+    print("Created new app:")
+    print(f"      Name: {name}")
+    print(f"        ID: {new_id}")
+    print(f"Access Key: {key}")
+    return {"id": new_id, "name": name, "accessKey": key}
+
+
+def app_list() -> List[Dict[str, Any]]:
+    apps = sorted(Storage.get_meta_data_apps().get_all(), key=lambda a: a.name)
+    keys = Storage.get_meta_data_access_keys()
+    out = []
+    print(f"{'Name':<20}|{'ID':>6}| Access Key(s)")
+    for app in apps:
+        app_keys = [k.key for k in keys.get_by_appid(app.id)]
+        print(f"{app.name:<20}|{app.id:>6}| {', '.join(app_keys)}")
+        out.append({"name": app.name, "id": app.id, "accessKeys": app_keys})
+    print(f"Finished listing {len(apps)} app(s).")
+    return out
+
+
+def _get_app(name: str) -> App:
+    app = Storage.get_meta_data_apps().get_by_name(name)
+    if app is None:
+        raise CommandError(f"App {name} does not exist. Aborting.")
+    return app
+
+
+def app_show(name: str) -> Dict[str, Any]:
+    app = _get_app(name)
+    keys = Storage.get_meta_data_access_keys().get_by_appid(app.id)
+    channels = Storage.get_meta_data_channels().get_by_appid(app.id)
+    print(f"    App Name: {app.name}")
+    print(f"      App ID: {app.id}")
+    print(f" Description: {app.description or ''}")
+    for k in keys:
+        allowed = "(all)" if not k.events else ", ".join(k.events)
+        print(f"  Access Key: {k.key} | {allowed}")
+    for c in channels:
+        print(f"     Channel: {c.name} (ID {c.id})")
+    return {
+        "name": app.name, "id": app.id, "description": app.description,
+        "accessKeys": [k.key for k in keys],
+        "channels": [c.name for c in channels],
+    }
+
+
+def app_delete(name: str) -> None:
+    app = _get_app(name)
+    channels = Storage.get_meta_data_channels()
+    events = Storage.get_events()
+    for channel in channels.get_by_appid(app.id):
+        events.remove(app.id, channel.id)
+        channels.delete(channel.id)
+    events.remove(app.id)
+    keys = Storage.get_meta_data_access_keys()
+    for key in keys.get_by_appid(app.id):
+        keys.delete(key.key)
+    Storage.get_meta_data_apps().delete(app.id)
+    print(f"App successfully deleted: {name}")
+
+
+def app_data_delete(name: str, channel: Optional[str] = None) -> None:
+    app = _get_app(name)
+    channel_id = None
+    if channel is not None:
+        matches = [
+            c for c in Storage.get_meta_data_channels().get_by_appid(app.id)
+            if c.name == channel
+        ]
+        if not matches:
+            raise CommandError(f"Channel {channel} does not exist.")
+        channel_id = matches[0].id
+    events = Storage.get_events()
+    events.remove(app.id, channel_id)
+    events.init(app.id, channel_id)
+    print(f"Deleted all data of app {name}"
+          + (f" channel {channel}" if channel else ""))
+
+
+def channel_new(app_name: str, channel_name: str) -> Dict[str, Any]:
+    app = _get_app(app_name)
+    if not is_valid_channel_name(channel_name):
+        raise CommandError(f"Invalid channel name: {channel_name}.")
+    channels = Storage.get_meta_data_channels()
+    channel_id = channels.insert(Channel(0, channel_name, app.id))
+    if channel_id is None:
+        raise CommandError(
+            f"Channel {channel_name} already exists for app {app_name}."
+        )
+    Storage.get_events().init(app.id, channel_id)
+    print(f"Created new channel {channel_name} (ID {channel_id}) "
+          f"for app {app_name}.")
+    return {"id": channel_id, "name": channel_name, "appId": app.id}
+
+
+def channel_delete(app_name: str, channel_name: str) -> None:
+    app = _get_app(app_name)
+    channels = Storage.get_meta_data_channels()
+    matches = [
+        c for c in channels.get_by_appid(app.id) if c.name == channel_name
+    ]
+    if not matches:
+        raise CommandError(
+            f"Channel {channel_name} does not exist for app {app_name}."
+        )
+    Storage.get_events().remove(app.id, matches[0].id)
+    channels.delete(matches[0].id)
+    print(f"Deleted channel {channel_name} of app {app_name}.")
+
+
+def accesskey_new(app_name: str, key: str = "",
+                  events: Tuple[str, ...] = ()) -> str:
+    app = _get_app(app_name)
+    new_key = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key, app.id, tuple(events))
+    )
+    if new_key is None:
+        raise CommandError("Unable to create access key.")
+    print(f"Created new access key: {new_key}")
+    return new_key
+
+
+def accesskey_list(app_name: Optional[str] = None) -> List[AccessKey]:
+    keys_dao = Storage.get_meta_data_access_keys()
+    if app_name is not None:
+        keys = keys_dao.get_by_appid(_get_app(app_name).id)
+    else:
+        keys = keys_dao.get_all()
+    for k in sorted(keys, key=lambda k: k.key):
+        allowed = "(all)" if not k.events else ", ".join(k.events)
+        print(f"{k.key} | app {k.appid} | {allowed}")
+    print(f"Finished listing {len(keys)} access key(s).")
+    return list(keys)
+
+
+def accesskey_delete(key: str) -> None:
+    if not Storage.get_meta_data_access_keys().delete(key):
+        raise CommandError(f"Error deleting access key {key}.")
+    print(f"Deleted access key {key}.")
+
+
+# ---------------------------------------------------------------------------
+# engine resolution (commands/Engine.scala + WorkflowUtils.getEngine)
+# ---------------------------------------------------------------------------
+
+def load_variant(engine_json: str = "engine.json") -> Dict[str, Any]:
+    path = Path(engine_json)
+    if not path.exists():
+        raise CommandError(
+            f"{engine_json} does not exist. Aborting. (Run from your engine "
+            "template directory, or pass --variant.)"
+        )
+    with open(path) as f:
+        return json.load(f)
+
+
+def resolve_engine_factory(factory_path: str) -> Any:
+    """Load the engine factory class/object from ``module:Attr`` or
+    ``module.Attr`` (WorkflowUtils.getEngine:64 resolves Scala objects vs
+    classes the same way)."""
+    if ":" in factory_path:
+        module_name, _, attr = factory_path.partition(":")
+    else:
+        module_name, _, attr = factory_path.rpartition(".")
+    if not module_name:
+        raise CommandError(f"Invalid engineFactory {factory_path!r}")
+    sys.path.insert(0, os.getcwd())
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError as e:
+        raise CommandError(
+            f"Cannot import engine factory module {module_name!r}: {e}"
+        ) from e
+    finally:
+        sys.path.pop(0)
+    try:
+        factory = getattr(module, attr)
+    except AttributeError as e:
+        raise CommandError(
+            f"Module {module_name!r} has no attribute {attr!r}"
+        ) from e
+    return factory() if isinstance(factory, type) else factory
+
+
+def engine_from_variant(variant: Dict[str, Any]):
+    factory_path = variant.get("engineFactory")
+    if not factory_path:
+        raise CommandError("engine.json is missing 'engineFactory'.")
+    factory = resolve_engine_factory(factory_path)
+    engine = factory.apply()
+    return engine, engine.jvalue_to_engine_params(variant)
+
+
+# ---------------------------------------------------------------------------
+# export / import (tools/.../export/EventsToFile.scala, imprt/FileToEvents.scala)
+# ---------------------------------------------------------------------------
+
+def export_events(app_name: str, output: str,
+                  channel: Optional[str] = None) -> int:
+    from incubator_predictionio_tpu.data.store import EventStore
+
+    n = 0
+    with open(output, "w") as f:
+        for event in EventStore.find(app_name=app_name, channel_name=channel):
+            f.write(json.dumps(event.to_jsonable()) + "\n")
+            n += 1
+    print(f"Exported {n} events to {output}.")
+    return n
+
+
+def import_events(app_name: str, input_path: str,
+                  channel: Optional[str] = None) -> int:
+    from incubator_predictionio_tpu.data.event import validate_event
+    from incubator_predictionio_tpu.data.store import EventStore
+
+    events = []
+    with open(input_path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = Event.from_jsonable(json.loads(line))
+                validate_event(event)
+                events.append(event)
+            except ValueError as e:
+                raise CommandError(
+                    f"{input_path}:{line_no}: invalid event: {e}"
+                ) from e
+    EventStore.write(events, app_name=app_name, channel_name=channel)
+    print(f"Imported {len(events)} events.")
+    return len(events)
+
+
+# ---------------------------------------------------------------------------
+# status (commands/Management.scala:99-178)
+# ---------------------------------------------------------------------------
+
+def status() -> bool:
+    from incubator_predictionio_tpu import __version__
+
+    print(f"PredictionIO-TPU {__version__}")
+    print("Inspecting storage backend connections...")
+    try:
+        Storage.verify_all_data_objects()
+        print("Storage: OK (metadata, event data, model data all verified)")
+    except Exception as e:
+        print(f"Storage: ERROR: {e}")
+        return False
+    try:
+        import jax
+
+        devices = jax.devices()
+        print(f"Compute: jax {jax.__version__}, {len(devices)} device(s): "
+              f"{devices[0].platform}")
+    except Exception as e:
+        print(f"Compute: ERROR: {e}")
+        return False
+    print("Your system is all ready to go.")
+    return True

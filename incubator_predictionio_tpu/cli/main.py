@@ -1,17 +1,29 @@
-"""``pio`` CLI entry point — subcommands land as subsystems are built.
+"""``pio`` CLI — the full verb set.
 
-Reference verb inventory (tools/.../console/Console.scala:153-600): version,
-status, app {new,list,show,delete,data-delete,channel-new,channel-delete},
-accesskey {new,list,delete}, train, eval, deploy, undeploy, eventserver,
-adminserver, dashboard, export, import, build, run, template.
+Parity: tools/.../console/Console.scala:153-600 subcommand matrix:
+version / status / app {new,list,show,delete,data-delete,channel-new,
+channel-delete} / accesskey {new,list,delete} / train / eval / deploy /
+undeploy / eventserver / adminserver / dashboard / export / import / build /
+run / template {get,list}.
+
+Design delta from the reference: no spark-submit process hop
+(Runner.runOnSpark, tools/.../Runner.scala:101-213) — train/eval/deploy run
+in-process on the TPU host, so ``pio build`` has no sbt step (it validates
+engine.json and importability instead).
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
+import importlib
+import json
 import sys
+from typing import Any, List, Optional
 
 from incubator_predictionio_tpu import __version__
+from incubator_predictionio_tpu.cli import commands
+from incubator_predictionio_tpu.cli.commands import CommandError
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -19,18 +31,356 @@ def build_parser() -> argparse.ArgumentParser:
         prog="pio",
         description="TPU-native PredictionIO-compatible machine learning server",
     )
-    parser.add_argument("--version", action="version", version=f"pio-tpu {__version__}")
-    parser.add_subparsers(dest="command")
+    parser.add_argument("--version", action="version",
+                        version=f"pio-tpu {__version__}")
+    sub = parser.add_subparsers(dest="command")
+
+    sub.add_parser("version", help="show version")
+    sub.add_parser("status", help="validate storage + compute configuration")
+
+    # -- app ---------------------------------------------------------------
+    app = sub.add_parser("app", help="manage apps").add_subparsers(
+        dest="app_command"
+    )
+    p = app.add_parser("new")
+    p.add_argument("name")
+    p.add_argument("--id", type=int, default=0)
+    p.add_argument("--description")
+    p.add_argument("--access-key", default="")
+    app.add_parser("list")
+    p = app.add_parser("show")
+    p.add_argument("name")
+    p = app.add_parser("delete")
+    p.add_argument("name")
+    p.add_argument("-f", "--force", action="store_true")
+    p = app.add_parser("data-delete")
+    p.add_argument("name")
+    p.add_argument("--channel")
+    p.add_argument("-f", "--force", action="store_true")
+    p = app.add_parser("channel-new")
+    p.add_argument("name")
+    p.add_argument("channel")
+    p = app.add_parser("channel-delete")
+    p.add_argument("name")
+    p.add_argument("channel")
+    p.add_argument("-f", "--force", action="store_true")
+
+    # -- accesskey ---------------------------------------------------------
+    ak = sub.add_parser("accesskey", help="manage access keys").add_subparsers(
+        dest="accesskey_command"
+    )
+    p = ak.add_parser("new")
+    p.add_argument("app_name")
+    p.add_argument("--key", default="")
+    p.add_argument("--events", nargs="*", default=[])
+    p = ak.add_parser("list")
+    p.add_argument("app_name", nargs="?")
+    p = ak.add_parser("delete")
+    p.add_argument("key")
+
+    # -- engine lifecycle --------------------------------------------------
+    for name, help_text in (
+        ("build", "validate the engine in the current directory"),
+        ("train", "train the engine in the current directory"),
+        ("deploy", "deploy the latest trained engine instance"),
+    ):
+        p = sub.add_parser(name, help=help_text)
+        p.add_argument("--variant", default="engine.json")
+        if name == "train":
+            p.add_argument("--batch", default="")
+            p.add_argument("--skip-sanity-check", action="store_true")
+            p.add_argument("--stop-after-read", action="store_true")
+            p.add_argument("--stop-after-prepare", action="store_true")
+            p.add_argument("--seed", type=int, default=0)
+            p.add_argument("--model-parallelism", type=int, default=1)
+        if name == "deploy":
+            p.add_argument("--ip", default="0.0.0.0")
+            p.add_argument("--port", type=int, default=8000)
+            p.add_argument("--engine-instance-id")
+            p.add_argument("--event-server-ip", default="0.0.0.0")
+            p.add_argument("--event-server-port", type=int, default=7070)
+            p.add_argument("--accesskey", default=None)
+            p.add_argument("--feedback", action="store_true")
+            p.add_argument("--server-key", default=None)
+
+    p = sub.add_parser("eval", help="run evaluation / hyperparameter tuning")
+    p.add_argument("evaluation_class",
+                   help="module:attr of the Evaluation object")
+    p.add_argument("engine_params_generator_class", nargs="?",
+                   help="module:attr of the EngineParamsGenerator")
+    p.add_argument("--batch", default="")
+    p.add_argument("--output-best", default="best.json")
+
+    p = sub.add_parser("undeploy", help="stop a deployed engine server")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--server-key", default=None)
+
+    # -- servers -----------------------------------------------------------
+    p = sub.add_parser("eventserver", help="start the event server")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7070)
+    p.add_argument("--stats", action="store_true")
+    p = sub.add_parser("adminserver", help="start the admin API server")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7071)
+    p = sub.add_parser("dashboard", help="start the evaluation dashboard")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9000)
+
+    # -- data --------------------------------------------------------------
+    p = sub.add_parser("export", help="export app events to JSON lines")
+    p.add_argument("--appid-or-name", dest="app_name", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--channel")
+    p = sub.add_parser("import", help="import JSON-line events into an app")
+    p.add_argument("--appid-or-name", dest="app_name", required=True)
+    p.add_argument("--input", required=True)
+    p.add_argument("--channel")
+
+    # -- misc --------------------------------------------------------------
+    p = sub.add_parser("run", help="run an arbitrary main in the engine env")
+    p.add_argument("main_class")
+    p.add_argument("args", nargs="*")
+    tpl = sub.add_parser("template", help="(deprecated)").add_subparsers(
+        dest="template_command"
+    )
+    tpl.add_parser("get")
+    tpl.add_parser("list")
+
     return parser
 
 
-def main(argv: list[str] | None = None) -> int:
-    parser = build_parser()
-    args = parser.parse_args(argv)
-    if not getattr(args, "command", None):
-        parser.print_help()
+def _confirm(prompt: str, force: bool) -> bool:
+    if force:
+        return True
+    answer = input(f"{prompt} (YES to confirm): ")
+    return answer == "YES"
+
+
+def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
+    cmd = args.command
+    if cmd in (None, "version"):
+        print(f"pio-tpu {__version__}")
+        return 0 if cmd else 1
+
+    if cmd == "status":
+        return 0 if commands.status() else 1
+
+    if cmd == "app":
+        ac = args.app_command
+        if ac == "new":
+            commands.app_new(args.name, args.id, args.description,
+                             args.access_key)
+        elif ac == "list":
+            commands.app_list()
+        elif ac == "show":
+            commands.app_show(args.name)
+        elif ac == "delete":
+            if not _confirm(f"Delete app {args.name} and ALL its data?",
+                            args.force):
+                print("Aborted.")
+                return 1
+            commands.app_delete(args.name)
+        elif ac == "data-delete":
+            if not _confirm(f"Delete ALL data of app {args.name}?", args.force):
+                print("Aborted.")
+                return 1
+            commands.app_data_delete(args.name, args.channel)
+        elif ac == "channel-new":
+            commands.channel_new(args.name, args.channel)
+        elif ac == "channel-delete":
+            if not _confirm(
+                f"Delete channel {args.channel} of app {args.name}?",
+                args.force,
+            ):
+                print("Aborted.")
+                return 1
+            commands.channel_delete(args.name, args.channel)
+        else:
+            print("Usage: pio app {new,list,show,delete,data-delete,"
+                  "channel-new,channel-delete}")
+            return 1
+        return 0
+
+    if cmd == "accesskey":
+        kc = args.accesskey_command
+        if kc == "new":
+            commands.accesskey_new(args.app_name, args.key,
+                                   tuple(args.events))
+        elif kc == "list":
+            commands.accesskey_list(args.app_name)
+        elif kc == "delete":
+            commands.accesskey_delete(args.key)
+        else:
+            print("Usage: pio accesskey {new,list,delete}")
+            return 1
+        return 0
+
+    if cmd == "build":
+        variant = commands.load_variant(args.variant)
+        engine, engine_params = commands.engine_from_variant(variant)
+        n_algos = len(engine_params.algorithm_params_list) or 1
+        print(f"Engine {variant.get('engineFactory')} is valid "
+              f"({n_algos} algorithm(s) configured).")
+        print("No compilation step is needed; your engine is ready to train.")
+        return 0
+
+    if cmd == "train":
+        from incubator_predictionio_tpu.core.params import WorkflowParams
+        from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+        variant = commands.load_variant(args.variant)
+        engine, engine_params = commands.engine_from_variant(variant)
+        params = WorkflowParams(
+            batch=args.batch,
+            skip_sanity_check=args.skip_sanity_check,
+            stop_after_read=args.stop_after_read,
+            stop_after_prepare=args.stop_after_prepare,
+            runtime_conf={
+                "seed": str(args.seed),
+                "model_parallelism": str(args.model_parallelism),
+            },
+        )
+        instance_id = CoreWorkflow.run_train(
+            engine,
+            engine_params,
+            engine_id=variant.get("id", "default"),
+            engine_version=variant.get("version", "NOT_VERSIONED"),
+            engine_variant=variant.get("id", "default"),
+            engine_factory=variant.get("engineFactory", ""),
+            params=params,
+        )
+        print(f"Training completed. Engine instance ID: {instance_id}")
+        return 0
+
+    if cmd == "eval":
+        from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+        evaluation = commands.resolve_engine_factory(args.evaluation_class)
+        if args.engine_params_generator_class:
+            generator = commands.resolve_engine_factory(
+                args.engine_params_generator_class
+            )
+            params_list = generator.engine_params_list
+        else:
+            params_list = getattr(evaluation, "engine_params_list", None)
+            if not params_list:
+                raise CommandError(
+                    "Provide an EngineParamsGenerator class or set "
+                    "engine_params_list on the Evaluation."
+                )
+        evaluator = evaluation.evaluator
+        if args.output_best and hasattr(evaluator, "output_path"):
+            evaluator.output_path = args.output_best
+        from incubator_predictionio_tpu.core.params import WorkflowParams
+
+        instance_id, result = CoreWorkflow.run_evaluation(
+            evaluation, params_list,
+            evaluation_class=args.evaluation_class,
+            engine_params_generator_class=(
+                args.engine_params_generator_class or ""
+            ),
+            params=WorkflowParams(batch=args.batch),
+        )
+        print(result.to_one_liner())
+        print(f"Evaluation completed. Instance ID: {instance_id}")
+        return 0
+
+    if cmd == "deploy":
+        from incubator_predictionio_tpu.servers.prediction_server import (
+            PredictionServer,
+            ServerConfig,
+        )
+
+        variant = commands.load_variant(args.variant)
+        engine, _params = commands.engine_from_variant(variant)
+        server = PredictionServer(engine, ServerConfig(
+            ip=args.ip,
+            port=args.port,
+            engine_instance_id=args.engine_instance_id,
+            engine_id=variant.get("id", "default"),
+            engine_version=variant.get("version", "NOT_VERSIONED"),
+            engine_variant=variant.get("id", "default"),
+            event_server_ip=args.event_server_ip,
+            event_server_port=args.event_server_port,
+            access_key=args.accesskey,
+            feedback=args.feedback,
+            server_key=args.server_key,
+        ))
+        print(f"Deploying on http://{args.ip}:{args.port} ...")
+        asyncio.run(server.serve_forever())
+        return 0
+
+    if cmd == "undeploy":
+        from incubator_predictionio_tpu.servers.prediction_server import undeploy
+
+        if undeploy(args.ip, args.port, args.server_key):
+            print("Undeployed.")
+            return 0
+        print("Nothing at the given address responded to /stop.")
         return 1
-    return 0
+
+    if cmd == "eventserver":
+        from incubator_predictionio_tpu.servers.event_server import (
+            EventServer,
+            EventServerConfig,
+        )
+
+        server = EventServer(EventServerConfig(
+            ip=args.ip, port=args.port, stats=args.stats,
+        ))
+        print(f"Event Server running on http://{args.ip}:{args.port}")
+        asyncio.run(server.serve_forever())
+        return 0
+
+    if cmd == "adminserver":
+        from incubator_predictionio_tpu.servers.admin import AdminServer
+
+        server = AdminServer(args.ip, args.port)
+        print(f"Admin API running on http://{args.ip}:{args.port}")
+        asyncio.run(server.serve_forever())
+        return 0
+
+    if cmd == "dashboard":
+        from incubator_predictionio_tpu.servers.dashboard import DashboardServer
+
+        server = DashboardServer(args.ip, args.port)
+        print(f"Dashboard running on http://{args.ip}:{args.port}")
+        asyncio.run(server.serve_forever())
+        return 0
+
+    if cmd == "export":
+        commands.export_events(args.app_name, args.output, args.channel)
+        return 0
+
+    if cmd == "import":
+        commands.import_events(args.app_name, args.input, args.channel)
+        return 0
+
+    if cmd == "run":
+        target = commands.resolve_engine_factory(args.main_class)
+        result = target(*args.args) if callable(target) else None
+        if result is not None:
+            print(result)
+        return 0
+
+    if cmd == "template":
+        print("The template command is deprecated; browse the template "
+              "gallery instead (reference: commands/Template.scala:38-83).")
+        return 0
+
+    print(f"Unknown command {cmd!r}")
+    return 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return dispatch(args)
+    except CommandError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

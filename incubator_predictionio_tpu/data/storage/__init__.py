@@ -39,12 +39,13 @@ from incubator_predictionio_tpu.data.storage.base import (  # re-export
     Models,
     StorageClientConfig,
     UNSET,
+    is_valid_channel_name,
 )
 
 __all__ = [
     "AccessKey", "AccessKeys", "App", "Apps", "Channel", "Channels",
     "EngineInstance", "EngineInstances", "EvaluationInstance",
-    "EvaluationInstances", "Events", "Model", "Models", "Storage",
+    "EvaluationInstances", "Events", "Model", "Models", "Storage", "is_valid_channel_name",
     "StorageClientConfig", "StorageError", "UNSET", "BaseStorageClient",
 ]
 

@@ -1,0 +1,29 @@
+"""Classification template (NaiveBayes + LogisticRegression).
+
+Parity: examples/scala-parallel-classification/ (add-algorithm and
+custom-attributes variants).
+"""
+
+from incubator_predictionio_tpu.models.classification.engine import (
+    ClassificationDataSource,
+    ClassificationEngine,
+    ClassificationPreparator,
+    DataSourceParams,
+    FirstServing,
+    LabeledPoint,
+    LogRegAlgorithm,
+    LogRegAlgorithmParams,
+    NaiveBayesAlgorithm,
+    NaiveBayesAlgorithmParams,
+    PredictedResult,
+    Query,
+    TrainingData,
+)
+
+__all__ = [
+    "ClassificationDataSource", "ClassificationEngine",
+    "ClassificationPreparator", "DataSourceParams", "FirstServing",
+    "LabeledPoint", "LogRegAlgorithm", "LogRegAlgorithmParams",
+    "NaiveBayesAlgorithm", "NaiveBayesAlgorithmParams", "PredictedResult",
+    "Query", "TrainingData",
+]

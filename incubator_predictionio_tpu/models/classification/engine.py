@@ -1,0 +1,258 @@
+"""Classification engine: entity attributes → NB / LogReg on device.
+
+Reference parity (examples/scala-parallel-classification/add-algorithm/):
+
+- DataSource aggregates ``user`` entity properties requiring
+  ``plan, attr0, attr1, attr2`` (DataSource.scala:46-71) into LabeledPoints;
+  attribute names are configurable (custom-attributes variant reads
+  ``featureA..D`` — DataSourceParams.attrs covers both).
+- ``Query(attr0, attr1, attr2)`` / ``PredictedResult(label)``
+  (Engine.scala:23-31).
+- Two algorithms registered under one engine ("naive" + "logreg"), the
+  add-algorithm variant's multi-algo engine.json shape (its
+  algorithms list pairs NaiveBayes with a second model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Algorithm,
+    AverageMetric,
+    DataSource,
+    Engine,
+    EngineFactory,
+    Params,
+    Preparator,
+    Serving,
+)
+from incubator_predictionio_tpu.data.store import EventStore
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    __camel_case__ = True
+
+    features: Tuple[float, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    __camel_case__ = True
+
+    label: float
+
+
+@dataclasses.dataclass(frozen=True)
+class LabeledPoint:
+    label: float
+    features: Tuple[float, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    __camel_case__ = True
+
+    app_name: str
+    entity_type: str = "user"
+    label_attr: str = "plan"
+    attrs: Tuple[str, ...] = ("attr0", "attr1", "attr2")
+    eval_k: int = 0
+
+
+@dataclasses.dataclass
+class TrainingData:
+    labeled_points: List[LabeledPoint]
+
+    def sanity_check(self) -> None:
+        if not self.labeled_points:
+            raise ValueError("TrainingData has no labeled points")
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalInfo:
+    fold: int
+
+
+class ClassificationDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def _read_points(self) -> List[LabeledPoint]:
+        required = [self.params.label_attr, *self.params.attrs]
+        props = EventStore.aggregate_properties(
+            app_name=self.params.app_name,
+            entity_type=self.params.entity_type,
+            required=required,
+        )
+        points = []
+        for _entity, pm in sorted(props.items()):
+            points.append(LabeledPoint(
+                label=pm.get(self.params.label_attr, float),
+                features=tuple(pm.get(a, float) for a in self.params.attrs),
+            ))
+        return points
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        return TrainingData(self._read_points())
+
+    def read_eval(self, ctx: RuntimeContext):
+        from incubator_predictionio_tpu.e2 import split_data
+
+        if self.params.eval_k <= 0:
+            return []
+        points = self._read_points()
+        return [
+            (TrainingData(train), EvalInfo(fold), qa)
+            for train, fold, qa in split_data(
+                self.params.eval_k, points,
+                lambda p: (Query(features=p.features), p.label),
+            )
+        ]
+
+
+@dataclasses.dataclass
+class PreparedData:
+    features: np.ndarray       # [N, D] f32
+    labels: np.ndarray         # [N] int32 class ids
+    label_values: Tuple[float, ...]  # class id -> original label value
+
+
+class ClassificationPreparator(Preparator):
+    """Labels (arbitrary doubles in the reference) index to dense class ids
+    for the device; the map rides in the model to translate back."""
+
+    def prepare(self, ctx: RuntimeContext, td: TrainingData) -> PreparedData:
+        values = sorted({p.label for p in td.labeled_points})
+        index = {v: i for i, v in enumerate(values)}
+        return PreparedData(
+            features=np.array([p.features for p in td.labeled_points],
+                              np.float32),
+            labels=np.array([index[p.label] for p in td.labeled_points],
+                            np.int32),
+            label_values=tuple(values),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesAlgorithmParams(Params):
+    __camel_case__ = True  # accepts {"lambda": ...}
+
+    lambda_: float = 1.0
+
+
+@dataclasses.dataclass
+class NBModel:
+    nb: Any
+    label_values: Tuple[float, ...]
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    """NaiveBayesAlgorithm.scala of the template → ops.nb."""
+
+    params_class = NaiveBayesAlgorithmParams
+    query_class_ = Query
+
+    def __init__(self, params: NaiveBayesAlgorithmParams = NaiveBayesAlgorithmParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, pd: PreparedData) -> NBModel:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.nb import nb_fit
+
+        model = nb_fit(
+            jnp.asarray(pd.features), jnp.asarray(pd.labels),
+            n_classes=len(pd.label_values), lambda_=self.params.lambda_,
+        )
+        return NBModel(nb=model, label_values=pd.label_values)
+
+    def predict(self, model: NBModel, query: Query) -> PredictedResult:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.nb import nb_predict
+
+        cls = int(nb_predict(
+            model.nb, jnp.asarray([query.features], jnp.float32)
+        )[0])
+        return PredictedResult(label=model.label_values[cls])
+
+
+@dataclasses.dataclass(frozen=True)
+class LogRegAlgorithmParams(Params):
+    __camel_case__ = True
+
+    steps: int = 300
+    learning_rate: float = 0.1
+    l2: float = 1e-4
+
+
+@dataclasses.dataclass
+class LogRegModelWrap:
+    lr: Any
+    label_values: Tuple[float, ...]
+
+
+class LogRegAlgorithm(Algorithm):
+    """The add-algorithm second model → optax logreg (ops.logreg)."""
+
+    params_class = LogRegAlgorithmParams
+    query_class_ = Query
+
+    def __init__(self, params: LogRegAlgorithmParams = LogRegAlgorithmParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, pd: PreparedData) -> LogRegModelWrap:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.logreg import logreg_fit
+
+        model = logreg_fit(
+            jnp.asarray(pd.features), jnp.asarray(pd.labels),
+            n_classes=len(pd.label_values),
+            steps=self.params.steps,
+            learning_rate=self.params.learning_rate,
+            l2=self.params.l2,
+        )
+        return LogRegModelWrap(lr=model, label_values=pd.label_values)
+
+    def predict(self, model: LogRegModelWrap, query: Query) -> PredictedResult:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.logreg import logreg_predict
+
+        cls = int(logreg_predict(
+            model.lr, jnp.asarray([query.features], jnp.float32)
+        )[0])
+        return PredictedResult(label=model.label_values[cls])
+
+
+class FirstServing(Serving):
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        return predictions[0]
+
+
+class AccuracyMetric(AverageMetric):
+    """The template's evaluation metric (the reference's evaluation variant
+    scores exact-label accuracy)."""
+
+    def calculate_qpa(self, q: Query, p: PredictedResult, a: float) -> float:
+        return 1.0 if p.label == a else 0.0
+
+
+class ClassificationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            ClassificationDataSource,
+            ClassificationPreparator,
+            {"naive": NaiveBayesAlgorithm, "logreg": LogRegAlgorithm},
+            FirstServing,
+        )

@@ -1,0 +1,17 @@
+"""E-commerce recommendation template (implicit ALS + serve-time business
+rules). Parity: examples/scala-parallel-ecommercerecommendation/.
+"""
+
+from incubator_predictionio_tpu.models.ecommerce.engine import (
+    DataSourceParams,
+    ECommAlgorithmParams,
+    ECommerceEngine,
+    ItemScore,
+    PredictedResult,
+    Query,
+)
+
+__all__ = [
+    "DataSourceParams", "ECommAlgorithmParams", "ECommerceEngine",
+    "ItemScore", "PredictedResult", "Query",
+]

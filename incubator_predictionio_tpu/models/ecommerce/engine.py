@@ -1,0 +1,355 @@
+"""E-commerce recommendation engine.
+
+Reference parity (examples/scala-parallel-ecommercerecommendation/
+train-with-rate-event + adjust-score + weighted-items variants):
+
+- ``Query(user, num, categories?, whiteList?, blackList?)`` /
+  ``PredictedResult(itemScores)`` (Engine.scala:23-38).
+- DataSource reads ``view``/``buy`` (train-with-rate-event adds ``rate``)
+  user→item events plus item ``$set`` properties.
+- ECommAlgorithm trains implicit ALS; at serve time it filters
+  *unavailable items* (the ``constraint`` entity's ``unavailableItems``
+  property, re-read per query so ops can flip availability without
+  retraining — ECommAlgorithm.scala predict), seen items, black/whitelists
+  and categories.
+- Unknown users fall back to a vector built from their recent view events
+  (ECommAlgorithm.scala recentFeatures), so fresh sessions still get
+  personalized results without retraining.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    Params,
+    Preparator,
+    Serving,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.store import EventStore
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    __camel_case__ = True
+
+    user: str
+    num: int
+    categories: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    __camel_case__ = True
+
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    __camel_case__ = True
+
+    item_scores: Tuple[ItemScore, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    __camel_case__ = True
+
+    app_name: str
+    channel_name: Optional[str] = None
+    event_weights: Tuple[Tuple[str, float], ...] = (
+        ("view", 1.0), ("buy", 4.0), ("rate", 2.0),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Interaction:
+    user: str
+    item: str
+    weight: float
+
+
+@dataclasses.dataclass
+class TrainingData:
+    interactions: List[Interaction]
+    item_categories: Dict[str, Tuple[str, ...]]
+
+    def sanity_check(self) -> None:
+        if not self.interactions:
+            raise ValueError("TrainingData has no user-item interactions")
+
+
+class ECommerceDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        weights = dict(self.params.event_weights)
+        events = EventStore.find(
+            app_name=self.params.app_name,
+            channel_name=self.params.channel_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(weights),
+        )
+        interactions = [
+            Interaction(e.entity_id, e.target_entity_id, weights[e.event])
+            for e in events
+        ]
+        props = EventStore.aggregate_properties(
+            app_name=self.params.app_name,
+            channel_name=self.params.channel_name,
+            entity_type="item",
+        )
+        cats = {
+            item: tuple(str(c) for c in (pm.opt("categories", list) or ()))
+            for item, pm in props.items()
+        }
+        return TrainingData(interactions=interactions, item_categories=cats)
+
+
+@dataclasses.dataclass
+class PreparedData:
+    users: np.ndarray
+    items: np.ndarray
+    weights: np.ndarray
+    user_bimap: BiMap
+    item_bimap: BiMap
+    item_categories: Dict[str, Tuple[str, ...]]
+
+
+class ECommercePreparator(Preparator):
+    def prepare(self, ctx: RuntimeContext, td: TrainingData) -> PreparedData:
+        user_bimap = BiMap.string_int(i.user for i in td.interactions)
+        item_bimap = BiMap.string_int(i.item for i in td.interactions)
+        agg: Dict[Tuple[int, int], float] = {}
+        for i in td.interactions:
+            key = (user_bimap[i.user], item_bimap[i.item])
+            agg[key] = agg.get(key, 0.0) + i.weight
+        coo = np.array([(u, i, w) for (u, i), w in agg.items()],
+                       np.float64).reshape(-1, 3)
+        return PreparedData(
+            users=coo[:, 0].astype(np.int32),
+            items=coo[:, 1].astype(np.int32),
+            weights=coo[:, 2].astype(np.float32),
+            user_bimap=user_bimap,
+            item_bimap=item_bimap,
+            item_categories=td.item_categories,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    __camel_case__ = True
+
+    app_name: str
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+    #: events counted as "seen" and excluded from results
+    seen_events: Tuple[str, ...] = ("buy", "view")
+    unseen_only: bool = True
+    #: recent events used to build an unknown user's vector
+    similar_events: Tuple[str, ...] = ("view",)
+    num_recent_events: int = 10
+
+
+@dataclasses.dataclass
+class ECommModel:
+    user_factors: Any
+    item_factors: Any
+    user_bimap: BiMap
+    item_bimap: BiMap
+    item_categories: Dict[str, Tuple[str, ...]]
+    user_seen: Dict[int, Any]
+    #: popularity ranks (interaction counts) for the cold fallback
+    item_popularity: Any
+
+
+class ECommAlgorithm(Algorithm):
+    params_class = ECommAlgorithmParams
+    query_class_ = Query
+
+    def __init__(self, params: ECommAlgorithmParams):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, pd: PreparedData) -> ECommModel:
+        from incubator_predictionio_tpu.ops.als import als_train_implicit
+
+        seed = self.params.seed if self.params.seed is not None else ctx.seed
+        state = als_train_implicit(
+            pd.users, pd.items, pd.weights,
+            n_users=len(pd.user_bimap), n_items=len(pd.item_bimap),
+            rank=self.params.rank, iterations=self.params.num_iterations,
+            l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
+        )
+        # seen set honors params.seen_events — only those event types make an
+        # item "seen" (a viewed-but-not-bought item stays recommendable when
+        # seen_events=("buy",)), so re-read the raw events by name
+        user_seen: Dict[int, Any] = {}
+        seen_raw = EventStore.find(
+            app_name=self.params.app_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(self.params.seen_events),
+        )
+        for e in seen_raw:
+            u = pd.user_bimap.get(e.entity_id)
+            i = pd.item_bimap.get(e.target_entity_id)
+            if u is not None and i is not None:
+                user_seen.setdefault(u, []).append(i)
+        user_seen = {
+            u: np.asarray(sorted(set(ids)), np.int32)
+            for u, ids in user_seen.items()
+        }
+        popularity = np.zeros(len(pd.item_bimap), np.float32)
+        for i, w in zip(pd.items.tolist(), pd.weights.tolist()):
+            popularity[i] += w
+        return ECommModel(
+            user_factors=state.user_factors,
+            item_factors=state.item_factors,
+            user_bimap=pd.user_bimap,
+            item_bimap=pd.item_bimap,
+            item_categories=pd.item_categories,
+            user_seen=user_seen,
+            item_popularity=popularity,
+        )
+
+    def prepare_model(self, ctx, model: ECommModel) -> ECommModel:
+        import jax
+
+        return dataclasses.replace(
+            model,
+            user_factors=jax.device_put(np.asarray(model.user_factors)),
+            item_factors=jax.device_put(np.asarray(model.item_factors)),
+        )
+
+    # -- serve-time constraints --------------------------------------------
+    def _unavailable_items(self, model: ECommModel) -> List[int]:
+        """Re-read the constraint entity per query (ECommAlgorithm.scala:
+        the ops team $sets constraint/unavailableItems without retraining)."""
+        try:
+            props = EventStore.aggregate_properties(
+                app_name=self.params.app_name, entity_type="constraint",
+            )
+        except Exception:
+            return []
+        pm = props.get("unavailableItems")
+        if pm is None:
+            return []
+        names = pm.opt("items", list) or []
+        return [
+            model.item_bimap[n] for n in names if n in model.item_bimap
+        ]
+
+    def _recent_items(self, model: ECommModel, user: str) -> List[int]:
+        try:
+            events = EventStore.find_by_entity(
+                app_name=self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.similar_events),
+                limit=self.params.num_recent_events,
+                latest=True,
+            )
+        except Exception:
+            return []
+        out = []
+        for e in events:
+            if e.target_entity_id and e.target_entity_id in model.item_bimap:
+                out.append(model.item_bimap[e.target_entity_id])
+        return out
+
+    def _allowed_mask(self, model: ECommModel, query: Query,
+                      user_idx: Optional[int]) -> np.ndarray:
+        n = len(model.item_bimap)
+        mask = np.ones(n, bool)
+        for idx in self._unavailable_items(model):
+            mask[idx] = False
+        if query.categories:
+            wanted = set(query.categories)
+            for item, idx in model.item_bimap.items():
+                if not wanted.intersection(model.item_categories.get(item, ())):
+                    mask[idx] = False
+        if query.white_list:
+            allowed = {
+                model.item_bimap[i] for i in query.white_list
+                if i in model.item_bimap
+            }
+            for idx in range(n):
+                if idx not in allowed:
+                    mask[idx] = False
+        if query.black_list:
+            for item in query.black_list:
+                idx = model.item_bimap.get(item)
+                if idx is not None:
+                    mask[idx] = False
+        if self.params.unseen_only and user_idx is not None:
+            seen = model.user_seen.get(user_idx)
+            if seen is not None and len(seen):
+                mask[np.asarray(seen)] = False
+        return mask
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.topk import top_k_with_exclusions
+
+        user_idx = model.user_bimap.get(query.user)
+        factors = jnp.asarray(model.item_factors)
+        if user_idx is not None:
+            user_vec = jnp.asarray(model.user_factors)[user_idx]
+            scores = factors @ user_vec
+        else:
+            recent = self._recent_items(model, query.user)
+            if recent:
+                user_vec = factors[jnp.asarray(recent, jnp.int32)].mean(axis=0)
+                scores = factors @ user_vec
+            else:
+                # cold user with no history → popularity ranking
+                scores = jnp.asarray(model.item_popularity)
+        mask = self._allowed_mask(model, query, user_idx)
+        top_s, top_i = top_k_with_exclusions(
+            scores, k=min(query.num, len(model.item_bimap)),
+            allowed_mask=jnp.asarray(mask),
+        )
+        inv = model.item_bimap.inverse
+        out = []
+        for s, i in zip(np.asarray(top_s), np.asarray(top_i)):
+            if s <= -1e37:
+                continue
+            out.append(ItemScore(item=inv[int(i)], score=float(s)))
+        return PredictedResult(item_scores=tuple(out))
+
+
+class FirstServing(Serving):
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        return predictions[0]
+
+
+class ECommerceEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            ECommerceDataSource,
+            ECommercePreparator,
+            {"ecomm": ECommAlgorithm},
+            FirstServing,
+        )

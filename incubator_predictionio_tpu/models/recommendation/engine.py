@@ -93,6 +93,8 @@ class Rating:
 
 @dataclasses.dataclass(frozen=True)
 class DataSourceParams(Params):
+    __camel_case__ = True  # engine.json parity: appName, eventWindow...
+
     app_name: str
     channel_name: Optional[str] = None
     buy_rating: float = 4.0  # implicit weight of a "buy" event
@@ -257,6 +259,8 @@ class RecommendationPreparator(Preparator):
 
 @dataclasses.dataclass(frozen=True)
 class ALSAlgorithmParams(Params):
+    __camel_case__ = True  # engine.json parity: numIterations, lambda
+
     rank: int = 10
     num_iterations: int = 20
     lambda_: float = 0.01

@@ -1,0 +1,19 @@
+"""Similar-product template (implicit-feedback ALS, item-to-item queries).
+
+Parity: examples/scala-parallel-similarproduct/ (multi variant capabilities:
+view + like events, category/white/blacklist filters).
+"""
+
+from incubator_predictionio_tpu.models.similarproduct.engine import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Query,
+    SimilarProductEngine,
+)
+
+__all__ = [
+    "ALSAlgorithmParams", "DataSourceParams", "ItemScore", "PredictedResult",
+    "Query", "SimilarProductEngine",
+]

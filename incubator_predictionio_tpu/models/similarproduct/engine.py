@@ -1,0 +1,281 @@
+"""Similar-product engine: implicit ALS factors, item-to-item cosine ranking.
+
+Reference parity (examples/scala-parallel-similarproduct/multi/):
+
+- ``Query(items, num, categories?, whiteList?, blackList?)`` /
+  ``PredictedResult(itemScores)`` (Engine.scala:23-38).
+- DataSource reads ``view`` (and the multi variant's ``like``/``dislike``)
+  events user→item plus ``$set`` item properties with categories
+  (DataSource.scala).
+- ALSAlgorithm trains ``ALS.trainImplicit`` on view counts
+  (ALSAlgorithm.scala:147) — here ops.als_train_implicit; similarity is
+  cosine between item factors, query = average of the query items' vectors
+  (ALSAlgorithm.scala predict), ranked on-device, query items excluded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    Params,
+    Preparator,
+    Serving,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.store import EventStore
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    __camel_case__ = True
+
+    items: Tuple[str, ...]
+    num: int
+    categories: Optional[Tuple[str, ...]] = None
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    __camel_case__ = True
+
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    __camel_case__ = True
+
+    item_scores: Tuple[ItemScore, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViewEvent:
+    user: str
+    item: str
+    weight: float
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    __camel_case__ = True
+
+    app_name: str
+    channel_name: Optional[str] = None
+    #: event name -> implicit weight (the multi variant weighs likes > views)
+    event_weights: Tuple[Tuple[str, float], ...] = (("view", 1.0), ("like", 3.0))
+
+
+@dataclasses.dataclass
+class TrainingData:
+    views: List[ViewEvent]
+    item_categories: Dict[str, Tuple[str, ...]]
+
+    def sanity_check(self) -> None:
+        if not self.views:
+            raise ValueError("TrainingData has no view events")
+
+
+class SimilarProductDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        weights = dict(self.params.event_weights)
+        events = EventStore.find(
+            app_name=self.params.app_name,
+            channel_name=self.params.channel_name,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(weights),
+        )
+        views = [
+            ViewEvent(e.entity_id, e.target_entity_id, weights[e.event])
+            for e in events
+        ]
+        props = EventStore.aggregate_properties(
+            app_name=self.params.app_name,
+            channel_name=self.params.channel_name,
+            entity_type="item",
+        )
+        cats = {
+            item: tuple(str(c) for c in (pm.opt("categories", list) or ()))
+            for item, pm in props.items()
+        }
+        return TrainingData(views=views, item_categories=cats)
+
+
+@dataclasses.dataclass
+class PreparedData:
+    users: np.ndarray
+    items: np.ndarray
+    weights: np.ndarray
+    user_bimap: BiMap
+    item_bimap: BiMap
+    item_categories: Dict[str, Tuple[str, ...]]
+
+
+class SimilarProductPreparator(Preparator):
+    def prepare(self, ctx: RuntimeContext, td: TrainingData) -> PreparedData:
+        user_bimap = BiMap.string_int(v.user for v in td.views)
+        item_bimap = BiMap.string_int(v.item for v in td.views)
+        # sum repeated (user, item) weights — repeated views add confidence
+        agg: Dict[Tuple[int, int], float] = {}
+        for v in td.views:
+            key = (user_bimap[v.user], item_bimap[v.item])
+            agg[key] = agg.get(key, 0.0) + v.weight
+        coo = np.array([(u, i, w) for (u, i), w in agg.items()],
+                       np.float64).reshape(-1, 3)
+        return PreparedData(
+            users=coo[:, 0].astype(np.int32),
+            items=coo[:, 1].astype(np.int32),
+            weights=coo[:, 2].astype(np.float32),
+            user_bimap=user_bimap,
+            item_bimap=item_bimap,
+            item_categories=td.item_categories,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    __camel_case__ = True
+
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class SimilarProductModel:
+    #: unit-normalized item factors [I, K] — cosine becomes a dot product
+    item_factors_norm: Any
+    item_bimap: BiMap
+    item_categories: Dict[str, Tuple[str, ...]]
+
+
+class SimilarProductAlgorithm(Algorithm):
+    params_class = ALSAlgorithmParams
+    query_class_ = Query
+
+    def __init__(self, params: ALSAlgorithmParams = ALSAlgorithmParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, pd: PreparedData) -> SimilarProductModel:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.als import als_train_implicit
+
+        seed = self.params.seed if self.params.seed is not None else ctx.seed
+        state = als_train_implicit(
+            pd.users, pd.items, pd.weights,
+            n_users=len(pd.user_bimap), n_items=len(pd.item_bimap),
+            rank=self.params.rank, iterations=self.params.num_iterations,
+            l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
+        )
+        factors = state.item_factors
+        norm = jnp.linalg.norm(factors, axis=1, keepdims=True)
+        factors_norm = factors / jnp.maximum(norm, 1e-9)
+        return SimilarProductModel(
+            item_factors_norm=factors_norm,
+            item_bimap=pd.item_bimap,
+            item_categories=pd.item_categories,
+        )
+
+    def prepare_model(self, ctx, model: SimilarProductModel) -> SimilarProductModel:
+        import jax
+
+        return dataclasses.replace(
+            model,
+            item_factors_norm=jax.device_put(
+                np.asarray(model.item_factors_norm)
+            ),
+        )
+
+    def _allowed_mask(self, model: SimilarProductModel,
+                      query: Query) -> np.ndarray:
+        # always materialized: the query items themselves are always excluded
+        # (ALSAlgorithm.scala), so there is no "no filter" case
+        n = len(model.item_bimap)
+        mask = np.ones(n, bool)
+        if query.categories:
+            wanted = set(query.categories)
+            for item, idx in model.item_bimap.items():
+                if not wanted.intersection(model.item_categories.get(item, ())):
+                    mask[idx] = False
+        if query.white_list:
+            allowed = {
+                model.item_bimap[i] for i in query.white_list
+                if i in model.item_bimap
+            }
+            for idx in range(n):
+                if idx not in allowed:
+                    mask[idx] = False
+        if query.black_list:
+            for item in query.black_list:
+                idx = model.item_bimap.get(item)
+                if idx is not None:
+                    mask[idx] = False
+        for item in query.items:
+            idx = model.item_bimap.get(item)
+            if idx is not None:
+                mask[idx] = False
+        return mask
+
+    def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.topk import top_k_with_exclusions
+
+        indices = [
+            model.item_bimap[i] for i in query.items if i in model.item_bimap
+        ]
+        if not indices:
+            return PredictedResult(item_scores=())
+        factors = jnp.asarray(model.item_factors_norm)
+        query_vec = factors[jnp.asarray(indices, jnp.int32)].mean(axis=0)
+        qnorm = jnp.linalg.norm(query_vec)
+        query_vec = query_vec / jnp.maximum(qnorm, 1e-9)
+        scores = factors @ query_vec  # cosine (factors pre-normalized)
+        mask = self._allowed_mask(model, query)
+        top_s, top_i = top_k_with_exclusions(
+            scores, k=min(query.num, len(model.item_bimap)),
+            allowed_mask=jnp.asarray(mask),
+        )
+        inv = model.item_bimap.inverse
+        out = []
+        for s, i in zip(np.asarray(top_s), np.asarray(top_i)):
+            if s <= -1e37:
+                continue
+            out.append(ItemScore(item=inv[int(i)], score=float(s)))
+        return PredictedResult(item_scores=tuple(out))
+
+
+class FirstServing(Serving):
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        return predictions[0]
+
+
+class SimilarProductEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            SimilarProductDataSource,
+            SimilarProductPreparator,
+            {"als": SimilarProductAlgorithm},
+            FirstServing,
+        )

@@ -186,6 +186,131 @@ def als_sweep(
     return ALSState(user_factors=new_users, item_factors=new_items)
 
 
+# ---------------------------------------------------------------------------
+# Implicit-feedback ALS (Hu-Koren-Volinsky), the MLlib ALS.trainImplicit
+# replacement used by the similarproduct/ecommerce templates
+# (examples/scala-parallel-similarproduct/multi/src/main/scala/
+# ALSAlgorithm.scala:147).
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit, static_argnames=("precision",)
+)
+def _solve_bucket_implicit(
+    other_factors: jax.Array,  # [M, K]
+    yty: jax.Array,            # [K, K] — Gram of ALL other-side factors
+    cols: jax.Array,           # [B, D]
+    vals: jax.Array,           # [B, D] raw confidence weights r
+    mask: jax.Array,           # [B, D]
+    l2: float,
+    alpha: float,
+    precision: Any = jax.lax.Precision.HIGHEST,
+) -> jax.Array:
+    """Per-row system: (YᵗY + Yᵤᵗ(Cᵤ−I)Yᵤ + λI) x = Yᵤᵗ cᵤ with
+    c = 1 + α·r and binary preference — YᵗY is shared across the whole
+    batch (the classic implicit-ALS trick), so per-row work stays
+    proportional to the row's observations."""
+    rank = other_factors.shape[1]
+    gathered = other_factors[cols]                        # [B, D, K]
+    masked = gathered * mask[..., None]
+    conf_minus1 = alpha * vals * mask                     # (c-1), 0 on padding
+    gram = jnp.einsum(
+        "bd,bdk,bdl->bkl", conf_minus1, masked, gathered,
+        preferred_element_type=jnp.float32, precision=precision,
+    )
+    rhs = jnp.einsum(
+        "bd,bdk->bk", (1.0 + conf_minus1) * mask, masked,
+        preferred_element_type=jnp.float32, precision=precision,
+    )
+    nnz = mask.sum(axis=-1)
+    a = yty[None] + gram + l2 * jnp.eye(rank, dtype=jnp.float32)
+    chol = jax.scipy.linalg.cho_factor(a)
+    sol = jax.scipy.linalg.cho_solve(chol, rhs[..., None])[..., 0]
+    return jnp.where(nnz[:, None] > 0, sol, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def _gram_all(factors: jax.Array, precision: Any) -> jax.Array:
+    return jnp.einsum(
+        "ik,il->kl", factors, factors,
+        preferred_element_type=jnp.float32, precision=precision,
+    )
+
+
+def _update_side_implicit(
+    n_rows: int,
+    other_factors: jax.Array,
+    buckets: Sequence[PaddedRows],
+    l2: float,
+    alpha: float,
+    precision: Any,
+) -> jax.Array:
+    rank = other_factors.shape[1]
+    yty = _gram_all(other_factors, precision)
+    out = jnp.zeros((n_rows, rank), jnp.float32)
+    for bucket in buckets:
+        sol = _solve_bucket_implicit(
+            other_factors, yty,
+            jnp.asarray(bucket.cols), jnp.asarray(bucket.vals),
+            jnp.asarray(bucket.mask), l2, alpha, precision=precision,
+        )
+        out = _scatter_rows(out, jnp.asarray(bucket.row_ids), sol)
+    return out
+
+
+def als_sweep_implicit(
+    state: ALSState,
+    user_buckets: Sequence[PaddedRows],
+    item_buckets: Sequence[PaddedRows],
+    l2: float = 0.1,
+    alpha: float = 1.0,
+    precision: Any = jax.lax.Precision.HIGHEST,
+    validate: bool = True,
+) -> ALSState:
+    if validate:
+        assert_no_split(user_buckets, "user")
+        assert_no_split(item_buckets, "item")
+    new_users = _update_side_implicit(
+        state.user_factors.shape[0], state.item_factors, user_buckets,
+        l2, alpha, precision,
+    )
+    new_items = _update_side_implicit(
+        state.item_factors.shape[0], new_users, item_buckets,
+        l2, alpha, precision,
+    )
+    return ALSState(user_factors=new_users, item_factors=new_items)
+
+
+def als_train_implicit(
+    users: np.ndarray,
+    items: np.ndarray,
+    weights: np.ndarray,
+    n_users: int,
+    n_items: int,
+    rank: int = 64,
+    iterations: int = 10,
+    l2: float = 0.1,
+    alpha: float = 1.0,
+    seed: int = 0,
+    precision: Any = jax.lax.Precision.HIGHEST,
+    max_width: int = 1 << 16,
+) -> ALSState:
+    """Implicit-feedback training over (user, item, weight) observations."""
+    user_buckets = build_padded_rows(users, items, weights, n_users,
+                                     max_width=max_width)
+    item_buckets = build_padded_rows(items, users, weights, n_items,
+                                     max_width=max_width)
+    assert_no_split(user_buckets, "user")
+    assert_no_split(item_buckets, "item")
+    state = als_init(jax.random.key(seed), n_users, n_items, rank)
+    for _ in range(iterations):
+        state = als_sweep_implicit(
+            state, user_buckets, item_buckets, l2, alpha,
+            precision=precision, validate=False,
+        )
+    return state
+
+
 @jax.jit
 def _predict_coo(
     user_factors: jax.Array, item_factors: jax.Array,

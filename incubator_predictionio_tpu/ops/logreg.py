@@ -1,0 +1,77 @@
+"""Multinomial logistic regression via optax — the second classification
+algorithm (the reference's add-algorithm template pairs NaiveBayes with a
+second MLlib model, examples/scala-parallel-classification/add-algorithm/;
+BASELINE.json designates optax LogReg as the TPU-native counterpart).
+
+The whole optimization loop runs inside one jit via ``lax.scan`` —
+no per-step Python dispatch, full-batch gradients on the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LogRegModel:
+    weights: Any   # [D, C]
+    bias: Any      # [C]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_classes", "steps", "learning_rate", "l2")
+)
+def logreg_fit(
+    features: jax.Array,    # [N, D] f32
+    labels: jax.Array,      # [N] int32
+    n_classes: int,
+    steps: int = 300,
+    learning_rate: float = 0.1,
+    l2: float = 1e-4,
+) -> LogRegModel:
+    d = features.shape[1]
+    params = LogRegModel(
+        weights=jnp.zeros((d, n_classes), jnp.float32),
+        bias=jnp.zeros((n_classes,), jnp.float32),
+    )
+    tx = optax.adam(learning_rate)
+    opt_state = tx.init(params)
+
+    def loss_fn(p: LogRegModel) -> jax.Array:
+        logits = features @ p.weights + p.bias
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        return ce.mean() + l2 * jnp.sum(p.weights ** 2)
+
+    def step(carry, _):
+        p, s = carry
+        grads = jax.grad(loss_fn)(p)
+        updates, s = tx.update(grads, s, p)
+        return (optax.apply_updates(p, updates), s), None
+
+    (params, _), _ = jax.lax.scan(step, (params, opt_state), None, length=steps)
+    return params
+
+
+@jax.jit
+def logreg_predict(model: LogRegModel, features: jax.Array) -> jax.Array:
+    return jnp.argmax(features @ model.weights + model.bias, axis=-1)
+
+
+@jax.jit
+def logreg_proba(model: LogRegModel, features: jax.Array) -> jax.Array:
+    return jax.nn.softmax(features @ model.weights + model.bias, axis=-1)
+
+
+def logreg_accuracy(model: LogRegModel, features: np.ndarray,
+                    labels: np.ndarray) -> float:
+    pred = np.asarray(logreg_predict(model, jnp.asarray(features)))
+    return float((pred == np.asarray(labels)).mean())

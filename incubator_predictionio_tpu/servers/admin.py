@@ -1,0 +1,111 @@
+"""AdminAPI — REST admin mirroring the CLI app commands.
+
+Parity: tools/.../admin/AdminAPI.scala:38-160 + CommandClient.scala on
+:7071 — ``GET /`` status, ``GET /cmd/app`` list, ``POST /cmd/app`` create
+(generates a default access key like the CLI), ``DELETE /cmd/app/{name}``,
+``DELETE /cmd/app/{name}/data``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.utils.http import (
+    HttpServer,
+    Request,
+    Response,
+    Router,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class AdminServer:
+    def __init__(self, ip: str = "127.0.0.1", port: int = 7071):
+        self.apps = Storage.get_meta_data_apps()
+        self.access_keys = Storage.get_meta_data_access_keys()
+        self.channels = Storage.get_meta_data_channels()
+        self.events = Storage.get_events()
+        self.http = HttpServer(self._build_router(), ip, port)
+
+    def _build_router(self) -> Router:
+        r = Router()
+
+        @r.get("/")
+        def index(request: Request) -> Response:
+            return Response(200, {
+                "status": "alive",
+                "description": "PredictionIO-TPU Admin API",
+            })
+
+        @r.get("/cmd/app")
+        def list_apps(request: Request) -> Response:
+            out = []
+            for app in self.apps.get_all():
+                keys = self.access_keys.get_by_appid(app.id)
+                out.append({
+                    "name": app.name, "id": app.id,
+                    "description": app.description,
+                    "accessKeys": [k.key for k in keys],
+                })
+            return Response(200, out)
+
+        @r.post("/cmd/app")
+        def new_app(request: Request) -> Response:
+            try:
+                body = request.json()
+            except ValueError as e:
+                return Response(400, {"message": str(e)})
+            name = body.get("name")
+            if not name:
+                return Response(400, {"message": "app name is required"})
+            if self.apps.get_by_name(name) is not None:
+                return Response(400, {
+                    "message": f"App {name} already exists. Aborting."
+                })
+            app_id = self.apps.insert(App(
+                int(body.get("id", 0)), name, body.get("description")
+            ))
+            if app_id is None:
+                return Response(400, {"message": f"Unable to create app {name}."})
+            key = self.access_keys.insert(AccessKey("", app_id, ()))
+            self.events.init(app_id)
+            return Response(200, {
+                "name": name, "id": app_id, "accessKey": key,
+            })
+
+        @r.delete("/cmd/app/{name}")
+        def delete_app(request: Request) -> Response:
+            app = self.apps.get_by_name(request.path_params["name"])
+            if app is None:
+                return Response(404, {"message": "App not found."})
+            for channel in self.channels.get_by_appid(app.id):
+                self.events.remove(app.id, channel.id)
+                self.channels.delete(channel.id)
+            self.events.remove(app.id)
+            for key in self.access_keys.get_by_appid(app.id):
+                self.access_keys.delete(key.key)
+            self.apps.delete(app.id)
+            return Response(200, {"message": f"App {app.name} deleted."})
+
+        @r.delete("/cmd/app/{name}/data")
+        def delete_app_data(request: Request) -> Response:
+            app = self.apps.get_by_name(request.path_params["name"])
+            if app is None:
+                return Response(404, {"message": "App not found."})
+            self.events.remove(app.id)
+            self.events.init(app.id)
+            return Response(200, {"message": f"App {app.name} data deleted."})
+
+        return r
+
+    def start_background(self) -> int:
+        return self.http.start_background()
+
+    async def serve_forever(self) -> None:
+        await self.http.serve_forever()
+
+    def stop(self) -> None:
+        self.http.stop()

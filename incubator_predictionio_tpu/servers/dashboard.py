@@ -1,0 +1,84 @@
+"""Dashboard — evaluation results UI.
+
+Parity: tools/.../dashboard/Dashboard.scala:46-162 on :9000 — lists
+completed EvaluationInstances newest-first with links to each instance's
+stored HTML results (the reference renders the same data through Twirl).
+"""
+
+from __future__ import annotations
+
+import html
+import logging
+
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.utils.http import (
+    HttpServer,
+    Request,
+    Response,
+    Router,
+)
+from incubator_predictionio_tpu.utils.times import format_iso8601
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardServer:
+    def __init__(self, ip: str = "127.0.0.1", port: int = 9000):
+        self.evaluation_instances = Storage.get_meta_data_evaluation_instances()
+        self.http = HttpServer(self._build_router(), ip, port)
+
+    def _build_router(self) -> Router:
+        r = Router()
+
+        @r.get("/")
+        def index(request: Request) -> Response:
+            rows = []
+            for i in self.evaluation_instances.get_completed():
+                rows.append(
+                    "<tr>"
+                    f"<td><a href='/engine_instances/{i.id}'>{i.id}</a></td>"
+                    f"<td>{html.escape(i.evaluation_class)}</td>"
+                    f"<td>{html.escape(i.engine_params_generator_class)}</td>"
+                    f"<td>{format_iso8601(i.start_time)}</td>"
+                    f"<td>{format_iso8601(i.end_time)}</td>"
+                    f"<td>{html.escape(i.evaluator_results)}</td>"
+                    "</tr>"
+                )
+            body = (
+                "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
+                "<body><h1>Completed Evaluations</h1>"
+                "<table border=1><tr><th>ID</th><th>Evaluation</th>"
+                "<th>Params Generator</th><th>Start</th><th>End</th>"
+                f"<th>Result</th></tr>{''.join(rows)}</table></body></html>"
+            )
+            return Response(200, body=body.encode(),
+                            content_type="text/html; charset=UTF-8")
+
+        @r.get("/engine_instances/{instance_id}")
+        def detail(request: Request) -> Response:
+            i = self.evaluation_instances.get(request.path_params["instance_id"])
+            if i is None or not i.evaluator_results_html:
+                return Response(404, {"message": "Not Found"})
+            return Response(200, body=i.evaluator_results_html.encode(),
+                            content_type="text/html; charset=UTF-8")
+
+        @r.get("/engine_instances/{instance_id}/evaluator_results.json")
+        def detail_json(request: Request) -> Response:
+            i = self.evaluation_instances.get(request.path_params["instance_id"])
+            if i is None:
+                return Response(404, {"message": "Not Found"})
+            return Response(
+                200,
+                body=(i.evaluator_results_json or "{}").encode(),
+            )
+
+        return r
+
+    def start_background(self) -> int:
+        return self.http.start_background()
+
+    async def serve_forever(self) -> None:
+        await self.http.serve_forever()
+
+    def stop(self) -> None:
+        self.http.stop()

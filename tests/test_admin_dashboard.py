@@ -1,0 +1,89 @@
+"""AdminAPI + Dashboard servers (parity: tools AdminAPISpec + Dashboard)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fake_engine import AP, QxMetric, make_engine, params
+from incubator_predictionio_tpu.core.evaluation import Evaluation
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.servers.admin import AdminServer
+from incubator_predictionio_tpu.servers.dashboard import DashboardServer
+from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+
+@pytest.fixture(autouse=True)
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            ct = resp.headers.get("Content-Type", "")
+            raw = resp.read()
+            return resp.status, (json.loads(raw) if "json" in ct else raw)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def test_admin_api_app_crud():
+    srv = AdminServer(ip="127.0.0.1", port=0)
+    port = srv.start_background()
+    try:
+        assert call(port, "GET", "/")[1]["status"] == "alive"
+        status, body = call(port, "POST", "/cmd/app", {"name": "AdminApp"})
+        assert status == 200 and body["accessKey"]
+        status, body = call(port, "POST", "/cmd/app", {"name": "AdminApp"})
+        assert status == 400 and "already exists" in body["message"]
+        assert call(port, "POST", "/cmd/app", {})[0] == 400
+        status, apps = call(port, "GET", "/cmd/app")
+        assert [a["name"] for a in apps] == ["AdminApp"]
+        assert call(port, "DELETE", "/cmd/app/AdminApp/data")[0] == 200
+        assert call(port, "DELETE", "/cmd/app/AdminApp")[0] == 200
+        assert call(port, "DELETE", "/cmd/app/AdminApp")[0] == 404
+    finally:
+        srv.stop()
+
+
+def test_dashboard_lists_evaluations():
+    evaluation = Evaluation()
+    evaluation.engine_metric = (make_engine(), QxMetric())
+    iid, _ = CoreWorkflow.run_evaluation(
+        evaluation, [params(algos=[("algo0", AP(2))])],
+        evaluation_class="tests.Eval",
+    )
+    srv = DashboardServer(ip="127.0.0.1", port=0)
+    port = srv.start_background()
+    try:
+        status, body = call(port, "GET", "/")
+        assert status == 200
+        html = body.decode()
+        assert iid in html and "tests.Eval" in html
+        status, detail = call(port, "GET", f"/engine_instances/{iid}")
+        assert status == 200 and b"<table" in detail
+        status, js = call(
+            port, "GET", f"/engine_instances/{iid}/evaluator_results.json"
+        )
+        assert status == 200
+        assert call(port, "GET", "/engine_instances/nope")[0] == 404
+    finally:
+        srv.stop()

@@ -1,0 +1,170 @@
+"""CLI verb coverage (parity: tools/.../console/Console.scala matrix +
+the integration suite's BasicAppUsecases)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.cli.main import main
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import Storage
+
+
+@pytest.fixture(autouse=True)
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+def test_version_and_help():
+    assert main(["version"]) == 0
+    assert main([]) == 1
+
+
+def test_status():
+    assert main(["status"]) == 0
+
+
+def test_app_lifecycle(capsys):
+    assert main(["app", "new", "CliApp", "--description", "d"]) == 0
+    out = capsys.readouterr().out
+    assert "Access Key:" in out
+    # duplicate fails
+    assert main(["app", "new", "CliApp"]) == 1
+    assert main(["app", "list"]) == 0
+    assert "CliApp" in capsys.readouterr().out
+    assert main(["app", "show", "CliApp"]) == 0
+    # channels
+    assert main(["app", "channel-new", "CliApp", "chan-a"]) == 0
+    assert main(["app", "channel-new", "CliApp", "chan-a"]) == 1  # dup
+    assert main(["app", "channel-new", "CliApp", "bad name!"]) == 1
+    assert main(["app", "channel-delete", "CliApp", "chan-a", "-f"]) == 0
+    assert main(["app", "channel-delete", "CliApp", "ghost", "-f"]) == 1
+    # data + delete
+    assert main(["app", "data-delete", "CliApp", "-f"]) == 0
+    assert main(["app", "delete", "CliApp", "-f"]) == 0
+    assert main(["app", "show", "CliApp"]) == 1
+
+
+def test_accesskey_lifecycle(capsys):
+    main(["app", "new", "KeyApp"])
+    capsys.readouterr()
+    assert main(["accesskey", "new", "KeyApp", "--key", "my-key",
+                 "--events", "rate", "buy"]) == 0
+    assert main(["accesskey", "list", "KeyApp"]) == 0
+    out = capsys.readouterr().out
+    assert "my-key" in out and "rate, buy" in out
+    assert main(["accesskey", "delete", "my-key"]) == 0
+    assert main(["accesskey", "delete", "my-key"]) == 1
+    assert main(["accesskey", "new", "GhostApp"]) == 1
+
+
+def test_import_export_round_trip(tmp_path, capsys):
+    main(["app", "new", "IOApp"])
+    src = tmp_path / "events.jsonl"
+    events = [
+        {"event": "rate", "entityType": "user", "entityId": f"u{i}",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": i}, "eventTime": "2020-01-01T00:00:00.000Z"}
+        for i in range(5)
+    ]
+    src.write_text("\n".join(json.dumps(e) for e in events))
+    assert main(["import", "--appid-or-name", "IOApp",
+                 "--input", str(src)]) == 0
+    dst = tmp_path / "out.jsonl"
+    assert main(["export", "--appid-or-name", "IOApp",
+                 "--output", str(dst)]) == 0
+    lines = [json.loads(l) for l in dst.read_text().splitlines()]
+    assert len(lines) == 5
+    assert {l["entityId"] for l in lines} == {f"u{i}" for i in range(5)}
+    # malformed line fails loudly with position
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"entityType": "user"}\n')
+    assert main(["import", "--appid-or-name", "IOApp",
+                 "--input", str(bad)]) == 1
+
+
+def _seed_quickstart_events(app_name):
+    from incubator_predictionio_tpu.data.store import EventStore
+
+    rng = np.random.default_rng(0)
+    events = []
+    for u in range(30):
+        for i in rng.choice(20, size=8, replace=False):
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))}),
+            ))
+    EventStore.write(events, app_name=app_name)
+
+
+def test_build_train_from_engine_json(tmp_path, monkeypatch, capsys):
+    main(["app", "new", "MyApp1"])
+    _seed_quickstart_events("MyApp1")
+    variant = {
+        "id": "cli-test",
+        "engineFactory":
+            "incubator_predictionio_tpu.models.recommendation:RecommendationEngine",
+        "datasource": {"params": {"appName": "MyApp1"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 8, "numIterations": 5, "lambda": 0.05, "seed": 1,
+        }}],
+    }
+    (tmp_path / "engine.json").write_text(json.dumps(variant))
+    monkeypatch.chdir(tmp_path)
+    assert main(["build"]) == 0
+    assert main(["train"]) == 0
+    out = capsys.readouterr().out
+    assert "Engine instance ID:" in out
+    latest = Storage.get_meta_data_engine_instances().get_latest_completed(
+        "cli-test", "NOT_VERSIONED", "cli-test"
+    )
+    assert latest is not None
+    assert latest.status == "COMPLETED"
+    # camelCase params round-trip through the stored instance
+    assert '"numIterations": 5' in latest.algorithms_params
+    from incubator_predictionio_tpu.cli import commands as cli_commands
+    engine, _ = cli_commands.engine_from_variant(variant)
+    restored = engine.engine_params_from_instance(latest)
+    assert restored.algorithm_params_list[0][1].num_iterations == 5
+    assert restored.algorithm_params_list[0][1].lambda_ == 0.05
+
+
+def test_train_missing_engine_json(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    assert main(["train"]) == 1
+    assert main(["build"]) == 1
+
+
+def test_eval_via_cli(tmp_path, monkeypatch, capsys):
+    main(["app", "new", "MyApp1"])
+    _seed_quickstart_events("MyApp1")
+    repo_examples = os.path.join(os.path.dirname(__file__), "..", "examples",
+                                 "recommendation-quickstart")
+    monkeypatch.chdir(repo_examples)
+    monkeypatch.setattr("sys.path", ["."] + __import__("sys").path)
+    assert main(["eval", "evaluation:evaluation",
+                 "evaluation:engine_params_generator",
+                 "--output-best", str(tmp_path / "best.json")]) == 0
+    out = capsys.readouterr().out
+    assert "Evaluation completed" in out
+    assert (tmp_path / "best.json").exists()
+    best = json.loads((tmp_path / "best.json").read_text())
+    assert best["algorithmParamsList"][0]["name"] == "als"
+
+
+def test_undeploy_nothing_running():
+    assert main(["undeploy", "--port", "59999"]) == 1

@@ -1,0 +1,138 @@
+"""Implicit ALS, NaiveBayes, LogReg ops + the e2 library."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from incubator_predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    LabeledPoint,
+    MarkovChain,
+    split_data,
+)
+from incubator_predictionio_tpu.ops.als import als_train_implicit
+from incubator_predictionio_tpu.ops.logreg import (
+    logreg_accuracy,
+    logreg_fit,
+    logreg_predict,
+)
+from incubator_predictionio_tpu.ops.nb import nb_accuracy, nb_fit, nb_predict
+
+
+def test_implicit_als_separates_blocks():
+    # two user/item blocks with implicit view counts
+    rng = np.random.default_rng(0)
+    users, items, weights = [], [], []
+    for u in range(20):
+        block = u % 2
+        for i in range(10):
+            if rng.random() < 0.6:
+                users.append(u)
+                items.append(block * 10 + i)
+                weights.append(float(rng.integers(1, 5)))
+    state = als_train_implicit(
+        np.array(users), np.array(items), np.array(weights, np.float32),
+        n_users=20, n_items=20, rank=8, iterations=8, l2=0.1, alpha=2.0,
+    )
+    uf = np.asarray(state.user_factors)
+    itf = np.asarray(state.item_factors)
+    # user 0 (block 0) scores block-0 items higher than block-1 items
+    scores = itf @ uf[0]
+    assert scores[:10].mean() > scores[10:].mean() + 0.1
+    scores1 = itf @ uf[1]
+    assert scores1[10:].mean() > scores1[:10].mean() + 0.1
+
+
+def test_nb_fit_predict():
+    rng = np.random.default_rng(1)
+    # class 0 concentrates on features 0-1; class 1 on features 2-3
+    n = 200
+    labels = rng.integers(0, 2, n)
+    feats = np.zeros((n, 4), np.float32)
+    for i, y in enumerate(labels):
+        base = 0 if y == 0 else 2
+        feats[i, base] = rng.integers(3, 8)
+        feats[i, base + 1] = rng.integers(1, 5)
+        feats[i, rng.integers(0, 4)] += 1  # noise
+    model = nb_fit(jnp.asarray(feats), jnp.asarray(labels, jnp.int32), 2)
+    assert nb_accuracy(model, feats, labels) > 0.95
+    single = nb_predict(model, jnp.asarray(feats[:1]))
+    assert int(single[0]) == labels[0]
+
+
+def test_logreg_fit_predict():
+    rng = np.random.default_rng(2)
+    n = 300
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    w_true = np.array([[2.0, -1.0], [-2.0, 1.5], [0.5, 0.5]], np.float32)
+    logits = x @ w_true
+    y = logits.argmax(axis=1)
+    model = logreg_fit(jnp.asarray(x), jnp.asarray(y, jnp.int32),
+                       n_classes=2, steps=200)
+    assert logreg_accuracy(model, x, y) > 0.95
+
+
+def test_categorical_naive_bayes():
+    points = [
+        LabeledPoint("spam", ("viagra", "now")),
+        LabeledPoint("spam", ("viagra", "later")),
+        LabeledPoint("ham", ("hello", "now")),
+        LabeledPoint("ham", ("hello", "later")),
+        LabeledPoint("ham", ("meeting", "now")),
+    ]
+    model = CategoricalNaiveBayes.train(points)
+    assert model.predict(("viagra", "now")) == "spam"
+    assert model.predict(("hello", "later")) == "ham"
+    # unseen value with default -inf → score -inf
+    score = model.log_score(LabeledPoint("spam", ("unseen", "now")))
+    assert score == float("-inf")
+    # custom default (min of seen)
+    score2 = model.log_score(
+        LabeledPoint("spam", ("unseen", "now")),
+        default_likelihood=lambda ls: min(ls) if ls else float("-inf"),
+    )
+    assert np.isfinite(score2)
+    assert model.log_score(LabeledPoint("nope", ("a", "b"))) is None
+    with pytest.raises(ValueError):
+        model.log_score(LabeledPoint("spam", ("only-one",)))
+
+
+def test_markov_chain():
+    # transitions: 0 -> 1 (3x), 0 -> 2 (1x), 1 -> 0 (2x)
+    model = MarkovChain.train(
+        rows=[0, 0, 1], cols=[1, 2, 0], counts=[3, 1, 2], top_n=2
+    )
+    assert model.predict([0, 1]) == [1, 0]
+    assert model.predict([9]) == [-1]  # unknown state
+    top = model.top_n(0)
+    assert top[0] == (1, 0.75)
+    assert top[1] == (2, 0.25)
+
+
+def test_binary_vectorizer():
+    vec = BinaryVectorizer.fit([("color", "red"), ("color", "blue"),
+                                ("size", "L")])
+    assert vec.n == 3
+    v = vec.transform({"color": "red", "size": "L"})
+    assert v.sum() == 2.0
+    assert vec.transform({"color": "green"}).sum() == 0.0  # unseen ignored
+    batch = vec.transform_batch([{"color": "blue"}, {}])
+    assert batch.shape == (2, 3)
+    assert batch[1].sum() == 0
+
+
+def test_split_data():
+    data = list(range(10))
+    folds = split_data(3, data, lambda d: (f"q{d}", f"a{d}"))
+    assert len(folds) == 3
+    train0, idx0, qa0 = folds[0]
+    assert idx0 == 0
+    assert 0 not in train0 and 3 not in train0
+    assert ("q0", "a0") in qa0
+    # every element appears in exactly one test fold
+    all_test = [q for _t, _i, qa in folds for q, _a in qa]
+    assert len(all_test) == 10
+    with pytest.raises(ValueError):
+        split_data(1, data, lambda d: (d, d))

@@ -1,0 +1,257 @@
+"""Classification, similarproduct and ecommerce templates end-to-end."""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import App, Storage
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+
+@pytest.fixture(autouse=True)
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+def seed_app(name):
+    Storage.get_meta_data_apps().insert(App(0, name))
+    return Storage.get_meta_data_apps().get_by_name(name).id
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+def seed_classification(app_id):
+    dao = Storage.get_events()
+    rng = np.random.default_rng(0)
+    for n in range(80):
+        # plan 1.0 users: high attr0; plan 0.0 users: high attr2
+        plan = float(n % 2)
+        attrs = (
+            {"attr0": int(rng.integers(5, 10)), "attr1": int(rng.integers(0, 3)),
+             "attr2": int(rng.integers(0, 2))}
+            if plan == 1.0 else
+            {"attr0": int(rng.integers(0, 2)), "attr1": int(rng.integers(0, 3)),
+             "attr2": int(rng.integers(5, 10))}
+        )
+        dao.insert(Event(
+            event="$set", entity_type="user", entity_id=f"u{n}",
+            properties=DataMap({"plan": plan, **attrs}),
+        ), app_id)
+
+
+def test_classification_template():
+    from incubator_predictionio_tpu.models.classification import (
+        ClassificationEngine,
+        DataSourceParams,
+        LogRegAlgorithmParams,
+        NaiveBayesAlgorithmParams,
+        Query,
+    )
+
+    app_id = seed_app("clf")
+    seed_classification(app_id)
+    engine = ClassificationEngine().apply()
+    ep = EngineParams(
+        data_source_params=("", DataSourceParams(app_name="clf")),
+        algorithm_params_list=[
+            ("naive", NaiveBayesAlgorithmParams(lambda_=1.0)),
+            ("logreg", LogRegAlgorithmParams(steps=200)),
+        ],
+    )
+    iid = CoreWorkflow.run_train(engine, ep, engine_variant="clf")
+    models = CoreWorkflow.load_models(iid, engine, ep)
+    nb_algo, lr_algo = engine.algorithms(ep)
+    q_plan1 = Query(features=(8.0, 1.0, 0.0))
+    q_plan0 = Query(features=(0.0, 1.0, 8.0))
+    assert nb_algo.predict(models[0], q_plan1).label == 1.0
+    assert nb_algo.predict(models[0], q_plan0).label == 0.0
+    assert lr_algo.predict(models[1], q_plan1).label == 1.0
+    assert lr_algo.predict(models[1], q_plan0).label == 0.0
+
+
+def test_classification_wire_format():
+    from incubator_predictionio_tpu.models.classification import Query
+    from incubator_predictionio_tpu.utils import json_codec
+
+    q = json_codec.extract(Query, {"features": [1.0, 2.0, 3.0]})
+    assert q.features == (1.0, 2.0, 3.0)
+
+
+# ---------------------------------------------------------------------------
+# similarproduct
+# ---------------------------------------------------------------------------
+
+def seed_views(app_id, extra_like=False):
+    dao = Storage.get_events()
+    rng = np.random.default_rng(1)
+    # block structure: uA* view iA*, uB* view iB*
+    for block, (users, items) in enumerate((
+        ([f"uA{i}" for i in range(6)], [f"iA{i}" for i in range(8)]),
+        ([f"uB{i}" for i in range(6)], [f"iB{i}" for i in range(8)]),
+    )):
+        for u in users:
+            for it in items:
+                if rng.random() < 0.6:
+                    dao.insert(Event(event="view", entity_type="user",
+                                     entity_id=u, target_entity_type="item",
+                                     target_entity_id=it), app_id)
+    if extra_like:
+        dao.insert(Event(event="like", entity_type="user", entity_id="uA0",
+                         target_entity_type="item", target_entity_id="iA1"),
+                   app_id)
+    for i in range(8):
+        dao.insert(Event(
+            event="$set", entity_type="item", entity_id=f"iA{i}",
+            properties=DataMap({"categories": ["catA"]}),
+        ), app_id)
+
+
+def test_similarproduct_template():
+    from incubator_predictionio_tpu.models.similarproduct import (
+        ALSAlgorithmParams,
+        DataSourceParams,
+        Query,
+        SimilarProductEngine,
+    )
+
+    app_id = seed_app("simapp")
+    seed_views(app_id, extra_like=True)
+    engine = SimilarProductEngine().apply()
+    ep = EngineParams(
+        data_source_params=("", DataSourceParams(app_name="simapp")),
+        algorithm_params_list=[
+            ("als", ALSAlgorithmParams(rank=8, num_iterations=10,
+                                       lambda_=0.05, alpha=2.0, seed=7)),
+        ],
+    )
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+    r = algo.predict(models[0], Query(items=("iA0",), num=3))
+    assert r.item_scores
+    assert all(s.item.startswith("iA") for s in r.item_scores)
+    assert "iA0" not in {s.item for s in r.item_scores}  # query item excluded
+    # unknown item → empty
+    assert algo.predict(models[0], Query(items=("nope",), num=3)).item_scores == ()
+    # blacklist
+    r2 = algo.predict(models[0], Query(items=("iA0",), num=4,
+                                       black_list=("iA1",)))
+    assert "iA1" not in {s.item for s in r2.item_scores}
+    # category filter restricts to cat-A even for a B-block query item
+    r3 = algo.predict(models[0], Query(items=("iB0",), num=3,
+                                       categories=("catA",)))
+    assert all(s.item.startswith("iA") for s in r3.item_scores)
+
+
+# ---------------------------------------------------------------------------
+# ecommerce
+# ---------------------------------------------------------------------------
+
+def test_ecommerce_template():
+    from incubator_predictionio_tpu.models.ecommerce import (
+        DataSourceParams,
+        ECommAlgorithmParams,
+        ECommerceEngine,
+        Query,
+    )
+
+    app_id = seed_app("shop2")
+    seed_views(app_id)
+    dao = Storage.get_events()
+    # buys strengthen block A for uA0
+    dao.insert(Event(event="buy", entity_type="user", entity_id="uA0",
+                     target_entity_type="item", target_entity_id="iA2"), app_id)
+    engine = ECommerceEngine().apply()
+    ep = EngineParams(
+        data_source_params=("", DataSourceParams(app_name="shop2")),
+        algorithm_params_list=[
+            ("ecomm", ECommAlgorithmParams(app_name="shop2", rank=8,
+                                           num_iterations=10, lambda_=0.05,
+                                           alpha=2.0, seed=5)),
+        ],
+    )
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+
+    r = algo.predict(models[0], Query(user="uA1", num=3))
+    assert r.item_scores
+    # top unseen recommendation comes from the user's own block (implicit
+    # ALS scores *all* unobserved cells near 0, so only the best in-block
+    # unseen item clearly outranks the other block on a tiny catalog)
+    assert r.item_scores[0].item.startswith("iA")
+    # unseen_only: none of uA1's seen items
+    seen = {
+        e.target_entity_id for e in Storage.get_events().find(
+            app_id=app_id, entity_id="uA1")
+    }
+    assert not seen.intersection({s.item for s in r.item_scores})
+
+    # unavailable items constraint ($set without retraining)
+    first = r.item_scores[0].item
+    dao.insert(Event(
+        event="$set", entity_type="constraint",
+        entity_id="unavailableItems",
+        properties=DataMap({"items": [first]}),
+    ), app_id)
+    r2 = algo.predict(models[0], Query(user="uA1", num=3))
+    assert first not in {s.item for s in r2.item_scores}
+
+    # unknown user with recent views → item-based vector
+    dao.insert(Event(event="view", entity_type="user", entity_id="fresh",
+                     target_entity_type="item", target_entity_id="iB0"), app_id)
+    dao.insert(Event(event="view", entity_type="user", entity_id="fresh",
+                     target_entity_type="item", target_entity_id="iB1"), app_id)
+    r3 = algo.predict(models[0], Query(user="fresh", num=2))
+    assert r3.item_scores
+    assert all(s.item.startswith("iB") for s in r3.item_scores)
+
+    # totally cold user → popularity fallback still answers
+    r4 = algo.predict(models[0], Query(user="nobody", num=2))
+    assert len(r4.item_scores) == 2
+
+
+def test_ecommerce_seen_events_config():
+    """seen_events controls which event types mark items as 'seen'."""
+    from incubator_predictionio_tpu.models.ecommerce import (
+        DataSourceParams,
+        ECommAlgorithmParams,
+        ECommerceEngine,
+        Query,
+    )
+
+    app_id = seed_app("shop3")
+    seed_views(app_id)
+    engine = ECommerceEngine().apply()
+    ep = EngineParams(
+        data_source_params=("", DataSourceParams(app_name="shop3")),
+        algorithm_params_list=[
+            ("ecomm", ECommAlgorithmParams(app_name="shop3", rank=8,
+                                           num_iterations=5, lambda_=0.05,
+                                           alpha=2.0, seed=5,
+                                           seen_events=("buy",))),
+        ],
+    )
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+    # uA1 only VIEWED items (no buys) -> nothing is "seen" -> viewed items
+    # may be recommended again
+    r = algo.predict(models[0], Query(user="uA1", num=5))
+    viewed = {
+        e.target_entity_id for e in Storage.get_events().find(
+            app_id=app_id, entity_id="uA1", event_names=["view"])
+    }
+    assert viewed.intersection({s.item for s in r.item_scores})

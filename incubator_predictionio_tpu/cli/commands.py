@@ -49,6 +49,13 @@ def app_new(name: str, app_id: int = 0, description: Optional[str] = None,
     key = Storage.get_meta_data_access_keys().insert(
         AccessKey(access_key, new_id, ())
     )
+    if key is None:
+        Storage.get_events().remove(new_id)
+        apps.delete(new_id)
+        raise CommandError(
+            f"Unable to create new access key for app {name} "
+            "(duplicate key?). Aborting."
+        )
     print(f"Initialized Event Store for this app ID: {new_id}.")
     print("Created new app:")
     print(f"      Name: {name}")
@@ -247,10 +254,23 @@ def engine_from_variant(variant: Dict[str, Any]):
 # export / import (tools/.../export/EventsToFile.scala, imprt/FileToEvents.scala)
 # ---------------------------------------------------------------------------
 
+def _appid_or_name_to_name(appid_or_name: str) -> str:
+    """The reference CLI accepts either an app ID or name for export/import
+    (Console.scala export/import subcommands); the EventStore facade resolves
+    names, so translate a numeric ID to its app name first."""
+    if appid_or_name.isdigit():
+        app = Storage.get_meta_data_apps().get(int(appid_or_name))
+        if app is None:
+            raise CommandError(f"App ID {appid_or_name} does not exist.")
+        return app.name
+    return appid_or_name
+
+
 def export_events(app_name: str, output: str,
                   channel: Optional[str] = None) -> int:
     from incubator_predictionio_tpu.data.store import EventStore
 
+    app_name = _appid_or_name_to_name(app_name)
     n = 0
     with open(output, "w") as f:
         for event in EventStore.find(app_name=app_name, channel_name=channel):
@@ -264,6 +284,8 @@ def import_events(app_name: str, input_path: str,
                   channel: Optional[str] = None) -> int:
     from incubator_predictionio_tpu.data.event import validate_event
     from incubator_predictionio_tpu.data.store import EventStore
+
+    app_name = _appid_or_name_to_name(app_name)
 
     events = []
     with open(input_path) as f:

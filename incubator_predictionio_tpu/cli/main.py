@@ -160,9 +160,12 @@ def _confirm(prompt: str, force: bool) -> bool:
 
 def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
     cmd = args.command
-    if cmd in (None, "version"):
+    if cmd is None:
+        build_parser().print_help()
+        return 1
+    if cmd == "version":
         print(f"pio-tpu {__version__}")
-        return 0 if cmd else 1
+        return 0
 
     if cmd == "status":
         return 0 if commands.status() else 1

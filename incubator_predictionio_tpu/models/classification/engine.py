@@ -27,6 +27,7 @@ from incubator_predictionio_tpu.core import (
     DataSource,
     Engine,
     EngineFactory,
+    FirstServing,
     Params,
     Preparator,
     Serving,
@@ -233,11 +234,6 @@ class LogRegAlgorithm(Algorithm):
             model.lr, jnp.asarray([query.features], jnp.float32)
         )[0])
         return PredictedResult(label=model.label_values[cls])
-
-
-class FirstServing(Serving):
-    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
-        return predictions[0]
 
 
 class AccuracyMetric(AverageMetric):

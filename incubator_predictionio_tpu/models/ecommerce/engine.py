@@ -30,6 +30,7 @@ from incubator_predictionio_tpu.core import (
     DataSource,
     Engine,
     EngineFactory,
+    FirstServing,
     Params,
     Preparator,
     Serving,
@@ -159,6 +160,7 @@ class ECommAlgorithmParams(Params):
     __camel_case__ = True
 
     app_name: str
+    channel_name: Optional[str] = None
     rank: int = 10
     num_iterations: int = 20
     lambda_: float = 0.01
@@ -207,6 +209,7 @@ class ECommAlgorithm(Algorithm):
         user_seen: Dict[int, Any] = {}
         seen_raw = EventStore.find(
             app_name=self.params.app_name,
+            channel_name=self.params.channel_name,
             entity_type="user",
             target_entity_type="item",
             event_names=list(self.params.seen_events),
@@ -248,9 +251,16 @@ class ECommAlgorithm(Algorithm):
         the ops team $sets constraint/unavailableItems without retraining)."""
         try:
             props = EventStore.aggregate_properties(
-                app_name=self.params.app_name, entity_type="constraint",
+                app_name=self.params.app_name,
+                channel_name=self.params.channel_name,
+                entity_type="constraint",
             )
         except Exception:
+            logger.warning(
+                "ecommerce: constraint lookup failed for app %r; "
+                "serving without unavailable-item filtering",
+                self.params.app_name, exc_info=True,
+            )
             return []
         pm = props.get("unavailableItems")
         if pm is None:
@@ -264,6 +274,7 @@ class ECommAlgorithm(Algorithm):
         try:
             events = EventStore.find_by_entity(
                 app_name=self.params.app_name,
+                channel_name=self.params.channel_name,
                 entity_type="user",
                 entity_id=user,
                 event_names=list(self.params.similar_events),
@@ -271,6 +282,11 @@ class ECommAlgorithm(Algorithm):
                 latest=True,
             )
         except Exception:
+            logger.warning(
+                "ecommerce: recent-event lookup failed for app %r user %r; "
+                "falling back to popularity ranking",
+                self.params.app_name, user, exc_info=True,
+            )
             return []
         out = []
         for e in events:
@@ -338,11 +354,6 @@ class ECommAlgorithm(Algorithm):
                 continue
             out.append(ItemScore(item=inv[int(i)], score=float(s)))
         return PredictedResult(item_scores=tuple(out))
-
-
-class FirstServing(Serving):
-    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
-        return predictions[0]
 
 
 class ECommerceEngine(EngineFactory):

@@ -26,6 +26,7 @@ from incubator_predictionio_tpu.core import (
     DataSource,
     Engine,
     EngineFactory,
+    FirstServing,
     Params,
     Preparator,
     Serving,
@@ -264,11 +265,6 @@ class SimilarProductAlgorithm(Algorithm):
                 continue
             out.append(ItemScore(item=inv[int(i)], score=float(s)))
         return PredictedResult(item_scores=tuple(out))
-
-
-class FirstServing(Serving):
-    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
-        return predictions[0]
 
 
 class SimilarProductEngine(EngineFactory):

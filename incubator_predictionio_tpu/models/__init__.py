@@ -7,4 +7,6 @@ template families (SURVEY.md §2.6, examples/scala-parallel-*):
 - ``classification``  — categorical NaiveBayes + optax logistic regression
 - ``similarproduct``  — implicit-feedback ALS, item-to-item queries
 - ``ecommerce``       — implicit ALS + serve-time business-rule filtering
+- ``sequence``        — session-based next-item transformer (SASRec-style)
+  with ring/Ulysses sequence parallelism for long histories
 """

@@ -1,0 +1,199 @@
+"""Attention kernels for the sequence model family.
+
+The reference has no attention anywhere (SURVEY.md §5 "Long-context");
+sequence behavior tops out at MarkovChain transitions. This framework's
+sequence engines (models/sequence/) are transformer-based, so attention is a
+first-class hot op designed for the MXU:
+
+- :func:`dot_product_attention` — dense reference implementation (and the
+  fast path for short sequences: one fused softmax(QKᵀ)V per head).
+- :func:`blockwise_attention` — FlashAttention-style online-softmax over KV
+  blocks via ``lax.scan``: O(S) memory in sequence length, static shapes,
+  MXU-sized [block × head_dim] matmuls. This is the single-device
+  long-context path; the distributed path wraps it per-shard
+  (parallel/ring.py ring attention).
+
+All functions take [batch, seq, heads, head_dim] ("BSHD") arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: scores at masked positions — large-negative instead of -inf so a fully
+#: masked row exps to exactly 0 without NaNs from (-inf) - (-inf)
+MASK_VALUE = -1e30
+
+
+def _scale(q, scale: Optional[float]) -> float:
+    return scale if scale is not None else q.shape[-1] ** -0.5
+
+
+def _combine_masks(causal, q_pos, kv_pos, kv_valid):
+    """Broadcastable [B|1, 1, Q, K] boolean mask, or None if unmasked.
+
+    ``kv_valid`` is a per-key padding mask, [K] or [B, K].
+    """
+    mask = None
+    if causal:
+        mask = (q_pos[:, None] >= kv_pos[None, :])[None, None]
+    if kv_valid is not None:
+        vm = kv_valid if kv_valid.ndim == 2 else kv_valid[None]
+        vm = vm[:, None, None, :]
+        mask = vm if mask is None else (mask & vm)
+    return mask
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+    kv_valid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Dense softmax(QKᵀ)V on [B, S, H, D] inputs.
+
+    ``q_offset``/``kv_offset`` are the global positions of the first query /
+    key row — this is what lets sequence-sharded callers (ring attention)
+    reuse the same masking rule on local blocks. ``kv_valid`` ([K] or
+    [B, K]) masks padding keys.
+    """
+    s = _scale(q, scale)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * s
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    kv_pos = kv_offset + jnp.arange(k.shape[1])
+    mask = _combine_masks(causal, q_pos, kv_pos, kv_valid)
+    if mask is not None:
+        logits = jnp.where(mask, logits, MASK_VALUE)
+    p = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    if mask is not None:
+        # zero (not softmax-uniform) output for fully masked rows — the
+        # invariant the sequence-sharded kernels rely on when a shard's
+        # whole KV block is in the future
+        p = jnp.where(mask, p, 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    probs = (p / jnp.where(l == 0.0, 1.0, l)).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _online_block(q, k_blk, v_blk, m, l, o, scale, causal, q_pos, kv_pos,
+                  kv_valid=None):
+    """One online-softmax accumulation step against a single KV block.
+
+    Carries (m, l, o) = running rowmax, normalizer, unnormalized output in
+    f32. Shared by blockwise_attention and ring attention so the numerics
+    are identical on one chip and on a sequence-sharded mesh. ``kv_valid``
+    masks padded tail keys independently of causality.
+    """
+    s_blk = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+    ) * scale
+    mask = _combine_masks(causal, q_pos, kv_pos, kv_valid)
+    if mask is not None:
+        s_blk = jnp.where(mask, s_blk, MASK_VALUE)
+    # m_new is always finite (masked scores are MASK_VALUE), so the exps
+    # below never see (-inf) - (-inf); the initial m = -inf just makes the
+    # first block's correction factor exp(-inf - m_new) = 0
+    m_new = jnp.maximum(m, s_blk.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s_blk - m_new[..., None])
+    if mask is not None:
+        # zero masked probabilities so a fully-masked block adds no mass
+        # (exp(MASK_VALUE - MASK_VALUE) would otherwise be 1)
+        p = jnp.where(mask, p, 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def _finalize(m, l, o, dtype):
+    # fully-masked rows (l == 0) produce 0 output rather than NaN
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return jnp.einsum("bhqd->bqhd", o / l_safe[..., None]).astype(dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_size", "scale"))
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_size: int = 512,
+    scale: Optional[float] = None,
+    kv_valid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Online-softmax attention scanning KV in blocks ([B, S, H, D] in/out).
+
+    Memory is O(S·block) instead of O(S²); the scan is a static-length
+    ``lax.scan`` so XLA pipelines the per-block matmuls on the MXU.
+    ``kv_valid`` ([K] or [B, K]) masks padding keys.
+    """
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    blk = min(block_size, s_kv)
+    n_blocks = -(-s_kv // blk)
+    pad = n_blocks * blk - s_kv
+    if pad or kv_valid is not None:
+        # fold ragged-tail padding into one per-key validity mask
+        if kv_valid is None:
+            valid = jnp.ones((1, s_kv), bool)
+        else:
+            valid = jnp.broadcast_to(
+                kv_valid if kv_valid.ndim == 2 else kv_valid[None],
+                (kv_valid.shape[0] if kv_valid.ndim == 2 else 1, s_kv),
+            )
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))  # pads with False
+    else:
+        valid = None
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    sc = _scale(q, scale)
+    q_pos = jnp.arange(s_q)
+
+    k_blocks = k.reshape(b, n_blocks, blk, h, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, n_blocks, blk, h, d).transpose(1, 0, 2, 3, 4)
+    valid_blocks = (
+        None if valid is None
+        else valid.reshape(valid.shape[0], n_blocks, blk).transpose(1, 0, 2)
+    )
+
+    def step(carry, xs):
+        m, l, o = carry
+        i, k_blk, v_blk, valid_blk = xs
+        kv_pos = i * blk + jnp.arange(blk)
+        m, l, o = _online_block(
+            q, k_blk, v_blk, m, l, o, sc, causal, q_pos, kv_pos,
+            kv_valid=valid_blk,
+        )
+        return (m, l, o), None
+
+    init = (
+        jnp.full((b, h, s_q), -jnp.inf, jnp.float32),
+        jnp.zeros((b, h, s_q), jnp.float32),
+        jnp.zeros((b, h, s_q, d), jnp.float32),
+    )
+    if valid_blocks is None:
+        def step_novalid(carry, xs):
+            return step(carry, (*xs, None))
+        (m, l, o), _ = lax.scan(
+            step_novalid, init, (jnp.arange(n_blocks), k_blocks, v_blocks)
+        )
+    else:
+        (m, l, o), _ = lax.scan(
+            step, init,
+            (jnp.arange(n_blocks), k_blocks, v_blocks, valid_blocks),
+        )
+    return _finalize(m, l, o, q.dtype)

@@ -1,0 +1,239 @@
+"""Causal transformer for next-item prediction (the sequence engines).
+
+No reference counterpart exists — the reference's only sequence behavior is
+MarkovChain top-N transitions (e2/.../MarkovChain.scala:33); this is the
+TPU-native upgrade of that capability: a SASRec-style self-attentive
+session model over event-store item sequences.
+
+TPU design notes:
+- Layers are *stacked* pytrees scanned with ``lax.scan`` — one compiled
+  block body regardless of depth, no Python-loop unrolling.
+- Attention is pluggable: dense/blockwise on one chip
+  (ops/attention.py), ring or Ulysses sequence parallelism on an ``sp``
+  mesh axis (parallel/ring.py) for long sessions.
+- The full fit loop (epochs × minibatches) runs inside one jit via a
+  nested ``lax.scan`` over a pre-batched [steps, B, L] tensor; weights are
+  donated so optimizer state lives on device across the whole run.
+- Embedding/projection matmuls accumulate in f32 via
+  ``preferred_element_type`` and are MXU-shaped ([B·L, D] × [D, V]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+#: attention callable: (q, k, v, causal) -> out, all [B, S, H, Dh]
+AttnFn = Callable[..., jax.Array]
+
+PAD = 0  # padding token; real items are 1..n_items
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TransformerWeights:
+    item_emb: Any    # [V, D]  (tied output projection)
+    pos_emb: Any     # [L, D]
+    # stacked per-layer weights, leading axis = layer
+    ln1_scale: Any   # [N, D]
+    ln2_scale: Any   # [N, D]
+    wq: Any          # [N, D, D]
+    wk: Any          # [N, D, D]
+    wv: Any          # [N, D, D]
+    wo: Any          # [N, D, D]
+    w_up: Any        # [N, D, 4D]
+    w_down: Any      # [N, 4D, D]
+    lnf_scale: Any   # [D]
+
+
+def transformer_init(
+    key: jax.Array,
+    n_items: int,
+    max_len: int,
+    d_model: int = 64,
+    n_layers: int = 2,
+) -> TransformerWeights:
+    ks = jax.random.split(key, 8)
+    v = n_items + 1  # + PAD
+    d, h = d_model, 4 * d_model
+
+    def init(k, shape, scale):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    return TransformerWeights(
+        item_emb=init(ks[0], (v, d), d ** -0.5),
+        pos_emb=init(ks[1], (max_len, d), 0.02),
+        ln1_scale=jnp.ones((n_layers, d)),
+        ln2_scale=jnp.ones((n_layers, d)),
+        wq=init(ks[2], (n_layers, d, d), d ** -0.5),
+        wk=init(ks[3], (n_layers, d, d), d ** -0.5),
+        wv=init(ks[4], (n_layers, d, d), d ** -0.5),
+        wo=init(ks[5], (n_layers, d, d), d ** -0.5),
+        w_up=init(ks[6], (n_layers, d, h), d ** -0.5),
+        w_down=init(ks[7], (n_layers, h, d), h ** -0.5),
+        lnf_scale=jnp.ones((d,)),
+    )
+
+
+def _rms_norm(x, scale):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def _default_attn(q, k, v, causal=True, kv_valid=None):
+    from incubator_predictionio_tpu.ops.attention import (
+        blockwise_attention, dot_product_attention,
+    )
+    if q.shape[1] > 1024:
+        return blockwise_attention(q, k, v, causal=causal, kv_valid=kv_valid)
+    return dot_product_attention(q, k, v, causal=causal, kv_valid=kv_valid)
+
+
+def transformer_apply(
+    w: TransformerWeights,
+    tokens: jax.Array,          # [B, L] int32
+    n_heads: int,
+    attn_fn: Optional[AttnFn] = None,
+) -> jax.Array:
+    """Hidden states [B, L, D] after the final norm."""
+    attn = attn_fn or _default_attn
+    b, l = tokens.shape
+    d = w.item_emb.shape[1]
+    dh = d // n_heads
+    x = w.item_emb[tokens] + w.pos_emb[:l]
+    # padding keys are masked out of every attention softmax
+    kv_valid = tokens != PAD
+
+    layer_stack = (w.ln1_scale, w.ln2_scale, w.wq, w.wk, w.wv, w.wo,
+                   w.w_up, w.w_down)
+
+    def block(x, layer):
+        ln1, ln2, wq, wk, wv, wo, w_up, w_down = layer
+        h = _rms_norm(x, ln1)
+        q = (h @ wq).reshape(b, l, n_heads, dh)
+        k = (h @ wk).reshape(b, l, n_heads, dh)
+        v = (h @ wv).reshape(b, l, n_heads, dh)
+        o = attn(q, k, v, causal=True, kv_valid=kv_valid).reshape(b, l, d)
+        x = x + o @ wo
+        h = _rms_norm(x, ln2)
+        x = x + jax.nn.gelu(h @ w_up) @ w_down
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, layer_stack)
+    return _rms_norm(x, w.lnf_scale)
+
+
+def next_item_logits(
+    w: TransformerWeights, tokens: jax.Array, n_heads: int,
+    attn_fn: Optional[AttnFn] = None,
+) -> jax.Array:
+    """[B, L, V] logits with the output projection tied to item_emb."""
+    h = transformer_apply(w, tokens, n_heads, attn_fn)
+    return jnp.einsum(
+        "bld,vd->blv", h, w.item_emb, preferred_element_type=jnp.float32
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_heads", "learning_rate", "epochs", "attn_fn"),
+    donate_argnames=("w", "tx_state"),
+)
+def _fit_scan(w, batches, tx_state, n_heads, learning_rate, epochs,
+              attn_fn=None):
+    tx = optax.adamw(learning_rate)
+
+    def loss_fn(w, batch):
+        logits = next_item_logits(w, batch[:, :-1], n_heads, attn_fn)
+        targets = batch[:, 1:]
+        mask = (targets != PAD) & (batch[:, :-1] != PAD)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return jnp.sum(ce * mask) / jnp.maximum(mask.sum(), 1)
+
+    def step(carry, batch):
+        w, s = carry
+        loss, grads = jax.value_and_grad(loss_fn)(w, batch)
+        updates, s = tx.update(grads, s, w)
+        return (optax.apply_updates(w, updates), s), loss
+
+    def epoch(carry, _):
+        carry, losses = jax.lax.scan(step, carry, batches)
+        return carry, losses.mean()
+
+    (w, tx_state), losses = jax.lax.scan(
+        epoch, (w, tx_state), None, length=epochs
+    )
+    return w, losses
+
+
+def sasrec_fit(
+    sequences: np.ndarray,      # [N, L] int32, PAD-padded, items 1..n_items
+    n_items: int,
+    d_model: int = 64,
+    n_heads: int = 2,
+    n_layers: int = 2,
+    epochs: int = 20,
+    batch_size: int = 128,
+    learning_rate: float = 1e-3,
+    seed: int = 0,
+    attn_fn: Optional[AttnFn] = None,
+) -> tuple[TransformerWeights, np.ndarray]:
+    """Train on next-item prediction; returns (weights, per-epoch loss).
+
+    ``attn_fn`` selects the attention backend — e.g. a
+    ``functools.partial(ring_attention, mesh=mesh)`` for sequence-parallel
+    training of long sessions. It must be hashable (jit-static).
+    """
+    seqs = np.asarray(sequences, np.int32)
+    n, max_len = seqs.shape
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} not divisible by {n_heads} heads")
+    w = transformer_init(
+        jax.random.key(seed), n_items, max_len, d_model, n_layers
+    )
+    # pre-batch into [steps, B, L]; ragged tail is padded with PAD-only rows
+    # (masked out of the loss)
+    bs = min(batch_size, n)
+    steps = -(-n // bs)
+    pad_rows = steps * bs - n
+    if pad_rows:
+        seqs = np.concatenate(
+            [seqs, np.zeros((pad_rows, max_len), np.int32)]
+        )
+    rng = np.random.default_rng(seed)
+    seqs = seqs[rng.permutation(len(seqs))]
+    batches = jnp.asarray(seqs.reshape(steps, bs, max_len))
+    tx_state = optax.adamw(learning_rate).init(w)
+    w, losses = _fit_scan(w, batches, tx_state, n_heads,
+                          learning_rate, epochs, attn_fn)
+    return w, np.asarray(losses)
+
+
+@functools.partial(jax.jit, static_argnames=("n_heads", "k"))
+def sasrec_topk(
+    w: TransformerWeights,
+    tokens: jax.Array,          # [B, L] recent history, PAD-padded LEFT
+    n_heads: int,
+    k: int = 10,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k next items from the last position's hidden state.
+
+    Returns (scores [B, k], item ids [B, k]); PAD is never returned.
+    """
+    h = transformer_apply(w, tokens, n_heads)
+    last = h[:, -1]                                       # [B, D]
+    scores = jnp.einsum(
+        "bd,vd->bv", last, w.item_emb, preferred_element_type=jnp.float32
+    )
+    # never recommend PAD or items already in the history (PAD ∈ history
+    # columns, so the vmap covers it)
+    scores = jax.vmap(lambda s, t: s.at[t].set(-jnp.inf))(scores, tokens)
+    scores = scores.at[:, PAD].set(-jnp.inf)
+    return jax.lax.top_k(scores, k)

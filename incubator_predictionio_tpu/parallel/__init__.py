@@ -11,9 +11,20 @@ shuffles.
 
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 from incubator_predictionio_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
     make_mesh,
     mesh_shape_for,
     device_count,
 )
 
-__all__ = ["RuntimeContext", "make_mesh", "mesh_shape_for", "device_count"]
+__all__ = [
+    "RuntimeContext",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "make_mesh",
+    "mesh_shape_for",
+    "device_count",
+]

@@ -1,0 +1,140 @@
+"""Multi-host (multi-process) runtime for TPU pods and pod slices.
+
+The reference scales out by submitting to a Spark cluster
+(tools/.../Runner.scala:101-213 builds the spark-submit line; executors talk
+through Spark's shuffle service). The TPU-native equivalent is JAX's
+multi-controller runtime: one Python process per host, every process runs
+the same program, and arrays are globally sharded over all hosts' devices —
+collectives ride ICI inside a slice and DCN across slices.
+
+``ensure_initialized`` is the single entry point; it is safe to call on a
+laptop (no-op), under pytest's forced-CPU mesh, and on a real pod where the
+coordinator env vars are set.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def ensure_initialized() -> bool:
+    """Initialize ``jax.distributed`` when a coordinator is configured.
+
+    Configuration comes from the standard JAX env vars (auto-detected on
+    Cloud TPU) or the explicit ``PIO_COORDINATOR_ADDRESS`` /
+    ``PIO_NUM_PROCESSES`` / ``PIO_PROCESS_ID`` trio, mirroring how the
+    reference forwards ``PIO_*`` env across process boundaries
+    (Runner.scala:129-131). Returns True when running multi-process.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coord = os.environ.get("PIO_COORDINATOR_ADDRESS")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["PIO_NUM_PROCESSES"]),
+            process_id=int(os.environ["PIO_PROCESS_ID"]),
+        )
+        logger.info(
+            "distributed: process %d/%d via coordinator %s",
+            jax.process_index(), jax.process_count(), coord,
+        )
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def make_pod_mesh(
+    axis_names: Sequence[str],
+    axis_sizes: Sequence[int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A named mesh over all (global) devices, DCN-aware on multi-host.
+
+    The FIRST axis is the cross-host axis: on a real multi-slice topology it
+    is laid out over DCN (via ``create_hybrid_device_mesh``) so that only
+    that axis's collectives cross the data-center network, while every later
+    axis stays inside a slice on ICI — put ``dp`` first and ``mp``/``sp``
+    after it (the scaling-book layout).
+
+    ``axis_sizes`` may use -1 once to absorb the remaining device count.
+    """
+    import numpy as np
+
+    devs = list(devices if devices is not None else jax.devices())
+    sizes = list(axis_sizes)
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        if len(devs) % known != 0:
+            raise ValueError(
+                f"{len(devs)} devices not divisible by fixed axes {known}"
+            )
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != len(devs):
+        raise ValueError(
+            f"mesh {dict(zip(axis_names, sizes))} needs {total} devices, "
+            f"have {len(devs)}"
+        )
+
+    if is_multihost() and devices is None:
+        from jax.experimental import mesh_utils
+
+        per_host = sizes[0] // jax.process_count() or 1
+        try:
+            grid = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(per_host, *sizes[1:]),
+                dcn_mesh_shape=(sizes[0] // per_host,) + (1,) * (len(sizes) - 1),
+            )
+            return Mesh(grid, tuple(axis_names))
+        except Exception:
+            logger.warning(
+                "hybrid DCN mesh layout failed; falling back to flat device "
+                "order (collectives on the first axis may cross DCN "
+                "suboptimally)", exc_info=True,
+            )
+    grid = np.array(devs).reshape(*sizes)
+    return Mesh(grid, tuple(axis_names))
+
+
+def host_local_batch_slice(global_batch: int) -> slice:
+    """Which rows of a global batch this host is responsible for feeding.
+
+    Multi-host input pipelines load only their slice and form global arrays
+    with ``jax.make_array_from_process_local_data``; this gives the row
+    range, replacing the reference's per-executor RDD partition assignment.
+    """
+    per = global_batch // jax.process_count()
+    start = per * jax.process_index()
+    return slice(start, start + per)
+
+
+def global_array_from_local(local, sharding):
+    """Assemble a globally-sharded array from this host's local rows."""
+    return jax.make_array_from_process_local_data(sharding, local)

@@ -21,6 +21,8 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "dp"
 MODEL_AXIS = "mp"
+#: sequence/context axis for long-input attention (ring / all-to-all SP)
+SEQ_AXIS = "sp"
 
 
 def device_count() -> int:

@@ -1,0 +1,165 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context support is first-class in this framework even though the
+reference has none (SURVEY.md §5 "Long-context / sequence parallelism:
+Absent"): the sequence engines must scale past one chip's HBM in sequence
+length. Two standard strategies, both over the ``sp`` mesh axis:
+
+- :func:`ring_attention` — KV blocks rotate around the ``sp`` ring via
+  ``ppermute`` (neighbor ICI links), each device accumulating online-softmax
+  attention for its local query block. Communication overlaps compute after
+  the first hop; memory is O(S/n) per device. (Liu et al., "Ring Attention
+  with Blockwise Transformers", PAPERS.md.)
+- :func:`ulysses_attention` — two ``all_to_all`` reshards: seq-sharded →
+  head-sharded, run full-sequence attention per head locally, and reshard
+  back. Cheaper at moderate S (2 collectives instead of n-1 hops) but caps
+  parallelism at the head count.
+
+Both produce numerics matching ops/attention.py's single-device kernels (the
+shared ``_online_block`` accumulator) and are plain traceable functions: jit
+them under a mesh and XLA lays the ppermutes onto the ICI torus.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from incubator_predictionio_tpu.ops.attention import (
+    _finalize,
+    _online_block,
+    _scale,
+)
+from incubator_predictionio_tpu.parallel.collectives import ppermute_next
+from incubator_predictionio_tpu.parallel.mesh import SEQ_AXIS
+
+
+def _ring_attention_local(q, k, v, kv_valid, axis_name, causal, scale):
+    """Per-shard body: q stays put, (k, v, kv_valid) rotate around the ring."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    sc = _scale(q, scale)
+    q_pos = my * s_loc + jnp.arange(s_loc)
+
+    def accumulate(i, k_blk, v_blk, valid_blk, m, l, o):
+        # after i forward rotations the resident block originated at rank
+        # (my - i) mod n, which fixes its global key positions for masking
+        src = (my - i) % n
+        kv_pos = src * s_loc + jnp.arange(s_loc)
+        return _online_block(
+            q, k_blk, v_blk, m, l, o, sc, causal, q_pos, kv_pos,
+            kv_valid=valid_blk,
+        )
+
+    def step(i, carry):
+        # rotate first, then accumulate: hop 0 runs outside the loop, so
+        # exactly n-1 ppermutes are issued and none is discarded
+        k_blk, v_blk, valid_blk, m, l, o = carry
+        k_blk = ppermute_next(k_blk, axis_name)
+        v_blk = ppermute_next(v_blk, axis_name)
+        valid_blk = ppermute_next(valid_blk, axis_name)
+        m, l, o = accumulate(i, k_blk, v_blk, valid_blk, m, l, o)
+        return k_blk, v_blk, valid_blk, m, l, o
+
+    m, l, o = accumulate(
+        0, k, v, kv_valid,
+        jnp.full((b, h, s_loc), -jnp.inf, jnp.float32),
+        jnp.zeros((b, h, s_loc), jnp.float32),
+        jnp.zeros((b, h, s_loc, d), jnp.float32),
+    )
+    _, _, _, m, l, o = lax.fori_loop(1, n, step, (k, v, kv_valid, m, l, o))
+    return _finalize(m, l, o, q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = SEQ_AXIS,
+    kv_valid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Attention over [B, S, H, D] with S sharded on ``axis_name``.
+
+    Inputs may be unsharded (GSPMD moves them); output is sharded the same
+    way as q. S must divide evenly by the axis size. ``kv_valid`` ([B, S])
+    masks padding keys and is sharded/rotated with the keys.
+    """
+    if kv_valid is None:
+        kv_valid = jnp.ones((q.shape[0], k.shape[1]), bool)
+    spec = P(None, axis_name, None, None)
+    vspec = P(None, axis_name)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=axis_name, causal=causal, scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, vspec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kv_valid)
+
+
+def _ulysses_local(q, k, v, kv_valid, axis_name, causal, scale):
+    from incubator_predictionio_tpu.ops.attention import blockwise_attention
+
+    def seq_to_heads(x):
+        # [B, S/n, H, D] → [B, S, H/n, D]: gather seq, scatter heads
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # validity has no head dim to scatter — every shard needs the full mask
+    valid_full = lax.all_gather(kv_valid, axis_name, axis=1, tiled=True)
+    o = blockwise_attention(q, k, v, causal=causal, scale=scale,
+                            kv_valid=valid_full)
+    return heads_to_seq(o)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = SEQ_AXIS,
+    kv_valid: Optional[jax.Array] = None,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    Requires the head count to be divisible by the ``axis_name`` size.
+    """
+    if q.shape[2] % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            f"heads {q.shape[2]} not divisible by sequence-parallel degree "
+            f"{mesh.shape[axis_name]}"
+        )
+    if kv_valid is None:
+        kv_valid = jnp.ones((q.shape[0], k.shape[1]), bool)
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(
+            _ulysses_local, axis_name=axis_name, causal=causal, scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(None, axis_name)),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kv_valid)

@@ -1,0 +1,115 @@
+"""Distributed communication backend: collectives + pod mesh construction."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from incubator_predictionio_tpu.parallel import collectives as C
+from incubator_predictionio_tpu.parallel.distributed import (
+    ensure_initialized,
+    host_local_batch_slice,
+    make_pod_mesh,
+)
+
+
+def _mesh1d(name="dp", n=8):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def _run(mesh, fn, x, in_spec, out_spec):
+    return shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+                     check_vma=False)(x)
+
+
+def test_all_reduce_sum_mean_max():
+    mesh = _mesh1d()
+    x = jnp.arange(8.0)
+
+    out = _run(mesh, lambda v: C.all_reduce_sum(v, "dp"), x, P("dp"), P("dp"))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))
+    out = _run(mesh, lambda v: C.all_reduce_mean(v, "dp"), x, P("dp"), P("dp"))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, x.mean()))
+    out = _run(mesh, lambda v: C.all_reduce_max(v, "dp"), x, P("dp"), P("dp"))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 7.0))
+
+
+def test_all_gather_and_reduce_scatter():
+    mesh = _mesh1d()
+    x = jnp.arange(16.0)
+
+    gathered = _run(mesh, lambda v: C.all_gather(v, "dp"), x, P("dp"), P("dp"))
+    # every shard holds the full row → global result is 8 copies
+    assert gathered.shape == (128,)
+    np.testing.assert_allclose(np.asarray(gathered)[:16], np.arange(16.0))
+
+    scattered = _run(mesh, lambda v: C.reduce_scatter(v, "dp"),
+                     jnp.ones(64), P("dp"), P("dp"))
+    # each shard's [8] local vector sums across shards then scatters one
+    # element back per shard: every element is 8
+    np.testing.assert_allclose(np.asarray(scattered), np.full(8, 8.0))
+
+
+def test_ppermute_ring_rotation():
+    mesh = _mesh1d()
+    x = jnp.arange(8.0)
+    nxt = _run(mesh, lambda v: C.ppermute_next(v, "dp"), x, P("dp"), P("dp"))
+    np.testing.assert_allclose(np.asarray(nxt), np.roll(np.arange(8.0), 1))
+    prv = _run(mesh, lambda v: C.ppermute_prev(v, "dp"), x, P("dp"), P("dp"))
+    np.testing.assert_allclose(np.asarray(prv), np.roll(np.arange(8.0), -1))
+
+
+def test_broadcast_from():
+    mesh = _mesh1d()
+    x = jnp.arange(8.0)
+    out = _run(mesh, lambda v: C.broadcast_from(v, "dp", src_index=3),
+               x, P("dp"), P("dp"))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+
+def test_all_to_all_transpose():
+    mesh = _mesh1d(n=4)
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    def body(v):  # local [1, 4] → split cols, gather rows → [4, 1]
+        return C.all_to_all(v, "dp", split_axis=1, concat_axis=0)
+
+    out = _run(mesh, body, x, P("dp", None), P(None, "dp"))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).T.T)
+    assert out.shape == (4, 4)
+
+
+def test_make_pod_mesh_shapes():
+    mesh = make_pod_mesh(("dp", "mp"), (2, 4))
+    assert dict(mesh.shape) == {"dp": 2, "mp": 4}
+    mesh = make_pod_mesh(("dp", "sp"), (-1, 2))
+    assert dict(mesh.shape) == {"dp": 4, "sp": 2}
+    with pytest.raises(ValueError):
+        make_pod_mesh(("dp",), (3,))
+
+
+def test_single_host_runtime():
+    assert ensure_initialized() is False  # no coordinator configured
+    assert host_local_batch_slice(64) == slice(0, 64)
+
+
+def test_dp_training_step_gradient_sync():
+    """The DP pattern every engine uses: per-shard grads, pmean, identical
+    update everywhere — Spark's aggregate replaced by one all-reduce."""
+    mesh = _mesh1d()
+    w = jnp.ones(4)
+    x = jnp.arange(32.0).reshape(8, 4)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P("dp", None)),
+                       out_specs=P(), check_vma=False)
+    def grad_step(w, batch):
+        g = jax.grad(lambda w: jnp.mean((batch @ w) ** 2))(w)
+        return C.all_reduce_mean(g, "dp")
+
+    g = grad_step(w, x)
+    g_ref = jax.grad(lambda w: jnp.mean((x @ w) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-6)

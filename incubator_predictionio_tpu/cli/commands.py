@@ -13,6 +13,7 @@ import importlib
 import json
 import logging
 import os
+import re
 import sys
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
@@ -248,6 +249,118 @@ def engine_from_variant(variant: Dict[str, Any]):
     factory = resolve_engine_factory(factory_path)
     engine = factory.apply()
     return engine, engine.jvalue_to_engine_params(variant)
+
+
+# ---------------------------------------------------------------------------
+# build / register (commands/Engine.scala:158-260, RegisterEngine.scala,
+# commands/Template.scala)
+# ---------------------------------------------------------------------------
+
+def verify_template_min_version(engine_dir: str = ".") -> Optional[str]:
+    """template.json min-version gate (commands/Template.scala:38-83).
+
+    Returns a warning string when ``pio.required.version`` exceeds the
+    running framework version; None otherwise (including no template.json —
+    the reference warns separately but proceeds either way).
+    """
+    from incubator_predictionio_tpu import __version__
+
+    path = Path(engine_dir) / "template.json"
+    if not path.exists():
+        return None
+    try:
+        with open(path) as f:
+            required = json.load(f).get("pio", {}).get("version", {}).get("min")
+    except (json.JSONDecodeError, AttributeError):
+        return None
+    if not required:
+        return None
+
+    def _key(v: str) -> tuple:
+        return tuple(int(p) for p in re.findall(r"\d+", v)[:3])
+
+    if _key(str(required)) > _key(__version__):
+        return (
+            f"This engine template requires at least version {required}, "
+            f"but you are running {__version__}. It may not work properly."
+        )
+    return None
+
+
+def _manifest_for_engine_dir(engine_dir: str,
+                             variant: Dict[str, Any]) -> "storage_base.EngineManifest":
+    """manifest.json regeneration (commands/Engine.scala:123-156): the ID is
+    derived from the engine directory, the version from a content hash of the
+    variant (there is no JAR to fingerprint)."""
+    import hashlib
+
+    from incubator_predictionio_tpu import __version__
+    from incubator_predictionio_tpu.data.storage import base as storage_base
+
+    abs_dir = str(Path(engine_dir).resolve())
+    digest = hashlib.sha1(
+        json.dumps(variant, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    files = sorted(
+        str(p) for p in Path(engine_dir).glob("*.json")
+        if p.name != "manifest.json"   # the output of this very build
+    ) + sorted(str(p) for p in Path(engine_dir).glob("*.py"))
+    return storage_base.EngineManifest(
+        id=hashlib.sha1(abs_dir.encode()).hexdigest()[:16],
+        version=digest,
+        name=Path(abs_dir).name,
+        engine_factory=variant.get("engineFactory", ""),
+        description=f"pio-tpu {__version__} engine at {abs_dir}",
+        files=tuple(files),
+    )
+
+
+def build(engine_dir: str = ".", engine_json: str = "engine.json") -> str:
+    """``pio build`` (commands/Engine.scala:158-260). There is no sbt
+    compile step: "building" validates the variant resolves to an importable
+    factory, checks the template version gate, writes manifest.json, and
+    registers the EngineManifest."""
+    warning = verify_template_min_version(engine_dir)
+    if warning:
+        print(f"WARNING: {warning}")
+    variant = load_variant(str(Path(engine_dir) / engine_json))
+    # import + params extraction = the "compile" step
+    _engine, engine_params = engine_from_variant(variant)
+    n_algos = len(engine_params.algorithm_params_list) or 1
+    print(f"Engine {variant.get('engineFactory')} is valid "
+          f"({n_algos} algorithm(s) configured).")
+    manifest = _manifest_for_engine_dir(engine_dir, variant)
+    with open(Path(engine_dir) / "manifest.json", "w") as f:
+        json.dump(
+            {
+                "id": manifest.id,
+                "version": manifest.version,
+                "name": manifest.name,
+                "engineFactory": manifest.engine_factory,
+                "description": manifest.description,
+                "files": list(manifest.files),
+            },
+            f, indent=2,
+        )
+    Storage.get_meta_data_engine_manifests().update(manifest, upsert=True)
+    print(f"Engine {manifest.id} {manifest.version} registered "
+          f"({manifest.engine_factory}).")
+    return manifest.id
+
+
+def unregister(engine_dir: str = ".") -> None:
+    """``pio unregister`` (RegisterEngine.unregisterEngine:58)."""
+    path = Path(engine_dir) / "manifest.json"
+    if not path.exists():
+        raise CommandError(f"{path} does not exist. Nothing to unregister.")
+    with open(path) as f:
+        m = json.load(f)
+    if Storage.get_meta_data_engine_manifests().delete(m["id"], m["version"]):
+        print(f"Engine {m['id']} {m['version']} unregistered.")
+    else:
+        raise CommandError(
+            f"Engine {m['id']} {m['version']} is not registered."
+        )
 
 
 # ---------------------------------------------------------------------------

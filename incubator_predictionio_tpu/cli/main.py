@@ -103,6 +103,9 @@ def build_parser() -> argparse.ArgumentParser:
             p.add_argument("--feedback", action="store_true")
             p.add_argument("--server-key", default=None)
 
+    sub.add_parser("unregister",
+                   help="unregister the engine in the current directory")
+
     p = sub.add_parser("eval", help="run evaluation / hyperparameter tuning")
     p.add_argument("evaluation_class",
                    help="module:attr of the Evaluation object")
@@ -221,12 +224,12 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
         return 0
 
     if cmd == "build":
-        variant = commands.load_variant(args.variant)
-        engine, engine_params = commands.engine_from_variant(variant)
-        n_algos = len(engine_params.algorithm_params_list) or 1
-        print(f"Engine {variant.get('engineFactory')} is valid "
-              f"({n_algos} algorithm(s) configured).")
+        commands.build(engine_json=args.variant)
         print("No compilation step is needed; your engine is ready to train.")
+        return 0
+
+    if cmd == "unregister":
+        commands.unregister()
         return 0
 
     if cmd == "train":

@@ -32,6 +32,8 @@ from incubator_predictionio_tpu.data.storage.base import (  # re-export
     Channels,
     EngineInstance,
     EngineInstances,
+    EngineManifest,
+    EngineManifests,
     EvaluationInstance,
     EvaluationInstances,
     Events,
@@ -44,7 +46,8 @@ from incubator_predictionio_tpu.data.storage.base import (  # re-export
 
 __all__ = [
     "AccessKey", "AccessKeys", "App", "Apps", "Channel", "Channels",
-    "EngineInstance", "EngineInstances", "EvaluationInstance",
+    "EngineInstance", "EngineInstances", "EngineManifest", "EngineManifests",
+    "EvaluationInstance",
     "EvaluationInstances", "Events", "Model", "Models", "Storage", "is_valid_channel_name",
     "StorageClientConfig", "StorageError", "UNSET", "BaseStorageClient",
 ]
@@ -207,6 +210,10 @@ class Storage:
         return cls.get_data_object(MetaDataRepository, "EngineInstances")
 
     @classmethod
+    def get_meta_data_engine_manifests(cls) -> EngineManifests:
+        return cls.get_data_object(MetaDataRepository, "EngineManifests")
+
+    @classmethod
     def get_meta_data_evaluation_instances(cls) -> EvaluationInstances:
         return cls.get_data_object(MetaDataRepository, "EvaluationInstances")
 
@@ -227,6 +234,7 @@ class Storage:
         cls.get_meta_data_access_keys()
         cls.get_meta_data_channels()
         cls.get_meta_data_engine_instances()
+        cls.get_meta_data_engine_manifests()
         cls.get_meta_data_evaluation_instances()
         cls.get_model_data_models()
         events = cls.get_events()

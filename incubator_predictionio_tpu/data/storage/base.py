@@ -121,6 +121,22 @@ class EvaluationInstance:
 
 
 @dataclasses.dataclass(frozen=True)
+class EngineManifest:
+    """EngineManifests.scala:36-42 — discover engines by ID and version.
+
+    The reference's ``files`` lists built JAR paths; here they are the
+    engine's variant/module files (there is no build artifact to register,
+    the factory path is importable directly).
+    """
+    id: str
+    version: str
+    name: str
+    engine_factory: str
+    description: Optional[str] = None
+    files: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class Model:
     """Models.scala:33 — a serialized model blob keyed by engine instance."""
     id: str
@@ -348,6 +364,25 @@ class EvaluationInstances(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, instance_id: str) -> bool: ...
+
+
+class EngineManifests(abc.ABC):
+    """EngineManifests.scala:49-66 — engine registry DAO."""
+
+    @abc.abstractmethod
+    def insert(self, m: EngineManifest) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, manifest_id: str, version: str) -> Optional[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineManifest]: ...
+
+    @abc.abstractmethod
+    def update(self, m: EngineManifest, upsert: bool = False) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, manifest_id: str, version: str) -> bool: ...
 
 
 class Models(abc.ABC):

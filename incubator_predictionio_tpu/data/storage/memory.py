@@ -32,6 +32,7 @@ class _Namespace:
         self.access_keys: Dict[str, base.AccessKey] = {}
         self.channels: Dict[int, base.Channel] = {}
         self.engine_instances: Dict[str, base.EngineInstance] = {}
+        self.engine_manifests: Dict[Tuple[str, str], base.EngineManifest] = {}
         self.evaluation_instances: Dict[str, base.EvaluationInstance] = {}
         self.models: Dict[str, base.Model] = {}
         self._next = 1
@@ -346,6 +347,34 @@ class MemoryEvaluationInstances(_MemoryDAO, base.EvaluationInstances):
             return self.t.evaluation_instances.pop(instance_id, None) is not None
 
 
+class MemoryEngineManifests(_MemoryDAO, base.EngineManifests):
+    def insert(self, m: base.EngineManifest) -> None:
+        with self.client.lock:
+            self.t.engine_manifests[(m.id, m.version)] = m
+
+    def get(self, manifest_id: str, version: str) -> Optional[base.EngineManifest]:
+        with self.client.lock:
+            return self.t.engine_manifests.get((manifest_id, version))
+
+    def get_all(self) -> list[base.EngineManifest]:
+        with self.client.lock:
+            return list(self.t.engine_manifests.values())
+
+    def update(self, m: base.EngineManifest, upsert: bool = False) -> bool:
+        with self.client.lock:
+            if (m.id, m.version) not in self.t.engine_manifests and not upsert:
+                return False
+            self.t.engine_manifests[(m.id, m.version)] = m
+            return True
+
+    def delete(self, manifest_id: str, version: str) -> bool:
+        with self.client.lock:
+            return (
+                self.t.engine_manifests.pop((manifest_id, version), None)
+                is not None
+            )
+
+
 class MemoryModels(_MemoryDAO, base.Models):
     def insert(self, model: base.Model) -> None:
         with self.client.lock:
@@ -368,6 +397,7 @@ DATA_OBJECTS = {
     "AccessKeys": MemoryAccessKeys,
     "Channels": MemoryChannels,
     "EngineInstances": MemoryEngineInstances,
+    "EngineManifests": MemoryEngineManifests,
     "EvaluationInstances": MemoryEvaluationInstances,
     "Models": MemoryModels,
 }

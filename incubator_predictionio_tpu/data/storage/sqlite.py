@@ -144,6 +144,16 @@ class StorageClient(base.BaseStorageClient):
                     serving_params TEXT,
                     PRIMARY KEY (ns, id)
                 );
+                CREATE TABLE IF NOT EXISTS engine_manifests (
+                    ns TEXT NOT NULL,
+                    id TEXT NOT NULL,
+                    version TEXT NOT NULL,
+                    name TEXT NOT NULL,
+                    description TEXT,
+                    files TEXT,
+                    engine_factory TEXT NOT NULL,
+                    PRIMARY KEY (ns, id, version)
+                );
                 CREATE TABLE IF NOT EXISTS evaluation_instances (
                     ns TEXT NOT NULL,
                     id TEXT NOT NULL,
@@ -594,6 +604,57 @@ class SQLiteEngineInstances(_SQLiteDAO, base.EngineInstances):
             ).rowcount > 0
 
 
+class SQLiteEngineManifests(_SQLiteDAO, base.EngineManifests):
+    @staticmethod
+    def _row(row: Sequence[Any]) -> base.EngineManifest:
+        return base.EngineManifest(
+            id=row[0], version=row[1], name=row[2],
+            engine_factory=row[3], description=row[4],
+            files=tuple(json.loads(row[5])) if row[5] else (),
+        )
+
+    _COLS = "id, version, name, engine_factory, description, files"
+
+    def insert(self, m: base.EngineManifest) -> None:
+        with self.client.lock, self.client.conn as c:
+            c.execute(
+                "INSERT OR REPLACE INTO engine_manifests "
+                "(ns, id, version, name, description, files, engine_factory) "
+                "VALUES (?,?,?,?,?,?,?)",
+                (self.ns, m.id, m.version, m.name, m.description,
+                 json.dumps(list(m.files)), m.engine_factory),
+            )
+
+    def get(self, manifest_id: str, version: str) -> Optional[base.EngineManifest]:
+        row = self._query_one(
+            f"SELECT {self._COLS} FROM engine_manifests "
+            "WHERE ns = ? AND id = ? AND version = ?",
+            (self.ns, manifest_id, version),
+        )
+        return self._row(row) if row else None
+
+    def get_all(self) -> list[base.EngineManifest]:
+        rows = self._query(
+            f"SELECT {self._COLS} FROM engine_manifests WHERE ns = ?",
+            (self.ns,),
+        )
+        return [self._row(r) for r in rows]
+
+    def update(self, m: base.EngineManifest, upsert: bool = False) -> bool:
+        if not upsert and self.get(m.id, m.version) is None:
+            return False
+        self.insert(m)
+        return True
+
+    def delete(self, manifest_id: str, version: str) -> bool:
+        with self.client.lock, self.client.conn as c:
+            return c.execute(
+                "DELETE FROM engine_manifests "
+                "WHERE ns = ? AND id = ? AND version = ?",
+                (self.ns, manifest_id, version),
+            ).rowcount > 0
+
+
 _EVI_COLS = (
     "id, status, start_time, end_time, evaluation_class,"
     " engine_params_generator_class, batch, env, runtime_conf,"
@@ -704,6 +765,7 @@ DATA_OBJECTS = {
     "AccessKeys": SQLiteAccessKeys,
     "Channels": SQLiteChannels,
     "EngineInstances": SQLiteEngineInstances,
+    "EngineManifests": SQLiteEngineManifests,
     "EvaluationInstances": SQLiteEvaluationInstances,
     "Models": SQLiteModels,
 }

@@ -290,9 +290,13 @@ class SeqRecAlgorithm(Algorithm):
         hist = self._history(query, model)
         if not hist:
             return PredictedResult(item_scores=())
-        tokens = np.zeros((1, model.max_len), np.int32)
-        hist = hist[-model.max_len:]
-        tokens[0, model.max_len - len(hist):] = hist
+        # Score at width max_len-1 — the width training ran at
+        # (sasrec_fit shifts batch[:, :-1] → batch[:, 1:]), so every
+        # positional-embedding row used here received gradients.
+        window = model.max_len - 1
+        tokens = np.zeros((1, window), np.int32)
+        hist = hist[-window:]
+        tokens[0, window - len(hist):] = hist
         k = min(query.num, len(model.item_bimap))
         scores, ids = sasrec_topk(
             model.weights, jnp.asarray(tokens), model.n_heads, k=k

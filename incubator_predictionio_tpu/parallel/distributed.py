@@ -130,7 +130,14 @@ def host_local_batch_slice(global_batch: int) -> slice:
     with ``jax.make_array_from_process_local_data``; this gives the row
     range, replacing the reference's per-executor RDD partition assignment.
     """
-    per = global_batch // jax.process_count()
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(
+            f"global_batch={global_batch} is not divisible by "
+            f"process_count={n}; remainder rows would silently be fed by "
+            "no host — pad or trim the batch first"
+        )
+    per = global_batch // n
     start = per * jax.process_index()
     return slice(start, start + per)
 

@@ -7,5 +7,6 @@ hop disappears: `pio train` runs the workflow in-process on the TPU host.
 
 from incubator_predictionio_tpu.workflow.workflow import CoreWorkflow
 from incubator_predictionio_tpu.workflow import checkpoint
+from incubator_predictionio_tpu.workflow.fake import FakeRun
 
-__all__ = ["CoreWorkflow", "checkpoint"]
+__all__ = ["CoreWorkflow", "checkpoint", "FakeRun"]

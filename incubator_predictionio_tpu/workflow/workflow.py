@@ -175,6 +175,17 @@ class CoreWorkflow:
             evaluator = evaluation.evaluator
             eval_data = engine.batch_eval(ctx, engine_params_list, params)
             result = evaluator.evaluate(ctx, evaluation, eval_data, params)
+            if getattr(result, "no_save", False):
+                # FakeWorkflow results are not persisted
+                # (CoreWorkflow.scala:138-142 noSave branch).
+                instances.update(
+                    dataclasses.replace(
+                        instance,
+                        status=CoreWorkflow.EVAL_STATUS_COMPLETED,
+                        end_time=now_utc(),
+                    )
+                )
+                return instance_id, result
             instances.update(
                 dataclasses.replace(
                     instance,

@@ -37,7 +37,7 @@ from incubator_predictionio_tpu.core.base import (
 )
 from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
-from incubator_predictionio_tpu.utils import json_codec
+from incubator_predictionio_tpu.utils import json_codec, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -132,17 +132,26 @@ class Engine:
                     type(data_source).__name__, type(preparator).__name__,
                     [type(a).__name__ for a in algo_list])
 
-        td = data_source.read_training(ctx)
+        with tracing.phase("read"):
+            td = data_source.read_training(ctx)
         _sanity(td, params.skip_sanity_check)
+        if params.verbose >= 3:
+            logger.info("Training data: %s", tracing.debug_string(td))
         if params.stop_after_read:
             raise StopAfterReadInterruption()
 
-        pd = preparator.prepare(ctx, td)
+        with tracing.phase("prepare"):
+            pd = preparator.prepare(ctx, td)
         _sanity(pd, params.skip_sanity_check)
+        if params.verbose >= 3:
+            logger.info("Prepared data: %s", tracing.debug_string(pd))
         if params.stop_after_prepare:
             raise StopAfterPrepareInterruption()
 
-        models = [algo.train(ctx, pd) for algo in algo_list]
+        models = []
+        for i, algo in enumerate(algo_list):
+            with tracing.phase(f"train.algo{i}"):
+                models.append(algo.train(ctx, pd))
         for model in models:
             _sanity(model, params.skip_sanity_check)
         return models

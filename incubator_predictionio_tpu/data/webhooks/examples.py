@@ -92,9 +92,15 @@ class ExampleJsonConnector(JsonConnector):
 
 def _form_context(data: Dict[str, str], required: bool) -> Optional[Dict[str, Any]]:
     """Bracketed two-level form fields → nested context object
-    (ExampleFormConnector.scala:80-127)."""
+    (ExampleFormConnector.scala:80-127). When ``required``, all three
+    context fields must be present (the reference's userActionItem path
+    accesses each unconditionally, so a missing one raises)."""
     if not required and not any(k.startswith("context[") for k in data):
         return None
+    if required:
+        for field in ("context[ip]", "context[prop1]", "context[prop2]"):
+            if field not in data:
+                raise ConnectorError(f"The field '{field}' is required.")
     context: Dict[str, Any] = {}
     if "context[ip]" in data:
         context["ip"] = data["context[ip]"]

@@ -28,7 +28,7 @@ class AdminServer:
         self.access_keys = Storage.get_meta_data_access_keys()
         self.channels = Storage.get_meta_data_channels()
         self.events = Storage.get_events()
-        self.http = HttpServer(self._build_router(), ip, port)
+        self.http = HttpServer.from_conf(self._build_router(), ip, port)
 
     def _build_router(self) -> Router:
         r = Router()

@@ -25,7 +25,7 @@ logger = logging.getLogger(__name__)
 class DashboardServer:
     def __init__(self, ip: str = "127.0.0.1", port: int = 9000):
         self.evaluation_instances = Storage.get_meta_data_evaluation_instances()
-        self.http = HttpServer(self._build_router(), ip, port)
+        self.http = HttpServer.from_conf(self._build_router(), ip, port)
 
     def _build_router(self) -> Router:
         r = Router()

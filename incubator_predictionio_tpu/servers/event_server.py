@@ -84,7 +84,7 @@ class EventServer:
         self.stats = Stats()
         self.plugin_context = plugin_context or PluginContext()
         self.router = self._build_router()
-        self.http = HttpServer(self.router, config.ip, config.port)
+        self.http = HttpServer.from_conf(self.router, config.ip, config.port)
 
     # -- auth (EventServer.scala:93-131) ------------------------------------
     def _authenticate(self, request: Request) -> AuthData:

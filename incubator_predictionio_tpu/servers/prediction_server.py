@@ -92,7 +92,14 @@ class PredictionServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
-        self.http = HttpServer(self._build_router(), config.ip, config.port)
+        from incubator_predictionio_tpu.utils.ssl_config import load_server_key
+
+        # loaded once, like the reference's ServerKey config object
+        self._conf_server_key = (
+            load_server_key() if config.server_key is None else None
+        )
+        self.http = HttpServer.from_conf(self._build_router(), config.ip,
+                                         config.port)
 
     # -- deploy lifecycle ---------------------------------------------------
     def _resolve_instance(self) -> EngineInstance:
@@ -234,9 +241,15 @@ class PredictionServer:
 
     # -- auth for /stop, /reload (common/.../KeyAuthentication.scala:34) ----
     def _check_server_key(self, request: Request) -> None:
-        if self.config.server_key is None:
+        provided = request.query.get("accessKey")
+        if self.config.server_key is not None:
+            if provided != self.config.server_key:
+                raise HttpError(401, "Invalid accessKey.")
             return
-        if request.query.get("accessKey") != self.config.server_key:
+        # No explicit key on the config: fall back to server.conf enforcement
+        # (KeyAuthentication.ServerKey.authEnforced, KeyAuthentication.scala:39)
+        if (self._conf_server_key is not None
+                and not self._conf_server_key.check(provided)):
             raise HttpError(401, "Invalid accessKey.")
 
     # -- routes -------------------------------------------------------------

@@ -8,9 +8,10 @@ DAOs (the moral equivalent of the reference's ``Future { ... }`` blocks
 around blocking storage calls, e.g. EventServer.scala:97).
 
 Deliberately minimal: Content-Length bodies (no chunked uploads), HTTP/1.1
-keep-alive, no TLS termination in-process (run behind a terminating proxy;
-the reference's SSLConfiguration keystore plays that role — see
-utils/ssl.py).
+keep-alive. TLS termination is available by passing an ``ssl_context``
+(built from server.conf by utils/ssl_config.py — the reference's
+SSLConfiguration keystore equivalent); otherwise run behind a terminating
+proxy.
 """
 
 from __future__ import annotations
@@ -21,6 +22,7 @@ import json
 import logging
 import re
 import socket
+import ssl
 import threading
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qsl, unquote, urlsplit
@@ -170,14 +172,26 @@ class HttpServer:
     ``sync()`` helper run on the default thread pool so blocking DAO work
     never stalls the event loop."""
 
-    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0,
+                 ssl_context: Optional["ssl.SSLContext"] = None):
         self.router = router
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+
+    @classmethod
+    def from_conf(cls, router: Router, host: str = "0.0.0.0",
+                  port: int = 0) -> "HttpServer":
+        """Server with TLS material from server.conf when configured
+        (the reference mixes SSLConfiguration into every server)."""
+        from incubator_predictionio_tpu.utils.ssl_config import load_ssl_config
+
+        return cls(router, host, port,
+                   ssl_context=load_ssl_config().ssl_context())
 
     # -- request cycle -----------------------------------------------------
     async def _handle_conn(
@@ -276,11 +290,12 @@ class HttpServer:
         self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port,
-            limit=MAX_HEADER_BYTES,
+            limit=MAX_HEADER_BYTES, ssl=self.ssl_context,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self._started.set()
-        logger.info("http server listening on %s:%d", self.host, self.port)
+        logger.info("http%s server listening on %s:%d",
+                    "s" if self.ssl_context else "", self.host, self.port)
 
     async def serve_forever(self) -> None:
         await self.start()

@@ -1,0 +1,139 @@
+"""Per-phase wall-clock tracing + optional device profiling.
+
+The reference's nearest mechanisms are per-query latency bookkeeping in the
+prediction server (CreateServer.scala:426-428,611-618), `WorkflowParams.
+verbose` with `debugString` RDD dumps (WorkflowUtils.scala:217-239), and the
+implicit Spark UI. The TPU build replaces them with an explicit tracer: the
+workflow runner times every pipeline phase (read/prepare/train/checkpoint),
+records the timings on the EngineInstance, and can capture a device-level
+``jax.profiler`` trace for TensorBoard when a profile dir is configured.
+
+Usage::
+
+    tracer = Tracer()
+    with tracer.activate():
+        with phase("read"):
+            ...
+    tracer.timings  # {"read": 0.123}
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import time
+from typing import Any, Dict, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+_current: contextvars.ContextVar[Optional["Tracer"]] = contextvars.ContextVar(
+    "pio_tpu_tracer", default=None
+)
+
+
+class Tracer:
+    """Accumulates named phase durations (seconds) for one workflow run."""
+
+    def __init__(self, profile_dir: Optional[str] = None):
+        self.timings: Dict[str, float] = {}
+        self.profile_dir = profile_dir
+        self._profiling = False
+
+    # -- activation --------------------------------------------------------
+    @contextlib.contextmanager
+    def activate(self) -> Iterator["Tracer"]:
+        """Install as the ambient tracer; starts/stops the jax profiler
+        when ``profile_dir`` is set."""
+        token = _current.set(self)
+        self._start_profiler()
+        try:
+            yield self
+        finally:
+            self._stop_profiler()
+            _current.reset(token)
+
+    def _start_profiler(self) -> None:
+        if not self.profile_dir:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+            logger.info("tracing: jax profiler capturing to %s",
+                        self.profile_dir)
+        except Exception:
+            logger.warning("tracing: could not start jax profiler",
+                           exc_info=True)
+
+    def _stop_profiler(self) -> None:
+        if not self._profiling:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            logger.warning("tracing: could not stop jax profiler",
+                           exc_info=True)
+        self._profiling = False
+
+    # -- recording ---------------------------------------------------------
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.timings[name] = self.timings.get(name, 0.0) + dt
+            logger.info("tracing: phase %s took %.3fs", name, dt)
+
+    def summary(self) -> str:
+        total = sum(self.timings.values())
+        parts = ", ".join(
+            f"{k}={v:.3f}s" for k, v in self.timings.items()
+        )
+        return f"total={total:.3f}s ({parts})"
+
+    def to_conf(self) -> Dict[str, str]:
+        """Phase timings as string values for EngineInstance.runtime_conf."""
+        return {
+            f"phase.{name}_s": f"{secs:.6f}"
+            for name, secs in self.timings.items()
+        }
+
+
+def current() -> Optional[Tracer]:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Time a phase on the ambient tracer; no-op when none is active."""
+    tracer = _current.get()
+    if tracer is None:
+        yield
+        return
+    with tracer.phase(name):
+        yield
+
+
+def debug_string(obj: Any, max_items: int = 10) -> str:
+    """Human dump of a pipeline intermediate (WorkflowUtils.debugString
+    parity — there it collects an RDD; here it summarizes arrays/sequences
+    without forcing a device transfer of the full buffer)."""
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        return f"<array shape={tuple(obj.shape)} dtype={obj.dtype}>"
+    if isinstance(obj, dict):
+        items = list(obj.items())[:max_items]
+        body = ", ".join(f"{k!r}: {debug_string(v)}" for k, v in items)
+        more = "" if len(obj) <= max_items else f", … +{len(obj)-max_items}"
+        return "{" + body + more + "}"
+    if isinstance(obj, (list, tuple)):
+        items = [debug_string(x) for x in obj[:max_items]]
+        more = [] if len(obj) <= max_items else [f"… +{len(obj)-max_items}"]
+        return "[" + ", ".join(items + more) + "]"
+    out = repr(obj)
+    return out if len(out) <= 200 else out[:200] + "…"

@@ -25,7 +25,7 @@ from incubator_predictionio_tpu.data.storage import (
     Storage,
 )
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
-from incubator_predictionio_tpu.utils import json_codec
+from incubator_predictionio_tpu.utils import json_codec, tracing
 from incubator_predictionio_tpu.utils.times import now_utc
 from incubator_predictionio_tpu.workflow import checkpoint
 
@@ -89,27 +89,37 @@ class CoreWorkflow:
         instance_id = instances.insert(instance)
         instance = dataclasses.replace(instance, id=instance_id)
         logger.info("Training engine instance %s", instance_id)
+        tracer = tracing.Tracer(
+            profile_dir=params.runtime_conf.get("profile_dir") or None
+        )
         try:
             instances.update(
                 dataclasses.replace(instance,
                                     status=CoreWorkflow.TRAIN_STATUS_TRAINING)
             )
-            models = engine.train(ctx, engine_params, params)
-            algo_params = [p for _n, p in engine_params.algorithm_params_list]
-            blob = checkpoint.serialize_models(
-                models, instance_id, ctx, algo_params=algo_params
-            )
-            Storage.get_model_data_models().insert(Model(instance_id, blob))
+            with tracer.activate():
+                models = engine.train(ctx, engine_params, params)
+                algo_params = [
+                    p for _n, p in engine_params.algorithm_params_list
+                ]
+                with tracing.phase("checkpoint"):
+                    blob = checkpoint.serialize_models(
+                        models, instance_id, ctx, algo_params=algo_params
+                    )
+                    Storage.get_model_data_models().insert(
+                        Model(instance_id, blob)
+                    )
             instances.update(
                 dataclasses.replace(
                     instance,
                     status=CoreWorkflow.TRAIN_STATUS_COMPLETED,
                     end_time=now_utc(),
+                    runtime_conf={**instance.runtime_conf, **tracer.to_conf()},
                 )
             )
             logger.info(
-                "Training completed; engine instance %s saved (%d bytes of models)",
-                instance_id, len(blob),
+                "Training completed; engine instance %s saved (%d bytes of "
+                "models); %s", instance_id, len(blob), tracer.summary(),
             )
         except Exception:
             instances.update(

@@ -155,6 +155,12 @@ def test_example_form_connector():
     assert out["properties"]["anotherPropertyB"] is False
     with pytest.raises(ConnectorError):
         ExampleFormConnector().to_event_json({"type": "bad"})
+    with pytest.raises(ConnectorError):
+        # userActionItem requires all context[...] fields
+        ExampleFormConnector().to_event_json({
+            "type": "userActionItem", "userId": "u", "event": "view",
+            "itemId": "i1", "timestamp": "2015-01-15T04:20:23.567Z",
+        })
 
 
 # ---------------------------------------------------------------------------
@@ -233,7 +239,7 @@ def test_fake_run(tmp_path, monkeypatch):
             run, run.engine_params_list, evaluation_class="test:fake",
         )
         assert len(calls) == 1
-        assert calls[0].mesh is not None or calls[0] is not None
+        assert calls[0] is not None  # func received the RuntimeContext
         assert result.no_save is True
         instance = Storage.get_meta_data_evaluation_instances().get(instance_id)
         assert instance.status == "EVALCOMPLETED"

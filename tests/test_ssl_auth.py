@@ -1,0 +1,157 @@
+"""SSL configuration + server-key authentication tests.
+
+Parity targets: common/.../configuration/SSLConfiguration.scala (https on
+all servers) and common/.../authentication/KeyAuthentication.scala
+(enforced accessKey on /stop,/reload).
+"""
+
+import json
+import ssl
+import subprocess
+import urllib.request
+
+import pytest
+
+from incubator_predictionio_tpu.utils.ssl_config import (
+    ServerKeyConfig,
+    SSLConfig,
+    load_server_conf,
+    load_server_key,
+    load_ssl_config,
+    parse_server_conf,
+)
+
+CONF = """
+# comment
+// another comment
+pio.server.ssl-certfile = /tmp/server.crt
+pio.server.ssl-keyfile  = "/tmp/server.key"
+pio.server.key-auth-enforced = true
+pio.server.accessKey = sekrit
+"""
+
+
+def test_parse_server_conf():
+    conf = parse_server_conf(CONF)
+    assert conf["pio.server.ssl-certfile"] == "/tmp/server.crt"
+    assert conf["pio.server.ssl-keyfile"] == "/tmp/server.key"
+    assert conf["pio.server.key-auth-enforced"] == "true"
+
+
+def test_parse_server_conf_inline_comments():
+    conf = parse_server_conf(
+        "pio.server.ssl-keyfile-pass = secret        # optional\n"
+        "pio.server.accessKey = ab#cd   // trailing\n"
+    )
+    assert conf["pio.server.ssl-keyfile-pass"] == "secret"
+    assert conf["pio.server.accessKey"] == "ab#cd"  # '#' inside value kept
+
+
+def test_load_from_conf_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_CONF_DIR", str(tmp_path))
+    (tmp_path / "server.conf").write_text(CONF)
+    assert load_ssl_config().certfile == "/tmp/server.crt"
+    key = load_server_key()
+    assert key.auth_enforced is True
+    assert key.check("sekrit") is True
+    assert key.check("wrong") is False
+    assert key.check(None) is False
+
+
+def test_missing_conf_is_permissive(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_CONF_DIR", str(tmp_path))
+    assert load_server_conf() == {}
+    assert load_ssl_config().enabled is False
+    assert load_ssl_config().ssl_context() is None
+    key = load_server_key()
+    assert key.auth_enforced is False
+    assert key.check(None) is True  # authEnforced=false passes everything
+
+
+def _make_self_signed(tmp_path):
+    crt, key = tmp_path / "server.crt", tmp_path / "server.key"
+    proc = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(crt), "-days", "1",
+         "-subj", "/CN=localhost"],
+        capture_output=True,
+    )
+    if proc.returncode != 0:
+        pytest.skip("openssl unavailable for self-signed cert generation")
+    return crt, key
+
+
+def test_https_round_trip(tmp_path, monkeypatch):
+    """A server constructed with server.conf TLS material serves https."""
+    crt, key = _make_self_signed(tmp_path)
+    conf_dir = tmp_path / "conf"
+    conf_dir.mkdir()
+    (conf_dir / "server.conf").write_text(
+        f"pio.server.ssl-certfile = {crt}\n"
+        f"pio.server.ssl-keyfile = {key}\n"
+    )
+    monkeypatch.setenv("PIO_CONF_DIR", str(conf_dir))
+
+    from incubator_predictionio_tpu.utils.http import (
+        HttpServer,
+        Response,
+        Router,
+    )
+    from incubator_predictionio_tpu.utils.ssl_config import load_ssl_config
+
+    router = Router()
+
+    @router.get("/")
+    def root(request):
+        return Response(200, {"secure": True})
+
+    server = HttpServer(router, "127.0.0.1", 0,
+                        ssl_context=load_ssl_config().ssl_context())
+    port = server.start_background()
+    try:
+        client_ctx = ssl.create_default_context()
+        client_ctx.check_hostname = False
+        client_ctx.verify_mode = ssl.CERT_NONE
+        with urllib.request.urlopen(
+            f"https://127.0.0.1:{port}/", context=client_ctx, timeout=10
+        ) as resp:
+            assert json.loads(resp.read()) == {"secure": True}
+    finally:
+        server.stop()
+
+
+def test_prediction_server_key_auth_from_conf(tmp_path, monkeypatch):
+    """/stop,/reload reject without the conf-enforced key when the server
+    has no explicit --server-key."""
+    conf_dir = tmp_path / "conf"
+    conf_dir.mkdir()
+    (conf_dir / "server.conf").write_text(
+        "pio.server.key-auth-enforced = true\n"
+        "pio.server.accessKey = sekrit\n"
+    )
+    monkeypatch.setenv("PIO_CONF_DIR", str(conf_dir))
+
+    from incubator_predictionio_tpu.servers.prediction_server import (
+        PredictionServer,
+        ServerConfig,
+    )
+    from incubator_predictionio_tpu.utils.http import HttpError, Request
+
+    server = PredictionServer.__new__(PredictionServer)
+    server.config = ServerConfig(server_key=None)
+    server._conf_server_key = load_server_key()
+
+    def req(query):
+        return Request("POST", "/stop", query, {}, b"")
+
+    with pytest.raises(HttpError):
+        server._check_server_key(req({}))
+    with pytest.raises(HttpError):
+        server._check_server_key(req({"accessKey": "wrong"}))
+    server._check_server_key(req({"accessKey": "sekrit"}))  # passes
+
+
+def test_server_key_config_check():
+    k = ServerKeyConfig(auth_enforced=True, key="k1")
+    assert k.check("k1") and not k.check("k2") and not k.check(None)
+    assert ServerKeyConfig(auth_enforced=False).check(None)

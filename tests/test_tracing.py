@@ -1,0 +1,69 @@
+"""Tracing/profiling subsystem tests (SURVEY §5: the TPU build's replacement
+for the reference's latency bookkeeping + verbose debugString dumps)."""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.utils import tracing
+
+
+def test_phase_noop_without_tracer():
+    with tracing.phase("anything"):
+        pass  # must not raise
+    assert tracing.current() is None
+
+
+def test_tracer_records_phases():
+    tracer = tracing.Tracer()
+    with tracer.activate():
+        assert tracing.current() is tracer
+        with tracing.phase("read"):
+            pass
+        with tracing.phase("train.algo0"):
+            pass
+        with tracing.phase("read"):   # repeated phases accumulate
+            pass
+    assert set(tracer.timings) == {"read", "train.algo0"}
+    assert all(v >= 0 for v in tracer.timings.values())
+    conf = tracer.to_conf()
+    assert set(conf) == {"phase.read_s", "phase.train.algo0_s"}
+    assert "total=" in tracer.summary()
+    assert tracing.current() is None
+
+
+def test_debug_string_summarizes():
+    arr = np.zeros((3, 4), np.float32)
+    assert tracing.debug_string(arr) == "<array shape=(3, 4) dtype=float32>"
+    s = tracing.debug_string(list(range(100)))
+    assert "+90" in s
+    s = tracing.debug_string({i: i for i in range(20)})
+    assert "+10" in s
+
+
+def test_run_train_records_phase_timings(tmp_path, monkeypatch):
+    """Phase timings land on the completed EngineInstance.runtime_conf."""
+    from tests.fake_engine import make_engine, params as make_engine_params
+
+    monkeypatch.setenv("PIO_HOME", str(tmp_path))
+    Storage.configure({"PIO_STORAGE_SOURCES_T_TYPE": "memory",
+                       "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+                       "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "T",
+                       "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+                       "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "T",
+                       "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+                       "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "T"})
+    try:
+        from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+        engine = make_engine()
+        instance_id = CoreWorkflow.run_train(engine, make_engine_params())
+        instance = Storage.get_meta_data_engine_instances().get(instance_id)
+        assert instance.status == "COMPLETED"
+        assert "phase.read_s" in instance.runtime_conf
+        assert "phase.prepare_s" in instance.runtime_conf
+        assert "phase.train.algo0_s" in instance.runtime_conf
+        assert "phase.checkpoint_s" in instance.runtime_conf
+        assert float(instance.runtime_conf["phase.read_s"]) >= 0
+    finally:
+        Storage.reset()

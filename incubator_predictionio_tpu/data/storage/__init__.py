@@ -57,6 +57,8 @@ _BACKENDS: Dict[str, str] = {
     "memory": "incubator_predictionio_tpu.data.storage.memory",
     "sqlite": "incubator_predictionio_tpu.data.storage.sqlite",
     "localfs": "incubator_predictionio_tpu.data.storage.localfs",
+    # native append-only event log (the HBase-driver role; events only)
+    "cpplog": "incubator_predictionio_tpu.data.storage.cpplog",
 }
 
 MetaDataRepository = "METADATA"

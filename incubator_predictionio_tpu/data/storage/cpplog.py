@@ -1,0 +1,258 @@
+"""``cpplog`` event backend — the native append-only event-store engine.
+
+The high-throughput event store, playing the HBase driver's role in the
+reference (data/.../storage/hbase/HB{L,P}Events.scala: hashed row keys, one
+table per app/channel, server-side scan filters). Storage engine is
+``native/src/eventlog.cc`` (C++, ctypes-bound): one framed append-only log
+file per (namespace, app, channel); record headers carry event time and
+FNV-1a hashes of the filterable fields so time-range / entity / event-name
+scans are pushed down to C++ without parsing JSON; deletes are tombstones.
+The DAO re-checks every predicate on the JSON payload, so hash collisions
+cannot produce wrong results — only wasted candidate reads.
+
+Events only (``PIO_STORAGE_REPOSITORIES_EVENTDATA_{NAME,SOURCE}`` →
+``TYPE=cpplog``); metadata/models stay on sqlite/memory/localfs, mirroring
+how the reference mixes HBase event data with JDBC/ES metadata.
+
+Like the localfs model store, a log directory is owned by one server
+process at a time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import threading
+from datetime import datetime
+from pathlib import Path
+from typing import Any, Iterator, Optional, Sequence
+
+from incubator_predictionio_tpu import native
+from incubator_predictionio_tpu.data.event import (
+    Event,
+    new_event_id,
+    validate_event,
+)
+from incubator_predictionio_tpu.data.storage import base
+from incubator_predictionio_tpu.data.storage.base import UNSET
+from incubator_predictionio_tpu.utils.times import to_millis
+
+_I64_MIN = -(1 << 63)
+_I64_MAX = (1 << 63) - 1
+
+
+def _h(s: Optional[str]) -> int:
+    return 0 if s is None else native.fnv1a64(s.encode("utf-8"))
+
+
+class StorageClient(base.BaseStorageClient):
+    """Holds the log directory and open native handles."""
+
+    def __init__(self, config: base.StorageClientConfig):
+        super().__init__(config)
+        lib = native.load()
+        if lib is None:
+            raise base.StorageError(
+                "cpplog backend requires the native library (g++ toolchain)")
+        self.lib = lib
+        from incubator_predictionio_tpu.data.storage import pio_home
+        path = config.properties.get("PATH") or str(
+            Path(pio_home()) / "cpplog")
+        self.dir = Path(path)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.lock = threading.RLock()
+        self._handles: dict[str, int] = {}
+
+    def _file(self, ns: str, app_id: int, channel_id: Optional[int]) -> Path:
+        chan = 0 if channel_id is None else channel_id
+        return self.dir / f"{ns}app{app_id}_ch{chan}.log"
+
+    def handle(self, ns: str, app_id: int, channel_id: Optional[int]) -> int:
+        key = str(self._file(ns, app_id, channel_id))
+        with self.lock:
+            h = self._handles.get(key)
+            if h is None:
+                h = self.lib.pio_evlog_open(key.encode())
+                if not h:
+                    raise base.StorageError(f"cannot open event log {key}")
+                self._handles[key] = h
+            return h
+
+    def drop(self, ns: str, app_id: int, channel_id: Optional[int]) -> bool:
+        path = self._file(ns, app_id, channel_id)
+        key = str(path)
+        with self.lock:
+            h = self._handles.pop(key, None)
+            if h is not None:
+                self.lib.pio_evlog_close(h)
+            path.unlink(missing_ok=True)
+        return True
+
+    def close(self) -> None:
+        with self.lock:
+            for h in self._handles.values():
+                self.lib.pio_evlog_close(h)
+            self._handles.clear()
+
+
+class CppLogEvents(base.Events):
+    """Events DAO over the native log (contract: LEvents.scala:40-492)."""
+
+    def __init__(self, client: StorageClient,
+                 config: base.StorageClientConfig, prefix: str = ""):
+        self.client = client
+        self.ns = prefix
+
+    def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
+        return self.client.handle(self.ns, app_id, channel_id)
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._handle(app_id, channel_id)
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return self.client.drop(self.ns, app_id, channel_id)
+
+    def close(self) -> None:  # client-owned handles stay for other DAOs
+        pass
+
+    # -- record io ---------------------------------------------------------
+    def _read(self, h: int, index: int) -> Optional[dict]:
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self.client.lib.pio_evlog_read(h, index, buf, cap)
+            if n < 0:
+                return None
+            if n <= cap:
+                return json.loads(buf.raw[:n].decode("utf-8"))
+            cap = n
+
+    def _candidates_by_id(self, h: int, event_id: str) -> list[int]:
+        cap = 64
+        out = (ctypes.c_int64 * cap)()
+        n = self.client.lib.pio_evlog_find_id(h, _h(event_id), out, cap)
+        return list(out[:n])
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        validate_event(event)
+        h = self._handle(app_id, channel_id)
+        if event.event_id:
+            # upsert semantics (parity with the sqlite backend's INSERT OR
+            # REPLACE): tombstone any existing record with this event id.
+            # Only explicit ids can collide — freshly minted UUIDs skip the
+            # scan so bulk ingest stays O(1) per event.
+            eid = event.event_id
+            for idx in self._candidates_by_id(h, eid):
+                obj = self._read(h, idx)
+                if obj is not None and obj.get("eventId") == eid:
+                    self.client.lib.pio_evlog_tombstone(h, idx)
+        else:
+            eid = new_event_id()
+        payload = json.dumps(
+            event.with_id(eid).to_jsonable(), separators=(",", ":")
+        ).encode("utf-8")
+        rc = self.client.lib.pio_evlog_append(
+            h, to_millis(event.event_time), _h(event.entity_type),
+            _h(event.entity_id), _h(event.event), _h(eid),
+            payload, len(payload),
+        )
+        if rc < 0:
+            raise base.StorageError("event log append failed")
+        return eid
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        h = self._handle(app_id, channel_id)
+        for idx in self._candidates_by_id(h, event_id):
+            obj = self._read(h, idx)
+            if obj is not None and obj.get("eventId") == event_id:
+                return Event.from_jsonable(obj)
+        return None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        h = self._handle(app_id, channel_id)
+        for idx in self._candidates_by_id(h, event_id):
+            obj = self._read(h, idx)
+            if obj is not None and obj.get("eventId") == event_id:
+                return self.client.lib.pio_evlog_tombstone(h, idx) == 0
+        return False
+
+    # -- query -------------------------------------------------------------
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        h = self._handle(app_id, channel_id)
+        lib = self.client.lib
+        names = None if event_names is None else list(event_names)
+        if names is not None and not names:
+            return iter(())  # IN () matches nothing (sqlite parity)
+        n_names = 0 if names is None else len(names)
+        name_arr = ((ctypes.c_uint64 * n_names)(*map(_h, names))
+                    if n_names else None)
+        # the target-entity predicates are not in the native header, so the
+        # C-side limit can only apply when they are absent
+        post_filter = target_entity_type is not UNSET or \
+            target_entity_id is not UNSET
+        want = -1 if limit is None or limit < 0 else limit
+        c_limit = -1 if post_filter else want
+        total = lib.pio_evlog_count(h)
+        cap = total if c_limit < 0 else min(total, c_limit)
+        out = (ctypes.c_int64 * max(cap, 1))()
+        n = lib.pio_evlog_query(
+            h,
+            _I64_MIN if start_time is None else to_millis(start_time),
+            _I64_MAX if until_time is None else to_millis(until_time),
+            _h(entity_type) if entity_type is not None else 0,
+            _h(entity_id) if entity_id is not None else 0,
+            name_arr, n_names, 1 if reversed else 0, c_limit, out, cap,
+        )
+
+        # materialize payload reads NOW: the returned iterator must not
+        # touch the native handle, which remove()/close() may free before
+        # the consumer finishes draining (the sqlite backend is eager for
+        # the same reason)
+        objs = [self._read(h, out[i]) for i in range(n)]
+
+        def gen() -> Iterator[Event]:
+            emitted = 0
+            for obj in objs:
+                if obj is None:
+                    continue
+                ev = Event.from_jsonable(obj)
+                # exact re-checks: hashes prune, Python decides
+                if entity_type is not None and ev.entity_type != entity_type:
+                    continue
+                if entity_id is not None and ev.entity_id != entity_id:
+                    continue
+                if names is not None and ev.event not in names:
+                    continue
+                if target_entity_type is not UNSET and \
+                        ev.target_entity_type != target_entity_type:
+                    continue
+                if target_entity_id is not UNSET and \
+                        ev.target_entity_id != target_entity_id:
+                    continue
+                yield ev
+                emitted += 1
+                if want >= 0 and emitted >= want:
+                    return
+
+        return gen()
+
+
+DATA_OBJECTS = {"Events": CppLogEvents}

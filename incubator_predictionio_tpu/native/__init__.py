@@ -1,0 +1,144 @@
+"""Native (C++) runtime components and their lazy build.
+
+The reference is pure JVM — its native performance arrives transitively via
+Spark/netlib (SURVEY.md "Languages"). This framework's compute path is
+XLA/Pallas; the *runtime around it* is native where it is hot:
+
+- ``src/eventlog.cc``  — append-only event-store engine (the HBase-driver
+  role, data/.../storage/hbase/ in the reference)
+- ``src/csr_builder.cc`` — COO → degree-bucketed padded rows (the host data
+  loader feeding device ingest)
+
+The shared library is compiled on first use with the system ``g++`` (no pip
+deps, mirroring how the reference compiles engines on demand via ``pio
+build`` → sbt, tools/.../commands/Engine.scala:158-225) and cached next to
+the sources keyed on their mtimes. Everything degrades gracefully: callers
+check :func:`load` for ``None`` and fall back to pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = Path(__file__).parent / "src"
+_BUILD_DIR = Path(__file__).parent / "_build"
+_SOURCES = ("eventlog.cc", "csr_builder.cc")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def lib_path() -> Path:
+    return _BUILD_DIR / "libpio_native.so"
+
+
+def _needs_build(so: Path) -> bool:
+    if not so.exists():
+        return True
+    so_mtime = so.stat().st_mtime
+    return any(
+        (_SRC_DIR / s).stat().st_mtime > so_mtime for s in _SOURCES
+    )
+
+
+def build(force: bool = False) -> Path:
+    """Compile the native library (idempotent; mtime-cached)."""
+    so = lib_path()
+    if not force and not _needs_build(so):
+        return so
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-std=c++17", "-shared", "-fPIC",
+        *[str(_SRC_DIR / s) for s in _SOURCES],
+        "-o", str(so),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return so
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    u64p = c.POINTER(c.c_uint64)
+    i64p = c.POINTER(c.c_int64)
+    # eventlog
+    lib.pio_evlog_open.restype = c.c_void_p
+    lib.pio_evlog_open.argtypes = [c.c_char_p]
+    lib.pio_evlog_close.restype = None
+    lib.pio_evlog_close.argtypes = [c.c_void_p]
+    lib.pio_evlog_append.restype = c.c_int64
+    lib.pio_evlog_append.argtypes = [
+        c.c_void_p, c.c_int64, c.c_uint64, c.c_uint64, c.c_uint64,
+        c.c_uint64, c.c_char_p, c.c_uint32,
+    ]
+    lib.pio_evlog_tombstone.restype = c.c_int64
+    lib.pio_evlog_tombstone.argtypes = [c.c_void_p, c.c_int64]
+    lib.pio_evlog_count.restype = c.c_int64
+    lib.pio_evlog_count.argtypes = [c.c_void_p]
+    lib.pio_evlog_query.restype = c.c_int64
+    lib.pio_evlog_query.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int64, c.c_uint64, c.c_uint64,
+        u64p, c.c_int32, c.c_int32, c.c_int64, i64p, c.c_int64,
+    ]
+    lib.pio_evlog_find_id.restype = c.c_int64
+    lib.pio_evlog_find_id.argtypes = [c.c_void_p, c.c_uint64, i64p, c.c_int64]
+    lib.pio_evlog_read.restype = c.c_int32
+    lib.pio_evlog_read.argtypes = [
+        c.c_void_p, c.c_int64, c.c_char_p, c.c_int32,
+    ]
+    # csr builder
+    pp_i32 = c.POINTER(c.POINTER(c.c_int32))
+    pp_f32 = c.POINTER(c.POINTER(c.c_float))
+    lib.pio_csr_plan.restype = c.c_int64
+    lib.pio_csr_plan.argtypes = [
+        c.POINTER(c.c_int32), c.c_int64, c.c_int64, c.c_int32, c.c_int32,
+        c.c_int32, i64p,
+    ]
+    lib.pio_csr_fill.restype = c.c_int64
+    lib.pio_csr_fill.argtypes = [
+        c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_float),
+        c.c_int64, c.c_int64, c.c_int32, c.c_int32, c.c_int32,
+        pp_i32, pp_i32, pp_f32, pp_f32,
+    ]
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            so = build()
+            lib = ctypes.CDLL(str(so))
+            _declare(lib)
+            _lib = lib
+        except Exception as exc:  # toolchain missing / compile error
+            _load_failed = True
+            logger.warning(
+                "native library unavailable (%s); using pure-Python "
+                "fallbacks", exc)
+    return _lib
+
+
+def fnv1a64(data: bytes) -> int:
+    """FNV-1a 64-bit — the hash the eventlog headers use for predicate
+    pushdown. 0 is reserved as the "no filter" sentinel, so real hashes of 0
+    are mapped to 1 (a one-in-2⁶⁴ bias, invisible next to the exact-match
+    recheck in the DAO)."""
+    h = 0xCBF29CE484222325
+    for byte in data:
+        h ^= byte
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h or 1

@@ -1,0 +1,236 @@
+// Append-only event log — the native event-store engine.
+//
+// Plays the role the HBase driver plays in the reference
+// (data/.../storage/hbase/: hashed row keys + column-family scans feeding the
+// event DAO): a high-throughput, file-backed event store with header-level
+// predicate pushdown. The design is TPU-serving-native instead of a
+// translation: one framed append-only log per (app, channel), a 48-byte
+// fixed header per record carrying the event time and FNV-1a hashes of the
+// filterable fields, and an in-memory index built on open so time-range /
+// entity / event-name scans never parse JSON. The Python DAO
+// (data/storage/cpplog.py) keeps payloads as JSON and does the final
+// exact-match check on the (rare) hash candidates.
+//
+// Concurrency: one process owns a log file at a time (like the localfs
+// model store); within the process all calls are serialized by a mutex.
+// Deletes are tombstone records so the file stays append-only.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <unistd.h>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+struct __attribute__((packed)) RecHeader {
+  int64_t time_ms;
+  uint64_t etype_hash;  // entity type
+  uint64_t eid_hash;    // entity id
+  uint64_t name_hash;   // event name
+  uint64_t id_hash;     // event id
+  uint32_t payload_len;
+  uint32_t flags;       // 1 = tombstone (payload = 8-byte target index)
+};
+
+static_assert(sizeof(RecHeader) == 48, "header layout is the disk format");
+
+struct Entry {
+  int64_t time_ms;
+  uint64_t etype_hash, eid_hash, name_hash, id_hash;
+  uint64_t offset;      // of payload
+  uint32_t payload_len;
+  bool dead;
+};
+
+struct EventLog {
+  FILE* f = nullptr;
+  std::vector<Entry> entries;
+  std::vector<int64_t> sorted;  // indices ordered by (time_ms, idx)
+  bool sorted_dirty = true;
+  int64_t last_time = INT64_MIN; // fast-path: appends already in order
+  std::mutex mu;
+};
+
+static void resort(EventLog* log) {
+  if (!log->sorted_dirty) return;
+  log->sorted.resize(log->entries.size());
+  for (size_t i = 0; i < log->sorted.size(); ++i) log->sorted[i] = (int64_t)i;
+  std::stable_sort(log->sorted.begin(), log->sorted.end(),
+                   [&](int64_t a, int64_t b) {
+                     return log->entries[a].time_ms < log->entries[b].time_ms;
+                   });
+  log->sorted_dirty = false;
+}
+
+void* pio_evlog_open(const char* path) {
+  FILE* f = fopen(path, "a+b");
+  if (!f) return nullptr;
+  auto* log = new EventLog();
+  log->f = f;
+  // build the index: one sequential header scan
+  fseeko(f, 0, SEEK_SET);
+  RecHeader h;
+  while (fread(&h, sizeof(h), 1, f) == 1) {
+    uint64_t off = (uint64_t)ftello(f);
+    if (h.flags & 1) {  // tombstone
+      int64_t target = -1;
+      if (h.payload_len == 8 && fread(&target, 8, 1, f) == 1 &&
+          target >= 0 && (size_t)target < log->entries.size()) {
+        log->entries[target].dead = true;
+      } else {
+        fseeko(f, (off_t)(off + h.payload_len), SEEK_SET);
+      }
+      log->entries.push_back({0, 0, 0, 0, 0, off, h.payload_len, true});
+    } else {
+      log->last_time = std::max(log->last_time, h.time_ms);
+      log->entries.push_back({h.time_ms, h.etype_hash, h.eid_hash,
+                              h.name_hash, h.id_hash, off, h.payload_len,
+                              false});
+      fseeko(f, (off_t)(off + h.payload_len), SEEK_SET);
+    }
+  }
+  log->sorted_dirty = true;
+  fseeko(f, 0, SEEK_END);
+  return log;
+}
+
+void pio_evlog_close(void* handle) {
+  auto* log = (EventLog*)handle;
+  if (!log) return;
+  if (log->f) fclose(log->f);
+  delete log;
+}
+
+int64_t pio_evlog_append(void* handle, int64_t time_ms, uint64_t etype_hash,
+                         uint64_t eid_hash, uint64_t name_hash,
+                         uint64_t id_hash, const uint8_t* payload,
+                         uint32_t len) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  RecHeader h{time_ms, etype_hash, eid_hash, name_hash, id_hash, len, 0};
+  fseeko(log->f, 0, SEEK_END);
+  off_t rec_start = ftello(log->f);
+  uint64_t off = (uint64_t)rec_start + sizeof(h);
+  if (fwrite(&h, sizeof(h), 1, log->f) != 1 ||
+      (len && fwrite(payload, 1, len, log->f) != len)) {
+    // never leave a partial record: it would misframe every later record
+    // on the reopen scan
+    fflush(log->f);
+    (void)!ftruncate(fileno(log->f), rec_start);
+    clearerr(log->f);
+    fseeko(log->f, 0, SEEK_END);
+    return -1;
+  }
+  fflush(log->f);
+  log->entries.push_back(
+      {time_ms, etype_hash, eid_hash, name_hash, id_hash, off, len, false});
+  if (time_ms >= log->last_time && !log->sorted_dirty) {
+    log->sorted.push_back((int64_t)log->entries.size() - 1);  // stays sorted
+  } else {
+    log->sorted_dirty = true;
+  }
+  log->last_time = std::max(log->last_time, time_ms);
+  return (int64_t)log->entries.size() - 1;
+}
+
+int64_t pio_evlog_tombstone(void* handle, int64_t index) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  if (index < 0 || (size_t)index >= log->entries.size()) return -1;
+  if (log->entries[index].dead) return -1;
+  RecHeader h{0, 0, 0, 0, 0, 8, 1};
+  fseeko(log->f, 0, SEEK_END);
+  off_t rec_start = ftello(log->f);
+  uint64_t off = (uint64_t)rec_start + sizeof(h);
+  if (fwrite(&h, sizeof(h), 1, log->f) != 1 ||
+      fwrite(&index, 8, 1, log->f) != 1) {
+    fflush(log->f);
+    (void)!ftruncate(fileno(log->f), rec_start);
+    clearerr(log->f);
+    fseeko(log->f, 0, SEEK_END);
+    return -1;
+  }
+  fflush(log->f);
+  log->entries[index].dead = true;
+  log->entries.push_back({0, 0, 0, 0, 0, off, 8, true});
+  log->sorted_dirty = true;
+  return 0;
+}
+
+int64_t pio_evlog_count(void* handle) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  int64_t n = 0;
+  for (auto& e : log->entries)
+    if (!e.dead) ++n;
+  return n;
+}
+
+// Header-level scan. 0 hash = "no filter" (the Python side maps real hashes
+// of 0 to 1). Returns the number of record indices written to `out`,
+// time-ordered (ties by append order), reversed/limit applied like
+// LEvents.futureFind (reference data/.../storage/LEvents.scala:167-182).
+int64_t pio_evlog_query(void* handle, int64_t start_ms, int64_t until_ms,
+                        uint64_t etype_hash, uint64_t eid_hash,
+                        const uint64_t* name_hashes, int32_t n_names,
+                        int32_t reversed, int64_t limit, int64_t* out,
+                        int64_t cap) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  resort(log);
+  int64_t n = 0;
+  int64_t total = (int64_t)log->sorted.size();
+  for (int64_t step = 0; step < total; ++step) {
+    int64_t idx = log->sorted[reversed ? total - 1 - step : step];
+    const Entry& e = log->entries[idx];
+    if (e.dead) continue;
+    if (e.time_ms < start_ms || e.time_ms >= until_ms) continue;
+    if (etype_hash && e.etype_hash != etype_hash) continue;
+    if (eid_hash && e.eid_hash != eid_hash) continue;
+    if (n_names > 0) {
+      bool hit = false;
+      for (int32_t i = 0; i < n_names; ++i)
+        if (e.name_hash == name_hashes[i]) { hit = true; break; }
+      if (!hit) continue;
+    }
+    if (n >= cap) break;
+    out[n++] = idx;
+    if (limit >= 0 && n >= limit) break;
+  }
+  return n;
+}
+
+int64_t pio_evlog_find_id(void* handle, uint64_t id_hash, int64_t* out,
+                          int64_t cap) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  int64_t n = 0;
+  for (size_t i = 0; i < log->entries.size() && n < cap; ++i) {
+    const Entry& e = log->entries[i];
+    if (!e.dead && e.id_hash == id_hash) out[n++] = (int64_t)i;
+  }
+  return n;
+}
+
+// Returns the payload length; copies into buf only when it fits. Dead or
+// out-of-range records return -1.
+int32_t pio_evlog_read(void* handle, int64_t index, uint8_t* buf,
+                       int32_t cap) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  if (index < 0 || (size_t)index >= log->entries.size()) return -1;
+  const Entry& e = log->entries[index];
+  if (e.dead) return -1;
+  if ((int32_t)e.payload_len <= cap) {
+    fseeko(log->f, (off_t)e.offset, SEEK_SET);
+    if (fread(buf, 1, e.payload_len, log->f) != e.payload_len) return -1;
+    fseeko(log->f, 0, SEEK_END);
+  }
+  return (int32_t)e.payload_len;
+}
+
+}  // extern "C"

@@ -63,6 +63,10 @@ class PaddedRows:
         )
 
 
+#: triplet count above which the C++ builder is worth its call overhead
+NATIVE_MIN_NNZ = 100_000
+
+
 def build_padded_rows(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -71,6 +75,7 @@ def build_padded_rows(
     min_width: int = 8,
     max_width: int = 4096,
     row_multiple: int = 8,
+    impl: str = "auto",
 ) -> List[PaddedRows]:
     """COO triplets → degree-bucketed :class:`PaddedRows`.
 
@@ -81,7 +86,26 @@ def build_padded_rows(
     raises on them (ops/als.py ``assert_no_split``). The split layout exists
     for the future partial-Gram combining solver (the ALX multi-chip path);
     until then keep ``max_width`` above the data's max degree.
+
+    ``impl``: "auto" uses the native C++ builder (native/src/csr_builder.cc)
+    for large inputs, "native"/"numpy" force a path. Both produce identical
+    buckets.
     """
+    if impl not in ("auto", "native", "numpy"):
+        raise ValueError(f"unknown impl {impl!r}")
+    if impl == "native" or (impl == "auto" and len(rows) >= NATIVE_MIN_NNZ):
+        from incubator_predictionio_tpu.native.csr import build_buckets_native
+        buckets = build_buckets_native(
+            np.asarray(rows), np.asarray(cols), np.asarray(vals), n_rows,
+            min_width, max_width)
+        if buckets is not None:
+            return [
+                PaddedRows(row_ids=r, cols=c, vals=v, mask=m)
+                .pad_rows_to(row_multiple)
+                for (_w, r, c, v, m) in buckets
+            ]
+        if impl == "native":
+            raise RuntimeError("native csr builder unavailable")
     rows = np.asarray(rows, np.int64)
     cols = np.asarray(cols, np.int32)
     vals = np.asarray(vals, np.float32)

@@ -1,0 +1,147 @@
+"""Native runtime components: event-log engine + CSR builder.
+
+The storage behavior spec runs against cpplog via test_storage_conformance;
+this file covers what only the native layer has: durability across reopen
+(the reference proves the same with live-service storage tests,
+data/src/test/.../storage/LEventsSpec.scala), tombstone persistence, and
+bit-equality of the C++ CSR builder with the numpy reference.
+"""
+
+from datetime import timedelta
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu import native
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import StorageClientConfig
+from incubator_predictionio_tpu.ops.sparse import build_padded_rows
+from incubator_predictionio_tpu.utils.times import parse_iso8601
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native library unavailable")
+
+T0 = parse_iso8601("2021-06-01T00:00:00Z")
+
+
+def _client(path):
+    from incubator_predictionio_tpu.data.storage import cpplog
+    return cpplog.StorageClient(
+        StorageClientConfig(properties={"PATH": str(path)}))
+
+
+def _events(client):
+    from incubator_predictionio_tpu.data.storage import cpplog
+    return cpplog.CppLogEvents(client, client.config, prefix="t_")
+
+
+def ev(name="rate", eid="u1", minutes=0, target=None, props=None):
+    return Event(
+        event=name, entity_type="user", entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+class TestEventLogDurability:
+    def test_events_survive_reopen(self, tmp_path):
+        c1 = _client(tmp_path)
+        d1 = _events(c1)
+        d1.init(1)
+        ids = [d1.insert(ev(minutes=i, eid=f"u{i}"), 1) for i in range(5)]
+        d1.delete(ids[2], 1)
+        c1.close()
+
+        c2 = _client(tmp_path)  # fresh handle: index rebuilt from disk
+        d2 = _events(c2)
+        found = list(d2.find(app_id=1))
+        assert [e.event_id for e in found] == [
+            ids[0], ids[1], ids[3], ids[4]]  # tombstone persisted
+        assert d2.get(ids[2], 1) is None
+        assert d2.get(ids[3], 1).entity_id == "u3"
+        c2.close()
+
+    def test_upsert_replaces_across_reopen(self, tmp_path):
+        c1 = _client(tmp_path)
+        d1 = _events(c1)
+        d1.init(1)
+        eid = d1.insert(ev(props={"rating": 1}), 1)
+        d1.insert(ev(props={"rating": 9}).with_id(eid), 1)
+        assert d1.get(eid, 1).properties.get("rating") == 9
+        assert len(list(d1.find(app_id=1))) == 1
+        c1.close()
+
+        c2 = _client(tmp_path)
+        d2 = _events(c2)
+        assert d2.get(eid, 1).properties.get("rating") == 9
+        assert len(list(d2.find(app_id=1))) == 1
+        c2.close()
+
+    def test_out_of_order_times_sorted_and_limited(self, tmp_path):
+        c = _client(tmp_path)
+        d = _events(c)
+        d.init(1)
+        for m in (5, 1, 9, 3, 7):
+            d.insert(ev(minutes=m, eid=f"u{m}"), 1)
+        asc = [e.entity_id for e in d.find(app_id=1)]
+        assert asc == ["u1", "u3", "u5", "u7", "u9"]
+        top2 = [e.entity_id for e in d.find(app_id=1, reversed=True, limit=2)]
+        assert top2 == ["u9", "u7"]
+        window = [e.entity_id for e in d.find(
+            app_id=1, start_time=T0 + timedelta(minutes=3),
+            until_time=T0 + timedelta(minutes=9))]
+        assert window == ["u3", "u5", "u7"]
+        c.close()
+
+
+class TestNativeCsrBuilder:
+    @pytest.mark.parametrize("seed,n_rows,n_cols,nnz,max_width", [
+        (0, 50, 40, 600, 64),
+        (1, 7, 5, 30, 8),      # tiny, single bucket
+        (2, 100, 30, 2000, 16),  # heavy rows split at max_width
+    ])
+    def test_matches_numpy_reference(self, seed, n_rows, n_cols, nnz,
+                                     max_width):
+        rng = np.random.default_rng(seed)
+        rows = rng.integers(0, n_rows, nnz).astype(np.int64)
+        cols = rng.integers(0, n_cols, nnz).astype(np.int32)
+        vals = rng.random(nnz).astype(np.float32)
+        ref = build_padded_rows(rows, cols, vals, n_rows,
+                                max_width=max_width, impl="numpy")
+        got = build_padded_rows(rows, cols, vals, n_rows,
+                                max_width=max_width, impl="native")
+        assert len(ref) == len(got)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(r.row_ids, g.row_ids)
+            np.testing.assert_array_equal(r.cols, g.cols)
+            np.testing.assert_array_equal(r.vals, g.vals)
+            np.testing.assert_array_equal(r.mask, g.mask)
+
+    def test_empty_rows_and_empty_input(self):
+        # rows 3..9 have no entries; row 0 dense
+        rows = np.array([0] * 10 + [2], np.int64)
+        cols = np.arange(11, dtype=np.int32)
+        vals = np.ones(11, np.float32)
+        ref = build_padded_rows(rows, cols, vals, 10, impl="numpy")
+        got = build_padded_rows(rows, cols, vals, 10, impl="native")
+        assert len(ref) == len(got)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(r.cols, g.cols)
+        assert build_padded_rows(
+            np.empty(0, np.int64), np.empty(0, np.int32),
+            np.empty(0, np.float32), 4, impl="native") == []
+
+    def test_auto_dispatch_threshold(self, monkeypatch):
+        import incubator_predictionio_tpu.ops.sparse as sparse
+        monkeypatch.setattr(sparse, "NATIVE_MIN_NNZ", 10)
+        rng = np.random.default_rng(3)
+        rows = rng.integers(0, 20, 500).astype(np.int64)
+        cols = rng.integers(0, 20, 500).astype(np.int32)
+        vals = rng.random(500).astype(np.float32)
+        auto = sparse.build_padded_rows(rows, cols, vals, 20)
+        ref = sparse.build_padded_rows(rows, cols, vals, 20, impl="numpy")
+        for a, r in zip(auto, ref):
+            np.testing.assert_array_equal(a.cols, r.cols)

@@ -8,10 +8,11 @@ delegates to Spark MLlib ALS; the reference publishes no numbers, so the
 baseline is self-generated (BASELINE.md "to be measured").
 
 Baseline: the same solver on this host's CPU (JAX CPU backend, warm cache)
-measured at 3.79 s — our stand-in for the single-box Spark driver the
-reference CI validates against (tests/before_script.travis.sh:25-28; Spark
-1.4 itself cannot run in this offline image). ``vs_baseline`` > 1 means the
-TPU path is faster than that CPU reference.
+measured at 3.18 s with the fused single-dispatch training loop — our
+stand-in for the single-box Spark driver the reference CI validates against
+(tests/before_script.travis.sh:25-28; Spark 1.4 itself cannot run in this
+offline image). ``vs_baseline`` > 1 means the TPU path is faster than that
+CPU reference.
 
 Prints exactly ONE JSON line on stdout.
 """
@@ -25,7 +26,7 @@ import numpy as np
 #: CPU-JAX warm wall-clock for the identical workload on this image's host
 #: (measured via `python bench.py --cpu`); the Spark-MLlib single-box number
 #: this proxies is historically far slower, so this is a conservative bar.
-CPU_BASELINE_S = 3.79
+CPU_BASELINE_S = 3.18
 
 N_USERS, N_ITEMS, NNZ = 943, 1682, 100_000
 RANK, ITERATIONS, L2 = 64, 10, 0.1

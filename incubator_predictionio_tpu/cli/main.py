@@ -150,6 +150,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     tpl.add_parser("get")
     tpl.add_parser("list")
+    # `pio upgrade` (Console.scala upgrade subcommand): the reference
+    # migrates HBase 0.8.x schemas; this framework has no legacy schema, so
+    # the verb exists for CLI parity and reports there is nothing to do
+    sub.add_parser("upgrade", help="upgrade storage schema (no-op)")
 
     return parser
 
@@ -374,6 +378,12 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
     if cmd == "template":
         print("The template command is deprecated; browse the template "
               "gallery instead (reference: commands/Template.scala:38-83).")
+        return 0
+
+    if cmd == "upgrade":
+        print("No storage schema migration is required for this version "
+              "(reference `pio upgrade` migrates HBase 0.8.x schemas; "
+              "this framework's backends have a single schema version).")
         return 0
 
     print(f"Unknown command {cmd!r}")

@@ -138,6 +138,11 @@ class CppLogEvents(base.Events):
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
         validate_event(event)
+        with self.client.lock:
+            return self._insert_locked(event, app_id, channel_id)
+
+    def _insert_locked(self, event: Event, app_id: int,
+                       channel_id: Optional[int]) -> str:
         h = self._handle(app_id, channel_id)
         if event.event_id:
             # upsert semantics (parity with the sqlite backend's INSERT OR
@@ -165,21 +170,23 @@ class CppLogEvents(base.Events):
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
-        h = self._handle(app_id, channel_id)
-        for idx in self._candidates_by_id(h, event_id):
-            obj = self._read(h, idx)
-            if obj is not None and obj.get("eventId") == event_id:
-                return Event.from_jsonable(obj)
-        return None
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            for idx in self._candidates_by_id(h, event_id):
+                obj = self._read(h, idx)
+                if obj is not None and obj.get("eventId") == event_id:
+                    return Event.from_jsonable(obj)
+            return None
 
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
-        h = self._handle(app_id, channel_id)
-        for idx in self._candidates_by_id(h, event_id):
-            obj = self._read(h, idx)
-            if obj is not None and obj.get("eventId") == event_id:
-                return self.client.lib.pio_evlog_tombstone(h, idx) == 0
-        return False
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            for idx in self._candidates_by_id(h, event_id):
+                obj = self._read(h, idx)
+                if obj is not None and obj.get("eventId") == event_id:
+                    return self.client.lib.pio_evlog_tombstone(h, idx) == 0
+            return False
 
     # -- query -------------------------------------------------------------
     def find(
@@ -196,11 +203,12 @@ class CppLogEvents(base.Events):
         limit: Optional[int] = None,
         reversed: bool = False,
     ) -> Iterator[Event]:
-        h = self._handle(app_id, channel_id)
-        lib = self.client.lib
         names = None if event_names is None else list(event_names)
         if names is not None and not names:
             return iter(())  # IN () matches nothing (sqlite parity)
+        want = -1 if limit is None or limit < 0 else limit
+        if want == 0:
+            return iter(())
         n_names = 0 if names is None else len(names)
         name_arr = ((ctypes.c_uint64 * n_names)(*map(_h, names))
                     if n_names else None)
@@ -208,29 +216,30 @@ class CppLogEvents(base.Events):
         # C-side limit can only apply when they are absent
         post_filter = target_entity_type is not UNSET or \
             target_entity_id is not UNSET
-        want = -1 if limit is None or limit < 0 else limit
         c_limit = -1 if post_filter else want
-        total = lib.pio_evlog_count(h)
-        cap = total if c_limit < 0 else min(total, c_limit)
-        out = (ctypes.c_int64 * max(cap, 1))()
-        n = lib.pio_evlog_query(
-            h,
-            _I64_MIN if start_time is None else to_millis(start_time),
-            _I64_MAX if until_time is None else to_millis(until_time),
-            _h(entity_type) if entity_type is not None else 0,
-            _h(entity_id) if entity_id is not None else 0,
-            name_arr, n_names, 1 if reversed else 0, c_limit, out, cap,
-        )
 
-        # materialize payload reads NOW: the returned iterator must not
-        # touch the native handle, which remove()/close() may free before
-        # the consumer finishes draining (the sqlite backend is eager for
-        # the same reason)
-        objs = [self._read(h, out[i]) for i in range(n)]
-
-        def gen() -> Iterator[Event]:
-            emitted = 0
-            for obj in objs:
+        # hold the client lock across the native query AND the payload
+        # reads: remove()/close() take the same lock before freeing the
+        # handle, so the handle cannot be freed under us, and the returned
+        # iterator (plain list) never touches native state afterwards (the
+        # sqlite backend is eager for the same reason)
+        results: list[Event] = []
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            lib = self.client.lib
+            total = lib.pio_evlog_count(h)
+            cap = total if c_limit < 0 else min(total, c_limit)
+            out = (ctypes.c_int64 * max(cap, 1))()
+            n = lib.pio_evlog_query(
+                h,
+                _I64_MIN if start_time is None else to_millis(start_time),
+                _I64_MAX if until_time is None else to_millis(until_time),
+                _h(entity_type) if entity_type is not None else 0,
+                _h(entity_id) if entity_id is not None else 0,
+                name_arr, n_names, 1 if reversed else 0, c_limit, out, cap,
+            )
+            for i in range(n):
+                obj = self._read(h, out[i])
                 if obj is None:
                     continue
                 ev = Event.from_jsonable(obj)
@@ -247,12 +256,10 @@ class CppLogEvents(base.Events):
                 if target_entity_id is not UNSET and \
                         ev.target_entity_id != target_entity_id:
                     continue
-                yield ev
-                emitted += 1
-                if want >= 0 and emitted >= want:
-                    return
-
-        return gen()
+                results.append(ev)
+                if want >= 0 and len(results) >= want:
+                    break  # stop reading/parsing as soon as limit is met
+        return iter(results)
 
 
 DATA_OBJECTS = {"Events": CppLogEvents}

@@ -56,13 +56,20 @@ def build(force: bool = False) -> Path:
     if not force and not _needs_build(so):
         return so
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    # compile to a process-unique temp name, then atomically rename: two
+    # processes racing a cold build must never CDLL a half-written .so
+    tmp = so.with_suffix(f".so.tmp{os.getpid()}")
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O3", "-std=c++17", "-shared", "-fPIC",
         *[str(_SRC_DIR / s) for s in _SOURCES],
-        "-o", str(so),
+        "-o", str(tmp),
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, so)
+    finally:
+        tmp.unlink(missing_ok=True)
     return so
 
 

@@ -103,14 +103,19 @@ def _solve_bucket(
     return jnp.where(nnz[:, None] > 0, sol, 0.0)
 
 
-@functools.partial(jax.jit, donate_argnames=("out",),
-                   static_argnames=())
-def _scatter_rows(out: jax.Array, row_ids: jax.Array, sol: jax.Array) -> jax.Array:
+def _scatter_rows_impl(out: jax.Array, row_ids: jax.Array,
+                       sol: jax.Array) -> jax.Array:
     # Padding rows carry row_id -1. JAX scatter wraps negative indices
     # numpy-style (-1 = last row!), so remap them to n (out of bounds) where
     # mode="drop" genuinely drops them.
     safe_ids = jnp.where(row_ids < 0, out.shape[0], row_ids)
     return out.at[safe_ids].set(sol, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnames=("out",),
+                   static_argnames=())
+def _scatter_rows(out: jax.Array, row_ids: jax.Array, sol: jax.Array) -> jax.Array:
+    return _scatter_rows_impl(out, row_ids, sol)
 
 
 def _update_side(
@@ -303,12 +308,10 @@ def als_train_implicit(
     assert_no_split(user_buckets, "user")
     assert_no_split(item_buckets, "item")
     state = als_init(jax.random.key(seed), n_users, n_items, rank)
-    for _ in range(iterations):
-        state = als_sweep_implicit(
-            state, user_buckets, item_buckets, l2, alpha,
-            precision=precision, validate=False,
-        )
-    return state
+    return _als_run_fused(
+        state, _buckets_tree(user_buckets), _buckets_tree(item_buckets),
+        l2, alpha, iterations, True, jnp.float32, precision, implicit=True,
+    )
 
 
 @jax.jit
@@ -339,6 +342,70 @@ def rmse(
         )
         total += float(jnp.sum((pred - jnp.asarray(ratings[s:s + chunk])) ** 2))
     return float(np.sqrt(total / max(n, 1)))
+
+
+# ---------------------------------------------------------------------------
+# Fused whole-run training: every sweep of every bucket inside ONE jit.
+#
+# The per-bucket python loop above costs one device dispatch per
+# solve/scatter — ~2·sweeps·buckets dispatches per training run. On a
+# tunneled/remote TPU each dispatch is a host round trip, which dominates
+# ML-100K-scale training (measured: ~0.6 s of a 0.6 s run). The fused path
+# traces the full alternation (lax.fori_loop over sweeps; buckets unrolled
+# inside the body, their shapes are static) so the whole `pio train` compute
+# is ONE dispatch.
+# ---------------------------------------------------------------------------
+
+def _buckets_tree(buckets: Sequence[PaddedRows]):
+    return tuple(
+        (jnp.asarray(b.row_ids), jnp.asarray(b.cols), jnp.asarray(b.vals),
+         jnp.asarray(b.mask))
+        for b in buckets
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("iterations", "reg_nnz", "compute_dtype", "precision",
+                     "implicit"),
+    donate_argnames=("state",),
+)
+def _als_run_fused(
+    state: ALSState,
+    user_tree,
+    item_tree,
+    l2: float,
+    alpha: float,
+    iterations: int,
+    reg_nnz: bool,
+    compute_dtype: Any,
+    precision: Any,
+    implicit: bool,
+) -> ALSState:
+    def update_side(n_rows, other, tree):
+        rank = other.shape[1]
+        out = jnp.zeros((n_rows, rank), jnp.float32)
+        yty = _gram_all(other, precision) if implicit else None
+        for row_ids, cols, vals, mask in tree:
+            if implicit:
+                sol = _solve_bucket_implicit(
+                    other, yty, cols, vals, mask, l2, alpha,
+                    precision=precision)
+            else:
+                sol = _solve_bucket(
+                    other, cols, vals, mask, l2, reg_nnz=reg_nnz,
+                    compute_dtype=compute_dtype, precision=precision)
+            out = _scatter_rows_impl(out, row_ids, sol)
+        return out
+
+    def body(_, st):
+        new_users = update_side(
+            st.user_factors.shape[0], st.item_factors, user_tree)
+        new_items = update_side(
+            st.item_factors.shape[0], new_users, item_tree)
+        return ALSState(user_factors=new_users, item_factors=new_items)
+
+    return jax.lax.fori_loop(0, iterations, body, state)
 
 
 def als_train(
@@ -372,10 +439,17 @@ def als_train(
 
     state = als_init(jax.random.key(seed), n_users, n_items, rank)
     history: List[float] = []
-    for _ in range(iterations):
-        state = als_sweep(state, user_buckets, item_buckets, l2,
-                          reg_nnz=reg_nnz, compute_dtype=compute_dtype,
-                          precision=precision, validate=False)
-        if track_rmse:
+    if track_rmse:
+        # per-sweep metric needs per-sweep dispatches
+        for _ in range(iterations):
+            state = als_sweep(state, user_buckets, item_buckets, l2,
+                              reg_nnz=reg_nnz, compute_dtype=compute_dtype,
+                              precision=precision, validate=False)
             history.append(rmse(state, users, items, ratings))
+    else:
+        state = _als_run_fused(
+            state, _buckets_tree(user_buckets), _buckets_tree(item_buckets),
+            l2, 0.0, iterations, reg_nnz, compute_dtype, precision,
+            implicit=False,
+        )
     return state, history

@@ -118,7 +118,7 @@ class CppLogEvents(base.Events):
         pass
 
     # -- record io ---------------------------------------------------------
-    def _read(self, h: int, index: int) -> Optional[dict]:
+    def _read_raw(self, h: int, index: int) -> Optional[bytes]:
         cap = 4096
         while True:
             buf = ctypes.create_string_buffer(cap)
@@ -126,8 +126,14 @@ class CppLogEvents(base.Events):
             if n < 0:
                 return None
             if n <= cap:
-                return json.loads(buf.raw[:n].decode("utf-8"))
+                return buf.raw[:n]
             cap = n
+
+    def _read(self, h: int, index: int) -> Optional[dict]:
+        payload = self._read_raw(h, index)
+        if payload is None:
+            return None
+        return json.loads(payload.decode("utf-8"))
 
     def _candidates_by_id(self, h: int, event_id: str) -> list[int]:
         cap = 64
@@ -218,12 +224,12 @@ class CppLogEvents(base.Events):
             target_entity_id is not UNSET
         c_limit = -1 if post_filter else want
 
-        # hold the client lock across the native query AND the payload
-        # reads: remove()/close() take the same lock before freeing the
-        # handle, so the handle cannot be freed under us, and the returned
-        # iterator (plain list) never touches native state afterwards (the
-        # sqlite backend is eager for the same reason)
-        results: list[Event] = []
+        # hold the client lock only across the native query and the raw
+        # payload copies (memcpy): remove()/close() take the same lock
+        # before freeing the handle, so the handle stays alive, while the
+        # expensive JSON parsing below never blocks other DAO operations.
+        # The returned iterator (plain list) never touches native state.
+        raw: list[bytes] = []
         with self.client.lock:
             h = self._handle(app_id, channel_id)
             lib = self.client.lib
@@ -239,26 +245,29 @@ class CppLogEvents(base.Events):
                 name_arr, n_names, 1 if reversed else 0, c_limit, out, cap,
             )
             for i in range(n):
-                obj = self._read(h, out[i])
-                if obj is None:
-                    continue
-                ev = Event.from_jsonable(obj)
-                # exact re-checks: hashes prune, Python decides
-                if entity_type is not None and ev.entity_type != entity_type:
-                    continue
-                if entity_id is not None and ev.entity_id != entity_id:
-                    continue
-                if names is not None and ev.event not in names:
-                    continue
-                if target_entity_type is not UNSET and \
-                        ev.target_entity_type != target_entity_type:
-                    continue
-                if target_entity_id is not UNSET and \
-                        ev.target_entity_id != target_entity_id:
-                    continue
-                results.append(ev)
-                if want >= 0 and len(results) >= want:
-                    break  # stop reading/parsing as soon as limit is met
+                payload = self._read_raw(h, out[i])
+                if payload is not None:
+                    raw.append(payload)
+
+        results: list[Event] = []
+        for payload in raw:
+            ev = Event.from_jsonable(json.loads(payload.decode("utf-8")))
+            # exact re-checks: hashes prune, Python decides
+            if entity_type is not None and ev.entity_type != entity_type:
+                continue
+            if entity_id is not None and ev.entity_id != entity_id:
+                continue
+            if names is not None and ev.event not in names:
+                continue
+            if target_entity_type is not UNSET and \
+                    ev.target_entity_type != target_entity_type:
+                continue
+            if target_entity_id is not UNSET and \
+                    ev.target_entity_id != target_entity_id:
+                continue
+            results.append(ev)
+            if want >= 0 and len(results) >= want:
+                break  # stop parsing as soon as the limit is met
         return iter(results)
 
 

@@ -32,7 +32,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from incubator_predictionio_tpu.ops.sparse import PaddedRows, build_padded_rows
+from incubator_predictionio_tpu.ops.sparse import (
+    PaddedRows,
+    build_padded_rows,
+    split_heavy,
+)
 
 
 @jax.tree_util.register_dataclass
@@ -52,6 +56,73 @@ def als_init(
         user_factors=scale * jax.random.normal(ku, (n_users, rank), jnp.float32),
         item_factors=scale * jax.random.normal(ki, (n_items, rank), jnp.float32),
     )
+
+
+def _gram_rhs_nnz(
+    other_factors: jax.Array,  # [M, K]
+    cols: jax.Array,           # [..., D] int32
+    vals: jax.Array,           # [..., D] f32
+    mask: jax.Array,           # [..., D] f32 in {0, 1}
+    compute_dtype: Any,
+    precision: Any,
+    implicit: bool,
+    alpha: float,
+):
+    """Normal-equation pieces for a batch of padded rows → (gram, rhs, nnz).
+
+    THE single copy of the numerically delicate assembly — explicit mode
+    relies on mask² == mask to apply the mask once per side; implicit mode
+    builds Yᵤᵗ(Cᵤ−I)Yᵤ with c = 1 + α·r (Hu-Koren-Volinsky). Everything
+    accumulates in f32 at the given matmul precision (see the note on
+    :func:`_solve_bucket`). Used by the bucket solvers AND the split-row
+    partial-Gram path so their numerics cannot drift apart."""
+    gathered = other_factors[cols]                      # [..., D, K]
+    masked = gathered * mask[..., None]
+    if implicit:
+        conf_minus1 = alpha * vals * mask               # (c-1), 0 on padding
+        gram = jnp.einsum(
+            "...d,...dk,...dl->...kl", conf_minus1, masked, gathered,
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        rhs = jnp.einsum(
+            "...d,...dk->...k", (1.0 + conf_minus1) * mask, masked,
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+    else:
+        g16 = masked.astype(compute_dtype)
+        gram = jnp.einsum(
+            "...dk,...dl->...kl", g16, gathered.astype(compute_dtype),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        rhs = jnp.einsum(
+            "...d,...dk->...k", (vals * mask).astype(compute_dtype), g16,
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+    return gram, rhs, mask.sum(axis=-1)
+
+
+def _reg_solve(
+    gram: jax.Array,           # [B, K, K]
+    rhs: jax.Array,            # [B, K]
+    nnz: jax.Array,            # [B]
+    l2: float,
+    reg_nnz: bool,
+    implicit: bool,
+    yty: Optional[jax.Array],
+) -> jax.Array:
+    """Regularize + batched Cholesky solve; zero factors for empty rows."""
+    rank = gram.shape[-1]
+    eye = jnp.eye(rank, dtype=jnp.float32)
+    if implicit:
+        a = yty[None] + gram + l2 * eye
+    else:
+        # MLlib-style ALS-WR: lambda scaled by row nnz (reg_nnz=True)
+        lam = l2 * jnp.where(reg_nnz, jnp.maximum(nnz, 1.0), 1.0)
+        a = gram + lam[:, None, None] * eye
+    # cho_solve over the batch: SPD systems, MXU-friendly triangular ops
+    chol = jax.scipy.linalg.cho_factor(a)
+    sol = jax.scipy.linalg.cho_solve(chol, rhs[..., None])[..., 0]
+    return jnp.where(nnz[:, None] > 0, sol, 0.0)
 
 
 @functools.partial(
@@ -77,30 +148,10 @@ def _solve_bucket(
     DEFAULT precision remains available as the fast low-precision mode for
     early sweeps.
     """
-    rank = other_factors.shape[1]
-    gathered = other_factors[cols]                      # [B, D, K]
-    masked = gathered * mask[..., None]
-    g16 = masked.astype(compute_dtype)
-    # Gram: mask appears once on one side (mask² == mask for 0/1)
-    gram = jnp.einsum(
-        "bdk,bdl->bkl", g16, gathered.astype(compute_dtype),
-        preferred_element_type=jnp.float32,
-        precision=precision,
-    )                                                   # [B, K, K]
-    rhs = jnp.einsum(
-        "bd,bdk->bk", (vals * mask).astype(compute_dtype), g16,
-        preferred_element_type=jnp.float32,
-        precision=precision,
-    )                                                   # [B, K]
-    nnz = mask.sum(axis=-1)                             # [B]
-    # MLlib-style ALS-WR: lambda scaled by row nnz (reg_nnz=True)
-    lam = l2 * jnp.where(reg_nnz, jnp.maximum(nnz, 1.0), 1.0)
-    a = gram + lam[:, None, None] * jnp.eye(rank, dtype=jnp.float32)
-    # cho_solve over the batch: SPD systems, maps to MXU-friendly triangular ops
-    chol = jax.scipy.linalg.cho_factor(a)
-    sol = jax.scipy.linalg.cho_solve(chol, rhs[..., None])[..., 0]
-    # rows with zero observations keep zero factors
-    return jnp.where(nnz[:, None] > 0, sol, 0.0)
+    gram, rhs, nnz = _gram_rhs_nnz(
+        other_factors, cols, vals, mask, compute_dtype, precision,
+        implicit=False, alpha=0.0)
+    return _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit=False, yty=None)
 
 
 def _scatter_rows_impl(out: jax.Array, row_ids: jax.Array,
@@ -118,6 +169,55 @@ def _scatter_rows(out: jax.Array, row_ids: jax.Array, sol: jax.Array) -> jax.Arr
     return _scatter_rows_impl(out, row_ids, sol)
 
 
+def _sweep_side(
+    n_rows: int,
+    other_factors: jax.Array,
+    tree,                      # ((row_ids, cols, vals, mask), ...)
+    heavy,                     # (seg_ids, row_ids, cols, vals, mask) | None
+    l2: float,
+    alpha: float,
+    reg_nnz: bool,
+    compute_dtype: Any,
+    precision: Any,
+    implicit: bool,
+) -> jax.Array:
+    """One half-sweep (traced): solve every bucket + split rows, scatter.
+
+    THE single sweep implementation — the fused trainer, als_sweep and
+    als_sweep_implicit all trace through here, so the paths cannot
+    diverge."""
+    rank = other_factors.shape[1]
+    out = jnp.zeros((n_rows, rank), jnp.float32)
+    yty = _gram_all(other_factors, precision) if implicit else None
+    for row_ids, cols, vals, mask in tree:
+        if implicit:
+            sol = _solve_bucket_implicit(
+                other_factors, yty, cols, vals, mask, l2, alpha,
+                precision=precision)
+        else:
+            sol = _solve_bucket(
+                other_factors, cols, vals, mask, l2, reg_nnz=reg_nnz,
+                compute_dtype=compute_dtype, precision=precision)
+        out = _scatter_rows_impl(out, row_ids, sol)
+    if heavy is not None:
+        h_ids, h_sol = _solve_heavy(
+            other_factors, heavy, l2, alpha, reg_nnz, compute_dtype,
+            precision, implicit, yty)
+        out = _scatter_rows_impl(out, h_ids, h_sol)
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_rows", "reg_nnz", "compute_dtype", "precision",
+                     "implicit"),
+)
+def _sweep_side_jit(n_rows, other_factors, tree, heavy, l2, alpha, reg_nnz,
+                    compute_dtype, precision, implicit):
+    return _sweep_side(n_rows, other_factors, tree, heavy, l2, alpha,
+                       reg_nnz, compute_dtype, precision, implicit)
+
+
 def _update_side(
     n_rows: int,
     other_factors: jax.Array,
@@ -127,29 +227,19 @@ def _update_side(
     compute_dtype: Any,
     precision: Any,
 ) -> jax.Array:
-    rank = other_factors.shape[1]
-    out = jnp.zeros((n_rows, rank), jnp.float32)
-    for bucket in buckets:
-        sol = _solve_bucket(
-            other_factors,
-            jnp.asarray(bucket.cols),
-            jnp.asarray(bucket.vals),
-            jnp.asarray(bucket.mask),
-            l2,
-            reg_nnz=reg_nnz,
-            compute_dtype=compute_dtype,
-            precision=precision,
-        )
-        out = _scatter_rows(out, jnp.asarray(bucket.row_ids), sol)
-    return out
+    return _sweep_side_jit(
+        n_rows, other_factors, _buckets_tree(buckets), None, l2, 0.0,
+        reg_nnz, compute_dtype, precision, implicit=False)
 
 
 def assert_no_split(buckets: Sequence[PaddedRows], side: str = "row") -> None:
     """Raise if any row was split across padded rows (degree > max_width).
 
-    The scatter-set in the sweep keeps one arbitrary segment's solution for a
-    duplicated row id, which would be silently wrong — so it is an error
-    until the partial-Gram combining solver lands."""
+    The scatter-set in the sweep keeps one arbitrary segment's solution for
+    a duplicated row id, which would be silently wrong. The ``als_sweep``
+    API therefore rejects split rows; ``als_train``/``als_train_implicit``
+    route them through the partial-Gram combining solve instead
+    (``split_heavy`` + ``_solve_heavy``)."""
     ids = np.concatenate(
         [np.asarray(b.row_ids)[np.asarray(b.row_ids) >= 0] for b in buckets]
     ) if buckets else np.empty(0, np.int32)
@@ -215,23 +305,10 @@ def _solve_bucket_implicit(
     c = 1 + α·r and binary preference — YᵗY is shared across the whole
     batch (the classic implicit-ALS trick), so per-row work stays
     proportional to the row's observations."""
-    rank = other_factors.shape[1]
-    gathered = other_factors[cols]                        # [B, D, K]
-    masked = gathered * mask[..., None]
-    conf_minus1 = alpha * vals * mask                     # (c-1), 0 on padding
-    gram = jnp.einsum(
-        "bd,bdk,bdl->bkl", conf_minus1, masked, gathered,
-        preferred_element_type=jnp.float32, precision=precision,
-    )
-    rhs = jnp.einsum(
-        "bd,bdk->bk", (1.0 + conf_minus1) * mask, masked,
-        preferred_element_type=jnp.float32, precision=precision,
-    )
-    nnz = mask.sum(axis=-1)
-    a = yty[None] + gram + l2 * jnp.eye(rank, dtype=jnp.float32)
-    chol = jax.scipy.linalg.cho_factor(a)
-    sol = jax.scipy.linalg.cho_solve(chol, rhs[..., None])[..., 0]
-    return jnp.where(nnz[:, None] > 0, sol, 0.0)
+    gram, rhs, nnz = _gram_rhs_nnz(
+        other_factors, cols, vals, mask, jnp.float32, precision,
+        implicit=True, alpha=alpha)
+    return _reg_solve(gram, rhs, nnz, l2, True, implicit=True, yty=yty)
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))
@@ -250,17 +327,9 @@ def _update_side_implicit(
     alpha: float,
     precision: Any,
 ) -> jax.Array:
-    rank = other_factors.shape[1]
-    yty = _gram_all(other_factors, precision)
-    out = jnp.zeros((n_rows, rank), jnp.float32)
-    for bucket in buckets:
-        sol = _solve_bucket_implicit(
-            other_factors, yty,
-            jnp.asarray(bucket.cols), jnp.asarray(bucket.vals),
-            jnp.asarray(bucket.mask), l2, alpha, precision=precision,
-        )
-        out = _scatter_rows(out, jnp.asarray(bucket.row_ids), sol)
-    return out
+    return _sweep_side_jit(
+        n_rows, other_factors, _buckets_tree(buckets), None, l2, alpha,
+        True, jnp.float32, precision, implicit=True)
 
 
 def als_sweep_implicit(
@@ -301,16 +370,17 @@ def als_train_implicit(
     max_width: int = 1 << 16,
 ) -> ALSState:
     """Implicit-feedback training over (user, item, weight) observations."""
-    user_buckets = build_padded_rows(users, items, weights, n_users,
-                                     max_width=max_width)
-    item_buckets = build_padded_rows(items, users, weights, n_items,
-                                     max_width=max_width)
-    assert_no_split(user_buckets, "user")
-    assert_no_split(item_buckets, "item")
+    user_light, user_heavy = split_heavy(
+        build_padded_rows(users, items, weights, n_users,
+                          max_width=max_width))
+    item_light, item_heavy = split_heavy(
+        build_padded_rows(items, users, weights, n_items,
+                          max_width=max_width))
     state = als_init(jax.random.key(seed), n_users, n_items, rank)
     return _als_run_fused(
-        state, _buckets_tree(user_buckets), _buckets_tree(item_buckets),
+        state, _buckets_tree(user_light), _buckets_tree(item_light),
         l2, alpha, iterations, True, jnp.float32, precision, implicit=True,
+        user_heavy=_heavy_tree(user_heavy), item_heavy=_heavy_tree(item_heavy),
     )
 
 
@@ -364,6 +434,41 @@ def _buckets_tree(buckets: Sequence[PaddedRows]):
     )
 
 
+def _heavy_tree(heavy):
+    if heavy is None:
+        return None
+    return (jnp.asarray(heavy.seg_ids), jnp.asarray(heavy.row_ids),
+            jnp.asarray(heavy.cols), jnp.asarray(heavy.vals),
+            jnp.asarray(heavy.mask))
+
+
+def _solve_heavy(
+    other_factors: jax.Array,
+    heavy,                      # (seg_ids[S], row_ids[H], cols, vals, mask)
+    l2: float,
+    alpha: float,
+    reg_nnz: bool,
+    compute_dtype: Any,
+    precision: Any,
+    implicit: bool,
+    yty: Optional[jax.Array],
+) -> Tuple[jax.Array, jax.Array]:
+    """Partial-Gram combining solve for split rows → (row_ids, sol[H, K]).
+
+    Per-segment normal-equation pieces are computed exactly like a regular
+    bucket, then segment-summed per original row before ONE solve per row —
+    the reduction ALX does across shards, here across split segments."""
+    seg_ids, row_ids, cols, vals, mask = heavy
+    n_heavy = row_ids.shape[0]
+    pg, prhs, pnnz = _gram_rhs_nnz(
+        other_factors, cols, vals, mask, compute_dtype, precision,
+        implicit, alpha)
+    gram = jax.ops.segment_sum(pg, seg_ids, num_segments=n_heavy)
+    rhs = jax.ops.segment_sum(prhs, seg_ids, num_segments=n_heavy)
+    nnz = jax.ops.segment_sum(pnnz, seg_ids, num_segments=n_heavy)
+    return row_ids, _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit, yty)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("iterations", "reg_nnz", "compute_dtype", "precision",
@@ -381,28 +486,16 @@ def _als_run_fused(
     compute_dtype: Any,
     precision: Any,
     implicit: bool,
+    user_heavy=None,
+    item_heavy=None,
 ) -> ALSState:
-    def update_side(n_rows, other, tree):
-        rank = other.shape[1]
-        out = jnp.zeros((n_rows, rank), jnp.float32)
-        yty = _gram_all(other, precision) if implicit else None
-        for row_ids, cols, vals, mask in tree:
-            if implicit:
-                sol = _solve_bucket_implicit(
-                    other, yty, cols, vals, mask, l2, alpha,
-                    precision=precision)
-            else:
-                sol = _solve_bucket(
-                    other, cols, vals, mask, l2, reg_nnz=reg_nnz,
-                    compute_dtype=compute_dtype, precision=precision)
-            out = _scatter_rows_impl(out, row_ids, sol)
-        return out
-
     def body(_, st):
-        new_users = update_side(
-            st.user_factors.shape[0], st.item_factors, user_tree)
-        new_items = update_side(
-            st.item_factors.shape[0], new_users, item_tree)
+        new_users = _sweep_side(
+            st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
+            l2, alpha, reg_nnz, compute_dtype, precision, implicit)
+        new_items = _sweep_side(
+            st.item_factors.shape[0], new_users, item_tree, item_heavy,
+            l2, alpha, reg_nnz, compute_dtype, precision, implicit)
         return ALSState(user_factors=new_users, item_factors=new_items)
 
     return jax.lax.fori_loop(0, iterations, body, state)
@@ -426,30 +519,33 @@ def als_train(
 ) -> Tuple[ALSState, List[float]]:
     """Full training: build padded buckets once, run ``iterations`` sweeps.
 
-    Raises if any row's degree exceeds ``max_width`` (row splitting across
-    solve batches — the multi-chip ALX path — is not wired into the solver
-    yet; 65k interactions per single user/item is beyond the single-chip
-    design point)."""
-    user_buckets = build_padded_rows(users, items, ratings, n_users,
-                                     max_width=max_width)
-    item_buckets = build_padded_rows(items, users, ratings, n_items,
-                                     max_width=max_width)
-    assert_no_split(user_buckets, "user")
-    assert_no_split(item_buckets, "item")
+    Rows whose degree exceeds ``max_width`` are split into segments and
+    solved via the partial-Gram combining path (ops/sparse.py
+    ``split_heavy`` + ``_solve_heavy``), so power users/items of any degree
+    train correctly."""
+    user_light, user_heavy = split_heavy(
+        build_padded_rows(users, items, ratings, n_users,
+                          max_width=max_width))
+    item_light, item_heavy = split_heavy(
+        build_padded_rows(items, users, ratings, n_items,
+                          max_width=max_width))
+    u_tree, i_tree = _buckets_tree(user_light), _buckets_tree(item_light)
+    u_hv, i_hv = _heavy_tree(user_heavy), _heavy_tree(item_heavy)
 
     state = als_init(jax.random.key(seed), n_users, n_items, rank)
     history: List[float] = []
     if track_rmse:
         # per-sweep metric needs per-sweep dispatches
         for _ in range(iterations):
-            state = als_sweep(state, user_buckets, item_buckets, l2,
-                              reg_nnz=reg_nnz, compute_dtype=compute_dtype,
-                              precision=precision, validate=False)
+            state = _als_run_fused(
+                state, u_tree, i_tree, l2, 0.0, 1, reg_nnz, compute_dtype,
+                precision, implicit=False, user_heavy=u_hv, item_heavy=i_hv,
+            )
             history.append(rmse(state, users, items, ratings))
     else:
         state = _als_run_fused(
-            state, _buckets_tree(user_buckets), _buckets_tree(item_buckets),
-            l2, 0.0, iterations, reg_nnz, compute_dtype, precision,
-            implicit=False,
+            state, u_tree, i_tree, l2, 0.0, iterations, reg_nnz,
+            compute_dtype, precision, implicit=False,
+            user_heavy=u_hv, item_heavy=i_hv,
         )
     return state, history

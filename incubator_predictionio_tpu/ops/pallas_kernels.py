@@ -344,6 +344,55 @@ def _flash_bhsd(
     return out[:, :s_q, :]
 
 
+@functools.lru_cache(maxsize=None)
+def _flash_with_vjp(causal: bool, scale: float, q_block: int, kv_block: int,
+                    interpret: bool):
+    """custom_vjp closure over the static config.
+
+    Mosaic kernels are not reverse-differentiable, but the sequence engines
+    train through their attention op (ops/transformer.py _fit_scan), so the
+    fused kernel must be usable under ``value_and_grad``. Forward runs the
+    Pallas kernel; backward differentiates the XLA blockwise path
+    (ops/attention.py), which implements the *same* online-softmax update
+    rule — a recompute-based backward with O(S·block) memory, no [S, S]
+    residuals."""
+    from incubator_predictionio_tpu.ops.attention import blockwise_attention
+
+    def forward(q, k, v, valid):
+        b, s_q, h, d = q.shape
+
+        def to_bhsd(x):
+            return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+        out = _flash_bhsd(
+            to_bhsd(q), to_bhsd(k), to_bhsd(v), valid[:, None, :],
+            n_heads=h, causal=causal, scale=scale,
+            q_block=q_block, kv_block=kv_block, interpret=interpret,
+        )
+        return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+
+    @jax.custom_vjp
+    def f(q, k, v, valid):
+        return forward(q, k, v, valid)
+
+    def fwd(q, k, v, valid):
+        return forward(q, k, v, valid), (q, k, v, valid)
+
+    def bwd(res, g):
+        q, k, v, valid = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: blockwise_attention(
+                q_, k_, v_, causal=causal, block_size=kv_block, scale=scale,
+                kv_valid=valid > 0.0),
+            q, k, v,
+        )
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, jnp.zeros_like(valid)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
 def flash_attention(
     q: jax.Array,                   # [B, S, H, D]
     k: jax.Array,
@@ -361,16 +410,14 @@ def flash_attention(
     The full K/V for one head stays VMEM-resident (S·D·8 bytes — fits to
     S≈8k at D=128), the scan over KV blocks runs in-kernel, and causal
     query blocks skip their strictly-future KV blocks entirely, so the
-    [S, S] logit matrix never exists in HBM.
+    [S, S] logit matrix never exists in HBM. Differentiable: backward runs
+    through the XLA blockwise reference (see :func:`_flash_with_vjp`).
     """
     if interpret is None:
         interpret = not pallas_available()
-    b, s_q, h, d = q.shape
+    b, _s_q, _h, d = q.shape
     s_kv = k.shape[1]
     sc = scale if scale is not None else d ** -0.5
-
-    def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
     if kv_valid is None:
         valid = jnp.ones((b, s_kv), jnp.float32)
@@ -379,11 +426,7 @@ def flash_attention(
             kv_valid.astype(jnp.float32)[None, :], (b, s_kv))
     else:
         valid = kv_valid.astype(jnp.float32)
-    valid = valid[:, None, :]
 
-    out = _flash_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v), valid,
-        n_heads=h, causal=causal, scale=float(sc),
-        q_block=q_block, kv_block=kv_block, interpret=bool(interpret),
-    )
-    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    fn = _flash_with_vjp(bool(causal), float(sc), int(q_block),
+                         int(kv_block), bool(interpret))
+    return fn(q, k, v, valid)

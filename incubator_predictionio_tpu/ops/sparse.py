@@ -67,6 +67,78 @@ class PaddedRows:
 NATIVE_MIN_NNZ = 100_000
 
 
+@dataclasses.dataclass
+class HeavySegments:
+    """Split-row segments extracted from :class:`PaddedRows` buckets.
+
+    Rows whose degree exceeds ``max_width`` are split across several padded
+    rows; the solver cannot treat those independently (one scatter-set per
+    padded row would keep only one segment's solution). This structure
+    groups every split row's segments for the partial-Gram combining solve
+    in ops/als.py: per-segment Grams/rhs are computed exactly like a normal
+    bucket, then segment-summed by ``seg_ids`` before the single solve per
+    heavy row — the ALX sharded-batch reduction in single-host form
+    (PAPERS.md: ALX §4).
+    """
+
+    seg_ids: np.ndarray  # [S] int32 → index into row_ids (compact)
+    row_ids: np.ndarray  # [H] int32 original row indices
+    cols: np.ndarray     # [S, W] int32
+    vals: np.ndarray     # [S, W] float32
+    mask: np.ndarray     # [S, W] float32
+
+
+def split_heavy(
+    buckets: Sequence[PaddedRows],
+    row_multiple: int = 8,
+) -> Tuple[List[PaddedRows], "HeavySegments | None"]:
+    """Separate split rows (duplicated row ids) from the light buckets.
+
+    Returns rebuilt light buckets (split rows removed, re-padded to
+    ``row_multiple``) and a :class:`HeavySegments` holding every split
+    row's segments, or None when no row was split.
+    """
+    all_ids = np.concatenate(
+        [np.asarray(b.row_ids) for b in buckets]
+    ) if buckets else np.empty(0, np.int32)
+    live = all_ids[all_ids >= 0]
+    uniq, counts = np.unique(live, return_counts=True)
+    heavy_ids = set(int(i) for i in uniq[counts > 1])
+    if not heavy_ids:
+        return list(buckets), None
+
+    light: List[PaddedRows] = []
+    seg_rows: List[Tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
+    for b in buckets:
+        ids = np.asarray(b.row_ids)
+        is_heavy = np.isin(ids, list(heavy_ids)) & (ids >= 0)
+        for i in np.nonzero(is_heavy)[0]:
+            seg_rows.append((int(ids[i]), b.cols[i], b.vals[i], b.mask[i]))
+        keep = ~is_heavy & (ids >= 0)
+        if keep.any():
+            light.append(
+                PaddedRows(
+                    row_ids=ids[keep], cols=b.cols[keep],
+                    vals=b.vals[keep], mask=b.mask[keep],
+                ).pad_rows_to(row_multiple)
+            )
+
+    width = max(seg[1].shape[0] for seg in seg_rows)
+    s = len(seg_rows)
+    cols = np.zeros((s, width), np.int32)
+    vals = np.zeros((s, width), np.float32)
+    mask = np.zeros((s, width), np.float32)
+    row_ids = np.asarray(sorted(heavy_ids), np.int32)
+    index = {int(r): i for i, r in enumerate(row_ids)}
+    seg_ids = np.empty(s, np.int32)
+    for i, (rid, c, v, m) in enumerate(seg_rows):
+        w = c.shape[0]
+        cols[i, :w], vals[i, :w], mask[i, :w] = c, v, m
+        seg_ids[i] = index[rid]
+    return light, HeavySegments(
+        seg_ids=seg_ids, row_ids=row_ids, cols=cols, vals=vals, mask=mask)
+
+
 def build_padded_rows(
     rows: np.ndarray,
     cols: np.ndarray,

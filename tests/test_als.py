@@ -85,13 +85,21 @@ def test_als_cold_rows_stay_zero():
     assert np.allclose(np.asarray(state.item_factors)[39], 0.0)
 
 
-def test_als_heavy_row_raises():
+def test_als_heavy_row_trains_and_sweep_api_still_rejects():
     users = np.zeros(10, dtype=np.int64)
     items = np.arange(10)
     ratings = np.ones(10, np.float32)
+    # als_train routes split rows through the partial-Gram combining solver
+    state, _ = als_train(users, items, ratings, 1, 10, rank=2, iterations=1,
+                         max_width=4)
+    assert np.isfinite(np.asarray(state.user_factors)).all()
+    # the raw sweep API cannot combine split rows and must keep rejecting
+    from incubator_predictionio_tpu.ops.als import als_init, als_sweep
+    from incubator_predictionio_tpu.ops.sparse import build_padded_rows
+    import jax
+    buckets = build_padded_rows(users, items, ratings, 1, max_width=4)
     with pytest.raises(NotImplementedError):
-        als_train(users, items, ratings, 1, 10, rank=2, iterations=1,
-                  max_width=4)
+        als_sweep(als_init(jax.random.key(0), 1, 10, 2), buckets, buckets)
 
 
 def test_top_k_with_exclusions():
@@ -110,3 +118,67 @@ def test_top_k_with_exclusions():
     # -1 exclude ids are inert (drop mode)
     _s, top_i = top_k_with_exclusions(scores, 1, exclude=jnp.asarray([-1]))
     assert top_i.tolist() == [1]
+
+
+class TestSplitRowSolver:
+    """Rows with degree > max_width: partial-Gram combining (ALX-style)."""
+
+    def test_explicit_matches_unsplit(self):
+        import numpy as np
+        from incubator_predictionio_tpu.ops.als import als_train, rmse
+        rng = np.random.default_rng(0)
+        # user 0 rates 60 items; max_width=16 forces 4-way splitting
+        users = np.concatenate([np.zeros(60, np.int64),
+                                rng.integers(1, 20, 200)])
+        items = np.concatenate([np.arange(60) % 30,
+                                rng.integers(0, 30, 200)]).astype(np.int64)
+        ratings = rng.integers(1, 6, 260).astype(np.float32)
+        split, _ = als_train(users, items, ratings, 20, 30, rank=8,
+                             iterations=5, seed=1, max_width=16)
+        whole, _ = als_train(users, items, ratings, 20, 30, rank=8,
+                             iterations=5, seed=1, max_width=1 << 12)
+        np.testing.assert_allclose(
+            np.asarray(split.user_factors), np.asarray(whole.user_factors),
+            atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(split.item_factors), np.asarray(whole.item_factors),
+            atol=1e-4)
+        assert rmse(split, users, items, ratings) < 1.0
+
+    def test_implicit_matches_unsplit(self):
+        import numpy as np
+        from incubator_predictionio_tpu.ops.als import als_train_implicit
+        rng = np.random.default_rng(2)
+        users = np.concatenate([np.full(40, 3, np.int64),
+                                rng.integers(0, 10, 100)])
+        items = np.concatenate([np.arange(40) % 25,
+                                rng.integers(0, 25, 100)]).astype(np.int64)
+        w = rng.random(140).astype(np.float32) + 0.5
+        split = als_train_implicit(users, items, w, 10, 25, rank=8,
+                                   iterations=4, seed=3, max_width=8)
+        whole = als_train_implicit(users, items, w, 10, 25, rank=8,
+                                   iterations=4, seed=3, max_width=1 << 12)
+        np.testing.assert_allclose(
+            np.asarray(split.user_factors), np.asarray(whole.user_factors),
+            atol=1e-4)
+
+    def test_split_heavy_structure(self):
+        import numpy as np
+        from incubator_predictionio_tpu.ops.sparse import (
+            build_padded_rows, split_heavy)
+        rows = np.concatenate([np.zeros(20, np.int64), [1, 2, 2]])
+        cols = np.arange(23, dtype=np.int32)
+        vals = np.ones(23, np.float32)
+        buckets = build_padded_rows(rows, cols, vals, 3, max_width=8)
+        light, heavy = split_heavy(buckets)
+        assert heavy is not None
+        # row 0 split into ceil(20/8)=3 segments; rows 1, 2 stay light
+        assert list(heavy.row_ids) == [0]
+        assert heavy.seg_ids.shape[0] == 3
+        assert heavy.mask.sum() == 20
+        light_ids = np.concatenate([b.row_ids for b in light])
+        assert set(light_ids[light_ids >= 0]) == {1, 2}
+        # no-split input passes through untouched
+        l2, h2 = split_heavy(build_padded_rows(
+            rows[20:], cols[20:], vals[20:], 3))
+        assert h2 is None and len(l2) == 1

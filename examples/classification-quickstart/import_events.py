@@ -1,0 +1,36 @@
+"""Seed the classification quickstart (reference: examples/
+scala-parallel-classification/.../data/import_eventserver.py — $set events
+carrying the attr0-2 features and the 'plan' label)."""
+import argparse, json, random, urllib.request
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--access-key", required=True)
+    ap.add_argument("--url", default="http://127.0.0.1:7070")
+    ap.add_argument("--n", type=int, default=200)
+    args = ap.parse_args()
+    random.seed(3)
+    events = []
+    for i in range(args.n):
+        plan = random.randint(0, 2)
+        events.append({
+            "event": "$set", "entityType": "user", "entityId": f"u{i}",
+            "properties": {
+                "attr0": plan * 10 + random.randint(0, 9),
+                "attr1": random.randint(0, 5) + plan,
+                "attr2": random.randint(0, 3),
+                "plan": plan,
+            },
+        })
+    for s in range(0, len(events), 50):  # EventServer batch cap is 50
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            json.dumps(events[s:s + 50]).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
+    print(f"imported {len(events)} $set user events")
+
+
+if __name__ == "__main__":
+    main()

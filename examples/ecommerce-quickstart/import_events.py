@@ -1,0 +1,35 @@
+"""Seed the e-commerce quickstart (reference: examples/
+scala-parallel-ecommercerecommendation/data/import_eventserver.py — $set
+items with categories, view/buy events)."""
+import argparse, json, random, urllib.request
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--access-key", required=True)
+    ap.add_argument("--url", default="http://127.0.0.1:7070")
+    args = ap.parse_args()
+    random.seed(7)
+    events = [{"event": "$set", "entityType": "item", "entityId": f"i{i}",
+               "properties": {"categories": [f"c{i % 5}"]}}
+              for i in range(60)]
+    for u in range(12):
+        for i in random.sample(range(60), 12):
+            events.append({"event": "view", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "item",
+                           "targetEntityId": f"i{i}"})
+        for i in random.sample(range(60), 3):
+            events.append({"event": "buy", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "item",
+                           "targetEntityId": f"i{i}"})
+    for s in range(0, len(events), 50):
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            json.dumps(events[s:s + 50]).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
+    print(f"imported {len(events)} events")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Seed the recommendation quickstart (reference: examples/
+scala-parallel-recommendation/custom-query/data/import_eventserver.py —
+rate events, MovieLens-style)."""
+import argparse, json, random, urllib.request
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--access-key", required=True)
+    ap.add_argument("--url", default="http://127.0.0.1:7070")
+    args = ap.parse_args()
+    random.seed(0)
+    events = []
+    for u in range(30):
+        for i in random.sample(range(60), 12):
+            events.append({"event": "rate", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "item",
+                           "targetEntityId": f"i{i}",
+                           "properties": {"rating": float(random.randint(1, 5))}})
+    for s in range(0, len(events), 50):
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            json.dumps(events[s:s + 50]).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
+    print(f"imported {len(events)} rate events")
+
+
+if __name__ == "__main__":
+    main()

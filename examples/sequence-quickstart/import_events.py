@@ -1,0 +1,38 @@
+"""Seed the sequence quickstart: per-user time-ordered view/buy sessions
+(no reference counterpart — the reference's closest capability is the
+MarkovChain template; this feeds the SASRec-style session model)."""
+import argparse, json, random, urllib.request
+from datetime import datetime, timedelta, timezone
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--access-key", required=True)
+    ap.add_argument("--url", default="http://127.0.0.1:7070")
+    args = ap.parse_args()
+    random.seed(11)
+    t0 = datetime(2021, 6, 1, tzinfo=timezone.utc)
+    events = []
+    for u in range(40):
+        # sessions walk a ring of items so there is sequence signal to learn
+        start = random.randint(0, 29)
+        for step in range(random.randint(4, 12)):
+            item = (start + step) % 30
+            events.append({
+                "event": "buy" if step % 4 == 3 else "view",
+                "entityType": "user", "entityId": f"u{u}",
+                "targetEntityType": "item", "targetEntityId": f"i{item}",
+                "eventTime": (t0 + timedelta(minutes=u * 60 + step))
+                             .isoformat(),
+            })
+    for s in range(0, len(events), 50):  # EventServer batch cap is 50
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            json.dumps(events[s:s + 50]).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
+    print(f"imported {len(events)} session events")
+
+
+if __name__ == "__main__":
+    main()

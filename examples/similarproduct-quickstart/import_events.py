@@ -1,0 +1,37 @@
+"""Seed the similar-product quickstart (reference: examples/
+scala-parallel-similarproduct/multi/data/import_eventserver.py — $set users
+and items, then view/like events)."""
+import argparse, json, random, urllib.request
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--access-key", required=True)
+    ap.add_argument("--url", default="http://127.0.0.1:7070")
+    args = ap.parse_args()
+    random.seed(5)
+    events = [{"event": "$set", "entityType": "user", "entityId": f"u{i}"}
+              for i in range(10)]
+    events += [{"event": "$set", "entityType": "item", "entityId": f"i{i}",
+                "properties": {"categories": [f"c{i % 4}", f"c{(i + 1) % 4}"]}}
+               for i in range(50)]
+    for u in range(10):
+        for i in random.sample(range(50), 10):
+            events.append({"event": "view", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "item",
+                           "targetEntityId": f"i{i}"})
+        for i in random.sample(range(50), 3):
+            events.append({"event": "like", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "item",
+                           "targetEntityId": f"i{i}"})
+    for s in range(0, len(events), 50):
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            json.dumps(events[s:s + 50]).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
+    print(f"imported {len(events)} events")
+
+
+if __name__ == "__main__":
+    main()

@@ -242,6 +242,27 @@ def resolve_engine_factory(factory_path: str) -> Any:
     return factory() if isinstance(factory, type) else factory
 
 
+def engine_identity(engine_dir: str, engine_factory: str) -> str:
+    """Engine identity = (engine directory, factory), like the reference's
+    manifest id (commands/Engine.scala:123-156 derives it from the engine
+    directory). Keying instances on the variant's own "id" field would
+    collide across engines that all ship the default variant id — deploy
+    would then pick another engine's latest instance; mixing in the factory
+    also keeps two different engines sharing one directory apart. The ONE
+    derivation used by build manifests and train/deploy instance lookups."""
+    import hashlib
+
+    abs_dir = str(Path(engine_dir).resolve())
+    return hashlib.sha1(
+        f"{abs_dir}\0{engine_factory}".encode()).hexdigest()[:16]
+
+
+def engine_id_for_variant_path(variant_path: str,
+                               variant: Dict[str, Any]) -> str:
+    return engine_identity(str(Path(variant_path).resolve().parent),
+                           variant.get("engineFactory", ""))
+
+
 def engine_from_variant(variant: Dict[str, Any]):
     factory_path = variant.get("engineFactory")
     if not factory_path:
@@ -306,7 +327,7 @@ def _manifest_for_engine_dir(engine_dir: str,
         if p.name != "manifest.json"   # the output of this very build
     ) + sorted(str(p) for p in Path(engine_dir).glob("*.py"))
     return storage_base.EngineManifest(
-        id=hashlib.sha1(abs_dir.encode()).hexdigest()[:16],
+        id=engine_identity(abs_dir, variant.get("engineFactory", "")),
         version=digest,
         name=Path(abs_dir).name,
         engine_factory=variant.get("engineFactory", ""),

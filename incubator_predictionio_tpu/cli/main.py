@@ -255,7 +255,7 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
         instance_id = CoreWorkflow.run_train(
             engine,
             engine_params,
-            engine_id=variant.get("id", "default"),
+            engine_id=commands.engine_id_for_variant_path(args.variant, variant),
             engine_version=variant.get("version", "NOT_VERSIONED"),
             engine_variant=variant.get("id", "default"),
             engine_factory=variant.get("engineFactory", ""),
@@ -309,7 +309,7 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
             ip=args.ip,
             port=args.port,
             engine_instance_id=args.engine_instance_id,
-            engine_id=variant.get("id", "default"),
+            engine_id=commands.engine_id_for_variant_path(args.variant, variant),
             engine_version=variant.get("version", "NOT_VERSIONED"),
             engine_variant=variant.get("id", "default"),
             event_server_ip=args.event_server_ip,

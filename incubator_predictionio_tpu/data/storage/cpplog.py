@@ -244,13 +244,35 @@ class CppLogEvents(base.Events):
                 _h(entity_id) if entity_id is not None else 0,
                 name_arr, n_names, 1 if reversed else 0, c_limit, out, cap,
             )
+            if post_filter and want >= 0:
+                # limited query whose predicates live only in Python: parse
+                # and filter IN-lock so reading stops at `want` matches —
+                # copying all candidates first would be O(log size)
+                results = self._filter_parsed(
+                    (self._read_raw(h, out[i]) for i in range(n)),
+                    entity_type, entity_id, names,
+                    target_entity_type, target_entity_id, want)
+                return iter(results)
             for i in range(n):
                 payload = self._read_raw(h, out[i])
                 if payload is not None:
                     raw.append(payload)
 
+        # unlimited (or natively limited) queries: the expensive JSON
+        # parsing runs outside the lock so other DAO ops are not stalled
+        results = self._filter_parsed(
+            iter(raw), entity_type, entity_id, names,
+            target_entity_type, target_entity_id, want)
+        return iter(results)
+
+    @staticmethod
+    def _filter_parsed(payloads, entity_type, entity_id, names,
+                       target_entity_type, target_entity_id,
+                       want: int) -> list[Event]:
         results: list[Event] = []
-        for payload in raw:
+        for payload in payloads:
+            if payload is None:
+                continue
             ev = Event.from_jsonable(json.loads(payload.decode("utf-8")))
             # exact re-checks: hashes prune, Python decides
             if entity_type is not None and ev.entity_type != entity_type:
@@ -267,8 +289,8 @@ class CppLogEvents(base.Events):
                 continue
             results.append(ev)
             if want >= 0 and len(results) >= want:
-                break  # stop parsing as soon as the limit is met
-        return iter(results)
+                break  # stop reading/parsing as soon as the limit is met
+        return results
 
 
 DATA_OBJECTS = {"Events": CppLogEvents}

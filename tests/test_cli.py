@@ -129,8 +129,15 @@ def test_build_train_from_engine_json(tmp_path, monkeypatch, capsys):
     assert main(["train"]) == 0
     out = capsys.readouterr().out
     assert "Engine instance ID:" in out
+    from incubator_predictionio_tpu.cli.commands import (
+        engine_id_for_variant_path,
+    )
+    # engine identity is directory-derived (manifest-id semantics), the
+    # variant id only names the params variant — two engines shipping the
+    # default variant id must not collide in the instance registry
     latest = Storage.get_meta_data_engine_instances().get_latest_completed(
-        "cli-test", "NOT_VERSIONED", "cli-test"
+        engine_id_for_variant_path(str(tmp_path / "engine.json"), variant),
+        "NOT_VERSIONED", "cli-test"
     )
     assert latest is not None
     assert latest.status == "COMPLETED"

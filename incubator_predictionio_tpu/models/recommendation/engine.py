@@ -290,20 +290,37 @@ class ALSAlgorithm(Algorithm):
         super().__init__(params)
 
     def train(self, ctx: RuntimeContext, pd: PreparedData) -> ALSModel:
+        import jax
+
         from incubator_predictionio_tpu.ops import als_train
 
         n_users, n_items = len(pd.user_bimap), len(pd.item_bimap)
         if n_users == 0 or n_items == 0:
             raise ValueError("No ratings to train on")
         seed = self.params.seed if self.params.seed is not None else ctx.seed
-        state, _ = als_train(
-            pd.users, pd.items, pd.ratings,
-            n_users=n_users, n_items=n_items,
-            rank=self.params.rank,
-            iterations=self.params.num_iterations,
-            l2=self.params.lambda_,
-            seed=seed,
-        )
+        if ctx.model_parallelism > 1 and jax.device_count() > 1:
+            # `pio train --model-parallelism N`: shard the factor tables
+            # over the mp mesh axis (the ALX layout, ops/als.py
+            # als_train_sharded); buckets shard over the whole mesh.
+            # ctx.mesh is the context's (possibly caller-supplied) mesh.
+            from incubator_predictionio_tpu.ops.als import als_train_sharded
+
+            state = als_train_sharded(
+                pd.users, pd.items, pd.ratings, n_users, n_items, ctx.mesh,
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                l2=self.params.lambda_,
+                seed=seed,
+            )
+        else:
+            state, _ = als_train(
+                pd.users, pd.items, pd.ratings,
+                n_users=n_users, n_items=n_items,
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                l2=self.params.lambda_,
+                seed=seed,
+            )
         logger.info(
             "ALS trained: %d users × %d items, rank %d",
             n_users, n_items, self.params.rank,

@@ -384,6 +384,106 @@ def als_train_implicit(
     )
 
 
+def als_train_sharded(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    mesh,                       # jax.sharding.Mesh with (dp, mp) axes
+    rank: int = 64,
+    iterations: int = 10,
+    l2: float = 0.1,
+    alpha: float = 1.0,
+    seed: int = 0,
+    reg_nnz: bool = True,
+    implicit: bool = False,
+    compute_dtype: Any = jnp.float32,
+    precision: Any = jax.lax.Precision.HIGHEST,
+    max_width: int = 1 << 16,
+) -> ALSState:
+    """Mesh-sharded training — the full ALX layout (PAPERS.md: ALX §4).
+
+    Placement is the whole parallelization (scaling-book recipe: annotate,
+    let GSPMD insert collectives): interaction buckets shard on rows over
+    the flattened (dp × mp) mesh; factor tables shard on rows over ``mp``
+    (halving per-device HBM at mp=2, etc.). The SAME traced program as the
+    single-chip fused run (:func:`_als_run_fused`) then compiles with an
+    all-gather of the other side's factor shards per half-sweep and a
+    sharded scatter of the solved rows — exactly the cross-device data flow
+    ALX schedules by hand. Numerics are identical to the unsharded run up
+    to floating-point reduction order.
+
+    Factor tables are padded to a multiple of the ``mp`` axis size; padding
+    rows are zero and never referenced, and the returned state is sliced
+    back to the true sizes.
+    """
+    from incubator_predictionio_tpu.parallel.mesh import MODEL_AXIS
+    from incubator_predictionio_tpu.parallel.sharding import (
+        model_sharding,
+        replicated,
+    )
+
+    n_dev = mesh.devices.size
+    mp = mesh.shape[MODEL_AXIS]
+
+    def round_up(x, m):
+        return -(-x // m) * m
+
+    n_users_p = round_up(n_users, mp)
+    n_items_p = round_up(n_items, mp)
+
+    user_light, user_heavy = split_heavy(
+        build_padded_rows(users, items, ratings, n_users,
+                          max_width=max_width, row_multiple=n_dev),
+        row_multiple=n_dev)
+    item_light, item_heavy = split_heavy(
+        build_padded_rows(items, users, ratings, n_items,
+                          max_width=max_width, row_multiple=n_dev),
+        row_multiple=n_dev)
+
+    repl = replicated(mesh)
+    tables = model_sharding(mesh)
+
+    def place_tree(light):
+        # the ONE bucket-placement recipe (parallel/sharding.py) + the ONE
+        # tree conversion
+        from incubator_predictionio_tpu.parallel.sharding import (
+            shard_buckets,
+        )
+        return _buckets_tree(shard_buckets(light, mesh))
+
+    def place_heavy(heavy):
+        if heavy is None:
+            return None
+        # split segments are few; replicate them so the per-row
+        # segment-sum needs no cross-device reduction
+        return tuple(
+            jax.device_put(jnp.asarray(a), repl)
+            for a in (heavy.seg_ids, heavy.row_ids, heavy.cols, heavy.vals,
+                      heavy.mask)
+        )
+
+    state0 = als_init(jax.random.key(seed), n_users, n_items, rank)
+    state = ALSState(
+        user_factors=jax.device_put(
+            jnp.pad(state0.user_factors, ((0, n_users_p - n_users), (0, 0))),
+            tables),
+        item_factors=jax.device_put(
+            jnp.pad(state0.item_factors, ((0, n_items_p - n_items), (0, 0))),
+            tables),
+    )
+    out = _als_run_fused(
+        state, place_tree(user_light), place_tree(item_light),
+        l2, alpha, iterations, reg_nnz, compute_dtype, precision,
+        implicit=implicit,
+        user_heavy=place_heavy(user_heavy),
+        item_heavy=place_heavy(item_heavy),
+    )
+    return ALSState(user_factors=out.user_factors[:n_users],
+                    item_factors=out.item_factors[:n_items])
+
+
 @jax.jit
 def _predict_coo(
     user_factors: jax.Array, item_factors: jax.Array,

@@ -90,3 +90,95 @@ def test_graft_entry_contract():
     shapes = [x.shape for x in jax.tree_util.tree_leaves(out)]
     assert shapes == [(8, 10), (8, 10)]
     g.dryrun_multichip(8)
+
+
+class TestModelParallelTraining:
+    """als_train_sharded: factor tables sharded over mp (the ALX layout)."""
+
+    @pytest.mark.parametrize("model_parallelism", [2, 4])
+    def test_matches_unsharded(self, model_parallelism):
+        from incubator_predictionio_tpu.ops.als import als_train_sharded
+        rng = np.random.default_rng(1)
+        n_users, n_items, nnz, rank = 50, 30, 500, 8
+        users = rng.integers(0, n_users, nnz)
+        items = rng.integers(0, n_items, nnz)
+        vals = rng.uniform(1, 5, nnz).astype(np.float32)
+
+        ref, _ = als_train(users, items, vals, n_users, n_items, rank=rank,
+                           iterations=3, seed=4)
+        mesh = make_mesh(model_parallelism=model_parallelism)
+        out = als_train_sharded(users, items, vals, n_users, n_items, mesh,
+                                rank=rank, iterations=3, seed=4)
+        np.testing.assert_allclose(
+            np.asarray(ref.user_factors), np.asarray(out.user_factors),
+            rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(ref.item_factors), np.asarray(out.item_factors),
+            rtol=2e-4, atol=2e-5)
+
+    def test_tables_actually_sharded_on_mp(self):
+        from incubator_predictionio_tpu.ops.als import (
+            ALSState, _als_run_fused, _buckets_tree, als_init,
+        )
+        from incubator_predictionio_tpu.parallel.sharding import (
+            batch_sharding, model_sharding,
+        )
+        rng = np.random.default_rng(2)
+        n_users, n_items, rank = 64, 32, 8
+        users = rng.integers(0, n_users, 300)
+        items = rng.integers(0, n_items, 300)
+        vals = rng.uniform(1, 5, 300).astype(np.float32)
+        mesh = make_mesh(model_parallelism=4)
+        ub = build_padded_rows(users, items, vals, n_users, row_multiple=8)
+        ib = build_padded_rows(items, users, vals, n_items, row_multiple=8)
+        tables = model_sharding(mesh)
+        rows = batch_sharding(mesh)
+        st = als_init(jax.random.key(0), n_users, n_items, rank)
+        st = ALSState(jax.device_put(st.user_factors, tables),
+                      jax.device_put(st.item_factors, tables))
+
+        def place(tree):
+            return tuple(tuple(jax.device_put(a, rows) for a in b)
+                         for b in tree)
+
+        out = _als_run_fused(
+            st, place(_buckets_tree(ub)), place(_buckets_tree(ib)),
+            0.1, 0.0, 2, True, jnp.float32, jax.lax.Precision.HIGHEST,
+            implicit=False)
+        # the result keeps the mp row sharding (no silent full replication)
+        spec = out.user_factors.sharding.spec
+        assert spec[0] == MODEL_AXIS, spec
+
+    def test_split_rows_on_mesh(self):
+        from incubator_predictionio_tpu.ops.als import als_train_sharded
+        rng = np.random.default_rng(3)
+        users = np.concatenate([np.zeros(40, np.int64),
+                                rng.integers(1, 20, 200)])
+        items = np.concatenate([np.arange(40) % 24,
+                                rng.integers(0, 24, 200)]).astype(np.int64)
+        vals = rng.uniform(1, 5, 240).astype(np.float32)
+        ref, _ = als_train(users, items, vals, 20, 24, rank=8, iterations=3,
+                           seed=5, max_width=16)
+        mesh = make_mesh(model_parallelism=2)
+        out = als_train_sharded(users, items, vals, 20, 24, mesh, rank=8,
+                                iterations=3, seed=5, max_width=16)
+        np.testing.assert_allclose(
+            np.asarray(ref.user_factors), np.asarray(out.user_factors),
+            rtol=2e-4, atol=2e-5)
+
+    def test_implicit_on_mesh(self):
+        from incubator_predictionio_tpu.ops.als import (
+            als_train_implicit, als_train_sharded,
+        )
+        rng = np.random.default_rng(6)
+        users = rng.integers(0, 30, 400)
+        items = rng.integers(0, 20, 400)
+        w = rng.random(400).astype(np.float32) + 0.5
+        ref = als_train_implicit(users, items, w, 30, 20, rank=8,
+                                 iterations=3, seed=7)
+        mesh = make_mesh(model_parallelism=2)
+        out = als_train_sharded(users, items, w, 30, 20, mesh, rank=8,
+                                iterations=3, seed=7, implicit=True)
+        np.testing.assert_allclose(
+            np.asarray(ref.user_factors), np.asarray(out.user_factors),
+            rtol=2e-4, atol=2e-5)

@@ -1,0 +1,140 @@
+"""End-to-end quickstart: REST ingest → build → train → deploy → query.
+
+Parity with the reference's Python integration tier
+(tests/pio_tests/tests.py QuickStartTest: app new → import → build → train
+→ deploy → query the recommendation engine), run fully in-process against
+the real framework stack — CLI verbs, EventServer REST ingest, the training
+workflow, and a live PredictionServer.
+"""
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from incubator_predictionio_tpu.cli.commands import engine_id_for_variant_path
+from incubator_predictionio_tpu.cli.main import main
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.servers.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+from incubator_predictionio_tpu.servers.prediction_server import (
+    PredictionServer,
+    ServerConfig,
+)
+
+VARIANT = {
+    "id": "default",
+    "engineFactory":
+        "incubator_predictionio_tpu.models.recommendation:"
+        "RecommendationEngine",
+    "datasource": {"params": {"appName": "QsApp"}},
+    "algorithms": [{"name": "als", "params": {
+        "rank": 8, "numIterations": 5, "lambda": 0.05, "seed": 3,
+    }}],
+}
+
+
+def post(url, body):
+    req = urllib.request.Request(
+        url, json.dumps(body).encode(), {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read() or b"null")
+
+
+@pytest.fixture
+def storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+def test_quickstart_full_pipeline(storage, tmp_path, monkeypatch, capsys):
+    # 1. app new (CLI) — prints the generated access key
+    assert main(["app", "new", "QsApp"]) == 0
+    out = capsys.readouterr().out
+    key = next(line.split(":")[1].strip() for line in out.splitlines()
+               if "Access Key" in line)
+
+    # 2. REST batch ingest through a live event server (50-event cap parity)
+    es = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+    es_port = es.start_background()
+    try:
+        random.seed(0)
+        events = []
+        for u in range(25):
+            for i in random.sample(range(40), 10):
+                events.append({
+                    "event": "rate", "entityType": "user",
+                    "entityId": f"u{u}", "targetEntityType": "item",
+                    "targetEntityId": f"i{i}",
+                    "properties": {"rating": float(random.randint(1, 5))},
+                })
+        base = f"http://127.0.0.1:{es_port}"
+        for s in range(0, len(events), 50):
+            status, body = post(
+                f"{base}/batch/events.json?accessKey={key}",
+                events[s:s + 50])
+            assert status == 200
+        # oversized batch is rejected (EventServer.scala:71 cap)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(f"{base}/batch/events.json?accessKey={key}",
+                 [events[0]] * 51)
+        assert err.value.code == 400
+    finally:
+        es.stop()
+
+    # 3. build + train from an engine.json on disk (CLI)
+    (tmp_path / "engine.json").write_text(json.dumps(VARIANT))
+    monkeypatch.chdir(tmp_path)
+    assert main(["build"]) == 0
+    assert main(["train"]) == 0
+    assert "Engine instance ID:" in capsys.readouterr().out
+
+    # 4. deploy the latest completed instance and query it
+    from incubator_predictionio_tpu.cli.commands import engine_from_variant
+    engine, _ = engine_from_variant(VARIANT)
+    ps = PredictionServer(engine, ServerConfig(
+        ip="127.0.0.1", port=0,
+        engine_id=engine_id_for_variant_path(
+            str(tmp_path / "engine.json"), VARIANT),
+        engine_variant="default",
+    ))
+    ps_port = ps.start_background()
+    try:
+        status, body = post(
+            f"http://127.0.0.1:{ps_port}/queries.json",
+            {"user": "u1", "num": 4})
+        assert status == 200
+        scores = body["itemScores"]
+        assert len(scores) == 4
+        assert all(s["item"].startswith("i") for s in scores)
+        # ranked descending
+        vals = [s["score"] for s in scores]
+        assert vals == sorted(vals, reverse=True)
+        # unknown user → empty result, not an error (template parity)
+        status, body = post(
+            f"http://127.0.0.1:{ps_port}/queries.json",
+            {"user": "ghost", "num": 4})
+        assert status == 200
+        assert body["itemScores"] == []
+    finally:
+        ps.stop()
+
+    # 5. export the ingested events back out (CLI)
+    out_file = tmp_path / "export.jsonl"
+    assert main(["export", "--appid-or-name", "QsApp",
+                 "--output", str(out_file)]) == 0
+    lines = out_file.read_text().splitlines()
+    assert len(lines) == 250

@@ -184,3 +184,19 @@ def test_wire_format_parity():
     assert out == {"itemScores": [
         {"item": "i1", "score": 1.5, "creationYear": 1990}
     ]}
+
+
+def test_train_with_model_parallelism_matches_single(seeded_app):
+    """ctx.model_parallelism > 1 routes through als_train_sharded (the
+    mp-sharded ALX layout) and must produce the same model (the tests run
+    on the virtual 8-device CPU mesh)."""
+    engine = RecommendationEngine().apply()
+    ref = engine.train(RuntimeContext(), engine_params())
+    mp = engine.train(RuntimeContext(model_parallelism=2), engine_params())
+    import numpy as np
+    np.testing.assert_allclose(
+        np.asarray(ref[0].user_factors), np.asarray(mp[0].user_factors),
+        rtol=2e-4, atol=2e-5)
+    algo = engine.algorithms(engine_params())[0]
+    result = algo.predict(mp[0], Query(user="uA1", num=3))
+    assert all(s.item.startswith("iA") for s in result.item_scores)

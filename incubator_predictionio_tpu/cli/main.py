@@ -392,6 +392,11 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    # persistent XLA cache: every pio process after the first skips the
+    # multi-second compile (the TPU analogue of the reference's JVM/Spark
+    # startup cost per spark-submit)
+    from incubator_predictionio_tpu.utils.compile_cache import enable
+    enable()
     try:
         return dispatch(args)
     except CommandError as e:

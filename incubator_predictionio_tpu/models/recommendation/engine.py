@@ -355,12 +355,12 @@ class ALSAlgorithm(Algorithm):
 
     # -- serving ----------------------------------------------------------
     def _allowed_mask(
-        self, model: ALSModel, query: Query, user_idx: Optional[int] = None
+        self, model: ALSModel, query: Query
     ) -> Optional[np.ndarray]:
         """Serve-time filters (custom-query creationYear; filter-by-category;
-        white/blacklists; seen-item exclusion) → boolean mask over item
-        indices. Always a fixed [n_items] shape so the jitted scoring path
-        compiles once, regardless of how many items a user has seen."""
+        white/blacklists) → boolean mask over item indices; seen-item
+        exclusion is handled in predict. Always a fixed [n_items] shape so
+        the jitted scoring path compiles once."""
         n_items = len(model.item_bimap)
         mask = None
 
@@ -397,29 +397,84 @@ class ALSAlgorithm(Algorithm):
                 idx = model.item_bimap.get(item)
                 if idx is not None:
                     m[idx] = False
-        if query.exclude_seen and user_idx is not None:
-            seen = model.user_seen.get(user_idx)
-            if seen is not None and len(seen):
-                m = ensure()
-                m[np.asarray(seen)] = False
         return mask
+
+    #: catalogs up to this many factor elements also serve from a host copy
+    #: (numpy matvec, no device round trip per query); larger models serve
+    #: from TPU-resident state
+    HOST_SERVE_MAX_ELEMS = 1 << 22
+
+    def _host_cache(self, model: ALSModel):
+        """Lazy host-resident factor copy for small models.
+
+        On a tunneled/remote TPU the blocking dispatch+fetch floor is tens
+        of ms; a sub-4M-element factor pair is microseconds of numpy. The
+        reference serves driver-local from JVM memory
+        (CreateServer.scala:498-650) — same locality decision. Large models
+        keep the single-dispatch device path."""
+        cache = getattr(model, "_np_cache", None)
+        if cache is None:
+            n_elems = (np.prod(np.shape(model.user_factors)) +
+                       np.prod(np.shape(model.item_factors)))
+            if n_elems > self.HOST_SERVE_MAX_ELEMS:
+                cache = False
+            else:
+                cache = (np.asarray(model.user_factors),
+                         np.asarray(model.item_factors))
+            # benign race under concurrent first queries: both sides
+            # compute the same value
+            object.__setattr__(model, "_np_cache", cache)
+        return cache or None
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         import jax.numpy as jnp
 
-        from incubator_predictionio_tpu.ops.topk import score_and_top_k
+        from incubator_predictionio_tpu.ops.topk import score_user_and_top_k
 
         user_idx = model.user_bimap.get(query.user)
         if user_idx is None:
             # unknown user → empty result (ALSAlgorithm.scala predict miss)
             return PredictedResult(item_scores=())
-        mask = self._allowed_mask(model, query, user_idx)
-        packed = np.asarray(score_and_top_k(  # ONE device->host fetch
-            jnp.asarray(model.user_factors)[user_idx],
-            jnp.asarray(model.item_factors),
-            k=min(query.num, len(model.item_bimap)),
-            allowed_mask=None if mask is None else jnp.asarray(mask),
-        ))
+        mask = self._allowed_mask(model, query)
+        seen = None
+        if query.exclude_seen:
+            seen = model.user_seen.get(user_idx)
+            if seen is not None and not len(seen):
+                seen = None
+        k = min(query.num, len(model.item_bimap))
+        if k <= 0:
+            # num=0 must be an empty result on BOTH serving paths
+            # (np.argpartition with k=0 would return the whole catalog)
+            return PredictedResult(item_scores=())
+
+        host = self._host_cache(model)
+        if host is not None:
+            np_users, np_items = host
+            scores = np_items @ np_users[user_idx]
+            if mask is not None:
+                scores = np.where(mask, scores, -3.4e38)
+            if seen is not None:
+                scores[np.asarray(seen)] = -3.4e38
+            top = np.argpartition(scores, -k)[-k:]
+            top = top[np.argsort(scores[top])[::-1]]
+            packed = np.stack([scores[top], top.astype(np.float64)])
+        else:
+            exclude = None
+            if seen is not None:
+                # pad to the next power of two (-1 = no-op slots) so the
+                # jitted serve call compiles O(log max-seen) times total
+                width = 1 << (len(seen) - 1).bit_length()
+                exclude = np.full(width, -1, np.int32)
+                exclude[:len(seen)] = seen
+                exclude = jnp.asarray(exclude)
+            packed = np.asarray(score_user_and_top_k(  # ONE dispatch+fetch
+                model.user_factors,
+                model.item_factors,
+                int(user_idx),
+                k=k,
+                exclude=exclude,
+                allowed_mask=None if mask is None else jnp.asarray(mask),
+            ))
         scores, indices = packed[0], packed[1].astype(np.int64)
         inv = model.item_bimap.inverse
         out = []

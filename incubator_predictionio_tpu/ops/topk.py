@@ -55,6 +55,50 @@ def _score_and_top_k_xla(
 PALLAS_MIN_ITEMS = 500_000
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _score_user_top_k_xla(
+    user_factors: jax.Array,        # [U, K]
+    item_factors: jax.Array,        # [I, K]
+    user_idx,                       # scalar int
+    k: int,
+    exclude: Optional[jax.Array] = None,
+    allowed_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    scores = item_factors @ user_factors[user_idx]
+    top_s, top_i = top_k_with_exclusions(scores, k, exclude, allowed_mask)
+    return jnp.stack([top_s, top_i.astype(jnp.float32)])
+
+
+def score_user_and_top_k(
+    user_factors: jax.Array,        # [U, K] (device-resident)
+    item_factors: jax.Array,        # [I, K] (device-resident)
+    user_idx: int,
+    k: int,
+    exclude: Optional[jax.Array] = None,
+    allowed_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Serving fast path: user-row gather + full-catalog scoring + top-k in
+    ONE device call, packed [2, k].
+
+    On a tunneled/remote TPU every separate op is a host round trip;
+    indexing ``user_factors[user_idx]`` outside the jit would double the
+    per-query latency. Callers fetch the packed result with one
+    ``np.asarray``."""
+    if item_factors.shape[0] >= PALLAS_MIN_ITEMS and k <= 128:
+        from incubator_predictionio_tpu.ops.pallas_kernels import (
+            pallas_available, score_and_top_k_pallas)
+        if pallas_available():
+            # huge catalogs: compute dominates, the extra gather dispatch
+            # is noise next to the blocked kernel's win
+            return score_and_top_k_pallas(
+                user_factors[user_idx], item_factors, k,
+                exclude=exclude, allowed_mask=allowed_mask,
+                block_items=8192,
+            )
+    return _score_user_top_k_xla(user_factors, item_factors, user_idx, k,
+                                 exclude, allowed_mask)
+
+
 def score_and_top_k(
     user_vector: jax.Array,         # [K]
     item_factors: jax.Array,        # [I, K]

@@ -200,3 +200,28 @@ def test_train_with_model_parallelism_matches_single(seeded_app):
     algo = engine.algorithms(engine_params())[0]
     result = algo.predict(mp[0], Query(user="uA1", num=3))
     assert all(s.item.startswith("iA") for s in result.item_scores)
+
+
+def test_host_and_device_serving_paths_agree(seeded_app):
+    """Small models serve from a host factor copy; forcing the device path
+    must give identical rankings (same scoring, same filters)."""
+    engine = RecommendationEngine().apply()
+    models = engine.train(RuntimeContext(), engine_params())
+    algo = engine.algorithms(engine_params())[0]
+    q = Query(user="uA1", num=3, exclude_seen=True)
+    host = algo.predict(models[0], q)
+    object.__setattr__(models[0], "_np_cache", False)  # force device path
+    dev = algo.predict(models[0], q)
+    assert [s.item for s in host.item_scores] == \
+           [s.item for s in dev.item_scores]
+    for a, b in zip(host.item_scores, dev.item_scores):
+        assert abs(a.score - b.score) < 1e-4
+
+
+def test_num_zero_returns_empty_on_both_paths(seeded_app):
+    engine = RecommendationEngine().apply()
+    models = engine.train(RuntimeContext(), engine_params())
+    algo = engine.algorithms(engine_params())[0]
+    assert algo.predict(models[0], Query(user="uA1", num=0)).item_scores == ()
+    object.__setattr__(models[0], "_np_cache", False)
+    assert algo.predict(models[0], Query(user="uA1", num=0)).item_scores == ()

@@ -325,28 +325,52 @@ class ECommAlgorithm(Algorithm):
         return mask
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
-        import jax.numpy as jnp
-
-        from incubator_predictionio_tpu.ops.topk import top_k_with_exclusions
-
         user_idx = model.user_bimap.get(query.user)
-        factors = jnp.asarray(model.item_factors)
-        if user_idx is not None:
-            user_vec = jnp.asarray(model.user_factors)[user_idx]
-            scores = factors @ user_vec
+        mask = self._allowed_mask(model, query, user_idx)
+        k = min(query.num, len(model.item_bimap))
+
+        from incubator_predictionio_tpu.ops.host_serving import (
+            host_arrays,
+            host_top_k,
+        )
+        host = host_arrays(model, "user_factors", "item_factors",
+                           "item_popularity")
+        if host is not None:
+            np_users, np_items, np_pop = host
+            if user_idx is not None:
+                scores = np_items @ np_users[user_idx]
+            else:
+                recent = self._recent_items(model, query.user)
+                if recent:
+                    scores = np_items @ np_items[
+                        np.asarray(recent, np.int32)].mean(axis=0)
+                else:
+                    # cold user with no history → popularity ranking
+                    scores = np.asarray(np_pop, np.float32)
+            top_s, top_i = host_top_k(scores, k, allowed_mask=mask)
         else:
-            recent = self._recent_items(model, query.user)
-            if recent:
-                user_vec = factors[jnp.asarray(recent, jnp.int32)].mean(axis=0)
+            import jax.numpy as jnp
+
+            from incubator_predictionio_tpu.ops.topk import (
+                top_k_with_exclusions,
+            )
+
+            factors = jnp.asarray(model.item_factors)
+            if user_idx is not None:
+                user_vec = jnp.asarray(model.user_factors)[user_idx]
                 scores = factors @ user_vec
             else:
-                # cold user with no history → popularity ranking
-                scores = jnp.asarray(model.item_popularity)
-        mask = self._allowed_mask(model, query, user_idx)
-        top_s, top_i = top_k_with_exclusions(
-            scores, k=min(query.num, len(model.item_bimap)),
-            allowed_mask=jnp.asarray(mask),
-        )
+                recent = self._recent_items(model, query.user)
+                if recent:
+                    user_vec = factors[
+                        jnp.asarray(recent, jnp.int32)].mean(axis=0)
+                    scores = factors @ user_vec
+                else:
+                    # cold user with no history → popularity ranking
+                    scores = jnp.asarray(model.item_popularity)
+            top_s, top_i = top_k_with_exclusions(
+                scores, k=k, allowed_mask=jnp.asarray(mask),
+            )
         inv = model.item_bimap.inverse
         out = []
         for s, i in zip(np.asarray(top_s), np.asarray(top_i)):

@@ -399,33 +399,6 @@ class ALSAlgorithm(Algorithm):
                     m[idx] = False
         return mask
 
-    #: catalogs up to this many factor elements also serve from a host copy
-    #: (numpy matvec, no device round trip per query); larger models serve
-    #: from TPU-resident state
-    HOST_SERVE_MAX_ELEMS = 1 << 22
-
-    def _host_cache(self, model: ALSModel):
-        """Lazy host-resident factor copy for small models.
-
-        On a tunneled/remote TPU the blocking dispatch+fetch floor is tens
-        of ms; a sub-4M-element factor pair is microseconds of numpy. The
-        reference serves driver-local from JVM memory
-        (CreateServer.scala:498-650) — same locality decision. Large models
-        keep the single-dispatch device path."""
-        cache = getattr(model, "_np_cache", None)
-        if cache is None:
-            n_elems = (np.prod(np.shape(model.user_factors)) +
-                       np.prod(np.shape(model.item_factors)))
-            if n_elems > self.HOST_SERVE_MAX_ELEMS:
-                cache = False
-            else:
-                cache = (np.asarray(model.user_factors),
-                         np.asarray(model.item_factors))
-            # benign race under concurrent first queries: both sides
-            # compute the same value
-            object.__setattr__(model, "_np_cache", cache)
-        return cache or None
-
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         import jax.numpy as jnp
 
@@ -444,20 +417,20 @@ class ALSAlgorithm(Algorithm):
         k = min(query.num, len(model.item_bimap))
         if k <= 0:
             # num=0 must be an empty result on BOTH serving paths
-            # (np.argpartition with k=0 would return the whole catalog)
             return PredictedResult(item_scores=())
 
-        host = self._host_cache(model)
+        from incubator_predictionio_tpu.ops.host_serving import (
+            host_arrays, host_top_k,
+        )
+        host = host_arrays(model, "user_factors", "item_factors")
         if host is not None:
             np_users, np_items = host
             scores = np_items @ np_users[user_idx]
-            if mask is not None:
-                scores = np.where(mask, scores, -3.4e38)
             if seen is not None:
+                scores = scores.copy()
                 scores[np.asarray(seen)] = -3.4e38
-            top = np.argpartition(scores, -k)[-k:]
-            top = top[np.argsort(scores[top])[::-1]]
-            packed = np.stack([scores[top], top.astype(np.float64)])
+            top_s, top_i = host_top_k(scores, k, allowed_mask=mask)
+            packed = np.stack([top_s, top_i.astype(np.float64)])
         else:
             exclude = None
             if seen is not None:

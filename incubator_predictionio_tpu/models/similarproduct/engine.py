@@ -239,25 +239,41 @@ class SimilarProductAlgorithm(Algorithm):
         return mask
 
     def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
-        import jax.numpy as jnp
-
-        from incubator_predictionio_tpu.ops.topk import top_k_with_exclusions
+        from incubator_predictionio_tpu.ops.host_serving import (
+            host_arrays,
+            host_top_k,
+        )
 
         indices = [
             model.item_bimap[i] for i in query.items if i in model.item_bimap
         ]
         if not indices:
             return PredictedResult(item_scores=())
-        factors = jnp.asarray(model.item_factors_norm)
-        query_vec = factors[jnp.asarray(indices, jnp.int32)].mean(axis=0)
-        qnorm = jnp.linalg.norm(query_vec)
-        query_vec = query_vec / jnp.maximum(qnorm, 1e-9)
-        scores = factors @ query_vec  # cosine (factors pre-normalized)
         mask = self._allowed_mask(model, query)
-        top_s, top_i = top_k_with_exclusions(
-            scores, k=min(query.num, len(model.item_bimap)),
-            allowed_mask=jnp.asarray(mask),
-        )
+        k = min(query.num, len(model.item_bimap))
+        host = host_arrays(model, "item_factors_norm")
+        if host is not None:
+            (factors,) = host
+            query_vec = factors[np.asarray(indices, np.int32)].mean(axis=0)
+            query_vec = query_vec / max(float(np.linalg.norm(query_vec)),
+                                        1e-9)
+            top_s, top_i = host_top_k(factors @ query_vec, k,
+                                      allowed_mask=mask)
+        else:
+            import jax.numpy as jnp
+
+            from incubator_predictionio_tpu.ops.topk import (
+                top_k_with_exclusions,
+            )
+
+            factors = jnp.asarray(model.item_factors_norm)
+            query_vec = factors[jnp.asarray(indices, jnp.int32)].mean(axis=0)
+            qnorm = jnp.linalg.norm(query_vec)
+            query_vec = query_vec / jnp.maximum(qnorm, 1e-9)
+            scores = factors @ query_vec  # cosine (pre-normalized factors)
+            top_s, top_i = top_k_with_exclusions(
+                scores, k=k, allowed_mask=jnp.asarray(mask),
+            )
         inv = model.item_bimap.inverse
         out = []
         for s, i in zip(np.asarray(top_s), np.asarray(top_i)):

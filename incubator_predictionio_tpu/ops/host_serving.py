@@ -1,0 +1,55 @@
+"""Host-resident serving helpers for small models.
+
+The deployed environment may reach the TPU through a network tunnel whose
+blocking dispatch+fetch round trip is tens of milliseconds — the latency
+floor for ANY per-query device call. Models whose factor tables are a few
+MB serve faster from a host copy (numpy matvec + argpartition — the
+reference's driver-local serving locality, CreateServer.scala:498-650);
+big catalogs keep the device path, where compute dominates the round trip.
+
+Used by the recommendation / similarproduct / ecommerce serving code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+NEG_INF = -3.4e38
+
+#: models up to this many cached elements serve from the host copy
+HOST_SERVE_MAX_ELEMS = 1 << 22
+
+
+def host_arrays(model, *field_names: str,
+                max_elems: int = HOST_SERVE_MAX_ELEMS):
+    """Lazy host copies of the named model fields, or None for big models.
+
+    The copy is cached on the model object itself (``_np_cache``) so reloads
+    naturally invalidate it. A benign race under concurrent first queries
+    computes the same value twice."""
+    cache = getattr(model, "_np_cache", None)
+    if cache is None:
+        arrays = tuple(np.asarray(getattr(model, f)) for f in field_names)
+        cache = arrays if sum(a.size for a in arrays) <= max_elems else False
+        object.__setattr__(model, "_np_cache", cache)
+    return cache or None
+
+
+def host_top_k(
+    scores: np.ndarray,
+    k: int,
+    allowed_mask: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """numpy equivalent of ops.topk.top_k_with_exclusions: returns
+    (top_scores[k], top_indices[k]) descending; masked slots score
+    ``NEG_INF`` (callers already filter ``<= -1e37``)."""
+    if allowed_mask is not None:
+        scores = np.where(allowed_mask, scores, NEG_INF)
+    k = min(k, scores.shape[-1])
+    if k <= 0:
+        return np.empty(0, scores.dtype), np.empty(0, np.int64)
+    top = np.argpartition(scores, -k)[-k:]
+    top = top[np.argsort(scores[top])[::-1]]
+    return scores[top], top

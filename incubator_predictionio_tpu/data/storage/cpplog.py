@@ -88,9 +88,23 @@ class StorageClient(base.BaseStorageClient):
             path.unlink(missing_ok=True)
         return True
 
-    def close(self) -> None:
+    def sync(self) -> None:
+        """fdatasync every open log (durability point; appends only fflush —
+        torn tails are dropped by the reopen scan in eventlog.cc)."""
         with self.lock:
-            for h in self._handles.values():
+            for key, h in self._handles.items():
+                if self.lib.pio_evlog_sync(h) != 0:
+                    raise base.StorageError(
+                        f"fdatasync failed on event log {key}")
+
+    def close(self) -> None:
+        import logging
+        with self.lock:
+            for key, h in self._handles.items():
+                if self.lib.pio_evlog_sync(h) != 0:
+                    logging.getLogger(__name__).warning(
+                        "fdatasync failed on event log %s at close; recent "
+                        "appends may not be durable", key)
                 self.lib.pio_evlog_close(h)
             self._handles.clear()
 

@@ -102,6 +102,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pio_evlog_read.argtypes = [
         c.c_void_p, c.c_int64, c.c_char_p, c.c_int32,
     ]
+    lib.pio_evlog_sync.restype = c.c_int64
+    lib.pio_evlog_sync.argtypes = [c.c_void_p]
     # csr builder
     pp_i32 = c.POINTER(c.POINTER(c.c_int32))
     pp_f32 = c.POINTER(c.POINTER(c.c_float))

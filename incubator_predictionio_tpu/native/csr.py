@@ -38,6 +38,15 @@ def build_buckets_native(
     lib = native.load()
     if lib is None:
         return None
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    if len(rows) and (
+        int(rows.max()) >= 2**31 or int(cols.max()) >= 2**31
+        or int(rows.min()) < 0 or int(cols.min()) < 0
+    ):
+        # int32 cast below would silently wrap; let the caller take the
+        # numpy (int64) path instead of corrupting buckets
+        return None
     rows32 = np.ascontiguousarray(rows, np.int32)
     cols32 = np.ascontiguousarray(cols, np.int32)
     vals32 = np.ascontiguousarray(vals, np.float32)

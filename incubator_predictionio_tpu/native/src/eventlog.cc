@@ -71,18 +71,39 @@ void* pio_evlog_open(const char* path) {
   if (!f) return nullptr;
   auto* log = new EventLog();
   log->f = f;
-  // build the index: one sequential header scan
+  // Build the index: one sequential header scan. A crash mid-append (the
+  // in-process ftruncate recovery only covers fwrite failures) can leave a
+  // torn tail record whose header or payload extends past EOF; indexing it
+  // would make later appends start inside its claimed payload range and
+  // misframe every subsequent record. Validate each record's extent
+  // against the file size and truncate away a torn tail.
+  fseeko(f, 0, SEEK_END);
+  const off_t file_size = ftello(f);
   fseeko(f, 0, SEEK_SET);
   RecHeader h;
-  while (fread(&h, sizeof(h), 1, f) == 1) {
-    uint64_t off = (uint64_t)ftello(f);
+  off_t rec_start = 0;
+  bool torn_tail = false;   // extent past EOF — safe to truncate
+  bool read_error = false;  // transient I/O failure — must NOT truncate
+  while (rec_start + (off_t)sizeof(h) <= file_size) {
+    if (fread(&h, sizeof(h), 1, f) != 1) {
+      // a full header should fit here; a short read is an I/O problem
+      // (or the file shrank underneath us), not a torn tail
+      read_error = true;
+      break;
+    }
+    uint64_t off = (uint64_t)rec_start + sizeof(h);
+    const off_t rec_end = (off_t)(off + h.payload_len);
+    if (rec_end > file_size) {  // torn tail: payload past EOF
+      torn_tail = true;
+      break;
+    }
     if (h.flags & 1) {  // tombstone
       int64_t target = -1;
       if (h.payload_len == 8 && fread(&target, 8, 1, f) == 1 &&
           target >= 0 && (size_t)target < log->entries.size()) {
         log->entries[target].dead = true;
       } else {
-        fseeko(f, (off_t)(off + h.payload_len), SEEK_SET);
+        fseeko(f, rec_end, SEEK_SET);
       }
       log->entries.push_back({0, 0, 0, 0, 0, off, h.payload_len, true});
     } else {
@@ -90,12 +111,35 @@ void* pio_evlog_open(const char* path) {
       log->entries.push_back({h.time_ms, h.etype_hash, h.eid_hash,
                               h.name_hash, h.id_hash, off, h.payload_len,
                               false});
-      fseeko(f, (off_t)(off + h.payload_len), SEEK_SET);
+      fseeko(f, rec_end, SEEK_SET);
     }
+    rec_start = rec_end;
+  }
+  // Truncate ONLY a genuine torn tail (payload extent past EOF, or a
+  // partial header at EOF). A mid-file fread error must leave the file
+  // untouched — truncating there would destroy valid later records.
+  if (!read_error && rec_start < file_size &&
+      (torn_tail || rec_start + (off_t)sizeof(h) > file_size)) {
+    (void)!ftruncate(fileno(f), rec_start);
   }
   log->sorted_dirty = true;
   fseeko(f, 0, SEEK_END);
   return log;
+}
+
+// Flush buffered appends to the OS and the disk (fdatasync). The hot ingest
+// path only fflush()es — torn tails are recovered at open — so durability
+// is opt-in: the Python DAO calls this on close and on demand.
+int64_t pio_evlog_sync(void* handle) {
+  auto* log = (EventLog*)handle;
+  if (!log || !log->f) return -1;
+  std::lock_guard<std::mutex> g(log->mu);
+  if (fflush(log->f) != 0) return -1;
+#if defined(__APPLE__)
+  return fsync(fileno(log->f)) == 0 ? 0 : -1;
+#else
+  return fdatasync(fileno(log->f)) == 0 ? 0 : -1;
+#endif
 }
 
 void pio_evlog_close(void* handle) {

@@ -26,15 +26,21 @@ def host_arrays(model, *field_names: str,
                 max_elems: int = HOST_SERVE_MAX_ELEMS):
     """Lazy host copies of the named model fields, or None for big models.
 
-    The copy is cached on the model object itself (``_np_cache``) so reloads
-    naturally invalidate it. A benign race under concurrent first queries
-    computes the same value twice."""
+    The copy is cached on the model object itself (``_np_cache``, keyed by
+    the requested field names) so reloads naturally invalidate it. A benign
+    race under concurrent first queries computes the same value twice."""
     cache = getattr(model, "_np_cache", None)
+    if cache is False:   # host serving disabled for this model
+        return None
     if cache is None:
-        arrays = tuple(np.asarray(getattr(model, f)) for f in field_names)
-        cache = arrays if sum(a.size for a in arrays) <= max_elems else False
+        cache = {}
         object.__setattr__(model, "_np_cache", cache)
-    return cache or None
+    entry = cache.get(field_names)
+    if entry is None:
+        arrays = tuple(np.asarray(getattr(model, f)) for f in field_names)
+        entry = arrays if sum(a.size for a in arrays) <= max_elems else False
+        cache[field_names] = entry
+    return entry or None
 
 
 def host_top_k(

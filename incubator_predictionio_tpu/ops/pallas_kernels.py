@@ -46,11 +46,44 @@ NEG_INF = -3.4e38   # python float: pallas kernels may not close over arrays
 _LANES = 128
 
 
+_mosaic_ok: "bool | None" = None
+
+
 def pallas_available() -> bool:
-    """True when the default backend compiles Mosaic kernels (real TPU)."""
+    """True when the default backend compiles Mosaic kernels.
+
+    Platform name alone is not enough: experimental backends may report
+    ``tpu`` without full Mosaic support, and serving calls the kernels with
+    no per-query fallback — so probe once by compiling a trivial kernel and
+    cache the result."""
+    global _mosaic_ok
+    if _mosaic_ok is None:
+        _mosaic_ok = _probe_mosaic()
+    return _mosaic_ok
+
+
+def _probe_mosaic() -> bool:
     try:
-        return jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover - backend init failure
+        if jax.default_backend() != "tpu":
+            return False
+
+        def _probe_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + 1.0
+
+        x = jnp.zeros((8, _LANES), jnp.float32)
+        out = pl.pallas_call(
+            _probe_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        )(x)
+        jax.block_until_ready(out)
+        return True
+    except Exception as exc:  # pragma: no cover - Mosaic unsupported
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "Mosaic probe failed on backend %r; Pallas kernels disabled "
+            "for this process (XLA fallback paths will serve): %s",
+            jax.default_backend(), exc)
         return False
 
 

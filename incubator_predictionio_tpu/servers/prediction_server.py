@@ -120,7 +120,12 @@ class PredictionServer:
                 raise ValueError(
                     "No valid engine instance found for engine "
                     f"{self.config.engine_id} {self.config.engine_version} "
-                    f"{self.config.engine_variant}."
+                    f"{self.config.engine_variant}. The engine id is derived "
+                    "from the engine directory's absolute path — if the "
+                    "engine was trained from a different path (moved, "
+                    "re-cloned, other mount), its instances are keyed under "
+                    "a different id; redeploy from the training path or pass "
+                    "--engine-instance-id explicitly."
                 )
         return instance
 

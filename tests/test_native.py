@@ -80,6 +80,57 @@ class TestEventLogDurability:
         assert len(list(d2.find(app_id=1))) == 1
         c2.close()
 
+    def test_torn_tail_truncated_on_reopen(self, tmp_path):
+        """A crash mid-append leaves a record claiming payload past EOF; the
+        reopen scan must drop + truncate it so later appends never start
+        inside its claimed range (eventlog.cc open-scan extent check)."""
+        c1 = _client(tmp_path)
+        d1 = _events(c1)
+        d1.init(1)
+        good = [d1.insert(ev(minutes=i, eid=f"u{i}"), 1) for i in range(3)]
+        c1.close()
+
+        log_file = next(tmp_path.glob("*.log"))
+        intact = log_file.stat().st_size
+        # forge a torn record: full 48-byte header claiming a 500-byte
+        # payload, but only 10 payload bytes made it to disk
+        import struct
+        with open(log_file, "ab") as f:
+            f.write(struct.pack("<qQQQQIi", 12345, 2, 3, 4, 5, 500, 0))
+            f.write(b"x" * 10)
+
+        c2 = _client(tmp_path)
+        d2 = _events(c2)
+        found = list(d2.find(app_id=1))
+        assert [e.event_id for e in found] == good
+        # the torn tail was physically truncated away
+        assert log_file.stat().st_size == intact
+        # appends after recovery frame correctly across another reopen
+        extra = d2.insert(ev(minutes=9, eid="u9"), 1)
+        c2.close()
+        c3 = _client(tmp_path)
+        d3 = _events(c3)
+        assert [e.event_id for e in d3.find(app_id=1)] == good + [extra]
+        c3.close()
+
+    def test_torn_header_truncated_on_reopen(self, tmp_path):
+        c1 = _client(tmp_path)
+        d1 = _events(c1)
+        d1.init(1)
+        good = d1.insert(ev(minutes=0, eid="u0"), 1)
+        c1.close()
+
+        log_file = next(tmp_path.glob("*.log"))
+        intact = log_file.stat().st_size
+        with open(log_file, "ab") as f:
+            f.write(b"\x01" * 20)  # partial header
+
+        c2 = _client(tmp_path)
+        d2 = _events(c2)
+        assert [e.event_id for e in d2.find(app_id=1)] == [good]
+        assert log_file.stat().st_size == intact
+        c2.close()
+
     def test_out_of_order_times_sorted_and_limited(self, tmp_path):
         c = _client(tmp_path)
         d = _events(c)
@@ -119,6 +170,17 @@ class TestNativeCsrBuilder:
             np.testing.assert_array_equal(r.cols, g.cols)
             np.testing.assert_array_equal(r.vals, g.vals)
             np.testing.assert_array_equal(r.mask, g.mask)
+
+    def test_ids_beyond_int32_fall_back_to_numpy_path(self):
+        """Indices ≥ 2^31 would silently wrap in the int32 cast for C++;
+        the guard must return None (→ caller uses the int64 numpy path)."""
+        from incubator_predictionio_tpu.native.csr import build_buckets_native
+        rows = np.array([0, 2**31 + 5], np.int64)
+        cols = np.array([0, 1], np.int64)
+        vals = np.array([1.0, 2.0], np.float32)
+        assert build_buckets_native(
+            rows, cols, vals, n_rows=2**31 + 6, min_width=8, max_width=64,
+        ) is None
 
     def test_empty_rows_and_empty_input(self):
         # rows 3..9 have no entries; row 0 dense

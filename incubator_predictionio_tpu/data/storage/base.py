@@ -147,6 +147,29 @@ class Model:
 # Event DAO
 # ---------------------------------------------------------------------------
 
+@dataclasses.dataclass
+class Interactions:
+    """Columnar, pre-indexed (entity, target, value) triples — the training
+    ingest format.
+
+    This is the TPU-native replacement for the reference's parallel event
+    read (``PEvents.find`` → ``RDD[Event]`` via ``newAPIHadoopRDD``,
+    hbase/HBPEvents.scala:63-88): instead of materializing per-event
+    objects, backends stream straight into dense int32 COO arrays plus the
+    distinct-id tables, ready for ``jax.device_put`` after bucketing.
+    ``user_ids[user_idx[k]]`` recovers the original entity id of triple k.
+    """
+
+    user_idx: "Any"     # np.ndarray int32 [nnz] — index into user_ids
+    item_idx: "Any"     # np.ndarray int32 [nnz] — index into item_ids
+    values: "Any"       # np.ndarray float32 [nnz]
+    user_ids: list      # distinct entity ids, first-seen order
+    item_ids: list      # distinct target entity ids, first-seen order
+
+    def __len__(self) -> int:
+        return int(self.user_idx.shape[0])
+
+
 class Events(abc.ABC):
     """Event CRUD + query DAO (LEvents.scala:40-492)."""
 
@@ -167,6 +190,14 @@ class Events(abc.ABC):
         self, event: Event, app_id: int, channel_id: Optional[int] = None
     ) -> str:
         """Insert one event, returning its event ID (LEvents.futureInsert)."""
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> list:
+        """Bulk insert (PEvents.write:184 / the import tool's path).
+        Backends override with a single-write fast path."""
+        return [self.insert(e, app_id, channel_id) for e in events]
 
     @abc.abstractmethod
     def get(
@@ -237,6 +268,70 @@ class Events(abc.ABC):
                 if all(prop in v for prop in required)
             }
         return result
+
+    def scan_interactions(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_names: Sequence[str] = ("rate",),
+        value_prop: Optional[str] = None,
+        event_values: Optional[Dict[str, float]] = None,
+        default_value: float = 1.0,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+    ) -> Interactions:
+        """Columnar training-ingest scan (see :class:`Interactions`).
+
+        Value resolution per event, in order: a fixed per-event-name value
+        from ``event_values``; else the numeric property ``value_prop``
+        (events *missing* it are skipped — DataSource.scala:66-72 drops
+        rate events without a rating); else ``default_value``. Events
+        without a target entity are skipped. Backends override this with
+        scans that never materialize :class:`Event` objects; this generic
+        implementation defines the semantics they must match.
+        """
+        import numpy as np
+
+        fixed = event_values or {}
+        users: Dict[str, int] = {}
+        items: Dict[str, int] = {}
+        uidx: list = []
+        iidx: list = []
+        vals: list = []
+        for e in self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=list(event_names),
+        ):
+            if e.target_entity_id is None:
+                continue
+            if e.event in fixed:
+                v = fixed[e.event]
+            elif value_prop is not None:
+                raw = e.properties.to_jsonable().get(value_prop)
+                if not isinstance(raw, (int, float)) or isinstance(raw, bool):
+                    continue   # missing or non-numeric → skipped
+                v = float(raw)
+            else:
+                v = default_value
+            u = users.setdefault(e.entity_id, len(users))
+            i = items.setdefault(e.target_entity_id, len(items))
+            uidx.append(u)
+            iidx.append(i)
+            vals.append(v)
+        return Interactions(
+            user_idx=np.asarray(uidx, np.int32),
+            item_idx=np.asarray(iidx, np.int32),
+            values=np.asarray(vals, np.float32),
+            user_ids=list(users),
+            item_ids=list(items),
+        )
 
 
 # ---------------------------------------------------------------------------

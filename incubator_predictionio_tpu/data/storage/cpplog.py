@@ -157,36 +157,104 @@ class CppLogEvents(base.Events):
 
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
-        validate_event(event)
-        with self.client.lock:
-            return self._insert_locked(event, app_id, channel_id)
+        # one code path: a single insert is a batch of one (gets the same
+        # upsert semantics and the sidecar fast-scan block)
+        return self.insert_batch([event], app_id, channel_id)[0]
 
-    def _insert_locked(self, event: Event, app_id: int,
-                       channel_id: Optional[int]) -> str:
-        h = self._handle(app_id, channel_id)
-        if event.event_id:
-            # upsert semantics (parity with the sqlite backend's INSERT OR
-            # REPLACE): tombstone any existing record with this event id.
-            # Only explicit ids can collide — freshly minted UUIDs skip the
-            # scan so bulk ingest stays O(1) per event.
-            eid = event.event_id
-            for idx in self._candidates_by_id(h, eid):
-                obj = self._read(h, idx)
-                if obj is not None and obj.get("eventId") == eid:
-                    self.client.lib.pio_evlog_tombstone(h, idx)
-        else:
-            eid = new_event_id()
-        payload = json.dumps(
-            event.with_id(eid).to_jsonable(), separators=(",", ":")
-        ).encode("utf-8")
-        rc = self.client.lib.pio_evlog_append(
-            h, to_millis(event.event_time), _h(event.entity_type),
-            _h(event.entity_id), _h(event.event), _h(eid),
-            payload, len(payload),
-        )
-        if rc < 0:
-            raise base.StorageError("event log append failed")
-        return eid
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> list:
+        """Bulk fast path: one framed batch write (pio_evlog_append_bulk).
+
+        Hashing, sidecar construction, and framing happen in C++; Python
+        serializes the JSON document and packs the numeric properties. Each
+        record gets a binary sidecar block (the columnar-scan fast path)
+        unless a field exceeds the sidecar's length limits."""
+        import struct
+
+        import numpy as np
+
+        n = len(events)
+        if n == 0:
+            return []
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            ids: list[str] = []
+            times = np.empty(n, np.int64)
+            offs = np.empty(7 * n + 1, np.int64)
+            meta = bytearray(8 * n)
+            chunks: list[bytes] = []
+            pos = 0
+            offs[0] = 0
+            j = 0
+            for k, event in enumerate(events):
+                validate_event(event)
+                if event.event_id:
+                    # upsert parity with insert(): tombstone existing record
+                    eid = event.event_id
+                    for idx in self._candidates_by_id(h, eid):
+                        obj = self._read(h, idx)
+                        if obj is not None and obj.get("eventId") == eid:
+                            self.client.lib.pio_evlog_tombstone(h, idx)
+                else:
+                    eid = new_event_id()
+                ids.append(eid)
+                payload = json.dumps(
+                    event.with_id(eid).to_jsonable(), separators=(",", ":")
+                ).encode("utf-8")
+                times[k] = to_millis(event.event_time)
+                etype_b = event.entity_type.encode("utf-8")
+                ent_b = event.entity_id.encode("utf-8")
+                name_b = event.event.encode("utf-8")
+                tet_b = (event.target_entity_type or "").encode("utf-8")
+                tei_b = (event.target_entity_id or "").encode("utf-8")
+                has_target = event.target_entity_id is not None
+                # numeric properties for the sidecar's value lookup
+                props_blob = b""
+                n_props = 0
+                sidecar_ok = max(
+                    len(etype_b), len(ent_b), len(name_b),
+                    len(tet_b), len(tei_b)) < 0xFFFF
+                if sidecar_ok:
+                    parts = []
+                    for key, v in event.properties.to_jsonable().items():
+                        if isinstance(v, bool) or \
+                                not isinstance(v, (int, float)):
+                            continue
+                        kb = key.encode("utf-8")
+                        if len(kb) > 255 or n_props == 255:
+                            # a numeric prop the sidecar cannot carry: the
+                            # sidecar would disagree with the JSON, so this
+                            # record must use the JSON path
+                            sidecar_ok = False
+                            break
+                        parts.append(struct.pack("<B", len(kb)) + kb
+                                     + struct.pack("<d", float(v)))
+                        n_props += 1
+                    if sidecar_ok:
+                        props_blob = b"".join(parts)
+                    else:
+                        n_props = 0
+                struct.pack_into("<BBBBI", meta, 8 * k,
+                                 1 if has_target else 0,
+                                 1 if sidecar_ok else 0,
+                                 n_props, 0, len(props_blob))
+                for field in (etype_b, ent_b, name_b, eid.encode("utf-8"),
+                              tet_b, tei_b, props_blob + payload):
+                    chunks.append(field)
+                    pos += len(field)
+                    j += 1
+                    offs[j] = pos
+            buf = b"".join(chunks)
+            rc = self.client.lib.pio_evlog_append_bulk(
+                h, n,
+                times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                buf,
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                bytes(meta),
+            )
+            if rc != n:
+                raise base.StorageError("bulk event append failed")
+        return ids
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
@@ -278,6 +346,76 @@ class CppLogEvents(base.Events):
             iter(raw), entity_type, entity_id, names,
             target_entity_type, target_entity_id, want)
         return iter(results)
+
+    def scan_interactions(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_names: Sequence[str] = ("rate",),
+        value_prop: Optional[str] = None,
+        event_values: Optional[dict] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        default_value: float = 1.0,
+    ) -> base.Interactions:
+        """Columnar scan fully in C++ (pio_evlog_scan_interactions): header
+        prefilter, payload field extraction, value resolution, and id
+        interning all happen natively; Python only receives the finished
+        int32/float32 arrays and the two id tables."""
+        import numpy as np
+
+        lib = self.client.lib
+        names = [str(n) for n in event_names]
+        fixed = event_values or {}
+        c_names = (ctypes.c_char_p * max(len(names), 1))(
+            *[n.encode("utf-8") for n in names] or [None])
+        c_fixed = (ctypes.c_double * max(len(names), 1))(
+            *[float(fixed.get(n, float("nan"))) for n in names] or [0.0])
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            res = lib.pio_evlog_scan_interactions(
+                h,
+                _I64_MIN if start_time is None else to_millis(start_time),
+                _I64_MAX if until_time is None else to_millis(until_time),
+                entity_type.encode("utf-8"),
+                target_entity_type.encode("utf-8"),
+                c_names, c_fixed, len(names),
+                None if value_prop is None else value_prop.encode("utf-8"),
+                float(default_value),
+            )
+            try:
+                nnz = lib.pio_scan_nnz(res)
+                uidx = np.empty(nnz, np.int32)
+                iidx = np.empty(nnz, np.int32)
+                vals = np.empty(nnz, np.float32)
+                if nnz:
+                    lib.pio_scan_fill(
+                        res,
+                        uidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                        iidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    )
+                user_ids = self._scan_ids(res, 0)
+                item_ids = self._scan_ids(res, 1)
+            finally:
+                lib.pio_scan_free(res)
+        return base.Interactions(
+            user_idx=uidx, item_idx=iidx, values=vals,
+            user_ids=user_ids, item_ids=item_ids,
+        )
+
+    def _scan_ids(self, res: int, which: int) -> list:
+        lib = self.client.lib
+        n = lib.pio_scan_n_ids(res, which)
+        nbytes = lib.pio_scan_ids_bytes(res, which)
+        buf = ctypes.create_string_buffer(max(int(nbytes), 1))
+        offs = (ctypes.c_int64 * (n + 1))()
+        lib.pio_scan_copy_ids(res, which, buf, offs)
+        blob = buf.raw[:nbytes]
+        return [blob[offs[i]:offs[i + 1]].decode("utf-8")
+                for i in range(n)]
 
     @staticmethod
     def _filter_parsed(payloads, entity_type, entity_id, names,

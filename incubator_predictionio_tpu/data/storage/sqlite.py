@@ -355,6 +355,116 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
         rows = self._query(sql, params)
         return (_row_to_event(r) for r in rows)
 
+    def scan_interactions(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_names: Sequence[str] = ("rate",),
+        value_prop: Optional[str] = None,
+        event_values: Optional[Dict[str, float]] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        default_value: float = 1.0,
+        batch_rows: int = 500_000,
+    ) -> base.Interactions:
+        """Columnar scan resolved entirely in SQL — id interning via
+        ``dense_rank`` windows and value extraction via ``json_extract``,
+        so no :class:`Event` objects (and no Python JSON parsing) exist on
+        the training path. Replaces the reference's partitioned
+        ``JdbcRDD`` read (jdbc/JDBCPEvents.scala:64-88)."""
+        import numpy as np
+
+        fixed = dict(event_values or {})
+        names = [str(n) for n in event_names]
+        where = ["ns = ?", "app_id = ?", "channel_id = ?",
+                 "entity_type = ?", "target_entity_type = ?",
+                 "target_entity_id IS NOT NULL"]
+        params: list[Any] = [self.ns, app_id, _chan(channel_id),
+                             entity_type, target_entity_type]
+        if names:
+            where.append("event IN (%s)" % ",".join("?" * len(names)))
+            params.extend(names)
+        else:
+            where.append("0")
+        if start_time is not None:
+            where.append("event_time >= ?")
+            params.append(to_millis(start_time))
+        if until_time is not None:
+            where.append("event_time < ?")
+            params.append(to_millis(until_time))
+
+        # value: fixed per event name, else json_extract(value_prop), else
+        # the default constant; rows whose value resolves NULL are skipped
+        # (the generic scan's "rate event without a rating" rule)
+        value_sql = "?"
+        value_params: list[Any] = [default_value]
+        if value_prop is not None:
+            if '"' in value_prop or "\\" in value_prop:
+                raise ValueError(
+                    f"unsupported value_prop name: {value_prop!r}")
+            # json_type guard: CAST('hi' AS REAL) would silently yield 0.0;
+            # non-numeric properties must skip the row instead
+            path = '\'$."%s"\'' % value_prop
+            value_sql = (
+                f"CASE WHEN json_type(properties, {path}) IN "
+                "('integer','real') THEN "
+                f"CAST(json_extract(properties, {path}) AS REAL) END"
+            )
+            value_params = []
+        if fixed:
+            cases = " ".join("WHEN ? THEN ?" for _ in fixed)
+            value_sql = f"CASE event {cases} ELSE {value_sql} END"
+            case_params: list[Any] = []
+            for name, v in fixed.items():
+                case_params.extend([name, float(v)])
+            value_params = case_params + value_params
+
+        cond = " AND ".join(where)
+        # one inner row set shared by the COO stream and the id tables, so
+        # the dense_rank index space and the DISTINCT tables always align
+        # (a row whose value resolves NULL exists in neither)
+        inner = (
+            f"SELECT entity_id, target_entity_id, {value_sql} AS v,"
+            f" event_time, id FROM events WHERE {cond}"
+        )
+        body_params = value_params + params
+        sql = (
+            "SELECT"
+            " dense_rank() OVER (ORDER BY entity_id) - 1,"
+            " dense_rank() OVER (ORDER BY target_entity_id) - 1,"
+            f" v FROM ({inner}) WHERE v IS NOT NULL"
+            " ORDER BY event_time, id"
+        )
+        u_chunks, i_chunks, v_chunks = [], [], []
+        with self.client.lock:
+            cur = self.client.conn.execute(sql, body_params)
+            while True:
+                rows = cur.fetchmany(batch_rows)
+                if not rows:
+                    break
+                arr = np.array(rows, np.float64)
+                u_chunks.append(arr[:, 0].astype(np.int32))
+                i_chunks.append(arr[:, 1].astype(np.int32))
+                v_chunks.append(arr[:, 2].astype(np.float32))
+            user_ids = [r[0] for r in self.client.conn.execute(
+                f"SELECT DISTINCT entity_id FROM ({inner})"
+                " WHERE v IS NOT NULL ORDER BY entity_id", body_params)]
+            item_ids = [r[0] for r in self.client.conn.execute(
+                f"SELECT DISTINCT target_entity_id FROM ({inner})"
+                " WHERE v IS NOT NULL ORDER BY target_entity_id",
+                body_params)]
+        empty = np.zeros(0, np.int32)
+        return base.Interactions(
+            user_idx=np.concatenate(u_chunks) if u_chunks else empty,
+            item_idx=np.concatenate(i_chunks) if i_chunks else empty,
+            values=(np.concatenate(v_chunks) if v_chunks
+                    else np.zeros(0, np.float32)),
+            user_ids=user_ids,
+            item_ids=item_ids,
+        )
+
 
 class SQLiteApps(_SQLiteDAO, base.Apps):
     def insert(self, app: base.App) -> Optional[int]:

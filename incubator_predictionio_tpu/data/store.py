@@ -102,6 +102,37 @@ class EventStore:
         )
 
     @staticmethod
+    def interactions(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_names: Sequence[str] = ("rate",),
+        value_prop: Optional[str] = None,
+        event_values: Optional[Dict[str, float]] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        default_value: float = 1.0,
+    ):
+        """Columnar training ingest (base.Events.scan_interactions): the
+        TPU-native replacement for the reference's RDD event read
+        (PEventStore.find → newAPIHadoopRDD) — streams matching events into
+        pre-indexed COO arrays + id tables without per-event objects."""
+        app_id, channel_id = _resolve(app_name, channel_name)
+        return Storage.get_events().scan_interactions(
+            app_id=app_id,
+            channel_id=channel_id,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names,
+            value_prop=value_prop,
+            event_values=event_values,
+            start_time=start_time,
+            until_time=until_time,
+            default_value=default_value,
+        )
+
+    @staticmethod
     def aggregate_properties(
         app_name: str,
         entity_type: str,
@@ -129,8 +160,8 @@ class EventStore:
     ) -> list[str]:
         """Bulk insert (PEvents.write:184, used by `pio import`)."""
         app_id, channel_id = _resolve(app_name, channel_name)
-        dao = Storage.get_events()
-        return [dao.insert(e, app_id, channel_id) for e in events]
+        return Storage.get_events().insert_batch(
+            list(events), app_id, channel_id)
 
     @staticmethod
     def delete(

@@ -36,6 +36,7 @@ from incubator_predictionio_tpu.core import (
     Serving,
 )
 from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.storage.base import Interactions
 from incubator_predictionio_tpu.data.store import EventStore
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 
@@ -88,11 +89,18 @@ class Interaction:
 
 @dataclasses.dataclass
 class TrainingData:
-    interactions: List[Interaction]
-    item_categories: Dict[str, Tuple[str, ...]]
+    interactions: Optional[List[Interaction]] = None  # fixture/legacy form
+    item_categories: Dict[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=dict)
+    columnar: Optional[Interactions] = None           # columnar ingest form
+
+    def __len__(self) -> int:
+        if self.columnar is not None:
+            return len(self.columnar)
+        return len(self.interactions or [])
 
     def sanity_check(self) -> None:
-        if not self.interactions:
+        if not len(self):
             raise ValueError("TrainingData has no user-item interactions")
 
 
@@ -102,17 +110,14 @@ class ECommerceDataSource(DataSource):
 
     def read_training(self, ctx: RuntimeContext) -> TrainingData:
         weights = dict(self.params.event_weights)
-        events = EventStore.find(
+        columnar = EventStore.interactions(
             app_name=self.params.app_name,
             channel_name=self.params.channel_name,
             entity_type="user",
             target_entity_type="item",
-            event_names=list(weights),
+            event_names=tuple(weights),
+            event_values={k: float(v) for k, v in weights.items()},
         )
-        interactions = [
-            Interaction(e.entity_id, e.target_entity_id, weights[e.event])
-            for e in events
-        ]
         props = EventStore.aggregate_properties(
             app_name=self.params.app_name,
             channel_name=self.params.channel_name,
@@ -122,7 +127,7 @@ class ECommerceDataSource(DataSource):
             item: tuple(str(c) for c in (pm.opt("categories", list) or ()))
             for item, pm in props.items()
         }
-        return TrainingData(interactions=interactions, item_categories=cats)
+        return TrainingData(columnar=columnar, item_categories=cats)
 
 
 @dataclasses.dataclass
@@ -137,6 +142,8 @@ class PreparedData:
 
 class ECommercePreparator(Preparator):
     def prepare(self, ctx: RuntimeContext, td: TrainingData) -> PreparedData:
+        if td.columnar is not None:
+            return self._prepare_columnar(td)
         user_bimap = BiMap.string_int(i.user for i in td.interactions)
         item_bimap = BiMap.string_int(i.item for i in td.interactions)
         agg: Dict[Tuple[int, int], float] = {}
@@ -151,6 +158,25 @@ class ECommercePreparator(Preparator):
             weights=coo[:, 2].astype(np.float32),
             user_bimap=user_bimap,
             item_bimap=item_bimap,
+            item_categories=td.item_categories,
+        )
+
+    def _prepare_columnar(self, td: TrainingData) -> PreparedData:
+        """Vectorized weight summation over the columnar scan (same math
+        as the legacy loop: repeated events sum their weights)."""
+        inter = td.columnar
+        n_items = max(len(inter.item_ids), 1)
+        keys = inter.user_idx.astype(np.int64) * n_items \
+            + inter.item_idx.astype(np.int64)
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        sums = np.zeros(len(uniq), np.float64)
+        np.add.at(sums, inverse, inter.values.astype(np.float64))
+        return PreparedData(
+            users=(uniq // n_items).astype(np.int32),
+            items=(uniq % n_items).astype(np.int32),
+            weights=sums.astype(np.float32),
+            user_bimap=BiMap({u: i for i, u in enumerate(inter.user_ids)}),
+            item_bimap=BiMap({t: i for i, t in enumerate(inter.item_ids)}),
             item_categories=td.item_categories,
         )
 

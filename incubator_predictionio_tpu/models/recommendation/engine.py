@@ -41,6 +41,7 @@ from incubator_predictionio_tpu.core.self_cleaning import (
     SelfCleaningDataSource,
 )
 from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.storage.base import Interactions
 from incubator_predictionio_tpu.data.store import EventStore
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 
@@ -105,14 +106,37 @@ class DataSourceParams(Params):
 
 @dataclasses.dataclass
 class TrainingData:
-    ratings: List[Rating]
+    """Training set in columnar form (``interactions``) or, for hand-built
+    fixtures and the legacy path, a ``ratings`` list. The columnar form is
+    what the event store's streamed ingest produces (SURVEY §7(b)) — no
+    per-event Python objects exist on that path."""
+
+    ratings: Optional[List[Rating]] = None
     item_years: Dict[str, int] = dataclasses.field(default_factory=dict)
     item_categories: Dict[str, Tuple[str, ...]] = dataclasses.field(
         default_factory=dict
     )
+    interactions: Optional[Interactions] = None
+
+    def __len__(self) -> int:
+        if self.interactions is not None:
+            return len(self.interactions)
+        return len(self.ratings or [])
+
+    def materialize_ratings(self) -> List[Rating]:
+        """Compat view for consumers that want per-triple objects."""
+        if self.ratings is None and self.interactions is not None:
+            inter = self.interactions
+            self.ratings = [
+                Rating(inter.user_ids[int(u)], inter.item_ids[int(i)],
+                       float(v))
+                for u, i, v in zip(inter.user_idx, inter.item_idx,
+                                   inter.values)
+            ]
+        return self.ratings or []
 
     def sanity_check(self) -> None:
-        if not self.ratings:
+        if not len(self):
             raise ValueError(
                 "TrainingData has no ratings — ingest rate/buy events first"
             )
@@ -128,24 +152,20 @@ class RecommendationDataSource(DataSource, SelfCleaningDataSource):
         else:
             self.event_window = None
 
-    def _read_ratings(self) -> List[Rating]:
-        events = EventStore.find(
+    def _read_interactions(self) -> Interactions:
+        """Columnar ingest: rate events contribute their ``rating``
+        property (missing/non-numeric skipped, DataSource.scala:66-72),
+        buy events the fixed implicit weight — streamed straight to COO
+        arrays by the store backend, no Event objects."""
+        return EventStore.interactions(
             app_name=self.params.app_name,
             channel_name=self.params.channel_name,
             entity_type="user",
             target_entity_type="item",
-            event_names=["rate", "buy"],
+            event_names=("rate", "buy"),
+            value_prop="rating",
+            event_values={"buy": self.params.buy_rating},
         )
-        ratings: List[Rating] = []
-        for e in events:
-            if e.event == "rate":
-                value = e.properties.opt("rating", float)
-                if value is None:
-                    continue
-            else:  # "buy"
-                value = self.params.buy_rating
-            ratings.append(Rating(e.entity_id, e.target_entity_id, value))
-        return ratings
 
     def _read_item_meta(self) -> Tuple[Dict[str, int], Dict[str, Tuple[str, ...]]]:
         props = EventStore.aggregate_properties(
@@ -168,24 +188,35 @@ class RecommendationDataSource(DataSource, SelfCleaningDataSource):
             self.clean_persisted_events()
         years, cats = self._read_item_meta()
         return TrainingData(
-            ratings=self._read_ratings(), item_years=years, item_categories=cats
+            interactions=self._read_interactions(),
+            item_years=years, item_categories=cats,
         )
 
     def read_eval(self, ctx: RuntimeContext):
         """k-fold split (parity: e2 CrossValidation + the integration-test
         engine's Evaluation). Queries ask top-N for each user in the test
-        fold; actuals are that user's held-out items."""
+        fold; actuals are that user's held-out items. Folds are columnar
+        slices — no per-triple objects."""
         k = self.params.eval_k
         if k <= 0:
             return []
         td = self.read_training(ctx)
+        inter = td.interactions
+        nnz = len(inter)
         out = []
         for fold in range(k):
-            train = [r for i, r in enumerate(td.ratings) if i % k != fold]
-            test = [r for i, r in enumerate(td.ratings) if i % k == fold]
+            mask = (np.arange(nnz) % k) != fold
+            train_inter = Interactions(
+                user_idx=inter.user_idx[mask],
+                item_idx=inter.item_idx[mask],
+                values=inter.values[mask],
+                user_ids=inter.user_ids,
+                item_ids=inter.item_ids,
+            )
             by_user: Dict[str, set] = {}
-            for r in test:
-                by_user.setdefault(r.user, set()).add(r.item)
+            for u, i in zip(inter.user_idx[~mask], inter.item_idx[~mask]):
+                by_user.setdefault(inter.user_ids[int(u)], set()).add(
+                    inter.item_ids[int(i)])
             qa = [
                 (Query(user=user, num=self.params.eval_queries_num,
                        exclude_seen=True),
@@ -194,7 +225,9 @@ class RecommendationDataSource(DataSource, SelfCleaningDataSource):
             ]
             out.append(
                 (
-                    TrainingData(train, td.item_years, td.item_categories),
+                    TrainingData(interactions=train_inter,
+                                 item_years=td.item_years,
+                                 item_categories=td.item_categories),
                     EvalInfo(fold=fold),
                     qa,
                 )
@@ -234,6 +267,8 @@ class RecommendationPreparator(Preparator):
     convention."""
 
     def prepare(self, ctx: RuntimeContext, td: TrainingData) -> PreparedData:
+        if td.interactions is not None:
+            return self._prepare_columnar(td)
         user_bimap = BiMap.string_int(r.user for r in td.ratings)
         item_bimap = BiMap.string_int(r.item for r in td.ratings)
         latest: Dict[Tuple[int, int], float] = {}
@@ -246,6 +281,31 @@ class RecommendationPreparator(Preparator):
             users=coo[:, 0].astype(np.int32),
             items=coo[:, 1].astype(np.int32),
             ratings=coo[:, 2].astype(np.float32),
+            user_bimap=user_bimap,
+            item_bimap=item_bimap,
+            item_years=td.item_years,
+            item_categories=td.item_categories,
+        )
+
+    def _prepare_columnar(self, td: TrainingData) -> PreparedData:
+        """Vectorized reindex: the scan already interned ids, so the BiMaps
+        are direct table views and the latest-wins dedup is one np.unique
+        over packed (user, item) keys — O(nnz log nnz) C work, no Python
+        loop over triples."""
+        inter = td.interactions
+        user_bimap = BiMap({u: i for i, u in enumerate(inter.user_ids)})
+        item_bimap = BiMap({t: i for i, t in enumerate(inter.item_ids)})
+        n_items = max(len(inter.item_ids), 1)
+        keys = inter.user_idx.astype(np.int64) * n_items \
+            + inter.item_idx.astype(np.int64)
+        # keep the LAST occurrence of each (user, item): scan order is
+        # event-time order, so the newest rating wins (template convention)
+        _, first_in_rev = np.unique(keys[::-1], return_index=True)
+        keep = np.sort(len(keys) - 1 - first_in_rev)
+        return PreparedData(
+            users=inter.user_idx[keep],
+            items=inter.item_idx[keep],
+            ratings=inter.values[keep],
             user_bimap=user_bimap,
             item_bimap=item_bimap,
             item_years=td.item_years,

@@ -32,6 +32,7 @@ from incubator_predictionio_tpu.core import (
     Serving,
 )
 from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.storage.base import Interactions
 from incubator_predictionio_tpu.data.store import EventStore
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 
@@ -83,11 +84,18 @@ class DataSourceParams(Params):
 
 @dataclasses.dataclass
 class TrainingData:
-    views: List[ViewEvent]
-    item_categories: Dict[str, Tuple[str, ...]]
+    views: Optional[List[ViewEvent]] = None   # fixture/legacy form
+    item_categories: Dict[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=dict)
+    interactions: Optional[Interactions] = None  # columnar ingest form
+
+    def __len__(self) -> int:
+        if self.interactions is not None:
+            return len(self.interactions)
+        return len(self.views or [])
 
     def sanity_check(self) -> None:
-        if not self.views:
+        if not len(self):
             raise ValueError("TrainingData has no view events")
 
 
@@ -97,17 +105,14 @@ class SimilarProductDataSource(DataSource):
 
     def read_training(self, ctx: RuntimeContext) -> TrainingData:
         weights = dict(self.params.event_weights)
-        events = EventStore.find(
+        inter = EventStore.interactions(
             app_name=self.params.app_name,
             channel_name=self.params.channel_name,
             entity_type="user",
             target_entity_type="item",
-            event_names=list(weights),
+            event_names=tuple(weights),
+            event_values={k: float(v) for k, v in weights.items()},
         )
-        views = [
-            ViewEvent(e.entity_id, e.target_entity_id, weights[e.event])
-            for e in events
-        ]
         props = EventStore.aggregate_properties(
             app_name=self.params.app_name,
             channel_name=self.params.channel_name,
@@ -117,7 +122,7 @@ class SimilarProductDataSource(DataSource):
             item: tuple(str(c) for c in (pm.opt("categories", list) or ()))
             for item, pm in props.items()
         }
-        return TrainingData(views=views, item_categories=cats)
+        return TrainingData(interactions=inter, item_categories=cats)
 
 
 @dataclasses.dataclass
@@ -132,6 +137,8 @@ class PreparedData:
 
 class SimilarProductPreparator(Preparator):
     def prepare(self, ctx: RuntimeContext, td: TrainingData) -> PreparedData:
+        if td.interactions is not None:
+            return self._prepare_columnar(td)
         user_bimap = BiMap.string_int(v.user for v in td.views)
         item_bimap = BiMap.string_int(v.item for v in td.views)
         # sum repeated (user, item) weights — repeated views add confidence
@@ -147,6 +154,26 @@ class SimilarProductPreparator(Preparator):
             weights=coo[:, 2].astype(np.float32),
             user_bimap=user_bimap,
             item_bimap=item_bimap,
+            item_categories=td.item_categories,
+        )
+
+    def _prepare_columnar(self, td: TrainingData) -> PreparedData:
+        """Vectorized weight summation: np.unique over packed (user, item)
+        keys + np.add.at accumulation — repeated views add confidence with
+        no Python loop over triples."""
+        inter = td.interactions
+        n_items = max(len(inter.item_ids), 1)
+        keys = inter.user_idx.astype(np.int64) * n_items \
+            + inter.item_idx.astype(np.int64)
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        sums = np.zeros(len(uniq), np.float64)
+        np.add.at(sums, inverse, inter.values.astype(np.float64))
+        return PreparedData(
+            users=(uniq // n_items).astype(np.int32),
+            items=(uniq % n_items).astype(np.int32),
+            weights=sums.astype(np.float32),
+            user_bimap=BiMap({u: i for i, u in enumerate(inter.user_ids)}),
+            item_bimap=BiMap({t: i for i, t in enumerate(inter.item_ids)}),
             item_categories=td.item_categories,
         )
 

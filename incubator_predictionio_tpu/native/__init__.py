@@ -61,7 +61,7 @@ def build(force: bool = False) -> Path:
     tmp = so.with_suffix(f".so.tmp{os.getpid()}")
     cmd = [
         os.environ.get("CXX", "g++"),
-        "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
         *[str(_SRC_DIR / s) for s in _SOURCES],
         "-o", str(tmp),
     ]
@@ -104,6 +104,34 @@ def _declare(lib: ctypes.CDLL) -> None:
     ]
     lib.pio_evlog_sync.restype = c.c_int64
     lib.pio_evlog_sync.argtypes = [c.c_void_p]
+    # columnar interaction scan
+    lib.pio_evlog_scan_interactions.restype = c.c_void_p
+    lib.pio_evlog_scan_interactions.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int64, c.c_char_p, c.c_char_p,
+        c.POINTER(c.c_char_p), c.POINTER(c.c_double), c.c_int32,
+        c.c_char_p, c.c_double,
+    ]
+    lib.pio_scan_nnz.restype = c.c_int64
+    lib.pio_scan_nnz.argtypes = [c.c_void_p]
+    lib.pio_scan_n_ids.restype = c.c_int64
+    lib.pio_scan_n_ids.argtypes = [c.c_void_p, c.c_int32]
+    lib.pio_scan_ids_bytes.restype = c.c_int64
+    lib.pio_scan_ids_bytes.argtypes = [c.c_void_p, c.c_int32]
+    lib.pio_scan_fill.restype = None
+    lib.pio_scan_fill.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+        c.POINTER(c.c_float),
+    ]
+    lib.pio_scan_copy_ids.restype = None
+    lib.pio_scan_copy_ids.argtypes = [
+        c.c_void_p, c.c_int32, c.c_char_p, i64p,
+    ]
+    lib.pio_scan_free.restype = None
+    lib.pio_scan_free.argtypes = [c.c_void_p]
+    lib.pio_evlog_append_bulk.restype = c.c_int64
+    lib.pio_evlog_append_bulk.argtypes = [
+        c.c_void_p, c.c_int64, i64p, c.c_char_p, i64p, c.c_char_p,
+    ]
     # csr builder
     pp_i32 = c.POINTER(c.POINTER(c.c_int32))
     pp_f32 = c.POINTER(c.POINTER(c.c_float))

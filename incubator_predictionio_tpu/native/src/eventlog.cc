@@ -16,12 +16,21 @@
 // Deletes are tombstone records so the file stays append-only.
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <deque>
+#include <functional>
 #include <mutex>
-#include <unistd.h>
 #include <string>
+#include <string_view>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -33,8 +42,22 @@ struct __attribute__((packed)) RecHeader {
   uint64_t name_hash;   // event name
   uint64_t id_hash;     // event id
   uint32_t payload_len;
-  uint32_t flags;       // 1 = tombstone (payload = 8-byte target index)
+  uint32_t flags;       // bit0 = tombstone (payload = 8-byte target index)
+                        // bit1 = payload starts with a binary sidecar block
 };
+
+// flags bit1: the payload is [sidecar block][JSON] instead of bare JSON.
+// The sidecar carries the scan-relevant fields in binary so the columnar
+// training scan never parses JSON. Layout (little-endian, packed):
+//   u32 block_len (including this field)
+//   u8  n_numeric_props
+//   u16 etype_len, name_len, eid_len, tetype_len (0xFFFF = no target),
+//       teid_len
+//   bytes: etype, name, eid, tetype, teid
+//   per prop: u8 key_len, key bytes, f64 value
+static constexpr uint32_t kTombstone = 1;
+static constexpr uint32_t kSidecar = 2;
+static constexpr uint16_t kNoTarget = 0xFFFF;
 
 static_assert(sizeof(RecHeader) == 48, "header layout is the disk format");
 
@@ -43,6 +66,7 @@ struct Entry {
   uint64_t etype_hash, eid_hash, name_hash, id_hash;
   uint64_t offset;      // of payload
   uint32_t payload_len;
+  uint32_t flags;
   bool dead;
 };
 
@@ -105,12 +129,13 @@ void* pio_evlog_open(const char* path) {
       } else {
         fseeko(f, rec_end, SEEK_SET);
       }
-      log->entries.push_back({0, 0, 0, 0, 0, off, h.payload_len, true});
+      log->entries.push_back({0, 0, 0, 0, 0, off, h.payload_len, h.flags,
+                              true});
     } else {
       log->last_time = std::max(log->last_time, h.time_ms);
       log->entries.push_back({h.time_ms, h.etype_hash, h.eid_hash,
                               h.name_hash, h.id_hash, off, h.payload_len,
-                              false});
+                              h.flags, false});
       fseeko(f, rec_end, SEEK_SET);
     }
     rec_start = rec_end;
@@ -171,7 +196,8 @@ int64_t pio_evlog_append(void* handle, int64_t time_ms, uint64_t etype_hash,
   }
   fflush(log->f);
   log->entries.push_back(
-      {time_ms, etype_hash, eid_hash, name_hash, id_hash, off, len, false});
+      {time_ms, etype_hash, eid_hash, name_hash, id_hash, off, len, 0,
+       false});
   if (time_ms >= log->last_time && !log->sorted_dirty) {
     log->sorted.push_back((int64_t)log->entries.size() - 1);  // stays sorted
   } else {
@@ -200,7 +226,7 @@ int64_t pio_evlog_tombstone(void* handle, int64_t index) {
   }
   fflush(log->f);
   log->entries[index].dead = true;
-  log->entries.push_back({0, 0, 0, 0, 0, off, 8, true});
+  log->entries.push_back({0, 0, 0, 0, 0, off, 8, kTombstone, true});
   log->sorted_dirty = true;
   return 0;
 }
@@ -260,6 +286,803 @@ int64_t pio_evlog_find_id(void* handle, uint64_t id_hash, int64_t* out,
   return n;
 }
 
+// ---------------------------------------------------------------------------
+// Columnar interaction scan — the training-ingest fast path.
+//
+// Plays the role of the reference's parallel HBase read
+// (hbase/HBPEvents.scala:63-88 newAPIHadoopRDD): streams matching events
+// straight into int32 COO arrays + interned id tables without ever
+// materializing per-event objects in Python. The JSON payloads are written
+// by this framework's own DAO (compact json.dumps), so a small
+// depth-tracking scanner suffices; all header-hash candidates are
+// re-checked with exact string compares, so hash collisions cannot corrupt
+// the output.
+// ---------------------------------------------------------------------------
+
+static uint64_t fnv1a64(const char* s, size_t n) {
+  uint64_t h = 0xCBF29CE484222325ull;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= (uint8_t)s[i];
+    h *= 0x100000001B3ull;
+  }
+  return h ? h : 1;  // 0 is the "no filter" sentinel (native/__init__.py)
+}
+
+// Scan a compact JSON object for a top-level key; returns the byte position
+// of the first character of its value, or npos. Tracks string/escape state
+// and brace depth so key text inside nested values never matches.
+static size_t json_toplevel_value(const std::string& s, const char* key) {
+  const std::string pat = std::string("\"") + key + "\"";
+  int depth = 0;
+  bool in_str = false;
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    if (in_str) {
+      if (c == '\\') { ++i; continue; }
+      if (c == '"') in_str = false;
+      continue;
+    }
+    if (c == '{' || c == '[') { ++depth; continue; }
+    if (c == '}' || c == ']') { --depth; continue; }
+    if (c == '"') {
+      if (depth == 1 && s.compare(i, pat.size(), pat) == 0) {
+        size_t j = i + pat.size();
+        while (j < s.size() && (s[j] == ' ' || s[j] == '\t')) ++j;
+        if (j < s.size() && s[j] == ':') {
+          ++j;
+          while (j < s.size() && (s[j] == ' ' || s[j] == '\t')) ++j;
+          return j;
+        }
+      }
+      in_str = true;
+    }
+  }
+  return std::string::npos;
+}
+
+// Decode the JSON string whose opening quote is at s[pos]; false when the
+// value there is not a string. Handles \", \\, \/, \b, \f, \n, \r, \t and
+// \uXXXX (incl. surrogate pairs) — json.dumps default ensure_ascii=True
+// escapes all non-ASCII ids this way.
+static bool json_decode_string(const std::string& s, size_t pos,
+                               std::string* out) {
+  if (pos == std::string::npos || pos >= s.size() || s[pos] != '"')
+    return false;
+  out->clear();
+  for (size_t i = pos + 1; i < s.size(); ++i) {
+    char c = s[i];
+    if (c == '"') return true;
+    if (c != '\\') { out->push_back(c); continue; }
+    if (++i >= s.size()) return false;
+    char e = s[i];
+    switch (e) {
+      case '"': out->push_back('"'); break;
+      case '\\': out->push_back('\\'); break;
+      case '/': out->push_back('/'); break;
+      case 'b': out->push_back('\b'); break;
+      case 'f': out->push_back('\f'); break;
+      case 'n': out->push_back('\n'); break;
+      case 'r': out->push_back('\r'); break;
+      case 't': out->push_back('\t'); break;
+      case 'u': {
+        if (i + 4 >= s.size()) return false;
+        auto hex4 = [&](size_t p) -> int {
+          int v = 0;
+          for (int k = 0; k < 4; ++k) {
+            char hc = s[p + k];
+            v <<= 4;
+            if (hc >= '0' && hc <= '9') v |= hc - '0';
+            else if (hc >= 'a' && hc <= 'f') v |= hc - 'a' + 10;
+            else if (hc >= 'A' && hc <= 'F') v |= hc - 'A' + 10;
+            else return -1;
+          }
+          return v;
+        };
+        int cp = hex4(i + 1);
+        if (cp < 0) return false;
+        i += 4;
+        if (cp >= 0xD800 && cp <= 0xDBFF && i + 6 < s.size() &&
+            s[i + 1] == '\\' && s[i + 2] == 'u') {
+          int lo = hex4(i + 3);
+          if (lo >= 0xDC00 && lo <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            i += 6;
+          }
+        }
+        // utf-8 encode
+        if (cp < 0x80) out->push_back((char)cp);
+        else if (cp < 0x800) {
+          out->push_back((char)(0xC0 | (cp >> 6)));
+          out->push_back((char)(0x80 | (cp & 0x3F)));
+        } else if (cp < 0x10000) {
+          out->push_back((char)(0xE0 | (cp >> 12)));
+          out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+          out->push_back((char)(0x80 | (cp & 0x3F)));
+        } else {
+          out->push_back((char)(0xF0 | (cp >> 18)));
+          out->push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+          out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+          out->push_back((char)(0x80 | (cp & 0x3F)));
+        }
+        break;
+      }
+      default: return false;
+    }
+  }
+  return false;  // unterminated
+}
+
+// Extract "properties".<key> as a double; false when absent / not numeric.
+static bool json_property_number(const std::string& s, const char* key,
+                                 double* out) {
+  size_t props = json_toplevel_value(s, "properties");
+  if (props == std::string::npos || props >= s.size() || s[props] != '{')
+    return false;
+  // find the matching close brace of the properties object
+  int depth = 0;
+  bool in_str = false;
+  size_t end = props;
+  for (size_t i = props; i < s.size(); ++i) {
+    char c = s[i];
+    if (in_str) {
+      if (c == '\\') { ++i; continue; }
+      if (c == '"') in_str = false;
+      continue;
+    }
+    if (c == '"') { in_str = true; continue; }
+    if (c == '{' || c == '[') ++depth;
+    else if (c == '}' || c == ']') {
+      if (--depth == 0) { end = i + 1; break; }
+    }
+  }
+  std::string sub = s.substr(props, end - props);
+  size_t vpos = json_toplevel_value(sub, key);
+  if (vpos == std::string::npos || vpos >= sub.size()) return false;
+  char c = sub[vpos];
+  if (c != '-' && (c < '0' || c > '9')) return false;  // not a number
+  char* endp = nullptr;
+  *out = strtod(sub.c_str() + vpos, &endp);
+  return endp != sub.c_str() + vpos;
+}
+
+struct ScanResult {
+  std::vector<int32_t> uidx, iidx;
+  std::vector<float> vals;
+  std::string ubuf, ibuf;            // concatenated utf-8 id bytes
+  std::vector<int64_t> uoff, ioff;   // n_ids + 1 offsets into the buffers
+};
+
+// ---- single-pass payload field extraction (span-based, zero-copy) --------
+
+struct Span {
+  size_t pos = 0, len = 0;
+  bool esc = false, present = false;
+};
+
+struct Fields {
+  Span event, etype, eid, tetype, teid, props;
+};
+
+// One pass over a compact JSON object, recording the value spans of the six
+// keys the scan needs. Strings are kept raw (escape flag only); object
+// values record their full balanced extent.
+static bool extract_fields(std::string_view s, Fields* f) {
+  size_t i = 0;
+  const size_t n = s.size();
+  int depth = 0;
+  while (i < n) {
+    char c = s[i];
+    if (c == '{' || c == '[') { ++depth; ++i; continue; }
+    if (c == '}' || c == ']') { --depth; ++i; continue; }
+    if (c != '"') { ++i; continue; }
+    if (depth != 1) {  // a string inside a nested value: skip it
+      ++i;
+      while (i < n && s[i] != '"') i += (s[i] == '\\') ? 2 : 1;
+      ++i;
+      continue;
+    }
+    // depth-1 string reached outside a value ⇒ it is a key
+    size_t kstart = ++i;
+    bool kesc = false;
+    while (i < n && s[i] != '"') {
+      if (s[i] == '\\') { kesc = true; i += 2; } else ++i;
+    }
+    if (i >= n) return false;
+    std::string_view key = s.substr(kstart, i - kstart);
+    ++i;
+    while (i < n && (s[i] == ' ' || s[i] == '\t')) ++i;
+    if (i >= n || s[i] != ':') return false;
+    ++i;
+    while (i < n && (s[i] == ' ' || s[i] == '\t')) ++i;
+    if (i >= n) return false;
+    Span v;
+    if (s[i] == '"') {
+      size_t vstart = ++i;
+      bool vesc = false;
+      while (i < n && s[i] != '"') {
+        if (s[i] == '\\') { vesc = true; i += 2; } else ++i;
+      }
+      if (i >= n) return false;
+      v = {vstart, i - vstart, vesc, true};
+      ++i;
+    } else if (s[i] == '{' || s[i] == '[') {
+      size_t vstart = i;
+      int d2 = 0;
+      bool instr = false;
+      while (i < n) {
+        char c2 = s[i];
+        if (instr) {
+          if (c2 == '\\') { i += 2; continue; }
+          if (c2 == '"') instr = false;
+          ++i;
+          continue;
+        }
+        if (c2 == '"') { instr = true; ++i; continue; }
+        if (c2 == '{' || c2 == '[') ++d2;
+        else if (c2 == '}' || c2 == ']') {
+          if (--d2 == 0) { ++i; break; }
+        }
+        ++i;
+      }
+      v = {vstart, i - vstart, false, true};
+      // the balanced walk above consumed the closing brace, keeping the
+      // outer `depth` unchanged — do not let the main loop see it
+    } else {
+      size_t vstart = i;
+      while (i < n && s[i] != ',' && s[i] != '}') ++i;
+      v = {vstart, i - vstart, false, true};
+    }
+    if (!kesc) {
+      if (key == "event") f->event = v;
+      else if (key == "entityType") f->etype = v;
+      else if (key == "entityId") f->eid = v;
+      else if (key == "targetEntityType") f->tetype = v;
+      else if (key == "targetEntityId") f->teid = v;
+      else if (key == "properties") f->props = v;
+    }
+  }
+  return true;
+}
+
+// Decode JSON string escapes of a raw (quote-less) span. Mirrors
+// json_decode_string (incl. \uXXXX surrogate pairs).
+static bool decode_escapes(std::string_view raw, std::string* out) {
+  std::string quoted;
+  quoted.reserve(raw.size() + 2);
+  quoted.push_back('"');
+  quoted.append(raw);
+  quoted.push_back('"');
+  return json_decode_string(quoted, 0, out);
+}
+
+// Materialize a span as a string id: direct slice when unescaped.
+static bool span_id(std::string_view payload, const Span& v,
+                    std::string* out) {
+  if (!v.present) return false;
+  std::string_view raw = payload.substr(v.pos, v.len);
+  if (!v.esc) {
+    out->assign(raw);
+    return true;
+  }
+  return decode_escapes(raw, out);
+}
+
+static bool span_equals(std::string_view payload, const Span& v,
+                        std::string_view want, std::string* scratch) {
+  if (!v.present) return false;
+  std::string_view raw = payload.substr(v.pos, v.len);
+  if (!v.esc) return raw == want;
+  if (!decode_escapes(raw, scratch)) return false;
+  return *scratch == want;
+}
+
+// properties.<key> as a double from the raw props span (an object).
+static bool span_property_number(std::string_view props,
+                                 std::string_view key, double* out) {
+  size_t i = 0;
+  const size_t n = props.size();
+  int depth = 0;
+  while (i < n) {
+    char c = props[i];
+    if (c == '{' || c == '[') { ++depth; ++i; continue; }
+    if (c == '}' || c == ']') { --depth; ++i; continue; }
+    if (c != '"') { ++i; continue; }
+    if (depth != 1) {
+      ++i;
+      while (i < n && props[i] != '"') i += (props[i] == '\\') ? 2 : 1;
+      ++i;
+      continue;
+    }
+    size_t kstart = ++i;
+    bool kesc = false;
+    while (i < n && props[i] != '"') {
+      if (props[i] == '\\') { kesc = true; i += 2; } else ++i;
+    }
+    if (i >= n) return false;
+    std::string_view k = props.substr(kstart, i - kstart);
+    ++i;
+    while (i < n && (props[i] == ' ' || props[i] == '\t')) ++i;
+    if (i >= n || props[i] != ':') return false;
+    ++i;
+    while (i < n && (props[i] == ' ' || props[i] == '\t')) ++i;
+    if (i >= n) return false;
+    if (!kesc && k == key) {
+      char c2 = props[i];
+      if (c2 != '-' && (c2 < '0' || c2 > '9')) return false;  // not a number
+      char buf[64];
+      size_t m = 0;
+      while (i < n && m < 63 && props[i] != ',' && props[i] != '}' &&
+             props[i] != ' ')
+        buf[m++] = props[i++];
+      buf[m] = 0;
+      char* endp = nullptr;
+      *out = strtod(buf, &endp);
+      return endp != buf;
+    }
+    // skip this value
+    char c2 = props[i];
+    if (c2 == '"') {
+      ++i;
+      while (i < n && props[i] != '"') i += (props[i] == '\\') ? 2 : 1;
+      ++i;
+    } else if (c2 == '{' || c2 == '[') {
+      int d2 = 0;
+      bool instr = false;
+      while (i < n) {
+        char c3 = props[i];
+        if (instr) {
+          if (c3 == '\\') { i += 2; continue; }
+          if (c3 == '"') instr = false;
+          ++i;
+          continue;
+        }
+        if (c3 == '"') { instr = true; ++i; continue; }
+        if (c3 == '{' || c3 == '[') ++d2;
+        else if (c3 == '}' || c3 == ']') {
+          if (--d2 == 0) { ++i; break; }
+        }
+        ++i;
+      }
+    } else {
+      while (i < n && props[i] != ',' && props[i] != '}') ++i;
+    }
+  }
+  return false;
+}
+
+// ---- binary sidecar fast path --------------------------------------------
+
+struct SideFields {
+  std::string_view etype, name, eid, tetype, teid, props;
+  uint8_t n_props = 0;
+  bool has_target = false;
+};
+
+static bool parse_sidecar(const char* p, size_t plen, SideFields* f) {
+  if (plen < 15) return false;
+  uint32_t bl;
+  memcpy(&bl, p, 4);
+  if (bl > plen || bl < 15) return false;
+  f->n_props = (uint8_t)p[4];
+  uint16_t l[5];
+  memcpy(l, p + 5, 10);
+  size_t pos = 15;
+  auto take = [&](uint16_t len) {
+    std::string_view v(p + pos, len);
+    pos += len;
+    return v;
+  };
+  if ((size_t)l[0] + l[1] + l[2] > bl) return false;
+  f->etype = take(l[0]);
+  f->name = take(l[1]);
+  f->eid = take(l[2]);
+  f->has_target = l[3] != kNoTarget;
+  if (f->has_target) {
+    if (pos + l[3] + l[4] > bl) return false;
+    f->tetype = take(l[3]);
+    f->teid = take(l[4]);
+  }
+  if (pos > bl) return false;
+  f->props = std::string_view(p + pos, bl - pos);
+  return true;
+}
+
+static bool sidecar_prop_value(const SideFields& f, std::string_view key,
+                               double* out) {
+  std::string_view props = f.props;
+  size_t pos = 0;
+  for (uint8_t i = 0; i < f.n_props; ++i) {
+    if (pos + 1 > props.size()) return false;
+    const uint8_t kl = (uint8_t)props[pos];
+    ++pos;
+    if (pos + kl + 8 > props.size()) return false;
+    std::string_view k = props.substr(pos, kl);
+    pos += kl;
+    if (k == key) {
+      memcpy(out, props.data() + pos, 8);
+      return true;
+    }
+    pos += 8;
+  }
+  return false;
+}
+
+// Per-thread partial scan: local interning, merged in submit order. Id keys
+// are string_views into the mmapped file (or into `arena` for ids that
+// needed JSON unescaping) — no per-record string allocations.
+struct LocalScan {
+  std::vector<int32_t> uidx, iidx;
+  std::vector<float> vals;
+  std::vector<std::string_view> users, items;  // local idx → id view
+  std::unordered_map<std::string_view, int32_t> umap, imap;
+  std::deque<std::string> arena;  // stable storage for decoded ids
+};
+
+struct ScanFilters {
+  int64_t start_ms, until_ms;
+  std::string_view entity_type, target_entity_type, value_prop;
+  const std::vector<std::string>* names;
+  std::vector<uint64_t> name_hs;
+  const double* fixed_vals;
+  bool have_prop;
+  double default_value;
+  uint64_t etype_h;
+};
+
+// A span as an interning key: a view into the mmap when unescaped, else a
+// decoded copy pinned in the arena.
+static bool span_view(std::string_view payload, const Span& v,
+                      LocalScan* out, std::string_view* view) {
+  if (!v.present) return false;
+  std::string_view raw = payload.substr(v.pos, v.len);
+  if (!v.esc) {
+    *view = raw;
+    return true;
+  }
+  std::string decoded;
+  if (!decode_escapes(raw, &decoded)) return false;
+  out->arena.push_back(std::move(decoded));
+  *view = out->arena.back();
+  return true;
+}
+
+static void scan_range(const char* base, const EventLog* log,
+                       int64_t lo, int64_t hi, const ScanFilters& flt,
+                       LocalScan* out) {
+  std::string scratch;
+  std::string_view uid, iid;
+  const int32_t n_names = (int32_t)flt.names->size();
+  for (int64_t k = lo; k < hi; ++k) {
+    const Entry& e = log->entries[log->sorted[k]];
+    if (e.dead) continue;
+    if (e.time_ms < flt.start_ms || e.time_ms >= flt.until_ms) continue;
+    if (e.etype_hash != flt.etype_h) continue;
+    int32_t slot = -1;
+    for (int32_t i = 0; i < n_names; ++i)
+      if (e.name_hash == flt.name_hs[i]) { slot = i; break; }
+    if (slot < 0) continue;
+    double v;
+    if (e.flags & kSidecar) {
+      // fast path: all fields binary, no JSON touched
+      SideFields sf;
+      if (!parse_sidecar(base + e.offset, e.payload_len, &sf)) continue;
+      if (sf.name != (*flt.names)[slot]) {  // hash collision in name set
+        slot = -1;
+        for (int32_t i = 0; i < n_names; ++i)
+          if (sf.name == (*flt.names)[i]) { slot = i; break; }
+        if (slot < 0) continue;
+      }
+      if (sf.etype != flt.entity_type) continue;
+      if (!sf.has_target || sf.tetype != flt.target_entity_type) continue;
+      const double fv = flt.fixed_vals[slot];
+      if (!std::isnan(fv)) {
+        v = fv;
+      } else if (flt.have_prop) {
+        if (!sidecar_prop_value(sf, flt.value_prop, &v)) continue;
+      } else {
+        v = flt.default_value;
+      }
+      uid = sf.eid;
+      iid = sf.teid;
+    } else {
+      // JSON fallback (records written before the sidecar format)
+      std::string_view payload(base + e.offset, e.payload_len);
+      Fields f;
+      if (!extract_fields(payload, &f)) continue;
+      // exact rechecks (headers are hash prefilters only)
+      if (!span_equals(payload, f.event, (*flt.names)[slot], &scratch)) {
+        slot = -1;
+        for (int32_t i = 0; i < n_names; ++i)
+          if (span_equals(payload, f.event, (*flt.names)[i], &scratch)) {
+            slot = i;
+            break;
+          }
+        if (slot < 0) continue;
+      }
+      if (!span_equals(payload, f.etype, flt.entity_type, &scratch))
+        continue;
+      if (!span_equals(payload, f.tetype, flt.target_entity_type, &scratch))
+        continue;
+      const double fv = flt.fixed_vals[slot];
+      if (!std::isnan(fv)) {
+        v = fv;
+      } else if (flt.have_prop) {
+        if (!f.props.present ||
+            !span_property_number(
+                payload.substr(f.props.pos, f.props.len), flt.value_prop,
+                &v))
+          continue;
+      } else {
+        v = flt.default_value;
+      }
+      if (!span_view(payload, f.eid, out, &uid)) continue;
+      if (!span_view(payload, f.teid, out, &iid)) continue;
+    }
+    auto ur = out->umap.emplace(uid, (int32_t)out->users.size());
+    if (ur.second) out->users.push_back(uid);
+    auto ir = out->imap.emplace(iid, (int32_t)out->items.size());
+    if (ir.second) out->items.push_back(iid);
+    out->uidx.push_back(ur.first->second);
+    out->iidx.push_back(ir.first->second);
+    out->vals.push_back((float)v);
+  }
+}
+
+// Runs the scan under the log mutex. `names`/`fixed_vals` are parallel:
+// fixed_vals[i] = NaN means "resolve via value_prop / default_value".
+// value_prop may be null (every non-fixed event gets default_value).
+// The file is mmapped and partitioned across threads; per-thread id tables
+// are merged in partition order so the global table keeps first-seen order.
+void* pio_evlog_scan_interactions(
+    void* handle, int64_t start_ms, int64_t until_ms,
+    const char* entity_type, const char* target_entity_type,
+    const char** names, const double* fixed_vals, int32_t n_names,
+    const char* value_prop, double default_value) {
+  auto* log = (EventLog*)handle;
+  auto* res = new ScanResult();
+  if (n_names <= 0) {  // empty name list matches nothing (find() contract)
+    res->uoff.push_back(0);
+    res->ioff.push_back(0);
+    return res;
+  }
+  std::lock_guard<std::mutex> g(log->mu);
+  resort(log);
+  fflush(log->f);
+
+  std::vector<std::string> name_strs(names, names + n_names);
+  ScanFilters flt;
+  flt.start_ms = start_ms;
+  flt.until_ms = until_ms;
+  flt.entity_type = entity_type;
+  flt.target_entity_type = target_entity_type;
+  flt.value_prop = value_prop ? std::string_view(value_prop)
+                              : std::string_view();
+  flt.names = &name_strs;
+  for (auto& s : name_strs) flt.name_hs.push_back(fnv1a64(s.data(), s.size()));
+  flt.fixed_vals = fixed_vals;
+  flt.have_prop = value_prop != nullptr;
+  flt.default_value = default_value;
+  flt.etype_h = fnv1a64(entity_type, strlen(entity_type));
+
+  // mmap the flushed extent; fall back to a heap read if mmap fails
+  struct stat st;
+  const int fd = fileno(log->f);
+  char* base = nullptr;
+  size_t map_len = 0;
+  std::string heap;
+  if (fstat(fd, &st) == 0 && st.st_size > 0) {
+    map_len = (size_t)st.st_size;
+    void* m = mmap(nullptr, map_len, PROT_READ, MAP_SHARED, fd, 0);
+    if (m != MAP_FAILED) {
+      base = (char*)m;
+    } else {
+      heap.resize(map_len);
+      fseeko(log->f, 0, SEEK_SET);
+      if (fread(&heap[0], 1, map_len, log->f) != map_len) {
+        fseeko(log->f, 0, SEEK_END);
+        res->uoff.push_back(0);
+        res->ioff.push_back(0);
+        return res;
+      }
+      fseeko(log->f, 0, SEEK_END);
+      base = &heap[0];
+    }
+  }
+  const int64_t total = (int64_t)log->sorted.size();
+  if (base == nullptr || total == 0) {
+    res->uoff.push_back(0);
+    res->ioff.push_back(0);
+    if (base && map_len && base != heap.data()) munmap(base, map_len);
+    return res;
+  }
+
+  constexpr int64_t kMinPerThread = 200000;
+  int hw = (int)std::thread::hardware_concurrency();
+  int n_threads = (int)std::min<int64_t>(
+      std::max(hw, 1), std::max<int64_t>(1, total / kMinPerThread));
+  n_threads = std::min(n_threads, 16);
+
+  std::vector<LocalScan> locals(n_threads);
+  if (n_threads == 1) {
+    scan_range(base, log, 0, total, flt, &locals[0]);
+  } else {
+    std::vector<std::thread> pool;
+    const int64_t step = (total + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+      int64_t lo = t * step, hi = std::min<int64_t>(total, lo + step);
+      pool.emplace_back(scan_range, base, log, lo, hi, std::cref(flt),
+                        &locals[t]);
+    }
+    for (auto& th : pool) th.join();
+  }
+  // merge in partition order: global tables keep first-seen order. Views
+  // still point into the mapped file / local arenas — the file stays
+  // mapped until the merge has materialized the id tables.
+  std::unordered_map<std::string_view, int32_t> gu, gi;
+  std::vector<std::string_view> user_order, item_order;
+  size_t nnz = 0;
+  for (auto& L : locals) nnz += L.uidx.size();
+  res->uidx.reserve(nnz);
+  res->iidx.reserve(nnz);
+  res->vals.reserve(nnz);
+  for (auto& L : locals) {
+    std::vector<int32_t> uremap(L.users.size()), iremap(L.items.size());
+    for (size_t j = 0; j < L.users.size(); ++j) {
+      auto r = gu.emplace(L.users[j], (int32_t)gu.size());
+      if (r.second) user_order.push_back(L.users[j]);
+      uremap[j] = r.first->second;
+    }
+    for (size_t j = 0; j < L.items.size(); ++j) {
+      auto r = gi.emplace(L.items[j], (int32_t)gi.size());
+      if (r.second) item_order.push_back(L.items[j]);
+      iremap[j] = r.first->second;
+    }
+    for (size_t j = 0; j < L.uidx.size(); ++j) {
+      res->uidx.push_back(uremap[L.uidx[j]]);
+      res->iidx.push_back(iremap[L.iidx[j]]);
+      res->vals.push_back(L.vals[j]);
+    }
+  }
+  res->uoff.push_back(0);
+  for (auto& s : user_order) {
+    res->ubuf += s;
+    res->uoff.push_back((int64_t)res->ubuf.size());
+  }
+  res->ioff.push_back(0);
+  for (auto& s : item_order) {
+    res->ibuf += s;
+    res->ioff.push_back((int64_t)res->ibuf.size());
+  }
+  if (base != heap.data() && map_len) munmap(base, map_len);
+  return res;
+}
+
+// Bulk append: n records whose per-record byte fields live concatenated in
+// `buf` — for record k, offs[7k..7k+7] delimit (entity_type, entity_id,
+// event name, event id, target_entity_type, target_entity_id+props_blob?,
+// json_payload)... see below. Field layout per record (7 ranges):
+//   0 entity_type   1 entity_id   2 event name   3 event id
+//   4 target_entity_type   5 target_entity_id   6 props_blob ++ json
+// props_blob comes pre-packed ([u8 klen][key][f64 value] per numeric
+// property) followed by the JSON document; `meta` per record packs
+// (u8 has_target, u8 sidecar_ok, u8 n_props, u8 pad, u32 props_blob_len).
+// When sidecar_ok, the record is written as [sidecar][json] with the
+// kSidecar flag; otherwise as bare JSON. Hashing and framing happen here;
+// one buffered write per batch. Returns n, or -1 with the file truncated
+// back to the batch start on a write failure (never a partial batch).
+int64_t pio_evlog_append_bulk(void* handle, int64_t n,
+                              const int64_t* time_ms, const uint8_t* buf,
+                              const int64_t* offs, const uint8_t* meta) {
+  auto* log = (EventLog*)handle;
+  if (n <= 0) return 0;
+  std::lock_guard<std::mutex> g(log->mu);
+  fseeko(log->f, 0, SEEK_END);
+  const off_t batch_start = ftello(log->f);
+  std::string out;
+  out.reserve((size_t)(offs[7 * n] - offs[0]) +
+              (size_t)n * (sizeof(RecHeader) + 32));
+  std::vector<Entry> new_entries;
+  new_entries.reserve(n);
+  off_t pos = batch_start;
+  for (int64_t k = 0; k < n; ++k) {
+    const int64_t* o = offs + 7 * k;
+    auto flen = [&](int i) { return (size_t)(o[i + 1] - o[i]); };
+    auto fptr = [&](int i) { return (const char*)buf + o[i]; };
+    auto field_hash = [&](int i) { return fnv1a64(fptr(i), flen(i)); };
+    const uint8_t* m = meta + 8 * k;
+    const bool has_target = m[0] != 0;
+    const bool sidecar_ok = m[1] != 0;
+    const uint8_t n_props = m[2];
+    uint32_t props_len;
+    memcpy(&props_len, m + 4, 4);
+    const size_t json_len = flen(6) - props_len;
+    const char* json = fptr(6) + props_len;
+    uint32_t plen, flags;
+    uint32_t side_len = 0;
+    if (sidecar_ok) {
+      side_len = 4 + 1 + 10 + (uint32_t)(flen(0) + flen(2) + flen(1)) +
+                 (has_target ? (uint32_t)(flen(4) + flen(5)) : 0) + props_len;
+      plen = side_len + (uint32_t)json_len;
+      flags = kSidecar;
+    } else {
+      plen = (uint32_t)json_len;
+      flags = 0;
+    }
+    RecHeader h{time_ms[k], field_hash(0), field_hash(1), field_hash(2),
+                field_hash(3), plen, flags};
+    out.append((const char*)&h, sizeof(h));
+    if (sidecar_ok) {
+      out.append((const char*)&side_len, 4);
+      out.push_back((char)n_props);
+      uint16_t l[5] = {(uint16_t)flen(0), (uint16_t)flen(2),
+                       (uint16_t)flen(1),
+                       has_target ? (uint16_t)flen(4) : kNoTarget,
+                       has_target ? (uint16_t)flen(5) : (uint16_t)0};
+      out.append((const char*)l, 10);
+      out.append(fptr(0), flen(0));  // etype
+      out.append(fptr(2), flen(2));  // event name
+      out.append(fptr(1), flen(1));  // entity id
+      if (has_target) {
+        out.append(fptr(4), flen(4));
+        out.append(fptr(5), flen(5));
+      }
+      out.append(fptr(6), props_len);
+    }
+    out.append(json, json_len);
+    new_entries.push_back({time_ms[k], h.etype_hash, h.eid_hash, h.name_hash,
+                           h.id_hash, (uint64_t)(pos + sizeof(h)), plen,
+                           h.flags, false});
+    pos += sizeof(h) + plen;
+  }
+  if (fwrite(out.data(), 1, out.size(), log->f) != out.size()) {
+    fflush(log->f);
+    (void)!ftruncate(fileno(log->f), batch_start);
+    clearerr(log->f);
+    fseeko(log->f, 0, SEEK_END);
+    return -1;
+  }
+  fflush(log->f);
+  for (auto& e : new_entries) {
+    if (e.time_ms >= log->last_time && !log->sorted_dirty) {
+      log->sorted.push_back((int64_t)log->entries.size());
+    } else {
+      log->sorted_dirty = true;
+    }
+    log->last_time = std::max(log->last_time, e.time_ms);
+    log->entries.push_back(e);
+  }
+  return n;
+}
+
+int64_t pio_scan_nnz(void* r) { return (int64_t)((ScanResult*)r)->uidx.size(); }
+
+int64_t pio_scan_n_ids(void* r, int32_t which) {
+  auto* res = (ScanResult*)r;
+  return (int64_t)(which == 0 ? res->uoff.size() : res->ioff.size()) - 1;
+}
+
+int64_t pio_scan_ids_bytes(void* r, int32_t which) {
+  auto* res = (ScanResult*)r;
+  return (int64_t)(which == 0 ? res->ubuf.size() : res->ibuf.size());
+}
+
+void pio_scan_fill(void* r, int32_t* u, int32_t* i, float* v) {
+  auto* res = (ScanResult*)r;
+  memcpy(u, res->uidx.data(), res->uidx.size() * sizeof(int32_t));
+  memcpy(i, res->iidx.data(), res->iidx.size() * sizeof(int32_t));
+  memcpy(v, res->vals.data(), res->vals.size() * sizeof(float));
+}
+
+void pio_scan_copy_ids(void* r, int32_t which, char* buf, int64_t* offsets) {
+  auto* res = (ScanResult*)r;
+  const std::string& b = which == 0 ? res->ubuf : res->ibuf;
+  const std::vector<int64_t>& o = which == 0 ? res->uoff : res->ioff;
+  memcpy(buf, b.data(), b.size());
+  memcpy(offsets, o.data(), o.size() * sizeof(int64_t));
+}
+
+void pio_scan_free(void* r) { delete (ScanResult*)r; }
+
 // Returns the payload length; copies into buf only when it fits. Dead or
 // out-of-range records return -1.
 int32_t pio_evlog_read(void* handle, int64_t index, uint8_t* buf,
@@ -269,12 +1092,26 @@ int32_t pio_evlog_read(void* handle, int64_t index, uint8_t* buf,
   if (index < 0 || (size_t)index >= log->entries.size()) return -1;
   const Entry& e = log->entries[index];
   if (e.dead) return -1;
-  if ((int32_t)e.payload_len <= cap) {
-    fseeko(log->f, (off_t)e.offset, SEEK_SET);
-    if (fread(buf, 1, e.payload_len, log->f) != e.payload_len) return -1;
+  uint64_t off = e.offset;
+  uint32_t len = e.payload_len;
+  if (e.flags & kSidecar) {
+    // skip the binary sidecar block: callers get the JSON document only
+    uint32_t bl = 0;
+    fflush(log->f);
+    fseeko(log->f, (off_t)off, SEEK_SET);
+    if (fread(&bl, 4, 1, log->f) != 1 || bl > len) {
+      fseeko(log->f, 0, SEEK_END);
+      return -1;
+    }
+    off += bl;
+    len -= bl;
+  }
+  if ((int32_t)len <= cap) {
+    fseeko(log->f, (off_t)off, SEEK_SET);
+    if (fread(buf, 1, len, log->f) != len) return -1;
     fseeko(log->f, 0, SEEK_END);
   }
-  return (int32_t)e.payload_len;
+  return (int32_t)len;
 }
 
 }  // extern "C"

@@ -154,6 +154,38 @@ def _solve_bucket(
     return _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit=False, yty=None)
 
 
+#: f32-element budget for one bucket chunk's gather intermediate
+#: ([chunk, D, K]); 2^24 elements = 64 MB. Buckets whose full gather would
+#: exceed this are solved in row chunks under lax.map, keeping peak HBM for
+#: the normal-equation assembly flat regardless of dataset size (the
+#: ML-20M-scale requirement: 20M nnz × rank 128 would otherwise gather
+#: multi-GB [B, D, K] tensors per bucket).
+_CHUNK_ELEMS = 1 << 24
+
+
+def _solve_bucket_chunked(solver_fn, cols, vals, mask, rank: int):
+    """Apply ``solver_fn((cols, vals, mask)) -> sol`` in bounded row chunks.
+
+    Zero-mask padding rows solve to 0 and are sliced off, so chunk padding
+    never leaks into the scatter."""
+    B, D = cols.shape
+    chunk = max(8, _CHUNK_ELEMS // max(D * rank, 1))
+    if B <= chunk:
+        return solver_fn((cols, vals, mask))
+    n = -(-B // chunk)
+    pad = n * chunk - B
+    if pad:
+        cols = jnp.pad(cols, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        mask = jnp.pad(mask, ((0, pad), (0, 0)))
+    sols = jax.lax.map(
+        solver_fn,
+        (cols.reshape(n, chunk, D), vals.reshape(n, chunk, D),
+         mask.reshape(n, chunk, D)),
+    )
+    return sols.reshape(n * chunk, rank)[:B]
+
+
 def _scatter_rows_impl(out: jax.Array, row_ids: jax.Array,
                        sol: jax.Array) -> jax.Array:
     # Padding rows carry row_id -1. JAX scatter wraps negative indices

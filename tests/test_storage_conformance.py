@@ -326,6 +326,113 @@ def test_namespace_isolation(backend):
     assert len(list(events_a.find(app_id=1))) == 1
 
 
+def _seed_interaction_events(events):
+    """A spread of training-shaped events exercising every scan rule."""
+    from incubator_predictionio_tpu.data.event import Event as Ev
+
+    events.init(9)
+    rows = [
+        # (event, entity_id, target, props, minutes)
+        ("rate", "alice", "i1", {"rating": 4.5}, 0),
+        ("rate", "bob", "i2", {"rating": 2.0}, 1),
+        ("rate", "alice", "i2", {}, 2),            # missing prop → skipped
+        ("rate", "carol", "i1", {"rating": "hi"}, 3),  # non-numeric → skip
+        ("buy", "bob", "i3", {}, 4),               # fixed value 4.0
+        ("view", "dave", "i1", {}, 5),             # name not in scan
+        ("rate", "éva", "ïtem-√2", {"rating": 5.0}, 6),  # non-ascii ids
+        ("rate", 'q"uote\\back', "i1", {"rating": 1.5}, 7),  # escapes
+        ("rate", "alice", "i1", {"rating": 3.0}, 8),  # later re-rate
+    ]
+    for name, eid, target, props, minutes in rows:
+        events.insert(Ev(
+            event=name, entity_type="user", entity_id=eid,
+            target_entity_type="item", target_entity_id=target,
+            properties=DataMap(props),
+            event_time=T0 + timedelta(minutes=minutes),
+        ), 9)
+    # wrong entity_type / wrong target type: excluded by the scan
+    events.insert(Ev(
+        event="rate", entity_type="item", entity_id="i1",
+        target_entity_type="item", target_entity_id="i9",
+        properties=DataMap({"rating": 9.0}),
+        event_time=T0 + timedelta(minutes=9)), 9)
+    events.insert(Ev(
+        event="rate", entity_type="user", entity_id="zed",
+        target_entity_type="category", target_entity_id="c1",
+        properties=DataMap({"rating": 9.0}),
+        event_time=T0 + timedelta(minutes=10)), 9)
+    # no target entity at all
+    events.insert(Ev(
+        event="rate", entity_type="user", entity_id="zed",
+        properties=DataMap({"rating": 9.0}),
+        event_time=T0 + timedelta(minutes=11)), 9)
+
+
+#: triples the scan must yield, in event-time order
+_EXPECTED_TRIPLES = [
+    ("alice", "i1", 4.5),
+    ("bob", "i2", 2.0),
+    ("bob", "i3", 4.0),
+    ("éva", "ïtem-√2", 5.0),
+    ('q"uote\\back', "i1", 1.5),
+    ("alice", "i1", 3.0),
+]
+
+
+def _triples(inter):
+    return [
+        (inter.user_ids[int(u)], inter.item_ids[int(i)], float(v))
+        for u, i, v in zip(inter.user_idx, inter.item_idx, inter.values)
+    ]
+
+
+def test_scan_interactions_conformance(backend):
+    """Every backend's columnar scan must match the generic semantics:
+    value resolution (fixed per name > value_prop > skip), filters, and
+    event-time ordering of the triples."""
+    events = dao(backend, "Events")
+    _seed_interaction_events(events)
+    inter = events.scan_interactions(
+        app_id=9, entity_type="user", target_entity_type="item",
+        event_names=("rate", "buy"), value_prop="rating",
+        event_values={"buy": 4.0},
+    )
+    assert _triples(inter) == _EXPECTED_TRIPLES
+    assert inter.user_idx.dtype.name == "int32"
+    assert inter.values.dtype.name == "float32"
+    # id tables hold exactly the ids referenced by the triples
+    assert set(inter.user_ids) == {t[0] for t in _EXPECTED_TRIPLES}
+    assert set(inter.item_ids) == {t[1] for t in _EXPECTED_TRIPLES}
+    # and agree with the generic (Event-object) implementation
+    from incubator_predictionio_tpu.data.storage import base as storage_base
+    generic = storage_base.Events.scan_interactions(
+        events, app_id=9, entity_type="user", target_entity_type="item",
+        event_names=("rate", "buy"), value_prop="rating",
+        event_values={"buy": 4.0},
+    )
+    assert _triples(generic) == _EXPECTED_TRIPLES
+
+
+def test_scan_interactions_time_window_and_defaults(backend):
+    events = dao(backend, "Events")
+    _seed_interaction_events(events)
+    # window [min 1, min 7) keeps bob/i2, buy, éva
+    inter = events.scan_interactions(
+        app_id=9, event_names=("rate", "buy"), value_prop="rating",
+        event_values={"buy": 4.0},
+        start_time=T0 + timedelta(minutes=1),
+        until_time=T0 + timedelta(minutes=7),
+    )
+    assert _triples(inter) == _EXPECTED_TRIPLES[1:4]
+    # no value_prop: every non-fixed event scores default_value
+    inter = events.scan_interactions(
+        app_id=9, event_names=("view",), default_value=1.0)
+    assert _triples(inter) == [("dave", "i1", 1.0)]
+    # empty names match nothing (find() contract)
+    inter = events.scan_interactions(app_id=9, event_names=())
+    assert len(inter) == 0 and inter.user_ids == []
+
+
 def test_aggregate_required_filters_by_property_names(backend):
     events = dao(backend, "Events")
     events.init(1)

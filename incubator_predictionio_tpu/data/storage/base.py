@@ -25,7 +25,7 @@ import secrets
 from datetime import datetime
 from typing import Any, Dict, Iterator, Optional, Sequence
 
-from incubator_predictionio_tpu.data.datamap import PropertyMap
+from incubator_predictionio_tpu.data.datamap import DataMap, PropertyMap
 from incubator_predictionio_tpu.data.event import Event
 
 #: Sentinel distinguishing "no filter" from "filter for absent" on target
@@ -147,6 +147,83 @@ class Model:
 # Event DAO
 # ---------------------------------------------------------------------------
 
+class IdTable:
+    """Arrow-style string table: one utf-8 byte blob + int64 offsets.
+
+    The zero-copy form of a distinct-id list: entry ``i`` is
+    ``blob[offsets[i]:offsets[i+1]]`` decoded as utf-8. The native scan
+    (eventlog.cc pio_scan_copy_ids) returns exactly this layout, and keeping
+    it avoids materializing one Python string per entity on the training
+    path — at the native log's ambitions (hundreds of millions of entities)
+    per-id ``str`` objects would become the bottleneck. Strings materialize
+    lazily at serving-translation time (indexing / iteration).
+
+    Behaves as a read-only sequence of ``str`` so code written against the
+    plain-``list`` form of :class:`Interactions` works unchanged.
+    """
+
+    __slots__ = ("blob", "offsets", "_lookup")
+
+    def __init__(self, blob: bytes, offsets: "Any"):
+        import numpy as np
+
+        self.blob = blob
+        self.offsets = np.asarray(offsets, np.int64)
+        self._lookup: Optional[Dict[str, int]] = None
+
+    def __len__(self) -> int:
+        return max(len(self.offsets) - 1, 0)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        return self.blob[self.offsets[i]:self.offsets[i + 1]].decode("utf-8")
+
+    def __iter__(self):
+        offs = self.offsets
+        blob = self.blob
+        for i in range(len(self)):
+            yield blob[offs[i]:offs[i + 1]].decode("utf-8")
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, (list, tuple, IdTable)):
+            return len(self) == len(other) and all(
+                a == b for a, b in zip(self, other))
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"IdTable(n={len(self)}, bytes={len(self.blob)})"
+
+    def index(self, value: str) -> int:
+        """Id → dense index (builds a hash lookup on first use)."""
+        if self._lookup is None:
+            self._lookup = {s: i for i, s in enumerate(self)}
+        return self._lookup[value]
+
+    def __contains__(self, value: str) -> bool:
+        if self._lookup is None:
+            self._lookup = {s: i for i, s in enumerate(self)}
+        return value in self._lookup
+
+    def tolist(self) -> list:
+        return list(self)
+
+    @classmethod
+    def from_list(cls, ids: Sequence[str]) -> "IdTable":
+        import numpy as np
+
+        parts = [s.encode("utf-8") for s in ids]
+        offs = np.zeros(len(parts) + 1, np.int64)
+        if parts:
+            np.cumsum([len(p) for p in parts], out=offs[1:])
+        return cls(b"".join(parts), offs)
+
+
 @dataclasses.dataclass
 class Interactions:
     """Columnar, pre-indexed (entity, target, value) triples — the training
@@ -158,13 +235,17 @@ class Interactions:
     objects, backends stream straight into dense int32 COO arrays plus the
     distinct-id tables, ready for ``jax.device_put`` after bucketing.
     ``user_ids[user_idx[k]]`` recovers the original entity id of triple k.
+
+    The id tables are sequences of ``str`` in first-seen (event-time) order —
+    either plain lists or zero-copy :class:`IdTable` views (the native
+    backend returns the latter; both support len/indexing/iteration).
     """
 
     user_idx: "Any"     # np.ndarray int32 [nnz] — index into user_ids
     item_idx: "Any"     # np.ndarray int32 [nnz] — index into item_ids
     values: "Any"       # np.ndarray float32 [nnz]
-    user_ids: list      # distinct entity ids, first-seen order
-    item_ids: list      # distinct target entity ids, first-seen order
+    user_ids: "Any"     # distinct entity ids (list | IdTable), first-seen order
+    item_ids: "Any"     # distinct target entity ids (list | IdTable)
 
     def __len__(self) -> int:
         return int(self.user_idx.shape[0])
@@ -332,6 +413,60 @@ class Events(abc.ABC):
             user_ids=list(users),
             item_ids=list(items),
         )
+
+    def import_interactions(
+        self,
+        inter: Interactions,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_name: str = "rate",
+        value_prop: str = "rating",
+        times: Optional["Any"] = None,
+        base_time: Optional[datetime] = None,
+        chunk: int = 20_000,
+    ) -> int:
+        """Columnar bulk ingest — the inverse of :func:`scan_interactions`.
+
+        Writes one ``event_name`` event per triple with the value stored
+        under ``value_prop``; event times come from ``times`` (epoch ms,
+        int64 [nnz]) or default to ``base_time + k`` milliseconds so the
+        write order is the scan order. This is the bulk-import path the
+        reference routes through ``PEvents.write`` (PEvents.scala:184) /
+        ``pio import``; backends override it with writers that never
+        materialize per-event objects (the native log renders records fully
+        in C++).
+        """
+        from datetime import timedelta
+
+        from incubator_predictionio_tpu.utils.times import now_utc
+
+        n = len(inter)
+        t0 = base_time if base_time is not None else now_utc()
+        if times is None:
+            get_time = lambda k: t0 + timedelta(milliseconds=k)  # noqa: E731
+        else:
+            from incubator_predictionio_tpu.utils.times import from_millis
+            get_time = lambda k: from_millis(int(times[k]))  # noqa: E731
+        user_ids = inter.user_ids
+        item_ids = inter.item_ids
+        for s in range(0, n, chunk):
+            batch = [
+                Event(
+                    event=event_name,
+                    entity_type=entity_type,
+                    entity_id=user_ids[int(inter.user_idx[k])],
+                    target_entity_type=target_entity_type,
+                    target_entity_id=item_ids[int(inter.item_idx[k])],
+                    properties=DataMap(
+                        {value_prop: float(inter.values[k])}),
+                    event_time=get_time(k),
+                )
+                for k in range(s, min(s + chunk, n))
+            ]
+            self.insert_batch(batch, app_id, channel_id)
+        return n
 
 
 # ---------------------------------------------------------------------------

@@ -176,6 +176,13 @@ class CppLogEvents(base.Events):
         n = len(events)
         if n == 0:
             return []
+        # last-wins for duplicate explicit ids WITHIN the batch too (sqlite
+        # INSERT OR REPLACE parity): earlier occurrences are dropped from
+        # the write set, since the per-event tombstone scan below can only
+        # see records already in the log
+        last_pos: dict[str, int] = {
+            e.event_id: k for k, e in enumerate(events) if e.event_id
+        }
         with self.client.lock:
             h = self._handle(app_id, channel_id)
             ids: list[str] = []
@@ -183,14 +190,19 @@ class CppLogEvents(base.Events):
             offs = np.empty(7 * n + 1, np.int64)
             meta = bytearray(8 * n)
             chunks: list[bytes] = []
+            skipped = 0
             pos = 0
             offs[0] = 0
             j = 0
             for k, event in enumerate(events):
                 validate_event(event)
                 if event.event_id:
-                    # upsert parity with insert(): tombstone existing record
                     eid = event.event_id
+                    if last_pos[eid] != k:  # superseded later in this batch
+                        ids.append(eid)
+                        skipped += 1
+                        continue
+                    # upsert parity with insert(): tombstone existing record
                     for idx in self._candidates_by_id(h, eid):
                         obj = self._read(h, idx)
                         if obj is not None and obj.get("eventId") == eid:
@@ -198,10 +210,11 @@ class CppLogEvents(base.Events):
                 else:
                     eid = new_event_id()
                 ids.append(eid)
+                w = k - skipped  # position in the write set
                 payload = json.dumps(
                     event.with_id(eid).to_jsonable(), separators=(",", ":")
                 ).encode("utf-8")
-                times[k] = to_millis(event.event_time)
+                times[w] = to_millis(event.event_time)
                 etype_b = event.entity_type.encode("utf-8")
                 ent_b = event.entity_id.encode("utf-8")
                 name_b = event.event.encode("utf-8")
@@ -234,7 +247,7 @@ class CppLogEvents(base.Events):
                         props_blob = b"".join(parts)
                     else:
                         n_props = 0
-                struct.pack_into("<BBBBI", meta, 8 * k,
+                struct.pack_into("<BBBBI", meta, 8 * w,
                                  1 if has_target else 0,
                                  1 if sidecar_ok else 0,
                                  n_props, 0, len(props_blob))
@@ -244,15 +257,16 @@ class CppLogEvents(base.Events):
                     pos += len(field)
                     j += 1
                     offs[j] = pos
+            n_write = n - skipped
             buf = b"".join(chunks)
             rc = self.client.lib.pio_evlog_append_bulk(
-                h, n,
+                h, n_write,
                 times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 buf,
                 offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 bytes(meta),
             )
-            if rc != n:
+            if rc != n_write:
                 raise base.StorageError("bulk event append failed")
         return ids
 
@@ -406,16 +420,94 @@ class CppLogEvents(base.Events):
             user_ids=user_ids, item_ids=item_ids,
         )
 
-    def _scan_ids(self, res: int, which: int) -> list:
+    def _scan_ids(self, res: int, which: int) -> base.IdTable:
+        """Copy the C++ id table out as an arrow-style IdTable — offsets +
+        byte blob flow through as numpy/bytes, no per-id Python strings
+        until serving translation (eventlog.cc pio_scan_copy_ids)."""
+        import numpy as np
+
         lib = self.client.lib
         n = lib.pio_scan_n_ids(res, which)
-        nbytes = lib.pio_scan_ids_bytes(res, which)
-        buf = ctypes.create_string_buffer(max(int(nbytes), 1))
-        offs = (ctypes.c_int64 * (n + 1))()
-        lib.pio_scan_copy_ids(res, which, buf, offs)
-        blob = buf.raw[:nbytes]
-        return [blob[offs[i]:offs[i + 1]].decode("utf-8")
-                for i in range(n)]
+        nbytes = int(lib.pio_scan_ids_bytes(res, which))
+        buf = ctypes.create_string_buffer(max(nbytes, 1))
+        offs = np.empty(n + 1, np.int64)
+        lib.pio_scan_copy_ids(
+            res, which, buf,
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return base.IdTable(buf.raw[:nbytes], offs)
+
+    def import_interactions(
+        self,
+        inter: base.Interactions,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_name: str = "rate",
+        value_prop: str = "rating",
+        times: Optional[Any] = None,
+        base_time: Optional[datetime] = None,
+        chunk: int = 20_000,
+    ) -> int:
+        """Fully-native columnar bulk import (pio_evlog_append_interactions):
+        record rendering (JSON + sidecar + framed headers), hashing, and the
+        single buffered write all happen in C++ — no per-event Python
+        objects. Falls back to the generic per-Event path when a field
+        exceeds the sidecar limits (rc=-2)."""
+        import secrets
+
+        import numpy as np
+
+        from incubator_predictionio_tpu.utils.times import now_utc
+
+        n = len(inter)
+        if n == 0:
+            return 0
+        if times is None:
+            t0 = to_millis(base_time if base_time is not None else now_utc())
+            times_arr = t0 + np.arange(n, dtype=np.int64)
+        else:
+            times_arr = np.ascontiguousarray(times, np.int64)
+            if times_arr.shape != (n,):
+                raise ValueError(
+                    f"times must have shape ({n},), got {times_arr.shape}")
+        uidx = np.ascontiguousarray(inter.user_idx, np.int32)
+        iidx = np.ascontiguousarray(inter.item_idx, np.int32)
+        vals = np.ascontiguousarray(inter.values, np.float32)
+        if iidx.shape != (n,) or vals.shape != (n,):
+            raise ValueError(
+                "user_idx/item_idx/values must all have shape "
+                f"({n},), got {iidx.shape} / {vals.shape}")
+        utab = (inter.user_ids if isinstance(inter.user_ids, base.IdTable)
+                else base.IdTable.from_list(inter.user_ids))
+        itab = (inter.item_ids if isinstance(inter.item_ids, base.IdTable)
+                else base.IdTable.from_list(inter.item_ids))
+        uoffs = np.ascontiguousarray(utab.offsets, np.int64)
+        ioffs = np.ascontiguousarray(itab.offsets, np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            rc = self.client.lib.pio_evlog_append_interactions(
+                h, n,
+                times_arr.ctypes.data_as(i64p),
+                uidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                iidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                utab.blob, uoffs.ctypes.data_as(i64p), len(utab),
+                itab.blob, ioffs.ctypes.data_as(i64p), len(itab),
+                entity_type.encode("utf-8"),
+                target_entity_type.encode("utf-8"),
+                event_name.encode("utf-8"),
+                value_prop.encode("utf-8"),
+                int.from_bytes(secrets.token_bytes(8), "little"),
+            )
+        if rc == -2:  # sidecar limits exceeded: generic per-Event path
+            return super().import_interactions(
+                inter, app_id, channel_id, entity_type, target_entity_type,
+                event_name, value_prop, times, base_time, chunk)
+        if rc != n:
+            raise base.StorageError("columnar interaction import failed")
+        return n
 
     @staticmethod
     def _filter_parsed(payloads, entity_type, entity_id, names,

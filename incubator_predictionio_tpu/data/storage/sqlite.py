@@ -423,38 +423,66 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
 
         cond = " AND ".join(where)
         # one inner row set shared by the COO stream and the id tables, so
-        # the dense_rank index space and the DISTINCT tables always align
-        # (a row whose value resolves NULL exists in neither)
+        # the dense index space and the id tables always align (a row whose
+        # value resolves NULL exists in neither). Materialized ONCE into a
+        # temp table: the filter predicates and json_extract evaluate a
+        # single time, then the COO stream and both id tables read the
+        # materialized rows (previously three full passes).
         inner = (
             f"SELECT entity_id, target_entity_id, {value_sql} AS v,"
             f" event_time, id FROM events WHERE {cond}"
         )
         body_params = value_params + params
-        sql = (
-            "SELECT"
-            " dense_rank() OVER (ORDER BY entity_id) - 1,"
-            " dense_rank() OVER (ORDER BY target_entity_id) - 1,"
-            f" v FROM ({inner}) WHERE v IS NOT NULL"
-            " ORDER BY event_time, id"
-        )
         u_chunks, i_chunks, v_chunks = [], [], []
         with self.client.lock:
-            cur = self.client.conn.execute(sql, body_params)
-            while True:
-                rows = cur.fetchmany(batch_rows)
-                if not rows:
-                    break
-                arr = np.array(rows, np.float64)
-                u_chunks.append(arr[:, 0].astype(np.int32))
-                i_chunks.append(arr[:, 1].astype(np.int32))
-                v_chunks.append(arr[:, 2].astype(np.float32))
-            user_ids = [r[0] for r in self.client.conn.execute(
-                f"SELECT DISTINCT entity_id FROM ({inner})"
-                " WHERE v IS NOT NULL ORDER BY entity_id", body_params)]
-            item_ids = [r[0] for r in self.client.conn.execute(
-                f"SELECT DISTINCT target_entity_id FROM ({inner})"
-                " WHERE v IS NOT NULL ORDER BY target_entity_id",
-                body_params)]
+            conn = self.client.conn
+            conn.execute("DROP TABLE IF EXISTS temp.pio_scan")
+            conn.execute(
+                f"CREATE TEMP TABLE pio_scan AS SELECT * FROM ({inner})"
+                " WHERE v IS NOT NULL", body_params)
+            try:
+                # first-seen (event-time, id) order for the id tables — the
+                # cross-backend Interactions contract; dense ranks are keyed
+                # on each entity's FIRST row in that order
+                sql = (
+                    "SELECT"
+                    " dense_rank() OVER (ORDER BY u_ft, u_fid) - 1,"
+                    " dense_rank() OVER (ORDER BY i_ft, i_fid) - 1,"
+                    " v FROM ("
+                    "SELECT v, event_time, id,"
+                    " FIRST_VALUE(event_time) OVER (PARTITION BY entity_id"
+                    "   ORDER BY event_time, id) AS u_ft,"
+                    " FIRST_VALUE(id) OVER (PARTITION BY entity_id"
+                    "   ORDER BY event_time, id) AS u_fid,"
+                    " FIRST_VALUE(event_time) OVER"
+                    "   (PARTITION BY target_entity_id"
+                    "   ORDER BY event_time, id) AS i_ft,"
+                    " FIRST_VALUE(id) OVER (PARTITION BY target_entity_id"
+                    "   ORDER BY event_time, id) AS i_fid"
+                    " FROM temp.pio_scan)"
+                    " ORDER BY event_time, id"
+                )
+                cur = conn.execute(sql)
+                while True:
+                    rows = cur.fetchmany(batch_rows)
+                    if not rows:
+                        break
+                    arr = np.array(rows, np.float64)
+                    u_chunks.append(arr[:, 0].astype(np.int32))
+                    i_chunks.append(arr[:, 1].astype(np.int32))
+                    v_chunks.append(arr[:, 2].astype(np.float32))
+                first_seen = (
+                    "SELECT {col} FROM (SELECT {col}, event_time, id,"
+                    " ROW_NUMBER() OVER (PARTITION BY {col}"
+                    "   ORDER BY event_time, id) AS rn FROM temp.pio_scan)"
+                    " WHERE rn = 1 ORDER BY event_time, id"
+                )
+                user_ids = [r[0] for r in conn.execute(
+                    first_seen.format(col="entity_id"))]
+                item_ids = [r[0] for r in conn.execute(
+                    first_seen.format(col="target_entity_id"))]
+            finally:
+                conn.execute("DROP TABLE IF EXISTS temp.pio_scan")
         empty = np.zeros(0, np.int32)
         return base.Interactions(
             user_idx=np.concatenate(u_chunks) if u_chunks else empty,

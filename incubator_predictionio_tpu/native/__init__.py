@@ -132,6 +132,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pio_evlog_append_bulk.argtypes = [
         c.c_void_p, c.c_int64, i64p, c.c_char_p, i64p, c.c_char_p,
     ]
+    lib.pio_evlog_append_interactions.restype = c.c_int64
+    lib.pio_evlog_append_interactions.argtypes = [
+        c.c_void_p, c.c_int64, i64p,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_float),
+        c.c_char_p, i64p, c.c_int64,
+        c.c_char_p, i64p, c.c_int64,
+        c.c_char_p, c.c_char_p, c.c_char_p, c.c_char_p, c.c_uint64,
+    ]
     # csr builder
     pp_i32 = c.POINTER(c.POINTER(c.c_int32))
     pp_f32 = c.POINTER(c.POINTER(c.c_float))

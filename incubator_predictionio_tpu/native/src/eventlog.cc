@@ -21,6 +21,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <deque>
 #include <functional>
 #include <mutex>
@@ -672,7 +673,7 @@ static bool parse_sidecar(const char* p, size_t plen, SideFields* f) {
     pos += len;
     return v;
   };
-  if ((size_t)l[0] + l[1] + l[2] > bl) return false;
+  if (15 + (size_t)l[0] + l[1] + l[2] > bl) return false;
   f->etype = take(l[0]);
   f->name = take(l[1]);
   f->eid = take(l[2]);
@@ -1035,6 +1036,225 @@ int64_t pio_evlog_append_bulk(void* handle, int64_t n,
     pos += sizeof(h) + plen;
   }
   if (fwrite(out.data(), 1, out.size(), log->f) != out.size()) {
+    fflush(log->f);
+    (void)!ftruncate(fileno(log->f), batch_start);
+    clearerr(log->f);
+    fseeko(log->f, 0, SEEK_END);
+    return -1;
+  }
+  fflush(log->f);
+  for (auto& e : new_entries) {
+    if (e.time_ms >= log->last_time && !log->sorted_dirty) {
+      log->sorted.push_back((int64_t)log->entries.size());
+    } else {
+      log->sorted_dirty = true;
+    }
+    log->last_time = std::max(log->last_time, e.time_ms);
+    log->entries.push_back(e);
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// Columnar bulk import — the inverse of the interaction scan.
+//
+// Renders `n` interaction events (JSON payload + binary sidecar + framed
+// header) entirely in C++ from columnar inputs: COO index arrays plus
+// arrow-style id tables (byte blob + offsets — the same layout the scan
+// emits). This is the high-throughput seeding path for `pio import` and the
+// benchmark: no per-event Python objects exist anywhere. Plays the role of
+// the reference's bulk write (data/.../storage/PEvents.scala:184
+// `write(RDD[Event])` via the HBase TableOutputFormat).
+// ---------------------------------------------------------------------------
+
+static void json_escape_append(std::string* out, std::string_view s) {
+  for (char c : s) {
+    switch (c) {
+      case '"': out->append("\\\""); break;
+      case '\\': out->append("\\\\"); break;
+      case '\b': out->append("\\b"); break;
+      case '\f': out->append("\\f"); break;
+      case '\n': out->append("\\n"); break;
+      case '\r': out->append("\\r"); break;
+      case '\t': out->append("\\t"); break;
+      default:
+        if ((uint8_t)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", (int)(uint8_t)c);
+          out->append(buf);
+        } else {
+          out->push_back(c);  // raw utf-8 bytes are valid JSON strings
+        }
+    }
+  }
+}
+
+static void iso8601_append(std::string* out, int64_t ms) {
+  time_t secs = (time_t)(ms >= 0 ? ms / 1000 : (ms - 999) / 1000);
+  int milli = (int)(ms - (int64_t)secs * 1000);
+  struct tm tmv;
+  gmtime_r(&secs, &tmv);
+  char buf[40];
+  snprintf(buf, sizeof(buf), "%04d-%02d-%02dT%02d:%02d:%02d.%03d+00:00",
+           tmv.tm_year + 1900, tmv.tm_mon + 1, tmv.tm_mday, tmv.tm_hour,
+           tmv.tm_min, tmv.tm_sec, milli);
+  out->append(buf);
+}
+
+static uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+static void hex32_append(std::string* out, uint64_t a, uint64_t b) {
+  static const char* d = "0123456789abcdef";
+  char buf[32];
+  for (int i = 15; i >= 0; --i) { buf[i] = d[a & 15]; a >>= 4; }
+  for (int i = 31; i >= 16; --i) { buf[i] = d[b & 15]; b >>= 4; }
+  out->append(buf, 32);
+}
+
+// Returns n on success; -1 on write failure (file truncated back to the
+// batch start — never a partial batch); -2 when an id/field exceeds the
+// sidecar length limits (caller falls back to the generic Python path).
+int64_t pio_evlog_append_interactions(
+    void* handle, int64_t n, const int64_t* time_ms, const int32_t* uidx,
+    const int32_t* iidx, const float* vals, const char* ubuf,
+    const int64_t* uoffs, int64_t n_users, const char* ibuf,
+    const int64_t* ioffs, int64_t n_items, const char* entity_type,
+    const char* target_entity_type, const char* event_name,
+    const char* value_prop, uint64_t seed) {
+  auto* log = (EventLog*)handle;
+  if (n <= 0) return 0;
+  const std::string_view etype(entity_type), tetype(target_entity_type);
+  const std::string_view name(event_name), prop(value_prop);
+  if (etype.size() >= kNoTarget || tetype.size() >= kNoTarget ||
+      name.size() >= kNoTarget || prop.size() > 255)
+    return -2;
+  for (int64_t i = 0; i < n_users; ++i)
+    if (uoffs[i + 1] - uoffs[i] >= kNoTarget) return -2;
+  for (int64_t i = 0; i < n_items; ++i)
+    if (ioffs[i + 1] - ioffs[i] >= kNoTarget) return -2;
+  for (int64_t k = 0; k < n; ++k)
+    if (!std::isfinite((double)vals[k]) || uidx[k] < 0 ||
+        uidx[k] >= n_users || iidx[k] < 0 || iidx[k] >= n_items)
+      return -2;
+
+  const uint64_t etype_h = fnv1a64(etype.data(), etype.size());
+  const uint64_t name_h = fnv1a64(name.data(), name.size());
+  // per-user id hashes, computed once
+  std::vector<uint64_t> uhash(n_users);
+  for (int64_t i = 0; i < n_users; ++i)
+    uhash[i] = fnv1a64(ubuf + uoffs[i], (size_t)(uoffs[i + 1] - uoffs[i]));
+  // pre-escaped id fragments (most ids need no escaping; the check is an
+  // allocation-free scan), reused across all their interactions
+  auto escape_table = [](const char* buf, const int64_t* offs, int64_t cnt) {
+    std::vector<std::string> out((size_t)cnt);
+    for (int64_t i = 0; i < cnt; ++i) {
+      out[i].reserve((size_t)(offs[i + 1] - offs[i]));
+      json_escape_append(&out[i],
+                         std::string_view(buf + offs[i],
+                                          (size_t)(offs[i + 1] - offs[i])));
+    }
+    return out;
+  };
+  std::vector<std::string> uesc = escape_table(ubuf, uoffs, n_users);
+  std::vector<std::string> iesc = escape_table(ibuf, ioffs, n_items);
+  std::string name_esc, etype_esc, tetype_esc, prop_esc;
+  json_escape_append(&name_esc, name);
+  json_escape_append(&etype_esc, etype);
+  json_escape_append(&tetype_esc, tetype);
+  json_escape_append(&prop_esc, prop);
+
+  std::lock_guard<std::mutex> g(log->mu);
+  fseeko(log->f, 0, SEEK_END);
+  const off_t batch_start = ftello(log->f);
+  off_t pos = batch_start;
+  std::vector<Entry> new_entries;
+  new_entries.reserve((size_t)n);
+  std::string out;
+  out.reserve(8 << 20);
+  std::string json, iso;
+  bool failed = false;
+  for (int64_t k = 0; k < n && !failed; ++k) {
+    const int32_t u = uidx[k], it = iidx[k];
+    const double v = (double)vals[k];
+    const std::string_view uid(ubuf + uoffs[u],
+                               (size_t)(uoffs[u + 1] - uoffs[u]));
+    const std::string_view iid(ibuf + ioffs[it],
+                               (size_t)(ioffs[it + 1] - ioffs[it]));
+    // JSON payload (compact; key order matches the DAO's json.dumps of
+    // Event.to_jsonable so downstream scanners see one shape)
+    json.clear();
+    json.append("{\"eventId\":\"");
+    const uint64_t ida = splitmix64(seed ^ (uint64_t)k);
+    const uint64_t idb = splitmix64(seed + 0x9E3779B97F4A7C15ull + (uint64_t)k);
+    size_t id_pos = json.size();
+    hex32_append(&json, ida, idb);
+    const uint64_t id_h = fnv1a64(json.data() + id_pos, 32);
+    json.append("\",\"event\":\"");
+    json.append(name_esc);
+    json.append("\",\"entityType\":\"");
+    json.append(etype_esc);
+    json.append("\",\"entityId\":\"");
+    json.append(uesc[u]);
+    json.append("\",\"targetEntityType\":\"");
+    json.append(tetype_esc);
+    json.append("\",\"targetEntityId\":\"");
+    json.append(iesc[it]);
+    json.append("\",\"properties\":{\"");
+    json.append(prop_esc);
+    json.append("\":");
+    char vbuf[40];
+    snprintf(vbuf, sizeof(vbuf), "%.9g", v);
+    json.append(vbuf);
+    json.append("},\"eventTime\":\"");
+    iso.clear();
+    iso8601_append(&iso, time_ms[k]);
+    json.append(iso);
+    json.append("\",\"tags\":[],\"creationTime\":\"");
+    json.append(iso);
+    json.append("\"}");
+    // sidecar: etype, name, eid(=event_id? no: entity id), target, 1 prop
+    const uint32_t props_len = (uint32_t)(1 + prop.size() + 8);
+    const uint32_t side_len =
+        4 + 1 + 10 + (uint32_t)(etype.size() + name.size() + uid.size() +
+                                tetype.size() + iid.size()) + props_len;
+    const uint32_t plen = side_len + (uint32_t)json.size();
+    RecHeader h{time_ms[k], etype_h, uhash[u], name_h, id_h, plen, kSidecar};
+    out.append((const char*)&h, sizeof(h));
+    out.append((const char*)&side_len, 4);
+    out.push_back((char)1);  // n_props
+    uint16_t l[5] = {(uint16_t)etype.size(), (uint16_t)name.size(),
+                     (uint16_t)uid.size(), (uint16_t)tetype.size(),
+                     (uint16_t)iid.size()};
+    out.append((const char*)l, 10);
+    out.append(etype);
+    out.append(name);
+    out.append(uid);
+    out.append(tetype);
+    out.append(iid);
+    out.push_back((char)prop.size());
+    out.append(prop);
+    double v64 = v;
+    out.append((const char*)&v64, 8);
+    out.append(json);
+    new_entries.push_back({time_ms[k], etype_h, uhash[u], name_h, id_h,
+                           (uint64_t)(pos + sizeof(h)), plen, kSidecar,
+                           false});
+    pos += (off_t)(sizeof(h) + plen);
+    if (out.size() >= (8u << 20)) {
+      if (fwrite(out.data(), 1, out.size(), log->f) != out.size())
+        failed = true;
+      out.clear();
+    }
+  }
+  if (!failed && !out.empty() &&
+      fwrite(out.data(), 1, out.size(), log->f) != out.size())
+    failed = true;
+  if (failed) {
     fflush(log->f);
     (void)!ftruncate(fileno(log->f), batch_start);
     clearerr(log->f);

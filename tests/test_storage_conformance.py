@@ -400,9 +400,10 @@ def test_scan_interactions_conformance(backend):
     assert _triples(inter) == _EXPECTED_TRIPLES
     assert inter.user_idx.dtype.name == "int32"
     assert inter.values.dtype.name == "float32"
-    # id tables hold exactly the ids referenced by the triples
-    assert set(inter.user_ids) == {t[0] for t in _EXPECTED_TRIPLES}
-    assert set(inter.item_ids) == {t[1] for t in _EXPECTED_TRIPLES}
+    # id tables hold exactly the referenced ids, in FIRST-SEEN
+    # (event-time, insertion) order — the cross-backend contract
+    assert list(inter.user_ids) == ["alice", "bob", "éva", 'q"uote\\back']
+    assert list(inter.item_ids) == ["i1", "i2", "i3", "ïtem-√2"]
     # and agree with the generic (Event-object) implementation
     from incubator_predictionio_tpu.data.storage import base as storage_base
     generic = storage_base.Events.scan_interactions(
@@ -431,6 +432,98 @@ def test_scan_interactions_time_window_and_defaults(backend):
     # empty names match nothing (find() contract)
     inter = events.scan_interactions(app_id=9, event_names=())
     assert len(inter) == 0 and inter.user_ids == []
+
+
+def test_scan_interactions_json_fallback_path(backend):
+    """Records whose sidecar cannot be built (a numeric property key beyond
+    the sidecar's 255-byte key limit) must scan identically through the
+    JSON-parsing fallback (eventlog.cc extract_fields/span_property_number;
+    trivially true for the non-native backends)."""
+    from incubator_predictionio_tpu.data.event import Event as Ev
+
+    events = dao(backend, "Events")
+    events.init(11)
+    long_key = "k" * 300  # forces sidecar_ok=False in the cpplog writer
+    rows = [
+        ("alice", "i1", 4.5, 0),
+        ("éva", "ïtem-√2", 5.0, 1),
+        ('q"uote\\back', "i1", 1.5, 2),
+    ]
+    for eid, target, rating, minutes in rows:
+        events.insert(Ev(
+            event="rate", entity_type="user", entity_id=eid,
+            target_entity_type="item", target_entity_id=target,
+            properties=DataMap({"rating": rating, long_key: 1.0}),
+            event_time=T0 + timedelta(minutes=minutes),
+        ), 11)
+    # one event missing the prop → skipped by value resolution
+    events.insert(Ev(
+        event="rate", entity_type="user", entity_id="bob",
+        target_entity_type="item", target_entity_id="i2",
+        properties=DataMap({long_key: 1.0}),
+        event_time=T0 + timedelta(minutes=3)), 11)
+    inter = events.scan_interactions(
+        app_id=11, event_names=("rate",), value_prop="rating")
+    assert _triples(inter) == [(u, t, v) for u, t, v, _ in rows]
+    assert list(inter.user_ids) == ["alice", "éva", 'q"uote\\back']
+
+
+def test_insert_batch_duplicate_explicit_id_last_wins(backend):
+    """Duplicate explicit event ids inside ONE batch resolve last-wins,
+    matching sqlite INSERT OR REPLACE / upsert-across-batches semantics."""
+    events = dao(backend, "Events")
+    events.init(12)
+    e1 = ev("rate", "u1", 0, target="i1", props={"rating": 1.0})
+    batch = [
+        e1.with_id("dup-id"),
+        ev("rate", "u2", 1, target="i2", props={"rating": 2.0}),
+        ev("rate", "u1", 2, target="i3",
+           props={"rating": 3.0}).with_id("dup-id"),
+    ]
+    ids = events.insert_batch(batch, 12)
+    assert ids == ["dup-id", ids[1], "dup-id"]
+    got = events.get("dup-id", 12)
+    assert got is not None and got.target_entity_id == "i3"
+    # exactly two live records: the winner and the independent event
+    assert len(list(events.find(app_id=12))) == 2
+
+
+def test_import_interactions_roundtrip(backend):
+    """Columnar bulk import (the inverse of scan_interactions) must
+    round-trip exactly on every backend — incl. the fully-native cpplog
+    writer (eventlog.cc pio_evlog_append_interactions)."""
+    import numpy as np
+
+    from incubator_predictionio_tpu.data.storage.base import Interactions
+
+    events = dao(backend, "Events")
+    events.init(13)
+    inter = Interactions(
+        user_idx=np.array([0, 1, 0, 2, 1], np.int32),
+        item_idx=np.array([0, 0, 1, 2, 1], np.int32),
+        values=np.array([4.5, 2.0, 3.25, 1.0, 5.0], np.float32),
+        user_ids=["alice", "éva", 'q"uote\\back'],
+        item_ids=["i1", "ïtem-√2", "i3"],
+    )
+    n = events.import_interactions(
+        inter, 13, entity_type="user", target_entity_type="item",
+        event_name="rate", value_prop="rating", base_time=T0)
+    assert n == 5
+    back = events.scan_interactions(
+        app_id=13, entity_type="user", target_entity_type="item",
+        event_names=("rate",), value_prop="rating")
+    assert _triples(back) == [
+        ("alice", "i1", 4.5), ("éva", "i1", 2.0),
+        ("alice", "ïtem-√2", 3.25), ('q"uote\\back', "i3", 1.0),
+        ("éva", "ïtem-√2", 5.0),
+    ]
+    # the imported records are real events (queryable, typed, timestamped)
+    found = list(events.find(app_id=13, entity_id="alice"))
+    assert len(found) == 2
+    assert found[0].event == "rate"
+    assert found[0].properties.get("rating") in (4.5,)
+    assert found[0].event_time == T0
+    assert found[0].event_id  # ids were generated
 
 
 def test_aggregate_required_filters_by_property_names(backend):

@@ -1,0 +1,169 @@
+"""StorageServer — the shared-store network service.
+
+Closes the reference's multi-box deployment topology: there, N event /
+prediction servers share state through external services (PostgreSQL via
+jdbc/StorageClient.scala:35-60, HBase, Elasticsearch). Here the same role
+is played by ONE process owning a local backend (sqlite / cpplog / memory)
+and exporting the complete DAO surface over HTTP: any number of
+eventservers, prediction servers, and trainers on other boxes point their
+``PIO_STORAGE_SOURCES_<N>_TYPE=remote`` at it and see one store.
+
+Protocol: ``POST /rpc`` with a msgpack body
+``{iface, prefix, method, args, kwargs}`` (storage/wire.py codec) →
+msgpack ``{ok, value}`` / ``{ok: false, etype, error}``. Columnar scans
+(``scan_interactions``) travel as raw array buffers, so remote training
+ingest stays columnar end-to-end. Optional shared-key auth via the
+``X-Pio-Storage-Key`` header (KeyAuthentication.scala's role).
+
+Start via ``pio storageserver`` (cli) or embed :class:`StorageServer`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from incubator_predictionio_tpu.data.event import EventValidationError
+from incubator_predictionio_tpu.data.storage import StorageError, base, wire
+from incubator_predictionio_tpu.utils.http import (
+    HttpServer,
+    Request,
+    Response,
+    Router,
+)
+
+logger = logging.getLogger(__name__)
+
+#: iface → methods callable over RPC (the full DAO surface; everything
+#: else 404s, so the server's attack surface is exactly this table)
+_ALLOWED: Dict[str, Tuple[str, ...]] = {
+    "Events": (
+        "init", "remove", "insert", "insert_batch", "get", "delete",
+        "find", "aggregate_properties", "scan_interactions",
+        "import_interactions",
+    ),
+    "Apps": ("insert", "get", "get_by_name", "get_all", "update", "delete"),
+    "AccessKeys": ("insert", "get", "get_all", "get_by_appid", "update",
+                   "delete"),
+    "Channels": ("insert", "get", "get_by_appid", "delete"),
+    "EngineInstances": ("insert", "get", "get_all", "get_latest_completed",
+                        "get_completed", "update", "delete"),
+    "EvaluationInstances": ("insert", "get", "get_all", "get_completed",
+                            "update", "delete"),
+    "EngineManifests": ("insert", "get", "get_all", "update", "delete"),
+    "Models": ("insert", "get", "delete"),
+}
+
+#: exception types that cross the wire by name (client re-raises them)
+_ERROR_TYPES = {
+    "StorageError": StorageError,
+    "ValueError": ValueError,
+    "KeyError": KeyError,
+    "EventValidationError": EventValidationError,
+}
+
+
+class StorageServer:
+    """One backing backend (module, client, config) exported over HTTP."""
+
+    def __init__(
+        self,
+        module: Any,
+        client: Any,
+        config: base.StorageClientConfig,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        auth_key: Optional[str] = None,
+    ):
+        self.module = module
+        self.client = client
+        self.config = config
+        self.auth_key = auth_key
+        self._daos: Dict[Tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+        self.http = HttpServer.from_conf(self._router(), host, port)
+
+    @classmethod
+    def from_env(cls, source: str = "DEFAULT", host: str = "0.0.0.0",
+                 port: int = 0, auth_key: Optional[str] = None
+                 ) -> "StorageServer":
+        """Back the server with the source the environment configures
+        (the Storage registry's own resolution, so `pio storageserver`
+        honours the PIO_STORAGE_SOURCES_* scheme)."""
+        from incubator_predictionio_tpu.data.storage import Storage
+
+        client, module, config = Storage._get_client(source)
+        return cls(module, client, config, host, port, auth_key)
+
+    def _dao(self, iface: str, prefix: str) -> Any:
+        with self._lock:
+            dao = self._daos.get((iface, prefix))
+            if dao is None:
+                cls = self.module.DATA_OBJECTS.get(iface)
+                if cls is None:
+                    raise StorageError(
+                        f"backend {self.module.__name__} does not implement "
+                        f"{iface}")
+                dao = cls(self.client, self.config, prefix=prefix)
+                self._daos[(iface, prefix)] = dao
+            return dao
+
+    def _router(self) -> Router:
+        r = Router()
+
+        @r.get("/")
+        def status(request: Request) -> Response:
+            return Response(200, {
+                "status": "alive",
+                "backend": self.module.__name__.rsplit(".", 1)[-1],
+                "interfaces": sorted(self.module.DATA_OBJECTS),
+            })
+
+        @r.post("/rpc")
+        def rpc(request: Request) -> Response:
+            if self.auth_key is not None and \
+                    request.headers.get("x-pio-storage-key") != self.auth_key:
+                return _packed({"ok": False, "etype": "StorageError",
+                                "error": "invalid storage key"}, 401)
+            try:
+                msg = wire.unpack(request.body)
+                iface = msg["iface"]
+                method = msg["method"]
+                if method not in _ALLOWED.get(iface, ()):
+                    raise StorageError(
+                        f"method {iface}.{method} is not exported")
+                dao = self._dao(iface, msg.get("prefix", ""))
+                value = getattr(dao, method)(
+                    *msg.get("args", ()), **msg.get("kwargs", {}))
+                if iface == "Events" and method == "find":
+                    value = list(value)  # materialize the iterator
+                return _packed({"ok": True, "value": value})
+            except Exception as e:  # error crosses the wire, typed
+                etype = type(e).__name__
+                if etype not in _ERROR_TYPES:
+                    logger.exception("storage rpc failed")
+                    etype = "StorageError"
+                return _packed({"ok": False, "etype": etype,
+                                "error": str(e)})
+
+        return r
+
+    # -- lifecycle ---------------------------------------------------------
+    def start_background(self) -> int:
+        port = self.http.start_background()
+        logger.info("StorageServer listening on :%d (backend %s)",
+                    port, self.module.__name__)
+        return port
+
+    async def serve_forever(self) -> None:
+        await self.http.serve_forever()
+
+    def stop(self) -> None:
+        self.http.stop()
+        self.client.close()
+
+
+def _packed(payload: Dict[str, Any], status: int = 200) -> Response:
+    return Response(status, body=wire.pack(payload),
+                    content_type="application/x-msgpack")

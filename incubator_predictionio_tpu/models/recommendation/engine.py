@@ -524,11 +524,15 @@ class ALSAlgorithm(Algorithm):
     def batch_predict(
         self, model: ALSModel, queries: Sequence[Tuple[int, Query]]
     ) -> List[Tuple[int, PredictedResult]]:
-        """Evaluation path: one (B×K)·(K×I) matmul + batched top-k for all
-        unfiltered queries (the MXU-shaped path); filtered queries fall back
-        to per-query predict."""
-        import jax
-        import jax.numpy as jnp
+        """Batched serving/evaluation path: one (B×K)·(K×I) matmul + batched
+        top-k for all unfiltered queries (the MXU-shaped path; the serving
+        micro-batcher routes concurrent /queries.json traffic here —
+        CreateServer.scala:523 leaves this as "TODO: Parallelize"). Filtered
+        queries fall back to per-query predict."""
+        from incubator_predictionio_tpu.ops.host_serving import (
+            host_arrays, host_top_k,
+        )
+        from incubator_predictionio_tpu.ops.topk import batch_score_top_k
 
         plain = [
             (qx, q) for qx, q in queries
@@ -539,32 +543,36 @@ class ALSAlgorithm(Algorithm):
         out: List[Tuple[int, PredictedResult]] = []
         if plain:
             k = min(max(q.num for _qx, q in plain), len(model.item_bimap))
-            user_rows = jnp.asarray(
-                [model.user_bimap[q.user] for _qx, q in plain], jnp.int32
-            )
-            @jax.jit
-            def _batch_score(user_factors, item_factors, rows):
-                scores = user_factors[rows] @ item_factors.T      # [B, I]
-                top_s, top_i = jax.lax.top_k(scores, k)
-                return jnp.stack([top_s, top_i.astype(jnp.float32)])
-
-            packed = np.asarray(_batch_score(                     # one fetch
-                jnp.asarray(model.user_factors),
-                jnp.asarray(model.item_factors), user_rows,
-            ))
-            top_s, top_i = packed[0], packed[1].astype(np.int64)
-            inv = model.item_bimap.inverse
-            for row, (qx, q) in enumerate(plain):
-                scored = tuple(
-                    ItemScore(item=inv[int(i)], score=float(s))
-                    for s, i in zip(top_s[row][: q.num], top_i[row][: q.num])
-                )
-                out.append((qx, PredictedResult(item_scores=scored)))
+            rows = [model.user_bimap[q.user] for _qx, q in plain]
+            host = host_arrays(model, "user_factors", "item_factors")
+            if host is not None and len(plain) <= 4:
+                # small model + tiny batch: the host matvec beats a
+                # device round trip; larger batches amortize the dispatch
+                np_users, np_items = host
+                for (qx, q), row in zip(plain, rows):
+                    top_s, top_i = host_top_k(np_items @ np_users[row], k)
+                    out.append((qx, self._pack_scores(
+                        model, top_s[: q.num], top_i[: q.num])))
+            else:
+                packed = np.asarray(batch_score_top_k(     # ONE fetch
+                    model.user_factors, model.item_factors, rows, k))
+                top_s, top_i = packed[0], packed[1].astype(np.int64)
+                for row, (qx, q) in enumerate(plain):
+                    out.append((qx, self._pack_scores(
+                        model, top_s[row][: q.num], top_i[row][: q.num])))
         handled = {qx for qx, _ in out}
         for qx, q in queries:
             if qx not in handled:
                 out.append((qx, self.predict(model, q)))
         return out
+
+    def _pack_scores(self, model: ALSModel, scores, indices) -> PredictedResult:
+        inv = model.item_bimap.inverse
+        return PredictedResult(item_scores=tuple(
+            ItemScore(item=inv[int(i)], score=float(s),
+                      creation_year=model.item_years.get(inv[int(i)]))
+            for s, i in zip(scores, indices) if s > -1e37
+        ))
 
 
 # ---------------------------------------------------------------------------

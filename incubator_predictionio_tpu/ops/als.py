@@ -186,6 +186,37 @@ def _solve_bucket_chunked(solver_fn, cols, vals, mask, rank: int):
     return sols.reshape(n * chunk, rank)[:B]
 
 
+def _gram_rhs_nnz_chunked(other_factors, cols, vals, mask, compute_dtype,
+                          precision, implicit, alpha):
+    """Apply :func:`_gram_rhs_nnz` in bounded row chunks (lax.map).
+
+    The heavy-segment path's equivalent of :func:`_solve_bucket_chunked`:
+    split segments are max_width wide, so even a few hundred of them would
+    gather a multi-GB [S, D, K] tensor at once. Chunk padding rows carry
+    zero masks → zero partials, sliced off before the segment sum."""
+    S, D = cols.shape
+    rank = other_factors.shape[1]
+    chunk = max(1, _CHUNK_ELEMS // max(D * rank, 1))
+    if S <= chunk:
+        return _gram_rhs_nnz(other_factors, cols, vals, mask, compute_dtype,
+                             precision, implicit, alpha)
+    n = -(-S // chunk)
+    pad = n * chunk - S
+    if pad:
+        cols = jnp.pad(cols, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        mask = jnp.pad(mask, ((0, pad), (0, 0)))
+    pg, prhs, pnnz = jax.lax.map(
+        lambda t: _gram_rhs_nnz(other_factors, t[0], t[1], t[2],
+                                compute_dtype, precision, implicit, alpha),
+        (cols.reshape(n, chunk, D), vals.reshape(n, chunk, D),
+         mask.reshape(n, chunk, D)),
+    )
+    return (pg.reshape(n * chunk, rank, rank)[:S],
+            prhs.reshape(n * chunk, rank)[:S],
+            pnnz.reshape(n * chunk)[:S])
+
+
 def _scatter_rows_impl(out: jax.Array, row_ids: jax.Array,
                        sol: jax.Array) -> jax.Array:
     # Padding rows carry row_id -1. JAX scatter wraps negative indices
@@ -223,13 +254,19 @@ def _sweep_side(
     yty = _gram_all(other_factors, precision) if implicit else None
     for row_ids, cols, vals, mask in tree:
         if implicit:
-            sol = _solve_bucket_implicit(
-                other_factors, yty, cols, vals, mask, l2, alpha,
-                precision=precision)
+            def solver(t, _yty=yty):
+                return _solve_bucket_implicit(
+                    other_factors, _yty, t[0], t[1], t[2], l2, alpha,
+                    precision=precision)
         else:
-            sol = _solve_bucket(
-                other_factors, cols, vals, mask, l2, reg_nnz=reg_nnz,
-                compute_dtype=compute_dtype, precision=precision)
+            def solver(t):
+                return _solve_bucket(
+                    other_factors, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
+                    compute_dtype=compute_dtype, precision=precision)
+        # large buckets solve in bounded row chunks (lax.map) so the
+        # [B, D, K] gather / [B, K, K] gram temps never exceed the chunk
+        # budget — the ML-20M-scale HBM requirement
+        sol = _solve_bucket_chunked(solver, cols, vals, mask, rank)
         out = _scatter_rows_impl(out, row_ids, sol)
     if heavy is not None:
         h_ids, h_sol = _solve_heavy(
@@ -592,7 +629,7 @@ def _solve_heavy(
     the reduction ALX does across shards, here across split segments."""
     seg_ids, row_ids, cols, vals, mask = heavy
     n_heavy = row_ids.shape[0]
-    pg, prhs, pnnz = _gram_rhs_nnz(
+    pg, prhs, pnnz = _gram_rhs_nnz_chunked(
         other_factors, cols, vals, mask, compute_dtype, precision,
         implicit, alpha)
     gram = jax.ops.segment_sum(pg, seg_ids, num_segments=n_heavy)

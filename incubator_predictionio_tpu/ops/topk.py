@@ -99,6 +99,39 @@ def score_user_and_top_k(
                                  exclude, allowed_mask)
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _batch_score_top_k_xla(
+    user_factors: jax.Array,        # [U, K]
+    item_factors: jax.Array,        # [I, K]
+    rows: jax.Array,                # [B] int32 user indices
+    k: int,
+) -> jax.Array:
+    scores = user_factors[rows] @ item_factors.T          # [B, I] — MXU
+    top_s, top_i = jax.lax.top_k(scores, k)
+    return jnp.stack([top_s, top_i.astype(jnp.float32)])  # [2, B, k]
+
+
+def batch_score_top_k(
+    user_factors: jax.Array,
+    item_factors: jax.Array,
+    rows,                           # [B] int array of user indices
+    k: int,
+) -> jax.Array:
+    """Score B users against the whole catalog and rank, in ONE dispatch.
+
+    The serving micro-batcher's compute path (the reference leaves this as
+    "TODO: Parallelize", CreateServer.scala:523): one [B, K] × [K, I] matmul
+    amortizes the device round trip over the whole batch. ``rows`` is padded
+    to the next power of two (row 0 repeated) so the jit compiles
+    O(log max-batch) times total; callers slice row b of the packed
+    [2, B_pad, k] result."""
+    B = len(rows)
+    pad = 1 << max(B - 1, 0).bit_length()
+    rows_arr = jnp.asarray(
+        list(rows) + [rows[0]] * (pad - B), jnp.int32)
+    return _batch_score_top_k_xla(user_factors, item_factors, rows_arr, k)
+
+
 def score_and_top_k(
     user_vector: jax.Array,         # [K]
     item_factors: jax.Array,        # [I, K]

@@ -65,6 +65,70 @@ class ServerConfig:
     feedback: bool = False
     server_key: Optional[str] = None  # auth for /stop and /reload
     verbose: bool = False
+    #: max concurrent queries fused into one batch_predict device dispatch
+    #: (0 disables micro-batching; the reference serves queries one at a
+    #: time — CreateServer.scala:523 "TODO: Parallelize")
+    micro_batch: int = 32
+
+
+class _MicroBatcher:
+    """Natural (queue-depth) micro-batching for the query path.
+
+    Requests enqueue; a single dispatcher thread drains whatever is queued
+    (up to ``max_batch``) into ONE ``_handle_batch`` call. Under sequential
+    load every batch has size 1 — zero added latency; under concurrent load
+    batches form automatically while the previous dispatch is in flight, so
+    the device cost is amortized without any timer. This replaces the
+    per-query actor ask the reference serves with (CreateServer.scala:523
+    "TODO: Parallelize" — here it IS parallelized, MXU-style)."""
+
+    def __init__(self, handle_batch, max_batch: int = 32):
+        import concurrent.futures as cf
+
+        self._cf = cf
+        self._handle_batch = handle_batch
+        self.max_batch = max(int(max_batch), 1)
+        self._cv = threading.Condition()
+        self._queue: List[Any] = []
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pio-microbatch")
+        self._thread.start()
+
+    def submit(self, body: bytes) -> "Any":
+        """Enqueue one query body → concurrent Future of its result."""
+        fut = self._cf.Future()
+        with self._cv:
+            if self._stopped:
+                fut.set_exception(HttpError(503, "Server is shutting down."))
+                return fut
+            self._queue.append((body, fut))
+            self._cv.notify()
+        return fut
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait(0.5)
+                if self._stopped and not self._queue:
+                    return
+                batch = self._queue[: self.max_batch]
+                del self._queue[: len(batch)]
+            try:
+                results = self._handle_batch([b for b, _f in batch])
+            except Exception as exc:  # catastrophic: fail the whole batch
+                results = [exc] * len(batch)
+            for (_b, fut), res in zip(batch, results):
+                if isinstance(res, Exception):
+                    fut.set_exception(res)
+                else:
+                    fut.set_result(res)
 
 
 class PredictionServer:
@@ -92,6 +156,7 @@ class PredictionServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        self.max_batch_served = 0  # largest micro-batch fused so far
         from incubator_predictionio_tpu.utils.ssl_config import load_server_key
 
         # loaded once, like the reference's ServerKey config object
@@ -100,6 +165,10 @@ class PredictionServer:
         )
         self.http = HttpServer.from_conf(self._build_router(), config.ip,
                                          config.port)
+        self._batcher = (
+            _MicroBatcher(self._handle_batch, config.micro_batch)
+            if config.micro_batch > 0 else None
+        )
 
     # -- deploy lifecycle ---------------------------------------------------
     def _resolve_instance(self) -> EngineInstance:
@@ -150,51 +219,98 @@ class PredictionServer:
 
     # -- query pipeline -----------------------------------------------------
     def _handle_query(self, body: bytes) -> Any:
+        res = self._handle_batch([body])[0]
+        if isinstance(res, Exception):
+            raise res
+        return res
+
+    def _handle_batch(self, bodies: List[bytes]) -> List[Any]:
+        """Serve a batch of query bodies in one pass: parse + supplement per
+        query, then ONE ``batch_predict`` per algorithm (a single device
+        dispatch for the whole batch, ops/topk.py batch_score_top_k), then
+        per-query serve/feedback/plugins. Per-query failures become entries
+        in the result list — one bad query never fails its batchmates.
+        A batch of one is the plain sequential path."""
         t0 = time.perf_counter()
         with self._lock:
             algorithms = self.algorithms
             serving = self.serving
             models = self.models
             instance = self.engine_instance
+        n = len(bodies)
         if not algorithms or instance is None:
-            raise HttpError(503, "No engine instance deployed.")
+            return [HttpError(503, "No engine instance deployed.")] * n
         query_class = algorithms[0].query_class
-        raw = json.loads(body.decode("utf-8"))
-        query = (
-            json_codec.extract(query_class, raw)
-            if query_class is not None else raw
-        )
-        supplemented = serving.supplement(query)
-        predictions = [
-            a.predict(m, supplemented) for a, m in zip(algorithms, models)
-        ]
-        # by design, serve sees the ORIGINAL query (CreateServer.scala:526)
-        prediction = serving.serve(query, predictions)
-        result = json_codec.to_jsonable(prediction)
-
-        if self.config.feedback:
-            result = self._feedback(instance, raw, result)
-
-        for blocker in self.plugin_context.output_blockers.values():
-            result = blocker.process(
-                instance.engine_variant, raw, result, self.plugin_context
-            )
-        for sniffer in self.plugin_context.output_sniffers.values():
+        results: List[Any] = [None] * n
+        parsed: List[Any] = []  # [idx, raw, query, supplemented]
+        for idx, body in enumerate(bodies):
             try:
-                sniffer.process(
-                    instance.engine_variant, raw, result, self.plugin_context
+                raw = json.loads(body.decode("utf-8"))
+                query = (
+                    json_codec.extract(query_class, raw)
+                    if query_class is not None else raw
                 )
-            except Exception:
-                logger.exception("output sniffer failed")
-
+                parsed.append([idx, raw, query, serving.supplement(query)])
+            except Exception as e:
+                results[idx] = e
+        # one prediction per algorithm per live query; a batch of >1 goes
+        # through the algorithm's batched path
+        preds: Dict[int, List[Any]] = {p[0]: [] for p in parsed}
+        for a, m in zip(algorithms, models):
+            live = [(idx, supp) for idx, _r, _q, supp in parsed
+                    if results[idx] is None]
+            if not live:
+                break
+            if len(live) > 1:
+                try:
+                    got = dict(a.batch_predict(m, live))
+                    for idx, _supp in live:
+                        preds[idx].append(got[idx])
+                    continue
+                except Exception:
+                    logger.exception(
+                        "batch_predict failed; falling back to per-query")
+            for idx, supp in live:
+                try:
+                    preds[idx].append(a.predict(m, supp))
+                except Exception as e:
+                    results[idx] = e
+        for idx, raw, query, _supp in parsed:
+            if results[idx] is not None:
+                continue
+            try:
+                # by design, serve sees the ORIGINAL query
+                # (CreateServer.scala:526)
+                prediction = serving.serve(query, preds[idx])
+                result = json_codec.to_jsonable(prediction)
+                if self.config.feedback:
+                    result = self._feedback(instance, raw, result)
+                for blocker in self.plugin_context.output_blockers.values():
+                    result = blocker.process(
+                        instance.engine_variant, raw, result,
+                        self.plugin_context)
+                for sniffer in self.plugin_context.output_sniffers.values():
+                    try:
+                        sniffer.process(
+                            instance.engine_variant, raw, result,
+                            self.plugin_context)
+                    except Exception:
+                        logger.exception("output sniffer failed")
+                results[idx] = result
+            except Exception as e:
+                results[idx] = e
         dt = time.perf_counter() - t0
         with self._lock:
-            self.request_count += 1
+            # every query in the batch took dt wall-clock (they shared one
+            # dispatch) — the counters keep CreateServer.scala:611-618
+            # per-query semantics
+            self.request_count += n
             self.avg_serving_sec = (
-                self.avg_serving_sec * (self.request_count - 1) + dt
+                self.avg_serving_sec * (self.request_count - n) + dt * n
             ) / self.request_count
             self.last_serving_sec = dt
-        return result
+            self.max_batch_served = max(self.max_batch_served, n)
+        return results
 
     def _feedback(
         self, instance: EngineInstance, query_json: Any, prediction_json: Any
@@ -275,6 +391,7 @@ class PredictionServer:
                     "requestCount": self.request_count,
                     "avgServingSec": self.avg_serving_sec,
                     "lastServingSec": self.last_serving_sec,
+                    "maxBatchServed": self.max_batch_served,
                 }
             accept = request.headers.get("accept", "")
             if "text/html" in accept:
@@ -293,9 +410,17 @@ class PredictionServer:
             return Response(200, info)
 
         @r.post("/queries.json")
-        def queries(request: Request) -> Response:
+        async def queries(request: Request) -> Response:
+            import asyncio
+
+            from incubator_predictionio_tpu.utils.http import sync
+
             try:
-                result = self._handle_query(request.body)
+                if self._batcher is not None:
+                    result = await asyncio.wrap_future(
+                        self._batcher.submit(request.body))
+                else:
+                    result = await sync(self._handle_query, request.body)
             except HttpError:
                 raise
             except (ValueError, KeyError) as e:
@@ -311,7 +436,7 @@ class PredictionServer:
         @r.post("/stop")
         def stop_route(request: Request) -> Response:
             self._check_server_key(request)
-            threading.Timer(0.2, self.http.stop).start()
+            threading.Timer(0.2, self.stop).start()
             return Response(200, {"message": "Shutting down."})
 
         @r.get("/plugins.json")
@@ -353,6 +478,8 @@ class PredictionServer:
         await self.http.serve_forever()
 
     def stop(self) -> None:
+        if self._batcher is not None:
+            self._batcher.stop()
         self.http.stop()
 
 

@@ -5,21 +5,37 @@ MODELDATA repository (CoreWorkflow.scala:76-81, CreateServer.scala:73-87
 KryoInstantiator). Device arrays are converted to host numpy on save and
 restored as numpy on load; they migrate back to the TPU (with the serving
 sharding) the first time a jitted predict touches them, or explicitly via
-:func:`device_restore`.
+:func:`device_restore` / ``Algorithm.prepare_model``.
+
+Format (version 2): a magic header + **msgpack of a structural encoding** —
+plain JSON-ish values pass through, numpy/jax arrays become
+(dtype, shape, raw bytes) tags, and model objects are encoded as
+dataclass-field maps reconstructed through their constructors. Loading
+never executes embedded code: the only import the decoder performs is the
+named dataclass type, and it refuses anything that is not a dataclass —
+the arbitrary-callable gadget surface of pickle does not exist here.
+(The reference inherits the same class of risk through Kryo's
+class-name-driven instantiation.)
+
+Version-1 blobs (pickle) still load for backward compatibility, with a
+loud warning; set ``PIO_ALLOW_PICKLE_CHECKPOINTS=0`` to refuse them.
 
 The reference's three model classes (SURVEY.md §5 checkpoint/resume):
 serializable models → stored as-is; RDD models → stored as Unit + silently
-retrained at deploy; PersistentModel → custom save/load. Here: pytrees are
-always storable, :class:`~...core.persistent_model.RetrainMarker` makes the
-retrain path explicit, and PersistentModel keeps its contract.
+retrained at deploy; PersistentModel → custom save/load. Here: dataclass /
+pytree models are storable, :class:`~...core.persistent_model.RetrainMarker`
+makes the retrain path explicit, and PersistentModel keeps its contract.
 """
 
 from __future__ import annotations
 
-import io
+import dataclasses
+import importlib
 import logging
+import os
 import pickle
-from typing import Any, List, Optional
+from datetime import datetime
+from typing import Any, Dict, List, Optional
 
 from incubator_predictionio_tpu.core.persistent_model import (
     PersistentModel,
@@ -29,44 +45,174 @@ from incubator_predictionio_tpu.parallel.context import RuntimeContext
 
 logger = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 1
+_MAGIC_V2 = b"PIOCKPT2"
+_FORMAT_VERSION = 2
+
+#: structural tag key — a reserved dict key marking an encoded object
+_TAG = "~pio~"
 
 
-def _np(obj: Any):
+class CheckpointError(ValueError):
+    """A model (or blob) outside the safe checkpoint format."""
+
+
+# ---------------------------------------------------------------------------
+# structural encode / decode
+# ---------------------------------------------------------------------------
+
+def _is_jax_array(obj: Any) -> bool:
+    try:
+        import jax
+
+        return isinstance(obj, jax.Array)
+    except Exception:  # pragma: no cover - jax always present
+        return False
+
+
+def _encode(obj: Any) -> Any:
     import numpy as np
 
-    return np.asarray(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if _is_jax_array(obj):
+        obj = np.asarray(obj)
+    if isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        return {_TAG: "nd", "d": a.dtype.str, "s": list(a.shape),
+                "b": a.tobytes()}
+    if isinstance(obj, np.generic):  # numpy scalar
+        return {_TAG: "npv", "d": obj.dtype.str, "b": obj.tobytes()}
+    if isinstance(obj, tuple):
+        return {_TAG: "tu", "v": [_encode(x) for x in obj]}
+    if isinstance(obj, list):
+        return [_encode(x) for x in obj]
+    if isinstance(obj, (set, frozenset)):
+        return {_TAG: "set", "f": isinstance(obj, frozenset),
+                "v": [_encode(x) for x in obj]}
+    if isinstance(obj, datetime):
+        return {_TAG: "dt", "v": obj.isoformat()}
+    if isinstance(obj, dict):
+        if all(isinstance(k, str) for k in obj) and _TAG not in obj:
+            return {k: _encode(v) for k, v in obj.items()}
+        # non-string (or reserved) keys: encode as a pair list
+        return {_TAG: "map",
+                "v": [[_encode(k), _encode(v)] for k, v in obj.items()]}
+    from incubator_predictionio_tpu.data.bimap import BiMap
+
+    if isinstance(obj, BiMap):
+        return {_TAG: "bimap", "v": _encode(dict(obj.items()))}
+    from incubator_predictionio_tpu.data.datamap import DataMap
+
+    if isinstance(obj, DataMap) and type(obj) is DataMap:
+        return {_TAG: "dmap", "v": _encode(obj.to_jsonable())}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        cls = type(obj)
+        fields = {
+            f.name: _encode(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+        return {_TAG: "dc",
+                "c": f"{cls.__module__}:{cls.__qualname__}", "f": fields}
+    raise CheckpointError(
+        f"cannot checkpoint {type(obj).__module__}.{type(obj).__qualname__}: "
+        "models must be dataclasses / pytrees of arrays and plain values "
+        "(or implement PersistentModel for custom persistence)"
+    )
 
 
-def _restore_array(arr: Any) -> Any:
-    return arr  # numpy; device transfer happens lazily at first jit use
+def _resolve_dataclass(path: str) -> type:
+    mod_name, _, qual = path.partition(":")
+    try:
+        mod = importlib.import_module(mod_name)
+        cls: Any = mod
+        for part in qual.split("."):
+            cls = getattr(cls, part)
+    except Exception as e:
+        raise CheckpointError(f"cannot resolve model class {path!r}: {e}")
+    if not (isinstance(cls, type) and dataclasses.is_dataclass(cls)):
+        # the decoder only ever constructs dataclasses — anything else in
+        # the class slot is a malformed (or malicious) blob
+        raise CheckpointError(f"{path!r} is not a dataclass")
+    return cls
 
 
-class _ModelPickler(pickle.Pickler):
-    """Pickler that converts jax Arrays to host numpy on the way out."""
+def _decode(obj: Any) -> Any:
+    import numpy as np
 
-    def reducer_override(self, obj: Any):
-        try:
-            import jax
-        except Exception:  # pragma: no cover - jax always present
-            return NotImplemented
-        if isinstance(obj, jax.Array):
-            return (_restore_array, (_np(obj),))
-        return NotImplemented
+    if isinstance(obj, list):
+        return [_decode(x) for x in obj]
+    if not isinstance(obj, dict):
+        return obj
+    tag = obj.get(_TAG)
+    if tag is None:
+        return {k: _decode(v) for k, v in obj.items()}
+    if tag == "nd":
+        arr = np.frombuffer(obj["b"], dtype=np.dtype(obj["d"]))
+        return arr.reshape(obj["s"]).copy()  # writable, owned
+    if tag == "npv":
+        return np.frombuffer(obj["b"], dtype=np.dtype(obj["d"]))[0]
+    if tag == "tu":
+        return tuple(_decode(x) for x in obj["v"])
+    if tag == "set":
+        vals = (_decode(x) for x in obj["v"])
+        return frozenset(vals) if obj["f"] else set(vals)
+    if tag == "dt":
+        return datetime.fromisoformat(obj["v"])
+    if tag == "map":
+        return {_decode(k): _decode(v) for k, v in obj["v"]}
+    if tag == "bimap":
+        from incubator_predictionio_tpu.data.bimap import BiMap
 
+        return BiMap(_decode(obj["v"]))
+    if tag == "dmap":
+        from incubator_predictionio_tpu.data.datamap import DataMap
+
+        return DataMap(_decode(obj["v"]))
+    if tag == "dc":
+        cls = _resolve_dataclass(obj["c"])
+        fields = {k: _decode(v) for k, v in obj["f"].items()}
+        return cls(**fields)
+    raise CheckpointError(f"unknown checkpoint tag {tag!r}")
+
+
+# ---------------------------------------------------------------------------
+# blob API
+# ---------------------------------------------------------------------------
 
 def dumps(obj: Any) -> bytes:
-    buf = io.BytesIO()
-    _ModelPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(
-        (_FORMAT_VERSION, obj)
+    """Encode a model pytree into a version-2 checkpoint blob."""
+    import msgpack
+
+    payload = msgpack.packb(
+        {"version": _FORMAT_VERSION, "root": _encode(obj)},
+        use_bin_type=True,
     )
-    return buf.getvalue()
+    return _MAGIC_V2 + payload
 
 
 def loads(data: bytes) -> Any:
+    """Decode a checkpoint blob (v2 msgpack; v1 pickle with opt-out)."""
+    import msgpack
+
+    if data[: len(_MAGIC_V2)] == _MAGIC_V2:
+        doc = msgpack.unpackb(
+            data[len(_MAGIC_V2):], raw=False, strict_map_key=False)
+        if doc.get("version") != _FORMAT_VERSION:
+            raise CheckpointError(
+                f"Unsupported model blob version {doc.get('version')}")
+        return _decode(doc["root"])
+    # ---- legacy v1: pickle ----
+    if os.environ.get("PIO_ALLOW_PICKLE_CHECKPOINTS", "1") == "0":
+        raise CheckpointError(
+            "legacy pickle checkpoint refused "
+            "(PIO_ALLOW_PICKLE_CHECKPOINTS=0); retrain to re-checkpoint "
+            "in the safe format")
+    logger.warning(
+        "loading a legacy v1 (pickle) model checkpoint — retrain to "
+        "upgrade it to the safe msgpack format")
     version, obj = pickle.loads(data)
-    if version != _FORMAT_VERSION:
-        raise ValueError(f"Unsupported model blob version {version}")
+    if version != 1:
+        raise CheckpointError(f"Unsupported model blob version {version}")
     return obj
 
 
@@ -103,7 +249,7 @@ def serialize_models(
 def deserialize_models(data: bytes) -> List[Any]:
     models = loads(data)
     if not isinstance(models, list):
-        raise ValueError("Model blob does not contain a model list")
+        raise CheckpointError("Model blob does not contain a model list")
     return models
 
 

@@ -173,3 +173,59 @@ def test_plugins_listing(stack):
     status, body = call(port, "GET", "/plugins.json")
     assert status == 200
     assert "RewritingBlocker" in body["plugins"]["outputblockers"]
+
+
+def test_concurrent_queries_micro_batch(stack):
+    """Concurrent queries fuse into micro-batches (one batch_predict per
+    drain) and every client still gets ITS OWN result — no cross-wiring.
+    The reference serves queries strictly one-at-a-time
+    (CreateServer.scala:523 'TODO: Parallelize')."""
+    import threading
+
+    ps, port, _es, _esp = stack
+    n_clients, per_client = 16, 4
+    errors = []
+
+    def client(cid):
+        for j in range(per_client):
+            qx = cid * 1000 + j
+            status, body = call(port, "POST", "/queries.json", {"qx": qx})
+            if status != 200 or body.get("qx") != qx:
+                errors.append((cid, j, status, body))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    status, info = call(port, "GET", "/")
+    assert info["requestCount"] == n_clients * per_client
+    # under 16-way concurrency at least one drain must have fused >1 query
+    assert info["maxBatchServed"] > 1
+
+
+def test_batch_isolates_bad_queries(stack):
+    """A malformed query inside a fused batch 400s alone; batchmates
+    succeed."""
+    import threading
+
+    ps, port, _es, _esp = stack
+    results = {}
+
+    def good(i):
+        results[i] = call(port, "POST", "/queries.json", {"qx": i})
+
+    def bad():
+        results["bad"] = call(port, "POST", "/queries.json", {"bogus": 1})
+
+    threads = [threading.Thread(target=good, args=(i,)) for i in range(8)]
+    threads.append(threading.Thread(target=bad))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results["bad"][0] == 400
+    for i in range(8):
+        assert results[i][0] == 200 and results[i][1]["qx"] == i

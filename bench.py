@@ -44,13 +44,13 @@ RANK = int(os.environ.get("PIO_BENCH_RANK", 128))
 ITERATIONS = int(os.environ.get("PIO_BENCH_SWEEPS", 10))
 L2 = 0.1
 
-#: Measured on this image's host CPU (single core, JAX CPU backend, warm
-#: compile cache) via `python bench.py --cpu` — the stand-in for the
-#: reference's single-box Spark-MLlib driver (Spark 1.4 cannot run here;
-#: historically it is far slower than a native CPU solver, so this bar is
-#: conservative). Value = warm fused-train wall-clock at the full ML-20M
-#: shape above.
-CPU_BASELINE_TRAIN_S = float(os.environ.get("PIO_BENCH_CPU_BASELINE", 760.0))
+#: Measured on this image's host CPU (JAX CPU backend, warm compile cache)
+#: via `python bench.py --cpu` — the stand-in for the reference's
+#: single-box Spark-MLlib driver (Spark 1.4 cannot run here; historically
+#: it is far slower than a native CPU solver, so this bar is conservative).
+#: Value = warm fused-train wall-clock at the full ML-20M shape above with
+#: the same CG solver (measured 2026-07-29).
+CPU_BASELINE_TRAIN_S = float(os.environ.get("PIO_BENCH_CPU_BASELINE", 621.8))
 
 #: TPU v5e peak: 197 TFLOP/s bf16 / ~98.5 TFLOP/s fp32 on the MXU. The
 #: solver's Gram assembly runs f32 at HIGHEST precision, so the honest
@@ -83,13 +83,21 @@ def als_flops_per_run() -> float:
     Per half-sweep over `nnz` observations with rank K: the Gram batch is
     2·nnz·K² MACs = 4·nnz·K² FLOPs at HIGHEST precision (the f32 multi-pass
     costs ~3× a bf16 pass; counted at face value — conservative), the rhs
-    2·nnz·K, and each of the `rows` Cholesky solves ~K³/3 + 2K² FLOPs.
-    Both sides per sweep, ITERATIONS sweeps.
+    2·nnz·K, and each of the `rows` CG solves ~iters·2·K² FLOPs (the
+    batched-matvec Jacobi-PCG in ops/als.py — about the same count as a
+    direct K³/3 Cholesky at K=128, iters=32). Both sides per sweep,
+    ITERATIONS sweeps.
     """
+    from incubator_predictionio_tpu.ops import als
+
     k = float(RANK)
     per_side_gram = 2.0 * NNZ * k * k * 2.0   # multiply+add
     per_side_rhs = 2.0 * NNZ * k
-    solves = (N_USERS + N_ITEMS) * (k ** 3 / 3.0 + 2.0 * k * k)
+    if als._SOLVER == "cg":
+        per_solve = als._CG_ITERS * 2.0 * k * k
+    else:
+        per_solve = k ** 3 / 3.0 + 2.0 * k * k
+    solves = (N_USERS + N_ITEMS) * per_solve
     per_sweep = 2.0 * per_side_gram + 2.0 * per_side_rhs + solves
     return per_sweep * ITERATIONS
 
@@ -180,7 +188,12 @@ def run(platform_cpu: bool = False) -> None:
             state0, u_tree, i_tree, L2, 0.0, ITERATIONS, True,
             jnp.float32, jax.lax.Precision.HIGHEST, implicit=False,
             user_heavy=u_hv, item_heavy=i_hv)
-        jax.block_until_ready(out.user_factors)
+        # sync via a dependent 1-element device fetch: on the tunneled
+        # platform jax.block_until_ready returns before execution finishes
+        # (verified empirically), which silently turns the timer into a
+        # dispatch-latency measurement
+        np.asarray(out.user_factors[0:1, 0:1])
+        np.asarray(out.item_factors[0:1, 0:1])
         return out
 
     t0 = time.perf_counter()
@@ -340,7 +353,12 @@ def bench_serving(state, inter):
     # concurrent: 32 clients; the micro-batcher fuses them
     n_clients = 32
     per_client = int(os.environ.get("PIO_BENCH_SERVE_CONC", 25))
-    # warm the batched kernel shapes (powers of two up to 32)
+    # warm the batched kernel shapes (powers of two up to 32) so the
+    # concurrent window measures serving, not XLA compiles
+    from incubator_predictionio_tpu.models.recommendation.engine import Query
+    for size in (1, 2, 4, 8, 16, 32):
+        algo.batch_predict(model, [
+            (i, Query(user=f"u{i % N_USERS}", num=10)) for i in range(size)])
     errors = []
 
     def client(cid: int) -> None:

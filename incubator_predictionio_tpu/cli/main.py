@@ -130,6 +130,16 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("dashboard", help="start the evaluation dashboard")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=9000)
+    p = sub.add_parser(
+        "storageserver",
+        help="export this box's storage source to other boxes "
+             "(point their PIO_STORAGE_SOURCES_<N>_TYPE=remote at it)")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7077)
+    p.add_argument("--source", default="DEFAULT",
+                   help="which PIO_STORAGE_SOURCES_<NAME> to export")
+    p.add_argument("--auth-key", default=None,
+                   help="shared key clients must send (X-Pio-Storage-Key)")
 
     # -- data --------------------------------------------------------------
     p = sub.add_parser("export", help="export app events to JSON lines")
@@ -357,6 +367,18 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
 
         server = DashboardServer(args.ip, args.port)
         print(f"Dashboard running on http://{args.ip}:{args.port}")
+        asyncio.run(server.serve_forever())
+        return 0
+
+    if cmd == "storageserver":
+        from incubator_predictionio_tpu.data.storage.server import (
+            StorageServer,
+        )
+
+        server = StorageServer.from_env(
+            source=args.source, host=args.ip, port=args.port,
+            auth_key=args.auth_key)
+        print(f"Storage Server running on http://{args.ip}:{args.port}")
         asyncio.run(server.serve_forever())
         return 0
 

@@ -59,6 +59,9 @@ _BACKENDS: Dict[str, str] = {
     "localfs": "incubator_predictionio_tpu.data.storage.localfs",
     # native append-only event log (the HBase-driver role; events only)
     "cpplog": "incubator_predictionio_tpu.data.storage.cpplog",
+    # network client for a shared StorageServer (the multi-box topology —
+    # the role PostgreSQL/HBase play for the reference)
+    "remote": "incubator_predictionio_tpu.data.storage.remote",
 }
 
 MetaDataRepository = "METADATA"

@@ -86,24 +86,32 @@ class StorageClient(base.BaseStorageClient):
         if self.auth_key:
             headers["X-Pio-Storage-Key"] = self.auth_key
         conn = self._conn()
-        for attempt in (0, 1):
+        # Only idempotent methods retry after a connection failure: a write
+        # like insert/import may already have executed server-side when the
+        # response is lost, and silently re-sending it would commit the
+        # payload twice. Non-idempotent calls surface the indeterminate
+        # state to the caller instead.
+        retries = (0, 1) if method in _IDEMPOTENT else (0,)
+        for attempt in retries:
             try:
                 conn.request("POST", "/rpc", body=body, headers=headers)
                 resp = conn.getresponse()
                 payload = resp.read()
                 break
-            except (http.client.HTTPException, ConnectionError, OSError):
-                # stale keep-alive connection: reconnect once
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                # stale keep-alive connection: reconnect (and retry if safe)
                 conn.close()
-                if attempt:
+                if attempt == retries[-1]:
                     raise _storage_error()(
-                        f"storage server {self.host}:{self.port} unreachable")
+                        f"storage server {self.host}:{self.port} failed "
+                        f"during {iface}.{method} ({e!r})"
+                        + ("" if method in _IDEMPOTENT else
+                           "; the call is not idempotent — it may or may "
+                           "not have been applied"))
         msg = wire.unpack(payload)
         if msg.get("ok"):
             return msg.get("value")
         etype = _ERROR_TYPES.get(msg.get("etype")) or _storage_error()
-        if etype is None:
-            etype = _storage_error()
         raise etype(msg.get("error", "remote storage error"))
 
     def close(self) -> None:
@@ -117,7 +125,14 @@ class StorageClient(base.BaseStorageClient):
         self._local = threading.local()
 
 
-_ERROR_TYPES["StorageError"] = None  # resolved lazily in rpc()
+#: methods safe to re-send after a lost response (reads, and writes whose
+#: re-execution is a no-op: init/remove/delete/update are last-wins or
+#: existence-keyed; insert/insert_batch/import_interactions are NOT)
+_IDEMPOTENT = frozenset({
+    "init", "remove", "get", "get_by_name", "get_all", "get_by_appid",
+    "get_latest_completed", "get_completed", "find", "aggregate_properties",
+    "scan_interactions", "delete", "update",
+})
 
 
 class _RemoteDAO:
@@ -149,7 +164,28 @@ def _proxy(iface: str, base_cls: type, methods: Tuple[str, ...],
 
 
 def _events_find(self, *args: Any, **kwargs: Any) -> Iterator:
-    return iter(self._call("find", *args, **kwargs))
+    """Lazy, chunked find: the server streams FIND_CHUNK-sized pages
+    through a cursor (server.py _find_rpc), so a 20M-event export never
+    materializes on either side."""
+    def gen() -> Iterator:
+        msg = self._call("find_open", *args, **kwargs)
+        cursor = msg["cursor"]
+        try:
+            while True:
+                for event in msg["events"]:
+                    yield event
+                if msg["done"]:
+                    cursor = ""
+                    return
+                msg = self._call("find_next", cursor)
+        finally:
+            if cursor:  # abandoned mid-iteration: free the server cursor
+                try:
+                    self._call("find_close", cursor)
+                except Exception:
+                    pass
+
+    return gen()
 
 
 def _events_close(self) -> None:  # connection is client-owned
@@ -162,6 +198,9 @@ RemoteEvents = _proxy(
      "aggregate_properties", "scan_interactions", "import_interactions"),
     extra={"find": _events_find, "close": _events_close},
 )
+#: cursor pulls are idempotent-safe to NOT retry (state lives server-side);
+#: find_open/find_close are read-only and retryable
+_IDEMPOTENT = _IDEMPOTENT | {"find_open", "find_close"}
 RemoteApps = _proxy(
     "Apps", base.Apps,
     ("insert", "get", "get_by_name", "get_all", "update", "delete"))

@@ -36,11 +36,15 @@ from incubator_predictionio_tpu.utils.http import (
 logger = logging.getLogger(__name__)
 
 #: iface → methods callable over RPC (the full DAO surface; everything
-#: else 404s, so the server's attack surface is exactly this table)
+#: else 404s, so the server's attack surface is exactly this table).
+#: ``find`` is served through the cursor protocol (find_open / find_next /
+#: find_close) so a training-scale result set streams in bounded chunks
+#: instead of materializing one multi-GB response.
 _ALLOWED: Dict[str, Tuple[str, ...]] = {
     "Events": (
         "init", "remove", "insert", "insert_batch", "get", "delete",
-        "find", "aggregate_properties", "scan_interactions",
+        "find_open", "find_next", "find_close",
+        "aggregate_properties", "scan_interactions",
         "import_interactions",
     ),
     "Apps": ("insert", "get", "get_by_name", "get_all", "update", "delete"),
@@ -64,6 +68,13 @@ _ERROR_TYPES = {
 }
 
 
+#: events per find_next chunk — bounds both sides' memory per round trip
+FIND_CHUNK = 5000
+#: open cursors kept server-side; oldest evicted beyond this (a client that
+#: abandons iteration mid-way cannot pin server memory forever)
+MAX_CURSORS = 64
+
+
 class StorageServer:
     """One backing backend (module, client, config) exported over HTTP."""
 
@@ -82,6 +93,8 @@ class StorageServer:
         self.auth_key = auth_key
         self._daos: Dict[Tuple[str, str], Any] = {}
         self._lock = threading.Lock()
+        self._cursors: Dict[str, Any] = {}   # insertion-ordered
+        self._cursor_seq = 0
         self.http = HttpServer.from_conf(self._router(), host, port)
 
     @classmethod
@@ -134,10 +147,11 @@ class StorageServer:
                     raise StorageError(
                         f"method {iface}.{method} is not exported")
                 dao = self._dao(iface, msg.get("prefix", ""))
-                value = getattr(dao, method)(
-                    *msg.get("args", ()), **msg.get("kwargs", {}))
-                if iface == "Events" and method == "find":
-                    value = list(value)  # materialize the iterator
+                if method.startswith("find_"):
+                    value = self._find_rpc(dao, method, msg)
+                else:
+                    value = getattr(dao, method)(
+                        *msg.get("args", ()), **msg.get("kwargs", {}))
                 return _packed({"ok": True, "value": value})
             except Exception as e:  # error crosses the wire, typed
                 etype = type(e).__name__
@@ -148,6 +162,49 @@ class StorageServer:
                                 "error": str(e)})
 
         return r
+
+    # -- find cursor protocol ---------------------------------------------
+    def _find_rpc(self, dao: Any, method: str, msg: Dict[str, Any]) -> Any:
+        """Streamed Events.find: open runs the backend query and returns the
+        first chunk + a cursor; next pulls more; close releases early."""
+        import itertools
+
+        if method == "find_open":
+            it = iter(dao.find(*msg.get("args", ()), **msg.get("kwargs", {})))
+            events = list(itertools.islice(it, FIND_CHUNK))
+            done = len(events) < FIND_CHUNK
+            cursor = ""
+            if not done:
+                with self._lock:
+                    self._cursor_seq += 1
+                    cursor = f"c{self._cursor_seq}"
+                    self._cursors[cursor] = it
+                    while len(self._cursors) > MAX_CURSORS:
+                        evicted = next(iter(self._cursors))
+                        del self._cursors[evicted]
+                        logger.warning(
+                            "evicted abandoned find cursor %s", evicted)
+            return {"cursor": cursor, "events": events, "done": done}
+        cursor = msg.get("args", [""])[0]
+        if method == "find_close":
+            with self._lock:
+                self._cursors.pop(cursor, None)
+            return None
+        # pop while pulling: backend iterators are not thread-safe, so a
+        # concurrent find_next on the same cursor sees "unknown cursor"
+        # instead of a torn read
+        with self._lock:
+            it = self._cursors.pop(cursor, None)
+        if it is None:
+            raise StorageError(
+                f"unknown find cursor {cursor!r} (expired, evicted, or "
+                "pulled concurrently); re-issue the find")
+        events = list(itertools.islice(it, FIND_CHUNK))
+        done = len(events) < FIND_CHUNK
+        if not done:
+            with self._lock:
+                self._cursors[cursor] = it
+        return {"cursor": cursor, "events": events, "done": done}
 
     # -- lifecycle ---------------------------------------------------------
     def start_background(self) -> int:

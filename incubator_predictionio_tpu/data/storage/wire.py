@@ -4,10 +4,11 @@ The reference's deployment story runs every server against shared network
 services (PostgreSQL/HBase/Elasticsearch — data/.../storage/jdbc/
 StorageClient.scala:35-60); the drivers speak those services' own wire
 protocols. This framework's network backend speaks its own compact
-protocol instead: msgpack framing with explicit tags for the storage
-record types. The decoder constructs ONLY the fixed record types in
-``_RECORDS`` plus a handful of structural tags — there is no class-name
-resolution and no code execution on decode.
+protocol instead: msgpack framing over the shared structural codec
+(utils/structcodec.py) with explicit tags for the storage record types.
+The decoder constructs ONLY the fixed record types in ``_RECORDS`` plus
+the structural tags — there is no class-name resolution and no code
+execution on decode.
 
 Numpy arrays (and the columnar :class:`Interactions` / :class:`IdTable`
 forms) travel as raw dtype+shape+bytes, so a training-scale scan crosses
@@ -24,6 +25,7 @@ from incubator_predictionio_tpu.data.datamap import DataMap, PropertyMap
 from incubator_predictionio_tpu.data.event import Event
 from incubator_predictionio_tpu.data.storage import base
 from incubator_predictionio_tpu.data.storage.base import UNSET
+from incubator_predictionio_tpu.utils.structcodec import StructCodec
 
 _TAG = "~t~"
 
@@ -45,65 +47,36 @@ class WireError(ValueError):
     """Malformed wire payload."""
 
 
-def encode(obj: Any) -> Any:
-    import numpy as np
-
-    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
-        return obj
+def _encode_ext(obj: Any, codec: StructCodec) -> Any:
     if obj is UNSET:
         return {_TAG: "unset"}
-    if isinstance(obj, datetime):
-        return {_TAG: "dt", "v": obj.isoformat()}
     if isinstance(obj, Event):
         return {_TAG: "event", "v": obj.to_jsonable()}
-    if isinstance(obj, PropertyMap):
+    if isinstance(obj, PropertyMap):  # before the structural DataMap rule
         return {_TAG: "pmap", "v": obj.to_jsonable(),
                 "a": obj.first_updated.isoformat(),
                 "z": obj.last_updated.isoformat()}
-    if isinstance(obj, DataMap):
-        return {_TAG: "dmap", "v": obj.to_jsonable()}
-    if isinstance(obj, np.ndarray):
-        a = np.ascontiguousarray(obj)
-        return {_TAG: "nd", "d": a.dtype.str, "s": list(a.shape),
-                "b": a.tobytes()}
     if isinstance(obj, base.IdTable):
-        return {_TAG: "idt", "b": obj.blob, "o": encode(obj.offsets)}
+        return {_TAG: "idt", "b": obj.blob, "o": codec.encode(obj.offsets)}
     if isinstance(obj, base.Interactions):
-        return {_TAG: "inter", "u": encode(obj.user_idx),
-                "i": encode(obj.item_idx), "v": encode(obj.values),
-                "uids": encode(obj.user_ids), "iids": encode(obj.item_ids)}
+        return {_TAG: "inter", "u": codec.encode(obj.user_idx),
+                "i": codec.encode(obj.item_idx),
+                "v": codec.encode(obj.values),
+                "uids": codec.encode(obj.user_ids),
+                "iids": codec.encode(obj.item_ids)}
     cls_name = _RECORD_NAMES.get(type(obj))
     if cls_name is not None:
         fields = {
-            f.name: encode(getattr(obj, f.name))
+            f.name: codec.encode(getattr(obj, f.name))
             for f in dataclasses.fields(obj)
         }
         return {_TAG: "rec", "c": cls_name, "f": fields}
-    if isinstance(obj, (list, tuple)):
-        return {_TAG: "tu", "v": [encode(x) for x in obj]} \
-            if isinstance(obj, tuple) else [encode(x) for x in obj]
-    if isinstance(obj, dict):
-        if all(isinstance(k, str) for k in obj) and _TAG not in obj:
-            return {k: encode(v) for k, v in obj.items()}
-        return {_TAG: "map",
-                "v": [[encode(k), encode(v)] for k, v in obj.items()]}
-    raise WireError(f"cannot encode {type(obj).__qualname__} on the wire")
+    return NotImplemented
 
 
-def decode(obj: Any) -> Any:
-    import numpy as np
-
-    if isinstance(obj, list):
-        return [decode(x) for x in obj]
-    if not isinstance(obj, dict):
-        return obj
-    tag = obj.get(_TAG)
-    if tag is None:
-        return {k: decode(v) for k, v in obj.items()}
+def _decode_ext(tag: str, obj: dict, codec: StructCodec) -> Any:
     if tag == "unset":
         return UNSET
-    if tag == "dt":
-        return datetime.fromisoformat(obj["v"])
     if tag == "event":
         return Event.from_jsonable(obj["v"])
     if tag == "pmap":
@@ -111,28 +84,30 @@ def decode(obj: Any) -> Any:
             obj["v"],
             first_updated=datetime.fromisoformat(obj["a"]),
             last_updated=datetime.fromisoformat(obj["z"]))
-    if tag == "dmap":
-        return DataMap(obj["v"])
-    if tag == "nd":
-        arr = np.frombuffer(obj["b"], dtype=np.dtype(obj["d"]))
-        return arr.reshape(obj["s"]).copy()
     if tag == "idt":
-        return base.IdTable(obj["b"], decode(obj["o"]))
+        return base.IdTable(obj["b"], codec.decode(obj["o"]))
     if tag == "inter":
         return base.Interactions(
-            user_idx=decode(obj["u"]), item_idx=decode(obj["i"]),
-            values=decode(obj["v"]), user_ids=decode(obj["uids"]),
-            item_ids=decode(obj["iids"]))
+            user_idx=codec.decode(obj["u"]), item_idx=codec.decode(obj["i"]),
+            values=codec.decode(obj["v"]), user_ids=codec.decode(obj["uids"]),
+            item_ids=codec.decode(obj["iids"]))
     if tag == "rec":
         cls = _RECORDS.get(obj["c"])
         if cls is None:
             raise WireError(f"unknown record type {obj['c']!r}")
-        return cls(**{k: decode(v) for k, v in obj["f"].items()})
-    if tag == "tu":
-        return tuple(decode(x) for x in obj["v"])
-    if tag == "map":
-        return {decode(k): decode(v) for k, v in obj["v"]}
-    raise WireError(f"unknown wire tag {tag!r}")
+        return cls(**{k: codec.decode(v) for k, v in obj["f"].items()})
+    return NotImplemented
+
+
+_CODEC = StructCodec(_TAG, WireError, _encode_ext, _decode_ext)
+
+
+def encode(obj: Any) -> Any:
+    return _CODEC.encode(obj)
+
+
+def decode(obj: Any) -> Any:
+    return _CODEC.decode(obj)
 
 
 def pack(obj: Any) -> bytes:

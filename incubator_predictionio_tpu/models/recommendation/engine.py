@@ -545,12 +545,14 @@ class ALSAlgorithm(Algorithm):
             k = min(max(q.num for _qx, q in plain), len(model.item_bimap))
             rows = [model.user_bimap[q.user] for _qx, q in plain]
             host = host_arrays(model, "user_factors", "item_factors")
-            if host is not None and len(plain) <= 4:
-                # small model + tiny batch: the host matvec beats a
-                # device round trip; larger batches amortize the dispatch
+            if host is not None:
+                # model small enough for a host copy: one [B,K]@[K,I] numpy
+                # matmul is a few ms at any batch size, always under the
+                # device dispatch+fetch round trip such a model would pay
                 np_users, np_items = host
-                for (qx, q), row in zip(plain, rows):
-                    top_s, top_i = host_top_k(np_items @ np_users[row], k)
+                all_scores = np_users[rows] @ np_items.T
+                for row, (qx, q) in enumerate(plain):
+                    top_s, top_i = host_top_k(all_scores[row], k)
                     out.append((qx, self._pack_scores(
                         model, top_s[: q.num], top_i[: q.num])))
             else:

@@ -121,15 +121,20 @@ def batch_score_top_k(
 
     The serving micro-batcher's compute path (the reference leaves this as
     "TODO: Parallelize", CreateServer.scala:523): one [B, K] × [K, I] matmul
-    amortizes the device round trip over the whole batch. ``rows`` is padded
-    to the next power of two (row 0 repeated) so the jit compiles
-    O(log max-batch) times total; callers slice row b of the packed
-    [2, B_pad, k] result."""
+    amortizes the device round trip over the whole batch. BOTH static shape
+    inputs are padded to the next power of two — ``rows`` with row 0
+    repeated, ``k`` capped at the catalog — so live traffic with varying
+    batch sizes AND varying ``num`` compiles O(log max-batch · log catalog)
+    variants total instead of one per distinct (B, num) pair. Callers slice
+    row b of the packed [2, B_pad, k_pad] result to their own ``num``."""
     B = len(rows)
     pad = 1 << max(B - 1, 0).bit_length()
+    n_items = item_factors.shape[0]
+    k_pad = min(1 << max(int(k) - 1, 0).bit_length(), n_items)
     rows_arr = jnp.asarray(
         list(rows) + [rows[0]] * (pad - B), jnp.int32)
-    return _batch_score_top_k_xla(user_factors, item_factors, rows_arr, k)
+    return _batch_score_top_k_xla(user_factors, item_factors, rows_arr,
+                                  k_pad)
 
 
 def score_and_top_k(

@@ -264,8 +264,13 @@ class PredictionServer:
             if len(live) > 1:
                 try:
                     got = dict(a.batch_predict(m, live))
-                    for idx, _supp in live:
-                        preds[idx].append(got[idx])
+                    # all-or-nothing: resolve every idx BEFORE mutating
+                    # preds, so a partial batch_predict result (missing
+                    # idx → KeyError here) falls through to the per-query
+                    # path without leaving duplicate appends behind
+                    vals = [got[idx] for idx, _supp in live]
+                    for (idx, _supp), v in zip(live, vals):
+                        preds[idx].append(v)
                     continue
                 except Exception:
                     logger.exception(

@@ -1,0 +1,144 @@
+"""Shared structural value codec — one tagged encoding, two consumers.
+
+Both durable formats in the framework encode the same structural core —
+numpy/jax arrays as (dtype, shape, bytes), datetimes, tuples, sets,
+non-string-keyed maps, DataMap/BiMap — under a reserved tag key:
+
+- model checkpoints (workflow/checkpoint.py, tag ``~pio~``), which add an
+  open-but-guarded dataclass tag resolved only from imported modules;
+- the remote-storage wire protocol (data/storage/wire.py, tag ``~t~``),
+  which adds a CLOSED table of storage record types plus Event/Interactions
+  forms.
+
+This module is the single implementation of the shared core so the two
+formats cannot drift (they had already diverged once: numpy scalars
+round-tripped through checkpoints but raised at the RPC boundary).
+Decoding constructs only fixed structural types here; anything
+type-resolving (dataclasses, records) lives in the consumers' extension
+hooks with their own security posture.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Callable, Optional
+
+#: extension hook signatures — return NotImplemented to fall through
+EncodeExt = Callable[[Any, "StructCodec"], Any]
+DecodeExt = Callable[[str, dict, "StructCodec"], Any]
+
+
+def _is_jax_array(obj: Any) -> bool:
+    try:
+        import jax
+
+        return isinstance(obj, jax.Array)
+    except Exception:  # pragma: no cover - jax always present
+        return False
+
+
+class StructCodec:
+    """Structural encoder/decoder parameterized by tag key + extensions.
+
+    ``encode_ext`` runs before the structural rules (so a consumer can
+    claim its own types — e.g. PropertyMap before the DataMap rule);
+    ``decode_ext`` runs for any tag the structural rules don't own.
+    """
+
+    def __init__(
+        self,
+        tag_key: str,
+        error_cls: type = ValueError,
+        encode_ext: Optional[EncodeExt] = None,
+        decode_ext: Optional[DecodeExt] = None,
+    ):
+        self.tag = tag_key
+        self.error_cls = error_cls
+        self.encode_ext = encode_ext
+        self.decode_ext = decode_ext
+
+    # -- encode ------------------------------------------------------------
+    def encode(self, obj: Any) -> Any:
+        import numpy as np
+
+        if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+            return obj
+        if self.encode_ext is not None:
+            out = self.encode_ext(obj, self)
+            if out is not NotImplemented:
+                return out
+        tag = self.tag
+        if _is_jax_array(obj):
+            obj = np.asarray(obj)
+        if isinstance(obj, np.ndarray):
+            a = np.ascontiguousarray(obj)
+            return {tag: "nd", "d": a.dtype.str, "s": list(a.shape),
+                    "b": a.tobytes()}
+        if isinstance(obj, np.generic):  # numpy scalar
+            return {tag: "npv", "d": obj.dtype.str, "b": obj.tobytes()}
+        if isinstance(obj, tuple):
+            return {tag: "tu", "v": [self.encode(x) for x in obj]}
+        if isinstance(obj, list):
+            return [self.encode(x) for x in obj]
+        if isinstance(obj, (set, frozenset)):
+            return {tag: "set", "f": isinstance(obj, frozenset),
+                    "v": [self.encode(x) for x in obj]}
+        if isinstance(obj, datetime):
+            return {tag: "dt", "v": obj.isoformat()}
+        if isinstance(obj, dict):
+            if all(isinstance(k, str) for k in obj) and tag not in obj:
+                return {k: self.encode(v) for k, v in obj.items()}
+            # non-string (or reserved) keys: encode as a pair list
+            return {tag: "map",
+                    "v": [[self.encode(k), self.encode(v)]
+                          for k, v in obj.items()]}
+        from incubator_predictionio_tpu.data.bimap import BiMap
+
+        if isinstance(obj, BiMap):
+            return {tag: "bimap", "v": self.encode(dict(obj.items()))}
+        from incubator_predictionio_tpu.data.datamap import DataMap
+
+        if isinstance(obj, DataMap) and type(obj) is DataMap:
+            return {tag: "dmap", "v": self.encode(obj.to_jsonable())}
+        raise self.error_cls(
+            f"cannot encode {type(obj).__module__}.{type(obj).__qualname__}"
+        )
+
+    # -- decode ------------------------------------------------------------
+    def decode(self, obj: Any) -> Any:
+        import numpy as np
+
+        if isinstance(obj, list):
+            return [self.decode(x) for x in obj]
+        if not isinstance(obj, dict):
+            return obj
+        tag = obj.get(self.tag)
+        if tag is None:
+            return {k: self.decode(v) for k, v in obj.items()}
+        if tag == "nd":
+            arr = np.frombuffer(obj["b"], dtype=np.dtype(obj["d"]))
+            return arr.reshape(obj["s"]).copy()  # writable, owned
+        if tag == "npv":
+            return np.frombuffer(obj["b"], dtype=np.dtype(obj["d"]))[0]
+        if tag == "tu":
+            return tuple(self.decode(x) for x in obj["v"])
+        if tag == "set":
+            vals = (self.decode(x) for x in obj["v"])
+            return frozenset(vals) if obj["f"] else set(vals)
+        if tag == "dt":
+            return datetime.fromisoformat(obj["v"])
+        if tag == "map":
+            return {self.decode(k): self.decode(v) for k, v in obj["v"]}
+        if tag == "bimap":
+            from incubator_predictionio_tpu.data.bimap import BiMap
+
+            return BiMap(self.decode(obj["v"]))
+        if tag == "dmap":
+            from incubator_predictionio_tpu.data.datamap import DataMap
+
+            return DataMap(self.decode(obj["v"]))
+        if self.decode_ext is not None:
+            out = self.decode_ext(tag, obj, self)
+            if out is not NotImplemented:
+                return out
+        raise self.error_cls(f"unknown structural tag {tag!r}")

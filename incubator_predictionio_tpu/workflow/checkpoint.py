@@ -11,10 +11,11 @@ Format (version 2): a magic header + **msgpack of a structural encoding** —
 plain JSON-ish values pass through, numpy/jax arrays become
 (dtype, shape, raw bytes) tags, and model objects are encoded as
 dataclass-field maps reconstructed through their constructors. Loading
-never executes embedded code: the only import the decoder performs is the
-named dataclass type, and it refuses anything that is not a dataclass —
-the arbitrary-callable gadget surface of pickle does not exist here.
-(The reference inherits the same class of risk through Kryo's
+never executes embedded code: the decoder resolves model classes only from
+modules that are ALREADY imported (no import side effects; see
+``_resolve_dataclass``) and refuses anything that is not a dataclass — the
+arbitrary-callable gadget surface of pickle does not exist here. (The
+reference inherits a worse version of this risk through Kryo's
 class-name-driven instantiation.)
 
 Version-1 blobs (pickle) still load for backward compatibility, with a
@@ -34,7 +35,6 @@ import importlib
 import logging
 import os
 import pickle
-from datetime import datetime
 from typing import Any, Dict, List, Optional
 
 from incubator_predictionio_tpu.core.persistent_model import (
@@ -42,6 +42,7 @@ from incubator_predictionio_tpu.core.persistent_model import (
     PersistentModelManifest,
 )
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.utils.structcodec import StructCodec
 
 logger = logging.getLogger(__name__)
 
@@ -57,77 +58,66 @@ class CheckpointError(ValueError):
 
 
 # ---------------------------------------------------------------------------
-# structural encode / decode
+# structural encode / decode — the shared codec (utils/structcodec.py, same
+# core the remote-storage wire protocol uses) plus the dataclass tag
 # ---------------------------------------------------------------------------
 
-def _is_jax_array(obj: Any) -> bool:
-    try:
-        import jax
-
-        return isinstance(obj, jax.Array)
-    except Exception:  # pragma: no cover - jax always present
-        return False
-
-
-def _encode(obj: Any) -> Any:
-    import numpy as np
-
-    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
-        return obj
-    if _is_jax_array(obj):
-        obj = np.asarray(obj)
-    if isinstance(obj, np.ndarray):
-        a = np.ascontiguousarray(obj)
-        return {_TAG: "nd", "d": a.dtype.str, "s": list(a.shape),
-                "b": a.tobytes()}
-    if isinstance(obj, np.generic):  # numpy scalar
-        return {_TAG: "npv", "d": obj.dtype.str, "b": obj.tobytes()}
-    if isinstance(obj, tuple):
-        return {_TAG: "tu", "v": [_encode(x) for x in obj]}
-    if isinstance(obj, list):
-        return [_encode(x) for x in obj]
-    if isinstance(obj, (set, frozenset)):
-        return {_TAG: "set", "f": isinstance(obj, frozenset),
-                "v": [_encode(x) for x in obj]}
-    if isinstance(obj, datetime):
-        return {_TAG: "dt", "v": obj.isoformat()}
-    if isinstance(obj, dict):
-        if all(isinstance(k, str) for k in obj) and _TAG not in obj:
-            return {k: _encode(v) for k, v in obj.items()}
-        # non-string (or reserved) keys: encode as a pair list
-        return {_TAG: "map",
-                "v": [[_encode(k), _encode(v)] for k, v in obj.items()]}
-    from incubator_predictionio_tpu.data.bimap import BiMap
-
-    if isinstance(obj, BiMap):
-        return {_TAG: "bimap", "v": _encode(dict(obj.items()))}
-    from incubator_predictionio_tpu.data.datamap import DataMap
-
-    if isinstance(obj, DataMap) and type(obj) is DataMap:
-        return {_TAG: "dmap", "v": _encode(obj.to_jsonable())}
+def _encode_ext(obj: Any, codec: Any) -> Any:
+    # dataclass instances (the model pytree nodes) — checked here so the
+    # checkpoint error message stays domain-specific for everything else
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         cls = type(obj)
         fields = {
-            f.name: _encode(getattr(obj, f.name))
+            f.name: codec.encode(getattr(obj, f.name))
             for f in dataclasses.fields(obj)
         }
         return {_TAG: "dc",
                 "c": f"{cls.__module__}:{cls.__qualname__}", "f": fields}
-    raise CheckpointError(
-        f"cannot checkpoint {type(obj).__module__}.{type(obj).__qualname__}: "
-        "models must be dataclasses / pytrees of arrays and plain values "
-        "(or implement PersistentModel for custom persistence)"
-    )
+    return NotImplemented
+
+
+def _encode(obj: Any) -> Any:
+    try:
+        return _CODEC.encode(obj)
+    except CheckpointError as e:
+        raise CheckpointError(
+            f"{e}: models must be dataclasses / pytrees of arrays and "
+            "plain values (or implement PersistentModel for custom "
+            "persistence)"
+        ) from None
 
 
 def _resolve_dataclass(path: str) -> type:
+    """Resolve a model class from an ALREADY-IMPORTED module.
+
+    The decoder never imports new modules: importing runs the module's
+    top-level code, which would let a tampered blob execute an arbitrary
+    installed module as a side effect. Engine model classes are always
+    imported before models load (deploy resolves the engine factory first),
+    so a sys.modules miss means a truly foreign blob — refuse it unless the
+    operator opts in via ``PIO_CHECKPOINT_ALLOW_IMPORT=1``."""
+    import sys
+
     mod_name, _, qual = path.partition(":")
+    mod = sys.modules.get(mod_name)
+    if mod is None:
+        if os.environ.get("PIO_CHECKPOINT_ALLOW_IMPORT") == "1":
+            try:
+                mod = importlib.import_module(mod_name)
+            except Exception as e:
+                raise CheckpointError(
+                    f"cannot resolve model class {path!r}: {e}")
+        else:
+            raise CheckpointError(
+                f"model class {path!r} lives in a module that is not "
+                "imported; import your engine module before loading the "
+                "checkpoint (or set PIO_CHECKPOINT_ALLOW_IMPORT=1 to let "
+                "the loader import it)")
     try:
-        mod = importlib.import_module(mod_name)
         cls: Any = mod
         for part in qual.split("."):
             cls = getattr(cls, part)
-    except Exception as e:
+    except AttributeError as e:
         raise CheckpointError(f"cannot resolve model class {path!r}: {e}")
     if not (isinstance(cls, type) and dataclasses.is_dataclass(cls)):
         # the decoder only ever constructs dataclasses — anything else in
@@ -136,43 +126,19 @@ def _resolve_dataclass(path: str) -> type:
     return cls
 
 
-def _decode(obj: Any) -> Any:
-    import numpy as np
-
-    if isinstance(obj, list):
-        return [_decode(x) for x in obj]
-    if not isinstance(obj, dict):
-        return obj
-    tag = obj.get(_TAG)
-    if tag is None:
-        return {k: _decode(v) for k, v in obj.items()}
-    if tag == "nd":
-        arr = np.frombuffer(obj["b"], dtype=np.dtype(obj["d"]))
-        return arr.reshape(obj["s"]).copy()  # writable, owned
-    if tag == "npv":
-        return np.frombuffer(obj["b"], dtype=np.dtype(obj["d"]))[0]
-    if tag == "tu":
-        return tuple(_decode(x) for x in obj["v"])
-    if tag == "set":
-        vals = (_decode(x) for x in obj["v"])
-        return frozenset(vals) if obj["f"] else set(vals)
-    if tag == "dt":
-        return datetime.fromisoformat(obj["v"])
-    if tag == "map":
-        return {_decode(k): _decode(v) for k, v in obj["v"]}
-    if tag == "bimap":
-        from incubator_predictionio_tpu.data.bimap import BiMap
-
-        return BiMap(_decode(obj["v"]))
-    if tag == "dmap":
-        from incubator_predictionio_tpu.data.datamap import DataMap
-
-        return DataMap(_decode(obj["v"]))
+def _decode_ext(tag: str, obj: dict, codec: Any) -> Any:
     if tag == "dc":
         cls = _resolve_dataclass(obj["c"])
-        fields = {k: _decode(v) for k, v in obj["f"].items()}
+        fields = {k: codec.decode(v) for k, v in obj["f"].items()}
         return cls(**fields)
-    raise CheckpointError(f"unknown checkpoint tag {tag!r}")
+    return NotImplemented
+
+
+_CODEC = StructCodec(_TAG, CheckpointError, _encode_ext, _decode_ext)
+
+
+def _decode(obj: Any) -> Any:
+    return _CODEC.decode(obj)
 
 
 # ---------------------------------------------------------------------------

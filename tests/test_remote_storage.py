@@ -1,0 +1,243 @@
+"""Network storage backend: the multi-box deployment topology.
+
+The reference's deployment story is N servers sharing state through
+external services (PostgreSQL via jdbc/StorageClient.scala:35-60, HBase,
+Elasticsearch). Here the same topology runs through the framework's own
+StorageServer + ``remote`` backend: these tests prove (a) the registry
+resolves ``PIO_STORAGE_SOURCES_<N>_TYPE=remote``, (b) two independent
+clients see one store, (c) shared-key auth gates the RPC surface, and
+(d) a real ``pio storageserver`` child process serves a client in this
+process — the actual two-process topology, not a loopback simulation.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from datetime import timedelta
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import (
+    App,
+    Storage,
+    StorageClientConfig,
+    StorageError,
+)
+from incubator_predictionio_tpu.data.storage import memory as memory_backend
+from incubator_predictionio_tpu.data.storage import remote as remote_backend
+from incubator_predictionio_tpu.data.storage.server import StorageServer
+from incubator_predictionio_tpu.utils.times import parse_iso8601
+
+T0 = parse_iso8601("2022-01-01T00:00:00Z")
+
+
+@pytest.fixture
+def shared_server():
+    config = StorageClientConfig(test=True, properties={})
+    client = memory_backend.StorageClient(config)
+    srv = StorageServer(memory_backend, client, config,
+                        host="127.0.0.1", port=0)
+    port = srv.start_background()
+    yield srv, port
+    srv.stop()
+
+
+def _client(port, **props):
+    config = StorageClientConfig(
+        test=True, properties={"URL": f"http://127.0.0.1:{port}", **props})
+    return remote_backend.StorageClient(config)
+
+
+def ev(name, eid, minutes=0, target=None, props=None):
+    return Event(
+        event=name, entity_type="user", entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target, properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def test_two_clients_share_one_store(shared_server):
+    """Box A (eventserver) writes, box B (trainer) reads — one store."""
+    _srv, port = shared_server
+    box_a = _client(port)
+    box_b = _client(port)
+    try:
+        events_a = remote_backend.RemoteEvents(
+            box_a, box_a.config, prefix="pio_event_")
+        events_b = remote_backend.RemoteEvents(
+            box_b, box_b.config, prefix="pio_event_")
+        events_a.init(1)
+        events_a.insert(ev("rate", "u1", 0, target="i1",
+                           props={"rating": 4.0}), 1)
+        events_a.insert(ev("rate", "u2", 1, target="i1",
+                           props={"rating": 3.0}), 1)
+        got = list(events_b.find(app_id=1))
+        assert {e.entity_id for e in got} == {"u1", "u2"}
+        # columnar scan crosses the wire as array buffers
+        inter = events_b.scan_interactions(
+            app_id=1, entity_type="user", target_entity_type="item",
+            event_names=("rate",), value_prop="rating")
+        assert len(inter) == 2
+        assert list(inter.user_ids) == ["u1", "u2"]
+        assert inter.values.dtype == np.float32
+    finally:
+        box_a.close()
+        box_b.close()
+
+
+def test_metadata_and_models_over_the_wire(shared_server):
+    _srv, port = shared_server
+    client = _client(port)
+    try:
+        apps = remote_backend.RemoteApps(client, client.config,
+                                         prefix="pio_meta_")
+        app_id = apps.insert(App(id=0, name="remoteapp"))
+        assert apps.get_by_name("remoteapp").id == app_id
+        models = remote_backend.RemoteModels(client, client.config,
+                                             prefix="pio_model_")
+        from incubator_predictionio_tpu.data.storage import Model
+        models.insert(Model(id="m1", models=b"\x00\x01binary"))
+        assert models.get("m1").models == b"\x00\x01binary"
+    finally:
+        client.close()
+
+
+def test_registry_resolves_remote_type(shared_server):
+    _srv, port = shared_server
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_NET_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_NET_URL": f"http://127.0.0.1:{port}",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "pio_meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio_event",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "pio_model",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+    })
+    try:
+        assert Storage.verify_all_data_objects()
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.insert(App(id=0, name="viaregistry"))
+        assert Storage.get_meta_data_apps().get(app_id).name == "viaregistry"
+    finally:
+        Storage.reset()
+
+
+def test_auth_key_required():
+    config = StorageClientConfig(test=True, properties={})
+    client = memory_backend.StorageClient(config)
+    srv = StorageServer(memory_backend, client, config,
+                        host="127.0.0.1", port=0, auth_key="s3cret")
+    port = srv.start_background()
+    try:
+        bad = _client(port)
+        apps = remote_backend.RemoteApps(bad, bad.config, prefix="m_")
+        with pytest.raises(StorageError):
+            apps.get_all()
+        bad.close()
+        good = _client(port, AUTHKEY="s3cret")
+        apps = remote_backend.RemoteApps(good, good.config, prefix="m_")
+        assert apps.get_all() == []
+        good.close()
+    finally:
+        srv.stop()
+
+
+def test_find_streams_in_chunks(shared_server, monkeypatch):
+    """A find larger than one chunk streams through the server cursor
+    protocol instead of materializing one response (server._find_rpc)."""
+    from incubator_predictionio_tpu.data.storage import server as srv_mod
+
+    monkeypatch.setattr(srv_mod, "FIND_CHUNK", 4)
+    _srv, port = shared_server
+    client = _client(port)
+    try:
+        events = remote_backend.RemoteEvents(client, client.config,
+                                             prefix="pio_event_")
+        events.init(1)
+        for i in range(11):
+            events.insert(ev("view", f"u{i}", minutes=i), 1)
+        got = list(events.find(app_id=1))
+        assert [e.entity_id for e in got] == [f"u{i}" for i in range(11)]
+        # abandoning an iteration mid-way frees the server-side cursor
+        it = events.find(app_id=1)
+        next(it)
+        it.close()
+        assert _srv._cursors == {}
+    finally:
+        client.close()
+
+
+def test_typed_errors_cross_the_wire(shared_server):
+    _srv, port = shared_server
+    client = _client(port)
+    try:
+        events = remote_backend.RemoteEvents(client, client.config,
+                                             prefix="pio_event_")
+        events.init(1)
+        # non-exported method name → StorageError, not a crash
+        with pytest.raises(StorageError):
+            client.rpc("Events", "pio_event_", "unknown_method", (), {})
+    finally:
+        client.close()
+
+
+def test_real_two_process_topology(tmp_path):
+    """Spawn `pio storageserver` as a CHILD PROCESS (sqlite-backed) and run
+    a client from this process — the actual box-A/box-B deployment."""
+    port = _free_port()
+    env = dict(
+        os.environ,
+        PIO_STORAGE_SOURCES_DISK_TYPE="sqlite",
+        PIO_STORAGE_SOURCES_DISK_PATH=str(tmp_path / "shared.db"),
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "incubator_predictionio_tpu.cli.main",
+         "storageserver", "--ip", "127.0.0.1", "--port", str(port),
+         "--source", "DISK"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        _wait_alive(port, proc)
+        client = _client(port)
+        events = remote_backend.RemoteEvents(client, client.config,
+                                             prefix="pio_event_")
+        events.init(1)
+        eid = events.insert(ev("buy", "u9", target="i3"), 1)
+        got = events.get(eid, 1)
+        assert got is not None and got.entity_id == "u9"
+        client.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_alive(port: int, proc, timeout: float = 30.0) -> None:
+    import urllib.request
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode(errors="replace")
+            raise AssertionError(f"storageserver died:\n{out}")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=2) as resp:
+                if resp.status == 200:
+                    return
+        except OSError:
+            time.sleep(0.2)
+    raise AssertionError("storageserver did not come up")

@@ -27,7 +27,7 @@ from incubator_predictionio_tpu.utils.times import now_utc, parse_iso8601
 T0 = parse_iso8601("2021-06-01T00:00:00Z")
 
 
-@pytest.fixture(params=["memory", "sqlite", "cpplog"])
+@pytest.fixture(params=["memory", "sqlite", "cpplog", "remote"])
 def backend(request, tmp_path):
     if request.param == "cpplog":
         # the native event-log backend (events only); skip its spec slice
@@ -41,6 +41,29 @@ def backend(request, tmp_path):
         config = StorageClientConfig(
             test=True, properties={"PATH": str(tmp_path / "cpplog")})
         mod = cpplog_backend
+    elif request.param == "remote":
+        # the network backend: the SAME spec runs through a real
+        # StorageServer over HTTP (loopback), backed by the memory backend —
+        # the multi-box topology the reference gets from PostgreSQL/HBase
+        from incubator_predictionio_tpu.data.storage import (
+            remote as remote_backend,
+        )
+        from incubator_predictionio_tpu.data.storage.server import (
+            StorageServer,
+        )
+
+        back_config = StorageClientConfig(test=True, properties={})
+        back_client = memory_backend.StorageClient(back_config)
+        srv = StorageServer(memory_backend, back_client, back_config,
+                            host="127.0.0.1", port=0)
+        port = srv.start_background()
+        config = StorageClientConfig(
+            test=True, properties={"URL": f"http://127.0.0.1:{port}"})
+        client = remote_backend.StorageClient(config)
+        yield remote_backend, client, config
+        client.close()
+        srv.stop()
+        return
     else:
         config = StorageClientConfig(
             test=True, properties={"PATH": ":memory:"})

@@ -1,0 +1,37 @@
+"""Seed the recommended-user quickstart (reference: examples/
+scala-parallel-similarproduct/recommended-user/data/import_eventserver.py —
+$set users, then user-follows-user events)."""
+import argparse, json, random, urllib.request
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--access-key", required=True)
+    ap.add_argument("--url", default="http://127.0.0.1:7070")
+    args = ap.parse_args()
+    random.seed(7)
+    events = [{"event": "$set", "entityType": "user", "entityId": f"u{i}"}
+              for i in range(50)]
+    # two loose communities plus a few random cross-edges
+    for u in range(50):
+        peers = range(0, 25) if u < 25 else range(25, 50)
+        for v in random.sample([p for p in peers if p != u], 8):
+            events.append({"event": "follow", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "user",
+                           "targetEntityId": f"u{v}"})
+        if random.random() < 0.2:
+            other = random.randrange(25, 50) if u < 25 else random.randrange(25)
+            events.append({"event": "follow", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "user",
+                           "targetEntityId": f"u{other}"})
+    for s in range(0, len(events), 50):
+        req = urllib.request.Request(
+            f"{args.url}/batch/events.json?accessKey={args.access_key}",
+            json.dumps(events[s:s + 50]).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
+    print(f"imported {len(events)} events")
+
+
+if __name__ == "__main__":
+    main()

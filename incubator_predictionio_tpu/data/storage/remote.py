@@ -165,8 +165,11 @@ def _proxy(iface: str, base_cls: type, methods: Tuple[str, ...],
 
 def _events_find(self, *args: Any, **kwargs: Any) -> Iterator:
     """Lazy, chunked find: the server streams FIND_CHUNK-sized pages
-    through a cursor (server.py _find_rpc), so a 20M-event export never
-    materializes on either side."""
+    through a cursor (server.py _find_rpc), so the wire and the CLIENT
+    hold at most one chunk of Event objects at a time. (The server's peak
+    depends on the backing backend's own ``find`` — sqlite pre-fetches its
+    row set — but row tuples are far lighter than wire-encoded Events, and
+    the multi-GB single response this replaces is gone.)"""
     def gen() -> Iterator:
         msg = self._call("find_open", *args, **kwargs)
         cursor = msg["cursor"]
@@ -198,9 +201,11 @@ RemoteEvents = _proxy(
      "aggregate_properties", "scan_interactions", "import_interactions"),
     extra={"find": _events_find, "close": _events_close},
 )
-#: cursor pulls are idempotent-safe to NOT retry (state lives server-side);
-#: find_open/find_close are read-only and retryable
-_IDEMPOTENT = _IDEMPOTENT | {"find_open", "find_close"}
+#: find_close retries safely (popping a cursor twice is a no-op). find_open
+#: is NOT retried: it allocates a server-side cursor, so re-sending after a
+#: lost response would orphan the first cursor in the bounded table.
+#: find_next is stateful by design — a lost pull loses its chunk.
+_IDEMPOTENT = _IDEMPOTENT | {"find_close"}
 RemoteApps = _proxy(
     "Apps", base.Apps,
     ("insert", "get", "get_by_name", "get_all", "update", "delete"))

@@ -38,8 +38,9 @@ logger = logging.getLogger(__name__)
 #: iface → methods callable over RPC (the full DAO surface; everything
 #: else 404s, so the server's attack surface is exactly this table).
 #: ``find`` is served through the cursor protocol (find_open / find_next /
-#: find_close) so a training-scale result set streams in bounded chunks
-#: instead of materializing one multi-GB response.
+#: find_close): the response, the wire, and the client stay bounded at one
+#: FIND_CHUNK of encoded Events per round trip (the backend's own ``find``
+#: sets the server's peak — sqlite pre-fetches row tuples).
 _ALLOWED: Dict[str, Tuple[str, ...]] = {
     "Events": (
         "init", "remove", "insert", "insert_batch", "get", "delete",

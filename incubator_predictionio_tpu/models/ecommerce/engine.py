@@ -272,9 +272,19 @@ class ECommAlgorithm(Algorithm):
         )
 
     # -- serve-time constraints --------------------------------------------
-    def _unavailable_items(self, model: ECommModel) -> List[int]:
-        """Re-read the constraint entity per query (ECommAlgorithm.scala:
-        the ops team $sets constraint/unavailableItems without retraining)."""
+    def _constraints(
+        self, model: ECommModel
+    ) -> Tuple[List[int], Optional[np.ndarray]]:
+        """Re-read the ``constraint`` entities per query → (unavailable item
+        indices, per-item weight multipliers or None).
+
+        The ops team ``$set``s these without retraining:
+        ``constraint/unavailableItems`` {items: [...]} drops items from
+        results (ECommAlgorithm.scala predict), and
+        ``constraint/weightedItems`` {weights: [{items: [...], weight: w}]}
+        multiplies matching items' scores — the weighted-items template
+        variant (weighted-items/ECommAlgorithm.scala:234-261, WeightsGroup
+        at :71-74; unlisted items default to weight 1.0)."""
         try:
             props = EventStore.aggregate_properties(
                 app_name=self.params.app_name,
@@ -284,17 +294,39 @@ class ECommAlgorithm(Algorithm):
         except Exception:
             logger.warning(
                 "ecommerce: constraint lookup failed for app %r; "
-                "serving without unavailable-item filtering",
+                "serving without unavailable-item/weight constraints",
                 self.params.app_name, exc_info=True,
             )
-            return []
+            return [], None
+        unavailable: List[int] = []
         pm = props.get("unavailableItems")
-        if pm is None:
-            return []
-        names = pm.opt("items", list) or []
-        return [
-            model.item_bimap[n] for n in names if n in model.item_bimap
-        ]
+        if pm is not None:
+            names = pm.opt("items", list) or []
+            unavailable = [
+                model.item_bimap[n] for n in names if n in model.item_bimap
+            ]
+        weights: Optional[np.ndarray] = None
+        wm = props.get("weightedItems")
+        if wm is not None:
+            # ops-authored data: one malformed group must degrade to
+            # weight-1.0, not turn every predict into a 500
+            groups = wm.opt("weights", list) or []
+            weights = np.ones(len(model.item_bimap), np.float32)
+            for group in groups:
+                try:
+                    w = float(group.get("weight", 1.0))
+                    items = group.get("items", ())
+                    if isinstance(items, str):
+                        raise TypeError("items must be a list, not a string")
+                    for name in items:
+                        idx = model.item_bimap.get(name)
+                        if idx is not None:
+                            weights[idx] = w
+                except Exception:
+                    logger.warning(
+                        "ecommerce: malformed weightedItems group %r "
+                        "ignored", group, exc_info=True)
+        return unavailable, weights
 
     def _recent_items(self, model: ECommModel, user: str) -> List[int]:
         try:
@@ -321,10 +353,11 @@ class ECommAlgorithm(Algorithm):
         return out
 
     def _allowed_mask(self, model: ECommModel, query: Query,
-                      user_idx: Optional[int]) -> np.ndarray:
+                      user_idx: Optional[int],
+                      unavailable: Sequence[int]) -> np.ndarray:
         n = len(model.item_bimap)
         mask = np.ones(n, bool)
-        for idx in self._unavailable_items(model):
+        for idx in unavailable:
             mask[idx] = False
         if query.categories:
             wanted = set(query.categories)
@@ -352,7 +385,8 @@ class ECommAlgorithm(Algorithm):
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         user_idx = model.user_bimap.get(query.user)
-        mask = self._allowed_mask(model, query, user_idx)
+        unavailable, weights = self._constraints(model)
+        mask = self._allowed_mask(model, query, user_idx, unavailable)
         k = min(query.num, len(model.item_bimap))
 
         from incubator_predictionio_tpu.ops.host_serving import (
@@ -373,6 +407,8 @@ class ECommAlgorithm(Algorithm):
                 else:
                     # cold user with no history → popularity ranking
                     scores = np.asarray(np_pop, np.float32)
+            if weights is not None:
+                scores = scores * weights
             top_s, top_i = host_top_k(scores, k, allowed_mask=mask)
         else:
             import jax.numpy as jnp
@@ -394,6 +430,8 @@ class ECommAlgorithm(Algorithm):
                 else:
                     # cold user with no history → popularity ranking
                     scores = jnp.asarray(model.item_popularity)
+            if weights is not None:
+                scores = scores * jnp.asarray(weights)
             top_s, top_i = top_k_with_exclusions(
                 scores, k=k, allowed_mask=jnp.asarray(mask),
             )

@@ -1,7 +1,8 @@
 """Similar-product template (implicit-feedback ALS, item-to-item queries).
 
 Parity: examples/scala-parallel-similarproduct/ (multi variant capabilities:
-view + like events, category/white/blacklist filters).
+view + like events, category/white/blacklist filters; the recommended-user
+variant lives in .recommended_user).
 """
 
 from incubator_predictionio_tpu.models.similarproduct.engine import (
@@ -12,8 +13,11 @@ from incubator_predictionio_tpu.models.similarproduct.engine import (
     Query,
     SimilarProductEngine,
 )
+from incubator_predictionio_tpu.models.similarproduct.recommended_user import (
+    RecommendedUserEngine,
+)
 
 __all__ = [
     "ALSAlgorithmParams", "DataSourceParams", "ItemScore", "PredictedResult",
-    "Query", "SimilarProductEngine",
+    "Query", "SimilarProductEngine", "RecommendedUserEngine",
 ]

@@ -1,0 +1,264 @@
+"""Recommended-user engine: user→user recommendations from follow events.
+
+The similarproduct family's ``recommended-user`` template variant
+(examples/scala-parallel-similarproduct/recommended-user/): instead of
+item-to-item similarity it learns user-to-user affinity from ``follow``
+events and answers "who should these users follow next".
+
+Reference parity:
+
+- ``Query(users, num, whiteList?, blackList?)`` /
+  ``PredictedResult(similarUserScores)`` (Engine.scala:22-36).
+- DataSource reads ``user follow user`` events (DataSource.scala:52-60).
+- ALSAlgorithm trains implicit ALS on the follower×followed matrix with
+  ONE shared user index for both sides (ALSAlgorithm.scala:74-76 builds a
+  single BiMap); the model keeps the followed-side factors
+  (``m.productFeatures``, :120).
+- Predict scores every user by the SUM of cosine similarities to the
+  query users' vectors, drops the query users themselves, applies
+  white/blacklists, keeps positive scores, top N
+  (ALSAlgorithm.scala:127-185).
+
+TPU shape: factors are L2-normalized once at train time, so the serve-time
+cosine sum collapses to one matvec ``normed @ Σ normed[query]`` against
+the whole user catalog (host copy for small models, fused device
+score+top-k otherwise).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    Params,
+    Preparator,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.storage.base import Interactions
+from incubator_predictionio_tpu.data.store import EventStore
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    __camel_case__ = True
+
+    users: Tuple[str, ...]
+    num: int
+    white_list: Optional[Tuple[str, ...]] = None
+    black_list: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarUserScore:
+    __camel_case__ = True
+
+    user: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    __camel_case__ = True
+
+    similar_user_scores: Tuple[SimilarUserScore, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    __camel_case__ = True
+
+    app_name: str
+    channel_name: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TrainingData:
+    #: columnar follower→followed scan (both sides are users)
+    follows: Interactions
+
+    def __len__(self) -> int:
+        return len(self.follows)
+
+    def sanity_check(self) -> None:
+        if not len(self):
+            raise ValueError("TrainingData has no follow events")
+
+
+class RecommendedUserDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        follows = EventStore.interactions(
+            app_name=self.params.app_name,
+            channel_name=self.params.channel_name,
+            entity_type="user",
+            target_entity_type="user",
+            event_names=("follow",),
+            event_values={"follow": 1.0},
+        )
+        return TrainingData(follows=follows)
+
+
+@dataclasses.dataclass
+class PreparedData:
+    followers: np.ndarray     # [nnz] int32, shared user index
+    followed: np.ndarray      # [nnz] int32, shared user index
+    weights: np.ndarray       # [nnz] f32
+    user_bimap: BiMap         # ONE id space for both matrix sides
+
+
+class RecommendedUserPreparator(Preparator):
+    def prepare(self, ctx: RuntimeContext, td: TrainingData) -> PreparedData:
+        """Merge the scan's follower/followed id tables into the single
+        shared user index the reference uses for both ALS sides
+        (ALSAlgorithm.scala:74-76)."""
+        inter = td.follows
+        mapping: Dict[str, int] = {}
+        for uid in inter.user_ids:
+            mapping.setdefault(uid, len(mapping))
+        for uid in inter.item_ids:
+            mapping.setdefault(uid, len(mapping))
+        bimap = BiMap(mapping)
+        follower_remap = np.asarray(
+            [mapping[u] for u in inter.user_ids], np.int32)
+        followed_remap = np.asarray(
+            [mapping[u] for u in inter.item_ids], np.int32)
+        return PreparedData(
+            followers=follower_remap[inter.user_idx],
+            followed=followed_remap[inter.item_idx],
+            weights=inter.values.astype(np.float32),
+            user_bimap=bimap,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    __camel_case__ = True
+
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RecommendedUserModel:
+    #: followed-side factors, L2-normalized rows (cosine = dot)
+    user_features: Any
+    user_bimap: BiMap
+
+
+class RecommendedUserAlgorithm(Algorithm):
+    params_class = ALSAlgorithmParams
+    query_class_ = Query
+
+    def __init__(self, params: ALSAlgorithmParams):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext,
+              pd: PreparedData) -> RecommendedUserModel:
+        from incubator_predictionio_tpu.ops.als import als_train_implicit
+
+        n = len(pd.user_bimap)
+        seed = self.params.seed if self.params.seed is not None else ctx.seed
+        state = als_train_implicit(
+            pd.followers, pd.followed, pd.weights,
+            n_users=n, n_items=n,
+            rank=self.params.rank, iterations=self.params.num_iterations,
+            l2=self.params.lambda_, seed=seed,
+        )
+        # the reference serves from the followed-side ("product") factors
+        # (ALSAlgorithm.scala:120-123); normalize once so serve-time cosine
+        # sums are a single matvec
+        feats = np.asarray(state.item_factors, np.float32)
+        norms = np.linalg.norm(feats, axis=1, keepdims=True)
+        feats = np.where(norms > 0, feats / np.maximum(norms, 1e-30), 0.0)
+        return RecommendedUserModel(
+            user_features=feats, user_bimap=pd.user_bimap)
+
+    def prepare_model(self, ctx, model: RecommendedUserModel
+                      ) -> RecommendedUserModel:
+        import jax
+
+        return dataclasses.replace(
+            model, user_features=jax.device_put(
+                np.asarray(model.user_features)))
+
+    def predict(self, model: RecommendedUserModel,
+                query: Query) -> PredictedResult:
+        query_idx = [
+            model.user_bimap[u] for u in query.users
+            if u in model.user_bimap
+        ]
+        if not query_idx:
+            logger.info("no feature vectors for query users %s", query.users)
+            return PredictedResult(similar_user_scores=())
+        n = len(model.user_bimap)
+        # candidate mask: never recommend the query users back; then
+        # white/blacklist (ALSAlgorithm.scala isCandidateSimilarUser)
+        mask = np.ones(n, bool)
+        mask[np.asarray(query_idx, np.int64)] = False
+        if query.white_list is not None:
+            allowed = np.zeros(n, bool)
+            for u in query.white_list:
+                idx = model.user_bimap.get(u)
+                if idx is not None:
+                    allowed[idx] = True
+            mask &= allowed
+        if query.black_list:
+            for u in query.black_list:
+                idx = model.user_bimap.get(u)
+                if idx is not None:
+                    mask[idx] = False
+        k = min(query.num, n)
+
+        from incubator_predictionio_tpu.ops.host_serving import (
+            host_arrays,
+            host_top_k,
+        )
+        host = host_arrays(model, "user_features")
+        rows = np.asarray(query_idx, np.int32)
+        if host is not None:
+            feats = host[0]
+            qvec = feats[rows].sum(axis=0)
+            top_s, top_i = host_top_k(feats @ qvec, k, allowed_mask=mask)
+        else:
+            import jax.numpy as jnp
+
+            from incubator_predictionio_tpu.ops.topk import score_and_top_k
+
+            feats = jnp.asarray(model.user_features)
+            qvec = feats[jnp.asarray(rows)].sum(axis=0)
+            packed = np.asarray(score_and_top_k(
+                qvec, feats, k, allowed_mask=jnp.asarray(mask)))
+            top_s, top_i = packed[0], packed[1].astype(np.int64)
+        inv = model.user_bimap.inverse
+        out = []
+        for s, i in zip(np.asarray(top_s), np.asarray(top_i)):
+            if s <= 0:  # reference keeps strictly positive scores only
+                continue
+            out.append(SimilarUserScore(user=inv[int(i)], score=float(s)))
+        return PredictedResult(similar_user_scores=tuple(out))
+
+
+class RecommendedUserEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            RecommendedUserDataSource,
+            RecommendedUserPreparator,
+            {"als": RecommendedUserAlgorithm},
+            FirstServing,
+        )

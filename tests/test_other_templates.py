@@ -224,6 +224,148 @@ def test_ecommerce_template():
     assert len(r4.item_scores) == 2
 
 
+def test_ecommerce_weighted_items():
+    """weightedItems constraint multiplies matching items' scores at serve
+    time (weighted-items/ECommAlgorithm.scala:234-261)."""
+    from incubator_predictionio_tpu.models.ecommerce import (
+        DataSourceParams,
+        ECommAlgorithmParams,
+        ECommerceEngine,
+        Query,
+    )
+
+    app_id = seed_app("wshop")
+    seed_views(app_id)
+    dao = Storage.get_events()
+    engine = ECommerceEngine().apply()
+    ep = EngineParams(
+        data_source_params=("", DataSourceParams(app_name="wshop")),
+        algorithm_params_list=[
+            ("ecomm", ECommAlgorithmParams(app_name="wshop", rank=8,
+                                           num_iterations=10, lambda_=0.05,
+                                           alpha=2.0, seed=5)),
+        ],
+    )
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+
+    base = algo.predict(models[0], Query(user="uA1", num=4))
+    assert len(base.item_scores) >= 2
+    first, second = base.item_scores[0], base.item_scores[1]
+
+    # boost the runner-up enough to overtake; demote the old leader
+    dao.insert(Event(
+        event="$set", entity_type="constraint", entity_id="weightedItems",
+        properties=DataMap({"weights": [
+            {"items": [second.item], "weight": 100.0},
+            {"items": [first.item], "weight": 0.001},
+        ]}),
+    ), app_id)
+    boosted = algo.predict(models[0], Query(user="uA1", num=4))
+    assert boosted.item_scores[0].item == second.item
+    by_item = {s.item: s.score for s in boosted.item_scores}
+    assert by_item[second.item] == pytest.approx(second.score * 100.0,
+                                                 rel=1e-4)
+
+    # a later $set replaces the groups: back to the natural order
+    dao.insert(Event(
+        event="$set", entity_type="constraint", entity_id="weightedItems",
+        properties=DataMap({"weights": []}),
+    ), app_id)
+    reset = algo.predict(models[0], Query(user="uA1", num=4))
+    assert reset.item_scores[0].item == first.item
+
+
+# ---------------------------------------------------------------------------
+# similarproduct: recommended-user variant
+# ---------------------------------------------------------------------------
+
+def seed_follows(app_id):
+    dao = Storage.get_events()
+    rng = np.random.default_rng(3)
+    # two communities: cA* follow each other, cB* follow each other;
+    # one bridge edge from cA0 to cB0
+    groups = (
+        [f"cA{i}" for i in range(7)],
+        [f"cB{i}" for i in range(7)],
+    )
+    for members in groups:
+        for u in members:
+            for v in members:
+                if u != v and rng.random() < 0.7:
+                    dao.insert(Event(
+                        event="follow", entity_type="user", entity_id=u,
+                        target_entity_type="user", target_entity_id=v,
+                    ), app_id)
+    dao.insert(Event(event="follow", entity_type="user", entity_id="cA0",
+                     target_entity_type="user", target_entity_id="cB0"),
+               app_id)
+
+
+def test_recommended_user_template():
+    from incubator_predictionio_tpu.models.similarproduct.recommended_user import (
+        ALSAlgorithmParams,
+        DataSourceParams,
+        Query,
+        RecommendedUserEngine,
+    )
+
+    app_id = seed_app("social")
+    seed_follows(app_id)
+    engine = RecommendedUserEngine().apply()
+    ep = EngineParams(
+        data_source_params=("", DataSourceParams(app_name="social")),
+        algorithm_params_list=[
+            ("als", ALSAlgorithmParams(rank=8, num_iterations=10,
+                                       lambda_=0.05, seed=11)),
+        ],
+    )
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+
+    r = algo.predict(models[0], Query(users=("cA1", "cA2"), num=3))
+    assert r.similar_user_scores
+    # same community dominates; the query users themselves are excluded
+    names = [s.user for s in r.similar_user_scores]
+    assert all(u.startswith("cA") for u in names)
+    assert not {"cA1", "cA2"}.intersection(names)
+    # scores are positive, descending
+    scores = [s.score for s in r.similar_user_scores]
+    assert all(s > 0 for s in scores)
+    assert scores == sorted(scores, reverse=True)
+
+    # blacklist removes a recommendation
+    r2 = algo.predict(models[0], Query(users=("cA1",), num=5,
+                                       black_list=(names[0],)))
+    assert names[0] not in {s.user for s in r2.similar_user_scores}
+
+    # whitelist restricts candidates
+    r3 = algo.predict(models[0], Query(users=("cA1",), num=5,
+                                       white_list=("cB0", "cB1")))
+    assert {s.user for s in r3.similar_user_scores} <= {"cB0", "cB1"}
+
+    # unknown query users → empty result (ALSAlgorithm.scala:149-151)
+    r4 = algo.predict(models[0], Query(users=("ghost",), num=3))
+    assert r4.similar_user_scores == ()
+
+
+def test_recommended_user_wire_format():
+    from incubator_predictionio_tpu.models.similarproduct.recommended_user import (
+        PredictedResult,
+        Query,
+        SimilarUserScore,
+    )
+    from incubator_predictionio_tpu.utils import json_codec
+
+    q = json_codec.extract(Query, {
+        "users": ["u1", "u2"], "num": 5, "whiteList": ["u3"],
+    })
+    assert q.users == ("u1", "u2") and q.white_list == ("u3",)
+    out = json_codec.to_jsonable(PredictedResult(
+        similar_user_scores=(SimilarUserScore(user="u9", score=1.5),)))
+    assert out == {"similarUserScores": [{"user": "u9", "score": 1.5}]}
+
+
 def test_ecommerce_seen_events_config():
     """seen_events controls which event types mark items as 'seen'."""
     from incubator_predictionio_tpu.models.ecommerce import (

@@ -102,6 +102,10 @@ def build_parser() -> argparse.ArgumentParser:
             p.add_argument("--accesskey", default=None)
             p.add_argument("--feedback", action="store_true")
             p.add_argument("--server-key", default=None)
+            p.add_argument("--log-url", default=None,
+                           help="POST query errors to this collector URL")
+            p.add_argument("--log-prefix", default="",
+                           help="prefix prepended to each shipped log line")
 
     sub.add_parser("unregister",
                    help="unregister the engine in the current directory")
@@ -327,6 +331,8 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
             access_key=args.accesskey,
             feedback=args.feedback,
             server_key=args.server_key,
+            log_url=args.log_url,
+            log_prefix=args.log_prefix,
         ))
         print(f"Deploying on http://{args.ip}:{args.port} ...")
         asyncio.run(server.serve_forever())

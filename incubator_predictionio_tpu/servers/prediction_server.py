@@ -26,6 +26,8 @@ import logging
 import secrets
 import threading
 import time
+import traceback
+import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
@@ -69,6 +71,9 @@ class ServerConfig:
     #: (0 disables micro-batching; the reference serves queries one at a
     #: time — CreateServer.scala:523 "TODO: Parallelize")
     micro_batch: int = 32
+    #: ship query errors to a remote collector (CreateServer.scala:449-460)
+    log_url: Optional[str] = None
+    log_prefix: str = ""
 
 
 class _MicroBatcher:
@@ -131,6 +136,37 @@ class _MicroBatcher:
                     fut.set_result(res)
 
 
+class _AsyncPoster:
+    """One worker thread + bounded queue for fire-and-forget HTTP posts
+    (feedback events, --log-url error shipping). Bounds the resource cost
+    of an error storm against a slow collector: excess posts drop with a
+    local log line instead of spawning a thread + socket per failure."""
+
+    def __init__(self, maxsize: int = 256):
+        import queue
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pio-poster")
+        self._thread.start()
+
+    def submit(self, fn, what: str) -> None:
+        import queue
+
+        try:
+            self._queue.put_nowait(fn)
+        except queue.Full:
+            logger.error("async post queue full; dropping %s", what)
+
+    def _run(self) -> None:
+        while True:
+            fn = self._queue.get()
+            try:
+                fn()
+            except Exception:
+                logger.exception("async post failed")
+
+
 class PredictionServer:
     def __init__(
         self,
@@ -163,12 +199,14 @@ class PredictionServer:
         self._conf_server_key = (
             load_server_key() if config.server_key is None else None
         )
+        # bind-retry 3×/1 s for occupied ports (CreateServer.scala:371-381)
         self.http = HttpServer.from_conf(self._build_router(), config.ip,
-                                         config.port)
+                                         config.port, bind_retries=3)
         self._batcher = (
             _MicroBatcher(self._handle_batch, config.micro_batch)
             if config.micro_batch > 0 else None
         )
+        self._poster = _AsyncPoster()
 
     # -- deploy lifecycle ---------------------------------------------------
     def _resolve_instance(self) -> EngineInstance:
@@ -304,6 +342,13 @@ class PredictionServer:
                 results[idx] = result
             except Exception as e:
                 results[idx] = e
+        if self.config.log_url:
+            for idx, res in enumerate(results):
+                if isinstance(res, Exception) and not isinstance(
+                        res, HttpError):
+                    self._remote_log(
+                        f"Query:\n{bodies[idx][:2048]!r}\n\nStack Trace:\n"
+                        + "".join(traceback.format_exception(res)))
         dt = time.perf_counter() - t0
         with self._lock:
             # every query in the batch took dt wall-clock (they shared one
@@ -316,6 +361,35 @@ class PredictionServer:
             self.last_serving_sec = dt
             self.max_batch_served = max(self.max_batch_served, n)
         return results
+
+    def _remote_log(self, message: str) -> None:
+        """POST a query error to the --log-url collector, prefixed with
+        --log-prefix (remoteLog, CreateServer.scala:449-460). Fire-and-
+        forget on a daemon thread; collector failures only log locally."""
+        with self._lock:
+            instance = self.engine_instance
+        payload = (self.config.log_prefix or "") + json.dumps({
+            "engineInstance": {
+                "id": instance.id if instance else None,
+                "engineId": instance.engine_id if instance else None,
+                "engineVariant": (
+                    instance.engine_variant if instance else None),
+            },
+            "message": message,
+        })
+
+        def post() -> None:
+            try:
+                req = urllib.request.Request(
+                    self.config.log_url, data=payload.encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=10):
+                    pass
+            except Exception as e:
+                logger.error("Unable to send remote log: %s", e)
+
+        self._poster.submit(post, "remote log")
 
     def _feedback(
         self, instance: EngineInstance, query_json: Any, prediction_json: Any
@@ -359,7 +433,7 @@ class PredictionServer:
             except Exception as e:
                 logger.error("Feedback event failed: %s", e)
 
-        threading.Thread(target=post, daemon=True).start()
+        self._poster.submit(post, "feedback event")
         # inject prId into the served result when the prediction carries one
         if isinstance(prediction_json, dict) and "prId" in prediction_json:
             prediction_json = dict(prediction_json, prId=pr_id)
@@ -472,14 +546,45 @@ class PredictionServer:
         return r
 
     # -- lifecycle ----------------------------------------------------------
+    def undeploy_existing(self) -> None:
+        """Stop any engine server already deployed at this address before
+        binding (MasterActor.undeploy, CreateServer.scala:283-308): 200 →
+        old deployment stopped; connection refused → nothing there; any
+        other response → a foreign process owns the port (bind-retry will
+        surface the conflict). The scheme follows this server's own TLS
+        config (a stale deployment shares server.conf), and the key falls
+        back to server.conf like /stop auth itself does."""
+        if self.config.port == 0:
+            return  # ephemeral port: nothing can be squatting on it
+        ip = self.config.ip if self.config.ip != "0.0.0.0" else "127.0.0.1"
+        key = self.config.server_key
+        if key is None and self._conf_server_key is not None:
+            key = self._conf_server_key.key
+        scheme = "https" if self.http.ssl_context is not None else "http"
+        try:
+            status = _stop_request(ip, self.config.port, key, scheme=scheme)
+            if status == 200:
+                logger.info(
+                    "Undeployed existing engine server at %s:%d",
+                    ip, self.config.port)
+                time.sleep(0.5)  # give the old process time to unbind
+            else:
+                logger.error(
+                    "Another process is using %s:%d (HTTP %d on /stop). "
+                    "Unable to undeploy.", ip, self.config.port, status)
+        except Exception:
+            logger.debug("Nothing at %s:%d", ip, self.config.port)
+
     def start_background(self) -> int:
         self.load_models()
+        self.undeploy_existing()
         port = self.http.start_background()
         logger.info("PredictionServer started on %s:%d", self.config.ip, port)
         return port
 
     async def serve_forever(self) -> None:
         self.load_models()
+        self.undeploy_existing()
         await self.http.serve_forever()
 
     def stop(self) -> None:
@@ -488,14 +593,31 @@ class PredictionServer:
         self.http.stop()
 
 
-def undeploy(ip: str, port: int, server_key: Optional[str] = None) -> bool:
-    """POST /stop to a running server (commands/Engine.undeploy:341)."""
-    url = f"http://{ip}:{port}/stop"
+def _stop_request(ip: str, port: int, server_key: Optional[str],
+                  scheme: str = "http", timeout: float = 5.0) -> int:
+    """POST /stop → HTTP status (one shared implementation for the CLI
+    undeploy verb and undeploy-before-deploy). Raises on connection
+    failure. https uses an unverified context (the reference's
+    allowUnsafeSSL — self-signed server.conf material is the norm)."""
+    import ssl as ssl_mod
+    from urllib.parse import quote
+
+    url = f"{scheme}://{ip}:{port}/stop"
     if server_key:
-        url += f"?accessKey={server_key}"
+        url += f"?accessKey={quote(server_key, safe='')}"
+    ctx = ssl_mod._create_unverified_context() if scheme == "https" else None
+    req = urllib.request.Request(url, method="POST", data=b"")
     try:
-        req = urllib.request.Request(url, method="POST", data=b"")
-        with urllib.request.urlopen(req, timeout=5) as resp:
-            return resp.status == 200
+        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def undeploy(ip: str, port: int, server_key: Optional[str] = None,
+             scheme: str = "http") -> bool:
+    """POST /stop to a running server (commands/Engine.undeploy:341)."""
+    try:
+        return _stop_request(ip, port, server_key, scheme=scheme) == 200
     except Exception:
         return False

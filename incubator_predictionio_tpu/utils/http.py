@@ -17,6 +17,7 @@ proxy.
 from __future__ import annotations
 
 import asyncio
+import errno
 import inspect
 import json
 import logging
@@ -173,11 +174,17 @@ class HttpServer:
     never stalls the event loop."""
 
     def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0,
-                 ssl_context: Optional["ssl.SSLContext"] = None):
+                 ssl_context: Optional["ssl.SSLContext"] = None,
+                 bind_retries: int = 0, bind_retry_delay: float = 1.0):
         self.router = router
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
+        #: extra bind attempts after a failed bind (occupied port), each
+        #: after ``bind_retry_delay`` seconds — MasterActor retries 3×/1 s
+        #: (CreateServer.scala:371-381)
+        self.bind_retries = bind_retries
+        self.bind_retry_delay = bind_retry_delay
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -185,13 +192,14 @@ class HttpServer:
 
     @classmethod
     def from_conf(cls, router: Router, host: str = "0.0.0.0",
-                  port: int = 0) -> "HttpServer":
+                  port: int = 0, bind_retries: int = 0) -> "HttpServer":
         """Server with TLS material from server.conf when configured
         (the reference mixes SSLConfiguration into every server)."""
         from incubator_predictionio_tpu.utils.ssl_config import load_ssl_config
 
         return cls(router, host, port,
-                   ssl_context=load_ssl_config().ssl_context())
+                   ssl_context=load_ssl_config().ssl_context(),
+                   bind_retries=bind_retries)
 
     # -- request cycle -----------------------------------------------------
     async def _handle_conn(
@@ -288,10 +296,24 @@ class HttpServer:
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port,
-            limit=MAX_HEADER_BYTES, ssl=self.ssl_context,
-        )
+        attempt = self.bind_retries
+        while True:
+            try:
+                self._server = await asyncio.start_server(
+                    self._handle_conn, self.host, self.port,
+                    limit=MAX_HEADER_BYTES, ssl=self.ssl_context,
+                )
+                break
+            except OSError as e:
+                # only an occupied port is transient; EACCES, gaierror
+                # etc. can never clear, so fail fast on those
+                if attempt <= 0 or e.errno != errno.EADDRINUSE:
+                    raise
+                attempt -= 1
+                logger.error(
+                    "Bind to %s:%d failed (%s). Retrying... "
+                    "(%d more trial(s))", self.host, self.port, e, attempt + 1)
+                await asyncio.sleep(self.bind_retry_delay)
         self.port = self._server.sockets[0].getsockname()[1]
         self._started.set()
         logger.info("http%s server listening on %s:%d",
@@ -305,17 +327,29 @@ class HttpServer:
 
     def start_background(self) -> int:
         """Run the server on a daemon thread; returns the bound port."""
+        self._start_error: Optional[BaseException] = None
 
         def _run() -> None:
             try:
                 asyncio.run(self.serve_forever())
             except asyncio.CancelledError:
                 pass  # normal stop() path
+            except BaseException as e:
+                if self._started.is_set():
+                    # post-startup crash: the waiter is long gone — make
+                    # the dead listener loud instead of vanishing silently
+                    logger.exception("http server died after startup")
+                self._start_error = e
+                self._started.set()  # unblock the waiter; error checked there
 
         self._thread = threading.Thread(target=_run, daemon=True)
         self._thread.start()
-        if not self._started.wait(10):
+        timeout = 10 + self.bind_retries * self.bind_retry_delay
+        if not self._started.wait(timeout):
             raise RuntimeError("http server failed to start")
+        if self._start_error is not None:
+            raise RuntimeError(
+                f"http server failed to start: {self._start_error}")
         return self.port
 
     def stop(self) -> None:

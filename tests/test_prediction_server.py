@@ -229,3 +229,154 @@ def test_batch_isolates_bad_queries(stack):
     assert results["bad"][0] == 400
     for i in range(8):
         assert results[i][0] == 200 and results[i][1]["qx"] == i
+
+
+# ---------------------------------------------------------------------------
+# deploy lifecycle hardening (CreateServer.scala:283-308, :371-381, :449-460)
+# ---------------------------------------------------------------------------
+
+def _mini_server(port=0):
+    """A dumb HTTP listener standing in for 'something on the port'."""
+    from incubator_predictionio_tpu.utils.http import (
+        HttpServer,
+        Request,
+        Response,
+        Router,
+    )
+
+    r = Router()
+    hits = []
+
+    @r.post("/stop")
+    def stop(request: Request) -> Response:
+        hits.append("stop")
+        return Response(404, {"message": "not a pio server"})
+
+    srv = HttpServer(r, "127.0.0.1", port)
+    return srv, hits
+
+
+def test_bind_retry_on_occupied_port(stack):
+    """Bind retries 3x/1s: a port freed within the retry window binds
+    (MasterActor Http.CommandFailed handling, CreateServer.scala:371-381)."""
+    import socket
+    import threading
+
+    from fake_engine import make_engine
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    port = sock.getsockname()[1]
+
+    # free the port ~1.2s in — after the first bind failure, within retries
+    threading.Timer(1.2, sock.close).start()
+    ps2 = PredictionServer(make_engine(), ServerConfig(
+        ip="127.0.0.1", port=port, engine_variant="served"))
+    ps2.http.bind_retry_delay = 0.6
+    try:
+        bound = ps2.start_background()
+        assert bound == port
+    finally:
+        ps2.stop()
+
+
+def test_bind_fails_after_retries_exhausted(stack):
+    import socket
+
+    from fake_engine import make_engine
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    port = sock.getsockname()[1]
+    try:
+        ps2 = PredictionServer(make_engine(), ServerConfig(
+            ip="127.0.0.1", port=port, engine_variant="served"))
+        ps2.http.bind_retries = 1
+        ps2.http.bind_retry_delay = 0.1
+        with pytest.raises(RuntimeError, match="failed to start"):
+            ps2.start_background()
+    finally:
+        sock.close()
+
+
+def test_undeploy_before_deploy_replaces_stale_server(stack):
+    """Deploying onto an address with a live engine server stops the old
+    one first (undeploy-before-deploy, CreateServer.scala:283-308)."""
+    from fake_engine import make_engine
+
+    ps, port, _es, _esp = stack
+    assert call(port, "GET", "/")[0] == 200
+    # a second deploy on the SAME port: the stale server must be asked to
+    # stop (server-key authed), then the port reused
+    ps2 = PredictionServer(make_engine(), ServerConfig(
+        ip="127.0.0.1", port=port, engine_variant="served",
+        server_key="sekrit"))
+    try:
+        bound = ps2.start_background()
+        assert bound == port
+        status, body = call(port, "GET", "/")
+        assert status == 200 and body["requestCount"] == 0
+    finally:
+        ps2.stop()
+
+
+def test_undeploy_foreign_process_logs_and_continues(stack, caplog):
+    """A non-pio process answering /stop with an error is reported, not
+    crashed into (MasterActor.undeploy 404 branch)."""
+    import logging
+
+    from fake_engine import make_engine
+
+    srv, hits = _mini_server()
+    port = srv.start_background()
+    ps2 = PredictionServer(make_engine(), ServerConfig(
+        ip="127.0.0.1", port=port, engine_variant="served"))
+    ps2.http.bind_retries = 0
+    with caplog.at_level(logging.ERROR):
+        with pytest.raises(RuntimeError):
+            ps2.start_background()  # foreign owner keeps the port
+    assert hits == ["stop"]
+    assert any("Another process is using" in r.message for r in caplog.records)
+    srv.stop()
+
+
+def test_log_url_ships_query_errors(stack):
+    """Query errors POST to --log-url with the prefix + engine instance
+    (remoteLog, CreateServer.scala:449-460)."""
+    import threading
+
+    from incubator_predictionio_tpu.utils.http import (
+        HttpServer,
+        Request,
+        Response,
+        Router,
+    )
+
+    ps, port, _es, _esp = stack
+    received = []
+    got_one = threading.Event()
+    r = Router()
+
+    @r.post("/collect")
+    def collect(request: Request) -> Response:
+        received.append(request.body.decode())
+        got_one.set()
+        return Response(200, {})
+
+    collector = HttpServer(r, "127.0.0.1", 0)
+    cport = collector.start_background()
+    ps.config.log_url = f"http://127.0.0.1:{cport}/collect"
+    ps.config.log_prefix = "PIOLOG "
+    try:
+        status, _ = call(port, "POST", "/queries.json", {"bogus": 1})
+        assert status == 400
+        assert got_one.wait(10), "no remote log arrived"
+        assert received[0].startswith("PIOLOG ")
+        doc = json.loads(received[0][len("PIOLOG "):])
+        assert doc["engineInstance"]["id"]
+        assert "Stack Trace" in doc["message"]
+    finally:
+        ps.config.log_url = None
+        collector.stop()

@@ -137,18 +137,24 @@ class _MicroBatcher:
 
 
 class _AsyncPoster:
-    """One worker thread + bounded queue for fire-and-forget HTTP posts
-    (feedback events, --log-url error shipping). Bounds the resource cost
-    of an error storm against a slow collector: excess posts drop with a
-    local log line instead of spawning a thread + socket per failure."""
+    """Bounded worker pool for fire-and-forget HTTP posts. Bounds the
+    resource cost of an error storm against a slow collector: excess posts
+    drop with a local log line instead of spawning a thread + socket per
+    failure. Feedback events and --log-url shipping get SEPARATE posters so
+    a hung diagnostics collector can never starve feedback delivery
+    (feedback is training data, not telemetry)."""
 
-    def __init__(self, maxsize: int = 256):
+    def __init__(self, name: str, workers: int = 2, maxsize: int = 1024):
         import queue
 
         self._queue: "queue.Queue" = queue.Queue(maxsize=maxsize)
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name="pio-poster")
-        self._thread.start()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"pio-poster-{name}-{i}")
+            for i in range(max(workers, 1))
+        ]
+        for t in self._threads:
+            t.start()
 
     def submit(self, fn, what: str) -> None:
         import queue
@@ -158,9 +164,18 @@ class _AsyncPoster:
         except queue.Full:
             logger.error("async post queue full; dropping %s", what)
 
+    def stop(self) -> None:
+        for _ in self._threads:
+            try:
+                self._queue.put_nowait(None)
+            except Exception:
+                pass
+
     def _run(self) -> None:
         while True:
             fn = self._queue.get()
+            if fn is None:
+                return
             try:
                 fn()
             except Exception:
@@ -206,7 +221,8 @@ class PredictionServer:
             _MicroBatcher(self._handle_batch, config.micro_batch)
             if config.micro_batch > 0 else None
         )
-        self._poster = _AsyncPoster()
+        self._feedback_poster = _AsyncPoster("feedback")
+        self._log_poster = _AsyncPoster("log", workers=1, maxsize=256)
 
     # -- deploy lifecycle ---------------------------------------------------
     def _resolve_instance(self) -> EngineInstance:
@@ -389,7 +405,7 @@ class PredictionServer:
             except Exception as e:
                 logger.error("Unable to send remote log: %s", e)
 
-        self._poster.submit(post, "remote log")
+        self._log_poster.submit(post, "remote log")
 
     def _feedback(
         self, instance: EngineInstance, query_json: Any, prediction_json: Any
@@ -433,7 +449,7 @@ class PredictionServer:
             except Exception as e:
                 logger.error("Feedback event failed: %s", e)
 
-        self._poster.submit(post, "feedback event")
+        self._feedback_poster.submit(post, "feedback event")
         # inject prId into the served result when the prediction carries one
         if isinstance(prediction_json, dict) and "prId" in prediction_json:
             prediction_json = dict(prediction_json, prId=pr_id)
@@ -572,8 +588,23 @@ class PredictionServer:
                 logger.error(
                     "Another process is using %s:%d (HTTP %d on /stop). "
                     "Unable to undeploy.", ip, self.config.port, status)
-        except Exception:
+        except ConnectionRefusedError:
             logger.debug("Nothing at %s:%d", ip, self.config.port)
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, ConnectionRefusedError):
+                logger.debug("Nothing at %s:%d", ip, self.config.port)
+            else:
+                # something answered the socket but not the protocol
+                # (hung process, TLS mismatch, timeout) — that is NOT
+                # "nothing there"; say so before bind-retry fights it
+                logger.warning(
+                    "A process at %s:%d did not respond properly to "
+                    "/stop (%s); unable to undeploy.",
+                    ip, self.config.port, e.reason)
+        except Exception as e:
+            logger.warning(
+                "A process at %s:%d did not respond properly to /stop "
+                "(%s); unable to undeploy.", ip, self.config.port, e)
 
     def start_background(self) -> int:
         self.load_models()
@@ -590,6 +621,8 @@ class PredictionServer:
     def stop(self) -> None:
         if self._batcher is not None:
             self._batcher.stop()
+        self._feedback_poster.stop()
+        self._log_poster.stop()
         self.http.stop()
 
 

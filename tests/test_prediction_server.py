@@ -256,29 +256,47 @@ def _mini_server(port=0):
     return srv, hits
 
 
-def test_bind_retry_on_occupied_port(stack):
-    """Bind retries 3x/1s: a port freed within the retry window binds
-    (MasterActor Http.CommandFailed handling, CreateServer.scala:371-381)."""
+def test_bind_retry_on_occupied_port():
+    """Bind retries on EADDRINUSE: a port freed within the retry window
+    binds (MasterActor Http.CommandFailed handling,
+    CreateServer.scala:371-381). Tested directly at the HttpServer level
+    so the first bind attempt genuinely collides (the prediction server's
+    undeploy handshake would otherwise consume time and free the port
+    before the first bind)."""
     import socket
     import threading
 
-    from fake_engine import make_engine
+    from incubator_predictionio_tpu.utils.http import HttpServer, Router
 
     sock = socket.socket()
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     sock.bind(("127.0.0.1", 0))
     sock.listen(1)
     port = sock.getsockname()[1]
 
-    # free the port ~1.2s in — after the first bind failure, within retries
-    threading.Timer(1.2, sock.close).start()
-    ps2 = PredictionServer(make_engine(), ServerConfig(
-        ip="127.0.0.1", port=port, engine_variant="served"))
-    ps2.http.bind_retry_delay = 0.6
+    srv = HttpServer(Router(), "127.0.0.1", port,
+                     bind_retries=3, bind_retry_delay=0.4)
+    # free the port ~0.6s in: after the first bind failure, within retries
+    threading.Timer(0.6, sock.close).start()
     try:
-        bound = ps2.start_background()
+        bound = srv.start_background()
         assert bound == port
     finally:
-        ps2.stop()
+        srv.stop()
+
+
+def test_bind_no_retry_on_non_transient_oserror():
+    """Non-EADDRINUSE OSErrors (bad host) fail fast, no retry loop."""
+    import time as _time
+
+    from incubator_predictionio_tpu.utils.http import HttpServer, Router
+
+    srv = HttpServer(Router(), "256.256.256.256", 1,
+                     bind_retries=3, bind_retry_delay=1.0)
+    t0 = _time.monotonic()
+    with pytest.raises(RuntimeError, match="failed to start"):
+        srv.start_background()
+    assert _time.monotonic() - t0 < 2.5  # did not burn 3x1s retries
 
 
 def test_bind_fails_after_retries_exhausted(stack):

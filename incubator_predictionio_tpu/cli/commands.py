@@ -400,41 +400,142 @@ def _appid_or_name_to_name(appid_or_name: str) -> str:
     return appid_or_name
 
 
+#: parquet schema: scalar event fields as columns, properties as a JSON
+#: string column (the reference dumps a DataFrame of the Event case class —
+#: EventsToFile.scala:44,88-93; a JSON property column keeps arbitrary
+#: DataMap payloads schema-stable across rows)
+_PARQUET_FIELDS = (
+    "eventId", "event", "entityType", "entityId", "targetEntityType",
+    "targetEntityId", "properties", "eventTime", "tags", "prId",
+    "creationTime",
+)
+
+
 def export_events(app_name: str, output: str,
-                  channel: Optional[str] = None) -> int:
+                  channel: Optional[str] = None,
+                  format: str = "json") -> int:
     from incubator_predictionio_tpu.data.store import EventStore
 
     app_name = _appid_or_name_to_name(app_name)
-    n = 0
-    with open(output, "w") as f:
-        for event in EventStore.find(app_name=app_name, channel_name=channel):
-            f.write(json.dumps(event.to_jsonable()) + "\n")
-            n += 1
+    found = EventStore.find(app_name=app_name, channel_name=channel)
+    if format == "parquet":
+        n = _export_parquet(found, output)
+    elif format == "json":
+        n = 0
+        with open(output, "w") as f:
+            for event in found:
+                f.write(json.dumps(event.to_jsonable()) + "\n")
+                n += 1
+    else:
+        raise CommandError(
+            f"unknown export format {format!r} (json or parquet — "
+            "EventsToFile.scala:44 parity)")
     print(f"Exported {n} events to {output}.")
     return n
 
 
+def _export_parquet(events, output: str, batch_rows: int = 65536) -> int:
+    """EventsToFile.scala:88-93's DataFrame.write.parquet role, streamed
+    in bounded row batches."""
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover - baked into the image
+        raise CommandError(
+            "parquet export needs pyarrow, which is not installed; "
+            "use --format json") from e
+
+    schema = pa.schema([
+        (name, pa.list_(pa.string()) if name == "tags" else pa.string())
+        for name in _PARQUET_FIELDS
+    ])
+    n = 0
+    writer = pq.ParquetWriter(output, schema)
+    try:
+        batch = {name: [] for name in _PARQUET_FIELDS}
+        for event in events:
+            doc = event.to_jsonable()
+            for name in _PARQUET_FIELDS:
+                if name == "properties":
+                    batch[name].append(json.dumps(doc.get(name, {})))
+                elif name == "tags":
+                    batch[name].append(doc.get(name, []))
+                else:
+                    batch[name].append(doc.get(name))
+            n += 1
+            if n % batch_rows == 0:
+                writer.write_table(pa.table(batch, schema=schema))
+                batch = {name: [] for name in _PARQUET_FIELDS}
+        if batch[_PARQUET_FIELDS[0]] or n == 0:
+            writer.write_table(pa.table(batch, schema=schema))
+    finally:
+        writer.close()
+    return n
+
+
+def _iter_import_file(input_path: str, format: str):
+    """Yield (location, jsonable-event-dict) from a JSON-lines or parquet
+    export file."""
+    if format == "parquet":
+        try:
+            import pyarrow.parquet as pq
+        except ImportError as e:  # pragma: no cover
+            raise CommandError(
+                "parquet import needs pyarrow, which is not installed"
+            ) from e
+        row_no = 0
+        # stream row batches: a multi-million-row export never materializes
+        # whole-file columns (mirrors the export side's bounded batching)
+        for batch in pq.ParquetFile(input_path).iter_batches(65536):
+            cols = batch.to_pydict()
+            names = [n for n in _PARQUET_FIELDS if n in cols]
+            for i in range(batch.num_rows):
+                row_no += 1
+                location = f"{input_path}:row {row_no}"
+                doc = {}
+                for name in names:
+                    value = cols[name][i]
+                    if value is None:
+                        continue
+                    if name == "properties":
+                        try:
+                            value = json.loads(value)
+                        except ValueError as e:
+                            raise CommandError(
+                                f"{location}: invalid properties JSON: {e}"
+                            ) from e
+                    doc[name] = value
+                yield location, doc
+    else:
+        with open(input_path) as f:
+            for line_no, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError as e:
+                    raise CommandError(
+                        f"{input_path}:{line_no}: invalid event: {e}") from e
+                yield f"{input_path}:{line_no}", doc
+
+
 def import_events(app_name: str, input_path: str,
-                  channel: Optional[str] = None) -> int:
+                  channel: Optional[str] = None,
+                  format: str = "json") -> int:
     from incubator_predictionio_tpu.data.event import validate_event
     from incubator_predictionio_tpu.data.store import EventStore
 
     app_name = _appid_or_name_to_name(app_name)
 
     events = []
-    with open(input_path) as f:
-        for line_no, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                event = Event.from_jsonable(json.loads(line))
-                validate_event(event)
-                events.append(event)
-            except ValueError as e:
-                raise CommandError(
-                    f"{input_path}:{line_no}: invalid event: {e}"
-                ) from e
+    for location, doc in _iter_import_file(input_path, format):
+        try:
+            event = Event.from_jsonable(doc)
+            validate_event(event)
+            events.append(event)
+        except ValueError as e:
+            raise CommandError(f"{location}: invalid event: {e}") from e
     EventStore.write(events, app_name=app_name, channel_name=channel)
     print(f"Imported {len(events)} events.")
     return len(events)

@@ -146,14 +146,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shared key clients must send (X-Pio-Storage-Key)")
 
     # -- data --------------------------------------------------------------
-    p = sub.add_parser("export", help="export app events to JSON lines")
+    p = sub.add_parser("export",
+                       help="export app events to JSON lines or parquet")
     p.add_argument("--appid-or-name", dest="app_name", required=True)
     p.add_argument("--output", required=True)
     p.add_argument("--channel")
-    p = sub.add_parser("import", help="import JSON-line events into an app")
+    p.add_argument("--format", choices=("json", "parquet"), default="json")
+    p = sub.add_parser("import", help="import exported events into an app")
     p.add_argument("--appid-or-name", dest="app_name", required=True)
     p.add_argument("--input", required=True)
     p.add_argument("--channel")
+    p.add_argument("--format", choices=("json", "parquet"), default="json")
 
     # -- misc --------------------------------------------------------------
     p = sub.add_parser("run", help="run an arbitrary main in the engine env")
@@ -389,11 +392,13 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
         return 0
 
     if cmd == "export":
-        commands.export_events(args.app_name, args.output, args.channel)
+        commands.export_events(args.app_name, args.output, args.channel,
+                               format=args.format)
         return 0
 
     if cmd == "import":
-        commands.import_events(args.app_name, args.input, args.channel)
+        commands.import_events(args.app_name, args.input, args.channel,
+                               format=args.format)
         return 0
 
     if cmd == "run":

@@ -21,6 +21,7 @@ from incubator_predictionio_tpu.core.engine import Engine, _select
 from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 from incubator_predictionio_tpu.utils import json_codec
+from incubator_predictionio_tpu.utils.annotations import experimental
 
 logger = logging.getLogger(__name__)
 
@@ -29,6 +30,7 @@ def _key(*parts: Any) -> str:
     return json.dumps([json_codec.to_jsonable(p) for p in parts], sort_keys=True)
 
 
+@experimental
 class FastEvalEngineWorkflow:
     """Holds the prefix caches for one batch_eval run
     (FastEvalEngine.scala:215-264)."""
@@ -104,6 +106,7 @@ class FastEvalEngineWorkflow:
         return self.serving_cache[k]
 
 
+@experimental
 class FastEvalEngine(Engine):
     """Engine whose batch_eval memoizes pipeline prefixes.
 

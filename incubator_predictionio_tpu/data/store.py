@@ -153,6 +153,26 @@ class EventStore:
         )
 
     @staticmethod
+    def extract_entity_map(
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[datetime] = None,
+        until_time: Optional[datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ):
+        """Aggregated entity properties keyed by id AND a dense index
+        (PEvents.extractEntityMap:136-160) — the form templates feed
+        factor tables from."""
+        from incubator_predictionio_tpu.data.entity_map import EntityMap
+
+        return EntityMap(EventStore.aggregate_properties(
+            app_name=app_name, entity_type=entity_type,
+            channel_name=channel_name, start_time=start_time,
+            until_time=until_time, required=required,
+        ))
+
+    @staticmethod
     def write(
         events: Sequence[Event],
         app_name: str,

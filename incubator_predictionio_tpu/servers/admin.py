@@ -12,6 +12,7 @@ import logging
 from typing import Optional
 
 from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.utils.annotations import experimental
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
     Request,
@@ -22,6 +23,7 @@ from incubator_predictionio_tpu.utils.http import (
 logger = logging.getLogger(__name__)
 
 
+@experimental
 class AdminServer:
     def __init__(self, ip: str = "127.0.0.1", port: int = 7071):
         self.apps = Storage.get_meta_data_apps()

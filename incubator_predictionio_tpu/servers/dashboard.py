@@ -2,7 +2,9 @@
 
 Parity: tools/.../dashboard/Dashboard.scala:46-162 on :9000 — lists
 completed EvaluationInstances newest-first with links to each instance's
-stored HTML results (the reference renders the same data through Twirl).
+stored HTML results (the reference renders the same data through Twirl),
+with CORS enabled (CorsSupport.scala:30-66) so external dashboards can
+fetch the JSON results cross-origin.
 """
 
 from __future__ import annotations
@@ -28,7 +30,7 @@ class DashboardServer:
         self.http = HttpServer.from_conf(self._build_router(), ip, port)
 
     def _build_router(self) -> Router:
-        r = Router()
+        r = Router(cors=True)
 
         @r.get("/")
         def index(request: Request) -> Response:

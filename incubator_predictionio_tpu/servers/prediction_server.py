@@ -165,11 +165,18 @@ class _AsyncPoster:
             logger.error("async post queue full; dropping %s", what)
 
     def stop(self) -> None:
+        import queue
+
         for _ in self._threads:
             try:
-                self._queue.put_nowait(None)
-            except Exception:
-                pass
+                # blocking put with a timeout: when the queue is full of
+                # backlog, the sentinel must still land or workers never
+                # exit (drained posts run first — stop() is fire-and-forget)
+                self._queue.put(None, timeout=5)
+            except queue.Full:
+                logger.warning(
+                    "async post queue still full at stop; a worker may "
+                    "keep draining in the background")
 
     def _run(self) -> None:
         while True:

@@ -113,12 +113,29 @@ class Response:
 Handler = Callable[[Request], "Response | Awaitable[Response]"]
 
 
+#: headers a CORS-enabled router grants on OPTIONS preflight
+#: (CorsSupport.scala:34-45 — AllOrigins + the standard request headers)
+CORS_ALLOW_HEADERS = (
+    "Origin, X-Requested-With, Content-Type, Accept, Accept-Encoding, "
+    "Accept-Language, Host, Referer, User-Agent"
+)
+
+
 class Router:
     """Method + path routing with ``{param}`` segments and a catch-all
-    ``{tail...}`` form."""
+    ``{tail...}`` form. ``cors=True`` adds ``Access-Control-Allow-Origin: *``
+    to every response and answers OPTIONS preflights with the allowed
+    methods (the dashboard's CorsSupport trait,
+    tools/.../dashboard/CorsSupport.scala:30-66)."""
 
-    def __init__(self) -> None:
+    def __init__(self, cors: bool = False) -> None:
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self.cors = cors
+
+    def allowed_methods(self, path: str) -> List[str]:
+        return sorted({
+            m for m, pattern, _h in self._routes if pattern.match(path)
+        })
 
     _PARAM_RE = re.compile(r"\{([a-zA-Z_][a-zA-Z0-9_]*)(\.\.\.)?\}")
 
@@ -273,9 +290,21 @@ class HttpServer:
             request.method, request.path
         )
         if handler is None:
+            if self.router.cors and path_exists \
+                    and request.method == "OPTIONS":
+                # CORS preflight for a resource that answers other methods
+                # (CorsSupport.scala:49-62)
+                methods = self.router.allowed_methods(request.path)
+                return self._with_cors(Response(200, headers={
+                    "Access-Control-Allow-Methods":
+                        ", ".join(["OPTIONS"] + methods),
+                    "Access-Control-Allow-Headers": CORS_ALLOW_HEADERS,
+                    "Access-Control-Max-Age": "1728000",
+                }))
             if path_exists:
-                return Response(405, {"message": "Method Not Allowed"})
-            return Response(404, {"message": "Not Found"})
+                return self._with_cors(
+                    Response(405, {"message": "Method Not Allowed"}))
+            return self._with_cors(Response(404, {"message": "Not Found"}))
         request.path_params = params
         try:
             if inspect.iscoroutinefunction(handler):
@@ -285,13 +314,18 @@ class HttpServer:
                 result = await loop.run_in_executor(None, handler, request)
                 if inspect.isawaitable(result):
                     result = await result
-            return result
+            return self._with_cors(result)
         except HttpError as e:
-            return Response(e.status, {"message": e.message})
+            return self._with_cors(Response(e.status, {"message": e.message}))
         except Exception as e:
             logger.exception("handler error for %s %s", request.method,
                              request.path)
-            return Response(500, {"message": str(e)})
+            return self._with_cors(Response(500, {"message": str(e)}))
+
+    def _with_cors(self, response: Response) -> Response:
+        if self.router.cors:
+            response.headers.setdefault("Access-Control-Allow-Origin", "*")
+        return response
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:

@@ -87,3 +87,27 @@ def test_dashboard_lists_evaluations():
         assert call(port, "GET", "/engine_instances/nope")[0] == 404
     finally:
         srv.stop()
+
+
+def test_dashboard_cors():
+    """CORS parity (CorsSupport.scala:30-66): allow-origin on responses,
+    OPTIONS preflight announces allowed methods."""
+    srv = DashboardServer(ip="127.0.0.1", port=0)
+    port = srv.start_background()
+    try:
+        _dashboard_cors_checks(port)
+    finally:
+        srv.stop()
+
+
+def _dashboard_cors_checks(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as resp:
+        assert resp.headers["Access-Control-Allow-Origin"] == "*"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", method="OPTIONS")
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+        assert "GET" in resp.headers["Access-Control-Allow-Methods"]
+        assert "OPTIONS" in resp.headers["Access-Control-Allow-Methods"]
+        assert resp.headers["Access-Control-Max-Age"] == "1728000"
+        assert "Content-Type" in resp.headers["Access-Control-Allow-Headers"]

@@ -39,3 +39,85 @@ def test_take():
     m = BiMap.string_int(["a", "b", "c"])
     t = m.take(2)
     assert t.to_dict() == {"a": 0, "b": 1}
+
+
+# ---------------------------------------------------------------------------
+# EntityMap (EntityMap.scala:27-99) + API-stability markers
+# ---------------------------------------------------------------------------
+
+def test_entity_id_ix_map():
+    from incubator_predictionio_tpu.data.entity_map import EntityIdIxMap
+
+    m = EntityIdIxMap.from_keys(["a", "b", "c"])
+    assert len(m) == 3
+    assert m("a") == 0 and m("c") == 2
+    assert m(1) == "b"  # symmetric apply: int → id
+    assert "b" in m and 2 in m and "z" not in m and 9 not in m
+    assert m.get("z") is None and m.get(7, "dflt") == "dflt"
+    t = m.take(2)
+    assert len(t) == 2 and t("b") == 1 and "c" not in t
+
+
+def test_entity_map_data_and_take():
+    from incubator_predictionio_tpu.data.entity_map import EntityMap
+
+    em = EntityMap({"u1": {"age": 30}, "u2": {"age": 40}, "u3": {"age": 50}})
+    assert em.data("u2") == {"age": 40}
+    assert em.data(em("u2")) == {"age": 40}      # by dense index
+    assert em.get_data("ghost") is None
+    assert em.get_or_else_data("ghost", {"age": 0}) == {"age": 0}
+    assert em.get_or_else_data("ghost", lambda: {"age": 1}) == {"age": 1}
+    t = em.take(2)
+    assert len(t) == 2 and set(t.id_to_data) == {"u1", "u2"}
+
+
+def test_extract_entity_map_from_event_store():
+    from incubator_predictionio_tpu.data.datamap import DataMap
+    from incubator_predictionio_tpu.data.event import Event
+    from incubator_predictionio_tpu.data.storage import App, Storage
+    from incubator_predictionio_tpu.data.store import EventStore
+
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(0, "emap"))
+        dao = Storage.get_events()
+        dao.insert(Event(event="$set", entity_type="item", entity_id="i1",
+                         properties=DataMap({"year": 1999})), app_id)
+        dao.insert(Event(event="$set", entity_type="item", entity_id="i2",
+                         properties=DataMap({"year": 2005})), app_id)
+        em = EventStore.extract_entity_map(app_name="emap",
+                                           entity_type="item")
+        assert len(em) == 2
+        assert em.data("i2").get("year") == 2005
+        assert em.data(em("i1")).get("year") == 1999
+    finally:
+        Storage.reset()
+
+
+def test_api_stability_markers():
+    from incubator_predictionio_tpu.data.entity_map import EntityMap
+    from incubator_predictionio_tpu.utils.annotations import (
+        api_stability,
+        developer_api,
+        experimental,
+    )
+
+    assert api_stability(EntityMap) == "Experimental"
+    assert EntityMap.__doc__.startswith(":: Experimental ::")
+
+    @developer_api
+    def low_level():
+        """Does internal things."""
+
+    assert api_stability(low_level) == "DeveloperApi"
+    assert ":: DeveloperApi ::" in low_level.__doc__
+    assert "Does internal things." in low_level.__doc__
+    assert api_stability(test_entity_map_data_and_take) == "stable"

@@ -96,6 +96,37 @@ def test_import_export_round_trip(tmp_path, capsys):
                  "--input", str(bad)]) == 1
 
 
+def test_parquet_export_import_round_trip(tmp_path, capsys):
+    """--format parquet on both verbs (EventsToFile.scala:44 parity),
+    preserving properties / tags / prId / times through the round trip."""
+    pytest.importorskip("pyarrow")
+    main(["app", "new", "PqApp"])
+    main(["app", "new", "PqApp2"])
+    from incubator_predictionio_tpu.data.store import EventStore
+
+    EventStore.write([
+        Event(event="rate", entity_type="user", entity_id=f"u{i}",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"rating": float(i), "nested": {"a": [i]}}),
+              tags=("t1", "t2"), pr_id="pr-9" if i == 0 else None)
+        for i in range(3)
+    ], app_name="PqApp")
+    pq_file = tmp_path / "events.parquet"
+    assert main(["export", "--appid-or-name", "PqApp",
+                 "--output", str(pq_file), "--format", "parquet"]) == 0
+    assert pq_file.stat().st_size > 0
+    assert main(["import", "--appid-or-name", "PqApp2",
+                 "--input", str(pq_file), "--format", "parquet"]) == 0
+    got = sorted(EventStore.find(app_name="PqApp2"),
+                 key=lambda e: e.entity_id)
+    assert [e.entity_id for e in got] == ["u0", "u1", "u2"]
+    assert got[1].properties.get("rating") == 1.0
+    assert got[1].properties.get("nested") == {"a": [1]}
+    assert got[0].tags == ("t1", "t2")
+    assert got[0].pr_id == "pr-9"
+    assert got[2].event_time is not None
+
+
 def _seed_quickstart_events(app_name):
     from incubator_predictionio_tpu.data.store import EventStore
 

@@ -47,9 +47,16 @@ def test_two_process_pod_runtime():
             [sys.executable, str(worker)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out.decode(errors="replace"))
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        # a hung worker must not outlive the test holding the coordinator
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
     oks = [line for out in outs for line in out.splitlines()

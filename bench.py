@@ -299,6 +299,7 @@ def bench_serving(state, inter):
     server.config = ServerConfig(ip="127.0.0.1", port=0)
     from incubator_predictionio_tpu.servers.plugins import PluginContext
     from incubator_predictionio_tpu.servers.prediction_server import (
+        _AsyncPoster,
         _MicroBatcher,
     )
     from incubator_predictionio_tpu.utils.http import HttpServer
@@ -321,6 +322,8 @@ def bench_serving(state, inter):
     server._conf_server_key = None
     server.http = HttpServer(server._build_router(), "127.0.0.1", 0)
     server._batcher = _MicroBatcher(server._handle_batch, 32)
+    server._feedback_poster = _AsyncPoster("feedback")
+    server._log_poster = _AsyncPoster("log", workers=1)
     port = server.http.start_background()
 
     def query_once(user: str) -> None:

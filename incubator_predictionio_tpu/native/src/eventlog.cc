@@ -31,7 +31,6 @@
 #include <sys/stat.h>
 #include <thread>
 #include <unistd.h>
-#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -83,8 +82,31 @@ struct EventLog {
   std::vector<int64_t> sorted;  // indices ordered by (time_ms, idx)
   bool sorted_dirty = true;
   int64_t last_time = INT64_MIN; // fast-path: appends already in order
+  // id_hash → entry index, built LAZILY on the first find_id (explicit-id
+  // upserts/re-imports); plain ingest never pays its memory. A sorted flat
+  // vector (16 B/record — a node-based hash map would cost ~4×) plus an
+  // unsorted append tail merged on growth; tombstoned entries are filtered
+  // at query time, so marking dead needs no upkeep.
+  std::vector<std::pair<uint64_t, int64_t>> id_sorted;
+  std::vector<std::pair<uint64_t, int64_t>> id_tail;
+  bool id_index_built = false;
   std::mutex mu;
 };
+
+static void index_new_entry(EventLog* log, int64_t idx) {
+  if (!log->id_index_built || log->entries[idx].dead) return;
+  log->id_tail.emplace_back(log->entries[idx].id_hash, idx);
+  if (log->id_tail.size() > 4096 &&
+      log->id_tail.size() > log->id_sorted.size() / 8) {
+    const size_t mid = log->id_sorted.size();
+    log->id_sorted.insert(log->id_sorted.end(), log->id_tail.begin(),
+                          log->id_tail.end());
+    std::sort(log->id_sorted.begin() + mid, log->id_sorted.end());
+    std::inplace_merge(log->id_sorted.begin(),
+                       log->id_sorted.begin() + mid, log->id_sorted.end());
+    log->id_tail.clear();
+  }
+}
 
 static void resort(EventLog* log) {
   if (!log->sorted_dirty) return;
@@ -205,6 +227,7 @@ int64_t pio_evlog_append(void* handle, int64_t time_ms, uint64_t etype_hash,
   log->entries.push_back(
       {time_ms, etype_hash, eid_hash, name_hash, id_hash, off, len, 0,
        false});
+  index_new_entry(log, (int64_t)log->entries.size() - 1);
   if (time_ms >= log->last_time && !log->sorted_dirty) {
     log->sorted.push_back((int64_t)log->entries.size() - 1);  // stays sorted
   } else {
@@ -285,11 +308,27 @@ int64_t pio_evlog_find_id(void* handle, uint64_t id_hash, int64_t* out,
                           int64_t cap) {
   auto* log = (EventLog*)handle;
   std::lock_guard<std::mutex> g(log->mu);
-  int64_t n = 0;
-  for (size_t i = 0; i < log->entries.size() && n < cap; ++i) {
-    const Entry& e = log->entries[i];
-    if (!e.dead && e.id_hash == id_hash) out[n++] = (int64_t)i;
+  if (!log->id_index_built) {
+    // one linear pass + sort on the FIRST lookup; afterwards appends keep
+    // the index current, so an M-event explicit-id re-import into an
+    // N-record log costs O(N log N + M), not the O(M·N) a per-event scan
+    // would
+    log->id_sorted.reserve(log->entries.size());
+    for (size_t i = 0; i < log->entries.size(); ++i)
+      if (!log->entries[i].dead)
+        log->id_sorted.emplace_back(log->entries[i].id_hash, (int64_t)i);
+    std::sort(log->id_sorted.begin(), log->id_sorted.end());
+    log->id_index_built = true;
   }
+  int64_t n = 0;
+  auto lo = std::lower_bound(
+      log->id_sorted.begin(), log->id_sorted.end(),
+      std::make_pair(id_hash, INT64_MIN));
+  for (; lo != log->id_sorted.end() && lo->first == id_hash && n < cap; ++lo)
+    if (!log->entries[lo->second].dead) out[n++] = lo->second;
+  for (const auto& kv : log->id_tail)
+    if (n < cap && kv.first == id_hash && !log->entries[kv.second].dead)
+      out[n++] = kv.second;
   return n;
 }
 
@@ -1057,6 +1096,7 @@ int64_t pio_evlog_append_bulk(void* handle, int64_t n,
     }
     log->last_time = std::max(log->last_time, e.time_ms);
     log->entries.push_back(e);
+    index_new_entry(log, (int64_t)log->entries.size() - 1);
   }
   return n;
 }
@@ -1283,6 +1323,7 @@ int64_t pio_evlog_append_interactions(
     }
     log->last_time = std::max(log->last_time, e.time_ms);
     log->entries.push_back(e);
+    index_new_entry(log, (int64_t)log->entries.size() - 1);
   }
   return n;
 }

@@ -321,7 +321,8 @@ def bench_serving(state, inter):
     server.max_batch_served = 0
     server._conf_server_key = None
     server.http = HttpServer(server._build_router(), "127.0.0.1", 0)
-    server._batcher = _MicroBatcher(server._handle_batch, 32)
+    server._batcher = _MicroBatcher(server._handle_batch,
+                                    server.config.micro_batch)
     server._feedback_poster = _AsyncPoster("feedback")
     server._log_poster = _AsyncPoster("log", workers=1)
     port = server.http.start_background()
@@ -353,15 +354,20 @@ def bench_serving(state, inter):
     p99 = float(lat_ms[int(0.99 * (n_seq - 1))])
     qps_seq = n_seq / seq_wall
 
-    # concurrent: 32 clients; the micro-batcher fuses them
-    n_clients = 32
+    # concurrent: 64 clients; the micro-batcher fuses them
+    n_clients = int(os.environ.get("PIO_BENCH_SERVE_CLIENTS", 64))
     per_client = int(os.environ.get("PIO_BENCH_SERVE_CONC", 25))
-    # warm the batched kernel shapes (powers of two up to 32) so the
-    # concurrent window measures serving, not XLA compiles
+    # warm the batched kernel shapes (powers of two up to the PADDED batch
+    # cap — batch_score_top_k pads B to the next power of two, so a
+    # non-power-of-two micro_batch still lands on 1 << ceil(log2(cap))) so
+    # the concurrent window measures serving, not XLA compiles
     from incubator_predictionio_tpu.models.recommendation.engine import Query
-    for size in (1, 2, 4, 8, 16, 32):
+    cap = 1 << max(server.config.micro_batch - 1, 0).bit_length()
+    size = 1
+    while size <= cap:
         algo.batch_predict(model, [
             (i, Query(user=f"u{i % N_USERS}", num=10)) for i in range(size)])
+        size *= 2
     errors = []
 
     def client(cid: int) -> None:

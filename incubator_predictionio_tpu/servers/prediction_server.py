@@ -69,8 +69,10 @@ class ServerConfig:
     verbose: bool = False
     #: max concurrent queries fused into one batch_predict device dispatch
     #: (0 disables micro-batching; the reference serves queries one at a
-    #: time — CreateServer.scala:523 "TODO: Parallelize")
-    micro_batch: int = 32
+    #: time — CreateServer.scala:523 "TODO: Parallelize"). 64 measured best
+    #: on v5e at ML-20M scale: 397 QPS vs 210 at 32 and 366 at 128 (the
+    #: per-dispatch overhead amortizes until padding waste wins)
+    micro_batch: int = 64
     #: ship query errors to a remote collector (CreateServer.scala:449-460)
     log_url: Optional[str] = None
     log_prefix: str = ""

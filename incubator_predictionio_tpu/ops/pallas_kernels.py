@@ -25,7 +25,12 @@ Two kernels:
   so the single-chip path and the ring-attention path agree.
 
 Both kernels run under ``interpret=True`` on CPU for the test suite and
-compile with Mosaic on real TPU. Callers gate on :func:`pallas_available`.
+compile with Mosaic on real TPU. Callers gate on the PER-FAMILY probes —
+:func:`topk_kernel_available` / :func:`flash_available` — never on
+:func:`pallas_available` alone: Mosaic support is not all-or-nothing (a
+backend can compile the top-k kernel yet reject flash attention's
+lowering), so each family probes its own real kernel at the call sites'
+block shapes before production code selects it.
 """
 
 from __future__ import annotations
@@ -84,6 +89,78 @@ def _probe_mosaic() -> bool:
             "Mosaic probe failed on backend %r; Pallas kernels disabled "
             "for this process (XLA fallback paths will serve): %s",
             jax.default_backend(), exc)
+        return False
+
+
+# Mosaic support is NOT all-or-nothing: a backend can accept the trivial
+# probe and the blocked top-k kernel yet reject flash attention's lowering
+# (observed on the tunneled v5e: top-k compiles and runs, flash attention's
+# remote compile crashes). Each kernel family that production code selects
+# at runtime therefore probes ITSELF — compile + one real execution, with
+# the same block shapes the call sites use — and the result is cached for
+# the process. A failed probe logs once and the caller's XLA path serves.
+
+_topk_ok: "bool | None" = None
+_flash_ok: "bool | None" = None
+
+
+def topk_kernel_available() -> bool:
+    """The serving top-k family: probe the real blocked kernel."""
+    global _topk_ok
+    if _topk_ok is None:
+        if not pallas_available():
+            _topk_ok = False
+        else:
+            _topk_ok = _probe_kernel_runs(
+                lambda: score_and_top_k_pallas(
+                    jnp.zeros((_LANES,), jnp.float32),
+                    jnp.zeros((2 * 8192, _LANES), jnp.float32),
+                    8, block_items=8192),
+                "blocked top-k")
+    return _topk_ok
+
+
+def flash_available() -> bool:
+    """The attention family: probe the real flash kernel FORWARD AND
+    BACKWARD (training differentiates through it) at the call sites' block
+    shapes. First probe compiles two small kernels (seconds, once per
+    process, only when a long-sequence workload actually asks)."""
+    global _flash_ok
+    if _flash_ok is None:
+        if not pallas_available():
+            _flash_ok = False
+        else:
+            def probe():
+                # [B, S, H, D] with S large enough that the q/kv blocks are
+                # the REAL 512-wide call-site shapes, not clamped stubs
+                q = jnp.zeros((1, 1024, 1, 64), jnp.float32)
+                out = flash_attention(q, q, q, q_block=512, kv_block=512)
+                grad = jax.grad(
+                    lambda x: jnp.sum(flash_attention(
+                        x, x, x, q_block=512, kv_block=512)))(q)
+                return out, grad
+
+            _flash_ok = _probe_kernel_runs(probe, "flash attention")
+    return _flash_ok
+
+
+def _probe_kernel_runs(fn, what: str) -> bool:
+    import numpy as np
+
+    try:
+        out = fn()
+        # force real execution (block_until_ready may return early on
+        # tunneled backends; a dependent fetch cannot)
+        for leaf in jax.tree_util.tree_leaves(out):
+            np.asarray(leaf.ravel()[0:1])
+        return True
+    except Exception as exc:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s Pallas kernel unsupported on backend %r; the XLA fallback "
+            "path serves instead: %s", what, jax.default_backend(),
+            str(exc)[:500])
         return False
 
 

@@ -86,8 +86,8 @@ def score_user_and_top_k(
     ``np.asarray``."""
     if item_factors.shape[0] >= PALLAS_MIN_ITEMS and k <= 128:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
-            pallas_available, score_and_top_k_pallas)
-        if pallas_available():
+            score_and_top_k_pallas, topk_kernel_available)
+        if topk_kernel_available():
             # huge catalogs: compute dominates, the extra gather dispatch
             # is noise next to the blocked kernel's win
             return score_and_top_k_pallas(
@@ -155,8 +155,8 @@ def score_and_top_k(
     """
     if item_factors.shape[0] >= PALLAS_MIN_ITEMS and k <= 128:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
-            pallas_available, score_and_top_k_pallas)
-        if pallas_available():
+            score_and_top_k_pallas, topk_kernel_available)
+        if topk_kernel_available():
             return score_and_top_k_pallas(
                 user_vector, item_factors, k,
                 exclude=exclude, allowed_mask=allowed_mask,

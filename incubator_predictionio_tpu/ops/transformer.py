@@ -95,8 +95,8 @@ def _default_attn(q, k, v, causal=True, kv_valid=None):
     # (2·S·D·4B ≤ 8MB) as well as the measured ≈4k crossover vs the scan
     if 4096 < q.shape[1] and 2 * k.shape[1] * q.shape[-1] * 4 <= 8 << 20:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
-            flash_attention, pallas_available)
-        if pallas_available():
+            flash_attention, flash_available)
+        if flash_available():
             return flash_attention(q, k, v, causal=causal, kv_valid=kv_valid,
                                    q_block=512, kv_block=512)
     if q.shape[1] > 1024:

@@ -99,9 +99,12 @@ class StorageClient(base.BaseStorageClient):
                 payload = resp.read()
                 break
             except (http.client.HTTPException, ConnectionError, OSError) as e:
-                # stale keep-alive connection: reconnect (and retry if safe)
+                # stale keep-alive connection: reconnect (and retry if safe).
+                # A TIMEOUT is different: the request likely reached the
+                # server and is still executing — re-sending would run the
+                # same (possibly expensive) call twice concurrently
                 conn.close()
-                if attempt == retries[-1]:
+                if isinstance(e, TimeoutError) or attempt == retries[-1]:
                     raise _storage_error()(
                         f"storage server {self.host}:{self.port} failed "
                         f"during {iface}.{method} ({e!r})"
@@ -202,10 +205,12 @@ RemoteEvents = _proxy(
     extra={"find": _events_find, "close": _events_close},
 )
 #: find_close retries safely (popping a cursor twice is a no-op). find_open
-#: is NOT retried: it allocates a server-side cursor, so re-sending after a
-#: lost response would orphan the first cursor in the bounded table.
+#: retries too: a stale keep-alive connection otherwise fails the *first*
+#: find after an idle period even though the request usually never reached
+#: the server, and the worst case — a lost response orphaning one server
+#: cursor — is already bounded by the server's idle-age cursor eviction.
 #: find_next is stateful by design — a lost pull loses its chunk.
-_IDEMPOTENT = _IDEMPOTENT | {"find_close"}
+_IDEMPOTENT = _IDEMPOTENT | {"find_close", "find_open"}
 RemoteApps = _proxy(
     "Apps", base.Apps,
     ("insert", "get", "get_by_name", "get_all", "update", "delete"))

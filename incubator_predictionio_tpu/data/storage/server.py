@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 from incubator_predictionio_tpu.data.event import EventValidationError
@@ -71,9 +72,22 @@ _ERROR_TYPES = {
 
 #: events per find_next chunk — bounds both sides' memory per round trip
 FIND_CHUNK = 5000
-#: open cursors kept server-side; oldest evicted beyond this (a client that
-#: abandons iteration mid-way cannot pin server memory forever)
+#: open cursors kept server-side before idle-age eviction kicks in (a
+#: client that abandons iteration mid-way cannot pin server memory forever)
 MAX_CURSORS = 64
+#: a cursor pulled within this window is presumed live and is not evicted
+#: at the soft cap — >MAX_CURSORS genuinely concurrent scans grow the table
+#: instead of killing an active iteration mid-find
+CURSOR_MIN_IDLE_S = 30.0
+#: any cursor idle this long is evicted regardless of table size — bounds
+#: the memory an orphaned cursor (e.g. from a retried find_open whose first
+#: request did land) can pin on a low-traffic server
+CURSOR_TTL_S = 600.0
+#: absolute ceiling; beyond this the least-recently-pulled cursor goes even
+#: if recently active (logged as possibly live). 2× the soft cap keeps the
+#: worst-case memory pin near the old fixed-64 bound while still letting a
+#: burst of genuinely concurrent scans complete.
+MAX_CURSORS_HARD = MAX_CURSORS * 2
 
 
 class StorageServer:
@@ -140,6 +154,11 @@ class StorageServer:
                     request.headers.get("x-pio-storage-key") != self.auth_key:
                 return _packed({"ok": False, "etype": "StorageError",
                                 "error": "invalid storage key"}, 401)
+            # sweep on EVERY rpc, not just find traffic: an orphaned cursor
+            # (lost-response find_open retry, crashed client) on an
+            # otherwise-quiet server must still age out past the TTL
+            with self._lock:
+                self._evict_cursors_locked()
             try:
                 msg = wire.unpack(request.body)
                 iface = msg["iface"]
@@ -179,12 +198,8 @@ class StorageServer:
                 with self._lock:
                     self._cursor_seq += 1
                     cursor = f"c{self._cursor_seq}"
-                    self._cursors[cursor] = it
-                    while len(self._cursors) > MAX_CURSORS:
-                        evicted = next(iter(self._cursors))
-                        del self._cursors[evicted]
-                        logger.warning(
-                            "evicted abandoned find cursor %s", evicted)
+                    self._cursors[cursor] = (it, time.monotonic())
+                    self._evict_cursors_locked()
             return {"cursor": cursor, "events": events, "done": done}
         cursor = msg.get("args", [""])[0]
         if method == "find_close":
@@ -195,17 +210,54 @@ class StorageServer:
         # concurrent find_next on the same cursor sees "unknown cursor"
         # instead of a torn read
         with self._lock:
-            it = self._cursors.pop(cursor, None)
-        if it is None:
+            entry = self._cursors.pop(cursor, None)
+        if entry is None:
             raise StorageError(
                 f"unknown find cursor {cursor!r} (expired, evicted, or "
                 "pulled concurrently); re-issue the find")
+        it = entry[0]
         events = list(itertools.islice(it, FIND_CHUNK))
         done = len(events) < FIND_CHUNK
         if not done:
             with self._lock:
-                self._cursors[cursor] = it
+                # re-insert moves the cursor to the tail, so dict order is
+                # least-recently-pulled first — what eviction walks
+                self._cursors[cursor] = (it, time.monotonic())
+                self._evict_cursors_locked()
         return {"cursor": cursor, "events": events, "done": done}
+
+    def _evict_cursors_locked(self) -> None:
+        """Free abandoned cursors by idle age, not raw count: at the soft
+        cap only cursors idle ≥ CURSOR_MIN_IDLE_S go (an active slow scan
+        among >MAX_CURSORS concurrent finds survives); the hard cap evicts
+        the least-recently-pulled regardless, honestly logged."""
+        now = time.monotonic()
+        # TTL sweep first: orphans (lost-response retries, crashed clients)
+        # must not pin backend row sets forever even when the table is small
+        while self._cursors:
+            oldest = next(iter(self._cursors))
+            if now - self._cursors[oldest][1] < CURSOR_TTL_S:
+                break
+            del self._cursors[oldest]
+            logger.warning("evicted find cursor %s past %.0fs TTL",
+                           oldest, CURSOR_TTL_S)
+        while len(self._cursors) > MAX_CURSORS:
+            oldest = next(iter(self._cursors))
+            idle = now - self._cursors[oldest][1]
+            if idle >= CURSOR_MIN_IDLE_S:
+                del self._cursors[oldest]
+                logger.warning(
+                    "evicted find cursor %s idle %.0fs (abandoned?)",
+                    oldest, idle)
+            elif len(self._cursors) > MAX_CURSORS_HARD:
+                del self._cursors[oldest]
+                logger.warning(
+                    "evicted find cursor %s at hard cap %d — it was pulled "
+                    "%.0fs ago and may have been LIVE; that client's find "
+                    "will fail mid-iteration", oldest, MAX_CURSORS_HARD,
+                    idle)
+            else:
+                break  # all remaining cursors recently active; let it grow
 
     # -- lifecycle ---------------------------------------------------------
     def start_background(self) -> int:

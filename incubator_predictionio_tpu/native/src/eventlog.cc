@@ -24,6 +24,7 @@
 #include <ctime>
 #include <deque>
 #include <functional>
+#include <iterator>
 #include <mutex>
 #include <string>
 #include <string_view>
@@ -84,28 +85,62 @@ struct EventLog {
   int64_t last_time = INT64_MIN; // fast-path: appends already in order
   // id_hash → entry index, built LAZILY on the first find_id (explicit-id
   // upserts/re-imports); plain ingest never pays its memory. A sorted flat
-  // vector (16 B/record — a node-based hash map would cost ~4×) plus an
-  // unsorted append tail merged on growth; tombstoned entries are filtered
-  // at query time, so marking dead needs no upkeep.
+  // vector (16 B/record — a node-based hash map would cost ~4×) plus a
+  // logarithmic tail: a ≤4096-entry unsorted buffer and carry-merged
+  // sorted runs of geometrically increasing size (Bentley–Saxe), so an
+  // interleaved lookup+append re-import pays O(log) amortized per append
+  // and O(log² N) per lookup instead of a linear tail walk or an O(N)
+  // merge every fixed-size flush. Tombstoned entries are filtered at
+  // query time, so marking dead needs no upkeep.
   std::vector<std::pair<uint64_t, int64_t>> id_sorted;
-  std::vector<std::pair<uint64_t, int64_t>> id_tail;
+  std::vector<std::pair<uint64_t, int64_t>> id_buf;
+  std::vector<std::vector<std::pair<uint64_t, int64_t>>> id_runs;
+  size_t id_tail_total = 0;  // id_buf + all id_runs
   bool id_index_built = false;
   std::mutex mu;
 };
 
-static void index_new_entry(EventLog* log, int64_t idx) {
-  if (!log->id_index_built || log->entries[idx].dead) return;
-  log->id_tail.emplace_back(log->entries[idx].id_hash, idx);
-  if (log->id_tail.size() > 4096 &&
-      log->id_tail.size() > log->id_sorted.size() / 8) {
+static void flush_id_buf(EventLog* log) {
+  if (log->id_buf.empty()) return;
+  std::sort(log->id_buf.begin(), log->id_buf.end());
+  std::vector<std::pair<uint64_t, int64_t>> run = std::move(log->id_buf);
+  log->id_buf.clear();
+  // carry-merge: absorb every trailing run no larger than the incoming
+  // one, so run sizes stay geometric (largest first) and each entry is
+  // re-merged only O(log) times on its way toward id_sorted
+  while (!log->id_runs.empty() && log->id_runs.back().size() <= run.size()) {
+    std::vector<std::pair<uint64_t, int64_t>> merged;
+    merged.reserve(run.size() + log->id_runs.back().size());
+    std::merge(run.begin(), run.end(), log->id_runs.back().begin(),
+               log->id_runs.back().end(), std::back_inserter(merged));
+    run = std::move(merged);
+    log->id_runs.pop_back();
+  }
+  log->id_runs.push_back(std::move(run));
+}
+
+static void merge_id_tail_into_main(EventLog* log) {
+  flush_id_buf(log);
+  for (auto& run : log->id_runs) {
     const size_t mid = log->id_sorted.size();
-    log->id_sorted.insert(log->id_sorted.end(), log->id_tail.begin(),
-                          log->id_tail.end());
-    std::sort(log->id_sorted.begin() + mid, log->id_sorted.end());
+    log->id_sorted.insert(log->id_sorted.end(), run.begin(), run.end());
     std::inplace_merge(log->id_sorted.begin(),
                        log->id_sorted.begin() + mid, log->id_sorted.end());
-    log->id_tail.clear();
   }
+  log->id_runs.clear();
+  log->id_tail_total = 0;
+}
+
+static void index_new_entry(EventLog* log, int64_t idx) {
+  if (!log->id_index_built || log->entries[idx].dead) return;
+  log->id_buf.emplace_back(log->entries[idx].id_hash, idx);
+  ++log->id_tail_total;
+  if (log->id_buf.size() >= 4096) flush_id_buf(log);
+  // geometric schedule into the main run: amortized O(1) of main-merge
+  // work per append, while lookups stay logarithmic via the runs
+  if (log->id_tail_total > 4096 &&
+      log->id_tail_total > log->id_sorted.size() / 8)
+    merge_id_tail_into_main(log);
 }
 
 static void resort(EventLog* log) {
@@ -310,9 +345,10 @@ int64_t pio_evlog_find_id(void* handle, uint64_t id_hash, int64_t* out,
   std::lock_guard<std::mutex> g(log->mu);
   if (!log->id_index_built) {
     // one linear pass + sort on the FIRST lookup; afterwards appends keep
-    // the index current, so an M-event explicit-id re-import into an
-    // N-record log costs O(N log N + M), not the O(M·N) a per-event scan
-    // would
+    // the index current. An M-event explicit-id re-import into an N-record
+    // log costs O(N log N) for this build, O(log) amortized per append
+    // (carry-merged runs), and O(log² N) + a ≤4096 linear buffer walk per
+    // lookup — far below the O(M·N) of a per-event scan
     log->id_sorted.reserve(log->entries.size());
     for (size_t i = 0; i < log->entries.size(); ++i)
       if (!log->entries[i].dead)
@@ -321,12 +357,17 @@ int64_t pio_evlog_find_id(void* handle, uint64_t id_hash, int64_t* out,
     log->id_index_built = true;
   }
   int64_t n = 0;
+  const auto probe = std::make_pair(id_hash, INT64_MIN);
   auto lo = std::lower_bound(
-      log->id_sorted.begin(), log->id_sorted.end(),
-      std::make_pair(id_hash, INT64_MIN));
+      log->id_sorted.begin(), log->id_sorted.end(), probe);
   for (; lo != log->id_sorted.end() && lo->first == id_hash && n < cap; ++lo)
     if (!log->entries[lo->second].dead) out[n++] = lo->second;
-  for (const auto& kv : log->id_tail)
+  for (const auto& run : log->id_runs) {
+    auto it = std::lower_bound(run.begin(), run.end(), probe);
+    for (; it != run.end() && it->first == id_hash && n < cap; ++it)
+      if (!log->entries[it->second].dead) out[n++] = it->second;
+  }
+  for (const auto& kv : log->id_buf)
     if (n < cap && kv.first == id_hash && !log->entries[kv.second].dead)
       out[n++] = kv.second;
   return n;

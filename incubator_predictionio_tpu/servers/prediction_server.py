@@ -150,6 +150,8 @@ class _AsyncPoster:
         import queue
 
         self._queue: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self.dropped = 0  # surfaced on the status page (feedback is data)
+        self._dropped_lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"pio-poster-{name}-{i}")
@@ -161,10 +163,17 @@ class _AsyncPoster:
     def submit(self, fn, what: str) -> None:
         import queue
 
+        # never blocks: submit runs on the serving hot path (the single
+        # micro-batcher thread), where even a brief put(timeout=...) under
+        # a collector outage would stall every query behind it
         try:
             self._queue.put_nowait(fn)
         except queue.Full:
-            logger.error("async post queue full; dropping %s", what)
+            with self._dropped_lock:
+                self.dropped += 1
+                n = self.dropped
+            logger.error("async post queue full; dropping %s (%d dropped "
+                         "total)", what, n)
 
     def stop(self) -> None:
         import queue
@@ -230,7 +239,10 @@ class PredictionServer:
             _MicroBatcher(self._handle_batch, config.micro_batch)
             if config.micro_batch > 0 else None
         )
-        self._feedback_poster = _AsyncPoster("feedback")
+        # feedback events are training data: a deep queue so only a
+        # sustained collector outage drops (drops counted and shown on the
+        # status page); --log-url diagnostics stay shallow and lossy
+        self._feedback_poster = _AsyncPoster("feedback", maxsize=16384)
         self._log_poster = _AsyncPoster("log", workers=1, maxsize=256)
 
     # -- deploy lifecycle ---------------------------------------------------
@@ -496,6 +508,7 @@ class PredictionServer:
                     "avgServingSec": self.avg_serving_sec,
                     "lastServingSec": self.last_serving_sec,
                     "maxBatchServed": self.max_batch_served,
+                    "feedbackEventsDropped": self._feedback_poster.dropped,
                 }
             accept = request.headers.get("accept", "")
             if "text/html" in accept:

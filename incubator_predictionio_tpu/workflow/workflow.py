@@ -141,7 +141,15 @@ class CoreWorkflow:
         params: Optional[WorkflowParams] = None,
     ) -> List[Any]:
         """Restore checkpointed models (CreateServer.scala:216-220 kryo invert
-        + Engine.prepareDeploy)."""
+        + Engine.prepareDeploy).
+
+        The decoder resolves model dataclasses from ALREADY-IMPORTED modules
+        only (checkpoint._resolve_dataclass — no import side effects on
+        decode). Deploy/eval satisfy this by construction: the engine
+        factory is resolved (hence its module imported) before any blob is
+        read. Programmatic callers passing just ``instance_id`` must import
+        the engine module first, or set ``PIO_CHECKPOINT_ALLOW_IMPORT=1``
+        to restore the pre-r3 importlib behavior for trusted stores."""
         blob = Storage.get_model_data_models().get(instance_id)
         if blob is None:
             raise ValueError(f"No models stored for engine instance {instance_id}")

@@ -93,18 +93,23 @@ class StorageClient(base.BaseStorageClient):
         # state to the caller instead.
         retries = (0, 1) if method in _IDEMPOTENT else (0,)
         for attempt in retries:
+            sent = False
             try:
                 conn.request("POST", "/rpc", body=body, headers=headers)
+                sent = True
                 resp = conn.getresponse()
                 payload = resp.read()
                 break
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 # stale keep-alive connection: reconnect (and retry if safe).
-                # A TIMEOUT is different: the request likely reached the
-                # server and is still executing — re-sending would run the
-                # same (possibly expensive) call twice concurrently
+                # A timeout AFTER the request was sent is different: the
+                # server is likely still executing the call — re-sending
+                # would run the same (possibly expensive) work twice
+                # concurrently. A connect-phase timeout never reached the
+                # server, so it stays retryable.
                 conn.close()
-                if isinstance(e, TimeoutError) or attempt == retries[-1]:
+                if (sent and isinstance(e, TimeoutError)) \
+                        or attempt == retries[-1]:
                     raise _storage_error()(
                         f"storage server {self.host}:{self.port} failed "
                         f"during {iface}.{method} ({e!r})"

@@ -407,11 +407,22 @@ class ALSAlgorithm(Algorithm):
         state; see Algorithm.prepare_model)."""
         import jax
 
-        return dataclasses.replace(
-            model,
-            user_factors=jax.device_put(np.asarray(model.user_factors)),
-            item_factors=jax.device_put(np.asarray(model.item_factors)),
+        from incubator_predictionio_tpu.ops.host_serving import (
+            warm_host_arrays,
         )
+
+        np_users = np.asarray(model.user_factors)
+        np_items = np.asarray(model.item_factors)
+        model = dataclasses.replace(
+            model,
+            user_factors=jax.device_put(np_users),
+            item_factors=jax.device_put(np_items),
+        )
+        # pre-warm the host mirror (same field order as the serving call
+        # sites) — the first query never pays a device→host factor fetch
+        warm_host_arrays(
+            model, user_factors=np_users, item_factors=np_items)
+        return model
 
     # -- serving ----------------------------------------------------------
     def _allowed_mask(

@@ -1,31 +1,109 @@
-"""Host-resident serving helpers for small models.
+"""Host-resident serving helpers (the reference's driver-local locality).
 
 The deployed environment may reach the TPU through a network tunnel whose
 blocking dispatch+fetch round trip is tens of milliseconds — the latency
-floor for ANY per-query device call. Models whose factor tables are a few
-MB serve faster from a host copy (numpy matvec + argpartition — the
-reference's driver-local serving locality, CreateServer.scala:498-650);
-big catalogs keep the device path, where compute dominates the round trip.
+floor for ANY per-query device call. Models whose factor tables fit a host
+mirror serve singleton queries faster from numpy (matvec + argpartition —
+the reference's driver-local serving locality, CreateServer.scala:498-650).
+
+How big "fits" is is ADAPTIVE: the first caller measures the device
+dispatch+fetch overhead once (a dependent 1-element fetch — on this
+platform `block_until_ready` returns before execution finishes, so only a
+fetch observes the true round trip). When the round trip is expensive
+(≥5 ms: tunneled or remote device), the mirror budget grows to 64M
+elements (256 MB f32) so even an ML-20M-scale catalog (~21M elems) serves
+from the host at sub-ms instead of paying the tunnel per query; when the
+device is local (sub-ms dispatch), the budget stays at 4M elements and
+large catalogs keep the device path, where the MXU wins.
+
+``PIO_HOST_SERVE_MAX_ELEMS`` overrides the measurement entirely
+(0 disables host serving).
 
 Used by the recommendation / similarproduct / ecommerce serving code.
 """
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Optional, Tuple
 
 import numpy as np
 
 NEG_INF = -3.4e38
 
-#: models up to this many cached elements serve from the host copy
+#: mirror budget when the device round trip is cheap (local chip)
 HOST_SERVE_MAX_ELEMS = 1 << 22
+#: mirror budget when every device call pays an expensive round trip
+HOST_SERVE_BIG_ELEMS = 1 << 26
+#: dispatch+fetch round trip above this means "expensive device"
+DISPATCH_EXPENSIVE_S = 5e-3
+
+_dispatch_overhead: Optional[float] = None
 
 
-def host_arrays(model, *field_names: str,
-                max_elems: int = HOST_SERVE_MAX_ELEMS):
+def dispatch_overhead_s() -> float:
+    """Measured device dispatch+fetch round trip (cached; best of 3)."""
+    global _dispatch_overhead
+    if _dispatch_overhead is None:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            fn = jax.jit(lambda v: v + 1)
+            x = jnp.zeros(8, jnp.float32)
+            np.asarray(fn(x))  # compile + warm outside the timed window
+            samples = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(fn(x))
+                samples.append(time.perf_counter() - t0)
+            _dispatch_overhead = min(samples)
+        except Exception:
+            _dispatch_overhead = 0.0
+    return _dispatch_overhead
+
+
+def host_serve_limit() -> int:
+    """Current mirror budget in elements (env override, else adaptive)."""
+    env = os.environ.get("PIO_HOST_SERVE_MAX_ELEMS", "").strip()
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ignoring malformed PIO_HOST_SERVE_MAX_ELEMS=%r "
+                "(want an integer element count); using the adaptive "
+                "budget", env)
+    if dispatch_overhead_s() >= DISPATCH_EXPENSIVE_S:
+        return HOST_SERVE_BIG_ELEMS
+    return HOST_SERVE_MAX_ELEMS
+
+
+def warm_host_arrays(model, **field_arrays: np.ndarray) -> None:
+    """Seed the host mirror from numpy copies already in hand (e.g. inside
+    ``prepare_model`` before factors are device_put), so the first query
+    never pays a device→host fetch. Owns the same cache-key contract as
+    :func:`host_arrays`; respects the budget and any disabled cache."""
+    cache = getattr(model, "_np_cache", None)
+    if cache is False:
+        return
+    names = tuple(field_arrays)
+    arrays = tuple(field_arrays.values())
+    if sum(a.size for a in arrays) > host_serve_limit():
+        return
+    if cache is None:
+        cache = {}
+        object.__setattr__(model, "_np_cache", cache)
+    cache[names] = arrays
+
+
+def host_arrays(model, *field_names: str, max_elems: Optional[int] = None):
     """Lazy host copies of the named model fields, or None for big models.
 
+    ``max_elems=None`` uses the adaptive budget (``host_serve_limit``).
     The copy is cached on the model object itself (``_np_cache``, keyed by
     the requested field names) so reloads naturally invalidate it. A benign
     race under concurrent first queries computes the same value twice."""
@@ -37,8 +115,16 @@ def host_arrays(model, *field_names: str,
         object.__setattr__(model, "_np_cache", cache)
     entry = cache.get(field_names)
     if entry is None:
-        arrays = tuple(np.asarray(getattr(model, f)) for f in field_names)
-        entry = arrays if sum(a.size for a in arrays) <= max_elems else False
+        if max_elems is None:
+            max_elems = host_serve_limit()
+        total = sum(
+            int(np.prod(getattr(model, f).shape)) for f in field_names)
+        if total <= max_elems:
+            # one device→host fetch per field, paid once per deploy
+            entry = tuple(
+                np.asarray(getattr(model, f)) for f in field_names)
+        else:
+            entry = False
         cache[field_names] = entry
     return entry or None
 

@@ -197,16 +197,49 @@ def run(platform_cpu: bool = False) -> None:
         np.asarray(out.item_factors[0:1, 0:1])
         return out
 
+    # persistent compile cache: a FRESH directory so the first compile is
+    # honestly cold (and writes the entry); clearing the in-memory
+    # executable cache then forces a re-trace that must hit the persistent
+    # entry — the compile cost every pio process after the first pays.
+    # Both compile numbers subtract the warm execution time (each timed
+    # call runs the full training once), so they are pure compile cost.
+    from incubator_predictionio_tpu.utils import compile_cache
+
+    import atexit
+    import shutil
+
+    xla_cache_dir = tempfile.mkdtemp(prefix="pio_bench_xla_")
+    atexit.register(shutil.rmtree, xla_cache_dir, True)
+    compile_cache.enable(xla_cache_dir)
+
     t0 = time.perf_counter()
     state = train(als.als_init(jax.random.key(0), n_users, n_items, RANK))
-    compile_s = time.perf_counter() - t0
+    first_call_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     state = train(als.als_init(jax.random.key(0), n_users, n_items, RANK))
     train_s = time.perf_counter() - t0
+    compile_s = max(first_call_s - train_s, 0.0)
+    cache_engaged = bool(os.listdir(xla_cache_dir))
+    compile_warm_cache_s = None
+    if cache_engaged:
+        jax.clear_caches()  # drop in-memory executables; cache dir stays
+        t0 = time.perf_counter()
+        state = train(als.als_init(jax.random.key(0), n_users, n_items,
+                                   RANK))
+        compile_warm_cache_s = round(
+            max(time.perf_counter() - t0 - train_s, 0.0), 1)
+        log(f"compile: cold={compile_s:.1f}s warm-persistent-cache="
+            f"{compile_warm_cache_s}s (dir {xla_cache_dir})")
+    else:
+        # PIO_COMPILE_CACHE=off in the environment, or the cache was
+        # rejected: do NOT publish a second cold compile as "warm"
+        log("compile: persistent cache did not engage "
+            "(PIO_COMPILE_CACHE=off or cache rejected); "
+            f"cold={compile_s:.1f}s")
     fit = als.rmse(state, inter.user_idx, inter.item_idx, inter.values)
     flops = als_flops_per_run()
     mfu = flops / train_s / PEAK_FLOPS_F32
-    log(f"device={jax.devices()[0]} compile+first={compile_s:.1f}s "
+    log(f"device={jax.devices()[0]} compile={compile_s:.1f}s "
         f"warm={train_s:.2f}s rmse={fit:.3f} "
         f"flops={flops:.3e} mfu={mfu:.3f}")
 
@@ -231,7 +264,8 @@ def run(platform_cpu: bool = False) -> None:
         "vs_baseline": round(CPU_BASELINE_TRAIN_S / train_s, 1),
         "train_rmse": round(float(fit), 3),
         "mfu": round(mfu, 4),
-        "compile_s": round(compile_s, 1),
+        "compile_s_cold": round(compile_s, 1),
+        "compile_s_warm_cache": compile_warm_cache_s,
         "seed_wall_s": round(seed_s, 1),
         "ingest_wall_s": round(ingest_s, 1),
         "prep_wall_s": round(prep_s, 1),

@@ -86,13 +86,16 @@ class StorageClient(base.BaseStorageClient):
         if self.auth_key:
             headers["X-Pio-Storage-Key"] = self.auth_key
         conn = self._conn()
-        # Only idempotent methods retry after a connection failure: a write
-        # like insert/import may already have executed server-side when the
-        # response is lost, and silently re-sending it would commit the
-        # payload twice. Non-idempotent calls surface the indeterminate
-        # state to the caller instead.
-        retries = (0, 1) if method in _IDEMPOTENT else (0,)
-        for attempt in retries:
+        # Retry policy after a connection failure. Failures BEFORE the
+        # request body went out (sent=False: connect error, send error on a
+        # stale keep-alive) provably never executed server-side, so any
+        # method retries once. After the body was sent, only idempotent
+        # methods retry — a write like insert/import may already have
+        # executed when the response is lost, and silently re-sending it
+        # would commit the payload twice. A timeout after send is never
+        # retried even for reads: the server is likely still executing the
+        # call, and re-sending would run the same work twice concurrently.
+        for attempt in (0, 1):
             sent = False
             try:
                 conn.request("POST", "/rpc", body=body, headers=headers)
@@ -101,21 +104,21 @@ class StorageClient(base.BaseStorageClient):
                 payload = resp.read()
                 break
             except (http.client.HTTPException, ConnectionError, OSError) as e:
-                # stale keep-alive connection: reconnect (and retry if safe).
-                # A timeout AFTER the request was sent is different: the
-                # server is likely still executing the call — re-sending
-                # would run the same (possibly expensive) work twice
-                # concurrently. A connect-phase timeout never reached the
-                # server, so it stays retryable.
                 conn.close()
-                if (sent and isinstance(e, TimeoutError)) \
-                        or attempt == retries[-1]:
+                retryable = (not sent) or (
+                    method in _IDEMPOTENT
+                    and not isinstance(e, TimeoutError))
+                if attempt == 1 or not retryable:
+                    if not sent:
+                        state = "; the request was never sent — it was NOT applied"
+                    elif method in _IDEMPOTENT:
+                        state = ""
+                    else:
+                        state = ("; the call is not idempotent — it may or "
+                                 "may not have been applied")
                     raise _storage_error()(
                         f"storage server {self.host}:{self.port} failed "
-                        f"during {iface}.{method} ({e!r})"
-                        + ("" if method in _IDEMPOTENT else
-                           "; the call is not idempotent — it may or may "
-                           "not have been applied"))
+                        f"during {iface}.{method} ({e!r})" + state)
         msg = wire.unpack(payload)
         if msg.get("ok"):
             return msg.get("value")

@@ -22,36 +22,57 @@ logger = logging.getLogger(__name__)
 _enabled = False
 
 
-def enable() -> None:
-    """Idempotently enable the persistent compilation cache."""
+def enable(cache_dir: str | None = None) -> None:
+    """Idempotently enable the persistent compilation cache.
+
+    ``cache_dir`` overrides the resolution below (used by the bench to
+    point at a fresh directory for an honestly-cold measurement).
+
+    On platforms whose site customization pre-imports jax at interpreter
+    startup (the tunneled TPU image does), setting the JAX_* env vars is
+    ALWAYS too late — jax.config has already read its defaults — so when
+    jax is in sys.modules the settings are applied via jax.config.update
+    directly. The env vars are still set for child processes and for
+    platforms where jax genuinely hasn't been imported yet (there they
+    keep `pio app new`-style commands from paying the jax import)."""
     global _enabled
     if _enabled:
         return
     setting = os.environ.get("PIO_COMPILE_CACHE", "")
     if setting.lower() in ("off", "0", "false", "disable"):
         return
-    if setting and setting.lower() not in ("on", "1", "true"):
-        cache_dir = setting
-    else:
-        from incubator_predictionio_tpu.data.storage import pio_home
+    explicit = cache_dir is not None or (
+        setting and setting.lower() not in ("on", "1", "true"))
+    if cache_dir is None:
+        if setting and setting.lower() not in ("on", "1", "true"):
+            cache_dir = setting
+        else:
+            from incubator_predictionio_tpu.data.storage import pio_home
 
-        cache_dir = os.path.join(pio_home(), "xla_cache")
+            cache_dir = os.path.join(pio_home(), "xla_cache")
     try:
+        # a user-set JAX_COMPILATION_CACHE_DIR still wins over the implicit
+        # PIO_HOME default; explicit PIO_COMPILE_CACHE=/path or a direct
+        # cache_dir argument wins over everything
+        if explicit:
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        else:
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        cache_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
         os.makedirs(cache_dir, exist_ok=True)
-        # env vars, NOT jax.config: jax reads these at import time, so
-        # commands that never touch jax (app new, status, export) stay
-        # fast while train/deploy still get the cache when they import it
-        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
         # cache every program that takes noticeable time to compile
+        # (setdefault: a user-tuned threshold wins here too)
         os.environ.setdefault(
             "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+        min_compile_s = float(
+            os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"])
         import sys
-        if "jax" in sys.modules:  # already imported: apply directly
+        if "jax" in sys.modules:  # pre-imported: env vars are too late
             import jax
 
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.5)
+                "jax_persistent_cache_min_compile_time_secs", min_compile_s)
         _enabled = True
     except Exception as exc:  # pragma: no cover - cache is best-effort
         logger.warning("compilation cache unavailable: %s", exc)

@@ -1,0 +1,73 @@
+"""Persistent-compile-cache wiring (utils/compile_cache.py).
+
+The cache itself is jax's; what this framework owns — and what round-3
+shipped broken — is the wiring: on platforms that pre-import jax at
+interpreter startup (the TPU image's site customization), env vars are
+read too late, so enable() must apply jax.config.update directly.
+"""
+
+import os
+
+import jax
+import pytest
+
+from incubator_predictionio_tpu.utils import compile_cache
+
+
+@pytest.fixture(autouse=True)
+def _reset_enable_state(monkeypatch):
+    monkeypatch.setattr(compile_cache, "_enabled", False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("PIO_COMPILE_CACHE", raising=False)
+    old = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_enable_applies_config_when_jax_preimported(tmp_path):
+    # jax IS imported in this process — the env-var path alone would be a
+    # silent no-op, which is exactly the round-3 bug
+    compile_cache.enable(str(tmp_path / "cache"))
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "cache")
+    assert os.path.isdir(tmp_path / "cache")
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == str(tmp_path / "cache")
+
+
+def test_enable_off_switch(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_COMPILE_CACHE", "off")
+    before = jax.config.jax_compilation_cache_dir
+    compile_cache.enable(str(tmp_path / "cache"))
+    assert jax.config.jax_compilation_cache_dir == before
+    assert not (tmp_path / "cache").exists()
+
+
+def test_enable_respects_user_env_over_implicit_default(
+        tmp_path, monkeypatch):
+    user_dir = str(tmp_path / "user")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", user_dir)
+    monkeypatch.setenv("PIO_HOME", str(tmp_path / "home"))
+    compile_cache.enable()  # implicit PIO_HOME default must NOT override
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == user_dir
+    assert jax.config.jax_compilation_cache_dir == user_dir
+
+
+def test_enable_is_idempotent(tmp_path):
+    compile_cache.enable(str(tmp_path / "a"))
+    compile_cache.enable(str(tmp_path / "b"))  # second call: no-op
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "a")
+
+
+def test_persistent_cache_round_trip(tmp_path):
+    """A compiled program lands in the cache dir and is read back after
+    the in-memory executable cache is cleared (the cross-process story,
+    driven in-process via jax.clear_caches)."""
+    import numpy as np
+
+    compile_cache.enable(str(tmp_path / "cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    f = jax.jit(lambda a: a * 2 + 1)
+    np.asarray(f(jax.numpy.ones(16)))
+    entries = list((tmp_path / "cache").iterdir())
+    assert entries, "no persistent cache entry written"
+    jax.clear_caches()
+    np.asarray(f(jax.numpy.ones(16)))  # served from the persistent entry

@@ -325,6 +325,10 @@ class ALSAlgorithmParams(Params):
     num_iterations: int = 20
     lambda_: float = 0.01
     seed: Optional[int] = None
+    #: mixed-precision schedule: run this many early sweeps with bf16
+    #: gathers/matmuls before the f32 polish sweeps (ops/als.py
+    #: ``_mixed_run``) — the TPU fast path; 0 = all-f32 (MLlib parity)
+    bf16_sweeps: int = 0
 
 
 @dataclasses.dataclass
@@ -371,6 +375,7 @@ class ALSAlgorithm(Algorithm):
                 iterations=self.params.num_iterations,
                 l2=self.params.lambda_,
                 seed=seed,
+                bf16_sweeps=self.params.bf16_sweeps,
             )
         else:
             state, _ = als_train(
@@ -380,6 +385,7 @@ class ALSAlgorithm(Algorithm):
                 iterations=self.params.num_iterations,
                 l2=self.params.lambda_,
                 seed=seed,
+                bf16_sweeps=self.params.bf16_sweeps,
             )
         logger.info(
             "ALS trained: %d users × %d items, rank %d",

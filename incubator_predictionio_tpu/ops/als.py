@@ -77,8 +77,18 @@ def _gram_rhs_nnz(
     accumulates in f32 at the given matmul precision (see the note on
     :func:`_solve_bucket`). Used by the bucket solvers AND the split-row
     partial-Gram path so their numerics cannot drift apart."""
-    gathered = other_factors[cols]                      # [..., D, K]
-    masked = gathered * mask[..., None]
+    # The gather is the dominant HBM stream at scale ([..., D, K] ≈
+    # nnz·K elements per half-sweep): casting the SOURCE table to
+    # compute_dtype first halves that traffic in bf16 mode AND hands the
+    # MXU single-pass bf16 operands (vs the 6-pass f32 HIGHEST schedule).
+    # Implicit mode NEVER casts — its bucket solver is hardcoded f32, and
+    # the heavy (split-row) path must match it exactly (the "numerics
+    # cannot drift apart" contract above).
+    src = (other_factors
+           if implicit or other_factors.dtype == compute_dtype
+           else other_factors.astype(compute_dtype))
+    gathered = src[cols]                                # [..., D, K]
+    masked = gathered * mask[..., None].astype(gathered.dtype)
     if implicit:
         conf_minus1 = alpha * vals * mask               # (c-1), 0 on padding
         gram = jnp.einsum(
@@ -90,13 +100,12 @@ def _gram_rhs_nnz(
             preferred_element_type=jnp.float32, precision=precision,
         )
     else:
-        g16 = masked.astype(compute_dtype)
         gram = jnp.einsum(
-            "...dk,...dl->...kl", g16, gathered.astype(compute_dtype),
+            "...dk,...dl->...kl", masked, gathered,
             preferred_element_type=jnp.float32, precision=precision,
         )
         rhs = jnp.einsum(
-            "...d,...dk->...k", (vals * mask).astype(compute_dtype), g16,
+            "...d,...dk->...k", (vals * mask).astype(gathered.dtype), masked,
             preferred_element_type=jnp.float32, precision=precision,
         )
     return gram, rhs, mask.sum(axis=-1)
@@ -115,6 +124,13 @@ def _gram_rhs_nnz(
 #: ML-20M-shape workloads, and the solve cost is linear in the budget)
 _SOLVER = os.environ.get("PIO_ALS_SOLVER", "cg")
 _CG_ITERS = int(os.environ.get("PIO_ALS_CG_ITERS", "16"))
+#: CG budget for the bf16 early sweeps of the mixed schedule. Each CG
+#: iteration re-reads the whole [rows, K, K] f32 Gram batch (~9 GB at
+#: ML-20M scale on the user side) — the dominant HBM stream once gathers
+#: run bf16 — and early sweeps are re-solved from scratch next sweep
+#: anyway, so a loose solve costs nothing in final quality (the f32
+#: polish runs the full budget; guarded by the planted-recovery test).
+_CG_ITERS_BF16 = int(os.environ.get("PIO_ALS_CG_ITERS_BF16", "6"))
 
 
 def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int) -> jax.Array:
@@ -155,6 +171,7 @@ def _reg_solve(
     reg_nnz: bool,
     implicit: bool,
     yty: Optional[jax.Array],
+    cg_iters: int = _CG_ITERS,
 ) -> jax.Array:
     """Regularize + batched SPD solve; zero factors for empty rows."""
     rank = gram.shape[-1]
@@ -168,7 +185,7 @@ def _reg_solve(
     if _SOLVER == "cg":
         # implicit grams are dominated by the shared YᵗY with only λ (not
         # λ·nnz) on the diagonal — worse conditioned, so double the budget
-        sol = _cg_solve_spd(a, rhs, _CG_ITERS * (2 if implicit else 1))
+        sol = _cg_solve_spd(a, rhs, cg_iters * (2 if implicit else 1))
     else:
         chol = jax.scipy.linalg.cho_factor(a)
         sol = jax.scipy.linalg.cho_solve(chol, rhs[..., None])[..., 0]
@@ -176,7 +193,8 @@ def _reg_solve(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("reg_nnz", "compute_dtype", "precision")
+    jax.jit,
+    static_argnames=("reg_nnz", "compute_dtype", "precision", "cg_iters"),
 )
 def _solve_bucket(
     other_factors: jax.Array,  # [M, K] f32
@@ -187,6 +205,7 @@ def _solve_bucket(
     reg_nnz: bool = True,
     compute_dtype: Any = jnp.float32,
     precision: Any = jax.lax.Precision.HIGHEST,
+    cg_iters: int = _CG_ITERS,
 ) -> jax.Array:
     """Batched normal-equation solve for one degree bucket → [B, K].
 
@@ -201,7 +220,8 @@ def _solve_bucket(
     gram, rhs, nnz = _gram_rhs_nnz(
         other_factors, cols, vals, mask, compute_dtype, precision,
         implicit=False, alpha=0.0)
-    return _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit=False, yty=None)
+    return _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit=False, yty=None,
+                      cg_iters=cg_iters)
 
 
 #: f32-element budget for one bucket chunk's gather intermediate
@@ -294,6 +314,7 @@ def _sweep_side(
     compute_dtype: Any,
     precision: Any,
     implicit: bool,
+    cg_iters: int = _CG_ITERS,
 ) -> jax.Array:
     """One half-sweep (traced): solve every bucket + split rows, scatter.
 
@@ -303,17 +324,25 @@ def _sweep_side(
     rank = other_factors.shape[1]
     out = jnp.zeros((n_rows, rank), jnp.float32)
     yty = _gram_all(other_factors, precision) if implicit else None
+    # Hoist the compute-dtype cast of the gather source to once per
+    # half-sweep — inside the chunked lax.map it would re-cast the whole
+    # table per chunk (~150 chunks/half-sweep at ML-20M), swamping the
+    # bf16 traffic saving it exists to provide. Implicit mode stays f32.
+    gsrc = other_factors
+    if not implicit and other_factors.dtype != compute_dtype:
+        gsrc = other_factors.astype(compute_dtype)
     for row_ids, cols, vals, mask in tree:
         if implicit:
             def solver(t, _yty=yty):
                 return _solve_bucket_implicit(
                     other_factors, _yty, t[0], t[1], t[2], l2, alpha,
-                    precision=precision)
+                    precision=precision, cg_iters=cg_iters)
         else:
             def solver(t):
                 return _solve_bucket(
-                    other_factors, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
-                    compute_dtype=compute_dtype, precision=precision)
+                    gsrc, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
+                    compute_dtype=compute_dtype, precision=precision,
+                    cg_iters=cg_iters)
         # large buckets solve in bounded row chunks (lax.map) so the
         # [B, D, K] gather / [B, K, K] gram temps never exceed the chunk
         # budget — the ML-20M-scale HBM requirement
@@ -321,8 +350,8 @@ def _sweep_side(
         out = _scatter_rows_impl(out, row_ids, sol)
     if heavy is not None:
         h_ids, h_sol = _solve_heavy(
-            other_factors, heavy, l2, alpha, reg_nnz, compute_dtype,
-            precision, implicit, yty)
+            gsrc, heavy, l2, alpha, reg_nnz, compute_dtype,
+            precision, implicit, yty, cg_iters=cg_iters)
         out = _scatter_rows_impl(out, h_ids, h_sol)
     return out
 
@@ -330,12 +359,14 @@ def _sweep_side(
 @functools.partial(
     jax.jit,
     static_argnames=("n_rows", "reg_nnz", "compute_dtype", "precision",
-                     "implicit"),
+                     "implicit", "cg_iters"),
 )
 def _sweep_side_jit(n_rows, other_factors, tree, heavy, l2, alpha, reg_nnz,
-                    compute_dtype, precision, implicit):
+                    compute_dtype, precision, implicit,
+                    cg_iters=_CG_ITERS):
     return _sweep_side(n_rows, other_factors, tree, heavy, l2, alpha,
-                       reg_nnz, compute_dtype, precision, implicit)
+                       reg_nnz, compute_dtype, precision, implicit,
+                       cg_iters=cg_iters)
 
 
 def _update_side(
@@ -409,7 +440,7 @@ def als_sweep(
 # ---------------------------------------------------------------------------
 
 @functools.partial(
-    jax.jit, static_argnames=("precision",)
+    jax.jit, static_argnames=("precision", "cg_iters")
 )
 def _solve_bucket_implicit(
     other_factors: jax.Array,  # [M, K]
@@ -420,6 +451,7 @@ def _solve_bucket_implicit(
     l2: float,
     alpha: float,
     precision: Any = jax.lax.Precision.HIGHEST,
+    cg_iters: int = _CG_ITERS,
 ) -> jax.Array:
     """Per-row system: (YᵗY + Yᵤᵗ(Cᵤ−I)Yᵤ + λI) x = Yᵤᵗ cᵤ with
     c = 1 + α·r and binary preference — YᵗY is shared across the whole
@@ -428,7 +460,8 @@ def _solve_bucket_implicit(
     gram, rhs, nnz = _gram_rhs_nnz(
         other_factors, cols, vals, mask, jnp.float32, precision,
         implicit=True, alpha=alpha)
-    return _reg_solve(gram, rhs, nnz, l2, True, implicit=True, yty=yty)
+    return _reg_solve(gram, rhs, nnz, l2, True, implicit=True, yty=yty,
+                      cg_iters=cg_iters)
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))
@@ -521,6 +554,7 @@ def als_train_sharded(
     compute_dtype: Any = jnp.float32,
     precision: Any = jax.lax.Precision.HIGHEST,
     max_width: int = 1 << 16,
+    bf16_sweeps: int = 0,
 ) -> ALSState:
     """Mesh-sharded training — the full ALX layout (PAPERS.md: ALX §4).
 
@@ -593,13 +627,20 @@ def als_train_sharded(
             jnp.pad(state0.item_factors, ((0, n_items_p - n_items), (0, 0))),
             tables),
     )
-    out = _als_run_fused(
-        state, place_tree(user_light), place_tree(item_light),
-        l2, alpha, iterations, reg_nnz, compute_dtype, precision,
-        implicit=implicit,
-        user_heavy=place_heavy(user_heavy),
-        item_heavy=place_heavy(item_heavy),
-    )
+    u_tree, i_tree = place_tree(user_light), place_tree(item_light)
+    u_hv, i_hv = place_heavy(user_heavy), place_heavy(item_heavy)
+    if implicit:
+        out = _als_run_fused(
+            state, u_tree, i_tree, l2, alpha, iterations, reg_nnz,
+            compute_dtype, precision, implicit=True,
+            user_heavy=u_hv, item_heavy=i_hv,
+        )
+    else:
+        out = _mixed_run(
+            state, u_tree, i_tree, l2, iterations, bf16_sweeps,
+            reg_nnz, compute_dtype, precision,
+            user_heavy=u_hv, item_heavy=i_hv,
+        )
     return ALSState(user_factors=out.user_factors[:n_users],
                     item_factors=out.item_factors[:n_items])
 
@@ -672,6 +713,7 @@ def _solve_heavy(
     precision: Any,
     implicit: bool,
     yty: Optional[jax.Array],
+    cg_iters: int = _CG_ITERS,
 ) -> Tuple[jax.Array, jax.Array]:
     """Partial-Gram combining solve for split rows → (row_ids, sol[H, K]).
 
@@ -686,13 +728,14 @@ def _solve_heavy(
     gram = jax.ops.segment_sum(pg, seg_ids, num_segments=n_heavy)
     rhs = jax.ops.segment_sum(prhs, seg_ids, num_segments=n_heavy)
     nnz = jax.ops.segment_sum(pnnz, seg_ids, num_segments=n_heavy)
-    return row_ids, _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit, yty)
+    return row_ids, _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit, yty,
+                               cg_iters=cg_iters)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("iterations", "reg_nnz", "compute_dtype", "precision",
-                     "implicit"),
+                     "implicit", "cg_iters"),
     donate_argnames=("state",),
 )
 def _als_run_fused(
@@ -708,17 +751,61 @@ def _als_run_fused(
     implicit: bool,
     user_heavy=None,
     item_heavy=None,
+    cg_iters: int = _CG_ITERS,
 ) -> ALSState:
     def body(_, st):
         new_users = _sweep_side(
             st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
-            l2, alpha, reg_nnz, compute_dtype, precision, implicit)
+            l2, alpha, reg_nnz, compute_dtype, precision, implicit,
+            cg_iters=cg_iters)
         new_items = _sweep_side(
             st.item_factors.shape[0], new_users, item_tree, item_heavy,
-            l2, alpha, reg_nnz, compute_dtype, precision, implicit)
+            l2, alpha, reg_nnz, compute_dtype, precision, implicit,
+            cg_iters=cg_iters)
         return ALSState(user_factors=new_users, item_factors=new_items)
 
     return jax.lax.fori_loop(0, iterations, body, state)
+
+
+def _mixed_run(
+    state: ALSState,
+    u_tree,
+    i_tree,
+    l2: float,
+    iterations: int,
+    bf16_sweeps: int,
+    reg_nnz: bool,
+    compute_dtype: Any,
+    precision: Any,
+    user_heavy,
+    item_heavy,
+) -> ALSState:
+    """Mixed-precision schedule: ``bf16_sweeps`` early sweeps with bf16
+    gathers + single-pass MXU matmuls (DEFAULT precision), then the
+    remaining sweeps at (compute_dtype, precision) — the f32 HIGHEST
+    polish that restores full convergence. Two fused dispatches instead
+    of one; explicit feedback only (implicit confidences stay f32).
+
+    Why this is safe: ALS re-solves every factor row from scratch each
+    half-sweep (the state is not incrementally perturbed), so low-precision
+    early sweeps only affect the *starting point* of the f32 polish — the
+    polish sweeps land on the same fixed point (validated by the planted
+    low-rank recovery test, tests/test_als.py)."""
+    lo = min(max(bf16_sweeps, 0), iterations)
+    if lo:
+        state = _als_run_fused(
+            state, u_tree, i_tree, l2, 0.0, lo, reg_nnz,
+            jnp.bfloat16, jax.lax.Precision.DEFAULT, implicit=False,
+            user_heavy=user_heavy, item_heavy=item_heavy,
+            cg_iters=min(_CG_ITERS_BF16, _CG_ITERS),
+        )
+    if iterations - lo:
+        state = _als_run_fused(
+            state, u_tree, i_tree, l2, 0.0, iterations - lo, reg_nnz,
+            compute_dtype, precision, implicit=False,
+            user_heavy=user_heavy, item_heavy=item_heavy,
+        )
+    return state
 
 
 def als_train(
@@ -736,6 +823,7 @@ def als_train(
     precision: Any = jax.lax.Precision.HIGHEST,
     max_width: int = 1 << 16,
     track_rmse: bool = False,
+    bf16_sweeps: int = 0,
 ) -> Tuple[ALSState, List[float]]:
     """Full training: build padded buckets once, run ``iterations`` sweeps.
 
@@ -756,16 +844,18 @@ def als_train(
     history: List[float] = []
     if track_rmse:
         # per-sweep metric needs per-sweep dispatches
-        for _ in range(iterations):
-            state = _als_run_fused(
-                state, u_tree, i_tree, l2, 0.0, 1, reg_nnz, compute_dtype,
-                precision, implicit=False, user_heavy=u_hv, item_heavy=i_hv,
+        for sweep in range(iterations):
+            state = _mixed_run(
+                state, u_tree, i_tree, l2, 1,
+                1 if sweep < bf16_sweeps else 0,
+                reg_nnz, compute_dtype, precision,
+                user_heavy=u_hv, item_heavy=i_hv,
             )
             history.append(rmse(state, users, items, ratings))
     else:
-        state = _als_run_fused(
-            state, u_tree, i_tree, l2, 0.0, iterations, reg_nnz,
-            compute_dtype, precision, implicit=False,
+        state = _mixed_run(
+            state, u_tree, i_tree, l2, iterations, bf16_sweeps,
+            reg_nnz, compute_dtype, precision,
             user_heavy=u_hv, item_heavy=i_hv,
         )
     return state, history

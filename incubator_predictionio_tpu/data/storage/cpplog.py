@@ -86,6 +86,8 @@ class StorageClient(base.BaseStorageClient):
             if h is not None:
                 self.lib.pio_evlog_close(h)
             path.unlink(missing_ok=True)
+            from incubator_predictionio_tpu.data.storage import traincache
+            traincache.invalidate(path)
         return True
 
     def sync(self) -> None:
@@ -377,48 +379,169 @@ class CppLogEvents(base.Events):
         """Columnar scan fully in C++ (pio_evlog_scan_interactions): header
         prefilter, payload field extraction, value resolution, and id
         interning all happen natively; Python only receives the finished
-        int32/float32 arrays and the two id tables."""
+        int32/float32 arrays and the two id tables.
+
+        Stored-value queries (one event name, a ``value_prop``, no fixed
+        override) are served from the training-projection cache when one is
+        valid (traincache.py): only the log *tail* appended since the cache
+        was written is re-scanned, and the merged result is folded back.
+        Everything else — and any shape the fold cannot prove equivalent —
+        takes the full native scan, which then (re)seeds the cache at
+        training scale."""
+        import numpy as np
+
+        from incubator_predictionio_tpu.data.storage import traincache
+
+        names = [str(n) for n in event_names]
+        fixed = event_values or {}
+        servable = (
+            len(names) == 1 and value_prop is not None
+            and names[0] not in fixed
+        )
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            lib = self.client.lib
+            cpath = traincache.path_for(
+                self.client._file(self.ns, app_id, channel_id))
+            raw = lib.pio_evlog_entry_count(h)
+            dead = lib.pio_evlog_dead_count(h)
+            if servable:
+                cache = traincache.load(cpath)
+                if cache is not None and (
+                        cache.spec.entity_type == entity_type
+                        and cache.spec.target_entity_type
+                        == target_entity_type
+                        and cache.spec.event_name == names[0]
+                        and cache.spec.value_prop == value_prop
+                        and cache.dead_count == dead
+                        and cache.raw_count <= raw):
+                    inter = self._serve_from_cache(
+                        h, cache, cpath, raw, dead, entity_type,
+                        target_entity_type, names[0], value_prop,
+                        start_time, until_time)
+                    if inter is not None:
+                        return inter
+            unbounded = start_time is None and until_time is None
+            seed_cache = servable and unbounded
+            inter, times = self._scan_native(
+                h, start_time, until_time, entity_type, target_entity_type,
+                names, fixed, value_prop, default_value,
+                with_times=seed_cache)
+            if seed_cache and len(inter) >= traincache.MIN_NNZ and (
+                    len(times) < 2 or not np.any(np.diff(times) < 0)):
+                traincache.write(cpath, traincache.TrainCache(
+                    spec=traincache.Spec(entity_type, target_entity_type,
+                                         names[0], value_prop),
+                    uidx=inter.user_idx, iidx=inter.item_idx,
+                    vals=inter.values, times=times,
+                    user_tab=inter.user_ids, item_tab=inter.item_ids,
+                    raw_count=raw, dead_count=dead))
+        return inter
+
+    def _scan_native(self, h, start_time, until_time, entity_type,
+                     target_entity_type, names, fixed, value_prop,
+                     default_value, min_entry_idx: int = 0,
+                     with_times: bool = False):
+        """The raw native scan → (Interactions, times|None). Caller holds
+        the client lock."""
         import numpy as np
 
         lib = self.client.lib
-        names = [str(n) for n in event_names]
-        fixed = event_values or {}
         c_names = (ctypes.c_char_p * max(len(names), 1))(
             *[n.encode("utf-8") for n in names] or [None])
         c_fixed = (ctypes.c_double * max(len(names), 1))(
             *[float(fixed.get(n, float("nan"))) for n in names] or [0.0])
-        with self.client.lock:
-            h = self._handle(app_id, channel_id)
-            res = lib.pio_evlog_scan_interactions(
-                h,
-                _I64_MIN if start_time is None else to_millis(start_time),
-                _I64_MAX if until_time is None else to_millis(until_time),
-                entity_type.encode("utf-8"),
-                target_entity_type.encode("utf-8"),
-                c_names, c_fixed, len(names),
-                None if value_prop is None else value_prop.encode("utf-8"),
-                float(default_value),
-            )
-            try:
-                nnz = lib.pio_scan_nnz(res)
-                uidx = np.empty(nnz, np.int32)
-                iidx = np.empty(nnz, np.int32)
-                vals = np.empty(nnz, np.float32)
-                if nnz:
-                    lib.pio_scan_fill(
+        res = lib.pio_evlog_scan_interactions(
+            h,
+            _I64_MIN if start_time is None else to_millis(start_time),
+            _I64_MAX if until_time is None else to_millis(until_time),
+            min_entry_idx,
+            entity_type.encode("utf-8"),
+            target_entity_type.encode("utf-8"),
+            c_names, c_fixed, len(names),
+            None if value_prop is None else value_prop.encode("utf-8"),
+            float(default_value),
+        )
+        try:
+            nnz = lib.pio_scan_nnz(res)
+            uidx = np.empty(nnz, np.int32)
+            iidx = np.empty(nnz, np.int32)
+            vals = np.empty(nnz, np.float32)
+            times = np.empty(nnz, np.int64) if with_times else None
+            if nnz:
+                lib.pio_scan_fill(
+                    res,
+                    uidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    iidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                )
+                if with_times:
+                    lib.pio_scan_fill_times(
                         res,
-                        uidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                        iidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                    )
-                user_ids = self._scan_ids(res, 0)
-                item_ids = self._scan_ids(res, 1)
-            finally:
-                lib.pio_scan_free(res)
-        return base.Interactions(
+                        times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            user_ids = self._scan_ids(res, 0)
+            item_ids = self._scan_ids(res, 1)
+        finally:
+            lib.pio_scan_free(res)
+        inter = base.Interactions(
             user_idx=uidx, item_idx=iidx, values=vals,
             user_ids=user_ids, item_ids=item_ids,
         )
+        return inter, times
+
+    def _serve_from_cache(self, h, cache, cpath, raw, dead, entity_type,
+                          target_entity_type, name, value_prop,
+                          start_time, until_time):
+        """Tail-scan + merge + time-filter; None → caller full-scans.
+        Caller holds the client lock and has validated the cache."""
+        import dataclasses
+
+        import numpy as np
+
+        from incubator_predictionio_tpu.data.storage import traincache
+
+        if raw > cache.raw_count:
+            # records appended since the cache was written: scan just them
+            tail, tail_times = self._scan_native(
+                h, None, None, entity_type, target_entity_type, [name], {},
+                value_prop, 1.0, min_entry_idx=cache.raw_count,
+                with_times=True)
+            if len(tail):
+                if len(cache) and tail_times[0] < cache.times[-1]:
+                    return None  # out-of-order tail: merge would reorder
+                utab, uremap = traincache.merge_tables(
+                    cache.user_tab, tail.user_ids)
+                itab, iremap = traincache.merge_tables(
+                    cache.item_tab, tail.item_ids)
+                cache = dataclasses.replace(
+                    cache,
+                    uidx=np.concatenate([cache.uidx, uremap[tail.user_idx]]),
+                    iidx=np.concatenate([cache.iidx, iremap[tail.item_idx]]),
+                    vals=np.concatenate([cache.vals, tail.values]),
+                    times=np.concatenate([cache.times, tail_times]),
+                    user_tab=utab, item_tab=itab,
+                    raw_count=raw, dead_count=dead)
+                if len(tail) * 100 >= len(cache):
+                    # persist the fold only when the tail is ≥1% of the
+                    # cache: smaller tails re-scan in microseconds, while
+                    # the rewrite is O(cache) disk traffic per train
+                    traincache.write(cpath, cache)
+            # empty tail: skip the rewrite — re-checking the tail is a
+            # cheap header walk, rewriting the cache is not
+        if start_time is None and until_time is None:
+            return base.Interactions(
+                user_idx=cache.uidx, item_idx=cache.iidx, values=cache.vals,
+                user_ids=cache.user_tab, item_ids=cache.item_tab)
+        lo = _I64_MIN if start_time is None else to_millis(start_time)
+        hi = _I64_MAX if until_time is None else to_millis(until_time)
+        keep = (cache.times >= lo) & (cache.times < hi)
+        uidx, utab = traincache.first_seen_reindex(
+            cache.uidx[keep], cache.user_tab)
+        iidx, itab = traincache.first_seen_reindex(
+            cache.iidx[keep], cache.item_tab)
+        return base.Interactions(
+            user_idx=uidx, item_idx=iidx, values=cache.vals[keep],
+            user_ids=utab, item_ids=itab)
 
     def _scan_ids(self, res: int, which: int) -> base.IdTable:
         """Copy the C++ id table out as an arrow-style IdTable — offsets +
@@ -487,6 +610,8 @@ class CppLogEvents(base.Events):
         i64p = ctypes.POINTER(ctypes.c_int64)
         with self.client.lock:
             h = self._handle(app_id, channel_id)
+            raw_before = self.client.lib.pio_evlog_entry_count(h)
+            dead_before = self.client.lib.pio_evlog_dead_count(h)
             rc = self.client.lib.pio_evlog_append_interactions(
                 h, n,
                 times_arr.ctypes.data_as(i64p),
@@ -501,6 +626,11 @@ class CppLogEvents(base.Events):
                 value_prop.encode("utf-8"),
                 int.from_bytes(secrets.token_bytes(8), "little"),
             )
+            if rc == n:
+                self._maintain_cache_after_import(
+                    h, app_id, channel_id, raw_before, dead_before,
+                    uidx, iidx, vals, times_arr, utab, itab,
+                    entity_type, target_entity_type, event_name, value_prop)
         if rc == -2:  # sidecar limits exceeded: generic per-Event path
             return super().import_interactions(
                 inter, app_id, channel_id, entity_type, target_entity_type,
@@ -508,6 +638,75 @@ class CppLogEvents(base.Events):
         if rc != n:
             raise base.StorageError("columnar interaction import failed")
         return n
+
+    def _maintain_cache_after_import(self, h, app_id, channel_id,
+                                     raw_before, dead_before, uidx, iidx,
+                                     vals, times_arr, utab, itab,
+                                     entity_type, target_entity_type,
+                                     event_name, value_prop) -> None:
+        """Create or extend the training projection from the batch's own
+        columnar arrays — the import has them in hand, so maintaining the
+        projection here is nearly free vs. rebuilding it from a full scan
+        (traincache.py rationale). Covered cases: a fresh log at training
+        scale (create), or an up-to-date cache with an in-order batch
+        (append). Anything else leaves the batch in the log tail, which the
+        next scan folds. Caller holds the client lock; the native append
+        has already succeeded (raw count is now raw_before + n)."""
+        import dataclasses
+
+        import numpy as np
+
+        from incubator_predictionio_tpu.data.storage import traincache
+
+        n = len(uidx)
+        if value_prop is None:
+            return
+        monotone = n < 2 or not np.any(np.diff(times_arr) < 0)
+        if not monotone:
+            return
+        cpath = traincache.path_for(
+            self.client._file(self.ns, app_id, channel_id))
+        spec = traincache.Spec(entity_type, target_entity_type, event_name,
+                               value_prop)
+        # re-intern in first-seen order: the batch's tables may hold
+        # unreferenced or differently-ordered ids, and the cache must be
+        # indistinguishable from a fresh native scan (the cross-backend
+        # first-seen contract, tests/test_storage_conformance.py)
+        if raw_before == 0 and n >= traincache.MIN_NNZ:
+            new_u, new_utab = traincache.first_seen_reindex(uidx, utab)
+            new_i, new_itab = traincache.first_seen_reindex(iidx, itab)
+            traincache.write(cpath, traincache.TrainCache(
+                spec=spec, uidx=new_u, iidx=new_i,
+                vals=np.asarray(vals, np.float32),
+                times=np.asarray(times_arr, np.int64),
+                user_tab=new_utab, item_tab=new_itab,
+                raw_count=raw_before + n, dead_count=dead_before))
+            return
+        cache = traincache.load(cpath)
+        if cache is None or cache.spec != spec:
+            return
+        if cache.raw_count != raw_before or cache.dead_count != dead_before:
+            return  # gap or deletes: the next scan's fold handles it
+        if n * 20 < len(cache):
+            # appending rewrites the whole projection file: a batch below
+            # 5% of the cache isn't worth O(cache) disk traffic per
+            # import — it stays in the log tail, which scans fold cheaply
+            return
+        if len(cache) and n and times_arr[0] < cache.times[-1]:
+            return  # out-of-order batch: appending would break time order
+        new_u, new_utab = traincache.first_seen_reindex(uidx, utab)
+        new_i, new_itab = traincache.first_seen_reindex(iidx, itab)
+        m_utab, uremap = traincache.merge_tables(cache.user_tab, new_utab)
+        m_itab, iremap = traincache.merge_tables(cache.item_tab, new_itab)
+        traincache.write(cpath, dataclasses.replace(
+            cache,
+            uidx=np.concatenate([cache.uidx, uremap[new_u]]),
+            iidx=np.concatenate([cache.iidx, iremap[new_i]]),
+            vals=np.concatenate([cache.vals, np.asarray(vals, np.float32)]),
+            times=np.concatenate([cache.times,
+                                  np.asarray(times_arr, np.int64)]),
+            user_tab=m_utab, item_tab=m_itab,
+            raw_count=raw_before + n, dead_count=dead_before))
 
     @staticmethod
     def _filter_parsed(payloads, entity_type, entity_id, names,

@@ -104,10 +104,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     ]
     lib.pio_evlog_sync.restype = c.c_int64
     lib.pio_evlog_sync.argtypes = [c.c_void_p]
+    lib.pio_evlog_entry_count.restype = c.c_int64
+    lib.pio_evlog_entry_count.argtypes = [c.c_void_p]
+    lib.pio_evlog_dead_count.restype = c.c_int64
+    lib.pio_evlog_dead_count.argtypes = [c.c_void_p]
     # columnar interaction scan
     lib.pio_evlog_scan_interactions.restype = c.c_void_p
     lib.pio_evlog_scan_interactions.argtypes = [
-        c.c_void_p, c.c_int64, c.c_int64, c.c_char_p, c.c_char_p,
+        c.c_void_p, c.c_int64, c.c_int64, c.c_int64, c.c_char_p, c.c_char_p,
         c.POINTER(c.c_char_p), c.POINTER(c.c_double), c.c_int32,
         c.c_char_p, c.c_double,
     ]
@@ -122,6 +126,8 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_void_p, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
         c.POINTER(c.c_float),
     ]
+    lib.pio_scan_fill_times.restype = None
+    lib.pio_scan_fill_times.argtypes = [c.c_void_p, i64p]
     lib.pio_scan_copy_ids.restype = None
     lib.pio_scan_copy_ids.argtypes = [
         c.c_void_p, c.c_int32, c.c_char_p, i64p,

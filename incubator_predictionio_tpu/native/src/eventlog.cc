@@ -97,6 +97,11 @@ struct EventLog {
   std::vector<std::vector<std::pair<uint64_t, int64_t>>> id_runs;
   size_t id_tail_total = 0;  // id_buf + all id_runs
   bool id_index_built = false;
+  // entries with dead==true (tombstone markers + their targets). The
+  // Python training-projection cache (cpplog.py) stores this at write
+  // time: any change means a cached row may have died, invalidating the
+  // projection without walking the log.
+  int64_t dead_count = 0;
   std::mutex mu;
 };
 
@@ -189,10 +194,12 @@ void* pio_evlog_open(const char* path) {
       int64_t target = -1;
       if (h.payload_len == 8 && fread(&target, 8, 1, f) == 1 &&
           target >= 0 && (size_t)target < log->entries.size()) {
+        if (!log->entries[target].dead) ++log->dead_count;
         log->entries[target].dead = true;
       } else {
         fseeko(f, rec_end, SEEK_SET);
       }
+      ++log->dead_count;  // the marker entry itself
       log->entries.push_back({0, 0, 0, 0, 0, off, h.payload_len, h.flags,
                               true});
     } else {
@@ -292,8 +299,24 @@ int64_t pio_evlog_tombstone(void* handle, int64_t index) {
   fflush(log->f);
   log->entries[index].dead = true;
   log->entries.push_back({0, 0, 0, 0, 0, off, 8, kTombstone, true});
+  log->dead_count += 2;  // the target + the marker entry
   log->sorted_dirty = true;
   return 0;
+}
+
+// Raw entry count (live + dead + tombstone markers) — the projection
+// cache's high-water mark: entries at index >= a stored count are exactly
+// the records appended after the cache was written.
+int64_t pio_evlog_entry_count(void* handle) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  return (int64_t)log->entries.size();
+}
+
+int64_t pio_evlog_dead_count(void* handle) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  return log->dead_count;
 }
 
 int64_t pio_evlog_count(void* handle) {
@@ -535,6 +558,7 @@ static bool json_property_number(const std::string& s, const char* key,
 struct ScanResult {
   std::vector<int32_t> uidx, iidx;
   std::vector<float> vals;
+  std::vector<int64_t> times;        // per-row event time (projection cache)
   std::string ubuf, ibuf;            // concatenated utf-8 id bytes
   std::vector<int64_t> uoff, ioff;   // n_ids + 1 offsets into the buffers
 };
@@ -800,6 +824,7 @@ static bool sidecar_prop_value(const SideFields& f, std::string_view key,
 struct LocalScan {
   std::vector<int32_t> uidx, iidx;
   std::vector<float> vals;
+  std::vector<int64_t> times;
   std::vector<std::string_view> users, items;  // local idx → id view
   std::unordered_map<std::string_view, int32_t> umap, imap;
   std::deque<std::string> arena;  // stable storage for decoded ids
@@ -807,6 +832,7 @@ struct LocalScan {
 
 struct ScanFilters {
   int64_t start_ms, until_ms;
+  int64_t min_entry_idx;  // skip entries below this index (tail scans)
   std::string_view entity_type, target_entity_type, value_prop;
   const std::vector<std::string>* names;
   std::vector<uint64_t> name_hs;
@@ -840,6 +866,7 @@ static void scan_range(const char* base, const EventLog* log,
   std::string_view uid, iid;
   const int32_t n_names = (int32_t)flt.names->size();
   for (int64_t k = lo; k < hi; ++k) {
+    if (log->sorted[k] < flt.min_entry_idx) continue;
     const Entry& e = log->entries[log->sorted[k]];
     if (e.dead) continue;
     if (e.time_ms < flt.start_ms || e.time_ms >= flt.until_ms) continue;
@@ -912,6 +939,7 @@ static void scan_range(const char* base, const EventLog* log,
     out->uidx.push_back(ur.first->second);
     out->iidx.push_back(ir.first->second);
     out->vals.push_back((float)v);
+    out->times.push_back(e.time_ms);
   }
 }
 
@@ -921,7 +949,7 @@ static void scan_range(const char* base, const EventLog* log,
 // The file is mmapped and partitioned across threads; per-thread id tables
 // are merged in partition order so the global table keeps first-seen order.
 void* pio_evlog_scan_interactions(
-    void* handle, int64_t start_ms, int64_t until_ms,
+    void* handle, int64_t start_ms, int64_t until_ms, int64_t min_entry_idx,
     const char* entity_type, const char* target_entity_type,
     const char** names, const double* fixed_vals, int32_t n_names,
     const char* value_prop, double default_value) {
@@ -940,6 +968,7 @@ void* pio_evlog_scan_interactions(
   ScanFilters flt;
   flt.start_ms = start_ms;
   flt.until_ms = until_ms;
+  flt.min_entry_idx = min_entry_idx;
   flt.entity_type = entity_type;
   flt.target_entity_type = target_entity_type;
   flt.value_prop = value_prop ? std::string_view(value_prop)
@@ -1012,6 +1041,7 @@ void* pio_evlog_scan_interactions(
   res->uidx.reserve(nnz);
   res->iidx.reserve(nnz);
   res->vals.reserve(nnz);
+  res->times.reserve(nnz);
   for (auto& L : locals) {
     std::vector<int32_t> uremap(L.users.size()), iremap(L.items.size());
     for (size_t j = 0; j < L.users.size(); ++j) {
@@ -1028,6 +1058,7 @@ void* pio_evlog_scan_interactions(
       res->uidx.push_back(uremap[L.uidx[j]]);
       res->iidx.push_back(iremap[L.iidx[j]]);
       res->vals.push_back(L.vals[j]);
+      res->times.push_back(L.times[j]);
     }
   }
   res->uoff.push_back(0);
@@ -1386,6 +1417,13 @@ void pio_scan_fill(void* r, int32_t* u, int32_t* i, float* v) {
   memcpy(u, res->uidx.data(), res->uidx.size() * sizeof(int32_t));
   memcpy(i, res->iidx.data(), res->iidx.size() * sizeof(int32_t));
   memcpy(v, res->vals.data(), res->vals.size() * sizeof(float));
+}
+
+// Per-row event times, parallel to pio_scan_fill's arrays — consumed by the
+// Python training-projection cache (cpplog.py) so any full scan can seed it.
+void pio_scan_fill_times(void* r, int64_t* t) {
+  auto* res = (ScanResult*)r;
+  memcpy(t, res->times.data(), res->times.size() * sizeof(int64_t));
 }
 
 void pio_scan_copy_ids(void* r, int32_t which, char* buf, int64_t* offsets) {

@@ -1,0 +1,210 @@
+"""Training-projection cache (data/storage/traincache.py + cpplog wiring).
+
+The contract under test: every scan served (even partially) from the cache
+must be byte-identical to a fresh full native scan of the same log — same
+triples, same first-seen id-table order — across creation at import time,
+tail folds, time windows, deletes, and fallback shapes.
+"""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import (
+    StorageClientConfig,
+    cpplog,
+    traincache,
+)
+from incubator_predictionio_tpu.data.storage.base import Interactions
+from incubator_predictionio_tpu.utils.times import from_millis
+
+pytestmark = pytest.mark.skipif(
+    __import__("incubator_predictionio_tpu.native", fromlist=["load"]).load()
+    is None,
+    reason="native library unavailable",
+)
+
+
+@pytest.fixture
+def events(tmp_path, monkeypatch):
+    # every log in these tests is "training scale"
+    monkeypatch.setattr(traincache, "MIN_NNZ", 4)
+    client = cpplog.StorageClient(
+        StorageClientConfig(properties={"PATH": str(tmp_path)}))
+    ev = cpplog.CppLogEvents(client, None, prefix="t_")
+    yield ev
+    client.close()
+
+
+def _imp(events, app_id=1, n=8, t0=1_000_000, users=None, items=None):
+    users = users if users is not None else np.arange(n, dtype=np.int32) % 3
+    items = items if items is not None else np.arange(n, dtype=np.int32) % 4
+    inter = Interactions(
+        user_idx=np.asarray(users, np.int32),
+        item_idx=np.asarray(items, np.int32),
+        values=np.arange(1, len(users) + 1, dtype=np.float32),
+        user_ids=[f"u{k}" for k in range(int(max(users)) + 1)],
+        item_ids=[f"i{k}" for k in range(int(max(items)) + 1)],
+    )
+    assert events.import_interactions(
+        inter, app_id, times=t0 + np.arange(len(users), dtype=np.int64),
+    ) == len(users)
+    return inter
+
+
+def _scan(events, app_id=1, **kw):
+    kw.setdefault("entity_type", "user")
+    kw.setdefault("target_entity_type", "item")
+    kw.setdefault("event_names", ("rate",))
+    kw.setdefault("value_prop", "rating")
+    return events.scan_interactions(app_id=app_id, **kw)
+
+
+def _cache_path(events, app_id=1):
+    return traincache.path_for(
+        events.client._file(events.ns, app_id, None))
+
+
+def _as_triples(inter):
+    return [
+        (inter.user_ids[int(u)], inter.item_ids[int(i)], float(v))
+        for u, i, v in zip(inter.user_idx, inter.item_idx, inter.values)
+    ]
+
+
+def _assert_same(a, b):
+    assert _as_triples(a) == _as_triples(b)
+    assert list(a.user_ids) == list(b.user_ids)
+    assert list(a.item_ids) == list(b.item_ids)
+
+
+def _fresh_scan(events, app_id=1, **kw):
+    """Ground truth: the same query with the cache removed."""
+    _cache_path(events, app_id).unlink(missing_ok=True)
+    out = _scan(events, app_id, **kw)
+    return out
+
+
+def test_import_creates_cache_and_scan_serves_it(events):
+    _imp(events)
+    assert _cache_path(events).exists()
+    served = _scan(events)
+    truth = _fresh_scan(events)
+    _assert_same(served, truth)
+    assert len(served) == 8
+
+
+def test_cache_matches_scan_interning_order(events):
+    # batch id tables deliberately hold unreferenced + shuffled ids: the
+    # cache must still produce first-seen order (conformance contract)
+    inter = Interactions(
+        user_idx=np.array([2, 0, 2, 1], np.int32),
+        item_idx=np.array([1, 1, 0, 2], np.int32),
+        values=np.array([1, 2, 3, 4], np.float32),
+        user_ids=["a", "b", "c", "never-used"],
+        item_ids=["x", "y", "z"],
+    )
+    events.import_interactions(inter, 1, times=np.arange(4, dtype=np.int64))
+    served = _scan(events)
+    assert list(served.user_ids) == ["c", "a", "b"]
+    assert list(served.item_ids) == ["y", "x", "z"]
+    _assert_same(served, _fresh_scan(events))
+
+
+def test_tail_fold_after_rest_ingest(events):
+    _imp(events, t0=1000)
+    # two REST-path events land past the cache's high-water mark
+    for k, minutes in ((0, 10), (1, 11)):
+        events.insert(Event(
+            event="rate", entity_type="user", entity_id=f"new{k}",
+            target_entity_type="item", target_entity_id="i0",
+            properties=DataMap({"rating": 9.0 + k}),
+            event_time=from_millis(1_000_000_000 + minutes)), 1)
+    served = _scan(events)
+    assert len(served) == 10
+    assert "new0" in list(served.user_ids)
+    _assert_same(served, _fresh_scan(events))
+    # the fold advanced the cache: next scan serves 10 rows from cache
+    cache = traincache.load(_cache_path(events))
+    assert cache is not None and len(cache) == 10
+
+
+def test_second_import_appends_to_cache(events):
+    _imp(events, t0=1000)
+    _imp(events, n=4, t0=500_000, users=np.array([3, 3, 0, 4]),
+         items=np.array([0, 5, 1, 2]))
+    cache = traincache.load(_cache_path(events))
+    assert cache is not None and len(cache) == 12 and cache.raw_count == 12
+    _assert_same(_scan(events), _fresh_scan(events))
+
+
+def test_delete_invalidates_cache(events):
+    _imp(events)
+    ev_id = next(iter(events.find(app_id=1))).event_id
+    assert events.delete(ev_id, 1)
+    served = _scan(events)  # full scan (dead_count mismatch) + reseed
+    assert len(served) == 7
+    _assert_same(served, _fresh_scan(events))
+    # the reseeded cache reflects the delete
+    cache = traincache.load(_cache_path(events))
+    assert cache is not None and len(cache) == 7
+
+
+def test_time_window_served_from_cache(events):
+    _imp(events, t0=1000)
+    lo, hi = from_millis(1002), from_millis(1006)
+    served = _scan(events, start_time=lo, until_time=hi)
+    truth = _fresh_scan(events, start_time=lo, until_time=hi)
+    assert len(served) == 4
+    _assert_same(served, truth)
+
+
+def test_non_servable_queries_bypass_cache(events):
+    _imp(events)
+    # fixed-value query: includes records regardless of the prop
+    a = _scan(events, event_values={"rate": 2.5})
+    assert set(a.values.tolist()) == {2.5}
+    # no value_prop → default fill
+    b = _scan(events, value_prop=None, default_value=7.0)
+    assert set(b.values.tolist()) == {7.0}
+    # two names
+    c = _scan(events, event_names=("rate", "buy"))
+    assert len(c) == 8
+
+
+def test_out_of_order_tail_falls_back(events):
+    _imp(events, t0=1_000_000)
+    # REST event with an EARLIER event time than the cached rows
+    events.insert(Event(
+        event="rate", entity_type="user", entity_id="early",
+        target_entity_type="item", target_entity_id="i0",
+        properties=DataMap({"rating": 1.0}),
+        event_time=from_millis(5)), 1)
+    served = _scan(events)
+    truth = _fresh_scan(events)
+    assert _as_triples(served)[0][0] == "early"  # time order preserved
+    _assert_same(served, truth)
+
+
+def test_small_logs_get_no_cache(events, monkeypatch):
+    monkeypatch.setattr(traincache, "MIN_NNZ", 1_000_000)
+    _imp(events)
+    assert not _cache_path(events).exists()
+    assert len(_scan(events)) == 8
+
+
+def test_corrupt_cache_is_ignored(events):
+    _imp(events)
+    path = _cache_path(events)
+    path.write_bytes(path.read_bytes()[:40])  # torn file
+    served = _scan(events)
+    assert len(served) == 8
+    _assert_same(served, _fresh_scan(events))
+
+
+def test_drop_removes_cache(events):
+    _imp(events)
+    assert _cache_path(events).exists()
+    events.remove(1)
+    assert not _cache_path(events).exists()

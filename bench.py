@@ -43,6 +43,13 @@ N_ITEMS = int(os.environ.get("PIO_BENCH_ITEMS", 26_744))
 NNZ = int(os.environ.get("PIO_BENCH_NNZ", 20_000_000))
 RANK = int(os.environ.get("PIO_BENCH_RANK", 128))
 ITERATIONS = int(os.environ.get("PIO_BENCH_SWEEPS", 10))
+#: mixed-precision schedule (ops/als.py _mixed_run): bf16 gathers +
+#: single-pass MXU matmuls for the early sweeps, f32 HIGHEST polish for
+#: the rest. RMSE parity with the all-f32 run is guarded by
+#: tests/test_als.py::test_als_mixed_bf16_schedule_recovers_planted_rank
+#: and re-checked here (the JSON line carries train_rmse either way).
+BF16_SWEEPS = int(os.environ.get("PIO_BENCH_BF16_SWEEPS",
+                                 max(ITERATIONS - 2, 0)))
 L2 = 0.1
 
 #: Measured on this image's host CPU (JAX CPU backend, warm compile cache)
@@ -63,7 +70,18 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_dataset(rng):
+#: planted ground truth: ratings = 3.5 + U·Vᵀ + N(0, NOISE_SIGMA) with a
+#: rank-PLANT_RANK U, V. The solver (rank 128 ⊇ 16) can recover the
+#: structure, so heldout RMSE has a KNOWN floor (= NOISE_SIGMA) and
+#: ranking quality a known ceiling — the r3 verdict's "model quality is
+#: asserted, not proven" fix. Marginals stay the r3 power-law (identical
+#: bucket shapes → timing comparability across rounds).
+PLANT_RANK = int(os.environ.get("PIO_BENCH_PLANT_RANK", 16))
+NOISE_SIGMA = float(os.environ.get("PIO_BENCH_NOISE_SIGMA", 0.35))
+N_HOLDOUT = int(os.environ.get("PIO_BENCH_HOLDOUT", 200_000))
+
+
+def _sample_pairs(rng, n):
     """Power-law item popularity matching ML-20M's marginals: the real
     ratings.csv tops out at ≈67k ratings for the most-rated movie; an
     i^-0.55 profile over 27k items puts the top item at ≈90k of 20M —
@@ -71,11 +89,55 @@ def make_dataset(rng):
     Users get a milder i^-0.3 tail (ML-20M users are min-20, median ≈70,
     max ≈9.3k ratings)."""
     iw = (np.arange(N_ITEMS) + 1.0) ** -0.55
-    items = rng.choice(N_ITEMS, NNZ, p=iw / iw.sum()).astype(np.int32)
+    items = rng.choice(N_ITEMS, n, p=iw / iw.sum()).astype(np.int32)
     uw = (np.arange(N_USERS) + 1.0) ** -0.3
-    users = rng.choice(N_USERS, NNZ, p=uw / uw.sum()).astype(np.int32)
-    ratings = (rng.integers(1, 11, NNZ) * 0.5).astype(np.float32)
-    return users, items, ratings
+    users = rng.choice(N_USERS, n, p=uw / uw.sum()).astype(np.int32)
+    return users, items
+
+
+def make_dataset(rng):
+    """→ (users, items, ratings, heldout (u, i, r), true (U, V)). The
+    heldout pairs are fresh draws from the same ground truth — never
+    stored, never trained on."""
+    u_true = rng.normal(0, 1.0 / np.sqrt(PLANT_RANK),
+                        (N_USERS, PLANT_RANK)).astype(np.float32)
+    v_true = rng.normal(0, 1.0, (N_ITEMS, PLANT_RANK)).astype(np.float32)
+
+    def rate(users, items):
+        signal = np.einsum("nk,nk->n", u_true[users], v_true[items])
+        return (3.5 + signal
+                + rng.normal(0, NOISE_SIGMA, len(users))).astype(np.float32)
+
+    users, items = _sample_pairs(rng, NNZ)
+    ho_u, ho_i = _sample_pairs(rng, N_HOLDOUT)
+    return (users, items, rate(users, items),
+            (ho_u, ho_i, rate(ho_u, ho_i)), (u_true, v_true))
+
+
+def quality_metrics(state, heldout, truth, rng):
+    """Heldout RMSE vs the known noise floor + precision@10 against the
+    ground-truth ranking (sampled users, device-scored)."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops import als
+
+    ho_u, ho_i, ho_r = heldout
+    u_true, v_true = truth
+    heldout_rmse = als.rmse(state, ho_u, ho_i, ho_r)
+
+    n_probe = 1000
+    probe = rng.choice(N_USERS, n_probe, replace=False)
+    true_scores = u_true[probe] @ v_true.T                  # [P, I] host
+    true_top = np.argsort(-true_scores, axis=1)[:, :10]
+    model_scores = jnp.take(state.user_factors, jnp.asarray(probe),
+                            axis=0) @ state.item_factors.T
+    model_top = np.asarray(jax.lax.top_k(model_scores, 10)[1])
+    hits = np.mean([
+        len(set(a.tolist()) & set(b.tolist())) / 10.0
+        for a, b in zip(model_top, true_top)
+    ])
+    return float(heldout_rmse), float(hits)
 
 
 def als_flops_per_run() -> float:
@@ -144,8 +206,10 @@ def run(platform_cpu: bool = False) -> None:
 
     rng = np.random.default_rng(7)
     log(f"dataset: {N_USERS}x{N_ITEMS}, nnz={NNZ}, rank={RANK}, "
-        f"sweeps={ITERATIONS}")
-    users, items, ratings = make_dataset(rng)
+        f"sweeps={ITERATIONS} ({BF16_SWEEPS} bf16 + "
+        f"{ITERATIONS - BF16_SWEEPS} f32-polish), planted rank "
+        f"{PLANT_RANK} + noise {NOISE_SIGMA}")
+    users, items, ratings, heldout, truth = make_dataset(rng)
 
     with tempfile.TemporaryDirectory(prefix="pio_bench_") as tmpdir:
         # -- 1. SEED: native columnar bulk import --------------------------
@@ -154,6 +218,10 @@ def run(platform_cpu: bool = False) -> None:
             f"({NNZ / seed_s / 1e6:.2f}M ev/s)")
 
         # -- 2. INGEST: columnar scan back out of the event store ----------
+        # the bulk import just materialized the training projection
+        # (data/storage/traincache.py), so this scan measures the real
+        # warm-train read path: projection load + empty-tail check. Set
+        # PIO_TRAINCACHE_MIN_NNZ above NNZ to measure the cold full scan.
         t0 = time.perf_counter()
         inter = events.scan_interactions(
             app_id=1, entity_type="user", target_entity_type="item",
@@ -185,9 +253,9 @@ def run(platform_cpu: bool = False) -> None:
     u_hv, i_hv = als._heavy_tree(u_heavy), als._heavy_tree(i_heavy)
 
     def train(state0):
-        out = als._als_run_fused(
-            state0, u_tree, i_tree, L2, 0.0, ITERATIONS, True,
-            jnp.float32, jax.lax.Precision.HIGHEST, implicit=False,
+        out = als._mixed_run(
+            state0, u_tree, i_tree, L2, ITERATIONS, BF16_SWEEPS, True,
+            jnp.float32, jax.lax.Precision.HIGHEST,
             user_heavy=u_hv, item_heavy=i_hv)
         # sync via a dependent 1-element device fetch: on the tunneled
         # platform jax.block_until_ready returns before execution finishes
@@ -239,9 +307,11 @@ def run(platform_cpu: bool = False) -> None:
     fit = als.rmse(state, inter.user_idx, inter.item_idx, inter.values)
     flops = als_flops_per_run()
     mfu = flops / train_s / PEAK_FLOPS_F32
+    heldout_rmse, prec10 = quality_metrics(state, heldout, truth, rng)
     log(f"device={jax.devices()[0]} compile={compile_s:.1f}s "
         f"warm={train_s:.2f}s rmse={fit:.3f} "
-        f"flops={flops:.3e} mfu={mfu:.3f}")
+        f"heldout_rmse={heldout_rmse:.3f} (noise floor {NOISE_SIGMA}) "
+        f"p@10={prec10:.3f} flops={flops:.3e} mfu={mfu:.3f}")
 
     if platform_cpu:
         log(f"CPU baseline measured: warm train = {train_s:.1f}s "
@@ -254,7 +324,10 @@ def run(platform_cpu: bool = False) -> None:
         }))
         return
 
-    # -- 5. SERVE: the real PredictionServer (HTTP + micro-batcher) --------
+    # -- 5. INGEST-HTTP: the real EventServer REST batch path --------------
+    ingest_http_eps = bench_ingest_http()
+
+    # -- 6. SERVE: the real PredictionServer (HTTP + micro-batcher) --------
     serve = bench_serving(state, inter)
 
     print(json.dumps({
@@ -263,12 +336,23 @@ def run(platform_cpu: bool = False) -> None:
         "unit": "s",
         "vs_baseline": round(CPU_BASELINE_TRAIN_S / train_s, 1),
         "train_rmse": round(float(fit), 3),
+        # planted-ground-truth quality (r3 verdict item 5): heldout pairs
+        # are fresh draws from the same rank-PLANT_RANK truth, so the
+        # recoverable floor is exactly the noise sigma; precision@10 is
+        # measured against the TRUE ranking, not observed interactions
+        "heldout_rmse": round(heldout_rmse, 3),
+        "noise_floor": NOISE_SIGMA,
+        "precision_at_10_vs_truth": round(prec10, 3),
         "mfu": round(mfu, 4),
         "compile_s_cold": round(compile_s, 1),
         "compile_s_warm_cache": compile_warm_cache_s,
         "seed_wall_s": round(seed_s, 1),
         "ingest_wall_s": round(ingest_s, 1),
         "prep_wall_s": round(prep_s, 1),
+        # the user-visible `pio train` wall: storage read + host prep +
+        # the fused device training run (VERDICT r3 item 2)
+        "e2e_train_wall_s": round(ingest_s + prep_s + train_s, 1),
+        "ingest_http_eps": ingest_http_eps,
         "serve_p50_ms": serve["p50_ms"],
         "serve_p99_ms": serve["p99_ms"],
         "serve_qps": serve["qps_sequential"],
@@ -277,7 +361,118 @@ def run(platform_cpu: bool = False) -> None:
         "nnz": NNZ,
         "rank": RANK,
         "sweeps": ITERATIONS,
+        "bf16_sweeps": BF16_SWEEPS,
     }))
+
+
+async def _http_post_loop(port, path, bodies) -> None:
+    """One async keep-alive connection POSTing each body in turn — the
+    shared load-generator leg of the ingest and serving benches."""
+    import asyncio
+
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        for body in bodies:
+            writer.write(
+                f"POST {path} HTTP/1.1\r\nHost: bench\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n".encode()
+                + body)
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            status_line = head.split(b"\r\n", 1)[0]
+            if b" 200 " not in status_line:
+                raise RuntimeError(f"request failed: {status_line!r}")
+            clen = next(
+                (int(line.split(b":")[1])
+                 for line in head.split(b"\r\n")
+                 if line.lower().startswith(b"content-length")), None)
+            if clen is None:
+                raise RuntimeError("response without Content-Length")
+            await reader.readexactly(clen)
+    finally:
+        writer.close()
+
+
+def bench_ingest_http():
+    """REST ingest throughput through the real EventServer into the cpplog
+    backend: async keep-alive clients posting 50-event batches to
+    POST /batch/events.json (the contract cap, EventServer.scala:269-289's
+    hot path). Returns events/s."""
+    import asyncio
+    import tempfile
+
+    from incubator_predictionio_tpu.data.storage import (
+        AccessKey,
+        App,
+        Storage,
+    )
+    from incubator_predictionio_tpu.servers.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+
+    n_clients = int(os.environ.get("PIO_BENCH_INGEST_CLIENTS", 32))
+    batches_per_client = int(os.environ.get("PIO_BENCH_INGEST_BATCHES", 25))
+    batch_size = 50
+
+    with tempfile.TemporaryDirectory(prefix="pio_bench_ingest_") as tmpdir:
+        Storage.configure({
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_EV_TYPE": "cpplog",
+            "PIO_STORAGE_SOURCES_EV_PATH": tmpdir,
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "bench-ingest"))
+        Storage.get_meta_data_access_keys().insert(
+            AccessKey("benchkey", app_id))
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+        port = srv.start_background()
+
+        def batch_body(cid: int, b: int) -> bytes:
+            return json.dumps([
+                {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": f"u{cid}_{b}_{k}",
+                    "targetEntityType": "item",
+                    "targetEntityId": f"i{k}",
+                    "properties": {"rating": float(1 + k % 5)},
+                }
+                for k in range(batch_size)
+            ]).encode()
+
+        path = "/batch/events.json?accessKey=benchkey"
+
+        async def load() -> float:
+            t0 = time.perf_counter()
+            await asyncio.wait_for(
+                asyncio.gather(*[
+                    _http_post_loop(port, path, (
+                        batch_body(c, b) for b in range(batches_per_client)))
+                    for c in range(n_clients)
+                ]),
+                timeout=600.0)
+            return time.perf_counter() - t0
+
+        wall = asyncio.run(load())
+        total = n_clients * batches_per_client * batch_size
+        landed = Storage.get_events().scan_interactions(
+            app_id=app_id, event_names=("rate",), value_prop="rating")
+        assert len(landed) == total, (len(landed), total)
+        eps = total / wall
+        log(f"ingest-http: {total} events in {wall:.1f}s "
+            f"({eps:.0f} ev/s, {n_clients} clients x "
+            f"{batches_per_client} batches of {batch_size})")
+        srv.stop()
+        Storage.reset()
+        return round(eps, 1)
 
 
 def bench_serving(state, inter):
@@ -410,35 +605,20 @@ def bench_serving(state, inter):
     import asyncio
 
     async def _load() -> float:
-        async def one(cid: int) -> None:
-            reader, writer = await asyncio.open_connection("127.0.0.1", port)
-            try:
-                for j in range(per_client):
-                    body = json.dumps({
-                        "user": f"u{(cid * per_client + j) % N_USERS}",
-                        "num": 10}).encode()
-                    writer.write(
-                        b"POST /queries.json HTTP/1.1\r\nHost: bench\r\n"
-                        b"Content-Type: application/json\r\n"
-                        + f"Content-Length: {len(body)}\r\n\r\n".encode()
-                        + body)
-                    await writer.drain()
-                    head = await reader.readuntil(b"\r\n\r\n")
-                    status_line = head.split(b"\r\n", 1)[0]
-                    if b" 200 " not in status_line:
-                        raise RuntimeError(
-                            f"concurrent query failed: {status_line!r}")
-                    clen = int(next(
-                        line.split(b":")[1]
-                        for line in head.split(b"\r\n")
-                        if line.lower().startswith(b"content-length")))
-                    await reader.readexactly(clen)
-            finally:
-                writer.close()
+        def bodies(cid: int):
+            return (
+                json.dumps({
+                    "user": f"u{(cid * per_client + j) % N_USERS}",
+                    "num": 10}).encode()
+                for j in range(per_client)
+            )
         t0 = time.perf_counter()
         # per-phase deadline replacing the old per-request urlopen timeout
         await asyncio.wait_for(
-            asyncio.gather(*[one(c) for c in range(n_clients)]),
+            asyncio.gather(*[
+                _http_post_loop(port, "/queries.json", bodies(c))
+                for c in range(n_clients)
+            ]),
             timeout=max(120.0, 0.5 * n_clients * per_client))
         return time.perf_counter() - t0
 

@@ -1,0 +1,157 @@
+"""Planted-ground-truth quality proof through the REAL eval workflow.
+
+The r3 verdict: "model quality is asserted, not proven" — synthetic noise
+benches only prove the solver ran. These tests plant a low-rank + noise
+ground truth with a KNOWN recoverable structure and drive the actual
+`pio eval` machinery (CoreWorkflow.run_evaluation → MetricEvaluator →
+best.json, MetricEvaluator.scala:185's role):
+
+- heldout RMSE must approach the planted noise floor (recovery),
+- precision@k must find the planted ranking,
+- the evaluator must *discriminate*: given a good and a crippled
+  candidate, best.json must carry the good one.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams, MetricEvaluator
+from incubator_predictionio_tpu.core.evaluation import Evaluation
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import App, Storage
+from incubator_predictionio_tpu.models.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    Query,
+    RecommendationEngine,
+)
+from incubator_predictionio_tpu.models.recommendation.engine import (
+    PrecisionAtK,
+)
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+N_USERS, N_ITEMS, PLANT_RANK = 60, 40, 3
+SIGMA = 0.2
+DENSITY = 0.5
+
+
+@pytest.fixture(autouse=True)
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+@pytest.fixture
+def planted():
+    """Ratings = 3.5 + U·Vᵀ + N(0, σ) seeded into the event store;
+    returns (app_id, U, V, observed-(u,i) set).
+
+    Observation is preference-biased (each user rates items with
+    probability ∝ softmax of the true score) — the property of real
+    ratings data that makes held-out precision@k a DISCRIMINATING metric:
+    under uniform observation, hits are observation-driven and every
+    model scores at the chance floor."""
+    rng = np.random.default_rng(11)
+    u_true = rng.normal(0, 1 / np.sqrt(PLANT_RANK),
+                        (N_USERS, PLANT_RANK))
+    v_true = rng.normal(0, 1.0, (N_ITEMS, PLANT_RANK))
+    Storage.get_meta_data_apps().insert(App(0, "planted"))
+    app_id = Storage.get_meta_data_apps().get_by_name("planted").id
+    dao = Storage.get_events()
+    per_user = int(DENSITY * N_ITEMS)
+    users_l, items_l = [], []
+    for u in range(N_USERS):
+        scores = u_true[u] @ v_true.T
+        w = np.exp(2.0 * (scores - scores.max()))
+        picks = rng.choice(N_ITEMS, size=per_user, replace=False,
+                           p=w / w.sum())
+        users_l.extend([u] * per_user)
+        items_l.extend(picks.tolist())
+    users = np.asarray(users_l)
+    items = np.asarray(items_l)
+    ratings = (3.5 + np.einsum("nk,nk->n", u_true[users], v_true[items])
+               + rng.normal(0, SIGMA, len(users)))
+    for u, i, r in zip(users, items, ratings):
+        dao.insert(Event(
+            event="rate", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id=f"i{i}",
+            properties=DataMap({"rating": float(r)}),
+        ), app_id)
+    return app_id, u_true, v_true, set(zip(users.tolist(), items.tolist()))
+
+
+def params(lambda_=0.05, rank=8, eval_k=0, iterations=12):
+    return EngineParams(
+        data_source_params=("", DataSourceParams(app_name="planted",
+                                                 eval_k=eval_k)),
+        algorithm_params_list=[
+            ("als", ALSAlgorithmParams(rank=rank, num_iterations=iterations,
+                                       lambda_=lambda_, seed=7))
+        ],
+    )
+
+
+def test_heldout_rmse_recovers_noise_floor(planted):
+    """Training on the observed half recovers the planted structure: RMSE
+    on FRESH (user, item) pairs — never observed — approaches σ, far
+    below the ratings' own stdev (≈ 1 + σ)."""
+    app_id, u_true, v_true, seen = planted
+    engine = RecommendationEngine().apply()
+    model = engine.train(RuntimeContext(), params())[0]
+    rng = np.random.default_rng(3)
+    err, n = 0.0, 0
+    uf = np.asarray(model.user_factors)
+    vf = np.asarray(model.item_factors)
+    for _ in range(2000):
+        u = int(rng.integers(N_USERS))
+        i = int(rng.integers(N_ITEMS))
+        if (u, i) in seen:
+            continue
+        ui = model.user_bimap.get(f"u{u}")
+        ii = model.item_bimap.get(f"i{i}")
+        if ui is None or ii is None:
+            continue
+        true_rating = 3.5 + float(u_true[u] @ v_true[i])
+        pred = float(uf[ui] @ vf[ii])
+        err += (pred - true_rating) ** 2
+        n += 1
+    assert n > 300
+    rmse = np.sqrt(err / n)
+    # generalization ≈ noise floor (σ=0.2); the ratings themselves have
+    # stdev ≈ 1.1, so anything near σ proves real structure recovery
+    assert rmse < 2.5 * SIGMA, rmse
+
+
+def test_eval_workflow_discriminates_and_writes_best_json(planted, tmp_path):
+    """pio eval parity: MetricEvaluator scores a good candidate against an
+    over-regularized one, picks the good one, and writes best.json."""
+    app_id, *_ = planted
+    best_path = tmp_path / "best.json"
+    engine = RecommendationEngine().apply()
+    evaluation = Evaluation()
+    evaluation.engine_evaluator = (
+        engine,
+        MetricEvaluator(PrecisionAtK(k=5), output_path=str(best_path)),
+    )
+    good = params(lambda_=0.05, eval_k=3)
+    untrained = params(eval_k=3, iterations=0)  # random init factors
+    iid, result = CoreWorkflow.run_evaluation(evaluation, [untrained, good])
+    assert result.best_score.score > 0.35   # planted ranking is findable
+    assert result.best_idx == 1             # ...and the evaluator knows it
+    scores = [ms.score for _, ms in result.engine_params_scores]
+    assert scores[1] > scores[0] + 0.1      # clear separation from chance
+    written = json.loads(best_path.read_text())
+    assert written == good.to_jsonable()    # best.json carries the winner

@@ -18,6 +18,7 @@ import argparse
 import asyncio
 import importlib
 import json
+import os
 import sys
 from typing import Any, List, Optional
 
@@ -86,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
     ):
         p = sub.add_parser(name, help=help_text)
         p.add_argument("--variant", default="engine.json")
+        if name in ("train", "deploy"):
+            p.add_argument(
+                "--hosts", default="",
+                help="comma-separated pod hosts: launch this command on "
+                     "every host with the coordinator env trio set "
+                     "(parallel/launcher.py; Runner.scala:101-213 parity)")
         if name == "train":
             p.add_argument("--batch", default="")
             p.add_argument("--skip-sanity-check", action="store_true")
@@ -117,6 +124,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="module:attr of the EngineParamsGenerator")
     p.add_argument("--batch", default="")
     p.add_argument("--output-best", default="best.json")
+    p.add_argument("--hosts", default="",
+                   help="comma-separated pod hosts (see `pio train --hosts`)")
 
     p = sub.add_parser("undeploy", help="stop a deployed engine server")
     p.add_argument("--ip", default="127.0.0.1")
@@ -248,6 +257,30 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
         commands.build(engine_json=args.variant)
         print("No compilation step is needed; your engine is ready to train.")
         return 0
+
+    # pod launch (Runner.runOnSpark parity, Runner.scala:101-213): when
+    # --hosts is given and we are NOT already a launched worker, re-run
+    # this exact command once per host with the coordinator trio set —
+    # each worker then joins the multi-controller runtime via
+    # parallel.distributed.ensure_initialized.
+    if cmd in ("train", "eval", "deploy") and getattr(args, "hosts", "") \
+            and "PIO_PROCESS_ID" not in os.environ:
+        from incubator_predictionio_tpu.parallel.launcher import (
+            relaunch_over_hosts,
+        )
+
+        hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+        return relaunch_over_hosts(hosts)
+
+    # a launched worker (or an externally-provisioned pod process) joins
+    # the multi-controller runtime before any engine code builds a mesh
+    if cmd in ("train", "eval", "deploy") and \
+            os.environ.get("PIO_COORDINATOR_ADDRESS"):
+        from incubator_predictionio_tpu.parallel.distributed import (
+            ensure_initialized,
+        )
+
+        ensure_initialized()
 
     if cmd == "unregister":
         commands.unregister()

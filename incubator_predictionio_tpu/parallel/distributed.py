@@ -39,10 +39,23 @@ def ensure_initialized() -> bool:
     if _initialized:
         return jax.process_count() > 1
     coord = os.environ.get("PIO_COORDINATOR_ADDRESS")
+    if coord and "PIO_NUM_PROCESSES" not in os.environ:
+        # fail loudly: silently defaulting to 1 would make every host of
+        # a misconfigured pod train its own duplicate model
+        raise RuntimeError(
+            "PIO_COORDINATOR_ADDRESS is set but PIO_NUM_PROCESSES is not "
+            "— set the full coordinator env trio (launcher.py does)")
+    n_proc = int(os.environ.get("PIO_NUM_PROCESSES", "1") or 1)
+    if coord and n_proc <= 1:
+        # a 1-host pod has nothing to coordinate: plain single-controller
+        # JAX is the correct runtime (and distributed.initialize with a
+        # 1-process service hangs under proxied/tunneled device platforms)
+        logger.info("distributed: single process — coordinator skipped")
+        coord = None
     if coord:
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(os.environ["PIO_NUM_PROCESSES"]),
+            num_processes=n_proc,
             process_id=int(os.environ["PIO_PROCESS_ID"]),
         )
         logger.info(

@@ -1,0 +1,206 @@
+"""Pod launcher — spawn and supervise one worker process per host.
+
+The reference's ``pio train``/``deploy`` don't just *join* a cluster run,
+they *launch* it: Runner.runOnSpark (tools/.../Runner.scala:101-213)
+builds the spark-submit command line and forwards every ``PIO_*`` env var
+to the executors (Runner.scala:129-131). This is the TPU-pod equivalent:
+given N hosts, spawn the SAME pio command on each with the coordinator
+env trio set (``PIO_COORDINATOR_ADDRESS`` / ``PIO_NUM_PROCESSES`` /
+``PIO_PROCESS_ID`` — consumed by parallel/distributed.py
+``ensure_initialized``), stream per-host logs with a host prefix, and
+supervise: the first failing worker tears the rest down, spark-driver
+style.
+
+Host specs: ``local`` / ``localhost`` / ``127.0.0.1`` spawn directly;
+anything else goes through ``ssh <host> env K=V... <cmd>`` (ssh does not
+forward environment, so the trio + PIO_* vars ride the command line).
+Process 0 runs on the first host, which also hosts the coordinator.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+_LOCAL = {"local", "localhost", "127.0.0.1"}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _is_local(host: str) -> bool:
+    return host.split("@")[-1] in _LOCAL
+
+
+class PodLauncher:
+    """Launch ``argv`` once per host with the coordinator trio set."""
+
+    def __init__(
+        self,
+        hosts: Sequence[str],
+        argv: Sequence[str],
+        coordinator_port: Optional[int] = None,
+        env_extra: Optional[Dict[str, str]] = None,
+        ssh: Sequence[str] = ("ssh", "-o", "BatchMode=yes"),
+    ):
+        if not hosts:
+            raise ValueError("need at least one host")
+        self.hosts = list(hosts)
+        self.argv = list(argv)
+        self.ssh = list(ssh)
+        self.env_extra = dict(env_extra or {})
+        # the coordinator lives on host 0. A local host 0 can pick a free
+        # port here; for a remote host 0 an auto-picked port would be
+        # validated on the WRONG machine, so it must be given explicitly
+        # (PIO_COORDINATOR_PORT / --coordinator-port).
+        first = self.hosts[0]
+        if coordinator_port is None and not _is_local(first):
+            coordinator_port = int(
+                os.environ.get("PIO_COORDINATOR_PORT", "0")) or None
+            if coordinator_port is None:
+                raise ValueError(
+                    f"host 0 ({first}) is remote: pass coordinator_port "
+                    "(or set PIO_COORDINATOR_PORT) — a port picked on "
+                    "this machine is not known to be free there")
+        self.port = coordinator_port or _free_port()
+        self.coordinator = (
+            f"127.0.0.1:{self.port}" if _is_local(first)
+            else f"{first.split('@')[-1]}:{self.port}"
+        )
+        self.procs: List[subprocess.Popen] = []
+
+    def _worker_env(self, process_id: int) -> Dict[str, str]:
+        env = {
+            "PIO_COORDINATOR_ADDRESS": self.coordinator,
+            "PIO_NUM_PROCESSES": str(len(self.hosts)),
+            "PIO_PROCESS_ID": str(process_id),
+        }
+        # PIO_* forwarding parity (Runner.scala:129-131)
+        env.update({
+            k: v for k, v in os.environ.items()
+            if k.startswith("PIO_") and k not in env
+        })
+        env.update(self.env_extra)
+        return env
+
+    def _spawn(self, host: str, process_id: int) -> subprocess.Popen:
+        wenv = self._worker_env(process_id)
+        if _is_local(host):
+            env = dict(os.environ)
+            env.update(wenv)
+            cmd = self.argv
+        else:
+            env = dict(os.environ)
+            cmd = self.ssh + [host, "env"] + [
+                f"{k}={shlex.quote(v)}" for k, v in wenv.items()
+            ] + [shlex.quote(a) for a in self.argv]
+        logger.info("pod launcher: process %d on %s", process_id, host)
+        return subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, errors="replace",
+        )
+
+    @staticmethod
+    def _stream(proc: subprocess.Popen, tag: str, sink) -> None:
+        for line in proc.stdout:  # type: ignore[union-attr]
+            sink(f"[{tag}] {line.rstrip()}")
+
+    def launch(self, sink=None, timeout: Optional[float] = None) -> int:
+        """Run all workers to completion → worst exit code.
+
+        The first non-zero exit terminates the remaining workers (a pod
+        program cannot make progress minus one participant — collectives
+        would deadlock)."""
+        sink = sink or (lambda line: print(line, file=sys.stderr))
+        self.procs = [
+            self._spawn(host, i) for i, host in enumerate(self.hosts)
+        ]
+        streams = [
+            threading.Thread(
+                target=self._stream, args=(p, f"{i}:{h}", sink), daemon=True)
+            for i, (p, h) in enumerate(zip(self.procs, self.hosts))
+        ]
+        for t in streams:
+            t.start()
+        rc = 0
+        try:
+            pending = set(range(len(self.procs)))
+            import time as _time
+            deadline = None if timeout is None else _time.time() + timeout
+            while pending:
+                for i in list(pending):
+                    r = self.procs[i].poll()
+                    if r is None:
+                        continue
+                    pending.discard(i)
+                    if r != 0:
+                        rc = rc or (128 - r if r < 0 else r)
+                        logger.error(
+                            "pod launcher: process %d (%s) exited %d — "
+                            "terminating the pod", i, self.hosts[i], r)
+                        self.terminate()
+                        pending.clear()
+                        break
+                if pending:
+                    if deadline is not None and _time.time() > deadline:
+                        logger.error("pod launcher: timeout — terminating")
+                        self.terminate()
+                        rc = rc or 124
+                        break
+                    _time.sleep(0.05)
+        finally:
+            self.terminate()
+            for p in self.procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    # a worker holding a SIGTERM handler (grpc coordinator
+                    # threads) must not wedge the launcher: escalate
+                    p.kill()
+                    p.wait()
+            for t in streams:
+                t.join(timeout=5)
+        for p in self.procs:
+            code = p.returncode or 0
+            if code and not rc:
+                # normalize signal deaths (negative returncode) to the
+                # shell convention so a crashed worker can never be
+                # masked to success by a clean sibling
+                rc = 128 - code if code < 0 else code
+        return rc
+
+    def terminate(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+
+
+def relaunch_over_hosts(hosts: Sequence[str],
+                        extra_env: Optional[Dict[str, str]] = None) -> int:
+    """Re-run THIS pio invocation once per host (minus its ``--hosts``
+    flag), coordinator trio set — the CLI hook for
+    ``pio train --hosts h1,h2``. Returns the pod's exit code."""
+    argv = [sys.executable, "-m", "incubator_predictionio_tpu.cli.main"]
+    skip_next = False
+    for a in sys.argv[1:]:
+        if skip_next:
+            skip_next = False
+            continue
+        if a == "--hosts":
+            skip_next = True
+            continue
+        if a.startswith("--hosts="):
+            continue
+        argv.append(a)
+    return PodLauncher(hosts, argv, env_extra=extra_env).launch()

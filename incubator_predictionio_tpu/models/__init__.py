@@ -9,4 +9,6 @@ template families (SURVEY.md §2.6, examples/scala-parallel-*):
 - ``ecommerce``       — implicit ALS + serve-time business-rule filtering
 - ``sequence``        — session-based next-item transformer (SASRec-style)
   with ring/Ulysses sequence parallelism for long histories
+- ``regression``      — linear regression (exact ridge solve + SGD) under
+  AverageServing (examples/experimental/scala-{parallel,local}-regression)
 """

@@ -1,0 +1,13 @@
+from incubator_predictionio_tpu.models.regression.engine import (
+    DataSourceParams,
+    LinearAlgorithmParams,
+    MeanSquareError,
+    Query,
+    RegressionEngine,
+    SGDAlgorithmParams,
+)
+
+__all__ = [
+    "DataSourceParams", "LinearAlgorithmParams", "MeanSquareError",
+    "Query", "RegressionEngine", "SGDAlgorithmParams",
+]

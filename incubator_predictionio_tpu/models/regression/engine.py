@@ -1,0 +1,256 @@
+"""Regression engine: labeled feature vectors → linear model on device.
+
+Reference parity (the one mainline algorithm family previously missing —
+examples/experimental/scala-parallel-regression/Run.scala and
+scala-local-regression/Run.scala):
+
+- DataSource reads labeled points. The reference examples read a text
+  file of ``label f0 f1 ...`` rows; here points live in the event store
+  as entity properties (``label`` + ``features``), with a file reader
+  kept for the examples' lr_data.txt format. k-fold read_eval mirrors
+  the parallel example's ``MLUtils.kFold`` (Run.scala:63).
+- Two algorithms under one engine: ``linear`` (exact normal-equation
+  solve — the local example's breeze/nak path) and ``sgd``
+  (LinearRegressionWithSGD's numIterations/stepSize contract).
+- AverageServing combines them (the parallel example's LAverageServing),
+  and predictions are plain doubles on the wire.
+- MeanSquareError metric (controller.MeanSquareError in both examples).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Algorithm,
+    AverageMetric,
+    AverageServing,
+    DataSource,
+    Engine,
+    EngineFactory,
+    Params,
+    Preparator,
+)
+from incubator_predictionio_tpu.data.store import EventStore
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    __camel_case__ = True
+
+    features: Tuple[float, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class LabeledPoint:
+    label: float
+    features: Tuple[float, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    __camel_case__ = True
+
+    app_name: str = ""
+    #: optional ``label f0 f1 ...`` text file (the reference examples'
+    #: lr_data.txt format); when set, the event store is not consulted
+    filepath: str = ""
+    entity_type: str = "point"
+    label_attr: str = "label"
+    features_attr: str = "features"
+    eval_k: int = 0
+    seed: int = 9527
+
+
+@dataclasses.dataclass
+class TrainingData:
+    labeled_points: List[LabeledPoint]
+
+    def sanity_check(self) -> None:
+        if not self.labeled_points:
+            raise ValueError("TrainingData has no labeled points")
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalInfo:
+    fold: int
+
+
+class RegressionDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def _read_points(self) -> List[LabeledPoint]:
+        if self.params.filepath:
+            points = []
+            with open(self.params.filepath) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    points.append(LabeledPoint(
+                        label=float(parts[0]),
+                        features=tuple(float(v) for v in parts[1:]),
+                    ))
+            return points
+        props = EventStore.aggregate_properties(
+            app_name=self.params.app_name,
+            entity_type=self.params.entity_type,
+            required=[self.params.label_attr, self.params.features_attr],
+        )
+        points = []
+        for _entity, pm in sorted(props.items()):
+            features = pm.get(self.params.features_attr, list)
+            points.append(LabeledPoint(
+                label=pm.get(self.params.label_attr, float),
+                features=tuple(float(v) for v in features),
+            ))
+        return points
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        return TrainingData(self._read_points())
+
+    def read_eval(self, ctx: RuntimeContext):
+        from incubator_predictionio_tpu.e2 import split_data
+
+        if self.params.eval_k <= 0:
+            return []
+        points = self._read_points()
+        return [
+            (TrainingData(train), EvalInfo(fold), qa)
+            for train, fold, qa in split_data(
+                self.params.eval_k, points,
+                lambda p: (Query(features=p.features), p.label),
+            )
+        ]
+
+
+@dataclasses.dataclass
+class PreparedData:
+    features: np.ndarray   # [N, K] f32
+    labels: np.ndarray     # [N] f32
+
+
+class RegressionPreparator(Preparator):
+    """Points → dense device-ready arrays (IdentityPreparator's role; the
+    columnar form is the TPU-native identity)."""
+
+    def prepare(self, ctx: RuntimeContext, td: TrainingData) -> PreparedData:
+        return PreparedData(
+            features=np.array([p.features for p in td.labeled_points],
+                              np.float32),
+            labels=np.array([p.label for p in td.labeled_points],
+                            np.float32),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearAlgorithmParams(Params):
+    __camel_case__ = True
+
+    l2: float = 0.0
+
+
+@dataclasses.dataclass
+class RegressionModel:
+    weights: Any  # [K+1] device array, intercept last
+
+
+def _predict(model: RegressionModel, query: Query) -> float:
+    """The one prediction path both algorithms share (a regression model
+    is just its weight vector, however it was fit)."""
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops.linreg import linreg_predict
+
+    return float(linreg_predict(
+        model.weights, jnp.asarray([query.features], jnp.float32))[0])
+
+
+class LinearAlgorithm(Algorithm):
+    """Exact normal-equation ridge solve (the local example's
+    nak LinearRegression.regress path → ops.linreg.linreg_fit)."""
+
+    params_class = LinearAlgorithmParams
+    query_class_ = Query
+
+    def __init__(self, params: LinearAlgorithmParams = LinearAlgorithmParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, pd: PreparedData) -> RegressionModel:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.linreg import linreg_fit
+
+        return RegressionModel(weights=linreg_fit(
+            jnp.asarray(pd.features), jnp.asarray(pd.labels),
+            l2=self.params.l2))
+
+    def predict(self, model: RegressionModel, query: Query) -> float:
+        return _predict(model, query)
+
+
+@dataclasses.dataclass(frozen=True)
+class SGDAlgorithmParams(Params):
+    __camel_case__ = True
+
+    num_iterations: int = 200
+    step_size: float = 0.1
+    l2: float = 0.0
+
+
+class SGDAlgorithm(Algorithm):
+    """Gradient-descent fit (LinearRegressionWithSGD's contract —
+    Run.scala AlgorithmParams(numIterations, stepSize))."""
+
+    params_class = SGDAlgorithmParams
+    query_class_ = Query
+
+    def __init__(self, params: SGDAlgorithmParams = SGDAlgorithmParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, pd: PreparedData) -> RegressionModel:
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.linreg import linreg_fit_sgd
+
+        return RegressionModel(weights=linreg_fit_sgd(
+            jnp.asarray(pd.features), jnp.asarray(pd.labels),
+            steps=self.params.num_iterations,
+            step_size=self.params.step_size,
+            l2=self.params.l2))
+
+    def predict(self, model: RegressionModel, query: Query) -> float:
+        return _predict(model, query)
+
+
+class MeanSquareError(AverageMetric):
+    """controller.MeanSquareError (both reference regression examples'
+    evaluator)."""
+
+    def header(self) -> str:
+        return "MSE"
+
+    def calculate_qpa(self, q: Query, p: float, a: float) -> float:
+        return (p - a) ** 2
+
+    def compare(self, left: float, right: float) -> int:
+        # lower MSE is better
+        return (left < right) - (left > right)
+
+
+class RegressionEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            RegressionDataSource,
+            RegressionPreparator,
+            {"linear": LinearAlgorithm, "sgd": SGDAlgorithm},
+            AverageServing,
+        )

@@ -1,0 +1,69 @@
+"""Linear regression on device — the regression-template solvers.
+
+Two fits mirroring the reference's pair of regression examples:
+
+- :func:`linreg_fit` — closed-form ridge via the normal equations, the
+  local example's exact solve (examples/experimental/scala-local-regression/
+  Run.scala: breeze + nak LinearRegression.regress). One K×K Cholesky on
+  the MXU; K = feature count is small, the cost is the [N, K] Gram.
+- :func:`linreg_fit_sgd` — gradient descent, the parallel example's
+  LinearRegressionWithSGD (scala-parallel-regression/Run.scala:
+  numIterations/stepSize params). ``lax.scan`` over full-batch gradient
+  steps: one fused device program, no per-step dispatch.
+
+Both learn an intercept by augmenting features with a constant column,
+and return the weight vector [K+1] (intercept last).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _augment(x: jax.Array) -> jax.Array:
+    return jnp.concatenate(
+        [x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def linreg_fit(x: jax.Array, y: jax.Array, l2: float = 0.0) -> jax.Array:
+    """Ridge normal equations: (XᵗX + λI) w = Xᵗy → w [K+1]."""
+    xa = _augment(x.astype(jnp.float32))
+    k = xa.shape[1]
+    gram = xa.T @ xa + l2 * jnp.eye(k, dtype=jnp.float32)
+    rhs = xa.T @ y.astype(jnp.float32)
+    chol = jax.scipy.linalg.cho_factor(gram)
+    return jax.scipy.linalg.cho_solve(chol, rhs)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def linreg_fit_sgd(
+    x: jax.Array,
+    y: jax.Array,
+    steps: int = 200,
+    step_size: float = 0.1,
+    l2: float = 0.0,
+) -> jax.Array:
+    """Full-batch gradient descent on MSE (LinearRegressionWithSGD's role;
+    full-batch because the whole design matrix sits in HBM — minibatching
+    would only add dispatch overhead at template scale)."""
+    xa = _augment(x.astype(jnp.float32))
+    ya = y.astype(jnp.float32)
+    n = xa.shape[0]
+
+    def step(w, _):
+        grad = xa.T @ (xa @ w - ya) / n + l2 * w
+        return w - step_size * grad, None
+
+    w0 = jnp.zeros((xa.shape[1],), jnp.float32)
+    w, _ = jax.lax.scan(step, w0, None, length=steps)
+    return w
+
+
+@jax.jit
+def linreg_predict(w: jax.Array, x: jax.Array) -> jax.Array:
+    """Predictions for a [N, K] feature batch → [N]."""
+    return _augment(x.astype(jnp.float32)) @ w

@@ -1,0 +1,138 @@
+"""Regression template (models/regression) — the last missing mainline
+algorithm family (parity: examples/experimental/scala-parallel-regression
++ scala-local-regression)."""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams, MetricEvaluator
+from incubator_predictionio_tpu.core.evaluation import Evaluation
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import App, Storage
+from incubator_predictionio_tpu.models.regression import (
+    DataSourceParams,
+    LinearAlgorithmParams,
+    MeanSquareError,
+    Query,
+    RegressionEngine,
+    SGDAlgorithmParams,
+)
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+W_TRUE = np.array([2.0, -1.0, 0.5])
+INTERCEPT = 0.7
+
+
+@pytest.fixture(autouse=True)
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+@pytest.fixture
+def seeded_app():
+    Storage.get_meta_data_apps().insert(App(0, "regapp"))
+    app_id = Storage.get_meta_data_apps().get_by_name("regapp").id
+    dao = Storage.get_events()
+    rng = np.random.default_rng(1)
+    for i in range(150):
+        x = rng.normal(size=3)
+        y = float(x @ W_TRUE + INTERCEPT + rng.normal(0, 0.05))
+        dao.insert(Event(
+            event="$set", entity_type="point", entity_id=f"p{i}",
+            properties=DataMap({"label": y,
+                                "features": [float(v) for v in x]}),
+        ), app_id)
+    return app_id
+
+
+def params(eval_k=0, algos=("linear", "sgd")):
+    algo_params = {
+        "linear": LinearAlgorithmParams(l2=0.0),
+        "sgd": SGDAlgorithmParams(num_iterations=300, step_size=0.1),
+    }
+    return EngineParams(
+        data_source_params=("", DataSourceParams(app_name="regapp",
+                                                 eval_k=eval_k)),
+        algorithm_params_list=[(a, algo_params[a]) for a in algos],
+    )
+
+
+def test_linear_recovers_planted_weights(seeded_app):
+    engine = RegressionEngine().apply()
+    models = engine.train(RuntimeContext(), params(algos=("linear",)))
+    w = np.asarray(models[0].weights)
+    np.testing.assert_allclose(w[:3], W_TRUE, atol=0.05)
+    assert abs(w[3] - INTERCEPT) < 0.05  # intercept last
+
+
+def test_sgd_agrees_with_exact_solve(seeded_app):
+    engine = RegressionEngine().apply()
+    models = engine.train(RuntimeContext(), params())
+    w_lin = np.asarray(models[0].weights)
+    w_sgd = np.asarray(models[1].weights)
+    np.testing.assert_allclose(w_sgd, w_lin, atol=0.1)
+
+
+def test_average_serving_over_both_algorithms(seeded_app):
+    engine = RegressionEngine().apply()
+    ep = params()
+    models = engine.train(RuntimeContext(), ep)
+    algos = engine.algorithms(ep)
+    q = Query(features=(1.0, 2.0, -1.0))
+    preds = [a.predict(m, q) for a, m in zip(algos, models)]
+    serving = engine.serving(ep)
+    served = serving.serve(q, preds)
+    assert served == pytest.approx(sum(preds) / 2)
+    truth = float(np.array(q.features) @ W_TRUE + INTERCEPT)
+    assert abs(served - truth) < 0.2
+
+
+def test_file_datasource_reads_lr_data_format(tmp_path):
+    # the reference examples' lr_data.txt shape: "label f0 f1 f2"
+    rows = ["1.5 1.0 0.0 0.0", "0.5 0.0 1.0 0.0", "2.5 1.0 1.0 1.0"]
+    path = tmp_path / "lr_data.txt"
+    path.write_text("\n".join(rows) + "\n")
+    from incubator_predictionio_tpu.models.regression.engine import (
+        RegressionDataSource,
+    )
+
+    ds = RegressionDataSource(DataSourceParams(filepath=str(path)))
+    td = ds.read_training(RuntimeContext())
+    assert len(td.labeled_points) == 3
+    assert td.labeled_points[0].label == 1.5
+    assert td.labeled_points[2].features == (1.0, 1.0, 1.0)
+
+
+def test_eval_workflow_mse(seeded_app, tmp_path):
+    engine = RegressionEngine().apply()
+    evaluation = Evaluation()
+    evaluation.engine_evaluator = (
+        engine, MetricEvaluator(MeanSquareError(),
+                                output_path=str(tmp_path / "best.json")))
+    good = params(eval_k=3, algos=("linear",))
+    iid, result = CoreWorkflow.run_evaluation(evaluation, [good])
+    # planted noise sigma = 0.05 → MSE floor ≈ 0.0025
+    assert result.best_score.score < 0.01
+    assert (tmp_path / "best.json").exists()
+
+
+def test_wire_format_parity():
+    from incubator_predictionio_tpu.utils import json_codec
+
+    q = json_codec.extract(Query, {"features": [1.0, 2.0, 3.0]})
+    assert q.features == (1.0, 2.0, 3.0)
+    # predictions are bare doubles on the wire (the reference serves
+    # Double through LAverageServing)
+    assert json_codec.to_jsonable(1.25) == 1.25

@@ -270,7 +270,8 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
         )
 
         hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
-        return relaunch_over_hosts(hosts)
+        return relaunch_over_hosts(
+            hosts, argv=getattr(args, "_invocation_argv", None))
 
     # a launched worker (or an externally-provisioned pod process) joins
     # the multi-controller runtime before any engine code builds a mesh
@@ -458,6 +459,9 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    # the true invocation argv, for pod relaunch (programmatic main(argv)
+    # must not fall back to the host process's sys.argv — e.g. pytest's)
+    args._invocation_argv = list(argv) if argv is not None else sys.argv[1:]
     # persistent XLA cache: every pio process after the first skips the
     # multi-second compile (the TPU analogue of the reference's JVM/Spark
     # startup cost per spark-submit)

@@ -74,10 +74,18 @@ class PodLauncher:
                     "(or set PIO_COORDINATOR_PORT) — a port picked on "
                     "this machine is not known to be free there")
         self.port = coordinator_port or _free_port()
-        self.coordinator = (
-            f"127.0.0.1:{self.port}" if _is_local(first)
-            else f"{first.split('@')[-1]}:{self.port}"
-        )
+        if not _is_local(first):
+            coord_host = first.split("@")[-1]
+        elif all(_is_local(h) for h in self.hosts):
+            coord_host = "127.0.0.1"
+        else:
+            # host 0 is this machine but other workers are remote: loopback
+            # would point each remote worker at itself — advertise a
+            # reachable name (override with PIO_COORDINATOR_HOST when the
+            # default hostname doesn't resolve from the workers)
+            coord_host = os.environ.get(
+                "PIO_COORDINATOR_HOST") or socket.getfqdn()
+        self.coordinator = f"{coord_host}:{self.port}"
         self.procs: List[subprocess.Popen] = []
 
     def _worker_env(self, process_id: int) -> Dict[str, str]:
@@ -187,13 +195,17 @@ class PodLauncher:
 
 
 def relaunch_over_hosts(hosts: Sequence[str],
-                        extra_env: Optional[Dict[str, str]] = None) -> int:
+                        extra_env: Optional[Dict[str, str]] = None,
+                        argv: Optional[Sequence[str]] = None) -> int:
     """Re-run THIS pio invocation once per host (minus its ``--hosts``
     flag), coordinator trio set — the CLI hook for
-    ``pio train --hosts h1,h2``. Returns the pod's exit code."""
+    ``pio train --hosts h1,h2``. ``argv`` is the pio argument list
+    (without the program name); defaults to sys.argv[1:] for the
+    command-line entry point. Returns the pod's exit code."""
+    source = list(argv) if argv is not None else sys.argv[1:]
     argv = [sys.executable, "-m", "incubator_predictionio_tpu.cli.main"]
     skip_next = False
-    for a in sys.argv[1:]:
+    for a in source:
         if skip_next:
             skip_next = False
             continue

@@ -36,8 +36,10 @@ def enable(cache_dir: str | None = None) -> None:
     platforms where jax genuinely hasn't been imported yet (there they
     keep `pio app new`-style commands from paying the jax import)."""
     global _enabled
-    if _enabled:
+    if _enabled and cache_dir is None:
         return
+    # an explicit cache_dir re-points the cache even when already enabled
+    # (the bench directs different measurement phases at fresh dirs)
     setting = os.environ.get("PIO_COMPILE_CACHE", "")
     if setting.lower() in ("off", "0", "false", "disable"):
         return
@@ -73,6 +75,15 @@ def enable(cache_dir: str | None = None) -> None:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", min_compile_s)
+            if _enabled:
+                # jax lazily opens its file-cache handle once per process;
+                # re-pointing an already-active cache needs a reset or the
+                # old directory keeps serving
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc,
+                )
+
+                _cc.reset_cache()
         _enabled = True
     except Exception as exc:  # pragma: no cover - cache is best-effort
         logger.warning("compilation cache unavailable: %s", exc)

@@ -130,10 +130,6 @@ def test_cli_worker_joins_runtime_when_coordinator_set():
         "ensure_initialized()\n"
         "print('COUNT', jax.process_count())\n"
     )
-    env = _base_env()
-    env.update({
-        "PIO_COORDINATOR_ADDRESS": "127.0.0.1:0",  # replaced below
-    })
     # use the launcher itself for a 1-process pod: trio set, port picked
     launcher = PodLauncher(["local"], [sys.executable, "-c", code],
                            env_extra=_base_env())

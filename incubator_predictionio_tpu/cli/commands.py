@@ -545,6 +545,35 @@ def import_events(app_name: str, input_path: str,
 # status (commands/Management.scala:99-178)
 # ---------------------------------------------------------------------------
 
+def upgrade(appid_or_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Rewrite event stores in the current on-disk format — the store
+    migration verb (the reference's HBase upgrade tool role,
+    data/.../storage/hbase/upgrade/Upgrade.scala). Delegates to the
+    backend's ``compact`` (cpplog: live-record rewrite dropping
+    tombstones + adding sidecars; sqlite: VACUUM); backends without a
+    migration (memory) are skipped. Covers the default channel plus
+    every named channel of each selected app."""
+    events = Storage.get_events()
+    if not hasattr(events, "compact"):
+        return []
+    apps_dao = Storage.get_meta_data_apps()
+    if appid_or_name is not None:
+        apps = [_get_app(_appid_or_name_to_name(appid_or_name))]
+    else:
+        apps = apps_dao.get_all()
+    results: List[Dict[str, Any]] = []
+    for app in apps:
+        channel_ids = [None] + [
+            c.id for c in Storage.get_meta_data_channels().get_by_appid(
+                app.id)
+        ]
+        for cid in channel_ids:
+            stats = events.compact(app.id, cid)
+            results.append({"app": app.name, "channel": cid or "default",
+                            **stats})
+    return results
+
+
 def status() -> bool:
     from incubator_predictionio_tpu import __version__
 

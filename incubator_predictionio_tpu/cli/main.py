@@ -176,10 +176,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     tpl.add_parser("get")
     tpl.add_parser("list")
-    # `pio upgrade` (Console.scala upgrade subcommand): the reference
-    # migrates HBase 0.8.x schemas; this framework has no legacy schema, so
-    # the verb exists for CLI parity and reports there is nothing to do
-    sub.add_parser("upgrade", help="upgrade storage schema (no-op)")
+    # `pio upgrade` (Console.scala upgrade subcommand → the HBase upgrade
+    # tool's role): rewrite event stores in the current on-disk format —
+    # drops tombstoned records, adds sidecars to pre-sidecar records
+    # (cpplog), VACUUMs the JDBC store (sqlite)
+    p = sub.add_parser(
+        "upgrade", help="rewrite event stores in the current format")
+    p.add_argument("app", nargs="?", default=None,
+                   help="app name or id (default: every app)")
 
     return parser
 
@@ -448,9 +452,19 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
         return 0
 
     if cmd == "upgrade":
-        print("No storage schema migration is required for this version "
-              "(reference `pio upgrade` migrates HBase 0.8.x schemas; "
-              "this framework's backends have a single schema version).")
+        results = commands.upgrade(args.app)
+        if not results:
+            print("Nothing to upgrade: the configured event backend has "
+                  "no store-level migration/compaction (memory backend), "
+                  "or no apps exist.")
+            return 0
+        for r in results:
+            saved = r["bytes_before"] - r["bytes_after"]
+            print(f"  app {r['app']} channel {r['channel']}: "
+                  f"{r['events']} live events rewritten, "
+                  f"{r['bytes_before']} -> {r['bytes_after']} bytes "
+                  f"({saved:+d} reclaimed)")
+        print("Upgrade complete: stores rewritten in the current format.")
         return 0
 
     print(f"Unknown command {cmd!r}")

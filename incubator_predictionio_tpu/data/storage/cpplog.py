@@ -708,6 +708,60 @@ class CppLogEvents(base.Events):
             user_tab=m_utab, item_tab=m_itab,
             raw_count=raw_before + n, dead_count=dead_before))
 
+    def compact(self, app_id: int,
+                channel_id: Optional[int] = None) -> dict:
+        """Rewrite the log in the CURRENT on-disk format, keeping only
+        live records — the store-migration verb behind ``pio upgrade``
+        (the reference migrates HBase schemas via its upgrade tool,
+        data/.../storage/hbase/upgrade/Upgrade.scala; here the format
+        deltas that have accrued are tombstoned records occupying space
+        and pre-sidecar bare-JSON records that every scan must
+        JSON-parse).
+
+        Every live event round-trips through the normal Event write path
+        (ids, event/creation times, and properties preserved; records
+        gain sidecars where the current writer would produce them), into
+        a temp log that atomically replaces the original. The training
+        projection is invalidated (entry numbering changes). Returns
+        ``{"events", "bytes_before", "bytes_after"}``."""
+        import os
+        import shutil
+        import tempfile
+
+        from incubator_predictionio_tpu.data.storage import traincache
+
+        with self.client.lock:
+            events = list(self.find(app_id=app_id, channel_id=channel_id))
+            path = self.client._file(self.ns, app_id, channel_id)
+            bytes_before = path.stat().st_size if path.exists() else 0
+            tmpdir = tempfile.mkdtemp(prefix=".compact_",
+                                      dir=str(self.client.dir))
+            try:
+                tmp_client = StorageClient(base.StorageClientConfig(
+                    properties={"PATH": tmpdir}))
+                try:
+                    tmp_dao = CppLogEvents(tmp_client, None, prefix=self.ns)
+                    # create the (possibly empty) target log up front: a
+                    # tombstone-only or event-less store must still swap
+                    # to a fresh empty file, not crash on a missing one
+                    tmp_dao.init(app_id, channel_id)
+                    for s in range(0, len(events), 500):
+                        tmp_dao.insert_batch(
+                            events[s:s + 500], app_id, channel_id)
+                finally:
+                    tmp_client.close()  # syncs to disk
+                tmp_path = Path(tmpdir) / path.name
+                old = self.client._handles.pop(str(path), None)
+                if old is not None:
+                    self.client.lib.pio_evlog_close(old)
+                os.replace(tmp_path, path)
+            finally:
+                shutil.rmtree(tmpdir, ignore_errors=True)
+            traincache.invalidate(path)
+            bytes_after = path.stat().st_size if path.exists() else 0
+        return {"events": len(events), "bytes_before": bytes_before,
+                "bytes_after": bytes_after}
+
     @staticmethod
     def _filter_parsed(payloads, entity_type, entity_id, names,
                        target_entity_type, target_entity_id,

@@ -251,6 +251,40 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
     def close(self) -> None:
         pass
 
+    def compact(self, app_id: int,
+                channel_id: Optional[int] = None) -> dict:
+        """``pio upgrade``'s sqlite leg: VACUUM reclaims the space DELETEd
+        rows leave behind (the JDBC store has no other format debt).
+
+        VACUUM rewrites the WHOLE database file, so it runs once per
+        client lifetime (`pio upgrade` = one process = one VACUUM however
+        many apps/channels it walks); later compact() calls of the same
+        run only report their store's live-event count, with zero byte
+        deltas."""
+        import os
+
+        path = self.client._path
+
+        def size() -> int:
+            return (os.path.getsize(path)
+                    if path != ":memory:" and os.path.exists(path) else 0)
+
+        with self.client.lock:
+            conn = self.client.conn
+            (n,) = conn.execute(
+                "SELECT COUNT(*) FROM events WHERE ns = ? AND app_id = ? "
+                "AND channel_id = ?",
+                (self.ns, app_id, _chan(channel_id))).fetchone()
+            if getattr(self.client, "_vacuumed", False):
+                before = after = size()
+            else:
+                before = size()
+                conn.execute("VACUUM")
+                self.client._vacuumed = True
+                after = size()
+        return {"events": int(n), "bytes_before": before,
+                "bytes_after": after}
+
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
         validate_event(event)

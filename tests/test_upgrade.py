@@ -1,0 +1,144 @@
+"""`pio upgrade` — the store migration/compaction verb (the reference's
+HBase upgrade tool role, data/.../storage/hbase/upgrade/Upgrade.scala)."""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.cli import commands
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import App, Storage
+
+
+def _ev(i, minutes=0):
+    from datetime import datetime, timedelta, timezone
+
+    return Event(
+        event="rate", entity_type="user", entity_id=f"u{i}",
+        target_entity_type="item", target_entity_id=f"i{i % 5}",
+        properties=DataMap({"rating": float(1 + i % 5)}),
+        event_time=datetime(2026, 1, 1, tzinfo=timezone.utc)
+        + timedelta(minutes=minutes),
+    )
+
+
+@pytest.fixture
+def cpplog_storage(tmp_path):
+    native = __import__(
+        "incubator_predictionio_tpu.native", fromlist=["load"])
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "cpplog",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+@pytest.fixture
+def sqlite_storage(tmp_path):
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+    })
+    yield
+    Storage.reset()
+
+
+def test_cpplog_compact_drops_dead_records_and_preserves_live(
+        cpplog_storage):
+    Storage.get_meta_data_apps().insert(App(0, "upapp"))
+    app_id = Storage.get_meta_data_apps().get_by_name("upapp").id
+    dao = Storage.get_events()
+    ids = dao.insert_batch([_ev(i, minutes=i) for i in range(40)], app_id)
+    for eid in ids[:15]:  # tombstone 15 of 40
+        assert dao.delete(eid, app_id)
+    path = dao.client._file(dao.ns, app_id, None)
+    bytes_dirty = path.stat().st_size
+    before = [(e.event_id, e.entity_id, e.event_time,
+               e.properties.get("rating"))
+              for e in dao.find(app_id=app_id)]
+    assert len(before) == 25
+
+    results = commands.upgrade("upapp")
+    assert len(results) == 1
+    assert results[0]["events"] == 25
+    assert results[0]["bytes_after"] < bytes_dirty  # tombstones reclaimed
+
+    after = [(e.event_id, e.entity_id, e.event_time,
+              e.properties.get("rating"))
+             for e in dao.find(app_id=app_id)]
+    assert after == before  # ids, times, properties, order all preserved
+    # the store stays fully functional post-swap (reads AND writes)
+    new_id = dao.insert(_ev(99, minutes=99), app_id)
+    assert dao.get(new_id, app_id) is not None
+    inter = dao.scan_interactions(
+        app_id=app_id, event_names=("rate",), value_prop="rating")
+    assert len(inter) == 26
+
+
+def test_cpplog_compact_invalidates_traincache(cpplog_storage, monkeypatch):
+    from incubator_predictionio_tpu.data.storage import traincache
+
+    monkeypatch.setattr(traincache, "MIN_NNZ", 4)
+    Storage.get_meta_data_apps().insert(App(0, "upapp2"))
+    app_id = Storage.get_meta_data_apps().get_by_name("upapp2").id
+    dao = Storage.get_events()
+    from incubator_predictionio_tpu.data.storage.base import Interactions
+
+    inter = Interactions(
+        user_idx=np.arange(8, dtype=np.int32) % 3,
+        item_idx=np.arange(8, dtype=np.int32) % 4,
+        values=np.ones(8, np.float32),
+        user_ids=["a", "b", "c"], item_ids=["w", "x", "y", "z"],
+    )
+    dao.import_interactions(inter, app_id)
+    cpath = traincache.path_for(dao.client._file(dao.ns, app_id, None))
+    assert cpath.exists()
+    commands.upgrade("upapp2")
+    assert not cpath.exists()  # entry numbering changed: cache must die
+    back = dao.scan_interactions(
+        app_id=app_id, event_names=("rate",), value_prop="rating")
+    assert len(back) == 8
+
+
+def test_sqlite_vacuum_reports_and_preserves(sqlite_storage):
+    Storage.get_meta_data_apps().insert(App(0, "upsql"))
+    app_id = Storage.get_meta_data_apps().get_by_name("upsql").id
+    dao = Storage.get_events()
+    ids = dao.insert_batch([_ev(i, minutes=i) for i in range(30)], app_id)
+    for eid in ids[:10]:
+        dao.delete(eid, app_id)
+    results = commands.upgrade()
+    assert results and results[0]["events"] == 20
+    assert len(list(dao.find(app_id=app_id))) == 20
+
+
+def test_memory_backend_reports_nothing_to_do():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    try:
+        assert commands.upgrade() == []
+    finally:
+        Storage.reset()

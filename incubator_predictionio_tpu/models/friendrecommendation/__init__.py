@@ -1,0 +1,14 @@
+from incubator_predictionio_tpu.models.friendrecommendation.engine import (
+    DataSourceParams,
+    FriendRecommendationEngine,
+    KeywordSimilarityAlgoParams,
+    Prediction,
+    Query,
+    SimRankAlgoParams,
+)
+
+__all__ = [
+    "DataSourceParams", "FriendRecommendationEngine",
+    "KeywordSimilarityAlgoParams", "Prediction", "Query",
+    "SimRankAlgoParams",
+]

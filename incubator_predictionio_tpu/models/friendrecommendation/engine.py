@@ -1,0 +1,282 @@
+"""Friend-recommendation engine (the KDD-2012 scenario).
+
+Reference parity (examples/experimental/scala-local-friend-recommendation
++ scala-parallel-friend-recommendation): given ``Query(user, item)``,
+predict an acceptance ``confidence`` plus a boolean ``acceptance``.
+
+- ``keyword``  — sparse dot product of the user's and item's keyword
+  term-weight maps with a trained-or-default weight/threshold pair
+  (KeywordSimilarityAlgorithm.scala: findKeywordSimilarity; the
+  reference ships weight=1, threshold=1).
+- ``random``   — the RandomAlgorithm baseline (RandomModel.scala: a
+  seeded uniform confidence and fixed acceptance threshold).
+- ``simrank``  — the parallel variant's graph similarity
+  (DeltaSimRankRDD.scala), recomputed exactly as dense MXU iterations
+  (ops/simrank.py) over the follow/action edge graph.
+
+Data lives in the event store: ``$set`` on user/item entities carrying a
+``keywords`` map of term→weight, and directed ``follow`` (user→user) /
+``action`` (user→item) events forming the SimRank graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+from incubator_predictionio_tpu.data.store import EventStore
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    __camel_case__ = True
+
+    user: str
+    item: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    __camel_case__ = True
+
+    confidence: float
+    acceptance: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    __camel_case__ = True
+
+    app_name: str
+    user_entity: str = "user"
+    item_entity: str = "item"
+    keywords_attr: str = "keywords"
+    #: directed graph edges for simrank: (entity → target) event names
+    edge_events: Tuple[str, ...] = ("follow", "action")
+
+
+@dataclasses.dataclass
+class TrainingData:
+    user_keywords: Dict[str, Dict[str, float]]
+    item_keywords: Dict[str, Dict[str, float]]
+    #: directed edges over the combined user+item node space, keyed
+    #: "<entity_type>:<entity_id>"
+    edges: List[Tuple[str, str]]
+    #: the entity-type names the edges were keyed with (query resolution
+    #: must use the same prefixes)
+    user_entity: str = "user"
+    item_entity: str = "item"
+
+    def sanity_check(self) -> None:
+        if not self.user_keywords and not self.edges:
+            raise ValueError("TrainingData has no keywords and no edges")
+
+
+class FriendRecommendationDataSource(DataSource):
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+
+    def _keywords(self, entity_type: str) -> Dict[str, Dict[str, float]]:
+        props = EventStore.aggregate_properties(
+            app_name=self.params.app_name, entity_type=entity_type,
+            required=[self.params.keywords_attr])
+        out: Dict[str, Dict[str, float]] = {}
+        for entity, pm in props.items():
+            kw = pm.get(self.params.keywords_attr, dict)
+            out[entity] = {
+                str(k): float(v) for k, v in kw.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+        return out
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        edges: List[Tuple[str, str]] = []
+        for ev in EventStore.find(
+                app_name=self.params.app_name,
+                event_names=self.params.edge_events):
+            if ev.target_entity_id:
+                edges.append((f"{ev.entity_type}:{ev.entity_id}",
+                              f"{ev.target_entity_type}:{ev.target_entity_id}"))
+        return TrainingData(
+            user_keywords=self._keywords(self.params.user_entity),
+            item_keywords=self._keywords(self.params.item_entity),
+            edges=edges,
+            user_entity=self.params.user_entity,
+            item_entity=self.params.item_entity,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class KeywordSimilarityAlgoParams(Params):
+    __camel_case__ = True
+
+    #: the reference's (untrained) defaults
+    #: (KeywordSimilarityAlgorithm.scala:36-37)
+    sim_weight: float = 1.0
+    sim_threshold: float = 1.0
+
+
+@dataclasses.dataclass
+class KeywordSimilarityModel:
+    user_keywords: Dict[str, Dict[str, float]]
+    item_keywords: Dict[str, Dict[str, float]]
+    sim_weight: float
+    sim_threshold: float
+
+
+class KeywordSimilarityAlgorithm(Algorithm):
+    params_class = KeywordSimilarityAlgoParams
+    query_class_ = Query
+
+    def __init__(self, params: KeywordSimilarityAlgoParams =
+                 KeywordSimilarityAlgoParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext,
+              td: TrainingData) -> KeywordSimilarityModel:
+        return KeywordSimilarityModel(
+            user_keywords=td.user_keywords,
+            item_keywords=td.item_keywords,
+            sim_weight=self.params.sim_weight,
+            sim_threshold=self.params.sim_threshold,
+        )
+
+    def predict(self, model: KeywordSimilarityModel,
+                query: Query) -> Prediction:
+        u = model.user_keywords.get(query.user)
+        i = model.item_keywords.get(query.item)
+        confidence = 0.0
+        if u and i:
+            # findKeywordSimilarity: Σ w_u(t) · w_i(t)
+            small, big = (u, i) if len(u) <= len(i) else (i, u)
+            confidence = sum(w * big.get(t, 0.0) for t, w in small.items())
+        return Prediction(
+            confidence=confidence,
+            acceptance=confidence * model.sim_weight
+            >= model.sim_threshold,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomAlgoParams(Params):
+    __camel_case__ = True
+
+    seed: int = 3
+    acceptance_threshold: float = 0.5
+
+
+@dataclasses.dataclass
+class RandomModel:
+    seed: int
+    threshold: float
+
+
+class RandomAlgorithm(Algorithm):
+    """The baseline (RandomAlgorithm.scala / RandomModel.scala): a seeded
+    uniform confidence, deterministic per (user, item)."""
+
+    params_class = RandomAlgoParams
+    query_class_ = Query
+
+    def __init__(self, params: RandomAlgoParams = RandomAlgoParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, td: TrainingData) -> RandomModel:
+        return RandomModel(seed=self.params.seed,
+                           threshold=self.params.acceptance_threshold)
+
+    def predict(self, model: RandomModel, query: Query) -> Prediction:
+        import zlib
+
+        # stable across processes: Python's str hash is salted per
+        # interpreter, which would break the seeded-determinism contract
+        key = f"{model.seed}\x00{query.user}\x00{query.item}".encode()
+        rng = np.random.default_rng(zlib.crc32(key))
+        confidence = float(rng.random())
+        return Prediction(confidence=confidence,
+                          acceptance=confidence >= model.threshold)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimRankAlgoParams(Params):
+    __camel_case__ = True
+
+    decay: float = 0.8          # DeltaSimRankRDD.scala:31
+    iterations: int = 7
+    acceptance_threshold: float = 0.01
+
+
+@dataclasses.dataclass
+class SimRankModel:
+    similarities: np.ndarray    # [N, N]
+    node_index: Dict[str, int]
+    threshold: float
+    user_entity: str = "user"
+    item_entity: str = "item"
+
+
+class SimRankAlgorithm(Algorithm):
+    params_class = SimRankAlgoParams
+    query_class_ = Query
+
+    def __init__(self, params: SimRankAlgoParams = SimRankAlgoParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, td: TrainingData) -> SimRankModel:
+        from incubator_predictionio_tpu.ops.simrank import simrank
+
+        nodes = sorted({n for e in td.edges for n in e})
+        index = {n: k for k, n in enumerate(nodes)}
+        if not nodes:
+            return SimRankModel(
+                similarities=np.zeros((0, 0), np.float32),
+                node_index={}, threshold=self.params.acceptance_threshold,
+                user_entity=td.user_entity, item_entity=td.item_entity)
+        src = np.array([index[a] for a, _ in td.edges], np.int64)
+        dst = np.array([index[b] for _, b in td.edges], np.int64)
+        sims = simrank(src, dst, len(nodes), decay=self.params.decay,
+                       iterations=self.params.iterations)
+        return SimRankModel(similarities=sims, node_index=index,
+                            threshold=self.params.acceptance_threshold,
+                            user_entity=td.user_entity,
+                            item_entity=td.item_entity)
+
+    def predict(self, model: SimRankModel, query: Query) -> Prediction:
+        a = model.node_index.get(f"{model.user_entity}:{query.user}")
+        b = model.node_index.get(f"{model.item_entity}:{query.item}")
+        if b is None:
+            b = model.node_index.get(f"{model.user_entity}:{query.item}")
+        confidence = 0.0
+        if a is not None and b is not None:
+            confidence = float(model.similarities[a, b])
+        return Prediction(confidence=confidence,
+                          acceptance=confidence >= model.threshold)
+
+
+class FriendRecommendationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            FriendRecommendationDataSource,
+            IdentityPreparator,
+            {
+                "keyword": KeywordSimilarityAlgorithm,
+                "random": RandomAlgorithm,
+                "simrank": SimRankAlgorithm,
+            },
+            FirstServing,
+        )

@@ -310,11 +310,83 @@ class SimilarProductAlgorithm(Algorithm):
         return PredictedResult(item_scores=tuple(out))
 
 
+@dataclasses.dataclass(frozen=True)
+class DIMSUMAlgorithmParams(Params):
+    __camel_case__ = True
+
+    #: minimum cosine similarity kept (columnSimilarities(threshold))
+    threshold: float = 0.1
+    #: neighbors stored per item — the model is [I, topN], not [I, I]
+    top_n: int = 100
+
+
+@dataclasses.dataclass
+class DIMSUMModel:
+    sim_scores: np.ndarray    # [I, T] f32, 0 where absent
+    sim_indices: np.ndarray   # [I, T] int32
+    item_bimap: BiMap
+    item_categories: Dict[str, Tuple[str, ...]]
+
+
+class DIMSUMAlgorithm(Algorithm):
+    """Exact item-item cosine similarity (the similarproduct-dimsum
+    variant, examples/experimental/scala-parallel-similarproduct-dimsum/
+    DIMSUMAlgorithm.scala:118-145 — its Spark columnSimilarities sampling
+    replaced by the exact MXU Gram, ops/dimsum.py). Prediction sums
+    similarity over the query items (indexScores groupBy-sum, :168)."""
+
+    params_class = DIMSUMAlgorithmParams
+    query_class_ = Query
+
+    def __init__(self,
+                 params: DIMSUMAlgorithmParams = DIMSUMAlgorithmParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, pd: PreparedData) -> DIMSUMModel:
+        from incubator_predictionio_tpu.ops.dimsum import column_cosine_topk
+
+        scores, indices = column_cosine_topk(
+            pd.users, pd.items, pd.weights,
+            n_items=len(pd.item_bimap),
+            threshold=self.params.threshold,
+            top_n=self.params.top_n,
+        )
+        return DIMSUMModel(
+            sim_scores=np.asarray(scores),
+            sim_indices=np.asarray(indices),
+            item_bimap=pd.item_bimap,
+            item_categories=pd.item_categories,
+        )
+
+    # filters are identical to the ALS variant's (same Query contract)
+    _allowed_mask = SimilarProductAlgorithm._allowed_mask
+
+    def predict(self, model: DIMSUMModel, query: Query) -> PredictedResult:
+        indices = [
+            model.item_bimap[i] for i in query.items if i in model.item_bimap
+        ]
+        if not indices:
+            return PredictedResult(item_scores=())
+        n = len(model.item_bimap)
+        acc = np.zeros(n, np.float32)
+        for qi in indices:
+            np.add.at(acc, model.sim_indices[qi], model.sim_scores[qi])
+        mask = self._allowed_mask(model, query)
+        acc[~mask] = 0.0
+        k = min(query.num, n)
+        top = np.argsort(-acc, kind="stable")[:k]
+        inv = model.item_bimap.inverse
+        return PredictedResult(item_scores=tuple(
+            ItemScore(item=inv[int(i)], score=float(acc[i]))
+            for i in top if acc[i] > 0.0
+        ))
+
+
 class SimilarProductEngine(EngineFactory):
     def apply(self) -> Engine:
         return Engine(
             SimilarProductDataSource,
             SimilarProductPreparator,
-            {"als": SimilarProductAlgorithm},
+            {"als": SimilarProductAlgorithm, "dimsum": DIMSUMAlgorithm},
             FirstServing,
         )

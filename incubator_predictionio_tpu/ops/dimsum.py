@@ -1,0 +1,108 @@
+"""Item-item cosine column similarities — the DIMSUM-variant solver.
+
+The reference's similarproduct-dimsum template calls
+``RowMatrix.columnSimilarities(threshold)`` (examples/experimental/
+scala-parallel-similarproduct-dimsum/src/main/scala/
+DIMSUMAlgorithm.scala:133), Spark's sampling-based DIMSUM approximation
+of the item-item cosine matrix — sampling exists there because the Gram
+must be shuffled across executors. On a TPU the Gram IS the MXU's native
+operation, so the rebuild computes it exactly: user-chunked dense
+scatter → one ``[C, I]ᵀ·[C, I]`` matmul per chunk accumulated under
+``lax.scan`` in one fused program, then cosine normalization,
+thresholding (exact, where DIMSUM's is probabilistic), and a per-row
+top-N. No sampling error, deterministic output.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: dense [I, I] similarity ceiling: above this the Gram no longer fits
+#: comfortably (16k² f32 = 1 GB) and the ALS-factor variant is the right
+#: tool anyway — this solver targets the template's catalog scale
+MAX_ITEMS = 16384
+
+
+@functools.partial(jax.jit, static_argnames=("n_items", "top_n"))
+def _gram_cosine_topk(
+    chunks_u: jax.Array,     # [S, C] int32 row-in-chunk (or C = padding)
+    chunks_i: jax.Array,     # [S, C] int32 item index
+    chunks_w: jax.Array,     # [S, C] f32 weight (0 on padding)
+    n_items: int,
+    threshold: float,
+    top_n: int,
+) -> Tuple[jax.Array, jax.Array]:
+    # row-in-chunk ids are < _CHUNK_ROWS by construction
+    # (column_cosine_topk packs them), so every chunk scatters into the
+    # same static [_CHUNK_ROWS, n_items] buffer; padding triples carry
+    # weight 0 and add nothing
+    def step(gram, xs):
+        u, i, w = xs
+        dense = jnp.zeros((_CHUNK_ROWS, n_items), jnp.float32)
+        dense = dense.at[u, i].add(w)
+        return gram + dense.T @ dense, None
+
+    gram0 = jnp.zeros((n_items, n_items), jnp.float32)
+    gram, _ = jax.lax.scan(step, gram0, (chunks_u, chunks_i, chunks_w))
+    norms = jnp.sqrt(jnp.maximum(jnp.diagonal(gram), 1e-12))
+    sim = gram / (norms[:, None] * norms[None, :])
+    sim = jnp.where(sim >= threshold, sim, 0.0)
+    sim = sim * (1.0 - jnp.eye(n_items, dtype=jnp.float32))  # no self-sim
+    scores, indices = jax.lax.top_k(sim, min(top_n, n_items))
+    return scores, indices
+
+
+_CHUNK_ROWS = 2048
+
+
+def column_cosine_topk(
+    users: np.ndarray,
+    items: np.ndarray,
+    weights: np.ndarray,
+    n_items: int,
+    threshold: float = 0.1,
+    top_n: int = 100,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """→ (scores [I, T], indices [I, T]): per-item top-T cosine neighbors
+    with similarity ≥ threshold (0-padded; an index whose score is 0 is
+    absent). Exact where the reference's DIMSUM samples."""
+    if n_items > MAX_ITEMS:
+        raise ValueError(
+            f"dimsum similarity targets catalogs ≤ {MAX_ITEMS} items "
+            f"(got {n_items}); use the ALS similarproduct algorithm for "
+            "larger catalogs")
+    users = np.asarray(users, np.int64)
+    items = np.asarray(items, np.int32)
+    weights = np.asarray(weights, np.float32)
+    order = np.argsort(users, kind="stable")
+    users, items, weights = users[order], items[order], weights[order]
+    # pack users into chunks of _CHUNK_ROWS distinct users: row-in-chunk
+    # ids stay < _CHUNK_ROWS so every chunk scatters into the same static
+    # [_CHUNK_ROWS, I] buffer
+    _, user_dense = np.unique(users, return_inverse=True)
+    chunk_of = user_dense // _CHUNK_ROWS
+    row_in_chunk = (user_dense % _CHUNK_ROWS).astype(np.int32)
+    n_chunks = int(chunk_of.max()) + 1 if len(users) else 1
+    # split nnz by chunk, pad each chunk's triple list to the max length
+    counts = np.bincount(chunk_of, minlength=n_chunks)
+    width = max(int(counts.max()), 1) if len(users) else 1
+    cu = np.zeros((n_chunks, width), np.int32)
+    ci = np.zeros((n_chunks, width), np.int32)
+    cw = np.zeros((n_chunks, width), np.float32)
+    starts = np.zeros(n_chunks + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    for c in range(n_chunks):
+        lo, hi = starts[c], starts[c + 1]
+        cu[c, :hi - lo] = row_in_chunk[lo:hi]
+        ci[c, :hi - lo] = items[lo:hi]
+        cw[c, :hi - lo] = weights[lo:hi]
+    scores, indices = _gram_cosine_topk(
+        jnp.asarray(cu), jnp.asarray(ci), jnp.asarray(cw),
+        n_items=n_items, threshold=float(threshold), top_n=int(top_n))
+    return np.asarray(scores), np.asarray(indices)

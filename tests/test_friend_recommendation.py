@@ -1,0 +1,149 @@
+"""Friend-recommendation template (KDD-2012 scenario) — keyword
+similarity, random baseline, and dense device SimRank (parity:
+examples/experimental/scala-{local,parallel}-friend-recommendation)."""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import App, Storage
+from incubator_predictionio_tpu.models.friendrecommendation import (
+    DataSourceParams,
+    FriendRecommendationEngine,
+    KeywordSimilarityAlgoParams,
+    Query,
+    SimRankAlgoParams,
+)
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+
+@pytest.fixture(autouse=True)
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+@pytest.fixture
+def seeded_app():
+    Storage.get_meta_data_apps().insert(App(0, "frapp"))
+    app_id = Storage.get_meta_data_apps().get_by_name("frapp").id
+    dao = Storage.get_events()
+    kw = {
+        ("user", "u1"): {"1": 0.6, "2": 0.4},
+        ("user", "u2"): {"3": 1.0},
+        ("item", "g1"): {"1": 0.5, "2": 0.5},   # overlaps u1
+        ("item", "g2"): {"9": 1.0},             # overlaps nobody
+    }
+    for (etype, eid), words in kw.items():
+        dao.insert(Event(
+            event="$set", entity_type=etype, entity_id=eid,
+            properties=DataMap({"keywords": words})), app_id)
+    # graph: u3 follows both u1 and u2 (shared in-neighbor → SimRank
+    # similarity between u1 and u2); both act on g1
+    for (et, a), (tt, b), name in (
+        (("user", "u3"), ("user", "u1"), "follow"),
+        (("user", "u3"), ("user", "u2"), "follow"),
+        (("user", "u1"), ("item", "g1"), "action"),
+        (("user", "u2"), ("item", "g1"), "action"),
+    ):
+        dao.insert(Event(
+            event=name, entity_type=et, entity_id=a,
+            target_entity_type=tt, target_entity_id=b), app_id)
+    return app_id
+
+
+def _ep(algo, params):
+    return EngineParams(
+        data_source_params=("", DataSourceParams(app_name="frapp")),
+        algorithm_params_list=[(algo, params)],
+    )
+
+
+def test_keyword_similarity_confidence_and_acceptance(seeded_app):
+    engine = FriendRecommendationEngine().apply()
+    ep = _ep("keyword", KeywordSimilarityAlgoParams(sim_weight=2.0,
+                                                    sim_threshold=0.5))
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+    p = algo.predict(models[0], Query(user="u1", item="g1"))
+    # Σ w_u·w_i = 0.6*0.5 + 0.4*0.5 = 0.5; 0.5*2.0 >= 0.5 → accepted
+    assert p.confidence == pytest.approx(0.5)
+    assert p.acceptance
+    # no keyword overlap → 0 confidence, rejected
+    p2 = algo.predict(models[0], Query(user="u2", item="g1"))
+    assert p2.confidence == pytest.approx(0.0) and not p2.acceptance
+    # unseen user behaves like the reference's empty-map case
+    p3 = algo.predict(models[0], Query(user="ghost", item="g1"))
+    assert p3.confidence == 0.0
+
+
+def test_random_baseline_is_deterministic(seeded_app):
+    engine = FriendRecommendationEngine().apply()
+    from incubator_predictionio_tpu.models.friendrecommendation.engine import (
+        RandomAlgoParams,
+    )
+
+    ep = _ep("random", RandomAlgoParams(seed=5))
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+    a = algo.predict(models[0], Query(user="u1", item="g1"))
+    b = algo.predict(models[0], Query(user="u1", item="g1"))
+    assert a == b
+    assert 0.0 <= a.confidence < 1.0
+
+
+def test_simrank_scores_structural_similarity(seeded_app):
+    engine = FriendRecommendationEngine().apply()
+    ep = _ep("simrank", SimRankAlgoParams(iterations=8,
+                                          acceptance_threshold=0.05))
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+    # u1 and u2 share the in-linked... u1→g1 and u2→g1: the QUERY pair is
+    # (user, item); u1 vs g1 share no in-neighbors → low, while u1/u2
+    # both point at g1 so sim(u1, u2) > 0 — query the user pair via the
+    # item slot fallback
+    p_users = algo.predict(models[0], Query(user="u1", item="u2"))
+    assert p_users.confidence > 0.0
+    p_cross = algo.predict(models[0], Query(user="u2", item="g1"))
+    assert p_cross.confidence >= 0.0
+    assert algo.predict(
+        models[0], Query(user="ghost", item="g1")).confidence == 0.0
+
+
+def test_simrank_matches_naive_reference():
+    """Dense device SimRank equals a naive per-pair python SimRank."""
+    from incubator_predictionio_tpu.ops.simrank import simrank
+
+    edges = [(0, 2), (1, 2), (0, 3), (1, 3), (3, 2), (2, 4), (3, 4)]
+    src = np.array([a for a, _ in edges])
+    dst = np.array([b for _, b in edges])
+    n, c, iters = 5, 0.8, 12
+    got = simrank(src, dst, n, decay=c, iterations=iters)
+
+    in_nb = {v: [a for a, b in edges if b == v] for v in range(n)}
+    s = np.eye(n)
+    for _ in range(iters):
+        nxt = np.eye(n)
+        for a in range(n):
+            for b in range(n):
+                if a == b:
+                    continue
+                na, nb = in_nb[a], in_nb[b]
+                if not na or not nb:
+                    nxt[a, b] = 0.0
+                    continue
+                nxt[a, b] = c * sum(
+                    s[x, y] for x in na for y in nb) / (len(na) * len(nb))
+        s = nxt
+    np.testing.assert_allclose(got, s, atol=1e-4)

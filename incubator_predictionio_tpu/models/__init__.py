@@ -11,4 +11,10 @@ template families (SURVEY.md §2.6, examples/scala-parallel-*):
   with ring/Ulysses sequence parallelism for long histories
 - ``regression``      — linear regression (exact ridge solve + SGD) under
   AverageServing (examples/experimental/scala-{parallel,local}-regression)
+- ``friendrecommendation`` — KDD-2012 acceptance prediction: keyword
+  similarity, random baseline, dense device SimRank
+  (examples/experimental/scala-*-friend-recommendation)
+- ``stock``           — price-panel strategies (momentum, batched
+  per-ticker regression) + backtesting evaluator
+  (examples/experimental/scala-stock)
 """

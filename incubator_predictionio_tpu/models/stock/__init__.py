@@ -1,0 +1,16 @@
+from incubator_predictionio_tpu.models.stock.engine import (
+    BacktestingEvaluator,
+    BacktestingParams,
+    DataSourceParams,
+    MomentumStrategyParams,
+    Prediction,
+    Query,
+    RegressionStrategyParams,
+    StockEngine,
+)
+
+__all__ = [
+    "BacktestingEvaluator", "BacktestingParams", "DataSourceParams",
+    "MomentumStrategyParams", "Prediction", "Query",
+    "RegressionStrategyParams", "StockEngine",
+]

@@ -1,0 +1,96 @@
+"""HelloWorld engine — the reference's first tutorial
+(examples/experimental/scala-local-helloworld/HelloWorld.scala): average
+temperature per day-of-week from a CSV, queried by day.
+
+A complete user-defined engine in one local file: `pio build/train/deploy`
+resolve `engine:HelloWorldEngine` from this directory. Data format (the
+reference's ../data/helloworld/data.csv): `Mon,75.5` per line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+from incubator_predictionio_tpu.core import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+
+@dataclasses.dataclass(frozen=True)
+class MyQuery:
+    day: str
+
+
+@dataclasses.dataclass(frozen=True)
+class MyPredictedResult:
+    temperature: float
+
+
+@dataclasses.dataclass(frozen=True)
+class MyDataSourceParams(Params):
+    filepath: str = "data.csv"
+
+
+@dataclasses.dataclass
+class MyTrainingData:
+    temperatures: List[Tuple[str, float]]
+
+
+class MyDataSource(DataSource):
+    def __init__(self, params: MyDataSourceParams = MyDataSourceParams()):
+        super().__init__(params)
+
+    def read_training(self, ctx: RuntimeContext) -> MyTrainingData:
+        rows = []
+        with open(self.params.filepath) as f:
+            for line in f:
+                if line.strip():
+                    day, temp = line.strip().split(",")
+                    rows.append((day, float(temp)))
+        return MyTrainingData(temperatures=rows)
+
+
+@dataclasses.dataclass
+class MyModel:
+    temperatures: Dict[str, float]
+
+
+@dataclasses.dataclass(frozen=True)
+class MyAlgorithmParams(Params):
+    pass
+
+
+class MyAlgorithm(Algorithm):
+    params_class = MyAlgorithmParams
+    query_class_ = MyQuery
+
+    def __init__(self, params: MyAlgorithmParams = MyAlgorithmParams()):
+        super().__init__(params)
+
+    def train(self, ctx: RuntimeContext, td: MyTrainingData) -> MyModel:
+        sums: Dict[str, List[float]] = {}
+        for day, temp in td.temperatures:
+            sums.setdefault(day, []).append(temp)
+        return MyModel(temperatures={
+            day: sum(v) / len(v) for day, v in sums.items()
+        })
+
+    def predict(self, model: MyModel, query: MyQuery) -> MyPredictedResult:
+        return MyPredictedResult(
+            temperature=model.temperatures.get(query.day, 0.0))
+
+
+class HelloWorldEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            MyDataSource, IdentityPreparator, {"": MyAlgorithm},
+            FirstServing,
+        )

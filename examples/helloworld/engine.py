@@ -84,8 +84,13 @@ class MyAlgorithm(Algorithm):
         })
 
     def predict(self, model: MyModel, query: MyQuery) -> MyPredictedResult:
-        return MyPredictedResult(
-            temperature=model.temperatures.get(query.day, 0.0))
+        if query.day not in model.temperatures:
+            # the reference throws on an unknown key too — a fabricated
+            # 0.0° would be indistinguishable from real data
+            raise ValueError(
+                f"unknown day {query.day!r}; trained days: "
+                f"{sorted(model.temperatures)}")
+        return MyPredictedResult(temperature=model.temperatures[query.day])
 
 
 class HelloWorldEngine(EngineFactory):

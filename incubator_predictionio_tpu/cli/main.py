@@ -316,7 +316,11 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
             engine_factory=variant.get("engineFactory", ""),
             params=params,
         )
-        print(f"Training completed. Engine instance ID: {instance_id}")
+        if instance_id:
+            print(f"Training completed. Engine instance ID: {instance_id}")
+        else:
+            print("Training shard completed (pod worker; process 0 "
+                  "persists the engine instance).")
         return 0
 
     if cmd == "eval":
@@ -348,8 +352,12 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
             ),
             params=WorkflowParams(batch=args.batch),
         )
-        print(result.to_one_liner())
-        print(f"Evaluation completed. Instance ID: {instance_id}")
+        if instance_id:
+            print(result.to_one_liner())
+            print(f"Evaluation completed. Instance ID: {instance_id}")
+        else:
+            print("Evaluation shard completed (pod worker; process 0 "
+                  "persists the result).")
         return 0
 
     if cmd == "deploy":

@@ -219,6 +219,32 @@ def deserialize_models(data: bytes) -> List[Any]:
     return models
 
 
+def host_materialize(tree: Any) -> Any:
+    """Fetch every array leaf to host numpy, COLLECTIVELY when a leaf is
+    sharded across pod processes.
+
+    Called by the workflow on EVERY pod process before the non-zero
+    workers exit: a model holding a jax.Array with non-addressable shards
+    cannot be fetched by process 0 alone (and a lone allgather would
+    deadlock once the workers are gone), so the gather happens here while
+    all participants are still alive. Single-process runs reduce to a
+    plain host fetch."""
+    import jax
+    import numpy as np
+
+    def fetch(leaf: Any) -> Any:
+        if isinstance(leaf, jax.Array):
+            if not leaf.is_fully_addressable:
+                from jax.experimental import multihost_utils
+
+                return np.asarray(
+                    multihost_utils.process_allgather(leaf, tiled=True))
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(fetch, tree)
+
+
 def device_restore(tree: Any, sharding: Optional[Any] = None) -> Any:
     """Push every array leaf of a restored model back onto device, optionally
     with a serving sharding (donated device-resident serving state)."""

@@ -65,9 +65,29 @@ class CoreWorkflow:
         ctx: Optional[RuntimeContext] = None,
         env: Optional[dict] = None,
     ) -> str:
-        """Train, checkpoint, register. Returns the engine instance ID."""
+        """Train, checkpoint, register. Returns the engine instance ID.
+
+        In a multi-process pod (`pio train --hosts`, or an
+        externally-provisioned jax.distributed runtime) every process
+        runs the same SPMD training program and participates in the
+        collective host-materialization of the trained models
+        (checkpoint.host_materialize — pod-sharded arrays cannot be
+        fetched by one process after the others exit), but only process 0
+        owns the metadata/model writes — the workers then return an empty
+        id, exactly like Spark executors vs the driver."""
         params = params or WorkflowParams()
         ctx = ctx or make_runtime_context(params)
+        from incubator_predictionio_tpu.parallel import distributed
+
+        if distributed.process_count() > 1 and \
+                distributed.process_index() != 0:
+            models = engine.train(ctx, engine_params, params)
+            checkpoint.host_materialize(models)  # collective leg
+            logger.info(
+                "process %d/%d: training shard complete (process 0 "
+                "persists the instance)",
+                distributed.process_index(), distributed.process_count())
+            return ""
         instances = Storage.get_meta_data_engine_instances()
         instance = EngineInstance(
             id="",
@@ -99,6 +119,9 @@ class CoreWorkflow:
             )
             with tracer.activate():
                 models = engine.train(ctx, engine_params, params)
+                if distributed.process_count() > 1:
+                    # collective: every pod process runs this in lockstep
+                    models = checkpoint.host_materialize(models)
                 algo_params = [
                     p for _n, p in engine_params.algorithm_params_list
                 ]
@@ -171,9 +194,33 @@ class CoreWorkflow:
         ctx: Optional[RuntimeContext] = None,
         env: Optional[dict] = None,
     ) -> tuple[str, Any]:
-        """Evaluate all candidates. Returns (evaluation instance id, result)."""
+        """Evaluate all candidates. Returns (evaluation instance id, result).
+
+        Pod semantics mirror run_train: non-zero processes compute their
+        SPMD shard of every candidate but never touch storage; process 0
+        persists the instance and returns the result."""
         params = params or WorkflowParams()
         ctx = ctx or make_runtime_context(params)
+        from incubator_predictionio_tpu.parallel import distributed
+
+        if distributed.process_count() > 1 and \
+                distributed.process_index() != 0:
+            engine = evaluation.engine
+            evaluator = evaluation.evaluator
+            # process 0 owns best.json too (same-content races on a
+            # shared filesystem are still races)
+            saved_path = getattr(evaluator, "output_path", None)
+            if saved_path is not None:
+                evaluator.output_path = None
+            try:
+                eval_data = engine.batch_eval(ctx, engine_params_list,
+                                              params)
+                result = evaluator.evaluate(ctx, evaluation, eval_data,
+                                            params)
+            finally:
+                if saved_path is not None:
+                    evaluator.output_path = saved_path
+            return "", result
         instances = Storage.get_meta_data_evaluation_instances()
         instance = EvaluationInstance(
             id="",

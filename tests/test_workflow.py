@@ -44,6 +44,41 @@ def test_run_train_lifecycle():
     assert latest.id == instance_id
 
 
+def test_run_train_nonzero_pod_process_trains_but_does_not_persist(
+        monkeypatch):
+    """In a `pio train --hosts` pod only process 0 owns storage writes —
+    workers train their SPMD shard and return an empty instance id (the
+    Spark executor-vs-driver split)."""
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    engine = make_engine()
+    assert CoreWorkflow.run_train(engine, params()) == ""
+    assert Storage.get_meta_data_engine_instances().get_all() == []
+
+
+def test_run_evaluation_nonzero_pod_process_computes_without_persisting(
+        monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    engine = make_engine()
+    evaluation = Evaluation()
+    best = tmp_path / "best.json"
+    evaluation.engine_evaluator = (
+        engine, MetricEvaluator(QxMetric(), output_path=str(best)))
+    iid, result = CoreWorkflow.run_evaluation(
+        evaluation, [params(algos=[("algo0", AP(3))])])
+    assert iid == ""
+    assert result.best_score is not None      # the worker still computed
+    assert not best.exists()                  # ...but process 0 owns best.json
+    assert Storage.get_meta_data_evaluation_instances().get_all() == []
+    # output_path restored for a later promotion to process 0
+    assert evaluation.evaluator.output_path == str(best)
+
+
 def test_run_train_failure_marks_aborted():
     from fake_engine import FailingDataSource, Preparator0, Algorithm0, Serving0
     from incubator_predictionio_tpu.core import Engine

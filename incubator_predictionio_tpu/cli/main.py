@@ -480,6 +480,29 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # honor the user's JAX_PLATFORMS even on images whose site
+    # customization pre-imports jax and pins the platform config at
+    # interpreter start (env vars are read only at import time, so the
+    # pin would otherwise silently override the user's choice)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+        try:
+            from jax._src import xla_bridge as _xb
+
+            # the config update only takes effect if no backend has
+            # initialized; a site customization that already called
+            # jax.devices() would still win — say so instead of silently
+            # running on the wrong platform
+            if getattr(_xb, "_backends", None):
+                print(
+                    f"warning: JAX_PLATFORMS={plat} set but JAX backends "
+                    "were already initialized at interpreter start; the "
+                    "platform pin may not take effect", file=sys.stderr)
+        except Exception:
+            pass
     args = build_parser().parse_args(argv)
     # the true invocation argv, for pod relaunch (programmatic main(argv)
     # must not fall back to the host process's sys.argv — e.g. pytest's)

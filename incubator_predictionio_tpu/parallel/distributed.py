@@ -78,6 +78,13 @@ def is_multihost() -> bool:
     return jax.process_count() > 1
 
 
+def is_pod_worker() -> bool:
+    """True on a multi-process pod's non-zero processes — the ones that
+    run the SPMD program but never own storage writes (the Spark
+    executor role; CoreWorkflow gates persistence on this)."""
+    return jax.process_count() > 1 and jax.process_index() != 0
+
+
 def make_pod_mesh(
     axis_names: Sequence[str],
     axis_sizes: Sequence[int],

@@ -219,30 +219,44 @@ def deserialize_models(data: bytes) -> List[Any]:
     return models
 
 
-def host_materialize(tree: Any) -> Any:
-    """Fetch every array leaf to host numpy, COLLECTIVELY when a leaf is
-    sharded across pod processes.
+def host_materialize(obj: Any) -> Any:
+    """Fetch every array found anywhere in a model structure to host
+    numpy, COLLECTIVELY when an array is sharded across pod processes.
 
     Called by the workflow on EVERY pod process before the non-zero
     workers exit: a model holding a jax.Array with non-addressable shards
     cannot be fetched by process 0 alone (and a lone allgather would
-    deadlock once the workers are gone), so the gather happens here while
-    all participants are still alive. Single-process runs reduce to a
-    plain host fetch."""
+    deadlock once the workers are gone), so the gather happens while all
+    participants are still alive. Single-process runs reduce to a plain
+    host fetch.
+
+    Traversal mirrors the checkpoint encoder (``_encode_ext``): engine
+    models are plain dataclasses, NOT registered pytrees, so
+    ``tree_map`` would treat them as opaque leaves and skip exactly the
+    arrays this function exists to gather — the walk recurses into
+    dataclass fields, dicts, lists, and tuples by hand. The field walk
+    must be deterministic and identical on every process (dataclass
+    field order is), because each non-addressable fetch is a collective."""
     import jax
     import numpy as np
 
-    def fetch(leaf: Any) -> Any:
-        if isinstance(leaf, jax.Array):
-            if not leaf.is_fully_addressable:
-                from jax.experimental import multihost_utils
+    if isinstance(obj, jax.Array):
+        if not obj.is_fully_addressable:
+            from jax.experimental import multihost_utils
 
-                return np.asarray(
-                    multihost_utils.process_allgather(leaf, tiled=True))
-            return np.asarray(leaf)
-        return leaf
-
-    return jax.tree_util.tree_map(fetch, tree)
+            return np.asarray(
+                multihost_utils.process_allgather(obj, tiled=True))
+        return np.asarray(obj)
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.replace(obj, **{
+            f.name: host_materialize(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        })
+    if isinstance(obj, dict):
+        return {k: host_materialize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(host_materialize(v) for v in obj)
+    return obj
 
 
 def device_restore(tree: Any, sharding: Optional[Any] = None) -> Any:

@@ -79,15 +79,23 @@ class CoreWorkflow:
         ctx = ctx or make_runtime_context(params)
         from incubator_predictionio_tpu.parallel import distributed
 
-        if distributed.process_count() > 1 and \
-                distributed.process_index() != 0:
+        pod = distributed.is_multihost()
+        pre_trained = None
+        if pod:
+            # EVERY pod process runs the collective legs FIRST — before
+            # any process touches fallible storage. Otherwise a
+            # proc-0-only storage error (its insert/update) would strand
+            # the workers inside untimed jax collectives forever.
             models = engine.train(ctx, engine_params, params)
-            checkpoint.host_materialize(models)  # collective leg
-            logger.info(
-                "process %d/%d: training shard complete (process 0 "
-                "persists the instance)",
-                distributed.process_index(), distributed.process_count())
-            return ""
+            models = checkpoint.host_materialize(models)  # collective
+            if distributed.is_pod_worker():
+                logger.info(
+                    "process %d/%d: training shard complete (process 0 "
+                    "persists the instance)",
+                    distributed.process_index(),
+                    distributed.process_count())
+                return ""
+            pre_trained = models
         instances = Storage.get_meta_data_engine_instances()
         instance = EngineInstance(
             id="",
@@ -118,10 +126,8 @@ class CoreWorkflow:
                                     status=CoreWorkflow.TRAIN_STATUS_TRAINING)
             )
             with tracer.activate():
-                models = engine.train(ctx, engine_params, params)
-                if distributed.process_count() > 1:
-                    # collective: every pod process runs this in lockstep
-                    models = checkpoint.host_materialize(models)
+                models = (pre_trained if pre_trained is not None
+                          else engine.train(ctx, engine_params, params))
                 algo_params = [
                     p for _n, p in engine_params.algorithm_params_list
                 ]
@@ -203,24 +209,31 @@ class CoreWorkflow:
         ctx = ctx or make_runtime_context(params)
         from incubator_predictionio_tpu.parallel import distributed
 
-        if distributed.process_count() > 1 and \
-                distributed.process_index() != 0:
+        pod_result = None
+        if distributed.is_multihost():
+            # collective legs first on EVERY process (same rationale as
+            # run_train: no proc-0 storage I/O while workers sit in
+            # untimed collectives)
             engine = evaluation.engine
             evaluator = evaluation.evaluator
-            # process 0 owns best.json too (same-content races on a
-            # shared filesystem are still races)
-            saved_path = getattr(evaluator, "output_path", None)
-            if saved_path is not None:
-                evaluator.output_path = None
-            try:
-                eval_data = engine.batch_eval(ctx, engine_params_list,
-                                              params)
-                result = evaluator.evaluate(ctx, evaluation, eval_data,
-                                            params)
-            finally:
+            if distributed.is_pod_worker():
+                # process 0 owns best.json too (same-content races on a
+                # shared filesystem are still races)
+                saved_path = getattr(evaluator, "output_path", None)
                 if saved_path is not None:
-                    evaluator.output_path = saved_path
-            return "", result
+                    evaluator.output_path = None
+                try:
+                    eval_data = engine.batch_eval(ctx, engine_params_list,
+                                                  params)
+                    result = evaluator.evaluate(ctx, evaluation, eval_data,
+                                                params)
+                finally:
+                    if saved_path is not None:
+                        evaluator.output_path = saved_path
+                return "", result
+            eval_data = engine.batch_eval(ctx, engine_params_list, params)
+            pod_result = evaluator.evaluate(ctx, evaluation, eval_data,
+                                            params)
         instances = Storage.get_meta_data_evaluation_instances()
         instance = EvaluationInstance(
             id="",
@@ -236,10 +249,15 @@ class CoreWorkflow:
         instance_id = instances.insert(instance)
         instance = dataclasses.replace(instance, id=instance_id)
         try:
-            engine = evaluation.engine
-            evaluator = evaluation.evaluator
-            eval_data = engine.batch_eval(ctx, engine_params_list, params)
-            result = evaluator.evaluate(ctx, evaluation, eval_data, params)
+            if pod_result is not None:
+                result = pod_result
+            else:
+                engine = evaluation.engine
+                evaluator = evaluation.evaluator
+                eval_data = engine.batch_eval(ctx, engine_params_list,
+                                              params)
+                result = evaluator.evaluate(ctx, evaluation, eval_data,
+                                            params)
             if getattr(result, "no_save", False):
                 # FakeWorkflow results are not persisted
                 # (CoreWorkflow.scala:138-142 noSave branch).

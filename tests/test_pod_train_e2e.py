@@ -1,0 +1,105 @@
+"""`pio train --hosts local,local` end to end: the launcher spawns two
+real CLI worker processes that join one jax.distributed runtime over
+shared sqlite storage; exactly ONE engine instance is persisted
+(process 0), and its model serves (Runner.scala:101-213's role, driven
+through the actual CLI)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+def test_pio_train_hosts_two_process(tmp_path):
+    engine_dir = tmp_path / "engine"
+    engine_dir.mkdir()
+    # a local one-file engine, resolved from the engine dir like
+    # examples/helloworld (commands.resolve_engine_factory adds cwd)
+    (engine_dir / "podengine.py").write_text(
+        "import dataclasses\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "from incubator_predictionio_tpu.core import (\n"
+        "    Algorithm, DataSource, Engine, EngineFactory, FirstServing,\n"
+        "    IdentityPreparator, Params)\n"
+        "\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class DSParams(Params):\n"
+        "    n: int = 64\n"
+        "\n"
+        "class DS(DataSource):\n"
+        "    def __init__(self, params: DSParams = DSParams()):\n"
+        "        super().__init__(params)\n"
+        "    def read_training(self, ctx):\n"
+        "        return np.arange(self.params.n, dtype=np.float32)\n"
+        "\n"
+        "@dataclasses.dataclass(frozen=True)\n"
+        "class AParams(Params):\n"
+        "    scale: float = 2.0\n"
+        "\n"
+        "@dataclasses.dataclass\n"
+        "class Model:\n"
+        "    mean: np.ndarray\n"
+        "\n"
+        "class Algo(Algorithm):\n"
+        "    params_class = AParams\n"
+        "    def __init__(self, params: AParams = AParams()):\n"
+        "        super().__init__(params)\n"
+        "    def train(self, ctx, td):\n"
+        "        # a real device reduction so the SPMD path is exercised\n"
+        "        m = jnp.mean(jnp.asarray(td)) * self.params.scale\n"
+        "        return Model(mean=np.asarray(m))\n"
+        "    def predict(self, model, query):\n"
+        "        return float(model.mean)\n"
+        "\n"
+        "class PodEngine(EngineFactory):\n"
+        "    def apply(self):\n"
+        "        return Engine(DS, IdentityPreparator, {'a': Algo},\n"
+        "                      FirstServing)\n"
+    )
+    (engine_dir / "engine.json").write_text(json.dumps({
+        "id": "pod-test",
+        "engineFactory": "podengine:PodEngine",
+        "datasource": {"params": {"n": 64}},
+        "algorithms": [{"name": "a", "params": {"scale": 2.0}}],
+    }))
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    repo_root = str(Path(__file__).parent.parent)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PIO_HOME": str(tmp_path / "home"),
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.cli.main",
+         "train", "--hosts", "local,localhost"],
+        cwd=engine_dir, env=env, capture_output=True, text=True,
+        timeout=420,
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "Training completed. Engine instance ID:" in out
+    assert "pod worker" in out  # the non-zero process said so
+
+    # exactly one COMPLETED instance, with a working model blob
+    import sqlite3
+
+    conn = sqlite3.connect(str(tmp_path / "pio.db"))
+    rows = conn.execute(
+        "SELECT status FROM engine_instances").fetchall()
+    assert [r[0] for r in rows] == ["COMPLETED"], rows
+    (n_models,) = conn.execute("SELECT COUNT(*) FROM models").fetchone()
+    assert n_models == 1

@@ -79,6 +79,38 @@ def test_run_evaluation_nonzero_pod_process_computes_without_persisting(
     assert evaluation.evaluator.output_path == str(best)
 
 
+def test_host_materialize_recurses_into_dataclass_models():
+    """Engine models are plain dataclasses, NOT registered pytrees — the
+    collective host fetch must walk their fields by hand or pod-sharded
+    arrays inside them would silently survive to the checkpoint encoder."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    @dc.dataclass
+    class Inner:
+        arr: object
+
+    @dc.dataclass(frozen=True)
+    class Model:
+        factors: object
+        nested: Inner
+        table: dict
+        name: str
+
+    m = Model(
+        factors=jnp.arange(4.0),
+        nested=Inner(arr=jnp.ones((2, 2))),
+        table={"a": jnp.zeros(3), "b": "text"},
+        name="m",
+    )
+    out = checkpoint.host_materialize([m])[0]
+    assert isinstance(out.factors, np.ndarray)
+    assert isinstance(out.nested.arr, np.ndarray)
+    assert isinstance(out.table["a"], np.ndarray)
+    assert out.table["b"] == "text" and out.name == "m"
+
+
 def test_run_train_failure_marks_aborted():
     from fake_engine import FailingDataSource, Preparator0, Algorithm0, Serving0
     from incubator_predictionio_tpu.core import Engine

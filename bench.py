@@ -114,9 +114,16 @@ def make_dataset(rng):
             (ho_u, ho_i, rate(ho_u, ho_i)), (u_true, v_true))
 
 
-def quality_metrics(state, heldout, truth, rng):
+def quality_metrics(state, inter, heldout, truth, rng):
     """Heldout RMSE vs the known noise floor + precision@10 against the
-    ground-truth ranking (sampled users, device-scored)."""
+    ground-truth ranking (sampled users, device-scored).
+
+    The trained factors live in the event-log scan's FIRST-SEEN id order
+    (``inter.user_ids``/``inter.item_ids``), not the seed's original
+    integer order — translate every ground-truth index through the
+    interned id tables before touching the model, or the metrics score a
+    permutation of the model (the exact bug this comment guards against:
+    p@10 ≈ 10/N_ITEMS ≈ 0)."""
     import jax
     import jax.numpy as jnp
 
@@ -124,15 +131,30 @@ def quality_metrics(state, heldout, truth, rng):
 
     ho_u, ho_i, ho_r = heldout
     u_true, v_true = truth
-    heldout_rmse = als.rmse(state, ho_u, ho_i, ho_r)
+    u_lookup = {s: i for i, s in enumerate(inter.user_ids)}
+    i_lookup = {s: i for i, s in enumerate(inter.item_ids)}
+    u_scan = np.asarray([u_lookup.get(f"u{k}", -1) for k in range(N_USERS)])
+    i_scan = np.asarray([i_lookup.get(f"i{k}", -1) for k in range(N_ITEMS)])
 
-    n_probe = 1000
-    probe = rng.choice(N_USERS, n_probe, replace=False)
-    true_scores = u_true[probe] @ v_true.T                  # [P, I] host
+    # heldout pairs whose user/item never appeared in training have no
+    # factor row (possible at smoke-test NNZ); score only the rest
+    mask = (u_scan[ho_u] >= 0) & (i_scan[ho_i] >= 0)
+    heldout_rmse = als.rmse(
+        state, u_scan[ho_u[mask]], i_scan[ho_i[mask]], ho_r[mask])
+
+    # ranking quality over the trainable universe: items present in
+    # training (nothing can recommend an item it never saw)
+    present_items = np.flatnonzero(i_scan >= 0)
+    probe_pool = np.flatnonzero(u_scan >= 0)
+    n_probe = min(1000, len(probe_pool))
+    probe = rng.choice(probe_pool, n_probe, replace=False)
+    true_scores = u_true[probe] @ v_true[present_items].T   # [P, Ip] host
     true_top = np.argsort(-true_scores, axis=1)[:, :10]
-    model_scores = jnp.take(state.user_factors, jnp.asarray(probe),
-                            axis=0) @ state.item_factors.T
-    model_top = np.asarray(jax.lax.top_k(model_scores, 10)[1])
+    model_scores = jnp.take(state.user_factors, jnp.asarray(u_scan[probe]),
+                            axis=0) @ state.item_factors.T  # [P, I_scan]
+    model_scores = np.asarray(model_scores)[:, i_scan[present_items]]
+    model_top = np.asarray(
+        jax.lax.top_k(jnp.asarray(model_scores), 10)[1])
     hits = np.mean([
         len(set(a.tolist()) & set(b.tolist())) / 10.0
         for a, b in zip(model_top, true_top)
@@ -307,7 +329,7 @@ def run(platform_cpu: bool = False) -> None:
     fit = als.rmse(state, inter.user_idx, inter.item_idx, inter.values)
     flops = als_flops_per_run()
     mfu = flops / train_s / PEAK_FLOPS_F32
-    heldout_rmse, prec10 = quality_metrics(state, heldout, truth, rng)
+    heldout_rmse, prec10 = quality_metrics(state, inter, heldout, truth, rng)
     log(f"device={jax.devices()[0]} compile={compile_s:.1f}s "
         f"warm={train_s:.2f}s rmse={fit:.3f} "
         f"heldout_rmse={heldout_rmse:.3f} (noise floor {NOISE_SIGMA}) "

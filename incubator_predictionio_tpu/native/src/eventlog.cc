@@ -27,6 +27,7 @@
 #include <iterator>
 #include <mutex>
 #include <string>
+#include <sched.h>
 #include <string_view>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -1317,86 +1318,158 @@ int64_t pio_evlog_append_interactions(
   std::vector<uint64_t> uhash(n_users);
   for (int64_t i = 0; i < n_users; ++i)
     uhash[i] = fnv1a64(ubuf + uoffs[i], (size_t)(uoffs[i + 1] - uoffs[i]));
+
+  // Record size is a function of the two id lengths alone, so a prefix sum
+  // over the batch gives every record's exact byte offset — which makes the
+  // rendering embarrassingly parallel: T threads fill disjoint slices of
+  // one contiguous buffer, then a single fwrite lands the super-batch.
+  // Super-batches (~2M events ≈ 270 MB) bound peak memory at import scale.
+  const size_t base_rec = sizeof(RecHeader) + 4 + 1 + 10 + etype.size() +
+                          name.size() + tetype.size() + 1 + prop.size() + 8 +
+                          32;
+  // respect the cpuset/affinity mask (containers routinely pin to fewer
+  // CPUs than the machine has; hardware_concurrency ignores that and
+  // oversubscribing a 1-core mask just adds spawn + context-switch cost)
+#if defined(__linux__)
+  cpu_set_t cs;
+  int nthreads = sched_getaffinity(0, sizeof(cs), &cs) == 0
+                     ? CPU_COUNT(&cs)
+                     : (int)std::thread::hardware_concurrency();
+#else
+  int nthreads = (int)std::thread::hardware_concurrency();
+#endif
+  if (const char* env = getenv("PIO_NATIVE_THREADS")) {
+    const int v = atoi(env);
+    if (v > 0) nthreads = v;
+  }
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > 16) nthreads = 16;
+  const int64_t kSuper = 2'000'000;
+  if (n < 65536) nthreads = 1;  // spawn cost dwarfs tiny batches
+
   std::lock_guard<std::mutex> g(log->mu);
   fseeko(log->f, 0, SEEK_END);
   const off_t batch_start = ftello(log->f);
+  const size_t old_n = log->entries.size();
+  const int64_t old_last_time = log->last_time;
   off_t pos = batch_start;
-  std::vector<Entry> new_entries;
-  new_entries.reserve((size_t)n);
-  std::string out;
-  out.reserve(8 << 20);
-  std::string idhex;
+  log->entries.reserve(old_n + (size_t)n);
+  std::string buf;
+  std::vector<size_t> rec_off;
   bool failed = false;
-  for (int64_t k = 0; k < n && !failed; ++k) {
-    const int32_t u = uidx[k], it = iidx[k];
-    const double v = (double)vals[k];
-    const std::string_view uid(ubuf + uoffs[u],
-                               (size_t)(uoffs[u + 1] - uoffs[u]));
-    const std::string_view iid(ibuf + ioffs[it],
-                               (size_t)(ioffs[it + 1] - ioffs[it]));
-    const uint64_t ida = splitmix64(seed ^ (uint64_t)k);
-    const uint64_t idb = splitmix64(seed + 0x9E3779B97F4A7C15ull + (uint64_t)k);
-    idhex.clear();
-    hex32_append(&idhex, ida, idb);
-    const uint64_t id_h = fnv1a64(idhex.data(), 32);
-    // COMPACT record: sidecar only (with the 32-char event id appended
-    // inside the block); pio_evlog_read renders the JSON on demand via
-    // render_compact_json
-    const uint32_t props_len = (uint32_t)(1 + prop.size() + 8);
-    const uint32_t side_len =
-        4 + 1 + 10 + (uint32_t)(etype.size() + name.size() + uid.size() +
-                                tetype.size() + iid.size()) + props_len + 32;
-    const uint32_t plen = side_len;
-    const uint32_t flags = kSidecar | kCompact;
-    RecHeader h{time_ms[k], etype_h, uhash[u], name_h, id_h, plen, flags};
-    out.append((const char*)&h, sizeof(h));
-    out.append((const char*)&side_len, 4);
-    out.push_back((char)1);  // n_props
-    uint16_t l[5] = {(uint16_t)etype.size(), (uint16_t)name.size(),
-                     (uint16_t)uid.size(), (uint16_t)tetype.size(),
-                     (uint16_t)iid.size()};
-    out.append((const char*)l, 10);
-    out.append(etype);
-    out.append(name);
-    out.append(uid);
-    out.append(tetype);
-    out.append(iid);
-    out.push_back((char)prop.size());
-    out.append(prop);
-    double v64 = v;
-    out.append((const char*)&v64, 8);
-    out.append(idhex);
-    new_entries.push_back({time_ms[k], etype_h, uhash[u], name_h, id_h,
-                           (uint64_t)(pos + sizeof(h)), plen, flags,
-                           false});
-    pos += (off_t)(sizeof(h) + plen);
-    if (out.size() >= (8u << 20)) {
-      if (fwrite(out.data(), 1, out.size(), log->f) != out.size())
-        failed = true;
-      out.clear();
+  bool monotone = true;  // batch times in order AND not before the log tail
+  int64_t prev_t = log->last_time;
+  int64_t max_t = log->last_time;
+  for (int64_t s0 = 0; s0 < n && !failed; s0 += kSuper) {
+    const int64_t m = std::min(n - s0, kSuper);
+    rec_off.assign((size_t)m + 1, 0);
+    for (int64_t k = 0; k < m; ++k) {
+      const int32_t u = uidx[s0 + k], it = iidx[s0 + k];
+      rec_off[k + 1] = rec_off[k] + base_rec +
+                       (size_t)(uoffs[u + 1] - uoffs[u]) +
+                       (size_t)(ioffs[it + 1] - ioffs[it]);
+      const int64_t t = time_ms[s0 + k];
+      if (t < prev_t) monotone = false;
+      prev_t = t;
+      if (t > max_t) max_t = t;
     }
+    buf.resize(rec_off[(size_t)m]);
+    log->entries.resize(old_n + (size_t)(s0 + m));
+    Entry* ents = log->entries.data() + old_n + s0;
+    char* out = buf.data();
+    const off_t sb_pos = pos;
+    auto render = [&, s0, sb_pos, ents, out](int64_t a, int64_t b) {
+      std::string idhex;
+      for (int64_t k = a; k < b; ++k) {
+        const int64_t g_k = s0 + k;
+        const int32_t u = uidx[g_k], it = iidx[g_k];
+        const std::string_view uid(ubuf + uoffs[u],
+                                   (size_t)(uoffs[u + 1] - uoffs[u]));
+        const std::string_view iid(ibuf + ioffs[it],
+                                   (size_t)(ioffs[it + 1] - ioffs[it]));
+        const uint64_t ida = splitmix64(seed ^ (uint64_t)g_k);
+        const uint64_t idb =
+            splitmix64(seed + 0x9E3779B97F4A7C15ull + (uint64_t)g_k);
+        idhex.clear();
+        hex32_append(&idhex, ida, idb);
+        const uint64_t id_h = fnv1a64(idhex.data(), 32);
+        // COMPACT record: sidecar only (with the 32-char event id appended
+        // inside the block); pio_evlog_read renders the JSON on demand via
+        // render_compact_json
+        const uint32_t side_len = (uint32_t)(rec_off[k + 1] - rec_off[k] -
+                                             sizeof(RecHeader));
+        const uint32_t flags = kSidecar | kCompact;
+        char* p = out + rec_off[k];
+        RecHeader h{time_ms[g_k], etype_h, uhash[u], name_h, id_h, side_len,
+                    flags};
+        memcpy(p, &h, sizeof(h));
+        p += sizeof(h);
+        memcpy(p, &side_len, 4);
+        p += 4;
+        *p++ = (char)1;  // n_props
+        uint16_t l[5] = {(uint16_t)etype.size(), (uint16_t)name.size(),
+                         (uint16_t)uid.size(), (uint16_t)tetype.size(),
+                         (uint16_t)iid.size()};
+        memcpy(p, l, 10);
+        p += 10;
+        auto put = [&p](std::string_view s) {
+          memcpy(p, s.data(), s.size());
+          p += s.size();
+        };
+        put(etype);
+        put(name);
+        put(uid);
+        put(tetype);
+        put(iid);
+        *p++ = (char)prop.size();
+        put(prop);
+        const double v64 = (double)vals[g_k];
+        memcpy(p, &v64, 8);
+        p += 8;
+        memcpy(p, idhex.data(), 32);
+        ents[k] = {time_ms[g_k], etype_h, uhash[u], name_h, id_h,
+                   (uint64_t)(sb_pos + (off_t)rec_off[k] + sizeof(RecHeader)),
+                   side_len, flags, false};
+      }
+    };
+    if (nthreads == 1) {
+      render(0, m);
+    } else {
+      std::vector<std::thread> pool;
+      pool.reserve((size_t)nthreads);
+      const int64_t chunk = (m + nthreads - 1) / nthreads;
+      for (int t = 0; t < nthreads; ++t) {
+        const int64_t a = t * chunk, b = std::min(m, a + chunk);
+        if (a >= b) break;
+        pool.emplace_back(render, a, b);
+      }
+      for (auto& th : pool) th.join();
+    }
+    if (fwrite(buf.data(), 1, buf.size(), log->f) != buf.size())
+      failed = true;
+    pos += (off_t)buf.size();
   }
-  if (!failed && !out.empty() &&
-      fwrite(out.data(), 1, out.size(), log->f) != out.size())
-    failed = true;
   if (failed) {
     fflush(log->f);
     (void)!ftruncate(fileno(log->f), batch_start);
     clearerr(log->f);
     fseeko(log->f, 0, SEEK_END);
+    log->entries.resize(old_n);  // sorted/last_time were never touched
     return -1;
   }
   fflush(log->f);
-  for (auto& e : new_entries) {
-    if (e.time_ms >= log->last_time && !log->sorted_dirty) {
-      log->sorted.push_back((int64_t)log->entries.size());
-    } else {
-      log->sorted_dirty = true;
-    }
-    log->last_time = std::max(log->last_time, e.time_ms);
-    log->entries.push_back(e);
-    index_new_entry(log, (int64_t)log->entries.size() - 1);
+  if (monotone && !log->sorted_dirty) {
+    const size_t old_sorted = log->sorted.size();
+    log->sorted.resize(old_sorted + (size_t)n);
+    for (int64_t k = 0; k < n; ++k)
+      log->sorted[old_sorted + (size_t)k] = (int64_t)(old_n + (size_t)k);
+  } else {
+    log->sorted_dirty = true;
   }
+  log->last_time = std::max(old_last_time, max_t);
+  if (log->id_index_built)
+    for (int64_t k = 0; k < n; ++k)
+      index_new_entry(log, (int64_t)(old_n + (size_t)k));
   return n;
 }
 

@@ -248,12 +248,22 @@ def host_materialize(obj: Any) -> Any:
                 multihost_utils.process_allgather(obj, tiled=True))
         return np.asarray(obj)
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        return dataclasses.replace(obj, **{
-            f.name: host_materialize(getattr(obj, f.name))
-            for f in dataclasses.fields(obj)
-        })
+        # copy + setattr instead of dataclasses.replace: replace() refuses
+        # init=False fields and re-runs __init__ (breaking on InitVars),
+        # and object.__setattr__ also covers frozen dataclasses
+        import copy
+
+        new = copy.copy(obj)
+        for f in dataclasses.fields(obj):
+            object.__setattr__(
+                new, f.name, host_materialize(getattr(obj, f.name)))
+        return new
     if isinstance(obj, dict):
         return {k: host_materialize(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        # namedtuple: the constructor takes N positional args, not one
+        # iterable (a plain tuple(<generator>) call would TypeError here)
+        return type(obj)(*(host_materialize(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         return type(obj)(host_materialize(v) for v in obj)
     return obj

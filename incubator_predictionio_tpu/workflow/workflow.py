@@ -31,6 +31,11 @@ from incubator_predictionio_tpu.workflow import checkpoint
 
 logger = logging.getLogger(__name__)
 
+#: sentinel for "pod leg did not run" — None is a legal return value for a
+#: custom evaluator (and conceivably a train), and confusing the two would
+#: re-run a collective after the workers exited: a permanent hang
+_UNSET = object()
+
 
 def make_runtime_context(
     workflow_params: Optional[WorkflowParams] = None,
@@ -80,14 +85,55 @@ class CoreWorkflow:
         from incubator_predictionio_tpu.parallel import distributed
 
         pod = distributed.is_multihost()
-        pre_trained = None
+        pre_trained = _UNSET
+        # captured before the (possibly hours-long) pod training leg so the
+        # persisted instance's start→end span covers training even though
+        # the insert itself is deferred until after the collectives
+        train_start = now_utc()
+        tracer = tracing.Tracer(
+            profile_dir=params.runtime_conf.get("profile_dir") or None
+        )
         if pod:
             # EVERY pod process runs the collective legs FIRST — before
             # any process touches fallible storage. Otherwise a
             # proc-0-only storage error (its insert/update) would strand
             # the workers inside untimed jax collectives forever.
-            models = engine.train(ctx, engine_params, params)
-            models = checkpoint.host_materialize(models)  # collective
+            try:
+                with tracer.activate():
+                    models = engine.train(ctx, engine_params, params)
+                    models = checkpoint.host_materialize(models)  # collective
+            except Exception:
+                if not distributed.is_pod_worker():
+                    # the collective already failed, so storage I/O can no
+                    # longer strand the workers — record the abort so the
+                    # instance list shows the failure (single-host parity)
+                    try:
+                        Storage.get_meta_data_engine_instances().insert(
+                            EngineInstance(
+                                id="",
+                                status=CoreWorkflow.TRAIN_STATUS_ABORTED,
+                                start_time=train_start,
+                                end_time=now_utc(),
+                                engine_id=engine_id,
+                                engine_version=engine_version,
+                                engine_variant=engine_variant,
+                                engine_factory=engine_factory,
+                                batch=params.batch,
+                                env=dict(env or {}),
+                                runtime_conf=dict(params.runtime_conf),
+                                data_source_params=json_codec.dumps(
+                                    engine_params.data_source_params),
+                                preparator_params=json_codec.dumps(
+                                    engine_params.preparator_params),
+                                algorithms_params=json_codec.dumps(
+                                    engine_params.algorithm_params_list),
+                                serving_params=json_codec.dumps(
+                                    engine_params.serving_params),
+                            ))
+                    except Exception:
+                        logger.exception(
+                            "failed to record ABORTED pod train instance")
+                raise
             if distributed.is_pod_worker():
                 logger.info(
                     "process %d/%d: training shard complete (process 0 "
@@ -100,7 +146,7 @@ class CoreWorkflow:
         instance = EngineInstance(
             id="",
             status=CoreWorkflow.TRAIN_STATUS_INIT,
-            start_time=now_utc(),
+            start_time=train_start,
             end_time=now_utc(),
             engine_id=engine_id,
             engine_version=engine_version,
@@ -117,16 +163,13 @@ class CoreWorkflow:
         instance_id = instances.insert(instance)
         instance = dataclasses.replace(instance, id=instance_id)
         logger.info("Training engine instance %s", instance_id)
-        tracer = tracing.Tracer(
-            profile_dir=params.runtime_conf.get("profile_dir") or None
-        )
         try:
             instances.update(
                 dataclasses.replace(instance,
                                     status=CoreWorkflow.TRAIN_STATUS_TRAINING)
             )
             with tracer.activate():
-                models = (pre_trained if pre_trained is not None
+                models = (pre_trained if pre_trained is not _UNSET
                           else engine.train(ctx, engine_params, params))
                 algo_params = [
                     p for _n, p in engine_params.algorithm_params_list
@@ -209,12 +252,19 @@ class CoreWorkflow:
         ctx = ctx or make_runtime_context(params)
         from incubator_predictionio_tpu.parallel import distributed
 
-        pod_result = None
+        pod_result = _UNSET
+        eval_start = now_utc()
+
+        def _eval():
+            eval_data = evaluation.engine.batch_eval(
+                ctx, engine_params_list, params)
+            return evaluation.evaluator.evaluate(
+                ctx, evaluation, eval_data, params)
+
         if distributed.is_multihost():
             # collective legs first on EVERY process (same rationale as
             # run_train: no proc-0 storage I/O while workers sit in
             # untimed collectives)
-            engine = evaluation.engine
             evaluator = evaluation.evaluator
             if distributed.is_pod_worker():
                 # process 0 owns best.json too (same-content races on a
@@ -223,22 +273,39 @@ class CoreWorkflow:
                 if saved_path is not None:
                     evaluator.output_path = None
                 try:
-                    eval_data = engine.batch_eval(ctx, engine_params_list,
-                                                  params)
-                    result = evaluator.evaluate(ctx, evaluation, eval_data,
-                                                params)
+                    result = _eval()
                 finally:
                     if saved_path is not None:
                         evaluator.output_path = saved_path
                 return "", result
-            eval_data = engine.batch_eval(ctx, engine_params_list, params)
-            pod_result = evaluator.evaluate(ctx, evaluation, eval_data,
-                                            params)
+            try:
+                pod_result = _eval()
+            except Exception:
+                # collective already failed; record the abort (the
+                # single-host path below does this inside its try block)
+                try:
+                    Storage.get_meta_data_evaluation_instances().insert(
+                        EvaluationInstance(
+                            id="",
+                            status=CoreWorkflow.EVAL_STATUS_ABORTED,
+                            start_time=eval_start,
+                            end_time=now_utc(),
+                            evaluation_class=evaluation_class,
+                            engine_params_generator_class=(
+                                engine_params_generator_class),
+                            batch=params.batch,
+                            env=dict(env or {}),
+                            runtime_conf=dict(params.runtime_conf),
+                        ))
+                except Exception:
+                    logger.exception(
+                        "failed to record ABORTED pod evaluation instance")
+                raise
         instances = Storage.get_meta_data_evaluation_instances()
         instance = EvaluationInstance(
             id="",
             status=CoreWorkflow.EVAL_STATUS_EVALUATING,
-            start_time=now_utc(),
+            start_time=eval_start,
             end_time=now_utc(),
             evaluation_class=evaluation_class,
             engine_params_generator_class=engine_params_generator_class,
@@ -249,15 +316,7 @@ class CoreWorkflow:
         instance_id = instances.insert(instance)
         instance = dataclasses.replace(instance, id=instance_id)
         try:
-            if pod_result is not None:
-                result = pod_result
-            else:
-                engine = evaluation.engine
-                evaluator = evaluation.evaluator
-                eval_data = engine.batch_eval(ctx, engine_params_list,
-                                              params)
-                result = evaluator.evaluate(ctx, evaluation, eval_data,
-                                            params)
+            result = pod_result if pod_result is not _UNSET else _eval()
             if getattr(result, "no_save", False):
                 # FakeWorkflow results are not persisted
                 # (CoreWorkflow.scala:138-142 noSave branch).

@@ -68,6 +68,7 @@ def _gram_rhs_nnz(
     precision: Any,
     implicit: bool,
     alpha: float,
+    gram_dtype: Any = jnp.float32,
 ):
     """Normal-equation pieces for a batch of padded rows → (gram, rhs, nnz).
 
@@ -76,7 +77,14 @@ def _gram_rhs_nnz(
     builds Yᵤᵗ(Cᵤ−I)Yᵤ with c = 1 + α·r (Hu-Koren-Volinsky). Everything
     accumulates in f32 at the given matmul precision (see the note on
     :func:`_solve_bucket`). Used by the bucket solvers AND the split-row
-    partial-Gram path so their numerics cannot drift apart."""
+    partial-Gram path so their numerics cannot drift apart.
+
+    ``gram_dtype=bfloat16`` casts the Gram batch in the einsum epilogue
+    (accumulation stays f32): the [rows, K, K] Gram is the largest tensor
+    of a sweep (~9 GB f32 on the ML-20M user side), so emitting it bf16
+    halves both the write and every CG re-read without a separate
+    materialized cast. Only the bf16 bucket path opts in — the split-row
+    path must segment-sum partial Grams in f32 first."""
     # The gather is the dominant HBM stream at scale ([..., D, K] ≈
     # nnz·K elements per half-sweep): casting the SOURCE table to
     # compute_dtype first halves that traffic in bf16 mode AND hands the
@@ -108,7 +116,7 @@ def _gram_rhs_nnz(
             "...d,...dk->...k", (vals * mask).astype(gathered.dtype), masked,
             preferred_element_type=jnp.float32, precision=precision,
         )
-    return gram, rhs, mask.sum(axis=-1)
+    return gram.astype(gram_dtype), rhs, mask.sum(axis=-1)
 
 
 #: batched SPD solver: "cg" (Jacobi-preconditioned conjugate gradient) or
@@ -134,8 +142,9 @@ _CG_ITERS_BF16 = int(os.environ.get("PIO_ALS_CG_ITERS_BF16", "6"))
 
 
 def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
-                  matvec_dtype: Any = jnp.float32) -> jax.Array:
-    """Batched Jacobi-PCG for SPD systems → x ≈ a⁻¹ b, [B, K].
+                  matvec_dtype: Any = jnp.float32,
+                  lam: Optional[jax.Array] = None) -> jax.Array:
+    """Batched Jacobi-PCG for SPD systems → x ≈ (a [+ diag(lam)])⁻¹ b, [B, K].
 
     Division guards make converged (and all-zero) systems fixed points
     instead of NaN factories: a zero-nnz explicit row has a = λI, b = 0,
@@ -143,13 +152,20 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
 
     ``matvec_dtype=bfloat16`` halves the dominant HBM stream (every
     iteration re-reads the whole [B, K, K] Gram batch — ~9 GB at ML-20M
-    scale) by casting the Gram once and running the matvec with f32
-    accumulation; x/r/p and all reductions stay f32. Used by the mixed
-    schedule's bf16 sweeps only — the f32 polish runs full-precision CG."""
-    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    scale) by running the matvec on a bf16 Gram with f32 accumulation;
+    x/r/p and all reductions stay f32. Used by the mixed schedule's bf16
+    sweeps only — the f32 polish runs full-precision CG.
+
+    ``lam`` ([B] f32) applies the λ(+λ·nnz) ridge INSIDE the matvec in
+    f32, so the caller can hand over a bare bf16 Gram (half the write and
+    every re-read) while the regularizer — the part conditioning depends
+    on — never rounds through bf16."""
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1).astype(jnp.float32)
+    if lam is not None:
+        diag = diag + lam[:, None]
     minv = jnp.where(diag > 0, 1.0 / diag, 0.0)
     hp = jax.lax.Precision.HIGHEST
-    a_mv = a if matvec_dtype == jnp.float32 else a.astype(matvec_dtype)
+    a_mv = a if a.dtype == matvec_dtype else a.astype(matvec_dtype)
 
     def body(_, carry):
         x, r, p, rz = carry
@@ -158,6 +174,8 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
             preferred_element_type=jnp.float32,
             precision=hp if a_mv.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
+        if lam is not None:
+            ap = ap + lam[:, None] * p
         pap = jnp.sum(p * ap, -1)
         alpha = jnp.where(pap > 0, rz / pap, 0.0)
         x = x + alpha[:, None] * p
@@ -191,16 +209,21 @@ def _reg_solve(
     eye = jnp.eye(rank, dtype=jnp.float32)
     if implicit:
         a = yty[None] + gram + l2 * eye
+        lam = None
     else:
-        # MLlib-style ALS-WR: lambda scaled by row nnz (reg_nnz=True)
+        # MLlib-style ALS-WR: lambda scaled by row nnz (reg_nnz=True).
+        # For CG the ridge stays OUT of the matrix — applied in f32 inside
+        # the matvec — so a bf16 Gram batch can be solved directly.
         lam = l2 * jnp.where(reg_nnz, jnp.maximum(nnz, 1.0), 1.0)
-        a = gram + lam[:, None, None] * eye
+        a = gram
     if _SOLVER == "cg":
         # implicit grams are dominated by the shared YᵗY with only λ (not
         # λ·nnz) on the diagonal — worse conditioned, so double the budget
         sol = _cg_solve_spd(a, rhs, cg_iters * (2 if implicit else 1),
-                            matvec_dtype=cg_matvec_dtype)
+                            matvec_dtype=cg_matvec_dtype, lam=lam)
     else:
+        if lam is not None:
+            a = a.astype(jnp.float32) + lam[:, None, None] * eye
         chol = jax.scipy.linalg.cho_factor(a)
         sol = jax.scipy.linalg.cho_solve(chol, rhs[..., None])[..., 0]
     return jnp.where(nnz[:, None] > 0, sol, 0.0)
@@ -231,9 +254,13 @@ def _solve_bucket(
     DEFAULT precision remains available as the fast low-precision mode for
     early sweeps.
     """
+    # the bf16 bucket path emits the Gram batch directly in bf16 (CG takes
+    # it as-is, with the ridge applied in f32 — see _cg_solve_spd); the
+    # cholesky solver needs the f32 matrix to factor
+    gram_dtype = compute_dtype if _SOLVER == "cg" else jnp.float32
     gram, rhs, nnz = _gram_rhs_nnz(
         other_factors, cols, vals, mask, compute_dtype, precision,
-        implicit=False, alpha=0.0)
+        implicit=False, alpha=0.0, gram_dtype=gram_dtype)
     return _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit=False, yty=None,
                       cg_iters=cg_iters, cg_matvec_dtype=compute_dtype)
 

@@ -92,14 +92,15 @@ def _default_attn(q, k, v, causal=True, kv_valid=None):
         blockwise_attention, dot_product_attention,
     )
     # flash streams KV block-by-block (kv is a grid dimension), so VMEM use
-    # is S-independent — no length cap, only the measured ≈4k crossover vs
-    # the XLA scan (v5e: 2.0x at 8k, 3.4x at 32k)
-    if 4096 < q.shape[1]:
+    # is S-independent — no length cap. Crossover measured on v5e with
+    # dispatch amortized (20-call loops): the XLA blockwise scan still wins
+    # at S=8k (12.6 vs 15.2 ms), flash wins 5.8x at 32k — so the kernel
+    # takes over strictly above 8k.
+    if 8192 < q.shape[1]:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
             flash_attention, flash_available)
         if flash_available():
-            return flash_attention(q, k, v, causal=causal, kv_valid=kv_valid,
-                                   q_block=512, kv_block=512)
+            return flash_attention(q, k, v, causal=causal, kv_valid=kv_valid)
     if q.shape[1] > 1024:
         return blockwise_attention(q, k, v, causal=causal, kv_valid=kv_valid)
     return dot_product_attention(q, k, v, causal=causal, kv_valid=kv_valid)

@@ -93,6 +93,31 @@ class CoreWorkflow:
         tracer = tracing.Tracer(
             profile_dir=params.runtime_conf.get("profile_dir") or None
         )
+
+        def make_instance(status: str) -> EngineInstance:
+            # single source for the instance record — the pod abort path
+            # and the normal INIT path must never drift apart field-wise
+            return EngineInstance(
+                id="",
+                status=status,
+                start_time=train_start,
+                end_time=now_utc(),
+                engine_id=engine_id,
+                engine_version=engine_version,
+                engine_variant=engine_variant,
+                engine_factory=engine_factory,
+                batch=params.batch,
+                env=dict(env or {}),
+                runtime_conf=dict(params.runtime_conf),
+                data_source_params=json_codec.dumps(
+                    engine_params.data_source_params),
+                preparator_params=json_codec.dumps(
+                    engine_params.preparator_params),
+                algorithms_params=json_codec.dumps(
+                    engine_params.algorithm_params_list),
+                serving_params=json_codec.dumps(engine_params.serving_params),
+            )
+
         if pod:
             # EVERY pod process runs the collective legs FIRST — before
             # any process touches fallible storage. Otherwise a
@@ -109,27 +134,7 @@ class CoreWorkflow:
                     # instance list shows the failure (single-host parity)
                     try:
                         Storage.get_meta_data_engine_instances().insert(
-                            EngineInstance(
-                                id="",
-                                status=CoreWorkflow.TRAIN_STATUS_ABORTED,
-                                start_time=train_start,
-                                end_time=now_utc(),
-                                engine_id=engine_id,
-                                engine_version=engine_version,
-                                engine_variant=engine_variant,
-                                engine_factory=engine_factory,
-                                batch=params.batch,
-                                env=dict(env or {}),
-                                runtime_conf=dict(params.runtime_conf),
-                                data_source_params=json_codec.dumps(
-                                    engine_params.data_source_params),
-                                preparator_params=json_codec.dumps(
-                                    engine_params.preparator_params),
-                                algorithms_params=json_codec.dumps(
-                                    engine_params.algorithm_params_list),
-                                serving_params=json_codec.dumps(
-                                    engine_params.serving_params),
-                            ))
+                            make_instance(CoreWorkflow.TRAIN_STATUS_ABORTED))
                     except Exception:
                         logger.exception(
                             "failed to record ABORTED pod train instance")
@@ -143,23 +148,7 @@ class CoreWorkflow:
                 return ""
             pre_trained = models
         instances = Storage.get_meta_data_engine_instances()
-        instance = EngineInstance(
-            id="",
-            status=CoreWorkflow.TRAIN_STATUS_INIT,
-            start_time=train_start,
-            end_time=now_utc(),
-            engine_id=engine_id,
-            engine_version=engine_version,
-            engine_variant=engine_variant,
-            engine_factory=engine_factory,
-            batch=params.batch,
-            env=dict(env or {}),
-            runtime_conf=dict(params.runtime_conf),
-            data_source_params=json_codec.dumps(engine_params.data_source_params),
-            preparator_params=json_codec.dumps(engine_params.preparator_params),
-            algorithms_params=json_codec.dumps(engine_params.algorithm_params_list),
-            serving_params=json_codec.dumps(engine_params.serving_params),
-        )
+        instance = make_instance(CoreWorkflow.TRAIN_STATUS_INIT)
         instance_id = instances.insert(instance)
         instance = dataclasses.replace(instance, id=instance_id)
         logger.info("Training engine instance %s", instance_id)
@@ -261,6 +250,21 @@ class CoreWorkflow:
             return evaluation.evaluator.evaluate(
                 ctx, evaluation, eval_data, params)
 
+        def make_instance(status: str) -> EvaluationInstance:
+            # single source for the record — pod abort vs EVALUATING paths
+            # must never drift apart field-wise
+            return EvaluationInstance(
+                id="",
+                status=status,
+                start_time=eval_start,
+                end_time=now_utc(),
+                evaluation_class=evaluation_class,
+                engine_params_generator_class=engine_params_generator_class,
+                batch=params.batch,
+                env=dict(env or {}),
+                runtime_conf=dict(params.runtime_conf),
+            )
+
         if distributed.is_multihost():
             # collective legs first on EVERY process (same rationale as
             # run_train: no proc-0 storage I/O while workers sit in
@@ -285,34 +289,13 @@ class CoreWorkflow:
                 # single-host path below does this inside its try block)
                 try:
                     Storage.get_meta_data_evaluation_instances().insert(
-                        EvaluationInstance(
-                            id="",
-                            status=CoreWorkflow.EVAL_STATUS_ABORTED,
-                            start_time=eval_start,
-                            end_time=now_utc(),
-                            evaluation_class=evaluation_class,
-                            engine_params_generator_class=(
-                                engine_params_generator_class),
-                            batch=params.batch,
-                            env=dict(env or {}),
-                            runtime_conf=dict(params.runtime_conf),
-                        ))
+                        make_instance(CoreWorkflow.EVAL_STATUS_ABORTED))
                 except Exception:
                     logger.exception(
                         "failed to record ABORTED pod evaluation instance")
                 raise
         instances = Storage.get_meta_data_evaluation_instances()
-        instance = EvaluationInstance(
-            id="",
-            status=CoreWorkflow.EVAL_STATUS_EVALUATING,
-            start_time=eval_start,
-            end_time=now_utc(),
-            evaluation_class=evaluation_class,
-            engine_params_generator_class=engine_params_generator_class,
-            batch=params.batch,
-            env=dict(env or {}),
-            runtime_conf=dict(params.runtime_conf),
-        )
+        instance = make_instance(CoreWorkflow.EVAL_STATUS_EVALUATING)
         instance_id = instances.insert(instance)
         instance = dataclasses.replace(instance, id=instance_id)
         try:

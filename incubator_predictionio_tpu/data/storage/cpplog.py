@@ -571,6 +571,7 @@ class CppLogEvents(base.Events):
         times: Optional[Any] = None,
         base_time: Optional[datetime] = None,
         chunk: int = 20_000,
+        id_seed: Optional[int] = None,
     ) -> int:
         """Fully-native columnar bulk import (pio_evlog_append_interactions):
         record rendering (JSON + sidecar + framed headers), hashing, and the
@@ -624,7 +625,11 @@ class CppLogEvents(base.Events):
                 target_entity_type.encode("utf-8"),
                 event_name.encode("utf-8"),
                 value_prop.encode("utf-8"),
-                int.from_bytes(secrets.token_bytes(8), "little"),
+                # id_seed makes the generated event ids (and so the log
+                # bytes) reproducible — for deterministic re-imports and
+                # the thread-count byte-identity test
+                int.from_bytes(secrets.token_bytes(8), "little")
+                if id_seed is None else (id_seed & 0xFFFFFFFFFFFFFFFF),
             )
             if rc == n:
                 self._maintain_cache_after_import(

@@ -540,8 +540,9 @@ def flash_attention(
     matrix never exists in HBM. Block defaults are the measured v5e
     optimum (dispatch-amortized sweep over 256..2048: 1024×1024 wins at
     both 8k and 32k; 2048 q-blocks exceed VMEM): vs the XLA blockwise scan
-    flash is 0.83× at S=8k (the scan wins below the ~8k crossover —
-    transformer._default_attn routes accordingly) and 5.8× at S=32k.
+    flash is 0.68× at S=8k (the scan wins below the ~8k crossover —
+    transformer._default_attn routes accordingly) and 5.76× at S=32k
+    (BASELINE.md run: 27.97 vs 161.18 ms).
     Differentiable: backward runs through the XLA blockwise reference
     (see :func:`_flash_with_vjp`).
     """

@@ -93,9 +93,10 @@ def _default_attn(q, k, v, causal=True, kv_valid=None):
     )
     # flash streams KV block-by-block (kv is a grid dimension), so VMEM use
     # is S-independent — no length cap. Crossover measured on v5e with
-    # dispatch amortized (20-call loops): the XLA blockwise scan still wins
-    # at S=8k (12.6 vs 15.2 ms), flash wins 5.8x at 32k — so the kernel
-    # takes over strictly above 8k.
+    # dispatch amortized (20-call loops, BASELINE.md run): the XLA
+    # blockwise scan still wins at S=8k (12.33 vs 18.13 ms), flash wins
+    # 5.76x at 32k (161.18 vs 27.97 ms) — the kernel takes over strictly
+    # above 8k.
     if 8192 < q.shape[1]:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
             flash_attention, flash_available)

@@ -283,3 +283,100 @@ class TestCompactRecords:
             event_names=("rate",), value_prop="rating")
         assert len(back) == 4
         client2.close()
+
+
+class TestParallelBulkAppend:
+    """The multi-super-batch threaded render path of
+    pio_evlog_append_interactions (eventlog.cc): >2M events span two
+    super-batches, and PIO_NATIVE_THREADS forces the thread pool on."""
+
+    N = 2_100_000  # crosses the 2M super-batch boundary
+
+    def _import(self, tmp_path, monkeypatch, threads):
+        from incubator_predictionio_tpu.data.storage.base import (
+            IdTable,
+            Interactions,
+        )
+
+        monkeypatch.setenv("PIO_NATIVE_THREADS", str(threads))
+        # keep the projection cache out of the way: this test targets the
+        # native append + scan, not the cache fold (setattr, not reload —
+        # a reload would leak the changed MIN_NNZ to later test modules)
+        from incubator_predictionio_tpu.data.storage import traincache
+        monkeypatch.setattr(traincache, "MIN_NNZ", self.N * 10)
+        rng = np.random.default_rng(3)
+        nu, ni = 5_000, 1_200
+        users = rng.integers(0, nu, self.N).astype(np.int32)
+        items = rng.integers(0, ni, self.N).astype(np.int32)
+        vals = rng.random(self.N).astype(np.float32)
+        inter = Interactions(
+            user_idx=users, item_idx=items, values=vals,
+            user_ids=IdTable.from_list([f"u{k}" for k in range(nu)]),
+            item_ids=IdTable.from_list([f"i{k}" for k in range(ni)]),
+        )
+        client = _client(tmp_path)
+        events = _events(client)
+        n = events.import_interactions(
+            inter, 1, event_name="rate", value_prop="rating",
+            base_time=T0)
+        assert n == self.N
+        out = events.scan_interactions(
+            app_id=1, entity_type="user", target_entity_type="item",
+            event_names=("rate",), value_prop="rating")
+        client.close()
+        return users, items, vals, out
+
+    def test_two_superbatches_threaded_roundtrip(self, tmp_path,
+                                                 monkeypatch):
+        users, items, vals, out = self._import(tmp_path, monkeypatch, 4)
+        assert len(out) == self.N
+        # scan returns events in append (= time) order with first-seen
+        # interned ids; translate back and compare exactly
+        u_names = np.array([f"u{k}" for k in range(5_000)])
+        got_users = np.asarray(out.user_ids.tolist())[out.user_idx]
+        assert (got_users == u_names[users]).all()
+        i_names = np.array([f"i{k}" for k in range(1_200)])
+        got_items = np.asarray(out.item_ids.tolist())[out.item_idx]
+        assert (got_items == i_names[items]).all()
+        np.testing.assert_allclose(out.values, vals, rtol=1e-6)
+
+    def test_threaded_matches_single_thread_bytes(self, tmp_path,
+                                                  monkeypatch):
+        # determinism: the rendered log must be byte-identical no matter
+        # how many threads rendered it (same seed → same event ids)
+        import hashlib
+
+        d1, d4 = tmp_path / "t1", tmp_path / "t4"
+        d1.mkdir(), d4.mkdir()
+        from incubator_predictionio_tpu.data.storage.base import (
+            IdTable,
+            Interactions,
+        )
+
+        rng = np.random.default_rng(5)
+        n = 200_000
+        from incubator_predictionio_tpu.data.storage import traincache
+        monkeypatch.setattr(traincache, "MIN_NNZ", n * 10)
+        inter = Interactions(
+            user_idx=rng.integers(0, 50, n).astype(np.int32),
+            item_idx=rng.integers(0, 20, n).astype(np.int32),
+            values=rng.random(n).astype(np.float32),
+            user_ids=IdTable.from_list([f"u{k}" for k in range(50)]),
+            item_ids=IdTable.from_list([f"i{k}" for k in range(20)]),
+        )
+
+        def run(path, threads):
+            monkeypatch.setenv("PIO_NATIVE_THREADS", str(threads))
+            client = _client(path)
+            events = _events(client)
+            # fixed base time AND fixed id seed → byte-identical logs
+            events.import_interactions(
+                inter, 1, event_name="rate", value_prop="rating",
+                base_time=T0, id_seed=12345)
+            client.close()
+            return [
+                (p.name, hashlib.sha256(p.read_bytes()).hexdigest())
+                for p in sorted(path.iterdir())
+            ]
+
+        assert run(d1, 1) == run(d4, 4)

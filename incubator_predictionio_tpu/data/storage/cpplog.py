@@ -637,6 +637,15 @@ class CppLogEvents(base.Events):
                     uidx, iidx, vals, times_arr, utab, itab,
                     entity_type, target_entity_type, event_name, value_prop)
         if rc == -2:  # sidecar limits exceeded: generic per-Event path
+            if id_seed is not None:
+                # the generic path generates random event ids — honoring
+                # the caller's byte-reproducibility request is impossible,
+                # so fail loudly instead of silently losing determinism
+                raise base.StorageError(
+                    "id_seed requested but the data exceeds the native "
+                    "sidecar limits (id/field too long or non-finite "
+                    "value); the per-Event fallback cannot produce "
+                    "deterministic ids")
             return super().import_interactions(
                 inter, app_id, channel_id, entity_type, target_entity_type,
                 event_name, value_prop, times, base_time, chunk)

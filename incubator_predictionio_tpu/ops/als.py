@@ -143,7 +143,8 @@ _CG_ITERS_BF16 = int(os.environ.get("PIO_ALS_CG_ITERS_BF16", "6"))
 
 def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
                   matvec_dtype: Any = jnp.float32,
-                  lam: Optional[jax.Array] = None) -> jax.Array:
+                  lam: Optional[jax.Array] = None,
+                  shared: Optional[jax.Array] = None) -> jax.Array:
     """Batched Jacobi-PCG for SPD systems → x ≈ (a [+ diag(lam)])⁻¹ b, [B, K].
 
     Division guards make converged (and all-zero) systems fixed points
@@ -159,8 +160,15 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
     ``lam`` ([B] f32) applies the λ(+λ·nnz) ridge INSIDE the matvec in
     f32, so the caller can hand over a bare bf16 Gram (half the write and
     every re-read) while the regularizer — the part conditioning depends
-    on — never rounds through bf16."""
+    on — never rounds through bf16.
+
+    ``shared`` ([K, K] f32) adds a batch-shared SPD term (implicit ALS's
+    YᵗY) inside the matvec as one thin einsum — the [B, K, K] broadcast
+    ``yty[None] + gram`` never materializes, which at training scale is a
+    whole extra Gram-batch write + read per half-sweep."""
     diag = jnp.diagonal(a, axis1=-2, axis2=-1).astype(jnp.float32)
+    if shared is not None:
+        diag = diag + jnp.diagonal(shared)[None, :]
     if lam is not None:
         diag = diag + lam[:, None]
     minv = jnp.where(diag > 0, 1.0 / diag, 0.0)
@@ -174,6 +182,10 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
             preferred_element_type=jnp.float32,
             precision=hp if a_mv.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
+        if shared is not None:
+            ap = ap + jnp.einsum(
+                "kl,bl->bk", shared, p,
+                preferred_element_type=jnp.float32, precision=hp)
         if lam is not None:
             ap = ap + lam[:, None] * p
         pap = jnp.sum(p * ap, -1)
@@ -208,22 +220,28 @@ def _reg_solve(
     rank = gram.shape[-1]
     eye = jnp.eye(rank, dtype=jnp.float32)
     if implicit:
-        a = yty[None] + gram + l2 * eye
-        lam = None
+        # CG keeps the batch-shared YᵗY OUT of the matrix (one thin einsum
+        # in the matvec) — the [B, K, K] broadcast sum never materializes
+        lam = jnp.full(nnz.shape, l2, jnp.float32)
+        shared = yty
+        a = gram
     else:
         # MLlib-style ALS-WR: lambda scaled by row nnz (reg_nnz=True).
         # For CG the ridge stays OUT of the matrix — applied in f32 inside
         # the matvec — so a bf16 Gram batch can be solved directly.
         lam = l2 * jnp.where(reg_nnz, jnp.maximum(nnz, 1.0), 1.0)
+        shared = None
         a = gram
     if _SOLVER == "cg":
         # implicit grams are dominated by the shared YᵗY with only λ (not
         # λ·nnz) on the diagonal — worse conditioned, so double the budget
         sol = _cg_solve_spd(a, rhs, cg_iters * (2 if implicit else 1),
-                            matvec_dtype=cg_matvec_dtype, lam=lam)
+                            matvec_dtype=cg_matvec_dtype, lam=lam,
+                            shared=shared)
     else:
-        if lam is not None:
-            a = a.astype(jnp.float32) + lam[:, None, None] * eye
+        a = a.astype(jnp.float32) + lam[:, None, None] * eye
+        if shared is not None:
+            a = a + shared[None]
         chol = jax.scipy.linalg.cho_factor(a)
         sol = jax.scipy.linalg.cho_solve(chol, rhs[..., None])[..., 0]
     return jnp.where(nnz[:, None] > 0, sol, 0.0)

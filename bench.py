@@ -274,18 +274,13 @@ def run(platform_cpu: bool = False) -> None:
         client.close()
 
     # -- 3. PREP: degree-bucketed padded rows ------------------------------
-    from incubator_predictionio_tpu.ops.sparse import (
-        build_padded_rows,
-        split_heavy,
-    )
+    from incubator_predictionio_tpu.ops.sparse import build_both_sides
 
     # dims come from the scan's interned id tables (dense, first-seen order)
     n_users, n_items = len(inter.user_ids), len(inter.item_ids)
     t0 = time.perf_counter()
-    u_light, u_heavy = split_heavy(build_padded_rows(
-        inter.user_idx, inter.item_idx, inter.values, n_users))
-    i_light, i_heavy = split_heavy(build_padded_rows(
-        inter.item_idx, inter.user_idx, inter.values, n_items))
+    (u_light, u_heavy), (i_light, i_heavy) = build_both_sides(
+        inter.user_idx, inter.item_idx, inter.values, n_users, n_items)
     prep_s = time.perf_counter() - t0
     log(f"prep (bucketed padded rows): {prep_s:.1f}s "
         f"(users={n_users}, items={n_items})")

@@ -520,15 +520,95 @@ def _iter_import_file(input_path: str, format: str):
                 yield f"{input_path}:{line_no}", doc
 
 
+#: minimum batch size for the columnar import fast path (below it the
+#: Python interning pass costs more than the per-event path saves)
+_FAST_IMPORT_MIN = int(os.environ.get("PIO_IMPORT_FAST_MIN", "10000"))
+
+
+def _as_uniform_interactions(events):
+    """Events → (Interactions, entity_type, target_type, name, value_prop,
+    times_ms) when the columnar bulk import is observably equivalent to
+    per-event inserts, else None.
+
+    Equivalence requires: no explicit event ids (both paths would generate
+    them), no tags/prId, a target on every event, one shared numeric
+    property key whose values are float32-exact (the columnar store is
+    f32; 4.1 would read back 4.0999999), UTC event times (re-rendering
+    emits UTC strings), and identical event/entity/target types
+    throughout. Export round-trips carry eventIds (upsert semantics!) and
+    therefore never take this path; explicit creationTime is screened by
+    the caller (the parsed Event cannot distinguish explicit from
+    defaulted)."""
+    if len(events) < _FAST_IMPORT_MIN:
+        return None  # interning overhead beats the win on small files
+    import datetime as _dt
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.data.storage.base import (
+        IdTable,
+        Interactions,
+    )
+    from incubator_predictionio_tpu.utils.times import to_millis
+
+    first = events[0]
+    name, etype, tetype = first.event, first.entity_type, \
+        first.target_entity_type
+    if name.startswith("$") or not tetype:
+        return None
+    keys = list(first.properties)
+    if len(keys) != 1:
+        return None
+    vprop = keys[0]
+    users: list = []
+    items: list = []
+    uidx = np.empty(len(events), np.int32)
+    iidx = np.empty(len(events), np.int32)
+    vals = np.empty(len(events), np.float32)
+    times = np.empty(len(events), np.int64)
+    u_intern: dict = {}
+    i_intern: dict = {}
+    for k, e in enumerate(events):
+        if (e.event != name or e.entity_type != etype
+                or e.target_entity_type != tetype
+                or not e.target_entity_id or e.event_id or e.tags
+                or e.pr_id or list(e.properties) != keys):
+            return None
+        v = e.properties.get(vprop)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if float(np.float32(v)) != float(v):
+            return None  # not f32-exact: the columnar store would alter it
+        if e.event_time.utcoffset() != _dt.timedelta(0):
+            return None  # non-UTC offset: re-rendered strings would differ
+        u = u_intern.setdefault(e.entity_id, len(u_intern))
+        if u == len(users):
+            users.append(e.entity_id)
+        it = i_intern.setdefault(e.target_entity_id, len(i_intern))
+        if it == len(items):
+            items.append(e.target_entity_id)
+        uidx[k], iidx[k], vals[k] = u, it, v
+        times[k] = to_millis(e.event_time)
+    inter = Interactions(
+        user_idx=uidx, item_idx=iidx, values=vals,
+        user_ids=IdTable.from_list(users), item_ids=IdTable.from_list(items))
+    return inter, etype, tetype, name, vprop, times
+
+
 def import_events(app_name: str, input_path: str,
                   channel: Optional[str] = None,
                   format: str = "json") -> int:
     from incubator_predictionio_tpu.data.event import validate_event
     from incubator_predictionio_tpu.data.store import EventStore
+    from incubator_predictionio_tpu.data.storage import base as storage_base
 
     app_name = _appid_or_name_to_name(app_name)
 
     events = []
+    # doc-level screen for the fast path: a parsed Event cannot tell an
+    # explicit creationTime from the defaulted one, and creationTime is
+    # exactly what the columnar renderer would rewrite
+    plain_docs = True
     for location, doc in _iter_import_file(input_path, format):
         try:
             event = Event.from_jsonable(doc)
@@ -536,6 +616,25 @@ def import_events(app_name: str, input_path: str,
             events.append(event)
         except ValueError as e:
             raise CommandError(f"{location}: invalid event: {e}") from e
+        plain_docs = plain_docs and "creationTime" not in doc
+    dao = Storage.get_events()
+    fast = (
+        _as_uniform_interactions(events)
+        # only when the backend has a NATIVE columnar import — the base
+        # fallback converts straight back to Events, paying twice
+        if plain_docs and type(dao).import_interactions
+        is not storage_base.Events.import_interactions else None)
+    if fast is not None:
+        from incubator_predictionio_tpu.data.store import _resolve
+
+        inter, etype, tetype, name, vprop, times = fast
+        app_id, channel_id = _resolve(app_name, channel)
+        n = dao.import_interactions(
+            inter, app_id, channel_id, entity_type=etype,
+            target_entity_type=tetype, event_name=name, value_prop=vprop,
+            times=times)
+        print(f"Imported {n} events (native columnar path).")
+        return n
     EventStore.write(events, app_name=app_name, channel_name=channel)
     print(f"Imported {len(events)} events.")
     return len(events)

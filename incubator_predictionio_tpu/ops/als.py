@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from incubator_predictionio_tpu.ops.sparse import (
     PaddedRows,
+    build_both_sides,
     build_padded_rows,
     split_heavy,
 )
@@ -582,12 +583,8 @@ def als_train_implicit(
     max_width: int = 1 << 16,
 ) -> ALSState:
     """Implicit-feedback training over (user, item, weight) observations."""
-    user_light, user_heavy = split_heavy(
-        build_padded_rows(users, items, weights, n_users,
-                          max_width=max_width))
-    item_light, item_heavy = split_heavy(
-        build_padded_rows(items, users, weights, n_items,
-                          max_width=max_width))
+    (user_light, user_heavy), (item_light, item_heavy) = build_both_sides(
+        users, items, weights, n_users, n_items, max_width=max_width)
     state = als_init(jax.random.key(seed), n_users, n_items, rank)
     return _als_run_fused(
         state, _buckets_tree(user_light), _buckets_tree(item_light),
@@ -646,14 +643,9 @@ def als_train_sharded(
     n_users_p = round_up(n_users, mp)
     n_items_p = round_up(n_items, mp)
 
-    user_light, user_heavy = split_heavy(
-        build_padded_rows(users, items, ratings, n_users,
-                          max_width=max_width, row_multiple=n_dev),
-        row_multiple=n_dev)
-    item_light, item_heavy = split_heavy(
-        build_padded_rows(items, users, ratings, n_items,
-                          max_width=max_width, row_multiple=n_dev),
-        row_multiple=n_dev)
+    (user_light, user_heavy), (item_light, item_heavy) = build_both_sides(
+        users, items, ratings, n_users, n_items, max_width=max_width,
+        row_multiple=n_dev, split_row_multiple=n_dev)
 
     repl = replicated(mesh)
     tables = model_sharding(mesh)
@@ -891,12 +883,8 @@ def als_train(
     solved via the partial-Gram combining path (ops/sparse.py
     ``split_heavy`` + ``_solve_heavy``), so power users/items of any degree
     train correctly."""
-    user_light, user_heavy = split_heavy(
-        build_padded_rows(users, items, ratings, n_users,
-                          max_width=max_width))
-    item_light, item_heavy = split_heavy(
-        build_padded_rows(items, users, ratings, n_items,
-                          max_width=max_width))
+    (user_light, user_heavy), (item_light, item_heavy) = build_both_sides(
+        users, items, ratings, n_users, n_items, max_width=max_width)
     u_tree, i_tree = _buckets_tree(user_light), _buckets_tree(item_light)
     u_hv, i_hv = _heavy_tree(user_heavy), _heavy_tree(item_heavy)
 

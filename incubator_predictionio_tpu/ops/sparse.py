@@ -224,3 +224,34 @@ def build_padded_rows(
             )
         )
     return out
+
+
+def build_both_sides(
+    users: np.ndarray,
+    items: np.ndarray,
+    vals: np.ndarray,
+    n_users: int,
+    n_items: int,
+    max_width: int = 4096,
+    row_multiple: int = 8,
+    split_row_multiple: int = 8,
+):
+    """Both training orientations (user-major and item-major) built
+    concurrently → ((user_light, user_heavy), (item_light, item_heavy)).
+
+    The two sides are independent and the native builder's ctypes calls
+    release the GIL, so a two-thread pool halves the prep wall on hosts
+    with ≥2 usable cores (pinned single-core containers degrade to the
+    sequential cost — thread spawn is noise at this scale)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def side(rows, cols, n_rows):
+        return split_heavy(
+            build_padded_rows(rows, cols, vals, n_rows, max_width=max_width,
+                              row_multiple=row_multiple),
+            row_multiple=split_row_multiple)
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        fu = pool.submit(side, users, items, n_users)
+        fi = pool.submit(side, items, users, n_items)
+        return fu.result(), fi.result()

@@ -206,3 +206,58 @@ def test_eval_via_cli(tmp_path, monkeypatch, capsys):
 
 def test_undeploy_nothing_running():
     assert main(["undeploy", "--port", "59999"]) == 1
+
+
+def test_import_fast_path_uniform_batch(tmp_path, capsys, monkeypatch):
+    """A uniform id-less interaction batch routes through the backend's
+    native columnar import (cpplog), and the events remain readable
+    through the generic query path."""
+    from incubator_predictionio_tpu.cli import commands
+    from incubator_predictionio_tpu import native
+
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    Storage.reset()
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "cpplog",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path / "ev"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    monkeypatch.setattr(commands, "_FAST_IMPORT_MIN", 10)
+    main(["app", "new", "FastApp"])
+    capsys.readouterr()
+    src = tmp_path / "events.jsonl"
+    docs = [
+        {"event": "rate", "entityType": "user", "entityId": f"u{i % 7}",
+         "targetEntityType": "item", "targetEntityId": f"i{i % 5}",
+         "properties": {"rating": float(1 + i % 4)},
+         "eventTime": f"2020-01-01T00:00:{i % 60:02d}.000Z"}
+        for i in range(60)
+    ]
+    src.write_text("\n".join(json.dumps(d) for d in docs))
+    assert main(["import", "--appid-or-name", "FastApp",
+                 "--input", str(src)]) == 0
+    assert "native columnar path" in capsys.readouterr().out
+    inter = Storage.get_events().scan_interactions(
+        app_id=1, entity_type="user", target_entity_type="item",
+        event_names=("rate",), value_prop="rating")
+    assert len(inter) == 60
+    evs = list(Storage.get_events().find(app_id=1, limit=100))
+    assert len(evs) == 60 and all(e.event == "rate" for e in evs)
+
+    # events WITH ids must keep the per-event path (id-preserving upsert)
+    src2 = tmp_path / "with_ids.jsonl"
+    docs2 = [dict(d, eventId=f"e{i:032d}") for i, d in enumerate(docs)]
+    src2.write_text("\n".join(json.dumps(d) for d in docs2))
+    assert main(["import", "--appid-or-name", "FastApp",
+                 "--input", str(src2)]) == 0
+    out = capsys.readouterr().out
+    assert "native columnar path" not in out
+    assert Storage.get_events().get(
+        "e" + "0" * 31 + "0", 1) is not None  # explicit id preserved

@@ -574,7 +574,7 @@ def _as_uniform_interactions(events):
                 or not e.target_entity_id or e.event_id or e.tags
                 or e.pr_id or list(e.properties) != keys):
             return None
-        v = e.properties.get(vprop)
+        v = e.properties.opt(vprop)  # .get raises on an explicit null
         if isinstance(v, bool) or not isinstance(v, (int, float)):
             return None
         if float(np.float32(v)) != float(v):

@@ -195,11 +195,34 @@ def _confirm(prompt: str, force: bool) -> bool:
     return answer == "YES"
 
 
+#: verbs that never need the accelerator. On single-tenant devices (one
+#: TPU chip per box/tunnel) an ingest or metadata process that lazily
+#: initializes the device backend CLAIMS the chip — and then `pio train`
+#: on the same box blocks forever waiting for it. Pin these verbs to the
+#: CPU platform before any backend can initialize. (The env var alone is
+#: not enough: platform plugins may re-pin jax.config at interpreter
+#: start, so this must be a config update.)
+_STORAGE_ONLY_VERBS = frozenset({
+    "eventserver", "adminserver", "dashboard", "storageserver",
+    "app", "accesskey", "export", "import", "upgrade", "unregister",
+    "template", "undeploy", "build",
+})
+
+
 def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
     cmd = args.command
     if cmd is None:
         build_parser().print_help()
         return 1
+    if cmd in _STORAGE_ONLY_VERBS:
+        try:
+            import jax
+
+            from jax._src import xla_bridge as _xb
+            if not getattr(_xb, "_backends", None):
+                jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     if cmd == "version":
         print(f"pio-tpu {__version__}")
         return 0

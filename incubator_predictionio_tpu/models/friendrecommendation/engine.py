@@ -97,11 +97,20 @@ class FriendRecommendationDataSource(DataSource):
             required=[self.params.keywords_attr])
         out: Dict[str, Dict[str, float]] = {}
         for entity, pm in props.items():
-            kw = pm.get(self.params.keywords_attr, dict)
-            out[entity] = {
-                str(k): float(v) for k, v in kw.items()
-                if isinstance(v, (int, float)) and not isinstance(v, bool)
-            }
+            # opt, not get: an explicit null keywords value should mean
+            # "no keywords", not a DataMapError aborting the whole train
+            kw = pm.opt(self.params.keywords_attr)
+            if isinstance(kw, dict):
+                # weighted form (the KDD-cup data's keyword → weight map)
+                out[entity] = {
+                    str(k): float(v) for k, v in kw.items()
+                    if isinstance(v, (int, float)) and not isinstance(v, bool)
+                }
+            elif isinstance(kw, (list, tuple)):
+                # bare keyword list: uniform weight 1.0
+                out[entity] = {str(k): 1.0 for k in kw}
+            else:
+                out[entity] = {}
         return out
 
     def read_training(self, ctx: RuntimeContext) -> TrainingData:

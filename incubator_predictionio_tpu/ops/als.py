@@ -36,8 +36,6 @@ import jax.numpy as jnp
 from incubator_predictionio_tpu.ops.sparse import (
     PaddedRows,
     build_both_sides,
-    build_padded_rows,
-    split_heavy,
 )
 
 

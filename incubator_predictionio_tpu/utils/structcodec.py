@@ -20,7 +20,7 @@ hooks with their own security posture.
 
 from __future__ import annotations
 
-from datetime import datetime
+from datetime import date, datetime
 from typing import Any, Callable, Optional
 
 #: extension hook signatures — return NotImplemented to fall through
@@ -85,6 +85,8 @@ class StructCodec:
                     "v": [self.encode(x) for x in obj]}
         if isinstance(obj, datetime):
             return {tag: "dt", "v": obj.isoformat()}
+        if isinstance(obj, date):  # AFTER datetime: datetime is a date
+            return {tag: "date", "v": obj.isoformat()}
         if isinstance(obj, dict):
             if all(isinstance(k, str) for k in obj) and tag not in obj:
                 return {k: self.encode(v) for k, v in obj.items()}
@@ -127,6 +129,8 @@ class StructCodec:
             return frozenset(vals) if obj["f"] else set(vals)
         if tag == "dt":
             return datetime.fromisoformat(obj["v"])
+        if tag == "date":
+            return date.fromisoformat(obj["v"])
         if tag == "map":
             return {self.decode(k): self.decode(v) for k, v in obj["v"]}
         if tag == "bimap":

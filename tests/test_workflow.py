@@ -239,3 +239,20 @@ def test_run_evaluation_lifecycle():
     assert "4.0" in inst.evaluator_results
     assert inst.evaluator_results_json
     assert Storage.get_meta_data_evaluation_instances().get_completed()[0].id == instance_id
+
+
+def test_checkpoint_round_trips_dates_and_datetimes():
+    """Time-panel models (the stock template's trading-day index) carry
+    datetime.date values; both date and datetime must round-trip without
+    collapsing into each other (datetime is a date subclass)."""
+    from datetime import date, datetime, timezone
+
+    model = {
+        "days": (date(2024, 3, 1), date(2024, 3, 4)),
+        "stamp": datetime(2024, 3, 1, 9, 30, tzinfo=timezone.utc),
+    }
+    back = checkpoint.loads(checkpoint.dumps(model))
+    assert back["days"] == (date(2024, 3, 1), date(2024, 3, 4))
+    assert type(back["days"][0]) is date
+    assert back["stamp"] == datetime(2024, 3, 1, 9, 30, tzinfo=timezone.utc)
+    assert type(back["stamp"]) is datetime

@@ -4,19 +4,13 @@ examples/experimental/scala-parallel-friend-recommendation)."""
 import argparse, json, random, urllib.request
 
 
-def post(url, doc):
-    req = urllib.request.Request(
-        url, json.dumps(doc).encode(), {"Content-Type": "application/json"})
-    urllib.request.urlopen(req)
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--access-key", required=True)
     ap.add_argument("--url", default="http://127.0.0.1:7070")
     args = ap.parse_args()
     random.seed(0)
-    base = f"{args.url}/events.json?accessKey={args.access_key}"
+    batch_url = f"{args.url}/batch/events.json?accessKey={args.access_key}"
     vocab = [f"kw{k}" for k in range(40)]
 
     def keywords():
@@ -25,19 +19,25 @@ def main():
         return {k: round(random.random(), 3)
                 for k in random.sample(vocab, 6)}
 
+    events = []
     for u in range(25):
-        post(base, {"event": "$set", "entityType": "user",
-                    "entityId": f"u{u}",
-                    "properties": {"keywords": keywords()}})
+        events.append({"event": "$set", "entityType": "user",
+                       "entityId": f"u{u}",
+                       "properties": {"keywords": keywords()}})
     for i in range(30):
-        post(base, {"event": "$set", "entityType": "item",
-                    "entityId": f"i{i}",
-                    "properties": {"keywords": keywords()}})
+        events.append({"event": "$set", "entityType": "item",
+                       "entityId": f"i{i}",
+                       "properties": {"keywords": keywords()}})
     for u in range(25):
         for i in random.sample(range(30), 4):
-            post(base, {"event": "action", "entityType": "user",
-                        "entityId": f"u{u}", "targetEntityType": "item",
-                        "targetEntityId": f"i{i}", "properties": {}})
+            events.append({"event": "action", "entityType": "user",
+                           "entityId": f"u{u}", "targetEntityType": "item",
+                           "targetEntityId": f"i{i}", "properties": {}})
+    for s in range(0, len(events), 50):  # the batch endpoint's cap
+        req = urllib.request.Request(
+            batch_url, json.dumps(events[s:s + 50]).encode(),
+            {"Content-Type": "application/json"})
+        urllib.request.urlopen(req)
     print("seeded 25 users, 30 items, 100 action edges")
 
 

@@ -1,7 +1,7 @@
 """Seed the stock quickstart: 60 trading days of synthetic prices for 8
 tickers + the SPY market ticker (parity: scala-stock's YahooDataSource
 panel shape)."""
-import argparse, json, math, random, urllib.request
+import argparse, datetime, json, math, random, urllib.request
 
 
 def main():
@@ -13,17 +13,16 @@ def main():
     batch_url = f"{args.url}/batch/events.json?accessKey={args.access_key}"
     tickers = ["SPY"] + [f"T{k}" for k in range(8)]
     price = {t: 100.0 for t in tickers}
+    start = datetime.date(2024, 3, 1)
     events = []
     for day in range(60):
+        when = (start + datetime.timedelta(days=day)).isoformat()
         for t in tickers:
             price[t] *= math.exp(random.gauss(0.0003, 0.01))
             events.append({
                 "event": "price", "entityType": "ticker", "entityId": t,
                 "properties": {"price": round(price[t], 4)},
-                "eventTime": f"2024-03-{day % 28 + 1:02d}T00:00:00.000Z"
-                if day < 28 else
-                f"2024-0{4 + (day - 28) // 28}-{(day - 28) % 28 + 1:02d}"
-                "T00:00:00.000Z",
+                "eventTime": f"{when}T00:00:00.000Z",
             })
     for s in range(0, len(events), 50):
         req = urllib.request.Request(

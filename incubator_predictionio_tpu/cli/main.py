@@ -209,20 +209,89 @@ _STORAGE_ONLY_VERBS = frozenset({
 })
 
 
+def _ensure_accelerator(timeout_s: float) -> None:
+    """Fail fast — with an actionable message — when the accelerator
+    cannot initialize.
+
+    On single-tenant devices a chip claimed by another process makes the
+    PJRT client constructor block *indefinitely* with no output; a `pio
+    train` that sits silent forever reads as a hang, not a diagnosis. The
+    probe runs device init on a daemon thread and gives up after
+    ``timeout_s`` (PIO_ACCEL_INIT_TIMEOUT_S, default 180 — first contact
+    through a tunnel can legitimately take tens of seconds). The blocked
+    thread cannot be cancelled, but the process is about to exit anyway."""
+    import threading
+
+    done = threading.Event()
+    err: list = []
+
+    def probe() -> None:
+        try:
+            import jax
+
+            jax.devices()
+        except Exception as e:  # surfaced as the real failure below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True, name="pio-accel-probe")
+    t.start()
+    if not done.wait(timeout_s):
+        raise CommandError(
+            f"accelerator did not initialize within {timeout_s:.0f}s — on "
+            "a single-tenant device this usually means another process "
+            "holds the chip (a deployed engine server, a stuck run, or a "
+            "stale lease). Stop it (`pio undeploy`, kill the process) and "
+            "retry, or raise PIO_ACCEL_INIT_TIMEOUT_S if first contact is "
+            "genuinely slow on this platform.")
+    if err:
+        raise CommandError(f"accelerator initialization failed: {err[0]}")
+
+
+def _backends_initialized() -> bool:
+    """Whether any JAX backend has already been constructed (private-API
+    probe, single copy — main() and dispatch() both need it)."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        return bool(getattr(_xb, "_backends", None))
+    except Exception:
+        return False
+
+
+def _accel_timeout_s() -> float:
+    raw = os.environ.get("PIO_ACCEL_INIT_TIMEOUT_S", "180")
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"warning: PIO_ACCEL_INIT_TIMEOUT_S={raw!r} is not a "
+              "number; using 180", file=sys.stderr)
+        return 180.0
+
+
 def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
     cmd = args.command
     if cmd is None:
         build_parser().print_help()
         return 1
+    if cmd in ("train", "eval", "deploy", "status"):
+        _ensure_accelerator(_accel_timeout_s())
     if cmd in _STORAGE_ONLY_VERBS:
+        # PIO_STORAGE_VERB_PLATFORM overrides the cpu pin for users who
+        # genuinely want a storage verb on the device (the plain
+        # JAX_PLATFORMS env cannot express that intent here — the image
+        # itself pins it globally)
+        platform = os.environ.get("PIO_STORAGE_VERB_PLATFORM", "cpu")
         try:
             import jax
 
-            from jax._src import xla_bridge as _xb
-            if not getattr(_xb, "_backends", None):
-                jax.config.update("jax_platforms", "cpu")
+            if not _backends_initialized():
+                jax.config.update("jax_platforms", platform)
         except Exception:
-            pass
+            print("warning: could not pin the storage-only verb to the "
+                  f"{platform} platform; this process may claim the "
+                  "accelerator", file=sys.stderr)
     if cmd == "version":
         print(f"pio-tpu {__version__}")
         return 0
@@ -512,20 +581,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", plat)
-        try:
-            from jax._src import xla_bridge as _xb
-
-            # the config update only takes effect if no backend has
-            # initialized; a site customization that already called
-            # jax.devices() would still win — say so instead of silently
-            # running on the wrong platform
-            if getattr(_xb, "_backends", None):
-                print(
-                    f"warning: JAX_PLATFORMS={plat} set but JAX backends "
-                    "were already initialized at interpreter start; the "
-                    "platform pin may not take effect", file=sys.stderr)
-        except Exception:
-            pass
+        # the config update only takes effect if no backend has
+        # initialized; a site customization that already called
+        # jax.devices() would still win — say so instead of silently
+        # running on the wrong platform
+        if _backends_initialized():
+            print(
+                f"warning: JAX_PLATFORMS={plat} set but JAX backends "
+                "were already initialized at interpreter start; the "
+                "platform pin may not take effect", file=sys.stderr)
     args = build_parser().parse_args(argv)
     # the true invocation argv, for pod relaunch (programmatic main(argv)
     # must not fall back to the host process's sys.argv — e.g. pytest's)

@@ -261,3 +261,28 @@ def test_import_fast_path_uniform_batch(tmp_path, capsys, monkeypatch):
     assert "native columnar path" not in out
     assert Storage.get_events().get(
         "e" + "0" * 31 + "0", 1) is not None  # explicit id preserved
+
+
+def test_accelerator_watchdog_times_out_and_propagates_errors(monkeypatch):
+    """A chip claimed by another process blocks device init forever; the
+    probe must turn that into an actionable error, and real init errors
+    must surface as themselves."""
+    import time
+
+    from incubator_predictionio_tpu.cli import main as climain
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda: time.sleep(30))
+    with pytest.raises(climain.CommandError, match="holds the chip"):
+        climain._ensure_accelerator(0.2)
+
+    def boom():
+        raise RuntimeError("no backend at all")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    with pytest.raises(climain.CommandError,
+                       match="initialization failed.*no backend"):
+        climain._ensure_accelerator(5.0)
+
+    monkeypatch.setattr(jax, "devices", lambda: ["dev0"])
+    climain._ensure_accelerator(5.0)  # healthy path: no raise

@@ -234,6 +234,52 @@ def seed_store(tmpdir, users, items, ratings):
     return events, client, seed_s
 
 
+def _wait_for_accelerator(total_s: float) -> None:
+    """Bounded wait for device init instead of an indefinite hang.
+
+    PJRT client construction blocks forever while another process (or a
+    stale lease) holds a single-tenant chip. The bench retries init on
+    daemon threads — a stale lease usually expires within minutes — and
+    exits with a diagnosis if the window (PIO_BENCH_ACCEL_WAIT_S) runs
+    out, so the driver gets a failed bench, not a wedged one. (The CLI's
+    cli/main.py _ensure_accelerator is the single-attempt sibling: same
+    probe, but an interactive command should fail fast, not sit in a
+    retry loop.)"""
+    import threading
+
+    deadline = time.monotonic() + total_s
+    attempt = 0
+    while True:
+        attempt += 1
+        done = threading.Event()
+        err: list = []
+
+        def probe() -> None:
+            try:
+                import jax
+
+                jax.devices()
+            except Exception as e:
+                err.append(e)
+            finally:
+                done.set()
+
+        threading.Thread(target=probe, daemon=True).start()
+        if done.wait(min(120.0, max(deadline - time.monotonic(), 1.0))):
+            if not err:
+                return
+            # a raised error is permanent (missing driver, bad config) —
+            # only a *blocked* init suggests a lease that may expire
+            log(f"accelerator init failed: {err[0]}; aborting")
+            raise SystemExit(3)
+        log(f"accelerator init still blocked (attempt {attempt}) — "
+            "likely a stale chip lease; retrying")
+        if time.monotonic() >= deadline:
+            log(f"accelerator unavailable after {total_s:.0f}s; aborting")
+            raise SystemExit(3)
+        time.sleep(10)
+
+
 def run(platform_cpu: bool = False) -> None:
     import tempfile
 
@@ -241,6 +287,9 @@ def run(platform_cpu: bool = False) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        _wait_for_accelerator(
+            float(os.environ.get("PIO_BENCH_ACCEL_WAIT_S", "1200")))
     import jax
     import jax.numpy as jnp
 

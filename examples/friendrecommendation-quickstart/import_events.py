@@ -37,7 +37,13 @@ def main():
         req = urllib.request.Request(
             batch_url, json.dumps(events[s:s + 50]).encode(),
             {"Content-Type": "application/json"})
-        urllib.request.urlopen(req)
+        with urllib.request.urlopen(req) as resp:
+            # a 200 batch response carries PER-EVENT statuses; a partial
+            # failure must not look like a successful seed
+            for i, st in enumerate(json.load(resp)):
+                if st.get("status") != 201:
+                    raise SystemExit(
+                        f"event {s + i} failed: {st}")
     print("seeded 25 users, 30 items, 100 action edges")
 
 

@@ -28,7 +28,11 @@ def main():
         req = urllib.request.Request(
             batch_url, json.dumps(events[s:s + 50]).encode(),
             {"Content-Type": "application/json"})
-        urllib.request.urlopen(req)
+        with urllib.request.urlopen(req) as resp:
+            # per-event statuses ride inside the 200 batch response
+            for i, st in enumerate(json.load(resp)):
+                if st.get("status") != 201:
+                    raise SystemExit(f"event {s + i} failed: {st}")
     print(f"seeded {len(events)} price events for {len(tickers)} tickers")
 
 

@@ -275,7 +275,10 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
     if cmd is None:
         build_parser().print_help()
         return 1
-    if cmd in ("train", "eval", "deploy", "status"):
+    if cmd == "status":
+        # train/eval/deploy run their watchdog AFTER the pod relaunch
+        # branch (the launcher must never claim the chip its own workers
+        # need) and after jax.distributed joins — see below
         _ensure_accelerator(_accel_timeout_s())
     if cmd in _STORAGE_ONLY_VERBS:
         # PIO_STORAGE_VERB_PLATFORM overrides the cpu pin for users who
@@ -371,13 +374,17 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
 
     # a launched worker (or an externally-provisioned pod process) joins
     # the multi-controller runtime before any engine code builds a mesh
-    if cmd in ("train", "eval", "deploy") and \
-            os.environ.get("PIO_COORDINATOR_ADDRESS"):
-        from incubator_predictionio_tpu.parallel.distributed import (
-            ensure_initialized,
-        )
+    if cmd in ("train", "eval", "deploy"):
+        if os.environ.get("PIO_COORDINATOR_ADDRESS"):
+            from incubator_predictionio_tpu.parallel.distributed import (
+                ensure_initialized,
+            )
 
-        ensure_initialized()
+            ensure_initialized()
+        # watchdog AFTER the relaunch branch (the launcher returned above
+        # without ever touching the device) and AFTER distributed init
+        # (backend construction must follow jax.distributed.initialize)
+        _ensure_accelerator(_accel_timeout_s())
 
     if cmd == "unregister":
         commands.unregister()

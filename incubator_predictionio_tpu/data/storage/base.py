@@ -314,6 +314,16 @@ class Events(abc.ABC):
         ``target_entity_type=None`` (explicitly) matches only events *without*
         a target entity, while leaving it ``UNSET`` applies no filter.
         ``start_time`` is inclusive, ``until_time`` exclusive.
+
+        ORDER CONTRACT (cross-backend, pinned by
+        tests/test_storage_differential.py): equal event times tie-break
+        by insertion order, and an explicit-id upsert MOVES the event to
+        the end of its timestamp group (an upsert is a new write — the
+        append-only log's natural semantics; memory and sqlite implement
+        the same). ``reversed`` returns the exact reverse of the forward
+        sequence, ties included. Aggregation replays in this order, so
+        same-timestamp ``$set`` conflicts resolve identically on every
+        backend.
         """
 
     def aggregate_properties(

@@ -19,6 +19,7 @@ from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
 
 from incubator_predictionio_tpu.data.event import Event, new_event_id, validate_event
 from incubator_predictionio_tpu.data.storage import base
+from incubator_predictionio_tpu.utils.times import to_millis
 from incubator_predictionio_tpu.data.storage.base import UNSET
 
 
@@ -71,9 +72,15 @@ def _match(
     target_entity_type: Any,
     target_entity_id: Any,
 ) -> bool:
-    if start_time is not None and e.event_time < start_time:
+    # compare at MILLISECOND granularity — the durable backends store
+    # epoch millis (sqlite event_time INTEGER, cpplog time_ms), so the
+    # in-memory model must not discriminate at sub-ms precision they
+    # cannot represent (order contract, base.py Events.find)
+    if start_time is not None and to_millis(e.event_time) < to_millis(
+            start_time):
         return False
-    if until_time is not None and e.event_time >= until_time:
+    if until_time is not None and to_millis(e.event_time) >= to_millis(
+            until_time):
         return False
     if entity_type is not None and e.entity_type != entity_type:
         return False
@@ -117,7 +124,13 @@ class MemoryEvents(_MemoryDAO, base.Events):
         validate_event(event)
         with self.client.lock:
             eid = event.event_id or new_event_id()
-            self._table(app_id, channel_id)[eid] = event.with_id(eid)
+            table = self._table(app_id, channel_id)
+            # upsert moves the event to the END of insertion order — the
+            # cross-backend tie-break contract for equal event times (an
+            # upsert is a new write; cpplog's append-only log and
+            # sqlite's REPLACE rowid both behave this way)
+            table.pop(eid, None)
+            table[eid] = event.with_id(eid)
         return eid
 
     def get(self, event_id: str, app_id: int,
@@ -151,7 +164,16 @@ class MemoryEvents(_MemoryDAO, base.Events):
             if _match(e, start_time, until_time, entity_type, entity_id,
                       event_names, target_entity_type, target_entity_id)
         ]
-        rows.sort(key=lambda e: (e.event_time, e.event_id or ""), reverse=reversed)
+        # cross-backend order contract: (event_time AT MILLIS, insertion/
+        # upsert order) — the stable sort keeps the table's insertion
+        # order for equal-milli times (sub-ms differences are invisible
+        # to the durable backends and must not order here either);
+        # ``reversed`` is the exact reverse of the forward sequence (ties
+        # included), matching the native log's backward walk and sqlite's
+        # (event_time, rowid) DESC
+        rows.sort(key=lambda e: to_millis(e.event_time))
+        if reversed:
+            rows = rows[::-1]
         if limit is not None and limit >= 0:
             rows = rows[:limit]
         return iter(rows)

@@ -279,6 +279,21 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
                 before = after = size()
             else:
                 before = size()
+                # VACUUM renumbers the implicit rowids of tables without
+                # an INTEGER PRIMARY KEY and only *happens* to preserve
+                # their relative order — but find()'s tie-break contract
+                # rides on rowid order. Rebuild events in contract order
+                # first so the fresh ascending rowids REENCODE that order
+                # instead of depending on unspecified behavior.
+                conn.executescript(
+                    "BEGIN;"
+                    "CREATE TABLE events_compact AS SELECT * FROM events"
+                    " ORDER BY event_time, rowid;"
+                    "DELETE FROM events;"
+                    "INSERT INTO events SELECT * FROM events_compact"
+                    " ORDER BY rowid;"
+                    "DROP TABLE events_compact;"
+                    "COMMIT;")
                 conn.execute("VACUUM")
                 self.client._vacuumed = True
                 after = size()
@@ -379,9 +394,15 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
             else:
                 where.append("target_entity_id = ?")
                 params.append(target_entity_id)
+        # tie-break equal event times by rowid = insertion/upsert order
+        # (INSERT OR REPLACE assigns a fresh rowid, so an upsert moves the
+        # event to the end of its timestamp group — the cross-backend
+        # contract shared with the native log and the memory backend);
+        # reversed reverses ties too (DESC on both keys)
+        order = "DESC" if reversed else "ASC"
         sql = (
             f"SELECT {_EVENT_COLS} FROM events WHERE " + " AND ".join(where)
-            + f" ORDER BY event_time {'DESC' if reversed else 'ASC'}, id"
+            + f" ORDER BY event_time {order}, rowid {order}"
         )
         if limit is not None and limit >= 0:
             sql += " LIMIT ?"
@@ -464,7 +485,9 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
         # materialized rows (previously three full passes).
         inner = (
             f"SELECT entity_id, target_entity_id, {value_sql} AS v,"
-            f" event_time, id FROM events WHERE {cond}"
+            # seq = base-table rowid: the (event_time, insertion/upsert
+            # order) tie-break shared with find() and the native log
+            f" event_time, rowid AS seq FROM events WHERE {cond}"
         )
         body_params = value_params + params
         u_chunks, i_chunks, v_chunks = [], [], []
@@ -483,18 +506,18 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
                     " dense_rank() OVER (ORDER BY u_ft, u_fid) - 1,"
                     " dense_rank() OVER (ORDER BY i_ft, i_fid) - 1,"
                     " v FROM ("
-                    "SELECT v, event_time, id,"
+                    "SELECT v, event_time, seq,"
                     " FIRST_VALUE(event_time) OVER (PARTITION BY entity_id"
-                    "   ORDER BY event_time, id) AS u_ft,"
-                    " FIRST_VALUE(id) OVER (PARTITION BY entity_id"
-                    "   ORDER BY event_time, id) AS u_fid,"
+                    "   ORDER BY event_time, seq) AS u_ft,"
+                    " FIRST_VALUE(seq) OVER (PARTITION BY entity_id"
+                    "   ORDER BY event_time, seq) AS u_fid,"
                     " FIRST_VALUE(event_time) OVER"
                     "   (PARTITION BY target_entity_id"
-                    "   ORDER BY event_time, id) AS i_ft,"
-                    " FIRST_VALUE(id) OVER (PARTITION BY target_entity_id"
-                    "   ORDER BY event_time, id) AS i_fid"
+                    "   ORDER BY event_time, seq) AS i_ft,"
+                    " FIRST_VALUE(seq) OVER (PARTITION BY target_entity_id"
+                    "   ORDER BY event_time, seq) AS i_fid"
                     " FROM temp.pio_scan)"
-                    " ORDER BY event_time, id"
+                    " ORDER BY event_time, seq"
                 )
                 cur = conn.execute(sql)
                 while True:
@@ -506,10 +529,10 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
                     i_chunks.append(arr[:, 1].astype(np.int32))
                     v_chunks.append(arr[:, 2].astype(np.float32))
                 first_seen = (
-                    "SELECT {col} FROM (SELECT {col}, event_time, id,"
+                    "SELECT {col} FROM (SELECT {col}, event_time, seq,"
                     " ROW_NUMBER() OVER (PARTITION BY {col}"
-                    "   ORDER BY event_time, id) AS rn FROM temp.pio_scan)"
-                    " WHERE rn = 1 ORDER BY event_time, id"
+                    "   ORDER BY event_time, seq) AS rn FROM temp.pio_scan)"
+                    " WHERE rn = 1 ORDER BY event_time, seq"
                 )
                 user_ids = [r[0] for r in conn.execute(
                     first_seen.format(col="entity_id"))]

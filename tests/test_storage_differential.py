@@ -1,0 +1,165 @@
+"""Randomized differential storage test: the same operation sequence must
+be observably identical on every events backend.
+
+The conformance suite (test_storage_conformance.py) pins each behavior
+deterministically; this test drives random interleavings of
+insert/upsert/delete/find/aggregate against the in-memory model and the
+native cpplog + sqlite backends and requires identical results — the
+cross-backend contract under sequences nobody thought to write down
+(reference counterpart: the storage spec's property of interchangeable
+HBase/JDBC/ES drivers).
+"""
+
+from datetime import timedelta
+
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import StorageClientConfig
+from incubator_predictionio_tpu.data.storage import memory as memory_backend
+from incubator_predictionio_tpu.data.storage import sqlite as sqlite_backend
+from incubator_predictionio_tpu.utils.times import parse_iso8601
+
+T0 = parse_iso8601("2022-01-01T00:00:00Z")
+
+_ENTITIES = ("u1", "u2")
+_ITEMS = ("i1", "i2")
+_NAMES = ("rate", "view", "$set")
+_PROPS = ("rating", "color")
+
+_insert = st.fixed_dictionaries({
+    "op": st.just("insert"),
+    "name": st.sampled_from(_NAMES),
+    "eid": st.sampled_from(_ENTITIES),
+    "target": st.one_of(st.none(), st.sampled_from(_ITEMS)),
+    "minutes": st.integers(0, 5),
+    # sub-millisecond offsets: durable backends store epoch millis, so
+    # events differing only at microsecond precision are TIES and must
+    # order by insertion everywhere (the memory model once ordered them
+    # by microsecond — caught by exactly this)
+    "micros": st.sampled_from((0, 400, 900)),
+    "prop": st.sampled_from(_PROPS),
+    "value": st.one_of(st.integers(0, 3), st.just("red")),
+    # a small explicit-id pool forces upsert collisions
+    "explicit": st.one_of(st.none(), st.integers(0, 2)),
+})
+_delete = st.fixed_dictionaries({
+    "op": st.just("delete"),
+    "which": st.integers(0, 6),  # index into ids seen so far (mod len)
+})
+_find = st.fixed_dictionaries({
+    "op": st.just("find"),
+    "etype": st.one_of(st.none(), st.just("user")),
+    "eid": st.one_of(st.none(), st.sampled_from(_ENTITIES)),
+    "names": st.one_of(st.none(), st.just(("rate",)),
+                       st.just(("rate", "view"))),
+    "lo": st.one_of(st.none(), st.integers(0, 4)),
+    "hi": st.one_of(st.none(), st.integers(1, 6)),
+    "limit": st.one_of(st.none(), st.integers(1, 4)),
+    "reversed": st.booleans(),
+})
+_aggregate = st.just({"op": "aggregate"})
+
+_ops = st.lists(st.one_of(_insert, _delete, _find, _aggregate),
+                min_size=1, max_size=25)
+
+
+def _canon(e: Event):
+    from incubator_predictionio_tpu.utils.times import to_millis
+
+    # times compare at epoch-millis — the durable storage granularity
+    # (memory hands back the original microseconds; sqlite/cpplog store
+    # millis — equal under the contract)
+    return (e.event, e.entity_type, e.entity_id, e.target_entity_type,
+            e.target_entity_id, dict(e.properties.to_jsonable()),
+            to_millis(e.event_time))
+
+
+def _apply(ops, events_dao):
+    """Run the op list; return the observable outputs for comparison."""
+    out = []
+    ids: list = []
+    for op in ops:
+        kind = op["op"]
+        if kind == "insert":
+            target = op["target"]
+            if op["name"] == "$set":
+                target = None  # $set carries no target entity
+            event = Event(
+                event=op["name"], entity_type="user", entity_id=op["eid"],
+                target_entity_type="item" if target else None,
+                target_entity_id=target,
+                properties=DataMap({op["prop"]: op["value"]}),
+                event_time=T0 + timedelta(minutes=op["minutes"],
+                                          microseconds=op["micros"]),
+                event_id=(None if op["explicit"] is None
+                          else f"{op['explicit']:032d}"),
+            )
+            ids.append(events_dao.insert(event, 1))
+        elif kind == "delete":
+            if ids:
+                out.append(
+                    ("delete",
+                     events_dao.delete(ids[op["which"] % len(ids)], 1)))
+        elif kind == "find":
+            found = list(events_dao.find(
+                app_id=1,
+                entity_type=op["etype"],
+                entity_id=op["eid"],
+                event_names=op["names"],
+                start_time=(None if op["lo"] is None
+                            else T0 + timedelta(minutes=op["lo"])),
+                until_time=(None if op["hi"] is None
+                            else T0 + timedelta(minutes=op["hi"])),
+                limit=op["limit"],
+                reversed=op["reversed"],
+            ))
+            out.append(("find", [_canon(e) for e in found]))
+        else:
+            agg = events_dao.aggregate_properties(app_id=1,
+                                                  entity_type="user")
+            out.append(("aggregate", {
+                k: dict(v.to_jsonable()) for k, v in sorted(agg.items())
+            }))
+    # closing snapshot: the full store in time order
+    out.append(("final", [_canon(e) for e in events_dao.find(app_id=1)]))
+    return out
+
+
+def _events_for(mod, tmpdir):
+    cfg = StorageClientConfig(
+        test=True,
+        properties={"PATH": (":memory:" if mod is sqlite_backend
+                             else str(tmpdir))})
+    client = mod.StorageClient(cfg)
+    name = mod.__name__.rsplit(".", 1)[1]
+    factory = mod.DATA_OBJECTS["Events"]
+    return client, factory(client, cfg, prefix=f"diff_{name}_")
+
+
+@pytest.mark.parametrize("other_name", ["cpplog", "sqlite"])
+@settings(max_examples=30, deadline=None,
+          suppress_health_check=[HealthCheck.function_scoped_fixture])
+@given(ops=_ops)
+def test_backends_agree_on_random_op_sequences(tmp_path_factory, other_name,
+                                               ops):
+    if other_name == "cpplog":
+        from incubator_predictionio_tpu import native
+
+        if native.load() is None:
+            pytest.skip("native library unavailable")
+        from incubator_predictionio_tpu.data.storage import cpplog as other
+    else:
+        other = sqlite_backend
+
+    tmp = tmp_path_factory.mktemp("diff")
+    mem_client, mem_dao = _events_for(memory_backend, tmp / "mem")
+    oth_client, oth_dao = _events_for(other, tmp / "oth")
+    try:
+        assert _apply(ops, mem_dao) == _apply(ops, oth_dao)
+    finally:
+        mem_client.close()
+        oth_client.close()

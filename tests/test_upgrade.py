@@ -142,3 +142,28 @@ def test_memory_backend_reports_nothing_to_do():
         assert commands.upgrade() == []
     finally:
         Storage.reset()
+
+
+def test_sqlite_compact_preserves_upsert_tie_order(sqlite_storage):
+    """The find() tie-break contract rides on rowid order; VACUUM may
+    renumber implicit rowids, so compact() must re-encode the contract
+    order into the fresh rowids (base.py Events.find ORDER CONTRACT)."""
+    from datetime import datetime, timezone
+
+    Storage.get_meta_data_apps().insert(App(0, "tieorder"))
+    app_id = Storage.get_meta_data_apps().get_by_name("tieorder").id
+    dao = Storage.get_events()
+    t = datetime(2026, 2, 1, tzinfo=timezone.utc)
+    for eid, name in (("e1", "a"), ("e2", "b"), ("e3", "c")):
+        dao.insert(Event(event=name, entity_type="user", entity_id="u",
+                         properties=DataMap({}), event_time=t,
+                         event_id=eid), app_id)
+    # upsert the first one: moves to the end of its timestamp group
+    dao.insert(Event(event="a2", entity_type="user", entity_id="u",
+                     properties=DataMap({}), event_time=t,
+                     event_id="e1"), app_id)
+    before = [e.event for e in dao.find(app_id=app_id)]
+    assert before == ["b", "c", "a2"]
+    commands.upgrade()
+    after = [e.event for e in dao.find(app_id=app_id)]
+    assert after == before

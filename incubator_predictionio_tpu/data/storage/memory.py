@@ -64,8 +64,8 @@ class StorageClient(base.BaseStorageClient):
 
 def _match(
     e: Event,
-    start_time: Optional[datetime],
-    until_time: Optional[datetime],
+    start_ms: Optional[int],
+    until_ms: Optional[int],
     entity_type: Optional[str],
     entity_id: Optional[str],
     event_names: Optional[Sequence[str]],
@@ -75,13 +75,15 @@ def _match(
     # compare at MILLISECOND granularity — the durable backends store
     # epoch millis (sqlite event_time INTEGER, cpplog time_ms), so the
     # in-memory model must not discriminate at sub-ms precision they
-    # cannot represent (order contract, base.py Events.find)
-    if start_time is not None and to_millis(e.event_time) < to_millis(
-            start_time):
-        return False
-    if until_time is not None and to_millis(e.event_time) >= to_millis(
-            until_time):
-        return False
+    # cannot represent (order contract, base.py Events.find). Callers
+    # pass the bounds pre-converted (hot path: the aggregator replays
+    # through find()).
+    if start_ms is not None or until_ms is not None:
+        t = to_millis(e.event_time)
+        if start_ms is not None and t < start_ms:
+            return False
+        if until_ms is not None and t >= until_ms:
+            return False
     if entity_type is not None and e.entity_type != entity_type:
         return False
     if entity_id is not None and e.entity_id != entity_id:
@@ -159,9 +161,11 @@ class MemoryEvents(_MemoryDAO, base.Events):
     ) -> Iterator[Event]:
         with self.client.lock:
             rows = list(self._table(app_id, channel_id).values())
+        start_ms = None if start_time is None else to_millis(start_time)
+        until_ms = None if until_time is None else to_millis(until_time)
         rows = [
             e for e in rows
-            if _match(e, start_time, until_time, entity_type, entity_id,
+            if _match(e, start_ms, until_ms, entity_type, entity_id,
                       event_names, target_entity_type, target_entity_id)
         ]
         # cross-backend order contract: (event_time AT MILLIS, insertion/

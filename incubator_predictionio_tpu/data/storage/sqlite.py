@@ -284,16 +284,27 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
                 # their relative order — but find()'s tie-break contract
                 # rides on rowid order. Rebuild events in contract order
                 # first so the fresh ascending rowids REENCODE that order
-                # instead of depending on unspecified behavior.
-                conn.executescript(
-                    "BEGIN;"
-                    "CREATE TABLE events_compact AS SELECT * FROM events"
-                    " ORDER BY event_time, rowid;"
-                    "DELETE FROM events;"
-                    "INSERT INTO events SELECT * FROM events_compact"
-                    " ORDER BY rowid;"
-                    "DROP TABLE events_compact;"
-                    "COMMIT;")
+                # instead of depending on unspecified behavior. (An
+                # out-of-band `sqlite3 db VACUUM` bypasses this rebuild —
+                # run compaction through `pio upgrade`. Encoding the order
+                # in a schema-level seq column would close that hole but
+                # needs an ALTER TABLE migration for existing stores.)
+                try:
+                    conn.executescript(
+                        "BEGIN;"
+                        "CREATE TABLE events_compact AS SELECT * FROM"
+                        " events ORDER BY event_time, rowid;"
+                        "DELETE FROM events;"
+                        "INSERT INTO events SELECT * FROM events_compact"
+                        " ORDER BY rowid;"
+                        "DROP TABLE events_compact;"
+                        "COMMIT;")
+                except Exception:
+                    # a mid-script failure (disk full) leaves the open
+                    # transaction holding the DELETE — roll it back or the
+                    # next commit on this shared connection persists it
+                    conn.rollback()
+                    raise
                 conn.execute("VACUUM")
                 self.client._vacuumed = True
                 after = size()

@@ -1,0 +1,162 @@
+"""json_codec corner cases — the JsonExtractor parity suite.
+
+Reference counterpart: core/src/test/.../workflow/JsonExtractorSuite.scala
+(386 LoC: Scala vs Java param classes × Json4s/Gson/Both modes). Here the
+two extractor modes collapse into lenient (gson-shim) vs strict
+(json4s-native); the corner cases are the same — numeric widening/string
+coercion, missing-field defaults, camelCase wire names, nested
+dataclasses, unions, enums, and round-tripping through to_jsonable.
+"""
+
+import dataclasses
+import enum
+from datetime import datetime, timezone
+from typing import Any, Dict, List, Optional, Tuple
+
+import pytest
+
+from incubator_predictionio_tpu.utils.json_codec import (
+    ExtractionError,
+    dumps,
+    extract,
+    extract_json,
+    snake_to_camel,
+    to_jsonable,
+)
+
+
+class Color(enum.Enum):
+    RED = "red"
+    BLUE = "blue"
+
+
+@dataclasses.dataclass(frozen=True)
+class Inner:
+    name: str
+    weight: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    __camel_case__ = True
+
+    app_name: str
+    num_iterations: int = 10
+    seed: Optional[int] = None
+    inner: Optional[Inner] = None
+    tags: Tuple[str, ...] = ()
+    table: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+def test_dataclass_camel_case_and_defaults():
+    p = extract(Params, {"appName": "a", "numIterations": 3})
+    assert p.app_name == "a" and p.num_iterations == 3
+    assert p.seed is None and p.tags == () and p.table == {}
+    # snake_case keys are also accepted (Python callers)
+    assert extract(Params, {"app_name": "a"}).num_iterations == 10
+
+
+def test_missing_required_field_names_the_field():
+    with pytest.raises(ExtractionError, match="app_name"):
+        extract(Params, {"numIterations": 3})
+
+
+def test_nested_dataclass_and_collections():
+    p = extract(Params, {
+        "appName": "a",
+        "inner": {"name": "n"},
+        "tags": ["x", "y"],
+        "table": {"k": 2},          # int widens to float in the dict value
+    })
+    assert p.inner == Inner(name="n", weight=1.0)
+    assert p.tags == ("x", "y")
+    assert p.table == {"k": 2.0} and type(p.table["k"]) is float
+
+
+def test_lenient_gson_shim_coercions():
+    # the reference's Gson mode parses strings into numbers/bools
+    assert extract(int, "3") == 3
+    assert extract(float, "3.5") == 3.5
+    assert extract(bool, "true") is True
+    assert extract(float, 3) == 3.0            # JSON int → float always
+    assert extract(int, 3.0) == 3              # integral float → int
+
+
+def test_strict_json4s_mode_rejects_coercions():
+    with pytest.raises(ExtractionError):
+        extract(int, "3", lenient=False)
+    with pytest.raises(ExtractionError):
+        extract(bool, "true", lenient=False)
+    # int→float widening stays: JSON itself cannot distinguish them
+    assert extract(float, 3, lenient=False) == 3.0
+
+
+def test_bool_is_never_a_number():
+    with pytest.raises(ExtractionError):
+        extract(int, True)
+    with pytest.raises(ExtractionError):
+        extract(float, True)
+
+
+def test_union_and_optional():
+    assert extract(Optional[int], None) is None
+    assert extract(Optional[int], 4) == 4
+    # first matching member wins; errors accumulate into the message
+    with pytest.raises(ExtractionError, match="No member"):
+        extract(Optional[int], [1])
+
+
+def test_enum_by_value_and_name():
+    assert extract(Color, "red") is Color.RED
+    assert extract(Color, "BLUE") is Color.BLUE
+    with pytest.raises(ExtractionError):
+        extract(Color, "green")
+
+
+def test_datetime_iso8601():
+    dt = extract(datetime, "2024-02-03T04:05:06.000Z")
+    assert dt == datetime(2024, 2, 3, 4, 5, 6, tzinfo=timezone.utc)
+    with pytest.raises(ExtractionError):
+        extract(datetime, "not-a-time")
+
+
+def test_fixed_and_variadic_tuples():
+    assert extract(Tuple[int, str], [1, "a"]) == (1, "a")
+    assert extract(Tuple[int, ...], [1, 2, 3]) == (1, 2, 3)
+    with pytest.raises(ExtractionError, match="2 elements"):
+        extract(Tuple[int, str], [1])
+
+
+def test_any_list_dict_passthrough():
+    assert extract(Any, {"x": 1}) == {"x": 1}
+    assert extract(List[int], [1, 2]) == [1, 2]
+    assert extract(dict, {"a": 1}) == {"a": 1}
+
+
+def test_extract_json_invalid_text():
+    with pytest.raises(ExtractionError, match="Invalid JSON"):
+        extract_json(Params, "{nope")
+
+
+def test_round_trip_through_to_jsonable():
+    p = Params(app_name="a", num_iterations=7, seed=3,
+               inner=Inner(name="n", weight=0.5), tags=("t",),
+               table={"k": 1.5})
+    wire = to_jsonable(p)
+    assert wire["appName"] == "a"           # camelCase on the wire
+    assert wire["inner"] == {"name": "n", "weight": 0.5}
+    assert extract(Params, wire) == p
+    # dumps is json.dumps over to_jsonable
+    assert '"appName": "a"' in dumps(p)
+
+
+def test_to_jsonable_enum_and_datetime():
+    assert to_jsonable(Color.RED) == "red"
+    s = to_jsonable(datetime(2024, 1, 1, tzinfo=timezone.utc))
+    assert s.startswith("2024-01-01T00:00:00")
+
+
+def test_snake_to_camel():
+    assert snake_to_camel("app_name") == "appName"
+    assert snake_to_camel("a") == "a"
+    assert snake_to_camel("num_iterations_total") == "numIterationsTotal"

@@ -140,26 +140,51 @@ def _events_for(mod, tmpdir):
     return client, factory(client, cfg, prefix=f"diff_{name}_")
 
 
-@pytest.mark.parametrize("other_name", ["cpplog", "sqlite"])
+@pytest.mark.parametrize("other_name", ["cpplog", "sqlite", "remote"])
 @settings(max_examples=30, deadline=None,
           suppress_health_check=[HealthCheck.function_scoped_fixture])
 @given(ops=_ops)
 def test_backends_agree_on_random_op_sequences(tmp_path_factory, other_name,
                                                ops):
+    srv = None
     if other_name == "cpplog":
         from incubator_predictionio_tpu import native
 
         if native.load() is None:
             pytest.skip("native library unavailable")
         from incubator_predictionio_tpu.data.storage import cpplog as other
-    else:
-        other = sqlite_backend
 
-    tmp = tmp_path_factory.mktemp("diff")
-    mem_client, mem_dao = _events_for(memory_backend, tmp / "mem")
-    oth_client, oth_dao = _events_for(other, tmp / "oth")
+        oth_client, oth_dao = _events_for(
+            other, tmp_path_factory.mktemp("diff") / "oth")
+    elif other_name == "remote":
+        # the wire protocol must transport the order contract verbatim
+        from incubator_predictionio_tpu.data.storage import (
+            remote as remote_backend,
+        )
+        from incubator_predictionio_tpu.data.storage.server import (
+            StorageServer,
+        )
+
+        back_cfg = StorageClientConfig(test=True, properties={})
+        back_client = memory_backend.StorageClient(back_cfg)
+        srv = StorageServer(memory_backend, back_client, back_cfg,
+                            host="127.0.0.1", port=0)
+        port = srv.start_background()
+        cfg = StorageClientConfig(
+            test=True, properties={"URL": f"http://127.0.0.1:{port}"})
+        oth_client = remote_backend.StorageClient(cfg)
+        oth_dao = remote_backend.DATA_OBJECTS["Events"](
+            oth_client, cfg, prefix="diff_remote_")
+    else:
+        oth_client, oth_dao = _events_for(
+            sqlite_backend, tmp_path_factory.mktemp("diff") / "oth")
+
+    mem_client, mem_dao = _events_for(
+        memory_backend, tmp_path_factory.mktemp("diff") / "mem")
     try:
         assert _apply(ops, mem_dao) == _apply(ops, oth_dao)
     finally:
         mem_client.close()
         oth_client.close()
+        if srv is not None:
+            srv.stop()

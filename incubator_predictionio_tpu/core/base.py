@@ -196,6 +196,21 @@ class Algorithm(_Component, Generic[PD, M, Q, P]):
         """
         return model
 
+    def warmup(self, model: M, max_batch: int = 1) -> None:
+        """Deploy-time pre-compilation hook (optional, default no-op).
+
+        The first query against a freshly deployed engine otherwise pays
+        XLA compilation of the scoring dispatch (seconds to tens of
+        seconds on TPU). Implementations should run their jitted serving
+        paths once per compiled shape — e.g. the singleton path plus the
+        power-of-two micro-batch sizes up to ``max_batch``. Called by the
+        PredictionServer on a background thread AFTER the server binds,
+        so deploy latency is unchanged and only pre-warm queries compile.
+        The reference has no counterpart (its JVM serving needs no
+        compilation step); errors must not escape — the server logs and
+        serves anyway.
+        """
+
     @property
     def query_class(self) -> Optional[type]:
         """Query dataclass for JSON extraction at the server edge

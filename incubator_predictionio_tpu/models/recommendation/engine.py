@@ -585,6 +585,25 @@ class ALSAlgorithm(Algorithm):
                 out.append((qx, self.predict(model, q)))
         return out
 
+    def warmup(self, model: ALSModel, max_batch: int = 1) -> None:
+        """Pre-compile the serving dispatches (core/base.py Algorithm.warmup):
+        the singleton path once, then the batched path at each power-of-two
+        size up to the micro-batch cap (batch_score_top_k pads B to the
+        next power of two, so these are exactly the shapes concurrency can
+        produce). Uses a real known user so the device path executes."""
+        users = list(model.user_bimap.keys())
+        if not users:
+            return
+        q = Query(user=str(users[0]), num=10)
+        self.predict(model, q)
+        if int(max_batch) <= 0:
+            return  # micro-batching disabled: the batched path never runs
+        size = 1
+        cap = 1 << max(int(max_batch) - 1, 0).bit_length()
+        while size <= cap:
+            self.batch_predict(model, [(i, q) for i in range(size)])
+            size *= 2
+
     def _pack_scores(self, model: ALSModel, scores, indices) -> PredictedResult:
         inv = model.item_bimap.inverse
         return PredictedResult(item_scores=tuple(

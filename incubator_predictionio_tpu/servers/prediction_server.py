@@ -628,16 +628,47 @@ class PredictionServer:
                 "A process at %s:%d did not respond properly to /stop "
                 "(%s); unable to undeploy.", ip, self.config.port, e)
 
+    def _warmup_async(self) -> None:
+        """Pre-compile serving dispatches on a daemon thread AFTER the
+        server binds — the first real query otherwise pays the XLA compile
+        (seconds on TPU). The thread waits on the HTTP server's started
+        event so warmup tracing never delays the bind (the foreground
+        serve_forever path spawns this before the loop starts). Failures
+        are logged, never fatal: warmup is an optimization, the query
+        path compiles on demand regardless."""
+        algorithms, models = self.algorithms, self.models
+        # a disabled micro-batcher means live traffic never reaches the
+        # batched dispatch — don't compile it
+        max_batch = self.config.micro_batch if self._batcher is not None else 0
+
+        def run() -> None:
+            self.http._started.wait(60.0)
+            t0 = time.perf_counter()
+            for algo, model in zip(algorithms, models):
+                try:
+                    algo.warmup(model, max_batch=max_batch)
+                except Exception:
+                    logger.exception(
+                        "serving warmup failed for %s (first queries will "
+                        "compile on demand)", type(algo).__name__)
+            logger.info("serving warmup done in %.1fs",
+                        time.perf_counter() - t0)
+
+        threading.Thread(target=run, daemon=True,
+                         name="pio-serving-warmup").start()
+
     def start_background(self) -> int:
         self.load_models()
         self.undeploy_existing()
         port = self.http.start_background()
+        self._warmup_async()
         logger.info("PredictionServer started on %s:%d", self.config.ip, port)
         return port
 
     async def serve_forever(self) -> None:
         self.load_models()
         self.undeploy_existing()
+        self._warmup_async()
         await self.http.serve_forever()
 
     def stop(self) -> None:

@@ -383,6 +383,14 @@ class ECommAlgorithm(Algorithm):
                 mask[np.asarray(seen)] = False
         return mask
 
+    def warmup(self, model: ECommModel, max_batch: int = 1) -> None:
+        """Pre-compile the serving path (core/base.py Algorithm.warmup):
+        one real predict compiles whichever path this model size uses
+        (host mirror = free, device top-k = the XLA compile to pre-pay)."""
+        first = next(iter(model.user_bimap), None)
+        if first is not None:
+            self.predict(model, Query(user=str(first), num=10))
+
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         user_idx = model.user_bimap.get(query.user)
         unavailable, weights = self._constraints(model)

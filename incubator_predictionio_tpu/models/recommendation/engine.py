@@ -591,15 +591,19 @@ class ALSAlgorithm(Algorithm):
         size up to the micro-batch cap (batch_score_top_k pads B to the
         next power of two, so these are exactly the shapes concurrency can
         produce). Uses a real known user so the device path executes."""
-        users = list(model.user_bimap.keys())
-        if not users:
+        first = next(iter(model.user_bimap), None)
+        if first is None:
             return
-        q = Query(user=str(users[0]), num=10)
+        q = Query(user=str(first), num=10)
         self.predict(model, q)
         if int(max_batch) <= 0:
             return  # micro-batching disabled: the batched path never runs
-        size = 1
-        cap = 1 << max(int(max_batch) - 1, 0).bit_length()
+        from incubator_predictionio_tpu.ops.topk import next_pow2
+
+        # start at 2: the micro-batcher routes singleton queries through
+        # predict(), so B=1 is a shape live traffic never produces
+        size = 2
+        cap = next_pow2(int(max_batch))
         while size <= cap:
             self.batch_predict(model, [(i, q) for i in range(size)])
             size *= 2

@@ -265,6 +265,14 @@ class SimilarProductAlgorithm(Algorithm):
                 mask[idx] = False
         return mask
 
+    def warmup(self, model: SimilarProductModel, max_batch: int = 1) -> None:
+        """Pre-compile the serving path (core/base.py Algorithm.warmup):
+        one real predict compiles whichever path this model size uses
+        (host mirror = free, device top-k = the XLA compile to pre-pay)."""
+        first = next(iter(model.item_bimap), None)
+        if first is not None:
+            self.predict(model, Query(items=(str(first),), num=10))
+
     def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
         from incubator_predictionio_tpu.ops.host_serving import (
             host_arrays,

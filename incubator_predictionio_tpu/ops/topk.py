@@ -111,6 +111,13 @@ def _batch_score_top_k_xla(
     return jnp.stack([top_s, top_i.astype(jnp.float32)])  # [2, B, k]
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two ≥ n (≥1) — THE padding policy of the batched
+    serving dispatch. Warmup hooks compile per-shape against this exact
+    function, so any change here automatically changes what they warm."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
 def batch_score_top_k(
     user_factors: jax.Array,
     item_factors: jax.Array,
@@ -128,9 +135,9 @@ def batch_score_top_k(
     variants total instead of one per distinct (B, num) pair. Callers slice
     row b of the packed [2, B_pad, k_pad] result to their own ``num``."""
     B = len(rows)
-    pad = 1 << max(B - 1, 0).bit_length()
+    pad = next_pow2(B)
     n_items = item_factors.shape[0]
-    k_pad = min(1 << max(int(k) - 1, 0).bit_length(), n_items)
+    k_pad = min(next_pow2(int(k)), n_items)
     rows_arr = jnp.asarray(
         list(rows) + [rows[0]] * (pad - B), jnp.int32)
     return _batch_score_top_k_xla(user_factors, item_factors, rows_arr,

@@ -548,6 +548,9 @@ class PredictionServer:
         def reload(request: Request) -> Response:
             self._check_server_key(request)
             self.load_models()
+            # the new models' shapes may differ (catalog size, rank) —
+            # re-warm so live traffic doesn't pay the compile
+            self._warmup_async()
             return Response(200, {"message": "Reloaded."})
 
         @r.post("/stop")
@@ -642,7 +645,11 @@ class PredictionServer:
         max_batch = self.config.micro_batch if self._batcher is not None else 0
 
         def run() -> None:
-            self.http._started.wait(60.0)
+            if not self.http.wait_started(60.0):
+                logger.warning(
+                    "serving warmup skipped: server did not bind within "
+                    "60s (queries will compile on demand if it ever does)")
+                return
             t0 = time.perf_counter()
             for algo, model in zip(algorithms, models):
                 try:

@@ -359,6 +359,14 @@ class HttpServer:
         async with self._server:
             await self._server.serve_forever()
 
+    def wait_started(self, timeout: Optional[float] = None) -> bool:
+        """True once the server has bound (or False on timeout / when the
+        startup errored — callers gating work on a live listener should
+        treat False as "not serving")."""
+        if not self._started.wait(timeout):
+            return False
+        return getattr(self, "_start_error", None) is None
+
     def start_background(self) -> int:
         """Run the server on a daemon thread; returns the bound port."""
         self._start_error: Optional[BaseException] = None

@@ -467,3 +467,44 @@ def test_ecommerce_seen_events_config():
             app_id=app_id, entity_id="uA1", event_names=["view"])
     }
     assert viewed.intersection({s.item for s in r.item_scores})
+
+
+def test_warmup_hooks_run_on_template_models():
+    """Each template algorithm's warmup must execute cleanly against a
+    freshly trained model (the prediction server calls these on deploy)."""
+    from incubator_predictionio_tpu.models.similarproduct import (
+        ALSAlgorithmParams as SPParams,
+        DataSourceParams as SPDS,
+        SimilarProductEngine,
+    )
+
+    app_id = seed_app("warmapp")
+    seed_views(app_id, extra_like=True)
+    engine = SimilarProductEngine().apply()
+    ep = EngineParams(
+        data_source_params=("", SPDS(app_name="warmapp")),
+        algorithm_params_list=[
+            ("als", SPParams(rank=8, num_iterations=4, seed=3)),
+        ],
+    )
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+    algo.warmup(models[0], max_batch=4)      # must not raise
+
+    from incubator_predictionio_tpu.models.ecommerce import (
+        DataSourceParams as EcDS,
+        ECommAlgorithmParams,
+        ECommerceEngine,
+    )
+
+    ec_engine = ECommerceEngine().apply()
+    ec_ep = EngineParams(
+        data_source_params=("", EcDS(app_name="warmapp")),
+        algorithm_params_list=[
+            ("ecomm", ECommAlgorithmParams(app_name="warmapp", rank=8,
+                                           num_iterations=4,
+                                           seed=3)),
+        ],
+    )
+    ec_models = ec_engine.train(RuntimeContext(), ec_ep)
+    ec_engine.algorithms(ec_ep)[0].warmup(ec_models[0], max_batch=4)

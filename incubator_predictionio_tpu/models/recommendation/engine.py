@@ -513,7 +513,11 @@ class ALSAlgorithm(Algorithm):
             if seen is not None:
                 # pad to the next power of two (-1 = no-op slots) so the
                 # jitted serve call compiles O(log max-seen) times total
-                width = 1 << (len(seen) - 1).bit_length()
+                from incubator_predictionio_tpu.ops.topk import (
+                    next_pow2,
+                )
+
+                width = next_pow2(len(seen))
                 exclude = np.full(width, -1, np.int32)
                 exclude[:len(seen)] = seen
                 exclude = jnp.asarray(exclude)

@@ -282,6 +282,16 @@ class SeqRecAlgorithm(Algorithm):
         return [model.item_bimap[n] + 1 for n in names
                 if n in model.item_bimap]
 
+    def warmup(self, model: SeqRecModel, max_batch: int = 1) -> None:
+        """Pre-compile the serving forward (core/base.py Algorithm.warmup):
+        the transformer's first query otherwise pays the full XLA compile
+        — the most expensive cold path of any template. Uses an explicit
+        one-item history so no event-store read happens."""
+        first = next(iter(model.item_bimap), None)
+        if first is not None:
+            self.predict(model, Query(user="__warmup__", num=10,
+                                      recent_items=(str(first),)))
+
     def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:
         import jax.numpy as jnp
 

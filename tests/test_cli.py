@@ -286,3 +286,58 @@ def test_accelerator_watchdog_times_out_and_propagates_errors(monkeypatch):
 
     monkeypatch.setattr(jax, "devices", lambda: ["dev0"])
     climain._ensure_accelerator(5.0)  # healthy path: no raise
+
+
+def test_fast_import_then_export_roundtrip(tmp_path, capsys, monkeypatch):
+    """Events landed via the columnar fast path are compact (sidecar-only)
+    records; export must render them as full canonical JSON events."""
+    from incubator_predictionio_tpu import native
+    from incubator_predictionio_tpu.cli import commands
+
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    Storage.reset()
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "cpplog",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path / "ev"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    monkeypatch.setattr(commands, "_FAST_IMPORT_MIN", 10)
+    main(["app", "new", "RoundTrip"])
+    capsys.readouterr()
+    src = tmp_path / "in.jsonl"
+    docs = [
+        {"event": "rate", "entityType": "user", "entityId": f"u{i % 4}",
+         "targetEntityType": "item", "targetEntityId": f"i{i % 3}",
+         "properties": {"rating": float(1 + i % 5)},
+         "eventTime": f"2021-05-01T00:00:{i % 60:02d}.000Z"}
+        for i in range(30)
+    ]
+    src.write_text("\n".join(json.dumps(d) for d in docs))
+    assert main(["import", "--appid-or-name", "RoundTrip",
+                 "--input", str(src)]) == 0
+    assert "native columnar path" in capsys.readouterr().out
+    dst = tmp_path / "out.jsonl"
+    assert main(["export", "--appid-or-name", "RoundTrip",
+                 "--output", str(dst)]) == 0
+    lines = [json.loads(l) for l in dst.read_text().splitlines()]
+    assert len(lines) == 30
+    for got, want in zip(lines, docs):
+        assert got["event"] == want["event"]
+        assert got["entityId"] == want["entityId"]
+        assert got["targetEntityId"] == want["targetEntityId"]
+        assert got["properties"] == want["properties"]
+        assert got["eventTime"].startswith(want["eventTime"][:19])
+        assert len(got["eventId"]) == 32  # generated ids present
+    # and the exported file re-imports cleanly (per-event path: it now
+    # carries eventIds)
+    assert main(["import", "--appid-or-name", "RoundTrip",
+                 "--input", str(dst)]) == 0
+    out = capsys.readouterr().out
+    assert "native columnar path" not in out  # ids force the upsert path

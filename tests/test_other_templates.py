@@ -508,3 +508,71 @@ def test_warmup_hooks_run_on_template_models():
     )
     ec_models = ec_engine.train(RuntimeContext(), ec_ep)
     ec_engine.algorithms(ec_ep)[0].warmup(ec_models[0], max_batch=4)
+
+
+def test_warmup_recommendation_batched_and_sequence():
+    """The two complex warmups: the ALS batched loop must exercise the
+    exact power-of-two shapes live traffic compiles, and the SASRec
+    warmup must run the transformer forward without touching the store."""
+    from incubator_predictionio_tpu.models.recommendation.engine import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        Query as RecQuery,
+    )
+    import incubator_predictionio_tpu.models.recommendation.engine as rec_mod
+
+    app_id = seed_app("warmrec")
+    ev = Storage.get_events()
+    rng = np.random.default_rng(2)
+    for u in range(12):
+        for i in rng.choice(20, 5, replace=False):
+            ev.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{int(i)}",
+                properties=DataMap({"rating": float(1 + int(i) % 5)})),
+                app_id)
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine,
+        DataSourceParams as RecDS,
+    )
+
+    engine = RecommendationEngine().apply()
+    ep = EngineParams(
+        data_source_params=("", RecDS(app_name="warmrec")),
+        algorithm_params_list=[
+            ("als", ALSAlgorithmParams(rank=8, num_iterations=3, seed=1)),
+        ],
+    )
+    models = engine.train(RuntimeContext(), ep)
+    algo = engine.algorithms(ep)[0]
+    calls = []
+    orig = algo.batch_predict
+
+    def spy(model, queries):
+        calls.append(len(queries))
+        return orig(model, queries)
+
+    algo.batch_predict = spy
+    algo.warmup(models[0], max_batch=5)
+    # size=2 start, cap = next_pow2(5) = 8 → exactly [2, 4, 8]
+    assert calls == [2, 4, 8]
+    algo.batch_predict = orig
+    algo.warmup(models[0], max_batch=0)   # disabled batcher: singleton only
+
+    # sequence: explicit-history warmup, no event-store read
+    from incubator_predictionio_tpu.models.sequence.engine import (
+        SeqRecAlgorithm,
+        SeqRecAlgorithmParams,
+        PreparedData as SeqPD,
+    )
+    import numpy as _np
+
+    seqs = _np.array([[1, 2, 3, 4], [2, 3, 4, 5]], _np.int32)
+    from incubator_predictionio_tpu.data.bimap import BiMap
+
+    algo2 = SeqRecAlgorithm(SeqRecAlgorithmParams(
+        app_name="warmrec", d_model=8, n_heads=2, n_layers=1, epochs=1))
+    pd = SeqPD(sequences=seqs,
+               item_bimap=BiMap({f"i{k}": k for k in range(6)}))
+    model2 = algo2.train(RuntimeContext(), pd)
+    algo2.warmup(model2)                  # must not raise or hit storage

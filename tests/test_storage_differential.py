@@ -27,7 +27,7 @@ T0 = parse_iso8601("2022-01-01T00:00:00Z")
 
 _ENTITIES = ("u1", "u2")
 _ITEMS = ("i1", "i2")
-_NAMES = ("rate", "view", "$set")
+_NAMES = ("rate", "view", "$set", "$unset", "$delete")
 _PROPS = ("rating", "color")
 
 _insert = st.fixed_dictionaries({
@@ -50,21 +50,34 @@ _delete = st.fixed_dictionaries({
     "op": st.just("delete"),
     "which": st.integers(0, 6),  # index into ids seen so far (mod len)
 })
+#: shared optional time-window bounds (minutes from T0) — window-edge
+#: semantics (inclusive start, exclusive until, millis granularity) must
+#: agree across backends for BOTH find and aggregate replay
+_WINDOW_LO = st.one_of(st.none(), st.integers(0, 4))
+_WINDOW_HI = st.one_of(st.none(), st.integers(1, 6))
 _find = st.fixed_dictionaries({
     "op": st.just("find"),
     "etype": st.one_of(st.none(), st.just("user")),
     "eid": st.one_of(st.none(), st.sampled_from(_ENTITIES)),
     "names": st.one_of(st.none(), st.just(("rate",)),
                        st.just(("rate", "view"))),
-    "lo": st.one_of(st.none(), st.integers(0, 4)),
-    "hi": st.one_of(st.none(), st.integers(1, 6)),
+    "lo": _WINDOW_LO,
+    "hi": _WINDOW_HI,
     "limit": st.one_of(st.none(), st.integers(1, 4)),
     "reversed": st.booleans(),
 })
-_aggregate = st.just({"op": "aggregate"})
+_aggregate = st.fixed_dictionaries({
+    "op": st.just("aggregate"),
+    "lo": _WINDOW_LO,
+    "hi": _WINDOW_HI,
+})
 
 _ops = st.lists(st.one_of(_insert, _delete, _find, _aggregate),
                 min_size=1, max_size=25)
+
+
+def _window_time(op, key):
+    return None if op[key] is None else T0 + timedelta(minutes=op[key])
 
 
 def _canon(e: Event):
@@ -86,8 +99,8 @@ def _apply(ops, events_dao):
         kind = op["op"]
         if kind == "insert":
             target = op["target"]
-            if op["name"] == "$set":
-                target = None  # $set carries no target entity
+            if op["name"].startswith("$"):
+                target = None  # $-events carry no target entity
             event = Event(
                 event=op["name"], entity_type="user", entity_id=op["eid"],
                 target_entity_type="item" if target else None,
@@ -110,17 +123,17 @@ def _apply(ops, events_dao):
                 entity_type=op["etype"],
                 entity_id=op["eid"],
                 event_names=op["names"],
-                start_time=(None if op["lo"] is None
-                            else T0 + timedelta(minutes=op["lo"])),
-                until_time=(None if op["hi"] is None
-                            else T0 + timedelta(minutes=op["hi"])),
+                start_time=_window_time(op, "lo"),
+                until_time=_window_time(op, "hi"),
                 limit=op["limit"],
                 reversed=op["reversed"],
             ))
             out.append(("find", [_canon(e) for e in found]))
         else:
-            agg = events_dao.aggregate_properties(app_id=1,
-                                                  entity_type="user")
+            agg = events_dao.aggregate_properties(
+                app_id=1, entity_type="user",
+                start_time=_window_time(op, "lo"),
+                until_time=_window_time(op, "hi"))
             out.append(("aggregate", {
                 k: dict(v.to_jsonable()) for k, v in sorted(agg.items())
             }))

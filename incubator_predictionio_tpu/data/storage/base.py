@@ -277,8 +277,31 @@ class Events(abc.ABC):
         channel_id: Optional[int] = None,
     ) -> list:
         """Bulk insert (PEvents.write:184 / the import tool's path).
-        Backends override with a single-write fast path."""
-        return [self.insert(e, app_id, channel_id) for e in events]
+        Backends override with a single-write fast path.
+
+        Retry-safe: a mid-batch failure rolls back the AUTO-ID events
+        already inserted (best effort), so callers that retry per event
+        after a failed bulk write — the EventServer's batch route — can
+        never duplicate them. Explicit-id events are NOT rolled back: an
+        upsert destroyed the pre-image (deleting would lose data that
+        predates the batch), and a per-event retry of the same id is an
+        idempotent upsert anyway. The native log is fully atomic instead
+        (framed batch + truncate-on-failure)."""
+        done: list = []
+        try:
+            for e in events:
+                done.append((self.insert(e, app_id, channel_id),
+                             bool(e.event_id)))
+        except Exception:
+            for eid, explicit in done:
+                if explicit:
+                    continue  # idempotent under retry; pre-image is gone
+                try:
+                    self.delete(eid, app_id, channel_id)
+                except Exception:  # pragma: no cover - best effort
+                    pass
+            raise
+        return [eid for eid, _ in done]
 
     @abc.abstractmethod
     def get(

@@ -163,6 +163,83 @@ class CppLogEvents(base.Events):
         # upsert semantics and the sidecar fast-scan block)
         return self.insert_batch([event], app_id, channel_id)[0]
 
+    @staticmethod
+    def _derive_event_ids(seed: int, n: int) -> list:
+        """The 32-hex event ids pio_evlog_append_interactions generates for
+        ``id_seed=seed`` — byte-identical to eventlog.cc (splitmix64 over
+        seed^k and seed+golden+k), so a caller routing a batch through the
+        columnar import can report the stored ids without reading back."""
+        import numpy as np
+
+        def mix(x):
+            x = x + np.uint64(0x9E3779B97F4A7C15)
+            x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            return x ^ (x >> np.uint64(31))
+
+        with np.errstate(over="ignore"):
+            k = np.arange(n, dtype=np.uint64)
+            s = np.uint64(seed)
+            ida = mix(s ^ k)
+            idb = mix(s + np.uint64(0x9E3779B97F4A7C15) + k)
+        return [f"{a:016x}{b:016x}" for a, b in zip(ida, idb)]
+
+    def _uniform_batch(self, events: Sequence[Event]):
+        """events → (Interactions, etype, tetype, name, vprop, times_ms)
+        when the whole batch can take the columnar import, else None.
+
+        Mirrors the CLI import gate (cli/commands.py): no explicit ids, no
+        tags/prId, one shared float32-exact numeric property, a target on
+        every event, identical types, non-$ name. NOTE the one observable
+        delta, documented in docs/data-collection.md: columnar records
+        report creationTime == eventTime (the compact sidecar stores one
+        timestamp)."""
+        import numpy as np
+
+        first = events[0]
+        name, etype, tetype = first.event, first.entity_type, \
+            first.target_entity_type
+        if name.startswith("$") or not tetype:
+            return None
+        props = list(first.properties)
+        if len(props) != 1:
+            return None
+        vprop = props[0]
+        n = len(events)
+        uidx = np.empty(n, np.int32)
+        iidx = np.empty(n, np.int32)
+        vals = np.empty(n, np.float32)
+        times = np.empty(n, np.int64)
+        u_intern: dict = {}
+        i_intern: dict = {}
+        users: list = []
+        items: list = []
+        for k, e in enumerate(events):
+            validate_event(e)
+            if (e.event != name or e.entity_type != etype
+                    or e.target_entity_type != tetype
+                    or not e.target_entity_id or e.event_id or e.tags
+                    or e.pr_id or list(e.properties) != props):
+                return None
+            v = e.properties.opt(vprop)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return None
+            if float(np.float32(v)) != float(v):
+                return None
+            u = u_intern.setdefault(e.entity_id, len(u_intern))
+            if u == len(users):
+                users.append(e.entity_id)
+            it = i_intern.setdefault(e.target_entity_id, len(i_intern))
+            if it == len(items):
+                items.append(e.target_entity_id)
+            uidx[k], iidx[k], vals[k] = u, it, v
+            times[k] = to_millis(e.event_time)
+        inter = base.Interactions(
+            user_idx=uidx, item_idx=iidx, values=vals,
+            user_ids=base.IdTable.from_list(users),
+            item_ids=base.IdTable.from_list(items))
+        return inter, etype, tetype, name, vprop, times
+
     def insert_batch(self, events: Sequence[Event], app_id: int,
                      channel_id: Optional[int] = None) -> list:
         """Bulk fast path: one framed batch write (pio_evlog_append_bulk).
@@ -170,7 +247,14 @@ class CppLogEvents(base.Events):
         Hashing, sidecar construction, and framing happen in C++; Python
         serializes the JSON document and packs the numeric properties. Each
         record gets a binary sidecar block (the columnar-scan fast path)
-        unless a field exceeds the sidecar's length limits."""
+        unless a field exceeds the sidecar's length limits.
+
+        Uniform id-less interaction batches (the REST batch endpoint's hot
+        shape) route through the fully-native columnar import instead —
+        compact records, C++ rendering, and training-projection
+        maintenance — with the generated ids derived in Python from the
+        same seed formula."""
+        import secrets
         import struct
 
         import numpy as np
@@ -178,6 +262,25 @@ class CppLogEvents(base.Events):
         n = len(events)
         if n == 0:
             return []
+        if n >= 8:
+            fast = self._uniform_batch(events)
+            if fast is not None:
+                inter, etype, tetype, name, vprop, times = fast
+                seed = int.from_bytes(secrets.token_bytes(8), "little")
+                try:
+                    wrote = self.import_interactions(
+                        inter, app_id, channel_id, entity_type=etype,
+                        target_entity_type=tetype, event_name=name,
+                        value_prop=vprop, times=times, id_seed=seed)
+                except base.StorageError:
+                    # safe to fall through to the generic path: the -2
+                    # (sidecar-limits) case raises BEFORE any write, and a
+                    # write failure truncates the log back to the batch
+                    # start (eventlog.cc append_interactions is
+                    # all-or-nothing), so nothing partial remains
+                    wrote = 0
+                if wrote == n:
+                    return self._derive_event_ids(seed, n)
         # last-wins for duplicate explicit ids WITHIN the batch too (sqlite
         # INSERT OR REPLACE parity): earlier occurrences are dropped from
         # the write set, since the per-event tombstone scan below can only
@@ -632,10 +735,20 @@ class CppLogEvents(base.Events):
                 if id_seed is None else (id_seed & 0xFFFFFFFFFFFFFFFF),
             )
             if rc == n:
-                self._maintain_cache_after_import(
-                    h, app_id, channel_id, raw_before, dead_before,
-                    uidx, iidx, vals, times_arr, utab, itab,
-                    entity_type, target_entity_type, event_name, value_prop)
+                try:
+                    self._maintain_cache_after_import(
+                        h, app_id, channel_id, raw_before, dead_before,
+                        uidx, iidx, vals, times_arr, utab, itab,
+                        entity_type, target_entity_type, event_name,
+                        value_prop)
+                except Exception:
+                    # the append already succeeded durably; the projection
+                    # is an optimization the next scan rebuilds — raising
+                    # here would make callers believe nothing was written
+                    # (and retry-writers would then DUPLICATE the batch)
+                    logger.exception(
+                        "training-projection maintenance failed after a "
+                        "successful import (next scan rebuilds it)")
         if rc == -2:  # sidecar limits exceeded: generic per-Event path
             if id_seed is not None:
                 # the generic path generates random event ids — honoring

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import logging
 import threading
 from datetime import datetime
 from pathlib import Path
@@ -36,6 +37,8 @@ from incubator_predictionio_tpu.data.event import (
 from incubator_predictionio_tpu.data.storage import base
 from incubator_predictionio_tpu.data.storage.base import UNSET
 from incubator_predictionio_tpu.utils.times import to_millis
+
+logger = logging.getLogger(__name__)
 
 _I64_MIN = -(1 << 63)
 _I64_MAX = (1 << 63) - 1
@@ -845,48 +848,37 @@ class CppLogEvents(base.Events):
         and pre-sidecar bare-JSON records that every scan must
         JSON-parse).
 
-        Every live event round-trips through the normal Event write path
-        (ids, event/creation times, and properties preserved; records
-        gain sidecars where the current writer would produce them), into
-        a temp log that atomically replaces the original. The training
-        projection is invalidated (entry numbering changes). Returns
-        ``{"events", "bytes_before", "bytes_after"}``."""
+        Fully native (pio_evlog_compact_copy): live records that already
+        carry a sidecar — including compact bulk-imported records —
+        byte-copy unchanged, bare-JSON records gain a sidecar built in
+        C++ from the span parser, and the copy lands in a temp file that
+        atomically replaces the original. No Python Event objects exist
+        on this path, ids/times/bytes are preserved exactly, and log
+        (append) order survives — the equal-time tie-break contract. The
+        training projection is invalidated (entry numbering changes).
+        Returns ``{"events", "bytes_before", "bytes_after"}``."""
         import os
-        import shutil
-        import tempfile
 
         from incubator_predictionio_tpu.data.storage import traincache
 
         with self.client.lock:
-            events = list(self.find(app_id=app_id, channel_id=channel_id))
+            h = self._handle(app_id, channel_id)
             path = self.client._file(self.ns, app_id, channel_id)
             bytes_before = path.stat().st_size if path.exists() else 0
-            tmpdir = tempfile.mkdtemp(prefix=".compact_",
-                                      dir=str(self.client.dir))
-            try:
-                tmp_client = StorageClient(base.StorageClientConfig(
-                    properties={"PATH": tmpdir}))
-                try:
-                    tmp_dao = CppLogEvents(tmp_client, None, prefix=self.ns)
-                    # create the (possibly empty) target log up front: a
-                    # tombstone-only or event-less store must still swap
-                    # to a fresh empty file, not crash on a missing one
-                    tmp_dao.init(app_id, channel_id)
-                    for s in range(0, len(events), 500):
-                        tmp_dao.insert_batch(
-                            events[s:s + 500], app_id, channel_id)
-                finally:
-                    tmp_client.close()  # syncs to disk
-                tmp_path = Path(tmpdir) / path.name
-                old = self.client._handles.pop(str(path), None)
-                if old is not None:
-                    self.client.lib.pio_evlog_close(old)
-                os.replace(tmp_path, path)
-            finally:
-                shutil.rmtree(tmpdir, ignore_errors=True)
+            tmp_path = path.with_name(path.name + ".compact")
+            live = self.client.lib.pio_evlog_compact_copy(
+                h, str(tmp_path).encode("utf-8"))
+            if live < 0:
+                tmp_path.unlink(missing_ok=True)
+                raise base.StorageError(
+                    f"compaction failed for {path.name}")
+            old = self.client._handles.pop(str(path), None)
+            if old is not None:
+                self.client.lib.pio_evlog_close(old)
+            os.replace(tmp_path, path)
             traincache.invalidate(path)
             bytes_after = path.stat().st_size if path.exists() else 0
-        return {"events": len(events), "bytes_before": bytes_before,
+        return {"events": int(live), "bytes_before": bytes_before,
                 "bytes_after": bytes_after}
 
     @staticmethod

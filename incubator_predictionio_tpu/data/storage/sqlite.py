@@ -311,33 +311,54 @@ class SQLiteEvents(_SQLiteDAO, base.Events):
         return {"events": int(n), "bytes_before": before,
                 "bytes_after": after}
 
+    @staticmethod
+    def _row(ns: str, eid: str, app_id: int, channel_id, event: Event):
+        return (
+            ns,
+            eid,
+            app_id,
+            _chan(channel_id),
+            event.event,
+            event.entity_type,
+            event.entity_id,
+            event.target_entity_type,
+            event.target_entity_id,
+            json.dumps(event.properties.to_jsonable()),
+            to_millis(event.event_time),
+            str(event.event_time.tzinfo or "UTC"),
+            json.dumps(list(event.tags)),
+            event.pr_id,
+            to_millis(event.creation_time),
+        )
+
+    _INSERT_SQL = ("INSERT OR REPLACE INTO events VALUES "
+                   "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)")
+
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
         validate_event(event)
         eid = event.event_id or new_event_id()
         with self.client.lock, self.client.conn as c:
-            c.execute(
-                "INSERT OR REPLACE INTO events VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                (
-                    self.ns,
-                    eid,
-                    app_id,
-                    _chan(channel_id),
-                    event.event,
-                    event.entity_type,
-                    event.entity_id,
-                    event.target_entity_type,
-                    event.target_entity_id,
-                    json.dumps(event.properties.to_jsonable()),
-                    to_millis(event.event_time),
-                    str(event.event_time.tzinfo or "UTC"),
-                    json.dumps(list(event.tags)),
-                    event.pr_id,
-                    to_millis(event.creation_time),
-                ),
-            )
+            c.execute(self._INSERT_SQL,
+                      self._row(self.ns, eid, app_id, channel_id, event))
         return eid
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> list:
+        """One executemany in ONE transaction — genuinely atomic (the
+        generic base loop pays a transaction per event and compensates on
+        failure; SQLite can simply roll the whole batch back). REPLACE
+        keeps last-wins for duplicate explicit ids within the batch."""
+        ids = []
+        rows = []
+        for event in events:
+            validate_event(event)
+            eid = event.event_id or new_event_id()
+            ids.append(eid)
+            rows.append(self._row(self.ns, eid, app_id, channel_id, event))
+        with self.client.lock, self.client.conn as c:
+            c.executemany(self._INSERT_SQL, rows)
+        return ids
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:

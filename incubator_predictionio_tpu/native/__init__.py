@@ -91,6 +91,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pio_evlog_tombstone.argtypes = [c.c_void_p, c.c_int64]
     lib.pio_evlog_count.restype = c.c_int64
     lib.pio_evlog_count.argtypes = [c.c_void_p]
+    lib.pio_evlog_compact_copy.restype = c.c_int64
+    lib.pio_evlog_compact_copy.argtypes = [c.c_void_p, c.c_char_p]
     lib.pio_evlog_query.restype = c.c_int64
     lib.pio_evlog_query.argtypes = [
         c.c_void_p, c.c_int64, c.c_int64, c.c_uint64, c.c_uint64,

@@ -1473,6 +1473,185 @@ int64_t pio_evlog_append_interactions(
   return n;
 }
 
+// ---------------------------------------------------------------------------
+// Record-preserving compaction: copy LIVE records into a fresh log file at
+// dst_path in the CURRENT on-disk format. Records that already carry a
+// sidecar (incl. compact interaction records) byte-copy unchanged; bare-JSON
+// records gain a sidecar built from the span parser — conservatively: a
+// record whose relevant fields carry escapes or exceed the sidecar length
+// limits stays bare JSON (readers handle both forms). Order: original log
+// (append) order, which preserves the cross-backend equal-time tie-break.
+// Returns the live-record count, or -1 on I/O failure (dst removed).
+// ---------------------------------------------------------------------------
+
+// Pack the NUMERIC top-level entries of a JSON object span as sidecar props
+// (u8 klen, key bytes, f64 value). Returns false when the object cannot be
+// represented (escaped/oversize keys, >255 numeric props) — caller keeps
+// the record bare.
+static bool pack_numeric_props(std::string_view obj, std::string* out,
+                               uint8_t* n_out) {
+  size_t i = 0;
+  const size_t n = obj.size();
+  int count = 0;
+  if (n < 2 || obj[0] != '{') return false;
+  i = 1;
+  while (i < n) {
+    while (i < n && (obj[i] == ' ' || obj[i] == '\t' || obj[i] == ',')) ++i;
+    if (i < n && obj[i] == '}') break;
+    if (i >= n || obj[i] != '"') return false;
+    size_t kstart = ++i;
+    bool kesc = false;
+    while (i < n && obj[i] != '"') {
+      if (obj[i] == '\\') { kesc = true; i += 2; } else ++i;
+    }
+    if (i >= n) return false;
+    std::string_view key = obj.substr(kstart, i - kstart);
+    ++i;
+    while (i < n && (obj[i] == ' ' || obj[i] == '\t')) ++i;
+    if (i >= n || obj[i] != ':') return false;
+    ++i;
+    while (i < n && (obj[i] == ' ' || obj[i] == '\t')) ++i;
+    if (i >= n) return false;
+    if (obj[i] == '"') {  // string value: skip
+      ++i;
+      while (i < n && obj[i] != '"') i += (obj[i] == '\\') ? 2 : 1;
+      ++i;
+    } else if (obj[i] == '{' || obj[i] == '[') {  // nested: skip balanced
+      int d = 0;
+      bool instr = false;
+      while (i < n) {
+        char c = obj[i];
+        if (instr) {
+          if (c == '\\') { i += 2; continue; }
+          if (c == '"') instr = false;
+          ++i;
+          continue;
+        }
+        if (c == '"') { instr = true; ++i; continue; }
+        if (c == '{' || c == '[') ++d;
+        else if (c == '}' || c == ']') {
+          if (--d == 0) { ++i; break; }
+        }
+        ++i;
+      }
+    } else {  // bare token: numeric, true/false/null
+      size_t vstart = i;
+      while (i < n && obj[i] != ',' && obj[i] != '}' && obj[i] != ' ' &&
+             obj[i] != '\t')
+        ++i;
+      std::string tok(obj.substr(vstart, i - vstart));
+      if (!tok.empty() && tok != "true" && tok != "false" && tok != "null") {
+        char* end = nullptr;
+        double v = strtod(tok.c_str(), &end);
+        if (end == tok.c_str() + tok.size() && std::isfinite(v)) {
+          if (kesc || key.size() > 255) return false;
+          if (++count > 255) return false;
+          out->push_back((char)key.size());
+          out->append(key);
+          out->append((const char*)&v, 8);
+        }
+      }
+    }
+  }
+  *n_out = (uint8_t)count;
+  return true;
+}
+
+int64_t pio_evlog_compact_copy(void* handle, const char* dst_path) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  FILE* dst = fopen(dst_path, "wb");
+  if (!dst) return -1;
+  fflush(log->f);
+  int64_t live = 0;
+  bool failed = false;
+  std::string payload;
+  std::string side;
+  for (size_t idx = 0; idx < log->entries.size() && !failed; ++idx) {
+    const Entry& e = log->entries[idx];
+    if (e.dead || (e.flags & kTombstone)) continue;
+    payload.resize(e.payload_len);
+    fseeko(log->f, (off_t)e.offset, SEEK_SET);
+    if (e.payload_len &&
+        fread(payload.data(), 1, e.payload_len, log->f) != e.payload_len) {
+      failed = true;
+      break;
+    }
+    RecHeader h{e.time_ms, e.etype_hash, e.eid_hash, e.name_hash, e.id_hash,
+                e.payload_len, e.flags};
+    if (!(e.flags & kSidecar)) {
+      // bare JSON: try the sidecar upgrade
+      Fields f;
+      side.clear();
+      uint8_t n_props = 0;
+      std::string props_packed;
+      bool ok = extract_fields(payload, &f) && f.event.present &&
+                f.etype.present && f.eid.present && !f.event.esc &&
+                !f.etype.esc && !f.eid.esc &&
+                (!f.tetype.present || !f.tetype.esc) &&
+                (!f.teid.present || !f.teid.esc) &&
+                f.tetype.present == f.teid.present &&
+                f.etype.len < kNoTarget && f.event.len < kNoTarget &&
+                f.eid.len < kNoTarget && f.tetype.len < kNoTarget &&
+                f.teid.len < kNoTarget;
+      if (ok && f.props.present)
+        ok = pack_numeric_props(payload.substr(f.props.pos, f.props.len),
+                                &props_packed, &n_props);
+      if (ok) {
+        const bool has_target = f.tetype.present;
+        const uint32_t side_len =
+            4 + 1 + 10 +
+            (uint32_t)(f.etype.len + f.event.len + f.eid.len) +
+            (has_target ? (uint32_t)(f.tetype.len + f.teid.len) : 0) +
+            (uint32_t)props_packed.size();
+        side.append((const char*)&side_len, 4);
+        side.push_back((char)n_props);
+        uint16_t l[5] = {(uint16_t)f.etype.len, (uint16_t)f.event.len,
+                         (uint16_t)f.eid.len,
+                         has_target ? (uint16_t)f.tetype.len : kNoTarget,
+                         has_target ? (uint16_t)f.teid.len : (uint16_t)0};
+        side.append((const char*)l, 10);
+        side.append(payload, f.etype.pos, f.etype.len);
+        side.append(payload, f.event.pos, f.event.len);
+        side.append(payload, f.eid.pos, f.eid.len);
+        if (has_target) {
+          side.append(payload, f.tetype.pos, f.tetype.len);
+          side.append(payload, f.teid.pos, f.teid.len);
+        }
+        side.append(props_packed);
+        h.payload_len = side_len + (uint32_t)payload.size();
+        h.flags = kSidecar;
+      }
+    }
+    if (fwrite(&h, sizeof(h), 1, dst) != 1 ||
+        (!side.empty() &&
+         fwrite(side.data(), 1, side.size(), dst) != side.size()) ||
+        (!payload.empty() &&
+         fwrite(payload.data(), 1, payload.size(), dst) != payload.size()))
+      failed = true;
+    side.clear();
+    ++live;
+  }
+  fseeko(log->f, 0, SEEK_END);
+  // fdatasync BEFORE the caller renames dst over the original: a rename
+  // is durable only if the replacement's blocks are — a crash after an
+  // unsynced swap would lose the whole log
+#if defined(__APPLE__)
+  const bool synced = !failed && fflush(dst) == 0 &&
+                      fcntl(fileno(dst), F_FULLFSYNC) != -1;
+#else
+  const bool synced = !failed && fflush(dst) == 0 &&
+                      fdatasync(fileno(dst)) == 0;
+#endif
+  if (!synced) {
+    fclose(dst);
+    remove(dst_path);
+    return -1;
+  }
+  fclose(dst);
+  return live;
+}
+
 int64_t pio_scan_nnz(void* r) { return (int64_t)((ScanResult*)r)->uidx.size(); }
 
 int64_t pio_scan_n_ids(void* r, int32_t which) {

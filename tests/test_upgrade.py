@@ -167,3 +167,82 @@ def test_sqlite_compact_preserves_upsert_tie_order(sqlite_storage):
     commands.upgrade()
     after = [e.event for e in dao.find(app_id=app_id)]
     assert after == before
+
+
+def test_cpplog_compact_upgrades_bare_json_and_keeps_compact_records(
+        cpplog_storage, tmp_path):
+    """The native compaction must (a) byte-copy records that already carry
+    sidecars — bulk-imported compact records may NOT inflate — and (b)
+    add a sidecar to pre-sidecar bare-JSON records (the legacy format)
+    so post-upgrade scans take the binary fast path."""
+    import json as _json
+    import struct
+
+    from incubator_predictionio_tpu.data.storage import Storage as S
+
+    Storage.get_meta_data_apps().insert(App(0, "fmtapp"))
+    app_id = Storage.get_meta_data_apps().get_by_name("fmtapp").id
+    dao = Storage.get_events()
+    # uniform batch → columnar compact records via the fast path
+    ids = dao.insert_batch([_ev(i, minutes=i) for i in range(12)], app_id)
+    path = dao.client._file(dao.ns, app_id, None)
+
+    # forge a LEGACY bare-JSON record (flags=0) at the tail, hashes
+    # matching the fields so find()'s hash pruning still works
+    def fnv(s: str) -> int:
+        h = 0xCBF29CE484222325
+        for b in s.encode("utf-8"):
+            h = ((h ^ b) * 0x100000001B3) % (1 << 64)
+        return h
+
+    doc = {"eventId": "f" * 32, "event": "rate", "entityType": "user",
+           "entityId": "legacy", "targetEntityType": "item",
+           "targetEntityId": "i9", "properties": {"rating": 2.5},
+           "eventTime": "2026-01-01T00:30:00.000+00:00", "tags": [],
+           "creationTime": "2026-01-01T00:30:00.000+00:00"}
+    payload = _json.dumps(doc, separators=(",", ":")).encode()
+    t_ms = 1767227400000  # 2026-01-01T00:30:00Z
+    header = struct.pack(
+        "<qQQQQIi", t_ms, fnv("user"), fnv("legacy"), fnv("rate"),
+        fnv("f" * 32), len(payload), 0)
+    dao.client.close()
+    with open(path, "ab") as f:
+        f.write(header + payload)
+    S.reset()
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "cpplog",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(path.parent),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    Storage.get_meta_data_apps().insert(App(0, "fmtapp"))
+    dao = Storage.get_events()
+    assert dao.get("f" * 32, app_id).entity_id == "legacy"
+    size_before = path.stat().st_size
+
+    res = dao.compact(app_id)
+    assert res["events"] == 13
+    # compact records byte-copied (no inflation): total growth is exactly
+    # the ONE legacy record's new sidecar block (< 200 bytes), not the
+    # 2-3x a JSON round-trip of the 12 compact records would cost
+    assert 0 < res["bytes_after"] - size_before < 200
+    # the legacy record now carries a sidecar: walk the file's headers
+    flags_seen = []
+    blob = path.read_bytes()
+    off = 0
+    while off + 48 <= len(blob):
+        t, _e, _u, _n, _i, plen, flags = struct.unpack_from(
+            "<qQQQQIi", blob, off)
+        flags_seen.append(flags)
+        off += 48 + plen
+    assert all(f & 2 for f in flags_seen)  # every record has kSidecar
+    # and everything still reads correctly
+    assert dao.get("f" * 32, app_id).properties.get("rating") == 2.5
+    inter = dao.scan_interactions(
+        app_id=app_id, event_names=("rate",), value_prop="rating")
+    assert len(inter) == 13

@@ -23,12 +23,33 @@ Prints exactly ONE JSON line on stdout: the headline metric
 the sub-metrics as extra keys (ingest/seed/prep walls, mfu, serving p50 /
 QPS) so the driver's parsed record carries the whole story.
 
+Process architecture (resilience against the single-tenant chip lease —
+a stale lease blocks PJRT client construction *forever*, and a blocked
+dial can never be retried in-process because the backend-init lock is
+held by the blocked thread):
+
+- the PARENT never dials the accelerator. It pins its own jax to CPU,
+  runs every host-side stage (seed, ingest scan, prep, REST-ingest
+  bench), and supervises a CHILD process that does all TPU work.
+- the CHILD dials the chip as its first act and touches a claim file the
+  instant the dial succeeds; the parent recycles children that fail to
+  claim within an exponentially growing window (a *fresh* process gets a
+  fresh dial — the only true retry) until `PIO_BENCH_ACCEL_WAIT_S` runs
+  out. Children are stopped with SIGTERM-and-wait, never SIGKILL
+  (SIGKILL mid-claim is what wedges the lease in the first place).
+- if no child ever lands, the parent emits a **degraded** record —
+  host-stage walls at full shape plus train quality measured on the
+  pinned all-f32 CPU schedule at a reduced `PIO_BENCH_DEGRADED_NNZ`
+  shape, `"degraded": true`, exit 0 — so the driver always gets a
+  parsed record, never a null round.
+
 `--cpu` reruns the train stage on the host CPU backend to (re)measure the
 baseline constant. `PIO_BENCH_NNZ` shrinks the dataset for smoke runs.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -72,6 +93,22 @@ CPU_BASELINE_TRAIN_S = float(os.environ.get("PIO_BENCH_CPU_BASELINE", 571.1))
 PEAK_FLOPS_F32 = float(os.environ.get("PIO_BENCH_PEAK_FLOPS", 98.5e12))
 PEAK_FLOPS_BF16 = float(os.environ.get("PIO_BENCH_PEAK_FLOPS_BF16", 197e12))
 
+#: total budget for landing the TPU child (dial + respawn backoff). The
+#: round-4 wedge outlasted a flat 1200 s retry window; the default here is
+#: longer AND the wait overlaps the parent's host-side stages, so the
+#: worst-case bench wall is max(host stages, wait) + child run, not their
+#: sum.
+ACCEL_WAIT_S = float(os.environ.get("PIO_BENCH_ACCEL_WAIT_S", "1800"))
+#: if no child has claimed the chip this far into the wait, the parent
+#: starts computing the degraded record in parallel (a normal dial lands
+#: in seconds; by 300 s it is almost certainly a wedge) so the wait and
+#: the fallback work overlap instead of adding
+DEGRADED_START_S = float(os.environ.get("PIO_BENCH_DEGRADED_START_S", "300"))
+#: once a child HAS claimed the chip, how long its full TPU run may take
+TPU_RUN_TIMEOUT_S = float(os.environ.get("PIO_BENCH_TPU_RUN_S", "1800"))
+#: degraded-mode train shape (events subsampled from the full dataset)
+DEGRADED_NNZ = int(os.environ.get("PIO_BENCH_DEGRADED_NNZ", 2_000_000))
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -105,7 +142,9 @@ def _sample_pairs(rng, n):
 def make_dataset(rng):
     """→ (users, items, ratings, heldout (u, i, r), true (U, V)). The
     heldout pairs are fresh draws from the same ground truth — never
-    stored, never trained on."""
+    stored, never trained on. Deterministic for a given rng seed: the
+    TPU child regenerates the identical dataset from seed 7 instead of
+    shipping 240 MB of arrays across the process boundary."""
     u_true = rng.normal(0, 1.0 / np.sqrt(PLANT_RANK),
                         (N_USERS, PLANT_RANK)).astype(np.float32)
     v_true = rng.normal(0, 1.0, (N_ITEMS, PLANT_RANK)).astype(np.float32)
@@ -236,117 +275,51 @@ def seed_store(tmpdir, users, items, ratings):
     return events, client, seed_s
 
 
-def _wait_for_accelerator(total_s: float) -> None:
-    """Bounded wait for device init instead of an indefinite hang.
+def scan_store(tmpdir):
+    """Re-open the seeded store and stream the training projection back
+    out (the warm `pio train` read path). → (inter, ingest_wall_s)."""
+    from incubator_predictionio_tpu.data.storage import StorageClientConfig
+    from incubator_predictionio_tpu.data.storage import cpplog
 
-    PJRT client construction blocks forever while another process (or a
-    stale lease) holds a single-tenant chip. The bench retries init on
-    daemon threads — a stale lease usually expires within minutes — and
-    exits with a diagnosis if the window (PIO_BENCH_ACCEL_WAIT_S) runs
-    out, so the driver gets a failed bench, not a wedged one. (The CLI's
-    cli/main.py _ensure_accelerator is the single-attempt sibling: same
-    probe, but an interactive command should fail fast, not sit in a
-    retry loop.)"""
-    import threading
-
-    deadline = time.monotonic() + total_s
-    attempt = 0
-    while True:
-        attempt += 1
-        done = threading.Event()
-        err: list = []
-
-        def probe() -> None:
-            try:
-                import jax
-
-                jax.devices()
-            except Exception as e:
-                err.append(e)
-            finally:
-                done.set()
-
-        threading.Thread(target=probe, daemon=True).start()
-        if done.wait(min(120.0, max(deadline - time.monotonic(), 1.0))):
-            if not err:
-                return
-            # a raised error is permanent (missing driver, bad config) —
-            # only a *blocked* init suggests a lease that may expire
-            log(f"accelerator init failed: {err[0]}; aborting")
-            raise SystemExit(3)
-        log(f"accelerator init still blocked (attempt {attempt}) — "
-            "likely a stale chip lease; retrying")
-        if time.monotonic() >= deadline:
-            log(f"accelerator unavailable after {total_s:.0f}s; aborting")
-            raise SystemExit(3)
-        time.sleep(10)
+    cfg = StorageClientConfig(properties={"PATH": tmpdir})
+    client = cpplog.StorageClient(cfg)
+    events = cpplog.CppLogEvents(client, cfg, prefix="bench_")
+    t0 = time.perf_counter()
+    inter = events.scan_interactions(
+        app_id=1, entity_type="user", target_entity_type="item",
+        event_names=("rate",), value_prop="rating")
+    ingest_s = time.perf_counter() - t0
+    client.close()
+    return inter, ingest_s
 
 
-def run(platform_cpu: bool = False) -> None:
-    import tempfile
-
-    if platform_cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        _wait_for_accelerator(
-            float(os.environ.get("PIO_BENCH_ACCEL_WAIT_S", "1200")))
-    import jax
-    import jax.numpy as jnp
-
-    from incubator_predictionio_tpu.ops import als
-
-    rng = np.random.default_rng(7)
-    # --cpu forces the all-f32 schedule (BASELINE.md convention); report
-    # the schedule the run actually measures
-    eff_bf16 = 0 if platform_cpu else BF16_SWEEPS
-    log(f"dataset: {N_USERS}x{N_ITEMS}, nnz={NNZ}, rank={RANK}, "
-        f"sweeps={ITERATIONS} ({eff_bf16} bf16 + "
-        f"{ITERATIONS - eff_bf16} f32-polish), planted rank "
-        f"{PLANT_RANK} + noise {NOISE_SIGMA}")
-    users, items, ratings, heldout, truth = make_dataset(rng)
-
-    with tempfile.TemporaryDirectory(prefix="pio_bench_") as tmpdir:
-        # -- 1. SEED: native columnar bulk import --------------------------
-        events, client, seed_s = seed_store(tmpdir, users, items, ratings)
-        log(f"seed: {NNZ} events in {seed_s:.1f}s "
-            f"({NNZ / seed_s / 1e6:.2f}M ev/s)")
-
-        # -- 2. INGEST: columnar scan back out of the event store ----------
-        # the bulk import just materialized the training projection
-        # (data/storage/traincache.py), so this scan measures the real
-        # warm-train read path: projection load + empty-tail check. Set
-        # PIO_TRAINCACHE_MIN_NNZ above NNZ to measure the cold full scan.
-        t0 = time.perf_counter()
-        inter = events.scan_interactions(
-            app_id=1, entity_type="user", target_entity_type="item",
-            event_names=("rate",), value_prop="rating")
-        ingest_s = time.perf_counter() - t0
-        assert len(inter) == NNZ, len(inter)
-        log(f"ingest scan: {ingest_s:.1f}s ({NNZ / ingest_s / 1e6:.2f}M ev/s)")
-        client.close()
-
-    # -- 3. PREP: degree-bucketed padded rows ------------------------------
+def prep_buckets(inter):
+    """Degree-bucketed padded rows from the scanned projection."""
     from incubator_predictionio_tpu.ops.sparse import build_both_sides
 
-    # dims come from the scan's interned id tables (dense, first-seen order)
     n_users, n_items = len(inter.user_ids), len(inter.item_ids)
     t0 = time.perf_counter()
     (u_light, u_heavy), (i_light, i_heavy) = build_both_sides(
         inter.user_idx, inter.item_idx, inter.values, n_users, n_items)
     prep_s = time.perf_counter() - t0
-    log(f"prep (bucketed padded rows): {prep_s:.1f}s "
-        f"(users={n_users}, items={n_items})")
+    return (u_light, u_heavy), (i_light, i_heavy), n_users, n_items, prep_s
 
-    # -- 4. TRAIN: fused single-dispatch ALS -------------------------------
+
+def measure_train(buckets, bf16_sweeps, cache_probe=True):
+    """Compile-cold / warm / warm-persistent-cache timing of the fused
+    training run. → (state, dict of timing keys)."""
+    import atexit
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops import als
+
+    (u_light, u_heavy), (i_light, i_heavy), n_users, n_items = buckets
     u_tree, i_tree = als._buckets_tree(u_light), als._buckets_tree(i_light)
     u_hv, i_hv = als._heavy_tree(u_heavy), als._heavy_tree(i_heavy)
-
-    # the CPU baseline is all-f32 BY CONVENTION (BASELINE.md): bf16 is
-    # emulated (slower) on the host, so letting the bf16 schedule leak
-    # into a --cpu re-measure would inflate vs_baseline unfairly
-    bf16_sweeps = eff_bf16
 
     def train(state0):
         out = als._mixed_run(
@@ -369,9 +342,6 @@ def run(platform_cpu: bool = False) -> None:
     # call runs the full training once), so they are pure compile cost.
     from incubator_predictionio_tpu.utils import compile_cache
 
-    import atexit
-    import shutil
-
     xla_cache_dir = tempfile.mkdtemp(prefix="pio_bench_xla_")
     atexit.register(shutil.rmtree, xla_cache_dir, True)
     compile_cache.enable(xla_cache_dir)
@@ -383,9 +353,8 @@ def run(platform_cpu: bool = False) -> None:
     state = train(als.als_init(jax.random.key(0), n_users, n_items, RANK))
     train_s = time.perf_counter() - t0
     compile_s = max(first_call_s - train_s, 0.0)
-    cache_engaged = bool(os.listdir(xla_cache_dir))
     compile_warm_cache_s = None
-    if cache_engaged:
+    if cache_probe and os.listdir(xla_cache_dir):
         jax.clear_caches()  # drop in-memory executables; cache dir stays
         t0 = time.perf_counter()
         state = train(als.als_init(jax.random.key(0), n_users, n_items,
@@ -394,77 +363,409 @@ def run(platform_cpu: bool = False) -> None:
             max(time.perf_counter() - t0 - train_s, 0.0), 1)
         log(f"compile: cold={compile_s:.1f}s warm-persistent-cache="
             f"{compile_warm_cache_s}s (dir {xla_cache_dir})")
-    else:
+    elif cache_probe:
         # PIO_COMPILE_CACHE=off in the environment, or the cache was
         # rejected: do NOT publish a second cold compile as "warm"
         log("compile: persistent cache did not engage "
             "(PIO_COMPILE_CACHE=off or cache rejected); "
             f"cold={compile_s:.1f}s")
+    return state, {
+        "train_s": train_s,
+        "compile_s_cold": round(compile_s, 1),
+        "compile_s_warm_cache": compile_warm_cache_s,
+    }
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def run_cpu_baseline() -> None:
+    """`--cpu`: re-measure CPU_BASELINE_TRAIN_S on the host backend with
+    the pinned all-f32 schedule (BASELINE.md convention: bf16 is emulated
+    — slower — on the host, so letting the bf16 schedule leak into a
+    --cpu re-measure would inflate vs_baseline unfairly)."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    rng = np.random.default_rng(7)
+    log(f"dataset: {N_USERS}x{N_ITEMS}, nnz={NNZ}, rank={RANK}, "
+        f"sweeps={ITERATIONS} (all f32 — CPU convention)")
+    users, items, ratings, heldout, truth = make_dataset(rng)
+    with tempfile.TemporaryDirectory(prefix="pio_bench_") as tmpdir:
+        events, client, seed_s = seed_store(tmpdir, users, items, ratings)
+        log(f"seed: {NNZ} events in {seed_s:.1f}s")
+        client.close()
+        inter, ingest_s = scan_store(tmpdir)
+    assert len(inter) == NNZ, len(inter)
+    u_b, i_b, n_users, n_items, prep_s = prep_buckets(inter)
+    state, t = measure_train((u_b, i_b, n_users, n_items), 0,
+                             cache_probe=False)
+    log(f"CPU baseline measured: warm train = {t['train_s']:.1f}s "
+        "(update CPU_BASELINE_TRAIN_S)")
+    print(json.dumps({
+        "metric": "als_ml20m_train_wall_s_cpu",
+        "value": round(t["train_s"], 2),
+        "unit": "s",
+        "vs_baseline": 1.0,
+    }))
+
+
+def run_tpu_child(store_dir: str, out_path: str, claim_path: str) -> None:
+    """All accelerator work, in a disposable process. First act: dial the
+    chip (this is the call a stale lease blocks forever — the parent's
+    recycle window covers it). On success, touch the claim file so the
+    parent switches from 'dial watchdog' to 'run watchdog'."""
+    import jax
+
+    jax.devices()  # the dial
+    with open(claim_path, "w") as f:
+        f.write(str(os.getpid()))
+    log(f"tpu child: accelerator up ({jax.devices()[0]})")
+
+    rng = np.random.default_rng(7)
+    users, items, ratings, heldout, truth = make_dataset(rng)
+    del users, items, ratings  # events already seeded by the parent
+
+    inter, ingest_s = scan_store(store_dir)
+    assert len(inter) == NNZ, len(inter)
+    log(f"ingest scan: {ingest_s:.1f}s ({NNZ / ingest_s / 1e6:.2f}M ev/s)")
+    u_b, i_b, n_users, n_items, prep_s = prep_buckets(inter)
+    log(f"prep (bucketed padded rows): {prep_s:.1f}s "
+        f"(users={n_users}, items={n_items})")
+
+    from incubator_predictionio_tpu.ops import als  # noqa: F401
+
+    state, t = measure_train((u_b, i_b, n_users, n_items), BF16_SWEEPS)
+    train_s = t["train_s"]
     fit = als.rmse(state, inter.user_idx, inter.item_idx, inter.values)
-    flops = als_flops_per_run(bf16_sweeps)
+    flops = als_flops_per_run(BF16_SWEEPS)
     mfu = flops / train_s / PEAK_FLOPS_F32
     mfu_bf16 = flops / train_s / PEAK_FLOPS_BF16
     heldout_rmse, prec10 = quality_metrics(state, inter, heldout, truth, rng)
-    log(f"device={jax.devices()[0]} compile={compile_s:.1f}s "
+    log(f"device={jax.devices()[0]} compile={t['compile_s_cold']:.1f}s "
         f"warm={train_s:.2f}s rmse={fit:.3f} "
         f"heldout_rmse={heldout_rmse:.3f} (noise floor {NOISE_SIGMA}) "
         f"p@10={prec10:.3f} flops={flops:.3e} mfu={mfu:.3f}")
 
-    if platform_cpu:
-        log(f"CPU baseline measured: warm train = {train_s:.1f}s "
-            "(update CPU_BASELINE_TRAIN_S)")
-        print(json.dumps({
-            "metric": "als_ml20m_train_wall_s_cpu",
-            "value": round(train_s, 2),
-            "unit": "s",
-            "vs_baseline": 1.0,
-        }))
-        return
-
-    # -- 5. ATTENTION: driver-verified long-context kernel numbers ---------
     attn = bench_attention()
-
-    # -- 6. INGEST-HTTP: the real EventServer REST batch path --------------
-    ingest_http_eps = bench_ingest_http()
-
-    # -- 7. SERVE: the real PredictionServer (HTTP + micro-batcher) --------
     serve = bench_serving(state, inter)
 
-    print(json.dumps({
-        "metric": "als_ml20m_train_wall_s",
+    fragment = {
         "value": round(train_s, 3),
-        "unit": "s",
         "vs_baseline": round(CPU_BASELINE_TRAIN_S / train_s, 1),
         "train_rmse": round(float(fit), 3),
-        # planted-ground-truth quality (r3 verdict item 5): heldout pairs
-        # are fresh draws from the same rank-PLANT_RANK truth, so the
-        # recoverable floor is exactly the noise sigma; precision@10 is
-        # measured against the TRUE ranking, not observed interactions
         "heldout_rmse": round(heldout_rmse, 3),
-        "noise_floor": NOISE_SIGMA,
         "precision_at_10_vs_truth": round(prec10, 3),
         "mfu": round(mfu, 4),
         "mfu_bf16_peak": round(mfu_bf16, 4),
-        "compile_s_cold": round(compile_s, 1),
-        "compile_s_warm_cache": compile_warm_cache_s,
-        "seed_wall_s": round(seed_s, 1),
+        "compile_s_cold": t["compile_s_cold"],
+        "compile_s_warm_cache": t["compile_s_warm_cache"],
         "ingest_wall_s": round(ingest_s, 1),
         "prep_wall_s": round(prep_s, 1),
-        # the user-visible `pio train` wall: storage read + host prep +
-        # the fused device training run (VERDICT r3 item 2)
         "e2e_train_wall_s": round(ingest_s + prep_s + train_s, 1),
-        "ingest_http_eps": ingest_http_eps,
         **attn,
         "serve_p50_ms": serve["p50_ms"],
         "serve_p99_ms": serve["p99_ms"],
         "serve_qps": serve["qps_sequential"],
         "serve_qps_concurrent": serve["qps_concurrent"],
         "serve_max_batch": serve["max_batch"],
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(fragment, f)
+    os.replace(tmp, out_path)
+
+
+def supervise_tpu_child(store_dir: str, out_path: str,
+                        claim_event=None) -> bool:
+    """Spawn/recycle the TPU child until it lands a fragment or the
+    ACCEL_WAIT_S budget runs out. Returns True iff `out_path` exists
+    (checked on every exit path — an abandoned SIGTERM-ignoring child
+    that completes late still counts). Sets `claim_event` the moment any
+    child claims the chip so the parent can cancel fallback work.
+
+    A child that has not claimed the chip within its window is stopped
+    with SIGTERM (it is *waiting* on the lease, not holding it — killing
+    a waiter cannot wedge the chip; killing a holder can, which is why a
+    claimed child gets the long run window and is never force-killed
+    while healthy) and respawned with a doubled window: only a fresh
+    process gets a fresh PJRT dial."""
+    deadline = time.monotonic() + ACCEL_WAIT_S
+    window = 180.0
+    attempt = 0
+    fast_fails = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        claim_path = f"{out_path}.claim{attempt}"
+        t_spawn = time.monotonic()
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--tpu-child",
+             store_dir, out_path, claim_path],
+            stdout=sys.stderr, stderr=sys.stderr)
+        claimed = False
+        win_end = min(time.monotonic() + window, deadline)
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                if rc == 0 and os.path.exists(out_path):
+                    return True
+                log(f"tpu child attempt {attempt} exited rc={rc} "
+                    f"(claimed={claimed})")
+                if claimed and attempt >= 2:
+                    # the chip worked but the bench itself failed twice —
+                    # a real error, not a lease wait; stop burning budget
+                    return os.path.exists(out_path)
+                if not claimed and time.monotonic() - t_spawn < 30:
+                    # died before even reaching the dial (import error,
+                    # bad store path …) — respawning cannot fix that
+                    fast_fails += 1
+                    if fast_fails >= 3:
+                        log("tpu child crashes immediately; giving up on "
+                            "the accelerator path")
+                        return os.path.exists(out_path)
+                break
+            if not claimed and os.path.exists(claim_path):
+                claimed = True
+                if claim_event is not None:
+                    claim_event.set()
+                win_end = time.monotonic() + TPU_RUN_TIMEOUT_S
+                log(f"tpu child claimed the accelerator "
+                    f"(attempt {attempt}); run window "
+                    f"{TPU_RUN_TIMEOUT_S:.0f}s")
+            if time.monotonic() >= win_end:
+                log(f"tpu child attempt {attempt} "
+                    + ("overran its run window"
+                       if claimed else
+                       f"did not claim within {window:.0f}s — likely a "
+                       "stale chip lease; recycling for a fresh dial"))
+                proc.terminate()  # SIGTERM, never SIGKILL (lease safety)
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    log("tpu child ignored SIGTERM for 60s; abandoning it "
+                        "(NOT escalating to SIGKILL — that wedges the "
+                        "lease)")
+                break
+            time.sleep(2)
+        window = min(window * 2, 960.0)
+    log(f"accelerator never became available within {ACCEL_WAIT_S:.0f}s")
+    return os.path.exists(out_path)
+
+
+def run_degraded(inter, heldout, truth, rng, cancel=None):
+    """TPU never landed: measure train quality on the pinned all-f32 CPU
+    schedule at a reduced shape so the record still carries real RMSE /
+    ranking numbers (flagged degraded), then serve from those factors.
+
+    `cancel` (threading.Event) aborts between stages: when a TPU child
+    claims the chip mid-fallback, this thread stops at the next stage
+    boundary so parent CPU load stops perturbing the child's timed
+    sections as soon as possible (a jitted stage in flight can't be
+    interrupted)."""
+    n_sub = min(DEGRADED_NNZ, len(inter.user_idx))
+    log(f"DEGRADED mode: CPU all-f32 schedule on a {n_sub}-event "
+        f"subsample (full-shape host walls already measured)")
+    sub = np.random.default_rng(11).choice(
+        len(inter.user_idx), n_sub, replace=False)
+    sub.sort()
+
+    class _Sub:
+        user_idx = inter.user_idx[sub]
+        item_idx = inter.item_idx[sub]
+        values = inter.values[sub]
+        user_ids = inter.user_ids
+        item_ids = inter.item_ids
+
+    from incubator_predictionio_tpu.ops import als
+
+    def cancelled() -> bool:
+        if cancel is not None and cancel.is_set():
+            log("degraded fallback cancelled — a TPU child claimed the "
+                "chip")
+            return True
+        return False
+
+    if cancelled():
+        return None
+    u_b, i_b, n_users, n_items, prep_s = prep_buckets(_Sub)
+    if cancelled():
+        return None
+    state, t = measure_train((u_b, i_b, n_users, n_items), 0,
+                             cache_probe=False)
+    fit = als.rmse(state, _Sub.user_idx, _Sub.item_idx, _Sub.values)
+    if cancelled():
+        return None
+    heldout_rmse, prec10 = quality_metrics(state, _Sub, heldout, truth, rng)
+    log(f"degraded train: warm={t['train_s']:.1f}s fit={fit:.3f} "
+        f"heldout={heldout_rmse:.3f} p@10={prec10:.3f}")
+    if cancelled():
+        return None
+    serve = bench_serving(state, _Sub)
+    # vs_baseline against the baseline scaled to the degraded nnz (the
+    # train wall is ~linear in nnz at fixed shape) — an honest ~1.0, not
+    # a fake speedup
+    scaled_base = CPU_BASELINE_TRAIN_S * n_sub / NNZ
+    return {
+        "value": round(t["train_s"], 3),
+        "vs_baseline": round(scaled_base / t["train_s"], 2),
+        "train_rmse": round(float(fit), 3),
+        "heldout_rmse": round(heldout_rmse, 3),
+        "precision_at_10_vs_truth": round(prec10, 3),
+        "degraded_nnz": n_sub,
+        "serve_p50_ms": serve["p50_ms"],
+        "serve_p99_ms": serve["p99_ms"],
+        "serve_qps": serve["qps_sequential"],
+        "serve_qps_concurrent": serve["qps_concurrent"],
+        "serve_max_batch": serve["max_batch"],
+    }
+
+
+def run_orchestrator() -> None:
+    """Default entry: host-side stages in THIS process (jax pinned to
+    CPU — the parent never dials the chip), TPU stages in a supervised
+    child. Always prints one parsed JSON record; exit 0 even in degraded
+    mode (a degraded record is a result, not an error)."""
+    import atexit
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    rng = np.random.default_rng(7)
+    log(f"dataset: {N_USERS}x{N_ITEMS}, nnz={NNZ}, rank={RANK}, "
+        f"sweeps={ITERATIONS} ({BF16_SWEEPS} bf16 + "
+        f"{ITERATIONS - BF16_SWEEPS} f32-polish), planted rank "
+        f"{PLANT_RANK} + noise {NOISE_SIGMA}")
+    users, items, ratings, heldout, truth = make_dataset(rng)
+
+    store_dir = tempfile.mkdtemp(prefix="pio_bench_store_")
+    atexit.register(shutil.rmtree, store_dir, True)
+    frag_path = os.path.join(store_dir, "tpu_fragment.json")
+
+    # -- 1. SEED (host) ----------------------------------------------------
+    events, client, seed_s = seed_store(store_dir, users, items, ratings)
+    client.close()
+    log(f"seed: {NNZ} events in {seed_s:.1f}s "
+        f"({NNZ / seed_s / 1e6:.2f}M ev/s)")
+
+    # -- 2+3. INGEST + PREP (host, parent's own copy for the degraded
+    #         record; the child measures its own on the TPU path) ----------
+    inter, ingest_s = scan_store(store_dir)
+    assert len(inter) == NNZ, len(inter)
+    log(f"ingest scan: {ingest_s:.1f}s ({NNZ / ingest_s / 1e6:.2f}M ev/s)")
+    prep_probe = prep_buckets(inter)
+    prep_s = prep_probe[4]
+    del prep_probe
+    log(f"prep (bucketed padded rows): {prep_s:.1f}s")
+
+    # -- 6. INGEST-HTTP (host; needs no accelerator) -----------------------
+    ingest_http_eps = bench_ingest_http()
+
+    # -- 4/5/7. TRAIN + ATTENTION + SERVE: supervised TPU child ------------
+    # (started after the host stages so parent CPU load never perturbs the
+    # child's timed sections — on a 1-core driver box that skew is real).
+    # If no child claims the chip within DEGRADED_START_S, the parent
+    # starts computing the degraded record in parallel with the remaining
+    # wait; the overlap bounds the worst-case bench wall at roughly
+    # host stages + ACCEL_WAIT_S instead of their sum plus the fallback.
+    import threading
+
+    sup_done = threading.Event()
+    claim_seen = threading.Event()
+    sup_ok: list = []
+
+    def _supervise() -> None:
+        try:
+            sup_ok.append(
+                supervise_tpu_child(store_dir, frag_path, claim_seen))
+        finally:
+            sup_done.set()
+
+    threading.Thread(target=_supervise, daemon=True).start()
+
+    degraded_result: list = []
+    t_deg = None
+    if not sup_done.wait(DEGRADED_START_S) and not claim_seen.is_set():
+        log(f"no accelerator claim after {DEGRADED_START_S:.0f}s — "
+            "computing the degraded record in parallel with the wait")
+        t_deg = threading.Thread(
+            target=lambda: degraded_result.append(
+                run_degraded(inter, heldout, truth, rng,
+                             cancel=claim_seen)),
+            daemon=True)
+        t_deg.start()
+    sup_done.wait()
+    child_ok = bool(sup_ok and sup_ok[0])
+    if not child_ok and t_deg is not None:
+        # never start a second run_degraded while the thread lives — the
+        # two would race on the process-global Storage registry; wait it
+        # out instead (it is bounded: jitted stages finish, servers stop)
+        t_deg.join(timeout=1800)
+        if t_deg.is_alive():
+            log("degraded fallback still running after 1800s grace — "
+                "emitting the record without train-quality keys")
+    # stable key set across modes: every key a prior round's record had is
+    # present (None when the mode can't measure it), so round-over-round
+    # comparisons never hit a missing key on a degraded round
+    record = {
+        "metric": "als_ml20m_train_wall_s",
+        "value": None,
+        "unit": "s",
+        "vs_baseline": None,
+        "degraded": False,
+        "train_rmse": None,
+        "heldout_rmse": None,
+        "noise_floor": NOISE_SIGMA,
+        "precision_at_10_vs_truth": None,
+        "mfu": None,
+        "mfu_bf16_peak": None,
+        "compile_s_cold": None,
+        "compile_s_warm_cache": None,
+        "seed_wall_s": round(seed_s, 1),
+        "ingest_wall_s": round(ingest_s, 1),
+        "prep_wall_s": round(prep_s, 1),
+        "e2e_train_wall_s": None,
+        "ingest_http_eps": ingest_http_eps,
+        "serve_p50_ms": None,
+        "serve_p99_ms": None,
+        "serve_qps": None,
+        "serve_qps_concurrent": None,
+        "serve_max_batch": None,
         "nnz": NNZ,
         "rank": RANK,
         "sweeps": ITERATIONS,
         "bf16_sweeps": BF16_SWEEPS,
-    }))
+    }
+    if child_ok and os.path.exists(frag_path):
+        with open(frag_path) as f:
+            record.update(json.load(f))
+        record["e2e_train_wall_s"] = round(
+            record["ingest_wall_s"] + record["prep_wall_s"]
+            + record["value"], 1)
+    else:
+        record["degraded"] = True
+        record["bf16_sweeps"] = 0  # degraded runs the all-f32 CPU schedule
+        if degraded_result and degraded_result[0]:
+            deg = degraded_result[0]
+        elif t_deg is not None and t_deg.is_alive():
+            deg = None  # fallback thread hung — never race a second run
+        else:
+            # no fallback ran, or it was cancelled by a claim from a child
+            # that then failed — the thread is dead, safe to run fresh
+            deg = run_degraded(inter, heldout, truth, rng)
+        if deg:
+            record.update(deg)
+            # full-shape read/prep walls + degraded-shape train wall: the
+            # degraded flag marks the mixed provenance
+            record["e2e_train_wall_s"] = round(
+                record["ingest_wall_s"] + record["prep_wall_s"]
+                + record["value"], 1)
+    print(json.dumps(record))
 
 
 def bench_attention():
@@ -808,4 +1109,10 @@ def bench_serving(state, inter):
 
 
 if __name__ == "__main__":
-    run(platform_cpu="--cpu" in sys.argv)
+    if "--cpu" in sys.argv:
+        run_cpu_baseline()
+    elif "--tpu-child" in sys.argv:
+        i = sys.argv.index("--tpu-child")
+        run_tpu_child(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3])
+    else:
+        run_orchestrator()

@@ -530,69 +530,18 @@ def _as_uniform_interactions(events):
     times_ms) when the columnar bulk import is observably equivalent to
     per-event inserts, else None.
 
-    Equivalence requires: no explicit event ids (both paths would generate
-    them), no tags/prId, a target on every event, one shared numeric
-    property key whose values are float32-exact (the columnar store is
-    f32; 4.1 would read back 4.0999999), UTC event times (re-rendering
-    emits UTC strings), and identical event/entity/target types
-    throughout. Export round-trips carry eventIds (upsert semantics!) and
-    therefore never take this path; explicit creationTime is screened by
-    the caller (the parsed Event cannot distinguish explicit from
-    defaulted)."""
+    The equivalence conditions live in ``base.uniform_interactions`` —
+    shared with the cpplog REST batch gate so the two cannot drift.
+    Export round-trips carry eventIds (upsert semantics!) and therefore
+    never take this path; explicit creationTime is screened by the caller
+    (the parsed Event cannot distinguish explicit from defaulted)."""
     if len(events) < _FAST_IMPORT_MIN:
         return None  # interning overhead beats the win on small files
-    import datetime as _dt
-
-    import numpy as np
-
     from incubator_predictionio_tpu.data.storage.base import (
-        IdTable,
-        Interactions,
+        uniform_interactions,
     )
-    from incubator_predictionio_tpu.utils.times import to_millis
 
-    first = events[0]
-    name, etype, tetype = first.event, first.entity_type, \
-        first.target_entity_type
-    if name.startswith("$") or not tetype:
-        return None
-    keys = list(first.properties)
-    if len(keys) != 1:
-        return None
-    vprop = keys[0]
-    users: list = []
-    items: list = []
-    uidx = np.empty(len(events), np.int32)
-    iidx = np.empty(len(events), np.int32)
-    vals = np.empty(len(events), np.float32)
-    times = np.empty(len(events), np.int64)
-    u_intern: dict = {}
-    i_intern: dict = {}
-    for k, e in enumerate(events):
-        if (e.event != name or e.entity_type != etype
-                or e.target_entity_type != tetype
-                or not e.target_entity_id or e.event_id or e.tags
-                or e.pr_id or list(e.properties) != keys):
-            return None
-        v = e.properties.opt(vprop)  # .get raises on an explicit null
-        if isinstance(v, bool) or not isinstance(v, (int, float)):
-            return None
-        if float(np.float32(v)) != float(v):
-            return None  # not f32-exact: the columnar store would alter it
-        if e.event_time.utcoffset() != _dt.timedelta(0):
-            return None  # non-UTC offset: re-rendered strings would differ
-        u = u_intern.setdefault(e.entity_id, len(u_intern))
-        if u == len(users):
-            users.append(e.entity_id)
-        it = i_intern.setdefault(e.target_entity_id, len(i_intern))
-        if it == len(items):
-            items.append(e.target_entity_id)
-        uidx[k], iidx[k], vals[k] = u, it, v
-        times[k] = to_millis(e.event_time)
-    inter = Interactions(
-        user_idx=uidx, item_idx=iidx, values=vals,
-        user_ids=IdTable.from_list(users), item_ids=IdTable.from_list(items))
-    return inter, etype, tetype, name, vprop, times
+    return uniform_interactions(events)
 
 
 def import_events(app_name: str, input_path: str,

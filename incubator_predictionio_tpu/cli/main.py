@@ -218,8 +218,18 @@ def _ensure_accelerator(timeout_s: float) -> None:
     train` that sits silent forever reads as a hang, not a diagnosis. The
     probe runs device init on a daemon thread and gives up after
     ``timeout_s`` (PIO_ACCEL_INIT_TIMEOUT_S, default 180 — first contact
-    through a tunnel can legitimately take tens of seconds). The blocked
-    thread cannot be cancelled, but the process is about to exit anyway."""
+    through a tunnel can legitimately take tens of seconds).
+
+    Lease-safety contract for the timeout path: the blocked daemon thread
+    cannot be cancelled and may sit mid-PJRT-construction holding a
+    partial chip claim, so the CommandError raised here MUST propagate to
+    a normal interpreter exit — never ``os._exit`` and never SIGKILL from
+    a wrapper — so the process teardown closes the client's sockets and
+    the relay sees a clean disconnect. An abrupt kill at this point is
+    exactly what wedges the single-tenant lease for the next process
+    (observed: hours-long wedge). A blocked probe is a *waiter*, not a
+    holder; letting the process exit normally releases nothing it owns
+    and cannot wedge the chip."""
     import threading
 
     done = threading.Event()

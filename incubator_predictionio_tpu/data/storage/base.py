@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import logging
 import re
 import secrets
 from datetime import datetime
@@ -27,6 +28,8 @@ from typing import Any, Dict, Iterator, Optional, Sequence
 
 from incubator_predictionio_tpu.data.datamap import DataMap, PropertyMap
 from incubator_predictionio_tpu.data.event import Event
+
+logger = logging.getLogger(__name__)
 
 #: Sentinel distinguishing "no filter" from "filter for absent" on target
 #: entity queries (the reference encodes this as Option[Option[String]],
@@ -251,6 +254,77 @@ class Interactions:
         return int(self.user_idx.shape[0])
 
 
+def uniform_interactions(events: Sequence[Event]):
+    """Events → ``(Interactions, etype, tetype, name, vprop, times_ms)``
+    when the whole batch can take the columnar import with observable
+    equivalence to per-event inserts, else ``None``.
+
+    THE single fast-path gate — both the CLI bulk import
+    (cli/commands.py) and the cpplog REST batch route call this, so the
+    equivalence conditions can never drift apart again (a missing UTC
+    screen in one copy once silently dropped timezones on read-back).
+
+    Equivalence requires: no explicit event ids (both paths would
+    generate them), no tags/prId, a target on every event, one shared
+    numeric property key whose values are float32-exact (the columnar
+    store is f32; 4.1 would read back 4.0999999), UTC event times
+    (compact records store epoch millis and re-render as UTC strings),
+    identical event/entity/target types throughout, and a non-reserved
+    event name. Callers owe their own screens for anything invisible on
+    a parsed Event (the CLI screens raw docs for explicit creationTime)
+    and for event validity (this gate assumes validated events)."""
+    import datetime as _dt
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.utils.times import to_millis
+
+    first = events[0]
+    name, etype, tetype = first.event, first.entity_type, \
+        first.target_entity_type
+    if name.startswith("$") or not tetype:
+        return None
+    keys = list(first.properties)
+    if len(keys) != 1:
+        return None
+    vprop = keys[0]
+    n = len(events)
+    users: list = []
+    items: list = []
+    uidx = np.empty(n, np.int32)
+    iidx = np.empty(n, np.int32)
+    vals = np.empty(n, np.float32)
+    times = np.empty(n, np.int64)
+    u_intern: dict = {}
+    i_intern: dict = {}
+    for k, e in enumerate(events):
+        if (e.event != name or e.entity_type != etype
+                or e.target_entity_type != tetype
+                or not e.target_entity_id or e.event_id or e.tags
+                or e.pr_id or list(e.properties) != keys):
+            return None
+        v = e.properties.opt(vprop)  # .get raises on an explicit null
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if float(np.float32(v)) != float(v):
+            return None  # not f32-exact: the columnar store would alter it
+        if e.event_time.utcoffset() != _dt.timedelta(0):
+            return None  # non-UTC offset: re-rendered strings would differ
+        u = u_intern.setdefault(e.entity_id, len(u_intern))
+        if u == len(users):
+            users.append(e.entity_id)
+        it = i_intern.setdefault(e.target_entity_id, len(i_intern))
+        if it == len(items):
+            items.append(e.target_entity_id)
+        uidx[k], iidx[k], vals[k] = u, it, v
+        times[k] = to_millis(e.event_time)
+    inter = Interactions(
+        user_idx=uidx, item_idx=iidx, values=vals,
+        user_ids=IdTable.from_list(users),
+        item_ids=IdTable.from_list(items))
+    return inter, etype, tetype, name, vprop, times
+
+
 class Events(abc.ABC):
     """Event CRUD + query DAO (LEvents.scala:40-492)."""
 
@@ -299,7 +373,15 @@ class Events(abc.ABC):
                 try:
                     self.delete(eid, app_id, channel_id)
                 except Exception:  # pragma: no cover - best effort
-                    pass
+                    # a failed rollback-delete leaves the auto-id event in
+                    # the store, so a caller's per-event retry CAN
+                    # duplicate it — log loud enough for an operator to
+                    # reconcile (the EventServer batch route documents the
+                    # same window)
+                    logger.warning(
+                        "rollback delete of auto-id event %s failed after "
+                        "a mid-batch error; a per-event retry may "
+                        "duplicate it", eid, exc_info=True)
             raise
         return [eid for eid, _ in done]
 

@@ -191,57 +191,15 @@ class CppLogEvents(base.Events):
         """events → (Interactions, etype, tetype, name, vprop, times_ms)
         when the whole batch can take the columnar import, else None.
 
-        Mirrors the CLI import gate (cli/commands.py): no explicit ids, no
-        tags/prId, one shared float32-exact numeric property, a target on
-        every event, identical types, non-$ name. NOTE the one observable
-        delta, documented in docs/data-collection.md: columnar records
-        report creationTime == eventTime (the compact sidecar stores one
-        timestamp)."""
-        import numpy as np
-
-        first = events[0]
-        name, etype, tetype = first.event, first.entity_type, \
-            first.target_entity_type
-        if name.startswith("$") or not tetype:
-            return None
-        props = list(first.properties)
-        if len(props) != 1:
-            return None
-        vprop = props[0]
-        n = len(events)
-        uidx = np.empty(n, np.int32)
-        iidx = np.empty(n, np.int32)
-        vals = np.empty(n, np.float32)
-        times = np.empty(n, np.int64)
-        u_intern: dict = {}
-        i_intern: dict = {}
-        users: list = []
-        items: list = []
-        for k, e in enumerate(events):
+        The equivalence conditions live in ONE place —
+        ``base.uniform_interactions`` — shared with the CLI import gate
+        (cli/commands.py), so the two paths cannot drift. NOTE the one
+        observable delta, documented in docs/data-collection.md: columnar
+        records report creationTime == eventTime (the compact sidecar
+        stores one timestamp)."""
+        for e in events:
             validate_event(e)
-            if (e.event != name or e.entity_type != etype
-                    or e.target_entity_type != tetype
-                    or not e.target_entity_id or e.event_id or e.tags
-                    or e.pr_id or list(e.properties) != props):
-                return None
-            v = e.properties.opt(vprop)
-            if isinstance(v, bool) or not isinstance(v, (int, float)):
-                return None
-            if float(np.float32(v)) != float(v):
-                return None
-            u = u_intern.setdefault(e.entity_id, len(u_intern))
-            if u == len(users):
-                users.append(e.entity_id)
-            it = i_intern.setdefault(e.target_entity_id, len(i_intern))
-            if it == len(items):
-                items.append(e.target_entity_id)
-            uidx[k], iidx[k], vals[k] = u, it, v
-            times[k] = to_millis(e.event_time)
-        inter = base.Interactions(
-            user_idx=uidx, item_idx=iidx, values=vals,
-            user_ids=base.IdTable.from_list(users),
-            item_ids=base.IdTable.from_list(items))
-        return inter, etype, tetype, name, vprop, times
+        return base.uniform_interactions(events)
 
     def insert_batch(self, events: Sequence[Event], app_id: int,
                      channel_id: Optional[int] = None) -> list:

@@ -293,6 +293,16 @@ class EventServer:
                         [e for _, e, _ in pending], auth.app_id,
                         auth.channel_id)
                 except Exception:
+                    # Best-effort recovery window (documented): the failed
+                    # bulk attempt rolls back its auto-id inserts, but a
+                    # rollback-delete that itself fails (logged at warning
+                    # by base.Events.insert_batch) leaves an event the
+                    # per-event retry will DUPLICATE; and explicit-id
+                    # events that landed before the failure are re-upserted
+                    # here, which moves them to the end of their
+                    # timestamp tie-break group relative to a clean single
+                    # attempt. Operators reconciling after a 500-mixed
+                    # batch response should check for both.
                     logger.exception(
                         "bulk insert failed; retrying per event")
             if ids is not None:

@@ -42,15 +42,21 @@ class Tracer:
 
     # -- activation --------------------------------------------------------
     @contextlib.contextmanager
-    def activate(self) -> Iterator["Tracer"]:
+    def activate(self, profile: bool = True) -> Iterator["Tracer"]:
         """Install as the ambient tracer; starts/stops the jax profiler
-        when ``profile_dir`` is set."""
+        when ``profile_dir`` is set. Pass ``profile=False`` when the
+        traced region is a re-activation around already-computed work
+        (the pod training path activates twice) — a second profiler
+        start would drop a spurious near-empty trace next to the real
+        one in ``profile_dir``."""
         token = _current.set(self)
-        self._start_profiler()
+        if profile:
+            self._start_profiler()
         try:
             yield self
         finally:
-            self._stop_profiler()
+            if profile:
+                self._stop_profiler()
             _current.reset(token)
 
     def _start_profiler(self) -> None:

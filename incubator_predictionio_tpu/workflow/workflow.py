@@ -157,7 +157,10 @@ class CoreWorkflow:
                 dataclasses.replace(instance,
                                     status=CoreWorkflow.TRAIN_STATUS_TRAINING)
             )
-            with tracer.activate():
+            # on the pod path training already ran (and profiled) inside
+            # the first tracer.activate(); don't start the profiler again
+            # over the cached models — it would emit an empty extra trace
+            with tracer.activate(profile=pre_trained is _UNSET):
                 models = (pre_trained if pre_trained is not _UNSET
                           else engine.train(ctx, engine_params, params))
                 algo_params = [

@@ -459,6 +459,33 @@ class TestUniformBatchFastPath:
         assert d.get(ids[3], 1) is None
         c.close()
 
+    def test_non_utc_batches_take_the_generic_path(self, tmp_path):
+        """Compact columnar records store only epoch millis and re-render
+        eventTime as UTC, so a uniform batch carrying a non-UTC offset
+        (e.g. +09:00) must fall back to the generic path — same screen as
+        the CLI import gate — or the timezone silently vanishes on
+        read-back (other backends preserve tzinfo)."""
+        import dataclasses
+        from datetime import timezone as _tz
+
+        c = _client(tmp_path)
+        d = _events(c)
+        d.init(1)
+        jst = _tz(timedelta(hours=9))
+        batch = [
+            dataclasses.replace(e, event_time=e.event_time.astimezone(jst))
+            for e in self._batch(12)
+        ]
+        ids = d.insert_batch(batch, 1)
+        assert len(ids) == 12
+        for src, eid in zip(batch, ids):
+            got = d.get(eid, 1)
+            assert got is not None
+            assert got.event_time == src.event_time
+            # the offset itself survives, not just the instant
+            assert got.event_time.utcoffset() == timedelta(hours=9)
+        c.close()
+
     def test_non_uniform_batches_take_the_generic_path(self, tmp_path):
         c = _client(tmp_path)
         d = _events(c)

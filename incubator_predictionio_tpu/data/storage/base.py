@@ -279,6 +279,8 @@ def uniform_interactions(events: Sequence[Event]):
 
     from incubator_predictionio_tpu.utils.times import to_millis
 
+    if not events:
+        return None
     first = events[0]
     name, etype, tetype = first.event, first.entity_type, \
         first.target_entity_type

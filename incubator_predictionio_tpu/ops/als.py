@@ -131,6 +131,28 @@ def _gram_rhs_nnz(
 #: ML-20M-shape workloads, and the solve cost is linear in the budget)
 _SOLVER = os.environ.get("PIO_ALS_SOLVER", "cg")
 _CG_ITERS = int(os.environ.get("PIO_ALS_CG_ITERS", "16"))
+#: fused Pallas bucket solve (ops/pallas_kernels.als_solve_cg_pallas):
+#: "auto" probes Mosaic once per process and uses the kernel for explicit
+#: CG buckets; "on" forces it (tests use interpret mode); "off" pins the
+#: XLA path. The kernel removes the (1+iters)·rows·K² Gram HBM stream —
+#: the dominant bf16-sweep traffic at ML-20M shape — by keeping each
+#: row's Gram and the whole CG solve in VMEM.
+_ALS_KERNEL = os.environ.get("PIO_ALS_KERNEL", "auto")
+
+
+def _kernel_enabled(implicit: bool) -> bool:
+    """Resolve the bucket-kernel selector OUTSIDE any jit trace (the
+    Mosaic probe compiles+runs a real kernel). Explicit CG only: the
+    implicit path needs the batch-shared YᵗY term and stays on XLA."""
+    if implicit or _SOLVER != "cg" or _ALS_KERNEL == "off":
+        return False
+    if _ALS_KERNEL == "on":
+        return True
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_kernel_available,
+    )
+
+    return als_kernel_available()
 #: CG budget for the bf16 early sweeps of the mixed schedule. Each CG
 #: iteration re-reads the whole [rows, K, K] f32 Gram batch (~9 GB at
 #: ML-20M scale on the user side) — the dominant HBM stream once gathers
@@ -282,6 +304,30 @@ def _solve_bucket(
                       cg_iters=cg_iters, cg_matvec_dtype=compute_dtype)
 
 
+def _solve_bucket_kernel(
+    gsrc: jax.Array,           # [M, K] gather source, ALREADY compute-dtype
+    cols: jax.Array,
+    vals: jax.Array,
+    mask: jax.Array,
+    l2: float,
+    reg_nnz: bool,
+    cg_iters: int,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Explicit-CG bucket solve via the fused Pallas kernel.
+
+    Same contract as :func:`_solve_bucket` (CG leg): λ(+λ·nnz) ridge,
+    empty rows → 0. The [B, K, K] Gram batch lives only in VMEM — see
+    ops/pallas_kernels.als_solve_cg_pallas."""
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_solve_cg_pallas,
+    )
+
+    return als_solve_cg_pallas(
+        gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz, iters=cg_iters,
+        interpret=interpret)
+
+
 #: f32-element budget for one bucket chunk's gather intermediate
 #: ([chunk, D, K]); 2^24 elements = 64 MB. Buckets whose full gather would
 #: exceed this are solved in row chunks under lax.map, keeping peak HBM for
@@ -292,13 +338,17 @@ def _solve_bucket(
 _CHUNK_ELEMS = int(os.environ.get("PIO_ALS_CHUNK_ELEMS", str(1 << 24)))
 
 
-def _solve_bucket_chunked(solver_fn, cols, vals, mask, rank: int):
+def _solve_bucket_chunked(solver_fn, cols, vals, mask, rank: int,
+                          row_elems: Optional[int] = None):
     """Apply ``solver_fn((cols, vals, mask)) -> sol`` in bounded row chunks.
 
     Zero-mask padding rows solve to 0 and are sliced off, so chunk padding
-    never leaks into the scatter."""
+    never leaks into the scatter. ``row_elems`` overrides the per-row
+    gather footprint used for chunk sizing (the Pallas path pads D and K
+    to lane multiples, so its materialized gather is larger than D·rank
+    for narrow buckets)."""
     B, D = cols.shape
-    chunk = max(8, _CHUNK_ELEMS // max(D * rank, 1))
+    chunk = max(8, _CHUNK_ELEMS // max(row_elems or (D * rank), 1))
     if B <= chunk:
         return solver_fn((cols, vals, mask))
     n = -(-B // chunk)
@@ -373,12 +423,15 @@ def _sweep_side(
     precision: Any,
     implicit: bool,
     cg_iters: int = _CG_ITERS,
+    use_kernel: bool = False,
 ) -> jax.Array:
     """One half-sweep (traced): solve every bucket + split rows, scatter.
 
     THE single sweep implementation — the fused trainer, als_sweep and
     als_sweep_implicit all trace through here, so the paths cannot
-    diverge."""
+    diverge. ``use_kernel`` (resolved by the caller, outside the trace)
+    routes explicit-CG buckets through the fused Pallas solve; the heavy
+    split-row path and implicit mode always use the XLA assembly."""
     rank = other_factors.shape[1]
     out = jnp.zeros((n_rows, rank), jnp.float32)
     yty = _gram_all(other_factors, precision) if implicit else None
@@ -390,11 +443,23 @@ def _sweep_side(
     if not implicit and other_factors.dtype != compute_dtype:
         gsrc = other_factors.astype(compute_dtype)
     for row_ids, cols, vals, mask in tree:
+        row_elems = None
         if implicit:
             def solver(t, _yty=yty):
                 return _solve_bucket_implicit(
                     other_factors, _yty, t[0], t[1], t[2], l2, alpha,
                     precision=precision, cg_iters=cg_iters)
+        elif use_kernel:
+            # chunk by the PADDED gather footprint (the kernel pads D and
+            # K to lane multiples — min 128 each)
+            dp = max(128, -(-cols.shape[1] // 128) * 128)
+            kp = -(-rank // 128) * 128
+            row_elems = dp * kp
+
+            def solver(t):
+                return _solve_bucket_kernel(
+                    gsrc, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
+                    cg_iters=cg_iters)
         else:
             def solver(t):
                 return _solve_bucket(
@@ -404,7 +469,8 @@ def _sweep_side(
         # large buckets solve in bounded row chunks (lax.map) so the
         # [B, D, K] gather / [B, K, K] gram temps never exceed the chunk
         # budget — the ML-20M-scale HBM requirement
-        sol = _solve_bucket_chunked(solver, cols, vals, mask, rank)
+        sol = _solve_bucket_chunked(solver, cols, vals, mask, rank,
+                                    row_elems=row_elems)
         out = _scatter_rows_impl(out, row_ids, sol)
     if heavy is not None:
         h_ids, h_sol = _solve_heavy(
@@ -417,14 +483,14 @@ def _sweep_side(
 @functools.partial(
     jax.jit,
     static_argnames=("n_rows", "reg_nnz", "compute_dtype", "precision",
-                     "implicit", "cg_iters"),
+                     "implicit", "cg_iters", "use_kernel"),
 )
 def _sweep_side_jit(n_rows, other_factors, tree, heavy, l2, alpha, reg_nnz,
                     compute_dtype, precision, implicit,
-                    cg_iters=_CG_ITERS):
+                    cg_iters=_CG_ITERS, use_kernel=False):
     return _sweep_side(n_rows, other_factors, tree, heavy, l2, alpha,
                        reg_nnz, compute_dtype, precision, implicit,
-                       cg_iters=cg_iters)
+                       cg_iters=cg_iters, use_kernel=use_kernel)
 
 
 def _update_side(
@@ -438,7 +504,8 @@ def _update_side(
 ) -> jax.Array:
     return _sweep_side_jit(
         n_rows, other_factors, _buckets_tree(buckets), None, l2, 0.0,
-        reg_nnz, compute_dtype, precision, implicit=False)
+        reg_nnz, compute_dtype, precision, implicit=False,
+        use_kernel=_kernel_enabled(False))
 
 
 def assert_no_split(buckets: Sequence[PaddedRows], side: str = "row") -> None:
@@ -689,6 +756,9 @@ def als_train_sharded(
             state, u_tree, i_tree, l2, iterations, bf16_sweeps,
             reg_nnz, compute_dtype, precision,
             user_heavy=u_hv, item_heavy=i_hv,
+            # pallas_call does not auto-partition under GSPMD — the
+            # sharded program keeps the XLA bucket assembly
+            use_kernel=False,
         )
     return ALSState(user_factors=out.user_factors[:n_users],
                     item_factors=out.item_factors[:n_items])
@@ -785,7 +855,7 @@ def _solve_heavy(
 @functools.partial(
     jax.jit,
     static_argnames=("iterations", "reg_nnz", "compute_dtype", "precision",
-                     "implicit", "cg_iters"),
+                     "implicit", "cg_iters", "use_kernel"),
     donate_argnames=("state",),
 )
 def _als_run_fused(
@@ -802,16 +872,17 @@ def _als_run_fused(
     user_heavy=None,
     item_heavy=None,
     cg_iters: int = _CG_ITERS,
+    use_kernel: bool = False,
 ) -> ALSState:
     def body(_, st):
         new_users = _sweep_side(
             st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
-            cg_iters=cg_iters)
+            cg_iters=cg_iters, use_kernel=use_kernel)
         new_items = _sweep_side(
             st.item_factors.shape[0], new_users, item_tree, item_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
-            cg_iters=cg_iters)
+            cg_iters=cg_iters, use_kernel=use_kernel)
         return ALSState(user_factors=new_users, item_factors=new_items)
 
     return jax.lax.fori_loop(0, iterations, body, state)
@@ -829,6 +900,7 @@ def _mixed_run(
     precision: Any,
     user_heavy,
     item_heavy,
+    use_kernel: Optional[bool] = None,
 ) -> ALSState:
     """Mixed-precision schedule: ``bf16_sweeps`` early sweeps with bf16
     gathers + single-pass MXU matmuls (DEFAULT precision), then the
@@ -842,18 +914,26 @@ def _mixed_run(
     polish sweeps land on the same fixed point (validated by the planted
     low-rank recovery test, tests/test_als.py)."""
     lo = min(max(bf16_sweeps, 0), iterations)
+    # resolve the Pallas selector HERE (python level, outside any trace —
+    # the Mosaic probe runs a real kernel). Callers pass False explicitly
+    # on the mesh-sharded path: pallas_call does not auto-partition under
+    # GSPMD, so the sharded program keeps the XLA assembly.
+    if use_kernel is None:
+        use_kernel = _kernel_enabled(False)
     if lo:
         state = _als_run_fused(
             state, u_tree, i_tree, l2, 0.0, lo, reg_nnz,
             jnp.bfloat16, jax.lax.Precision.DEFAULT, implicit=False,
             user_heavy=user_heavy, item_heavy=item_heavy,
             cg_iters=min(_CG_ITERS_BF16, _CG_ITERS),
+            use_kernel=use_kernel,
         )
     if iterations - lo:
         state = _als_run_fused(
             state, u_tree, i_tree, l2, 0.0, iterations - lo, reg_nnz,
             compute_dtype, precision, implicit=False,
             user_heavy=user_heavy, item_heavy=item_heavy,
+            use_kernel=use_kernel,
         )
     return state
 

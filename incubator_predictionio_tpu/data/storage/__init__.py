@@ -62,6 +62,8 @@ _BACKENDS: Dict[str, str] = {
     # network client for a shared StorageServer (the multi-box topology —
     # the role PostgreSQL/HBase play for the reference)
     "remote": "incubator_predictionio_tpu.data.storage.remote",
+    # GCS bucket model-blob store (the HDFSModels role on TPU pods)
+    "gcs": "incubator_predictionio_tpu.data.storage.gcs",
 }
 
 MetaDataRepository = "METADATA"

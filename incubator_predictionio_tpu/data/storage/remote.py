@@ -62,19 +62,15 @@ class StorageClient(base.BaseStorageClient):
         self.port = parts.port or 7077
         self.auth_key = config.properties.get("AUTHKEY")
         self.timeout = float(config.properties.get("TIMEOUT", "60"))
-        self._local = threading.local()
-        self._conns_lock = threading.Lock()
-        self._conns: list = []
+        from incubator_predictionio_tpu.utils.http import (
+            ClientConnectionPool,
+        )
+
+        self._pool = ClientConnectionPool(self.host, self.port,
+                                          self.timeout)
 
     def _conn(self) -> http.client.HTTPConnection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = http.client.HTTPConnection(
-                self.host, self.port, timeout=self.timeout)
-            self._local.conn = conn
-            with self._conns_lock:
-                self._conns.append(conn)
-        return conn
+        return self._pool.get()
 
     def rpc(self, iface: str, prefix: str, method: str,
             args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Any:
@@ -126,14 +122,7 @@ class StorageClient(base.BaseStorageClient):
         raise etype(msg.get("error", "remote storage error"))
 
     def close(self) -> None:
-        with self._conns_lock:
-            for conn in self._conns:
-                try:
-                    conn.close()
-                except Exception:
-                    pass
-            self._conns.clear()
-        self._local = threading.local()
+        self._pool.close_all()
 
 
 #: methods safe to re-send after a lost response (reads, and writes whose

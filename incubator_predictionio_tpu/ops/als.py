@@ -312,20 +312,20 @@ def _solve_bucket_kernel(
     l2: float,
     reg_nnz: bool,
     cg_iters: int,
-    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Explicit-CG bucket solve via the fused Pallas kernel.
 
     Same contract as :func:`_solve_bucket` (CG leg): λ(+λ·nnz) ridge,
     empty rows → 0. The [B, K, K] Gram batch lives only in VMEM — see
-    ops/pallas_kernels.als_solve_cg_pallas."""
+    ops/pallas_kernels.als_solve_cg_pallas. (Interpret-mode selection
+    happens inside the kernel wrapper: no Mosaic backend → interpret,
+    which is how PIO_ALS_KERNEL=on works on the CPU test mesh.)"""
     from incubator_predictionio_tpu.ops.pallas_kernels import (
         als_solve_cg_pallas,
     )
 
     return als_solve_cg_pallas(
-        gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz, iters=cg_iters,
-        interpret=interpret)
+        gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz, iters=cg_iters)
 
 
 #: f32-element budget for one bucket chunk's gather intermediate
@@ -450,11 +450,13 @@ def _sweep_side(
                     other_factors, _yty, t[0], t[1], t[2], l2, alpha,
                     precision=precision, cg_iters=cg_iters)
         elif use_kernel:
-            # chunk by the PADDED gather footprint (the kernel pads D and
-            # K to lane multiples — min 128 each)
-            dp = max(128, -(-cols.shape[1] // 128) * 128)
-            kp = -(-rank // 128) * 128
-            row_elems = dp * kp
+            # chunk by the PADDED gather footprint the kernel actually
+            # materializes (single source of truth in pallas_kernels)
+            from incubator_predictionio_tpu.ops.pallas_kernels import (
+                als_padded_row_elems,
+            )
+
+            row_elems = als_padded_row_elems(cols.shape[1], rank)
 
             def solver(t):
                 return _solve_bucket_kernel(

@@ -669,6 +669,17 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
         o_ref[...] = x
 
 
+def als_padded_row_elems(d: int, k: int) -> int:
+    """Per-row element footprint of the [B, dp, kp] gather
+    :func:`als_solve_cg_pallas` materializes — THE single copy of its
+    padding math, so callers sizing HBM chunks (ops/als.py
+    _solve_bucket_chunked) can never drift from the kernel's real
+    footprint."""
+    kp = _round_up(k, _LANES)
+    dp = max(_LANES, _round_up(d, _LANES))
+    return dp * kp
+
+
 def als_solve_cg_pallas(
     table: jax.Array,              # [M, K] factor table (bf16 fast path)
     cols: jax.Array,               # [B, D] int32
@@ -696,7 +707,7 @@ def als_solve_cg_pallas(
     B, d = cols.shape
     k = table.shape[1]
     kp = _round_up(k, _LANES)
-    dp = max(_LANES, _round_up(d, _LANES))
+    dp = als_padded_row_elems(d, k) // kp
     # dt must DIVIDE dp or the floored grid would silently skip the
     # remainder tile (dp is always a multiple of 128, so 128 divides)
     dt = next(t for t in (512, 256, 128) if dp % t == 0)

@@ -185,6 +185,59 @@ class Router:
         return None, {}, path_matched
 
 
+class ClientConnectionPool:
+    """Thread-local keep-alive HTTP(S) connections to one host.
+
+    The single copy of client connection lifecycle shared by the
+    remote-storage RPC channel (data/storage/remote.py) and the GCS
+    driver (data/storage/gcs.py) — each layers its own retry policy on
+    top. ``get()`` returns this thread's connection (created on first
+    use; ``http.client`` transparently reconnects a closed one on the
+    next request), ``drop()`` discards this thread's connection so the
+    next ``get()`` builds a fresh object, ``close_all()`` closes every
+    connection the pool ever handed out."""
+
+    def __init__(self, host: str, port: int, timeout: float,
+                 tls: bool = False):
+        import http.client as _hc
+
+        self._cls = _hc.HTTPSConnection if tls else _hc.HTTPConnection
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._conns: list = []
+
+    def get(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._cls(self.host, self.port, timeout=self.timeout)
+            self._local.conn = conn
+            with self._lock:
+                self._conns.append(conn)
+        return conn
+
+    def drop(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def close_all(self) -> None:
+        with self._lock:
+            for conn in self._conns:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            self._conns.clear()
+        self._local = threading.local()
+
+
 class HttpServer:
     """One listening socket + a router. Synchronous handlers and the
     ``sync()`` helper run on the default thread pool so blocking DAO work

@@ -78,9 +78,23 @@ class StorageClient(base.BaseStorageClient):
         emulator = (props.get("EMULATOR_HOST")
                     or os.environ.get("STORAGE_EMULATOR_HOST"))
         if emulator:
-            emulator = emulator.replace("http://", "")
-            host, _, port = emulator.partition(":")
-            self.host, self.port, self.tls = host, int(port or 80), False
+            # accept the forms the ecosystem actually sets: bare
+            # host:port, http(s)://host:port, optional trailing slash
+            # (fake-gcs-server defaults to https://…:4443)
+            from urllib.parse import urlsplit
+
+            raw = emulator
+            if "//" not in emulator:
+                emulator = "http://" + emulator
+            parts = urlsplit(emulator)
+            if not parts.hostname or parts.scheme not in ("http", "https"):
+                raise _storage_error()(
+                    "unparseable GCS emulator address "
+                    f"{raw!r} (from EMULATOR_HOST / STORAGE_EMULATOR_HOST)"
+                    " — expected [http[s]://]host:port")
+            self.tls = parts.scheme == "https"
+            self.host = parts.hostname
+            self.port = parts.port or (443 if self.tls else 80)
             self._fixed_token: Optional[str] = None
             self._auth = False
         else:
@@ -221,19 +235,23 @@ class StorageClient(base.BaseStorageClient):
             return
         status, payload = self.request(
             "GET", f"/storage/v1/b/{self.bucket}")
-        if status == 200:
-            self._bucket_ok = True
-            return
-        if status == 404 and not self.tls:
-            # emulators (including ours) typically don't implement bucket
-            # metadata; absence of the route is not a config error there
-            self._bucket_ok = True
-            return
-        raise _storage_error()(
-            f"gcs bucket {self.bucket!r} is not readable (HTTP {status} "
-            f"{payload[:200]!r}) — check the BUCKET name and the service "
-            "account's storage permissions; object reads were returning "
-            "404 for every id")
+        if status == 404 and self.tls:
+            # the bucket itself does not exist — a typo'd BUCKET, the one
+            # misconfig that reads as "every model absent". (Emulators
+            # often don't implement bucket metadata, so plain-HTTP 404s
+            # are inconclusive.)
+            raise _storage_error()(
+                f"gcs bucket {self.bucket!r} does not exist (HTTP 404 on "
+                f"bucket metadata; {payload[:200]!r}) — check "
+                "PIO_STORAGE_SOURCES_<N>_BUCKET; object reads were "
+                "returning 404 for every id")
+        # 200 = bucket readable. 403 is NOT a misconfig signal: a
+        # least-privilege service account (roles/storage.objectAdmin —
+        # objects only, no storage.buckets.get) legitimately cannot read
+        # bucket metadata, and failing here would make Models.get() → None
+        # unreachable on correctly-scoped credentials. Anything
+        # inconclusive: accept and never re-probe.
+        self._bucket_ok = True
 
     def delete_object(self, name: str) -> bool:
         obj = quote(self._object_name(name), safe="")

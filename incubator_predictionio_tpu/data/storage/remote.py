@@ -21,7 +21,6 @@ Connections are persistent (HTTP/1.1 keep-alive) and per-thread.
 from __future__ import annotations
 
 import http.client
-import threading
 from typing import Any, Dict, Iterator, Tuple
 from urllib.parse import urlsplit
 

@@ -669,14 +669,18 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
         o_ref[...] = x
 
 
+def als_padded_dims(d: int, k: int) -> Tuple[int, int]:
+    """(dp, kp) padding of :func:`als_solve_cg_pallas` — THE single copy
+    of its padding math; the kernel and its chunk-sizing callers both
+    derive from this so they can never drift."""
+    return max(_LANES, _round_up(d, _LANES)), _round_up(k, _LANES)
+
+
 def als_padded_row_elems(d: int, k: int) -> int:
-    """Per-row element footprint of the [B, dp, kp] gather
-    :func:`als_solve_cg_pallas` materializes — THE single copy of its
-    padding math, so callers sizing HBM chunks (ops/als.py
-    _solve_bucket_chunked) can never drift from the kernel's real
-    footprint."""
-    kp = _round_up(k, _LANES)
-    dp = max(_LANES, _round_up(d, _LANES))
+    """Per-row element footprint of the [B, dp, kp] gather the kernel
+    materializes (ops/als.py _solve_bucket_chunked sizes HBM chunks with
+    this)."""
+    dp, kp = als_padded_dims(d, k)
     return dp * kp
 
 
@@ -706,8 +710,7 @@ def als_solve_cg_pallas(
         interpret = not pallas_available()
     B, d = cols.shape
     k = table.shape[1]
-    kp = _round_up(k, _LANES)
-    dp = als_padded_row_elems(d, k) // kp
+    dp, kp = als_padded_dims(d, k)
     # dt must DIVIDE dp or the floored grid would silently skip the
     # remainder tile (dp is always a multiple of 128, so 128 divides)
     dt = next(t for t in (512, 256, 128) if dp % t == 0)

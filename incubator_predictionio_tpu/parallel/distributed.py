@@ -24,6 +24,8 @@ from jax.sharding import Mesh
 logger = logging.getLogger(__name__)
 
 _initialized = False
+#: True only when this process actually joined a multi-controller runtime
+_multiprocess = False
 
 
 def ensure_initialized() -> bool:
@@ -35,7 +37,7 @@ def ensure_initialized() -> bool:
     reference forwards ``PIO_*`` env across process boundaries
     (Runner.scala:129-131). Returns True when running multi-process.
     """
-    global _initialized
+    global _initialized, _multiprocess
     if _initialized:
         return jax.process_count() > 1
     coord = os.environ.get("PIO_COORDINATOR_ADDRESS")
@@ -58,6 +60,7 @@ def ensure_initialized() -> bool:
             num_processes=n_proc,
             process_id=int(os.environ["PIO_PROCESS_ID"]),
         )
+        _multiprocess = True
         logger.info(
             "distributed: process %d/%d via coordinator %s",
             jax.process_index(), jax.process_count(), coord,
@@ -76,6 +79,27 @@ def process_index() -> int:
 
 def is_multihost() -> bool:
     return jax.process_count() > 1
+
+
+def barrier(name: str) -> None:
+    """Pod-wide sync point: returns only when EVERY process has reached it.
+
+    Used as the completion gate before process 0 persists an
+    EngineInstance as COMPLETED — a worker that crashed mid-train leaves
+    its peers parked here until the launcher tears the pod down, so a
+    failed pod run can never publish a COMPLETED instance (the
+    supervision contract of Runner.scala:101-213, proven by
+    tests/test_launcher.py's killed-worker drill). No-op off-pod.
+
+    Gates on ``_multiprocess`` — a ``jax.distributed`` runtime this
+    module actually joined — NOT on process_count(): tests fake process
+    counts to simulate pod roles in one process, and the sync primitive
+    only functions on a real multi-controller runtime."""
+    if not _multiprocess or jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
 
 
 def is_pod_worker() -> bool:

@@ -127,6 +127,13 @@ class CoreWorkflow:
                 with tracer.activate():
                     models = engine.train(ctx, engine_params, params)
                     models = checkpoint.host_materialize(models)  # collective
+                    # completion gate: COMPLETED must mean the WHOLE pod
+                    # finished. Without this, a training function with no
+                    # real cross-process dependency lets process 0 finish
+                    # and persist even though a peer crashed mid-train —
+                    # and a FAILED `pio train --hosts` run would leave a
+                    # COMPLETED instance for deploy to pick up.
+                    distributed.barrier("pio-train-complete")
             except Exception:
                 if not distributed.is_pod_worker():
                     # the collective already failed, so storage I/O can no

@@ -137,3 +137,84 @@ def test_cli_worker_joins_runtime_when_coordinator_set():
     rc = launcher.launch(sink=lines.append, timeout=120)
     assert rc == 0, "\n".join(lines)
     assert any("COUNT 1" in line for line in lines)
+
+
+def test_killed_worker_fails_cleanly_no_corrupt_instance(tmp_path):
+    """The supervision half of Runner.scala:101-213: a pod worker dying
+    mid-train (SIGKILL — a crash, not a polite exit) must produce a clean
+    nonzero supervisor failure with the surviving worker torn down and NO
+    corrupt EngineInstance — the store may hold an ABORTED record or
+    nothing, but never COMPLETED and never a model blob."""
+    import json
+    import signal
+    import sqlite3
+
+    engine_dir = tmp_path / "engine"
+    engine_dir.mkdir()
+    (engine_dir / "crashengine.py").write_text(
+        "import os, signal\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "from incubator_predictionio_tpu.core import (\n"
+        "    Algorithm, DataSource, Engine, EngineFactory, FirstServing,\n"
+        "    IdentityPreparator)\n"
+        "\n"
+        "class DS(DataSource):\n"
+        "    def read_training(self, ctx):\n"
+        "        return np.arange(32, dtype=np.float32)\n"
+        "\n"
+        "class Algo(Algorithm):\n"
+        "    def train(self, ctx, td):\n"
+        "        if os.environ.get('PIO_PROCESS_ID') == '1':\n"
+        "            os.kill(os.getpid(), signal.SIGKILL)  # worker crash\n"
+        "        return float(jnp.mean(jnp.asarray(td)))\n"
+        "    def predict(self, model, query):\n"
+        "        return model\n"
+        "\n"
+        "class CrashEngine(EngineFactory):\n"
+        "    def apply(self):\n"
+        "        return Engine(DS, IdentityPreparator, {'a': Algo},\n"
+        "                      FirstServing)\n"
+    )
+    (engine_dir / "engine.json").write_text(json.dumps({
+        "id": "crash-test",
+        "engineFactory": "crashengine:CrashEngine",
+    }))
+    env = _base_env()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PIO_HOME": str(tmp_path / "home"),
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "pio.db"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQL",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.cli.main",
+         "train", "--hosts", "local,localhost"],
+        cwd=engine_dir, env=env, capture_output=True, text=True,
+        timeout=420,
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode != 0, out
+
+    db = tmp_path / "pio.db"
+    if db.exists():
+        conn = sqlite3.connect(str(db))
+        try:
+            statuses = [r[0] for r in conn.execute(
+                "SELECT status FROM engine_instances").fetchall()]
+        except sqlite3.OperationalError:
+            statuses = []  # table never created — also clean
+        assert "COMPLETED" not in statuses, statuses
+        try:
+            (n_models,) = conn.execute(
+                "SELECT COUNT(*) FROM models").fetchone()
+        except sqlite3.OperationalError:
+            n_models = 0
+        assert n_models == 0, n_models
+        conn.close()

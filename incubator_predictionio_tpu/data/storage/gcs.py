@@ -87,14 +87,19 @@ class StorageClient(base.BaseStorageClient):
             if "//" not in emulator:
                 emulator = "http://" + emulator
             parts = urlsplit(emulator)
-            if not parts.hostname or parts.scheme not in ("http", "https"):
+            try:
+                port = parts.port  # lazily parsed; bad ports raise here
+                host = parts.hostname
+            except ValueError:
+                port = host = None
+            if not host or parts.scheme not in ("http", "https"):
                 raise _storage_error()(
                     "unparseable GCS emulator address "
                     f"{raw!r} (from EMULATOR_HOST / STORAGE_EMULATOR_HOST)"
                     " — expected [http[s]://]host:port")
             self.tls = parts.scheme == "https"
-            self.host = parts.hostname
-            self.port = parts.port or (443 if self.tls else 80)
+            self.host = host
+            self.port = port or (443 if self.tls else 80)
             self._fixed_token: Optional[str] = None
             self._auth = False
         else:
@@ -235,11 +240,12 @@ class StorageClient(base.BaseStorageClient):
             return
         status, payload = self.request(
             "GET", f"/storage/v1/b/{self.bucket}")
-        if status == 404 and self.tls:
+        if status == 404 and self._auth:
             # the bucket itself does not exist — a typo'd BUCKET, the one
-            # misconfig that reads as "every model absent". (Emulators
-            # often don't implement bucket metadata, so plain-HTTP 404s
-            # are inconclusive.)
+            # misconfig that reads as "every model absent". Gate on
+            # _auth (real GCS), not TLS: an https emulator
+            # (fake-gcs-server's default) may lack bucket metadata or
+            # auto-create buckets lazily, so its 404s are inconclusive.
             raise _storage_error()(
                 f"gcs bucket {self.bucket!r} does not exist (HTTP 404 on "
                 f"bucket metadata; {payload[:200]!r}) — check "

@@ -91,15 +91,31 @@ def barrier(name: str) -> None:
     supervision contract of Runner.scala:101-213, proven by
     tests/test_launcher.py's killed-worker drill). No-op off-pod.
 
-    Gates on ``_multiprocess`` — a ``jax.distributed`` runtime this
-    module actually joined — NOT on process_count(): tests fake process
-    counts to simulate pod roles in one process, and the sync primitive
-    only functions on a real multi-controller runtime."""
-    if not _multiprocess or jax.process_count() <= 1:
+    Gates on an ACTUAL multi-controller runtime — either one this module
+    joined (``_multiprocess``) or an externally-provisioned
+    ``jax.distributed`` client (Cloud TPU auto-init) — NOT on
+    process_count(): tests fake process counts to simulate pod roles in
+    one process, and the sync primitive only functions on a real
+    runtime."""
+    if not _runtime_active() or jax.process_count() <= 1:
         return
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+def _runtime_active() -> bool:
+    """True when a jax.distributed client genuinely exists in this
+    process, however it was initialized."""
+    if _multiprocess:
+        return True
+    try:  # externally-provisioned runtime (auto-init on Cloud TPU)
+        from jax._src import distributed as _jax_distributed
+
+        return getattr(_jax_distributed.global_state, "client",
+                       None) is not None
+    except Exception:  # pragma: no cover - private-API drift
+        return False
 
 
 def is_pod_worker() -> bool:

@@ -288,12 +288,19 @@ class CoreWorkflow:
                     evaluator.output_path = None
                 try:
                     result = _eval()
+                    distributed.barrier("pio-eval-complete")
                 finally:
                     if saved_path is not None:
                         evaluator.output_path = saved_path
                 return "", result
             try:
                 pod_result = _eval()
+                # completion gate, same rationale as run_train: an
+                # EVALCOMPLETED instance must mean the WHOLE pod finished
+                # — without this a crashed peer still lets process 0
+                # persist when the evaluation has no true cross-process
+                # dependency
+                distributed.barrier("pio-eval-complete")
             except Exception:
                 # collective already failed; record the abort (the
                 # single-host path below does this inside its try block)

@@ -109,10 +109,8 @@ class CsvDataSource(DataSource):
                             f"(got {line!r})") from e
                     users.append(u)
                     items.append(i)
-        user_bimap = BiMap(
-            {u: i for i, u in enumerate(dict.fromkeys(users))})
-        item_bimap = BiMap(
-            {t: i for i, t in enumerate(dict.fromkeys(items))})
+        user_bimap = BiMap.string_int(users)
+        item_bimap = BiMap.string_int(items)
         return TrainingData(
             users=np.asarray([user_bimap[u] for u in users], np.int32),
             items=np.asarray([item_bimap[i] for i in items], np.int32),

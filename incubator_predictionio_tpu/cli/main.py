@@ -137,6 +137,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=7070)
     p.add_argument("--stats", action="store_true")
+    def _positive_int(v: str) -> int:
+        n = int(v)
+        if n <= 0:
+            raise argparse.ArgumentTypeError(
+                f"must be a positive integer (got {v})")
+        return n
+
+    p.add_argument(
+        "--batch-cap", type=_positive_int, default=None, metavar="N",
+        help="max events per POST /batch/events.json (default 50 — the "
+             "reference's wire contract; raise for columnar bulk loaders)")
     p = sub.add_parser("adminserver", help="start the admin API server")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=7071)
@@ -511,8 +522,11 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
             EventServerConfig,
         )
 
+        conf_kw = {}
+        if getattr(args, "batch_cap", None) is not None:
+            conf_kw["max_batch"] = args.batch_cap
         server = EventServer(EventServerConfig(
-            ip=args.ip, port=args.port, stats=args.stats,
+            ip=args.ip, port=args.port, stats=args.stats, **conf_kw,
         ))
         print(f"Event Server running on http://{args.ip}:{args.port}")
         asyncio.run(server.serve_forever())

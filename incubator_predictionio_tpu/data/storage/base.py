@@ -271,12 +271,26 @@ def uniform_interactions(events: Sequence[Event]):
     (compact records store epoch millis and re-render as UTC strings),
     identical event/entity/target types throughout, and a non-reserved
     event name. Callers owe their own screens for anything invisible on
-    a parsed Event (the CLI screens raw docs for explicit creationTime)
-    and for event validity (this gate assumes validated events)."""
+    a parsed Event (the CLI screens raw docs for explicit creationTime).
+
+    Accepted batches are FULLY VALID per ``validate_event`` without the
+    caller re-validating each event (the REST hot path depends on this —
+    per-event re-validation was a third of insert_batch's cost): the
+    uniformity requirement makes every name/type/property-key rule a
+    batch-level check against ``first`` (validated once, below), and the
+    per-event rules that remain — non-empty entity ids, a target on
+    every event — are enforced inside the loop. Batches that fail any
+    screen return None and take the generic per-event path, which
+    validates in full."""
     import datetime as _dt
 
     import numpy as np
 
+    from incubator_predictionio_tpu.data.event import (
+        BUILTIN_ENTITY_TYPES,
+        BUILTIN_PROPERTIES,
+        is_reserved_prefix,
+    )
     from incubator_predictionio_tpu.utils.times import to_millis
 
     if not events:
@@ -284,12 +298,23 @@ def uniform_interactions(events: Sequence[Event]):
     first = events[0]
     name, etype, tetype = first.event, first.entity_type, \
         first.target_entity_type
-    if name.startswith("$") or not tetype:
+    if not name or name.startswith("$") or not tetype or not etype:
+        return None
+    # batch-level validity (identical on every event by the uniformity
+    # screen): reserved-prefix rules from validate_event — including
+    # the event NAME ('pio_rate' is invalid, not merely non-special)
+    if (is_reserved_prefix(name)
+            or (is_reserved_prefix(etype)
+                and etype not in BUILTIN_ENTITY_TYPES)
+            or (is_reserved_prefix(tetype)
+                and tetype not in BUILTIN_ENTITY_TYPES)):
         return None
     keys = list(first.properties)
     if len(keys) != 1:
         return None
     vprop = keys[0]
+    if is_reserved_prefix(vprop) and vprop not in BUILTIN_PROPERTIES:
+        return None
     n = len(events)
     users: list = []
     items: list = []
@@ -302,6 +327,7 @@ def uniform_interactions(events: Sequence[Event]):
     for k, e in enumerate(events):
         if (e.event != name or e.entity_type != etype
                 or e.target_entity_type != tetype
+                or not e.entity_id
                 or not e.target_entity_id or e.event_id or e.tags
                 or e.pr_id or list(e.properties) != keys):
             return None
@@ -327,8 +353,134 @@ def uniform_interactions(events: Sequence[Event]):
     return inter, etype, tetype, name, vprop, times
 
 
+def uniform_interactions_from_docs(docs):
+    """RAW JSON docs → the same ``(Interactions, etype, tetype, name,
+    vprop, times_ms)`` bundle as :func:`uniform_interactions`, or None.
+
+    The REST batch hot path: for the uniform shape, constructing 50
+    ``Event`` objects (+ full validation each) costs more than the
+    storage write itself — this gate reads the dicts directly and
+    guarantees the SAME acceptance set as parsing each doc into an Event
+    and running the Event-level gate (pinned by a differential test in
+    tests/test_event_server.py). Screens beyond the Event-level gate,
+    because a raw doc can carry what a parsed Event cannot show:
+    unknown keys reject the batch, and an explicit ``creationTime``
+    rejects it (the columnar renderer would rewrite it).
+
+    ``times_ms`` is None when every doc omits ``eventTime`` — the caller
+    assigns server-receive time, matching the Event path's parse-time
+    default."""
+    import datetime as _dt
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.data.event import (
+        BUILTIN_ENTITY_TYPES,
+        BUILTIN_PROPERTIES,
+        is_reserved_prefix,
+    )
+    from incubator_predictionio_tpu.utils.times import (
+        parse_iso8601,
+        to_millis,
+    )
+
+    if not docs:
+        return None
+    first = docs[0]
+    if not isinstance(first, dict):
+        return None
+    name = first.get("event")
+    etype = first.get("entityType")
+    tetype = first.get("targetEntityType")
+    if (not name or not isinstance(name, str) or name.startswith("$")
+            or not etype or not isinstance(etype, str)
+            or not tetype or not isinstance(tetype, str)):
+        return None
+    if (is_reserved_prefix(name)
+            or (is_reserved_prefix(etype)
+                and etype not in BUILTIN_ENTITY_TYPES)
+            or (is_reserved_prefix(tetype)
+                and tetype not in BUILTIN_ENTITY_TYPES)):
+        return None
+    props = first.get("properties")
+    if not isinstance(props, dict) or len(props) != 1:
+        return None
+    vprop = next(iter(props))
+    if is_reserved_prefix(vprop) and vprop not in BUILTIN_PROPERTIES:
+        return None
+    allowed_keys = {"event", "entityType", "entityId", "targetEntityType",
+                    "targetEntityId", "properties", "eventTime"}
+    n = len(docs)
+    uidx = np.empty(n, np.int32)
+    iidx = np.empty(n, np.int32)
+    vals = np.empty(n, np.float32)
+    times: Optional[Any] = None
+    u_intern: dict = {}
+    i_intern: dict = {}
+    users: list = []
+    items: list = []
+    utc = _dt.timezone.utc
+    for k, d in enumerate(docs):
+        if not isinstance(d, dict) or not allowed_keys.issuperset(d):
+            return None
+        if (d.get("event") != name or d.get("entityType") != etype
+                or d.get("targetEntityType") != tetype):
+            return None
+        uid = d.get("entityId")
+        tid = d.get("targetEntityId")
+        if (not uid or not isinstance(uid, str)
+                or not tid or not isinstance(tid, str)):
+            return None
+        p = d.get("properties")
+        if not isinstance(p, dict) or len(p) != 1:
+            return None
+        v = p.get(vprop)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if float(np.float32(v)) != float(v):
+            return None
+        ts = d.get("eventTime")
+        if ts is not None:
+            if not isinstance(ts, str):
+                return None
+            try:
+                t = parse_iso8601(ts)
+            except ValueError:
+                return None
+            if t.utcoffset() != _dt.timedelta(0):
+                return None
+            if times is None:
+                # first explicit time: backfill earlier implicit slots
+                times = np.empty(n, np.int64)
+                if k:
+                    now0 = to_millis(_dt.datetime.now(utc))
+                    times[:k] = now0 + np.arange(k)
+            times[k] = to_millis(t)
+        elif times is not None:
+            times[k] = to_millis(_dt.datetime.now(utc))
+        u = u_intern.setdefault(uid, len(u_intern))
+        if u == len(users):
+            users.append(uid)
+        it = i_intern.setdefault(tid, len(i_intern))
+        if it == len(items):
+            items.append(tid)
+        uidx[k], iidx[k], vals[k] = u, it, v
+    inter = Interactions(
+        user_idx=uidx, item_idx=iidx, values=vals,
+        user_ids=IdTable.from_list(users),
+        item_ids=IdTable.from_list(items))
+    return inter, etype, tetype, name, vprop, times
+
+
 class Events(abc.ABC):
     """Event CRUD + query DAO (LEvents.scala:40-492)."""
+
+    #: True for in-process backends whose inserts are sub-millisecond
+    #: (memory index, native append-only log). The EventServer runs its
+    #: ingest hot routes inline on the event loop for these — the
+    #: thread-pool round trip costs more than the insert — and keeps the
+    #: executor for networked/fsync-bound backends.
+    FAST_LOCAL = False
 
     @abc.abstractmethod
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:

@@ -117,6 +117,8 @@ class StorageClient(base.BaseStorageClient):
 class CppLogEvents(base.Events):
     """Events DAO over the native log (contract: LEvents.scala:40-492)."""
 
+    FAST_LOCAL = True  # native append, no fsync per op: ingest inline
+
     def __init__(self, client: StorageClient,
                  config: base.StorageClientConfig, prefix: str = ""):
         self.client = client
@@ -193,12 +195,13 @@ class CppLogEvents(base.Events):
 
         The equivalence conditions live in ONE place —
         ``base.uniform_interactions`` — shared with the CLI import gate
-        (cli/commands.py), so the two paths cannot drift. NOTE the one
-        observable delta, documented in docs/data-collection.md: columnar
-        records report creationTime == eventTime (the compact sidecar
-        stores one timestamp)."""
-        for e in events:
-            validate_event(e)
+        (cli/commands.py), so the two paths cannot drift. The gate's
+        screens imply full ``validate_event`` validity for every batch it
+        ACCEPTS (see its docstring), so no per-event re-validation here —
+        rejected batches fall to the generic path, which validates. NOTE
+        the one observable delta, documented in docs/data-collection.md:
+        columnar records report creationTime == eventTime (the compact
+        sidecar stores one timestamp)."""
         return base.uniform_interactions(events)
 
     def insert_batch(self, events: Sequence[Event], app_id: int,
@@ -622,6 +625,31 @@ class CppLogEvents(base.Events):
             res, which, buf,
             offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         return base.IdTable(buf.raw[:nbytes], offs)
+
+    def insert_interactions(
+        self,
+        inter: base.Interactions,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_name: str = "rate",
+        value_prop: str = "rating",
+        times: Optional[Any] = None,
+    ) -> list:
+        """Columnar insert that RETURNS the stored event ids — the REST
+        batch route's doc-level fast path (no per-event Python objects
+        anywhere between the wire and the log). Same write as
+        :meth:`import_interactions`; ids derived from the shared seed
+        formula (:meth:`_derive_event_ids`)."""
+        import secrets
+
+        seed = int.from_bytes(secrets.token_bytes(8), "little")
+        n = self.import_interactions(
+            inter, app_id, channel_id, entity_type=entity_type,
+            target_entity_type=target_entity_type, event_name=event_name,
+            value_prop=value_prop, times=times, id_seed=seed)
+        return self._derive_event_ids(seed, n)
 
     def import_interactions(
         self,

@@ -105,6 +105,8 @@ class _MemoryDAO:
 
 
 class MemoryEvents(_MemoryDAO, base.Events):
+    FAST_LOCAL = True  # dict index: EventServer ingests inline
+
     def _table(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
         return self.t.events.setdefault((app_id, channel_id), {})
 

@@ -276,3 +276,173 @@ def test_plugins_routes(server):
 def test_unknown_route_and_method(server):
     assert call(server, "GET", "/nope.json")[0] == 404
     assert call(server, "DELETE", "/events.json?accessKey=testkey")[0] == 405
+
+
+class TestDocGateDifferential:
+    """The doc-level batch gate (uniform_interactions_from_docs) must
+    accept exactly what parsing each doc into an Event and running the
+    Event-level gate would accept — except the two doc-only screens
+    (unknown keys, explicit creationTime), which may only be STRICTER
+    (doc gate rejects → generic path; never the other way). When both
+    accept, the produced bundles must be identical."""
+
+    def _cases(self):
+        base_doc = {
+            "event": "rate", "entityType": "user", "entityId": "u1",
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 3.0},
+        }
+
+        def batch(mut=None, idx=0, n=10):
+            docs = [dict(base_doc, entityId=f"u{k}",
+                         properties={"rating": float(1 + k % 5)})
+                    for k in range(n)]
+            if mut:
+                docs[idx] = mut(dict(docs[idx]))
+            return docs
+
+        def set_(key, val):
+            def m(d):
+                d[key] = val
+                return d
+            return m
+
+        def set_prop(val):
+            def m(d):
+                d["properties"] = val
+                return d
+            return m
+
+        return [
+            ("uniform", batch()),
+            ("reserved name", batch(set_("event", "$set"))),
+            ("pio_ event name", batch(set_("event", "pio_rate"))),
+            ("empty name", batch(set_("event", ""))),
+            ("mixed names", batch(set_("event", "view"), idx=3)),
+            ("no target", batch(set_("targetEntityId", None), idx=2)),
+            ("empty entity", batch(set_("entityId", ""), idx=5)),
+            ("pio_ entity type", batch(set_("entityType", "pio_x"))),
+            ("pio_pr builtin ok", batch(set_("targetEntityType", "pio_pr"))),
+            ("pio_ property", batch(set_prop({"pio_v": 1.0}))),
+            ("two props", batch(set_prop({"a": 1.0, "b": 2.0}), idx=7)),
+            ("bool value", batch(set_prop({"rating": True}), idx=1)),
+            ("string value", batch(set_prop({"rating": "x"}), idx=4)),
+            ("f32-inexact", batch(set_prop({"rating": 4.1}), idx=6)),
+            ("explicit id", batch(set_("eventId", "a" * 32), idx=0)),
+            ("prId", batch(set_("prId", "p1"), idx=8)),
+            ("non-utc time", batch(
+                set_("eventTime", "2026-07-15T10:00:00.000+09:00"), idx=3)),
+            ("utc time", batch(
+                set_("eventTime", "2026-07-15T10:00:00.000Z"), idx=3)),
+            ("bad time", batch(set_("eventTime", "not-a-date"), idx=2)),
+        ]
+
+    def test_doc_gate_matches_event_gate(self):
+        import numpy as np
+
+        from incubator_predictionio_tpu.data.event import (
+            Event,
+            EventValidationError,
+            validate_event,
+        )
+        from incubator_predictionio_tpu.data.storage.base import (
+            uniform_interactions,
+            uniform_interactions_from_docs,
+        )
+
+        for label, docs in self._cases():
+            doc_res = uniform_interactions_from_docs(docs)
+            try:
+                events = [Event.from_jsonable(d) for d in docs]
+                for e in events:
+                    validate_event(e)
+                ev_res = uniform_interactions(events)
+            except (ValueError, EventValidationError):
+                ev_res = None
+            if ev_res is None:
+                assert doc_res is None, label
+                continue
+            # the Event gate accepted; the doc gate must agree (none of
+            # the cases above carry unknown keys / creationTime, the two
+            # allowed doc-stricter screens) and produce the same bundle
+            assert doc_res is not None, label
+            for a, b, what in [
+                (doc_res[0].user_idx, ev_res[0].user_idx, "user_idx"),
+                (doc_res[0].item_idx, ev_res[0].item_idx, "item_idx"),
+                (doc_res[0].values, ev_res[0].values, "values"),
+            ]:
+                np.testing.assert_array_equal(a, b, err_msg=f"{label}:{what}")
+            assert list(doc_res[0].user_ids) == list(ev_res[0].user_ids), label
+            assert list(doc_res[0].item_ids) == list(ev_res[0].item_ids), label
+            assert doc_res[1:5] == ev_res[1:5], label
+
+    def test_doc_only_screens_are_stricter_not_looser(self):
+        from incubator_predictionio_tpu.data.storage.base import (
+            uniform_interactions_from_docs,
+        )
+
+        base_doc = {
+            "event": "rate", "entityType": "user", "entityId": "u1",
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 3.0},
+        }
+        docs = [dict(base_doc, entityId=f"u{k}") for k in range(10)]
+        docs[4]["creationTime"] = "2026-07-15T10:00:00.000Z"
+        assert uniform_interactions_from_docs(docs) is None
+        docs = [dict(base_doc, entityId=f"u{k}") for k in range(10)]
+        docs[2]["unknownField"] = 1
+        assert uniform_interactions_from_docs(docs) is None
+
+
+def test_batch_fast_path_ids_resolve(tmp_path):
+    """REST fast-path ids must be the ids the store actually holds."""
+    import json as _json
+    import urllib.request
+
+    from incubator_predictionio_tpu.data.storage import (
+        AccessKey,
+        App,
+        Storage,
+    )
+    from incubator_predictionio_tpu.servers.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+
+    Storage.reset()
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "cpplog",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(0, "fastapp"))
+        Storage.get_meta_data_access_keys().insert(AccessKey("fk", app_id))
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+        port = srv.start_background()
+        batch = [{"event": "rate", "entityType": "user",
+                  "entityId": f"u{k}", "targetEntityType": "item",
+                  "targetEntityId": f"i{k % 3}",
+                  "properties": {"rating": float(k % 5)}}
+                 for k in range(20)]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/batch/events.json?accessKey=fk",
+            data=_json.dumps(batch).encode(),
+            headers={"Content-Type": "application/json"})
+        res = _json.load(urllib.request.urlopen(req))
+        assert all(r["status"] == 201 for r in res)
+        for src, r in zip(batch, res):
+            got = _json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/events/{r['eventId']}.json"
+                "?accessKey=fk"))
+            assert got["entityId"] == src["entityId"]
+            assert got["properties"]["rating"] == src["properties"]["rating"]
+        srv.stop()
+    finally:
+        Storage.reset()

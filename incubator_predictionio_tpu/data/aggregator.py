@@ -20,6 +20,7 @@ from typing import Dict, Iterable, Optional
 
 from incubator_predictionio_tpu.data.datamap import DataMap, PropertyMap
 from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.utils.times import to_millis
 
 #: Event names that control aggregation (LEventAggregator.scala:92).
 AGGREGATOR_EVENT_NAMES = ("$set", "$unset", "$delete")
@@ -56,9 +57,19 @@ def _finish(p: _Prop) -> Optional[PropertyMap]:
 
 
 def aggregate_properties_single(events: Iterable[Event]) -> Optional[PropertyMap]:
-    """Aggregate one entity's events (LEventAggregator.scala:68-90)."""
+    """Aggregate one entity's events (LEventAggregator.scala:68-90).
+
+    The defensive sort runs at the ORDER CONTRACT's granularity — epoch
+    MILLIS (base.Events.find docstring): durable backends store millis,
+    so two events differing only at microsecond precision are a TIE that
+    must replay in find/insertion order on every backend. Sorting by the
+    raw datetime here once re-ordered such ties on the memory backend
+    (which hands back original microseconds) and made the SAME $set
+    sequence aggregate differently than on sqlite/cpplog — caught by the
+    differential fuzz. Python's sort is stable, so on conforming
+    (find-ordered) input this is a no-op."""
     p = _Prop()
-    for e in sorted(events, key=lambda e: e.event_time):
+    for e in sorted(events, key=lambda e: to_millis(e.event_time)):
         p = _step(p, e)
     return _finish(p)
 

@@ -75,7 +75,12 @@ ITERATIONS = int(os.environ.get("PIO_BENCH_SWEEPS", 10))
 #: its noise floor where f32 polish matters; parity is additionally
 #: guarded by tests/test_als.py planted-recovery.
 BF16_SWEEPS = int(os.environ.get("PIO_BENCH_BF16_SWEEPS", ITERATIONS))
-L2 = 0.1
+#: ridge weight (ALS-WR λ·nnz scaling). 0.03 is the measured optimum for
+#: the planted workload (round-5 sweep at 2M/5M-nnz bench marginals:
+#: heldout 0.675/0.494 at λ=0.1 → 0.611/0.472 at 0.03, overfit below) —
+#: λ=0.1 was costing ~0.1 heldout RMSE of pure over-regularization.
+#: See BASELINE.md "planted-quality gap" for the full decomposition.
+L2 = float(os.environ.get("PIO_BENCH_L2", "0.03"))
 
 #: Measured on this image's host CPU (JAX CPU backend, warm compile cache)
 #: via `python bench.py --cpu` — the stand-in for the reference's
@@ -666,6 +671,9 @@ def run_orchestrator() -> None:
     # -- 6. INGEST-HTTP (host; needs no accelerator) -----------------------
     ingest_http_eps = bench_ingest_http()
 
+    # -- 6b. REAL-DATA QUALITY BOUND (host CPU; tiny) ----------------------
+    movielens = bench_movielens_quality()
+
     # -- 4/5/7. TRAIN + ATTENTION + SERVE: supervised TPU child ------------
     # (started after the host stages so parent CPU load never perturbs the
     # child's timed sections — on a 1-core driver box that skew is real).
@@ -731,6 +739,7 @@ def run_orchestrator() -> None:
         "prep_wall_s": round(prep_s, 1),
         "e2e_train_wall_s": None,
         "ingest_http_eps": ingest_http_eps,
+        **movielens,
         "serve_p50_ms": None,
         "serve_p99_ms": None,
         "serve_qps": None,
@@ -766,6 +775,89 @@ def run_orchestrator() -> None:
                 record["ingest_wall_s"] + record["prep_wall_s"]
                 + record["value"], 1)
     print(json.dumps(record))
+
+
+#: the reference's own bundled MovieLens sample (user::item::rating, 1.5k
+#: real ratings) — the only real interaction dataset in this egress-free
+#: environment. Loaded AT RUN TIME from the read-only reference tree
+#: (never copied into the repo); the stage reports null when absent.
+MOVIELENS_SAMPLE = os.environ.get(
+    "PIO_BENCH_MOVIELENS",
+    "/root/reference/examples/experimental/data/movielens.txt")
+#: regression bound for the real-data stage: measured 1.076/1.058/1.024
+#: across seeds 0..2 (rank 8, λ=0.1, 10 sweeps, 80/20 split; the sample
+#: is 30 users × 100 items, rating std 1.19 — the model beats the
+#: constant predictor by ~10%, which is what 1.2k training ratings
+#: support). 1.20 is ~11% headroom over the worst seed and below the
+#: 1.31 a mis-regularized run measures — tight enough to catch a solver
+#: regression, loose enough for seed noise.
+MOVIELENS_RMSE_BOUND = float(
+    os.environ.get("PIO_BENCH_MOVIELENS_BOUND", "1.20"))
+
+
+def load_movielens_sample():
+    """→ (users, items, vals, n_users, n_items) from the sample file, or
+    None when missing/unparseable (the stage must never crash the
+    orchestrator's always-emit-a-record contract — the path is
+    env-overridable and an operator may point it at a file in another
+    format)."""
+    try:
+        with open(MOVIELENS_SAMPLE) as f:
+            rows = [line.strip().split("::") for line in f if line.strip()]
+        users = np.asarray([int(r[0]) for r in rows], np.int32)
+        items = np.asarray([int(r[1]) for r in rows], np.int32)
+        vals = np.asarray([float(r[2]) for r in rows], np.float32)
+    except (OSError, ValueError, IndexError) as e:
+        log(f"movielens sample unusable at {MOVIELENS_SAMPLE} ({e}); "
+            "real-data stage skipped")
+        return None
+    # dense reindex (ids in the file are sparse)
+    uu, users = np.unique(users, return_inverse=True)
+    ii, items = np.unique(items, return_inverse=True)
+    return (users.astype(np.int32), items.astype(np.int32), vals,
+            len(uu), len(ii))
+
+
+#: the stage's own hyperparameters: 1.2k training ratings cannot support
+#: the bench shape's rank-128/λ=0.03 config (it would overfit to
+#: noise) — this is a SEPARATE tiny-data solver-health bound, tuned for
+#: the sample (rank 8, λ=0.1 measured best of a small grid), NOT a
+#: validation of the big bench's λ. The planted stage owns that.
+MOVIELENS_RANK = 8
+MOVIELENS_L2 = 0.1
+
+
+def bench_movielens_quality():
+    """Real-data RMSE regression bound (VERDICT r4 item 4): train on 80%
+    of the reference's bundled MovieLens sample, report heldout RMSE and
+    whether it clears the pinned bound. Synthetic planted quality proves
+    recovery against a KNOWN floor; this proves the solver stays healthy
+    on real human ratings (at the sample's own tuned tiny-data
+    hyperparameters — see MOVIELENS_RANK/MOVIELENS_L2). → dict of record
+    keys (nulls if the sample file is unavailable)."""
+    from incubator_predictionio_tpu.ops import als
+
+    out = {"movielens_rmse": None, "movielens_rmse_bound": None}
+    loaded = load_movielens_sample()
+    if loaded is None:
+        return out
+    users, items, vals, n_users, n_items = loaded
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(vals))
+    cut = int(0.8 * len(vals))
+    tr, te = perm[:cut], perm[cut:]
+    state, _ = als.als_train(
+        users[tr], items[tr], vals[tr], n_users, n_items,
+        rank=MOVIELENS_RANK, iterations=10, l2=MOVIELENS_L2, seed=0)
+    rmse_te = als.rmse(state, users[te], items[te], vals[te])
+    ok = rmse_te <= MOVIELENS_RMSE_BOUND
+    log(f"movielens sample ({len(vals)} real ratings): heldout RMSE "
+        f"{rmse_te:.3f} (bound {MOVIELENS_RMSE_BOUND}) "
+        f"{'OK' if ok else 'REGRESSION'}")
+    return {
+        "movielens_rmse": round(float(rmse_te), 3),
+        "movielens_rmse_bound": MOVIELENS_RMSE_BOUND,
+    }
 
 
 def bench_attention():

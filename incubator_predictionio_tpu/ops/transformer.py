@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -87,17 +88,35 @@ def _rms_norm(x, scale):
     return x * jax.lax.rsqrt(var + 1e-6) * scale
 
 
+#: sequence length above which the Pallas flash kernel serves instead of
+#: the XLA blockwise scan. Measured crossover on v5e with dispatch
+#: amortized (scripts/flash_tune.py sweeps block shapes and re-measures
+#: this): the scan won at S=8k in the round-4 block configuration while
+#: flash won 5.76x at 32k. Re-run the sweep after kernel/toolchain
+#: changes and update here (or override per deployment via env).
+def _flash_min_seq() -> int:
+    raw = os.environ.get("PIO_FLASH_MIN_SEQ", "")
+    try:
+        return int(raw) if raw.strip() else 8192
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring malformed PIO_FLASH_MIN_SEQ=%r; using 8192", raw)
+        return 8192
+
+
+FLASH_MIN_SEQ = _flash_min_seq()
+
+
 def _default_attn(q, k, v, causal=True, kv_valid=None):
     from incubator_predictionio_tpu.ops.attention import (
         blockwise_attention, dot_product_attention,
     )
     # flash streams KV block-by-block (kv is a grid dimension), so VMEM use
-    # is S-independent — no length cap. Crossover measured on v5e with
-    # dispatch amortized (20-call loops, BASELINE.md run): the XLA
-    # blockwise scan still wins at S=8k (12.33 vs 18.13 ms), flash wins
-    # 5.76x at 32k (161.18 vs 27.97 ms) — the kernel takes over strictly
-    # above 8k.
-    if 8192 < q.shape[1]:
+    # is S-independent — no length cap; the crossover constant above picks
+    # the faster implementation per length.
+    if FLASH_MIN_SEQ < q.shape[1]:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
             flash_attention, flash_available)
         if flash_available():

@@ -394,21 +394,13 @@ class TestDocGateDifferential:
         assert uniform_interactions_from_docs(docs) is None
 
 
-def test_batch_fast_path_ids_resolve(tmp_path):
-    """REST fast-path ids must be the ids the store actually holds."""
-    import json as _json
-    import urllib.request
+import contextlib
 
-    from incubator_predictionio_tpu.data.storage import (
-        AccessKey,
-        App,
-        Storage,
-    )
-    from incubator_predictionio_tpu.servers.event_server import (
-        EventServer,
-        EventServerConfig,
-    )
 
+@contextlib.contextmanager
+def _cpplog_server(tmp_path, access_key="fk"):
+    """A live EventServer over a cpplog event store (the fast-path
+    backend), torn down server-first on every exit path."""
     Storage.reset()
     Storage.configure({
         "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
@@ -421,28 +413,78 @@ def test_batch_fast_path_ids_resolve(tmp_path):
         "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
     })
+    srv = None
     try:
         app_id = Storage.get_meta_data_apps().insert(App(0, "fastapp"))
-        Storage.get_meta_data_access_keys().insert(AccessKey("fk", app_id))
+        Storage.get_meta_data_access_keys().insert(
+            AccessKey(access_key, app_id))
         srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
         port = srv.start_background()
-        batch = [{"event": "rate", "entityType": "user",
-                  "entityId": f"u{k}", "targetEntityType": "item",
-                  "targetEntityId": f"i{k % 3}",
-                  "properties": {"rating": float(k % 5)}}
-                 for k in range(20)]
+        yield srv, port
+    finally:
+        if srv is not None:
+            srv.stop()
+        Storage.reset()
+
+
+def _uniform_batch_docs(n):
+    return [{"event": "rate", "entityType": "user",
+             "entityId": f"u{k}", "targetEntityType": "item",
+             "targetEntityId": f"i{k % 3}",
+             "properties": {"rating": float(k % 5)}}
+            for k in range(n)]
+
+
+def test_batch_fast_path_ids_resolve(tmp_path):
+    """REST fast-path ids must be the ids the store actually holds."""
+    with _cpplog_server(tmp_path) as (srv, port):
+        batch = _uniform_batch_docs(20)
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/batch/events.json?accessKey=fk",
-            data=_json.dumps(batch).encode(),
+            data=json.dumps(batch).encode(),
             headers={"Content-Type": "application/json"})
-        res = _json.load(urllib.request.urlopen(req))
+        res = json.load(urllib.request.urlopen(req))
         assert all(r["status"] == 201 for r in res)
         for src, r in zip(batch, res):
-            got = _json.load(urllib.request.urlopen(
+            got = json.load(urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/events/{r['eventId']}.json"
                 "?accessKey=fk"))
             assert got["entityId"] == src["entityId"]
             assert got["properties"]["rating"] == src["properties"]["rating"]
-        srv.stop()
-    finally:
-        Storage.reset()
+
+
+def test_batch_with_blocker_takes_generic_path_with_full_visibility(
+        tmp_path):
+    """A registered input blocker must see EVERY event of a uniform batch
+    (the columnar fast path skips per-Event plugin visibility, so it must
+    disengage), and its veto surfaces as a per-event 500 — the
+    reference's blocker-veto status (EventServer.scala:409-412; 403 is
+    reserved for auth / allowed-names) — without touching the other
+    slots."""
+    from incubator_predictionio_tpu.servers.plugins import (
+        EventServerPlugin as _Plugin,
+    )
+
+    class Veto(_Plugin):
+        input_blocker = True
+        seen: list = []
+
+        def process(self, event_info, context):
+            Veto.seen.append(event_info.event.entity_id)
+            if event_info.event.entity_id == "u3":
+                raise ValueError("u3 is banned")
+
+    with _cpplog_server(tmp_path, access_key="bk") as (srv, port):
+        srv.plugin_context.plugins.append(Veto())
+        batch = _uniform_batch_docs(12)  # uniform — fast-path shaped
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/batch/events.json?accessKey=bk",
+            data=json.dumps(batch).encode(),
+            headers={"Content-Type": "application/json"})
+        res = json.load(urllib.request.urlopen(req))
+        # per-event isolation: only u3 blocked; everything else landed
+        assert [r["status"] for r in res] == [
+            201 if k != 3 else 500 for k in range(12)], res
+        # the blocker saw every event — the columnar fast path (which has
+        # no per-Event hook) must have disengaged
+        assert Veto.seen == [f"u{k}" for k in range(12)]

@@ -48,6 +48,34 @@ def _h(s: Optional[str]) -> int:
     return 0 if s is None else native.fnv1a64(s.encode("utf-8"))
 
 
+#: group-commit outcome sentinel: the merged append hit the sidecar
+#: limits, so the caller must retry its own batch alone (see
+#: CppLogEvents.insert_interactions)
+_RETRY_SOLO = object()
+
+
+class _PendingInsert:
+    """One caller's prepped columnar batch, waiting in the group-commit
+    queue. ``key`` is the scalar field tuple (app, channel, entity types,
+    event name, value prop) — only identical keys merge."""
+
+    __slots__ = ("key", "n", "times", "uidx", "iidx", "vals", "utab",
+                 "itab", "done", "ids", "error")
+
+    def __init__(self, key, n, times, uidx, iidx, vals, utab, itab):
+        self.key = key
+        self.n = n
+        self.times = times
+        self.uidx = uidx
+        self.iidx = iidx
+        self.vals = vals
+        self.utab = utab
+        self.itab = itab
+        self.done = threading.Event()
+        self.ids = None
+        self.error = None
+
+
 class StorageClient(base.BaseStorageClient):
     """Holds the log directory and open native handles."""
 
@@ -118,11 +146,23 @@ class CppLogEvents(base.Events):
     """Events DAO over the native log (contract: LEvents.scala:40-492)."""
 
     FAST_LOCAL = True  # native append, no fsync per op: ingest inline
+    #: insert_interactions coalesces concurrent callers into one native
+    #: append (see __init__) — the EventServer keys its dispatch policy
+    #: on this declared capability, not on private method names
+    GROUP_COMMIT = True
 
     def __init__(self, client: StorageClient,
                  config: base.StorageClientConfig, prefix: str = ""):
         self.client = client
         self.ns = prefix
+        # group-commit state for insert_interactions (the REST batch hot
+        # path): concurrent wire batches coalesce into ONE native append
+        # under the client lock. The per-append fixed cost (~0.3 ms:
+        # ctypes crossing + C++ buffered-write epilogue) otherwise caps
+        # 50-event wire batches at ~28k ev/s no matter how many clients
+        # post concurrently, because the client lock serializes appends.
+        self._gc_mu = threading.Lock()
+        self._gc_pending: list = []
 
     def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
         return self.client.handle(self.ns, app_id, channel_id)
@@ -639,17 +679,246 @@ class CppLogEvents(base.Events):
     ) -> list:
         """Columnar insert that RETURNS the stored event ids — the REST
         batch route's doc-level fast path (no per-event Python objects
-        anywhere between the wire and the log). Same write as
-        :meth:`import_interactions`; ids derived from the shared seed
-        formula (:meth:`_derive_event_ids`)."""
+        anywhere between the wire and the log). Ids come from the shared
+        seed formula (:meth:`_derive_event_ids`).
+
+        Group-committed: concurrent callers enqueue their prepped batch,
+        and whichever thread holds the client lock drains the queue and
+        appends every compatible pending batch as one native call (ids
+        sliced per caller from one seed run). Within a caller's batch,
+        log order is preserved; across concurrent callers, order was
+        never defined (they race on the wire too)."""
+        n = len(inter)
+        if n == 0:
+            return []
+        prep = self._prep_columnar(inter, times)
+        key = (app_id, channel_id, entity_type, target_entity_type,
+               event_name, value_prop)
+        item = _PendingInsert(key, n, *prep)
+        with self._gc_mu:
+            self._gc_pending.append(item)
+        if not item.done.is_set():
+            with self.client.lock:
+                with self._gc_mu:
+                    batch, self._gc_pending = self._gc_pending, []
+                if batch:
+                    self._commit_pending_locked(batch)
+        item.done.wait()
+        if item.error is _RETRY_SOLO:
+            # a merged append hit the sidecar limits (rc=-2, nothing
+            # written): one oversized sub-batch poisons the whole merge,
+            # so each caller retries alone — clean batches land, the
+            # offending one raises (and the server falls back to the
+            # generic per-event path, exactly the un-merged semantics)
+            return self._insert_interactions_direct(key, n, *prep)
+        if item.error is not None:
+            raise item.error
+        return item.ids
+
+    def _insert_interactions_direct(self, key, n, times_arr, uidx, iidx,
+                                    vals, utab, itab) -> list:
+        """Single un-grouped columnar insert (the group-commit retry
+        leg). Same observable behavior as a lone insert_interactions."""
         import secrets
 
         seed = int.from_bytes(secrets.token_bytes(8), "little")
-        n = self.import_interactions(
-            inter, app_id, channel_id, entity_type=entity_type,
-            target_entity_type=target_entity_type, event_name=event_name,
-            value_prop=value_prop, times=times, id_seed=seed)
+        with self.client.lock:
+            rc = self._append_columnar_locked(
+                key, n, times_arr, uidx, iidx, vals, utab, itab, seed)
+        if rc == -2:
+            raise base.StorageError(
+                "batch exceeds the native sidecar limits (id/field too "
+                "long or non-finite value)")
+        if rc != n:
+            raise base.StorageError("columnar interaction import failed")
         return self._derive_event_ids(seed, n)
+
+    def _commit_pending_locked(self, batch: list) -> None:
+        """Leader leg of the group commit: append every drained batch,
+        merging batches that share the scalar field tuple. Caller holds
+        the client lock. Every item's ``done`` event is set on every
+        path — a stranded waiter would hang a server thread forever."""
+        import secrets
+
+        groups: dict = {}
+        for it in batch:
+            groups.setdefault(it.key, []).append(it)
+        for key, items in groups.items():
+            try:
+                if len(items) == 1:
+                    it = items[0]
+                    n, merged = it.n, (it.times, it.uidx, it.iidx,
+                                       it.vals, it.utab, it.itab)
+                else:
+                    n, merged = self._merge_pending(items)
+                seed = int.from_bytes(secrets.token_bytes(8), "little")
+                rc = self._append_columnar_locked(key, n, *merged, seed)
+                if rc == n:
+                    ids = self._derive_event_ids(seed, n)
+                    off = 0
+                    for it in items:
+                        it.ids = ids[off:off + it.n]
+                        off += it.n
+                elif rc == -2:
+                    if len(items) == 1:
+                        items[0].error = base.StorageError(
+                            "batch exceeds the native sidecar limits "
+                            "(id/field too long or non-finite value)")
+                    else:
+                        for it in items:
+                            it.error = _RETRY_SOLO
+                else:
+                    err = base.StorageError(
+                        "columnar interaction import failed")
+                    for it in items:
+                        it.error = err
+            except Exception as e:  # noqa: BLE001 — must reach waiters
+                for it in items:
+                    if it.ids is None and it.error is None:
+                        it.error = e
+            finally:
+                for it in items:
+                    it.done.set()
+
+    @staticmethod
+    def _merge_pending(items: list):
+        """Concatenate pending batches into one columnar append: id
+        tables are concatenated (duplicates across sub-batches are fine —
+        the table is a lookup blob, not a unique index) and each
+        sub-batch's dense indices are shifted by the entries before it."""
+        import numpy as np
+
+        from incubator_predictionio_tpu.utils.times import now_utc
+
+        times_parts, uidx_parts, iidx_parts, vals_parts = [], [], [], []
+        ublobs, iblobs = [], []
+        uoffs_parts = [np.zeros(1, np.int64)]
+        ioffs_parts = [np.zeros(1, np.int64)]
+        u_entries = u_bytes = i_entries = i_bytes = 0
+        # one shared 'now' + a running offset for implicit-time sub-batches:
+        # per-sub-batch now() stamps can repeat within a millisecond, and a
+        # backward jump at a merge seam would dirty the native sorted index
+        # and defeat incremental projection maintenance — under exactly the
+        # concurrent load group commit exists for
+        now_ms = None
+        impl_off = 0
+        for it in items:
+            t = it.times
+            if t is None:
+                if now_ms is None:
+                    now_ms = to_millis(now_utc())
+                t = now_ms + impl_off + np.arange(it.n, dtype=np.int64)
+                impl_off += it.n
+            times_parts.append(t)
+            uidx_parts.append(it.uidx + np.int32(u_entries))
+            iidx_parts.append(it.iidx + np.int32(i_entries))
+            vals_parts.append(it.vals)
+            uoffs_parts.append(it.utab.offsets[1:] + u_bytes)
+            ioffs_parts.append(it.itab.offsets[1:] + i_bytes)
+            ublobs.append(it.utab.blob)
+            iblobs.append(it.itab.blob)
+            u_entries += len(it.utab)
+            u_bytes += len(it.utab.blob)
+            i_entries += len(it.itab)
+            i_bytes += len(it.itab.blob)
+        n = sum(it.n for it in items)
+        return n, (
+            np.concatenate(times_parts),
+            np.concatenate(uidx_parts),
+            np.concatenate(iidx_parts),
+            np.concatenate(vals_parts),
+            base.IdTable(b"".join(ublobs), np.concatenate(uoffs_parts)),
+            base.IdTable(b"".join(iblobs), np.concatenate(ioffs_parts)),
+        )
+
+    def _prep_columnar(self, inter: base.Interactions, times,
+                       base_time: Optional[datetime] = None):
+        """Validate + coerce one columnar batch to the native append's
+        array layout. ``times_arr`` stays None when neither explicit
+        times nor a base_time were given — the commit leg stamps 'now'
+        then, so a batch queued behind a slow group commit is stamped at
+        write time, not enqueue time."""
+        import numpy as np
+
+        n = len(inter)
+        if times is None:
+            if base_time is None:
+                times_arr = None
+            else:
+                times_arr = to_millis(base_time) + np.arange(n,
+                                                             dtype=np.int64)
+        else:
+            times_arr = np.ascontiguousarray(times, np.int64)
+            if times_arr.shape != (n,):
+                raise ValueError(
+                    f"times must have shape ({n},), got {times_arr.shape}")
+        uidx = np.ascontiguousarray(inter.user_idx, np.int32)
+        iidx = np.ascontiguousarray(inter.item_idx, np.int32)
+        vals = np.ascontiguousarray(inter.values, np.float32)
+        if iidx.shape != (n,) or vals.shape != (n,):
+            raise ValueError(
+                "user_idx/item_idx/values must all have shape "
+                f"({n},), got {iidx.shape} / {vals.shape}")
+        utab = (inter.user_ids if isinstance(inter.user_ids, base.IdTable)
+                else base.IdTable.from_list(inter.user_ids))
+        itab = (inter.item_ids if isinstance(inter.item_ids, base.IdTable)
+                else base.IdTable.from_list(inter.item_ids))
+        return times_arr, uidx, iidx, vals, utab, itab
+
+    def _append_columnar_locked(self, key, n, times_arr, uidx, iidx, vals,
+                                utab, itab, seed: int) -> int:
+        """One native columnar append + training-projection maintenance.
+        Caller holds the client lock. Returns the native rc (n on
+        success, -2 when the sidecar limits reject the batch — nothing
+        written in that case; eventlog.cc append_interactions is
+        all-or-nothing)."""
+        import numpy as np
+
+        from incubator_predictionio_tpu.utils.times import now_utc
+
+        (app_id, channel_id, entity_type, target_entity_type,
+         event_name, value_prop) = key
+        if times_arr is None:
+            times_arr = to_millis(now_utc()) + np.arange(n, dtype=np.int64)
+        uoffs = np.ascontiguousarray(utab.offsets, np.int64)
+        ioffs = np.ascontiguousarray(itab.offsets, np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        h = self._handle(app_id, channel_id)
+        raw_before = self.client.lib.pio_evlog_entry_count(h)
+        dead_before = self.client.lib.pio_evlog_dead_count(h)
+        rc = self.client.lib.pio_evlog_append_interactions(
+            h, n,
+            times_arr.ctypes.data_as(i64p),
+            uidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            iidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            utab.blob, uoffs.ctypes.data_as(i64p), len(utab),
+            itab.blob, ioffs.ctypes.data_as(i64p), len(itab),
+            entity_type.encode("utf-8"),
+            target_entity_type.encode("utf-8"),
+            event_name.encode("utf-8"),
+            value_prop.encode("utf-8"),
+            # the seed makes the generated event ids (and so the log
+            # bytes) reproducible — for deterministic re-imports and
+            # the thread-count byte-identity test
+            seed,
+        )
+        if rc == n:
+            try:
+                self._maintain_cache_after_import(
+                    h, app_id, channel_id, raw_before, dead_before,
+                    uidx, iidx, vals, times_arr, utab, itab,
+                    entity_type, target_entity_type, event_name,
+                    value_prop)
+            except Exception:
+                # the append already succeeded durably; the projection
+                # is an optimization the next scan rebuilds — raising
+                # here would make callers believe nothing was written
+                # (and retry-writers would then DUPLICATE the batch)
+                logger.exception(
+                    "training-projection maintenance failed after a "
+                    "successful import (next scan rebuilds it)")
+        return rc
 
     def import_interactions(
         self,
@@ -672,72 +941,18 @@ class CppLogEvents(base.Events):
         exceeds the sidecar limits (rc=-2)."""
         import secrets
 
-        import numpy as np
-
-        from incubator_predictionio_tpu.utils.times import now_utc
-
         n = len(inter)
         if n == 0:
             return 0
-        if times is None:
-            t0 = to_millis(base_time if base_time is not None else now_utc())
-            times_arr = t0 + np.arange(n, dtype=np.int64)
-        else:
-            times_arr = np.ascontiguousarray(times, np.int64)
-            if times_arr.shape != (n,):
-                raise ValueError(
-                    f"times must have shape ({n},), got {times_arr.shape}")
-        uidx = np.ascontiguousarray(inter.user_idx, np.int32)
-        iidx = np.ascontiguousarray(inter.item_idx, np.int32)
-        vals = np.ascontiguousarray(inter.values, np.float32)
-        if iidx.shape != (n,) or vals.shape != (n,):
-            raise ValueError(
-                "user_idx/item_idx/values must all have shape "
-                f"({n},), got {iidx.shape} / {vals.shape}")
-        utab = (inter.user_ids if isinstance(inter.user_ids, base.IdTable)
-                else base.IdTable.from_list(inter.user_ids))
-        itab = (inter.item_ids if isinstance(inter.item_ids, base.IdTable)
-                else base.IdTable.from_list(inter.item_ids))
-        uoffs = np.ascontiguousarray(utab.offsets, np.int64)
-        ioffs = np.ascontiguousarray(itab.offsets, np.int64)
-        i64p = ctypes.POINTER(ctypes.c_int64)
+        times_arr, uidx, iidx, vals, utab, itab = self._prep_columnar(
+            inter, times, base_time)
+        key = (app_id, channel_id, entity_type, target_entity_type,
+               event_name, value_prop)
+        seed = (int.from_bytes(secrets.token_bytes(8), "little")
+                if id_seed is None else (id_seed & 0xFFFFFFFFFFFFFFFF))
         with self.client.lock:
-            h = self._handle(app_id, channel_id)
-            raw_before = self.client.lib.pio_evlog_entry_count(h)
-            dead_before = self.client.lib.pio_evlog_dead_count(h)
-            rc = self.client.lib.pio_evlog_append_interactions(
-                h, n,
-                times_arr.ctypes.data_as(i64p),
-                uidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                iidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                utab.blob, uoffs.ctypes.data_as(i64p), len(utab),
-                itab.blob, ioffs.ctypes.data_as(i64p), len(itab),
-                entity_type.encode("utf-8"),
-                target_entity_type.encode("utf-8"),
-                event_name.encode("utf-8"),
-                value_prop.encode("utf-8"),
-                # id_seed makes the generated event ids (and so the log
-                # bytes) reproducible — for deterministic re-imports and
-                # the thread-count byte-identity test
-                int.from_bytes(secrets.token_bytes(8), "little")
-                if id_seed is None else (id_seed & 0xFFFFFFFFFFFFFFFF),
-            )
-            if rc == n:
-                try:
-                    self._maintain_cache_after_import(
-                        h, app_id, channel_id, raw_before, dead_before,
-                        uidx, iidx, vals, times_arr, utab, itab,
-                        entity_type, target_entity_type, event_name,
-                        value_prop)
-                except Exception:
-                    # the append already succeeded durably; the projection
-                    # is an optimization the next scan rebuilds — raising
-                    # here would make callers believe nothing was written
-                    # (and retry-writers would then DUPLICATE the batch)
-                    logger.exception(
-                        "training-projection maintenance failed after a "
-                        "successful import (next scan rebuilds it)")
+            rc = self._append_columnar_locked(
+                key, n, times_arr, uidx, iidx, vals, utab, itab, seed)
         if rc == -2:  # sidecar limits exceeded: generic per-Event path
             if id_seed is not None:
                 # the generic path generates random event ids — honoring

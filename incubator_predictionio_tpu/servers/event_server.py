@@ -225,7 +225,8 @@ class EventServer:
         def alive(request: Request) -> Response:
             return Response(200, {"status": "alive"})
 
-        def _register_post(pattern: str, handler) -> None:
+        def _register_post(pattern: str, handler, *,
+                           prefer_pool: bool = False) -> None:
             """Ingest hot-path dispatch policy: FAST_LOCAL backends
             (in-process index + native append, sub-ms inserts — memory,
             cpplog) run INLINE on the event loop; the executor round trip
@@ -235,8 +236,18 @@ class EventServer:
             pool so a slow insert never stalls every connection — and so
             do requests while input plugins are registered (a blocker/
             sniffer may do arbitrary I/O; decided per REQUEST, since
-            plugins can be present at startup only)."""
-            if getattr(self.events, "FAST_LOCAL", False):
+            plugins can be present at startup only).
+
+            Over a GROUP_COMMIT backend, EVERY ingest route goes to the
+            pool (``prefer_pool``): pool threads let N in-flight batches
+            merge into one native append, and the native call drops the
+            GIL so the next request's Python runs under the previous
+            request's C++ write. Crucially this must cover the
+            single-event and generic-batch legs too, not just the batch
+            fast path — those take the same storage lock, and an inline
+            handler blocking the event loop on a lock a pool thread
+            holds across a merged append would freeze every connection."""
+            if getattr(self.events, "FAST_LOCAL", False) and not prefer_pool:
                 async def dispatch(request, _h=handler):
                     ctx = self.plugin_context
                     if ctx.input_blockers or ctx.input_sniffers:
@@ -259,7 +270,11 @@ class EventServer:
                 return Response(400, {"message": str(e)})
             return self._ingest(auth, event)
 
-        _register_post("/events.json", create_event)
+        # one policy for every ingest route: a group-committing backend
+        # moves them ALL to the pool (see _register_post docstring)
+        pool_ingest = getattr(self.events, "GROUP_COMMIT", False)
+
+        _register_post("/events.json", create_event, prefer_pool=pool_ingest)
 
         @r.get("/events/{event_id}.json")
         def get_event(request: Request) -> Response:
@@ -411,7 +426,8 @@ class EventServer:
                         self._book(auth, 500, event.event)
             return Response(200, results)
 
-        _register_post("/batch/events.json", batch_events)
+        _register_post("/batch/events.json", batch_events,
+                       prefer_pool=pool_ingest)
 
         @r.get("/stats.json")
         def stats_route(request: Request) -> Response:

@@ -184,6 +184,23 @@ class Algorithm(_Component, Generic[PD, M, Q, P]):
         """
         return [(qx, self.predict(model, q)) for qx, q in queries]
 
+    def batch_serve_json(self, model: M, docs: Sequence[Any]
+                         ) -> Optional[List[Optional[bytes]]]:
+        """Optional serving fast path: raw parsed query docs → fully
+        rendered response-body bytes, skipping Query/Prediction object
+        construction and the jsonable tree walk entirely (the serving
+        analogue of the event store's columnar ingest path).
+
+        Return None when the algorithm has no such path; otherwise a list
+        aligned with ``docs`` where each slot is the response bytes —
+        BYTE-IDENTICAL to ``json.dumps(to_jsonable(serve-result))`` for a
+        first-prediction serving — or None for docs the fast path cannot
+        take (filtered/custom queries fall back to the object path). The
+        PredictionServer only consults this when serving is declared
+        first-prediction-only and no feedback/output plugins are active
+        (prediction_server._handle_batch)."""
+        return None
+
     def prepare_model(self, ctx: RuntimeContext, model: M) -> M:
         """Deploy-time hook: make a checkpoint-restored model servable.
 
@@ -239,6 +256,13 @@ class Serving(_Component, Generic[Q, P]):
     """Combines per-algorithm predictions into the served result
     (core/BaseServing.scala:41-53, controller/LServing.scala:30-54)."""
 
+    #: declared capability: ``serve`` returns predictions[0] unchanged and
+    #: ``supplement`` is the identity — the conditions under which the
+    #: PredictionServer may route plain queries through an algorithm's
+    #: ``batch_serve_json`` fast path (rendered bytes never see serve()).
+    #: Subclasses that override either method must leave this False.
+    FIRST_PREDICTION_ONLY = False
+
     def supplement(self, query: Q) -> Q:
         """Pre-process the query before algorithms see it (LServing.supplement:41)."""
         return query
@@ -249,6 +273,8 @@ class Serving(_Component, Generic[Q, P]):
 
 class FirstServing(Serving[Q, P]):
     """Serve the first algorithm's prediction (controller/LFirstServing.scala)."""
+
+    FIRST_PREDICTION_ONLY = True
 
     def serve(self, query: Q, predictions: Sequence[P]) -> P:
         return predictions[0]

@@ -565,29 +565,40 @@ class ALSAlgorithm(Algorithm):
         if plain:
             k = min(max(q.num for _qx, q in plain), len(model.item_bimap))
             rows = [model.user_bimap[q.user] for _qx, q in plain]
-            host = host_arrays(model, "user_factors", "item_factors")
-            if host is not None:
-                # model small enough for a host copy: one [B,K]@[K,I] numpy
-                # matmul is a few ms at any batch size, always under the
-                # device dispatch+fetch round trip such a model would pay
-                np_users, np_items = host
-                all_scores = np_users[rows] @ np_items.T
-                for row, (qx, q) in enumerate(plain):
-                    top_s, top_i = host_top_k(all_scores[row], k)
-                    out.append((qx, self._pack_scores(
-                        model, top_s[: q.num], top_i[: q.num])))
-            else:
-                packed = np.asarray(batch_score_top_k(     # ONE fetch
-                    model.user_factors, model.item_factors, rows, k))
-                top_s, top_i = packed[0], packed[1].astype(np.int64)
-                for row, (qx, q) in enumerate(plain):
-                    out.append((qx, self._pack_scores(
-                        model, top_s[row][: q.num], top_i[row][: q.num])))
+            tops = self._score_plain_batch(model, rows, k)
+            for (qx, q), (top_s, top_i) in zip(plain, tops):
+                out.append((qx, self._pack_scores(
+                    model, top_s[: q.num], top_i[: q.num])))
         handled = {qx for qx, _ in out}
         for qx, q in queries:
             if qx not in handled:
                 out.append((qx, self.predict(model, q)))
         return out
+
+    @staticmethod
+    def _score_plain_batch(model: ALSModel, rows, k: int):
+        """Score a batch of user rows and return per-row ``(top_s, top_i)``
+        pairs — the ONE copy of the host/device crossover shared by
+        ``batch_predict`` and ``batch_serve_json`` (the byte-identity
+        contract between those two paths depends on them scoring
+        identically)."""
+        from incubator_predictionio_tpu.ops.host_serving import (
+            host_arrays, host_top_k,
+        )
+        from incubator_predictionio_tpu.ops.topk import batch_score_top_k
+
+        host = host_arrays(model, "user_factors", "item_factors")
+        if host is not None:
+            # model small enough for a host copy: one [B,K]@[K,I] numpy
+            # matmul is a few ms at any batch size, always under the
+            # device dispatch+fetch round trip such a model would pay
+            np_users, np_items = host
+            all_scores = np_users[rows] @ np_items.T
+            return [host_top_k(all_scores[b], k) for b in range(len(rows))]
+        packed = np.asarray(batch_score_top_k(     # ONE fetch
+            model.user_factors, model.item_factors, rows, k))
+        return [(packed[0][b], packed[1][b].astype(np.int64))
+                for b in range(len(rows))]
 
     def warmup(self, model: ALSModel, max_batch: int = 1) -> None:
         """Pre-compile the serving dispatches (core/base.py Algorithm.warmup):
@@ -614,11 +625,71 @@ class ALSAlgorithm(Algorithm):
 
     def _pack_scores(self, model: ALSModel, scores, indices) -> PredictedResult:
         inv = model.item_bimap.inverse
-        return PredictedResult(item_scores=tuple(
-            ItemScore(item=inv[int(i)], score=float(s),
-                      creation_year=model.item_years.get(inv[int(i)]))
-            for s, i in zip(scores, indices) if s > -1e37
-        ))
+        years = model.item_years
+        packed = []
+        for s, i in zip(scores, indices):
+            if s > -1e37:
+                iid = inv[int(i)]
+                packed.append(ItemScore(item=iid, score=float(s),
+                                        creation_year=years.get(iid)))
+        return PredictedResult(item_scores=tuple(packed))
+
+    def batch_serve_json(self, model: ALSModel, docs) -> list:
+        """Columnar serving fast path (core/base.py batch_serve_json): the
+        plain ``{"user": ..., "num": ...}`` wire shape renders straight
+        from the batched top-k arrays to response bytes — no Query /
+        ItemScore / PredictedResult objects, no jsonable tree walk. Output
+        is byte-identical to ``json.dumps(to_jsonable(...))`` of the
+        object path (pinned by tests/test_prediction_server.py); anything
+        else (extra keys, unknown user, filters) stays None and falls to
+        the object path."""
+        import json as _json
+        import math
+
+        get_row = model.user_bimap.get
+        plain = []  # (slot, row, num)
+        for slot, d in enumerate(docs):
+            if (type(d) is dict and len(d) == 2 and "user" in d
+                    and "num" in d):
+                u, num = d["user"], d["num"]
+                if (isinstance(u, str) and isinstance(num, int)
+                        and not isinstance(num, bool) and num > 0):
+                    row = get_row(u)
+                    if row is not None:
+                        plain.append((slot, row, num))
+        out: list = [None] * len(docs)
+        if not plain:
+            return out
+        k = min(max(num for _s, _r, num in plain), len(model.item_bimap))
+        rows = [r for _s, r, _n in plain]
+        tops = self._score_plain_batch(model, rows, k)
+        inv = model.item_bimap.inverse
+        years = model.item_years
+        dumps = _json.dumps
+        isfinite = math.isfinite
+        for (slot, _row, num), (top_s, top_i) in zip(plain, tops):
+            parts = []
+            ok = True
+            for s, i in zip(top_s[:num].tolist(), top_i[:num].tolist()):
+                if s > -1e37:
+                    if not isfinite(s):
+                        # repr(inf) is not JSON (json.dumps says
+                        # 'Infinity') — an overflowed score falls back
+                        # to the object path rather than diverge
+                        ok = False
+                        break
+                    iid = inv[i]
+                    y = years.get(iid)
+                    # mirror json.dumps' default formatting exactly
+                    # (', '/': ' separators, float repr)
+                    parts.append('{"item": %s, "score": %s, '
+                                 '"creationYear": %s}'
+                                 % (dumps(iid), repr(s),
+                                    "null" if y is None else repr(y)))
+            if ok:
+                out[slot] = ('{"itemScores": [' + ", ".join(parts)
+                             + "]}").encode("utf-8")
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -627,6 +698,8 @@ class ALSAlgorithm(Algorithm):
 
 class RecommendationServing(Serving):
     """First-algorithm serving (Serving.scala / LFirstServing)."""
+
+    FIRST_PREDICTION_ONLY = True
 
     def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
         return predictions[0]

@@ -317,10 +317,48 @@ class PredictionServer:
             return [HttpError(503, "No engine instance deployed.")] * n
         query_class = algorithms[0].query_class
         results: List[Any] = [None] * n
-        parsed: List[Any] = []  # [idx, raw, query, supplemented]
+        raws: List[Any] = [None] * n
         for idx, body in enumerate(bodies):
             try:
-                raw = json.loads(body.decode("utf-8"))
+                raws[idx] = json.loads(body.decode("utf-8"))
+            except Exception as e:
+                results[idx] = e
+        # columnar serving fast path (core/base.py batch_serve_json): only
+        # when the rendered bytes are observably identical to the object
+        # path — one algorithm, declared first-prediction serving with the
+        # inherited identity supplement, and nothing downstream that needs
+        # the result as an object (feedback loop, output plugins)
+        from incubator_predictionio_tpu.core.base import Serving
+
+        # the flag must be declared on the serving's OWN class: a subclass
+        # that overrides serve() would silently inherit True and its
+        # serve() would never run on fast-path responses
+        if (len(algorithms) == 1
+                and type(serving).__dict__.get("FIRST_PREDICTION_ONLY",
+                                               False)
+                and type(serving).supplement is Serving.supplement
+                and not self.config.feedback
+                and not self.plugin_context.output_blockers
+                and not self.plugin_context.output_sniffers):
+            try:
+                fast = algorithms[0].batch_serve_json(
+                    models[0],
+                    [r if results[i] is None else None
+                     for i, r in enumerate(raws)])
+            except Exception:
+                logger.exception(
+                    "batch_serve_json failed; using the object path")
+                fast = None
+            if fast:
+                for idx, payload in enumerate(fast):
+                    if payload is not None and results[idx] is None:
+                        results[idx] = payload
+        parsed: List[Any] = []  # [idx, raw, query, supplemented]
+        for idx, body in enumerate(bodies):
+            if results[idx] is not None:
+                continue
+            try:
+                raw = raws[idx]
                 query = (
                     json_codec.extract(query_class, raw)
                     if query_class is not None else raw
@@ -542,6 +580,9 @@ class PredictionServer:
                 raise
             except (ValueError, KeyError) as e:
                 return Response(400, {"message": str(e)})
+            if isinstance(result, (bytes, bytearray)):
+                # batch_serve_json fast path: body already rendered
+                return Response(200, body=bytes(result))
             return Response(200, result)
 
         @r.post("/reload")

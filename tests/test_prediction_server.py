@@ -432,3 +432,172 @@ def test_warmup_hook_runs_after_bind(stack, caplog):
                 break
             time.sleep(0.05)
     assert any("warmup failed" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# batch_serve_json columnar fast path (core/base.py batch_serve_json;
+# models/recommendation/engine.py ALSAlgorithm.batch_serve_json)
+# ---------------------------------------------------------------------------
+
+def _als_fixture():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from incubator_predictionio_tpu.data.bimap import BiMap
+    from incubator_predictionio_tpu.models.recommendation.engine import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        ALSModel,
+    )
+
+    rng = np.random.default_rng(3)
+    nu, ni, k = 40, 25, 8
+    model = ALSModel(
+        user_factors=jnp.asarray(rng.normal(size=(nu, k)).astype(np.float32)),
+        item_factors=jnp.asarray(rng.normal(size=(ni, k)).astype(np.float32)),
+        user_bimap=BiMap({f"u{i}": i for i in range(nu)}),
+        item_bimap=BiMap({f"i{i}": i for i in range(ni)}),
+        item_years={"i3": 1999, "i7": 2004},
+        item_categories={},
+    )
+    return ALSAlgorithm(ALSAlgorithmParams(rank=k)), model
+
+
+def test_batch_serve_json_byte_identical_to_object_path():
+    """The rendered fast-path bytes must be exactly what the object path
+    would put on the wire FOR THE SAME BATCH: batch_predict → serve →
+    json.dumps(to_jsonable(...)). (Compared against the batched object
+    path, not per-query predict: the batched matmul's f32 rounding is the
+    wire truth for any batch the micro-batcher forms.)"""
+    from incubator_predictionio_tpu.models.recommendation.engine import Query
+    from incubator_predictionio_tpu.utils import json_codec
+
+    algo, model = _als_fixture()
+    docs = [
+        {"user": "u1", "num": 5},
+        {"user": "u2", "num": 10},
+        {"user": "u39", "num": 3},
+    ]
+    fast = algo.batch_serve_json(model, docs)
+    assert all(isinstance(b, bytes) for b in fast)
+    objs = dict(algo.batch_predict(model, [
+        (i, Query(user=d["user"], num=d["num"]))
+        for i, d in enumerate(docs)]))
+    for i, (d, payload) in enumerate(zip(docs, fast)):
+        expect = json.dumps(json_codec.to_jsonable(objs[i])).encode()
+        assert payload == expect, (d, payload, expect)
+
+
+def test_batch_serve_json_rejects_non_plain_docs():
+    """Anything beyond the exact plain shape falls to the object path."""
+    algo, model = _als_fixture()
+    docs = [
+        {"user": "u1", "num": 5, "creationYear": 2000},  # extra key
+        {"user": "nosuch", "num": 5},                    # unknown user
+        {"user": "u1"},                                   # missing num
+        {"user": "u1", "num": True},                      # bool num
+        {"user": "u1", "num": 0},                         # non-positive
+        {"user": 7, "num": 5},                            # non-str user
+        ["not", "a", "dict"],
+        None,
+        {"user": "u1", "num": 5},                         # one good slot
+    ]
+    fast = algo.batch_serve_json(model, docs)
+    assert fast[:-1] == [None] * (len(docs) - 1)
+    assert isinstance(fast[-1], bytes)
+
+
+def test_fast_path_negative_gate_through_http(stack):
+    """The fake_engine stack's serving is not FIRST_PREDICTION_ONLY, so
+    this exercises the NEGATIVE gate: the object path still answers."""
+    _ps, port, _es, _es_port = stack
+    status, body = call(port, "POST", "/queries.json", {"qx": 1})
+    assert status == 200
+
+
+def test_fast_path_served_through_http():
+    """POSITIVE gate end-to-end: an ALS engine with stock serving behind
+    the REAL server answers plain queries from the bytes fast path, and
+    the wire body is exactly the object path's rendering for the same
+    singleton batch; filtered queries still take the object path."""
+    import threading
+
+    from incubator_predictionio_tpu.data.storage import (
+        EngineInstance,
+        Storage,
+    )
+    from incubator_predictionio_tpu.models.recommendation.engine import (
+        Query,
+        RecommendationServing,
+    )
+    from incubator_predictionio_tpu.servers.prediction_server import (
+        _AsyncPoster,
+        _MicroBatcher,
+    )
+    from incubator_predictionio_tpu.utils import json_codec
+    from incubator_predictionio_tpu.utils.http import HttpServer
+    from incubator_predictionio_tpu.utils.times import now_utc
+    from incubator_predictionio_tpu.workflow.workflow import (
+        make_runtime_context,
+    )
+
+    algo, model = _als_fixture()
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    now = now_utc()
+    srv = PredictionServer.__new__(PredictionServer)
+    srv.engine = None
+    srv.config = ServerConfig(ip="127.0.0.1", port=0)
+    srv.plugin_context = PluginContext()
+    srv.ctx = make_runtime_context(None)
+    srv._lock = threading.Lock()
+    srv.engine_instance = EngineInstance(
+        id="t", status="COMPLETED", start_time=now, end_time=now,
+        engine_id="t", engine_version="1", engine_variant="t",
+        engine_factory="t")
+    srv.engine_params = None
+    srv.algorithms = [algo]
+    srv.serving = RecommendationServing()
+    srv.models = [model]
+    srv.start_time = now
+    srv.request_count = 0
+    srv.avg_serving_sec = 0.0
+    srv.last_serving_sec = 0.0
+    srv.max_batch_served = 0
+    srv._conf_server_key = None
+    srv.http = HttpServer(srv._build_router(), "127.0.0.1", 0)
+    srv._batcher = _MicroBatcher(srv._handle_batch, srv.config.micro_batch)
+    srv._feedback_poster = _AsyncPoster("feedback")
+    srv._log_poster = _AsyncPoster("log", workers=1)
+    port = srv.http.start_background()
+    try:
+        url = f"http://127.0.0.1:{port}/queries.json"
+        req = urllib.request.Request(
+            url, data=json.dumps({"user": "u1", "num": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            wire = resp.read()
+        # the wire body is byte-identical to the object path's rendering
+        # for the same singleton batch
+        objs = dict(algo.batch_predict(model, [(0, Query(user="u1",
+                                                         num=5))]))
+        assert wire == json.dumps(json_codec.to_jsonable(objs[0])).encode()
+        # a filtered query still answers via the object path
+        req = urllib.request.Request(
+            url, data=json.dumps({"user": "u1", "num": 3,
+                                  "blacklist": ["i1"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            doc = json.loads(resp.read())
+        assert "i1" not in [s["item"] for s in doc["itemScores"]]
+        assert srv.request_count == 2  # stats cover both paths
+    finally:
+        srv.stop()
+        Storage.reset()

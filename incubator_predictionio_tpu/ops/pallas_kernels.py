@@ -37,6 +37,7 @@ block shapes before production code selects it.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -519,6 +520,57 @@ def _flash_with_vjp(causal: bool, scale: float, q_block: int, kv_block: int,
     return f
 
 
+#: measured per-length block optima on v5e (scripts/flash_tune.py,
+#: dispatch-amortized, jitted both sides; re-run after kernel/toolchain
+#: changes). Keys are the smallest sweep length ≥ S; larger S reuse the
+#: longest entry. Override per deployment:
+#: PIO_FLASH_BLOCKS="8192:2048x512,16384:1024x1024,32768:1024x1024"
+_FLASH_BLOCK_TABLE: "tuple" = (
+    # (max_seq, q_block, kv_block)
+    (8192, 2048, 512),      # 3.99 ms vs 13.10 ms XLA blockwise (3.3×)
+    (16384, 1024, 1024),    # 9.06 ms vs 38.51 ms (4.3×)
+    (1 << 62, 1024, 1024),  # 27.97 ms vs 161 ms at 32k (5.8×)
+)
+
+
+def _parse_block_env() -> "Optional[tuple]":
+    raw = os.environ.get("PIO_FLASH_BLOCKS", "").strip()
+    if not raw:
+        return None
+    try:
+        entries = []
+        for part in raw.split(","):
+            s, _, qk = part.partition(":")
+            qb, _, kb = qk.partition("x")
+            entry = (int(s), int(qb), int(kb))
+            if min(entry) <= 0:
+                raise ValueError("block sizes must be positive")
+            entries.append(entry)
+        entries.sort()
+        # the last entry also covers every longer sequence
+        entries[-1] = (1 << 62, entries[-1][1], entries[-1][2])
+        return tuple(entries)
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring malformed PIO_FLASH_BLOCKS=%r "
+            "(want e.g. 8192:2048x512,16384:1024x1024)", raw)
+        return None
+
+
+_FLASH_BLOCKS_ACTIVE = _parse_block_env() or _FLASH_BLOCK_TABLE
+
+
+def default_flash_blocks(s_q: int) -> "tuple":
+    """(q_block, kv_block) for sequence length ``s_q`` from the measured
+    table (or the PIO_FLASH_BLOCKS override)."""
+    for max_s, qb, kb in _FLASH_BLOCKS_ACTIVE:
+        if s_q <= max_s:
+            return qb, kb
+    return 1024, 1024
+
+
 def flash_attention(
     q: jax.Array,                   # [B, S, H, D]
     k: jax.Array,
@@ -526,8 +578,8 @@ def flash_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     kv_valid: Optional[jax.Array] = None,   # [S] or [B, S] bool
-    q_block: int = 1024,
-    kv_block: int = 1024,
+    q_block: Optional[int] = None,
+    kv_block: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Fused attention on BSHD arrays; same contract as
@@ -537,12 +589,11 @@ def flash_attention(
     is a grid dimension; the online-softmax state rides in VMEM scratch),
     so VMEM use is S-independent — any sequence length fits, and causal
     query blocks skip their strictly-future KV blocks. The [S, S] logit
-    matrix never exists in HBM. Block defaults are the measured v5e
-    optimum (dispatch-amortized sweep over 256..2048: 1024×1024 wins at
-    both 8k and 32k; 2048 q-blocks exceed VMEM): vs the XLA blockwise scan
-    flash is 0.68× at S=8k (the scan wins below the ~8k crossover —
-    transformer._default_attn routes accordingly) and 5.76× at S=32k
-    (BASELINE.md run: 27.97 vs 161.18 ms).
+    matrix never exists in HBM. Block defaults come from the measured
+    per-length table (:data:`_FLASH_BLOCK_TABLE`, scripts/flash_tune.py
+    sweep on v5e; PIO_FLASH_BLOCKS overrides): with them flash beats the
+    XLA blockwise scan 3.3× at S=8k, 4.3× at 16k and 5.8× at 32k —
+    transformer._default_attn routes to flash above FLASH_MIN_SEQ.
     Differentiable: backward runs through the XLA blockwise reference
     (see :func:`_flash_with_vjp`).
     """
@@ -551,6 +602,10 @@ def flash_attention(
     b, _s_q, _h, d = q.shape
     s_kv = k.shape[1]
     sc = scale if scale is not None else d ** -0.5
+    if q_block is None or kv_block is None:
+        dq, dk = default_flash_blocks(_s_q)
+        q_block = dq if q_block is None else q_block
+        kv_block = dk if kv_block is None else kv_block
 
     if kv_valid is None:
         valid = jnp.ones((b, s_kv), jnp.float32)

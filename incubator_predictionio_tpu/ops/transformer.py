@@ -88,12 +88,13 @@ def _rms_norm(x, scale):
     return x * jax.lax.rsqrt(var + 1e-6) * scale
 
 
-#: sequence length above which the Pallas flash kernel serves instead of
-#: the XLA blockwise scan. Measured crossover on v5e with dispatch
+#: sequence length from which (inclusive) the Pallas flash kernel serves
+#: instead of the XLA blockwise scan. Measured on v5e with dispatch
 #: amortized (scripts/flash_tune.py sweeps block shapes and re-measures
-#: this): the scan won at S=8k in the round-4 block configuration while
-#: flash won 5.76x at 32k. Re-run the sweep after kernel/toolchain
-#: changes and update here (or override per deployment via env).
+#: this): with the per-length block table (pallas_kernels.py) flash wins
+#: 3.3x at 8k, 4.3x at 16k, 5.8x at 32k. Below 8k is unmeasured on
+#: chip, so the scan keeps it for now. Re-run the sweep after
+#: kernel/toolchain changes and update here (or override via env).
 def _flash_min_seq() -> int:
     raw = os.environ.get("PIO_FLASH_MIN_SEQ", "")
     try:
@@ -116,7 +117,7 @@ def _default_attn(q, k, v, causal=True, kv_valid=None):
     # flash streams KV block-by-block (kv is a grid dimension), so VMEM use
     # is S-independent — no length cap; the crossover constant above picks
     # the faster implementation per length.
-    if FLASH_MIN_SEQ < q.shape[1]:
+    if FLASH_MIN_SEQ <= q.shape[1]:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
             flash_attention, flash_available)
         if flash_available():

@@ -214,6 +214,10 @@ class PredictionServer:
         self.plugin_context = plugin_context or PluginContext()
         self.ctx = ctx or make_runtime_context(None)
         self._lock = threading.Lock()
+        #: serializes /reload end-to-end: with pre-swap warmup the
+        #: resolve→swap window is seconds long, and two unserialized
+        #: reloads could last-writer-swap an OLDER instance back in
+        self._reload_lock = threading.Lock()
         # serving state (swapped atomically on /reload)
         self.engine_instance: Optional[EngineInstance] = None
         self.engine_params: Optional[EngineParams] = None
@@ -273,14 +277,24 @@ class PredictionServer:
                 )
         return instance
 
-    def load_models(self) -> None:
-        """createServerActorWithEngine (:207-266): restore + prepare_deploy."""
+    def load_models(self, warm_before_swap: bool = False) -> None:
+        """createServerActorWithEngine (:207-266): restore + prepare_deploy.
+
+        ``warm_before_swap`` is the /reload path's double-buffered
+        refresh: the OLD models keep serving while the replacements
+        compile their dispatches and build host mirrors (algo.warmup), and
+        the swap happens only once they are query-ready — a reload never
+        spikes live p50 with compiles or a tunnel-priced device→host
+        fetch. Initial deploy keeps warmup async (nothing serves yet;
+        binding fast matters more)."""
         instance = self._resolve_instance()
         engine_params = self.engine.engine_params_from_instance(instance)
         models = CoreWorkflow.load_models(
             instance.id, self.engine, engine_params, ctx=self.ctx
         )
         _ds, _prep, algorithms, serving = self.engine.components(engine_params)
+        if warm_before_swap:
+            self._warm_models(algorithms, models)
         with self._lock:
             self.engine_instance = instance
             self.engine_params = engine_params
@@ -588,10 +602,12 @@ class PredictionServer:
         @r.post("/reload")
         def reload(request: Request) -> Response:
             self._check_server_key(request)
-            self.load_models()
-            # the new models' shapes may differ (catalog size, rank) —
-            # re-warm so live traffic doesn't pay the compile
-            self._warmup_async()
+            # double-buffered: new models warm (compiles + host mirrors,
+            # shapes may differ — catalog size, rank) BEFORE the swap;
+            # the old models serve every query until then. Serialized so
+            # overlapping reloads cannot swap instances out of order.
+            with self._reload_lock:
+                self.load_models(warm_before_swap=True)
             return Response(200, {"message": "Reloaded."})
 
         @r.post("/stop")
@@ -672,18 +688,31 @@ class PredictionServer:
                 "A process at %s:%d did not respond properly to /stop "
                 "(%s); unable to undeploy.", ip, self.config.port, e)
 
+    def _warm_models(self, algorithms, models) -> None:
+        """Warm every algorithm's serving dispatches (compiles + host
+        mirrors). One copy of the max_batch rule and the per-algo
+        except-log-continue contract, shared by the async startup warmup
+        and the pre-swap /reload warmup. Failures are logged, never
+        fatal: warmup is an optimization, the query path compiles on
+        demand regardless."""
+        # a disabled micro-batcher means live traffic never reaches the
+        # batched dispatch — don't compile it
+        max_batch = self.config.micro_batch if self._batcher is not None else 0
+        for algo, model in zip(algorithms, models):
+            try:
+                algo.warmup(model, max_batch=max_batch)
+            except Exception:
+                logger.exception(
+                    "serving warmup failed for %s (first queries will "
+                    "compile on demand)", type(algo).__name__)
+
     def _warmup_async(self) -> None:
         """Pre-compile serving dispatches on a daemon thread AFTER the
         server binds — the first real query otherwise pays the XLA compile
         (seconds on TPU). The thread waits on the HTTP server's started
         event so warmup tracing never delays the bind (the foreground
-        serve_forever path spawns this before the loop starts). Failures
-        are logged, never fatal: warmup is an optimization, the query
-        path compiles on demand regardless."""
+        serve_forever path spawns this before the loop starts)."""
         algorithms, models = self.algorithms, self.models
-        # a disabled micro-batcher means live traffic never reaches the
-        # batched dispatch — don't compile it
-        max_batch = self.config.micro_batch if self._batcher is not None else 0
 
         def run() -> None:
             if not self.http.wait_started(60.0):
@@ -692,13 +721,7 @@ class PredictionServer:
                     "60s (queries will compile on demand if it ever does)")
                 return
             t0 = time.perf_counter()
-            for algo, model in zip(algorithms, models):
-                try:
-                    algo.warmup(model, max_batch=max_batch)
-                except Exception:
-                    logger.exception(
-                        "serving warmup failed for %s (first queries will "
-                        "compile on demand)", type(algo).__name__)
+            self._warm_models(algorithms, models)
             logger.info("serving warmup done in %.1fs",
                         time.perf_counter() - t0)
 

@@ -488,3 +488,56 @@ def test_batch_with_blocker_takes_generic_path_with_full_visibility(
         # the blocker saw every event — the columnar fast path (which has
         # no per-Event hook) must have disengaged
         assert Veto.seen == [f"u{k}" for k in range(12)]
+
+
+def test_concurrent_batches_group_commit(tmp_path):
+    """Concurrent uniform batches over the group-committing cpplog store:
+    every event lands exactly once, every returned id resolves, and ids
+    never collide across merged sub-batches (cpplog._commit_pending_locked
+    slices one seed run per merge)."""
+    import threading
+
+    with _cpplog_server(tmp_path) as (srv, port):
+        n_threads, batches_each, bs = 8, 6, 12
+        all_ids: list = []
+        errs: list = []
+        lock = threading.Lock()
+
+        def worker(t: int) -> None:
+            try:
+                for b in range(batches_each):
+                    docs = [{
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": f"t{t}_b{b}_u{k}",
+                        "targetEntityType": "item",
+                        "targetEntityId": f"i{k}",
+                        "properties": {"rating": float(1 + k % 5)},
+                    } for k in range(bs)]
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/batch/events.json"
+                        "?accessKey=fk",
+                        data=json.dumps(docs).encode(),
+                        headers={"Content-Type": "application/json"})
+                    res = json.load(urllib.request.urlopen(req))
+                    assert all(r["status"] == 201 for r in res), res
+                    with lock:
+                        all_ids.extend(r["eventId"] for r in res)
+            except Exception as e:  # surface in the main thread
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs, errs
+        expect = n_threads * batches_each * bs
+        assert len(all_ids) == expect
+        assert len(set(all_ids)) == expect  # no id collisions across merges
+        # total landed count is exact (no loss, no duplication)
+        got = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/events.json?accessKey=fk"
+            f"&limit={expect + 100}"))
+        assert len(got) == expect

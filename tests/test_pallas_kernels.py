@@ -234,3 +234,22 @@ class TestAlsCgKernel:
         # so it may be (slightly) more accurate than the bf16 XLA path
         assert r_krn < max(1.15 * r_xla, r_xla + 0.02), (r_krn, r_xla)
         assert r_krn < 0.1, r_krn
+
+
+def test_flash_block_table_selection(monkeypatch):
+    """default_flash_blocks picks the measured per-length optimum and the
+    PIO_FLASH_BLOCKS override parses (malformed values fall back)."""
+    from incubator_predictionio_tpu.ops import pallas_kernels as pk
+
+    assert pk.default_flash_blocks(1024) == (2048, 512)
+    assert pk.default_flash_blocks(8192) == (2048, 512)
+    assert pk.default_flash_blocks(8193) == (1024, 1024)
+    assert pk.default_flash_blocks(16384) == (1024, 1024)
+    assert pk.default_flash_blocks(1 << 20) == (1024, 1024)
+
+    monkeypatch.setenv("PIO_FLASH_BLOCKS", "4096:256x512,16384:512x1024")
+    parsed = pk._parse_block_env()
+    assert parsed == ((4096, 256, 512), (1 << 62, 512, 1024))
+
+    monkeypatch.setenv("PIO_FLASH_BLOCKS", "garbage")
+    assert pk._parse_block_env() is None

@@ -583,7 +583,7 @@ class ALSAlgorithm(Algorithm):
         contract between those two paths depends on them scoring
         identically)."""
         from incubator_predictionio_tpu.ops.host_serving import (
-            host_arrays, host_top_k,
+            host_arrays, host_batch_top_k,
         )
         from incubator_predictionio_tpu.ops.topk import batch_score_top_k
 
@@ -593,8 +593,8 @@ class ALSAlgorithm(Algorithm):
             # matmul is a few ms at any batch size, always under the
             # device dispatch+fetch round trip such a model would pay
             np_users, np_items = host
-            all_scores = np_users[rows] @ np_items.T
-            return [host_top_k(all_scores[b], k) for b in range(len(rows))]
+            top_s, top_i = host_batch_top_k(np_users[rows] @ np_items.T, k)
+            return [(top_s[b], top_i[b]) for b in range(len(rows))]
         packed = np.asarray(batch_score_top_k(     # ONE fetch
             model.user_factors, model.item_factors, rows, k))
         return [(packed[0][b], packed[1][b].astype(np.int64))

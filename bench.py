@@ -423,9 +423,19 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str) -> None:
     chip (this is the call a stale lease blocks forever — the parent's
     recycle window covers it). On success, touch the claim file so the
     parent switches from 'dial watchdog' to 'run watchdog'."""
+    from incubator_predictionio_tpu.utils.lease import install_sigterm_exit
+
     import jax
 
+    # NO SIGTERM handler before the dial: a waiter blocked inside the
+    # PJRT constructor can only be stopped by the default OS-level kill
+    # (a Python handler never fires inside a blocked C call), and the
+    # parent's recycle depends on being able to kill waiters
     jax.devices()  # the dial
+    # claimed from here on: SIGTERM must now tear the process down via
+    # normal interpreter shutdown — an abrupt death while HOLDING the
+    # chip wedges the single-tenant lease for hours
+    install_sigterm_exit()
     with open(claim_path, "w") as f:
         f.write(str(os.getpid()))
     log(f"tpu child: accelerator up ({jax.devices()[0]})")

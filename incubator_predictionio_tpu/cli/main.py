@@ -603,6 +603,12 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from incubator_predictionio_tpu.utils.lease import install_sigterm_exit
+
+    # device verbs may hold the chip: SIGTERM must exit via normal
+    # interpreter shutdown or the single-tenant lease wedges (see
+    # utils/lease.py and the _ensure_accelerator docstring)
+    install_sigterm_exit()
     # honor the user's JAX_PLATFORMS even on images whose site
     # customization pre-imports jax and pins the platform config at
     # interpreter start (env vars are read only at import time, so the

@@ -550,11 +550,6 @@ class ALSAlgorithm(Algorithm):
         micro-batcher routes concurrent /queries.json traffic here —
         CreateServer.scala:523 leaves this as "TODO: Parallelize"). Filtered
         queries fall back to per-query predict."""
-        from incubator_predictionio_tpu.ops.host_serving import (
-            host_arrays, host_top_k,
-        )
-        from incubator_predictionio_tpu.ops.topk import batch_score_top_k
-
         plain = [
             (qx, q) for qx, q in queries
             if q.creation_year is None and not q.categories

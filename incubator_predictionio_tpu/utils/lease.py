@@ -1,0 +1,62 @@
+"""Single-tenant accelerator lease safety helpers.
+
+On this platform a process killed ABRUPTLY while holding the chip (its
+PJRT client mid-RPC) wedges the single-tenant lease for every later
+process — observed: hours-long wedges after a `timeout`-style SIGTERM,
+whose default Python action is immediate death with no interpreter
+shutdown (no atexit, no client destructors, sockets torn mid-frame). The
+lease-safety contract (cli/main.py _ensure_accelerator docstring): any
+TPU-touching process must exit via NORMAL interpreter shutdown so the
+relay sees a clean disconnect.
+
+:func:`install_sigterm_exit` converts SIGTERM into ``SystemExit`` so
+`timeout`, supervisors, and Ctrl-style termination tear the process down
+through the interpreter instead of around it. The handler runs between
+bytecodes: a dispatch blocked inside the PJRT client returns first, then
+the exit proceeds — exactly the "finish the op, then leave cleanly"
+behavior the lease needs.
+
+Install-ORDER contract: TPU entry points that dial on the main thread
+(bench children, kernel-tuning scripts) install the handler AFTER
+``jax.devices()`` returns — a waiter blocked inside the PJRT constructor
+can only be stopped by the default OS-level kill (a Python handler never
+fires inside a blocked C call), and supervisors depend on being able to
+kill waiters; only a process that HOLDS the chip needs the graceful
+exit. The CLI installs at entry because its dial runs on a daemon probe
+thread (cli/main.py _ensure_accelerator) — the main thread stays
+signal-interruptible throughout.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+
+def install_sigterm_exit(code: int = 143) -> bool:
+    """Install a SIGTERM → ``SystemExit(code)`` handler (main thread
+    only; signal handlers cannot be installed elsewhere). Returns True
+    when installed. Idempotent; never raises."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        def _exit(_signum, _frame):
+            # raising (not os._exit) unwinds through finally blocks and
+            # atexit, closing the PJRT client's sockets cleanly
+            raise SystemExit(code)
+
+        signal.signal(signal.SIGTERM, _exit)
+        return True
+    except (ValueError, OSError):  # non-main interpreter contexts
+        return False
+
+
+def _selftest() -> None:  # pragma: no cover - manual aid
+    install_sigterm_exit()
+    signal.raise_signal(signal.SIGTERM)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _selftest()
+    sys.exit(1)  # unreachable if the handler worked

@@ -24,10 +24,16 @@ import numpy as np
 
 
 def main() -> int:
+    from incubator_predictionio_tpu.utils.lease import install_sigterm_exit
+
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # dial as a killable waiter, then make SIGTERM a clean exit so a
+    # timeout-kill mid-run cannot wedge the lease we now hold
+    jax.devices()
+    install_sigterm_exit()
 
     n_users = int(os.environ.get("PIO_TUNE_USERS", 138_493))
     n_items = int(os.environ.get("PIO_TUNE_ITEMS", 26_744))

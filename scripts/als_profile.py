@@ -6,8 +6,11 @@ model), so the mixed-schedule defaults in ops/als.py are measured on
 both axes — speed and RMSE parity with the all-f32 run.
 Run on the real TPU. Usage: python scripts/als_profile.py [nnz]
 """
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -17,7 +20,14 @@ PLANT_RANK, NOISE = 16, 0.35
 
 
 def main():
+    from incubator_predictionio_tpu.utils.lease import install_sigterm_exit
+
     import jax
+
+    # dial as a killable waiter, then make SIGTERM a clean exit so a
+    # timeout-kill mid-run cannot wedge the lease we now hold
+    jax.devices()
+    install_sigterm_exit()
     import jax.numpy as jnp
 
     from incubator_predictionio_tpu.ops import als

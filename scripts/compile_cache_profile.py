@@ -6,9 +6,12 @@ Phase 2 (jax.clear_caches): lower again (pure Python/trace cost), then
 compile — which should be a persistent-cache HIT (deserialize only).
 Run on the real TPU: python scripts/compile_cache_profile.py [nnz]
 """
+import os
 import sys
 import tempfile
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -17,7 +20,14 @@ N_USERS, N_ITEMS, RANK, SWEEPS = 138_493, 26_744, 128, 10
 
 
 def main():
+    from incubator_predictionio_tpu.utils.lease import install_sigterm_exit
+
     import jax
+
+    # dial as a killable waiter, then make SIGTERM a clean exit so a
+    # timeout-kill mid-run cannot wedge the lease we now hold
+    jax.devices()
+    install_sigterm_exit()
     import jax.numpy as jnp
 
     from incubator_predictionio_tpu.ops import als

@@ -30,12 +30,19 @@ import numpy as np
 
 
 def main() -> None:
+    from incubator_predictionio_tpu.utils.lease import install_sigterm_exit
+
     import jax
 
     # honor an explicit platform pin: the accelerator plugin re-selects
     # itself at interpreter start, so the env var alone is not enough
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # dial as a killable waiter (no handler: a blocked dial needs the
+    # default OS kill), THEN make SIGTERM a clean interpreter exit so a
+    # timeout-kill mid-run cannot wedge the chip lease we now hold
+    jax.devices()
+    install_sigterm_exit()
     import jax.numpy as jnp
 
     from incubator_predictionio_tpu.ops.attention import blockwise_attention

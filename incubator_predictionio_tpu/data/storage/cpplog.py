@@ -163,6 +163,13 @@ class CppLogEvents(base.Events):
         # post concurrently, because the client lock serializes appends.
         self._gc_mu = threading.Lock()
         self._gc_pending: list = []
+        # observability (served under /stats.json "groupCommit"): how
+        # well concurrent callers coalesce — appends vs caller batches
+        # is the amortization factor operators tune client counts by
+        self._gc_appends = 0       # native appends performed
+        self._gc_caller_batches = 0  # caller batches those appends carried
+        self._gc_events = 0        # events written through group commit
+        self._gc_max_merge = 0     # largest events-per-append seen
 
     def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
         return self.client.handle(self.ns, app_id, channel_id)
@@ -715,6 +722,23 @@ class CppLogEvents(base.Events):
             raise item.error
         return item.ids
 
+    def group_commit_stats(self) -> dict:
+        """Coalescing counters for /stats.json: events-per-append is the
+        amortization the group commit actually achieved."""
+        with self._gc_mu:
+            appends = self._gc_appends
+            return {
+                # counters are backend-global and never rotate — NOT the
+                # per-app hourly window the surrounding stats use
+                "scope": "all apps/channels, since server start",
+                "appends": appends,
+                "callerBatches": self._gc_caller_batches,
+                "events": self._gc_events,
+                "maxMergedEvents": self._gc_max_merge,
+                "meanEventsPerAppend": (
+                    round(self._gc_events / appends, 1) if appends else 0.0),
+            }
+
     def _insert_interactions_direct(self, key, n, times_arr, uidx, iidx,
                                     vals, utab, itab) -> list:
         """Single un-grouped columnar insert (the group-commit retry
@@ -754,6 +778,11 @@ class CppLogEvents(base.Events):
                 seed = int.from_bytes(secrets.token_bytes(8), "little")
                 rc = self._append_columnar_locked(key, n, *merged, seed)
                 if rc == n:
+                    with self._gc_mu:
+                        self._gc_appends += 1
+                        self._gc_caller_batches += len(items)
+                        self._gc_events += n
+                        self._gc_max_merge = max(self._gc_max_merge, n)
                     ids = self._derive_event_ids(seed, n)
                     off = 0
                     for it in items:

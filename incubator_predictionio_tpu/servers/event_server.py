@@ -436,7 +436,15 @@ class EventServer:
                 return Response(404, {
                     "message": "To see stats, launch Event Server with --stats argument."
                 })
-            return Response(200, self.stats.get(auth.app_id))
+            body = self.stats.get(auth.app_id)
+            gc_stats = getattr(self.events, "group_commit_stats", None)
+            if gc_stats is not None:
+                # additive key beyond the reference's Stats shape: how
+                # well concurrent wire batches coalesced into appends.
+                # Scope differs from the per-app hourly counters above —
+                # the payload says so explicitly ("scope" field)
+                body["groupCommit"] = gc_stats()
+            return Response(200, body)
 
         # -- webhooks (EventServer.scala webhooks routes + Webhooks.scala) --
         @r.post("/webhooks/{name}.json")

@@ -398,7 +398,7 @@ import contextlib
 
 
 @contextlib.contextmanager
-def _cpplog_server(tmp_path, access_key="fk"):
+def _cpplog_server(tmp_path, access_key="fk", stats=False):
     """A live EventServer over a cpplog event store (the fast-path
     backend), torn down server-first on every exit path."""
     Storage.reset()
@@ -418,7 +418,8 @@ def _cpplog_server(tmp_path, access_key="fk"):
         app_id = Storage.get_meta_data_apps().insert(App(0, "fastapp"))
         Storage.get_meta_data_access_keys().insert(
             AccessKey(access_key, app_id))
-        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                            stats=stats))
         port = srv.start_background()
         yield srv, port
     finally:
@@ -541,3 +542,28 @@ def test_concurrent_batches_group_commit(tmp_path):
             f"http://127.0.0.1:{port}/events.json?accessKey=fk"
             f"&limit={expect + 100}"))
         assert len(got) == expect
+
+
+def test_stats_reports_group_commit_counters(tmp_path):
+    """/stats.json over a group-committing backend carries the coalescing
+    counters, and they reconcile with what was posted."""
+    with _cpplog_server(tmp_path, stats=True) as (srv, port):
+        for b in range(3):
+            docs = [{
+                "event": "rate", "entityType": "user",
+                "entityId": f"s{b}_{k}", "targetEntityType": "item",
+                "targetEntityId": f"i{k}",
+                "properties": {"rating": 1.0},
+            } for k in range(10)]
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/batch/events.json?accessKey=fk",
+                data=json.dumps(docs).encode(),
+                headers={"Content-Type": "application/json"})).read()
+        got = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats.json?accessKey=fk"))
+        gc = got["groupCommit"]
+        assert gc["events"] == 30
+        assert gc["callerBatches"] == 3
+        assert 1 <= gc["appends"] <= 3
+        assert gc["maxMergedEvents"] >= 10
+        assert gc["meanEventsPerAppend"] >= 10.0

@@ -890,7 +890,9 @@ def bench_attention():
         log("attention: Mosaic rejected the flash family on this backend "
             "— XLA blockwise path serves (numbers below are XLA vs XLA)")
     h, d = 8, 64
-    seqs_env = os.environ.get("PIO_BENCH_ATTN_SEQS", "8192,32768")
+    # 4096 rides along to place the flash/scan crossover (the per-length
+    # block table serves ≥8192; 4k is the scan's side of the line today)
+    seqs_env = os.environ.get("PIO_BENCH_ATTN_SEQS", "4096,8192,32768")
     # enough calls to amortize the tunneled platform's per-dispatch floor
     # (~2.7 ms amortized, ~30 ms for a short burst — a 3-call loop would
     # measure dispatch, not the kernel; the same trap round 3 fell into

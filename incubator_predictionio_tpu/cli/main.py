@@ -160,8 +160,10 @@ def build_parser() -> argparse.ArgumentParser:
              "(point their PIO_STORAGE_SOURCES_<N>_TYPE=remote at it)")
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=7077)
-    p.add_argument("--source", default="DEFAULT",
-                   help="which PIO_STORAGE_SOURCES_<NAME> to export")
+    p.add_argument("--source", default=None,
+                   help="export ONE PIO_STORAGE_SOURCES_<NAME>; default "
+                        "routes by repository (metadata/eventdata/"
+                        "modeldata each to its configured source)")
     p.add_argument("--auth-key", default=None,
                    help="shared key clients must send (X-Pio-Storage-Key)")
 

@@ -42,6 +42,20 @@ def _storage_error() -> type:
     return StorageError
 
 
+def _ambiguous_error() -> type:
+    from incubator_predictionio_tpu.data.storage import AmbiguousWriteError
+
+    return AmbiguousWriteError
+
+
+def _unsupported_error() -> type:
+    from incubator_predictionio_tpu.data.storage import (
+        UnsupportedMethodError,
+    )
+
+    return UnsupportedMethodError
+
+
 class StorageClient(base.BaseStorageClient):
     """Keep-alive RPC channel to one StorageServer."""
 
@@ -104,6 +118,7 @@ class StorageClient(base.BaseStorageClient):
                     method in _IDEMPOTENT
                     and not isinstance(e, TimeoutError))
                 if attempt == 1 or not retryable:
+                    ambiguous = sent and method not in _IDEMPOTENT
                     if not sent:
                         state = "; the request was never sent — it was NOT applied"
                     elif method in _IDEMPOTENT:
@@ -111,13 +126,18 @@ class StorageClient(base.BaseStorageClient):
                     else:
                         state = ("; the call is not idempotent — it may or "
                                  "may not have been applied")
-                    raise _storage_error()(
+                    err_cls = (_ambiguous_error() if ambiguous
+                               else _storage_error())
+                    raise err_cls(
                         f"storage server {self.host}:{self.port} failed "
                         f"during {iface}.{method} ({e!r})" + state)
         msg = wire.unpack(payload)
         if msg.get("ok"):
             return msg.get("value")
-        etype = _ERROR_TYPES.get(msg.get("etype")) or _storage_error()
+        ename = msg.get("etype")
+        if ename == "UnsupportedMethodError":
+            raise _unsupported_error()(msg.get("error", ""))
+        etype = _ERROR_TYPES.get(ename) or _storage_error()
         raise etype(msg.get("error", "remote storage error"))
 
     def close(self) -> None:
@@ -194,11 +214,29 @@ def _events_close(self) -> None:  # connection is client-owned
     return None
 
 
+def _events_insert_interactions(self, *args: Any, **kwargs: Any) -> Any:
+    """Columnar id-returning insert over the wire, with the capability
+    answer cached: a box backed by a store without a columnar write path
+    answers UnsupportedMethodError ONCE, and every later call fails
+    locally (no per-batch round trip; the EventServer's fast path then
+    stays off for the process)."""
+    if getattr(self, "_columnar_insert_unsupported", False):
+        raise _unsupported_error()(
+            "remote backend has no columnar insert (cached answer)")
+    try:
+        return self._call("insert_interactions", *args, **kwargs)
+    except Exception as e:
+        if isinstance(e, _unsupported_error()):
+            self._columnar_insert_unsupported = True
+        raise
+
+
 RemoteEvents = _proxy(
     "Events", base.Events,
     ("init", "remove", "insert", "insert_batch", "get", "delete",
      "aggregate_properties", "scan_interactions", "import_interactions"),
-    extra={"find": _events_find, "close": _events_close},
+    extra={"find": _events_find, "close": _events_close,
+           "insert_interactions": _events_insert_interactions},
 )
 #: find_close retries safely (popping a cursor twice is a no-op). find_open
 #: retries too: a stale keep-alive connection otherwise fails the *first*

@@ -26,7 +26,12 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from incubator_predictionio_tpu.data.event import EventValidationError
-from incubator_predictionio_tpu.data.storage import StorageError, base, wire
+from incubator_predictionio_tpu.data.storage import (
+    StorageError,
+    UnsupportedMethodError,
+    base,
+    wire,
+)
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
     Request,
@@ -47,7 +52,7 @@ _ALLOWED: Dict[str, Tuple[str, ...]] = {
         "init", "remove", "insert", "insert_batch", "get", "delete",
         "find_open", "find_next", "find_close",
         "aggregate_properties", "scan_interactions",
-        "import_interactions",
+        "import_interactions", "insert_interactions",
     ),
     "Apps": ("insert", "get", "get_by_name", "get_all", "update", "delete"),
     "AccessKeys": ("insert", "get", "get_all", "get_by_appid", "update",
@@ -64,6 +69,7 @@ _ALLOWED: Dict[str, Tuple[str, ...]] = {
 #: exception types that cross the wire by name (client re-raises them)
 _ERROR_TYPES = {
     "StorageError": StorageError,
+    "UnsupportedMethodError": UnsupportedMethodError,
     "ValueError": ValueError,
     "KeyError": KeyError,
     "EventValidationError": EventValidationError,
@@ -90,14 +96,32 @@ CURSOR_TTL_S = 600.0
 MAX_CURSORS_HARD = MAX_CURSORS * 2
 
 
+#: which repository kind serves each RPC interface in routed mode —
+#: Events → EVENTDATA, Models → MODELDATA, every metadata DAO → METADATA
+#: (the same mapping Storage's typed accessors use)
+_IFACE_REPOSITORY: Dict[str, str] = {
+    "Events": "EVENTDATA",
+    "Models": "MODELDATA",
+}
+
+
 class StorageServer:
-    """One backing backend (module, client, config) exported over HTTP."""
+    """A storage source exported over HTTP.
+
+    Two modes: a single backing backend (module, client, config), or —
+    with ``module=None`` — REPOSITORY-ROUTED: each RPC interface resolves
+    through this process's own `PIO_STORAGE_REPOSITORIES_*` env the way
+    local Storage accessors do (Events to the EVENTDATA source, Models to
+    MODELDATA, metadata DAOs to METADATA). Routed mode is what `pio
+    storageserver` runs by default, so ONE box A process can own
+    sqlite metadata + a cpplog event store + model blobs at once (the
+    production 3-box topology, docs/production.md)."""
 
     def __init__(
         self,
         module: Any,
         client: Any,
-        config: base.StorageClientConfig,
+        config: Optional[base.StorageClientConfig],
         host: str = "0.0.0.0",
         port: int = 0,
         auth_key: Optional[str] = None,
@@ -113,27 +137,46 @@ class StorageServer:
         self.http = HttpServer.from_conf(self._router(), host, port)
 
     @classmethod
-    def from_env(cls, source: str = "DEFAULT", host: str = "0.0.0.0",
+    def from_env(cls, source: Optional[str] = None, host: str = "0.0.0.0",
                  port: int = 0, auth_key: Optional[str] = None
                  ) -> "StorageServer":
-        """Back the server with the source the environment configures
-        (the Storage registry's own resolution, so `pio storageserver`
-        honours the PIO_STORAGE_SOURCES_* scheme)."""
+        """Back the server from the environment: with ``source`` set,
+        export that one PIO_STORAGE_SOURCES_<NAME>; with ``source=None``
+        (the `pio storageserver` default) run repository-routed."""
         from incubator_predictionio_tpu.data.storage import Storage
 
-        client, module, config = Storage._get_client(source)
-        return cls(module, client, config, host, port, auth_key)
+        if source:
+            client, module, config = Storage._get_client(source)
+            return cls(module, client, config, host, port, auth_key)
+        # routed mode: resolve every repository's source NOW so a
+        # misconfigured box refuses to start instead of failing
+        # per-request after printing a healthy banner
+        for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+            _ns, source_name = Storage.repository(repo)
+            Storage._get_client(source_name)
+        return cls(None, None, None, host, port, auth_key)
 
     def _dao(self, iface: str, prefix: str) -> Any:
         with self._lock:
             dao = self._daos.get((iface, prefix))
             if dao is None:
-                cls = self.module.DATA_OBJECTS.get(iface)
+                if self.module is not None:
+                    module, client, config = (self.module, self.client,
+                                              self.config)
+                else:  # repository-routed: resolve via this box's env
+                    from incubator_predictionio_tpu.data.storage import (
+                        Storage,
+                    )
+
+                    repo = _IFACE_REPOSITORY.get(iface, "METADATA")
+                    _ns, source_name = Storage.repository(repo)
+                    client, module, config = Storage._get_client(source_name)
+                cls = module.DATA_OBJECTS.get(iface)
                 if cls is None:
                     raise StorageError(
-                        f"backend {self.module.__name__} does not implement "
+                        f"backend {module.__name__} does not implement "
                         f"{iface}")
-                dao = cls(self.client, self.config, prefix=prefix)
+                dao = cls(client, config, prefix=prefix)
                 self._daos[(iface, prefix)] = dao
             return dao
 
@@ -142,6 +185,24 @@ class StorageServer:
 
         @r.get("/")
         def status(request: Request) -> Response:
+            if self.module is None:
+                from incubator_predictionio_tpu.data.storage import Storage
+
+                repos = {}
+                for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+                    try:
+                        _ns, src = Storage.repository(repo)
+                        repos[repo] = src
+                    except Exception:
+                        # can't normally happen: from_env validated the
+                        # repos at startup — so an env drift is news
+                        logger.exception("repository %s unresolvable", repo)
+                        repos[repo] = None
+                return Response(200, {
+                    "status": "alive",
+                    "backend": "repository-routed",
+                    "repositories": repos,
+                })
             return Response(200, {
                 "status": "alive",
                 "backend": self.module.__name__.rsplit(".", 1)[-1],
@@ -170,7 +231,16 @@ class StorageServer:
                 if method.startswith("find_"):
                     value = self._find_rpc(dao, method, msg)
                 else:
-                    value = getattr(dao, method)(
+                    impl = getattr(dao, method, None)
+                    if impl is None:
+                        # optional capability (e.g. columnar
+                        # insert_interactions on a backend without a
+                        # columnar write path) — typed so clients cache
+                        # the answer instead of retrying per request
+                        raise UnsupportedMethodError(
+                            f"{iface}.{method} is not supported by the "
+                            f"{type(dao).__name__} backend")
+                    value = impl(
                         *msg.get("args", ()), **msg.get("kwargs", {}))
                 return _packed({"ok": True, "value": value})
             except Exception as e:  # error crosses the wire, typed
@@ -263,7 +333,9 @@ class StorageServer:
     def start_background(self) -> int:
         port = self.http.start_background()
         logger.info("StorageServer listening on :%d (backend %s)",
-                    port, self.module.__name__)
+                    port,
+                    self.module.__name__ if self.module is not None
+                    else "repository-routed")
         return port
 
     async def serve_forever(self) -> None:
@@ -271,7 +343,14 @@ class StorageServer:
 
     def stop(self) -> None:
         self.http.stop()
-        self.client.close()
+        if self.client is not None:
+            self.client.close()
+        # routed-mode backend clients belong to the process-global
+        # Storage registry (Storage._get_client cache) — closing them
+        # here would break this process's own accessors; Storage.reset
+        # owns their lifecycle
+        with self._lock:
+            self._daos.clear()
 
 
 def _packed(payload: Dict[str, Any], status: int = 200) -> Response:

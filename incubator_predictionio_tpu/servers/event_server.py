@@ -30,7 +30,11 @@ from typing import Any, Dict, Optional, Tuple
 
 from incubator_predictionio_tpu.data import webhooks
 from incubator_predictionio_tpu.data.event import Event, EventValidationError
-from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage import (
+    AmbiguousWriteError,
+    Storage,
+    UnsupportedMethodError,
+)
 from incubator_predictionio_tpu.data.webhooks import ConnectorError
 from incubator_predictionio_tpu.servers.plugins import EventInfo, PluginContext
 from incubator_predictionio_tpu.servers.stats import Stats
@@ -154,6 +158,21 @@ class EventServer:
                 inter, auth.app_id, auth.channel_id, entity_type=etype,
                 target_entity_type=tetype, event_name=name,
                 value_prop=vprop, times=times)
+        except UnsupportedMethodError:
+            # a remote box without a columnar write path answers this
+            # (once — the proxy caches it); stay on the generic path for
+            # the rest of the process, quietly
+            self._columnar_unsupported = True
+            logger.info(
+                "event store has no columnar insert; batch fast path off")
+            return None
+        except AmbiguousWriteError as e:
+            # the remote write MAY have been applied (response lost after
+            # the request hit the wire) — re-inserting via the generic
+            # path would duplicate the whole batch, so surface the
+            # ambiguity instead; the client decides whether to re-POST
+            logger.warning("columnar batch insert ambiguous: %s", e)
+            return Response(500, {"message": str(e)})
         except Exception:
             logger.exception(
                 "columnar batch insert failed; using the generic path")
@@ -350,7 +369,8 @@ class EventServer:
             if (len(items) >= 8
                     and not self.plugin_context.input_blockers
                     and not self.plugin_context.input_sniffers
-                    and hasattr(self.events, "insert_interactions")):
+                    and hasattr(self.events, "insert_interactions")
+                    and not getattr(self, "_columnar_unsupported", False)):
                 resp = self._batch_fast_path(auth, items)
                 if resp is not None:
                     return resp

@@ -241,3 +241,62 @@ def _wait_alive(port: int, proc, timeout: float = 30.0) -> None:
         except OSError:
             time.sleep(0.2)
     raise AssertionError("storageserver did not come up")
+
+
+def test_insert_interactions_over_the_wire(tmp_path):
+    """Columnar id-returning insert crosses the RPC: over a cpplog-backed
+    box it returns the stored ids (the EventServer batch fast path on a
+    storage-box topology); over a memory-backed box it answers a TYPED
+    UnsupportedMethodError exactly once and the proxy caches the answer
+    (no per-batch round trips afterward)."""
+    from incubator_predictionio_tpu.data.storage import (
+        UnsupportedMethodError,
+        base,
+    )
+    from incubator_predictionio_tpu.data.storage import cpplog as cpplog_backend
+
+    inter = base.Interactions(
+        user_idx=np.arange(6, dtype=np.int32),
+        item_idx=np.arange(6, dtype=np.int32),
+        values=np.ones(6, np.float32),
+        user_ids=[f"wu{k}" for k in range(6)],
+        item_ids=[f"wi{k}" for k in range(6)])
+
+    # cpplog-backed box: ids come back and resolve through the same wire
+    cfg = StorageClientConfig(test=True, properties={"PATH": str(tmp_path)})
+    back = cpplog_backend.StorageClient(cfg)
+    srv = StorageServer(cpplog_backend, back, cfg, host="127.0.0.1", port=0)
+    port = srv.start_background()
+    try:
+        rcfg = StorageClientConfig(
+            test=True, properties={"URL": f"http://127.0.0.1:{port}"})
+        rclient = remote_backend.StorageClient(rcfg)
+        ev = remote_backend.RemoteEvents(rclient, rcfg)
+        ids = ev.insert_interactions(inter, app_id=1)
+        assert len(ids) == 6 and all(len(i) == 32 for i in ids)
+        got = ev.get(ids[0], app_id=1)
+        assert got is not None and got.entity_id == "wu0"
+        rclient.close()
+    finally:
+        srv.stop()
+
+    # memory-backed box: typed unsupported, cached after the first call
+    cfg2 = StorageClientConfig(test=True, properties={})
+    back2 = memory_backend.StorageClient(cfg2)
+    srv2 = StorageServer(memory_backend, back2, cfg2,
+                         host="127.0.0.1", port=0)
+    port2 = srv2.start_background()
+    try:
+        rcfg2 = StorageClientConfig(
+            test=True, properties={"URL": f"http://127.0.0.1:{port2}"})
+        rclient2 = remote_backend.StorageClient(rcfg2)
+        ev2 = remote_backend.RemoteEvents(rclient2, rcfg2)
+        with pytest.raises(UnsupportedMethodError):
+            ev2.insert_interactions(inter, app_id=1)
+        assert ev2._columnar_insert_unsupported
+        srv2.stop()  # server gone: a cached answer must not need the wire
+        with pytest.raises(UnsupportedMethodError):
+            ev2.insert_interactions(inter, app_id=1)
+        rclient2.close()
+    finally:
+        srv2.stop()

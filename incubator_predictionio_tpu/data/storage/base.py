@@ -23,6 +23,7 @@ import dataclasses
 import logging
 import re
 import secrets
+import threading
 from datetime import datetime
 from typing import Any, Dict, Iterator, Optional, Sequence
 
@@ -351,6 +352,88 @@ def uniform_interactions(events: Sequence[Event]):
         user_ids=IdTable.from_list(users),
         item_ids=IdTable.from_list(items))
     return inter, etype, tetype, name, vprop, times
+
+
+#: per-thread scratch buffers for the native body parser
+_BODY_PARSE_TLS = threading.local()
+
+
+def uniform_interactions_from_body(body: bytes, max_n: int):
+    """RAW request bytes → the ``(Interactions, etype, tetype, name,
+    vprop, times_ms)`` bundle via the NATIVE strict-subset parser
+    (native/src/jsonparse.cc), or None when the body is not eligible
+    (escapes, eventTime, reserved prefixes, oversized fields, >max_n
+    docs…) or the native library is unavailable — callers then fall back
+    to ``json.loads`` + :func:`uniform_interactions_from_docs`, which
+    owns the full semantics.
+
+    The native acceptance set is a strict subset of the doc gate's with
+    identical output (pinned by a randomized differential test in
+    tests/test_event_server.py), and the parse runs GIL-released — the
+    ingest hot path never materializes per-doc Python objects at all.
+    ``times_ms`` is always None here (any explicit eventTime falls
+    back)."""
+    import ctypes
+
+    import numpy as np
+
+    from incubator_predictionio_tpu import native
+
+    lib = native.load()
+    if lib is None or max_n <= 0:
+        return None
+    cap_field = 200  # jsonparse.cc kMaxField
+    # thread-local scratch (the parser runs on pool threads): ~100 KB of
+    # buffers per call otherwise dominates the wrapper's own cost
+    tl = _BODY_PARSE_TLS
+    bufs = getattr(tl, "bufs", None)
+    if bufs is None or bufs[0] < max_n:
+        bufs = (
+            max_n,
+            np.empty(max_n, np.int32), np.empty(max_n, np.int32),
+            np.empty(max_n, np.float32),
+            np.empty(max_n + 1, np.int64), np.empty(max_n + 1, np.int64),
+            ctypes.create_string_buffer(max_n * cap_field),
+            ctypes.create_string_buffer(max_n * cap_field),
+            ctypes.create_string_buffer(4 * cap_field),
+            (ctypes.c_int64 * 4)(),
+        )
+        tl.bufs = bufs
+    (_cap, uidx, iidx, vals, uoffs, ioffs, ublob, iblob, scalars,
+     scalar_lens) = bufs
+    n_users = ctypes.c_int64()
+    n_items = ctypes.c_int64()
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    n = lib.pio_parse_uniform_batch(
+        body, len(body), max_n,
+        uidx.ctypes.data_as(i32p), iidx.ctypes.data_as(i32p),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ublob, max_n * cap_field, uoffs.ctypes.data_as(i64p),
+        ctypes.byref(n_users),
+        iblob, max_n * cap_field, ioffs.ctypes.data_as(i64p),
+        ctypes.byref(n_items),
+        scalars, 4 * cap_field, scalar_lens,
+    )
+    if n < 1:
+        return None
+    nu, ni = n_users.value, n_items.value
+    # string_at copies only the used prefix (``.raw`` would materialize
+    # the whole preallocated buffer per call)
+    inter = Interactions(
+        user_idx=uidx[:n].copy(), item_idx=iidx[:n].copy(),
+        values=vals[:n].copy(),
+        user_ids=IdTable(ctypes.string_at(ublob, int(uoffs[nu])),
+                         uoffs[:nu + 1].copy()),
+        item_ids=IdTable(ctypes.string_at(iblob, int(ioffs[ni])),
+                         ioffs[:ni + 1].copy()))
+    a, b, c, d = (int(v) for v in scalar_lens)
+    s = ctypes.string_at(scalars, a + b + c + d)
+    etype = s[:a].decode("utf-8")
+    name = s[a:a + b].decode("utf-8")
+    tetype = s[a + b:a + b + c].decode("utf-8")
+    vprop = s[a + b + c:a + b + c + d].decode("utf-8")
+    return inter, etype, tetype, name, vprop, None
 
 
 def uniform_interactions_from_docs(docs):

@@ -30,7 +30,7 @@ logger = logging.getLogger(__name__)
 
 _SRC_DIR = Path(__file__).parent / "src"
 _BUILD_DIR = Path(__file__).parent / "_build"
-_SOURCES = ("eventlog.cc", "csr_builder.cc")
+_SOURCES = ("eventlog.cc", "csr_builder.cc", "jsonparse.cc")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -161,6 +161,15 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_float),
         c.c_int64, c.c_int64, c.c_int32, c.c_int32, c.c_int32,
         pp_i32, pp_i32, pp_f32, pp_f32,
+    ]
+    # uniform-batch JSON parser (REST ingest hot path)
+    lib.pio_parse_uniform_batch.restype = c.c_int64
+    lib.pio_parse_uniform_batch.argtypes = [
+        c.c_char_p, c.c_int64, c.c_int64,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_float),
+        c.c_char_p, c.c_int64, i64p, i64p,
+        c.c_char_p, c.c_int64, i64p, i64p,
+        c.c_char_p, c.c_int64, i64p,
     ]
 
 

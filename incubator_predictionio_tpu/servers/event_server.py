@@ -145,14 +145,23 @@ class EventServer:
         fast = uniform_interactions_from_docs(items)
         if fast is None:
             return None
+        return self._columnar_fast_response(auth, fast, len(items))
+
+    def _columnar_fast_response(self, auth: AuthData, fast,
+                                n: int) -> Optional[Response]:
+        """Post-gate leg shared by the doc-level and native-body fast
+        paths: allowed-names check, one columnar insert, booking, and
+        direct response rendering. Returns None to hand the batch to the
+        generic path (storage failure — its bulk-then-retry semantics
+        then apply from scratch)."""
         inter, etype, tetype, name, vprop, times = fast
         try:
             self._check_allowed(auth, name)
         except AuthError as e:
-            for _ in items:
+            for _ in range(n):
                 self._book(auth, e.status, name)
             return Response(200, [
-                {"status": e.status, "message": e.message}] * len(items))
+                {"status": e.status, "message": e.message}] * n)
         try:
             ids = self.events.insert_interactions(
                 inter, auth.app_id, auth.channel_id, entity_type=etype,
@@ -177,7 +186,7 @@ class EventServer:
             logger.exception(
                 "columnar batch insert failed; using the generic path")
             return None
-        for _ in items:
+        for _ in range(n):
             self._book(auth, 201, name)
         # ids are our own 32-hex strings: render the uniform-status body
         # directly (no json.dumps tree walk on the hot path)
@@ -346,6 +355,27 @@ class EventServer:
 
         def batch_events(request: Request) -> Response:
             auth = self._authenticate(request)
+            # native-body fast path: raw bytes → columnar arrays in C++
+            # (GIL-released; native/src/jsonparse.cc), skipping even
+            # json.loads. Anything the strict-subset parser declines —
+            # and any storage failure — falls through to the doc path
+            # below, unchanged. The same ≥8 threshold as the doc gate
+            # keeps small-batch storage behavior identical.
+            if (not self.plugin_context.input_blockers
+                    and not self.plugin_context.input_sniffers
+                    and not getattr(self, "_columnar_unsupported", False)
+                    and hasattr(self.events, "insert_interactions")):
+                from incubator_predictionio_tpu.data.storage.base import (
+                    uniform_interactions_from_body,
+                )
+
+                fast = uniform_interactions_from_body(
+                    request.body, self.config.max_batch)
+                if fast is not None and len(fast[0]) >= 8:
+                    resp = self._columnar_fast_response(
+                        auth, fast, len(fast[0]))
+                    if resp is not None:
+                        return resp
             try:
                 items = request.json()
             except ValueError as e:
@@ -415,6 +445,15 @@ class EventServer:
                     ids = self.events.insert_batch(
                         [e for _, e, _ in pending], auth.app_id,
                         auth.channel_id)
+                except AmbiguousWriteError as e:
+                    # the remote write MAY have been applied — a per-event
+                    # retry would duplicate the whole batch; fail the
+                    # pending slots honestly and let the client decide
+                    logger.warning("bulk insert ambiguous: %s", e)
+                    for idx, event, _info in pending:
+                        results[idx] = {"status": 500, "message": str(e)}
+                        self._book(auth, 500, event.event)
+                    pending = []
                 except Exception:
                     # Best-effort recovery window (documented): the failed
                     # bulk attempt rolls back its auto-id inserts, but a

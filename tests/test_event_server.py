@@ -6,6 +6,7 @@ import json
 import urllib.request
 import urllib.error
 
+import numpy as np
 import pytest
 
 from incubator_predictionio_tpu.data.storage import AccessKey, App, Channel, Storage
@@ -567,3 +568,133 @@ def test_stats_reports_group_commit_counters(tmp_path):
         assert 1 <= gc["appends"] <= 3
         assert gc["maxMergedEvents"] >= 10
         assert gc["meanEventsPerAppend"] >= 10.0
+
+
+class TestNativeBodyParser:
+    """native/src/jsonparse.cc vs the Python doc gate: the native
+    acceptance set must be a strict subset with IDENTICAL output."""
+
+    def _gate(self, body: bytes, max_n: int = 50):
+        from incubator_predictionio_tpu.data.storage.base import (
+            uniform_interactions_from_body,
+        )
+        return uniform_interactions_from_body(body, max_n)
+
+    def _pygate(self, body: bytes):
+        from incubator_predictionio_tpu.data.storage.base import (
+            uniform_interactions_from_docs,
+        )
+        try:
+            docs = json.loads(body)
+        except ValueError:
+            return None
+        if not isinstance(docs, list):
+            return None
+        return uniform_interactions_from_docs(docs)
+
+    def _assert_subset_equal(self, body: bytes):
+        nat = self._gate(body)
+        if nat is None:
+            return False
+        py = self._pygate(body)
+        assert py is not None, f"native accepted what python rejects: {body!r}"
+        ni, ne, nt, nn, nv, ntm = nat
+        pi, pe, pt, pn, pv, ptm = py
+        assert (ne, nt, nn, nv) == (pe, pt, pn, pv)
+        assert ntm is None and ptm is None
+        assert np.array_equal(ni.user_idx, pi.user_idx)
+        assert np.array_equal(ni.item_idx, pi.item_idx)
+        assert np.array_equal(ni.values, pi.values)
+        assert list(ni.user_ids) == list(pi.user_ids)
+        assert list(ni.item_ids) == list(pi.item_ids)
+        return True
+
+    def test_plain_batch_accepted_identical(self):
+        docs = [{"event": "rate", "entityType": "user",
+                 "entityId": f"u{k % 5}", "targetEntityType": "item",
+                 "targetEntityId": f"i{k}",
+                 "properties": {"rating": float(1 + k % 5)}}
+                for k in range(20)]
+        assert self._assert_subset_equal(json.dumps(docs).encode())
+
+    def test_number_forms_and_unicode(self):
+        docs = [{"event": "rate", "entityType": "user",
+                 "entityId": "usér-ñ", "targetEntityType": "item",
+                 "targetEntityId": "i1", "properties": {"rating": 2}},
+                {"event": "rate", "entityType": "user", "entityId": "u2",
+                 "targetEntityType": "item", "targetEntityId": "i2",
+                 "properties": {"rating": 2.5e2}}]
+        body = json.dumps(docs, ensure_ascii=False).encode()
+        assert self._assert_subset_equal(body)
+
+    def test_fallback_cases_never_accepted_wrongly(self):
+        base_doc = {"event": "rate", "entityType": "user",
+                    "entityId": "u1", "targetEntityType": "item",
+                    "targetEntityId": "i1", "properties": {"rating": 1.0}}
+        rejected = [
+            [dict(base_doc, eventTime="2026-01-01T00:00:00.000Z")],
+            [dict(base_doc, entityId="a\\\"b")],          # escapes
+            [dict(base_doc, extra=1)],                     # unknown key
+            [dict(base_doc, event="$set")],                # reserved
+            [dict(base_doc, properties={"r": 0.1})],       # not f32-exact
+            [dict(base_doc, properties={"r": True})],      # bool
+            [dict(base_doc, properties={})],               # empty props
+            [dict(base_doc, entityId="")],                 # empty id
+            "not-a-list",
+            [],
+        ]
+        for case in rejected:
+            body = (json.dumps(case).encode()
+                    if not isinstance(case, bytes) else case)
+            nat = self._gate(body)
+            if nat is not None:
+                # native accepted: python MUST accept identically
+                self._assert_subset_equal(body)
+
+    def test_invalid_utf8_rejected(self):
+        """Raw non-UTF-8 bytes in any string must fall back (json.loads
+        on the generic path 400s them; persisting undecodable ids or
+        crashing the handler would both break the subset contract)."""
+        doc = (b'[{"event": "rate", "entityType": "user", '
+               b'"entityId": "u\xff\xfe1", "targetEntityType": "item", '
+               b'"targetEntityId": "i1", "properties": {"rating": 1.0}}]')
+        assert self._gate(doc) is None
+        # overlong encoding of '/' (0xC0 0xAF) and a lone surrogate
+        for bad in (b"\xc0\xaf", b"\xed\xa0\x80"):
+            doc2 = (b'[{"event": "rate", "entityType": "user", '
+                    b'"entityId": "u' + bad + b'", '
+                    b'"targetEntityType": "item", "targetEntityId": "i1", '
+                    b'"properties": {"rating": 1.0}}]')
+            assert self._gate(doc2) is None
+
+    def test_randomized_differential(self):
+        rng = np.random.default_rng(11)
+        keys = ["event", "entityType", "entityId", "targetEntityType",
+                "targetEntityId", "properties", "eventTime", "bogus"]
+        accepted = 0
+        for trial in range(300):
+            n = int(rng.integers(1, 12))
+            docs = []
+            for _ in range(n):
+                d = {"event": "rate", "entityType": "user",
+                     "entityId": f"u{int(rng.integers(0, 6))}",
+                     "targetEntityType": "item",
+                     "targetEntityId": f"i{int(rng.integers(0, 6))}",
+                     "properties": {"rating": float(int(rng.integers(1, 6)))}}
+                # random mutations
+                for _m in range(int(rng.integers(0, 3))):
+                    k = keys[int(rng.integers(0, len(keys)))]
+                    roll = rng.random()
+                    if roll < 0.3 and k in d:
+                        del d[k]
+                    elif roll < 0.6:
+                        d[k] = ["x", 1, None][int(rng.integers(0, 3))]
+                    elif k == "properties":
+                        d[k] = {"rating": float(rng.normal())}
+                    else:
+                        d[k] = f"v{int(rng.integers(0, 4))}"
+                docs.append(d)
+            body = json.dumps(docs).encode()
+            if self._assert_subset_equal(body):
+                accepted += 1
+        assert accepted >= 10  # the harness must exercise the accept leg

@@ -246,3 +246,29 @@ def test_fake_run(tmp_path, monkeypatch):
         assert instance.evaluator_results == ""  # noSave: nothing persisted
     finally:
         Storage.reset()
+
+
+def test_sigterm_exits_through_interpreter():
+    """utils/lease.py: SIGTERM must unwind through finally blocks and
+    exit 143 via SystemExit (abrupt death while holding the chip wedges
+    the single-tenant lease; see the lease-safety contract)."""
+    import subprocess
+    import sys
+    import time
+
+    p = subprocess.Popen([sys.executable, "-c", (
+        "from incubator_predictionio_tpu.utils.lease import "
+        "install_sigterm_exit\n"
+        "import time\n"
+        "assert install_sigterm_exit()\n"
+        "try:\n"
+        "    print('ready', flush=True)\n"
+        "    time.sleep(30)\n"
+        "finally:\n"
+        "    print('clean shutdown ran', flush=True)\n")],
+        stdout=subprocess.PIPE, text=True)
+    assert p.stdout.readline().strip() == "ready"
+    p.terminate()
+    out, _ = p.communicate(timeout=15)
+    assert p.returncode == 143
+    assert "clean shutdown ran" in out

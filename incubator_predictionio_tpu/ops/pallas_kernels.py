@@ -646,16 +646,24 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
                    *, iters: int, n_d_blocks: int, precise: bool):
     """One (row, d-block) program of the fused bucket solve.
 
+    Mosaic block-shape note: the TPU lowering requires each of the last
+    two block dims to be sublane/lane aligned (8/128) OR equal to the
+    array dim. A [B, dt]-shaped aux with block (1, dt) violates the
+    sublane rule, so every per-row aux rides as [B, 1, x] with block
+    (1, 1, x) — last-two dims (1, x) equal the array dims exactly.
+
     g_ref:   [1, dt, Kp]  this row's masked gathered factors, one d tile
                           (bf16 on the fast schedule; mask already applied,
                           so gram = gᵗg and rhs = wvᵗg need no masking here
                           — mask² == mask)
-    wv_ref:  [1, dt]      vals·mask tile, f32
-    lam_ref: [1, Kp]      per-row ridge λ(+λ·nnz), broadcast across K
+    wv_ref:  [1, 1, dp]   the row's FULL vals·mask vector, f32 — one
+                          block covering all d tiles, dynamic-sliced to
+                          the current [1, dt] tile each d step
+    lam_ref: [1, 1, Kp]   per-row ridge λ(+λ·nnz), broadcast across K
                           (f32; applied INSIDE the matvec so the Gram can
                           stay in its compute dtype without rounding the
                           regularizer)
-    o_ref:   [1, Kp]      solution, written on the last d step
+    o_ref:   [1, 1, Kp]   solution, written on the last d step
     gram/rhs scratch persist across the d-minor grid steps (flash-kernel
     accumulator pattern).
     """
@@ -667,6 +675,8 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
         rhs_ref[...] = jnp.zeros_like(rhs_ref)
 
     g = g_ref[0]                                         # [dt, Kp]
+    dt = g.shape[0]
+    wv = jax.lax.dynamic_slice(wv_ref[0], (0, j * dt), (1, dt))
     # bf16 inputs take the MXU single-pass (DEFAULT); the f32 polish path
     # pins HIGHEST so its Gram never silently truncates to bf16 passes —
     # the exact failure mode the XLA path documents (_solve_bucket:
@@ -678,7 +688,7 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
         preferred_element_type=jnp.float32, precision=prec,
     )
     rhs_ref[...] += jax.lax.dot_general(
-        wv_ref[...].astype(g.dtype), g,
+        wv.astype(g.dtype), g,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32, precision=prec,
     )
@@ -686,7 +696,7 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
     @pl.when(j == n_d_blocks - 1)
     def _solve():
         gram = gram_ref[...]                             # [Kp, Kp] f32
-        lam = lam_ref[...]                               # [1, Kp]
+        lam = lam_ref[0]                                 # [1, Kp]
         kp = gram.shape[0]
         row = jax.lax.broadcasted_iota(jnp.int32, (kp, kp), 0)
         col = jax.lax.broadcasted_iota(jnp.int32, (kp, kp), 1)
@@ -721,7 +731,7 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
         rz0 = jnp.sum(b * z0, keepdims=True)[..., :1]
         x, _r, _p, _rz = jax.lax.fori_loop(
             0, iters, body, (x0, b, z0, rz0))
-        o_ref[...] = x
+        o_ref[0] = x
 
 
 def als_padded_dims(d: int, k: int) -> Tuple[int, int]:
@@ -773,12 +783,13 @@ def als_solve_cg_pallas(
     gathered = table[cols]                               # [B, D, K]
     g = gathered * mask[..., None].astype(gathered.dtype)
     g = jnp.pad(g, ((0, 0), (0, dp - d), (0, kp - k)))
+    # per-row auxes ride as [B, 1, x] — see kernel docstring block note
     wv = jnp.pad((vals * mask).astype(jnp.float32),
-                 ((0, 0), (0, dp - d)))
+                 ((0, 0), (0, dp - d)))[:, None, :]
     nnz = jnp.sum(mask, axis=-1)
     lam = l2 * (jnp.maximum(nnz, 1.0) if reg_nnz
                 else jnp.ones_like(nnz))
-    lam_b = jnp.broadcast_to(lam[:, None], (B, kp))
+    lam_b = jnp.broadcast_to(lam[:, None, None], (B, 1, kp))
 
     n_d = dp // dt
     out = pl.pallas_call(
@@ -790,21 +801,21 @@ def als_solve_cg_pallas(
         in_specs=[
             pl.BlockSpec((1, dt, kp), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, dt), lambda i, j: (i, j),
+            pl.BlockSpec((1, 1, dp), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, kp), lambda i, j: (i, 0),
+            pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, kp), lambda i, j: (i, 0),
+        out_specs=pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, kp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, 1, kp), jnp.float32),
         scratch_shapes=[
             pltpu.VMEM((kp, kp), jnp.float32),   # gram accumulator
             pltpu.VMEM((1, kp), jnp.float32),    # rhs accumulator
         ],
         interpret=interpret,
     )(g, wv, lam_b)
-    return out[:, :k]
+    return out[:, 0, :k]
 
 
 _als_ok: "bool | None" = None
@@ -812,7 +823,8 @@ _als_ok: "bool | None" = None
 
 def als_kernel_available() -> bool:
     """The ALS bucket-solve family: probe the real kernel at a shape that
-    exercises rank padding (rank 64 → 128) and multi-tile D streaming."""
+    exercises rank padding (rank 64 → 128), row-group padding (12 → 16),
+    and multi-tile D streaming."""
     global _als_ok
     if _als_ok is None:
         if not pallas_available():
@@ -821,9 +833,9 @@ def als_kernel_available() -> bool:
             _als_ok = _probe_kernel_runs(
                 lambda: als_solve_cg_pallas(
                     jnp.zeros((64, 64), jnp.bfloat16),
-                    jnp.zeros((8, 1024), jnp.int32),
-                    jnp.ones((8, 1024), jnp.float32),
-                    jnp.ones((8, 1024), jnp.float32),
+                    jnp.zeros((12, 1024), jnp.int32),
+                    jnp.ones((12, 1024), jnp.float32),
+                    jnp.ones((12, 1024), jnp.float32),
                     0.1, True, 6, interpret=False),
                 "ALS bucket CG solve")
     return _als_ok

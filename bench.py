@@ -418,7 +418,8 @@ def run_cpu_baseline() -> None:
     }))
 
 
-def run_tpu_child(store_dir: str, out_path: str, claim_path: str) -> None:
+def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
+                  parent_pid: int = 0) -> None:
     """All accelerator work, in a disposable process. First act: dial the
     chip (this is the call a stale lease blocks forever — the parent's
     recycle window covers it). On success, touch the claim file so the
@@ -427,6 +428,11 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str) -> None:
 
     import jax
 
+    # honor an explicit platform override (tests run this child on the
+    # CPU backend) — the env var alone is not enough: the axon register
+    # hook initializes its backend from config, not JAX_PLATFORMS
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     # NO SIGTERM handler before the dial: a waiter blocked inside the
     # PJRT constructor can only be stopped by the default OS-level kill
     # (a Python handler never fires inside a blocked C call), and the
@@ -436,6 +442,24 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str) -> None:
     # normal interpreter shutdown — an abrupt death while HOLDING the
     # chip wedges the single-tenant lease for hours
     install_sigterm_exit()
+    # Abandoned-waiter pile-up guard. TERM-ignoring waiters (the dial
+    # retry loop swallows signals inside the C call) queue up on a wedged
+    # lease; when it finally frees they claim it ONE AFTER ANOTHER. Only
+    # the first claimer should run: a later claimer whose fragment
+    # already exists — or whose bench parent is gone entirely — must exit
+    # NOW, releasing the chip instead of re-running the whole TPU leg
+    # against nobody.
+    if os.path.exists(out_path):
+        log("tpu child: fragment already landed by an earlier child; "
+            "exiting to free the chip")
+        return
+    # explicit PID handshake, not getppid()==1: the bench itself can BE
+    # pid 1 (container entrypoint), and orphans reparent to a subreaper
+    # rather than init under systemd/tini
+    if parent_pid and os.getppid() != parent_pid:
+        log("tpu child: bench parent is gone (orphaned waiter); "
+            "exiting to free the chip")
+        return
     with open(claim_path, "w") as f:
         f.write(str(os.getpid()))
     log(f"tpu child: accelerator up ({jax.devices()[0]})")
@@ -518,11 +542,27 @@ def supervise_tpu_child(store_dir: str, out_path: str,
         t_spawn = time.monotonic()
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--tpu-child",
-             store_dir, out_path, claim_path],
+             store_dir, out_path, claim_path, str(os.getpid())],
             stdout=sys.stderr, stderr=sys.stderr)
         claimed = False
         win_end = min(time.monotonic() + window, deadline)
         while True:
+            if (not claimed and proc.poll() is None
+                    and os.path.exists(out_path)):
+                # an earlier abandoned child landed the fragment while
+                # this attempt was still dialing — stop the waiter (TERM;
+                # it is not holding the lease) and take the result. A
+                # CLAIMED child is never cut down here: its own fragment
+                # write precedes a slow PJRT teardown, and a TERM in that
+                # window is the abrupt-death-while-holding hazard
+                log("fragment landed via an abandoned child; stopping "
+                    f"attempt {attempt}")
+                proc.terminate()
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    pass
+                return True
             rc = proc.poll()
             if rc is not None:
                 if rc == 0 and os.path.exists(out_path):
@@ -1226,6 +1266,7 @@ if __name__ == "__main__":
         run_cpu_baseline()
     elif "--tpu-child" in sys.argv:
         i = sys.argv.index("--tpu-child")
-        run_tpu_child(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3])
+        run_tpu_child(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3],
+                      int(sys.argv[i + 4]) if len(sys.argv) > i + 4 else 0)
     else:
         run_orchestrator()

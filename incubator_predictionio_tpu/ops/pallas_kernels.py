@@ -656,9 +656,9 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
                           (bf16 on the fast schedule; mask already applied,
                           so gram = gᵗg and rhs = wvᵗg need no masking here
                           — mask² == mask)
-    wv_ref:  [1, 1, dp]   the row's FULL vals·mask vector, f32 — one
-                          block covering all d tiles, dynamic-sliced to
-                          the current [1, dt] tile each d step
+    wv_ref:  [1, 1, dt]   vals·mask d tile, f32 (legal under the rule
+                          above: sublane dim 1 equals the array dim 1,
+                          lane dim dt is a 128 multiple)
     lam_ref: [1, 1, Kp]   per-row ridge λ(+λ·nnz), broadcast across K
                           (f32; applied INSIDE the matvec so the Gram can
                           stay in its compute dtype without rounding the
@@ -675,8 +675,7 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
         rhs_ref[...] = jnp.zeros_like(rhs_ref)
 
     g = g_ref[0]                                         # [dt, Kp]
-    dt = g.shape[0]
-    wv = jax.lax.dynamic_slice(wv_ref[0], (0, j * dt), (1, dt))
+    wv = wv_ref[0]                                       # [1, dt]
     # bf16 inputs take the MXU single-pass (DEFAULT); the f32 polish path
     # pins HIGHEST so its Gram never silently truncates to bf16 passes —
     # the exact failure mode the XLA path documents (_solve_bucket:
@@ -801,7 +800,7 @@ def als_solve_cg_pallas(
         in_specs=[
             pl.BlockSpec((1, dt, kp), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, dp), lambda i, j: (i, 0, 0),
+            pl.BlockSpec((1, 1, dt), lambda i, j: (i, 0, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -823,8 +822,8 @@ _als_ok: "bool | None" = None
 
 def als_kernel_available() -> bool:
     """The ALS bucket-solve family: probe the real kernel at a shape that
-    exercises rank padding (rank 64 → 128), row-group padding (12 → 16),
-    and multi-tile D streaming."""
+    exercises rank padding (rank 64 → 128), a row count that is not a
+    sublane multiple, and multi-tile D streaming."""
     global _als_ok
     if _als_ok is None:
         if not pallas_available():

@@ -1,0 +1,72 @@
+"""TPU-child supervision: abandoned-waiter pile-up guards.
+
+A wedged single-tenant lease makes SIGTERM-immune waiters queue up (the
+PJRT dial retry swallows signals inside the C call); when the lease
+frees, the waiters claim it one after another. Only the first claimer
+may run the TPU leg — every later claimer must exit immediately and
+release the chip. These tests drive the real ``--tpu-child`` subprocess
+on the CPU backend, where the dial succeeds instantly and the guards are
+the first code after it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_child_exits_without_running_when_fragment_exists(tmp_path):
+    out_path = tmp_path / "fragment.json"
+    out_path.write_text(json.dumps({"value": 1.0}))
+    claim = tmp_path / "claim"
+    store = tmp_path / "store"
+    store.mkdir()
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--tpu-child", str(store), str(out_path),
+         str(claim), str(os.getpid())],
+        env=_child_env(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    # exited at the guard: no claim written, fragment untouched
+    assert not claim.exists()
+    assert json.loads(out_path.read_text()) == {"value": 1.0}
+    assert "already landed" in proc.stderr
+
+
+def test_orphaned_child_exits_without_claiming(tmp_path):
+    out_path = tmp_path / "fragment.json"
+    claim = tmp_path / "claim"
+    store = tmp_path / "store"
+    store.mkdir()
+    pidfile = tmp_path / "pid"
+    # the intermediate shell passes ITS pid as the parent handshake and
+    # exits immediately; by the time the guard runs the child has been
+    # reparented (to init or a subreaper — either way getppid() no
+    # longer matches the handshake pid)
+    subprocess.run(
+        ["sh", "-c",
+         f"{sys.executable} {BENCH} --tpu-child {store} {out_path} "
+         f"{claim} $$ >/dev/null 2>&1 & echo $! > {pidfile}"],
+        env=_child_env(), timeout=30, check=True)
+    pid = int(pidfile.read_text().strip())
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(1)
+    else:
+        raise AssertionError("orphaned tpu child still alive after 120s")
+    # exited at the orphan guard: never claimed, never wrote a fragment
+    assert not claim.exists()
+    assert not out_path.exists()

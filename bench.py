@@ -310,7 +310,81 @@ def prep_buckets(inter):
     return (u_light, u_heavy), (i_light, i_heavy), n_users, n_items, prep_s
 
 
-def measure_train(buckets, bf16_sweeps, cache_probe=True):
+def build_trees(buckets):
+    """Device-resident bucket + heavy trees from prep_buckets output —
+    built ONCE per child and shared by the kernel selector and the timed
+    train (each build uploads the whole padded interaction set)."""
+    from incubator_predictionio_tpu.ops import als
+
+    (u_light, u_heavy), (i_light, i_heavy), n_users, n_items = buckets
+    u_tree, i_tree = als._buckets_tree(u_light), als._buckets_tree(i_light)
+    u_hv, i_hv = als._heavy_tree(u_heavy), als._heavy_tree(i_heavy)
+    return u_tree, i_tree, u_hv, i_hv, n_users, n_items
+
+
+def select_als_kernel(buckets, trees=None):
+    """Measured on-chip choice for the fused Pallas ALS bucket solve.
+
+    ``PIO_ALS_KERNEL=auto``'s Mosaic probe only proves the kernel
+    COMPILES on this backend; it says nothing about speed, and a slow
+    kernel engaged blind would burn the TPU child's run window. A short
+    full-shape run each way — covering BOTH kernel programs (a bf16
+    DEFAULT sweep and, when the main schedule has one, an f32 HIGHEST
+    polish sweep) — warm-timed; the kernel must beat the XLA path
+    outright (ties keep the battle-tested path). Any crash in the probe
+    falls back to the XLA path instead of forfeiting the accelerator
+    leg. → (use_kernel, fragment fields recording the outcome)."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops import als
+
+    if not als._kernel_enabled(False):
+        # distinguish an operator override from backend inability so the
+        # fragment's cross-round comparison stays meaningful
+        forced_off = als._ALS_KERNEL == "off" or als._SOLVER != "cg"
+        return False, {"als_kernel": "disabled" if forced_off
+                       else "unavailable"}
+    u_tree, i_tree, u_hv, i_hv, n_users, n_items = (
+        trees if trees is not None else build_trees(buckets))
+    # mirror the main schedule's leg structure: probe the polish program
+    # too when the real run will use it
+    polish = BF16_SWEEPS < ITERATIONS
+    its = 2 if polish else 1
+    times = {}
+    for uk in (False, True):
+        def train():
+            out = als._mixed_run(
+                als.als_init(jax.random.key(0), n_users, n_items, RANK),
+                u_tree, i_tree, L2, its, 1, True,
+                jnp.float32, jax.lax.Precision.HIGHEST,
+                user_heavy=u_hv, item_heavy=i_hv, use_kernel=uk)
+            np.asarray(out.user_factors[0:1, 0:1])
+            np.asarray(out.item_factors[0:1, 0:1])
+        try:
+            train()  # compile + first run
+            t0 = time.perf_counter()
+            train()
+            times[uk] = time.perf_counter() - t0
+        except Exception as e:  # full-shape-only kernel failure
+            if not uk:
+                raise  # the XLA path must work; nothing to fall back to
+            log(f"ALS kernel probe crashed at full shape ({e!r}); "
+                "keeping the XLA path")
+            return False, {"als_kernel": "probe_failed"}
+    choice = bool(times[True] < 0.97 * times[False])
+    log(f"ALS kernel probe ({its} sweep(s), full shape): "
+        f"xla={times[False]:.3f}s pallas={times[True]:.3f}s -> "
+        f"{'pallas' if choice else 'xla'}")
+    return choice, {
+        "als_kernel_sweep_xla_s": round(times[False], 3),
+        "als_kernel_sweep_pallas_s": round(times[True], 3),
+        "als_kernel": "on" if choice else "off",
+    }
+
+
+def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
+                  trees=None):
     """Compile-cold / warm / warm-persistent-cache timing of the fused
     training run. → (state, dict of timing keys)."""
     import atexit
@@ -322,15 +396,14 @@ def measure_train(buckets, bf16_sweeps, cache_probe=True):
 
     from incubator_predictionio_tpu.ops import als
 
-    (u_light, u_heavy), (i_light, i_heavy), n_users, n_items = buckets
-    u_tree, i_tree = als._buckets_tree(u_light), als._buckets_tree(i_light)
-    u_hv, i_hv = als._heavy_tree(u_heavy), als._heavy_tree(i_heavy)
+    u_tree, i_tree, u_hv, i_hv, n_users, n_items = (
+        trees if trees is not None else build_trees(buckets))
 
     def train(state0):
         out = als._mixed_run(
             state0, u_tree, i_tree, L2, ITERATIONS, bf16_sweeps, True,
             jnp.float32, jax.lax.Precision.HIGHEST,
-            user_heavy=u_hv, item_heavy=i_hv)
+            user_heavy=u_hv, item_heavy=i_hv, use_kernel=use_kernel)
         # sync via a dependent 1-element device fetch: on the tunneled
         # platform jax.block_until_ready returns before execution finishes
         # (verified empirically), which silently turns the timer into a
@@ -477,7 +550,11 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
 
     from incubator_predictionio_tpu.ops import als  # noqa: F401
 
-    state, t = measure_train((u_b, i_b, n_users, n_items), BF16_SWEEPS)
+    buckets = (u_b, i_b, n_users, n_items)
+    trees = build_trees(buckets)
+    use_kernel, kernel_probe = select_als_kernel(buckets, trees=trees)
+    state, t = measure_train(buckets, BF16_SWEEPS,
+                             use_kernel=use_kernel, trees=trees)
     train_s = t["train_s"]
     fit = als.rmse(state, inter.user_idx, inter.item_idx, inter.values)
     flops = als_flops_per_run(BF16_SWEEPS)
@@ -505,6 +582,7 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
         "ingest_wall_s": round(ingest_s, 1),
         "prep_wall_s": round(prep_s, 1),
         "e2e_train_wall_s": round(ingest_s + prep_s + train_s, 1),
+        **kernel_probe,
         **attn,
         "serve_p50_ms": serve["p50_ms"],
         "serve_p99_ms": serve["p99_ms"],

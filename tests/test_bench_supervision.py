@@ -48,6 +48,7 @@ def test_orphaned_child_exits_without_claiming(tmp_path):
     store = tmp_path / "store"
     store.mkdir()
     pidfile = tmp_path / "pid"
+    childlog = tmp_path / "child.log"
     # the intermediate shell passes ITS pid as the parent handshake and
     # exits immediately; by the time the guard runs the child has been
     # reparented (to init or a subreaper — either way getppid() no
@@ -55,7 +56,7 @@ def test_orphaned_child_exits_without_claiming(tmp_path):
     subprocess.run(
         ["sh", "-c",
          f"{sys.executable} {BENCH} --tpu-child {store} {out_path} "
-         f"{claim} $$ >/dev/null 2>&1 & echo $! > {pidfile}"],
+         f"{claim} $$ >{childlog} 2>&1 & echo $! > {pidfile}"],
         env=_child_env(), timeout=30, check=True)
     pid = int(pidfile.read_text().strip())
     deadline = time.monotonic() + 120
@@ -67,6 +68,8 @@ def test_orphaned_child_exits_without_claiming(tmp_path):
         time.sleep(1)
     else:
         raise AssertionError("orphaned tpu child still alive after 120s")
-    # exited at the orphan guard: never claimed, never wrote a fragment
+    # exited AT THE GUARD (not via some startup crash): the log proves
+    # the orphan branch ran, and no claim/fragment was written
+    assert "orphaned waiter" in childlog.read_text()
     assert not claim.exists()
     assert not out_path.exists()

@@ -1,0 +1,92 @@
+"""The driver-bench contract, end-to-end at a tiny shape.
+
+`bench.py` is the round's external perf contract: the driver runs it
+once per round and records exactly what it prints. Round 4 was lost to
+this path breaking operationally (rc=3, parsed=null), so the whole
+orchestrator — host stages, supervised child, kernel selector, fragment
+assembly, the one-line JSON output — is pinned here on the CPU backend
+at a shape small enough for CI. Every field the judge's comparisons
+read must be present and typed; `degraded` must be False when the
+child lands (on CPU it always can).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+REQUIRED_FIELDS = {
+    "metric": str,
+    "value": float,
+    "unit": str,
+    "vs_baseline": float,
+    "degraded": bool,
+    "train_rmse": float,
+    "heldout_rmse": float,
+    "seed_wall_s": float,
+    "ingest_wall_s": float,
+    "prep_wall_s": float,
+    "ingest_http_eps": float,
+    "movielens_rmse": float,
+    "serve_p50_ms": float,
+    "serve_qps_concurrent": float,
+    "als_kernel": str,
+    "flash_kernel_active": bool,
+    "sasrec_epoch_s": float,
+}
+
+
+def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
+    # hermetic movielens sample (the default path lives outside the
+    # repo): same user::item::rating format, enough rows for the 80/20
+    # split to produce a real number
+    import numpy as np
+    rng = np.random.default_rng(0)
+    sample = tmp_path / "movielens.txt"
+    sample.write_text("".join(
+        f"{rng.integers(1, 40)}::{rng.integers(1, 25)}::"
+        f"{rng.integers(1, 6)}\n" for _ in range(500)))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PIO_BENCH_NNZ": "30000",
+        "PIO_BENCH_RANK": "16",
+        "PIO_BENCH_SWEEPS": "2",
+        "PIO_BENCH_ATTN_SEQS": "512",
+        "PIO_BENCH_ATTN_REPS": "2",
+        "PIO_BENCH_DEGRADED_NNZ": "20000",
+        "PIO_BENCH_INGEST_CLIENTS": "8",
+        "PIO_BENCH_INGEST_BATCHES": "20",
+        "PIO_BENCH_MOVIELENS": str(sample),
+        "PIO_BENCH_MOVIELENS_BOUND": "10.0",  # synthetic data, shape only
+    })
+    # own session so a timeout kill reaps the whole tree — otherwise the
+    # claimed child outlives the parent and keeps burning CPU
+    proc = subprocess.Popen(
+        [sys.executable, BENCH], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, cwd=str(tmp_path),
+        start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=540)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(proc.pid, signal.SIGKILL)  # CPU-only tree: safe
+        proc.wait()
+        raise
+    assert proc.returncode == 0, stderr[-2000:]
+    # contract: exactly one JSON line on stdout
+    lines = [ln for ln in stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, stdout
+    rec = json.loads(lines[0])
+    for field, typ in REQUIRED_FIELDS.items():
+        assert field in rec, f"missing {field}"
+        assert isinstance(rec[field], typ), (field, rec[field])
+    assert rec["degraded"] is False          # the CPU child always lands
+    assert rec["value"] > 0
+    assert rec["ingest_http_eps"] > 0
+    # the selector on a Mosaic-less backend reports honestly
+    assert rec["als_kernel"] in ("unavailable", "disabled", "on", "off",
+                                 "probe_failed")

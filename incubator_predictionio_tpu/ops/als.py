@@ -138,6 +138,15 @@ _CG_ITERS = int(os.environ.get("PIO_ALS_CG_ITERS", "16"))
 #: the dominant bf16-sweep traffic at ML-20M shape — by keeping each
 #: row's Gram and the whole CG solve in VMEM.
 _ALS_KERNEL = os.environ.get("PIO_ALS_KERNEL", "auto")
+#: minimum bucket width D for kernel routing when the kernel is enabled.
+#: Small-D buckets are where the fused solve loses: the kernel pads every
+#: row's gather to a full 128 lane tile ((dp−d)·K wasted read per row)
+#: and solves each row's CG serially, while its Gram-stream saving —
+#: (1+iters)·K² per row on the XLA path — is the same for every bucket,
+#: so it is RELATIVELY thinnest exactly where the padding tax is highest
+#: (measured on-chip at 2M nnz, D̄≈14: kernel 1.50 s vs XLA 1.15 s).
+#: Bucket widths are static at trace time, so routing is free.
+_KERNEL_MIN_D = int(os.environ.get("PIO_ALS_KERNEL_MIN_D", "64"))
 
 
 def _kernel_enabled(implicit: bool) -> bool:
@@ -424,14 +433,18 @@ def _sweep_side(
     implicit: bool,
     cg_iters: int = _CG_ITERS,
     use_kernel: bool = False,
+    kernel_min_d: int = 0,
 ) -> jax.Array:
     """One half-sweep (traced): solve every bucket + split rows, scatter.
 
     THE single sweep implementation — the fused trainer, als_sweep and
     als_sweep_implicit all trace through here, so the paths cannot
-    diverge. ``use_kernel`` (resolved by the caller, outside the trace)
-    routes explicit-CG buckets through the fused Pallas solve; the heavy
-    split-row path and implicit mode always use the XLA assembly."""
+    diverge. ``use_kernel`` and ``kernel_min_d`` (resolved by the caller,
+    outside the trace, and part of every jit cache key — a mid-trace
+    global read would silently survive a runtime override) route
+    explicit-CG buckets of width ≥ min-D through the fused Pallas solve;
+    narrower buckets, the heavy split-row path and implicit mode always
+    use the XLA assembly."""
     rank = other_factors.shape[1]
     out = jnp.zeros((n_rows, rank), jnp.float32)
     yty = _gram_all(other_factors, precision) if implicit else None
@@ -449,7 +462,7 @@ def _sweep_side(
                 return _solve_bucket_implicit(
                     other_factors, _yty, t[0], t[1], t[2], l2, alpha,
                     precision=precision, cg_iters=cg_iters)
-        elif use_kernel:
+        elif use_kernel and cols.shape[1] >= kernel_min_d:
             # chunk by the PADDED gather footprint the kernel actually
             # materializes (single source of truth in pallas_kernels)
             from incubator_predictionio_tpu.ops.pallas_kernels import (
@@ -485,14 +498,15 @@ def _sweep_side(
 @functools.partial(
     jax.jit,
     static_argnames=("n_rows", "reg_nnz", "compute_dtype", "precision",
-                     "implicit", "cg_iters", "use_kernel"),
+                     "implicit", "cg_iters", "use_kernel", "kernel_min_d"),
 )
 def _sweep_side_jit(n_rows, other_factors, tree, heavy, l2, alpha, reg_nnz,
                     compute_dtype, precision, implicit,
-                    cg_iters=_CG_ITERS, use_kernel=False):
+                    cg_iters=_CG_ITERS, use_kernel=False, kernel_min_d=0):
     return _sweep_side(n_rows, other_factors, tree, heavy, l2, alpha,
                        reg_nnz, compute_dtype, precision, implicit,
-                       cg_iters=cg_iters, use_kernel=use_kernel)
+                       cg_iters=cg_iters, use_kernel=use_kernel,
+                       kernel_min_d=kernel_min_d)
 
 
 def _update_side(
@@ -507,7 +521,7 @@ def _update_side(
     return _sweep_side_jit(
         n_rows, other_factors, _buckets_tree(buckets), None, l2, 0.0,
         reg_nnz, compute_dtype, precision, implicit=False,
-        use_kernel=_kernel_enabled(False))
+        use_kernel=_kernel_enabled(False), kernel_min_d=_KERNEL_MIN_D)
 
 
 def assert_no_split(buckets: Sequence[PaddedRows], side: str = "row") -> None:
@@ -857,7 +871,7 @@ def _solve_heavy(
 @functools.partial(
     jax.jit,
     static_argnames=("iterations", "reg_nnz", "compute_dtype", "precision",
-                     "implicit", "cg_iters", "use_kernel"),
+                     "implicit", "cg_iters", "use_kernel", "kernel_min_d"),
     donate_argnames=("state",),
 )
 def _als_run_fused(
@@ -875,16 +889,19 @@ def _als_run_fused(
     item_heavy=None,
     cg_iters: int = _CG_ITERS,
     use_kernel: bool = False,
+    kernel_min_d: int = 0,
 ) -> ALSState:
     def body(_, st):
         new_users = _sweep_side(
             st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
-            cg_iters=cg_iters, use_kernel=use_kernel)
+            cg_iters=cg_iters, use_kernel=use_kernel,
+            kernel_min_d=kernel_min_d)
         new_items = _sweep_side(
             st.item_factors.shape[0], new_users, item_tree, item_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
-            cg_iters=cg_iters, use_kernel=use_kernel)
+            cg_iters=cg_iters, use_kernel=use_kernel,
+            kernel_min_d=kernel_min_d)
         return ALSState(user_factors=new_users, item_factors=new_items)
 
     return jax.lax.fori_loop(0, iterations, body, state)
@@ -903,6 +920,7 @@ def _mixed_run(
     user_heavy,
     item_heavy,
     use_kernel: Optional[bool] = None,
+    kernel_min_d: Optional[int] = None,
 ) -> ALSState:
     """Mixed-precision schedule: ``bf16_sweeps`` early sweeps with bf16
     gathers + single-pass MXU matmuls (DEFAULT precision), then the
@@ -922,20 +940,22 @@ def _mixed_run(
     # GSPMD, so the sharded program keeps the XLA assembly.
     if use_kernel is None:
         use_kernel = _kernel_enabled(False)
+    if kernel_min_d is None:
+        kernel_min_d = _KERNEL_MIN_D
     if lo:
         state = _als_run_fused(
             state, u_tree, i_tree, l2, 0.0, lo, reg_nnz,
             jnp.bfloat16, jax.lax.Precision.DEFAULT, implicit=False,
             user_heavy=user_heavy, item_heavy=item_heavy,
             cg_iters=min(_CG_ITERS_BF16, _CG_ITERS),
-            use_kernel=use_kernel,
+            use_kernel=use_kernel, kernel_min_d=kernel_min_d,
         )
     if iterations - lo:
         state = _als_run_fused(
             state, u_tree, i_tree, l2, 0.0, iterations - lo, reg_nnz,
             compute_dtype, precision, implicit=False,
             user_heavy=user_heavy, item_heavy=item_heavy,
-            use_kernel=use_kernel,
+            use_kernel=use_kernel, kernel_min_d=kernel_min_d,
         )
     return state
 

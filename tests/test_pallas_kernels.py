@@ -227,6 +227,9 @@ class TestAlsCgKernel:
         monkeypatch.setattr(als, "_ALS_KERNEL", "off")
         st_xla, _ = als.als_train(users, items, ratings, **kw)
         monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+        # this problem's buckets are narrower than the default min-D
+        # routing cut — force every bucket through the kernel
+        monkeypatch.setattr(als, "_KERNEL_MIN_D", 0)
         st_krn, _ = als.als_train(users, items, ratings, **kw)
         r_xla = als.rmse(st_xla, users, items, ratings)
         r_krn = als.rmse(st_krn, users, items, ratings)
@@ -234,6 +237,39 @@ class TestAlsCgKernel:
         # so it may be (slightly) more accurate than the bf16 XLA path
         assert r_krn < max(1.15 * r_xla, r_xla + 0.02), (r_krn, r_xla)
         assert r_krn < 0.1, r_krn
+
+    def test_min_d_routing(self, monkeypatch):
+        """With the kernel enabled, buckets narrower than _KERNEL_MIN_D
+        stay on the XLA path (the padding tax region) while wide buckets
+        route through the fused solve — decided per bucket at trace
+        time."""
+        from incubator_predictionio_tpu.ops import als
+
+        widths = []
+        real = als._solve_bucket_kernel
+
+        def spy(gsrc, cols, vals, mask, l2, reg_nnz, cg_iters):
+            widths.append(cols.shape[1])
+            return real(gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz,
+                        cg_iters=cg_iters)
+
+        monkeypatch.setattr(als, "_solve_bucket_kernel", spy)
+        monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+        monkeypatch.setattr(als, "_KERNEL_MIN_D", 64)
+
+        rng = np.random.default_rng(3)
+        n_u, n_i = 300, 40
+        # ~85% of users rate <16 items (narrow buckets), a few rate 100+
+        # (wide buckets) — both routing branches must appear
+        degs = np.where(rng.random(n_u) < 0.85, rng.integers(2, 12, n_u),
+                        rng.integers(100, 160, n_u)).astype(np.int64)
+        users = np.repeat(np.arange(n_u, dtype=np.int32), degs)
+        items = rng.integers(0, n_i, len(users)).astype(np.int32)
+        ratings = rng.normal(3.5, 1.0, len(users)).astype(np.float32)
+        als.als_train(users, items, ratings, n_users=n_u, n_items=n_i,
+                      rank=8, iterations=1, l2=0.05)
+        assert widths, "no bucket routed through the kernel"
+        assert all(w >= 64 for w in widths), widths
 
 
 def test_flash_block_table_selection(monkeypatch):

@@ -1227,7 +1227,9 @@ def bench_serving(state, inter):
     # direct state injection: the bench measures the serving path, not the
     # checkpoint restore (engine=None is never touched by /queries.json)
     server.engine = None
-    server.config = ServerConfig(ip="127.0.0.1", port=0)
+    server.config = ServerConfig(
+        ip="127.0.0.1", port=0,
+        micro_batch=int(os.environ.get("PIO_BENCH_SERVE_MICRO_BATCH", 64)))
     from incubator_predictionio_tpu.servers.plugins import PluginContext
     from incubator_predictionio_tpu.servers.prediction_server import (
         _AsyncPoster,
@@ -1253,7 +1255,8 @@ def bench_serving(state, inter):
     server._conf_server_key = None
     server.http = HttpServer(server._build_router(), "127.0.0.1", 0)
     server._batcher = _MicroBatcher(server._handle_batch,
-                                    server.config.micro_batch)
+                                    server.config.micro_batch,
+                                    workers=server.config.serve_workers)
     server._feedback_poster = _AsyncPoster("feedback")
     server._log_poster = _AsyncPoster("log", workers=1)
     port = server.http.start_background()

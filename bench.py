@@ -333,7 +333,8 @@ def select_als_kernel(buckets, trees=None):
     polish sweep) — warm-timed; the kernel must beat the XLA path
     outright (ties keep the battle-tested path). Any crash in the probe
     falls back to the XLA path instead of forfeiting the accelerator
-    leg. → (use_kernel, fragment fields recording the outcome)."""
+    leg. → (use_kernel, rows_per_program, fragment fields recording the
+    outcome)."""
     import jax
     import jax.numpy as jnp
 
@@ -343,48 +344,63 @@ def select_als_kernel(buckets, trees=None):
         # distinguish an operator override from backend inability so the
         # fragment's cross-round comparison stays meaningful
         forced_off = als._ALS_KERNEL == "off" or als._SOLVER != "cg"
-        return False, {"als_kernel": "disabled" if forced_off
-                       else "unavailable"}
+        return False, 1, {"als_kernel": "disabled" if forced_off
+                          else "unavailable"}
     u_tree, i_tree, u_hv, i_hv, n_users, n_items = (
         trees if trees is not None else build_trees(buckets))
     # mirror the main schedule's leg structure: probe the polish program
     # too when the real run will use it
     polish = BF16_SWEEPS < ITERATIONS
     its = 2 if polish else 1
+    # (use_kernel, rows-per-program): both kernel layouts compete with
+    # the XLA path, so the bench self-selects the best and records every
+    # timing — the on-chip layout comparison ships in the fragment
+    legs = [(False, 1), (True, 1), (True, 8)]
     times = {}
-    for uk in (False, True):
+    for uk, rows in legs:
         def train():
             out = als._mixed_run(
                 als.als_init(jax.random.key(0), n_users, n_items, RANK),
                 u_tree, i_tree, L2, its, 1, True,
                 jnp.float32, jax.lax.Precision.HIGHEST,
-                user_heavy=u_hv, item_heavy=i_hv, use_kernel=uk)
+                user_heavy=u_hv, item_heavy=i_hv, use_kernel=uk,
+                kernel_rows=rows)
             np.asarray(out.user_factors[0:1, 0:1])
             np.asarray(out.item_factors[0:1, 0:1])
         try:
             train()  # compile + first run
             t0 = time.perf_counter()
             train()
-            times[uk] = time.perf_counter() - t0
+            times[(uk, rows)] = time.perf_counter() - t0
         except Exception as e:  # full-shape-only kernel failure
             if not uk:
                 raise  # the XLA path must work; nothing to fall back to
-            log(f"ALS kernel probe crashed at full shape ({e!r}); "
-                "keeping the XLA path")
-            return False, {"als_kernel": "probe_failed"}
-    choice = bool(times[True] < 0.97 * times[False])
-    log(f"ALS kernel probe ({its} sweep(s), full shape): "
-        f"xla={times[False]:.3f}s pallas={times[True]:.3f}s -> "
-        f"{'pallas' if choice else 'xla'}")
-    return choice, {
-        "als_kernel_sweep_xla_s": round(times[False], 3),
-        "als_kernel_sweep_pallas_s": round(times[True], 3),
-        "als_kernel": "on" if choice else "off",
-    }
+            log(f"ALS kernel probe (rows={rows}) crashed at full shape "
+                f"({e!r}); leg skipped")
+    xla = times[(False, 1)]
+    kernel_times = {rows: t for (uk, rows), t in times.items() if uk}
+    frag = {"als_kernel_sweep_xla_s": round(xla, 3)}
+    for rows, t in kernel_times.items():
+        frag[f"als_kernel_sweep_pallas_r{rows}_s"] = round(t, 3)
+    if not kernel_times:
+        frag["als_kernel"] = "probe_failed"
+        log("ALS kernel probe: every kernel leg crashed; XLA path serves")
+        return False, 1, frag
+    best_rows = min(kernel_times, key=kernel_times.get)
+    best = kernel_times[best_rows]
+    choice = bool(best < 0.97 * xla)
+    log(f"ALS kernel probe ({its} sweep(s), full shape): xla={xla:.3f}s "
+        + " ".join(f"pallas_r{r}={t:.3f}s"
+                   for r, t in sorted(kernel_times.items()))
+        + f" -> {'pallas' if choice else 'xla'}"
+        + (f" rows={best_rows}" if choice else ""))
+    frag["als_kernel"] = "on" if choice else "off"
+    frag["als_kernel_rows"] = best_rows
+    return choice, best_rows, frag
 
 
 def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
-                  trees=None):
+                  trees=None, kernel_rows=None):
     """Compile-cold / warm / warm-persistent-cache timing of the fused
     training run. → (state, dict of timing keys)."""
     import atexit
@@ -403,7 +419,8 @@ def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
         out = als._mixed_run(
             state0, u_tree, i_tree, L2, ITERATIONS, bf16_sweeps, True,
             jnp.float32, jax.lax.Precision.HIGHEST,
-            user_heavy=u_hv, item_heavy=i_hv, use_kernel=use_kernel)
+            user_heavy=u_hv, item_heavy=i_hv, use_kernel=use_kernel,
+            kernel_rows=kernel_rows)
         # sync via a dependent 1-element device fetch: on the tunneled
         # platform jax.block_until_ready returns before execution finishes
         # (verified empirically), which silently turns the timer into a
@@ -552,9 +569,10 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
 
     buckets = (u_b, i_b, n_users, n_items)
     trees = build_trees(buckets)
-    use_kernel, kernel_probe = select_als_kernel(buckets, trees=trees)
-    state, t = measure_train(buckets, BF16_SWEEPS,
-                             use_kernel=use_kernel, trees=trees)
+    use_kernel, kernel_rows, kernel_probe = select_als_kernel(
+        buckets, trees=trees)
+    state, t = measure_train(buckets, BF16_SWEEPS, use_kernel=use_kernel,
+                             trees=trees, kernel_rows=kernel_rows)
     train_s = t["train_s"]
     fit = als.rmse(state, inter.user_idx, inter.item_idx, inter.values)
     flops = als_flops_per_run(BF16_SWEEPS)

@@ -149,6 +149,16 @@ _ALS_KERNEL = os.environ.get("PIO_ALS_KERNEL", "auto")
 _KERNEL_MIN_D = int(os.environ.get("PIO_ALS_KERNEL_MIN_D", "64"))
 
 
+def _kernel_rows_default() -> int:
+    """Current rows-per-program default (PIO_ALS_KERNEL_ROWS, owned by
+    pallas_kernels). Read at CALL time so sweeps/monkeypatches see it;
+    the resolved value is threaded as a static jit arg — never read
+    mid-trace."""
+    from incubator_predictionio_tpu.ops import pallas_kernels
+
+    return pallas_kernels._ALS_ROWS
+
+
 def _kernel_enabled(implicit: bool) -> bool:
     """Resolve the bucket-kernel selector OUTSIDE any jit trace (the
     Mosaic probe compiles+runs a real kernel). Explicit CG only: the
@@ -321,6 +331,7 @@ def _solve_bucket_kernel(
     l2: float,
     reg_nnz: bool,
     cg_iters: int,
+    kernel_rows: int = 1,
 ) -> jax.Array:
     """Explicit-CG bucket solve via the fused Pallas kernel.
 
@@ -328,13 +339,16 @@ def _solve_bucket_kernel(
     empty rows → 0. The [B, K, K] Gram batch lives only in VMEM — see
     ops/pallas_kernels.als_solve_cg_pallas. (Interpret-mode selection
     happens inside the kernel wrapper: no Mosaic backend → interpret,
-    which is how PIO_ALS_KERNEL=on works on the CPU test mesh.)"""
+    which is how PIO_ALS_KERNEL=on works on the CPU test mesh.)
+    ``kernel_rows`` selects the one-row or row-grouped kernel layout
+    (resolved by the caller via :func:`_kernel_rows_default`)."""
     from incubator_predictionio_tpu.ops.pallas_kernels import (
         als_solve_cg_pallas,
     )
 
     return als_solve_cg_pallas(
-        gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz, iters=cg_iters)
+        gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz, iters=cg_iters,
+        rows_per_program=max(kernel_rows, 1))
 
 
 #: f32-element budget for one bucket chunk's gather intermediate
@@ -434,6 +448,7 @@ def _sweep_side(
     cg_iters: int = _CG_ITERS,
     use_kernel: bool = False,
     kernel_min_d: int = 0,
+    kernel_rows: int = 1,
 ) -> jax.Array:
     """One half-sweep (traced): solve every bucket + split rows, scatter.
 
@@ -474,7 +489,7 @@ def _sweep_side(
             def solver(t):
                 return _solve_bucket_kernel(
                     gsrc, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
-                    cg_iters=cg_iters)
+                    cg_iters=cg_iters, kernel_rows=kernel_rows)
         else:
             def solver(t):
                 return _solve_bucket(
@@ -498,15 +513,17 @@ def _sweep_side(
 @functools.partial(
     jax.jit,
     static_argnames=("n_rows", "reg_nnz", "compute_dtype", "precision",
-                     "implicit", "cg_iters", "use_kernel", "kernel_min_d"),
+                     "implicit", "cg_iters", "use_kernel", "kernel_min_d",
+                     "kernel_rows"),
 )
 def _sweep_side_jit(n_rows, other_factors, tree, heavy, l2, alpha, reg_nnz,
                     compute_dtype, precision, implicit,
-                    cg_iters=_CG_ITERS, use_kernel=False, kernel_min_d=0):
+                    cg_iters=_CG_ITERS, use_kernel=False, kernel_min_d=0,
+                    kernel_rows=1):
     return _sweep_side(n_rows, other_factors, tree, heavy, l2, alpha,
                        reg_nnz, compute_dtype, precision, implicit,
                        cg_iters=cg_iters, use_kernel=use_kernel,
-                       kernel_min_d=kernel_min_d)
+                       kernel_min_d=kernel_min_d, kernel_rows=kernel_rows)
 
 
 def _update_side(
@@ -521,7 +538,8 @@ def _update_side(
     return _sweep_side_jit(
         n_rows, other_factors, _buckets_tree(buckets), None, l2, 0.0,
         reg_nnz, compute_dtype, precision, implicit=False,
-        use_kernel=_kernel_enabled(False), kernel_min_d=_KERNEL_MIN_D)
+        use_kernel=_kernel_enabled(False), kernel_min_d=_KERNEL_MIN_D,
+        kernel_rows=_kernel_rows_default())
 
 
 def assert_no_split(buckets: Sequence[PaddedRows], side: str = "row") -> None:
@@ -871,7 +889,8 @@ def _solve_heavy(
 @functools.partial(
     jax.jit,
     static_argnames=("iterations", "reg_nnz", "compute_dtype", "precision",
-                     "implicit", "cg_iters", "use_kernel", "kernel_min_d"),
+                     "implicit", "cg_iters", "use_kernel", "kernel_min_d",
+                     "kernel_rows"),
     donate_argnames=("state",),
 )
 def _als_run_fused(
@@ -890,18 +909,19 @@ def _als_run_fused(
     cg_iters: int = _CG_ITERS,
     use_kernel: bool = False,
     kernel_min_d: int = 0,
+    kernel_rows: int = 1,
 ) -> ALSState:
     def body(_, st):
         new_users = _sweep_side(
             st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
             cg_iters=cg_iters, use_kernel=use_kernel,
-            kernel_min_d=kernel_min_d)
+            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows)
         new_items = _sweep_side(
             st.item_factors.shape[0], new_users, item_tree, item_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
             cg_iters=cg_iters, use_kernel=use_kernel,
-            kernel_min_d=kernel_min_d)
+            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows)
         return ALSState(user_factors=new_users, item_factors=new_items)
 
     return jax.lax.fori_loop(0, iterations, body, state)
@@ -921,6 +941,7 @@ def _mixed_run(
     item_heavy,
     use_kernel: Optional[bool] = None,
     kernel_min_d: Optional[int] = None,
+    kernel_rows: Optional[int] = None,
 ) -> ALSState:
     """Mixed-precision schedule: ``bf16_sweeps`` early sweeps with bf16
     gathers + single-pass MXU matmuls (DEFAULT precision), then the
@@ -942,6 +963,8 @@ def _mixed_run(
         use_kernel = _kernel_enabled(False)
     if kernel_min_d is None:
         kernel_min_d = _KERNEL_MIN_D
+    if kernel_rows is None:
+        kernel_rows = _kernel_rows_default()
     if lo:
         state = _als_run_fused(
             state, u_tree, i_tree, l2, 0.0, lo, reg_nnz,
@@ -949,6 +972,7 @@ def _mixed_run(
             user_heavy=user_heavy, item_heavy=item_heavy,
             cg_iters=min(_CG_ITERS_BF16, _CG_ITERS),
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
+            kernel_rows=kernel_rows,
         )
     if iterations - lo:
         state = _als_run_fused(
@@ -956,6 +980,7 @@ def _mixed_run(
             compute_dtype, precision, implicit=False,
             user_heavy=user_heavy, item_heavy=item_heavy,
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
+            kernel_rows=kernel_rows,
         )
     return state
 

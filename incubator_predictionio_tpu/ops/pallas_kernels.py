@@ -733,6 +733,87 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
         o_ref[0] = x
 
 
+def _als_cg_kernel_rows(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
+                        *, iters: int, n_d_blocks: int, precise: bool):
+    """Row-grouped variant of :func:`_als_cg_kernel`: R rows per program.
+
+    The one-row kernel is per-program-overhead-bound at ML-20M shape
+    (~165k programs per half-sweep, each with ~0.1 µs of real work);
+    grouping R=8 sublane-aligned rows cuts the program count 8× and
+    batches the CG across the group. Aux arrays are plain 2-D here —
+    an R-row block satisfies Mosaic's sublane rule directly.
+
+    g_ref:   [R, dt, Kp]  row group's masked gathered factors, one d tile
+    wv_ref:  [R, dt]      vals·mask tile, f32
+    lam_ref: [R, Kp]      per-row ridge, broadcast across K
+    o_ref:   [R, Kp]      solutions, written on the last d step
+    gram/rhs scratch: [R, Kp, Kp] / [R, Kp], persist across d steps.
+    """
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        gram_ref[...] = jnp.zeros_like(gram_ref)
+        rhs_ref[...] = jnp.zeros_like(rhs_ref)
+
+    g = g_ref[...]                                       # [R, dt, Kp]
+    wv = wv_ref[...].astype(g.dtype)                     # [R, dt]
+    prec = (jax.lax.Precision.HIGHEST if precise
+            else jax.lax.Precision.DEFAULT)
+    # Mosaic's dot lowering is 2-D only (batched dot_general fails to
+    # parse) — unroll the static R rows; each Gram update stays one
+    # [dt,Kp]ᵗ[dt,Kp] MXU pass
+    for r in range(g.shape[0]):
+        g_r = g[r]                                       # [dt, Kp]
+        gram_ref[r] += jax.lax.dot_general(
+            g_r, g_r, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        rhs_ref[r:r + 1] += jax.lax.dot_general(
+            wv[r:r + 1], g_r, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+
+    @pl.when(j == n_d_blocks - 1)
+    def _solve():
+        gram = gram_ref[...]                             # [R, Kp, Kp] f32
+        lam = lam_ref[...]                               # [R, Kp]
+        r_n, kp = gram.shape[0], gram.shape[1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (r_n, kp, kp), 1)
+        col = jax.lax.broadcasted_iota(jnp.int32, (r_n, kp, kp), 2)
+        diag = jnp.sum(jnp.where(row == col, gram, 0.0), axis=1) + lam
+        minv = jnp.where(diag > 0, 1.0 / diag, 0.0)      # [R, Kp]
+        b = rhs_ref[...]                                 # [R, Kp]
+
+        def matvec(p):
+            # gram is symmetric; [R,Kp,Kp]·[R,Kp] as a VPU
+            # broadcast-reduce (8·128² f32 — tiny), sidestepping
+            # Mosaic's 2-D-only dots for the batched case
+            return jnp.sum(gram * p[:, :, None], axis=1) + lam * p
+
+        # batched Jacobi-PCG, numerics per ops/als.py _cg_solve_spd;
+        # every reduction is per-row so groups never mix
+        def body(_, carry):
+            x, r, p, rz = carry
+            ap = matvec(p)
+            pap = jnp.sum(p * ap, axis=1, keepdims=True)    # [R, 1]
+            alpha = jnp.where(pap > 0, rz / pap, 0.0)
+            x = x + alpha * p
+            r = r - alpha * ap
+            z = minv * r
+            rz2 = jnp.sum(r * z, axis=1, keepdims=True)
+            beta = jnp.where(rz > 0, rz2 / rz, 0.0)
+            p = z + beta * p
+            return x, r, p, rz2
+
+        x0 = jnp.zeros_like(b)
+        z0 = minv * b
+        rz0 = jnp.sum(b * z0, axis=1, keepdims=True)
+        x, _r, _p, _rz = jax.lax.fori_loop(
+            0, iters, body, (x0, b, z0, rz0))
+        o_ref[...] = x
+
+
 def als_padded_dims(d: int, k: int) -> Tuple[int, int]:
     """(dp, kp) padding of :func:`als_solve_cg_pallas` — THE single copy
     of its padding math; the kernel and its chunk-sizing callers both
@@ -748,6 +829,14 @@ def als_padded_row_elems(d: int, k: int) -> int:
     return dp * kp
 
 
+#: rows per program for the fused ALS solve. 1 = the proven one-program-
+#: per-row layout; 8 = sublane-aligned row groups (8× fewer programs,
+#: batched CG) — the per-program-overhead lever. Sweep on chip with
+#: scripts/als_kernel_bench.py (PIO_TUNE_ROWS) before changing the
+#: default.
+_ALS_ROWS = int(os.environ.get("PIO_ALS_KERNEL_ROWS", "1"))
+
+
 def als_solve_cg_pallas(
     table: jax.Array,              # [M, K] factor table (bf16 fast path)
     cols: jax.Array,               # [B, D] int32
@@ -757,6 +846,7 @@ def als_solve_cg_pallas(
     reg_nnz: bool = True,
     iters: int = 16,
     interpret: Optional[bool] = None,
+    rows_per_program: Optional[int] = None,
 ) -> jax.Array:
     """Fused normal-equation solve for one bucket chunk → [B, K] f32.
 
@@ -768,10 +858,19 @@ def als_solve_cg_pallas(
 
     D is padded to a lane multiple (min 128) and K to a 128 multiple;
     padding columns carry zero mask/vals and padding rank coordinates
-    solve to exactly 0 (see kernel docstring), so the slice-back is exact.
+    solve to exactly 0 (see kernel docstring), so the slice-back is
+    exact. ``rows_per_program`` > 1 (sublane multiples only) pads the row
+    count and runs the row-grouped kernel; padding rows carry zero
+    mask/vals and solve to exactly 0, sliced away on return.
     """
     if interpret is None:
         interpret = not pallas_available()
+    rows = _ALS_ROWS if rows_per_program is None else int(rows_per_program)
+    # group sizes must satisfy Mosaic's sublane rule: 1 (the [B,1,x] aux
+    # layout) or a multiple of 8 (a (rows, dt) block). Anything else is
+    # rounded UP to the next legal group instead of crashing the
+    # lowering mid-training.
+    rows = 1 if rows <= 1 else _round_up(rows, 8)
     B, d = cols.shape
     k = table.shape[1]
     dp, kp = als_padded_dims(d, k)
@@ -781,16 +880,49 @@ def als_solve_cg_pallas(
 
     gathered = table[cols]                               # [B, D, K]
     g = gathered * mask[..., None].astype(gathered.dtype)
-    g = jnp.pad(g, ((0, 0), (0, dp - d), (0, kp - k)))
-    # per-row auxes ride as [B, 1, x] — see kernel docstring block note
-    wv = jnp.pad((vals * mask).astype(jnp.float32),
-                 ((0, 0), (0, dp - d)))[:, None, :]
+    wv2 = jnp.pad((vals * mask).astype(jnp.float32),
+                  ((0, 0), (0, dp - d)))
     nnz = jnp.sum(mask, axis=-1)
     lam = l2 * (jnp.maximum(nnz, 1.0) if reg_nnz
                 else jnp.ones_like(nnz))
+    n_d = dp // dt
+
+    if rows > 1:
+        bp = _round_up(B, rows)
+        g = jnp.pad(g, ((0, bp - B), (0, dp - d), (0, kp - k)))
+        wv2 = jnp.pad(wv2, ((0, bp - B), (0, 0)))
+        # padding rows get λ of an empty system (b = 0, gram = 0 → x = 0)
+        lam_b = jnp.pad(jnp.broadcast_to(lam[:, None], (B, kp)),
+                        ((0, bp - B), (0, 0)), constant_values=1.0)
+        out = pl.pallas_call(
+            functools.partial(_als_cg_kernel_rows, iters=int(iters),
+                              n_d_blocks=n_d,
+                              precise=table.dtype == jnp.float32),
+            grid=(bp // rows, n_d),
+            in_specs=[
+                pl.BlockSpec((rows, dt, kp), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((rows, dt), lambda i, j: (i, j),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((rows, kp), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((rows, kp), lambda i, j: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((bp, kp), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((rows, kp, kp), jnp.float32),  # gram acc
+                pltpu.VMEM((rows, kp), jnp.float32),      # rhs acc
+            ],
+            interpret=interpret,
+        )(g, wv2, lam_b)
+        return out[:B, :k]
+
+    g = jnp.pad(g, ((0, 0), (0, dp - d), (0, kp - k)))
+    # per-row auxes ride as [B, 1, x] — see kernel docstring block note
+    wv = wv2[:, None, :]
     lam_b = jnp.broadcast_to(lam[:, None, None], (B, 1, kp))
 
-    n_d = dp // dt
     out = pl.pallas_call(
         functools.partial(_als_cg_kernel, iters=int(iters), n_d_blocks=n_d,
                           precise=table.dtype == jnp.float32),

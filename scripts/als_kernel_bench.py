@@ -87,30 +87,35 @@ def main() -> int:
     # kernel leg on the real Mosaic probe (forcing past a failed probe
     # would either crash mid-run or silently time interpret mode)
     kernel_ok = als._kernel_enabled(False)
-    # each leg: (use_kernel, min-D routing cut). PIO_TUNE_MIN_DS sweeps
-    # the cut so one chip window yields the whole routing picture
-    legs = [(False, 0)]
+    # each leg: (use_kernel, min-D routing cut, rows per program).
+    # PIO_TUNE_MIN_DS × PIO_TUNE_ROWS sweep both knobs so one chip window
+    # yields the whole layout picture
+    legs = [(False, 0, 1)]
     if kernel_ok:
         min_ds = [int(v) for v in os.environ.get(
-            "PIO_TUNE_MIN_DS", "0,64,128").split(",") if v.strip()]
-        if not min_ds:
+            "PIO_TUNE_MIN_DS", "0,64").split(",") if v.strip()]
+        rows_l = [int(v) for v in os.environ.get(
+            "PIO_TUNE_ROWS", "1,8").split(",") if v.strip()]
+        if not min_ds or not rows_l:
             print(json.dumps({"kernel": True,
-                              "skipped": "PIO_TUNE_MIN_DS is empty"}),
+                              "skipped": "PIO_TUNE_MIN_DS or "
+                                         "PIO_TUNE_ROWS is empty"}),
                   flush=True)
-        legs += [(True, d) for d in min_ds]
+        legs += [(True, d, r) for r in rows_l for d in min_ds]
     else:
         print(json.dumps({"kernel": True,
                           "skipped": "als_kernel_available() is False on "
                                      "this backend (or PIO_ALS_KERNEL=off)"
                           }), flush=True)
-    for use_kernel, min_d in legs:
+    for use_kernel, min_d, rows in legs:
         def train():
             out = als._mixed_run(
                 als.als_init(jax.random.key(0), n_users, n_items, rank),
                 u_tree, i_tree, l2, sweeps, sweeps, True,
                 jnp.float32, jax.lax.Precision.HIGHEST,
                 user_heavy=u_hv, item_heavy=i_hv,
-                use_kernel=use_kernel, kernel_min_d=min_d)
+                use_kernel=use_kernel, kernel_min_d=min_d,
+                kernel_rows=rows)
             np.asarray(out.user_factors[0:1, 0:1])
             np.asarray(out.item_factors[0:1, 0:1])
             return out
@@ -124,6 +129,7 @@ def main() -> int:
         rec = {
             "kernel": use_kernel,
             "kernel_min_d": min_d,
+            "kernel_rows": rows,
             "warm_s": round(warm, 3),
             "compile_s": round(max(first - warm, 0.0), 1),
             "mfu_f32_peak": round(flops / warm / peak_f32, 4),

@@ -42,27 +42,30 @@ def _tiny_buckets(bench):
 def test_unavailable_backend_skips_the_probe(bench, monkeypatch):
     from incubator_predictionio_tpu.ops import als
     monkeypatch.setattr(als, "_ALS_KERNEL", "auto")
-    use, frag = bench.select_als_kernel(_tiny_buckets(bench))
-    assert use is False
+    use, rows, frag = bench.select_als_kernel(_tiny_buckets(bench))
+    assert use is False and rows == 1
     assert frag == {"als_kernel": "unavailable"}
 
 
 def test_operator_override_recorded_as_disabled(bench, monkeypatch):
     from incubator_predictionio_tpu.ops import als
     monkeypatch.setattr(als, "_ALS_KERNEL", "off")
-    use, frag = bench.select_als_kernel(_tiny_buckets(bench))
-    assert use is False
+    use, rows, frag = bench.select_als_kernel(_tiny_buckets(bench))
+    assert use is False and rows == 1
     assert frag == {"als_kernel": "disabled"}
 
 
 def test_forced_on_measures_both_legs(bench, monkeypatch):
     from incubator_predictionio_tpu.ops import als
     monkeypatch.setattr(als, "_ALS_KERNEL", "on")
-    use, frag = bench.select_als_kernel(_tiny_buckets(bench))
+    use, rows, frag = bench.select_als_kernel(_tiny_buckets(bench))
     # interpret mode on CPU is never faster than XLA, so the measured
     # choice must fall back — the exact protection this selector exists
     # to provide on hardware
     assert isinstance(use, bool)
+    assert rows in (1, 8)
     assert frag["als_kernel"] == ("on" if use else "off")
     assert frag["als_kernel_sweep_xla_s"] > 0
-    assert frag["als_kernel_sweep_pallas_s"] > 0
+    assert frag["als_kernel_sweep_pallas_r1_s"] > 0
+    assert frag["als_kernel_sweep_pallas_r8_s"] > 0
+    assert frag["als_kernel_rows"] == rows

@@ -162,11 +162,12 @@ class TestAlsCgKernel:
         mask[3] = 0.0  # empty row must solve to exactly 0
         return table, cols, vals, mask
 
+    @pytest.mark.parametrize("rows", [1, 8])
     @pytest.mark.parametrize("dtype,prec,tol", [
         (jnp.float32, jax.lax.Precision.HIGHEST, 1e-4),
         (jnp.bfloat16, jax.lax.Precision.DEFAULT, 2e-2),
     ])
-    def test_matches_solve_bucket(self, dtype, prec, tol):
+    def test_matches_solve_bucket(self, dtype, prec, tol, rows):
         from incubator_predictionio_tpu.ops import als
         from incubator_predictionio_tpu.ops.pallas_kernels import (
             als_solve_cg_pallas,
@@ -180,20 +181,23 @@ class TestAlsCgKernel:
             cg_iters=16)
         got = als_solve_cg_pallas(
             src, jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
-            0.1, reg_nnz=True, iters=16, interpret=True)
+            0.1, reg_nnz=True, iters=16, interpret=True,
+            rows_per_program=rows)
         rel = float(jnp.max(jnp.abs(ref - got))
                     / (jnp.max(jnp.abs(ref)) + 1e-9))
         assert rel < tol, rel
         assert bool(jnp.all(got[3] == 0.0))
 
-    def test_multi_tile_d_and_no_reg_nnz(self):
-        """D=1024 streams two 512-wide tiles through the accumulator."""
+    @pytest.mark.parametrize("rows", [1, 8])
+    def test_multi_tile_d_and_no_reg_nnz(self, rows):
+        """D=1024 streams two 512-wide tiles through the accumulator;
+        B=13 forces row-group padding in the grouped variant."""
         from incubator_predictionio_tpu.ops import als
         from incubator_predictionio_tpu.ops.pallas_kernels import (
             als_solve_cg_pallas,
         )
 
-        table, cols, vals, mask = self._problem(seed=1, M=600, K=32, B=8,
+        table, cols, vals, mask = self._problem(seed=1, M=600, K=32, B=13,
                                                 D=1024)
         src = jnp.asarray(table)
         ref = als._solve_bucket(
@@ -201,7 +205,8 @@ class TestAlsCgKernel:
             0.05, reg_nnz=False, cg_iters=16)
         got = als_solve_cg_pallas(
             src, jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
-            0.05, reg_nnz=False, iters=16, interpret=True)
+            0.05, reg_nnz=False, iters=16, interpret=True,
+            rows_per_program=rows)
         rel = float(jnp.max(jnp.abs(ref - got))
                     / (jnp.max(jnp.abs(ref)) + 1e-9))
         assert rel < 1e-4, rel
@@ -248,10 +253,11 @@ class TestAlsCgKernel:
         widths = []
         real = als._solve_bucket_kernel
 
-        def spy(gsrc, cols, vals, mask, l2, reg_nnz, cg_iters):
+        def spy(gsrc, cols, vals, mask, l2, reg_nnz, cg_iters,
+                kernel_rows=1):
             widths.append(cols.shape[1])
             return real(gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz,
-                        cg_iters=cg_iters)
+                        cg_iters=cg_iters, kernel_rows=kernel_rows)
 
         monkeypatch.setattr(als, "_solve_bucket_kernel", spy)
         monkeypatch.setattr(als, "_ALS_KERNEL", "on")

@@ -891,6 +891,18 @@ def run_orchestrator() -> None:
         "serve_qps": None,
         "serve_qps_concurrent": None,
         "serve_max_batch": None,
+        # child-fragment fields (overwritten when the child lands; a
+        # degraded round carries the honest null markers so every
+        # deterministic key a successful round emits is present)
+        "als_kernel": None,
+        "als_kernel_rows": None,
+        "als_kernel_sweep_xla_s": None,
+        "flash_kernel_active": None,
+        "sasrec_epoch_s": None,
+        **{f"attn_{kind}_ms_{s // 1024}k": None
+           for s in (int(v) for v in os.environ.get(
+               "PIO_BENCH_ATTN_SEQS", "4096,8192,32768").split(",") if v)
+           for kind in ("flash", "xla")},
         "nnz": NNZ,
         "rank": RANK,
         "sweeps": ITERATIONS,

@@ -55,6 +55,26 @@ def test_operator_override_recorded_as_disabled(bench, monkeypatch):
     assert frag == {"als_kernel": "disabled"}
 
 
+def test_kernel_leg_crash_falls_back_to_xla(bench, monkeypatch):
+    """A full-shape-only kernel failure must not forfeit the accelerator
+    leg: crashed kernel legs are skipped, the XLA timing is kept, and
+    the fragment says probe_failed."""
+    from incubator_predictionio_tpu.ops import als
+    monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+    real = als._mixed_run
+
+    def boom(*a, **kw):
+        if kw.get("use_kernel"):
+            raise RuntimeError("mosaic rejected the full-shape block")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(als, "_mixed_run", boom)
+    use, rows, frag = bench.select_als_kernel(_tiny_buckets(bench))
+    assert use is False and rows == 1
+    assert frag["als_kernel"] == "probe_failed"
+    assert frag["als_kernel_sweep_xla_s"] > 0
+
+
 def test_forced_on_measures_both_legs(bench, monkeypatch):
     from incubator_predictionio_tpu.ops import als
     monkeypatch.setattr(als, "_ALS_KERNEL", "on")

@@ -840,6 +840,7 @@ def run_orchestrator() -> None:
         finally:
             sup_done.set()
 
+    t_sup0 = time.monotonic()
     threading.Thread(target=_supervise, daemon=True).start()
 
     degraded_result: list = []
@@ -854,6 +855,7 @@ def run_orchestrator() -> None:
             daemon=True)
         t_deg.start()
     sup_done.wait()
+    accel_waited_s = time.monotonic() - t_sup0
     child_ok = bool(sup_ok and sup_ok[0])
     if not child_ok and t_deg is not None:
         # never start a second run_degraded while the thread lives — the
@@ -898,6 +900,15 @@ def run_orchestrator() -> None:
         "als_kernel_rows": None,
         "als_kernel_sweep_xla_s": None,
         "flash_kernel_active": None,
+        # how long the supervised-child leg ran and how it ended — makes
+        # a wedged-lease round diagnosable from the record alone
+        # child_ok counts as claiming evidence too: a fragment can land
+        # via an abandoned child whose claim file the supervisor no
+        # longer polls
+        "accel_waited_s": round(accel_waited_s, 1),
+        "accel_outcome": ("claimed"
+                          if claim_seen.is_set() or child_ok
+                          else "never_available"),
         "sasrec_epoch_s": None,
         **{f"attn_{kind}_ms_{s // 1024}k": None
            for s in (int(v) for v in os.environ.get(

@@ -36,6 +36,8 @@ REQUIRED_FIELDS = {
     "als_kernel": str,
     "flash_kernel_active": bool,
     "sasrec_epoch_s": float,
+    "accel_waited_s": float,
+    "accel_outcome": str,
 }
 
 

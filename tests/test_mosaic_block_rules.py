@@ -1,0 +1,108 @@
+"""Mosaic block-shape rules, enforced on the CPU mesh.
+
+The TPU lowering requires each of the LAST TWO dims of a VMEM block
+shape to be sublane/lane aligned (multiples of 8 / 128) OR equal to the
+corresponding array dim. Interpret-mode tests cannot catch violations —
+this round's fused ALS kernel shipped with a sublane-1 aux block that
+only failed on real hardware. This suite captures every
+``pallas_call``'s (block shape, array shape) pairs while running the
+kernels in interpret mode and checks the rule statically, so the bug
+class is caught in CI without a chip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+SUBLANE, LANE = 8, 128
+
+
+def _dim_ok(block: int, array: int, quantum: int) -> bool:
+    return block % quantum == 0 or block == array
+
+
+def _check_pairs(pairs):
+    assert pairs, "no pallas_call captured — the kernel under test moved"
+    bad = []
+    for name, block, array in pairs:
+        if block is None or len(block) < 2:
+            continue
+        b2, b1 = block[-2], block[-1]
+        a2, a1 = array[-2], array[-1]
+        if not (_dim_ok(b2, a2, SUBLANE) and _dim_ok(b1, a1, LANE)):
+            bad.append((name, tuple(block), tuple(array)))
+    assert not bad, f"Mosaic-illegal blocks: {bad}"
+
+
+@pytest.fixture
+def capture(monkeypatch):
+    """Record (operand, block_shape, array_shape) for every pallas_call
+    issued under the fixture, while still executing it."""
+    captured = []
+    real = pl.pallas_call
+
+    def spy(kernel, **kw):
+        inner = real(kernel, **kw)
+
+        def wrapped(*args):
+            in_specs = kw.get("in_specs") or []
+            for i, (spec, arg) in enumerate(zip(in_specs, args)):
+                captured.append(
+                    (f"in{i}", getattr(spec, "block_shape", None),
+                     jnp.shape(arg)))
+            out_specs = kw.get("out_specs")
+            out_shape = kw.get("out_shape")
+            if out_specs is not None and out_shape is not None:
+                outs = (out_specs if isinstance(out_specs, (list, tuple))
+                        else [out_specs])
+                shapes = (out_shape if isinstance(out_shape, (list, tuple))
+                          else [out_shape])
+                for i, (spec, sh) in enumerate(zip(outs, shapes)):
+                    captured.append(
+                        (f"out{i}", getattr(spec, "block_shape", None),
+                         tuple(sh.shape)))
+            return inner(*args)
+
+        return wrapped
+
+    # pallas_kernels does `from jax.experimental import pallas as pl`,
+    # so patching the shared module object covers its call sites too
+    monkeypatch.setattr(pl, "pallas_call", spy)
+    return captured
+
+
+@pytest.mark.parametrize("rows", [1, 8])
+@pytest.mark.parametrize("B,D,K", [
+    (24, 48, 64),      # lane-padded D and K
+    (13, 1024, 32),    # multi-tile D, group padding
+    (8, 300, 128),     # non-multiple D, full-lane K
+])
+def test_als_kernel_blocks_are_mosaic_legal(capture, rows, B, D, K):
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_solve_cg_pallas,
+    )
+
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(0, 0.3, (200, K)).astype(np.float32))
+    cols = jnp.asarray(rng.integers(0, 200, (B, D)).astype(np.int32))
+    vals = jnp.asarray(rng.normal(3.5, 1.0, (B, D)).astype(np.float32))
+    mask = jnp.asarray((rng.random((B, D)) < 0.8).astype(np.float32))
+    als_solve_cg_pallas(table, cols, vals, mask, 0.1, True, 4,
+                        interpret=True, rows_per_program=rows)
+    _check_pairs(capture)
+
+
+@pytest.mark.parametrize("S", [512, 2048])
+def test_flash_attention_blocks_are_mosaic_legal(capture, S):
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        flash_attention,
+    )
+
+    key = jax.random.key(0)
+    q, k, v = (jax.random.normal(kk, (1, 4, S, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    flash_attention(q, k, v, causal=True, interpret=True)
+    _check_pairs(capture)

@@ -369,9 +369,16 @@ def select_als_kernel(buckets, trees=None):
             np.asarray(out.item_factors[0:1, 0:1])
         try:
             train()  # compile + first run
-            t0 = time.perf_counter()
-            train()
-            times[(uk, rows)] = time.perf_counter() - t0
+            best = None
+            for _ in range(2):
+                # best-of-2: a single short sweep on the tunneled
+                # platform carries dispatch jitter comparable to the 3%
+                # decision threshold
+                t0 = time.perf_counter()
+                train()
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            times[(uk, rows)] = best
         except Exception as e:  # full-shape-only kernel failure
             if not uk:
                 raise  # the XLA path must work; nothing to fall back to

@@ -211,12 +211,16 @@ class TestAlsCgKernel:
                     / (jnp.max(jnp.abs(ref)) + 1e-9))
         assert rel < 1e-4, rel
 
-    def test_full_training_parity(self, monkeypatch):
+    @pytest.mark.parametrize("rows", [1, 8])
+    def test_full_training_parity(self, monkeypatch, rows):
         """als_train with the kernel forced on (interpret on CPU) reaches
         the same fit as the XLA path — the planted-recovery guarantee
         holds through the fused solve, including the mixed bf16+f32
-        schedule and the split-row heavy path (max_width forces splits)."""
+        schedule and the split-row heavy path (max_width forces splits),
+        in BOTH program layouts."""
         from incubator_predictionio_tpu.ops import als
+        from incubator_predictionio_tpu.ops import pallas_kernels as pk
+        monkeypatch.setattr(pk, "_ALS_ROWS", rows)
 
         rng = np.random.default_rng(7)
         n_u, n_i, k_true, nnz = 120, 60, 4, 4000

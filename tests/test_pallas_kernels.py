@@ -211,6 +211,35 @@ class TestAlsCgKernel:
                     / (jnp.max(jnp.abs(ref)) + 1e-9))
         assert rel < 1e-4, rel
 
+    _PARITY_CACHE: dict = {}
+
+    def _parity_problem(self, als):
+        """Planted problem + the rows-independent XLA reference, computed
+        once and shared by both layout params (the baseline runs with the
+        kernel off, so re-training it per param would be pure waste)."""
+        if not self._PARITY_CACHE:
+            rng = np.random.default_rng(7)
+            n_u, n_i, k_true, nnz = 120, 60, 4, 4000
+            u = rng.normal(0, 1, (n_u, k_true)).astype(np.float32)
+            v = rng.normal(0, 1, (n_i, k_true)).astype(np.float32)
+            users = rng.integers(0, n_u, nnz).astype(np.int32)
+            items = rng.integers(0, n_i, nnz).astype(np.int32)
+            ratings = np.einsum("nk,nk->n", u[users], v[items]).astype(
+                np.float32)
+            kw = dict(n_users=n_u, n_items=n_i, rank=16, iterations=8,
+                      l2=0.02, bf16_sweeps=4, max_width=64)
+            old = als._ALS_KERNEL
+            als._ALS_KERNEL = "off"
+            try:
+                st_xla, _ = als.als_train(users, items, ratings, **kw)
+            finally:
+                als._ALS_KERNEL = old
+            self._PARITY_CACHE.update(
+                users=users, items=items, ratings=ratings, kw=kw,
+                st_xla=st_xla)
+        c = self._PARITY_CACHE
+        return c["users"], c["items"], c["ratings"], c["kw"], c["st_xla"]
+
     @pytest.mark.parametrize("rows", [1, 8])
     def test_full_training_parity(self, monkeypatch, rows):
         """als_train with the kernel forced on (interpret on CPU) reaches
@@ -222,19 +251,7 @@ class TestAlsCgKernel:
         from incubator_predictionio_tpu.ops import pallas_kernels as pk
         monkeypatch.setattr(pk, "_ALS_ROWS", rows)
 
-        rng = np.random.default_rng(7)
-        n_u, n_i, k_true, nnz = 120, 60, 4, 4000
-        u = rng.normal(0, 1, (n_u, k_true)).astype(np.float32)
-        v = rng.normal(0, 1, (n_i, k_true)).astype(np.float32)
-        users = rng.integers(0, n_u, nnz).astype(np.int32)
-        items = rng.integers(0, n_i, nnz).astype(np.int32)
-        ratings = np.einsum("nk,nk->n", u[users], v[items]).astype(
-            np.float32)
-
-        kw = dict(n_users=n_u, n_items=n_i, rank=16, iterations=8,
-                  l2=0.02, bf16_sweeps=4, max_width=64)
-        monkeypatch.setattr(als, "_ALS_KERNEL", "off")
-        st_xla, _ = als.als_train(users, items, ratings, **kw)
+        users, items, ratings, kw, st_xla = self._parity_problem(als)
         monkeypatch.setattr(als, "_ALS_KERNEL", "on")
         # this problem's buckets are narrower than the default min-D
         # routing cut — force every bucket through the kernel

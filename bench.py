@@ -823,6 +823,7 @@ def run_orchestrator() -> None:
 
     # -- 6. INGEST-HTTP (host; needs no accelerator) -----------------------
     ingest_http_eps = bench_ingest_http()
+    ingest_http_eps_cap500 = bench_ingest_http(batch_size=500)
 
     # -- 6b. REAL-DATA QUALITY BOUND (host CPU; tiny) ----------------------
     movielens = bench_movielens_quality()
@@ -894,6 +895,7 @@ def run_orchestrator() -> None:
         "prep_wall_s": round(prep_s, 1),
         "e2e_train_wall_s": None,
         "ingest_http_eps": ingest_http_eps,
+        "ingest_http_eps_cap500": ingest_http_eps_cap500,
         **movielens,
         "serve_p50_ms": None,
         "serve_p99_ms": None,
@@ -1134,11 +1136,13 @@ async def _http_post_loop(port, path, bodies) -> None:
         writer.close()
 
 
-def bench_ingest_http():
+def bench_ingest_http(batch_size: int = 50):
     """REST ingest throughput through the real EventServer into the cpplog
-    backend: async keep-alive clients posting 50-event batches to
-    POST /batch/events.json (the contract cap, EventServer.scala:269-289's
-    hot path). Returns events/s."""
+    backend: async keep-alive clients posting ``batch_size``-event batches
+    to POST /batch/events.json. 50 is the reference's wire-contract cap
+    (EventServer.scala:269-289's hot path); a second pass at 500 measures
+    the raised --batch-cap headroom the bulk-loader path advertises.
+    Returns events/s."""
     import asyncio
     import tempfile
 
@@ -1153,10 +1157,13 @@ def bench_ingest_http():
     )
 
     n_clients = int(os.environ.get("PIO_BENCH_INGEST_CLIENTS", 32))
-    # 100 batches/client = 160k events ≈ 2 s: long enough that connection
-    # setup and first-append warmup stop shaving ~20% off the number
-    batches_per_client = int(os.environ.get("PIO_BENCH_INGEST_BATCHES", 100))
-    batch_size = 50
+    # 100 batches/client (160k events at the contract cap) ≈ 2 s: long
+    # enough that connection setup and first-append warmup stop shaving
+    # ~20% off the number. The batch COUNT stays constant across caps —
+    # a bigger cap means more events and a comparable (slightly longer)
+    # wall, keeping both measurements sustained-rate, not burst
+    batches_per_client = int(os.environ.get("PIO_BENCH_INGEST_BATCHES",
+                                            100))
 
     with tempfile.TemporaryDirectory(prefix="pio_bench_ingest_") as tmpdir:
         Storage.configure({
@@ -1174,7 +1181,8 @@ def bench_ingest_http():
         app_id = apps.insert(App(0, "bench-ingest"))
         Storage.get_meta_data_access_keys().insert(
             AccessKey("benchkey", app_id))
-        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+        srv = EventServer(EventServerConfig(ip="127.0.0.1", port=0,
+                                            max_batch=batch_size))
         port = srv.start_background()
 
         def batch_body(cid: int, b: int) -> bytes:

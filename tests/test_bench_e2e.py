@@ -30,6 +30,7 @@ REQUIRED_FIELDS = {
     "ingest_wall_s": float,
     "prep_wall_s": float,
     "ingest_http_eps": float,
+    "ingest_http_eps_cap500": float,
     "movielens_rmse": float,
     "serve_p50_ms": float,
     "serve_qps_concurrent": float,

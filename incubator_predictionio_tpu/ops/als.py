@@ -147,6 +147,15 @@ _ALS_KERNEL = os.environ.get("PIO_ALS_KERNEL", "auto")
 #: (measured on-chip at 2M nnz, D̄≈14: kernel 1.50 s vs XLA 1.15 s).
 #: Bucket widths are static at trace time, so routing is free.
 _KERNEL_MIN_D = int(os.environ.get("PIO_ALS_KERNEL_MIN_D", "64"))
+#: warm-start every bucket CG from the previous sweep's factors. At a
+#: fixed iteration budget this only improves the residual (the start
+#: point is closer); its real payoff is a LOWER budget for the same
+#: RMSE — each saved CG iteration saves a full [rows, K, K] Gram-batch
+#: re-read, the dominant bf16-sweep HBM stream. Measured convergence
+#: curves: see docs/performance.md (warm@N vs cold@N on the planted
+#: bench workload — convergence is platform-independent).
+_CG_WARMSTART = os.environ.get("PIO_ALS_CG_WARMSTART", "1") not in (
+    "0", "off", "false")
 
 
 def _kernel_rows_default() -> int:
@@ -173,18 +182,25 @@ def _kernel_enabled(implicit: bool) -> bool:
 
     return als_kernel_available()
 #: CG budget for the bf16 early sweeps of the mixed schedule. Each CG
-#: iteration re-reads the whole [rows, K, K] f32 Gram batch (~9 GB at
+#: iteration re-reads the whole [rows, K, K] Gram batch (~9 GB at
 #: ML-20M scale on the user side) — the dominant HBM stream once gathers
-#: run bf16 — and early sweeps are re-solved from scratch next sweep
-#: anyway, so a loose solve costs nothing in final quality (the f32
-#: polish runs the full budget; guarded by the planted-recovery test).
-_CG_ITERS_BF16 = int(os.environ.get("PIO_ALS_CG_ITERS_BF16", "6"))
+#: run bf16 — and early sweeps are re-solved next sweep anyway, so a
+#: loose solve costs nothing in final quality (the f32 polish runs the
+#: full budget; guarded by the planted-recovery test). With warm start
+#: the default drops 6 → 3: measured on the planted workload (10
+#: sweeps, λ=0.03), warm@3 reaches the same fit RMSE as cold@6 (0.162
+#: vs 0.162; docs/performance.md has the full curve), and
+#: warm-start's +1 initial-residual matvec still nets 5 Gram
+#: reads/row vs cold@6's 7 — a ~29% cut of the dominant stream.
+_CG_ITERS_BF16 = int(os.environ.get("PIO_ALS_CG_ITERS_BF16") or
+                     ("3" if _CG_WARMSTART else "6"))
 
 
 def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
                   matvec_dtype: Any = jnp.float32,
                   lam: Optional[jax.Array] = None,
-                  shared: Optional[jax.Array] = None) -> jax.Array:
+                  shared: Optional[jax.Array] = None,
+                  x0: Optional[jax.Array] = None) -> jax.Array:
     """Batched Jacobi-PCG for SPD systems → x ≈ (a [+ diag(lam)])⁻¹ b, [B, K].
 
     Division guards make converged (and all-zero) systems fixed points
@@ -205,7 +221,14 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
     ``shared`` ([K, K] f32) adds a batch-shared SPD term (implicit ALS's
     YᵗY) inside the matvec as one thin einsum — the [B, K, K] broadcast
     ``yty[None] + gram`` never materializes, which at training scale is a
-    whole extra Gram-batch write + read per half-sweep."""
+    whole extra Gram-batch write + read per half-sweep.
+
+    ``x0`` ([B, K] f32) warm-starts the iteration (one extra matvec for
+    the initial residual). ALS re-solves every factor row from scratch
+    each sweep while the true solution moves less and less — warm
+    starting from the previous sweep's factors buys the same residual in
+    roughly half the iterations once the alternation settles, and each
+    saved iteration saves a full re-read of the Gram batch."""
     diag = jnp.diagonal(a, axis1=-2, axis2=-1).astype(jnp.float32)
     if shared is not None:
         diag = diag + jnp.diagonal(shared)[None, :]
@@ -215,8 +238,7 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
     hp = jax.lax.Precision.HIGHEST
     a_mv = a if a.dtype == matvec_dtype else a.astype(matvec_dtype)
 
-    def body(_, carry):
-        x, r, p, rz = carry
+    def matvec(p):
         ap = jnp.einsum(
             "bkl,bl->bk", a_mv, p.astype(a_mv.dtype),
             preferred_element_type=jnp.float32,
@@ -228,6 +250,11 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
                 preferred_element_type=jnp.float32, precision=hp)
         if lam is not None:
             ap = ap + lam[:, None] * p
+        return ap
+
+    def body(_, carry):
+        x, r, p, rz = carry
+        ap = matvec(p)
         pap = jnp.sum(p * ap, -1)
         alpha = jnp.where(pap > 0, rz / pap, 0.0)
         x = x + alpha[:, None] * p
@@ -238,10 +265,14 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
         p = z + beta[:, None] * p
         return x, r, p, rz2
 
-    x = jnp.zeros_like(b)
-    z = minv * b
+    if x0 is None:
+        x, r = jnp.zeros_like(b), b
+    else:
+        x = x0.astype(jnp.float32)
+        r = b - matvec(x)
+    z = minv * r
     x, _r, _p, _rz = jax.lax.fori_loop(
-        0, iters, body, (x, b, z, jnp.sum(b * z, -1)))
+        0, iters, body, (x, r, z, jnp.sum(r * z, -1)))
     return x
 
 
@@ -255,6 +286,7 @@ def _reg_solve(
     yty: Optional[jax.Array],
     cg_iters: int = _CG_ITERS,
     cg_matvec_dtype: Any = jnp.float32,
+    x0: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Regularize + batched SPD solve; zero factors for empty rows."""
     rank = gram.shape[-1]
@@ -277,7 +309,7 @@ def _reg_solve(
         # λ·nnz) on the diagonal — worse conditioned, so double the budget
         sol = _cg_solve_spd(a, rhs, cg_iters * (2 if implicit else 1),
                             matvec_dtype=cg_matvec_dtype, lam=lam,
-                            shared=shared)
+                            shared=shared, x0=x0)
     else:
         a = a.astype(jnp.float32) + lam[:, None, None] * eye
         if shared is not None:
@@ -301,6 +333,7 @@ def _solve_bucket(
     compute_dtype: Any = jnp.float32,
     precision: Any = jax.lax.Precision.HIGHEST,
     cg_iters: int = _CG_ITERS,
+    x0: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Batched normal-equation solve for one degree bucket → [B, K].
 
@@ -320,7 +353,8 @@ def _solve_bucket(
         other_factors, cols, vals, mask, compute_dtype, precision,
         implicit=False, alpha=0.0, gram_dtype=gram_dtype)
     return _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit=False, yty=None,
-                      cg_iters=cg_iters, cg_matvec_dtype=compute_dtype)
+                      cg_iters=cg_iters, cg_matvec_dtype=compute_dtype,
+                      x0=x0)
 
 
 def _solve_bucket_kernel(
@@ -332,6 +366,7 @@ def _solve_bucket_kernel(
     reg_nnz: bool,
     cg_iters: int,
     kernel_rows: int = 1,
+    x0: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Explicit-CG bucket solve via the fused Pallas kernel.
 
@@ -348,7 +383,7 @@ def _solve_bucket_kernel(
 
     return als_solve_cg_pallas(
         gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz, iters=cg_iters,
-        rows_per_program=max(kernel_rows, 1))
+        rows_per_program=max(kernel_rows, 1), x0=x0)
 
 
 #: f32-element budget for one bucket chunk's gather intermediate
@@ -362,29 +397,36 @@ _CHUNK_ELEMS = int(os.environ.get("PIO_ALS_CHUNK_ELEMS", str(1 << 24)))
 
 
 def _solve_bucket_chunked(solver_fn, cols, vals, mask, rank: int,
-                          row_elems: Optional[int] = None):
-    """Apply ``solver_fn((cols, vals, mask)) -> sol`` in bounded row chunks.
+                          row_elems: Optional[int] = None,
+                          x0: Optional[jax.Array] = None):
+    """Apply ``solver_fn((cols, vals, mask[, x0])) -> sol`` in bounded row
+    chunks.
 
     Zero-mask padding rows solve to 0 and are sliced off, so chunk padding
     never leaks into the scatter. ``row_elems`` overrides the per-row
     gather footprint used for chunk sizing (the Pallas path pads D and K
     to lane multiples, so its materialized gather is larger than D·rank
-    for narrow buckets)."""
+    for narrow buckets). ``x0`` rides along row-aligned when present
+    (CG warm start)."""
     B, D = cols.shape
+    rank_x = x0.shape[1] if x0 is not None else rank
     chunk = max(8, _CHUNK_ELEMS // max(row_elems or (D * rank), 1))
     if B <= chunk:
-        return solver_fn((cols, vals, mask))
+        t = (cols, vals, mask) + ((x0,) if x0 is not None else ())
+        return solver_fn(t)
     n = -(-B // chunk)
     pad = n * chunk - B
     if pad:
         cols = jnp.pad(cols, ((0, pad), (0, 0)))
         vals = jnp.pad(vals, ((0, pad), (0, 0)))
         mask = jnp.pad(mask, ((0, pad), (0, 0)))
-    sols = jax.lax.map(
-        solver_fn,
-        (cols.reshape(n, chunk, D), vals.reshape(n, chunk, D),
-         mask.reshape(n, chunk, D)),
-    )
+        if x0 is not None:
+            x0 = jnp.pad(x0, ((0, pad), (0, 0)))
+    parts = (cols.reshape(n, chunk, D), vals.reshape(n, chunk, D),
+             mask.reshape(n, chunk, D))
+    if x0 is not None:
+        parts = parts + (x0.reshape(n, chunk, rank_x),)
+    sols = jax.lax.map(solver_fn, parts)
     return sols.reshape(n * chunk, rank)[:B]
 
 
@@ -449,6 +491,7 @@ def _sweep_side(
     use_kernel: bool = False,
     kernel_min_d: int = 0,
     kernel_rows: int = 1,
+    prev_factors: Optional[jax.Array] = None,
 ) -> jax.Array:
     """One half-sweep (traced): solve every bucket + split rows, scatter.
 
@@ -472,6 +515,8 @@ def _sweep_side(
         gsrc = other_factors.astype(compute_dtype)
     for row_ids, cols, vals, mask in tree:
         row_elems = None
+        x0 = (prev_factors[row_ids].astype(jnp.float32)
+              if prev_factors is not None and not implicit else None)
         if implicit:
             def solver(t, _yty=yty):
                 return _solve_bucket_implicit(
@@ -489,23 +534,25 @@ def _sweep_side(
             def solver(t):
                 return _solve_bucket_kernel(
                     gsrc, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
-                    cg_iters=cg_iters, kernel_rows=kernel_rows)
+                    cg_iters=cg_iters, kernel_rows=kernel_rows,
+                    x0=t[3] if len(t) > 3 else None)
         else:
             def solver(t):
                 return _solve_bucket(
                     gsrc, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
                     compute_dtype=compute_dtype, precision=precision,
-                    cg_iters=cg_iters)
+                    cg_iters=cg_iters, x0=t[3] if len(t) > 3 else None)
         # large buckets solve in bounded row chunks (lax.map) so the
         # [B, D, K] gather / [B, K, K] gram temps never exceed the chunk
         # budget — the ML-20M-scale HBM requirement
         sol = _solve_bucket_chunked(solver, cols, vals, mask, rank,
-                                    row_elems=row_elems)
+                                    row_elems=row_elems, x0=x0)
         out = _scatter_rows_impl(out, row_ids, sol)
     if heavy is not None:
         h_ids, h_sol = _solve_heavy(
             gsrc, heavy, l2, alpha, reg_nnz, compute_dtype,
-            precision, implicit, yty, cg_iters=cg_iters)
+            precision, implicit, yty, cg_iters=cg_iters,
+            prev_factors=prev_factors)
         out = _scatter_rows_impl(out, h_ids, h_sol)
     return out
 
@@ -519,11 +566,12 @@ def _sweep_side(
 def _sweep_side_jit(n_rows, other_factors, tree, heavy, l2, alpha, reg_nnz,
                     compute_dtype, precision, implicit,
                     cg_iters=_CG_ITERS, use_kernel=False, kernel_min_d=0,
-                    kernel_rows=1):
+                    kernel_rows=1, prev_factors=None):
     return _sweep_side(n_rows, other_factors, tree, heavy, l2, alpha,
                        reg_nnz, compute_dtype, precision, implicit,
                        cg_iters=cg_iters, use_kernel=use_kernel,
-                       kernel_min_d=kernel_min_d, kernel_rows=kernel_rows)
+                       kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
+                       prev_factors=prev_factors)
 
 
 def _update_side(
@@ -867,12 +915,16 @@ def _solve_heavy(
     implicit: bool,
     yty: Optional[jax.Array],
     cg_iters: int = _CG_ITERS,
+    prev_factors: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Partial-Gram combining solve for split rows → (row_ids, sol[H, K]).
 
     Per-segment normal-equation pieces are computed exactly like a regular
     bucket, then segment-summed per original row before ONE solve per row —
-    the reduction ALX does across shards, here across split segments."""
+    the reduction ALX does across shards, here across split segments.
+    ``prev_factors`` warm-starts the combining CG exactly like the bucket
+    path — the heaviest rows share the reduced bf16 budget, so they need
+    the warm start most."""
     seg_ids, row_ids, cols, vals, mask = heavy
     n_heavy = row_ids.shape[0]
     pg, prhs, pnnz = _gram_rhs_nnz_chunked(
@@ -881,16 +933,19 @@ def _solve_heavy(
     gram = jax.ops.segment_sum(pg, seg_ids, num_segments=n_heavy)
     rhs = jax.ops.segment_sum(prhs, seg_ids, num_segments=n_heavy)
     nnz = jax.ops.segment_sum(pnnz, seg_ids, num_segments=n_heavy)
+    x0 = (prev_factors[row_ids].astype(jnp.float32)
+          if prev_factors is not None and not implicit else None)
     return row_ids, _reg_solve(
         gram, rhs, nnz, l2, reg_nnz, implicit, yty, cg_iters=cg_iters,
-        cg_matvec_dtype=jnp.float32 if implicit else compute_dtype)
+        cg_matvec_dtype=jnp.float32 if implicit else compute_dtype,
+        x0=x0)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("iterations", "reg_nnz", "compute_dtype", "precision",
                      "implicit", "cg_iters", "use_kernel", "kernel_min_d",
-                     "kernel_rows"),
+                     "kernel_rows", "warmstart"),
     donate_argnames=("state",),
 )
 def _als_run_fused(
@@ -910,18 +965,21 @@ def _als_run_fused(
     use_kernel: bool = False,
     kernel_min_d: int = 0,
     kernel_rows: int = 1,
+    warmstart: bool = False,
 ) -> ALSState:
     def body(_, st):
         new_users = _sweep_side(
             st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
             cg_iters=cg_iters, use_kernel=use_kernel,
-            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows)
+            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
+            prev_factors=st.user_factors if warmstart else None)
         new_items = _sweep_side(
             st.item_factors.shape[0], new_users, item_tree, item_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
             cg_iters=cg_iters, use_kernel=use_kernel,
-            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows)
+            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
+            prev_factors=st.item_factors if warmstart else None)
         return ALSState(user_factors=new_users, item_factors=new_items)
 
     return jax.lax.fori_loop(0, iterations, body, state)
@@ -942,6 +1000,7 @@ def _mixed_run(
     use_kernel: Optional[bool] = None,
     kernel_min_d: Optional[int] = None,
     kernel_rows: Optional[int] = None,
+    warmstart: Optional[bool] = None,
 ) -> ALSState:
     """Mixed-precision schedule: ``bf16_sweeps`` early sweeps with bf16
     gathers + single-pass MXU matmuls (DEFAULT precision), then the
@@ -965,6 +1024,8 @@ def _mixed_run(
         kernel_min_d = _KERNEL_MIN_D
     if kernel_rows is None:
         kernel_rows = _kernel_rows_default()
+    if warmstart is None:
+        warmstart = _CG_WARMSTART
     if lo:
         state = _als_run_fused(
             state, u_tree, i_tree, l2, 0.0, lo, reg_nnz,
@@ -972,7 +1033,7 @@ def _mixed_run(
             user_heavy=user_heavy, item_heavy=item_heavy,
             cg_iters=min(_CG_ITERS_BF16, _CG_ITERS),
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
-            kernel_rows=kernel_rows,
+            kernel_rows=kernel_rows, warmstart=warmstart,
         )
     if iterations - lo:
         state = _als_run_fused(
@@ -980,7 +1041,7 @@ def _mixed_run(
             compute_dtype, precision, implicit=False,
             user_heavy=user_heavy, item_heavy=item_heavy,
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
-            kernel_rows=kernel_rows,
+            kernel_rows=kernel_rows, warmstart=warmstart,
         )
     return state
 

@@ -642,8 +642,9 @@ def flash_attention(
 # pinning it in VMEM beats both.)
 
 
-def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
-                   *, iters: int, n_d_blocks: int, precise: bool):
+def _als_cg_kernel(g_ref, wv_ref, lam_ref, x0_ref, o_ref, gram_ref,
+                   rhs_ref, *, iters: int, n_d_blocks: int, precise: bool,
+                   warm: bool):
     """One (row, d-block) program of the fused bucket solve.
 
     Mosaic block-shape note: the TPU lowering requires each of the last
@@ -663,6 +664,7 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
                           (f32; applied INSIDE the matvec so the Gram can
                           stay in its compute dtype without rounding the
                           regularizer)
+    x0_ref:  [1, 1, Kp]   CG warm start (zeros + ``warm=False`` → cold)
     o_ref:   [1, 1, Kp]   solution, written on the last d step
     gram/rhs scratch persist across the d-minor grid steps (flash-kernel
     accumulator pattern).
@@ -704,17 +706,22 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
         minv = jnp.where(diag > 0, 1.0 / diag, 0.0)
         b = rhs_ref[...]                                 # [1, Kp]
 
-        # Jacobi-PCG, numerics matching ops/als.py _cg_solve_spd: x = 0
-        # start, z = M⁻¹r, division guards make converged/empty systems
-        # fixed points (rank-padding coords have b = 0, gram row 0 → they
-        # stay exactly 0)
-        def body(_, carry):
-            x, r, p, rz = carry
-            ap = jax.lax.dot_general(
+        # Jacobi-PCG, numerics matching ops/als.py _cg_solve_spd:
+        # cold x = 0 start or warm start from the previous sweep
+        # (one extra matvec for the initial residual); division guards
+        # make converged/empty systems fixed points (rank-padding coords
+        # have b = 0, gram row 0 → they stay exactly 0: a zero x0 row
+        # keeps the cold fixed point)
+        def matvec(p):
+            return jax.lax.dot_general(
                 p, gram, dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST,
             ) + lam * p                                  # [1, Kp]
+
+        def body(_, carry):
+            x, r, p, rz = carry
+            ap = matvec(p)
             pap = jnp.sum(p * ap, keepdims=True)[..., :1]   # [1, 1]
             alpha = jnp.where(pap > 0, rz / pap, 0.0)
             x = x + alpha * p
@@ -725,16 +732,22 @@ def _als_cg_kernel(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
             p = z + beta * p
             return x, r, p, rz2
 
-        x0 = jnp.zeros_like(b)
-        z0 = minv * b
-        rz0 = jnp.sum(b * z0, keepdims=True)[..., :1]
+        if warm:
+            x0 = x0_ref[0]                               # [1, Kp]
+            r0 = b - matvec(x0)
+        else:
+            x0 = jnp.zeros_like(b)
+            r0 = b
+        z0 = minv * r0
+        rz0 = jnp.sum(r0 * z0, keepdims=True)[..., :1]
         x, _r, _p, _rz = jax.lax.fori_loop(
-            0, iters, body, (x0, b, z0, rz0))
+            0, iters, body, (x0, r0, z0, rz0))
         o_ref[0] = x
 
 
-def _als_cg_kernel_rows(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
-                        *, iters: int, n_d_blocks: int, precise: bool):
+def _als_cg_kernel_rows(g_ref, wv_ref, lam_ref, x0_ref, o_ref, gram_ref,
+                        rhs_ref, *, iters: int, n_d_blocks: int,
+                        precise: bool, warm: bool):
     """Row-grouped variant of :func:`_als_cg_kernel`: R rows per program.
 
     The one-row kernel is per-program-overhead-bound at ML-20M shape
@@ -746,6 +759,7 @@ def _als_cg_kernel_rows(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
     g_ref:   [R, dt, Kp]  row group's masked gathered factors, one d tile
     wv_ref:  [R, dt]      vals·mask tile, f32
     lam_ref: [R, Kp]      per-row ridge, broadcast across K
+    x0_ref:  [R, Kp]      CG warm start (zeros + ``warm=False`` → cold)
     o_ref:   [R, Kp]      solutions, written on the last d step
     gram/rhs scratch: [R, Kp, Kp] / [R, Kp], persist across d steps.
     """
@@ -792,7 +806,9 @@ def _als_cg_kernel_rows(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
             return jnp.sum(gram * p[:, :, None], axis=1) + lam * p
 
         # batched Jacobi-PCG, numerics per ops/als.py _cg_solve_spd;
-        # every reduction is per-row so groups never mix
+        # every reduction is per-row so groups never mix. Cold x = 0 or
+        # warm start from the previous sweep (one extra matvec); zero
+        # padding rows keep the cold fixed point either way
         def body(_, carry):
             x, r, p, rz = carry
             ap = matvec(p)
@@ -806,11 +822,16 @@ def _als_cg_kernel_rows(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref,
             p = z + beta * p
             return x, r, p, rz2
 
-        x0 = jnp.zeros_like(b)
-        z0 = minv * b
-        rz0 = jnp.sum(b * z0, axis=1, keepdims=True)
+        if warm:
+            x0 = x0_ref[...]                             # [R, Kp]
+            r0 = b - matvec(x0)
+        else:
+            x0 = jnp.zeros_like(b)
+            r0 = b
+        z0 = minv * r0
+        rz0 = jnp.sum(r0 * z0, axis=1, keepdims=True)
         x, _r, _p, _rz = jax.lax.fori_loop(
-            0, iters, body, (x0, b, z0, rz0))
+            0, iters, body, (x0, r0, z0, rz0))
         o_ref[...] = x
 
 
@@ -847,6 +868,7 @@ def als_solve_cg_pallas(
     iters: int = 16,
     interpret: Optional[bool] = None,
     rows_per_program: Optional[int] = None,
+    x0: Optional[jax.Array] = None,   # [B, K] f32 CG warm start
 ) -> jax.Array:
     """Fused normal-equation solve for one bucket chunk → [B, K] f32.
 
@@ -861,7 +883,9 @@ def als_solve_cg_pallas(
     solve to exactly 0 (see kernel docstring), so the slice-back is
     exact. ``rows_per_program`` > 1 (sublane multiples only) pads the row
     count and runs the row-grouped kernel; padding rows carry zero
-    mask/vals and solve to exactly 0, sliced away on return.
+    mask/vals and solve to exactly 0, sliced away on return. ``x0``
+    warm-starts the in-VMEM CG from the previous sweep's factors (rank
+    padding rides as zero columns, which stay exact fixed points).
     """
     if interpret is None:
         interpret = not pallas_available()
@@ -885,6 +909,9 @@ def als_solve_cg_pallas(
     nnz = jnp.sum(mask, axis=-1)
     lam = l2 * (jnp.maximum(nnz, 1.0) if reg_nnz
                 else jnp.ones_like(nnz))
+    warm = x0 is not None
+    x0p = (jnp.pad(x0.astype(jnp.float32), ((0, 0), (0, kp - k)))
+           if warm else None)
     n_d = dp // dt
 
     if rows > 1:
@@ -894,19 +921,37 @@ def als_solve_cg_pallas(
         # padding rows get λ of an empty system (b = 0, gram = 0 → x = 0)
         lam_b = jnp.pad(jnp.broadcast_to(lam[:, None], (B, kp)),
                         ((0, bp - B), (0, 0)), constant_values=1.0)
+        # the x0 operand exists only on the warm path — cold kernels
+        # never read it, so a zeros buffer would be pure padding traffic
+        ops = [g, wv2, lam_b]
+        in_specs = [
+            pl.BlockSpec((rows, dt, kp), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, dt), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, kp), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        if warm:
+            ops.append(jnp.pad(x0p, ((0, bp - B), (0, 0))))
+            in_specs.append(pl.BlockSpec((rows, kp), lambda i, j: (i, 0),
+                                         memory_space=pltpu.VMEM))
+        body = functools.partial(_als_cg_kernel_rows, iters=int(iters),
+                                 n_d_blocks=n_d,
+                                 precise=table.dtype == jnp.float32,
+                                 warm=warm)
+        if warm:
+            kfn = body
+        else:
+            # positional ref alignment: without the x0 operand the
+            # kernel signature's x0_ref slot must not swallow o_ref
+            def kfn(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref):
+                return body(g_ref, wv_ref, lam_ref, None, o_ref,
+                            gram_ref, rhs_ref)
         out = pl.pallas_call(
-            functools.partial(_als_cg_kernel_rows, iters=int(iters),
-                              n_d_blocks=n_d,
-                              precise=table.dtype == jnp.float32),
+            kfn,
             grid=(bp // rows, n_d),
-            in_specs=[
-                pl.BlockSpec((rows, dt, kp), lambda i, j: (i, j, 0),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((rows, dt), lambda i, j: (i, j),
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((rows, kp), lambda i, j: (i, 0),
-                             memory_space=pltpu.VMEM),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((rows, kp), lambda i, j: (i, 0),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((bp, kp), jnp.float32),
@@ -915,7 +960,7 @@ def als_solve_cg_pallas(
                 pltpu.VMEM((rows, kp), jnp.float32),      # rhs acc
             ],
             interpret=interpret,
-        )(g, wv2, lam_b)
+        )(*ops)
         return out[:B, :k]
 
     g = jnp.pad(g, ((0, 0), (0, dp - d), (0, kp - k)))
@@ -923,20 +968,36 @@ def als_solve_cg_pallas(
     wv = wv2[:, None, :]
     lam_b = jnp.broadcast_to(lam[:, None, None], (B, 1, kp))
 
+    ops = [g, wv, lam_b]
+    in_specs = [
+        pl.BlockSpec((1, dt, kp), lambda i, j: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, dt), lambda i, j: (i, 0, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    if warm:
+        # cold kernels never read x0 — the operand only exists warm
+        ops.append(x0p[:, None, :])
+        in_specs.append(pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
+                                     memory_space=pltpu.VMEM))
+    body1 = functools.partial(_als_cg_kernel, iters=int(iters),
+                              n_d_blocks=n_d,
+                              precise=table.dtype == jnp.float32,
+                              warm=warm)
+    if warm:
+        kfn1 = body1
+    else:
+        def kfn1(g_ref, wv_ref, lam_ref, o_ref, gram_ref, rhs_ref):
+            return body1(g_ref, wv_ref, lam_ref, None, o_ref, gram_ref,
+                         rhs_ref)
     out = pl.pallas_call(
-        functools.partial(_als_cg_kernel, iters=int(iters), n_d_blocks=n_d,
-                          precise=table.dtype == jnp.float32),
+        kfn1,
         # d is the MINOR grid dim: programs revisiting one row's output
         # run consecutively, carrying gram/rhs in scratch
         grid=(B, n_d),
-        in_specs=[
-            pl.BlockSpec((1, dt, kp), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, dt), lambda i, j: (i, 0, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, 1, kp), jnp.float32),
@@ -945,7 +1006,7 @@ def als_solve_cg_pallas(
             pltpu.VMEM((1, kp), jnp.float32),    # rhs accumulator
         ],
         interpret=interpret,
-    )(g, wv, lam_b)
+    )(*ops)
     return out[:, 0, :k]
 
 

@@ -275,10 +275,10 @@ class TestAlsCgKernel:
         real = als._solve_bucket_kernel
 
         def spy(gsrc, cols, vals, mask, l2, reg_nnz, cg_iters,
-                kernel_rows=1):
+                kernel_rows=1, x0=None):
             widths.append(cols.shape[1])
             return real(gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz,
-                        cg_iters=cg_iters, kernel_rows=kernel_rows)
+                        cg_iters=cg_iters, kernel_rows=kernel_rows, x0=x0)
 
         monkeypatch.setattr(als, "_solve_bucket_kernel", spy)
         monkeypatch.setattr(als, "_ALS_KERNEL", "on")

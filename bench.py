@@ -88,7 +88,7 @@ L2 = float(os.environ.get("PIO_BENCH_L2", "0.03"))
 #: it is far slower than a native CPU solver, so this bar is conservative).
 #: Value = warm fused-train wall-clock at the full ML-20M shape above with
 #: the same CG solver (measured 2026-07-29).
-CPU_BASELINE_TRAIN_S = float(os.environ.get("PIO_BENCH_CPU_BASELINE", 571.1))
+CPU_BASELINE_TRAIN_S = float(os.environ.get("PIO_BENCH_CPU_BASELINE", 467.7))
 
 #: TPU v5e peak: 197 TFLOP/s bf16 / ~98.5 TFLOP/s fp32 on the MXU. The
 #: JSON reports BOTH conventions: `mfu` against the fp32 peak (the series

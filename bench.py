@@ -245,6 +245,9 @@ def als_flops_per_run(bf16_sweeps: int = None) -> float:
         bf16 = min(max(bf16_sweeps, 0), ITERATIONS)
         iters = (bf16 * min(als._CG_ITERS_BF16, als._CG_ITERS)
                  + (ITERATIONS - bf16) * als._CG_ITERS) / max(ITERATIONS, 1)
+        # warm start runs one extra matvec per solve (initial residual)
+        if als._CG_WARMSTART:
+            iters += 1.0
         per_solve = iters * 2.0 * k * k
     else:
         per_solve = k ** 3 / 3.0 + 2.0 * k * k

@@ -516,12 +516,13 @@ def _sweep_side(
     for row_ids, cols, vals, mask in tree:
         row_elems = None
         x0 = (prev_factors[row_ids].astype(jnp.float32)
-              if prev_factors is not None and not implicit else None)
+              if prev_factors is not None else None)
         if implicit:
             def solver(t, _yty=yty):
                 return _solve_bucket_implicit(
                     other_factors, _yty, t[0], t[1], t[2], l2, alpha,
-                    precision=precision, cg_iters=cg_iters)
+                    precision=precision, cg_iters=cg_iters,
+                    x0=t[3] if len(t) > 3 else None)
         elif use_kernel and cols.shape[1] >= kernel_min_d:
             # chunk by the PADDED gather footprint the kernel actually
             # materializes (single source of truth in pallas_kernels)
@@ -659,16 +660,20 @@ def _solve_bucket_implicit(
     alpha: float,
     precision: Any = jax.lax.Precision.HIGHEST,
     cg_iters: int = _CG_ITERS,
+    x0: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-row system: (YᵗY + Yᵤᵗ(Cᵤ−I)Yᵤ + λI) x = Yᵤᵗ cᵤ with
     c = 1 + α·r and binary preference — YᵗY is shared across the whole
     batch (the classic implicit-ALS trick), so per-row work stays
-    proportional to the row's observations."""
+    proportional to the row's observations. The implicit CG runs a
+    DOUBLED budget (worse conditioning, see _reg_solve), so a closer
+    starting point helps it most; the budget itself is unchanged until
+    an implicit-specific convergence study justifies cutting it."""
     gram, rhs, nnz = _gram_rhs_nnz(
         other_factors, cols, vals, mask, jnp.float32, precision,
         implicit=True, alpha=alpha)
     return _reg_solve(gram, rhs, nnz, l2, True, implicit=True, yty=yty,
-                      cg_iters=cg_iters)
+                      cg_iters=cg_iters, x0=x0)
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))
@@ -737,6 +742,7 @@ def als_train_implicit(
         state, _buckets_tree(user_light), _buckets_tree(item_light),
         l2, alpha, iterations, True, jnp.float32, precision, implicit=True,
         user_heavy=_heavy_tree(user_heavy), item_heavy=_heavy_tree(item_heavy),
+        warmstart=_CG_WARMSTART,
     )
 
 
@@ -832,6 +838,10 @@ def als_train_sharded(
             state, u_tree, i_tree, l2, alpha, iterations, reg_nnz,
             compute_dtype, precision, implicit=True,
             user_heavy=u_hv, item_heavy=i_hv,
+            # resolved HERE (outside the trace — a mid-trace global read
+            # would bake into the static cache key); the explicit branch
+            # gets the same default via _mixed_run's resolver
+            warmstart=_CG_WARMSTART,
         )
     else:
         out = _mixed_run(
@@ -934,7 +944,7 @@ def _solve_heavy(
     rhs = jax.ops.segment_sum(prhs, seg_ids, num_segments=n_heavy)
     nnz = jax.ops.segment_sum(pnnz, seg_ids, num_segments=n_heavy)
     x0 = (prev_factors[row_ids].astype(jnp.float32)
-          if prev_factors is not None and not implicit else None)
+          if prev_factors is not None else None)
     return row_ids, _reg_solve(
         gram, rhs, nnz, l2, reg_nnz, implicit, yty, cg_iters=cg_iters,
         cg_matvec_dtype=jnp.float32 if implicit else compute_dtype,

@@ -75,13 +75,15 @@ def main():
                     st, u_tree, i_tree, 0.1, 0.0, lo, True,
                     jnp.bfloat16, jax.lax.Precision.DEFAULT, implicit=False,
                     user_heavy=u_hv, item_heavy=i_hv,
-                    cg_iters=min(als._CG_ITERS_BF16, als._CG_ITERS))
+                    cg_iters=min(als._CG_ITERS_BF16, als._CG_ITERS),
+                    warmstart=als._CG_WARMSTART)
             if SWEEPS - lo:
                 st = als._als_run_fused(
                     st, u_tree, i_tree, 0.1, 0.0, SWEEPS - lo, True,
                     jnp.float32, precision, implicit=False,
                     user_heavy=u_hv, item_heavy=i_hv,
-                    cg_iters=polish_cg or als._CG_ITERS)
+                    cg_iters=polish_cg or als._CG_ITERS,
+                    warmstart=als._CG_WARMSTART)
             np.asarray(st.user_factors[0:1, 0:1])
             np.asarray(st.item_factors[0:1, 0:1])
             return st

@@ -343,7 +343,9 @@ def select_als_kernel(buckets, trees=None):
 
     from incubator_predictionio_tpu.ops import als
 
-    if not als._kernel_enabled(False):
+    # the timed legs run under the production warm-start default, so the
+    # gate must probe that exact kernel variant (warm adds the x0 operand)
+    if not als._kernel_enabled(False, warm=als._CG_WARMSTART):
         # distinguish an operator override from backend inability so the
         # fragment's cross-round comparison stays meaningful
         forced_off = als._ALS_KERNEL == "off" or als._SOLVER != "cg"

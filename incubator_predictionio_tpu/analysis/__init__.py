@@ -1,0 +1,27 @@
+"""pio-lint — TPU/JAX-aware static analysis for this repo.
+
+The reference caught mis-wired DASE components with Scala's compiler;
+this package is the Python/JAX rebuild's equivalent guardrail: an
+AST-based rule engine for the repo's documented tracer, sharding and
+host-sync hazard classes. Run ``python -m
+incubator_predictionio_tpu.analysis --baseline`` (CI does, on the
+tier-1 path) or ``scripts/lint.sh``; rules and suppression syntax are
+documented in ``docs/lint.md``.
+"""
+
+from incubator_predictionio_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Module,
+    apply_baseline,
+    default_baseline_path,
+    lint_paths,
+    load_baseline,
+    package_root,
+    repo_root,
+    write_baseline,
+)
+from incubator_predictionio_tpu.analysis.rules import (  # noqa: F401
+    ALL_RULES,
+    RULES_BY_NAME,
+    Rule,
+)

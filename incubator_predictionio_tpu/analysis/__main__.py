@@ -1,0 +1,150 @@
+"""CLI for pio-lint: ``python -m incubator_predictionio_tpu.analysis``.
+
+Exit codes: 0 = clean (modulo inline suppressions and, with
+``--baseline``, the baseline file), 1 = unsuppressed findings, 2 = a
+scanned file failed to parse or the invocation was malformed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List
+
+from incubator_predictionio_tpu.analysis.engine import (
+    apply_baseline,
+    default_baseline_path,
+    lint_paths,
+    load_baseline,
+    package_root,
+    write_baseline,
+)
+from incubator_predictionio_tpu.analysis.rules import ALL_RULES, RULES_BY_NAME
+
+
+def _entry_in_scope(entry: dict, rules, paths: List[Path]) -> bool:
+    """Whether this run could even SEE the entry's finding: its rule is
+    selected and its file is under one of the scanned paths."""
+    if entry["rule"] not in {r.name for r in rules}:
+        return False
+    from incubator_predictionio_tpu.analysis.engine import _relpath
+    for p in paths:
+        rel = _relpath(p)
+        if entry["path"] == rel or entry["path"].startswith(
+                rel.rstrip("/") + "/"):
+            return True
+    return False
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m incubator_predictionio_tpu.analysis",
+        description="pio-lint: TPU/JAX-aware static analysis "
+                    "(docs/lint.md)")
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files/directories to scan (default: the "
+             "incubator_predictionio_tpu package)")
+    parser.add_argument(
+        "--baseline", action="store_true",
+        help="subtract the checked-in analysis/baseline.json (this is "
+             "also the default when it exists; the flag makes CI "
+             "invocations explicit)")
+    parser.add_argument(
+        "--baseline-path", type=Path, default=None, metavar="PATH",
+        help="subtract a specific baseline JSON instead of the "
+             "checked-in one")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report baselined findings too (audit mode)")
+    parser.add_argument(
+        "--write-baseline", nargs="?", const=default_baseline_path(),
+        type=Path, default=None, metavar="PATH",
+        help="write the current findings as a fresh baseline and exit 0 "
+             "(every entry then needs a hand-written justification)")
+    parser.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule names to run (default: all)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print every rule with its severity and hazard class")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.name} ({rule.severity}): {rule.doc}")
+        return 0
+
+    rules = list(ALL_RULES)
+    if args.select:
+        names = [n.strip() for n in args.select.split(",") if n.strip()]
+        unknown = [n for n in names if n not in RULES_BY_NAME]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(RULES_BY_NAME)})", file=sys.stderr)
+            return 2
+        rules = [RULES_BY_NAME[n] for n in names]
+
+    paths = args.paths or [package_root()]
+    parse_errors: List[str] = []
+    findings = lint_paths(paths, rules, on_parse_error=parse_errors)
+    for err in parse_errors:
+        print(f"parse error: {err}", file=sys.stderr)
+
+    if args.write_baseline is not None:
+        # under --select / explicit paths this run cannot see every
+        # entry's finding — carry out-of-scope entries over verbatim
+        # instead of silently deleting their curated justifications
+        keep: List[dict] = []
+        if args.write_baseline.exists():
+            try:
+                keep = [e for e in load_baseline(args.write_baseline)
+                        if not _entry_in_scope(e, rules, paths)]
+            except (OSError, ValueError):
+                keep = []
+        write_baseline(args.write_baseline, findings, keep_entries=keep)
+        print(f"wrote {len(findings) + len(keep)} baseline entries to "
+              f"{args.write_baseline}"
+              + (f" ({len(keep)} out-of-scope kept)" if keep else ""))
+        return 0 if not parse_errors else 2
+
+    baseline_path = args.baseline_path
+    if (baseline_path is None and not args.no_baseline
+            and (args.baseline or default_baseline_path().exists())):
+        baseline_path = default_baseline_path()
+    stale: List[dict] = []
+    if baseline_path is not None and not args.no_baseline:
+        try:
+            entries = load_baseline(baseline_path)
+        except (OSError, ValueError) as exc:
+            print(f"cannot load baseline {baseline_path}: {exc}",
+                  file=sys.stderr)
+            return 2
+        # a filtered run (--select / explicit paths) never produces
+        # findings for out-of-scope entries — judging those "stale"
+        # would tell the developer to prune entries the full run needs
+        in_scope = [e for e in entries
+                    if _entry_in_scope(e, rules, paths)]
+        findings, stale = apply_baseline(findings, in_scope)
+
+    for f in findings:
+        print(f.format())
+    for e in stale:
+        print(f"stale baseline entry (fixed or drifted — prune it): "
+              f"{e['path']}: [{e['rule']}] {e['snippet']}",
+              file=sys.stderr)
+
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    if findings:
+        print(f"pio-lint: {n_err} error(s), {n_warn} warning(s)")
+        # parse errors outrank findings: part of the tree went unlinted
+        return 2 if parse_errors else 1
+    print("pio-lint: clean"
+          + (f" ({len(stale)} stale baseline entries)" if stale else ""))
+    return 2 if parse_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,542 @@
+"""pio-lint rules: this repo's documented TPU/JAX hazard classes.
+
+Every rule is grounded in a failure that either shipped here or is one
+compile away (ADVICE.md, ROUND5.md, docs/performance.md): host syncs
+inside traces, numpy-style negative-index wraparound on padding ids,
+availability probes that compile a different kernel than production
+runs, tracer-boolean branches, import-time env freezes, silent f64→f32
+downcasts, wall-clock reads baked into traces, and unlocked shared
+state in the async servers. ``docs/lint.md`` documents each rule with
+its hazard class and suppression syntax.
+
+Rules are pure AST visitors over :class:`~.engine.Module` — nothing is
+imported or executed, so the pass runs in milliseconds with no JAX
+backend and cannot be confused by import-time side effects.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+from incubator_predictionio_tpu.analysis.engine import (
+    CONFIG_MODULE_RE,
+    Finding,
+    Module,
+)
+
+
+class Rule:
+    name: str = ""
+    severity: str = "warning"
+    #: one-line hazard description for --list-rules and docs
+    doc: str = ""
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# 1. host syncs inside traced code
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_CALLS = {
+    "jax.device_get",
+    "numpy.asarray",
+    "numpy.array",
+}
+_HOST_SYNC_ATTRS = {"block_until_ready", "item"}
+
+
+class HostSyncInTrace(Rule):
+    name = "host-sync"
+    severity = "error"
+    doc = ("host-sync call (jax.device_get / .block_until_ready() / "
+           "np.asarray / .item()) inside a jit/pjit/shard_map-traced "
+           "function — inside a trace these operate on tracers, either "
+           "raising TracerError or silently baking a device round-trip "
+           "into every step")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for root, _statics in mod.traced_roots:
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                rname = mod.resolved(node.func)
+                if rname in _HOST_SYNC_CALLS:
+                    yield mod.finding(
+                        self, node,
+                        f"{rname}() inside traced function "
+                        f"{_root_name(root)!r} — move the host sync "
+                        "outside the trace")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOST_SYNC_ATTRS
+                        and rname not in _HOST_SYNC_CALLS):
+                    yield mod.finding(
+                        self, node,
+                        f".{node.func.attr}() inside traced function "
+                        f"{_root_name(root)!r} — move the host sync "
+                        "outside the trace")
+
+
+def _root_name(root: ast.AST) -> str:
+    return getattr(root, "name", "<lambda>")
+
+
+# ---------------------------------------------------------------------------
+# 2. negative-padding gather wraparound
+# ---------------------------------------------------------------------------
+
+_IDS_NAME_RE = re.compile(r"(?:^|_)ids?$")
+_CLAMP_CALLS = {
+    "jax.numpy.maximum", "jax.numpy.minimum", "jax.numpy.clip",
+    "jax.numpy.where", "numpy.maximum", "numpy.minimum", "numpy.clip",
+    "numpy.where", "jax.numpy.abs",
+}
+
+
+class NegativeGather(Rule):
+    name = "neg-gather"
+    severity = "warning"
+    doc = ("fancy-index gather fed by an *_ids variable that can carry "
+           "-1 padding: JAX/numpy wrap negative indices to the LAST row, "
+           "so padding rows silently read real data (the ADVICE.md "
+           "als.py:518 class) — clamp (jnp.maximum(ids, 0)) and mask "
+           "(jnp.where(ids >= 0, ..., 0)) or record the downstream "
+           "drop justification in the baseline")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        # module-scope clamp assignments apply everywhere; function-scope
+        # ones only inside their own function (chain) — a clamp in one
+        # function must not blind the rule to a same-named raw id in
+        # another (clamping is scope-local, not flow-sensitive)
+        module_clamped: Set[str] = set()
+        stack = list(mod.tree.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            _add_clamp_assign(mod, n, module_clamped)
+            stack.extend(ast.iter_child_nodes(n))
+        yield from self._visit(mod, mod.tree, frozenset(module_clamped))
+
+    def _visit(self, mod: Module, node: ast.AST,
+               clamped: "frozenset[str]") -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                local: Set[str] = set()
+                for sub in ast.walk(child):
+                    _add_clamp_assign(mod, sub, local)
+                yield from self._visit(mod, child, clamped | local)
+                continue
+            finding = self._check_subscript(mod, child, clamped)
+            if finding is not None:
+                yield finding
+            yield from self._visit(mod, child, clamped)
+
+    def _check_subscript(self, mod: Module, node: ast.AST,
+                         clamped: "frozenset[str]") -> Optional[Finding]:
+        if not (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)):
+            return None
+        # x.at[ids] carries explicit out-of-bounds semantics
+        # (mode="drop"/"fill") — the repo's scatter path
+        if (isinstance(node.value, ast.Attribute)
+                and node.value.attr == "at"):
+            return None
+        idx = node.slice
+        if not (isinstance(idx, ast.Name)
+                and _IDS_NAME_RE.search(idx.id)):
+            return None
+        if idx.id in clamped:
+            return None
+        return mod.finding(
+            self, node,
+            f"gather indexed by {idx.id!r} without a clamp/where "
+            "guard — -1 padding ids wrap to the last row")
+
+
+def _add_clamp_assign(mod: Module, node: ast.AST, into: Set[str]) -> None:
+    """Record ``name = jnp.where/maximum/clip(...)``-style assignments."""
+    if (isinstance(node, ast.Assign) and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+            and mod.resolved(node.value.func) in _CLAMP_CALLS):
+        into.add(node.targets[0].id)
+
+
+# ---------------------------------------------------------------------------
+# 3. availability probes that skip operands production passes
+# ---------------------------------------------------------------------------
+
+
+class ProbeArity(Rule):
+    name = "probe-arity"
+    severity = "error"
+    doc = ("a *_available() probe calls a kernel entry point without one "
+           "of its optional array operands — the probe then green-lights "
+           "a kernel whose production variant (extra BlockSpec / input "
+           "spec) was never compiled on the real backend (the "
+           "als_kernel_available/x0 class: interpret passes, Mosaic "
+           "fails at the first real train step)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        defs = {
+            n.name: n for n in ast.walk(mod.tree)
+            if isinstance(n, ast.FunctionDef)
+        }
+        for probe in ast.walk(mod.tree):
+            if not (isinstance(probe, ast.FunctionDef)
+                    and probe.name.endswith("_available")):
+                continue
+            for call in ast.walk(probe):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)):
+                    continue
+                callee = defs.get(call.func.id)
+                if callee is None:
+                    continue
+                missing = _unbound_optional_arrays(callee, call)
+                for param in missing:
+                    yield mod.finding(
+                        self, call,
+                        f"probe {probe.name!r} never passes the optional "
+                        f"array operand {param!r} of {callee.name!r} — "
+                        "the production variant's kernel is never "
+                        "compiled by the probe")
+
+
+def _unbound_optional_arrays(
+    callee: ast.FunctionDef, call: ast.Call
+) -> List[str]:
+    """Optional[jax.Array]-annotated params of ``callee`` with default
+    None that ``call`` binds neither positionally nor by keyword."""
+    args = callee.args
+    positional = args.posonlyargs + args.args
+    defaults = args.defaults
+    # map trailing defaults onto the positional tail
+    default_by_name = {}
+    for arg, default in zip(positional[len(positional) - len(defaults):],
+                            defaults):
+        default_by_name[arg.arg] = default
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None:
+            default_by_name[arg.arg] = default
+
+    optional_arrays = []
+    for arg in positional + args.kwonlyargs:
+        default = default_by_name.get(arg.arg)
+        if not (isinstance(default, ast.Constant) and default.value is None):
+            continue
+        if "jax.Array" in _annotation_text(arg.annotation):
+            optional_arrays.append(arg.arg)
+
+    bound = {kw.arg for kw in call.keywords if kw.arg}
+    if any(kw.arg is None for kw in call.keywords):  # **kwargs: assume bound
+        return []
+    n_pos = len(call.args)
+    bound |= {a.arg for a in positional[:n_pos]}
+    return [p for p in optional_arrays if p not in bound]
+
+
+def _annotation_text(annotation: Optional[ast.AST]) -> str:
+    if annotation is None:
+        return ""
+    if isinstance(annotation, ast.Constant) and isinstance(
+            annotation.value, str):
+        return annotation.value
+    try:
+        return ast.unparse(annotation)
+    except Exception:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# 4. Python control flow on tracer values
+# ---------------------------------------------------------------------------
+
+_TRACER_VALUED_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.ops.", "jax.nn.")
+
+
+class TracerBranch(Rule):
+    name = "tracer-branch"
+    severity = "error"
+    doc = ("Python if/while on a tracer-valued expression inside a "
+           "traced function — the branch is resolved ONCE at trace time "
+           "(or raises TracerBoolConversionError); use jnp.where / "
+           "lax.cond / lax.while_loop")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for root, statics in mod.traced_roots:
+            params = _param_names(root) - statics
+            for node in ast.walk(root):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                test = node.test
+                if _is_none_check(test):
+                    continue
+                jnp_call = next(
+                    (sub for sub in ast.walk(test)
+                     if isinstance(sub, ast.Call)
+                     and (mod.resolved(sub.func) or "").startswith(
+                         _TRACER_VALUED_PREFIXES)),
+                    None)
+                bare_param = (isinstance(test, ast.Name)
+                              and test.id in params)
+                if jnp_call is not None:
+                    yield mod.finding(
+                        self, node,
+                        f"`{ast.unparse(test)}` branches on a traced "
+                        f"array inside {_root_name(root)!r} — use "
+                        "jnp.where / lax.cond")
+                elif bare_param:
+                    yield mod.finding(
+                        self, node,
+                        f"branch on non-static parameter {test.id!r} "
+                        f"inside traced function {_root_name(root)!r} — "
+                        "mark it static or use lax.cond")
+
+
+def _param_names(root: ast.AST) -> Set[str]:
+    args = getattr(root, "args", None)
+    if args is None:
+        return set()
+    return {a.arg for a in
+            args.posonlyargs + args.args + args.kwonlyargs}
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops))
+
+
+# ---------------------------------------------------------------------------
+# 5. os.environ reads at import time
+# ---------------------------------------------------------------------------
+
+
+class EnvReadAtImport(Rule):
+    name = "env-import"
+    severity = "warning"
+    doc = ("os.environ read at module import time outside a config-style "
+           "module — the knob freezes at first import, so runtime "
+           "overrides (tests, bench sweeps, launcher re-exec) are "
+           "silently ignored; read it in the consumer, or baseline it "
+           "with the read-once justification")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if CONFIG_MODULE_RE.search(Path(mod.relpath).name):
+            return
+        seen_lines: Set[int] = set()
+        for node in _import_time_nodes(mod.tree):
+            rname = mod.resolved(node) if isinstance(
+                node, (ast.Name, ast.Attribute)) else None
+            if rname in ("os.environ", "os.getenv"):
+                line = node.lineno
+                if line not in seen_lines:
+                    seen_lines.add(line)
+                    yield mod.finding(
+                        self, node,
+                        "os.environ read at import time — the value "
+                        "freezes before any runtime override")
+
+
+def _import_time_nodes(tree: ast.Module) -> Iterator[ast.AST]:
+    """Every AST node evaluated while the module is being imported:
+    module/class bodies plus decorator lists, default argument values
+    and annotations of function definitions — but NOT function/lambda
+    bodies."""
+    stack: List[ast.AST] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            stack.extend(d for d in node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# 6. float64 without enable_x64
+# ---------------------------------------------------------------------------
+
+
+class Float64WithoutX64(Rule):
+    name = "f64"
+    severity = "warning"
+    doc = ("jnp.float64 / dtype='float64' requested without enable_x64 "
+           "anywhere in the module — JAX silently downgrades to float32 "
+           "unless jax.config.update('jax_enable_x64', True) ran, so "
+           "the extra precision the code asks for never materializes")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if "enable_x64" in mod.source:
+            return
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, (ast.Attribute, ast.Name))
+                    and mod.resolved(node) == "jax.numpy.float64"):
+                yield mod.finding(
+                    self, node,
+                    "jnp.float64 without enable_x64 — silently float32")
+            elif isinstance(node, ast.Call):
+                rname = mod.resolved(node.func) or ""
+                if not rname.startswith(("jax.", "jax.numpy.")):
+                    continue
+                for sub in list(node.args) + [
+                        kw.value for kw in node.keywords]:
+                    if (isinstance(sub, ast.Constant)
+                            and sub.value == "float64"):
+                        yield mod.finding(
+                            self, sub,
+                            f"dtype 'float64' passed to {rname} without "
+                            "enable_x64 — silently float32")
+
+
+# ---------------------------------------------------------------------------
+# 7. wall clock inside traced code
+# ---------------------------------------------------------------------------
+
+_WALLCLOCK_CALLS = {
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+
+
+class WallClockInTrace(Rule):
+    name = "wallclock"
+    severity = "warning"
+    doc = ("time.time()/perf_counter()/datetime.now() inside a traced "
+           "function — the value is captured ONCE at trace time and "
+           "baked into the compiled program as a constant; take "
+           "timestamps outside the jit boundary")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for root, _statics in mod.traced_roots:
+            for node in ast.walk(root):
+                if (isinstance(node, ast.Call)
+                        and mod.resolved(node.func) in _WALLCLOCK_CALLS):
+                    yield mod.finding(
+                        self, node,
+                        f"{mod.resolved(node.func)}() inside traced "
+                        f"function {_root_name(root)!r} — trace-time "
+                        "constant, not a per-step timestamp")
+
+
+# ---------------------------------------------------------------------------
+# 8. unlocked shared mutable state in async server handlers
+# ---------------------------------------------------------------------------
+
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "discard", "remove", "pop",
+    "popleft", "popitem", "update", "setdefault", "clear",
+}
+_LOCK_NAME_RE = re.compile(r"lock", re.IGNORECASE)
+
+
+class ServerUnlockedState(Rule):
+    name = "server-state"
+    severity = "warning"
+    doc = ("read-modify-write of shared instance/module state from an "
+           "async server handler without a lock — handlers interleave "
+           "at every await (and the pool-dispatch ingest path runs them "
+           "on threads), so counters and dicts mutated bare lose "
+           "updates under load (servers/*.py only)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if "/servers/" not in f"/{mod.relpath}":
+            return
+        seen: Set[Tuple[int, str]] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                for f in self._visit(mod, node.body, in_lock=False,
+                                     fn=node.name):
+                    # nested async defs are walked twice — dedupe
+                    if (f.line, f.message) not in seen:
+                        seen.add((f.line, f.message))
+                        yield f
+
+    def _visit(self, mod: Module, body: Sequence[ast.stmt],
+               in_lock: bool, fn: str) -> Iterator[Finding]:
+        for stmt in body:
+            # nested defs get their own ast.walk root (async) or run in
+            # an unknown context (sync) — descending here would report
+            # their mutations twice under two handler names
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            locked = in_lock
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                ctx = " ".join(
+                    ast.unparse(item.context_expr) for item in stmt.items)
+                locked = in_lock or bool(_LOCK_NAME_RE.search(ctx))
+            if not locked:
+                yield from self._flag_mutations(mod, stmt, fn)
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                sub = getattr(stmt, field, None)
+                if not sub:
+                    continue
+                for child in sub:
+                    child_body = (child.body
+                                  if isinstance(child, ast.ExceptHandler)
+                                  else [child])
+                    yield from self._visit(mod, child_body, locked, fn)
+
+    def _flag_mutations(self, mod: Module, stmt: ast.stmt,
+                        fn: str) -> Iterator[Finding]:
+        if isinstance(stmt, ast.AugAssign) and _is_shared_target(
+                stmt.target):
+            yield mod.finding(
+                self, stmt,
+                f"read-modify-write of shared state "
+                f"`{ast.unparse(stmt.target)}` in async handler "
+                f"{fn!r} without a lock")
+        elif isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and _is_shared_target(tgt.value)):
+                    yield mod.finding(
+                        self, stmt,
+                        f"item assignment to shared state "
+                        f"`{ast.unparse(tgt)}` in async handler "
+                        f"{fn!r} without a lock")
+        elif isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Call):
+            func = stmt.value.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATING_METHODS
+                    and _is_shared_target(func.value)):
+                yield mod.finding(
+                    self, stmt,
+                    f"`{ast.unparse(func)}()` mutates shared state in "
+                    f"async handler {fn!r} without a lock")
+
+
+def _is_shared_target(node: ast.AST) -> bool:
+    """self.<attr> (possibly nested, e.g. self.stats.counts)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+ALL_RULES: Sequence[Rule] = (
+    HostSyncInTrace(),
+    NegativeGather(),
+    ProbeArity(),
+    TracerBranch(),
+    EnvReadAtImport(),
+    Float64WithoutX64(),
+    WallClockInTrace(),
+    ServerUnlockedState(),
+)
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}

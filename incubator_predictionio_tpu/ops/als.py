@@ -168,10 +168,13 @@ def _kernel_rows_default() -> int:
     return pallas_kernels._ALS_ROWS
 
 
-def _kernel_enabled(implicit: bool) -> bool:
+def _kernel_enabled(implicit: bool, warm: bool = False) -> bool:
     """Resolve the bucket-kernel selector OUTSIDE any jit trace (the
     Mosaic probe compiles+runs a real kernel). Explicit CG only: the
-    implicit path needs the batch-shared YᵗY term and stays on XLA."""
+    implicit path needs the batch-shared YᵗY term and stays on XLA.
+    ``warm`` is the caller's resolved warm-start setting so the probe
+    compiles the exact kernel variant (x0 operand or not) this run
+    will dispatch."""
     if implicit or _SOLVER != "cg" or _ALS_KERNEL == "off":
         return False
     if _ALS_KERNEL == "on":
@@ -180,7 +183,7 @@ def _kernel_enabled(implicit: bool) -> bool:
         als_kernel_available,
     )
 
-    return als_kernel_available()
+    return als_kernel_available(warm=warm)
 #: CG budget for the bf16 early sweeps of the mixed schedule. Each CG
 #: iteration re-reads the whole [rows, K, K] Gram batch (~9 GB at
 #: ML-20M scale on the user side) — the dominant HBM stream once gathers
@@ -461,6 +464,19 @@ def _gram_rhs_nnz_chunked(other_factors, cols, vals, mask, compute_dtype,
             pnnz.reshape(n * chunk)[:S])
 
 
+def _gather_x0(prev_factors: jax.Array, row_ids: jax.Array) -> jax.Array:
+    """Warm-start factors for a padded row batch → [rows, K] f32.
+
+    Padding rows carry row_id -1, and a bare ``prev_factors[row_ids]``
+    wraps numpy-style to the LAST row — padding rows would warm-start
+    from a real row's factors. Their solutions are dropped at scatter
+    (``_scatter_rows_impl``), but the wraparound still feeds garbage
+    into the padded CG lanes, so clamp the gather and zero the padding
+    rows (a zero start is the exact cold-start fixed point)."""
+    safe = prev_factors[jnp.maximum(row_ids, 0)].astype(jnp.float32)
+    return jnp.where(row_ids[:, None] >= 0, safe, 0.0)
+
+
 def _scatter_rows_impl(out: jax.Array, row_ids: jax.Array,
                        sol: jax.Array) -> jax.Array:
     # Padding rows carry row_id -1. JAX scatter wraps negative indices
@@ -515,7 +531,7 @@ def _sweep_side(
         gsrc = other_factors.astype(compute_dtype)
     for row_ids, cols, vals, mask in tree:
         row_elems = None
-        x0 = (prev_factors[row_ids].astype(jnp.float32)
+        x0 = (_gather_x0(prev_factors, row_ids)
               if prev_factors is not None else None)
         if implicit:
             def solver(t, _yty=yty):
@@ -587,7 +603,9 @@ def _update_side(
     return _sweep_side_jit(
         n_rows, other_factors, _buckets_tree(buckets), None, l2, 0.0,
         reg_nnz, compute_dtype, precision, implicit=False,
-        use_kernel=_kernel_enabled(False), kernel_min_d=_KERNEL_MIN_D,
+        # this path never passes prev_factors, so probe the cold variant
+        use_kernel=_kernel_enabled(False, warm=False),
+        kernel_min_d=_KERNEL_MIN_D,
         kernel_rows=_kernel_rows_default())
 
 
@@ -943,7 +961,7 @@ def _solve_heavy(
     gram = jax.ops.segment_sum(pg, seg_ids, num_segments=n_heavy)
     rhs = jax.ops.segment_sum(prhs, seg_ids, num_segments=n_heavy)
     nnz = jax.ops.segment_sum(pnnz, seg_ids, num_segments=n_heavy)
-    x0 = (prev_factors[row_ids].astype(jnp.float32)
+    x0 = (_gather_x0(prev_factors, row_ids)
           if prev_factors is not None else None)
     return row_ids, _reg_solve(
         gram, rhs, nnz, l2, reg_nnz, implicit, yty, cg_iters=cg_iters,
@@ -1028,14 +1046,16 @@ def _mixed_run(
     # the Mosaic probe runs a real kernel). Callers pass False explicitly
     # on the mesh-sharded path: pallas_call does not auto-partition under
     # GSPMD, so the sharded program keeps the XLA assembly.
+    if warmstart is None:
+        warmstart = _CG_WARMSTART
     if use_kernel is None:
-        use_kernel = _kernel_enabled(False)
+        # probe the exact variant this run dispatches (warm adds the x0
+        # operand — a different kernel), honoring per-call overrides
+        use_kernel = _kernel_enabled(False, warm=bool(warmstart))
     if kernel_min_d is None:
         kernel_min_d = _KERNEL_MIN_D
     if kernel_rows is None:
         kernel_rows = _kernel_rows_default()
-    if warmstart is None:
-        warmstart = _CG_WARMSTART
     if lo:
         state = _als_run_fused(
             state, u_tree, i_tree, l2, 0.0, lo, reg_nnz,

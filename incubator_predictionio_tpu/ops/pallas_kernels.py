@@ -114,6 +114,10 @@ def topk_kernel_available() -> bool:
             _topk_ok = False
         else:
             _topk_ok = _probe_kernel_runs(
+                # exclude/allowed_mask fold into the always-present
+                # `allowed` operand before pallas_call — the probed
+                # kernel is identical with or without them
+                # pio-lint: disable=probe-arity
                 lambda: score_and_top_k_pallas(
                     jnp.zeros((_LANES,), jnp.float32),
                     jnp.zeros((2 * 8192, _LANES), jnp.float32),
@@ -136,8 +140,12 @@ def flash_available() -> bool:
                 # [B, S, H, D] with S large enough that the q/kv blocks are
                 # the REAL 512-wide call-site shapes, not clamped stubs
                 q = jnp.zeros((1, 1024, 1, 64), jnp.float32)
+                # kv_valid folds into the always-present `valid` operand
+                # (ones when None) — the probed kernel is identical
+                # pio-lint: disable=probe-arity
                 out = flash_attention(q, q, q, q_block=512, kv_block=512)
                 grad = jax.grad(
+                    # pio-lint: disable=probe-arity
                     lambda x: jnp.sum(flash_attention(
                         x, x, x, q_block=512, kv_block=512)))(q)
                 return out, grad
@@ -1010,24 +1018,39 @@ def als_solve_cg_pallas(
     return out[:, 0, :k]
 
 
-_als_ok: "bool | None" = None
+_als_ok: "dict[bool, bool]" = {}
 
 
-def als_kernel_available() -> bool:
+def als_kernel_available(warm: "bool | None" = None) -> bool:
     """The ALS bucket-solve family: probe the real kernel at a shape that
     exercises rank padding (rank 64 → 128), a row count that is not a
-    sublane multiple, and multi-tile D streaming."""
-    global _als_ok
-    if _als_ok is None:
+    sublane multiple, and multi-tile D streaming.
+
+    The probe must compile the variant the caller will actually run:
+    a warm-start bucket solve passes an ``x0`` operand, which is a
+    DIFFERENT kernel (extra input spec + the initial-residual matvec),
+    so a cold-only probe would green-light a warm kernel that was never
+    compiled on the real Mosaic backend — the interpret-passes/
+    hardware-fails class ROUND5.md documents. ``warm`` is therefore the
+    caller's resolved warm-start setting (als._mixed_run passes its
+    per-call override; None falls back to the PIO_ALS_CG_WARMSTART
+    process default), and results cache per variant."""
+    if warm is None:
+        from incubator_predictionio_tpu.ops.als import _CG_WARMSTART
+
+        warm = _CG_WARMSTART
+    warm = bool(warm)
+    if warm not in _als_ok:
         if not pallas_available():
-            _als_ok = False
+            _als_ok[warm] = False
         else:
-            _als_ok = _probe_kernel_runs(
+            x0 = jnp.zeros((12, 64), jnp.float32) if warm else None
+            _als_ok[warm] = _probe_kernel_runs(
                 lambda: als_solve_cg_pallas(
                     jnp.zeros((64, 64), jnp.bfloat16),
                     jnp.zeros((12, 1024), jnp.int32),
                     jnp.ones((12, 1024), jnp.float32),
                     jnp.ones((12, 1024), jnp.float32),
-                    0.1, True, 6, interpret=False),
-                "ALS bucket CG solve")
-    return _als_ok
+                    0.1, True, 6, interpret=False, x0=x0),
+                f"ALS bucket CG solve ({'warm' if warm else 'cold'})")
+    return _als_ok[warm]

@@ -85,8 +85,11 @@ def main() -> int:
 
     # measure what PIO_ALS_KERNEL=auto would actually select: gate the
     # kernel leg on the real Mosaic probe (forcing past a failed probe
-    # would either crash mid-run or silently time interpret mode)
-    kernel_ok = als._kernel_enabled(False)
+    # would either crash mid-run or silently time interpret mode). The
+    # legs run _mixed_run under the production warm-start default, so
+    # probe that exact variant (warm adds the x0 operand — a different
+    # kernel)
+    kernel_ok = als._kernel_enabled(False, warm=als._CG_WARMSTART)
     # each leg: (use_kernel, min-D routing cut, rows per program).
     # PIO_TUNE_MIN_DS × PIO_TUNE_ROWS sweep both knobs so one chip window
     # yields the whole layout picture

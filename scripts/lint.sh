@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# pio-lint convenience wrapper: scan the package against the checked-in
+# baseline (docs/lint.md). Extra args pass through, e.g.:
+#   scripts/lint.sh --select host-sync,probe-arity
+#   scripts/lint.sh --write-baseline   # then hand-justify every entry
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m incubator_predictionio_tpu.analysis --baseline "$@"

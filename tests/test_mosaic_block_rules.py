@@ -95,6 +95,34 @@ def test_als_kernel_blocks_are_mosaic_legal(capture, rows, B, D, K):
     _check_pairs(capture)
 
 
+@pytest.mark.parametrize("rows", [1, 8])
+@pytest.mark.parametrize("B,D,K", [
+    (24, 48, 64),      # lane-padded D and K
+    (13, 1024, 32),    # multi-tile D, group padding
+])
+def test_als_kernel_warmstart_blocks_are_mosaic_legal(capture, rows, B, D, K):
+    """The warm-start variant is a DIFFERENT kernel (extra x0 BlockSpec +
+    initial-residual matvec) — production runs it by default
+    (PIO_ALS_CG_WARMSTART=1), so its block shapes need the same static
+    Mosaic check as the cold kernel (the als_kernel_available/x0 probe
+    gap class, ADVICE.md round 5)."""
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_solve_cg_pallas,
+    )
+
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(rng.normal(0, 0.3, (200, K)).astype(np.float32))
+    cols = jnp.asarray(rng.integers(0, 200, (B, D)).astype(np.int32))
+    vals = jnp.asarray(rng.normal(3.5, 1.0, (B, D)).astype(np.float32))
+    mask = jnp.asarray((rng.random((B, D)) < 0.8).astype(np.float32))
+    x0 = jnp.asarray(rng.normal(0, 0.3, (B, K)).astype(np.float32))
+    als_solve_cg_pallas(table, cols, vals, mask, 0.1, True, 4,
+                        interpret=True, rows_per_program=rows, x0=x0)
+    x0_specs = [p for p in capture if p[0] == f"in{3}"]
+    assert x0_specs, "warm path did not add the x0 operand spec"
+    _check_pairs(capture)
+
+
 @pytest.mark.parametrize("S", [512, 2048])
 def test_flash_attention_blocks_are_mosaic_legal(capture, S):
     from incubator_predictionio_tpu.ops.pallas_kernels import (

@@ -316,3 +316,28 @@ def test_flash_block_table_selection(monkeypatch):
 
     monkeypatch.setenv("PIO_FLASH_BLOCKS", "garbage")
     assert pk._parse_block_env() is None
+
+
+def test_als_probe_compiles_the_variant_the_caller_runs(monkeypatch):
+    """als_kernel_available(warm=...) must probe the EXACT kernel variant
+    the caller will dispatch (warm adds the x0 operand — a different
+    Mosaic kernel) and cache per variant, so a cold-only probe can never
+    green-light a warm run or vice versa (the ADVICE.md round-5 probe
+    gap)."""
+    from incubator_predictionio_tpu.ops import pallas_kernels as pk
+
+    probed = []
+
+    def fake_probe(fn, what):
+        probed.append(what)
+        return True
+
+    monkeypatch.setattr(pk, "pallas_available", lambda: True)
+    monkeypatch.setattr(pk, "_probe_kernel_runs", fake_probe)
+    monkeypatch.setattr(pk, "_als_ok", {})
+
+    assert pk.als_kernel_available(warm=True)
+    assert pk.als_kernel_available(warm=False)
+    assert pk.als_kernel_available(warm=True)   # cached, no new probe
+    assert probed == ["ALS bucket CG solve (warm)",
+                      "ALS bucket CG solve (cold)"]

@@ -104,6 +104,24 @@ PEAK_FLOPS_BF16 = float(os.environ.get("PIO_BENCH_PEAK_FLOPS_BF16", 197e12))
 #: worst-case bench wall is max(host stages, wait) + child run, not their
 #: sum.
 ACCEL_WAIT_S = float(os.environ.get("PIO_BENCH_ACCEL_WAIT_S", "1800"))
+#: GLOBAL wall budget for the whole bench process. The driver kills the
+#: bench at its own timeout (observed: 870 s, rc=124) — BENCH_r05 lost an
+#: already-computed degraded record because the claim-retry loop's third
+#: recycle window ran past it. The bench therefore commits to emitting
+#: its one JSON record (degraded if need be) BEFORE this deadline: the
+#: claim wait is capped at deadline minus an emit margin, and the
+#: orchestrator abandons a still-dialing supervisor rather than die
+#: recordless. Raise it on drivers with a longer leash.
+BENCH_DEADLINE_S = float(os.environ.get("PIO_BENCH_DEADLINE_S", "840"))
+#: seconds reserved before the deadline for wrapping up: reading the
+#: fragment, joining the degraded thread, serializing the record
+EMIT_MARGIN_S = float(os.environ.get("PIO_BENCH_EMIT_MARGIN_S", "30"))
+#: how long the degraded fallback (prep + CPU train + quality + serving
+#: at DEGRADED_NNZ) is budgeted to take — the orchestrator starts the
+#: fallback early enough that it can finish before the deadline, even if
+#: that overlaps the accelerator wait from the first second
+DEGRADED_BUDGET_S = float(
+    os.environ.get("PIO_BENCH_DEGRADED_BUDGET_S", "600"))
 #: if no child has claimed the chip this far into the wait, the parent
 #: starts computing the degraded record in parallel (a normal dial lands
 #: in seconds; by 300 s it is almost certainly a wedge) so the wait and
@@ -483,6 +501,100 @@ def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
     }
 
 
+def bench_scan_probe(store_dir: str) -> dict:
+    """Sequential vs sharded event-log scan at bench scale, projection
+    cache bypassed, plus the pipelined scan→prep leg — the host-pipeline
+    sub-metrics (shard count, per-shard walls, native-lock-held wall,
+    scan/prep overlap). The headline ``ingest_wall_s`` keeps measuring
+    the production warm path (cache serve); this stage measures the cold
+    scan machinery those rounds would otherwise never see."""
+    from incubator_predictionio_tpu.data.storage import StorageClientConfig
+    from incubator_predictionio_tpu.data.storage import cpplog
+    from incubator_predictionio_tpu.ops.sparse import StreamingPrep
+
+    cfg = StorageClientConfig(properties={"PATH": store_dir})
+    client = cpplog.StorageClient(cfg)
+    events = cpplog.CppLogEvents(client, cfg, prefix="bench_")
+    out: dict = {}
+    old_shards = os.environ.get("PIO_SCAN_SHARDS")
+    try:
+        t0 = time.perf_counter()
+        client.handle("bench_", 1, None)
+        out["scan_open_s"] = round(time.perf_counter() - t0, 2)
+
+        # true single-thread leg — the acceptance baseline. PIO_SCAN_
+        # SHARDS=1 still uses the scanner's internal auto threading (the
+        # pre-sharding production path), so the 1-thread wall is measured
+        # through the raw native call with n_threads pinned to 1.
+        with client.lock:
+            h = events._handle(1, None)
+            raw = client.lib.pio_evlog_entry_count(h)
+            pin = client.pin("bench_", 1, None)
+        try:
+            t0 = time.perf_counter()
+            inter, _, _ = events._scan_native(
+                h, None, None, "user", "item", ["rate"], {}, "rating",
+                1.0, min_entry_idx=0, max_entry_idx=raw, n_threads=1)
+            out["scan_wall_1thread_s"] = round(time.perf_counter() - t0, 2)
+            del inter
+        finally:
+            client.unpin(pin)
+
+        os.environ["PIO_SCAN_SHARDS"] = "1"
+        t0 = time.perf_counter()
+        inter = events.scan_interactions(
+            app_id=1, entity_type="user", target_entity_type="item",
+            event_names=("rate",), value_prop="rating",
+            use_cache=False, seed_cache=False)
+        seq_s = time.perf_counter() - t0
+        n_seq = len(inter)
+        del inter
+
+        if old_shards is None:
+            os.environ.pop("PIO_SCAN_SHARDS", None)
+        else:
+            os.environ["PIO_SCAN_SHARDS"] = old_shards
+        prep = StreamingPrep()
+        stats: dict = {}
+        t0 = time.perf_counter()
+        inter = events.scan_interactions(
+            app_id=1, entity_type="user", target_entity_type="item",
+            event_names=("rate",), value_prop="rating",
+            use_cache=False, seed_cache=False, stats=stats,
+            shard_sink=prep.add_shard)
+        sharded_s = time.perf_counter() - t0
+        buckets = prep.finish(
+            inter, reordered=bool(stats.get("scan_reordered")))
+        pipelined_s = time.perf_counter() - t0
+        assert len(inter) == n_seq, (len(inter), n_seq)
+        del inter, buckets
+        out.update({
+            "scan_wall_seq_s": round(seq_s, 2),
+            "scan_wall_sharded_s": round(sharded_s, 2),
+            "scan_speedup_vs_seq": round(seq_s / max(sharded_s, 1e-9), 2),
+            "scan_speedup_vs_1thread": round(
+                out["scan_wall_1thread_s"] / max(sharded_s, 1e-9), 2),
+            "scan_shards": stats.get("scan_shards"),
+            "scan_shard_walls_s": stats.get("scan_shard_walls_s"),
+            "scan_lock_held_s": stats.get("scan_lock_held_s"),
+            "scan_merge_wall_s": stats.get("scan_merge_wall_s"),
+            "scan_prep_pipelined_wall_s": round(pipelined_s, 2),
+            "scan_prep_overlap_s": round(prep.overlap_s, 3),
+        })
+        log(f"scan probe: seq={seq_s:.1f}s sharded={sharded_s:.1f}s "
+            f"(shards={stats.get('scan_shards')}, "
+            f"lock-held={stats.get('scan_lock_held_s')}s) "
+            f"pipelined scan+prep={pipelined_s:.1f}s "
+            f"(overlap {prep.overlap_s:.2f}s)")
+    finally:
+        if old_shards is None:
+            os.environ.pop("PIO_SCAN_SHARDS", None)
+        else:
+            os.environ["PIO_SCAN_SHARDS"] = old_shards
+        client.close()
+    return out
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -573,14 +685,37 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
     inter, ingest_s = scan_store(store_dir)
     assert len(inter) == NNZ, len(inter)
     log(f"ingest scan: {ingest_s:.1f}s ({NNZ / ingest_s / 1e6:.2f}M ev/s)")
-    u_b, i_b, n_users, n_items, prep_s = prep_buckets(inter)
-    log(f"prep (bucketed padded rows): {prep_s:.1f}s "
-        f"(users={n_users}, items={n_items})")
 
-    from incubator_predictionio_tpu.ops import als  # noqa: F401
+    from incubator_predictionio_tpu.ops import als
+    from incubator_predictionio_tpu.ops.sparse import build_both_sides
 
-    buckets = (u_b, i_b, n_users, n_items)
-    trees = build_trees(buckets)
+    # pipelined prep→device: each side's bucket/heavy trees are uploaded
+    # (H2D) from the prep worker the moment that side finishes padding,
+    # overlapping the other side's bucket fill. prep_wall_s therefore now
+    # INCLUDES the device upload that used to run untimed after prep;
+    # prep_h2d_s records the upload share.
+    n_users, n_items = len(inter.user_ids), len(inter.item_ids)
+    side_box: dict = {}
+
+    def _on_side(side, light, heavy):
+        t0 = time.perf_counter()
+        side_box[side] = (als._buckets_tree(light), als._heavy_tree(heavy))
+        side_box[side + "_h2d_s"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    (u_b_light, u_b_heavy), (i_b_light, i_b_heavy) = build_both_sides(
+        inter.user_idx, inter.item_idx, inter.values, n_users, n_items,
+        on_side=_on_side)
+    prep_s = time.perf_counter() - t0
+    h2d_s = side_box["user_h2d_s"] + side_box["item_h2d_s"]
+    log(f"prep+H2D (bucketed padded rows; per-side device upload "
+        f"overlaps the other side's padding): {prep_s:.1f}s "
+        f"(H2D {h2d_s:.1f}s, users={n_users}, items={n_items})")
+
+    buckets = ((u_b_light, u_b_heavy), (i_b_light, i_b_heavy),
+               n_users, n_items)
+    trees = (side_box["user"][0], side_box["item"][0],
+             side_box["user"][1], side_box["item"][1], n_users, n_items)
     use_kernel, kernel_rows, kernel_probe = select_als_kernel(
         buckets, trees=trees)
     state, t = measure_train(buckets, BF16_SWEEPS, use_kernel=use_kernel,
@@ -611,6 +746,7 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
         "compile_s_warm_cache": t["compile_s_warm_cache"],
         "ingest_wall_s": round(ingest_s, 1),
         "prep_wall_s": round(prep_s, 1),
+        "prep_h2d_s": round(h2d_s, 1),
         "e2e_train_wall_s": round(ingest_s + prep_s + train_s, 1),
         **kernel_probe,
         **attn,
@@ -627,12 +763,18 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
 
 
 def supervise_tpu_child(store_dir: str, out_path: str,
-                        claim_event=None) -> bool:
+                        claim_event=None, deadline_mono=None) -> bool:
     """Spawn/recycle the TPU child until it lands a fragment or the
     ACCEL_WAIT_S budget runs out. Returns True iff `out_path` exists
     (checked on every exit path — an abandoned SIGTERM-ignoring child
     that completes late still counts). Sets `claim_event` the moment any
     child claims the chip so the parent can cancel fallback work.
+
+    ``deadline_mono`` (time.monotonic value) caps the CUMULATIVE claim
+    wait: past it the supervisor returns so the orchestrator can emit
+    its record before the driver's kill — terminating an unclaimed dial
+    waiter (safe: it holds nothing), but leaving a claimed child running
+    (a holder is never cut down; it finishes and exits on its own).
 
     A child that has not claimed the chip within its window is stopped
     with SIGTERM (it is *waiting* on the lease, not holding it — killing
@@ -641,6 +783,8 @@ def supervise_tpu_child(store_dir: str, out_path: str,
     while healthy) and respawned with a doubled window: only a fresh
     process gets a fresh PJRT dial."""
     deadline = time.monotonic() + ACCEL_WAIT_S
+    if deadline_mono is not None:
+        deadline = min(deadline, deadline_mono)
     window = 180.0
     attempt = 0
     fast_fails = 0
@@ -698,6 +842,14 @@ def supervise_tpu_child(store_dir: str, out_path: str,
                 log(f"tpu child claimed the accelerator "
                     f"(attempt {attempt}); run window "
                     f"{TPU_RUN_TIMEOUT_S:.0f}s")
+            if claimed and time.monotonic() >= deadline:
+                # global deadline with the TPU leg mid-run: the record
+                # must go out NOW. The claimed child is left running —
+                # a chip holder is never cut down — and its late
+                # fragment simply goes unused this round.
+                log("bench deadline reached during the TPU run; emitting "
+                    "the record without waiting (child left running)")
+                return os.path.exists(out_path)
             if time.monotonic() >= win_end:
                 log(f"tpu child attempt {attempt} "
                     + ("overran its run window"
@@ -799,6 +951,9 @@ def run_orchestrator() -> None:
 
     jax.config.update("jax_platforms", "cpu")
 
+    t_bench0 = time.monotonic()
+    emit_by = t_bench0 + BENCH_DEADLINE_S - EMIT_MARGIN_S
+
     rng = np.random.default_rng(7)
     log(f"dataset: {N_USERS}x{N_ITEMS}, nnz={NNZ}, rank={RANK}, "
         f"sweeps={ITERATIONS} ({BF16_SWEEPS} bf16 + "
@@ -815,6 +970,26 @@ def run_orchestrator() -> None:
     client.close()
     log(f"seed: {NNZ} events in {seed_s:.1f}s "
         f"({NNZ / seed_s / 1e6:.2f}M ev/s)")
+
+    # -- 2a. SCAN PROBES (host): the sharded-scan sub-metrics. The
+    #        ingest stage below serves from the projection cache (the
+    #        production warm path), so the native scan machinery is
+    #        measured here explicitly — sequential vs sharded, cache
+    #        bypassed, plus the pipelined scan→prep leg. Runs before the
+    #        ingest stage so its transient full-shape arrays are freed
+    #        before the parent holds its own copy, and GUARDED: a probe
+    #        failure nulls the sub-metrics, never costs the record (the
+    #        BENCH_r05 recordless-exit class)
+    scan_metrics = {k: None for k in (
+        "scan_open_s", "scan_wall_1thread_s", "scan_wall_seq_s",
+        "scan_wall_sharded_s", "scan_speedup_vs_seq",
+        "scan_speedup_vs_1thread", "scan_shards", "scan_shard_walls_s",
+        "scan_lock_held_s", "scan_merge_wall_s",
+        "scan_prep_pipelined_wall_s", "scan_prep_overlap_s")}
+    try:
+        scan_metrics.update(bench_scan_probe(store_dir))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"scan probe failed ({e!r}); sub-metrics null this round")
 
     # -- 2+3. INGEST + PREP (host, parent's own copy for the degraded
     #         record; the child measures its own on the TPU path) ----------
@@ -849,7 +1024,8 @@ def run_orchestrator() -> None:
     def _supervise() -> None:
         try:
             sup_ok.append(
-                supervise_tpu_child(store_dir, frag_path, claim_seen))
+                supervise_tpu_child(store_dir, frag_path, claim_seen,
+                                    deadline_mono=emit_by - 5.0))
         finally:
             sup_done.set()
 
@@ -858,8 +1034,17 @@ def run_orchestrator() -> None:
 
     degraded_result: list = []
     t_deg = None
-    if not sup_done.wait(DEGRADED_START_S) and not claim_seen.is_set():
-        log(f"no accelerator claim after {DEGRADED_START_S:.0f}s — "
+    # start the fallback at DEGRADED_START_S — or earlier when the global
+    # deadline demands it: the degraded record needs DEGRADED_BUDGET_S to
+    # compute, and a record MUST be on stdout before the driver's kill
+    # (the BENCH_r05 failure mode). Worst case the fallback overlaps the
+    # dial wait from the first second; cancel-on-claim keeps the CPU
+    # perturbation window as short as possible.
+    deg_start_wait = max(0.0, min(
+        DEGRADED_START_S,
+        (emit_by - DEGRADED_BUDGET_S) - time.monotonic()))
+    if not sup_done.wait(deg_start_wait) and not claim_seen.is_set():
+        log(f"no accelerator claim after {deg_start_wait:.0f}s — "
             "computing the degraded record in parallel with the wait")
         t_deg = threading.Thread(
             target=lambda: degraded_result.append(
@@ -867,16 +1052,18 @@ def run_orchestrator() -> None:
                              cancel=claim_seen)),
             daemon=True)
         t_deg.start()
-    sup_done.wait()
+    if not sup_done.wait(max(emit_by - time.monotonic(), 0.0)):
+        log("bench deadline: abandoning the supervisor thread and "
+            "emitting the record now")
     accel_waited_s = time.monotonic() - t_sup0
-    child_ok = bool(sup_ok and sup_ok[0])
+    child_ok = bool(sup_ok and sup_ok[0]) or os.path.exists(frag_path)
     if not child_ok and t_deg is not None:
         # never start a second run_degraded while the thread lives — the
         # two would race on the process-global Storage registry; wait it
-        # out instead (it is bounded: jitted stages finish, servers stop)
-        t_deg.join(timeout=1800)
+        # out up to the deadline instead
+        t_deg.join(timeout=max(emit_by - time.monotonic(), 5.0))
         if t_deg.is_alive():
-            log("degraded fallback still running after 1800s grace — "
+            log("degraded fallback still running at the deadline — "
                 "emitting the record without train-quality keys")
     # stable key set across modes: every key a prior round's record had is
     # present (None when the mode can't measure it), so round-over-round
@@ -898,6 +1085,10 @@ def run_orchestrator() -> None:
         "seed_wall_s": round(seed_s, 1),
         "ingest_wall_s": round(ingest_s, 1),
         "prep_wall_s": round(prep_s, 1),
+        "prep_h2d_s": None,  # child-only (pipelined prep→device upload)
+        # host-pipeline sub-metrics (bench_scan_probe): sharded-scan
+        # walls, native-lock-held wall, scan→prep overlap
+        **scan_metrics,
         "e2e_train_wall_s": None,
         "ingest_http_eps": ingest_http_eps,
         "ingest_http_eps_cap500": ingest_http_eps_cap500,
@@ -946,10 +1137,16 @@ def run_orchestrator() -> None:
             deg = degraded_result[0]
         elif t_deg is not None and t_deg.is_alive():
             deg = None  # fallback thread hung — never race a second run
-        else:
+        elif time.monotonic() + DEGRADED_BUDGET_S <= emit_by:
             # no fallback ran, or it was cancelled by a claim from a child
-            # that then failed — the thread is dead, safe to run fresh
+            # that then failed — the thread is dead and there is still
+            # budget before the deadline, so run it fresh
             deg = run_degraded(inter, heldout, truth, rng)
+        else:
+            log("no time left for a fresh degraded run before the "
+                "deadline — emitting the record without train-quality "
+                "keys")
+            deg = None
         if deg:
             record.update(deg)
             # full-shape read/prep walls + degraded-shape train wall: the
@@ -957,7 +1154,9 @@ def run_orchestrator() -> None:
             record["e2e_train_wall_s"] = round(
                 record["ingest_wall_s"] + record["prep_wall_s"]
                 + record["value"], 1)
-    print(json.dumps(record))
+    # explicit flush: the record must hit the pipe even if the driver's
+    # kill lands right after (stdout is block-buffered under a pipe)
+    print(json.dumps(record), flush=True)
 
 
 #: the reference's own bundled MovieLens sample (user::item::rating, 1.5k

@@ -528,6 +528,73 @@ def _is_shared_target(node: ast.AST) -> bool:
     return isinstance(node, ast.Name) and node.id == "self"
 
 
+# ---------------------------------------------------------------------------
+# 9. long-running native scans under the storage lock
+# ---------------------------------------------------------------------------
+
+#: the event-log scan entry points whose wall scales with the log size
+#: (seconds at training scale). The native side snapshots under its own
+#: short mutex, so nothing is gained — and every concurrent writer is
+#: stalled — by holding a Python storage lock across them.
+_NATIVE_SCAN_RE = re.compile(
+    r"^(pio_evlog_scan\w*|_scan_native|_scan_sharded)$")
+
+
+class LockNativeScan(Rule):
+    name = "lock-native-scan"
+    severity = "error"
+    doc = ("long-running native scan entry point (pio_evlog_scan* / "
+           "_scan_native / _scan_sharded) called inside a `with ...lock:` "
+           "body — the scan snapshots consistently under its own short "
+           "native mutex, so holding the Python storage lock across it "
+           "stalls every concurrent event write for the whole scan "
+           "(the ~13 s cpplog.scan_interactions class this repo fixed): "
+           "snapshot counts under the lock, scan outside it, revalidate "
+           "before publishing derived state")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        seen: Set[Tuple[int, int]] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            ctx = " ".join(
+                ast.unparse(item.context_expr) for item in node.items)
+            if not _LOCK_NAME_RE.search(ctx):
+                continue
+            for call in self._calls_in_body(node):
+                func = call.func
+                cname = (func.attr if isinstance(func, ast.Attribute)
+                         else func.id if isinstance(func, ast.Name)
+                         else None)
+                if cname is None or not _NATIVE_SCAN_RE.match(cname):
+                    continue
+                key = (call.lineno, call.col_offset)
+                if key in seen:  # nested lock withs walk the call twice
+                    continue
+                seen.add(key)
+                yield mod.finding(
+                    self, call,
+                    f"native scan {cname!r} called while holding "
+                    f"`{ctx}` — scans snapshot under their own native "
+                    "mutex; release the storage lock before scanning")
+
+    @staticmethod
+    def _calls_in_body(with_node: ast.AST) -> Iterator[ast.Call]:
+        """Call nodes lexically under the with, excluding nested function
+        bodies (a function *defined* under a lock is not *called* under
+        it)."""
+        stack: List[ast.AST] = list(
+            ast.iter_child_nodes(with_node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -537,6 +604,7 @@ ALL_RULES: Sequence[Rule] = (
     Float64WithoutX64(),
     WallClockInTrace(),
     ServerUnlockedState(),
+    LockNativeScan(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -43,6 +43,12 @@ logger = logging.getLogger(__name__)
 _I64_MIN = -(1 << 63)
 _I64_MAX = (1 << 63) - 1
 
+#: auto shard-count floor: below this many entries per shard the thread
+#: spawn + table-merge overhead outweighs the parallel scan (an explicit
+#: PIO_SCAN_SHARDS bypasses the floor — the differential tests exercise
+#: shard counts on tiny logs)
+_MIN_SCAN_ENTRIES_PER_SHARD = 200_000
+
 
 def _h(s: Optional[str]) -> int:
     return 0 if s is None else native.fnv1a64(s.encode("utf-8"))
@@ -93,6 +99,43 @@ class StorageClient(base.BaseStorageClient):
         self.dir.mkdir(parents=True, exist_ok=True)
         self.lock = threading.RLock()
         self._handles: dict[str, int] = {}
+        # handle read-pins: a lock-narrowed scan (CppLogEvents.
+        # scan_interactions) runs its native calls WITHOUT holding
+        # self.lock, so drop/close/compact — which free or swap the
+        # native handle — must wait until in-flight readers drain.
+        # Condition(self.lock) releases the (R)Lock while waiting, so a
+        # pinned reader can still take the lock briefly (revalidation,
+        # cache writes) without deadlocking the waiter.
+        self._pins: dict[str, int] = {}
+        self._pins_cv = threading.Condition(self.lock)
+
+    def pin(self, ns: str, app_id: int, channel_id: Optional[int]) -> str:
+        """Mark the (ns, app, channel) handle as read-busy; returns the
+        key for :meth:`unpin`. Caller must unpin in a finally block."""
+        key = str(self._file(ns, app_id, channel_id))
+        with self.lock:
+            self._pins[key] = self._pins.get(key, 0) + 1
+        return key
+
+    def unpin(self, key: str) -> None:
+        with self.lock:
+            n = self._pins.get(key, 0) - 1
+            if n > 0:
+                self._pins[key] = n
+            else:
+                self._pins.pop(key, None)
+            self._pins_cv.notify_all()
+
+    def _wait_unpinned_locked(self, key: Optional[str] = None) -> None:
+        """Block (lock released while waiting) until no reader pins the
+        key — or, with key=None, until no reader pins anything. Scans are
+        finite, so this always terminates."""
+        if key is None:
+            while any(self._pins.values()):
+                self._pins_cv.wait()
+        else:
+            while self._pins.get(key, 0) > 0:
+                self._pins_cv.wait()
 
     def _file(self, ns: str, app_id: int, channel_id: Optional[int]) -> Path:
         chan = 0 if channel_id is None else channel_id
@@ -113,6 +156,7 @@ class StorageClient(base.BaseStorageClient):
         path = self._file(ns, app_id, channel_id)
         key = str(path)
         with self.lock:
+            self._wait_unpinned_locked(key)
             h = self._handles.pop(key, None)
             if h is not None:
                 self.lib.pio_evlog_close(h)
@@ -133,6 +177,7 @@ class StorageClient(base.BaseStorageClient):
     def close(self) -> None:
         import logging
         with self.lock:
+            self._wait_unpinned_locked()
             for key, h in self._handles.items():
                 if self.lib.pio_evlog_sync(h) != 0:
                     logging.getLogger(__name__).warning(
@@ -489,21 +534,42 @@ class CppLogEvents(base.Events):
         start_time: Optional[datetime] = None,
         until_time: Optional[datetime] = None,
         default_value: float = 1.0,
+        use_cache: bool = True,
+        seed_cache: bool = True,
+        stats: Optional[dict] = None,
+        shard_sink=None,
     ) -> base.Interactions:
-        """Columnar scan fully in C++ (pio_evlog_scan_interactions): header
-        prefilter, payload field extraction, value resolution, and id
-        interning all happen natively; Python only receives the finished
-        int32/float32 arrays and the two id tables.
+        """Columnar scan, sharded across ``PIO_SCAN_SHARDS`` threads over
+        disjoint entry ranges (ctypes releases the GIL; each shard interns
+        into a private id table, merged deterministically in shard order —
+        the result is byte-identical to a sequential scan for every shard
+        count, including ids and row order).
+
+        Locking: the client lock is held only to snapshot the log's
+        entry/dead counts and pin the handle; the scan runs with the lock
+        RELEASED (the native side holds its own mutex only for a header
+        snapshot — eventlog.cc), so concurrent event writes proceed while
+        a training scan is in flight. The snapshot end bound keeps rows
+        appended mid-scan out of the result, and the snapshot is
+        revalidated (dead count unchanged) before it may seed the
+        projection cache.
 
         Stored-value queries (one event name, a ``value_prop``, no fixed
         override) are served from the training-projection cache when one is
         valid (traincache.py): only the log *tail* appended since the cache
         was written is re-scanned, and the merged result is folded back.
         Everything else — and any shape the fold cannot prove equivalent —
-        takes the full native scan, which then (re)seeds the cache at
-        training scale."""
-        import numpy as np
+        takes the full sharded scan, which then (re)seeds the cache at
+        training scale.
 
+        cpplog-specific extras (the bench and the pipelined ingest path;
+        other backends ignore them): ``use_cache``/``seed_cache`` bypass
+        the projection cache's read/write legs, ``stats`` (a dict) is
+        filled with the scan sub-metrics (shard count, per-shard walls,
+        native-lock-held wall), and ``shard_sink(k, uidx, iidx, vals,
+        times)`` receives each completed shard in shard order — indices
+        already remapped into the global id tables — while later shards
+        are still scanning (ops/sparse.StreamingPrep consumes this)."""
         from incubator_predictionio_tpu.data.storage import traincache
 
         names = [str(n) for n in event_names]
@@ -519,7 +585,9 @@ class CppLogEvents(base.Events):
                 self.client._file(self.ns, app_id, channel_id))
             raw = lib.pio_evlog_entry_count(h)
             dead = lib.pio_evlog_dead_count(h)
-            if servable:
+            pin = self.client.pin(self.ns, app_id, channel_id)
+        try:
+            if servable and use_cache:
                 cache = traincache.load(cpath)
                 if cache is not None and (
                         cache.spec.entity_type == entity_type
@@ -536,28 +604,206 @@ class CppLogEvents(base.Events):
                     if inter is not None:
                         return inter
             unbounded = start_time is None and until_time is None
-            seed_cache = servable and unbounded
-            inter, times = self._scan_native(
-                h, start_time, until_time, entity_type, target_entity_type,
-                names, fixed, value_prop, default_value,
-                with_times=seed_cache)
-            if seed_cache and len(inter) >= traincache.MIN_NNZ and (
-                    len(times) < 2 or not np.any(np.diff(times) < 0)):
-                traincache.write(cpath, traincache.TrainCache(
-                    spec=traincache.Spec(entity_type, target_entity_type,
-                                         names[0], value_prop),
-                    uidx=inter.user_idx, iidx=inter.item_idx,
-                    vals=inter.values, times=times,
-                    user_tab=inter.user_ids, item_tab=inter.item_ids,
-                    raw_count=raw, dead_count=dead))
-        return inter
+            seed = servable and unbounded and seed_cache
+            inter, times = self._scan_sharded(
+                h, raw, start_time, until_time, entity_type,
+                target_entity_type, names, fixed, value_prop,
+                default_value, stats=stats, shard_sink=shard_sink)
+            # times are always non-decreasing here: _merge_shards restores
+            # global time order whenever the log held an inversion
+            if seed and len(inter) >= traincache.MIN_NNZ:
+                self._seed_cache_revalidated(
+                    h, cpath, traincache.TrainCache(
+                        spec=traincache.Spec(
+                            entity_type, target_entity_type,
+                            names[0], value_prop),
+                        uidx=inter.user_idx, iidx=inter.item_idx,
+                        vals=inter.values, times=times,
+                        user_tab=inter.user_ids, item_tab=inter.item_ids,
+                        raw_count=raw, dead_count=dead),
+                    dead)
+            return inter
+        finally:
+            self.client.unpin(pin)
+
+    def _seed_cache_revalidated(self, h, cpath, cache, dead: int) -> None:
+        """Publish a projection cache built from a lock-free scan: the
+        (potentially hundreds-of-MB) file is serialized OUTSIDE the
+        client lock; only the snapshot revalidation + atomic rename run
+        under it. Commits only while the dead count still matches the
+        scan's snapshot — a delete that landed during the scan may have
+        killed rows the result still carries, and a cache seeded from it
+        would serve stale rows later."""
+        from incubator_predictionio_tpu.data.storage import traincache
+
+        staged = traincache.stage(cpath, cache)
+        committed = False
+        try:
+            with self.client.lock:
+                if self.client.lib.pio_evlog_dead_count(h) == dead:
+                    staged.commit()
+                    committed = True
+        finally:
+            if not committed:
+                staged.abort()
+
+    @staticmethod
+    def _resolve_shards(span: int) -> int:
+        """Shard count for a scan over ``span`` entries. PIO_SCAN_SHARDS
+        is read per call (tests and operators override at runtime): an
+        explicit positive value is honored exactly; unset/0 = auto —
+        min(usable cores, 8), with no sharding below
+        _MIN_SCAN_ENTRIES_PER_SHARD entries per shard (thread spawn and
+        merge overhead dwarfs tiny scans)."""
+        import os
+
+        if span <= 1:
+            return 1
+        try:
+            n = int(os.environ.get("PIO_SCAN_SHARDS", "0"))
+        except ValueError:
+            n = 0
+        if n <= 0:
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                cores = os.cpu_count() or 1
+            n = min(max(cores, 1), 8,
+                    max(span // _MIN_SCAN_ENTRIES_PER_SHARD, 1))
+        return max(1, min(n, span))
+
+    def _scan_sharded(self, h, hi_entry, start_time, until_time,
+                      entity_type, target_entity_type, names, fixed,
+                      value_prop, default_value, min_entry_idx: int = 0,
+                      stats: Optional[dict] = None, shard_sink=None):
+        """Fan the native scan out over disjoint entry ranges of
+        [min_entry_idx, hi_entry) → (Interactions, times).
+
+        Each shard scans in ENTRY order with a private id table; shards
+        are merged in shard order (traincache.TableMerger — global
+        first-seen interning), then global time order is restored with
+        one stable sort, which reproduces the sequential scan's
+        (time, append-order) output exactly; already-ordered logs (every
+        bulk import) skip the sort. Caller must hold the client lock or
+        have pinned the handle; the native calls themselves hold the log
+        mutex only for their header snapshots, so shards really run in
+        parallel and writers are never stalled."""
+        import time as _time
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        lo = max(int(min_entry_idx), 0)
+        span = max(int(hi_entry) - lo, 0)
+        shards = self._resolve_shards(span)
+        bounds = [lo + (span * k) // shards for k in range(shards + 1)]
+        bounds[-1] = int(hi_entry)
+        t_all0 = _time.perf_counter()
+
+        def run(k: int):
+            t0 = _time.perf_counter()
+            out = self._scan_native(
+                h, start_time, until_time, entity_type,
+                target_entity_type, names, fixed, value_prop,
+                default_value, min_entry_idx=bounds[k],
+                max_entry_idx=bounds[k + 1], with_times=True,
+                n_threads=1 if shards > 1 else 0)
+            return out, _time.perf_counter() - t0
+
+        if shards == 1:
+            shard_results = [run(0)]
+        else:
+            with ThreadPoolExecutor(max_workers=shards) as pool:
+                futs = [pool.submit(run, k) for k in range(shards)]
+                # in-order merge: shard k's table merge must follow
+                # shards 0..k-1 (first-seen determinism), so results are
+                # consumed in shard order — completed early shards merge
+                # on this thread while later shards are still scanning
+                shard_results = iter(f.result() for f in futs)
+                return self._merge_shards(
+                    shard_results, shards, t_all0, stats, shard_sink)
+        return self._merge_shards(iter(shard_results), shards, t_all0,
+                                  stats, shard_sink)
+
+    def _merge_shards(self, shard_results, shards, t_all0, stats,
+                      shard_sink):
+        import time as _time
+
+        import numpy as np
+
+        from incubator_predictionio_tpu.data.storage import traincache
+
+        umerge, imerge = traincache.TableMerger(), traincache.TableMerger()
+        u_parts, i_parts, v_parts, t_parts = [], [], [], []
+        first_tabs = None
+        walls: list = []
+        merge_wall = 0.0
+        lock_ns = 0
+        k = 0
+        for (s_inter, s_times, s_lock_ns), wall in shard_results:
+            t0 = _time.perf_counter()
+            uremap = umerge.add(s_inter.user_ids)
+            iremap = imerge.add(s_inter.item_ids)
+            uidx, iidx = s_inter.user_idx, s_inter.item_idx
+            if k > 0:  # shard 0's remap is the identity by construction
+                uidx, iidx = uremap[uidx], iremap[iidx]
+            else:
+                first_tabs = (s_inter.user_ids, s_inter.item_ids)
+            u_parts.append(uidx)
+            i_parts.append(iidx)
+            v_parts.append(s_inter.values)
+            t_parts.append(s_times)
+            if shard_sink is not None:
+                shard_sink(k, uidx, iidx, s_inter.values, s_times)
+            merge_wall += _time.perf_counter() - t0
+            walls.append(wall)
+            lock_ns += s_lock_ns
+            k += 1
+        if len(u_parts) == 1:
+            uidx, iidx = u_parts[0], i_parts[0]
+            vals, times = v_parts[0], t_parts[0]
+            utab, itab = first_tabs
+        else:
+            uidx = np.concatenate(u_parts)
+            iidx = np.concatenate(i_parts)
+            vals = np.concatenate(v_parts)
+            times = np.concatenate(t_parts)
+            utab, itab = umerge.table(), imerge.table()
+        reordered = False
+        if len(times) > 1 and np.any(np.diff(times) < 0):
+            order = np.argsort(times, kind="stable")
+            uidx, iidx = uidx[order], iidx[order]
+            vals, times = vals[order], times[order]
+            # first-seen interning must follow the REORDERED row sequence
+            uidx, utab = traincache.first_seen_reindex(uidx, utab)
+            iidx, itab = traincache.first_seen_reindex(iidx, itab)
+            reordered = True
+        if stats is not None:
+            stats.update({
+                "scan_shards": shards,
+                "scan_shard_walls_s": [round(w, 3) for w in walls],
+                "scan_lock_held_s": round(lock_ns / 1e9, 6),
+                "scan_merge_wall_s": round(merge_wall, 3),
+                "scan_wall_s": round(_time.perf_counter() - t_all0, 3),
+                "scan_reordered": reordered,
+                "scan_rows": int(len(vals)),
+            })
+        inter = base.Interactions(
+            user_idx=uidx, item_idx=iidx, values=vals,
+            user_ids=utab, item_ids=itab,
+        )
+        return inter, times
 
     def _scan_native(self, h, start_time, until_time, entity_type,
                      target_entity_type, names, fixed, value_prop,
                      default_value, min_entry_idx: int = 0,
-                     with_times: bool = False):
-        """The raw native scan → (Interactions, times|None). Caller holds
-        the client lock."""
+                     max_entry_idx: int = -1, with_times: bool = False,
+                     n_threads: int = 0):
+        """One native scan call → (Interactions, times|None, lock_ns).
+        Caller must hold the client lock or have pinned the handle (the
+        native call itself locks the log mutex only for its snapshot).
+        ``max_entry_idx >= 0`` bounds the entry range and switches the
+        output to ENTRY order (see eventlog.cc); -1 keeps the historical
+        time order through the end of the log."""
         import numpy as np
 
         lib = self.client.lib
@@ -569,15 +815,16 @@ class CppLogEvents(base.Events):
             h,
             _I64_MIN if start_time is None else to_millis(start_time),
             _I64_MAX if until_time is None else to_millis(until_time),
-            min_entry_idx,
+            min_entry_idx, max_entry_idx,
             entity_type.encode("utf-8"),
             target_entity_type.encode("utf-8"),
             c_names, c_fixed, len(names),
             None if value_prop is None else value_prop.encode("utf-8"),
-            float(default_value),
+            float(default_value), n_threads,
         )
         try:
             nnz = lib.pio_scan_nnz(res)
+            lock_ns = int(lib.pio_scan_lock_held_ns(res))
             uidx = np.empty(nnz, np.int32)
             iidx = np.empty(nnz, np.int32)
             vals = np.empty(nnz, np.float32)
@@ -601,13 +848,15 @@ class CppLogEvents(base.Events):
             user_idx=uidx, item_idx=iidx, values=vals,
             user_ids=user_ids, item_ids=item_ids,
         )
-        return inter, times
+        return inter, times, lock_ns
 
     def _serve_from_cache(self, h, cache, cpath, raw, dead, entity_type,
                           target_entity_type, name, value_prop,
                           start_time, until_time):
         """Tail-scan + merge + time-filter; None → caller full-scans.
-        Caller holds the client lock and has validated the cache."""
+        Caller has validated the cache and PINNED the handle (the client
+        lock is NOT held — the tail scan runs lock-free; the fold write
+        revalidates the snapshot under the lock)."""
         import dataclasses
 
         import numpy as np
@@ -615,11 +864,13 @@ class CppLogEvents(base.Events):
         from incubator_predictionio_tpu.data.storage import traincache
 
         if raw > cache.raw_count:
-            # records appended since the cache was written: scan just them
-            tail, tail_times = self._scan_native(
-                h, None, None, entity_type, target_entity_type, [name], {},
-                value_prop, 1.0, min_entry_idx=cache.raw_count,
-                with_times=True)
+            # records appended since the cache was written: scan just
+            # them — bounded at the snapshot count so rows appended
+            # mid-scan stay in the tail for the next fold
+            tail, tail_times = self._scan_sharded(
+                h, raw, None, None, entity_type, target_entity_type,
+                [name], {}, value_prop, 1.0,
+                min_entry_idx=cache.raw_count)
             if len(tail):
                 if len(cache) and tail_times[0] < cache.times[-1]:
                     return None  # out-of-order tail: merge would reorder
@@ -639,7 +890,7 @@ class CppLogEvents(base.Events):
                     # persist the fold only when the tail is ≥1% of the
                     # cache: smaller tails re-scan in microseconds, while
                     # the rewrite is O(cache) disk traffic per train
-                    traincache.write(cpath, cache)
+                    self._seed_cache_revalidated(h, cpath, cache, dead)
             # empty tail: skip the rewrite — re-checking the tail is a
             # cheap header walk, rewriting the cache is not
         if start_time is None and until_time is None:
@@ -1092,8 +1343,11 @@ class CppLogEvents(base.Events):
         from incubator_predictionio_tpu.data.storage import traincache
 
         with self.client.lock:
-            h = self._handle(app_id, channel_id)
             path = self.client._file(self.ns, app_id, channel_id)
+            # compaction renumbers entries and swaps the handle: wait out
+            # any lock-narrowed scan still reading the old one
+            self.client._wait_unpinned_locked(str(path))
+            h = self._handle(app_id, channel_id)
             bytes_before = path.stat().st_size if path.exists() else 0
             tmp_path = path.with_name(path.name + ".compact")
             live = self.client.lib.pio_evlog_compact_copy(

@@ -127,7 +127,42 @@ def load(path: Path) -> Optional[TrainCache]:
         return None
 
 
-def write(path: Path, cache: TrainCache) -> None:
+class StagedWrite:
+    """A cache file serialized to its temp name but not yet published.
+
+    Splits :func:`write` so the O(cache) disk serialization can run
+    WITHOUT the storage lock (at training scale the file is hundreds of
+    MB — streaming it under the lock would stall every concurrent event
+    write, the exact class the sharded-scan lock-narrowing removed),
+    while the atomic rename — the only part that needs to serialize with
+    other cache writers — runs under the lock after the caller has
+    revalidated its snapshot. Exactly one of commit()/abort() must be
+    called; abort() after commit() is a no-op."""
+
+    __slots__ = ("_tmp", "_path")
+
+    def __init__(self, tmp: Path, path: Path):
+        self._tmp = tmp
+        self._path = path
+
+    def commit(self) -> None:
+        os.replace(self._tmp, self._path)
+
+    def abort(self) -> None:
+        self._tmp.unlink(missing_ok=True)
+
+
+#: staging temp names must be unique per CALL, not just per process:
+#: serialization runs outside the storage lock, so two concurrent scans
+#: seeding the same cache would otherwise truncate/interleave one
+#: shared temp file (itertools.count() is atomic under the GIL)
+_stage_seq = __import__("itertools").count()
+
+
+def stage(path: Path, cache: TrainCache) -> StagedWrite:
+    """Serialize ``cache`` to a call-unique temp file next to ``path``
+    → :class:`StagedWrite` (publish with commit(), discard with
+    abort())."""
     hdr = json.dumps({
         "magic": _MAGIC, "version": _VERSION,
         "spec": cache.spec.to_json(),
@@ -137,7 +172,8 @@ def write(path: Path, cache: TrainCache) -> None:
         "ibytes": len(cache.item_tab.blob),
         "raw_count": cache.raw_count, "dead_count": cache.dead_count,
     }).encode() + b"\n"
-    tmp = path.with_suffix(path.suffix + f".tmp{os.getpid()}")
+    tmp = path.with_suffix(
+        path.suffix + f".tmp{os.getpid()}.{next(_stage_seq)}")
     try:
         with open(tmp, "wb") as f:
             f.write(hdr)
@@ -149,9 +185,14 @@ def write(path: Path, cache: TrainCache) -> None:
             np.ascontiguousarray(cache.user_tab.offsets, np.int64).tofile(f)
             f.write(cache.item_tab.blob)
             np.ascontiguousarray(cache.item_tab.offsets, np.int64).tofile(f)
-        os.replace(tmp, path)
-    finally:
+    except BaseException:
         tmp.unlink(missing_ok=True)
+        raise
+    return StagedWrite(tmp, path)
+
+
+def write(path: Path, cache: TrainCache) -> None:
+    stage(path, cache).commit()
 
 
 def invalidate(log_path: str | Path) -> None:
@@ -195,6 +236,43 @@ def merge_tables(base: IdTable, new: IdTable) -> Tuple[IdTable, np.ndarray]:
     np.cumsum([len(b) for b in added], out=offs[len(base) + 1:])
     offs[len(base) + 1:] += base.offsets[-1]
     return IdTable(bytes(base.blob) + b"".join(added), offs), remap
+
+
+class TableMerger:
+    """Incrementally merge per-shard id tables into one global table.
+
+    The sharded scan (cpplog.py) interns ids per shard; merging the shard
+    tables in shard order — appending each shard's unseen ids in its own
+    first-seen order — reproduces exactly the table a sequential scan of
+    the concatenated row sequence would intern. Unlike repeated
+    :func:`merge_tables` calls, the lookup dict persists across shards,
+    so an S-shard merge is O(total ids), not O(S × total ids)."""
+
+    __slots__ = ("_index", "_ids")
+
+    def __init__(self) -> None:
+        self._index: dict = {}
+        self._ids: list = []
+
+    def add(self, tab: IdTable) -> np.ndarray:
+        """Merge one shard table; returns ``remap`` with ``remap[j]`` the
+        global index of the shard's id j."""
+        remap = np.empty(len(tab), np.int32)
+        index, ids = self._index, self._ids
+        for j, b in enumerate(table_bytes(tab)):
+            k = index.get(b)
+            if k is None:
+                k = len(ids)
+                index[b] = k
+                ids.append(b)
+            remap[j] = k
+        return remap
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def table(self) -> IdTable:
+        return _build_table(self._ids)
 
 
 def first_seen_reindex(

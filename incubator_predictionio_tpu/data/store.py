@@ -113,11 +113,18 @@ class EventStore:
         start_time: Optional[datetime] = None,
         until_time: Optional[datetime] = None,
         default_value: float = 1.0,
+        **backend_extras: Any,
     ):
         """Columnar training ingest (base.Events.scan_interactions): the
         TPU-native replacement for the reference's RDD event read
         (PEventStore.find → newAPIHadoopRDD) — streams matching events into
-        pre-indexed COO arrays + id tables without per-event objects."""
+        pre-indexed COO arrays + id tables without per-event objects.
+
+        ``backend_extras`` forwards backend-specific keywords (the cpplog
+        backend accepts ``stats``/``shard_sink``/``use_cache``/
+        ``seed_cache`` for the sharded-scan sub-metrics and the pipelined
+        scan→prep path); passing one to a backend that lacks it raises
+        TypeError — callers opting in know their backend."""
         app_id, channel_id = _resolve(app_name, channel_name)
         return Storage.get_events().scan_interactions(
             app_id=app_id,
@@ -130,6 +137,7 @@ class EventStore:
             start_time=start_time,
             until_time=until_time,
             default_value=default_value,
+            **backend_extras,
         )
 
     @staticmethod

@@ -110,15 +110,18 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pio_evlog_entry_count.argtypes = [c.c_void_p]
     lib.pio_evlog_dead_count.restype = c.c_int64
     lib.pio_evlog_dead_count.argtypes = [c.c_void_p]
-    # columnar interaction scan
+    # columnar interaction scan ([min, max) entry range + thread count; the
+    # mutex is held only for the header snapshot — see eventlog.cc)
     lib.pio_evlog_scan_interactions.restype = c.c_void_p
     lib.pio_evlog_scan_interactions.argtypes = [
-        c.c_void_p, c.c_int64, c.c_int64, c.c_int64, c.c_char_p, c.c_char_p,
-        c.POINTER(c.c_char_p), c.POINTER(c.c_double), c.c_int32,
-        c.c_char_p, c.c_double,
+        c.c_void_p, c.c_int64, c.c_int64, c.c_int64, c.c_int64, c.c_char_p,
+        c.c_char_p, c.POINTER(c.c_char_p), c.POINTER(c.c_double), c.c_int32,
+        c.c_char_p, c.c_double, c.c_int32,
     ]
     lib.pio_scan_nnz.restype = c.c_int64
     lib.pio_scan_nnz.argtypes = [c.c_void_p]
+    lib.pio_scan_lock_held_ns.restype = c.c_int64
+    lib.pio_scan_lock_held_ns.argtypes = [c.c_void_p]
     lib.pio_scan_n_ids.restype = c.c_int64
     lib.pio_scan_n_ids.argtypes = [c.c_void_p, c.c_int32]
     lib.pio_scan_ids_bytes.restype = c.c_int64
@@ -159,7 +162,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pio_csr_fill.restype = c.c_int64
     lib.pio_csr_fill.argtypes = [
         c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_float),
-        c.c_int64, c.c_int64, c.c_int32, c.c_int32, c.c_int32,
+        c.c_int64, c.c_int64, c.c_int32, c.c_int32, c.c_int32, i64p,
         pp_i32, pp_i32, pp_f32, pp_f32,
     ]
     # uniform-batch JSON parser (REST ingest hot path)

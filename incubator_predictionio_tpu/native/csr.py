@@ -25,6 +25,27 @@ def native_available() -> bool:
     return native.load() is not None
 
 
+def bucket_counts_from_degrees(
+    degrees: np.ndarray, min_width: int, max_width: int, n_buckets: int
+) -> np.ndarray:
+    """Per-bucket segment counts from a per-row degree histogram — the
+    same numbers ``pio_csr_plan`` derives from one O(nnz) pass over the
+    rows array, computed instead from degrees alone (O(n_rows),
+    vectorized). The pipelined ingest path accumulates the degree
+    histogram per scan shard WHILE the scan is still running, so the
+    plan pass is already paid when prep starts."""
+    d = np.asarray(degrees, np.int64)
+    counts = np.zeros(n_buckets, np.int64)
+    # rows longer than max_width split into full-width segments + a tail
+    counts[n_buckets - 1] += int((d // max_width).sum())
+    rem = d % max_width
+    rem = rem[rem > 0]
+    widths = np.int64(min_width) << np.arange(n_buckets, dtype=np.int64)
+    counts += np.bincount(
+        np.searchsorted(widths, rem, side="left"), minlength=n_buckets)
+    return counts
+
+
 def build_buckets_native(
     rows: np.ndarray,
     cols: np.ndarray,
@@ -32,9 +53,17 @@ def build_buckets_native(
     n_rows: int,
     min_width: int,
     max_width: int,
+    degrees: Optional[np.ndarray] = None,
 ) -> Optional[List[Tuple[int, np.ndarray, np.ndarray, np.ndarray, np.ndarray]]]:
     """Returns [(width, row_ids, cols, vals, mask)] per non-empty bucket,
-    width-ascending, or None when the native library is unavailable."""
+    width-ascending, or None when the native library is unavailable.
+
+    ``degrees`` (optional, int64[n_rows] with ``degrees.sum() == nnz``):
+    a precomputed per-row nnz histogram replacing the native plan pass.
+    The fill is safe against a wrong histogram: the native fill bound-
+    checks every bucket and reports the segment total, and any mismatch
+    falls back to the exact plan — worst case is one wasted allocation,
+    never corrupt buckets."""
     lib = native.load()
     if lib is None:
         return None
@@ -54,13 +83,27 @@ def build_buckets_native(
     n_buckets = 1
     while (min_width << (n_buckets - 1)) < max_width:
         n_buckets += 1
-    counts = np.zeros(n_buckets, np.int64)
-    rc = lib.pio_csr_plan(
-        _as_ptr(rows32, ctypes.c_int32), nnz, n_rows,
-        min_width, max_width, n_buckets, _as_ptr(counts, ctypes.c_int64),
-    )
-    if rc != 0:
-        raise ValueError("csr plan failed (row index out of range?)")
+
+    def exact_counts() -> np.ndarray:
+        counts = np.zeros(n_buckets, np.int64)
+        rc = lib.pio_csr_plan(
+            _as_ptr(rows32, ctypes.c_int32), nnz, n_rows,
+            min_width, max_width, n_buckets, _as_ptr(counts, ctypes.c_int64),
+        )
+        if rc != 0:
+            raise ValueError("csr plan failed (row index out of range?)")
+        return counts
+
+    counts = None
+    if degrees is not None:
+        d = np.asarray(degrees, np.int64)
+        if d.shape == (n_rows,) and (
+                len(d) == 0 or int(d.min()) >= 0) and int(d.sum()) == nnz:
+            counts = bucket_counts_from_degrees(
+                d, min_width, max_width, n_buckets)
+    from_degrees = counts is not None
+    if counts is None:
+        counts = exact_counts()
 
     row_ids = [np.zeros(int(c), np.int32) for c in counts]
     out_cols = [np.zeros((int(c), min_width << b), np.int32)
@@ -79,11 +122,17 @@ def build_buckets_native(
     rc = lib.pio_csr_fill(
         _as_ptr(rows32, ctypes.c_int32), _as_ptr(cols32, ctypes.c_int32),
         _as_ptr(vals32, ctypes.c_float), nnz, n_rows,
-        min_width, max_width, n_buckets,
+        min_width, max_width, n_buckets, _as_ptr(counts, ctypes.c_int64),
         ptr_array(row_ids, ctypes.c_int32), ptr_array(out_cols, ctypes.c_int32),
         ptr_array(out_vals, ctypes.c_float), ptr_array(out_mask, ctypes.c_float),
     )
-    if rc != 0:
+    if rc != int(counts.sum()):
+        # a degree-derived plan disagreed with the data (under-allocation
+        # is rejected natively, over-allocation shows as a segment-count
+        # shortfall): redo with the exact plan — never serve junk rows
+        if from_degrees:
+            return build_buckets_native(
+                rows32, cols32, vals32, n_rows, min_width, max_width)
         raise ValueError("csr fill failed")
     return [
         (min_width << b, row_ids[b], out_cols[b], out_vals[b], out_mask[b])

@@ -83,23 +83,34 @@ int64_t pio_csr_plan(const int32_t* rows, int64_t nnz, int64_t n_rows,
 
 // Fills per-bucket arrays. For bucket b (width w = min_width << b) the
 // caller passes row_ids[b] (int32[count_b]), out_cols[b]/out_vals[b]/
-// out_mask[b] (count_b × w, zero-initialized). Returns 0, or -1 on bad
-// input.
+// out_mask[b] (count_b × w, zero-initialized), and bucket_counts[b] (the
+// allocation sizes, normally from pio_csr_plan). Returns the total number
+// of segments written, or -1 on bad input — including any bucket whose
+// allocation would overflow, so a caller-precomputed plan (the pipelined
+// ingest path derives bucket counts from per-shard degree histograms
+// accumulated DURING the scan) can never corrupt memory: a mismatch is
+// rejected, never written past the allocation. Callers must also check
+// the returned segment total against their plan — an over-allocated plan
+// fills fewer segments than allocated and the tail rows would be junk.
 int64_t pio_csr_fill(const int32_t* rows, const int32_t* cols,
                      const float* vals, int64_t nnz, int64_t n_rows,
                      int32_t min_width, int32_t max_width, int32_t n_buckets,
+                     const int64_t* bucket_counts,
                      int32_t* const* out_row_ids, int32_t* const* out_cols,
                      float* const* out_vals, float* const* out_mask) {
   Plan p;
   if (build_plan(rows, nnz, n_rows, &p) != 0) return -1;
   std::vector<int64_t> cursor(n_buckets, 0);
+  int64_t segments = 0;
   for (int64_t r = 0; r < n_rows; ++r) {
     int64_t off = 0, cnt = p.counts[r];
     while (cnt - off > 0) {
       int64_t seg = std::min<int64_t>(cnt - off, max_width);
       int b = bucket_of(seg, min_width, n_buckets);
       int64_t width = (int64_t)min_width << b;
+      if (bucket_counts && cursor[b] >= bucket_counts[b]) return -1;
       int64_t slot = cursor[b]++;
+      ++segments;
       out_row_ids[b][slot] = (int32_t)r;
       int32_t* oc = out_cols[b] + slot * width;
       float* ov = out_vals[b] + slot * width;
@@ -113,7 +124,7 @@ int64_t pio_csr_fill(const int32_t* rows, const int32_t* cols,
       off += seg;
     }
   }
-  return 0;
+  return segments;
 }
 
 }  // extern "C"

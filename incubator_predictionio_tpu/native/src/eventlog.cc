@@ -562,6 +562,7 @@ struct ScanResult {
   std::vector<int64_t> times;        // per-row event time (projection cache)
   std::string ubuf, ibuf;            // concatenated utf-8 id bytes
   std::vector<int64_t> uoff, ioff;   // n_ids + 1 offsets into the buffers
+  int64_t lock_ns = 0;               // wall spent holding the log mutex
 };
 
 // ---- single-pass payload field extraction (span-based, zero-copy) --------
@@ -833,7 +834,6 @@ struct LocalScan {
 
 struct ScanFilters {
   int64_t start_ms, until_ms;
-  int64_t min_entry_idx;  // skip entries below this index (tail scans)
   std::string_view entity_type, target_entity_type, value_prop;
   const std::vector<std::string>* names;
   std::vector<uint64_t> name_hs;
@@ -841,6 +841,19 @@ struct ScanFilters {
   bool have_prop;
   double default_value;
   uint64_t etype_h;
+};
+
+// One header-prefiltered entry, copied out of the in-memory index while the
+// log mutex is held. The expensive payload work (mmap reads, sidecar/JSON
+// parsing, interning) runs on these snapshots OUTSIDE the mutex, so
+// concurrent appends — which may reallocate the entries vector — are never
+// stalled by a scan and never race a reader.
+struct SnapEntry {
+  int64_t time_ms;
+  uint64_t offset;
+  uint32_t payload_len;
+  uint16_t flags;
+  uint16_t slot;  // matched name-hash slot (exact-checked during the scan)
 };
 
 // A span as an interning key: a view into the mmap when unescaped, else a
@@ -860,22 +873,15 @@ static bool span_view(std::string_view payload, const Span& v,
   return true;
 }
 
-static void scan_range(const char* base, const EventLog* log,
-                       int64_t lo, int64_t hi, const ScanFilters& flt,
-                       LocalScan* out) {
+static void scan_snap(const char* base, const std::vector<SnapEntry>& snap,
+                      int64_t lo, int64_t hi, const ScanFilters& flt,
+                      LocalScan* out) {
   std::string scratch;
   std::string_view uid, iid;
   const int32_t n_names = (int32_t)flt.names->size();
   for (int64_t k = lo; k < hi; ++k) {
-    if (log->sorted[k] < flt.min_entry_idx) continue;
-    const Entry& e = log->entries[log->sorted[k]];
-    if (e.dead) continue;
-    if (e.time_ms < flt.start_ms || e.time_ms >= flt.until_ms) continue;
-    if (e.etype_hash != flt.etype_h) continue;
-    int32_t slot = -1;
-    for (int32_t i = 0; i < n_names; ++i)
-      if (e.name_hash == flt.name_hs[i]) { slot = i; break; }
-    if (slot < 0) continue;
+    const SnapEntry& e = snap[k];
+    int32_t slot = (int32_t)e.slot;
     double v;
     if (e.flags & kSidecar) {
       // fast path: all fields binary, no JSON touched
@@ -944,32 +950,49 @@ static void scan_range(const char* base, const EventLog* log,
   }
 }
 
-// Runs the scan under the log mutex. `names`/`fixed_vals` are parallel:
-// fixed_vals[i] = NaN means "resolve via value_prop / default_value".
-// value_prop may be null (every non-fixed event gets default_value).
-// The file is mmapped and partitioned across threads; per-thread id tables
-// are merged in partition order so the global table keeps first-seen order.
+// Columnar scan. `names`/`fixed_vals` are parallel: fixed_vals[i] = NaN
+// means "resolve via value_prop / default_value". value_prop may be null
+// (every non-fixed event gets default_value).
+//
+// Locking: the log mutex is held ONLY for the snapshot — fflush, a header
+// prefilter pass over the in-memory index (copying the matching entries'
+// 24-byte headers out), and the mmap of the flushed extent. The payload
+// scan itself runs lock-free on the snapshot + mmap, so concurrent
+// appends proceed while a training scan is in flight. The time the mutex
+// was held is reported via pio_scan_lock_held_ns.
+//
+// Entry range: [min_entry_idx, max_entry_idx) in raw entry indices;
+// max_entry_idx < 0 means "through the end". A NEGATIVE max_entry_idx
+// keeps the historical output order (time-ascending, ties in append
+// order, via the sorted index). A bounded range emits rows in ENTRY
+// order instead and never builds/resorts the time index — the sharded
+// Python caller (data/storage/cpplog.py) restores global time order with
+// one stable sort across shards, which reproduces the sequential order
+// exactly (stable sort by time over entry order == the sorted index).
+//
+// n_threads: internal scan threads; <= 0 = auto (one per kMinPerThread
+// candidates up to the hardware limit). Sharded Python callers pass 1 so
+// parallelism is owned by exactly one layer. Per-thread id tables are
+// merged in partition order so the global table keeps first-seen order.
 void* pio_evlog_scan_interactions(
     void* handle, int64_t start_ms, int64_t until_ms, int64_t min_entry_idx,
-    const char* entity_type, const char* target_entity_type,
-    const char** names, const double* fixed_vals, int32_t n_names,
-    const char* value_prop, double default_value) {
+    int64_t max_entry_idx, const char* entity_type,
+    const char* target_entity_type, const char** names,
+    const double* fixed_vals, int32_t n_names, const char* value_prop,
+    double default_value, int32_t n_threads) {
   auto* log = (EventLog*)handle;
   auto* res = new ScanResult();
-  if (n_names <= 0) {  // empty name list matches nothing (find() contract)
+  // empty name list matches nothing (find() contract); slot is a u16
+  if (n_names <= 0 || n_names > 0xFFFF) {
     res->uoff.push_back(0);
     res->ioff.push_back(0);
     return res;
   }
-  std::lock_guard<std::mutex> g(log->mu);
-  resort(log);
-  fflush(log->f);
 
   std::vector<std::string> name_strs(names, names + n_names);
   ScanFilters flt;
   flt.start_ms = start_ms;
   flt.until_ms = until_ms;
-  flt.min_entry_idx = min_entry_idx;
   flt.entity_type = entity_type;
   flt.target_entity_type = target_entity_type;
   flt.value_prop = value_prop ? std::string_view(value_prop)
@@ -981,31 +1004,67 @@ void* pio_evlog_scan_interactions(
   flt.default_value = default_value;
   flt.etype_h = fnv1a64(entity_type, strlen(entity_type));
 
-  // mmap the flushed extent; fall back to a heap read if mmap fails
-  struct stat st;
-  const int fd = fileno(log->f);
+  std::vector<SnapEntry> snap;
   char* base = nullptr;
   size_t map_len = 0;
   std::string heap;
-  if (fstat(fd, &st) == 0 && st.st_size > 0) {
-    map_len = (size_t)st.st_size;
-    void* m = mmap(nullptr, map_len, PROT_READ, MAP_SHARED, fd, 0);
-    if (m != MAP_FAILED) {
-      base = (char*)m;
+  struct timespec lt0, lt1;
+  {
+    std::lock_guard<std::mutex> g(log->mu);
+    // clock starts AFTER acquisition: lock_ns reports time HELD (what a
+    // concurrent writer pays per scan), not time spent queueing behind
+    // sibling shards' snapshots
+    clock_gettime(CLOCK_MONOTONIC, &lt0);
+    fflush(log->f);
+    const int64_t n_entries = (int64_t)log->entries.size();
+    const int64_t lo = std::max<int64_t>(min_entry_idx, 0);
+    const int64_t hi = max_entry_idx < 0
+                           ? n_entries
+                           : std::min(max_entry_idx, n_entries);
+    auto prefilter = [&](int64_t idx) {
+      const Entry& e = log->entries[idx];
+      if (e.dead) return;
+      if (e.time_ms < flt.start_ms || e.time_ms >= flt.until_ms) return;
+      if (e.etype_hash != flt.etype_h) return;
+      int32_t slot = -1;
+      for (int32_t i = 0; i < n_names; ++i)
+        if (e.name_hash == flt.name_hs[i]) { slot = i; break; }
+      if (slot < 0) return;
+      snap.push_back({e.time_ms, e.offset, e.payload_len, (uint16_t)e.flags,
+                      (uint16_t)slot});
+    };
+    if (max_entry_idx >= 0) {
+      for (int64_t idx = lo; idx < hi; ++idx) prefilter(idx);
     } else {
-      heap.resize(map_len);
-      fseeko(log->f, 0, SEEK_SET);
-      if (fread(&heap[0], 1, map_len, log->f) != map_len) {
-        fseeko(log->f, 0, SEEK_END);
-        res->uoff.push_back(0);
-        res->ioff.push_back(0);
-        return res;
-      }
-      fseeko(log->f, 0, SEEK_END);
-      base = &heap[0];
+      resort(log);
+      for (int64_t k = 0; k < (int64_t)log->sorted.size(); ++k)
+        if (log->sorted[k] >= lo) prefilter(log->sorted[k]);
     }
+    // mmap the flushed extent (it covers every snapshotted payload — all
+    // were flushed before the snapshot); heap fallback if mmap fails
+    struct stat st;
+    const int fd = fileno(log->f);
+    if (!snap.empty() && fstat(fd, &st) == 0 && st.st_size > 0) {
+      map_len = (size_t)st.st_size;
+      void* m = mmap(nullptr, map_len, PROT_READ, MAP_SHARED, fd, 0);
+      if (m != MAP_FAILED) {
+        base = (char*)m;
+      } else {
+        heap.resize(map_len);
+        fseeko(log->f, 0, SEEK_SET);
+        if (fread(&heap[0], 1, map_len, log->f) != map_len)
+          snap.clear();
+        else
+          base = &heap[0];
+        fseeko(log->f, 0, SEEK_END);
+      }
+    }
+    clock_gettime(CLOCK_MONOTONIC, &lt1);
   }
-  const int64_t total = (int64_t)log->sorted.size();
+  res->lock_ns = (lt1.tv_sec - lt0.tv_sec) * 1000000000LL +
+                 (lt1.tv_nsec - lt0.tv_nsec);
+
+  const int64_t total = (int64_t)snap.size();
   if (base == nullptr || total == 0) {
     res->uoff.push_back(0);
     res->ioff.push_back(0);
@@ -1013,22 +1072,25 @@ void* pio_evlog_scan_interactions(
     return res;
   }
 
-  constexpr int64_t kMinPerThread = 200000;
-  int hw = (int)std::thread::hardware_concurrency();
-  int n_threads = (int)std::min<int64_t>(
-      std::max(hw, 1), std::max<int64_t>(1, total / kMinPerThread));
-  n_threads = std::min(n_threads, 16);
+  int nt = n_threads;
+  if (nt <= 0) {
+    constexpr int64_t kMinPerThread = 200000;
+    int hw = (int)std::thread::hardware_concurrency();
+    nt = (int)std::min<int64_t>(
+        std::max(hw, 1), std::max<int64_t>(1, total / kMinPerThread));
+  }
+  nt = std::max(1, std::min(nt, 16));
 
-  std::vector<LocalScan> locals(n_threads);
-  if (n_threads == 1) {
-    scan_range(base, log, 0, total, flt, &locals[0]);
+  std::vector<LocalScan> locals(nt);
+  if (nt == 1) {
+    scan_snap(base, snap, 0, total, flt, &locals[0]);
   } else {
     std::vector<std::thread> pool;
-    const int64_t step = (total + n_threads - 1) / n_threads;
-    for (int t = 0; t < n_threads; ++t) {
+    const int64_t step = (total + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
       int64_t lo = t * step, hi = std::min<int64_t>(total, lo + step);
-      pool.emplace_back(scan_range, base, log, lo, hi, std::cref(flt),
-                        &locals[t]);
+      pool.emplace_back(scan_snap, base, std::cref(snap), lo, hi,
+                        std::cref(flt), &locals[t]);
     }
     for (auto& th : pool) th.join();
   }
@@ -1659,6 +1721,10 @@ int64_t pio_evlog_compact_copy(void* handle, const char* dst_path) {
 }
 
 int64_t pio_scan_nnz(void* r) { return (int64_t)((ScanResult*)r)->uidx.size(); }
+
+// Nanoseconds the scan held the log mutex (snapshot + mmap only) — the
+// bench's lock-held-wall sub-metric; the payload scan runs lock-free.
+int64_t pio_scan_lock_held_ns(void* r) { return ((ScanResult*)r)->lock_ns; }
 
 int64_t pio_scan_n_ids(void* r, int32_t which) {
   auto* res = (ScanResult*)r;

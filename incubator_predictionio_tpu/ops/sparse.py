@@ -148,6 +148,7 @@ def build_padded_rows(
     max_width: int = 4096,
     row_multiple: int = 8,
     impl: str = "auto",
+    degrees: "np.ndarray | None" = None,
 ) -> List[PaddedRows]:
     """COO triplets → degree-bucketed :class:`PaddedRows`.
 
@@ -162,6 +163,12 @@ def build_padded_rows(
     ``impl``: "auto" uses the native C++ builder (native/src/csr_builder.cc)
     for large inputs, "native"/"numpy" force a path. Both produce identical
     buckets.
+
+    ``degrees``: optional precomputed per-row nnz histogram
+    (int64[n_rows], sum == nnz) replacing the native plan pass — the
+    pipelined ingest path accumulates it per scan shard while the scan is
+    still running (see :class:`StreamingPrep`). A wrong histogram is
+    detected natively and falls back to the exact plan.
     """
     if impl not in ("auto", "native", "numpy"):
         raise ValueError(f"unknown impl {impl!r}")
@@ -169,7 +176,7 @@ def build_padded_rows(
         from incubator_predictionio_tpu.native.csr import build_buckets_native
         buckets = build_buckets_native(
             np.asarray(rows), np.asarray(cols), np.asarray(vals), n_rows,
-            min_width, max_width)
+            min_width, max_width, degrees=degrees)
         if buckets is not None:
             return [
                 PaddedRows(row_ids=r, cols=c, vals=v, mask=m)
@@ -235,6 +242,9 @@ def build_both_sides(
     max_width: int = 4096,
     row_multiple: int = 8,
     split_row_multiple: int = 8,
+    user_degrees: "np.ndarray | None" = None,
+    item_degrees: "np.ndarray | None" = None,
+    on_side=None,
 ):
     """Both training orientations (user-major and item-major) built
     concurrently → ((user_light, user_heavy), (item_light, item_heavy)).
@@ -242,16 +252,95 @@ def build_both_sides(
     The two sides are independent and the native builder's ctypes calls
     release the GIL, so a two-thread pool halves the prep wall on hosts
     with ≥2 usable cores (pinned single-core containers degrade to the
-    sequential cost — thread spawn is noise at this scale)."""
+    sequential cost — thread spawn is noise at this scale).
+
+    ``user_degrees``/``item_degrees``: optional precomputed per-row
+    histograms (see :func:`build_padded_rows`). ``on_side(side, light,
+    heavy)`` — side in {"user", "item"} — fires from the worker thread
+    the moment that side finishes, so a consumer can start the H2D
+    transfer of one side's buckets while the other side is still
+    padding (bench.py's pipelined prep→device path)."""
     from concurrent.futures import ThreadPoolExecutor
 
-    def side(rows, cols, n_rows):
-        return split_heavy(
+    def side(name, rows, cols, n_rows, degrees):
+        out = split_heavy(
             build_padded_rows(rows, cols, vals, n_rows, max_width=max_width,
-                              row_multiple=row_multiple),
+                              row_multiple=row_multiple, degrees=degrees),
             row_multiple=split_row_multiple)
+        if on_side is not None:
+            on_side(name, out[0], out[1])
+        return out
 
     with ThreadPoolExecutor(max_workers=2) as pool:
-        fu = pool.submit(side, users, items, n_users)
-        fi = pool.submit(side, items, users, n_items)
+        fu = pool.submit(side, "user", users, items, n_users, user_degrees)
+        fi = pool.submit(side, "item", items, users, n_items, item_degrees)
         return fu.result(), fi.result()
+
+
+class StreamingPrep:
+    """Scan→prep pipeline sink: consume scan shards as they land.
+
+    The sharded event-log scan (data/storage/cpplog.py ``shard_sink``)
+    hands over each completed shard — indices already remapped into the
+    global id tables — while later shards are still scanning with the GIL
+    released. This sink does the prep work that is per-shard computable
+    up front: the per-side degree histograms that replace the native csr
+    *plan* pass (:func:`build_padded_rows` ``degrees``). ``overlap_s``
+    records how much prep wall was absorbed into the scan.
+
+    ``finish(inter)`` then runs :func:`build_both_sides` on the final
+    arrays. Histograms are only used when the scan did NOT have to
+    reorder rows (``scan_reordered`` in the scan stats): a reorder
+    re-interns ids, so the accumulated histograms index a permuted table
+    and are discarded (degrees are recomputed natively — correctness
+    never depends on the pipeline)."""
+
+    def __init__(self) -> None:
+        self.user_degrees = np.zeros(0, np.int64)
+        self.item_degrees = np.zeros(0, np.int64)
+        self.overlap_s = 0.0
+        self.shards = 0
+
+    def _accumulate(self, hist: np.ndarray, idx: np.ndarray) -> np.ndarray:
+        add = np.bincount(idx, minlength=len(hist)).astype(np.int64)
+        if len(add) > len(hist):
+            add[:len(hist)] += hist
+            return add
+        hist += add
+        return hist
+
+    def add_shard(self, k: int, uidx, iidx, vals, times=None) -> None:
+        import time
+
+        t0 = time.perf_counter()
+        self.user_degrees = self._accumulate(self.user_degrees, uidx)
+        self.item_degrees = self._accumulate(self.item_degrees, iidx)
+        self.shards += 1
+        self.overlap_s += time.perf_counter() - t0
+
+    def finish(
+        self,
+        inter,
+        max_width: int = 4096,
+        row_multiple: int = 8,
+        split_row_multiple: int = 8,
+        reordered: bool = False,
+        on_side=None,
+    ):
+        """→ same ((user_light, user_heavy), (item_light, item_heavy))
+        tuple as :func:`build_both_sides`, fed the pre-accumulated degree
+        histograms when they are still valid for ``inter``."""
+        n_users, n_items = len(inter.user_ids), len(inter.item_ids)
+        ud = id_ = None
+        if not reordered and self.shards:
+            mu = min(n_users, len(self.user_degrees))
+            ud = np.zeros(n_users, np.int64)
+            ud[:mu] = self.user_degrees[:mu]
+            mi = min(n_items, len(self.item_degrees))
+            id_ = np.zeros(n_items, np.int64)
+            id_[:mi] = self.item_degrees[:mi]
+        return build_both_sides(
+            inter.user_idx, inter.item_idx, inter.values, n_users, n_items,
+            max_width=max_width, row_multiple=row_multiple,
+            split_row_multiple=split_row_multiple,
+            user_degrees=ud, item_degrees=id_, on_side=on_side)

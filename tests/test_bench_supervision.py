@@ -73,3 +73,75 @@ def test_orphaned_child_exits_without_claiming(tmp_path):
     assert "orphaned waiter" in childlog.read_text()
     assert not claim.exists()
     assert not out_path.exists()
+
+
+class _FakeProc:
+    """A Popen stand-in that never claims and never exits on its own —
+    the wedged-lease dial waiter, minus the 870 s of waiting."""
+
+    def __init__(self, *a, **kw):
+        self.terminated = False
+
+    def poll(self):
+        return 0 if self.terminated else None
+
+    def terminate(self):
+        self.terminated = True
+
+    def wait(self, timeout=None):
+        return 0 if self.terminated else None
+
+
+def test_supervisor_deadline_caps_claim_wait(tmp_path, monkeypatch):
+    """The BENCH_r05 fix: the cumulative claim wait must respect the
+    global deadline — the supervisor returns (terminating the unclaimed
+    waiter) instead of recycling past it, so the orchestrator can emit
+    its degraded record before the driver's kill."""
+    import bench
+
+    spawned = []
+
+    def fake_popen(*a, **kw):
+        spawned.append(_FakeProc())
+        return spawned[-1]
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    t0 = time.monotonic()
+    ok = bench.supervise_tpu_child(
+        str(tmp_path / "store"), str(tmp_path / "frag.json"),
+        deadline_mono=time.monotonic() + 4.0)
+    elapsed = time.monotonic() - t0
+    assert ok is False
+    assert elapsed < 60, elapsed  # returned at the deadline, not 180 s+
+    assert spawned and spawned[-1].terminated  # waiter stopped (safe)
+
+
+def test_supervisor_deadline_leaves_claimed_child_running(tmp_path,
+                                                          monkeypatch):
+    """Past the deadline with a CLAIMED child mid-run, the supervisor
+    must return without terminating it — a chip holder is never cut
+    down — and report whatever fragment exists."""
+    import bench
+
+    out_path = str(tmp_path / "frag.json")
+    procs = []
+
+    class _ClaimingProc(_FakeProc):
+        def poll(self):
+            # claim file appears on the first poll, as if the dial landed
+            with open(f"{out_path}.claim1", "w") as f:
+                f.write("1")
+            return super().poll()
+
+    def fake_popen(*a, **kw):
+        procs.append(_ClaimingProc())
+        return procs[-1]
+
+    monkeypatch.setattr(bench.subprocess, "Popen", fake_popen)
+    t0 = time.monotonic()
+    ok = bench.supervise_tpu_child(
+        str(tmp_path / "store"), out_path,
+        deadline_mono=time.monotonic() + 3.0)
+    assert time.monotonic() - t0 < 60
+    assert ok is False  # no fragment landed
+    assert procs and not procs[-1].terminated  # holder left running

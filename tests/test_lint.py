@@ -162,6 +162,34 @@ def timed_step(x):
     return step(x), time.time() - t0
 """,
     ),
+    "lock-native-scan": (
+        """
+class Events:
+    def scan(self, h):
+        with self.client.lock:
+            raw = self.count(h)
+            inter, times = self._scan_native(h, raw)
+        return inter
+""",
+        """
+class Events:
+    def scan(self, h):
+        with self.client.lock:
+            raw = self.count(h)
+            pin = self.client.pin(h)
+        try:
+            inter, times = self._scan_native(h, raw)
+        finally:
+            self.client.unpin(pin)
+        return inter
+
+    def helper(self, h):
+        with self.client.lock:
+            def deferred():
+                return self._scan_native(h, 0)
+            return deferred
+""",
+    ),
     "server-state": (
         """
 class Handler:

@@ -501,6 +501,52 @@ def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
     }
 
 
+#: registry cross-check keys (docs/observability.md): the telemetry
+#: layer and the bench time THE SAME stages, so their numbers must
+#: corroborate — obs_ingest_events_total vs the seeded HTTP load,
+#: obs_query_p50_ms vs serve_p50_ms, compile-cache hits vs the
+#: warm-cache compile probe. A divergence means one of them lies.
+OBS_KEYS = (
+    "obs_ingest_events_total", "obs_ingest_batches",
+    "obs_http_requests_total", "obs_query_latency_count",
+    "obs_query_latency_sum_s", "obs_query_p50_ms", "obs_query_p99_ms",
+    "obs_compile_cache_hits", "obs_compile_cache_requests",
+)
+
+
+def obs_snapshot() -> dict:
+    """Snapshot the process-wide metrics registry into obs_* bench
+    sub-metrics. Keys for stages THIS process never ran stay None
+    (a metric that exists but never booked is indistinguishable from a
+    mis-wired one — the count guards keep the cross-check honest)."""
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.REGISTRY
+    out = dict.fromkeys(OBS_KEYS)
+    ingest = reg.get("pio_ingest_events_total")
+    if ingest is not None and ingest.total():
+        out["obs_ingest_events_total"] = int(ingest.total())
+    batches = reg.get("pio_ingest_batch_size")
+    if batches is not None and batches.count:
+        out["obs_ingest_batches"] = int(batches.count)
+    http = reg.get("pio_http_requests_total")
+    if http is not None and http.total():
+        out["obs_http_requests_total"] = int(http.total())
+    qlat = reg.get("pio_query_latency_seconds")
+    if qlat is not None and qlat.count:
+        out["obs_query_latency_count"] = int(qlat.count)
+        out["obs_query_latency_sum_s"] = round(qlat.sum, 3)
+        out["obs_query_p50_ms"] = round(qlat.quantile(0.50) * 1e3, 2)
+        out["obs_query_p99_ms"] = round(qlat.quantile(0.99) * 1e3, 2)
+    hits = reg.get("pio_compile_cache_hits_total")
+    if hits is not None:
+        out["obs_compile_cache_hits"] = int(hits.value)
+    reqs = reg.get("pio_compile_cache_requests_total")
+    if reqs is not None:
+        out["obs_compile_cache_requests"] = int(reqs.value)
+    return out
+
+
 def bench_scan_probe(store_dir: str) -> dict:
     """Sequential vs sharded event-log scan at bench scale, projection
     cache bypassed, plus the pipelined scan→prep leg — the host-pipeline
@@ -755,6 +801,11 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
         "serve_qps": serve["qps_sequential"],
         "serve_qps_concurrent": serve["qps_concurrent"],
         "serve_max_batch": serve["max_batch"],
+        # registry cross-check for the stages the CHILD ran (serving,
+        # compiles); the ingest-side obs_* keys belong to the parent —
+        # never shipped from here, even as None (update() overwrites)
+        **{k: v for k, v in obs_snapshot().items()
+           if k.startswith(("obs_query_", "obs_compile_"))},
     }
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
@@ -1123,6 +1174,10 @@ def run_orchestrator() -> None:
         "rank": RANK,
         "sweeps": ITERATIONS,
         "bf16_sweeps": BF16_SWEEPS,
+        # telemetry cross-check (docs/observability.md): stable None
+        # defaults; child-fragment values and the parent registry
+        # snapshot below fill what each process actually ran
+        **dict.fromkeys(OBS_KEYS),
     }
     if child_ok and os.path.exists(frag_path):
         with open(frag_path) as f:
@@ -1154,6 +1209,12 @@ def run_orchestrator() -> None:
             record["e2e_train_wall_s"] = round(
                 record["ingest_wall_s"] + record["prep_wall_s"]
                 + record["value"], 1)
+    # parent-side registry snapshot: fills the obs_* keys for the stages
+    # THIS process ran (ingest HTTP always; serving too on a degraded
+    # round) without overriding anything the child fragment measured
+    for k, v in obs_snapshot().items():
+        if record.get(k) is None:
+            record[k] = v
     # explicit flush: the record must hit the pipe even if the driver's
     # kill lands right after (stdout is block-buffered under a pipe)
     print(json.dumps(record), flush=True)

@@ -595,6 +595,51 @@ class LockNativeScan(Rule):
             stack.extend(ast.iter_child_nodes(n))
 
 
+# ---------------------------------------------------------------------------
+# 10. metrics mutation inside traced code
+# ---------------------------------------------------------------------------
+
+#: obs-registry mutators (obs/metrics.py): Counter.inc / Gauge.inc/dec /
+#: Histogram.observe. ``set`` is handled separately — ``x.at[i].set(v)``
+#: is the JAX scatter idiom and must stay exempt.
+_METRIC_MUTATORS = {"inc", "dec", "observe"}
+
+
+class MetricInTrace(Rule):
+    name = "metric-in-trace"
+    severity = "error"
+    doc = ("metrics-registry mutation (.inc()/.dec()/.observe()/metric "
+           ".set()) inside a jit/pjit/shard_map/pallas_call-traced "
+           "function — at trace time it books once and never again (a "
+           "lying counter), and any host-callback variant would "
+           "serialize the device per step; book metrics outside the "
+           "trace boundary (obs/metrics.py's hot-path contract)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for root, _statics in mod.traced_roots:
+            for node in ast.walk(root):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                attr = node.func.attr
+                if attr in _METRIC_MUTATORS or (
+                        attr == "set"
+                        and not _is_at_indexed(node.func.value)):
+                    yield mod.finding(
+                        self, node,
+                        f".{attr}() metric mutation inside traced "
+                        f"function {_root_name(root)!r} — book metrics "
+                        "outside the trace boundary")
+
+
+def _is_at_indexed(node: ast.AST) -> bool:
+    """True for ``x.at[...]`` receivers (the JAX functional-update
+    idiom ``x.at[i].set(v)``, including chained updates)."""
+    return (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "at")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -605,6 +650,7 @@ ALL_RULES: Sequence[Rule] = (
     WallClockInTrace(),
     ServerUnlockedState(),
     LockNativeScan(),
+    MetricInTrace(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

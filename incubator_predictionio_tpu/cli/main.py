@@ -318,6 +318,14 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
             print("warning: could not pin the storage-only verb to the "
                   f"{platform} platform; this process may claim the "
                   "accelerator", file=sys.stderr)
+    if cmd in ("deploy", "eventserver", "adminserver", "dashboard",
+               "storageserver"):
+        # long-running server verbs emit the per-request JSON span log
+        # out of the box (one line per request on stderr, trace-ID
+        # correlated; PIO_TRACE_LOG=off disables — docs/observability.md)
+        from incubator_predictionio_tpu.obs.trace import enable_span_logging
+
+        enable_span_logging()
     if cmd == "version":
         print(f"pio-tpu {__version__}")
         return 0

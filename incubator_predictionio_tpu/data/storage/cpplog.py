@@ -215,6 +215,64 @@ class CppLogEvents(base.Events):
         self._gc_caller_batches = 0  # caller batches those appends carried
         self._gc_events = 0        # events written through group commit
         self._gc_max_merge = 0     # largest events-per-append seen
+        # sub-metrics of the last full sharded scan (shard count, native
+        # lock-held wall, merge/total walls — _merge_shards fills the
+        # same dict the bench reads), exported as gauges at scrape time
+        self._last_scan_stats: dict = {}
+        # scrape-time bridge into the process registry: group-commit and
+        # scan counters show up on every server's GET /metrics. Named
+        # registration (replaces the previous backend's hook) + weakref
+        # (a dropped Events object must be collectable) keep
+        # Storage.reset()/re-configure cycles from accumulating hooks.
+        import weakref
+
+        from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+        ref = weakref.ref(self)
+
+        def collect() -> None:
+            ev = ref()
+            if ev is not None:
+                ev._export_native_metrics()
+
+        obs_metrics.REGISTRY.register_collector("cpplog_native", collect)
+
+    def _export_native_metrics(self) -> None:
+        """Snapshot the native-side counters into registry gauges
+        (gauges, not counters: the registry mirrors a snapshot owned by
+        the storage layer; process restarts and backend swaps reset it).
+        Runs only at scrape time — zero cost on the ingest hot path."""
+        from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.REGISTRY
+        gc = self.group_commit_stats()
+        reg.gauge("pio_group_commit_appends",
+                  "native appends performed by the group commit"
+                  ).set(gc["appends"])
+        reg.gauge("pio_group_commit_caller_batches",
+                  "caller batches carried by those appends"
+                  ).set(gc["callerBatches"])
+        reg.gauge("pio_group_commit_events",
+                  "events written through the group commit"
+                  ).set(gc["events"])
+        reg.gauge("pio_group_commit_mean_events_per_append",
+                  "achieved coalescing: events per native append"
+                  ).set(gc["meanEventsPerAppend"])
+        scan = self._last_scan_stats
+        if scan:
+            reg.gauge("pio_scan_shards",
+                      "shard count of the last full event-log scan"
+                      ).set(scan.get("scan_shards", 0))
+            reg.gauge("pio_scan_lock_held_seconds",
+                      "native log-mutex wall held by the last scan's "
+                      "snapshots (writers stalled at most this long)"
+                      ).set(scan.get("scan_lock_held_s", 0.0))
+            reg.gauge("pio_scan_wall_seconds",
+                      "total wall of the last full scan"
+                      ).set(scan.get("scan_wall_s", 0.0))
+            reg.gauge("pio_scan_rows",
+                      "interaction rows the last full scan returned"
+                      ).set(scan.get("scan_rows", 0))
 
     def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
         return self.client.handle(self.ns, app_id, channel_id)
@@ -605,10 +663,15 @@ class CppLogEvents(base.Events):
                         return inter
             unbounded = start_time is None and until_time is None
             seed = servable and unbounded and seed_cache
+            # stats always collect into a dict (the caller's, or our
+            # own) so the last full scan's sub-metrics stay readable by
+            # the /metrics bridge even for callers that pass none
+            stats = {} if stats is None else stats
             inter, times = self._scan_sharded(
                 h, raw, start_time, until_time, entity_type,
                 target_entity_type, names, fixed, value_prop,
                 default_value, stats=stats, shard_sink=shard_sink)
+            self._last_scan_stats = stats
             # times are always non-decreasing here: _merge_shards restores
             # global time order whenever the log held an inversion
             if seed and len(inter) >= traincache.MIN_NNZ:

@@ -134,7 +134,8 @@ class StorageServer:
         self._lock = threading.Lock()
         self._cursors: Dict[str, Any] = {}   # insertion-ordered
         self._cursor_seq = 0
-        self.http = HttpServer.from_conf(self._router(), host, port)
+        self.http = HttpServer.from_conf(self._router(), host, port,
+                                         name="storage")
 
     @classmethod
     def from_env(cls, source: Optional[str] = None, host: str = "0.0.0.0",
@@ -251,6 +252,9 @@ class StorageServer:
                 return _packed({"ok": False, "etype": etype,
                                 "error": str(e)})
 
+        from incubator_predictionio_tpu.obs.http import add_metrics_route
+
+        add_metrics_route(r)
         return r
 
     # -- find cursor protocol ---------------------------------------------

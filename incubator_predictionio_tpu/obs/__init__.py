@@ -1,0 +1,40 @@
+"""obs — unified telemetry: metrics registry, exposition, trace IDs.
+
+The reference leaned on the implicit Spark UI plus ad-hoc bookkeeping
+(per-query latency in CreateServer.scala:426-428, per-app hourly ingest
+counters in Stats.scala:51-80); the rebuild had reproduced those
+fragments piecemeal (``utils/tracing.py`` phase walls, ``servers/
+stats.py`` counters, native group-commit/scan counters only the bench
+read). This package is the one coherent layer over all of them:
+
+- :mod:`.metrics` — a process-wide registry of Counter / Gauge /
+  Histogram metrics, thread-safe and cheap enough for the serving hot
+  path (one uncontended lock + int add per observation, no host syncs,
+  never called from inside traced code — the ``metric-in-trace`` lint
+  rule enforces that last invariant repo-wide);
+- :mod:`.exposition` (via :func:`metrics.Registry.expose`) —
+  Prometheus text format, served at ``GET /metrics`` on every server
+  (:func:`.http.add_metrics_route`);
+- :mod:`.trace` — per-request trace IDs: accepted from an incoming
+  ``X-PIO-Trace-Id`` header, generated otherwise, propagated into the
+  structured JSON span log and echoed on the response.
+
+See ``docs/observability.md`` for the metric catalog and the scrape /
+trace-propagation contracts.
+"""
+
+from incubator_predictionio_tpu.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    DEFAULT_LATENCY_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from incubator_predictionio_tpu.obs.trace import (  # noqa: F401
+    TRACE_HEADER,
+    accept_trace_id,
+    current_trace_id,
+    new_trace_id,
+)

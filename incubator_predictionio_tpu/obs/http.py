@@ -1,0 +1,34 @@
+"""HTTP-side observability: the shared /metrics route.
+
+Every server's ``_build_router`` calls :func:`add_metrics_route` so
+``GET /metrics`` answers Prometheus text exposition from the
+process-wide registry on all of them (event :7070, prediction :8000,
+admin :7071, dashboard :9000 — plus the storage server). The route is
+unauthenticated by design, like the reference's status pages: it
+exposes operational counters, never event data; bind-address policy is
+the operator's access control, same as ``GET /``.
+
+The request-level instrumentation itself (per-route counters, latency
+histogram, trace-ID stamping, span logs) lives in the HTTP layer
+(``utils/http.py``) so every server gets it without per-server wiring.
+"""
+
+from __future__ import annotations
+
+from incubator_predictionio_tpu.obs import metrics
+
+
+def add_metrics_route(router) -> None:
+    """Register ``GET /metrics`` (Prometheus text exposition) on a
+    Router. Imports the http module lazily — obs must stay importable
+    below utils/http.py, which itself imports obs for instrumentation."""
+    from incubator_predictionio_tpu.utils.http import Request, Response
+
+    def metrics_route(request: Request) -> Response:
+        return Response(
+            200,
+            body=metrics.REGISTRY.expose().encode("utf-8"),
+            content_type=metrics.CONTENT_TYPE,
+        )
+
+    router.add("GET", "/metrics", metrics_route)

@@ -1,0 +1,399 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+Dependency-free (like the HTTP layer it rides on) and built for the
+serving hot path: an observation is one uncontended ``threading.Lock``
+acquire plus a few int adds — no allocation after the child exists, no
+host syncs, no device interaction of any kind. Metrics must NEVER be
+mutated from inside ``jit``/``pjit``/``pallas_call``-traced code (a
+host callback there would serialize the device); the ``metric-in-trace``
+pio-lint rule enforces this repo-wide.
+
+The module-level :data:`REGISTRY` is the process-wide default every
+server and subsystem registers into, so one ``GET /metrics`` scrape
+sees the whole process. Fresh :class:`Registry` instances exist for
+tests.
+
+Label cardinality discipline: label values must come from BOUNDED sets
+(route patterns, status codes, phase names) — never ids, entity names
+or other wire-derived strings.
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import math
+import re
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: exposition content type (Prometheus text format 0.0.4)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: fixed exponential latency buckets: 100 µs doubling to ~13.1 s — wide
+#: enough to hold both the sub-ms host-mirror serving path and a cold
+#: XLA compile on the first query, with p50/p95/p99 derivable anywhere
+#: in between. Shared by every latency histogram so panels line up.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = tuple(
+    1e-4 * (2.0 ** i) for i in range(18)
+)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: ints render bare, floats via repr."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class _CounterChild:
+    """One labeled time series of a Counter. ``inc`` is the hot path."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _HistogramChild:
+    """Fixed-bucket histogram: per-bucket counts + sum + count.
+
+    ``observe(v, n)`` records ``n`` observations of the same value in
+    one lock acquire — the micro-batched serving path uses it to keep
+    per-query semantics (every query in a fused batch took the batch
+    wall) at per-BATCH bookkeeping cost.
+    """
+
+    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count")
+
+    def __init__(self, bounds: Sequence[float]) -> None:
+        self._lock = threading.Lock()
+        self._bounds = tuple(bounds)  # upper bounds, ascending
+        self._counts = [0] * (len(self._bounds) + 1)  # + overflow
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float, n: int = 1) -> None:
+        i = bisect.bisect_left(self._bounds, v)
+        with self._lock:
+            self._counts[i] += n
+            self._sum += v * n
+            self._count += n
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        """(per-bucket counts incl. overflow, sum, count) — consistent."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Derive a quantile from the buckets (linear interpolation
+        within the bucket, Prometheus ``histogram_quantile`` style).
+        None when empty; values past the last finite bound report that
+        bound (the honest answer a fixed-bucket histogram can give)."""
+        counts, _sum, total = self.snapshot()
+        if total == 0:
+            return None
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(self._bounds):  # overflow bucket
+                    return self._bounds[-1]
+                lo = self._bounds[i - 1] if i > 0 else 0.0
+                hi = self._bounds[i]
+                return lo + (hi - lo) * max(rank - cum, 0.0) / c
+            cum += c
+        return self._bounds[-1]
+
+
+_KINDS = {
+    "counter": _CounterChild,
+    "gauge": _GaugeChild,
+    "histogram": _HistogramChild,
+}
+
+
+class _Metric:
+    """One named metric family: fixed label names, children per label
+    value tuple. Unlabeled metrics have a single implicit child."""
+
+    def __init__(self, name: str, help: str, kind: str,
+                 labelnames: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None) -> None:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln) or ln.startswith("__"):
+                raise ValueError(f"invalid label name {ln!r}")
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.labelnames = tuple(labelnames)
+        self._buckets = tuple(
+            buckets if buckets is not None else DEFAULT_LATENCY_BUCKETS)
+        if kind == "histogram" and list(self._buckets) != sorted(
+                set(self._buckets)):
+            raise ValueError("histogram buckets must be sorted and unique")
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not self.labelnames:
+            self._children[()] = self._make_child()
+
+    def _make_child(self):
+        if self.kind == "histogram":
+            return _HistogramChild(self._buckets)
+        return _KINDS[self.kind]()
+
+    def labels(self, **labels: str):
+        """Child for one label-value combination (created on first use,
+        cached — the hot path pays one dict lookup)."""
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(labels)}")
+        key = tuple(str(labels[ln]) for ln in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._make_child())
+        return child
+
+    # unlabeled convenience: metric.inc()/set()/observe() hit the child
+    def _solo(self):
+        if self.labelnames:
+            raise ValueError(f"{self.name} is labeled; use .labels(...)")
+        return self._children[()]
+
+    def inc(self, n: float = 1.0) -> None:
+        self._solo().inc(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._solo().dec(n)
+
+    def set(self, v: float) -> None:
+        self._solo().set(v)
+
+    def observe(self, v: float, n: int = 1) -> None:
+        self._solo().observe(v, n)
+
+    @property
+    def value(self):
+        return self._solo().value
+
+    @property
+    def sum(self):
+        return self._solo().sum
+
+    @property
+    def count(self):
+        return self._solo().count
+
+    def quantile(self, q: float):
+        return self._solo().quantile(q)
+
+    def total(self) -> float:
+        """Sum over every labeled child (counter/gauge families) — the
+        bench's registry snapshot collapses label sets with this."""
+        if self.kind == "histogram":
+            raise ValueError("total() is for counter/gauge; use sum/count")
+        with self._lock:
+            children = list(self._children.values())
+        return sum(c.value for c in children)
+
+    # -- exposition ---------------------------------------------------------
+    def _label_str(self, key: Tuple[str, ...],
+                   extra: str = "") -> str:
+        parts = [f'{ln}="{_escape_label(lv)}"'
+                 for ln, lv in zip(self.labelnames, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def expose_into(self, out: List[str]) -> None:
+        out.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            if self.kind in ("counter", "gauge"):
+                out.append(
+                    f"{self.name}{self._label_str(key)} "
+                    f"{_fmt(child.value)}")
+            else:
+                counts, total_sum, total = child.snapshot()
+                cum = 0
+                for bound, c in zip(self._buckets, counts):
+                    cum += c
+                    le = 'le="' + _fmt(bound) + '"'
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{self._label_str(key, le)} {cum}")
+                inf = 'le="+Inf"'
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{self._label_str(key, inf)} {total}")
+                out.append(
+                    f"{self.name}_sum{self._label_str(key)} "
+                    f"{_fmt(total_sum)}")
+                out.append(
+                    f"{self.name}_count{self._label_str(key)} {total}")
+
+
+Counter = Gauge = Histogram = _Metric  # type aliases for annotations
+
+
+class Registry:
+    """Named metrics + scrape-time collectors.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: the second
+    registration of a name returns the SAME metric (servers restart
+    inside one test process), but a kind or label-set mismatch raises —
+    two subsystems silently sharing a misdeclared series is how scrapes
+    lie. Collectors are named callbacks run at scrape time, for state
+    that lives elsewhere (native counters, queue depths): registering
+    the same name again replaces the old callback, so re-created
+    backends never accumulate dead hooks.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: Dict[str, Callable[[], None]] = {}
+
+    def _get_or_create(self, name: str, help: str, kind: str,
+                       labels: Sequence[str],
+                       buckets: Optional[Sequence[float]] = None) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if existing.kind != kind or existing.labelnames != tuple(
+                        labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{existing.labelnames}")
+                if (kind == "histogram" and buckets is not None
+                        and tuple(buckets) != existing._buckets):
+                    # two subsystems binning one series by different
+                    # bounds would silently produce lying quantiles
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {existing._buckets}")
+                return existing
+            m = _Metric(name, help, kind, labels, buckets)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str,
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(name, help, "counter", labels)
+
+    def gauge(self, name: str, help: str,
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(name, help, "gauge", labels)
+
+    def histogram(self, name: str, help: str, labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create(name, help, "histogram", labels, buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def register_collector(self, name: str,
+                           fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._collectors[name] = fn
+
+    def unregister_collector(self, name: str) -> None:
+        with self._lock:
+            self._collectors.pop(name, None)
+
+    def expose(self) -> str:
+        """Prometheus text exposition of every metric, after running
+        the collectors (a failing collector logs and is skipped — a
+        broken bridge must never take down the scrape)."""
+        with self._lock:
+            collectors = list(self._collectors.items())
+        for cname, fn in collectors:
+            try:
+                fn()
+            except Exception:
+                logger.exception("metrics collector %r failed", cname)
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: List[str] = []
+        for m in metrics:
+            m.expose_into(out)
+        return "\n".join(out) + "\n"
+
+
+#: the process-wide default registry — one scrape sees the whole system
+REGISTRY = Registry()

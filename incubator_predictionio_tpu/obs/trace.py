@@ -1,0 +1,108 @@
+"""Request trace IDs + structured JSON span logs.
+
+The propagation contract (docs/observability.md): every request to any
+of the servers gets a trace ID — accepted from an incoming
+``X-PIO-Trace-Id`` header when it is well-formed (1-128 chars of
+``[A-Za-z0-9._:-]``), freshly generated otherwise — which is
+
+- echoed back on the response in the same header,
+- installed in a contextvar for the duration of the handler (the HTTP
+  layer copies the context into the executor for sync handlers), and
+- emitted in one structured JSON span line per request on the
+  ``pio.trace`` logger (level INFO; silence it with
+  ``logging.getLogger("pio.trace").setLevel(logging.WARNING)``).
+
+A client that stamps its POST /events.json and POST /queries.json with
+the same trace ID can therefore join the ingest span, the serving span
+and any operator-side logs on one key — the distributed-tracing
+contract at log-line cost, with no collector dependency.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import re
+import secrets
+from typing import Any, Optional
+
+#: the propagation header, request and response side
+TRACE_HEADER = "X-PIO-Trace-Id"
+
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+
+_current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "pio_trace_id", default=None
+)
+
+#: one JSON object per line; operators point this at their log shipper
+span_logger = logging.getLogger("pio.trace")
+
+
+def new_trace_id() -> str:
+    """16 hex chars — collision-safe for log correlation windows."""
+    return secrets.token_hex(8)
+
+
+def accept_trace_id(incoming: Optional[str]) -> str:
+    """The incoming header value when well-formed, else a fresh ID.
+    Malformed values are REPLACED, not rejected: a trace header must
+    never be able to fail a request (or smuggle log-breaking bytes)."""
+    if incoming and _TRACE_ID_RE.match(incoming):
+        return incoming
+    return new_trace_id()
+
+
+def current_trace_id() -> Optional[str]:
+    """The ambient request's trace ID (None outside a request)."""
+    return _current.get()
+
+
+def set_current(trace_id: Optional[str]) -> contextvars.Token:
+    return _current.set(trace_id)
+
+
+def reset_current(token: contextvars.Token) -> None:
+    _current.reset(token)
+
+
+def enable_span_logging() -> None:
+    """Give the span logger a real sink: one bare-JSON line per request
+    on stderr. The CLI server verbs call this so `pio eventserver` /
+    `pio deploy` emit spans out of the box; library embedders configure
+    logging themselves and never pay for it (an unconfigured logger
+    fails the ``isEnabledFor`` gate). ``PIO_TRACE_LOG=off`` disables.
+    Idempotent; propagation stays on so pytest caplog and operator root
+    handlers keep seeing the records."""
+    if os.environ.get("PIO_TRACE_LOG", "").lower() in (
+            "off", "0", "false", "disable"):
+        return
+    if any(isinstance(h, logging.StreamHandler)
+           for h in span_logger.handlers):
+        return
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    span_logger.addHandler(handler)
+    span_logger.setLevel(logging.INFO)
+
+
+def log_span(server: str, method: str, route: str, status: int,
+             duration_s: float, trace_id: str, **extra: Any) -> None:
+    """Emit the per-request JSON span line. Pre-gated on the logger
+    level so a silenced logger costs one attribute read per request."""
+    if not span_logger.isEnabledFor(logging.INFO):
+        return
+    record = {
+        "span": "http.request",
+        "server": server,
+        "method": method,
+        "route": route,
+        "status": status,
+        "durationMs": round(duration_s * 1e3, 3),
+        "traceId": trace_id,
+    }
+    if extra:
+        record.update(extra)
+    span_logger.info("%s", json.dumps(record, separators=(",", ":")))

@@ -12,6 +12,7 @@ import logging
 from typing import Optional
 
 from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.obs.http import add_metrics_route
 from incubator_predictionio_tpu.utils.annotations import experimental
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
@@ -30,7 +31,8 @@ class AdminServer:
         self.access_keys = Storage.get_meta_data_access_keys()
         self.channels = Storage.get_meta_data_channels()
         self.events = Storage.get_events()
-        self.http = HttpServer.from_conf(self._build_router(), ip, port)
+        self.http = HttpServer.from_conf(self._build_router(), ip, port,
+                                         name="admin")
 
     def _build_router(self) -> Router:
         r = Router()
@@ -101,6 +103,7 @@ class AdminServer:
             self.events.init(app.id)
             return Response(200, {"message": f"App {app.name} data deleted."})
 
+        add_metrics_route(r)
         return r
 
     def start_background(self) -> int:

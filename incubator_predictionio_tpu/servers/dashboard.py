@@ -13,6 +13,7 @@ import html
 import logging
 
 from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.obs.http import add_metrics_route
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
     Request,
@@ -27,7 +28,8 @@ logger = logging.getLogger(__name__)
 class DashboardServer:
     def __init__(self, ip: str = "127.0.0.1", port: int = 9000):
         self.evaluation_instances = Storage.get_meta_data_evaluation_instances()
-        self.http = HttpServer.from_conf(self._build_router(), ip, port)
+        self.http = HttpServer.from_conf(self._build_router(), ip, port,
+                                         name="dashboard")
 
     def _build_router(self) -> Router:
         r = Router(cors=True)
@@ -74,6 +76,7 @@ class DashboardServer:
                 body=(i.evaluator_results_json or "{}").encode(),
             )
 
+        add_metrics_route(r)
         return r
 
     def start_background(self) -> int:

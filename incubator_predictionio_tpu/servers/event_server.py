@@ -36,6 +36,8 @@ from incubator_predictionio_tpu.data.storage import (
     UnsupportedMethodError,
 )
 from incubator_predictionio_tpu.data.webhooks import ConnectorError
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs.http import add_metrics_route
 from incubator_predictionio_tpu.servers.plugins import EventInfo, PluginContext
 from incubator_predictionio_tpu.servers.stats import Stats
 from incubator_predictionio_tpu.data.storage.base import UNSET as _UNSET_Q
@@ -52,6 +54,22 @@ logger = logging.getLogger(__name__)
 
 #: EventServer.scala:71
 MAX_EVENTS_PER_BATCH = 50
+
+#: per-EVENT ingest outcomes (the request-level counters live in the
+#: shared HTTP layer): every booked event — accepted or rejected — adds
+#: one here, labeled by route pattern and status, FEEDING the
+#: reference-parity per-app hourly window in /stats.json, not
+#: replacing it (these never rotate; scope = process lifetime)
+_INGEST_EVENTS = obs_metrics.REGISTRY.counter(
+    "pio_ingest_events_total",
+    "events booked by the event server, by route pattern and status",
+    labels=("route", "status"))
+#: batch-request shape: how many events each /batch/events.json request
+#: carried (the group-commit/columnar amortization depends on it)
+_INGEST_BATCH_SIZE = obs_metrics.REGISTRY.histogram(
+    "pio_ingest_batch_size",
+    "events per POST /batch/events.json request",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
 
 
 @dataclasses.dataclass
@@ -96,7 +114,8 @@ class EventServer:
         self.stats = Stats()
         self.plugin_context = plugin_context or PluginContext()
         self.router = self._build_router()
-        self.http = HttpServer.from_conf(self.router, config.ip, config.port)
+        self.http = HttpServer.from_conf(self.router, config.ip, config.port,
+                                         name="event")
 
     # -- auth (EventServer.scala:93-131) ------------------------------------
     def _authenticate(self, request: Request) -> AuthData:
@@ -147,6 +166,8 @@ class EventServer:
             return None
         return self._columnar_fast_response(auth, fast, len(items))
 
+    _BATCH_ROUTE = "/batch/events.json"
+
     def _columnar_fast_response(self, auth: AuthData, fast,
                                 n: int) -> Optional[Response]:
         """Post-gate leg shared by the doc-level and native-body fast
@@ -159,7 +180,7 @@ class EventServer:
             self._check_allowed(auth, name)
         except AuthError as e:
             for _ in range(n):
-                self._book(auth, e.status, name)
+                self._book(auth, e.status, name, route=self._BATCH_ROUTE)
             return Response(200, [
                 {"status": e.status, "message": e.message}] * n)
         try:
@@ -187,7 +208,7 @@ class EventServer:
                 "columnar batch insert failed; using the generic path")
             return None
         for _ in range(n):
-            self._book(auth, 201, name)
+            self._book(auth, 201, name, route=self._BATCH_ROUTE)
         # ids are our own 32-hex strings: render the uniform-status body
         # directly (no json.dumps tree walk on the hot path)
         body = ('[' + ",".join(
@@ -218,18 +239,19 @@ class EventServer:
         self._sniff(info)
         return event_id
 
-    def _ingest(self, auth: AuthData, event: Event) -> Response:
+    def _ingest(self, auth: AuthData, event: Event,
+                route: str = "/events.json") -> Response:
         """Guarded insert shared by /events.json and the webhook routes so
         403/500 outcomes get identical responses and stats booking."""
         try:
             event_id = self._insert(auth, event)
         except AuthError as e:
-            self._book(auth, e.status, event.event)
+            self._book(auth, e.status, event.event, route=route)
             raise
         except Exception as e:
-            self._book(auth, 500, event.event)
+            self._book(auth, 500, event.event, route=route)
             return Response(500, {"message": str(e)})
-        self._book(auth, 201, event.event)
+        self._book(auth, 201, event.event, route=route)
         return Response(201, {"eventId": event_id})
 
     @staticmethod
@@ -241,7 +263,12 @@ class EventServer:
         validate_event(event)
         return event
 
-    def _book(self, auth: AuthData, status: int, event_name: str) -> None:
+    def _book(self, auth: AuthData, status: int, event_name: str,
+              route: str = "/events.json") -> None:
+        # registry counter always (process-wide, label-bounded by route
+        # pattern + status); the per-app/per-event-name hourly window
+        # stays behind --stats, exactly the reference contract
+        _INGEST_EVENTS.labels(route=route, status=str(status)).inc()
         if self.config.stats:
             self.stats.update(auth.app_id, status, event_name)
 
@@ -375,6 +402,9 @@ class EventServer:
                     resp = self._columnar_fast_response(
                         auth, fast, len(fast[0]))
                     if resp is not None:
+                        # the size histogram books exactly once per
+                        # batch request, at whichever leg answers it
+                        _INGEST_BATCH_SIZE.observe(len(fast[0]))
                         return resp
             try:
                 items = request.json()
@@ -389,6 +419,7 @@ class EventServer:
                         f"{self.config.max_batch} events"
                     )
                 })
+            _INGEST_BATCH_SIZE.observe(len(items))
             # doc-level columnar fast path: the uniform interaction shape
             # goes wire → native log without ever constructing Event
             # objects (parse+validate of 50 Events costs more than the
@@ -422,7 +453,8 @@ class EventServer:
                     event = self._parse_event(item)
                 except (ValueError, EventValidationError) as e:
                     results[idx] = {"status": 400, "message": str(e)}
-                    self._book(auth, 400, "<error>")
+                    self._book(auth, 400, "<error>",
+                               route=self._BATCH_ROUTE)
                     continue
                 try:
                     self._check_allowed(auth, event.event)
@@ -432,11 +464,13 @@ class EventServer:
                         blocker.process(info, self.plugin_context)
                 except AuthError as e:
                     results[idx] = {"status": e.status, "message": e.message}
-                    self._book(auth, e.status, event.event)
+                    self._book(auth, e.status, event.event,
+                               route=self._BATCH_ROUTE)
                     continue
                 except Exception as e:
                     results[idx] = {"status": 500, "message": str(e)}
-                    self._book(auth, 500, event.event)
+                    self._book(auth, 500, event.event,
+                               route=self._BATCH_ROUTE)
                     continue
                 pending.append((idx, event, info))
             ids: Optional[list] = None
@@ -452,7 +486,8 @@ class EventServer:
                     logger.warning("bulk insert ambiguous: %s", e)
                     for idx, event, _info in pending:
                         results[idx] = {"status": 500, "message": str(e)}
-                        self._book(auth, 500, event.event)
+                        self._book(auth, 500, event.event,
+                                   route=self._BATCH_ROUTE)
                     pending = []
                 except Exception:
                     # Best-effort recovery window (documented): the failed
@@ -470,7 +505,8 @@ class EventServer:
             if ids is not None:
                 for (idx, event, info), event_id in zip(pending, ids):
                     results[idx] = {"status": 201, "eventId": event_id}
-                    self._book(auth, 201, event.event)
+                    self._book(auth, 201, event.event,
+                               route=self._BATCH_ROUTE)
                     self._sniff(info)
             else:
                 for idx, event, info in pending:
@@ -478,11 +514,13 @@ class EventServer:
                         event_id = self.events.insert(
                             event, auth.app_id, auth.channel_id)
                         results[idx] = {"status": 201, "eventId": event_id}
-                        self._book(auth, 201, event.event)
+                        self._book(auth, 201, event.event,
+                                   route=self._BATCH_ROUTE)
                         self._sniff(info)
                     except Exception as e:
                         results[idx] = {"status": 500, "message": str(e)}
-                        self._book(auth, 500, event.event)
+                        self._book(auth, 500, event.event,
+                                   route=self._BATCH_ROUTE)
             return Response(200, results)
 
         _register_post("/batch/events.json", batch_events,
@@ -518,9 +556,10 @@ class EventServer:
                 event_json = connector.to_event_json(request.json())
                 event = self._parse_event(event_json)
             except (ConnectorError, ValueError, EventValidationError) as e:
-                self._book(auth, 400, "<error>")
+                self._book(auth, 400, "<error>",
+                           route="/webhooks/{name}.json")
                 return Response(400, {"message": str(e)})
-            return self._ingest(auth, event)
+            return self._ingest(auth, event, route="/webhooks/{name}.json")
 
         @r.get("/webhooks/{name}.json")
         def webhook_json_probe(request: Request) -> Response:
@@ -541,9 +580,10 @@ class EventServer:
                 event_json = connector.to_event_json(request.form())
                 event = self._parse_event(event_json)
             except (ConnectorError, ValueError, EventValidationError) as e:
-                self._book(auth, 400, "<error>")
+                self._book(auth, 400, "<error>",
+                           route="/webhooks/{name}.form")
                 return Response(400, {"message": str(e)})
-            return self._ingest(auth, event)
+            return self._ingest(auth, event, route="/webhooks/{name}.form")
 
         @r.get("/webhooks/{name}.form")
         def webhook_form_probe(request: Request) -> Response:
@@ -576,6 +616,7 @@ class EventServer:
                 plugin.handle_rest("/".join(parts[1:]), dict(request.query)),
             )
 
+        add_metrics_route(r)
         return r
 
     # -- lifecycle ----------------------------------------------------------

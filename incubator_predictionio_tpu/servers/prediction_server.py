@@ -35,6 +35,8 @@ from typing import Any, Dict, List, Optional
 from incubator_predictionio_tpu.core.engine import Engine
 from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
 from incubator_predictionio_tpu.data.storage import EngineInstance, Storage
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs.http import add_metrics_route
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 from incubator_predictionio_tpu.servers.plugins import PluginContext
 from incubator_predictionio_tpu.utils import json_codec
@@ -50,6 +52,20 @@ from incubator_predictionio_tpu.workflow import CoreWorkflow
 from incubator_predictionio_tpu.workflow.workflow import make_runtime_context
 
 logger = logging.getLogger(__name__)
+
+#: per-QUERY serving latency (every query in a fused micro-batch took
+#: the batch wall — CreateServer.scala:611-618 per-query semantics, at
+#: one histogram observe per BATCH). p50/p95/p99 derive from the fixed
+#: exponential buckets; /status reports them too (no scraper needed).
+#: Booked on the micro-batch dispatcher thread AFTER the device
+#: dispatch resolves — host-side ints only, never inside traced code.
+_QUERY_LATENCY = obs_metrics.REGISTRY.histogram(
+    "pio_query_latency_seconds",
+    "per-query serving wall (micro-batch members share the batch wall)")
+#: instantaneous micro-batcher backlog, read at scrape time
+_QUEUE_DEPTH = obs_metrics.REGISTRY.gauge(
+    "pio_serve_queue_depth",
+    "queries waiting in the micro-batching queue (scrape-time snapshot)")
 
 
 @dataclasses.dataclass
@@ -257,12 +273,30 @@ class PredictionServer:
         )
         # bind-retry 3×/1 s for occupied ports (CreateServer.scala:371-381)
         self.http = HttpServer.from_conf(self._build_router(), config.ip,
-                                         config.port, bind_retries=3)
+                                         config.port, bind_retries=3,
+                                         name="prediction")
         self._batcher = (
             _MicroBatcher(self._handle_batch, config.micro_batch,
                           workers=config.serve_workers)
             if config.micro_batch > 0 else None
         )
+        if self._batcher is not None:
+            # scrape-time queue-depth read (len() is GIL-atomic); the
+            # named collector replaces any prior server's hook so
+            # re-deploys never accumulate dead closures, and the
+            # weakref keeps a stopped server (engine + loaded models)
+            # collectable — the registry must never pin model memory
+            import weakref
+
+            batcher_ref = weakref.ref(self._batcher)
+
+            def _collect_queue_depth() -> None:
+                b = batcher_ref()
+                if b is not None:
+                    _QUEUE_DEPTH.set(len(b._queue))
+
+            obs_metrics.REGISTRY.register_collector(
+                "prediction_queue_depth", _collect_queue_depth)
         # feedback events are training data: a deep queue so only a
         # sustained collector outage drops (drops counted and shown on the
         # status page); --log-url diagnostics stay shallow and lossy
@@ -469,6 +503,9 @@ class PredictionServer:
             ) / self.request_count
             self.last_serving_sec = dt
             self.max_batch_served = max(self.max_batch_served, n)
+        # n same-valued observations in one bucket add: per-query tail
+        # latency (p50/p95/p99) at per-batch bookkeeping cost
+        _QUERY_LATENCY.observe(dt, n)
         return results
 
     def _remote_log(self, message: str) -> None:
@@ -579,6 +616,17 @@ class PredictionServer:
                     "requestCount": self.request_count,
                     "avgServingSec": self.avg_serving_sec,
                     "lastServingSec": self.last_serving_sec,
+                    # tail latency from the query histogram: the running
+                    # average the reference keeps (:426-428) hides tail
+                    # regressions entirely — p50/p95/p99 on the status
+                    # page make them visible without a scraper. Scope:
+                    # process-wide histogram (all queries this process
+                    # served), like requestCount after a /reload. 0.0
+                    # before the first query — type-stable next to the
+                    # always-numeric avgServingSec
+                    "servingSecP50": _QUERY_LATENCY.quantile(0.50) or 0.0,
+                    "servingSecP95": _QUERY_LATENCY.quantile(0.95) or 0.0,
+                    "servingSecP99": _QUERY_LATENCY.quantile(0.99) or 0.0,
                     "maxBatchServed": self.max_batch_served,
                     "feedbackEventsDropped": self._feedback_poster.dropped,
                 }
@@ -661,6 +709,7 @@ class PredictionServer:
                 200, plugin.handle_rest("/".join(parts[1:]), dict(request.query))
             )
 
+        add_metrics_route(r)
         return r
 
     # -- lifecycle ----------------------------------------------------------

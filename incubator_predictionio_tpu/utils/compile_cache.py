@@ -20,6 +20,50 @@ import os
 logger = logging.getLogger(__name__)
 
 _enabled = False
+_listener_installed = False
+
+
+def _install_metrics_listener() -> None:
+    """Bridge JAX's compilation-cache monitoring events into the obs
+    registry: ``pio_compile_cache_hits_total`` / ``_requests_total``
+    counters (misses = requests − hits, derived as a gauge at scrape
+    time). Counters exist from the moment the cache is enabled, so a
+    scrape always sees the series even before the first compile. The
+    jax.monitoring event names are version-dependent — the whole bridge
+    is best-effort and a missing API degrades to zero counters, never
+    an error."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+    hits = obs_metrics.REGISTRY.counter(
+        "pio_compile_cache_hits_total",
+        "XLA persistent-cache hits (compile skipped)")
+    requests = obs_metrics.REGISTRY.counter(
+        "pio_compile_cache_requests_total",
+        "compile requests eligible for the persistent cache")
+    misses = obs_metrics.REGISTRY.gauge(
+        "pio_compile_cache_misses",
+        "cache-eligible compiles that missed (requests - hits)")
+    obs_metrics.REGISTRY.register_collector(
+        "compile_cache_misses",
+        lambda: misses.set(max(requests.value - hits.value, 0)))
+    try:
+        from jax._src import monitoring
+
+        def on_event(event: str, **_kw) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                hits.inc()
+            elif event == "/jax/compilation_cache/compile_requests_use_cache":
+                requests.inc()
+
+        monitoring.register_event_listener(on_event)
+        _listener_installed = True
+    except Exception:  # pragma: no cover - monitoring API drift
+        logger.debug("jax monitoring unavailable; compile-cache "
+                     "counters stay at zero", exc_info=True)
+        _listener_installed = True  # don't retry (and re-register) forever
 
 
 def enable(cache_dir: str | None = None) -> None:
@@ -85,5 +129,6 @@ def enable(cache_dir: str | None = None) -> None:
 
                 _cc.reset_cache()
         _enabled = True
+        _install_metrics_listener()
     except Exception as exc:  # pragma: no cover - cache is best-effort
         logger.warning("compilation cache unavailable: %s", exc)

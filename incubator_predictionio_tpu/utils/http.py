@@ -17,6 +17,7 @@ proxy.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import errno
 import inspect
 import json
@@ -25,10 +26,28 @@ import re
 import socket
 import ssl
 import threading
+import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qsl, unquote, urlsplit
 
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs import trace as obs_trace
+
 logger = logging.getLogger(__name__)
+
+#: request telemetry every server shares (docs/observability.md). The
+#: route label is the ROUTE PATTERN (bounded set), never the raw path —
+#: `/events/{event_id}.json` stays one series no matter how many ids
+#: pass through it; unrouted paths collapse into one `<unmatched>`.
+_HTTP_REQUESTS = obs_metrics.REGISTRY.counter(
+    "pio_http_requests_total",
+    "HTTP requests served, by server/method/route pattern/status",
+    labels=("server", "method", "route", "status"))
+_HTTP_LATENCY = obs_metrics.REGISTRY.histogram(
+    "pio_http_request_seconds",
+    "HTTP request wall (dispatch to response), by server/route pattern",
+    labels=("server", "route"))
+_UNMATCHED_ROUTE = "<unmatched>"
 
 MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 64 * 1024 * 1024
@@ -129,12 +148,12 @@ class Router:
     tools/.../dashboard/CorsSupport.scala:30-66)."""
 
     def __init__(self, cors: bool = False) -> None:
-        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self._routes: List[Tuple[str, re.Pattern, Handler, str]] = []
         self.cors = cors
 
     def allowed_methods(self, path: str) -> List[str]:
         return sorted({
-            m for m, pattern, _h in self._routes if pattern.match(path)
+            m for m, pattern, _h, _p in self._routes if pattern.match(path)
         })
 
     _PARAM_RE = re.compile(r"\{([a-zA-Z_][a-zA-Z0-9_]*)(\.\.\.)?\}")
@@ -158,7 +177,8 @@ class Router:
         if pattern.endswith("/") or pattern == "/":
             regex.append("/?")
         regex.append("$")
-        self._routes.append((method.upper(), re.compile("".join(regex)), handler))
+        self._routes.append(
+            (method.upper(), re.compile("".join(regex)), handler, pattern))
 
     def get(self, pattern: str):
         return lambda h: (self.add("GET", pattern, h), h)[1]
@@ -171,18 +191,24 @@ class Router:
 
     def resolve(
         self, method: str, path: str
-    ) -> Tuple[Optional[Handler], Dict[str, str], bool]:
-        """(handler, params, path_exists)."""
+    ) -> Tuple[Optional[Handler], Dict[str, str], bool, Optional[str]]:
+        """(handler, params, path_exists, route_pattern). The pattern
+        comes back even on a method mismatch, so 405s and CORS
+        preflights book under the real route label — `<unmatched>` is
+        reserved for paths no route knows at all."""
         path_matched = False
-        for m, pattern, handler in self._routes:
+        matched_route: Optional[str] = None
+        for m, pattern, handler, route in self._routes:
             match = pattern.match(path)
             if match:
                 path_matched = True
+                if matched_route is None:
+                    matched_route = route
                 if m == method:
                     return handler, {
                         k: unquote(v) for k, v in match.groupdict().items()
-                    }, True
-        return None, {}, path_matched
+                    }, True, route
+        return None, {}, path_matched, matched_route
 
 
 class ClientConnectionPool:
@@ -245,10 +271,13 @@ class HttpServer:
 
     def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0,
                  ssl_context: Optional["ssl.SSLContext"] = None,
-                 bind_retries: int = 0, bind_retry_delay: float = 1.0):
+                 bind_retries: int = 0, bind_retry_delay: float = 1.0,
+                 name: str = "http"):
         self.router = router
         self.host = host
         self.port = port
+        #: `server` label on the shared request metrics + span logs
+        self.name = name
         self.ssl_context = ssl_context
         #: extra bind attempts after a failed bind (occupied port), each
         #: after ``bind_retry_delay`` seconds — MasterActor retries 3×/1 s
@@ -262,14 +291,15 @@ class HttpServer:
 
     @classmethod
     def from_conf(cls, router: Router, host: str = "0.0.0.0",
-                  port: int = 0, bind_retries: int = 0) -> "HttpServer":
+                  port: int = 0, bind_retries: int = 0,
+                  name: str = "http") -> "HttpServer":
         """Server with TLS material from server.conf when configured
         (the reference mixes SSLConfiguration into every server)."""
         from incubator_predictionio_tpu.utils.ssl_config import load_ssl_config
 
         return cls(router, host, port,
                    ssl_context=load_ssl_config().ssl_context(),
-                   bind_retries=bind_retries)
+                   bind_retries=bind_retries, name=name)
 
     # -- request cycle -----------------------------------------------------
     async def _handle_conn(
@@ -339,7 +369,36 @@ class HttpServer:
             return None, False
 
     async def _dispatch(self, request: Request) -> Response:
-        handler, params, path_exists = self.router.resolve(
+        """Route + run the handler, wrapped in the shared request
+        telemetry (docs/observability.md): trace-ID stamping, the
+        per-route counter + latency histogram, and the JSON span log.
+        All of it is host-side bookkeeping on the event loop — one
+        counter add, one histogram add, one header — never a device
+        touch."""
+        t0 = time.perf_counter()
+        trace_id = obs_trace.accept_trace_id(
+            request.headers.get("x-pio-trace-id"))
+        token = obs_trace.set_current(trace_id)
+        try:
+            response, route = await self._dispatch_routed(request)
+        finally:
+            obs_trace.reset_current(token)
+        dt = time.perf_counter() - t0
+        route_label = route or _UNMATCHED_ROUTE
+        _HTTP_REQUESTS.labels(
+            server=self.name, method=request.method, route=route_label,
+            status=str(response.status)).inc()
+        _HTTP_LATENCY.labels(server=self.name, route=route_label).observe(dt)
+        response.headers.setdefault(obs_trace.TRACE_HEADER, trace_id)
+        obs_trace.log_span(self.name, request.method, route_label,
+                           response.status, dt, trace_id)
+        return response
+
+    async def _dispatch_routed(
+        self, request: Request
+    ) -> Tuple[Response, Optional[str]]:
+        """(response, matched route pattern or None)."""
+        handler, params, path_exists, route = self.router.resolve(
             request.method, request.path
         )
         if handler is None:
@@ -353,27 +412,35 @@ class HttpServer:
                         ", ".join(["OPTIONS"] + methods),
                     "Access-Control-Allow-Headers": CORS_ALLOW_HEADERS,
                     "Access-Control-Max-Age": "1728000",
-                }))
+                })), route
             if path_exists:
                 return self._with_cors(
-                    Response(405, {"message": "Method Not Allowed"}))
-            return self._with_cors(Response(404, {"message": "Not Found"}))
+                    Response(405, {"message": "Method Not Allowed"})), route
+            return self._with_cors(
+                Response(404, {"message": "Not Found"})), route
         request.path_params = params
         try:
             if inspect.iscoroutinefunction(handler):
                 result = await handler(request)
             else:
                 loop = asyncio.get_running_loop()
-                result = await loop.run_in_executor(None, handler, request)
+                # copy_context: run_in_executor does not propagate
+                # contextvars by itself, and sync handlers must see the
+                # ambient trace ID (obs_trace.current_trace_id)
+                ctx = contextvars.copy_context()
+                result = await loop.run_in_executor(
+                    None, ctx.run, handler, request)
                 if inspect.isawaitable(result):
                     result = await result
-            return self._with_cors(result)
+            return self._with_cors(result), route
         except HttpError as e:
-            return self._with_cors(Response(e.status, {"message": e.message}))
+            return self._with_cors(
+                Response(e.status, {"message": e.message})), route
         except Exception as e:
             logger.exception("handler error for %s %s", request.method,
                              request.path)
-            return self._with_cors(Response(500, {"message": str(e)}))
+            return self._with_cors(
+                Response(500, {"message": str(e)})), route
 
     def _with_cors(self, response: Response) -> Response:
         if self.router.cors:

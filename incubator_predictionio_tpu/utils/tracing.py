@@ -110,6 +110,27 @@ class Tracer:
             for name, secs in self.timings.items()
         }
 
+    def export_metrics(self) -> None:
+        """Publish this run's phase walls into the process-wide metrics
+        registry (``pio_workflow_phase_seconds{phase=...}`` gauges +
+        a run counter), so a /metrics scrape on any server co-hosted
+        with training sees the last run's read/prepare/train/checkpoint
+        breakdown next to the serving metrics. Gauges, not counters:
+        each workflow run REPLACES the previous run's wall per phase
+        (phase names are a bounded label set — pipeline stages, not
+        user data). Called by CoreWorkflow after each run; never from
+        inside traced code."""
+        from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+        phase_g = obs_metrics.REGISTRY.gauge(
+            "pio_workflow_phase_seconds",
+            "wall seconds per workflow phase, last run", labels=("phase",))
+        runs = obs_metrics.REGISTRY.counter(
+            "pio_workflow_runs_total", "workflow runs that exported timings")
+        for name, secs in self.timings.items():
+            phase_g.labels(phase=name).set(secs)
+        runs.inc()
+
 
 def current() -> Optional[Tracer]:
     return _current.get()

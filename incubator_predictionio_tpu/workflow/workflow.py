@@ -188,6 +188,14 @@ class CoreWorkflow:
                     runtime_conf={**instance.runtime_conf, **tracer.to_conf()},
                 )
             )
+            # phase walls → registry gauges: one /metrics scrape shows
+            # this run's read/prepare/train/checkpoint breakdown next to
+            # the serving metrics (docs/observability.md). Telemetry
+            # export must never demote a COMPLETED train to ABORTED
+            try:
+                tracer.export_metrics()
+            except Exception:
+                logger.exception("phase-metrics export failed")
             logger.info(
                 "Training completed; engine instance %s saved (%d bytes of "
                 "models); %s", instance_id, len(blob), tracer.summary(),

@@ -90,6 +90,14 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
     assert rec["degraded"] is False          # the CPU child always lands
     assert rec["value"] > 0
     assert rec["ingest_http_eps"] > 0
+    # telemetry cross-check keys (docs/observability.md): the registry
+    # snapshot corroborates the bench's own measurements — the ingest
+    # counter saw at least the cap-50 HTTP load, and the child's query
+    # histogram saw the serving stage
+    assert rec["obs_ingest_events_total"] >= 8 * 20 * 50
+    assert rec["obs_ingest_batches"] >= 8 * 20
+    assert rec["obs_query_latency_count"] > 0
+    assert rec["obs_query_p50_ms"] > 0
     # the selector on a Mosaic-less backend reports honestly
     assert rec["als_kernel"] in ("unavailable", "disabled", "on", "off",
                                  "probe_failed")

@@ -190,6 +190,36 @@ class Events:
             return deferred
 """,
     ),
+    "metric-in-trace": (
+        """
+import jax
+from incubator_predictionio_tpu.obs import metrics
+
+QUERIES = metrics.REGISTRY.counter("q_total", "queries")
+LAT = metrics.REGISTRY.histogram("q_seconds", "latency")
+
+@jax.jit
+def step(x):
+    QUERIES.inc()
+    LAT.observe(0.1)
+    return x + 1
+""",
+        """
+import jax
+from incubator_predictionio_tpu.obs import metrics
+
+QUERIES = metrics.REGISTRY.counter("q_total", "queries")
+
+@jax.jit
+def step(x, ids):
+    return x.at[ids].set(0.0)
+
+def serve(x, ids):
+    out = step(x, ids)
+    QUERIES.inc()
+    return out
+""",
+    ),
     "server-state": (
         """
 class Handler:
@@ -222,7 +252,7 @@ def _lint_source(tmp_path: Path, source: str, rule: str, name="fixture.py"):
 
 
 def test_registry_has_at_least_eight_rules():
-    assert len(ALL_RULES) >= 8
+    assert len(ALL_RULES) >= 10
     assert set(FIXTURES) == set(RULES_BY_NAME), (
         "every rule needs a positive/negative fixture pair")
 
@@ -316,6 +346,24 @@ def launch(x, precise):
     assert len(findings) == 1 and "time.time" in findings[0].message
     # and `precise` (partial-bound) must be static for tracer-branch
     assert not _lint_source(tmp_path, src, "tracer-branch")
+
+
+def test_metric_set_flagged_but_chained_at_set_exempt(tmp_path):
+    """`g.set(...)`-style metric writes in a trace are flagged while the
+    JAX functional-update idiom — including chained `.at[].set()` — is
+    not."""
+    src = """
+import jax
+
+@jax.jit
+def step(x, ids, g):
+    y = x.at[ids].set(0.0).at[0].set(1.0)
+    g.set(1.0)
+    return y
+"""
+    findings = _lint_source(tmp_path, src, "metric-in-trace")
+    assert len(findings) == 1
+    assert ".set() metric mutation" in findings[0].message
 
 
 def test_write_baseline_preserves_justifications(tmp_path):

@@ -1,0 +1,449 @@
+"""obs telemetry layer: registry semantics, Prometheus exposition
+conformance, /metrics on every server, and trace-ID propagation.
+
+The exposition tests parse the text format with a strict mini-parser
+(line grammar + histogram invariants) rather than string-matching, so a
+malformed scrape fails loudly. The e2e test drives the real four-server
+stack: event ingest and prediction queries carry an ``X-PIO-Trace-Id``
+header that must come back on the response AND appear in the JSON span
+log line (the docs/observability.md propagation contract).
+"""
+
+import json
+import logging
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fake_engine import AP, make_engine, params
+from incubator_predictionio_tpu import native
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs import trace as obs_trace
+from incubator_predictionio_tpu.obs.metrics import Registry
+from incubator_predictionio_tpu.servers.admin import AdminServer
+from incubator_predictionio_tpu.servers.dashboard import DashboardServer
+from incubator_predictionio_tpu.servers.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+from incubator_predictionio_tpu.servers.prediction_server import (
+    PredictionServer,
+    ServerConfig,
+)
+from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+# -- exposition mini-parser (the conformance oracle) ------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    # optional label set; quoted values may hold ANY escaped content,
+    # including braces (route patterns like /cmd/app/{name})
+    r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
+    r" (-?(?:[0-9]*\.?[0-9]+(?:e[+-]?[0-9]+)?)|[+-]Inf|NaN)$")
+_LABEL_ITEM_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text):
+    """Validate + parse: returns (types, samples) where samples maps
+    (name, frozenset(label items)) -> float. Raises AssertionError on
+    any line that violates the text-format grammar."""
+    types, helps, samples = {}, {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, h = rest.partition(" ")
+            helps[name] = h
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, t = rest.partition(" ")
+            assert t in ("counter", "gauge", "histogram"), line
+            types[name] = t
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labelblob, value = m.groups()
+        labels = frozenset(
+            _LABEL_ITEM_RE.findall(labelblob or ""))
+        v = float("inf") if value == "+Inf" else float(value)
+        samples[(name, labels)] = v
+    # every sample's family must be declared (histogram children map to
+    # their family name)
+    for (name, _), _v in samples.items():
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or family in types, name
+    return types, samples
+
+
+def histogram_series(samples, name, labels=frozenset()):
+    """(sorted [(le, cumulative)], sum, count) for one histogram child."""
+    buckets = []
+    for (n, ls), v in samples.items():
+        if n == f"{name}_bucket" and labels <= ls:
+            le = dict(ls)["le"]
+            buckets.append((float("inf") if le == "+Inf" else float(le), v))
+    buckets.sort()
+    total = samples[(f"{name}_count", labels)]
+    s = samples[(f"{name}_sum", labels)]
+    return buckets, s, total
+
+
+def scrape(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        return resp.read().decode("utf-8")
+
+
+# -- registry unit behavior -------------------------------------------------
+
+def test_exposition_format_conformance():
+    reg = Registry()
+    c = reg.counter("t_requests_total", "requests", labels=("route",))
+    c.labels(route="/a").inc(3)
+    c.labels(route='/with"quote').inc()
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(7.5)
+    h = reg.histogram("t_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    types, samples = parse_exposition(reg.expose())
+    assert types["t_requests_total"] == "counter"
+    assert types["t_depth"] == "gauge"
+    assert types["t_lat_seconds"] == "histogram"
+    assert samples[("t_requests_total",
+                    frozenset({("route", "/a")}))] == 3
+    assert samples[("t_depth", frozenset())] == 7.5
+    buckets, s, total = histogram_series(samples, "t_lat_seconds")
+    assert total == 2 and s == pytest.approx(5.05)
+    # cumulative buckets are monotone and +Inf equals the count
+    assert [b for b, _ in buckets] == [0.1, 1.0, float("inf")]
+    assert [v for _, v in buckets] == [1, 1, 2]
+
+
+def test_metric_and_label_name_validation():
+    reg = Registry()
+    with pytest.raises(ValueError):
+        reg.counter("bad-name", "x")
+    with pytest.raises(ValueError):
+        reg.counter("ok_name", "x", labels=("bad-label",))
+
+
+def test_get_or_create_and_kind_mismatch():
+    reg = Registry()
+    a = reg.counter("t_total", "x")
+    assert reg.counter("t_total", "x") is a
+    with pytest.raises(ValueError):
+        reg.gauge("t_total", "x")
+    with pytest.raises(ValueError):
+        reg.counter("t_total", "x", labels=("l",))
+    # a histogram bucket-layout mismatch raises too (silently sharing
+    # a series binned by the wrong bounds would produce lying quantiles)
+    h = reg.histogram("t_b_seconds", "x", buckets=(1.0, 2.0))
+    assert reg.histogram("t_b_seconds", "x") is h           # no opinion
+    assert reg.histogram("t_b_seconds", "x", buckets=(1.0, 2.0)) is h
+    with pytest.raises(ValueError):
+        reg.histogram("t_b_seconds", "x", buckets=(1.0, 4.0))
+
+
+def test_counter_rejects_negative_and_labels_mismatch():
+    reg = Registry()
+    c = reg.counter("t_n_total", "x")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    lc = reg.counter("t_l_total", "x", labels=("a",))
+    with pytest.raises(ValueError):
+        lc.labels(b="1")
+
+
+def test_histogram_bucket_math_and_quantiles():
+    reg = Registry()
+    h = reg.histogram("t_h_seconds", "x", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 10.0):
+        h.observe(v)
+    _types, samples = parse_exposition(reg.expose())
+    buckets, s, total = histogram_series(samples, "t_h_seconds")
+    assert [v for _, v in buckets] == [1, 2, 3, 4]
+    assert total == 4 and s == pytest.approx(15.0)
+    # boundary value lands in its own le bucket (le semantics)
+    h2 = reg.histogram("t_h2_seconds", "x", buckets=(1.0, 2.0))
+    h2.observe(1.0)
+    assert h2._solo().snapshot()[0] == [1, 0, 0]
+    # quantiles: linear interpolation inside the bucket
+    assert h.quantile(0.5) == pytest.approx(2.0)
+    assert h.quantile(1.0) == pytest.approx(4.0)  # overflow clamps
+    assert reg.histogram("t_empty_seconds", "x").quantile(0.5) is None
+
+
+def test_weighted_observe_counts_n():
+    reg = Registry()
+    h = reg.histogram("t_w_seconds", "x", buckets=(1.0,))
+    h.observe(0.5, 64)
+    assert h.count == 64 and h.sum == pytest.approx(32.0)
+    assert h.quantile(0.99) <= 1.0
+
+
+def test_concurrent_increment_correctness():
+    reg = Registry()
+    c = reg.counter("t_conc_total", "x")
+    h = reg.histogram("t_conc_seconds", "x", buckets=(1.0,))
+    n_threads, per_thread = 8, 5000
+
+    def work():
+        for _ in range(per_thread):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+    assert h.sum == pytest.approx(0.5 * n_threads * per_thread)
+
+
+def test_collector_runs_at_scrape_and_replaces_by_name():
+    reg = Registry()
+    g = reg.gauge("t_coll", "x")
+    reg.register_collector("k", lambda: g.set(1))
+    reg.register_collector("k", lambda: g.set(2))  # replaces
+    reg.expose()
+    assert g.value == 2
+    # a failing collector is skipped, never fails the scrape
+    reg.register_collector("boom", lambda: 1 / 0)
+    assert "t_coll" in reg.expose()
+
+
+def test_trace_id_accept_and_generate():
+    assert obs_trace.accept_trace_id("abc-123.X:ok") == "abc-123.X:ok"
+    fresh = obs_trace.accept_trace_id(None)
+    assert re.fullmatch(r"[0-9a-f]{16}", fresh)
+    # malformed (spaces / too long / log-breaking bytes) is REPLACED
+    assert obs_trace.accept_trace_id("has space") != "has space"
+    assert obs_trace.accept_trace_id("x" * 200) != "x" * 200
+    assert obs_trace.accept_trace_id('inj"ect\n') != 'inj"ect\n'
+
+
+# -- the four-server stack --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stack():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    app_id = Storage.get_meta_data_apps().insert(App(0, "obs-app"))
+    Storage.get_meta_data_access_keys().insert(AccessKey("obskey", app_id))
+    engine = make_engine()
+    # run_train exports the workflow-phase gauges as a side effect
+    CoreWorkflow.run_train(engine, params(ds=9, algos=[("algo0", AP(1))]),
+                           engine_variant="obs")
+    es = EventServer(EventServerConfig(ip="127.0.0.1", port=0, stats=True))
+    ps = PredictionServer(engine, ServerConfig(
+        ip="127.0.0.1", port=0, engine_variant="obs"))
+    ad = AdminServer(ip="127.0.0.1", port=0)
+    db = DashboardServer(ip="127.0.0.1", port=0)
+    ports = {
+        "event": es.start_background(),
+        "prediction": ps.start_background(),
+        "admin": ad.start_background(),
+        "dashboard": db.start_background(),
+    }
+    yield ports
+    for srv in (es, ps, ad, db):
+        srv.stop()
+    Storage.reset()
+
+
+def post(port, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"null")
+
+
+EV = {"event": "rate", "entityType": "user", "entityId": "u1",
+      "targetEntityType": "item", "targetEntityId": "i1",
+      "properties": {"rating": 5}}
+
+
+def test_metrics_route_on_all_four_servers(stack):
+    # exercise ingest + batch + query first so the scrape has content
+    status, _h, _b = post(stack["event"], "/events.json?accessKey=obskey",
+                          EV)
+    assert status == 201
+    status, _h, _b = post(stack["event"],
+                          "/batch/events.json?accessKey=obskey", [EV, EV])
+    assert status == 200
+    status, _h, body = post(stack["prediction"], "/queries.json", {"qx": 4})
+    assert status == 200 and body["qx"] == 4
+
+    for name, port in stack.items():
+        types, samples = parse_exposition(scrape(port))
+        # the shared HTTP-layer metrics exist everywhere
+        assert types["pio_http_requests_total"] == "counter", name
+        assert types["pio_http_request_seconds"] == "histogram", name
+
+    _types, samples = parse_exposition(scrape(stack["event"]))
+    # per-event ingest counters, by route pattern and status
+    assert samples[("pio_ingest_events_total", frozenset(
+        {("route", "/events.json"), ("status", "201")}))] >= 1
+    assert samples[("pio_ingest_events_total", frozenset(
+        {("route", "/batch/events.json"), ("status", "201")}))] >= 2
+    # batch-size histogram booked once for the 2-event batch
+    buckets, _s, total = histogram_series(samples, "pio_ingest_batch_size")
+    assert total >= 1
+
+    types, samples = parse_exposition(scrape(stack["prediction"]))
+    # per-query latency histogram + queue-depth gauge
+    _buckets, lat_sum, lat_count = histogram_series(
+        samples, "pio_query_latency_seconds")
+    assert lat_count >= 1 and lat_sum > 0
+    assert ("pio_serve_queue_depth", frozenset()) in samples
+    # workflow-phase gauges exported by run_train (one scrape sees the
+    # whole process: serving AND the last training run)
+    assert samples[("pio_workflow_phase_seconds", frozenset(
+        {("phase", "checkpoint")}))] >= 0
+    assert samples[("pio_workflow_runs_total", frozenset())] >= 1
+
+
+def test_compile_cache_metrics_registered(tmp_path, monkeypatch):
+    from incubator_predictionio_tpu.utils import compile_cache
+
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
+    compile_cache.enable(str(tmp_path))
+    text = obs_metrics.REGISTRY.expose()
+    types, samples = parse_exposition(text)
+    assert types["pio_compile_cache_hits_total"] == "counter"
+    assert types["pio_compile_cache_requests_total"] == "counter"
+    # the miss gauge derives at scrape time (requests - hits)
+    assert ("pio_compile_cache_misses", frozenset()) in samples
+
+
+def test_status_page_tail_latency(stack):
+    post(stack["prediction"], "/queries.json", {"qx": 7})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{stack['prediction']}/", timeout=30) as resp:
+        info = json.loads(resp.read())
+    # p50/p95/p99 derived from the histogram — visible without a scraper
+    assert info["servingSecP50"] is not None
+    assert info["servingSecP95"] is not None
+    assert info["servingSecP99"] >= info["servingSecP50"] > 0
+
+
+def test_trace_id_e2e_response_and_span_log(stack, caplog):
+    tid = "e2e-trace-0042"
+    with caplog.at_level(logging.INFO, logger="pio.trace"):
+        status, headers, _b = post(
+            stack["event"], "/events.json?accessKey=obskey", EV,
+            headers={"X-PIO-Trace-Id": tid})
+        assert status == 201
+        assert headers["X-PIO-Trace-Id"] == tid
+        status, headers, _b = post(
+            stack["prediction"], "/queries.json", {"qx": 1},
+            headers={"X-PIO-Trace-Id": tid})
+        assert status == 200
+        assert headers["X-PIO-Trace-Id"] == tid
+    spans = [json.loads(r.getMessage()) for r in caplog.records
+             if r.name == "pio.trace"]
+    mine = [s for s in spans if s["traceId"] == tid]
+    routes = {(s["server"], s["route"]) for s in mine}
+    assert ("event", "/events.json") in routes
+    assert ("prediction", "/queries.json") in routes
+    for s in mine:
+        assert s["span"] == "http.request"
+        assert s["durationMs"] >= 0
+        assert s["status"] in (200, 201)
+
+
+def test_trace_id_generated_when_absent(stack):
+    status, headers, _b = post(
+        stack["event"], "/events.json?accessKey=obskey", EV)
+    assert status == 201
+    assert re.fullmatch(r"[0-9a-f]{16}", headers["X-PIO-Trace-Id"])
+    # malformed incoming ids are replaced, never echoed
+    status, headers, _b = post(
+        stack["event"], "/events.json?accessKey=obskey", EV,
+        headers={"X-PIO-Trace-Id": "bad id with spaces"})
+    assert headers["X-PIO-Trace-Id"] != "bad id with spaces"
+
+
+def test_unmatched_routes_collapse_to_one_series(stack):
+    for path in ("/nope/a", "/nope/b"):
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{stack['event']}{path}", timeout=30)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    _types, samples = parse_exposition(scrape(stack["event"]))
+    assert samples[("pio_http_requests_total", frozenset(
+        {("server", "event"), ("method", "GET"),
+         ("route", "<unmatched>"), ("status", "404")}))] >= 2
+    # a method mismatch on a KNOWN path books under the real route
+    # pattern, not <unmatched> — 405 traffic is not scanner noise
+    status, _h, _b = post(stack["event"], "/", {})
+    assert status == 405
+    _types, samples = parse_exposition(scrape(stack["event"]))
+    assert samples[("pio_http_requests_total", frozenset(
+        {("server", "event"), ("method", "POST"),
+         ("route", "/"), ("status", "405")}))] >= 1
+
+
+@pytest.mark.skipif(native.load() is None,
+                    reason="native library unavailable")
+def test_native_storage_metrics_bridge(tmp_path):
+    """cpplog's group-commit and scan counters surface as gauges on the
+    process registry at scrape time."""
+    import numpy as np
+
+    from incubator_predictionio_tpu.data.storage import base, cpplog
+    from incubator_predictionio_tpu.data.storage import (
+        StorageClientConfig,
+    )
+
+    client = cpplog.StorageClient(
+        StorageClientConfig(properties={"PATH": str(tmp_path)}))
+    events = cpplog.CppLogEvents(client, client.config, prefix="t_")
+    try:
+        ids = events.insert_interactions(
+            base.Interactions(
+                user_idx=np.array([0, 1], np.int32),
+                item_idx=np.array([0, 0], np.int32),
+                values=np.array([5.0, 3.0], np.float32),
+                user_ids=["u1", "u2"], item_ids=["i1"]),
+            app_id=1)
+        assert len(ids) == 2
+        events.scan_interactions(app_id=1, event_names=("rate",),
+                                 value_prop="rating")
+        types, samples = parse_exposition(obs_metrics.REGISTRY.expose())
+        assert samples[("pio_group_commit_events", frozenset())] >= 2
+        assert samples[("pio_group_commit_appends", frozenset())] >= 1
+        assert ("pio_scan_wall_seconds", frozenset()) in samples
+        assert ("pio_scan_lock_held_seconds", frozenset()) in samples
+        assert samples[("pio_scan_rows", frozenset())] >= 2
+        assert types["pio_scan_shards"] == "gauge"
+    finally:
+        obs_metrics.REGISTRY.unregister_collector("cpplog_native")
+        client.close()

@@ -501,6 +501,183 @@ def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
     }
 
 
+#: continuation-retrain record keys (docs/performance.md "Steady-state
+#: retrain"): the O(delta) steady-state contract — after a ≤5% event
+#: tail, continuation (warm factors + early-stop + plan reuse) must
+#: finish in ≤ 1/3 of the fresh-retrain wall at RMSE parity
+RETRAIN_KEYS = (
+    "retrain_fresh_wall_s", "retrain_continue_wall_s",
+    "retrain_sweeps_used", "retrain_delta_rows", "retrain_scan_s",
+    "retrain_prep_fresh_s", "retrain_prep_continue_s",
+    "retrain_heldout_rmse_fresh", "retrain_heldout_rmse_continue",
+    "retrain_speedup",
+)
+
+
+def bench_retrain(store_dir, state, inter, heldout, truth):
+    """Steady-state retrain leg: append a tail, re-ingest (traincache
+    fold), then measure fresh-vs-continuation retrain walls.
+
+    Fresh = full prep + fixed-budget warm train from random init.
+    Continue = plan-reuse prep splice + warm factors + convergence
+    early-stop, timed end to end (the splice is part of the wall — the
+    plan is reset to its pre-tail state before the timed run so the
+    O(delta) fold is actually measured). Both train walls are WARM
+    (compile excluded, same convention as measure_train). Guarded by the
+    global bench deadline: PIO_BENCH_EMIT_BY_EPOCH (set by the
+    orchestrator from PIO_BENCH_DEADLINE_S) skips the leg rather than
+    cost the record."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.data.storage import (
+        StorageClientConfig,
+        cpplog,
+    )
+    from incubator_predictionio_tpu.data.storage.base import (
+        IdTable,
+        Interactions,
+    )
+    from incubator_predictionio_tpu.ops import als, retrain
+    from incubator_predictionio_tpu.ops.sparse import build_both_sides
+
+    out = dict.fromkeys(RETRAIN_KEYS)
+    emit_by = float(os.environ.get("PIO_BENCH_EMIT_BY_EPOCH", "0"))
+    if emit_by and time.time() > emit_by - 120.0:
+        log("retrain leg skipped: bench deadline too close")
+        return out
+    tail_frac = float(os.environ.get("PIO_BENCH_RETRAIN_TAIL", "0.05"))
+    tail_n = max(int(NNZ * tail_frac), 1)
+    rng = np.random.default_rng(13)
+    t_users, t_items = _sample_pairs(rng, tail_n)
+    u_true, v_true = truth
+    signal = np.einsum("nk,nk->n", u_true[t_users], v_true[t_items])
+    t_vals = (3.5 + signal
+              + rng.normal(0, NOISE_SIGMA, tail_n)).astype(np.float32)
+
+    # -- append the tail through the native columnar import --------------
+    cfg = StorageClientConfig(properties={"PATH": store_dir})
+    client = cpplog.StorageClient(cfg)
+    events = cpplog.CppLogEvents(client, cfg, prefix="bench_")
+    try:
+        wrote = events.import_interactions(
+            Interactions(
+                user_idx=t_users, item_idx=t_items, values=t_vals,
+                user_ids=IdTable.from_list(
+                    [f"u{k}" for k in range(N_USERS)]),
+                item_ids=IdTable.from_list(
+                    [f"i{k}" for k in range(N_ITEMS)]),
+            ), 1, event_name="rate", value_prop="rating")
+        assert wrote == tail_n
+
+        # -- re-ingest: the traincache tail fold (O(delta) scan) ---------
+        stats: dict = {}
+        t0 = time.perf_counter()
+        inter2 = events.scan_interactions(
+            app_id=1, entity_type="user", target_entity_type="item",
+            event_names=("rate",), value_prop="rating", stats=stats)
+        scan_s = time.perf_counter() - t0
+        delta_rows = int(stats.get("scan_tail_rows", tail_n))
+        n_users2, n_items2 = len(inter2.user_ids), len(inter2.item_ids)
+
+        # -- fresh leg: full prep + fixed-budget train from random init --
+        t0 = time.perf_counter()
+        (uf_l, uf_h), (if_l, if_h) = build_both_sides(
+            inter2.user_idx, inter2.item_idx, inter2.values,
+            n_users2, n_items2)
+        uf_t, if_t = als._buckets_tree(uf_l), als._buckets_tree(if_l)
+        uf_hv, if_hv = als._heavy_tree(uf_h), als._heavy_tree(if_h)
+        prep_fresh_s = time.perf_counter() - t0
+
+        def train_fresh():
+            st = als._mixed_run(
+                als.als_init(jax.random.key(0), n_users2, n_items2, RANK),
+                uf_t, if_t, L2, ITERATIONS, BF16_SWEEPS, True,
+                jnp.float32, jax.lax.Precision.HIGHEST,
+                user_heavy=uf_hv, item_heavy=if_hv)
+            np.asarray(st.user_factors[0:1, 0:1])
+            np.asarray(st.item_factors[0:1, 0:1])
+            return st
+
+        state_f = train_fresh()          # compile
+        t0 = time.perf_counter()
+        state_f = train_fresh()          # warm
+        train_fresh_s = time.perf_counter() - t0
+
+        # -- continue leg: plan splice + warm factors + early stop -------
+        prev = als.ALSState(
+            user_factors=np.asarray(state.user_factors),
+            item_factors=np.asarray(state.item_factors))
+
+        def seed_plan():
+            retrain.drop_plans()
+            retrain.prepare_with_reuse(
+                inter.user_idx, inter.item_idx, inter.values,
+                len(inter.user_ids), len(inter.item_ids),
+                plan_key="bench")
+
+        rs: dict = {}
+
+        def train_cont():
+            rs.clear()
+            st = retrain.als_retrain(
+                inter2.user_idx, inter2.item_idx, inter2.values,
+                n_users2, n_items2, rank=RANK, iterations=ITERATIONS,
+                l2=L2, seed=0, bf16_sweeps=BF16_SWEEPS,
+                prev_state=prev, plan_key="bench", stats=rs)
+            np.asarray(st.user_factors[0:1, 0:1])
+            np.asarray(st.item_factors[0:1, 0:1])
+            return st
+
+        from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+        seed_plan()
+        state_c = train_cont()           # compile + first fold
+        seed_plan()                      # reset so the timed run re-folds
+        sweeps_before = obs_metrics.REGISTRY.counter(
+            "pio_train_sweeps_total", "ALS sweeps actually run by "
+            "training, by schedule mode", labels=("mode",)
+        ).labels(mode="continue").value
+        t0 = time.perf_counter()
+        state_c = train_cont()           # warm, O(delta) splice included
+        cont_wall_s = time.perf_counter() - t0
+        # registry cross-check over the TIMED run only (the compile run
+        # books its own sweeps — a raw snapshot would double-count)
+        sweeps_booked = obs_metrics.REGISTRY.get(
+            "pio_train_sweeps_total").labels(mode="continue").value \
+            - sweeps_before
+        prep_cont_s = rs.get("prep_wall_s")  # the O(delta) splice wall
+
+        ho_f, _p1 = quality_metrics(state_f, inter2, heldout, truth, rng)
+        ho_c, _p2 = quality_metrics(state_c, inter2, heldout, truth, rng)
+        fresh_wall = prep_fresh_s + train_fresh_s
+        out.update({
+            "retrain_fresh_wall_s": round(fresh_wall, 3),
+            "retrain_continue_wall_s": round(cont_wall_s, 3),
+            "retrain_sweeps_used": int(rs.get("sweeps_used", 0)),
+            "retrain_delta_rows": delta_rows,
+            "retrain_scan_s": round(scan_s, 3),
+            "retrain_prep_fresh_s": round(prep_fresh_s, 3),
+            "retrain_prep_continue_s": (None if prep_cont_s is None
+                                        else round(prep_cont_s, 3)),
+            "obs_train_sweeps_continue": int(sweeps_booked),
+            "retrain_heldout_rmse_fresh": round(ho_f, 3),
+            "retrain_heldout_rmse_continue": round(ho_c, 3),
+            "retrain_speedup": round(fresh_wall / max(cont_wall_s, 1e-9),
+                                     2),
+        })
+        log(f"retrain: tail={tail_n} (delta_rows={delta_rows}) "
+            f"scan={scan_s:.2f}s fresh={fresh_wall:.2f}s "
+            f"(prep {prep_fresh_s:.2f}s) continue={cont_wall_s:.2f}s "
+            f"({rs.get('sweeps_used')} sweeps, "
+            f"mode={rs.get('mode')}, plan={rs.get('prep_plan')}) "
+            f"heldout fresh={ho_f:.3f} continue={ho_c:.3f}")
+        retrain.drop_plans()
+    finally:
+        client.close()
+    return out
+
+
 #: registry cross-check keys (docs/observability.md): the telemetry
 #: layer and the bench time THE SAME stages, so their numbers must
 #: corroborate — obs_ingest_events_total vs the seeded HTTP load,
@@ -511,6 +688,7 @@ OBS_KEYS = (
     "obs_http_requests_total", "obs_query_latency_count",
     "obs_query_latency_sum_s", "obs_query_p50_ms", "obs_query_p99_ms",
     "obs_compile_cache_hits", "obs_compile_cache_requests",
+    "obs_train_sweeps_continue",
 )
 
 
@@ -544,6 +722,10 @@ def obs_snapshot() -> dict:
     reqs = reg.get("pio_compile_cache_requests_total")
     if reqs is not None:
         out["obs_compile_cache_requests"] = int(reqs.value)
+    # obs_train_sweeps_continue is NOT snapshotted here: the retrain leg
+    # computes it as the counter delta over its timed run (bench_retrain)
+    # so it corroborates retrain_sweeps_used exactly — a raw snapshot
+    # would include the compile run's sweeps and read as a 2× lie
     return out
 
 
@@ -779,6 +961,14 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
 
     attn = bench_attention()
     serve = bench_serving(state, inter)
+    # steady-state retrain leg last: a failure here must never cost the
+    # train/serve numbers already measured
+    retrain_frag = dict.fromkeys(RETRAIN_KEYS)
+    try:
+        retrain_frag.update(
+            bench_retrain(store_dir, state, inter, heldout, truth))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"retrain leg failed ({e!r}); retrain_* keys null this round")
 
     fragment = {
         "value": round(train_s, 3),
@@ -796,14 +986,16 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
         "e2e_train_wall_s": round(ingest_s + prep_s + train_s, 1),
         **kernel_probe,
         **attn,
+        **retrain_frag,
         "serve_p50_ms": serve["p50_ms"],
         "serve_p99_ms": serve["p99_ms"],
         "serve_qps": serve["qps_sequential"],
         "serve_qps_concurrent": serve["qps_concurrent"],
         "serve_max_batch": serve["max_batch"],
         # registry cross-check for the stages the CHILD ran (serving,
-        # compiles); the ingest-side obs_* keys belong to the parent —
-        # never shipped from here, even as None (update() overwrites)
+        # compiles; the retrain leg ships its own obs_train_* delta);
+        # the ingest-side obs_* keys belong to the parent — never
+        # shipped from here, even as None (update() overwrites)
         **{k: v for k, v in obs_snapshot().items()
            if k.startswith(("obs_query_", "obs_compile_"))},
     }
@@ -1004,6 +1196,11 @@ def run_orchestrator() -> None:
 
     t_bench0 = time.monotonic()
     emit_by = t_bench0 + BENCH_DEADLINE_S - EMIT_MARGIN_S
+    # wall-clock deadline for the CHILD (monotonic clocks don't cross
+    # process boundaries): optional legs (retrain) skip themselves when
+    # the record must go out soon
+    os.environ["PIO_BENCH_EMIT_BY_EPOCH"] = str(
+        time.time() + BENCH_DEADLINE_S - EMIT_MARGIN_S)
 
     rng = np.random.default_rng(7)
     log(f"dataset: {N_USERS}x{N_ITEMS}, nnz={NNZ}, rank={RANK}, "
@@ -1156,6 +1353,8 @@ def run_orchestrator() -> None:
         "als_kernel_rows": None,
         "als_kernel_sweep_xla_s": None,
         "flash_kernel_active": None,
+        # steady-state retrain leg (child-only; docs/performance.md)
+        **dict.fromkeys(RETRAIN_KEYS),
         # how long the supervised-child leg ran and how it ended — makes
         # a wedged-lease round diagnosable from the record alone
         # child_ok counts as claiming evidence too: a fragment can land

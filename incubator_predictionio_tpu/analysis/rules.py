@@ -48,19 +48,28 @@ _HOST_SYNC_CALLS = {
     "numpy.array",
 }
 _HOST_SYNC_ATTRS = {"block_until_ready", "item"}
+#: builtin scalar coercions that force a device fetch when fed a traced
+#: value — the per-sweep ``float(delta) < tol`` convergence-check
+#: anti-pattern (the probe pattern fetches OUTSIDE the trace, once per
+#: PIO_RETRAIN_PROBE_EVERY-sweep chunk; see ops/retrain.py)
+_SCALAR_COERCIONS = {"float", "int", "bool"}
+_JAX_VALUED_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.ops.", "jax.nn.")
 
 
 class HostSyncInTrace(Rule):
     name = "host-sync"
     severity = "error"
     doc = ("host-sync call (jax.device_get / .block_until_ready() / "
-           "np.asarray / .item()) inside a jit/pjit/shard_map-traced "
-           "function — inside a trace these operate on tracers, either "
-           "raising TracerError or silently baking a device round-trip "
-           "into every step")
+           "np.asarray / .item() / float()-on-a-traced-value) inside a "
+           "jit/pjit/shard_map-traced function — inside a trace these "
+           "operate on tracers, either raising TracerError or silently "
+           "baking a device round-trip into every step; fetch outside "
+           "the trace (e.g. the chunked convergence probe, "
+           "ops/retrain.py)")
 
     def check(self, mod: Module) -> Iterator[Finding]:
-        for root, _statics in mod.traced_roots:
+        for root, statics in mod.traced_roots:
+            params = _param_names(root) - statics
             for node in ast.walk(root):
                 if not isinstance(node, ast.Call):
                     continue
@@ -79,6 +88,29 @@ class HostSyncInTrace(Rule):
                         f".{node.func.attr}() inside traced function "
                         f"{_root_name(root)!r} — move the host sync "
                         "outside the trace")
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in _SCALAR_COERCIONS
+                        and len(node.args) == 1
+                        and _is_jax_valued(mod, node.args[0], params)):
+                    yield mod.finding(
+                        self, node,
+                        f"{node.func.id}() on a traced value inside "
+                        f"{_root_name(root)!r} — a per-step host sync "
+                        "(or TracerError); fetch the scalar outside the "
+                        "trace (chunked probe pattern)")
+
+
+def _is_jax_valued(mod: Module, expr: ast.AST,
+                   params: "Set[str]") -> bool:
+    """Heuristic: the expression is (or contains) a jnp/lax call, or is a
+    bare non-static traced parameter — the cases where a builtin scalar
+    coercion must materialize a device value."""
+    if isinstance(expr, ast.Name):
+        return expr.id in params
+    return any(
+        isinstance(sub, ast.Call)
+        and (mod.resolved(sub.func) or "").startswith(_JAX_VALUED_PREFIXES)
+        for sub in ast.walk(expr))
 
 
 def _root_name(root: ast.AST) -> str:

@@ -170,6 +170,20 @@ class Algorithm(_Component, Generic[PD, M, Q, P]):
     def train(self, ctx: RuntimeContext, prepared_data: PD) -> M:
         raise NotImplementedError
 
+    def train_with_previous(
+        self, ctx: RuntimeContext, prepared_data: PD, prev_model: Any
+    ) -> M:
+        """Continuation-retrain hook: train with the previous run's model
+        available as a warm start (the steady-state O(delta) path —
+        ops/retrain.py). The DEFAULT ignores ``prev_model`` and trains
+        fresh, so algorithms without a continuation story are untouched.
+        Implementations MUST validate compatibility themselves (rank /
+        index-space prefix / hyperparameters) and fall back to
+        ``self.train`` when the prior model cannot seed this one — a
+        wrong warm start silently corrupts the model, while a refused
+        one only costs a cold train."""
+        return self.train(ctx, prepared_data)
+
     def predict(self, model: M, query: Q) -> P:
         raise NotImplementedError
 

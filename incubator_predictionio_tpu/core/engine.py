@@ -125,7 +125,14 @@ class Engine:
         ctx: RuntimeContext,
         engine_params: EngineParams,
         params: Optional[WorkflowParams] = None,
+        prev_models: Optional[List[Any]] = None,
     ) -> List[Any]:
+        """``prev_models`` (aligned with the algorithm list) enables the
+        continuation-retrain path: each algorithm receives its previous
+        model through ``Algorithm.train_with_previous`` and decides
+        itself whether it can seed from it (CoreWorkflow.run_train loads
+        them from the last COMPLETED instance behind the
+        ``PIO_RETRAIN_CONTINUE`` knob)."""
         params = params or WorkflowParams()
         data_source, preparator, algo_list, _ = self._components(engine_params)
         logger.info("Engine.train: ds=%s prep=%s algos=%s",
@@ -150,8 +157,13 @@ class Engine:
 
         models = []
         for i, algo in enumerate(algo_list):
+            prev = (prev_models[i]
+                    if prev_models is not None and i < len(prev_models)
+                    else None)
             with tracing.phase(f"train.algo{i}"):
-                models.append(algo.train(ctx, pd))
+                models.append(
+                    algo.train_with_previous(ctx, pd, prev)
+                    if prev is not None else algo.train(ctx, pd))
         for model in models:
             _sanity(model, params.skip_sanity_check)
         return models

@@ -89,6 +89,20 @@ class BiMap(Generic[K, V]):
             out[k] = v
         return BiMap(out)
 
+    def is_index_prefix_of(self, other: "BiMap[K, int]") -> bool:
+        """True when every (key → index) pair of this map holds verbatim
+        in ``other`` — i.e. this map's dense index space is an exact
+        prefix of the other's. THE compatibility gate of the
+        continuation retrain (ops/retrain.py): the traincache tail fold
+        interns ids in stable first-seen order, so a prior model's
+        BiMaps must satisfy this against the new PreparedData's, or its
+        factor rows would seed the wrong entities. Order-independent
+        (compares actual pairs, not iteration order), O(len(self))."""
+        if len(self) > len(other):
+            return False
+        get = other._fwd.get
+        return all(get(k) == v for k, v in self._fwd.items())
+
     def __eq__(self, other: object) -> bool:
         return isinstance(other, BiMap) and self._fwd == other._fwd
 

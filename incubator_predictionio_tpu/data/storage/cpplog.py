@@ -274,6 +274,21 @@ class CppLogEvents(base.Events):
                       "interaction rows the last full scan returned"
                       ).set(scan.get("scan_rows", 0))
 
+    def _export_retrain_delta(self, tail_rows: int) -> None:
+        """pio_retrain_delta_rows — the event delta the last cache-served
+        scan actually re-scanned (the O(delta) steady-state figure).
+        Booked once per scan on the host path; never inside a trace."""
+        try:
+            from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.REGISTRY.gauge(
+                "pio_retrain_delta_rows",
+                "event rows appended since the previous training scan "
+                "(the tail the cache fold re-scanned)",
+            ).set(tail_rows)
+        except Exception:
+            logger.exception("retrain-delta gauge export failed")
+
     def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
         return self.client.handle(self.ns, app_id, channel_id)
 
@@ -658,7 +673,7 @@ class CppLogEvents(base.Events):
                     inter = self._serve_from_cache(
                         h, cache, cpath, raw, dead, entity_type,
                         target_entity_type, names[0], value_prop,
-                        start_time, until_time)
+                        start_time, until_time, stats=stats)
                     if inter is not None:
                         return inter
             unbounded = start_time is None and until_time is None
@@ -672,6 +687,7 @@ class CppLogEvents(base.Events):
                 target_entity_type, names, fixed, value_prop,
                 default_value, stats=stats, shard_sink=shard_sink)
             self._last_scan_stats = stats
+            stats.setdefault("scan_source", "scan")
             # times are always non-decreasing here: _merge_shards restores
             # global time order whenever the log held an inversion
             if seed and len(inter) >= traincache.MIN_NNZ:
@@ -684,19 +700,29 @@ class CppLogEvents(base.Events):
                         vals=inter.values, times=times,
                         user_tab=inter.user_ids, item_tab=inter.item_ids,
                         raw_count=raw, dead_count=dead),
-                    dead)
+                    dead,
+                    plan=(traincache.plan_path_for(
+                        str(cpath)[: -len(".traincache")]), None))
             return inter
         finally:
             self.client.unpin(pin)
 
-    def _seed_cache_revalidated(self, h, cpath, cache, dead: int) -> None:
+    def _seed_cache_revalidated(self, h, cpath, cache, dead: int,
+                                plan=None) -> None:
         """Publish a projection cache built from a lock-free scan: the
         (potentially hundreds-of-MB) file is serialized OUTSIDE the
         client lock; only the snapshot revalidation + atomic rename run
         under it. Commits only while the dead count still matches the
         scan's snapshot — a delete that landed during the scan may have
         killed rows the result still carries, and a cache seeded from it
-        would serve stale rows later."""
+        would serve stale rows later.
+
+        ``plan``: optional ``(plan_path, (user_degrees, item_degrees) |
+        None)`` — the prep-plan sidecar published (or recomputed) next to
+        the cache, keyed to the same snapshot, so the next training prep
+        skips its degree pass (O(delta) steady-state retrain)."""
+        import numpy as np
+
         from incubator_predictionio_tpu.data.storage import traincache
 
         staged = traincache.stage(cpath, cache)
@@ -709,6 +735,19 @@ class CppLogEvents(base.Events):
         finally:
             if not committed:
                 staged.abort()
+        if committed and plan is not None:
+            ppath, degrees = plan
+            if degrees is None:
+                degrees = (
+                    np.bincount(cache.uidx, minlength=len(cache.user_tab)
+                                ).astype(np.int64),
+                    np.bincount(cache.iidx, minlength=len(cache.item_tab)
+                                ).astype(np.int64))
+            try:
+                traincache.save_plan(ppath, cache.spec, cache.raw_count,
+                                     cache.dead_count, *degrees)
+            except OSError:
+                logger.exception("prep-plan sidecar write failed")
 
     @staticmethod
     def _resolve_shards(span: int) -> int:
@@ -915,17 +954,35 @@ class CppLogEvents(base.Events):
 
     def _serve_from_cache(self, h, cache, cpath, raw, dead, entity_type,
                           target_entity_type, name, value_prop,
-                          start_time, until_time):
+                          start_time, until_time, stats=None):
         """Tail-scan + merge + time-filter; None → caller full-scans.
         Caller has validated the cache and PINNED the handle (the client
         lock is NOT held — the tail scan runs lock-free; the fold write
-        revalidates the snapshot under the lock)."""
+        revalidates the snapshot under the lock).
+
+        ``stats`` gains the continuation-retrain telemetry:
+        ``scan_source`` ("cache"), ``scan_tail_rows`` (the event delta —
+        also exported as the ``pio_retrain_delta_rows`` gauge) and the
+        per-side degree histograms (``plan_user_degrees`` /
+        ``plan_item_degrees``) maintained O(delta) through the prep-plan
+        sidecar so training prep can skip its degree pass."""
         import dataclasses
 
         import numpy as np
 
         from incubator_predictionio_tpu.data.storage import traincache
 
+        # the plan sidecar sits next to the cache: <log>.prepplan. Only
+        # unbounded scans can use (or maintain) it — a time-filtered
+        # query's degrees would describe the wrong row set, so it must
+        # not pay the sidecar read at all
+        unbounded = start_time is None and until_time is None
+        ppath = traincache.plan_path_for(
+            str(cpath)[: -len(".traincache")])
+        plan = (traincache.load_plan(
+            ppath, cache.spec, cache.raw_count, cache.dead_count)
+            if unbounded else None)
+        tail_rows = 0
         if raw > cache.raw_count:
             # records appended since the cache was written: scan just
             # them — bounded at the snapshot count so rows appended
@@ -941,21 +998,72 @@ class CppLogEvents(base.Events):
                     cache.user_tab, tail.user_ids)
                 itab, iremap = traincache.merge_tables(
                     cache.item_tab, tail.item_ids)
+                tail_u, tail_i = uremap[tail.user_idx], iremap[tail.item_idx]
+                tail_rows = len(tail)
                 cache = dataclasses.replace(
                     cache,
-                    uidx=np.concatenate([cache.uidx, uremap[tail.user_idx]]),
-                    iidx=np.concatenate([cache.iidx, iremap[tail.item_idx]]),
+                    uidx=np.concatenate([cache.uidx, tail_u]),
+                    iidx=np.concatenate([cache.iidx, tail_i]),
                     vals=np.concatenate([cache.vals, tail.values]),
                     times=np.concatenate([cache.times, tail_times]),
                     user_tab=utab, item_tab=itab,
                     raw_count=raw, dead_count=dead)
+                if plan is not None:
+                    # O(delta) plan maintenance: pad the histograms to
+                    # the merged table sizes, add the tail's counts
+                    ud = np.zeros(len(utab), np.int64)
+                    ud[:len(plan[0])] = plan[0]
+                    id_ = np.zeros(len(itab), np.int64)
+                    id_[:len(plan[1])] = plan[1]
+                    ud += np.bincount(tail_u, minlength=len(utab))
+                    id_ += np.bincount(tail_i, minlength=len(itab))
+                    plan = (ud, id_)
                 if len(tail) * 100 >= len(cache):
                     # persist the fold only when the tail is ≥1% of the
                     # cache: smaller tails re-scan in microseconds, while
-                    # the rewrite is O(cache) disk traffic per train
-                    self._seed_cache_revalidated(h, cpath, cache, dead)
+                    # the rewrite is O(cache) disk traffic per train.
+                    # A missing plan bootstraps HERE (one O(n) bincount)
+                    # so the sidecar write happens exactly once
+                    if plan is None and unbounded:
+                        plan = (np.bincount(
+                                    cache.uidx,
+                                    minlength=len(cache.user_tab)
+                                ).astype(np.int64),
+                                np.bincount(
+                                    cache.iidx,
+                                    minlength=len(cache.item_tab)
+                                ).astype(np.int64))
+                    self._seed_cache_revalidated(h, cpath, cache, dead,
+                                                 plan=(ppath, plan))
             # empty tail: skip the rewrite — re-checking the tail is a
             # cheap header walk, rewriting the cache is not
+        if stats is not None and unbounded:
+            stats["scan_source"] = "cache"
+            stats["scan_tail_rows"] = int(tail_rows)
+            stats["scan_rows"] = int(len(cache))
+            if plan is None:
+                # bootstrap: one O(n) bincount now buys O(delta) forever
+                plan = (np.bincount(cache.uidx,
+                                    minlength=len(cache.user_tab)
+                                    ).astype(np.int64),
+                        np.bincount(cache.iidx,
+                                    minlength=len(cache.item_tab)
+                                    ).astype(np.int64))
+                if tail_rows == 0:
+                    # only key the sidecar to a snapshot that is actually
+                    # on disk — an unpersisted fold's key would never
+                    # match the next scan's cache load (the persisted
+                    # fold saved its plan above)
+                    try:
+                        traincache.save_plan(ppath, cache.spec,
+                                             cache.raw_count,
+                                             cache.dead_count, *plan)
+                    except OSError:
+                        logger.exception(
+                            "prep-plan bootstrap write failed")
+            stats["plan_user_degrees"] = plan[0]
+            stats["plan_item_degrees"] = plan[1]
+            self._export_retrain_delta(tail_rows)
         if start_time is None and until_time is None:
             return base.Interactions(
                 user_idx=cache.uidx, item_idx=cache.iidx, values=cache.vals,

@@ -197,6 +197,72 @@ def write(path: Path, cache: TrainCache) -> None:
 
 def invalidate(log_path: str | Path) -> None:
     path_for(log_path).unlink(missing_ok=True)
+    plan_path_for(log_path).unlink(missing_ok=True)
+
+
+# ---------------------------------------------------------------------------
+# prep-plan sidecar: the per-side degree histograms, persisted alongside
+# the projection and keyed on the SAME (raw_count, dead_count, spec)
+# snapshot. A matching plan lets the next training prep skip the native
+# degree/plan pass entirely (ops/sparse.build_padded_rows ``degrees``) and
+# is maintained O(delta) at fold time (add the tail's bincount); any key
+# mismatch just means "no plan" — prep recomputes, correctness never
+# depends on it.
+# ---------------------------------------------------------------------------
+
+_PLAN_MAGIC = "pio-prepplan"
+_PLAN_VERSION = 1
+
+
+def plan_path_for(log_path: str | Path) -> Path:
+    return Path(str(log_path) + ".prepplan")
+
+
+def save_plan(path: Path, spec: Spec, raw_count: int, dead_count: int,
+              user_degrees: np.ndarray, item_degrees: np.ndarray) -> None:
+    """Atomically publish the degree histograms for one cache snapshot."""
+    hdr = json.dumps({
+        "magic": _PLAN_MAGIC, "version": _PLAN_VERSION,
+        "spec": spec.to_json(),
+        "raw_count": int(raw_count), "dead_count": int(dead_count),
+        "n_users": int(len(user_degrees)),
+        "n_items": int(len(item_degrees)),
+    }).encode() + b"\n"
+    tmp = path.with_suffix(
+        path.suffix + f".tmp{os.getpid()}.{next(_stage_seq)}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(hdr)
+            np.ascontiguousarray(user_degrees, np.int64).tofile(f)
+            np.ascontiguousarray(item_degrees, np.int64).tofile(f)
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+    os.replace(tmp, path)
+
+
+def load_plan(path: Path, spec: Spec, raw_count: int,
+              dead_count: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """→ (user_degrees, item_degrees) when the plan matches the exact
+    (spec, raw_count, dead_count) snapshot; None on any mismatch or a
+    torn/corrupt file (which just reads as 'no plan')."""
+    try:
+        with open(path, "rb") as f:
+            hdr = json.loads(f.readline(1 << 16))
+            if (hdr.get("magic") != _PLAN_MAGIC
+                    or hdr.get("version") != _PLAN_VERSION
+                    or Spec.from_json(hdr["spec"]) != spec
+                    or int(hdr["raw_count"]) != raw_count
+                    or int(hdr["dead_count"]) != dead_count):
+                return None
+            nu, ni = int(hdr["n_users"]), int(hdr["n_items"])
+            ud = np.fromfile(f, np.int64, nu)
+            id_ = np.fromfile(f, np.int64, ni)
+            if len(ud) != nu or len(id_) != ni:
+                return None
+        return ud, id_
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -229,6 +229,48 @@ class ECommAlgorithm(Algorithm):
             rank=self.params.rank, iterations=self.params.num_iterations,
             l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
         )
+        return self._assemble_model(pd, state)
+
+    def train_with_previous(
+        self, ctx: RuntimeContext, pd: PreparedData, prev_model: Any
+    ) -> ECommModel:
+        """Continuation retrain: both factor tables seed from the prior
+        model when both BiMaps are exact index prefixes of the new
+        PreparedData's (the traincache first-seen contract); otherwise
+        train fresh."""
+        from incubator_predictionio_tpu.ops.als import ALSState
+
+        ok = (isinstance(prev_model, ECommModel)
+              and np.asarray(prev_model.user_factors).ndim == 2
+              and np.asarray(prev_model.user_factors).shape[1]
+              == self.params.rank
+              and prev_model.user_bimap.is_index_prefix_of(pd.user_bimap)
+              and prev_model.item_bimap.is_index_prefix_of(pd.item_bimap))
+        if not ok:
+            return self.train(ctx, pd)
+        from incubator_predictionio_tpu.ops.retrain import als_retrain
+
+        from incubator_predictionio_tpu.models.recommendation.engine import (
+            _plan_key,
+        )
+
+        seed = self.params.seed if self.params.seed is not None else ctx.seed
+        stats: Dict[str, Any] = {}
+        state = als_retrain(
+            pd.users, pd.items, pd.weights,
+            n_users=len(pd.user_bimap), n_items=len(pd.item_bimap),
+            rank=self.params.rank, iterations=self.params.num_iterations,
+            l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
+            implicit=True, plan_key=_plan_key("ecomm", pd),
+            prev_state=ALSState(
+                user_factors=np.asarray(prev_model.user_factors),
+                item_factors=np.asarray(prev_model.item_factors)),
+            stats=stats)
+        logger.info("ecommerce continuation retrain: %s sweeps (mode=%s)",
+                    stats.get("sweeps_used"), stats.get("mode"))
+        return self._assemble_model(pd, state)
+
+    def _assemble_model(self, pd: PreparedData, state) -> ECommModel:
         # seen set honors params.seen_events — only those event types make an
         # item "seen" (a viewed-but-not-bought item stays recommendable when
         # seen_events=("buy",)), so re-read the raw events by name

@@ -313,6 +313,18 @@ class RecommendationPreparator(Preparator):
         )
 
 
+def _plan_key(tag: str, pd: Any) -> str:
+    """Process-resident prep-plan key for one training stream.
+
+    Derived from the stream's FIRST interned ids — stable across tail
+    folds (first-seen interning never reorders existing ids). Two
+    streams sharing first ids would collide, which is SAFE: the plan
+    verifies a full COO prefix digest before any reuse, so a collision
+    only costs a fresh rebuild, never a wrong splice."""
+    return (f"{tag}:{next(iter(pd.user_bimap), '')}"
+            f":{next(iter(pd.item_bimap), '')}")
+
+
 # ---------------------------------------------------------------------------
 # ALS algorithm (ALSAlgorithm.scala:25-31 → ops.als)
 # ---------------------------------------------------------------------------
@@ -391,6 +403,60 @@ class ALSAlgorithm(Algorithm):
             "ALS trained: %d users × %d items, rank %d",
             n_users, n_items, self.params.rank,
         )
+        return self._assemble_model(pd, state)
+
+    def train_with_previous(
+        self, ctx: RuntimeContext, pd: PreparedData, prev_model: Any
+    ) -> ALSModel:
+        """Continuation retrain (ops/retrain.py): seed from the previous
+        model's factors when its id space is an exact prefix of this
+        PreparedData's, and let the convergence early-stop turn the warm
+        start into fewer sweeps. Any incompatibility (rank change, index
+        space rebuilt, sharded run) falls back to a fresh train."""
+        import jax
+
+        seed = self.params.seed if self.params.seed is not None else ctx.seed
+        prev_state = self._continuation_seed(pd, prev_model)
+        if prev_state is None or (
+                ctx.model_parallelism > 1 and jax.device_count() > 1):
+            return self.train(ctx, pd)
+        from incubator_predictionio_tpu.ops.retrain import als_retrain
+
+        n_users, n_items = len(pd.user_bimap), len(pd.item_bimap)
+        stats: Dict[str, Any] = {}
+        state = als_retrain(
+            pd.users, pd.items, pd.ratings, n_users, n_items,
+            rank=self.params.rank, iterations=self.params.num_iterations,
+            l2=self.params.lambda_, seed=seed,
+            bf16_sweeps=self.params.bf16_sweeps,
+            prev_state=prev_state, plan_key=_plan_key("rec", pd),
+            stats=stats)
+        logger.info(
+            "ALS continuation retrain: %d users × %d items, rank %d, "
+            "%s sweeps (mode=%s, delta=%.3e)", n_users, n_items,
+            self.params.rank, stats.get("sweeps_used"),
+            stats.get("mode"), stats.get("final_delta", float("nan")))
+        return self._assemble_model(pd, state)
+
+    def _continuation_seed(self, pd: PreparedData, prev_model: Any):
+        """Prior factors as an (ungrown) ALSState, or None when they
+        cannot seed this training run."""
+        from incubator_predictionio_tpu.ops.als import ALSState
+
+        if not isinstance(prev_model, ALSModel):
+            return None
+        uf = np.asarray(prev_model.user_factors)
+        vf = np.asarray(prev_model.item_factors)
+        if uf.ndim != 2 or vf.ndim != 2 or uf.shape[1] != vf.shape[1] \
+                or uf.shape[1] != self.params.rank:
+            return None
+        if not (prev_model.user_bimap.is_index_prefix_of(pd.user_bimap)
+                and prev_model.item_bimap.is_index_prefix_of(
+                    pd.item_bimap)):
+            return None
+        return ALSState(user_factors=uf, item_factors=vf)
+
+    def _assemble_model(self, pd: PreparedData, state) -> ALSModel:
         user_seen: Dict[int, Any] = {}
         for u, i in zip(pd.users.tolist(), pd.items.tolist()):
             user_seen.setdefault(u, []).append(i)

@@ -225,6 +225,56 @@ class SimilarProductAlgorithm(Algorithm):
             item_categories=pd.item_categories,
         )
 
+    def train_with_previous(
+        self, ctx: RuntimeContext, pd: PreparedData, prev_model: Any
+    ) -> SimilarProductModel:
+        """Continuation retrain: the stored model only keeps the
+        unit-normalized item factors, so the warm start seeds the ITEM
+        side from them (scale is recovered within the first sweep — the
+        user half-sweep solves against whatever item factors exist) and
+        the user side starts fresh. Incompatible priors (rank change,
+        rebuilt item id space) fall back to a cold train."""
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.als import ALSState
+
+        prev_items = (np.asarray(prev_model.item_factors_norm)
+                      if isinstance(prev_model, SimilarProductModel)
+                      else None)
+        if (prev_items is None or prev_items.ndim != 2
+                or prev_items.shape[1] != self.params.rank
+                or not prev_model.item_bimap.is_index_prefix_of(
+                    pd.item_bimap)):
+            return self.train(ctx, pd)
+        from incubator_predictionio_tpu.ops.retrain import als_retrain
+
+        from incubator_predictionio_tpu.models.recommendation.engine import (
+            _plan_key,
+        )
+
+        seed = self.params.seed if self.params.seed is not None else ctx.seed
+        stats: Dict[str, Any] = {}
+        state = als_retrain(
+            pd.users, pd.items, pd.weights,
+            n_users=len(pd.user_bimap), n_items=len(pd.item_bimap),
+            rank=self.params.rank, iterations=self.params.num_iterations,
+            l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
+            implicit=True, plan_key=_plan_key("simprod", pd),
+            prev_state=ALSState(
+                user_factors=np.zeros((0, self.params.rank), np.float32),
+                item_factors=prev_items),
+            stats=stats)
+        logger.info("similarproduct continuation retrain: %s sweeps "
+                    "(mode=%s)", stats.get("sweeps_used"),
+                    stats.get("mode"))
+        factors = state.item_factors
+        norm = jnp.linalg.norm(factors, axis=1, keepdims=True)
+        return SimilarProductModel(
+            item_factors_norm=factors / jnp.maximum(norm, 1e-9),
+            item_bimap=pd.item_bimap,
+            item_categories=pd.item_categories,
+        )
+
     def prepare_model(self, ctx, model: SimilarProductModel) -> SimilarProductModel:
         import jax
 

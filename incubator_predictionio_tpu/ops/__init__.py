@@ -14,11 +14,14 @@ from incubator_predictionio_tpu.ops.als import (
     als_init,
     als_sweep,
     als_train,
+    continue_state,
     rmse,
 )
+from incubator_predictionio_tpu.ops.retrain import als_retrain
 from incubator_predictionio_tpu.ops.topk import top_k_with_exclusions
 
 __all__ = [
     "PaddedRows", "build_padded_rows", "ALSState", "als_init", "als_sweep",
-    "als_train", "rmse", "top_k_with_exclusions",
+    "als_train", "als_retrain", "continue_state", "rmse",
+    "top_k_with_exclusions",
 ]

@@ -58,6 +58,56 @@ def als_init(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def _grow_factors(prev: jax.Array, key: jax.Array, n_rows: int,
+                  scale: float) -> jax.Array:
+    """Prefix-copy a factor table into a larger index space → [n_rows, K].
+
+    The traincache tail fold interns ids in stable first-seen order, so a
+    previous model's rows map onto the new index space as an EXACT prefix
+    — no gather, no remap (and therefore none of the negative-padding
+    wraparound `_gather_x0` clamps against): the old table is copied
+    row-for-row device-side and only the NEW ids get ``als_init``-scale
+    random rows appended. Not donated: checkpointed prev factors arrive
+    as host numpy (never donatable — the annotation would only warn)."""
+    pu, rank = prev.shape
+    if n_rows == pu:
+        return prev.astype(jnp.float32)
+    fresh = scale * jax.random.normal(key, (n_rows - pu, rank), jnp.float32)
+    return jnp.concatenate([prev.astype(jnp.float32), fresh])
+
+
+def continue_state(
+    prev_user: Any,            # [U0, K] prior user factors (host or device)
+    prev_item: Any,            # [I0, K] prior item factors
+    n_users: int,
+    n_items: int,
+    seed: int = 0,
+    scale: float = 0.1,
+) -> Optional[ALSState]:
+    """Seed a retrain from a previous model's factors (the cross-retrain
+    continuation of the O(delta) steady-state path).
+
+    Returns None when the prior tables cannot be a prefix of the new
+    index space (more rows than the new table — ids were deleted or the
+    index space was rebuilt, so row i no longer names the same entity);
+    the caller then falls back to ``als_init``. The caller is
+    responsible for verifying the id-space prefix property itself (the
+    engines check the BiMap prefix; see models/*/engine.py)."""
+    prev_user = jnp.asarray(prev_user)
+    prev_item = jnp.asarray(prev_item)
+    if (prev_user.ndim != 2 or prev_item.ndim != 2
+            or prev_user.shape[1] != prev_item.shape[1]
+            or prev_user.shape[0] > n_users
+            or prev_item.shape[0] > n_items):
+        return None
+    ku, ki = jax.random.split(jax.random.key(seed))
+    return ALSState(
+        user_factors=_grow_factors(prev_user, ku, n_users, scale),
+        item_factors=_grow_factors(prev_item, ki, n_items, scale),
+    )
+
+
 def _gram_rhs_nnz(
     other_factors: jax.Array,  # [M, K]
     cols: jax.Array,           # [..., D] int32
@@ -756,12 +806,16 @@ def als_train_implicit(
     (user_light, user_heavy), (item_light, item_heavy) = build_both_sides(
         users, items, weights, n_users, n_items, max_width=max_width)
     state = als_init(jax.random.key(seed), n_users, n_items, rank)
-    return _als_run_fused(
+    out = _als_run_fused(
         state, _buckets_tree(user_light), _buckets_tree(item_light),
         l2, alpha, iterations, True, jnp.float32, precision, implicit=True,
         user_heavy=_heavy_tree(user_heavy), item_heavy=_heavy_tree(item_heavy),
         warmstart=_CG_WARMSTART,
     )
+    from incubator_predictionio_tpu.ops.retrain import _book_sweeps
+
+    _book_sweeps("fresh", iterations)
+    return out
 
 
 def als_train_sharded(
@@ -1013,6 +1067,93 @@ def _als_run_fused(
     return jax.lax.fori_loop(0, iterations, body, state)
 
 
+def _rel_delta(prev: ALSState, new: ALSState) -> jax.Array:
+    """Relative Frobenius factor movement of one sweep → f32 scalar.
+
+    THE plateau criterion of the convergence early-stop: ‖new − prev‖_F
+    over ‖prev‖_F across both sides. Scale-free, so one tolerance serves
+    every rank/λ/dataset, and an O(rows·K) reduction — noise next to a
+    sweep's Gram streams."""
+    num = (jnp.sum((new.user_factors - prev.user_factors) ** 2)
+           + jnp.sum((new.item_factors - prev.item_factors) ** 2))
+    den = (jnp.sum(prev.user_factors ** 2)
+           + jnp.sum(prev.item_factors ** 2))
+    return jnp.sqrt(num / jnp.maximum(den, 1e-30))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_sweeps", "min_sweeps", "reg_nnz", "compute_dtype",
+                     "precision", "implicit", "cg_iters", "use_kernel",
+                     "kernel_min_d", "kernel_rows", "warmstart"),
+    donate_argnames=("state",),
+)
+def _als_run_converge(
+    state: ALSState,
+    user_tree,
+    item_tree,
+    l2: float,
+    alpha: float,
+    tol,                        # f32 operand — NOT static (no recompiles)
+    max_sweeps: int,
+    min_sweeps: int,
+    reg_nnz: bool,
+    compute_dtype: Any,
+    precision: Any,
+    implicit: bool,
+    user_heavy=None,
+    item_heavy=None,
+    cg_iters: int = _CG_ITERS,
+    use_kernel: bool = False,
+    kernel_min_d: int = 0,
+    kernel_rows: int = 1,
+    warmstart: bool = False,
+) -> Tuple[ALSState, jax.Array, jax.Array]:
+    """Early-stopping fused run → (state, sweeps_run, last_delta).
+
+    ``lax.while_loop`` evaluates the plateau criterion (:func:`_rel_delta`
+    below ``tol``) DEVICE-SIDE every sweep, so the whole run is still one
+    dispatch and no per-sweep host sync exists (the `host-sync` lint
+    rule's contract). Floor: at least ``min_sweeps`` full sweep pairs
+    (and always ≥ 1 — the loop must produce a delta before it can judge
+    one); ceiling: the fixed ``max_sweeps`` budget. The returned
+    ``sweeps_run``/``last_delta`` are device scalars — callers fetch them
+    ONCE after the run (one sync per train, not per sweep). Calling with
+    ``min_sweeps == max_sweeps`` runs exactly that many sweeps and hands
+    back the last delta: the chunked-probe building block of the unfused
+    path (ops/retrain.py)."""
+    def sweep(st):
+        new_users = _sweep_side(
+            st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
+            l2, alpha, reg_nnz, compute_dtype, precision, implicit,
+            cg_iters=cg_iters, use_kernel=use_kernel,
+            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
+            prev_factors=st.user_factors if warmstart else None)
+        new_items = _sweep_side(
+            st.item_factors.shape[0], new_users, item_tree, item_heavy,
+            l2, alpha, reg_nnz, compute_dtype, precision, implicit,
+            cg_iters=cg_iters, use_kernel=use_kernel,
+            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
+            prev_factors=st.item_factors if warmstart else None)
+        return ALSState(user_factors=new_users, item_factors=new_items)
+
+    def cond(carry):
+        i, _st, d = carry
+        return jnp.logical_and(
+            i < max_sweeps,
+            jnp.logical_or(i < max(min_sweeps, 1), d >= tol))
+
+    def body(carry):
+        i, st, _d = carry
+        new = sweep(st)
+        return i + 1, new, _rel_delta(st, new)
+
+    i, st, d = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), state, jnp.float32(jnp.inf)))
+    return st, i, d
+
+
 def _mixed_run(
     state: ALSState,
     u_tree,
@@ -1122,4 +1263,10 @@ def als_train(
             reg_nnz, compute_dtype, precision,
             user_heavy=u_hv, item_heavy=i_hv,
         )
+    # obs bridge: the sweep counter books for fresh trains too, so
+    # /metrics' fresh-vs-continue split stays meaningful (lazy import —
+    # ops.retrain imports this module)
+    from incubator_predictionio_tpu.ops.retrain import _book_sweeps
+
+    _book_sweeps("fresh", iterations)
     return state, history

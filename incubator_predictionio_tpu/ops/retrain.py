@@ -1,0 +1,561 @@
+"""Continuation retrain — O(delta) steady-state training.
+
+The reference's Lambda loop re-runs `pio train` from zero on every
+refresh; the traincache tail fold (data/storage/traincache.py) already
+made the *scan* O(delta). This module makes the rest of the retrain wall
+scale with the event delta too:
+
+1. **Factor continuation** (`ops/als.continue_state`): the traincache
+   fold interns ids in stable first-seen order, so the previous model's
+   factor rows map onto the new index space as an exact prefix —
+   retraining seeds from them (device-side prefix copy) with random
+   rows appended for new ids only.
+2. **Convergence early-stop** (`ops/als._als_run_converge`): a warm
+   start converts directly into fewer sweeps only if the sweep budget is
+   adaptive — the fused path evaluates a relative-factor-delta plateau
+   criterion device-side inside ``lax.while_loop`` (no per-sweep host
+   sync; the `host-sync` lint contract), floored at one full sweep pair
+   and ceilinged at the fixed budget. The unfused path runs
+   ``PIO_RETRAIN_PROBE_EVERY``-sweep fused chunks and fetches the
+   in-trace delta once per chunk (the chunked probe).
+3. **Prep/plan reuse** (:class:`PrepPlan`): the degree histograms and
+   the padded bucket plan persist across retrains (process-resident,
+   keyed on the caller's plan key + a COO prefix digest). When only a
+   tail was appended, rows whose degree class is unchanged get their new
+   entries spliced into their existing padded slots — host-side in
+   place, device-side via pointwise scatters whose H2D payload is
+   O(delta) — and only rows that moved width class (or appeared) are
+   rebuilt, as small appended delta buckets. Unchanged buckets keep
+   their device trees resident across retrains.
+
+Correctness never depends on the reuse: any shape the plan cannot prove
+equivalent (prefix digest mismatch — e.g. the preparator's
+latest-wins dedup dropped an interior row — deletes, heavy/split rows,
+a row outgrowing ``max_width``) falls back to the fresh
+``build_both_sides`` path, which is byte-identical to a cold train.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from incubator_predictionio_tpu.ops import als
+from incubator_predictionio_tpu.ops.sparse import (
+    PaddedRows,
+    build_both_sides,
+    build_padded_rows,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def continue_enabled() -> bool:
+    """`PIO_RETRAIN_CONTINUE` (default on) — read per call, never frozen
+    at import (the env-import lint contract)."""
+    return os.environ.get("PIO_RETRAIN_CONTINUE", "1") not in (
+        "0", "off", "false")
+
+
+def retrain_tol() -> float:
+    """Plateau tolerance for the early-stop (relative factor delta per
+    sweep). 0 disables early stop (fixed budget).
+
+    Default 2e-2 is the measured plateau knee: on the planted bench
+    workload a warm continuation's per-sweep delta falls under 2e-2 by
+    sweep ~2-4 while its fit RMSE is already flat (0.2715 vs 0.2693
+    after the full 10-sweep budget — inside any noise floor), whereas a
+    FRESH run's delta stays above 3e-2 for its whole budget — so the
+    criterion cuts warm retrains hard without truncating cold trains
+    (docs/performance.md "Steady-state retrain")."""
+    return float(os.environ.get("PIO_RETRAIN_TOL", "2e-2"))
+
+
+def retrain_min_sweeps() -> int:
+    return max(int(os.environ.get("PIO_RETRAIN_MIN_SWEEPS", "1")), 1)
+
+
+def retrain_probe_every() -> int:
+    return max(int(os.environ.get("PIO_RETRAIN_PROBE_EVERY", "2")), 1)
+
+
+def _fused_early_stop() -> bool:
+    """1 (default): device-side lax.while_loop plateau; 0: host loop of
+    probe-sized fused chunks (one sync per chunk, never per sweep)."""
+    return os.environ.get("PIO_RETRAIN_FUSED", "1") not in (
+        "0", "off", "false")
+
+
+def plan_reuse_enabled() -> bool:
+    return os.environ.get("PIO_RETRAIN_PLAN", "1") not in (
+        "0", "off", "false")
+
+
+# ---------------------------------------------------------------------------
+# prep/plan reuse
+# ---------------------------------------------------------------------------
+
+def _coo_digest(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                upto: int) -> bytes:
+    """Digest of the first ``upto`` COO triplets — the prefix-equality
+    witness. O(upto) memory-bandwidth work (~0.3 s at 20M rows), paid
+    once per retrain to make reuse unconditionally safe."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.ascontiguousarray(rows[:upto], np.int64).tobytes())
+    h.update(np.ascontiguousarray(cols[:upto], np.int64).tobytes())
+    h.update(np.ascontiguousarray(vals[:upto], np.float32).tobytes())
+    return h.digest()
+
+
+def _width_classes(deg: np.ndarray, min_width: int) -> np.ndarray:
+    """Power-of-two bucket ceiling per row (0 for absent rows) — must
+    match build_padded_rows' width assignment exactly."""
+    d = np.maximum(deg, 1).astype(np.float64)
+    w = (1 << np.ceil(np.log2(d)).astype(np.int64)).astype(np.int64)
+    w = np.maximum(w, min_width)
+    return np.where(deg > 0, w, 0)
+
+
+@jax.jit
+def _set_entries(arr: jax.Array, pos: jax.Array, slot: jax.Array,
+                 val: jax.Array) -> jax.Array:
+    """Pointwise in-place splice of tail entries into a resident device
+    bucket: the H2D payload is the three O(delta) index/value vectors,
+    never the bucket itself."""
+    return arr.at[pos, slot].set(val)
+
+
+@jax.jit
+def _clear_rows(cols, vals, mask, row_ids, pos):
+    """Detach rows that moved to another width class: padding semantics
+    (row_id −1, zero mask) exactly like ``PaddedRows.pad_rows_to``."""
+    return (cols.at[pos].set(0), vals.at[pos].set(0.0),
+            mask.at[pos].set(0.0), row_ids.at[pos].set(-1))
+
+
+@dataclasses.dataclass
+class _SidePlan:
+    """One training orientation's bucket plan (host mirror + device
+    trees). The host arrays are the mutable source of truth; the device
+    tuples mirror them bucket-for-bucket."""
+
+    n_rows: int
+    degrees: np.ndarray                 # int64[n_rows]
+    buckets: List[PaddedRows]           # host mirror, spliced in place
+    trees: List[Tuple[Any, Any, Any, Any]]  # device (row_ids, cols, vals, mask)
+    row_bucket: np.ndarray              # int32[n_rows], -1 = absent
+    row_pos: np.ndarray                 # int32[n_rows]
+    min_width: int = 8
+    #: compaction bookkeeping: cleared (moved-away) slots never shrink a
+    #: bucket and every retrain may append delta buckets — past these
+    #: thresholds apply_tail refuses and the caller rebuilds a compact
+    #: fresh plan, bounding creep across long retrain sequences
+    dead_rows: int = 0
+    init_buckets: int = 0
+
+    @staticmethod
+    def build(buckets: List[PaddedRows], degrees: np.ndarray,
+              n_rows: int, min_width: int = 8) -> "_SidePlan":
+        row_bucket = np.full(n_rows, -1, np.int32)
+        row_pos = np.full(n_rows, -1, np.int32)
+        for bi, b in enumerate(buckets):
+            ids = np.asarray(b.row_ids)
+            live = np.flatnonzero(ids >= 0)
+            row_bucket[ids[live]] = bi
+            row_pos[ids[live]] = live.astype(np.int32)
+        return _SidePlan(
+            n_rows=n_rows, degrees=np.asarray(degrees, np.int64),
+            buckets=list(buckets),
+            trees=[als._buckets_tree([b])[0] for b in buckets],
+            row_bucket=row_bucket, row_pos=row_pos, min_width=min_width,
+            init_buckets=len(buckets))
+
+    def _grow_to(self, n_rows: int) -> None:
+        if n_rows > self.n_rows:
+            pad = n_rows - self.n_rows
+            self.degrees = np.concatenate(
+                [self.degrees, np.zeros(pad, np.int64)])
+            self.row_bucket = np.concatenate(
+                [self.row_bucket, np.full(pad, -1, np.int32)])
+            self.row_pos = np.concatenate(
+                [self.row_pos, np.full(pad, -1, np.int32)])
+            self.n_rows = n_rows
+
+    def apply_tail(self, tail_rows, tail_cols, tail_vals,
+                   full_rows, full_cols, full_vals,
+                   n_rows: int, max_width: int, row_multiple: int,
+                   stats: Dict[str, Any]) -> bool:
+        """Splice a tail into the resident plan; False → caller rebuilds.
+
+        Rows touched by the tail whose width class is unchanged keep
+        their padded slot — the new entries land in the padding region
+        (host fancy-index write + device pointwise scatter). Rows that
+        moved class (including newly-appeared rows) are cleared from
+        their old bucket and rebuilt from the full COO into appended
+        delta buckets. Untouched buckets are not touched at all."""
+        self._grow_to(n_rows)
+        tail_deg = np.bincount(tail_rows, minlength=n_rows).astype(np.int64)
+        new_deg = self.degrees + tail_deg
+        if len(tail_rows) and int(new_deg.max()) > max_width:
+            return False  # a row outgrew the plan: split-row territory
+        touched = np.flatnonzero(tail_deg)
+        old_w = _width_classes(self.degrees[touched], self.min_width)
+        new_w = _width_classes(new_deg[touched], self.min_width)
+        stay = touched[(old_w == new_w) & (self.degrees[touched] > 0)]
+        moved = touched[(old_w != new_w) | (self.degrees[touched] == 0)]
+
+        # compaction bound: refuse (→ fresh compact rebuild) once dead
+        # slots or appended delta buckets would dominate — otherwise a
+        # long retrain sequence creeps in padded solve work and memory
+        live = int((self.row_bucket >= 0).sum())
+        if (self.dead_rows + len(moved) > max(live, 1) // 4
+                or len(self.buckets) > 2 * self.init_buckets + 16):
+            return False
+
+        # -- stay rows: splice tail entries into their existing slots ----
+        if len(stay):
+            stay_lut = np.zeros(n_rows, bool)
+            stay_lut[stay] = True
+            sel = stay_lut[tail_rows]
+            rs, cs, vs = tail_rows[sel], tail_cols[sel], tail_vals[sel]
+            order = np.argsort(rs, kind="stable")  # keep scan order per row
+            rs, cs, vs = rs[order], cs[order], vs[order]
+            _uniq, first, counts = np.unique(
+                rs, return_index=True, return_counts=True)
+            within = np.arange(len(rs)) - np.repeat(first, counts)
+            slots = (self.degrees[rs] + within).astype(np.int32)
+            b_arr = self.row_bucket[rs]
+            p_arr = self.row_pos[rs]
+            for bi in np.unique(b_arr):
+                m = b_arr == bi
+                b = self.buckets[bi]
+                p, s = p_arr[m], slots[m]
+                b.cols[p, s] = cs[m]
+                b.vals[p, s] = vs[m]
+                b.mask[p, s] = 1.0
+                rids, dcols, dvals, dmask = self.trees[bi]
+                jp, js = jnp.asarray(p), jnp.asarray(s)
+                self.trees[bi] = (
+                    rids,
+                    _set_entries(dcols, jp, js, jnp.asarray(cs[m])),
+                    _set_entries(dvals, jp, js, jnp.asarray(vs[m])),
+                    _set_entries(dmask, jp, js,
+                                 jnp.ones(len(s), jnp.float32)),
+                )
+            stats["prep_spliced_entries"] = stats.get(
+                "prep_spliced_entries", 0) + int(len(rs))
+
+        # -- moved rows: clear old slots, rebuild into delta buckets -----
+        moved_present = moved[self.row_bucket[moved] >= 0]
+        if len(moved_present):
+            b_arr = self.row_bucket[moved_present]
+            p_arr = self.row_pos[moved_present]
+            for bi in np.unique(b_arr):
+                m = b_arr == bi
+                b = self.buckets[bi]
+                p = p_arr[m]
+                b.row_ids[p] = -1
+                b.cols[p, :] = 0
+                b.vals[p, :] = 0.0
+                b.mask[p, :] = 0.0
+                rids, dcols, dvals, dmask = self.trees[bi]
+                jp = jnp.asarray(p)
+                dcols, dvals, dmask, rids = _clear_rows(
+                    dcols, dvals, dmask, rids, jp)
+                self.trees[bi] = (rids, dcols, dvals, dmask)
+            self.row_bucket[moved_present] = -1
+            self.row_pos[moved_present] = -1
+            self.dead_rows += int(len(moved_present))
+        if len(moved):
+            lut = np.zeros(n_rows, bool)
+            lut[moved] = True
+            sel = lut[full_rows]
+            delta = build_padded_rows(
+                full_rows[sel], full_cols[sel], full_vals[sel], n_rows,
+                min_width=self.min_width, max_width=max_width,
+                row_multiple=row_multiple)
+            for b in delta:
+                bi = len(self.buckets)
+                self.buckets.append(b)
+                self.trees.append(als._buckets_tree([b])[0])
+                ids = np.asarray(b.row_ids)
+                live = np.flatnonzero(ids >= 0)
+                self.row_bucket[ids[live]] = bi
+                self.row_pos[ids[live]] = live.astype(np.int32)
+            stats["prep_rebuilt_rows"] = stats.get(
+                "prep_rebuilt_rows", 0) + int(len(moved))
+
+        self.degrees = new_deg
+        return True
+
+
+@dataclasses.dataclass
+class PrepPlan:
+    """Process-resident bucket plan for one (plan_key) training stream,
+    persisted across retrains alongside the traincache's scan state and
+    keyed on the COO prefix digest (the same append-only contract the
+    tail fold relies on)."""
+
+    key: str
+    nnz: int
+    digest: bytes
+    n_users: int
+    n_items: int
+    max_width: int
+    row_multiple: int
+    user: _SidePlan
+    item: _SidePlan
+
+    def trees(self):
+        """→ (u_tree, i_tree) in the ops/als fused-run format."""
+        return tuple(self.user.trees), tuple(self.item.trees)
+
+
+#: at most this many plans stay resident (each holds the padded host
+#: mirror of its dataset — hundreds of MB at ML-20M shape)
+_PLAN_CACHE_CAP = 2
+_PLAN_CACHE: Dict[str, PrepPlan] = {}
+
+
+def drop_plans() -> None:
+    """Tests / memory pressure: forget every resident plan."""
+    _PLAN_CACHE.clear()
+
+
+def prepare_with_reuse(
+    users: np.ndarray,
+    items: np.ndarray,
+    vals: np.ndarray,
+    n_users: int,
+    n_items: int,
+    max_width: int = 1 << 16,
+    row_multiple: int = 8,
+    plan_key: Optional[str] = None,
+    verify_prefix: bool = True,
+    user_degrees: Optional[np.ndarray] = None,
+    item_degrees: Optional[np.ndarray] = None,
+    stats: Optional[Dict[str, Any]] = None,
+):
+    """Degree-bucketed padded trees, reusing a resident plan when only a
+    tail was appended → (u_tree, i_tree, u_heavy, i_heavy).
+
+    ``plan_key`` names the training stream (e.g. the event-log path);
+    None disables reuse entirely (byte-identical to the fresh path).
+    ``verify_prefix=False`` skips the O(prefix) digest check for callers
+    that already hold the append-only guarantee (the traincache fold)."""
+    stats = {} if stats is None else stats
+    users = np.asarray(users)
+    items = np.asarray(items)
+    vals = np.asarray(vals, np.float32)
+    nnz = len(vals)
+    plan = _PLAN_CACHE.get(plan_key) if (
+        plan_key and plan_reuse_enabled()) else None
+    if plan is not None:
+        ok = (nnz >= plan.nnz and n_users >= plan.n_users
+              and n_items >= plan.n_items
+              and plan.max_width == max_width
+              and plan.row_multiple == row_multiple)
+        if ok and verify_prefix:
+            ok = _coo_digest(users, items, vals, plan.nnz) == plan.digest
+        if ok:
+            tr, tc, tv = users[plan.nnz:], items[plan.nnz:], vals[plan.nnz:]
+            u_ok = plan.user.apply_tail(
+                tr, tc, tv, users, items, vals, n_users, max_width,
+                row_multiple, stats)
+            i_ok = u_ok and plan.item.apply_tail(
+                tc, tr, tv, items, users, vals, n_items, max_width,
+                row_multiple, stats)
+            if u_ok and i_ok:
+                plan.nnz = nnz
+                plan.n_users, plan.n_items = n_users, n_items
+                plan.digest = _coo_digest(users, items, vals, nnz)
+                stats["prep_plan"] = "reused"
+                stats["prep_delta_rows"] = int(len(tr))
+                u_tree, i_tree = plan.trees()
+                return u_tree, i_tree, None, None
+            # a side bailed mid-splice: the plan's host/device state may
+            # be half-updated — drop it and rebuild fresh
+            _PLAN_CACHE.pop(plan_key, None)
+            stats["prep_plan"] = "rebuilt"
+        else:
+            _PLAN_CACHE.pop(plan_key, None)
+            stats["prep_plan"] = "invalidated"
+    else:
+        stats.setdefault(
+            "prep_plan",
+            "miss" if (plan_key and plan_reuse_enabled()) else "off")
+
+    (u_light, u_heavy), (i_light, i_heavy) = build_both_sides(
+        users, items, vals, n_users, n_items, max_width=max_width,
+        row_multiple=row_multiple,
+        # histograms from the scan's prep-plan sidecar (cpplog stats
+        # ``plan_user_degrees``/``plan_item_degrees``) skip the native
+        # degree pass; a wrong histogram is detected natively and redone
+        user_degrees=user_degrees, item_degrees=item_degrees)
+    if plan_key and plan_reuse_enabled() and u_heavy is None \
+            and i_heavy is None:
+        while len(_PLAN_CACHE) >= _PLAN_CACHE_CAP:
+            _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+        new_plan = PrepPlan(
+            key=plan_key, nnz=nnz,
+            digest=_coo_digest(users, items, vals, nnz),
+            n_users=n_users, n_items=n_items, max_width=max_width,
+            row_multiple=row_multiple,
+            user=_SidePlan.build(
+                u_light,
+                (user_degrees if user_degrees is not None
+                 else np.bincount(users, minlength=n_users)), n_users),
+            item=_SidePlan.build(
+                i_light,
+                (item_degrees if item_degrees is not None
+                 else np.bincount(items, minlength=n_items)), n_items),
+        )
+        _PLAN_CACHE[plan_key] = new_plan
+        u_tree, i_tree = new_plan.trees()
+        return u_tree, i_tree, None, None
+    return (als._buckets_tree(u_light), als._buckets_tree(i_light),
+            als._heavy_tree(u_heavy), als._heavy_tree(i_heavy))
+
+
+# ---------------------------------------------------------------------------
+# early-stopping training drivers
+# ---------------------------------------------------------------------------
+
+def _converge_leg(state, u_tree, i_tree, l2, alpha, tol, budget, floor,
+                  reg_nnz, compute_dtype, precision, implicit,
+                  u_hv, i_hv, cg_iters, use_kernel, kernel_min_d,
+                  kernel_rows, warmstart):
+    """One precision leg with early stop → (state, sweeps, delta).
+
+    Fused mode: the whole leg is one dispatch (`_als_run_converge`);
+    sweeps/delta are fetched once after it. Unfused mode: fused chunks
+    of PIO_RETRAIN_PROBE_EVERY sweeps, each returning its in-trace
+    last-sweep delta — the host fetches ONE scalar per chunk (the
+    chunked probe), never one per sweep."""
+    if _fused_early_stop():
+        state, n, d = als._als_run_converge(
+            state, u_tree, i_tree, l2, alpha, tol, budget, floor,
+            reg_nnz, compute_dtype, precision, implicit,
+            user_heavy=u_hv, item_heavy=i_hv, cg_iters=cg_iters,
+            use_kernel=use_kernel, kernel_min_d=kernel_min_d,
+            kernel_rows=kernel_rows, warmstart=warmstart)
+        return state, int(n), float(d)
+    probe = retrain_probe_every()
+    done, d = 0, float("inf")
+    while done < budget:
+        chunk = min(probe, budget - done)
+        state, _n, dd = als._als_run_converge(
+            state, u_tree, i_tree, l2, alpha, 0.0, chunk, chunk,
+            reg_nnz, compute_dtype, precision, implicit,
+            user_heavy=u_hv, item_heavy=i_hv, cg_iters=cg_iters,
+            use_kernel=use_kernel, kernel_min_d=kernel_min_d,
+            kernel_rows=kernel_rows, warmstart=warmstart)
+        done += chunk
+        d = float(dd)  # ONE host sync per chunk — the probe boundary
+        if done >= floor and tol > 0 and d < tol:
+            break
+    return state, done, d
+
+
+def als_retrain(
+    users: np.ndarray,
+    items: np.ndarray,
+    vals: np.ndarray,
+    n_users: int,
+    n_items: int,
+    rank: int = 64,
+    iterations: int = 10,
+    l2: float = 0.1,
+    alpha: float = 1.0,
+    seed: int = 0,
+    reg_nnz: bool = True,
+    implicit: bool = False,
+    bf16_sweeps: int = 0,
+    compute_dtype: Any = jnp.float32,
+    precision: Any = jax.lax.Precision.HIGHEST,
+    max_width: int = 1 << 16,
+    prev_state: Optional[als.ALSState] = None,
+    tol: Optional[float] = None,
+    min_sweeps: Optional[int] = None,
+    plan_key: Optional[str] = None,
+    verify_prefix: bool = True,
+    stats: Optional[Dict[str, Any]] = None,
+) -> als.ALSState:
+    """Continuation-aware training: warm factors + early stop + plan
+    reuse. With ``prev_state=None``, ``tol=0`` and ``plan_key=None``
+    this runs exactly the fixed-budget schedule of ``als_train`` /
+    ``als_train_implicit`` (their fresh paths stay byte-stable — this
+    entry point exists so they don't have to change).
+
+    ``stats`` (a dict) receives ``sweeps_used``, ``mode``
+    ("fresh"|"continue"), ``final_delta`` and the prep-reuse counters."""
+    import time
+
+    stats = {} if stats is None else stats
+    tol = retrain_tol() if tol is None else float(tol)
+    floor = retrain_min_sweeps() if min_sweeps is None else max(
+        int(min_sweeps), 1)
+    t_prep = time.perf_counter()
+    u_tree, i_tree, u_hv, i_hv = prepare_with_reuse(
+        users, items, vals, n_users, n_items, max_width=max_width,
+        plan_key=plan_key, verify_prefix=verify_prefix, stats=stats)
+    stats["prep_wall_s"] = time.perf_counter() - t_prep
+
+    state = None
+    if prev_state is not None:
+        state = als.continue_state(
+            prev_state.user_factors, prev_state.item_factors,
+            n_users, n_items, seed=seed)
+        if state is not None and state.user_factors.shape[1] != rank:
+            state = None  # rank changed: the prior factors are unusable
+    mode = "continue" if state is not None else "fresh"
+    if state is None:
+        state = als.als_init(jax.random.key(seed), n_users, n_items, rank)
+
+    warmstart = als._CG_WARMSTART
+    use_kernel = als._kernel_enabled(implicit, warm=warmstart)
+    kernel_min_d = als._KERNEL_MIN_D
+    kernel_rows = als._kernel_rows_default()
+    lo = 0 if implicit else min(max(bf16_sweeps, 0), iterations)
+    sweeps = 0
+    delta = float("inf")
+    if lo:
+        state, n, delta = _converge_leg(
+            state, u_tree, i_tree, l2, 0.0, tol, lo, min(floor, lo),
+            reg_nnz, jnp.bfloat16, jax.lax.Precision.DEFAULT, False,
+            u_hv, i_hv, min(als._CG_ITERS_BF16, als._CG_ITERS),
+            use_kernel, kernel_min_d, kernel_rows, warmstart)
+        sweeps += n
+    if iterations - lo > 0:
+        state, n, delta = _converge_leg(
+            state, u_tree, i_tree, l2, alpha, tol, iterations - lo,
+            max(floor - sweeps, 1), reg_nnz, compute_dtype, precision,
+            implicit, u_hv, i_hv, als._CG_ITERS, use_kernel,
+            kernel_min_d, kernel_rows, warmstart)
+        sweeps += n
+    stats.update(sweeps_used=sweeps, mode=mode, final_delta=delta)
+    _book_sweeps(mode, sweeps)
+    return state
+
+
+def _book_sweeps(mode: str, sweeps: int) -> None:
+    """pio_train_sweeps_total{mode} — the obs bridge for the retrain
+    path (booked OUTSIDE any trace; the metric-in-trace contract)."""
+    try:
+        from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.REGISTRY.counter(
+            "pio_train_sweeps_total",
+            "ALS sweeps actually run by training, by schedule mode",
+            labels=("mode",),
+        ).labels(mode=mode).inc(sweeps)
+    except Exception:  # telemetry must never fail a train
+        logger.exception("sweep-counter export failed")

@@ -134,14 +134,25 @@ def batch_score_top_k(
     batch sizes AND varying ``num`` compiles O(log max-batch · log catalog)
     variants total instead of one per distinct (B, num) pair. Callers slice
     row b of the packed [2, B_pad, k_pad] result to their own ``num``."""
+    import numpy as np
+
     B = len(rows)
-    pad = next_pow2(B)
     n_items = item_factors.shape[0]
     k_pad = min(next_pow2(int(k)), n_items)
-    rows_arr = jnp.asarray(
-        list(rows) + [rows[0]] * (pad - B), jnp.int32)
-    return _batch_score_top_k_xla(user_factors, item_factors, rows_arr,
-                                  k_pad)
+    if B == 0:
+        # an empty batch would otherwise index rows[0] below (and
+        # next_pow2(0) still pads to 1) — hand back an empty packed
+        # result without touching the device
+        return jnp.zeros((2, 0, k_pad), jnp.float32)
+    pad = next_pow2(B)
+    # vectorized pad (row 0 repeated), not a per-call Python list — this
+    # runs on the serving hot path for every fused micro-batch
+    rows_np = np.asarray(rows, np.int32).reshape(B)
+    if pad > B:
+        rows_np = np.concatenate(
+            [rows_np, np.full(pad - B, rows_np[0], np.int32)])
+    return _batch_score_top_k_xla(user_factors, item_factors,
+                                  jnp.asarray(rows_np), k_pad)
 
 
 def score_and_top_k(

@@ -37,6 +37,56 @@ logger = logging.getLogger(__name__)
 _UNSET = object()
 
 
+def _continuation_models(
+    engine_params: EngineParams,
+    engine_id: str,
+    engine_version: str,
+    engine_variant: str,
+) -> Optional[List[Any]]:
+    """Previous COMPLETED run's models for the continuation retrain, or
+    None when continuation is off / inapplicable.
+
+    Auto-disable (the spec-change guard): ANY difference in the stored
+    data-source / preparator / algorithm params invalidates the prior
+    model — a changed rank or λ makes its factors unusable, and a
+    changed data spec rebuilds the id space the prefix mapping relies
+    on. Strict JSON equality keeps the check simple; a refused
+    continuation only costs a cold train. Model-load failures likewise
+    degrade to fresh training — continuation is an optimization, never
+    a correctness dependency."""
+    from incubator_predictionio_tpu.ops.retrain import continue_enabled
+
+    if not continue_enabled():
+        return None
+    try:
+        prev = Storage.get_meta_data_engine_instances().get_latest_completed(
+            engine_id, engine_version, engine_variant)
+        if prev is None:
+            return None
+        current = (
+            json_codec.dumps(engine_params.data_source_params),
+            json_codec.dumps(engine_params.preparator_params),
+            json_codec.dumps(engine_params.algorithm_params_list),
+        )
+        stored = (prev.data_source_params, prev.preparator_params,
+                  prev.algorithms_params)
+        if current != stored:
+            logger.info(
+                "continuation disabled: engine params changed since "
+                "instance %s", prev.id)
+            return None
+        blob = Storage.get_model_data_models().get(prev.id)
+        if blob is None:
+            return None
+        models = checkpoint.deserialize_models(blob.models)
+        logger.info("continuation: seeding retrain from instance %s",
+                    prev.id)
+        return models
+    except Exception:
+        logger.exception("continuation model load failed; training fresh")
+        return None
+
+
 def make_runtime_context(
     workflow_params: Optional[WorkflowParams] = None,
 ) -> RuntimeContext:
@@ -168,8 +218,18 @@ class CoreWorkflow:
             # the first tracer.activate(); don't start the profiler again
             # over the cached models — it would emit an empty extra trace
             with tracer.activate(profile=pre_trained is _UNSET):
+                prev_models = None
+                if pre_trained is _UNSET:
+                    # continuation seed (single-host only — pod models are
+                    # sharded and the prefix mapping is per-host): timed as
+                    # its own phase so /metrics shows the seed-load leg
+                    with tracing.phase("continue_seed"):
+                        prev_models = _continuation_models(
+                            engine_params, engine_id, engine_version,
+                            engine_variant)
                 models = (pre_trained if pre_trained is not _UNSET
-                          else engine.train(ctx, engine_params, params))
+                          else engine.train(ctx, engine_params, params,
+                                            prev_models=prev_models))
                 algo_params = [
                     p for _n, p in engine_params.algorithm_params_list
                 ]

@@ -39,6 +39,15 @@ REQUIRED_FIELDS = {
     "sasrec_epoch_s": float,
     "accel_waited_s": float,
     "accel_outcome": str,
+    # steady-state retrain leg (docs/performance.md "Steady-state
+    # retrain"): the O(delta) continuation contract's record keys
+    "retrain_fresh_wall_s": float,
+    "retrain_continue_wall_s": float,
+    "retrain_sweeps_used": int,
+    "retrain_delta_rows": int,
+    "retrain_heldout_rmse_fresh": float,
+    "retrain_heldout_rmse_continue": float,
+    "retrain_speedup": float,
 }
 
 
@@ -101,3 +110,9 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
     # the selector on a Mosaic-less backend reports honestly
     assert rec["als_kernel"] in ("unavailable", "disabled", "on", "off",
                                  "probe_failed")
+    # retrain leg sanity: the continuation actually stopped early or at
+    # worst used the full budget, and the delta matches the 5% tail
+    assert 1 <= rec["retrain_sweeps_used"] <= rec["sweeps"]
+    assert rec["retrain_delta_rows"] >= 1
+    assert rec["retrain_continue_wall_s"] > 0
+    assert rec["retrain_fresh_wall_s"] > 0

@@ -28,6 +28,7 @@ FIXTURES = {
     "host-sync": (
         """
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 @jax.jit
@@ -36,9 +37,20 @@ def step(x):
     jax.device_get(x)
     x.block_until_ready()
     return host
+
+@jax.jit
+def train_sweeps(state):
+    # the per-sweep convergence-check anti-pattern: float() on a traced
+    # value forces a device round trip (or TracerError) EVERY sweep
+    for _ in range(10):
+        state = state * 0.5
+        if float(jnp.linalg.norm(state)) < 1e-3:
+            break
+    return state
 """,
         """
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 @jax.jit
@@ -47,6 +59,22 @@ def step(x):
 
 def fetch(x):
     return np.asarray(jax.device_get(x))
+
+@jax.jit
+def sweep_chunk(state):
+    # the early-stop probe pattern (ops/retrain.py): the delta is
+    # computed IN-trace and returned; the host fetches it outside
+    state = state * 0.5
+    return state, jnp.linalg.norm(state)
+
+def train(state, tol, budget=10):
+    done = 0
+    while done < budget:
+        state, delta = sweep_chunk(state)
+        done += 2
+        if float(delta) < tol:  # host sync at the probe boundary only
+            break
+    return state
 """,
     ),
     "neg-gather": (

@@ -1,0 +1,583 @@
+"""Continuation retrain: O(delta) steady-state training.
+
+Contracts under test (ops/retrain.py, ops/als.continue_state,
+workflow continuation plumbing):
+
+- the prefix id-mapping continuation relies on is byte-identical to the
+  traincache ``merge_tables`` remaps across tail folds, and breaks
+  (detectably) under deletes / unordered times;
+- plan-reused prep produces bitwise-identical training inputs to a
+  fresh build after a tail, and invalidates itself on any prefix break;
+- the convergence early-stop honors its floor (≥ min_sweeps, ≥ 1) and
+  ceiling (the fixed budget) on both the fused (while_loop) and the
+  unfused (chunked probe) paths;
+- continuation retrain after a small tail reaches fit quality at parity
+  with a fresh train;
+- the workflow auto-disables continuation on any spec/params change and
+  under PIO_RETRAIN_CONTINUE=0, and fresh-train behavior is untouched.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.ops import als, retrain
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plans():
+    retrain.drop_plans()
+    yield
+    retrain.drop_plans()
+
+
+def _coo(rng, n_u, n_i, nnz, rank=4):
+    u_true = rng.normal(0, 1 / np.sqrt(rank), (n_u, rank)).astype(np.float32)
+    v_true = rng.normal(0, 1, (n_i, rank)).astype(np.float32)
+    users = rng.integers(0, n_u, nnz).astype(np.int64)
+    items = rng.integers(0, n_i, nnz).astype(np.int64)
+    vals = (3.0 + np.einsum("nk,nk->n", u_true[users], v_true[items])
+            ).astype(np.float32)
+    return users, items, vals
+
+
+# ---------------------------------------------------------------------------
+# factor continuation
+# ---------------------------------------------------------------------------
+
+def test_continue_state_prefix_copy_is_exact():
+    prev_u = np.arange(12, dtype=np.float32).reshape(4, 3)
+    prev_i = -np.arange(6, dtype=np.float32).reshape(2, 3)
+    st = als.continue_state(prev_u, prev_i, 7, 5, seed=0)
+    uf = np.asarray(st.user_factors)
+    vf = np.asarray(st.item_factors)
+    assert uf.shape == (7, 3) and vf.shape == (5, 3)
+    # prefix rows are copied bit-for-bit — row i still names entity i
+    np.testing.assert_array_equal(uf[:4], prev_u)
+    np.testing.assert_array_equal(vf[:2], prev_i)
+    # appended rows are als_init-scale random, never zero/copies
+    assert np.all(np.any(uf[4:] != 0, axis=1))
+    assert np.std(uf[4:]) < 1.0  # scale 0.1 noise, not garbage
+
+
+def test_continue_state_refuses_shrunk_index_space():
+    prev = np.zeros((5, 3), np.float32)
+    assert als.continue_state(prev, prev, 4, 5, seed=0) is None  # users shrank
+    assert als.continue_state(prev, prev, 5, 4, seed=0) is None  # items shrank
+    assert als.continue_state(np.zeros((2, 3), np.float32),
+                              np.zeros((2, 4), np.float32), 5, 5) is None
+
+
+def test_bimap_index_prefix_gate():
+    prev = BiMap({"a": 0, "b": 1})
+    grown = BiMap({"a": 0, "b": 1, "c": 2})
+    assert prev.is_index_prefix_of(grown)
+    assert prev.is_index_prefix_of(prev)
+    # a delete/rebuild reorders the dense index space → gate closes
+    reordered = BiMap({"b": 0, "a": 1, "c": 2})
+    assert not prev.is_index_prefix_of(reordered)
+    dropped = BiMap({"a": 0})
+    assert not prev.is_index_prefix_of(dropped)
+
+
+def test_prefix_mapping_matches_merge_tables():
+    """The continuation's 'row i still names entity i' assumption IS the
+    merge_tables contract: merging a tail table appends unseen ids only,
+    so the base table is a byte-identical prefix of the merged one."""
+    from incubator_predictionio_tpu.data.storage import traincache
+
+    base = traincache._build_table([b"u0", b"u1", b"u2"])
+    tail = traincache._build_table([b"u1", b"u9", b"u0", b"u7"])
+    merged, remap = traincache.merge_tables(base, tail)
+    assert traincache.table_bytes(merged)[:3] == traincache.table_bytes(base)
+    # tail ids remap to base indices when seen, first-seen appends after
+    np.testing.assert_array_equal(remap, [1, 3, 0, 4])
+    # an unordered/deleted rebuild (first_seen_reindex of a reordered
+    # stream) does NOT preserve the prefix — exactly what the BiMap gate
+    # must catch
+    idx = np.asarray([2, 0, 1], np.int32)
+    _re_idx, re_tab = traincache.first_seen_reindex(idx, base)
+    assert traincache.table_bytes(re_tab)[0] != \
+        traincache.table_bytes(base)[0]
+
+
+# ---------------------------------------------------------------------------
+# convergence early-stop: floor and ceiling, fused and probe paths
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fused", ["1", "0"])
+def test_early_stop_floor_and_ceiling(monkeypatch, fused):
+    monkeypatch.setenv("PIO_RETRAIN_FUSED", fused)
+    monkeypatch.setenv("PIO_RETRAIN_PROBE_EVERY", "2")
+    rng = np.random.default_rng(0)
+    users, items, vals = _coo(rng, 40, 30, 800)
+
+    # ceiling: tol=0 never converges → the fixed budget runs in full
+    stats = {}
+    retrain.als_retrain(users, items, vals, 40, 30, rank=4, iterations=5,
+                        l2=0.05, seed=0, tol=0.0, stats=stats)
+    assert stats["sweeps_used"] == 5
+
+    # floor: an absurd tolerance still runs min_sweeps (and ≥ 1)
+    stats = {}
+    retrain.als_retrain(users, items, vals, 40, 30, rank=4, iterations=5,
+                        l2=0.05, seed=0, tol=1e9, stats=stats)
+    if fused == "1":
+        assert stats["sweeps_used"] == 1
+    else:
+        assert stats["sweeps_used"] == 2  # one probe chunk
+    stats = {}
+    retrain.als_retrain(users, items, vals, 40, 30, rank=4, iterations=5,
+                        l2=0.05, seed=0, tol=1e9, min_sweeps=3,
+                        stats=stats)
+    assert 3 <= stats["sweeps_used"] <= 5
+
+
+def test_early_stop_fixed_budget_matches_als_train():
+    """tol=0 + fresh init must reproduce als_train exactly (the
+    byte-stability guarantee for the disabled/fresh path)."""
+    rng = np.random.default_rng(1)
+    users, items, vals = _coo(rng, 30, 20, 500)
+    ref, _ = als.als_train(users, items, vals, 30, 20, rank=4,
+                           iterations=4, l2=0.05, seed=3)
+    stats = {}
+    got = retrain.als_retrain(users, items, vals, 30, 20, rank=4,
+                              iterations=4, l2=0.05, seed=3, tol=0.0,
+                              stats=stats)
+    np.testing.assert_array_equal(np.asarray(ref.user_factors),
+                                  np.asarray(got.user_factors))
+    np.testing.assert_array_equal(np.asarray(ref.item_factors),
+                                  np.asarray(got.item_factors))
+    assert stats["mode"] == "fresh"
+
+
+# ---------------------------------------------------------------------------
+# prep/plan reuse
+# ---------------------------------------------------------------------------
+
+def _run_two_sweeps(trees, n_u, n_i):
+    ut, it, uh, ih = trees
+    init = als.als_init(jax.random.key(0), n_u, n_i, 4)
+    out = als._als_run_fused(
+        als.ALSState(jnp.array(init.user_factors),
+                     jnp.array(init.item_factors)),
+        ut, it, 0.05, 0.0, 2, True, jnp.float32,
+        jax.lax.Precision.HIGHEST, implicit=False,
+        user_heavy=uh, item_heavy=ih)
+    return np.asarray(out.user_factors), np.asarray(out.item_factors)
+
+
+def test_plan_reuse_is_bitwise_identical_to_fresh_build():
+    rng = np.random.default_rng(2)
+    users, items, vals = _coo(rng, 60, 40, 1500)
+    t_users, t_items, t_vals = _coo(rng, 60, 40, 120)
+    u2 = np.concatenate([users, t_users])
+    i2 = np.concatenate([items, t_items])
+    v2 = np.concatenate([vals, t_vals])
+
+    retrain.prepare_with_reuse(users, items, vals, 60, 40, plan_key="p")
+    stats = {}
+    reused = retrain.prepare_with_reuse(u2, i2, v2, 60, 40, plan_key="p",
+                                        stats=stats)
+    assert stats["prep_plan"] == "reused"
+    assert stats["prep_delta_rows"] == 120
+    fresh = retrain.prepare_with_reuse(u2, i2, v2, 60, 40, plan_key=None)
+    ur, vr = _run_two_sweeps(reused, 60, 40)
+    uf, vf = _run_two_sweeps(fresh, 60, 40)
+    np.testing.assert_array_equal(ur, uf)
+    np.testing.assert_array_equal(vr, vf)
+
+    # idempotent: re-preparing the same data folds an empty tail
+    stats = {}
+    retrain.prepare_with_reuse(u2, i2, v2, 60, 40, plan_key="p",
+                               stats=stats)
+    assert stats["prep_plan"] == "reused"
+    assert stats["prep_delta_rows"] == 0
+
+
+def test_plan_reuse_compaction_bound_forces_fresh_rebuild():
+    """Accumulated dead slots must eventually force a compact rebuild —
+    the unbounded-creep guard for long retrain sequences."""
+    rng = np.random.default_rng(9)
+    users, items, vals = _coo(rng, 40, 30, 600)
+    retrain.prepare_with_reuse(users, items, vals, 40, 30, plan_key="c")
+    plan = retrain._PLAN_CACHE["c"]
+    plan.user.dead_rows = 10_000  # far past the 25%-of-live threshold
+    t_u, t_i, t_v = _coo(rng, 40, 30, 50)
+    u2 = np.concatenate([users, t_u])
+    i2 = np.concatenate([items, t_i])
+    v2 = np.concatenate([vals, t_v])
+    stats = {}
+    rebuilt = retrain.prepare_with_reuse(u2, i2, v2, 40, 30, plan_key="c",
+                                         stats=stats)
+    assert stats["prep_plan"] == "rebuilt"
+    # the rebuild re-registered a compact plan and stays correct
+    assert retrain._PLAN_CACHE["c"].user.dead_rows == 0
+    fresh = retrain.prepare_with_reuse(u2, i2, v2, 40, 30, plan_key=None)
+    ur, vr = _run_two_sweeps(rebuilt, 40, 30)
+    uf, vf = _run_two_sweeps(fresh, 40, 30)
+    np.testing.assert_array_equal(ur, uf)
+    np.testing.assert_array_equal(vr, vf)
+
+
+def test_plan_reuse_invalidates_on_prefix_break():
+    """A mutated interior triple (the latest-wins dedup class) must fail
+    the digest and fall back to a fresh build — never a silent splice."""
+    rng = np.random.default_rng(4)
+    users, items, vals = _coo(rng, 30, 20, 400)
+    retrain.prepare_with_reuse(users, items, vals, 30, 20, plan_key="q")
+    mutated = vals.copy()
+    mutated[5] += 1.0
+    stats = {}
+    retrain.prepare_with_reuse(users, items, mutated, 30, 20,
+                               plan_key="q", stats=stats)
+    assert stats["prep_plan"] == "invalidated"
+
+
+def test_plan_reuse_handles_growing_index_space():
+    rng = np.random.default_rng(5)
+    users, items, vals = _coo(rng, 20, 15, 300)
+    # tail introduces brand-new users/items (grown tables)
+    t_users = np.asarray([20, 21, 3, 22], np.int64)
+    t_items = np.asarray([15, 2, 16, 15], np.int64)
+    t_vals = np.asarray([1, 2, 3, 4], np.float32)
+    u2 = np.concatenate([users, t_users])
+    i2 = np.concatenate([items, t_items])
+    v2 = np.concatenate([vals, t_vals])
+    retrain.prepare_with_reuse(users, items, vals, 20, 15, plan_key="g")
+    stats = {}
+    reused = retrain.prepare_with_reuse(u2, i2, v2, 23, 17, plan_key="g",
+                                        stats=stats)
+    assert stats["prep_plan"] == "reused"
+    fresh = retrain.prepare_with_reuse(u2, i2, v2, 23, 17, plan_key=None)
+    ur, vr = _run_two_sweeps(reused, 23, 17)
+    uf, vf = _run_two_sweeps(fresh, 23, 17)
+    np.testing.assert_array_equal(ur, uf)
+    np.testing.assert_array_equal(vr, vf)
+
+
+# ---------------------------------------------------------------------------
+# continuation quality parity (planted workload)
+# ---------------------------------------------------------------------------
+
+def test_continuation_after_tail_reaches_fresh_quality():
+    rng = np.random.default_rng(6)
+    n_u, n_i, rank = 50, 35, 4
+    users, items, vals = _coo(rng, n_u, n_i, 2000, rank=rank)
+    cut = int(len(vals) * 0.95)  # last 5% is "the tail"
+    base = retrain.als_retrain(
+        users[:cut], items[:cut], vals[:cut], n_u, n_i, rank=8,
+        iterations=8, l2=0.05, seed=0, tol=0.0)
+    stats = {}
+    cont = retrain.als_retrain(
+        users, items, vals, n_u, n_i, rank=8, iterations=8, l2=0.05,
+        seed=0, prev_state=base, tol=1e-3, plan_key="parity",
+        stats=stats)
+    fresh, _ = als.als_train(users, items, vals, n_u, n_i, rank=8,
+                             iterations=8, l2=0.05, seed=0)
+    r_cont = als.rmse(cont, users, items, vals)
+    r_fresh = als.rmse(fresh, users, items, vals)
+    assert stats["mode"] == "continue"
+    # parity within a small noise margin, never catastrophically worse
+    assert r_cont <= r_fresh * 1.15 + 0.02, (r_cont, r_fresh)
+
+
+def test_continuation_implicit_path():
+    rng = np.random.default_rng(7)
+    users, items, vals = _coo(rng, 30, 25, 900)
+    weights = np.abs(vals)
+    base = retrain.als_retrain(users[:800], items[:800], weights[:800],
+                               30, 25, rank=4, iterations=4, l2=0.05,
+                               seed=0, implicit=True, tol=0.0)
+    stats = {}
+    cont = retrain.als_retrain(users, items, weights, 30, 25, rank=4,
+                               iterations=6, l2=0.05, seed=0,
+                               implicit=True, prev_state=base,
+                               tol=1e-3, stats=stats)
+    assert stats["mode"] == "continue"
+    assert 1 <= stats["sweeps_used"] <= 6
+    assert np.all(np.isfinite(np.asarray(cont.user_factors)))
+
+
+# ---------------------------------------------------------------------------
+# engine + workflow plumbing
+# ---------------------------------------------------------------------------
+
+def _sweep_counter(mode):
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+    m = obs_metrics.REGISTRY.get("pio_train_sweeps_total")
+    if m is None:
+        return 0.0
+    return m.labels(mode=mode).value
+
+
+def test_engine_continuation_compat_gate():
+    """Rank mismatch / foreign model / broken prefix → fresh train."""
+    from incubator_predictionio_tpu.models.recommendation.engine import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        ALSModel,
+        PreparedData,
+    )
+
+    rng = np.random.default_rng(8)
+    users, items, vals = _coo(rng, 10, 8, 200)
+    pd = PreparedData(
+        users=users.astype(np.int32), items=items.astype(np.int32),
+        ratings=vals,
+        user_bimap=BiMap({f"u{k}": k for k in range(10)}),
+        item_bimap=BiMap({f"i{k}": k for k in range(8)}),
+        item_years={}, item_categories={})
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=4, num_iterations=2,
+                                           seed=1))
+    ok_model = ALSModel(
+        user_factors=np.zeros((10, 4), np.float32),
+        item_factors=np.zeros((8, 4), np.float32),
+        user_bimap=pd.user_bimap, item_bimap=pd.item_bimap,
+        item_years={}, item_categories={})
+    assert algo._continuation_seed(pd, ok_model) is not None
+    # rank mismatch
+    bad_rank = ALSModel(
+        user_factors=np.zeros((10, 6), np.float32),
+        item_factors=np.zeros((8, 6), np.float32),
+        user_bimap=pd.user_bimap, item_bimap=pd.item_bimap,
+        item_years={}, item_categories={})
+    assert algo._continuation_seed(pd, bad_rank) is None
+    # broken prefix (reordered id space)
+    bad_map = ALSModel(
+        user_factors=np.zeros((10, 4), np.float32),
+        item_factors=np.zeros((8, 4), np.float32),
+        user_bimap=BiMap({f"u{k}": (k + 1) % 10 for k in range(10)}),
+        item_bimap=pd.item_bimap, item_years={}, item_categories={})
+    assert algo._continuation_seed(pd, bad_map) is None
+    # foreign model
+    assert algo._continuation_seed(pd, object()) is None
+    # the public hook falls back to a working fresh train
+    from incubator_predictionio_tpu.parallel.context import RuntimeContext
+
+    model = algo.train_with_previous(RuntimeContext(), pd, object())
+    assert np.asarray(model.user_factors).shape == (10, 4)
+
+
+@pytest.fixture
+def rec_app():
+    from incubator_predictionio_tpu.data.datamap import DataMap
+    from incubator_predictionio_tpu.data.event import Event
+    from incubator_predictionio_tpu.data.storage import App, Storage
+
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    Storage.get_meta_data_apps().insert(App(0, "contapp"))
+    app_id = Storage.get_meta_data_apps().get_by_name("contapp").id
+    dao = Storage.get_events()
+    rng = np.random.default_rng(0)
+    for u in range(8):
+        for i in range(6):
+            if rng.random() < 0.8:
+                dao.insert(Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    properties=DataMap(
+                        {"rating": float(rng.integers(1, 6))}),
+                ), app_id)
+    yield app_id
+    Storage.reset()
+
+
+def _rec_params(lambda_=0.05):
+    from incubator_predictionio_tpu.core import EngineParams
+    from incubator_predictionio_tpu.models.recommendation import (
+        ALSAlgorithmParams,
+        DataSourceParams,
+    )
+
+    return EngineParams(
+        data_source_params=("", DataSourceParams(app_name="contapp")),
+        algorithm_params_list=[
+            ("als", ALSAlgorithmParams(rank=4, num_iterations=3,
+                                       lambda_=lambda_, seed=7))
+        ],
+    )
+
+
+def test_workflow_continuation_and_spec_change_auto_disable(rec_app):
+    from incubator_predictionio_tpu.data.datamap import DataMap
+    from incubator_predictionio_tpu.data.event import Event
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.models.recommendation import (
+        Query,
+        RecommendationEngine,
+    )
+    from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+    engine = RecommendationEngine().apply()
+    before = _sweep_counter("continue")
+    iid1 = CoreWorkflow.run_train(engine, _rec_params(),
+                                  engine_variant="cont")
+    assert _sweep_counter("continue") == before  # first train is fresh
+
+    # append a tail, retrain with identical params → continuation engages
+    dao = Storage.get_events()
+    for u in range(8):
+        dao.insert(Event(
+            event="rate", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id="i6",
+            properties=DataMap({"rating": 5.0})), rec_app)
+    iid2 = CoreWorkflow.run_train(engine, _rec_params(),
+                                  engine_variant="cont")
+    after = _sweep_counter("continue")
+    assert after > before, "continuation retrain never engaged"
+    assert iid2 != iid1
+    # the continued model still serves
+    models = CoreWorkflow.load_models(iid2, engine, _rec_params())
+    algo = engine.algorithms(_rec_params())[0]
+    assert algo.predict(models[0], Query(user="u1", num=3)).item_scores
+
+    # spec change (λ) → auto-disabled, trains fresh
+    CoreWorkflow.run_train(engine, _rec_params(lambda_=0.2),
+                           engine_variant="cont")
+    assert _sweep_counter("continue") == after
+
+    # knob off → disabled even with identical params
+    import os
+
+    os.environ["PIO_RETRAIN_CONTINUE"] = "0"
+    try:
+        CoreWorkflow.run_train(engine, _rec_params(lambda_=0.2),
+                               engine_variant="cont")
+        assert _sweep_counter("continue") == after
+    finally:
+        os.environ.pop("PIO_RETRAIN_CONTINUE", None)
+
+
+# ---------------------------------------------------------------------------
+# satellites riding along
+# ---------------------------------------------------------------------------
+
+def test_batch_score_top_k_empty_batch():
+    from incubator_predictionio_tpu.ops.topk import batch_score_top_k
+
+    uf = jnp.ones((4, 3), jnp.float32)
+    vf = jnp.ones((5, 3), jnp.float32)
+    out = np.asarray(batch_score_top_k(uf, vf, [], 3))
+    assert out.shape[0] == 2 and out.shape[1] == 0
+
+
+def test_batch_score_top_k_accepts_ndarray_rows():
+    from incubator_predictionio_tpu.ops.topk import batch_score_top_k
+
+    uf = jnp.asarray(np.eye(4, 3, dtype=np.float32))
+    vf = jnp.asarray(np.eye(5, 3, dtype=np.float32))
+    a = np.asarray(batch_score_top_k(uf, vf, np.asarray([1, 2, 3]), 2))
+    b = np.asarray(batch_score_top_k(uf, vf, [1, 2, 3], 2))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape[1] == 4  # padded to the next power of two
+
+
+@pytest.mark.skipif(
+    __import__("incubator_predictionio_tpu.native",
+               fromlist=["load"]).load() is None,
+    reason="native library unavailable")
+def test_cpplog_tail_fold_stats_and_plan(tmp_path, monkeypatch):
+    """The scan layer's continuation telemetry: a cache-served scan
+    reports its source and the event delta, maintains the prep-plan
+    sidecar O(delta), and the folded id tables keep the originals as an
+    exact byte prefix (the continuation contract end to end)."""
+    from incubator_predictionio_tpu.data.storage import (
+        StorageClientConfig,
+        cpplog,
+        traincache,
+    )
+    from incubator_predictionio_tpu.data.storage.base import Interactions
+
+    monkeypatch.setattr(traincache, "MIN_NNZ", 4)
+    client = cpplog.StorageClient(
+        StorageClientConfig(properties={"PATH": str(tmp_path)}))
+    ev = cpplog.CppLogEvents(client, None, prefix="t_")
+    try:
+        def imp(users, items, t0):
+            inter = Interactions(
+                user_idx=np.asarray(users, np.int32),
+                item_idx=np.asarray(items, np.int32),
+                values=np.arange(1, len(users) + 1, dtype=np.float32),
+                user_ids=[f"u{k}" for k in range(max(users) + 1)],
+                item_ids=[f"i{k}" for k in range(max(items) + 1)],
+            )
+            assert ev.import_interactions(
+                inter, 1, times=t0 + np.arange(len(users), dtype=np.int64),
+            ) == len(users)
+
+        imp([0, 1, 2, 0, 1, 2], [0, 1, 0, 1, 2, 2], 1000)
+        s0: dict = {}
+        first = ev.scan_interactions(
+            app_id=1, event_names=("rate",), value_prop="rating",
+            stats=s0)
+        # the columnar import maintains the projection as it lands, so
+        # even the first scan is cache-served — with a zero delta
+        assert s0["scan_source"] == "cache"
+        assert s0["scan_tail_rows"] == 0
+
+        # tail with one new user and one new item. Import-time cache
+        # maintenance is disabled for this batch so the SCAN-time tail
+        # fold (the O(delta) retrain read path) is what's exercised.
+        monkeypatch.setattr(
+            cpplog.CppLogEvents, "_maintain_cache_after_import",
+            lambda *a, **k: None)
+        imp([1, 3, 2], [3, 0, 1], 2000)
+        s1: dict = {}
+        second = ev.scan_interactions(
+            app_id=1, event_names=("rate",), value_prop="rating",
+            stats=s1)
+        assert s1["scan_source"] == "cache"
+        assert s1["scan_tail_rows"] == 3
+        # folded tables keep the first scan's as an exact byte prefix
+        assert bytes(second.user_ids.blob).startswith(
+            bytes(first.user_ids.blob))
+        assert bytes(second.item_ids.blob).startswith(
+            bytes(first.item_ids.blob))
+        np.testing.assert_array_equal(
+            second.user_idx[:len(first.user_idx)], first.user_idx)
+        # the plan histograms match exact bincounts of the merged data
+        np.testing.assert_array_equal(
+            s1["plan_user_degrees"],
+            np.bincount(second.user_idx, minlength=len(second.user_ids)))
+        np.testing.assert_array_equal(
+            s1["plan_item_degrees"],
+            np.bincount(second.item_idx, minlength=len(second.item_ids)))
+        # retrain-delta gauge exported
+        from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+        g = obs_metrics.REGISTRY.get("pio_retrain_delta_rows")
+        assert g is not None and g.value == 3
+    finally:
+        client.close()
+
+
+def test_prep_plan_sidecar_roundtrip(tmp_path):
+    from incubator_predictionio_tpu.data.storage import traincache
+
+    spec = traincache.Spec("user", "item", "rate", "rating")
+    p = traincache.plan_path_for(tmp_path / "x.log")
+    ud = np.arange(5, dtype=np.int64)
+    id_ = np.arange(3, dtype=np.int64) * 2
+    traincache.save_plan(p, spec, 100, 0, ud, id_)
+    got = traincache.load_plan(p, spec, 100, 0)
+    assert got is not None
+    np.testing.assert_array_equal(got[0], ud)
+    np.testing.assert_array_equal(got[1], id_)
+    # any key mismatch reads as "no plan"
+    assert traincache.load_plan(p, spec, 101, 0) is None
+    assert traincache.load_plan(p, spec, 100, 1) is None
+    assert traincache.load_plan(
+        p, traincache.Spec("user", "item", "view", "rating"), 100, 0) is None

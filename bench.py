@@ -678,6 +678,146 @@ def bench_retrain(store_dir, state, inter, heldout, truth):
     return out
 
 
+#: speed-layer record keys (docs/production.md "Freshness between
+#: retrains"): fold-in latency under concurrent ingest + serve, the
+#: overlay hit rate, and how far the tail poll ran behind the writers
+SPEED_KEYS = (
+    "speed_foldin_p50_ms", "speed_foldin_p95_ms", "speed_hit_rate",
+    "speed_cursor_lag_events", "speed_foldins", "speed_ingested_keys",
+)
+
+
+def bench_speed(store_dir, state, inter):
+    """Speed-layer leg: concurrent cold-user ingest + overlay serve.
+
+    A writer thread streams brand-new users' rate events into the cpplog
+    store while the overlay polls the tail cursor and folds the dirty
+    users in on device; the serve side looks every ingested cold user up
+    after each poll. Emits the fold-in cycle wall (p50/p95), the overlay
+    hit rate over those lookups, and the worst cursor lag observed.
+    Deadline-guarded like the retrain leg."""
+    import threading
+
+    from incubator_predictionio_tpu.data.storage import App, Storage
+    from incubator_predictionio_tpu.speed.overlay import (
+        SpeedOverlay,
+        SpeedOverlayConfig,
+    )
+
+    out = dict.fromkeys(SPEED_KEYS)
+    emit_by = float(os.environ.get("PIO_BENCH_EMIT_BY_EPOCH", "0"))
+    if emit_by and time.time() > emit_by - 90.0:
+        log("speed leg skipped: bench deadline too close")
+        return out
+    run_s = float(os.environ.get("PIO_BENCH_SPEED_S", "8"))
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_CPP_TYPE": "cpplog",
+        "PIO_STORAGE_SOURCES_CPP_PATH": store_dir,
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        # repo NAME "bench" → namespace prefix "bench_", the seeded log
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "bench",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "CPP",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    try:
+        Storage.get_meta_data_apps().insert(App(1, "bench"))
+        item_index = {t: k for k, t in enumerate(inter.item_ids)}
+        user_index = {u: k for k, u in enumerate(inter.user_ids)}
+        overlay = SpeedOverlay(
+            SpeedOverlayConfig(
+                app_name="bench", event_names=("rate",),
+                value_prop="rating", l2=L2, reg_nnz=True,
+                max_keys_per_poll=1024, ttl_s=600.0),
+            other_factors=state.item_factors,
+            other_index=item_index, key_index=user_index)
+        assert overlay.enabled
+
+        from incubator_predictionio_tpu.data.storage.base import (
+            IdTable,
+            Interactions,
+        )
+
+        dao = Storage.get_events()
+        stop = threading.Event()
+        ingested: list = []  # cold user ids, in ingest order
+        rng = np.random.default_rng(23)
+        events_per_user = 8
+        users_per_batch = 16
+
+        def writer() -> None:
+            j = 0
+            while not stop.is_set():
+                uids = [f"cold{j + k}" for k in range(users_per_batch)]
+                n = users_per_batch * events_per_user
+                uidx = np.repeat(np.arange(users_per_batch, dtype=np.int32),
+                                 events_per_user)
+                iidx = rng.integers(0, len(item_index), n).astype(np.int32)
+                vals = rng.normal(3.5, 1.0, n).astype(np.float32)
+                item_tab = IdTable.from_list(
+                    [inter.item_ids[int(i)] for i in iidx])
+                dao.import_interactions(
+                    Interactions(
+                        user_idx=uidx,
+                        item_idx=np.arange(n, dtype=np.int32),
+                        values=vals,
+                        user_ids=IdTable.from_list(uids),
+                        item_ids=item_tab),
+                    1, event_name="rate", value_prop="rating")
+                ingested.extend(uids)
+                j += users_per_batch
+                stop.wait(0.05)
+
+        t_writer = threading.Thread(target=writer, daemon=True)
+        t_writer.start()
+        fold_walls: list = []
+        max_lag = 0
+        t_end = time.perf_counter() + run_s
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            s = overlay.poll()
+            if s.get("solved"):
+                fold_walls.append(time.perf_counter() - t0)
+            max_lag = max(max_lag, int(s.get("lag", 0)))
+            # serve side: look up every cold user ingested so far — the
+            # honest freshness probe (users not yet folded in miss)
+            for uid in list(ingested):
+                overlay.lookup(uid)
+        stop.set()
+        t_writer.join(timeout=10)
+        # drain the remaining dirty set so the final hit-rate pass
+        # reflects steady state, not the shutdown edge
+        for _ in range(8):
+            if not overlay.poll().get("dirty"):
+                break
+        st = overlay.stats()
+        walls_ms = np.sort(np.asarray(fold_walls)) * 1e3
+        looked = st["hits"] + st["misses"]
+        out.update({
+            "speed_foldin_p50_ms": (
+                round(float(walls_ms[int(0.50 * (len(walls_ms) - 1))]), 2)
+                if len(walls_ms) else None),
+            "speed_foldin_p95_ms": (
+                round(float(walls_ms[int(0.95 * (len(walls_ms) - 1))]), 2)
+                if len(walls_ms) else None),
+            "speed_hit_rate": (round(st["hits"] / looked, 3)
+                               if looked else None),
+            "speed_cursor_lag_events": int(max_lag),
+            "speed_foldins": int(st["foldins"]),
+            "speed_ingested_keys": int(len(ingested)),
+        })
+        log(f"speed: {len(ingested)} cold users ingested, "
+            f"{st['foldins']} fold-ins, "
+            f"foldin p50={out['speed_foldin_p50_ms']}ms "
+            f"p95={out['speed_foldin_p95_ms']}ms "
+            f"hit_rate={out['speed_hit_rate']} max_lag={max_lag}")
+    finally:
+        Storage.reset()
+    return out
+
+
 #: registry cross-check keys (docs/observability.md): the telemetry
 #: layer and the bench time THE SAME stages, so their numbers must
 #: corroborate — obs_ingest_events_total vs the seeded HTTP load,
@@ -969,6 +1109,11 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
             bench_retrain(store_dir, state, inter, heldout, truth))
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"retrain leg failed ({e!r}); retrain_* keys null this round")
+    speed_frag = dict.fromkeys(SPEED_KEYS)
+    try:
+        speed_frag.update(bench_speed(store_dir, state, inter))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"speed leg failed ({e!r}); speed_* keys null this round")
 
     fragment = {
         "value": round(train_s, 3),
@@ -987,6 +1132,7 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
         **kernel_probe,
         **attn,
         **retrain_frag,
+        **speed_frag,
         "serve_p50_ms": serve["p50_ms"],
         "serve_p99_ms": serve["p99_ms"],
         "serve_qps": serve["qps_sequential"],
@@ -1355,6 +1501,9 @@ def run_orchestrator() -> None:
         "flash_kernel_active": None,
         # steady-state retrain leg (child-only; docs/performance.md)
         **dict.fromkeys(RETRAIN_KEYS),
+        # speed-layer leg (child-only; docs/production.md "Freshness
+        # between retrains")
+        **dict.fromkeys(SPEED_KEYS),
         # how long the supervised-child leg ran and how it ended — makes
         # a wedged-lease round diagnosable from the record alone
         # child_ok counts as claiming evidence too: a fragment can land
@@ -1774,6 +1923,7 @@ def bench_serving(state, inter):
     server.max_batch_served = 0
     server._conf_server_key = None
     server.http = HttpServer(server._build_router(), "127.0.0.1", 0)
+    server._speed_overlays = []
     server._batcher = _MicroBatcher(server._handle_batch,
                                     server.config.micro_batch,
                                     workers=server.config.serve_workers)

@@ -21,6 +21,11 @@ idiomatically on JAX/XLA for TPU:
 - ``models``   — engine templates (recommendation, classification,
                  similarproduct, ecommerce) mirroring the reference's
                  examples/scala-parallel-* template families.
+- ``speed``    — the Lambda-architecture speed leg: log-tail cursor
+                 subscriber + batched device fold-in overlay serving
+                 fresh users/items between retrains (no reference
+                 counterpart — PredictionIO documents the architecture,
+                 this implements its third leg).
 - ``e2``       — standalone engine-building library (CategoricalNaiveBayes,
                  MarkovChain, BinaryVectorizer, CrossValidation) mirroring
                  the reference's e2/ module.

@@ -672,6 +672,79 @@ def _is_at_indexed(node: ast.AST) -> bool:
             and node.value.attr == "at")
 
 
+# ---------------------------------------------------------------------------
+# 11. blocking storage reads on the serving hot path
+# ---------------------------------------------------------------------------
+
+#: EventStore read entry points whose wall scales with the event log —
+#: a synchronous storage round trip per query is the latency class the
+#: speed layer's TTL micro-cache (speed/cache.py) exists to remove
+_EVENTSTORE_READS = {
+    "find", "find_by_entity", "aggregate_properties", "interactions",
+    "extract_entity_map",
+}
+_SERVE_ENTRY_POINTS = {"predict", "batch_predict", "batch_serve_json"}
+
+
+class ServeBlockingIO(Rule):
+    name = "serve-blocking-io"
+    severity = "warning"
+    doc = ("direct EventStore read (find/find_by_entity/"
+           "aggregate_properties/...) reachable from a predict() hot "
+           "path — a synchronous storage round trip per query; route it "
+           "through the bounded TTL micro-cache (speed/cache.py "
+           "TTLCache, invalidated by the speed-layer cursor) and record "
+           "the cache-miss loader in the baseline")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {
+                n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            # intra-class call graph over self.<method>() edges —
+            # ast.walk covers lambdas/closures, so a loader passed to a
+            # cache helper still counts as reachable (its read then
+            # carries a baseline justification)
+            edges: dict = {}
+            for name, fn in methods.items():
+                callees = set()
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self"
+                            and node.func.attr in methods):
+                        callees.add(node.func.attr)
+                edges[name] = callees
+            reachable: Set[str] = set()
+            stack = [m for m in _SERVE_ENTRY_POINTS if m in methods]
+            while stack:
+                m = stack.pop()
+                if m in reachable:
+                    continue
+                reachable.add(m)
+                stack.extend(edges.get(m, ()))
+            for name in sorted(reachable):
+                for node in ast.walk(methods[name]):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _EVENTSTORE_READS):
+                        continue
+                    base = mod.resolved(node.func.value) or ""
+                    if base != "EventStore" and not base.endswith(
+                            ".EventStore"):
+                        continue
+                    yield mod.finding(
+                        self, node,
+                        f"EventStore.{node.func.attr}() reachable from "
+                        f"the serving hot path (via {name!r}) — a "
+                        "storage round trip per query; front it with "
+                        "the TTL micro-cache (speed/cache.py)")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -683,6 +756,7 @@ ALL_RULES: Sequence[Rule] = (
     ServerUnlockedState(),
     LockNativeScan(),
     MetricInTrace(),
+    ServeBlockingIO(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -242,6 +242,33 @@ class Algorithm(_Component, Generic[PD, M, Q, P]):
         serves anyway.
         """
 
+    def make_speed_overlay(self, model: M, app_name: Optional[str],
+                           channel_name: Optional[str],
+                           data_source_params: Any = None):
+        """Speed-layer hook (incubator_predictionio_tpu/speed/): return a
+        configured ``SpeedOverlay`` over this model's frozen factors, or
+        None (the default) when the algorithm has no fold-in story.
+
+        Called by the PredictionServer at deploy/reload time with the
+        app/channel resolved from the engine's data-source params (and
+        those params themselves, for event-weight knobs that live there).
+        Implementations MUST build the overlay with the SAME event shape
+        and regularization their training used — the fold-in solve is
+        only "exact model quality" when it solves the training objective.
+        The server owns the overlay lifecycle (start/stop/invalidate on
+        hot swap) and attaches it via :meth:`attach_speed_overlay`."""
+        return None
+
+    def attach_speed_overlay(self, overlay) -> None:
+        """Bind (or clear, with None) the serving-time overlay consulted
+        before the base model. Engines read ``self._speed_overlay`` in
+        their predict paths."""
+        self._speed_overlay = overlay
+
+    @property
+    def speed_overlay(self):
+        return getattr(self, "_speed_overlay", None)
+
     @property
     def query_class(self) -> Optional[type]:
         """Query dataclass for JSON extraction at the server edge

@@ -771,6 +771,54 @@ class Events(abc.ABC):
             item_ids=list(items),
         )
 
+    # -- speed-layer tail cursor -------------------------------------------
+    #
+    # The Lambda-architecture speed leg (incubator_predictionio_tpu/speed/)
+    # polls the write tail of the event log to keep a per-user "dirty" set
+    # between retrains. ``tail_cursor`` is a MONOTONIC position in the
+    # backend's write order (append-only: entry count; in-memory: insert
+    # counter) and ``read_interactions_since`` scans only [cursor, now) —
+    # O(delta), never O(log). Backends without a cheap tail return -1 and
+    # the speed layer stays disabled on them.
+
+    #: generation shift for tail cursors: the high bits carry a
+    #: process-local LOG GENERATION (bumped on compaction/drop — any
+    #: rewrite that renumbers entries), the low bits the write position.
+    #: A bare count comparison cannot detect "compacted, then appended
+    #: past the old count before the next poll"; the generation can.
+    TAIL_GEN_SHIFT = 48
+
+    def tail_cursor(self, app_id: int,
+                    channel_id: Optional[int] = None) -> int:
+        """Current monotonic write cursor (generation ``<<
+        TAIL_GEN_SHIFT`` | position), or -1 when the backend has no
+        cheap tail-read support. Within one generation a later cursor
+        covers every event a previous one did; a generation change means
+        everything derived from old cursors is invalid."""
+        return -1
+
+    def read_interactions_since(
+        self,
+        cursor: int,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_names: Sequence[str] = ("rate",),
+        value_prop: Optional[str] = None,
+        event_values: Optional[Dict[str, float]] = None,
+        default_value: float = 1.0,
+    ):
+        """Columnar scan of ONLY the events written since ``cursor`` →
+        ``(Interactions, times_ms, new_cursor, reset)``. Value-resolution
+        semantics are identical to :meth:`scan_interactions`; rows arrive
+        in write order. ``reset=True`` (a cursor from a previous log
+        generation — compaction/drop renumbered the entries) carries an
+        EMPTY tail and a fresh cursor: the caller must drop everything it
+        derived and resynchronize."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support tail reads")
+
     def import_interactions(
         self,
         inter: Interactions,

@@ -108,6 +108,21 @@ class StorageClient(base.BaseStorageClient):
         # cache writes) without deadlocking the waiter.
         self._pins: dict[str, int] = {}
         self._pins_cv = threading.Condition(self.lock)
+        # process-local log generations: bumped whenever a log's entry
+        # numbering is rewritten (compact/drop), so tail cursors from
+        # before the rewrite are detectable even after the entry count
+        # grows past its old value (speed-layer resync contract)
+        self._generations: dict[str, int] = {}
+
+    def generation(self, ns: str, app_id: int,
+                   channel_id: Optional[int]) -> int:
+        key = str(self._file(ns, app_id, channel_id))
+        with self.lock:
+            return self._generations.get(key, 0)
+
+    def bump_generation_locked(self, path) -> None:
+        key = str(path)
+        self._generations[key] = self._generations.get(key, 0) + 1
 
     def pin(self, ns: str, app_id: int, channel_id: Optional[int]) -> str:
         """Mark the (ns, app, channel) handle as read-busy; returns the
@@ -163,6 +178,7 @@ class StorageClient(base.BaseStorageClient):
             path.unlink(missing_ok=True)
             from incubator_predictionio_tpu.data.storage import traincache
             traincache.invalidate(path)
+            self.bump_generation_locked(path)
         return True
 
     def sync(self) -> None:
@@ -704,6 +720,72 @@ class CppLogEvents(base.Events):
                     plan=(traincache.plan_path_for(
                         str(cpath)[: -len(".traincache")]), None))
             return inter
+        finally:
+            self.client.unpin(pin)
+
+    # -- speed-layer tail cursor -------------------------------------------
+    def tail_cursor(self, app_id: int,
+                    channel_id: Optional[int] = None) -> int:
+        """Monotonic write cursor = (log generation << TAIL_GEN_SHIFT) |
+        raw entry count. Compaction/drop renumber entries and bump the
+        generation, which read_interactions_since surfaces as a RESET —
+        a bare count comparison would miss "compacted, then appended
+        past the old count before the next poll"."""
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            gen = self.client._generations.get(
+                str(self.client._file(self.ns, app_id, channel_id)), 0)
+            return (gen << self.TAIL_GEN_SHIFT) | int(
+                self.client.lib.pio_evlog_entry_count(h))
+
+    def read_interactions_since(
+        self,
+        cursor: int,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_names: Sequence[str] = ("rate",),
+        value_prop: Optional[str] = None,
+        event_values: Optional[dict] = None,
+        default_value: float = 1.0,
+    ):
+        """Tail scan over entries [cursor_pos, entry_count) →
+        (Interactions, times, new_cursor, reset). Rides the
+        bounded-range sharded scan (entry order, lock-free on a pinned
+        handle) — the same O(delta) machinery the traincache fold uses,
+        so polling the tail costs the tail, not the log. A cursor minted
+        before a compaction/drop (generation mismatch) returns an EMPTY
+        tail with ``reset=True`` — the subscriber resynchronizes."""
+        import numpy as np
+
+        names = [str(n) for n in event_names]
+        fixed = event_values or {}
+        gen_mask = (1 << self.TAIL_GEN_SHIFT) - 1
+        with self.client.lock:
+            h = self._handle(app_id, channel_id)
+            gen = self.client._generations.get(
+                str(self.client._file(self.ns, app_id, channel_id)), 0)
+            raw = int(self.client.lib.pio_evlog_entry_count(h))
+            pin = self.client.pin(self.ns, app_id, channel_id)
+        try:
+            new_cursor = (gen << self.TAIL_GEN_SHIFT) | raw
+            cur = max(int(cursor), 0)
+            cur_gen, lo = cur >> self.TAIL_GEN_SHIFT, cur & gen_mask
+            reset = cur_gen != gen or lo > raw
+            if reset or raw <= lo:
+                empty = base.Interactions(
+                    user_idx=np.empty(0, np.int32),
+                    item_idx=np.empty(0, np.int32),
+                    values=np.empty(0, np.float32),
+                    user_ids=base.IdTable(b"", np.zeros(1, np.int64)),
+                    item_ids=base.IdTable(b"", np.zeros(1, np.int64)))
+                return empty, np.empty(0, np.int64), new_cursor, reset
+            inter, times = self._scan_sharded(
+                h, raw, None, None, entity_type, target_entity_type,
+                names, fixed, value_prop, default_value,
+                min_entry_idx=lo)
+            return inter, times, new_cursor, False
         finally:
             self.client.unpin(pin)
 
@@ -1532,6 +1614,9 @@ class CppLogEvents(base.Events):
                 self.client.lib.pio_evlog_close(old)
             os.replace(tmp_path, path)
             traincache.invalidate(path)
+            # entry numbering may have changed (tombstones dropped):
+            # tail cursors minted before this compaction are now invalid
+            self.client.bump_generation_locked(path)
             bytes_after = path.stat().st_size if path.exists() else 0
         return {"events": int(live), "bytes_before": bytes_before,
                 "bytes_after": bytes_after}

@@ -29,6 +29,13 @@ class _Namespace:
     def __init__(self) -> None:
         # (app_id, channel_id) -> {event_id: Event}
         self.events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+        # (app_id, channel_id) -> append-ordered write tail (upserts
+        # append again — a new write in the cross-backend order contract).
+        # Backs the speed layer's tail_cursor/read_interactions_since.
+        self.event_tail: Dict[Tuple[int, Optional[int]], list] = {}
+        # tail generation per table: bumped by remove() so stale cursors
+        # are detected even after the table refills past the old count
+        self.event_tail_gen: Dict[Tuple[int, Optional[int]], int] = {}
         self.apps: Dict[int, base.App] = {}
         self.access_keys: Dict[str, base.AccessKey] = {}
         self.channels: Dict[int, base.Channel] = {}
@@ -118,10 +125,28 @@ class MemoryEvents(_MemoryDAO, base.Events):
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         with self.client.lock:
             self.t.events.pop((app_id, channel_id), None)
+            self.t.event_tail.pop((app_id, channel_id), None)
+            key = (app_id, channel_id)
+            self.t.event_tail_gen[key] = \
+                self.t.event_tail_gen.get(key, 0) + 1
         return True
 
     def close(self) -> None:
         pass
+
+    def _tail_tombstone(self, app_id: int, channel_id: Optional[int],
+                        event_id: str) -> None:
+        """Null out the newest tail occurrence of an event id (caller
+        holds the client lock). Positions are PRESERVED — the tail
+        cursor counts slots, so a tombstone must not shift it."""
+        tail = self.t.event_tail.get((app_id, channel_id))
+        if not tail:
+            return
+        for i in range(len(tail) - 1, -1, -1):
+            e = tail[i]
+            if e is not None and e.event_id == event_id:
+                tail[i] = None
+                return
 
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
@@ -133,9 +158,92 @@ class MemoryEvents(_MemoryDAO, base.Events):
             # cross-backend tie-break contract for equal event times (an
             # upsert is a new write; cpplog's append-only log and
             # sqlite's REPLACE rowid both behave this way)
-            table.pop(eid, None)
+            if table.pop(eid, None) is not None:
+                # the superseded write must not replay to tail readers
+                self._tail_tombstone(app_id, channel_id, eid)
             table[eid] = event.with_id(eid)
+            self.t.event_tail.setdefault((app_id, channel_id), []).append(
+                table[eid])
         return eid
+
+    # -- speed-layer tail cursor -------------------------------------------
+    def tail_cursor(self, app_id: int,
+                    channel_id: Optional[int] = None) -> int:
+        with self.client.lock:
+            key = (app_id, channel_id)
+            gen = self.t.event_tail_gen.get(key, 0)
+            return (gen << self.TAIL_GEN_SHIFT) | len(
+                self.t.event_tail.get(key, ()))
+
+    def read_interactions_since(
+        self,
+        cursor: int,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_names: Sequence[str] = ("rate",),
+        value_prop: Optional[str] = None,
+        event_values: Optional[Dict[str, float]] = None,
+        default_value: float = 1.0,
+    ):
+        import numpy as np
+
+        with self.client.lock:
+            key = (app_id, channel_id)
+            gen = self.t.event_tail_gen.get(key, 0)
+            tail = self.t.event_tail.get(key, ())
+            pos = len(tail)
+            new_cursor = (gen << self.TAIL_GEN_SHIFT) | pos
+            cur_gen = max(int(cursor), 0) >> self.TAIL_GEN_SHIFT
+            cur_pos = max(int(cursor), 0) & (
+                (1 << self.TAIL_GEN_SHIFT) - 1)
+            if cur_gen != gen or cur_pos > pos:
+                # log rewritten since the caller's cursor: empty tail +
+                # reset — the caller resynchronizes from scratch
+                return (base.Interactions(
+                            user_idx=np.empty(0, np.int32),
+                            item_idx=np.empty(0, np.int32),
+                            values=np.empty(0, np.float32),
+                            user_ids=[], item_ids=[]),
+                        np.empty(0, np.int64), new_cursor, True)
+            rows = list(tail[cur_pos:pos])
+        fixed = event_values or {}
+        names = set(event_names)
+        users: Dict[str, int] = {}
+        items: Dict[str, int] = {}
+        uidx: list = []
+        iidx: list = []
+        vals: list = []
+        times: list = []
+        for e in rows:
+            if e is None:  # tombstoned (deleted/superseded) slot
+                continue
+            if (e.event not in names or e.entity_type != entity_type
+                    or e.target_entity_type != target_entity_type
+                    or e.target_entity_id is None):
+                continue
+            if e.event in fixed:
+                v = fixed[e.event]
+            elif value_prop is not None:
+                raw = e.properties.to_jsonable().get(value_prop)
+                if not isinstance(raw, (int, float)) or isinstance(raw, bool):
+                    continue
+                v = float(raw)
+            else:
+                v = default_value
+            uidx.append(users.setdefault(e.entity_id, len(users)))
+            iidx.append(items.setdefault(e.target_entity_id, len(items)))
+            vals.append(v)
+            times.append(to_millis(e.event_time))
+        inter = base.Interactions(
+            user_idx=np.asarray(uidx, np.int32),
+            item_idx=np.asarray(iidx, np.int32),
+            values=np.asarray(vals, np.float32),
+            user_ids=list(users),
+            item_ids=list(items),
+        )
+        return inter, np.asarray(times, np.int64), new_cursor, False
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
@@ -145,7 +253,14 @@ class MemoryEvents(_MemoryDAO, base.Events):
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         with self.client.lock:
-            return self._table(app_id, channel_id).pop(event_id, None) is not None
+            gone = self._table(app_id, channel_id).pop(
+                event_id, None) is not None
+            if gone:
+                # deleted events must not replay through the speed
+                # layer's tail read (cpplog's scans skip tombstones; the
+                # in-memory model must match)
+                self._tail_tombstone(app_id, channel_id, event_id)
+            return gone
 
     def find(
         self,

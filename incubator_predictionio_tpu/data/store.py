@@ -141,6 +141,42 @@ class EventStore:
         )
 
     @staticmethod
+    def tail_cursor(app_name: str, channel_name: Optional[str] = None) -> int:
+        """Monotonic write cursor of the app's event log, or -1 when the
+        backend has no cheap tail (base.Events.tail_cursor) — the speed
+        layer's poll anchor."""
+        app_id, channel_id = _resolve(app_name, channel_name)
+        return Storage.get_events().tail_cursor(app_id, channel_id)
+
+    @staticmethod
+    def read_interactions_since(
+        cursor: int,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        entity_type: str = "user",
+        target_entity_type: str = "item",
+        event_names: Sequence[str] = ("rate",),
+        value_prop: Optional[str] = None,
+        event_values: Optional[Dict[str, float]] = None,
+        default_value: float = 1.0,
+    ):
+        """Columnar scan of only the events written since ``cursor`` →
+        (Interactions, times_ms, new_cursor, reset). O(delta): the speed
+        layer polls this to maintain its dirty set between retrains;
+        ``reset=True`` means the log was rewritten (compaction/drop) and
+        everything derived from older cursors must be dropped."""
+        app_id, channel_id = _resolve(app_name, channel_name)
+        return Storage.get_events().read_interactions_since(
+            cursor, app_id, channel_id,
+            entity_type=entity_type,
+            target_entity_type=target_entity_type,
+            event_names=event_names,
+            value_prop=value_prop,
+            event_values=event_values,
+            default_value=default_value,
+        )
+
+    @staticmethod
     def aggregate_properties(
         app_name: str,
         entity_type: str,

@@ -218,6 +218,51 @@ class ECommAlgorithm(Algorithm):
 
     def __init__(self, params: ECommAlgorithmParams):
         super().__init__(params)
+        # bounded TTL micro-caches in front of the serve-time storage
+        # round trips (speed/cache.py): the recent-events read is keyed
+        # per user and versioned by the speed-layer cursor (a user whose
+        # events the overlay has seen misses immediately); the constraint
+        # read is one shared entry. Both default to a short TTL — ops
+        # flips (unavailableItems) still land within seconds while the
+        # hot path stops paying a storage scan per query.
+        from incubator_predictionio_tpu.speed.cache import (
+            TTLCache,
+            serve_cache_ttl,
+        )
+
+        ttl = serve_cache_ttl()
+        self._recent_cache = TTLCache(maxsize=4096, ttl_s=ttl)
+        self._constraint_cache = TTLCache(maxsize=4, ttl_s=ttl)
+
+    def make_speed_overlay(self, model: "ECommModel", app_name,
+                           channel_name, data_source_params=None):
+        """Implicit fold-in over the frozen item factors — the EXACT
+        Hu-Koren-Volinsky row solve replacing the crude averaged
+        ``recentFeatures`` fallback for unknown/dirty users. Event shape
+        mirrors the DataSource's weighted training read."""
+        if app_name is None:
+            return None
+        from incubator_predictionio_tpu.speed.overlay import (
+            SpeedOverlay,
+            SpeedOverlayConfig,
+        )
+
+        weights = dict(getattr(data_source_params, "event_weights", ())
+                       or (("view", 1.0), ("buy", 4.0), ("rate", 2.0)))
+        return SpeedOverlay(
+            SpeedOverlayConfig(
+                app_name=app_name, channel_name=channel_name,
+                entity_type="user", target_entity_type="item",
+                event_names=tuple(weights),
+                event_values={k: float(v) for k, v in weights.items()},
+                key_side="entity",
+                l2=self.params.lambda_, implicit=True,
+                alpha=self.params.alpha,
+            ),
+            other_factors=np.asarray(model.item_factors),
+            other_index=model.item_bimap,
+            key_index=model.user_bimap,
+        )
 
     def train(self, ctx: RuntimeContext, pd: PreparedData) -> ECommModel:
         from incubator_predictionio_tpu.ops.als import als_train_implicit
@@ -314,10 +359,29 @@ class ECommAlgorithm(Algorithm):
         )
 
     # -- serve-time constraints --------------------------------------------
+    def _store_version(self):
+        """Write-cursor version for the micro-caches (speed/cache.py
+        ``store_version``): a ``$set`` constraint flip still lands on the
+        very next query — the reference's re-read-per-query contract."""
+        from incubator_predictionio_tpu.speed.cache import store_version
+
+        return store_version(self.params.app_name,
+                             self.params.channel_name)
+
     def _constraints(
         self, model: ECommModel
     ) -> Tuple[List[int], Optional[np.ndarray]]:
-        """Re-read the ``constraint`` entities per query → (unavailable item
+        """Constraint state for this query, via the TTL micro-cache —
+        the storage aggregate runs once per write/TTL window instead of
+        once per query (`serve-blocking-io`)."""
+        return self._constraint_cache.get_or_load(
+            "constraints", lambda: self._load_constraints(model),
+            version=self._store_version())
+
+    def _load_constraints(
+        self, model: ECommModel
+    ) -> Tuple[List[int], Optional[np.ndarray]]:
+        """Re-read the ``constraint`` entities → (unavailable item
         indices, per-item weight multipliers or None).
 
         The ops team ``$set``s these without retraining:
@@ -371,6 +435,56 @@ class ECommAlgorithm(Algorithm):
         return unavailable, weights
 
     def _recent_items(self, model: ECommModel, user: str) -> List[int]:
+        """Recent-event item indices for one user, via the TTL micro-cache.
+
+        Versioned by the speed layer's per-user event cursor when an
+        overlay is attached (unrelated users' writes don't invalidate);
+        by the store's write cursor otherwise (exact re-read-on-write
+        semantics, reads deduped between writes)."""
+        ov = self.speed_overlay
+        version = (("u", ov.key_version(user)) if ov is not None
+                   else ("s", self._store_version()))
+        return self._recent_cache.get_or_load(
+            ("recent", user),
+            lambda: self._load_recent_items(model, user),
+            version=version)
+
+    def _seen_item_indices(self, model: ECommModel, user: str) -> List[int]:
+        """Seen-item indices for a user the MODEL doesn't know (overlay
+        fold-in users): their ``seen_events`` history is read through the
+        same micro-cache, so unseen_only filtering holds for fresh
+        sessions too — the reference reads this per query; here it costs
+        one storage read per write/TTL window."""
+        ov = self.speed_overlay
+        version = (("u", ov.key_version(user)) if ov is not None
+                   else ("s", self._store_version()))
+
+        def load() -> List[int]:
+            try:
+                events = EventStore.find_by_entity(
+                    app_name=self.params.app_name,
+                    channel_name=self.params.channel_name,
+                    entity_type="user",
+                    entity_id=user,
+                    event_names=list(self.params.seen_events),
+                )
+            except Exception:
+                logger.warning(
+                    "ecommerce: seen-event lookup failed for user %r; "
+                    "serving without the seen filter", user, exc_info=True)
+                return []
+            out = set()
+            for e in events:
+                idx = (model.item_bimap.get(e.target_entity_id)
+                       if e.target_entity_id else None)
+                if idx is not None:
+                    out.add(int(idx))
+            return sorted(out)
+
+        return self._recent_cache.get_or_load(("seen", user), load,
+                                              version=version)
+
+    def _load_recent_items(self, model: ECommModel, user: str) -> List[int]:
         try:
             events = EventStore.find_by_entity(
                 app_name=self.params.app_name,
@@ -438,6 +552,18 @@ class ECommAlgorithm(Algorithm):
         unavailable, weights = self._constraints(model)
         mask = self._allowed_mask(model, query, user_idx, unavailable)
         k = min(query.num, len(model.item_bimap))
+        # speed layer first: the exact device fold-in replaces BOTH a
+        # stale base row (dirty user) and the averaged recentFeatures
+        # approximation (unknown user). Misses fall through to the
+        # original ladder: base factors → recent average → popularity.
+        ov = self.speed_overlay
+        ov_vec = ov.lookup(query.user) if ov is not None else None
+        if ov_vec is not None and self.params.unseen_only:
+            # the model's train-time seen set misses everything this
+            # user did SINCE (or entirely, for brand-new users): apply
+            # the freshly-read seen filter on the overlay path
+            for idx in self._seen_item_indices(model, query.user):
+                mask[idx] = False
 
         from incubator_predictionio_tpu.ops.host_serving import (
             host_arrays,
@@ -447,7 +573,9 @@ class ECommAlgorithm(Algorithm):
                            "item_popularity")
         if host is not None:
             np_users, np_items, np_pop = host
-            if user_idx is not None:
+            if ov_vec is not None:
+                scores = np_items @ np.asarray(ov_vec, np.float32)
+            elif user_idx is not None:
                 scores = np_items @ np_users[user_idx]
             else:
                 recent = self._recent_items(model, query.user)
@@ -468,7 +596,10 @@ class ECommAlgorithm(Algorithm):
             )
 
             factors = jnp.asarray(model.item_factors)
-            if user_idx is not None:
+            if ov_vec is not None:
+                scores = factors @ jnp.asarray(
+                    np.asarray(ov_vec, np.float32))
+            elif user_idx is not None:
                 user_vec = jnp.asarray(model.user_factors)[user_idx]
                 scores = factors @ user_vec
             else:

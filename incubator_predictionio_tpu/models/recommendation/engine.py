@@ -496,6 +496,36 @@ class ALSAlgorithm(Algorithm):
             model, user_factors=np_users, item_factors=np_items)
         return model
 
+    # -- speed layer -------------------------------------------------------
+    def make_speed_overlay(self, model: ALSModel, app_name, channel_name,
+                           data_source_params=None):
+        """Explicit fold-in over the frozen item factors: same event
+        shape as the DataSource's training read (rate events carry
+        ``rating``; buy events the fixed implicit weight) and the same
+        ALS-WR regularization (λ·nnz) the trainer used — a dirty or
+        brand-new user's overlay row IS the row training would solve."""
+        if app_name is None:
+            return None
+        from incubator_predictionio_tpu.speed.overlay import (
+            SpeedOverlay,
+            SpeedOverlayConfig,
+        )
+
+        buy_rating = float(getattr(data_source_params, "buy_rating", 4.0))
+        return SpeedOverlay(
+            SpeedOverlayConfig(
+                app_name=app_name, channel_name=channel_name,
+                entity_type="user", target_entity_type="item",
+                event_names=("rate", "buy"), value_prop="rating",
+                event_values={"buy": buy_rating},
+                key_side="entity",
+                l2=self.params.lambda_, reg_nnz=True, implicit=False,
+            ),
+            other_factors=np.asarray(model.item_factors),
+            other_index=model.item_bimap,
+            key_index=model.user_bimap,
+        )
+
     # -- serving ----------------------------------------------------------
     def _allowed_mask(
         self, model: ALSModel, query: Query
@@ -548,12 +578,17 @@ class ALSAlgorithm(Algorithm):
         from incubator_predictionio_tpu.ops.topk import score_user_and_top_k
 
         user_idx = model.user_bimap.get(query.user)
-        if user_idx is None:
+        # speed layer: a folded-in vector (fresh session / dirty user)
+        # takes precedence over the frozen base row — exact model-quality
+        # scores seconds after the first events, not after the retrain
+        ov = self.speed_overlay
+        ov_vec = ov.lookup(query.user) if ov is not None else None
+        if user_idx is None and ov_vec is None:
             # unknown user → empty result (ALSAlgorithm.scala predict miss)
             return PredictedResult(item_scores=())
         mask = self._allowed_mask(model, query)
         seen = None
-        if query.exclude_seen:
+        if query.exclude_seen and user_idx is not None:
             seen = model.user_seen.get(user_idx)
             if seen is not None and not len(seen):
                 seen = None
@@ -568,12 +603,30 @@ class ALSAlgorithm(Algorithm):
         host = host_arrays(model, "user_factors", "item_factors")
         if host is not None:
             np_users, np_items = host
-            scores = np_items @ np_users[user_idx]
+            scores = np_items @ (np.asarray(ov_vec, np.float32)
+                                 if ov_vec is not None
+                                 else np_users[user_idx])
             if seen is not None:
                 scores = scores.copy()
                 scores[np.asarray(seen)] = -3.4e38
             top_s, top_i = host_top_k(scores, k, allowed_mask=mask)
             packed = np.stack([top_s, top_i.astype(np.float64)])
+        elif ov_vec is not None:
+            from incubator_predictionio_tpu.ops.topk import score_and_top_k
+
+            exclude = None
+            if seen is not None:
+                from incubator_predictionio_tpu.ops.topk import next_pow2
+
+                width = next_pow2(len(seen))
+                exclude = np.full(width, -1, np.int32)
+                exclude[:len(seen)] = seen
+                exclude = jnp.asarray(exclude)
+            packed = np.asarray(score_and_top_k(
+                jnp.asarray(np.asarray(ov_vec, np.float32)),
+                model.item_factors, k=k, exclude=exclude,
+                allowed_mask=None if mask is None else jnp.asarray(mask),
+            ))
         else:
             exclude = None
             if seen is not None:
@@ -616,11 +669,15 @@ class ALSAlgorithm(Algorithm):
         micro-batcher routes concurrent /queries.json traffic here —
         CreateServer.scala:523 leaves this as "TODO: Parallelize"). Filtered
         queries fall back to per-query predict."""
+        ov = self.speed_overlay
         plain = [
             (qx, q) for qx, q in queries
             if q.creation_year is None and not q.categories
             and not q.whitelist and not q.blacklist and not q.exclude_seen
             and model.user_bimap.get(q.user) is not None
+            # overlay-covered users have a FRESHER vector than the base
+            # row — they take the per-query path (which consults it)
+            and (ov is None or not ov.covers(q.user))
         ]
         out: List[Tuple[int, PredictedResult]] = []
         if plain:
@@ -708,6 +765,7 @@ class ALSAlgorithm(Algorithm):
         import math
 
         get_row = model.user_bimap.get
+        ov = self.speed_overlay
         plain = []  # (slot, row, num)
         for slot, d in enumerate(docs):
             if (type(d) is dict and len(d) == 2 and "user" in d
@@ -716,7 +774,10 @@ class ALSAlgorithm(Algorithm):
                 if (isinstance(u, str) and isinstance(num, int)
                         and not isinstance(num, bool) and num > 0):
                     row = get_row(u)
-                    if row is not None:
+                    # overlay-covered users fall to the object path: the
+                    # rendered bytes must reflect the folded-in vector
+                    if row is not None and (ov is None
+                                            or not ov.covers(u)):
                         plain.append((slot, row, num))
         out: list = [None] * len(docs)
         if not plain:

@@ -180,6 +180,23 @@ class SeqRecAlgorithm(Algorithm):
 
     def __init__(self, params: SeqRecAlgorithmParams):
         super().__init__(params)
+        # bounded TTL micro-cache in front of the per-query session-history
+        # read (`serve-blocking-io`): versioned by the store's write
+        # cursor, so new events invalidate immediately and repeat queries
+        # between writes stop paying a storage scan
+        from incubator_predictionio_tpu.speed.cache import (
+            TTLCache,
+            serve_cache_ttl,
+        )
+
+        self._history_cache = TTLCache(maxsize=4096,
+                                       ttl_s=serve_cache_ttl())
+
+    def _store_version(self):
+        from incubator_predictionio_tpu.speed.cache import store_version
+
+        return store_version(self.params.app_name,
+                             self.params.channel_name)
 
     def _attn_fn(self, ctx: RuntimeContext, train_len: int):
         """Sequence-parallel attention backend per params.seq_parallel.
@@ -257,30 +274,39 @@ class SeqRecAlgorithm(Algorithm):
         return model
 
     def _history(self, query: Query, model: SeqRecModel) -> List[int]:
-        """Session history as model token ids, oldest first."""
+        """Session history as model token ids, oldest first. The
+        event-store read goes through the TTL micro-cache (new writes
+        invalidate via the store cursor)."""
         if query.recent_items is not None:
             names: Sequence[str] = query.recent_items
         else:
-            try:
-                events = list(EventStore.find_by_entity(
-                    app_name=self.params.app_name,
-                    channel_name=self.params.channel_name,
-                    entity_type="user",
-                    entity_id=query.user,
-                    event_names=list(self.params.recent_events),
-                    limit=model.max_len,
-                    latest=True,
-                ))
-            except Exception:
-                logger.warning(
-                    "sequence: recent-event lookup failed for user %r",
-                    query.user, exc_info=True,
-                )
-                events = []
-            names = [e.target_entity_id for e in reversed(events)
-                     if e.target_entity_id]
+            names = self._history_cache.get_or_load(
+                query.user,
+                lambda: self._load_history_names(query.user, model),
+                version=self._store_version())
         return [model.item_bimap[n] + 1 for n in names
                 if n in model.item_bimap]
+
+    def _load_history_names(self, user: str,
+                            model: SeqRecModel) -> List[str]:
+        try:
+            events = list(EventStore.find_by_entity(
+                app_name=self.params.app_name,
+                channel_name=self.params.channel_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.recent_events),
+                limit=model.max_len,
+                latest=True,
+            ))
+        except Exception:
+            logger.warning(
+                "sequence: recent-event lookup failed for user %r",
+                user, exc_info=True,
+            )
+            events = []
+        return [e.target_entity_id for e in reversed(events)
+                if e.target_entity_id]
 
     def warmup(self, model: SeqRecModel, max_batch: int = 1) -> None:
         """Pre-compile the serving forward (core/base.py Algorithm.warmup):

@@ -195,6 +195,12 @@ class SimilarProductModel:
     item_factors_norm: Any
     item_bimap: BiMap
     item_categories: Dict[str, Tuple[str, ...]]
+    #: frozen USER factors + index (speed layer): a brand-new item's
+    #: factor row is one regularized solve of its view events against
+    #: these — the item-side fold-in. None on pre-speed checkpoints
+    #: (restored models degrade to no overlay, never to an error).
+    user_factors: Any = None
+    user_bimap: Optional[BiMap] = None
 
 
 class SimilarProductAlgorithm(Algorithm):
@@ -223,6 +229,8 @@ class SimilarProductAlgorithm(Algorithm):
             item_factors_norm=factors_norm,
             item_bimap=pd.item_bimap,
             item_categories=pd.item_categories,
+            user_factors=np.asarray(state.user_factors),
+            user_bimap=pd.user_bimap,
         )
 
     def train_with_previous(
@@ -273,6 +281,8 @@ class SimilarProductAlgorithm(Algorithm):
             item_factors_norm=factors / jnp.maximum(norm, 1e-9),
             item_bimap=pd.item_bimap,
             item_categories=pd.item_categories,
+            user_factors=np.asarray(state.user_factors),
+            user_bimap=pd.user_bimap,
         )
 
     def prepare_model(self, ctx, model: SimilarProductModel) -> SimilarProductModel:
@@ -283,6 +293,46 @@ class SimilarProductAlgorithm(Algorithm):
             item_factors_norm=jax.device_put(
                 np.asarray(model.item_factors_norm)
             ),
+        )
+
+    def make_speed_overlay(self, model: SimilarProductModel, app_name,
+                           channel_name, data_source_params=None):
+        """ITEM-side fold-in: a brand-new (or dirty) item's factor row is
+        solved from its view/like events against the FROZEN user factors
+        — the symmetric orientation of the same ALX row solve — then
+        unit-normalized so cosine ranking works unchanged. Models restored
+        from pre-speed checkpoints (no stored user factors) get no
+        overlay."""
+        user_factors = getattr(model, "user_factors", None)
+        user_bimap = getattr(model, "user_bimap", None)
+        if app_name is None or user_factors is None or user_bimap is None:
+            return None
+        from incubator_predictionio_tpu.speed.overlay import (
+            SpeedOverlay,
+            SpeedOverlayConfig,
+        )
+
+        weights = dict(getattr(data_source_params, "event_weights", ())
+                       or (("view", 1.0), ("like", 3.0)))
+
+        def normalize(vec: np.ndarray) -> np.ndarray:
+            n = float(np.linalg.norm(vec))
+            return vec / max(n, 1e-9)
+
+        return SpeedOverlay(
+            SpeedOverlayConfig(
+                app_name=app_name, channel_name=channel_name,
+                entity_type="user", target_entity_type="item",
+                event_names=tuple(weights),
+                event_values={k: float(v) for k, v in weights.items()},
+                key_side="target",
+                l2=self.params.lambda_, implicit=True,
+                alpha=self.params.alpha,
+                transform=normalize,
+            ),
+            other_factors=np.asarray(user_factors),
+            other_index=user_bimap,
+            key_index=model.item_bimap,
         )
 
     def _allowed_mask(self, model: SimilarProductModel,
@@ -329,17 +379,30 @@ class SimilarProductAlgorithm(Algorithm):
             host_top_k,
         )
 
-        indices = [
-            model.item_bimap[i] for i in query.items if i in model.item_bimap
-        ]
-        if not indices:
+        # speed layer: query items the model never trained on (or whose
+        # events are newer than the deployed instance) contribute their
+        # FOLDED-IN unit vectors to the query average — a just-listed
+        # product gets similar-product results from its first views
+        ov = self.speed_overlay
+        indices: list = []
+        extra_vecs: list = []
+        for item in query.items:
+            vec = ov.lookup(item) if ov is not None else None
+            if vec is not None:
+                extra_vecs.append(np.asarray(vec, np.float32))
+            elif item in model.item_bimap:
+                indices.append(model.item_bimap[item])
+        if not indices and not extra_vecs:
             return PredictedResult(item_scores=())
         mask = self._allowed_mask(model, query)
         k = min(query.num, len(model.item_bimap))
         host = host_arrays(model, "item_factors_norm")
         if host is not None:
             (factors,) = host
-            query_vec = factors[np.asarray(indices, np.int32)].mean(axis=0)
+            parts = ([factors[np.asarray(indices, np.int32)]]
+                     if indices else []) + (
+                [np.stack(extra_vecs)] if extra_vecs else [])
+            query_vec = np.concatenate(parts).mean(axis=0)
             query_vec = query_vec / max(float(np.linalg.norm(query_vec)),
                                         1e-9)
             top_s, top_i = host_top_k(factors @ query_vec, k,
@@ -352,7 +415,15 @@ class SimilarProductAlgorithm(Algorithm):
             )
 
             factors = jnp.asarray(model.item_factors_norm)
-            query_vec = factors[jnp.asarray(indices, jnp.int32)].mean(axis=0)
+            if indices:
+                query_vec = factors[
+                    jnp.asarray(indices, jnp.int32)].sum(axis=0)
+            else:
+                query_vec = jnp.zeros(factors.shape[1], jnp.float32)
+            if extra_vecs:
+                query_vec = query_vec + jnp.asarray(
+                    np.sum(extra_vecs, axis=0, dtype=np.float32))
+            query_vec = query_vec / (len(indices) + len(extra_vecs))
             qnorm = jnp.linalg.norm(query_vec)
             query_vec = query_vec / jnp.maximum(qnorm, 1e-9)
             scores = factors @ query_vec  # cosine (pre-normalized factors)

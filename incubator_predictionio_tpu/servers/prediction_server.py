@@ -47,7 +47,11 @@ from incubator_predictionio_tpu.utils.http import (
     Response,
     Router,
 )
-from incubator_predictionio_tpu.utils.times import format_iso8601, now_utc
+from incubator_predictionio_tpu.utils.times import (
+    ensure_aware,
+    format_iso8601,
+    now_utc,
+)
 from incubator_predictionio_tpu.workflow import CoreWorkflow
 from incubator_predictionio_tpu.workflow.workflow import make_runtime_context
 
@@ -302,6 +306,9 @@ class PredictionServer:
         # status page); --log-url diagnostics stay shallow and lossy
         self._feedback_poster = _AsyncPoster("feedback", maxsize=16384)
         self._log_poster = _AsyncPoster("log", workers=1, maxsize=256)
+        #: live speed-layer overlays (speed/overlay.py), rebuilt per
+        #: deploy/reload — the Lambda speed leg between retrains
+        self._speed_overlays: List[Any] = []
 
     # -- deploy lifecycle ---------------------------------------------------
     def _resolve_instance(self) -> EngineInstance:
@@ -349,16 +356,81 @@ class PredictionServer:
         _ds, _prep, algorithms, serving = self.engine.components(engine_params)
         if warm_before_swap:
             self._warm_models(algorithms, models)
+        overlays = self._build_speed_overlays(engine_params, algorithms,
+                                              models)
         with self._lock:
             self.engine_instance = instance
             self.engine_params = engine_params
             self.algorithms = algorithms
             self.serving = serving
             self.models = models
+            # getattr: tests and the bench build servers via __new__
+            # with hand-injected state
+            old_overlays = getattr(self, "_speed_overlays", [])
+            self._speed_overlays = overlays
+        # hot model swap: the OLD overlays' vectors were solved against
+        # the old factors — invalidated wholesale and stopped. Their KEYS
+        # (fresh sessions the new model may still not know) carry over as
+        # dirty marks so the new overlays re-solve them against the new
+        # factors instead of dropping fresh users until their next event.
+        # Both lists are ALGORITHM-ALIGNED (None where an algorithm has
+        # no overlay), so adoption can never pair across algorithms.
+        for old, ov in zip(old_overlays, overlays):
+            if old is None or ov is None:
+                continue
+            try:
+                ov.adopt_keys(old.known_keys())
+            except Exception:
+                logger.exception("speed overlay key adoption failed")
+        for ov in old_overlays:
+            if ov is None:
+                continue
+            try:
+                ov.invalidate_all()
+                ov.stop()
+            except Exception:
+                logger.exception("speed overlay teardown failed")
+        for ov in overlays:
+            if ov is not None:
+                ov.start()
         logger.info(
-            "Engine instance %s deployed (%d algorithms)",
-            instance.id, len(self.algorithms),
+            "Engine instance %s deployed (%d algorithms, %d speed "
+            "overlays)", instance.id, len(self.algorithms),
+            sum(1 for ov in overlays if ov is not None),
         )
+
+    def _build_speed_overlays(self, engine_params, algorithms,
+                              models) -> List[Any]:
+        """One overlay per algorithm that offers a fold-in config
+        (core/base.py Algorithm.make_speed_overlay), attached to the
+        algorithm for its predict path. Gated by PIO_SPEED_LAYER
+        (default on); any construction failure disables the overlay for
+        that algorithm only — serving never depends on the speed leg.
+        The returned list is ALGORITHM-ALIGNED (None placeholders), so
+        hot-swap key adoption pairs old and new overlays by algorithm."""
+        dsp = engine_params.data_source_params[1]
+        app_name = getattr(dsp, "app_name", None)
+        channel_name = getattr(dsp, "channel_name", None)
+        disabled = os.environ.get("PIO_SPEED_LAYER", "1").lower() in (
+            "0", "off", "false")
+        overlays: List[Any] = []
+        for algo, model in zip(algorithms, models):
+            overlay = None
+            if not disabled:
+                try:
+                    overlay = algo.make_speed_overlay(
+                        model, app_name, channel_name,
+                        data_source_params=dsp)
+                    if overlay is not None and not overlay.enabled:
+                        overlay = None  # backend without tail support
+                except Exception:
+                    logger.exception(
+                        "speed overlay unavailable for %s",
+                        type(algo).__name__)
+                    overlay = None
+            algo.attach_speed_overlay(overlay)
+            overlays.append(overlay)
+        return overlays
 
     # -- query pipeline -----------------------------------------------------
     def _handle_query(self, body: bytes) -> Any:
@@ -585,6 +657,27 @@ class PredictionServer:
             prediction_json = dict(prediction_json, prId=pr_id)
         return prediction_json
 
+    def _speed_status_locked(self) -> Dict[str, Any]:
+        """Aggregate speed-overlay stats for /status (caller holds
+        self._lock). size/hits/misses/foldins sum over the deployed
+        algorithms' overlays; cursorLagEvents is the worst lag."""
+        overlays = [ov for ov in getattr(self, "_speed_overlays", [])
+                    if ov is not None]
+        out = {"overlays": len(overlays), "size": 0,
+               "hits": 0, "misses": 0, "foldins": 0, "cursorLagEvents": 0}
+        for ov in overlays:
+            try:
+                s = ov.stats()
+            except Exception:
+                continue
+            out["size"] += s["size"]
+            out["hits"] += s["hits"]
+            out["misses"] += s["misses"]
+            out["foldins"] += s["foldins"]
+            out["cursorLagEvents"] = max(out["cursorLagEvents"],
+                                         s["cursorLagEvents"])
+        return out
+
     # -- auth for /stop, /reload (common/.../KeyAuthentication.scala:34) ----
     def _check_server_key(self, request: Request) -> None:
         provided = request.query.get("accessKey")
@@ -629,6 +722,15 @@ class PredictionServer:
                     "servingSecP99": _QUERY_LATENCY.quantile(0.99) or 0.0,
                     "maxBatchServed": self.max_batch_served,
                     "feedbackEventsDropped": self._feedback_poster.dropped,
+                    # model staleness: seconds since the served instance
+                    # finished training — the figure the speed layer
+                    # exists to make tolerable (docs/production.md
+                    # "Freshness between retrains")
+                    "modelStalenessSec": (
+                        max((now_utc() - ensure_aware(instance.end_time))
+                            .total_seconds(), 0.0)
+                        if instance is not None else None),
+                    "speedOverlay": self._speed_status_locked(),
                 }
             accept = request.headers.get("accept", "")
             if "text/html" in accept:
@@ -814,6 +916,13 @@ class PredictionServer:
     def stop(self) -> None:
         if self._batcher is not None:
             self._batcher.stop()
+        for ov in getattr(self, "_speed_overlays", []):
+            if ov is None:
+                continue
+            try:
+                ov.stop()
+            except Exception:
+                logger.exception("speed overlay stop failed")
         self._feedback_poster.stop()
         self._log_poster.stop()
         self.http.stop()

@@ -1,0 +1,61 @@
+"""Speed layer — device-side fold-in serving between retrains.
+
+PredictionIO is explicitly a Lambda Architecture; this package is the
+missing speed leg next to the batch leg (train + O(delta) continuation
+retrain) and the serving leg. It keeps deployed models fresh WITHOUT
+retraining:
+
+- :mod:`.foldin` — batched regularized least-squares row solves against
+  the frozen other-side factors, reusing the training CG machinery
+  (ops/als.py) and padded to a fixed bucket ladder so the compile cache
+  stays warm (no per-query recompiles). This is the same row solve ALX
+  (arxiv 2112.02194) runs at scale on TPUs.
+- :mod:`.overlay` — the real-time overlay: a log-tail cursor subscriber
+  (base.Events.tail_cursor / read_interactions_since) maintains a
+  per-key dirty set, folds dirty/unknown keys in batches, and caches the
+  solved vectors with a TTL, keyed (key, cursor). Invalidated wholesale
+  on hot model swap and per-key on newer events.
+- :mod:`.cache` — the bounded TTL micro-cache the serving hot paths use
+  in front of synchronous EventStore reads (the `serve-blocking-io`
+  pio-lint rule points here).
+
+Serving integration: the prediction server builds one overlay per
+algorithm that offers a fold-in config (core/base.py
+``Algorithm.make_speed_overlay``) and the engines consult it before the
+base model — fresh sessions and brand-new users get exact model-quality
+scores seconds after their first events, not after the next retrain.
+"""
+
+__all__ = [
+    "FoldInSolver",
+    "SpeedOverlay",
+    "SpeedOverlayConfig",
+    "TTLCache",
+    "foldin_compile_cache_size",
+]
+
+#: lazy re-exports (PEP 562): importing ``speed.cache`` from a serving
+#: algorithm's __init__ must NOT drag jax in through ``foldin`` — the
+#: storage-only CLI verbs pin their platform before any jax import
+_EXPORTS = {
+    "TTLCache": ("incubator_predictionio_tpu.speed.cache", "TTLCache"),
+    "FoldInSolver": (
+        "incubator_predictionio_tpu.speed.foldin", "FoldInSolver"),
+    "foldin_compile_cache_size": (
+        "incubator_predictionio_tpu.speed.foldin",
+        "foldin_compile_cache_size"),
+    "SpeedOverlay": (
+        "incubator_predictionio_tpu.speed.overlay", "SpeedOverlay"),
+    "SpeedOverlayConfig": (
+        "incubator_predictionio_tpu.speed.overlay", "SpeedOverlayConfig"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(module), attr)

@@ -8,12 +8,62 @@ in-memory representation is a timezone-aware ``datetime.datetime``.
 
 from __future__ import annotations
 
+import time as _time
 from datetime import datetime, timezone
+from typing import Callable
 
 
 def now_utc() -> datetime:
     """Current time as a timezone-aware UTC datetime."""
     return datetime.now(timezone.utc)
+
+
+# ---------------------------------------------------------------------------
+# Clock seam — TTL/staleness decisions route through here so tests can
+# inject a fake clock instead of sleeping (speed-layer overlay TTLs, the
+# serving micro-caches, /status staleness). Production code calls
+# :func:`monotonic`; tests swap the source with :func:`set_monotonic`
+# (restoring the previous source in a finally block) or use
+# :class:`FakeClock` directly.
+# ---------------------------------------------------------------------------
+
+_monotonic_source: Callable[[], float] = _time.monotonic
+
+
+def monotonic() -> float:
+    """Seconds from an arbitrary epoch, never going backwards — the ONE
+    clock every TTL/staleness decision reads (time.monotonic by default).
+    """
+    return _monotonic_source()
+
+
+def set_monotonic(source: Callable[[], float]) -> Callable[[], float]:
+    """Swap the monotonic source (tests inject a FakeClock); returns the
+    previous source so callers can restore it in a finally block."""
+    global _monotonic_source
+    prev = _monotonic_source
+    _monotonic_source = source
+    return prev
+
+
+class FakeClock:
+    """Deterministic clock for TTL tests: ``advance`` instead of sleep.
+
+    Install with ``prev = set_monotonic(clock)`` and restore with
+    ``set_monotonic(prev)``; or pass the instance directly to components
+    that take a ``clock=`` callable.
+    """
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        if seconds < 0:
+            raise ValueError("a monotonic clock cannot go backwards")
+        self._now += float(seconds)
 
 
 def ensure_aware(dt: datetime) -> datetime:

@@ -48,6 +48,12 @@ REQUIRED_FIELDS = {
     "retrain_heldout_rmse_fresh": float,
     "retrain_heldout_rmse_continue": float,
     "retrain_speedup": float,
+    # speed-layer leg (docs/production.md "Freshness between retrains"):
+    # device fold-in under concurrent ingest + serve
+    "speed_foldin_p50_ms": float,
+    "speed_foldin_p95_ms": float,
+    "speed_hit_rate": float,
+    "speed_cursor_lag_events": int,
 }
 
 
@@ -116,3 +122,11 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
     assert rec["retrain_delta_rows"] >= 1
     assert rec["retrain_continue_wall_s"] > 0
     assert rec["retrain_fresh_wall_s"] > 0
+    # speed leg sanity: cold users were ingested AND folded in, the
+    # overlay served hits, and the fold-in cycle produced real walls
+    assert rec["speed_foldins"] >= 1
+    assert rec["speed_ingested_keys"] >= 1
+    assert 0.0 < rec["speed_hit_rate"] <= 1.0
+    assert rec["speed_foldin_p50_ms"] > 0
+    assert rec["speed_foldin_p95_ms"] >= rec["speed_foldin_p50_ms"]
+    assert rec["speed_cursor_lag_events"] >= 0

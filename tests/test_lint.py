@@ -248,6 +248,32 @@ def serve(x, ids):
     return out
 """,
     ),
+    "serve-blocking-io": (
+        """
+from incubator_predictionio_tpu.data.store import EventStore
+
+class Algo:
+    def _recent(self, user):
+        return list(EventStore.find_by_entity(
+            app_name="app", entity_type="user", entity_id=user))
+
+    def predict(self, model, query):
+        return self._recent(query.user)
+""",
+        """
+from incubator_predictionio_tpu.data.store import EventStore
+
+class Algo:
+    def train(self, ctx, pd):
+        # train-time reads are not the serving hot path
+        return list(EventStore.find(app_name="app"))
+
+    def predict(self, model, query):
+        # serving reads go through the TTL micro-cache's public API;
+        # the cache-miss loader lives outside predict's reach
+        return self._cache.get_or_load(query.user, _load_recent)
+""",
+    ),
     "server-state": (
         """
 class Handler:

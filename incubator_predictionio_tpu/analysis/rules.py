@@ -745,6 +745,87 @@ class ServeBlockingIO(Rule):
                         "the TTL micro-cache (speed/cache.py)")
 
 
+# ---------------------------------------------------------------------------
+# 12. blocking profiler calls on the serving hot path
+# ---------------------------------------------------------------------------
+
+#: profiler-capture entry points — each one either serializes the device
+#: (block_until_ready per query) or starts a process-wide trace capture;
+#: both are catastrophic inside a predict path that is supposed to
+#: pipeline dispatches
+_PROFILER_CAPTURE_CALLS = {
+    "jax.block_until_ready",
+    "jax.profiler.start_trace",
+    "jax.profiler.stop_trace",
+    "jax.profiler.trace",
+    "jax.profiler.start_server",
+    "jax.profiler.TraceAnnotation",
+}
+
+
+class BlockingProfiler(Rule):
+    name = "blocking-profiler"
+    severity = "error"
+    doc = ("block_until_ready / jax.profiler capture call reachable "
+           "from a predict/batch_predict/batch_serve_json hot path — "
+           "each query then synchronizes (or trace-captures) the whole "
+           "device instead of pipelining dispatches; route device-wall "
+           "attribution through obs/profile.py (profile.t0()/record(), "
+           "gated on PIO_PROFILE and exempt from this rule)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        # obs/profile.py IS the sanctioned guard: its record() exists so
+        # nobody else ever writes a bare block_until_ready on a serve
+        # path, and its own block is env-gated
+        path = str(mod.path).replace("\\", "/")
+        if path.endswith("obs/profile.py"):
+            return
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {
+                n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            edges: dict = {}
+            for name, fn in methods.items():
+                callees = set()
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self"
+                            and node.func.attr in methods):
+                        callees.add(node.func.attr)
+                edges[name] = callees
+            reachable: Set[str] = set()
+            stack = [m for m in _SERVE_ENTRY_POINTS if m in methods]
+            while stack:
+                m = stack.pop()
+                if m in reachable:
+                    continue
+                reachable.add(m)
+                stack.extend(edges.get(m, ()))
+            for name in sorted(reachable):
+                for node in ast.walk(methods[name]):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    rname = mod.resolved(node.func) or ""
+                    blocking = rname in _PROFILER_CAPTURE_CALLS or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "block_until_ready")
+                    if not blocking:
+                        continue
+                    what = (f"{rname}()" if rname
+                            else f".{node.func.attr}()")
+                    yield mod.finding(
+                        self, node,
+                        f"{what} reachable from the serving hot path "
+                        f"(via {name!r}) — a device sync/capture per "
+                        "query; use obs/profile.py's gated "
+                        "t0()/record() instead")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -757,6 +838,7 @@ ALL_RULES: Sequence[Rule] = (
     LockNativeScan(),
     MetricInTrace(),
     ServeBlockingIO(),
+    BlockingProfiler(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

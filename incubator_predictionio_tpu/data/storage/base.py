@@ -810,12 +810,30 @@ class Events(abc.ABC):
         default_value: float = 1.0,
     ):
         """Columnar scan of ONLY the events written since ``cursor`` →
-        ``(Interactions, times_ms, new_cursor, reset)``. Value-resolution
-        semantics are identical to :meth:`scan_interactions`; rows arrive
-        in write order. ``reset=True`` (a cursor from a previous log
-        generation — compaction/drop renumbered the entries) carries an
-        EMPTY tail and a fresh cursor: the caller must drop everything it
-        derived and resynchronize."""
+        ``(Interactions, times_ms, append_ms, new_cursor, reset)``.
+        Value-resolution semantics are identical to
+        :meth:`scan_interactions`; rows arrive in write order.
+
+        ``append_ms`` (int64 [nnz]) is the wall-clock epoch-millisecond
+        stamp of when each row's event was APPENDED to the log — the
+        anchor of the end-to-end freshness trace (obs/freshness.py),
+        distinct from the event's logical ``eventTime`` (a backfill can
+        carry last year's event times but fresh append stamps). Backends
+        stamp it as precisely as they can, and always CONSERVATIVELY —
+        a stamp may be early (age overstated) but never late (freshness
+        is never fabricated): the in-memory backend records exact
+        per-slot walls; the native log bounds each batch by its newest
+        count observation at/below the cursor (exact when this process
+        wrote the events; within one poll interval when another process
+        did, since every tail read records what it saw). ``-1`` means
+        the backend cannot bound the append wall (e.g. entries written
+        before the subscriber's first look at the log) and the row is
+        excluded from freshness tracing.
+
+        ``reset=True`` (a cursor from a previous log generation —
+        compaction/drop renumbered the entries) carries an EMPTY tail
+        and a fresh cursor: the caller must drop everything it derived
+        and resynchronize."""
         raise NotImplementedError(
             f"{type(self).__name__} does not support tail reads")
 

@@ -24,6 +24,7 @@ import ctypes
 import json
 import logging
 import threading
+from collections import deque
 from datetime import datetime
 from pathlib import Path
 from typing import Any, Iterator, Optional, Sequence
@@ -113,6 +114,20 @@ class StorageClient(base.BaseStorageClient):
         # before the rewrite are detectable even after the entry count
         # grows past its old value (speed-layer resync contract)
         self._generations: dict[str, int] = {}
+        # per-log COUNT OBSERVATIONS: (entry_count, wall_ms) snapshots —
+        # "at wall w this process saw the log hold c entries". Pushed by
+        # appends (exact: the count just before/after the write) AND by
+        # every tail read / tail_cursor call, so a pure READER process
+        # (the split-deployment prediction server polling a log the
+        # event server writes) still bounds append times by its own poll
+        # cadence. The freshness trace stamps a tail [lo, hi) with the
+        # NEWEST observation whose count <= lo: every entry past lo was
+        # appended after that wall, so age is only ever OVERSTATED —
+        # exactly (base.py contract) — by at most one append batch
+        # in-process and one poll interval cross-process. No covering
+        # observation -> -1 (unattributable, dropped from the trace).
+        # Cleared on generation bump (entries renumber).
+        self._count_marks: dict[str, "deque"] = {}
 
     def generation(self, ns: str, app_id: int,
                    channel_id: Optional[int]) -> int:
@@ -123,6 +138,42 @@ class StorageClient(base.BaseStorageClient):
     def bump_generation_locked(self, path) -> None:
         key = str(path)
         self._generations[key] = self._generations.get(key, 0) + 1
+        # entries renumber: every count observation is now meaningless
+        self._count_marks.pop(key, None)
+
+    def note_count_locked(self, path, count: int) -> None:
+        """Record one count observation ("the log held ``count`` entries
+        now") — the freshness trace's append-stamp source. Appends push
+        their before/after counts (exact stamps); tail reads and
+        tail_cursor push what they saw (the cross-process bound). Caller
+        holds the client lock."""
+        from incubator_predictionio_tpu.utils.times import wall_millis
+
+        marks = self._count_marks.get(str(path))
+        if marks is None:
+            marks = self._count_marks[str(path)] = deque(maxlen=4096)
+        count = int(count)
+        if marks and marks[-1][0] == count:
+            # same count seen later: the newer wall is the TIGHTER lower
+            # bound for entries appended past it
+            marks[-1] = (count, wall_millis())
+            return
+        marks.append((count, wall_millis()))
+
+    def append_wall_since_locked(self, path, lo: int) -> int:
+        """Append-wall lower bound (epoch ms) for entries at/after
+        position ``lo``: the NEWEST count observation with count <= lo —
+        every entry past ``lo`` was appended after that wall, so the
+        batch's age can only be OVERSTATED (base.py contract), never
+        fabricated fresh. -1 when no observation covers ``lo`` (the
+        entries predate everything this process has seen — e.g. a log
+        written before the first poll). Caller holds the client lock."""
+        marks = self._count_marks.get(str(path))
+        if marks:
+            for count, wall in reversed(marks):
+                if count <= lo:
+                    return wall
+        return -1
 
     def pin(self, ns: str, app_id: int, channel_id: Optional[int]) -> str:
         """Mark the (ns, app, channel) handle as read-busy; returns the
@@ -518,6 +569,11 @@ class CppLogEvents(base.Events):
             )
             if rc != n_write:
                 raise base.StorageError("bulk event append failed")
+            if n_write:
+                end = self.client.lib.pio_evlog_entry_count(h)
+                path = self.client._file(self.ns, app_id, channel_id)
+                self.client.note_count_locked(path, end - n_write)
+                self.client.note_count_locked(path, end)
         return ids
 
     def get(self, event_id: str, app_id: int,
@@ -733,10 +789,13 @@ class CppLogEvents(base.Events):
         past the old count before the next poll"."""
         with self.client.lock:
             h = self._handle(app_id, channel_id)
-            gen = self.client._generations.get(
-                str(self.client._file(self.ns, app_id, channel_id)), 0)
-            return (gen << self.TAIL_GEN_SHIFT) | int(
-                self.client.lib.pio_evlog_entry_count(h))
+            path = self.client._file(self.ns, app_id, channel_id)
+            gen = self.client._generations.get(str(path), 0)
+            count = int(self.client.lib.pio_evlog_entry_count(h))
+            # count observation: anchors the freshness bound for a pure
+            # READER process (the subscriber calls this at startup)
+            self.client.note_count_locked(path, count)
+            return (gen << self.TAIL_GEN_SHIFT) | count
 
     def read_interactions_since(
         self,
@@ -751,12 +810,24 @@ class CppLogEvents(base.Events):
         default_value: float = 1.0,
     ):
         """Tail scan over entries [cursor_pos, entry_count) →
-        (Interactions, times, new_cursor, reset). Rides the
+        (Interactions, times, append_ms, new_cursor, reset). Rides the
         bounded-range sharded scan (entry order, lock-free on a pinned
         handle) — the same O(delta) machinery the traincache fold uses,
         so polling the tail costs the tail, not the log. A cursor minted
         before a compaction/drop (generation mismatch) returns an EMPTY
-        tail with ``reset=True`` — the subscriber resynchronizes."""
+        tail with ``reset=True`` — the subscriber resynchronizes.
+
+        Append stamps resolve from the client's python-side COUNT
+        observations at BATCH granularity (the native record has no
+        append-wall column): every row in this tail read carries the
+        newest observed wall at which the log still held <= cursor
+        entries, so a row's age is conservatively OVERSTATED — by at
+        most one append batch when this process wrote the events, and by
+        at most one poll interval when another process did (each tail
+        read records its own observation, so a pure reader bounds the
+        next delta by its poll cadence). Entries that predate every
+        observation (a log written before the subscriber's first look)
+        report -1 and drop out of the freshness trace."""
         import numpy as np
 
         names = [str(n) for n in event_names]
@@ -764,8 +835,8 @@ class CppLogEvents(base.Events):
         gen_mask = (1 << self.TAIL_GEN_SHIFT) - 1
         with self.client.lock:
             h = self._handle(app_id, channel_id)
-            gen = self.client._generations.get(
-                str(self.client._file(self.ns, app_id, channel_id)), 0)
+            path = self.client._file(self.ns, app_id, channel_id)
+            gen = self.client._generations.get(str(path), 0)
             raw = int(self.client.lib.pio_evlog_entry_count(h))
             pin = self.client.pin(self.ns, app_id, channel_id)
         try:
@@ -774,18 +845,28 @@ class CppLogEvents(base.Events):
             cur_gen, lo = cur >> self.TAIL_GEN_SHIFT, cur & gen_mask
             reset = cur_gen != gen or lo > raw
             if reset or raw <= lo:
+                with self.client.lock:
+                    if not reset:
+                        self.client.note_count_locked(path, raw)
                 empty = base.Interactions(
                     user_idx=np.empty(0, np.int32),
                     item_idx=np.empty(0, np.int32),
                     values=np.empty(0, np.float32),
                     user_ids=base.IdTable(b"", np.zeros(1, np.int64)),
                     item_ids=base.IdTable(b"", np.zeros(1, np.int64)))
-                return empty, np.empty(0, np.int64), new_cursor, reset
+                return (empty, np.empty(0, np.int64),
+                        np.empty(0, np.int64), new_cursor, reset)
+            with self.client.lock:
+                append_wall = self.client.append_wall_since_locked(
+                    path, lo)
+                # this read's own observation bounds the NEXT delta
+                self.client.note_count_locked(path, raw)
             inter, times = self._scan_sharded(
                 h, raw, None, None, entity_type, target_entity_type,
                 names, fixed, value_prop, default_value,
                 min_entry_idx=lo)
-            return inter, times, new_cursor, False
+            append_ms = np.full(len(inter), append_wall, np.int64)
+            return inter, times, append_ms, new_cursor, False
         finally:
             self.client.unpin(pin)
 
@@ -1437,6 +1518,9 @@ class CppLogEvents(base.Events):
             seed,
         )
         if rc == n:
+            path = self.client._file(self.ns, app_id, channel_id)
+            self.client.note_count_locked(path, raw_before)
+            self.client.note_count_locked(path, raw_before + n)
             try:
                 self._maintain_cache_after_import(
                     h, app_id, channel_id, raw_before, dead_before,

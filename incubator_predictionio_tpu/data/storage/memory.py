@@ -19,7 +19,7 @@ from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
 
 from incubator_predictionio_tpu.data.event import Event, new_event_id, validate_event
 from incubator_predictionio_tpu.data.storage import base
-from incubator_predictionio_tpu.utils.times import to_millis
+from incubator_predictionio_tpu.utils.times import to_millis, wall_millis
 from incubator_predictionio_tpu.data.storage.base import UNSET
 
 
@@ -29,8 +29,10 @@ class _Namespace:
     def __init__(self) -> None:
         # (app_id, channel_id) -> {event_id: Event}
         self.events: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
-        # (app_id, channel_id) -> append-ordered write tail (upserts
-        # append again — a new write in the cross-backend order contract).
+        # (app_id, channel_id) -> append-ordered write tail of
+        # (Event, append_wall_ms) pairs (upserts append again — a new
+        # write in the cross-backend order contract; the wall stamp is
+        # the freshness-tracing anchor: event APPENDED, not event TIME).
         # Backs the speed layer's tail_cursor/read_interactions_since.
         self.event_tail: Dict[Tuple[int, Optional[int]], list] = {}
         # tail generation per table: bumped by remove() so stale cursors
@@ -143,8 +145,8 @@ class MemoryEvents(_MemoryDAO, base.Events):
         if not tail:
             return
         for i in range(len(tail) - 1, -1, -1):
-            e = tail[i]
-            if e is not None and e.event_id == event_id:
+            entry = tail[i]
+            if entry is not None and entry[0].event_id == event_id:
                 tail[i] = None
                 return
 
@@ -163,7 +165,7 @@ class MemoryEvents(_MemoryDAO, base.Events):
                 self._tail_tombstone(app_id, channel_id, eid)
             table[eid] = event.with_id(eid)
             self.t.event_tail.setdefault((app_id, channel_id), []).append(
-                table[eid])
+                (table[eid], wall_millis()))
         return eid
 
     # -- speed-layer tail cursor -------------------------------------------
@@ -206,7 +208,8 @@ class MemoryEvents(_MemoryDAO, base.Events):
                             item_idx=np.empty(0, np.int32),
                             values=np.empty(0, np.float32),
                             user_ids=[], item_ids=[]),
-                        np.empty(0, np.int64), new_cursor, True)
+                        np.empty(0, np.int64), np.empty(0, np.int64),
+                        new_cursor, True)
             rows = list(tail[cur_pos:pos])
         fixed = event_values or {}
         names = set(event_names)
@@ -216,9 +219,11 @@ class MemoryEvents(_MemoryDAO, base.Events):
         iidx: list = []
         vals: list = []
         times: list = []
-        for e in rows:
-            if e is None:  # tombstoned (deleted/superseded) slot
+        appends: list = []
+        for entry in rows:
+            if entry is None:  # tombstoned (deleted/superseded) slot
                 continue
+            e, appended_ms = entry
             if (e.event not in names or e.entity_type != entity_type
                     or e.target_entity_type != target_entity_type
                     or e.target_entity_id is None):
@@ -236,6 +241,7 @@ class MemoryEvents(_MemoryDAO, base.Events):
             iidx.append(items.setdefault(e.target_entity_id, len(items)))
             vals.append(v)
             times.append(to_millis(e.event_time))
+            appends.append(appended_ms)
         inter = base.Interactions(
             user_idx=np.asarray(uidx, np.int32),
             item_idx=np.asarray(iidx, np.int32),
@@ -243,7 +249,8 @@ class MemoryEvents(_MemoryDAO, base.Events):
             user_ids=list(users),
             item_ids=list(items),
         )
-        return inter, np.asarray(times, np.int64), new_cursor, False
+        return (inter, np.asarray(times, np.int64),
+                np.asarray(appends, np.int64), new_cursor, False)
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:

@@ -161,8 +161,11 @@ class EventStore:
         default_value: float = 1.0,
     ):
         """Columnar scan of only the events written since ``cursor`` →
-        (Interactions, times_ms, new_cursor, reset). O(delta): the speed
-        layer polls this to maintain its dirty set between retrains;
+        (Interactions, times_ms, append_ms, new_cursor, reset). O(delta):
+        the speed layer polls this to maintain its dirty set between
+        retrains; ``append_ms`` carries each row's wall-clock APPEND
+        stamp (the end-to-end freshness anchor, -1 when the backend
+        cannot attribute one — base.Events.read_interactions_since);
         ``reset=True`` means the log was rewritten (compaction/drop) and
         everything derived from older cursors must be dropped."""
         app_id, channel_id = _resolve(app_name, channel_name)

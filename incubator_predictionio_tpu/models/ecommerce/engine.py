@@ -252,6 +252,7 @@ class ECommAlgorithm(Algorithm):
         return SpeedOverlay(
             SpeedOverlayConfig(
                 app_name=app_name, channel_name=channel_name,
+                engine="ecommerce",
                 entity_type="user", target_entity_type="item",
                 event_names=tuple(weights),
                 event_values={k: float(v) for k, v in weights.items()},

@@ -515,6 +515,7 @@ class ALSAlgorithm(Algorithm):
         return SpeedOverlay(
             SpeedOverlayConfig(
                 app_name=app_name, channel_name=channel_name,
+                engine="recommendation",
                 entity_type="user", target_entity_type="item",
                 event_names=("rate", "buy"), value_prop="rating",
                 event_values={"buy": buy_rating},

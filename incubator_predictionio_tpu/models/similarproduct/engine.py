@@ -322,6 +322,7 @@ class SimilarProductAlgorithm(Algorithm):
         return SpeedOverlay(
             SpeedOverlayConfig(
                 app_name=app_name, channel_name=channel_name,
+                engine="similarproduct",
                 entity_type="user", target_entity_type="item",
                 event_names=tuple(weights),
                 event_values={k: float(v) for k, v in weights.items()},

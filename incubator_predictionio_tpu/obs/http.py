@@ -1,4 +1,4 @@
-"""HTTP-side observability: the shared /metrics route.
+"""HTTP-side observability: the shared /metrics, /slo and /profile routes.
 
 Every server's ``_build_router`` calls :func:`add_metrics_route` so
 ``GET /metrics`` answers Prometheus text exposition from the
@@ -7,6 +7,14 @@ admin :7071, dashboard :9000 — plus the storage server). The route is
 unauthenticated by design, like the reference's status pages: it
 exposes operational counters, never event data; bind-address policy is
 the operator's access control, same as ``GET /``.
+
+``GET /slo`` (admin + dashboard, :func:`add_slo_route`) answers the SLO
+burn-rate engine's JSON evaluation — error budget remaining and
+fast/slow burn rates per declared objective (obs/slo.py).
+
+``POST /profile?seconds=N`` (admin, :func:`add_profile_route`) captures
+an on-demand ``jax.profiler`` xplane trace (obs/profile.py) for offline
+kernel analysis.
 
 The request-level instrumentation itself (per-route counters, latency
 histogram, trace-ID stamping, span logs) lives in the HTTP layer
@@ -18,11 +26,51 @@ from __future__ import annotations
 from incubator_predictionio_tpu.obs import metrics
 
 
+def _set_build_info() -> None:
+    """Register the constant ``pio_build_info{version,jax_version,
+    backend}`` gauge (value always 1 — the standard Prometheus build-
+    info idiom: the *labels* are the data, joinable onto any series).
+    The backend label reports the CONFIGURED platform (``JAX_PLATFORMS``
+    or "default") rather than poking ``jax.devices()`` — a scrape must
+    never be the thing that initializes a TPU backend — and the jax
+    version comes from package metadata (or sys.modules when jax is
+    already up), never a fresh ``import jax``: the event server is
+    deliberately jax-free and must not pay the import for a label."""
+    import os
+    import sys
+
+    mod = sys.modules.get("jax")
+    if mod is not None:
+        jax_version = getattr(mod, "__version__", "unknown")
+    else:
+        try:
+            from importlib.metadata import version
+
+            jax_version = version("jax")
+        except Exception:
+            jax_version = "unavailable"
+    try:
+        from incubator_predictionio_tpu import __version__ as version
+    except Exception:
+        version = "unknown"
+    metrics.REGISTRY.gauge(
+        "pio_build_info",
+        "constant build/runtime identity gauge (always 1; the labels "
+        "are the data)",
+        labels=("version", "jax_version", "backend"),
+    ).labels(
+        version=version, jax_version=jax_version,
+        backend=os.environ.get("JAX_PLATFORMS") or "default",
+    ).set(1)
+
+
 def add_metrics_route(router) -> None:
     """Register ``GET /metrics`` (Prometheus text exposition) on a
     Router. Imports the http module lazily — obs must stay importable
     below utils/http.py, which itself imports obs for instrumentation."""
     from incubator_predictionio_tpu.utils.http import Request, Response
+
+    _set_build_info()
 
     def metrics_route(request: Request) -> Response:
         return Response(
@@ -32,3 +80,114 @@ def add_metrics_route(router) -> None:
         )
 
     router.add("GET", "/metrics", metrics_route)
+
+
+def add_slo_route(router) -> None:
+    """Register ``GET /slo`` — the burn-rate engine's JSON evaluation.
+    Unauthenticated like /metrics (operational state only)."""
+    from incubator_predictionio_tpu.obs import slo as obs_slo
+    from incubator_predictionio_tpu.utils.http import Request, Response
+
+    def slo_route(request: Request) -> Response:
+        engine = obs_slo.get_engine()
+        return Response(200, {
+            "slos": engine.evaluate(),
+            "windows": {"fastSeconds": engine.fast_window_s,
+                        "slowSeconds": engine.slow_window_s},
+        })
+
+    router.add("GET", "/slo", slo_route)
+
+
+def add_profile_route(router) -> None:
+    """Register ``POST /profile?seconds=N`` — on-demand jax.profiler
+    xplane capture (obs/profile.py). The handler is synchronous, so the
+    HTTP layer runs it on the executor: the capture window never blocks
+    the event loop. 409 while another capture runs, 400 on a bad
+    window."""
+    from incubator_predictionio_tpu.obs import profile as obs_profile
+    from incubator_predictionio_tpu.utils.http import Request, Response
+
+    def profile_route(request: Request) -> Response:
+        try:
+            seconds = float(request.query.get("seconds", "1"))
+        except ValueError:
+            return Response(400, {"message": "seconds must be a number"})
+        try:
+            out = obs_profile.capture_trace(seconds)
+        except ValueError as e:
+            return Response(400, {"message": str(e)})
+        except RuntimeError as e:
+            return Response(409, {"message": str(e)})
+        except Exception as e:  # profiler unavailable on this backend
+            return Response(503, {"message": f"profiler capture "
+                                             f"failed: {e}"})
+        return Response(200, out)
+
+    router.add("POST", "/profile", profile_route)
+
+
+def render_latency_panels() -> str:
+    """HTML panel rows for the dashboard: p50/p95/p99 serving latency
+    and the freshness histogram's quantiles, derived from the process
+    registry (this replaces the old average-only serving figure — a
+    running average hides tail regressions entirely)."""
+    reg = metrics.REGISTRY
+
+    def quantiles(name, qs, scale, unit):
+        m = reg.get(name)
+        cells = []
+        for q in qs:
+            v = (m.quantile_over_children(q)
+                 if m is not None and m.kind == "histogram" else None)
+            cells.append("&mdash;" if v is None
+                         else f"{v * scale:.2f}{unit}")
+        return cells
+
+    p50, p95, p99 = quantiles(
+        "pio_query_latency_seconds", (0.50, 0.95, 0.99), 1e3, "ms")
+    f50, f95, f99 = quantiles(
+        "pio_freshness_seconds", (0.50, 0.95, 0.99), 1.0, "s")
+    return (
+        "<h2>Serving latency</h2>"
+        "<table border=1><tr><th>p50</th><th>p95</th><th>p99</th></tr>"
+        f"<tr><td>{p50}</td><td>{p95}</td><td>{p99}</td></tr></table>"
+        "<h2>Freshness (event append &rarr; served)</h2>"
+        "<table border=1><tr><th>p50</th><th>p95</th><th>p99</th></tr>"
+        f"<tr><td>{f50}</td><td>{f95}</td><td>{f99}</td></tr></table>"
+        "<p><a href='/slo'>SLO budget / burn rates (JSON)</a> &middot; "
+        "<a href='/metrics'>raw metrics</a></p>"
+    )
+
+
+def render_slo_panel() -> str:
+    """HTML summary table of the SLO engine's current evaluation."""
+    from incubator_predictionio_tpu.obs import slo as obs_slo
+
+    rows = []
+    for s in obs_slo.get_engine().evaluate():
+        fast = s["windows"]["fast"]["burnRate"]
+        slow = s["windows"]["slow"]["burnRate"]
+        state = ("no data" if s["noData"]
+                 else "BREACH" if s["breached"] else "ok")
+        rows.append(
+            "<tr>"
+            f"<td>{s['name']}</td>"
+            f"<td>&le; {s['objective']['thresholdSeconds']}s @ "
+            f"{s['objective']['target']:.2%}</td>"
+            f"<td>{fast}</td><td>{slow}</td>"
+            f"<td>{s['errorBudgetRemaining']:.2%}</td>"
+            f"<td>{state}</td></tr>")
+    return (
+        "<h2>SLOs</h2>"
+        "<table border=1><tr><th>SLO</th><th>Objective</th>"
+        "<th>Burn (fast)</th><th>Burn (slow)</th>"
+        "<th>Budget left</th><th>State</th></tr>"
+        + "".join(rows) + "</table>"
+    )
+
+
+__all__ = [
+    "add_metrics_route", "add_slo_route", "add_profile_route",
+    "render_latency_panels", "render_slo_panel",
+]

@@ -35,12 +35,15 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
-#: fixed exponential latency buckets: 100 µs doubling to ~13.1 s — wide
-#: enough to hold both the sub-ms host-mirror serving path and a cold
-#: XLA compile on the first query, with p50/p95/p99 derivable anywhere
-#: in between. Shared by every latency histogram so panels line up.
+#: fixed exponential latency buckets: 6.25 µs doubling to ~13.1 s — wide
+#: enough to hold a sub-millisecond device fold-in solve at the bottom
+#: (the original 100 µs floor dumped every sub-ms solve into one bucket,
+#: flattening their quantiles) and a cold XLA compile on the first query
+#: at the top, with p50/p95/p99 derivable anywhere in between. The
+#: >=100 µs bounds are unchanged, so dashboards keyed on the old ladder
+#: keep lining up. Shared by every latency histogram so panels align.
 DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = tuple(
-    1e-4 * (2.0 ** i) for i in range(18)
+    1e-4 * (2.0 ** i) for i in range(-4, 18)
 )
 
 
@@ -87,23 +90,30 @@ class _CounterChild:
 
 
 class _GaugeChild:
-    __slots__ = ("_lock", "_value")
+    # _touched distinguishes "never written" from "set to 0.0" — the
+    # SLO engine must not count a registered-but-unpopulated gauge as a
+    # healthy observation (obs/slo.py gauge objectives)
+    __slots__ = ("_lock", "_value", "_touched")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._value = 0.0
+        self._touched = False
 
     def set(self, v: float) -> None:
         with self._lock:
             self._value = float(v)
+            self._touched = True
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
             self._value += n
+            self._touched = True
 
     def dec(self, n: float = 1.0) -> None:
         with self._lock:
             self._value -= n
+            self._touched = True
 
     @property
     def value(self) -> float:
@@ -267,6 +277,57 @@ class _Metric:
         with self._lock:
             children = list(self._children.values())
         return sum(c.value for c in children)
+
+    def has_samples(self) -> bool:
+        """Gauge families: True when any child was ever written.
+        Registration alone creates a 0.0-valued child, and a consumer
+        deciding health from the value (the staleness SLO) must be able
+        to tell "never populated" from "genuinely zero"."""
+        if self.kind != "gauge":
+            raise ValueError("has_samples() is for gauges")
+        with self._lock:
+            children = list(self._children.values())
+        return any(c._touched for c in children)
+
+    def cumulative_below(self, bound: float) -> Tuple[int, int]:
+        """Histogram families only: ``(observations <= the largest bucket
+        bound <= ``bound``, total observations)`` summed over every
+        labeled child. The SLO engine's good/bad split reads this — a
+        threshold between bucket bounds rounds DOWN to the next bound, so
+        the good count is never overstated (an SLO can flag early, never
+        late)."""
+        if self.kind != "histogram":
+            raise ValueError("cumulative_below() is for histograms")
+        # number of bucket counts at bounds <= bound (bisect_right: an
+        # exact bound match includes its own le bucket)
+        k = bisect.bisect_right(self._buckets, bound)
+        with self._lock:
+            children = list(self._children.values())
+        below = total = 0
+        for child in children:
+            counts, _sum, count = child.snapshot()
+            below += sum(counts[:k])
+            total += count
+        return below, total
+
+    def quantile_over_children(self, q: float) -> Optional[float]:
+        """Histogram families only: one quantile over the SUM of every
+        labeled child's buckets (the dashboard's cross-engine panels
+        collapse the ``engine`` label with this). None when empty."""
+        if self.kind != "histogram":
+            raise ValueError("quantile_over_children() is for histograms")
+        with self._lock:
+            children = list(self._children.values())
+        if not children:
+            return None
+        merged = _HistogramChild(self._buckets)
+        for child in children:
+            counts, csum, count = child.snapshot()
+            for i, c in enumerate(counts):
+                merged._counts[i] += c
+            merged._sum += csum
+            merged._count += count
+        return merged.quantile(q)
 
     # -- exposition ---------------------------------------------------------
     def _label_str(self, key: Tuple[str, ...],

@@ -1,0 +1,170 @@
+"""Device-time / MFU attribution for the hot dispatch entry points.
+
+The bench computes MFU offline (analytic FLOPs over the measured warm
+wall); production had no live equivalent — device time was invisible.
+This module wraps the training, retrain, fold-in and serving dispatches
+with **block-until-ready wall deltas** plus known-FLOP counters, off by
+default and enabled with ``PIO_PROFILE=1``:
+
+- ``pio_device_seconds{op}`` — attributed device+dispatch wall,
+- ``pio_device_dispatches_total{op}`` — dispatches attributed,
+- ``pio_device_flops_total{op}`` — analytic useful FLOPs (padding waste
+  is *not* counted — it shows up as lower MFU, the honest convention
+  the bench uses),
+- ``pio_mfu{phase}`` — the LAST dispatch's model-FLOP utilization in
+  that phase against the fp32 peak (``PIO_BENCH_PEAK_FLOPS``, same
+  convention as the bench record's ``mfu``), so the live gauge and the
+  bench's offline figure are directly comparable.
+
+OFF is the contract: with ``PIO_PROFILE`` unset, a call site pays one
+``t0()`` env read returning None and one ``record()`` None-check —
+no block_until_ready, no metrics, no jax import. The profiler is the
+ONLY module allowed to call ``block_until_ready`` on a serve-reachable
+path (the ``blocking-profiler`` pio-lint rule enforces this): when ON,
+every attributed dispatch becomes synchronous, which is exactly what a
+wall measurement means — never leave it on for latency-critical
+production serving, use a canary.
+
+``capture_trace`` is the on-demand ``jax.profiler`` xplane capture
+behind the admin server's ``POST /profile?seconds=N`` — the raw input
+for the ROADMAP-5 kernel work.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+DEVICE_SECONDS = obs_metrics.REGISTRY.counter(
+    "pio_device_seconds",
+    "device+dispatch wall attributed by the PIO_PROFILE=1 profiler, "
+    "by op", labels=("op",))
+DEVICE_DISPATCHES = obs_metrics.REGISTRY.counter(
+    "pio_device_dispatches_total",
+    "dispatches attributed by the profiler, by op", labels=("op",))
+DEVICE_FLOPS = obs_metrics.REGISTRY.counter(
+    "pio_device_flops_total",
+    "analytic useful FLOPs attributed by the profiler, by op",
+    labels=("op",))
+MFU = obs_metrics.REGISTRY.gauge(
+    "pio_mfu",
+    "last attributed dispatch's model-FLOP utilization vs the fp32 "
+    "peak (PIO_BENCH_PEAK_FLOPS), by phase", labels=("phase",))
+
+
+def enabled() -> bool:
+    """True when the dispatch profiler is on (``PIO_PROFILE=1``). Read
+    per call — a live process can be toggled — and cheap enough for the
+    serving hot path (one env dict lookup)."""
+    return os.environ.get("PIO_PROFILE", "0").lower() not in (
+        "0", "", "false", "off")
+
+
+def peak_flops() -> float:
+    """The fp32 peak the MFU gauge divides by — the SAME knob the bench
+    uses (``PIO_BENCH_PEAK_FLOPS``, default TPU v5e ~98.5 TF/s fp32) so
+    ``pio_mfu{phase="train"}`` and the record's ``mfu`` are the same
+    convention by construction."""
+    try:
+        return float(os.environ.get("PIO_BENCH_PEAK_FLOPS", "") or 98.5e12)
+    except ValueError:
+        return 98.5e12
+
+
+def t0() -> Optional[float]:
+    """Dispatch-entry stamp: ``time.perf_counter()`` when profiling is
+    on, None otherwise. The None is the whole off-path cost — callers
+    hand it straight back to :func:`record`."""
+    if not enabled():
+        return None
+    return time.perf_counter()
+
+
+def record(start: Optional[float], phase: str, op: str,
+           flops: float = 0.0, result: Any = None,
+           flops_fn: Any = None) -> None:
+    """Close one attributed dispatch: block until ``result`` is device-
+    complete, book the wall under ``op`` and refresh ``pio_mfu{phase}``.
+    No-op when ``start`` is None (profiling was off at :func:`t0`).
+
+    ``flops_fn`` (a zero-arg callable) defers a FLOP count whose
+    computation itself touches the device (e.g. nnz from tree mask
+    sums) until AFTER ``dt`` is captured — otherwise its dispatches and
+    fetches would contaminate the measured wall. Plain ``flops`` is for
+    host-arithmetic counts.
+
+    This is the one sanctioned ``block_until_ready`` on serve-reachable
+    paths (pio-lint ``blocking-profiler``): a wall measurement *is* a
+    sync point. Telemetry must never fail the dispatch — any error here
+    logs and returns."""
+    if start is None:
+        return
+    try:
+        if result is not None:
+            import jax
+
+            jax.block_until_ready(result)
+        dt = time.perf_counter() - start
+        if flops_fn is not None:
+            flops = float(flops_fn())
+        DEVICE_SECONDS.labels(op=op).inc(dt)
+        DEVICE_DISPATCHES.labels(op=op).inc()
+        if flops > 0:
+            DEVICE_FLOPS.labels(op=op).inc(flops)
+            if dt > 0:
+                MFU.labels(phase=phase).set(flops / dt / peak_flops())
+    except Exception:
+        logger.exception("dispatch profiler record failed (op=%s)", op)
+
+
+# ---------------------------------------------------------------------------
+# on-demand jax.profiler capture (admin POST /profile?seconds=N)
+# ---------------------------------------------------------------------------
+
+#: serializes captures: jax.profiler supports one active trace per
+#: process, and a second start_trace would raise mid-capture
+_capture_lock = threading.Lock()
+
+MAX_CAPTURE_SECONDS = 120.0
+
+
+def capture_trace(seconds: float, out_dir: Optional[str] = None) -> dict:
+    """Capture ``seconds`` of ``jax.profiler`` trace into ``out_dir``
+    (default ``$PIO_PROFILE_DIR`` or a per-capture temp dir) and return
+    ``{"traceDir", "seconds"}``. Blocks the caller for the capture
+    window — the admin route runs it on the executor, so the server
+    keeps serving. Raises RuntimeError when a capture is already
+    running (the route maps it to 409) and ValueError on a bad window.
+    """
+    seconds = float(seconds)
+    if not 0.0 < seconds <= MAX_CAPTURE_SECONDS:
+        raise ValueError(
+            f"seconds must be in (0, {MAX_CAPTURE_SECONDS:.0f}]")
+    if out_dir is None:
+        out_dir = os.environ.get("PIO_PROFILE_DIR")
+    if not _capture_lock.acquire(blocking=False):
+        raise RuntimeError("a profiler capture is already running")
+    try:
+        # dir created only once the capture is actually ours to run (a
+        # rejected 409 must not leak an empty temp dir per request)
+        if out_dir is None:
+            import tempfile
+
+            out_dir = tempfile.mkdtemp(prefix="pio_profile_")
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    finally:
+        _capture_lock.release()
+    return {"traceDir": out_dir, "seconds": seconds}

@@ -24,9 +24,10 @@ import contextvars
 import json
 import logging
 import os
+import random
 import re
 import secrets
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 #: the propagation header, request and response side
 TRACE_HEADER = "X-PIO-Trace-Id"
@@ -88,6 +89,41 @@ def enable_span_logging() -> None:
     span_logger.setLevel(logging.INFO)
 
 
+#: last parsed PIO_TRACE_SAMPLE value, keyed by the raw env string so a
+#: runtime change re-parses but the steady state pays one dict-free
+#: string compare per request (no float() on the hot path)
+_sample_cache: Tuple[Optional[str], float] = (None, 1.0)
+
+
+def sample_rate() -> float:
+    """The span sampling rate from ``PIO_TRACE_SAMPLE`` (default 1.0 —
+    every request emits its span line). Clamped to [0, 1]; read per call
+    so operators can retune a live server, with the parse cached on the
+    raw string value."""
+    global _sample_cache
+    raw = os.environ.get("PIO_TRACE_SAMPLE")
+    cached_raw, cached = _sample_cache
+    if raw == cached_raw:
+        return cached
+    try:
+        rate = min(max(float(raw), 0.0), 1.0) if raw else 1.0
+    except ValueError:
+        rate = 1.0
+    _sample_cache = (raw, rate)
+    return rate
+
+
+def span_sampled() -> bool:
+    """Coin flip for THIS request's span line. Sampled-out requests
+    still carry (and echo) their trace IDs — sampling drops only the
+    JSON log line, which at bench QPS is the per-request hot-path cost;
+    the propagation contract is unconditional."""
+    rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    return rate > 0.0 and random.random() < rate
+
+
 def log_span(server: str, method: str, route: str, status: int,
              duration_s: float, trace_id: str, **extra: Any) -> None:
     """Emit the per-request JSON span line. Pre-gated on the logger
@@ -100,6 +136,25 @@ def log_span(server: str, method: str, route: str, status: int,
         "method": method,
         "route": route,
         "status": status,
+        "durationMs": round(duration_s * 1e3, 3),
+        "traceId": trace_id,
+    }
+    if extra:
+        record.update(extra)
+    span_logger.info("%s", json.dumps(record, separators=(",", ":")))
+
+
+def log_stage_span(span: str, trace_id: str, duration_s: float,
+                   **extra: Any) -> None:
+    """Emit a non-HTTP pipeline-stage span (the speed layer's freshness
+    chain: ``speed.poll`` → ``speed.foldin`` → ``speed.serve``) on the
+    same ``pio.trace`` logger and with the same shape as the request
+    spans, so one trace ID joins an event's whole journey across log
+    lines. Pre-gated like :func:`log_span`."""
+    if not span_logger.isEnabledFor(logging.INFO):
+        return
+    record = {
+        "span": span,
         "durationMs": round(duration_s * 1e3, 3),
         "traceId": trace_id,
     }

@@ -1154,6 +1154,70 @@ def _als_run_converge(
     return st, i, d
 
 
+def train_flops(
+    nnz: int,
+    n_users: int,
+    n_items: int,
+    rank: int,
+    iterations: int,
+    bf16_sweeps: int = 0,
+    solver: Optional[str] = None,
+    cg_iters: Optional[int] = None,
+    cg_iters_bf16: Optional[int] = None,
+    warmstart: Optional[bool] = None,
+) -> float:
+    """THE analytic FLOP count of one training run — the single formula
+    the bench's offline MFU and the live ``pio_mfu{phase="train"}``
+    gauge (obs/profile.py) both divide by, so the two figures agree by
+    construction when the measured walls agree.
+
+    Per half-sweep over ``nnz`` observations at rank K: the Gram batch
+    is 2·nnz·K² MACs = 4·nnz·K² FLOPs at HIGHEST precision (the f32
+    multi-pass costs ~3× a bf16 pass; counted at face value —
+    conservative), the rhs 2·nnz·K, and each row's CG solve
+    ~iters·2·K² FLOPs (about the same count as a direct K³/3 Cholesky
+    at K=128, iters=32; bf16 sweeps run the loose ``_CG_ITERS_BF16``
+    budget, polish sweeps the full one, warm starts pay one extra
+    matvec). Both sides per sweep, ``iterations`` sweeps. Counts USEFUL
+    work only — padding waste shows up as lower MFU, not higher FLOPs.
+    """
+    k = float(rank)
+    nnz = float(nnz)
+    solver = _SOLVER if solver is None else solver
+    cg_iters = _CG_ITERS if cg_iters is None else int(cg_iters)
+    cg_iters_bf16 = (_CG_ITERS_BF16 if cg_iters_bf16 is None
+                     else int(cg_iters_bf16))
+    warmstart = _CG_WARMSTART if warmstart is None else bool(warmstart)
+    per_side_gram = 2.0 * nnz * k * k * 2.0   # multiply+add
+    per_side_rhs = 2.0 * nnz * k
+    if solver == "cg":
+        bf16 = min(max(int(bf16_sweeps), 0), int(iterations))
+        iters = (bf16 * min(cg_iters_bf16, cg_iters)
+                 + (int(iterations) - bf16) * cg_iters) / max(
+                     int(iterations), 1)
+        if warmstart:
+            iters += 1.0  # the warm start's initial-residual matvec
+        per_solve = iters * 2.0 * k * k
+    else:
+        per_solve = k ** 3 / 3.0 + 2.0 * k * k
+    solves = (int(n_users) + int(n_items)) * per_solve
+    per_sweep = 2.0 * per_side_gram + 2.0 * per_side_rhs + solves
+    return per_sweep * int(iterations)
+
+
+def tree_nnz(tree, heavy=None) -> int:
+    """Observed interaction count of one side's device trees — mask
+    sums, so it costs a few device reduces + fetches. Only the
+    PIO_PROFILE=1 path calls this (the profiler is already blocking on
+    walls); production training never pays it."""
+    total = 0.0
+    for _row_ids, _cols, _vals, mask in tree:
+        total += float(jnp.sum(mask))
+    if heavy is not None:
+        total += float(jnp.sum(heavy[4]))
+    return int(total)
+
+
 def _mixed_run(
     state: ALSState,
     u_tree,
@@ -1182,6 +1246,9 @@ def _mixed_run(
     early sweeps only affect the *starting point* of the f32 polish — the
     polish sweeps land on the same fixed point (validated by the planted
     low-rank recovery test, tests/test_als.py)."""
+    from incubator_predictionio_tpu.obs import profile as _profile
+
+    _prof_t0 = _profile.t0()
     lo = min(max(bf16_sweeps, 0), iterations)
     # resolve the Pallas selector HERE (python level, outside any trace —
     # the Mosaic probe runs a real kernel). Callers pass False explicitly
@@ -1214,6 +1281,19 @@ def _mixed_run(
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
             kernel_rows=kernel_rows, warmstart=warmstart,
         )
+    if _prof_t0 is not None:
+        # PIO_PROFILE=1: attribute the device wall + analytic FLOPs of
+        # this run (blocks on the final state — the profiler's
+        # contract). flops_fn defers the tree mask sums until AFTER the
+        # wall is captured, so their dispatches/fetches never
+        # contaminate the measured device time.
+        _profile.record(
+            _prof_t0, "train", "als_train", result=state,
+            flops_fn=lambda: train_flops(
+                tree_nnz(u_tree, user_heavy),
+                state.user_factors.shape[0], state.item_factors.shape[0],
+                state.user_factors.shape[1], iterations, lo,
+                warmstart=warmstart))
     return state
 
 

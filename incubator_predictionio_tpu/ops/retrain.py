@@ -520,6 +520,9 @@ def als_retrain(
     if state is None:
         state = als.als_init(jax.random.key(seed), n_users, n_items, rank)
 
+    from incubator_predictionio_tpu.obs import profile as _profile
+
+    _prof_t0 = _profile.t0()
     warmstart = als._CG_WARMSTART
     use_kernel = als._kernel_enabled(implicit, warm=warmstart)
     kernel_min_d = als._KERNEL_MIN_D
@@ -527,6 +530,7 @@ def als_retrain(
     lo = 0 if implicit else min(max(bf16_sweeps, 0), iterations)
     sweeps = 0
     delta = float("inf")
+    bf16_used = 0
     if lo:
         state, n, delta = _converge_leg(
             state, u_tree, i_tree, l2, 0.0, tol, lo, min(floor, lo),
@@ -534,6 +538,7 @@ def als_retrain(
             u_hv, i_hv, min(als._CG_ITERS_BF16, als._CG_ITERS),
             use_kernel, kernel_min_d, kernel_rows, warmstart)
         sweeps += n
+        bf16_used = n
     if iterations - lo > 0:
         state, n, delta = _converge_leg(
             state, u_tree, i_tree, l2, alpha, tol, iterations - lo,
@@ -541,6 +546,15 @@ def als_retrain(
             implicit, u_hv, i_hv, als._CG_ITERS, use_kernel,
             kernel_min_d, kernel_rows, warmstart)
         sweeps += n
+    if _prof_t0 is not None and sweeps:
+        # PIO_PROFILE=1: device-time/MFU attribution over the sweeps
+        # actually run (the early stop makes the count data-dependent;
+        # nnz is in hand here — no device mask sums needed)
+        _profile.record(
+            _prof_t0, "train", "als_retrain",
+            als.train_flops(len(vals), n_users, n_items, rank, sweeps,
+                            bf16_used, warmstart=warmstart),
+            state)
     stats.update(sweeps_used=sweeps, mode=mode, final_delta=delta)
     _book_sweeps(mode, sweeps)
     return state

@@ -16,6 +16,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from incubator_predictionio_tpu.obs import profile as _profile
+
 NEG_INF = jnp.float32(-3.4e38)
 
 
@@ -84,19 +86,28 @@ def score_user_and_top_k(
     indexing ``user_factors[user_idx]`` outside the jit would double the
     per-query latency. Callers fetch the packed result with one
     ``np.asarray``."""
+    _pt0 = _profile.t0()
     if item_factors.shape[0] >= PALLAS_MIN_ITEMS and k <= 128:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
             score_and_top_k_pallas, topk_kernel_available)
         if topk_kernel_available():
             # huge catalogs: compute dominates, the extra gather dispatch
             # is noise next to the blocked kernel's win
-            return score_and_top_k_pallas(
+            out = score_and_top_k_pallas(
                 user_factors[user_idx], item_factors, k,
                 exclude=exclude, allowed_mask=allowed_mask,
                 block_items=8192,
             )
-    return _score_user_top_k_xla(user_factors, item_factors, user_idx, k,
-                                 exclude, allowed_mask)
+            _profile.record(
+                _pt0, "serve", "serve_topk",
+                2.0 * item_factors.shape[0] * item_factors.shape[1], out)
+            return out
+    out = _score_user_top_k_xla(user_factors, item_factors, user_idx, k,
+                                exclude, allowed_mask)
+    _profile.record(_pt0, "serve", "serve_topk",
+                    2.0 * item_factors.shape[0] * item_factors.shape[1],
+                    out)
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -151,8 +162,13 @@ def batch_score_top_k(
     if pad > B:
         rows_np = np.concatenate(
             [rows_np, np.full(pad - B, rows_np[0], np.int32)])
-    return _batch_score_top_k_xla(user_factors, item_factors,
-                                  jnp.asarray(rows_np), k_pad)
+    _pt0 = _profile.t0()  # None on the PIO_PROFILE=0 default hot path
+    out = _batch_score_top_k_xla(user_factors, item_factors,
+                                 jnp.asarray(rows_np), k_pad)
+    _profile.record(
+        _pt0, "serve", "serve_topk_batch",
+        2.0 * B * user_factors.shape[1] * item_factors.shape[0], out)
+    return out
 
 
 def score_and_top_k(
@@ -171,14 +187,23 @@ def score_and_top_k(
     Pallas blocked-candidate kernel (ops/pallas_kernels.py), which never
     writes the full score vector to HBM.
     """
+    _pt0 = _profile.t0()  # None on the PIO_PROFILE=0 default hot path
     if item_factors.shape[0] >= PALLAS_MIN_ITEMS and k <= 128:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
             score_and_top_k_pallas, topk_kernel_available)
         if topk_kernel_available():
-            return score_and_top_k_pallas(
+            out = score_and_top_k_pallas(
                 user_vector, item_factors, k,
                 exclude=exclude, allowed_mask=allowed_mask,
                 block_items=8192,
             )
-    return _score_and_top_k_xla(user_vector, item_factors, k,
-                                exclude, allowed_mask)
+            _profile.record(
+                _pt0, "serve", "serve_topk",
+                2.0 * item_factors.shape[0] * item_factors.shape[1], out)
+            return out
+    out = _score_and_top_k_xla(user_vector, item_factors, k,
+                               exclude, allowed_mask)
+    _profile.record(_pt0, "serve", "serve_topk",
+                    2.0 * item_factors.shape[0] * item_factors.shape[1],
+                    out)
+    return out

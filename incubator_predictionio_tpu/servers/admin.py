@@ -12,7 +12,11 @@ import logging
 from typing import Optional
 
 from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
-from incubator_predictionio_tpu.obs.http import add_metrics_route
+from incubator_predictionio_tpu.obs.http import (
+    add_metrics_route,
+    add_profile_route,
+    add_slo_route,
+)
 from incubator_predictionio_tpu.utils.annotations import experimental
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
@@ -104,6 +108,13 @@ class AdminServer:
             return Response(200, {"message": f"App {app.name} data deleted."})
 
         add_metrics_route(r)
+        # GET /slo: the burn-rate engine's JSON evaluation — the signal
+        # the autonomous retrain controller (ROADMAP-3) will consume
+        add_slo_route(r)
+        # POST /profile?seconds=N: on-demand jax.profiler xplane capture
+        # for the kernel/MFU work (ROADMAP-5); runs on the executor so
+        # the capture window never blocks other admin requests
+        add_profile_route(r)
         return r
 
     def start_background(self) -> int:

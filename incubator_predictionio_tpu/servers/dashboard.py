@@ -1,10 +1,18 @@
-"""Dashboard — evaluation results UI.
+"""Dashboard — evaluation results UI + live serving/SLO panels.
 
 Parity: tools/.../dashboard/Dashboard.scala:46-162 on :9000 — lists
 completed EvaluationInstances newest-first with links to each instance's
 stored HTML results (the reference renders the same data through Twirl),
 with CORS enabled (CorsSupport.scala:30-66) so external dashboards can
 fetch the JSON results cross-origin.
+
+On top of parity, the index renders live panels from the process
+registry: p50/p95/p99 serving latency (the running average the
+reference shows hides tail regressions entirely), the end-to-end
+freshness histogram's quantiles, and the SLO burn-rate summary —
+``GET /slo`` serves the same evaluation as JSON. The panels read THIS
+process's registry (a co-hosted stack sees everything; a split
+deployment points Grafana at the per-process /metrics instead).
 """
 
 from __future__ import annotations
@@ -13,7 +21,12 @@ import html
 import logging
 
 from incubator_predictionio_tpu.data.storage import Storage
-from incubator_predictionio_tpu.obs.http import add_metrics_route
+from incubator_predictionio_tpu.obs.http import (
+    add_metrics_route,
+    add_slo_route,
+    render_latency_panels,
+    render_slo_panel,
+)
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
     Request,
@@ -48,12 +61,18 @@ class DashboardServer:
                     f"<td>{html.escape(i.evaluator_results)}</td>"
                     "</tr>"
                 )
+            try:
+                panels = render_latency_panels() + render_slo_panel()
+            except Exception:
+                logger.exception("dashboard panels failed to render")
+                panels = "<p>panels unavailable</p>"
             body = (
                 "<html><head><title>PredictionIO-TPU Dashboard</title></head>"
                 "<body><h1>Completed Evaluations</h1>"
                 "<table border=1><tr><th>ID</th><th>Evaluation</th>"
                 "<th>Params Generator</th><th>Start</th><th>End</th>"
-                f"<th>Result</th></tr>{''.join(rows)}</table></body></html>"
+                f"<th>Result</th></tr>{''.join(rows)}</table>"
+                f"{panels}</body></html>"
             )
             return Response(200, body=body.encode(),
                             content_type="text/html; charset=UTF-8")
@@ -77,6 +96,7 @@ class DashboardServer:
             )
 
         add_metrics_route(r)
+        add_slo_route(r)
         return r
 
     def start_background(self) -> int:

@@ -70,6 +70,13 @@ _QUERY_LATENCY = obs_metrics.REGISTRY.histogram(
 _QUEUE_DEPTH = obs_metrics.REGISTRY.gauge(
     "pio_serve_queue_depth",
     "queries waiting in the micro-batching queue (scrape-time snapshot)")
+#: age of the deployed instance, read at scrape time — the gauge the
+#: staleness SLO (obs/slo.py) evaluates its bound against; /status's
+#: modelStalenessSec reports the same figure
+_STALENESS = obs_metrics.REGISTRY.gauge(
+    "pio_model_staleness_seconds",
+    "seconds since the served engine instance finished training "
+    "(scrape-time snapshot)")
 
 
 @dataclasses.dataclass
@@ -301,6 +308,27 @@ class PredictionServer:
 
             obs_metrics.REGISTRY.register_collector(
                 "prediction_queue_depth", _collect_queue_depth)
+        # scrape-time model-staleness gauge (weakref for the same
+        # reason as the queue collector: telemetry must never pin a
+        # stopped server's models)
+        import weakref as _weakref
+
+        server_ref = _weakref.ref(self)
+
+        def _collect_staleness() -> None:
+            s = server_ref()
+            if s is None:
+                return
+            with s._lock:
+                instance = s.engine_instance
+            if instance is None:
+                return
+            _STALENESS.set(max(
+                (now_utc() - ensure_aware(instance.end_time))
+                .total_seconds(), 0.0))
+
+        obs_metrics.REGISTRY.register_collector(
+            "prediction_model_staleness", _collect_staleness)
         # feedback events are training data: a deep queue so only a
         # sustained collector outage drops (drops counted and shown on the
         # status page); --log-url diagnostics stay shallow and lossy

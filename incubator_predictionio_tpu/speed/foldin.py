@@ -30,7 +30,21 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from incubator_predictionio_tpu.obs import profile as _profile
 from incubator_predictionio_tpu.ops import als as _als
+
+
+def foldin_flops(degrees: Sequence[int], rank: int,
+                 cg_iters: int) -> float:
+    """Analytic useful FLOPs of one fold-in bucket dispatch: per row of
+    degree d the Gram assembly is 4·d·K² + rhs 2·d·K, plus the CG solve
+    ~iters·2·K² per row — the same counting convention as
+    ``ops.als.train_flops`` (padding waste lowers MFU, it never counts
+    as work)."""
+    k = float(rank)
+    d = float(sum(int(x) for x in degrees))
+    return 4.0 * d * k * k + 2.0 * d * k \
+        + len(degrees) * cg_iters * 2.0 * k * k
 
 
 def _width_ladder() -> Tuple[int, ...]:
@@ -165,12 +179,19 @@ class FoldInSolver:
                     cols[r, :len(c)] = c
                     vals[r, :len(v)] = v
                     mask[r, :len(c)] = 1.0
+                _pt0 = _profile.t0()
                 sol = np.asarray(_solve_rows(
                     self.other_factors, self._yty,
                     jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
                     jnp.float32(self.l2), jnp.float32(self.alpha),
                     reg_nnz=self.reg_nnz, implicit=self.implicit,
                     cg_iters=self.cg_iters))
+                # np.asarray already synced the dispatch: result=None
+                _profile.record(
+                    _pt0, "foldin", "foldin_solve",
+                    foldin_flops([len(c) for _s, c, _v in chunk],
+                                 self.rank, self.cg_iters)
+                    if _pt0 is not None else 0.0)
                 for r, (slot, _c, _v) in enumerate(chunk):
                     out[slot] = sol[r]
         return out

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs.freshness import FreshnessTracker
 from incubator_predictionio_tpu.speed.foldin import FoldInSolver
 from incubator_predictionio_tpu.utils import times
 
@@ -77,6 +78,9 @@ class SpeedOverlayConfig:
 
     app_name: str
     channel_name: Optional[str] = None
+    #: engine name for the per-engine freshness series (BOUNDED label
+    #: set: one value per deployed engine template, never a key/id)
+    engine: str = "default"
     entity_type: str = "user"
     target_entity_type: str = "item"
     event_names: Tuple[str, ...] = ("rate",)
@@ -147,6 +151,10 @@ class SpeedOverlay:
         self._tail_hist: "OrderedDict[str, Tuple[list, list]]" = \
             OrderedDict()
         self._tail_hist_max_keys = 65536
+        #: end-to-end freshness trace (obs/freshness.py): append stamps
+        #: ride the tail read in, fold-in publishes hand them over, and
+        #: the first serving HIT closes the pio_freshness_seconds loop
+        self.freshness = FreshnessTracker(engine=config.engine)
         self.cursor = self._initial_cursor()
         _LIVE_OVERLAYS.add(self)
         self.hits = 0
@@ -184,11 +192,20 @@ class SpeedOverlay:
                 if now < expires and self._dirty.get(key_id, -1) <= at_cursor:
                     self.hits += 1
                     _HITS.inc()
-                    return vec
-                del self._vectors[key_id]
-            self.misses += 1
-            _MISSES.inc()
-            return None
+                else:
+                    del self._vectors[key_id]
+                    vec = None
+            else:
+                vec = None
+            if vec is None:
+                self.misses += 1
+                _MISSES.inc()
+        if vec is not None:
+            # outside the overlay lock: first hit after a fold closes
+            # the end-to-end freshness loop (dict pop + one observe;
+            # later hits are a single probe)
+            self.freshness.on_serve_hit(key_id)
+        return vec
 
     def covers(self, key_id: str) -> bool:
         """True when :meth:`lookup` would hit — batched serving fast
@@ -223,9 +240,12 @@ class SpeedOverlay:
     # -- lifecycle ----------------------------------------------------------
     def invalidate_all(self) -> None:
         """Wholesale invalidation — hot model swap. The dirty set stays:
-        those keys still have events newer than ANY model."""
+        those keys still have events newer than ANY model. In-flight
+        freshness journeys die with their vectors (the successor overlay
+        re-solves and restarts the trace)."""
         with self._lock:
             self._vectors.clear()
+        self.freshness.invalidate()
 
     def known_keys(self) -> List[str]:
         """Every key this overlay has state for (solved, dirty, or
@@ -287,7 +307,7 @@ class SpeedOverlay:
         cfg = self.config
         if not self.enabled:
             return {"enabled": False}
-        inter, _times, new_cursor, reset = \
+        inter, _times, append_ms, new_cursor, reset = \
             EventStore.read_interactions_since(
                 self.cursor, cfg.app_name, cfg.channel_name,
                 entity_type=cfg.entity_type,
@@ -307,6 +327,7 @@ class SpeedOverlay:
                 self._vectors.clear()
                 self._dirty.clear()
                 self._tail_hist.clear()
+            self.freshness.invalidate()
             self.cursor = new_cursor
             return {"reset": True, "cursor": new_cursor}
         if cfg.key_side == "entity":
@@ -323,14 +344,23 @@ class SpeedOverlay:
         # bounded chunks so lookups interleave
         keys = list(tail_keys)
         rows: List[Tuple[str, Optional[int], float]] = []
+        #: key -> oldest append wall (ms) in this delta — the freshness
+        #: trace's stage-0 anchor (all dirtied keys, model-known too)
+        append_by_key: Dict[str, int] = {}
         for row in range(len(inter)):
             key = keys[int(key_idx[row])]
+            if len(append_ms):
+                a = int(append_ms[row])
+                if a > 0:
+                    prev = append_by_key.get(key)
+                    append_by_key[key] = a if prev is None else min(prev, a)
             if key in self.key_index:
                 continue
             col = self.other_index.get(other_ids[int(other_idx[row])])
             if col is None:
                 continue
             rows.append((key, int(col), float(inter.values[row])))
+        self.freshness.on_poll_batch(append_by_key)
         chunk = 8192
         for s in range(0, max(len(keys), 1), chunk):
             with self._lock:
@@ -467,6 +497,8 @@ class SpeedOverlay:
         vectors = self.solver.solve(rows)
         expires = self._clock() + cfg.ttl_s
         solved = 0
+        published: List[str] = []
+        unpublished: List[str] = []
         with self._lock:
             for key, (cols, _vals), vec in zip(keys, rows, vectors):
                 # only retire the dirty mark if no NEWER event arrived
@@ -474,17 +506,25 @@ class SpeedOverlay:
                 if self._dirty.get(key, -1) <= cursor:
                     self._dirty.pop(key, None)
                 if len(cols) == 0:
-                    continue  # nothing the model knows about: no vector
+                    # nothing the model knows about: no vector
+                    unpublished.append(key)
+                    continue
                 if cfg.transform is not None:
                     vec = cfg.transform(vec)
                 self._vectors[key] = (np.asarray(vec, np.float32),
                                       cursor, expires)
                 self._vectors.move_to_end(key)
+                published.append(key)
                 solved += 1
             while len(self._vectors) > self._max_vectors:
                 self._vectors.popitem(last=False)
             self.foldins += solved
         dt = _time.perf_counter() - t0
+        # freshness stage 2: published keys now await their first serve;
+        # keys with nothing foldable stop being traced (no vector can
+        # ever serve their events until the next retrain)
+        self.freshness.on_folded(published, dt)
+        self.freshness.discard(unpublished)
         _FOLDIN_SECONDS.observe(dt)
         _FOLDIN_ROWS.inc(len(keys))
         return solved

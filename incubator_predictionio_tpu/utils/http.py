@@ -390,8 +390,12 @@ class HttpServer:
             status=str(response.status)).inc()
         _HTTP_LATENCY.labels(server=self.name, route=route_label).observe(dt)
         response.headers.setdefault(obs_trace.TRACE_HEADER, trace_id)
-        obs_trace.log_span(self.name, request.method, route_label,
-                           response.status, dt, trace_id)
+        # span sampling (PIO_TRACE_SAMPLE): the JSON line is the one
+        # per-request cost that scales with QPS; sampled-out requests
+        # still got their trace ID stamped and echoed above
+        if obs_trace.span_sampled():
+            obs_trace.log_span(self.name, request.method, route_label,
+                               response.status, dt, trace_id)
         return response
 
     async def _dispatch_routed(

@@ -66,6 +66,33 @@ class FakeClock:
         self._now += float(seconds)
 
 
+# ---------------------------------------------------------------------------
+# Wall-clock seam — epoch-millisecond reads that cross process boundaries
+# (event append stamps, freshness spans) route through here so tests can
+# plant deterministic append times instead of sleeping. Unlike the
+# monotonic seam this clock is comparable across processes: an event
+# appended by the event server and served by the prediction server share
+# the same epoch.
+# ---------------------------------------------------------------------------
+
+_wall_millis_source: Callable[[], int] = lambda: int(_time.time() * 1000)
+
+
+def wall_millis() -> int:
+    """Current wall time in epoch milliseconds — the ONE clock append
+    stamps and freshness measurements read (time.time by default)."""
+    return _wall_millis_source()
+
+
+def set_wall_millis(source: Callable[[], int]) -> Callable[[], int]:
+    """Swap the wall-millis source (tests plant append times); returns
+    the previous source so callers can restore it in a finally block."""
+    global _wall_millis_source
+    prev = _wall_millis_source
+    _wall_millis_source = source
+    return prev
+
+
 def ensure_aware(dt: datetime) -> datetime:
     """Interpret naive datetimes as UTC (the reference's default zone)."""
     if dt.tzinfo is None:

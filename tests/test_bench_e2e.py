@@ -54,6 +54,10 @@ REQUIRED_FIELDS = {
     "speed_foldin_p95_ms": float,
     "speed_hit_rate": float,
     "speed_cursor_lag_events": int,
+    # deep-observability keys (docs/observability.md): measured
+    # end-to-end freshness and the live device-time MFU attribution
+    "obs_freshness_p95_s": float,
+    "obs_mfu_train": float,
 }
 
 
@@ -130,3 +134,15 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
     assert rec["speed_foldin_p50_ms"] > 0
     assert rec["speed_foldin_p95_ms"] >= rec["speed_foldin_p50_ms"]
     assert rec["speed_cursor_lag_events"] >= 0
+    # end-to-end freshness came from the new pio_freshness_seconds
+    # histogram (event append -> first folded serve): a real, positive
+    # figure — the speed layer's promise, measured rather than inferred
+    assert rec["obs_freshness_p95_s"] > 0
+    # the live pio_mfu{phase=train} gauge and the bench's offline MFU
+    # divide the SAME analytic FLOPs by near-identical walls — they must
+    # agree within 10% or one of them lies (the ratio is computed in
+    # the child against the UNROUNDED offline figure; the record's
+    # "mfu" itself is 4-decimal-rounded and reads 0.0 on CPU backends)
+    assert rec["obs_mfu_train"] > 0
+    assert 0.90 <= rec["obs_mfu_vs_offline"] <= 1.10, (
+        rec["obs_mfu_train"], rec["obs_mfu_vs_offline"], rec["mfu"])

@@ -248,6 +248,38 @@ def serve(x, ids):
     return out
 """,
     ),
+    "blocking-profiler": (
+        """
+import jax
+
+class Algo:
+    def _score(self, model, query):
+        out = model.score(query)
+        jax.block_until_ready(out)
+        return out
+
+    def predict(self, model, query):
+        return self._score(model, query)
+""",
+        """
+import jax
+from incubator_predictionio_tpu.obs import profile
+
+class Algo:
+    def train(self, ctx, pd):
+        # training may block: it is not the serving hot path
+        out = pd.run()
+        jax.block_until_ready(out)
+        return out
+
+    def predict(self, model, query):
+        # the sanctioned pattern: env-gated attribution via obs/profile
+        t0 = profile.t0()
+        out = model.score(query)
+        profile.record(t0, "serve", "score", 0.0, out)
+        return out
+""",
+    ),
     "serve-blocking-io": (
         """
 from incubator_predictionio_tpu.data.store import EventStore

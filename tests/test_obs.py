@@ -411,6 +411,131 @@ def test_unmatched_routes_collapse_to_one_series(stack):
          ("route", "/"), ("status", "405")}))] >= 1
 
 
+def test_trace_sample_knob(monkeypatch):
+    """PIO_TRACE_SAMPLE gates ONLY the span line; IDs keep flowing."""
+    monkeypatch.setenv("PIO_TRACE_SAMPLE", "0")
+    assert obs_trace.sample_rate() == 0.0
+    assert obs_trace.span_sampled() is False
+    monkeypatch.setenv("PIO_TRACE_SAMPLE", "1.0")
+    assert obs_trace.span_sampled() is True
+    monkeypatch.setenv("PIO_TRACE_SAMPLE", "not-a-number")
+    assert obs_trace.sample_rate() == 1.0
+    monkeypatch.setenv("PIO_TRACE_SAMPLE", "7")   # clamped
+    assert obs_trace.sample_rate() == 1.0
+    monkeypatch.delenv("PIO_TRACE_SAMPLE")
+    assert obs_trace.sample_rate() == 1.0
+
+
+def test_sampled_out_requests_keep_trace_ids(stack, monkeypatch, caplog):
+    monkeypatch.setenv("PIO_TRACE_SAMPLE", "0")
+    tid = "sampled-out-0001"
+    with caplog.at_level(logging.INFO, logger="pio.trace"):
+        status, headers, _b = post(
+            stack["event"], "/events.json?accessKey=obskey", EV,
+            headers={"X-PIO-Trace-Id": tid})
+    assert status == 201
+    # the propagation contract is unconditional...
+    assert headers["X-PIO-Trace-Id"] == tid
+    # ...only the span LINE was sampled away
+    spans = [json.loads(r.getMessage()) for r in caplog.records
+             if r.name == "pio.trace"]
+    assert not [s for s in spans if s.get("traceId") == tid]
+
+
+def test_build_info_constant_gauge(stack):
+    """pio_build_info{version,jax_version,backend} == 1 on every
+    server's scrape (the standard join-target idiom)."""
+    for name, port in stack.items():
+        _types, samples = parse_exposition(scrape(port))
+        hits = [(ls, v) for (n, ls), v in samples.items()
+                if n == "pio_build_info"]
+        assert hits, name
+        labels, value = hits[0]
+        assert value == 1
+        keys = {k for k, _v in labels}
+        assert keys == {"version", "jax_version", "backend"}
+
+
+def test_latency_buckets_resolve_sub_millisecond():
+    """The extended bucket floor: sub-ms observations (device fold-in
+    solves) must not all collapse into the first bucket."""
+    bounds = obs_metrics.DEFAULT_LATENCY_BUCKETS
+    assert bounds[0] < 1e-4          # extended downward...
+    assert 1e-4 in bounds            # ...keeping the old bounds aligned
+    assert max(bounds) > 10.0
+    reg = Registry()
+    h = reg.histogram("t_subms_seconds", "x")
+    h.observe(20e-6)
+    h.observe(300e-6)
+    counts = h._solo().snapshot()[0]
+    occupied = [i for i, c in enumerate(counts) if c]
+    assert len(occupied) == 2        # distinct buckets, not one heap
+
+
+def test_histogram_snapshot_consistent_under_threaded_observation():
+    """snapshot() must return a CONSISTENT (counts, sum, count) triple
+    while writers hammer the child — sum/count never drift from the
+    per-bucket totals."""
+    reg = Registry()
+    h = reg.histogram("t_snap_seconds", "x", buckets=(1.0,))
+    stop = threading.Event()
+
+    def work():
+        while not stop.is_set():
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            counts, s, total = h._solo().snapshot()
+            assert sum(counts) == total
+            assert s == pytest.approx(0.5 * total)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+def test_concurrent_scrape_during_server_shutdown():
+    """Scrapes racing a server shutdown must either answer cleanly or
+    fail with a connection error — never hang or corrupt the registry
+    (the next scrape still parses)."""
+    from incubator_predictionio_tpu.obs.http import add_metrics_route
+    from incubator_predictionio_tpu.utils.http import HttpServer, Router
+
+    r = Router()
+    add_metrics_route(r)
+    srv = HttpServer(r, "127.0.0.1", 0, name="t_shutdown")
+    port = srv.start_background()
+    errors: list = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                text = scrape(port)
+                parse_exposition(text)
+            except AssertionError as e:      # malformed exposition
+                errors.append(e)
+                return
+            except Exception:
+                return  # connection refused/reset mid-shutdown: fine
+
+    threads = [threading.Thread(target=scraper) for _ in range(6)]
+    for t in threads:
+        t.start()
+    srv.stop()
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "scraper hung across shutdown"
+    assert not errors, errors
+    # the registry survived the race: a fresh exposition still parses
+    parse_exposition(obs_metrics.REGISTRY.expose())
+
+
 @pytest.mark.skipif(native.load() is None,
                     reason="native library unavailable")
 def test_native_storage_metrics_bridge(tmp_path):

@@ -176,13 +176,16 @@ def test_tail_cursor_memory(mem_store):
     _rate(app, "u2", "i2", 3.0)
     c1 = EventStore.tail_cursor(app)
     assert c1 == 2
-    inter, times, new_c, reset = EventStore.read_interactions_since(
-        0, app, event_names=("rate",), value_prop="rating")
+    inter, times, appends, new_c, reset = \
+        EventStore.read_interactions_since(
+            0, app, event_names=("rate",), value_prop="rating")
     assert new_c == 2 and len(inter) == 2 and not reset
     assert list(inter.user_ids) == ["u1", "u2"]
+    # the memory backend stamps exact per-slot append walls
+    assert appends.shape == (2,) and (appends > 0).all()
     # only the tail after the cursor
     _rate(app, "u3", "i1", 5.0)
-    inter2, _t, new_c2, _r = EventStore.read_interactions_since(
+    inter2, _t, _a, new_c2, _r = EventStore.read_interactions_since(
         c1, app, event_names=("rate",), value_prop="rating")
     assert new_c2 == 3 and len(inter2) == 1
     assert list(inter2.user_ids) == ["u3"]
@@ -191,7 +194,7 @@ def test_tail_cursor_memory(mem_store):
         event="$set", entity_type="item", entity_id="i9",
         properties=DataMap({"categories": ["x"]}),
         event_time=now_utc())], app)
-    inter3, _t, new_c3, _r = EventStore.read_interactions_since(
+    inter3, _t, _a, new_c3, _r = EventStore.read_interactions_since(
         new_c2, app, event_names=("rate",), value_prop="rating")
     assert new_c3 == 4 and len(inter3) == 0
 
@@ -208,7 +211,7 @@ def test_tail_skips_deleted_and_superseded_events(mem_store):
         properties=DataMap({"rating": 4.0}), event_time=now_utc())], app)
     _rate(app, "u2", "i2", 3.0)
     EventStore.delete([eids[0]], app)
-    inter, _t, new_c, reset = EventStore.read_interactions_since(
+    inter, _t, _a, new_c, reset = EventStore.read_interactions_since(
         0, app, event_names=("rate",), value_prop="rating")
     assert not reset and new_c == 2       # positions preserved
     assert list(inter.user_ids) == ["u2"]  # deleted event gone
@@ -223,7 +226,7 @@ def test_tail_skips_deleted_and_superseded_events(mem_store):
         target_entity_type="item", target_entity_id="i3",
         properties=DataMap({"rating": 2.0}), event_time=now_utc(),
         event_id="fixed-id")], app)
-    inter2, _t, _c, _r = EventStore.read_interactions_since(
+    inter2, _t, _a, _c, _r = EventStore.read_interactions_since(
         0, app, event_names=("rate",), value_prop="rating")
     u3_vals = [float(v) for u, v in zip(inter2.user_idx, inter2.values)
                if inter2.user_ids[int(u)] == "u3"]
@@ -257,10 +260,12 @@ def test_tail_cursor_cpplog(tmp_path):
             1, event_name="rate", value_prop="rating")
         c1 = dao.tail_cursor(1)
         assert c1 == 2
-        inter, times, new_c, reset = dao.read_interactions_since(
+        inter, times, appends, new_c, reset = dao.read_interactions_since(
             0, 1, event_names=("rate",), value_prop="rating")
         assert new_c == 2 and len(inter) == 2 and not reset
         assert list(inter.user_ids) == ["u1", "u2"]
+        # this process wrote the batch: its append mark covers the tail
+        assert appends.shape == (2,) and (appends > 0).all()
         dao.import_interactions(
             Interactions(
                 user_idx=np.asarray([0], np.int32),
@@ -269,12 +274,12 @@ def test_tail_cursor_cpplog(tmp_path):
                 user_ids=IdTable.from_list(["u3"]),
                 item_ids=IdTable.from_list(["i1"])),
             1, event_name="rate", value_prop="rating")
-        inter2, _t, new_c2, _r = dao.read_interactions_since(
+        inter2, _t, _a, new_c2, _r = dao.read_interactions_since(
             c1, 1, event_names=("rate",), value_prop="rating")
         assert new_c2 == 3 and len(inter2) == 1
         assert list(inter2.user_ids) == ["u3"]
         # empty tail round-trips cleanly
-        inter3, _t, new_c3, _r = dao.read_interactions_since(new_c2, 1)
+        inter3, _t, _a, new_c3, _r = dao.read_interactions_since(new_c2, 1)
         assert new_c3 == new_c2 and len(inter3) == 0
         # compaction renumbers entries: an old cursor must RESET even
         # when appends push the entry count past its old value (a bare
@@ -297,7 +302,7 @@ def test_tail_cursor_cpplog(tmp_path):
             1, event_name="rate", value_prop="rating")
         # entry count now exceeds the pre-compaction position...
         assert dao.tail_cursor(1) != pre_compact
-        _i, _t, _c, reset = dao.read_interactions_since(
+        _i, _t, _a, _c, reset = dao.read_interactions_since(
             pre_compact, 1, event_names=("rate",), value_prop="rating")
         assert reset is True  # ...but the generation mismatch catches it
     finally:

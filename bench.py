@@ -457,6 +457,21 @@ def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
     # the test_bench_e2e cross-check asserts. The timed run's gauge
     # value overwrites the compile run's.
     from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+    def device_train_booked():
+        """(seconds, dispatches) the profiler attributed to the training
+        op so far — als_train (XLA assembly) or als_fused (Pallas
+        kernel path), whichever this run routes through."""
+        secs = dispatches = 0.0
+        m = obs_metrics.REGISTRY.get("pio_device_seconds")
+        d = obs_metrics.REGISTRY.get("pio_device_dispatches_total")
+        for op in ("als_train", "als_fused"):
+            if m is not None:
+                secs += m.labels(op=op).value
+            if d is not None:
+                dispatches += d.labels(op=op).value
+        return secs, dispatches
+
     prev_profile = os.environ.get("PIO_PROFILE")
     os.environ["PIO_PROFILE"] = "1"
     try:
@@ -464,10 +479,14 @@ def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
         state = train(als.als_init(jax.random.key(0), n_users, n_items,
                                    RANK))
         first_call_s = time.perf_counter() - t0
+        # per-op device-seconds delta over the TIMED run only (the
+        # compile run books its own attribution)
+        secs0, disp0 = device_train_booked()
         t0 = time.perf_counter()
         state = train(als.als_init(jax.random.key(0), n_users, n_items,
                                    RANK))
         train_s = time.perf_counter() - t0
+        secs1, disp1 = device_train_booked()
     finally:
         if prev_profile is None:
             os.environ.pop("PIO_PROFILE", None)
@@ -503,6 +522,16 @@ def measure_train(buckets, bf16_sweeps, cache_probe=True, use_kernel=None,
         # decimals: CPU-backend MFU is ~1e-7 and must survive rounding
         "obs_mfu_train": (float(f"{obs_mfu_train:.6g}")
                           if obs_mfu_train > 0 else None),
+        # per-op pio_device_seconds cross-check: the profiler's
+        # block-until-ready wall over the SAME timed run — must bracket
+        # train_s (test_bench_e2e asserts the ratio), and the dispatch
+        # counter pins the whole run as ONE attributed dispatch
+        "obs_device_train_s": (round(secs1 - secs0, 4)
+                               if secs1 > secs0 else None),
+        "obs_device_train_dispatches": int(disp1 - disp0),
+        # warm wall through the fused Gram+solve kernel path, when the
+        # selector engaged it (None = XLA assembly served this round)
+        "train_fused_wall_s": (round(train_s, 3) if use_kernel else None),
     }
 
 
@@ -515,7 +544,7 @@ RETRAIN_KEYS = (
     "retrain_sweeps_used", "retrain_delta_rows", "retrain_scan_s",
     "retrain_prep_fresh_s", "retrain_prep_continue_s",
     "retrain_heldout_rmse_fresh", "retrain_heldout_rmse_continue",
-    "retrain_speedup",
+    "retrain_speedup", "retrain_one_dispatch", "retrain_train_dispatches",
 )
 
 
@@ -661,6 +690,10 @@ def bench_retrain(store_dir, state, inter, heldout, truth):
             "retrain_continue_wall_s": round(cont_wall_s, 3),
             "retrain_sweeps_used": int(rs.get("sweeps_used", 0)),
             "retrain_delta_rows": delta_rows,
+            # the one-dispatch contract, measured on the timed run:
+            # splice + sweeps + early-stop in a single device dispatch
+            "retrain_one_dispatch": bool(rs.get("one_dispatch", False)),
+            "retrain_train_dispatches": int(rs.get("train_dispatches", 0)),
             "retrain_scan_s": round(scan_s, 3),
             "retrain_prep_fresh_s": round(prep_fresh_s, 3),
             "retrain_prep_continue_s": (None if prep_cont_s is None
@@ -1152,6 +1185,9 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
         "obs_mfu_vs_offline": (
             round(t["obs_mfu_train"] / mfu, 4)
             if t["obs_mfu_train"] and mfu > 0 else None),
+        "obs_device_train_s": t["obs_device_train_s"],
+        "obs_device_train_dispatches": t["obs_device_train_dispatches"],
+        "train_fused_wall_s": t["train_fused_wall_s"],
         "compile_s_cold": t["compile_s_cold"],
         "compile_s_warm_cache": t["compile_s_warm_cache"],
         "ingest_wall_s": round(ingest_s, 1),
@@ -1345,6 +1381,8 @@ def run_degraded(inter, heldout, truth, rng, cancel=None):
         "value": round(t["train_s"], 3),
         "vs_baseline": round(scaled_base / t["train_s"], 2),
         "obs_mfu_train": t.get("obs_mfu_train"),
+        "obs_device_train_s": t.get("obs_device_train_s"),
+        "obs_device_train_dispatches": t.get("obs_device_train_dispatches"),
         "train_rmse": round(float(fit), 3),
         "heldout_rmse": round(heldout_rmse, 3),
         "precision_at_10_vs_truth": round(prec10, 3),
@@ -1389,9 +1427,138 @@ def run_orchestrator() -> None:
     atexit.register(shutil.rmtree, store_dir, True)
     frag_path = os.path.join(store_dir, "tpu_fragment.json")
 
+    # -- THE record, created before any stage runs. Every stage fills it
+    # in place, so at any instant it is the best-available parsed record
+    # — and the SIGTERM handler below can flush it if the DRIVER's
+    # deadline (not ours) lands first. BENCH_r05 ended rc=124 with
+    # parsed:null because an already-computed degraded record was still
+    # waiting for the orchestrator's own emit point when the driver
+    # killed the process; now the kill itself emits. Stable key set
+    # across modes: every key a prior round's record had is present
+    # (None when the mode can't measure it), so round-over-round
+    # comparisons never hit a missing key on a degraded round.
+    record = {
+        "metric": "als_ml20m_train_wall_s",
+        "value": None,
+        "unit": "s",
+        "vs_baseline": None,
+        "degraded": True,
+        "train_rmse": None,
+        "heldout_rmse": None,
+        "noise_floor": NOISE_SIGMA,
+        "precision_at_10_vs_truth": None,
+        # pre-declared so the degraded-fallback thread's record.update
+        # never INSERTS a key: a dict resize racing the SIGTERM
+        # handler's json.dumps would raise mid-flush (value swaps are
+        # GIL-atomic; popped again when a child fragment lands)
+        "degraded_nnz": None,
+        "mfu": None,
+        "mfu_bf16_peak": None,
+        "compile_s_cold": None,
+        "compile_s_warm_cache": None,
+        "seed_wall_s": None,
+        "ingest_wall_s": None,
+        "prep_wall_s": None,
+        "prep_h2d_s": None,  # child-only (pipelined prep→device upload)
+        # host-pipeline sub-metrics (bench_scan_probe): sharded-scan
+        # walls, native-lock-held wall, scan→prep overlap
+        **{k: None for k in (
+            "scan_open_s", "scan_wall_1thread_s", "scan_wall_seq_s",
+            "scan_wall_sharded_s", "scan_speedup_vs_seq",
+            "scan_speedup_vs_1thread", "scan_shards",
+            "scan_shard_walls_s", "scan_lock_held_s",
+            "scan_merge_wall_s", "scan_prep_pipelined_wall_s",
+            "scan_prep_overlap_s")},
+        "e2e_train_wall_s": None,
+        "ingest_http_eps": None,
+        "ingest_http_eps_cap500": None,
+        "movielens_rmse": None,
+        "movielens_rmse_bound": None,
+        "serve_p50_ms": None,
+        "serve_p99_ms": None,
+        "serve_qps": None,
+        "serve_qps_concurrent": None,
+        "serve_max_batch": None,
+        # child-fragment fields (overwritten when the child lands; a
+        # degraded round carries the honest null markers so every
+        # deterministic key a successful round emits is present)
+        "als_kernel": None,
+        "als_kernel_rows": None,
+        "als_kernel_sweep_xla_s": None,
+        "flash_kernel_active": None,
+        "train_fused_wall_s": None,
+        "obs_device_train_s": None,
+        "obs_device_train_dispatches": None,
+        # steady-state retrain leg (child-only; docs/performance.md)
+        **dict.fromkeys(RETRAIN_KEYS),
+        # speed-layer leg (child-only; docs/production.md "Freshness
+        # between retrains")
+        **dict.fromkeys(SPEED_KEYS),
+        "accel_waited_s": None,
+        "accel_outcome": "never_available",
+        "sasrec_epoch_s": None,
+        **{f"attn_{kind}_ms_{s // 1024}k": None
+           for s in (int(v) for v in os.environ.get(
+               "PIO_BENCH_ATTN_SEQS", "4096,8192,32768").split(",") if v)
+           for kind in ("flash", "xla")},
+        "nnz": NNZ,
+        "rank": RANK,
+        "sweeps": ITERATIONS,
+        "bf16_sweeps": BF16_SWEEPS,
+        # telemetry cross-check (docs/observability.md): stable None
+        # defaults; child-fragment values and the parent registry
+        # snapshot below fill what each process actually ran
+        **dict.fromkeys(OBS_KEYS),
+    }
+    emitted: list = []
+
+    def _emit_record(from_signal: bool = False) -> None:
+        # contract: ONE complete JSON line on stdout. `emitted` is set
+        # only AFTER the full line is flushed: a SIGTERM landing while
+        # the main emit is mid-write still re-emits (the handler
+        # prefixes a newline so any partial main-thread write becomes
+        # its own garbage line and the record line stays parseable —
+        # the worst case is a duplicated valid line, never a missing
+        # one, which was the parsed:null class). The dumps retry guards
+        # a worker thread mutating the record mid-serialization: value
+        # swaps are GIL-atomic (all keys pre-declared above), but one
+        # retry keeps even an unexpected resize from costing the round
+        # its record.
+        if emitted:
+            return
+        try:
+            line = json.dumps(record)
+        except RuntimeError:
+            line = json.dumps(dict(record))
+        sys.stdout.write(("\n" if from_signal else "") + line + "\n")
+        sys.stdout.flush()
+        emitted.append(True)
+
+    def _deadline_flush(signum, frame):
+        # the DRIVER's kill (timeout → SIGTERM, the rc=124 path): flush
+        # the best-available record NOW — a late child fragment is
+        # picked up if one landed — and exit cleanly. Machine-readable
+        # metrics from every run, even one the driver cut short.
+        try:
+            if os.path.exists(frag_path):
+                with open(frag_path) as f:
+                    record.update(json.load(f))
+                record["degraded"] = False
+        except Exception:
+            pass
+        log("SIGTERM before the bench's own emit point: flushing the "
+            "best-available record")
+        _emit_record(from_signal=True)
+        os._exit(0)
+
+    import signal
+
+    signal.signal(signal.SIGTERM, _deadline_flush)
+
     # -- 1. SEED (host) ----------------------------------------------------
     events, client, seed_s = seed_store(store_dir, users, items, ratings)
     client.close()
+    record["seed_wall_s"] = round(seed_s, 1)
     log(f"seed: {NNZ} events in {seed_s:.1f}s "
         f"({NNZ / seed_s / 1e6:.2f}M ev/s)")
 
@@ -1404,14 +1571,8 @@ def run_orchestrator() -> None:
     #        before the parent holds its own copy, and GUARDED: a probe
     #        failure nulls the sub-metrics, never costs the record (the
     #        BENCH_r05 recordless-exit class)
-    scan_metrics = {k: None for k in (
-        "scan_open_s", "scan_wall_1thread_s", "scan_wall_seq_s",
-        "scan_wall_sharded_s", "scan_speedup_vs_seq",
-        "scan_speedup_vs_1thread", "scan_shards", "scan_shard_walls_s",
-        "scan_lock_held_s", "scan_merge_wall_s",
-        "scan_prep_pipelined_wall_s", "scan_prep_overlap_s")}
     try:
-        scan_metrics.update(bench_scan_probe(store_dir))
+        record.update(bench_scan_probe(store_dir))
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"scan probe failed ({e!r}); sub-metrics null this round")
 
@@ -1419,18 +1580,20 @@ def run_orchestrator() -> None:
     #         record; the child measures its own on the TPU path) ----------
     inter, ingest_s = scan_store(store_dir)
     assert len(inter) == NNZ, len(inter)
+    record["ingest_wall_s"] = round(ingest_s, 1)
     log(f"ingest scan: {ingest_s:.1f}s ({NNZ / ingest_s / 1e6:.2f}M ev/s)")
     prep_probe = prep_buckets(inter)
     prep_s = prep_probe[4]
     del prep_probe
+    record["prep_wall_s"] = round(prep_s, 1)
     log(f"prep (bucketed padded rows): {prep_s:.1f}s")
 
     # -- 6. INGEST-HTTP (host; needs no accelerator) -----------------------
-    ingest_http_eps = bench_ingest_http()
-    ingest_http_eps_cap500 = bench_ingest_http(batch_size=500)
+    record["ingest_http_eps"] = bench_ingest_http()
+    record["ingest_http_eps_cap500"] = bench_ingest_http(batch_size=500)
 
     # -- 6b. REAL-DATA QUALITY BOUND (host CPU; tiny) ----------------------
-    movielens = bench_movielens_quality()
+    record.update(bench_movielens_quality())
 
     # -- 4/5/7. TRAIN + ATTENTION + SERVE: supervised TPU child ------------
     # (started after the host stages so parent CPU load never perturbs the
@@ -1467,14 +1630,28 @@ def run_orchestrator() -> None:
     deg_start_wait = max(0.0, min(
         DEGRADED_START_S,
         (emit_by - DEGRADED_BUDGET_S) - time.monotonic()))
+
+    def _run_degraded_into_record() -> None:
+        res = run_degraded(inter, heldout, truth, rng, cancel=claim_seen)
+        degraded_result.append(res)
+        if res:
+            # fold into the live record the moment it exists, so a
+            # driver kill from here on flushes REAL train-quality
+            # numbers (the child fragment, if one still lands, is
+            # applied after and overrides)
+            record.update(res)
+            record["bf16_sweeps"] = 0  # degraded = all-f32 CPU schedule
+            if record["ingest_wall_s"] is not None \
+                    and record["prep_wall_s"] is not None:
+                record["e2e_train_wall_s"] = round(
+                    record["ingest_wall_s"] + record["prep_wall_s"]
+                    + record["value"], 1)
+
     if not sup_done.wait(deg_start_wait) and not claim_seen.is_set():
         log(f"no accelerator claim after {deg_start_wait:.0f}s — "
             "computing the degraded record in parallel with the wait")
-        t_deg = threading.Thread(
-            target=lambda: degraded_result.append(
-                run_degraded(inter, heldout, truth, rng,
-                             cancel=claim_seen)),
-            daemon=True)
+        t_deg = threading.Thread(target=_run_degraded_into_record,
+                                 daemon=True)
         t_deg.start()
     if not sup_done.wait(max(emit_by - time.monotonic(), 0.0)):
         log("bench deadline: abandoning the supervisor thread and "
@@ -1489,77 +1666,23 @@ def run_orchestrator() -> None:
         if t_deg.is_alive():
             log("degraded fallback still running at the deadline — "
                 "emitting the record without train-quality keys")
-    # stable key set across modes: every key a prior round's record had is
-    # present (None when the mode can't measure it), so round-over-round
-    # comparisons never hit a missing key on a degraded round
-    record = {
-        "metric": "als_ml20m_train_wall_s",
-        "value": None,
-        "unit": "s",
-        "vs_baseline": None,
-        "degraded": False,
-        "train_rmse": None,
-        "heldout_rmse": None,
-        "noise_floor": NOISE_SIGMA,
-        "precision_at_10_vs_truth": None,
-        "mfu": None,
-        "mfu_bf16_peak": None,
-        "compile_s_cold": None,
-        "compile_s_warm_cache": None,
-        "seed_wall_s": round(seed_s, 1),
-        "ingest_wall_s": round(ingest_s, 1),
-        "prep_wall_s": round(prep_s, 1),
-        "prep_h2d_s": None,  # child-only (pipelined prep→device upload)
-        # host-pipeline sub-metrics (bench_scan_probe): sharded-scan
-        # walls, native-lock-held wall, scan→prep overlap
-        **scan_metrics,
-        "e2e_train_wall_s": None,
-        "ingest_http_eps": ingest_http_eps,
-        "ingest_http_eps_cap500": ingest_http_eps_cap500,
-        **movielens,
-        "serve_p50_ms": None,
-        "serve_p99_ms": None,
-        "serve_qps": None,
-        "serve_qps_concurrent": None,
-        "serve_max_batch": None,
-        # child-fragment fields (overwritten when the child lands; a
-        # degraded round carries the honest null markers so every
-        # deterministic key a successful round emits is present)
-        "als_kernel": None,
-        "als_kernel_rows": None,
-        "als_kernel_sweep_xla_s": None,
-        "flash_kernel_active": None,
-        # steady-state retrain leg (child-only; docs/performance.md)
-        **dict.fromkeys(RETRAIN_KEYS),
-        # speed-layer leg (child-only; docs/production.md "Freshness
-        # between retrains")
-        **dict.fromkeys(SPEED_KEYS),
-        # how long the supervised-child leg ran and how it ended — makes
-        # a wedged-lease round diagnosable from the record alone
-        # child_ok counts as claiming evidence too: a fragment can land
-        # via an abandoned child whose claim file the supervisor no
-        # longer polls
-        "accel_waited_s": round(accel_waited_s, 1),
-        "accel_outcome": ("claimed"
-                          if claim_seen.is_set() or child_ok
-                          else "never_available"),
-        "sasrec_epoch_s": None,
-        **{f"attn_{kind}_ms_{s // 1024}k": None
-           for s in (int(v) for v in os.environ.get(
-               "PIO_BENCH_ATTN_SEQS", "4096,8192,32768").split(",") if v)
-           for kind in ("flash", "xla")},
-        "nnz": NNZ,
-        "rank": RANK,
-        "sweeps": ITERATIONS,
-        "bf16_sweeps": BF16_SWEEPS,
-        # telemetry cross-check (docs/observability.md): stable None
-        # defaults; child-fragment values and the parent registry
-        # snapshot below fill what each process actually ran
-        **dict.fromkeys(OBS_KEYS),
-    }
+    # how long the supervised-child leg ran and how it ended — makes
+    # a wedged-lease round diagnosable from the record alone.
+    # child_ok counts as claiming evidence too: a fragment can land
+    # via an abandoned child whose claim file the supervisor no
+    # longer polls
+    record["accel_waited_s"] = round(accel_waited_s, 1)
+    record["accel_outcome"] = ("claimed"
+                               if claim_seen.is_set() or child_ok
+                               else "never_available")
     if child_ok and os.path.exists(frag_path):
         with open(frag_path) as f:
             record.update(json.load(f))
+        record["degraded"] = False
+        record["bf16_sweeps"] = BF16_SWEEPS
+        # a degraded fallback may have folded in before the child landed
+        # — the fragment overrode every shared key; drop its marker
+        record.pop("degraded_nnz", None)
         record["e2e_train_wall_s"] = round(
             record["ingest_wall_s"] + record["prep_wall_s"]
             + record["value"], 1)
@@ -1567,35 +1690,32 @@ def run_orchestrator() -> None:
         record["degraded"] = True
         record["bf16_sweeps"] = 0  # degraded runs the all-f32 CPU schedule
         if degraded_result and degraded_result[0]:
-            deg = degraded_result[0]
+            pass  # already folded into the record by the fallback thread
         elif t_deg is not None and t_deg.is_alive():
-            deg = None  # fallback thread hung — never race a second run
+            pass  # fallback thread hung — never race a second run
         elif time.monotonic() + DEGRADED_BUDGET_S <= emit_by:
             # no fallback ran, or it was cancelled by a claim from a child
             # that then failed — the thread is dead and there is still
             # budget before the deadline, so run it fresh
             deg = run_degraded(inter, heldout, truth, rng)
+            if deg:
+                record.update(deg)
+                # full-shape read/prep walls + degraded-shape train wall:
+                # the degraded flag marks the mixed provenance
+                record["e2e_train_wall_s"] = round(
+                    record["ingest_wall_s"] + record["prep_wall_s"]
+                    + record["value"], 1)
         else:
             log("no time left for a fresh degraded run before the "
                 "deadline — emitting the record without train-quality "
                 "keys")
-            deg = None
-        if deg:
-            record.update(deg)
-            # full-shape read/prep walls + degraded-shape train wall: the
-            # degraded flag marks the mixed provenance
-            record["e2e_train_wall_s"] = round(
-                record["ingest_wall_s"] + record["prep_wall_s"]
-                + record["value"], 1)
     # parent-side registry snapshot: fills the obs_* keys for the stages
     # THIS process ran (ingest HTTP always; serving too on a degraded
     # round) without overriding anything the child fragment measured
     for k, v in obs_snapshot().items():
         if record.get(k) is None:
             record[k] = v
-    # explicit flush: the record must hit the pipe even if the driver's
-    # kill lands right after (stdout is block-buffered under a pipe)
-    print(json.dumps(record), flush=True)
+    _emit_record()
 
 
 #: the reference's own bundled MovieLens sample (user::item::rating, 1.5k

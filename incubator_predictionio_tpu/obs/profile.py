@@ -16,6 +16,16 @@ default and enabled with ``PIO_PROFILE=1``:
   convention as the bench record's ``mfu``), so the live gauge and the
   bench's offline figure are directly comparable.
 
+Op labels are a BOUNDED set chosen by the call sites: ``als_train``
+(XLA-assembly training), ``als_fused`` (training through the fused
+Gram+solve Pallas kernel path — its own label so the kernel's measured
+trajectory is separable in /metrics, while ``als.train_flops`` stays
+the ONE FLOP formula for both, keeping ``pio_mfu{phase="train"}``
+comparable across the split), ``als_retrain`` (continuation retrain on
+the XLA path), ``foldin_solve`` (speed-layer fold-in buckets — same
+label on both its XLA and fused-kernel solve paths) and the serving
+``serve_topk``/``serve_topk_batch`` entries.
+
 OFF is the contract: with ``PIO_PROFILE`` unset, a call site pays one
 ``t0()`` env read returning None and one ``record()`` None-check —
 no block_until_ready, no metrics, no jax import. The profiler is the

@@ -218,15 +218,38 @@ def _kernel_rows_default() -> int:
     return pallas_kernels._ALS_ROWS
 
 
+def _fused_gram_mode() -> str:
+    """`PIO_ALS_FUSED_GRAM` — the fused gather+Gram+CG kernel selector
+    ("auto" probes per variant, "on" forces — tests use interpret mode —
+    "off" pins the two-stage kernel / XLA assembly). Read per call,
+    never frozen at import (the env-import lint contract)."""
+    return os.environ.get("PIO_ALS_FUSED_GRAM", "auto")
+
+
+def _cg_tol_env() -> float:
+    """`PIO_ALS_CG_TOL` — device-side CG residual early-exit tolerance
+    (relative preconditioned residual; 0 = fixed budget, the default:
+    the budget is already tuned, and a data-dependent iteration count
+    would blur the analytic FLOP attribution). Read per call."""
+    try:
+        return float(os.environ.get("PIO_ALS_CG_TOL", "0") or 0.0)
+    except ValueError:
+        return 0.0
+
+
 def _kernel_enabled(implicit: bool, warm: bool = False) -> bool:
     """Resolve the bucket-kernel selector OUTSIDE any jit trace (the
-    Mosaic probe compiles+runs a real kernel). Explicit CG only: the
-    implicit path needs the batch-shared YᵗY term and stays on XLA.
-    ``warm`` is the caller's resolved warm-start setting so the probe
-    compiles the exact kernel variant (x0 operand or not) this run
-    will dispatch."""
-    if implicit or _SOLVER != "cg" or _ALS_KERNEL == "off":
+    Mosaic probe compiles+runs a real kernel). Explicit CG routes
+    through either kernel generation; the implicit path needs the
+    batch-shared YᵗY term, which only the fused-gather kernel carries —
+    implicit is therefore kernel-eligible exactly when the fused
+    generation is. ``warm`` is the caller's resolved warm-start setting
+    so the probe compiles the exact kernel variant (x0 operand or not)
+    this run will dispatch."""
+    if _SOLVER != "cg" or _ALS_KERNEL == "off":
         return False
+    if implicit:
+        return _fused_enabled(True, warm)
     if _ALS_KERNEL == "on":
         return True
     from incubator_predictionio_tpu.ops.pallas_kernels import (
@@ -234,6 +257,61 @@ def _kernel_enabled(implicit: bool, warm: bool = False) -> bool:
     )
 
     return als_kernel_available(warm=warm)
+
+
+def _fused_enabled(implicit: bool, warm: bool) -> bool:
+    """Resolve the fused-gather generation selector OUTSIDE any trace.
+    Forced on ONLY by its own `PIO_ALS_FUSED_GRAM=on` (the
+    interpret-mode test hook); otherwise the auto probe compiles the
+    exact (warm, implicit) fused variant this run would dispatch.
+    `PIO_ALS_KERNEL=on` deliberately does NOT waive the probe here: a
+    deployment that forced the validated two-stage kernel must not be
+    silently upgraded to the brand-new in-kernel-gather lowering
+    without the per-variant probe contract (the PR 1 rule)."""
+    mode = _fused_gram_mode()
+    if mode in ("0", "off", "false") or _SOLVER != "cg" \
+            or _ALS_KERNEL == "off":
+        return False
+    if mode == "on":
+        return True
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_kernel_available,
+    )
+
+    return als_kernel_available(warm=warm, fused=True, implicit=implicit)
+
+
+def _fused_sides(n_users: int, n_items: int, implicit: bool, warm: bool,
+                 compute_dtype: Any, rank: int) -> Tuple[bool, bool]:
+    """Per-half-sweep fused-gather routing → (user_sweep, item_sweep).
+
+    The fused kernel pins the OTHER side's factor table in VMEM, so the
+    decision is per gather source: the user half-sweep gathers from the
+    item table (small — fits at ML-20M shape), the item half-sweep from
+    the user table (usually does not). Resolved HERE, outside the trace,
+    from static shapes + the VMEM budget (`PIO_ALS_FUSED_VMEM_MB`), and
+    threaded as a static jit arg — a mid-trace read would bake a stale
+    budget into the cache."""
+    dt = jnp.float32 if implicit else compute_dtype
+    return (_fused_one(True, implicit, warm, n_items, rank, dt),
+            _fused_one(True, implicit, warm, n_users, rank, dt))
+
+
+def _fused_one(use_kernel: bool, implicit: bool, warm: bool,
+               table_rows: int, rank: int, dtype: Any) -> bool:
+    """THE single-side fused-routing conjunction: kernel selected AND
+    the fused generation enabled for this exact (implicit, warm)
+    variant AND the gather table inside the VMEM budget. Every call
+    site — the per-sweep tuple above, the one-shot `_update_side`
+    entries, retrain's per-leg closure via `_fused_sides` — resolves
+    through here so the rule cannot drift between files."""
+    if not use_kernel or not _fused_enabled(implicit, warm):
+        return False
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_fused_fits,
+    )
+
+    return als_fused_fits(table_rows, rank, dtype)
 #: CG budget for the bf16 early sweeps of the mixed schedule. Each CG
 #: iteration re-reads the whole [rows, K, K] Gram batch (~9 GB at
 #: ML-20M scale on the user side) — the dominant HBM stream once gathers
@@ -253,7 +331,9 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
                   matvec_dtype: Any = jnp.float32,
                   lam: Optional[jax.Array] = None,
                   shared: Optional[jax.Array] = None,
-                  x0: Optional[jax.Array] = None) -> jax.Array:
+                  x0: Optional[jax.Array] = None,
+                  tol: float = 0.0,
+                  return_iters: bool = False):
     """Batched Jacobi-PCG for SPD systems → x ≈ (a [+ diag(lam)])⁻¹ b, [B, K].
 
     Division guards make converged (and all-zero) systems fixed points
@@ -281,7 +361,19 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
     each sweep while the true solution moves less and less — warm
     starting from the previous sweep's factors buys the same residual in
     roughly half the iterations once the alternation settles, and each
-    saved iteration saves a full re-read of the Gram batch."""
+    saved iteration saves a full re-read of the Gram batch.
+
+    ``tol`` > 0 adds a DEVICE-SIDE residual early exit
+    (``lax.while_loop`` with ``iters`` as the ceiling): the loop stops
+    once every row's preconditioned residual rᵗz has fallen to
+    tol²·(r₀ᵗz₀) — well-conditioned batches (warm starts on settled
+    alternations, small fold-in systems) stop paying the full budget,
+    and each saved iteration saves a full Gram-batch re-read. No host
+    sync: the criterion is evaluated in-trace (the host-sync lint
+    contract). ``tol == 0`` keeps the fixed-budget ``fori_loop`` —
+    bit-identical to the historical path. ``return_iters`` additionally
+    returns the iteration count actually run (a device scalar; tests
+    pin the early exit with it)."""
     diag = jnp.diagonal(a, axis1=-2, axis2=-1).astype(jnp.float32)
     if shared is not None:
         diag = diag + jnp.diagonal(shared)[None, :]
@@ -324,9 +416,26 @@ def _cg_solve_spd(a: jax.Array, b: jax.Array, iters: int,
         x = x0.astype(jnp.float32)
         r = b - matvec(x)
     z = minv * r
-    x, _r, _p, _rz = jax.lax.fori_loop(
-        0, iters, body, (x, r, z, jnp.sum(r * z, -1)))
-    return x
+    rz0 = jnp.sum(r * z, -1)
+    if tol and tol > 0.0:
+        tol2 = jnp.float32(tol) ** 2
+
+        def cond(carry):
+            i, _x, _r, _p, rz = carry
+            return jnp.logical_and(i < iters, jnp.any(rz > tol2 * rz0))
+
+        def wbody(carry):
+            i, x, r, p, rz = carry
+            x, r, p, rz = body(0, (x, r, p, rz))
+            return i + 1, x, r, p, rz
+
+        i, x, _r, _p, _rz = jax.lax.while_loop(
+            cond, wbody, (jnp.int32(0), x, r, z, rz0))
+    else:
+        x, _r, _p, _rz = jax.lax.fori_loop(
+            0, iters, body, (x, r, z, rz0))
+        i = jnp.int32(iters)
+    return (x, i) if return_iters else x
 
 
 def _reg_solve(
@@ -340,6 +449,7 @@ def _reg_solve(
     cg_iters: int = _CG_ITERS,
     cg_matvec_dtype: Any = jnp.float32,
     x0: Optional[jax.Array] = None,
+    cg_tol: float = 0.0,
 ) -> jax.Array:
     """Regularize + batched SPD solve; zero factors for empty rows."""
     rank = gram.shape[-1]
@@ -362,7 +472,7 @@ def _reg_solve(
         # λ·nnz) on the diagonal — worse conditioned, so double the budget
         sol = _cg_solve_spd(a, rhs, cg_iters * (2 if implicit else 1),
                             matvec_dtype=cg_matvec_dtype, lam=lam,
-                            shared=shared, x0=x0)
+                            shared=shared, x0=x0, tol=cg_tol)
     else:
         a = a.astype(jnp.float32) + lam[:, None, None] * eye
         if shared is not None:
@@ -374,7 +484,8 @@ def _reg_solve(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("reg_nnz", "compute_dtype", "precision", "cg_iters"),
+    static_argnames=("reg_nnz", "compute_dtype", "precision", "cg_iters",
+                     "cg_tol"),
 )
 def _solve_bucket(
     other_factors: jax.Array,  # [M, K] f32
@@ -387,6 +498,7 @@ def _solve_bucket(
     precision: Any = jax.lax.Precision.HIGHEST,
     cg_iters: int = _CG_ITERS,
     x0: Optional[jax.Array] = None,
+    cg_tol: float = 0.0,
 ) -> jax.Array:
     """Batched normal-equation solve for one degree bucket → [B, K].
 
@@ -407,7 +519,7 @@ def _solve_bucket(
         implicit=False, alpha=0.0, gram_dtype=gram_dtype)
     return _reg_solve(gram, rhs, nnz, l2, reg_nnz, implicit=False, yty=None,
                       cg_iters=cg_iters, cg_matvec_dtype=compute_dtype,
-                      x0=x0)
+                      x0=x0, cg_tol=cg_tol)
 
 
 def _solve_bucket_kernel(
@@ -437,6 +549,34 @@ def _solve_bucket_kernel(
     return als_solve_cg_pallas(
         gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz, iters=cg_iters,
         rows_per_program=max(kernel_rows, 1), x0=x0)
+
+
+def _solve_bucket_fused(
+    gsrc: jax.Array,           # [M, K] gather source, ALREADY compute-dtype
+    yty: Optional[jax.Array],  # [K, K] shared implicit term, or None
+    cols: jax.Array,
+    vals: jax.Array,
+    mask: jax.Array,
+    l2: float,
+    reg_nnz: bool,
+    cg_iters: int,
+    implicit: bool = False,
+    alpha: float = 0.0,
+    x0: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Bucket solve via the fused gather+Gram+CG Pallas kernel — the
+    table-resident generation of :func:`_solve_bucket_kernel`: the
+    [B, D, K] gather never materializes in HBM either. Covers BOTH
+    feedback modes (implicit rides the precomputed YᵗY as one shared
+    operand); callers gate on ``als_fused_fits`` for the table shape
+    and pass the implicit path's doubled CG budget themselves."""
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_fused_solve_cg_pallas,
+    )
+
+    return als_fused_solve_cg_pallas(
+        gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz, iters=cg_iters,
+        implicit=implicit, alpha=alpha, yty=yty, x0=x0)
 
 
 #: f32-element budget for one bucket chunk's gather intermediate
@@ -558,17 +698,24 @@ def _sweep_side(
     kernel_min_d: int = 0,
     kernel_rows: int = 1,
     prev_factors: Optional[jax.Array] = None,
+    use_fused: bool = False,
+    cg_tol: float = 0.0,
 ) -> jax.Array:
     """One half-sweep (traced): solve every bucket + split rows, scatter.
 
     THE single sweep implementation — the fused trainer, als_sweep and
     als_sweep_implicit all trace through here, so the paths cannot
-    diverge. ``use_kernel`` and ``kernel_min_d`` (resolved by the caller,
-    outside the trace, and part of every jit cache key — a mid-trace
-    global read would silently survive a runtime override) route
-    explicit-CG buckets of width ≥ min-D through the fused Pallas solve;
-    narrower buckets, the heavy split-row path and implicit mode always
-    use the XLA assembly."""
+    diverge. ``use_kernel``, ``kernel_min_d`` and ``use_fused``
+    (resolved by the caller, outside the trace, and part of every jit
+    cache key — a mid-trace global read would silently survive a
+    runtime override) route CG buckets of width ≥ min-D through the
+    Pallas solves: ``use_fused`` selects the gather+Gram+CG generation
+    (the caller has already checked the gather table fits the VMEM
+    budget for THIS side — see ``_fused_sides``), otherwise the
+    two-stage Gram+CG kernel serves explicit buckets. Narrower buckets
+    and the heavy split-row path always use the XLA assembly; implicit
+    buckets are kernel-eligible only in the fused generation (the
+    shared-YᵗY operand)."""
     rank = other_factors.shape[1]
     out = jnp.zeros((n_rows, rank), jnp.float32)
     yty = _gram_all(other_factors, precision) if implicit else None
@@ -579,16 +726,37 @@ def _sweep_side(
     gsrc = other_factors
     if not implicit and other_factors.dtype != compute_dtype:
         gsrc = other_factors.astype(compute_dtype)
+    if use_fused and use_kernel:
+        # the fused kernel's table block needs a sublane-aligned row
+        # count; pad ONCE per half-sweep (padding rows are never
+        # gathered — every col id < M — so the XLA buckets and the
+        # heavy path can share the padded source unchanged)
+        mp = -(-gsrc.shape[0] // 8) * 8
+        if mp != gsrc.shape[0]:
+            gsrc = jnp.pad(gsrc, ((0, mp - gsrc.shape[0]), (0, 0)))
     for row_ids, cols, vals, mask in tree:
         row_elems = None
         x0 = (_gather_x0(prev_factors, row_ids)
               if prev_factors is not None else None)
-        if implicit:
+        if use_kernel and use_fused and cols.shape[1] >= kernel_min_d:
+            from incubator_predictionio_tpu.ops.pallas_kernels import (
+                als_fused_row_elems,
+            )
+
+            row_elems = als_fused_row_elems(cols.shape[1], rank)
+
+            def solver(t, _yty=yty):
+                return _solve_bucket_fused(
+                    gsrc, _yty, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
+                    cg_iters=cg_iters * (2 if implicit else 1),
+                    implicit=implicit, alpha=alpha,
+                    x0=t[3] if len(t) > 3 else None)
+        elif implicit:
             def solver(t, _yty=yty):
                 return _solve_bucket_implicit(
                     other_factors, _yty, t[0], t[1], t[2], l2, alpha,
                     precision=precision, cg_iters=cg_iters,
-                    x0=t[3] if len(t) > 3 else None)
+                    x0=t[3] if len(t) > 3 else None, cg_tol=cg_tol)
         elif use_kernel and cols.shape[1] >= kernel_min_d:
             # chunk by the PADDED gather footprint the kernel actually
             # materializes (single source of truth in pallas_kernels)
@@ -608,7 +776,8 @@ def _sweep_side(
                 return _solve_bucket(
                     gsrc, t[0], t[1], t[2], l2, reg_nnz=reg_nnz,
                     compute_dtype=compute_dtype, precision=precision,
-                    cg_iters=cg_iters, x0=t[3] if len(t) > 3 else None)
+                    cg_iters=cg_iters, x0=t[3] if len(t) > 3 else None,
+                    cg_tol=cg_tol)
         # large buckets solve in bounded row chunks (lax.map) so the
         # [B, D, K] gather / [B, K, K] gram temps never exceed the chunk
         # budget — the ML-20M-scale HBM requirement
@@ -619,7 +788,7 @@ def _sweep_side(
         h_ids, h_sol = _solve_heavy(
             gsrc, heavy, l2, alpha, reg_nnz, compute_dtype,
             precision, implicit, yty, cg_iters=cg_iters,
-            prev_factors=prev_factors)
+            prev_factors=prev_factors, cg_tol=cg_tol)
         out = _scatter_rows_impl(out, h_ids, h_sol)
     return out
 
@@ -628,17 +797,19 @@ def _sweep_side(
     jax.jit,
     static_argnames=("n_rows", "reg_nnz", "compute_dtype", "precision",
                      "implicit", "cg_iters", "use_kernel", "kernel_min_d",
-                     "kernel_rows"),
+                     "kernel_rows", "use_fused", "cg_tol"),
 )
 def _sweep_side_jit(n_rows, other_factors, tree, heavy, l2, alpha, reg_nnz,
                     compute_dtype, precision, implicit,
                     cg_iters=_CG_ITERS, use_kernel=False, kernel_min_d=0,
-                    kernel_rows=1, prev_factors=None):
+                    kernel_rows=1, prev_factors=None, use_fused=False,
+                    cg_tol=0.0):
     return _sweep_side(n_rows, other_factors, tree, heavy, l2, alpha,
                        reg_nnz, compute_dtype, precision, implicit,
                        cg_iters=cg_iters, use_kernel=use_kernel,
                        kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
-                       prev_factors=prev_factors)
+                       prev_factors=prev_factors, use_fused=use_fused,
+                       cg_tol=cg_tol)
 
 
 def _update_side(
@@ -650,13 +821,18 @@ def _update_side(
     compute_dtype: Any,
     precision: Any,
 ) -> jax.Array:
+    use_kernel = _kernel_enabled(False, warm=False)
     return _sweep_side_jit(
         n_rows, other_factors, _buckets_tree(buckets), None, l2, 0.0,
         reg_nnz, compute_dtype, precision, implicit=False,
         # this path never passes prev_factors, so probe the cold variant
-        use_kernel=_kernel_enabled(False, warm=False),
+        use_kernel=use_kernel,
         kernel_min_d=_KERNEL_MIN_D,
-        kernel_rows=_kernel_rows_default())
+        kernel_rows=_kernel_rows_default(),
+        use_fused=_fused_one(use_kernel, False, False,
+                             other_factors.shape[0],
+                             other_factors.shape[1], compute_dtype),
+        cg_tol=_cg_tol_env())
 
 
 def assert_no_split(buckets: Sequence[PaddedRows], side: str = "row") -> None:
@@ -716,7 +892,7 @@ def als_sweep(
 # ---------------------------------------------------------------------------
 
 @functools.partial(
-    jax.jit, static_argnames=("precision", "cg_iters")
+    jax.jit, static_argnames=("precision", "cg_iters", "cg_tol")
 )
 def _solve_bucket_implicit(
     other_factors: jax.Array,  # [M, K]
@@ -729,6 +905,7 @@ def _solve_bucket_implicit(
     precision: Any = jax.lax.Precision.HIGHEST,
     cg_iters: int = _CG_ITERS,
     x0: Optional[jax.Array] = None,
+    cg_tol: float = 0.0,
 ) -> jax.Array:
     """Per-row system: (YᵗY + Yᵤᵗ(Cᵤ−I)Yᵤ + λI) x = Yᵤᵗ cᵤ with
     c = 1 + α·r and binary preference — YᵗY is shared across the whole
@@ -741,7 +918,7 @@ def _solve_bucket_implicit(
         other_factors, cols, vals, mask, jnp.float32, precision,
         implicit=True, alpha=alpha)
     return _reg_solve(gram, rhs, nnz, l2, True, implicit=True, yty=yty,
-                      cg_iters=cg_iters, x0=x0)
+                      cg_iters=cg_iters, x0=x0, cg_tol=cg_tol)
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))
@@ -760,9 +937,15 @@ def _update_side_implicit(
     alpha: float,
     precision: Any,
 ) -> jax.Array:
+    use_kernel = _kernel_enabled(True, warm=False)
     return _sweep_side_jit(
         n_rows, other_factors, _buckets_tree(buckets), None, l2, alpha,
-        True, jnp.float32, precision, implicit=True)
+        True, jnp.float32, precision, implicit=True,
+        use_kernel=use_kernel, kernel_min_d=_KERNEL_MIN_D,
+        use_fused=_fused_one(use_kernel, True, False,
+                             other_factors.shape[0],
+                             other_factors.shape[1], jnp.float32),
+        cg_tol=_cg_tol_env())
 
 
 def als_sweep_implicit(
@@ -806,11 +989,20 @@ def als_train_implicit(
     (user_light, user_heavy), (item_light, item_heavy) = build_both_sides(
         users, items, weights, n_users, n_items, max_width=max_width)
     state = als_init(jax.random.key(seed), n_users, n_items, rank)
+    # resolve the kernel/fused selectors HERE, outside the trace (the
+    # Mosaic probe compiles real kernels) — implicit is kernel-eligible
+    # only in the fused-gather generation (shared YᵗY operand)
+    warm = _CG_WARMSTART
+    use_kernel = _kernel_enabled(True, warm=warm)
     out = _als_run_fused(
         state, _buckets_tree(user_light), _buckets_tree(item_light),
         l2, alpha, iterations, True, jnp.float32, precision, implicit=True,
         user_heavy=_heavy_tree(user_heavy), item_heavy=_heavy_tree(item_heavy),
-        warmstart=_CG_WARMSTART,
+        warmstart=warm, use_kernel=use_kernel, kernel_min_d=_KERNEL_MIN_D,
+        use_fused=(_fused_sides(n_users, n_items, True, warm,
+                                jnp.float32, rank)
+                   if use_kernel else (False, False)),
+        cg_tol=_cg_tol_env(),
     )
     from incubator_predictionio_tpu.ops.retrain import _book_sweeps
 
@@ -998,6 +1190,7 @@ def _solve_heavy(
     yty: Optional[jax.Array],
     cg_iters: int = _CG_ITERS,
     prev_factors: Optional[jax.Array] = None,
+    cg_tol: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Partial-Gram combining solve for split rows → (row_ids, sol[H, K]).
 
@@ -1020,14 +1213,14 @@ def _solve_heavy(
     return row_ids, _reg_solve(
         gram, rhs, nnz, l2, reg_nnz, implicit, yty, cg_iters=cg_iters,
         cg_matvec_dtype=jnp.float32 if implicit else compute_dtype,
-        x0=x0)
+        x0=x0, cg_tol=cg_tol)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("iterations", "reg_nnz", "compute_dtype", "precision",
                      "implicit", "cg_iters", "use_kernel", "kernel_min_d",
-                     "kernel_rows", "warmstart"),
+                     "kernel_rows", "warmstart", "use_fused", "cg_tol"),
     donate_argnames=("state",),
 )
 def _als_run_fused(
@@ -1048,6 +1241,8 @@ def _als_run_fused(
     kernel_min_d: int = 0,
     kernel_rows: int = 1,
     warmstart: bool = False,
+    use_fused: Tuple[bool, bool] = (False, False),
+    cg_tol: float = 0.0,
 ) -> ALSState:
     def body(_, st):
         new_users = _sweep_side(
@@ -1055,13 +1250,15 @@ def _als_run_fused(
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
             cg_iters=cg_iters, use_kernel=use_kernel,
             kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
-            prev_factors=st.user_factors if warmstart else None)
+            prev_factors=st.user_factors if warmstart else None,
+            use_fused=use_fused[0], cg_tol=cg_tol)
         new_items = _sweep_side(
             st.item_factors.shape[0], new_users, item_tree, item_heavy,
             l2, alpha, reg_nnz, compute_dtype, precision, implicit,
             cg_iters=cg_iters, use_kernel=use_kernel,
             kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
-            prev_factors=st.item_factors if warmstart else None)
+            prev_factors=st.item_factors if warmstart else None,
+            use_fused=use_fused[1], cg_tol=cg_tol)
         return ALSState(user_factors=new_users, item_factors=new_items)
 
     return jax.lax.fori_loop(0, iterations, body, state)
@@ -1081,11 +1278,73 @@ def _rel_delta(prev: ALSState, new: ALSState) -> jax.Array:
     return jnp.sqrt(num / jnp.maximum(den, 1e-30))
 
 
+def _converge_impl(
+    state: ALSState,
+    user_tree,
+    item_tree,
+    l2: float,
+    alpha: float,
+    tol,                        # f32 operand — NOT static (no recompiles)
+    max_sweeps: int,
+    min_sweeps: int,
+    reg_nnz: bool,
+    compute_dtype: Any,
+    precision: Any,
+    implicit: bool,
+    user_heavy=None,
+    item_heavy=None,
+    cg_iters: int = _CG_ITERS,
+    use_kernel: bool = False,
+    kernel_min_d: int = 0,
+    kernel_rows: int = 1,
+    warmstart: bool = False,
+    use_fused: Tuple[bool, bool] = (False, False),
+    cg_tol: float = 0.0,
+) -> Tuple[ALSState, jax.Array, jax.Array]:
+    """Traced body of :func:`_als_run_converge` — split out so
+    ops/retrain.py can fuse the O(delta) plan splice into the SAME
+    dispatch (`_converge_spliced`: scatter the tail entries into the
+    resident trees, then run this loop, all inside one jit)."""
+    def sweep(st):
+        new_users = _sweep_side(
+            st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
+            l2, alpha, reg_nnz, compute_dtype, precision, implicit,
+            cg_iters=cg_iters, use_kernel=use_kernel,
+            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
+            prev_factors=st.user_factors if warmstart else None,
+            use_fused=use_fused[0], cg_tol=cg_tol)
+        new_items = _sweep_side(
+            st.item_factors.shape[0], new_users, item_tree, item_heavy,
+            l2, alpha, reg_nnz, compute_dtype, precision, implicit,
+            cg_iters=cg_iters, use_kernel=use_kernel,
+            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
+            prev_factors=st.item_factors if warmstart else None,
+            use_fused=use_fused[1], cg_tol=cg_tol)
+        return ALSState(user_factors=new_users, item_factors=new_items)
+
+    def cond(carry):
+        i, _st, d = carry
+        return jnp.logical_and(
+            i < max_sweeps,
+            jnp.logical_or(i < max(min_sweeps, 1), d >= tol))
+
+    def body(carry):
+        i, st, _d = carry
+        new = sweep(st)
+        return i + 1, new, _rel_delta(st, new)
+
+    i, st, d = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), state, jnp.float32(jnp.inf)))
+    return st, i, d
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("max_sweeps", "min_sweeps", "reg_nnz", "compute_dtype",
                      "precision", "implicit", "cg_iters", "use_kernel",
-                     "kernel_min_d", "kernel_rows", "warmstart"),
+                     "kernel_min_d", "kernel_rows", "warmstart", "use_fused",
+                     "cg_tol"),
     donate_argnames=("state",),
 )
 def _als_run_converge(
@@ -1108,6 +1367,8 @@ def _als_run_converge(
     kernel_min_d: int = 0,
     kernel_rows: int = 1,
     warmstart: bool = False,
+    use_fused: Tuple[bool, bool] = (False, False),
+    cg_tol: float = 0.0,
 ) -> Tuple[ALSState, jax.Array, jax.Array]:
     """Early-stopping fused run → (state, sweeps_run, last_delta).
 
@@ -1122,36 +1383,13 @@ def _als_run_converge(
     ``min_sweeps == max_sweeps`` runs exactly that many sweeps and hands
     back the last delta: the chunked-probe building block of the unfused
     path (ops/retrain.py)."""
-    def sweep(st):
-        new_users = _sweep_side(
-            st.user_factors.shape[0], st.item_factors, user_tree, user_heavy,
-            l2, alpha, reg_nnz, compute_dtype, precision, implicit,
-            cg_iters=cg_iters, use_kernel=use_kernel,
-            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
-            prev_factors=st.user_factors if warmstart else None)
-        new_items = _sweep_side(
-            st.item_factors.shape[0], new_users, item_tree, item_heavy,
-            l2, alpha, reg_nnz, compute_dtype, precision, implicit,
-            cg_iters=cg_iters, use_kernel=use_kernel,
-            kernel_min_d=kernel_min_d, kernel_rows=kernel_rows,
-            prev_factors=st.item_factors if warmstart else None)
-        return ALSState(user_factors=new_users, item_factors=new_items)
-
-    def cond(carry):
-        i, _st, d = carry
-        return jnp.logical_and(
-            i < max_sweeps,
-            jnp.logical_or(i < max(min_sweeps, 1), d >= tol))
-
-    def body(carry):
-        i, st, _d = carry
-        new = sweep(st)
-        return i + 1, new, _rel_delta(st, new)
-
-    i, st, d = jax.lax.while_loop(
-        cond, body,
-        (jnp.int32(0), state, jnp.float32(jnp.inf)))
-    return st, i, d
+    return _converge_impl(
+        state, user_tree, item_tree, l2, alpha, tol, max_sweeps,
+        min_sweeps, reg_nnz, compute_dtype, precision, implicit,
+        user_heavy=user_heavy, item_heavy=item_heavy, cg_iters=cg_iters,
+        use_kernel=use_kernel, kernel_min_d=kernel_min_d,
+        kernel_rows=kernel_rows, warmstart=warmstart, use_fused=use_fused,
+        cg_tol=cg_tol)
 
 
 def train_flops(
@@ -1234,6 +1472,7 @@ def _mixed_run(
     kernel_min_d: Optional[int] = None,
     kernel_rows: Optional[int] = None,
     warmstart: Optional[bool] = None,
+    use_fused: "Optional[Tuple[bool, bool]]" = None,
 ) -> ALSState:
     """Mixed-precision schedule: ``bf16_sweeps`` early sweeps with bf16
     gathers + single-pass MXU matmuls (DEFAULT precision), then the
@@ -1264,6 +1503,20 @@ def _mixed_run(
         kernel_min_d = _KERNEL_MIN_D
     if kernel_rows is None:
         kernel_rows = _kernel_rows_default()
+    n_u = state.user_factors.shape[0]
+    n_i = state.item_factors.shape[0]
+    rank = state.user_factors.shape[1]
+    cg_tol = _cg_tol_env()
+
+    def fused_for(dtype):
+        # per-leg: the VMEM fit depends on the gather table's dtype
+        # (a bf16 table is half the f32 footprint)
+        if use_fused is not None:
+            return use_fused
+        if not use_kernel:
+            return (False, False)
+        return _fused_sides(n_u, n_i, False, bool(warmstart), dtype, rank)
+
     if lo:
         state = _als_run_fused(
             state, u_tree, i_tree, l2, 0.0, lo, reg_nnz,
@@ -1272,6 +1525,7 @@ def _mixed_run(
             cg_iters=min(_CG_ITERS_BF16, _CG_ITERS),
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
             kernel_rows=kernel_rows, warmstart=warmstart,
+            use_fused=fused_for(jnp.bfloat16), cg_tol=cg_tol,
         )
     if iterations - lo:
         state = _als_run_fused(
@@ -1280,15 +1534,21 @@ def _mixed_run(
             user_heavy=user_heavy, item_heavy=item_heavy,
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
             kernel_rows=kernel_rows, warmstart=warmstart,
+            use_fused=fused_for(compute_dtype), cg_tol=cg_tol,
         )
     if _prof_t0 is not None:
         # PIO_PROFILE=1: attribute the device wall + analytic FLOPs of
         # this run (blocks on the final state — the profiler's
         # contract). flops_fn defers the tree mask sums until AFTER the
         # wall is captured, so their dispatches/fetches never
-        # contaminate the measured device time.
+        # contaminate the measured device time. Kernel-path runs book
+        # under their own op label (`als_fused`) so /metrics separates
+        # the fused Gram+solve trajectory from the XLA assembly —
+        # `als.train_flops` stays the ONE FLOP formula for both, so
+        # pio_mfu{phase="train"} is comparable across the op split.
         _profile.record(
-            _prof_t0, "train", "als_train", result=state,
+            _prof_t0, "train", "als_fused" if use_kernel else "als_train",
+            result=state,
             flops_fn=lambda: train_flops(
                 tree_nnz(u_tree, user_heavy),
                 state.user_factors.shape[0], state.item_factors.shape[0],

@@ -1018,10 +1018,311 @@ def als_solve_cg_pallas(
     return out[:, 0, :k]
 
 
-_als_ok: "dict[bool, bool]" = {}
+# ---------------------------------------------------------------------------
+# Kernel 4: fully fused ALS bucket solve (gather + Gram + CG in VMEM)
+# ---------------------------------------------------------------------------
+#
+# Kernel 3 removed the [rows, K, K] Gram stream but still consumes an
+# XLA-materialized [B, D, K] gather — one full HBM write + read of
+# nnz·K elements per half-sweep. When the OTHER side's factor table fits
+# VMEM (the ML-20M item table: 26.7k × 128 bf16 ≈ 6.9 MB), this kernel
+# removes that stream too: the whole table rides into VMEM once per
+# program chain, each program gathers its row's factor blocks directly
+# from the VMEM-resident table (jnp.take on the loaded block), weights
+# them, accumulates the K×K Gram and rhs in scratch, and runs every CG
+# iteration in VMEM. Per-row HBM traffic drops from dp·K (the gather
+# read) + 3·dp (cols/vals/mask) to just 3·dp + K — the interaction
+# triplets and the solution.
+#
+# One kernel covers all three production variants: explicit ALS-WR
+# (λ(·nnz) ridge), implicit Hu-Koren-Volinsky (the batch-shared YᵗY term
+# rides as one [K, K] operand added inside the matvec — never
+# materialized per row), and CG warm start (``x0``). The per-entry
+# weights are folded host/XLA-side into two [B, D] vectors so the kernel
+# body is variant-free:
+#
+#   gram_w  = mask            (explicit)   | α·r·mask        (implicit)
+#   rhs_w   = vals·mask       (explicit)   | (1 + α·r)·mask  (implicit)
+#   gram   += Σ_d gram_w_d · t_d t_dᵀ ;  rhs += Σ_d rhs_w_d · t_d
+#
+# (identical to ops/als._gram_rhs_nnz term-for-term: mask² == mask and
+# the implicit confidences already carry the mask factor).
 
 
-def als_kernel_available(warm: "bool | None" = None) -> bool:
+def _als_fused_kernel(tab_ref, cols_ref, gw_ref, rw_ref, lam_ref, yty_ref,
+                      x0_ref, o_ref, gram_ref, rhs_ref, *, iters: int,
+                      n_d_blocks: int, precise: bool, warm: bool,
+                      shared: bool):
+    """One (row, d-block) program of the fused gather+Gram+CG solve.
+
+    tab_ref:  [Mp, Kp]    the WHOLE other-side factor table (block == array
+                          → trivially Mosaic-legal; the index map is
+                          constant so the pipeline keeps it VMEM-resident
+                          across grid steps)
+    cols_ref: [1, 1, dt]  this row's interaction column ids, one d tile
+    gw_ref:   [1, 1, dt]  per-entry Gram weight (see module comment)
+    rw_ref:   [1, 1, dt]  per-entry rhs weight, f32
+    lam_ref:  [1, 1, Kp]  per-row ridge, broadcast across K
+    yty_ref:  [Kp, Kp]    batch-shared implicit term (``shared`` only)
+    x0_ref:   [1, 1, Kp]  CG warm start (``warm`` only)
+    o_ref:    [1, 1, Kp]  solution, written on the last d step
+    gram/rhs scratch persist across the d-minor grid steps."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        gram_ref[...] = jnp.zeros_like(gram_ref)
+        rhs_ref[...] = jnp.zeros_like(rhs_ref)
+
+    idx = cols_ref[0, 0]                                 # [dt] int32
+    tab = tab_ref[...]                                   # [Mp, Kp]
+    g = jnp.take(tab, idx, axis=0)                       # [dt, Kp] in VMEM
+    # weights ∈ {0,1}·stuff with the mask already folded in, so padding
+    # entries (idx 0) contribute exactly 0 to gram AND rhs
+    gw = gw_ref[0, 0].astype(g.dtype)                    # [dt]
+    rw = rw_ref[0]                                       # [1, dt] f32
+    prec = (jax.lax.Precision.HIGHEST if precise
+            else jax.lax.Precision.DEFAULT)
+    gram_ref[...] += jax.lax.dot_general(
+        g * gw[:, None], g, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec,
+    )
+    rhs_ref[...] += jax.lax.dot_general(
+        rw.astype(g.dtype), g, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec,
+    )
+
+    @pl.when(j == n_d_blocks - 1)
+    def _solve():
+        gram = gram_ref[...]                             # [Kp, Kp] f32
+        lam = lam_ref[0]                                 # [1, Kp]
+        kp = gram.shape[0]
+        row = jax.lax.broadcasted_iota(jnp.int32, (kp, kp), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (kp, kp), 1)
+        diag = jnp.sum(jnp.where(row == col, gram, 0.0), axis=0,
+                       keepdims=True) + lam              # [1, Kp]
+        if shared:
+            yty = yty_ref[...]                           # [Kp, Kp] f32
+            diag = diag + jnp.sum(jnp.where(row == col, yty, 0.0),
+                                  axis=0, keepdims=True)
+        minv = jnp.where(diag > 0, 1.0 / diag, 0.0)
+        b = rhs_ref[...]                                 # [1, Kp]
+
+        # Jacobi-PCG, numerics matching ops/als.py _cg_solve_spd: the
+        # ridge (and the shared YᵗY) stay OUT of the matrix, applied
+        # inside the matvec in f32; division guards make converged/empty
+        # systems fixed points (zero rows/rank padding stay exactly 0)
+        def matvec(p):
+            ap = jax.lax.dot_general(
+                p, gram, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ) + lam * p                                  # [1, Kp]
+            if shared:
+                ap = ap + jax.lax.dot_general(
+                    p, yty, dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+            return ap
+
+        def body(_, carry):
+            x, r, p, rz = carry
+            ap = matvec(p)
+            pap = jnp.sum(p * ap, keepdims=True)[..., :1]   # [1, 1]
+            alpha = jnp.where(pap > 0, rz / pap, 0.0)
+            x = x + alpha * p
+            r = r - alpha * ap
+            z = minv * r
+            rz2 = jnp.sum(r * z, keepdims=True)[..., :1]
+            beta = jnp.where(rz > 0, rz2 / rz, 0.0)
+            p = z + beta * p
+            return x, r, p, rz2
+
+        if warm:
+            x0 = x0_ref[0]                               # [1, Kp]
+            r0 = b - matvec(x0)
+        else:
+            x0 = jnp.zeros_like(b)
+            r0 = b
+        z0 = minv * r0
+        rz0 = jnp.sum(r0 * z0, keepdims=True)[..., :1]
+        x, _r, _p, _rz = jax.lax.fori_loop(
+            0, iters, body, (x0, r0, z0, rz0))
+        o_ref[0] = x
+
+
+def als_fused_row_elems(d: int, k: int) -> int:
+    """Per-row HBM element footprint of the fused-gather path: the
+    cols/gram-weight/rhs-weight tiles plus the lam/x0/out vectors — the
+    [B, dp, kp] gather of the two-stage path never materializes, so
+    chunk sizing (ops/als.py _solve_bucket_chunked) keys on this much
+    smaller figure."""
+    dp, kp = als_padded_dims(d, k)
+    return 3 * dp + 3 * kp
+
+
+def als_fused_table_bytes(m_rows: int, rank: int, dtype=jnp.float32) -> int:
+    """VMEM bytes of the padded gather table the fused kernel pins."""
+    kp = _round_up(max(rank, 1), _LANES)
+    mp = _round_up(max(m_rows, 8), 8)
+    return mp * kp * jnp.dtype(dtype).itemsize
+
+
+def als_fused_vmem_budget_bytes() -> int:
+    """Table budget for the fused-gather kernel (``PIO_ALS_FUSED_VMEM_MB``,
+    default 10 MB). VMEM is ~16 MB/core on current TPUs; the budget
+    covers the resident table only — the double-buffered [dt, Kp] tiles,
+    the [Kp, Kp] Gram scratch and the CG vectors ride in the remainder
+    (≲ 0.5 MB at dt=512, K=128). Read per call, never frozen at import."""
+    try:
+        mb = float(os.environ.get("PIO_ALS_FUSED_VMEM_MB", "") or 10.0)
+    except ValueError:
+        mb = 10.0
+    return int(mb * (1 << 20))
+
+
+def als_fused_fits(m_rows: int, rank: int, dtype=jnp.float32) -> bool:
+    """True when the other-side table fits the fused kernel's VMEM
+    budget. At ML-20M shape: the item table (26.7k × 128 bf16 ≈ 6.9 MB)
+    fits — the USER half-sweep (the heavy side) runs fully fused; the
+    user table (138k × 128 ≈ 35 MB bf16) does not — the item half-sweep
+    keeps the two-stage kernel. The check is pure host arithmetic on
+    static shapes, resolved OUTSIDE any trace."""
+    return als_fused_table_bytes(m_rows, rank, dtype) \
+        <= als_fused_vmem_budget_bytes()
+
+
+def als_fused_solve_cg_pallas(
+    table: jax.Array,              # [M, K] gather source (bf16 fast path)
+    cols: jax.Array,               # [B, D] int32
+    vals: jax.Array,               # [B, D] f32
+    mask: jax.Array,               # [B, D] f32 in {0, 1}
+    l2,
+    reg_nnz: bool = True,
+    iters: int = 16,
+    implicit: bool = False,
+    alpha: float = 1.0,
+    yty: Optional[jax.Array] = None,   # [K, K] f32 — implicit only
+    x0: Optional[jax.Array] = None,    # [B, K] f32 CG warm start
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused gather+normal-equation solve for one bucket chunk → [B, K].
+
+    Same contract as the explicit-CG leg of ops/als.py ``_solve_bucket``
+    (and, with ``implicit=True`` + ``yty``, as ``_solve_bucket_implicit``
+    at the caller's doubled budget): λ·max(nnz,1) / λ ridge, empty rows
+    solve to exactly 0. Unlike :func:`als_solve_cg_pallas`, the gather
+    happens INSIDE the kernel against the VMEM-resident table — callers
+    must gate on :func:`als_fused_fits` for the table's shape/dtype.
+    Padding (D → lane multiple, K → 128 multiple, padding cols id 0 with
+    zero weights) is exact: padded coordinates stay fixed at 0.
+
+    The in-kernel gather is a ``jnp.take`` on the loaded table block —
+    exact in interpret mode; on real Mosaic backends the per-variant
+    probe (:func:`als_kernel_available` ``fused=True``) decides whether
+    this lowering compiles before production selects it."""
+    if interpret is None:
+        interpret = not pallas_available()
+    B, d = cols.shape
+    m, k = table.shape
+    dp, kp = als_padded_dims(d, k)
+    mp = _round_up(max(m, 8), 8)
+    # dt must DIVIDE dp (dp is always a 128 multiple, so 128 divides)
+    dt = next(t for t in (512, 256, 128) if dp % t == 0)
+    n_d = dp // dt
+
+    tab = table
+    if (mp, kp) != tab.shape:
+        tab = jnp.zeros((mp, kp), table.dtype).at[:m, :k].set(tab)
+    maskf = mask.astype(jnp.float32)
+    if implicit:
+        gw = alpha * vals * maskf          # (c − 1), 0 on padding
+        rw = maskf + gw                    # (1 + α·r)·mask
+    else:
+        gw = maskf
+        rw = vals * maskf
+    colsp = jnp.pad(cols, ((0, 0), (0, dp - d)))[:, None, :]
+    gw = jnp.pad(gw, ((0, 0), (0, dp - d)))[:, None, :]
+    rw = jnp.pad(rw, ((0, 0), (0, dp - d)))[:, None, :]
+    nnz = jnp.sum(maskf, axis=-1)
+    if implicit:
+        lam = jnp.full_like(nnz, l2)
+    else:
+        lam = l2 * (jnp.maximum(nnz, 1.0) if reg_nnz
+                    else jnp.ones_like(nnz))
+    lam_b = jnp.broadcast_to(lam[:, None, None], (B, 1, kp))
+    shared = implicit
+    warm = x0 is not None
+
+    ops = [tab, colsp, gw, rw, lam_b]
+    in_specs = [
+        pl.BlockSpec((mp, kp), lambda i, j: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, dt), lambda i, j: (i, 0, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, dt), lambda i, j: (i, 0, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, dt), lambda i, j: (i, 0, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    if shared:
+        ytyp = yty.astype(jnp.float32)
+        if (kp, kp) != ytyp.shape:
+            ytyp = jnp.zeros((kp, kp), jnp.float32).at[:k, :k].set(ytyp)
+        ops.append(ytyp)
+        in_specs.append(pl.BlockSpec((kp, kp), lambda i, j: (0, 0),
+                                     memory_space=pltpu.VMEM))
+    if warm:
+        ops.append(jnp.pad(x0.astype(jnp.float32),
+                           ((0, 0), (0, kp - k)))[:, None, :])
+        in_specs.append(pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
+                                     memory_space=pltpu.VMEM))
+    body = functools.partial(_als_fused_kernel, iters=int(iters),
+                             n_d_blocks=n_d,
+                             precise=table.dtype == jnp.float32,
+                             warm=warm, shared=shared)
+    # positional ref alignment: absent optional operands must not let a
+    # later ref slot swallow o_ref (same pattern as als_solve_cg_pallas)
+    if shared and warm:
+        kfn = body
+    elif shared:
+        def kfn(t, c, g, r, l, y, o, gr, rh):
+            return body(t, c, g, r, l, y, None, o, gr, rh)
+    elif warm:
+        def kfn(t, c, g, r, l, x, o, gr, rh):
+            return body(t, c, g, r, l, None, x, o, gr, rh)
+    else:
+        def kfn(t, c, g, r, l, o, gr, rh):
+            return body(t, c, g, r, l, None, None, o, gr, rh)
+    out = pl.pallas_call(
+        kfn,
+        # d is the MINOR grid dim: programs revisiting one row's output
+        # run consecutively, carrying gram/rhs in scratch
+        grid=(B, n_d),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, kp), lambda i, j: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, 1, kp), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((kp, kp), jnp.float32),   # gram accumulator
+            pltpu.VMEM((1, kp), jnp.float32),    # rhs accumulator
+        ],
+        interpret=interpret,
+    )(*ops)
+    # empty rows solve to EXACTLY 0 (the _reg_solve where-guard): the
+    # cold kernel holds that fixed point by construction, but a warm
+    # start on a zero-nnz row would leave a converging-to-zero residue
+    return jnp.where(nnz[:, None] > 0, out[:, 0, :k], 0.0)
+
+
+_als_ok: "dict[tuple, bool]" = {}
+
+
+def als_kernel_available(warm: "bool | None" = None, fused: bool = False,
+                         implicit: bool = False) -> bool:
     """The ALS bucket-solve family: probe the real kernel at a shape that
     exercises rank padding (rank 64 → 128), a row count that is not a
     sublane multiple, and multi-tile D streaming.
@@ -1031,26 +1332,51 @@ def als_kernel_available(warm: "bool | None" = None) -> bool:
     DIFFERENT kernel (extra input spec + the initial-residual matvec),
     so a cold-only probe would green-light a warm kernel that was never
     compiled on the real Mosaic backend — the interpret-passes/
-    hardware-fails class ROUND5.md documents. ``warm`` is therefore the
-    caller's resolved warm-start setting (als._mixed_run passes its
-    per-call override; None falls back to the PIO_ALS_CG_WARMSTART
-    process default), and results cache per variant."""
+    hardware-fails class ROUND5.md documents. The same rule covers the
+    fused-gather generation: ``fused=True`` probes
+    :func:`als_fused_solve_cg_pallas` (in-kernel ``jnp.take`` gather —
+    a lowering the two-stage kernel never exercises) and
+    ``implicit=True`` its shared-YᵗY variant (an extra operand + matvec
+    term). ``warm`` is the caller's resolved warm-start setting
+    (als._mixed_run passes its per-call override; None falls back to
+    the PIO_ALS_CG_WARMSTART process default), and results cache per
+    (warm, fused, implicit) variant."""
     if warm is None:
         from incubator_predictionio_tpu.ops.als import _CG_WARMSTART
 
         warm = _CG_WARMSTART
-    warm = bool(warm)
-    if warm not in _als_ok:
+    key = (bool(warm), bool(fused), bool(implicit))
+    if key not in _als_ok:
         if not pallas_available():
-            _als_ok[warm] = False
+            _als_ok[key] = False
         else:
-            x0 = jnp.zeros((12, 64), jnp.float32) if warm else None
-            _als_ok[warm] = _probe_kernel_runs(
-                lambda: als_solve_cg_pallas(
-                    jnp.zeros((64, 64), jnp.bfloat16),
-                    jnp.zeros((12, 1024), jnp.int32),
-                    jnp.ones((12, 1024), jnp.float32),
-                    jnp.ones((12, 1024), jnp.float32),
-                    0.1, True, 6, interpret=False, x0=x0),
-                f"ALS bucket CG solve ({'warm' if warm else 'cold'})")
-    return _als_ok[warm]
+            warm_b, fused_b, implicit_b = key
+            x0 = jnp.zeros((12, 64), jnp.float32) if warm_b else None
+            if fused_b:
+                table = jnp.zeros(
+                    (60, 64),
+                    jnp.float32 if implicit_b else jnp.bfloat16)
+                yty = (jnp.zeros((64, 64), jnp.float32)
+                       if implicit_b else None)
+                what = ("ALS fused gather+Gram CG solve ("
+                        + ("warm" if warm_b else "cold")
+                        + (", implicit" if implicit_b else "") + ")")
+                _als_ok[key] = _probe_kernel_runs(
+                    lambda: als_fused_solve_cg_pallas(
+                        table,
+                        jnp.zeros((12, 1024), jnp.int32),
+                        jnp.ones((12, 1024), jnp.float32),
+                        jnp.ones((12, 1024), jnp.float32),
+                        0.1, True, 6, implicit=implicit_b, alpha=1.0,
+                        yty=yty, x0=x0, interpret=False),
+                    what)
+            else:
+                _als_ok[key] = _probe_kernel_runs(
+                    lambda: als_solve_cg_pallas(
+                        jnp.zeros((64, 64), jnp.bfloat16),
+                        jnp.zeros((12, 1024), jnp.int32),
+                        jnp.ones((12, 1024), jnp.float32),
+                        jnp.ones((12, 1024), jnp.float32),
+                        0.1, True, 6, interpret=False, x0=x0),
+                    f"ALS bucket CG solve ({'warm' if warm_b else 'cold'})")
+    return _als_ok[key]

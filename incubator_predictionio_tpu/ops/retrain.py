@@ -38,6 +38,7 @@ a row outgrowing ``max_width``) falls back to the fresh
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import logging
 import os
@@ -133,6 +134,20 @@ def _set_entries(arr: jax.Array, pos: jax.Array, slot: jax.Array,
     return arr.at[pos, slot].set(val)
 
 
+def _pad_pow2(arr: np.ndarray, fill) -> np.ndarray:
+    """Pad a 1-D splice vector to the next power of two (min 8) so the
+    spliced-converge jit sees a BOUNDED set of shapes across retrains
+    (log2 many per bucket, not one per delta size). ``fill`` for index
+    vectors is an out-of-range sentinel that ``mode="drop"`` scatters
+    ignore."""
+    n = len(arr)
+    target = max(8, 1 << max(n - 1, 0).bit_length())
+    if target == n:
+        return arr
+    return np.concatenate(
+        [arr, np.full(target - n, fill, arr.dtype)])
+
+
 @jax.jit
 def _clear_rows(cols, vals, mask, row_ids, pos):
     """Detach rows that moved to another width class: padding semantics
@@ -160,6 +175,11 @@ class _SidePlan:
     #: fresh plan, bounding creep across long retrain sequences
     dead_rows: int = 0
     init_buckets: int = 0
+    #: deferred device-splice specs from the last ``apply_tail(defer=
+    #: True)``: per-bucket ``None | (clear_pos, (pos, slot, cols, vals))``
+    #: with pow2-padded device arrays (puts already issued — the
+    #: double-buffered H2D), consumed by retrain's spliced converge
+    pending: Optional[List[Any]] = None
 
     @staticmethod
     def build(buckets: List[PaddedRows], degrees: np.ndarray,
@@ -192,7 +212,7 @@ class _SidePlan:
     def apply_tail(self, tail_rows, tail_cols, tail_vals,
                    full_rows, full_cols, full_vals,
                    n_rows: int, max_width: int, row_multiple: int,
-                   stats: Dict[str, Any]) -> bool:
+                   stats: Dict[str, Any], defer: bool = False) -> bool:
         """Splice a tail into the resident plan; False → caller rebuilds.
 
         Rows touched by the tail whose width class is unchanged keep
@@ -200,8 +220,18 @@ class _SidePlan:
         (host fancy-index write + device pointwise scatter). Rows that
         moved class (including newly-appeared rows) are cleared from
         their old bucket and rebuilt from the full COO into appended
-        delta buckets. Untouched buckets are not touched at all."""
+        delta buckets. Untouched buckets are not touched at all.
+
+        ``defer=True`` (the one-dispatch retrain path): the host mirror
+        updates eagerly as always, but instead of dispatching per-bucket
+        device scatters the splice vectors are pow2-padded, their H2D
+        puts issued IMMEDIATELY (async — the transfers overlap whatever
+        host work follows, and are long done when the training dispatch
+        consumes them: the double-buffered device-put contract), and the
+        specs parked in ``self.pending`` for retrain's `_converge_
+        spliced` to scatter inside the SAME dispatch as the sweeps."""
         self._grow_to(n_rows)
+        pending: List[Any] = [None] * len(self.buckets) if defer else None
         tail_deg = np.bincount(tail_rows, minlength=n_rows).astype(np.int64)
         new_deg = self.degrees + tail_deg
         if len(tail_rows) and int(new_deg.max()) > max_width:
@@ -241,6 +271,18 @@ class _SidePlan:
                 b.cols[p, s] = cs[m]
                 b.vals[p, s] = vs[m]
                 b.mask[p, s] = 1.0
+                if defer:
+                    # sentinel = one past the bucket's row count — the
+                    # in-dispatch mode="drop" scatter ignores padding
+                    sentinel = np.int32(b.row_ids.shape[0])
+                    pending[bi] = (
+                        None,
+                        tuple(jax.device_put(a) for a in (
+                            _pad_pow2(p.astype(np.int32), sentinel),
+                            _pad_pow2(s.astype(np.int32), 0),
+                            _pad_pow2(cs[m].astype(np.int32), 0),
+                            _pad_pow2(vs[m].astype(np.float32), 0.0))))
+                    continue
                 rids, dcols, dvals, dmask = self.trees[bi]
                 jp, js = jnp.asarray(p), jnp.asarray(s)
                 self.trees[bi] = (
@@ -266,6 +308,14 @@ class _SidePlan:
                 b.cols[p, :] = 0
                 b.vals[p, :] = 0.0
                 b.mask[p, :] = 0.0
+                if defer:
+                    sentinel = np.int32(b.row_ids.shape[0])
+                    prev = pending[bi]
+                    pending[bi] = (
+                        jax.device_put(
+                            _pad_pow2(p.astype(np.int32), sentinel)),
+                        prev[1] if prev is not None else None)
+                    continue
                 rids, dcols, dvals, dmask = self.trees[bi]
                 jp = jnp.asarray(p)
                 dcols, dvals, dmask, rids = _clear_rows(
@@ -286,6 +336,8 @@ class _SidePlan:
                 bi = len(self.buckets)
                 self.buckets.append(b)
                 self.trees.append(als._buckets_tree([b])[0])
+                if defer:
+                    pending.append(None)  # fresh upload, nothing to splice
                 ids = np.asarray(b.row_ids)
                 live = np.flatnonzero(ids >= 0)
                 self.row_bucket[ids[live]] = bi
@@ -294,6 +346,8 @@ class _SidePlan:
                 "prep_rebuilt_rows", 0) + int(len(moved))
 
         self.degrees = new_deg
+        if defer:
+            self.pending = pending
         return True
 
 
@@ -343,6 +397,7 @@ def prepare_with_reuse(
     user_degrees: Optional[np.ndarray] = None,
     item_degrees: Optional[np.ndarray] = None,
     stats: Optional[Dict[str, Any]] = None,
+    defer_splice: bool = False,
 ):
     """Degree-bucketed padded trees, reusing a resident plan when only a
     tail was appended → (u_tree, i_tree, u_heavy, i_heavy).
@@ -350,7 +405,15 @@ def prepare_with_reuse(
     ``plan_key`` names the training stream (e.g. the event-log path);
     None disables reuse entirely (byte-identical to the fresh path).
     ``verify_prefix=False`` skips the O(prefix) digest check for callers
-    that already hold the append-only guarantee (the traincache fold)."""
+    that already hold the append-only guarantee (the traincache fold).
+
+    ``defer_splice=True`` (the one-dispatch retrain path): when the plan
+    is reused, the returned trees are the PRE-splice residents and the
+    splice vectors land in ``stats["pending_splices"]`` (their H2D puts
+    already in flight) for :func:`als_retrain` to scatter inside the
+    training dispatch itself; the caller MUST apply them (and write the
+    updated trees back via ``commit_spliced_trees``) or drop the plan —
+    the host mirror is already updated either way."""
     stats = {} if stats is None else stats
     users = np.asarray(users)
     items = np.asarray(items)
@@ -369,16 +432,23 @@ def prepare_with_reuse(
             tr, tc, tv = users[plan.nnz:], items[plan.nnz:], vals[plan.nnz:]
             u_ok = plan.user.apply_tail(
                 tr, tc, tv, users, items, vals, n_users, max_width,
-                row_multiple, stats)
+                row_multiple, stats, defer=defer_splice)
             i_ok = u_ok and plan.item.apply_tail(
                 tc, tr, tv, items, users, vals, n_items, max_width,
-                row_multiple, stats)
+                row_multiple, stats, defer=defer_splice)
             if u_ok and i_ok:
                 plan.nnz = nnz
                 plan.n_users, plan.n_items = n_users, n_items
                 plan.digest = _coo_digest(users, items, vals, nnz)
                 stats["prep_plan"] = "reused"
                 stats["prep_delta_rows"] = int(len(tr))
+                if defer_splice:
+                    u_pend = plan.user.pending or []
+                    i_pend = plan.item.pending or []
+                    plan.user.pending = plan.item.pending = None
+                    if any(s is not None for s in (*u_pend, *i_pend)):
+                        stats["pending_splices"] = (
+                            tuple(u_pend), tuple(i_pend))
                 u_tree, i_tree = plan.trees()
                 return u_tree, i_tree, None, None
             # a side bailed mid-splice: the plan's host/device state may
@@ -425,29 +495,132 @@ def prepare_with_reuse(
             als._heavy_tree(u_heavy), als._heavy_tree(i_heavy))
 
 
+def commit_spliced_trees(plan_key: str, u_tree, i_tree) -> None:
+    """Adopt the in-dispatch-spliced device trees as the plan's new
+    residents (the deferred-splice counterpart of apply_tail's eager
+    device scatters). The host mirror was already updated eagerly."""
+    plan = _PLAN_CACHE.get(plan_key)
+    if plan is not None:
+        plan.user.trees = list(u_tree)
+        plan.item.trees = list(i_tree)
+
+
 # ---------------------------------------------------------------------------
 # early-stopping training drivers
 # ---------------------------------------------------------------------------
 
+def _splice_tree(tree, splices):
+    """Scatter deferred splice specs into a bucket tree — TRACED (the
+    body of the one-dispatch retrain). Per bucket: ``None`` (untouched)
+    or ``(clear_pos, (pos, slot, cols, vals))``. All index vectors are
+    pow2-padded with out-of-range sentinels; ``mode="drop"`` makes the
+    padding a no-op, exactly like the -1 row-id scatter in ops/als.
+    Produces trees bitwise-identical to apply_tail's eager
+    ``_set_entries``/``_clear_rows`` scatters (pinned by
+    tests/test_fused_gram.py)."""
+    out = []
+    for (rids, cols, vals, mask), sp in zip(tree, splices):
+        if sp is not None:
+            clear_pos, sets = sp
+            if clear_pos is not None:
+                cols = cols.at[clear_pos].set(0, mode="drop")
+                vals = vals.at[clear_pos].set(0.0, mode="drop")
+                mask = mask.at[clear_pos].set(0.0, mode="drop")
+                rids = rids.at[clear_pos].set(-1, mode="drop")
+            if sets is not None:
+                pos, slot, c, v = sets
+                cols = cols.at[pos, slot].set(c, mode="drop")
+                vals = vals.at[pos, slot].set(v, mode="drop")
+                mask = mask.at[pos, slot].set(1.0, mode="drop")
+        out.append((rids, cols, vals, mask))
+    return tuple(out)
+
+
+@jax.jit
+def _apply_splices(tree, splices):
+    """Standalone splice application (one dispatch per side) — the
+    unfused-probe path's fallback when the spliced converge cannot
+    carry it."""
+    return _splice_tree(tree, splices)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_sweeps", "min_sweeps", "reg_nnz", "compute_dtype",
+                     "precision", "implicit", "cg_iters", "use_kernel",
+                     "kernel_min_d", "kernel_rows", "warmstart", "use_fused",
+                     "cg_tol"),
+    donate_argnames=("state",),
+)
+def _converge_spliced(
+    state, u_tree, i_tree, u_splice, i_splice, l2, alpha, tol,
+    max_sweeps, min_sweeps, reg_nnz, compute_dtype, precision, implicit,
+    u_hv, i_hv, cg_iters, use_kernel, kernel_min_d, kernel_rows,
+    warmstart, use_fused, cg_tol,
+):
+    """THE one-dispatch continuation retrain: splice the O(delta) tail
+    into the resident trees, run every sweep, and evaluate the
+    early-stop plateau — all inside a single jit, so a steady-state
+    retrain costs exactly one device dispatch end to end (splice
+    scatters included; the H2D puts were issued back in apply_tail and
+    have long overlapped the host work since). Returns the spliced
+    trees so the caller re-adopts them as the plan's residents."""
+    u_tree = _splice_tree(u_tree, u_splice)
+    i_tree = _splice_tree(i_tree, i_splice)
+    st, n, d = als._converge_impl(
+        state, u_tree, i_tree, l2, alpha, tol, max_sweeps, min_sweeps,
+        reg_nnz, compute_dtype, precision, implicit,
+        user_heavy=u_hv, item_heavy=i_hv, cg_iters=cg_iters,
+        use_kernel=use_kernel, kernel_min_d=kernel_min_d,
+        kernel_rows=kernel_rows, warmstart=warmstart, use_fused=use_fused,
+        cg_tol=cg_tol)
+    return st, n, d, u_tree, i_tree
+
+
 def _converge_leg(state, u_tree, i_tree, l2, alpha, tol, budget, floor,
                   reg_nnz, compute_dtype, precision, implicit,
                   u_hv, i_hv, cg_iters, use_kernel, kernel_min_d,
-                  kernel_rows, warmstart):
-    """One precision leg with early stop → (state, sweeps, delta).
+                  kernel_rows, warmstart, use_fused=(False, False),
+                  cg_tol=0.0, splices=None, counter=None):
+    """One precision leg with early stop → (state, sweeps, delta,
+    u_tree, i_tree).
 
-    Fused mode: the whole leg is one dispatch (`_als_run_converge`);
+    Fused mode: the whole leg is one dispatch (`_als_run_converge`, or
+    `_converge_spliced` when a deferred plan splice rides along);
     sweeps/delta are fetched once after it. Unfused mode: fused chunks
     of PIO_RETRAIN_PROBE_EVERY sweeps, each returning its in-trace
     last-sweep delta — the host fetches ONE scalar per chunk (the
-    chunked probe), never one per sweep."""
+    chunked probe), never one per sweep. ``counter`` (a ``{"n": int}``
+    dict) books every device dispatch this leg issues — the
+    one-dispatch contract's measured pin."""
+    def count(k=1):
+        if counter is not None:
+            counter["n"] += k
+
     if _fused_early_stop():
+        if splices is not None:
+            state, n, d, u_tree, i_tree = _converge_spliced(
+                state, u_tree, i_tree, splices[0], splices[1], l2, alpha,
+                tol, budget, floor, reg_nnz, compute_dtype, precision,
+                implicit, u_hv, i_hv, cg_iters, use_kernel, kernel_min_d,
+                kernel_rows, warmstart, use_fused, cg_tol)
+            count()
+            return state, int(n), float(d), u_tree, i_tree
         state, n, d = als._als_run_converge(
             state, u_tree, i_tree, l2, alpha, tol, budget, floor,
             reg_nnz, compute_dtype, precision, implicit,
             user_heavy=u_hv, item_heavy=i_hv, cg_iters=cg_iters,
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
-            kernel_rows=kernel_rows, warmstart=warmstart)
-        return state, int(n), float(d)
+            kernel_rows=kernel_rows, warmstart=warmstart,
+            use_fused=use_fused, cg_tol=cg_tol)
+        count()
+        return state, int(n), float(d), u_tree, i_tree
+    if splices is not None:
+        # the chunked probe re-enters the jit per chunk — apply the
+        # splice once, up front (one extra dispatch per side)
+        u_tree = _apply_splices(u_tree, splices[0])
+        i_tree = _apply_splices(i_tree, splices[1])
+        count(2)
     probe = retrain_probe_every()
     done, d = 0, float("inf")
     while done < budget:
@@ -457,12 +630,14 @@ def _converge_leg(state, u_tree, i_tree, l2, alpha, tol, budget, floor,
             reg_nnz, compute_dtype, precision, implicit,
             user_heavy=u_hv, item_heavy=i_hv, cg_iters=cg_iters,
             use_kernel=use_kernel, kernel_min_d=kernel_min_d,
-            kernel_rows=kernel_rows, warmstart=warmstart)
+            kernel_rows=kernel_rows, warmstart=warmstart,
+            use_fused=use_fused, cg_tol=cg_tol)
+        count()
         done += chunk
         d = float(dd)  # ONE host sync per chunk — the probe boundary
         if done >= floor and tol > 0 and d < tol:
             break
-    return state, done, d
+    return state, done, d, u_tree, i_tree
 
 
 def als_retrain(
@@ -496,7 +671,10 @@ def als_retrain(
     entry point exists so they don't have to change).
 
     ``stats`` (a dict) receives ``sweeps_used``, ``mode``
-    ("fresh"|"continue"), ``final_delta`` and the prep-reuse counters."""
+    ("fresh"|"continue"), ``final_delta``, the prep-reuse counters, and
+    the one-dispatch pins ``train_dispatches``/``one_dispatch`` (every
+    device dispatch the train phase issued — splice included; steady
+    state is exactly 1)."""
     import time
 
     stats = {} if stats is None else stats
@@ -506,8 +684,10 @@ def als_retrain(
     t_prep = time.perf_counter()
     u_tree, i_tree, u_hv, i_hv = prepare_with_reuse(
         users, items, vals, n_users, n_items, max_width=max_width,
-        plan_key=plan_key, verify_prefix=verify_prefix, stats=stats)
+        plan_key=plan_key, verify_prefix=verify_prefix, stats=stats,
+        defer_splice=True)
     stats["prep_wall_s"] = time.perf_counter() - t_prep
+    splices = stats.pop("pending_splices", None)
 
     state = None
     if prev_state is not None:
@@ -527,35 +707,75 @@ def als_retrain(
     use_kernel = als._kernel_enabled(implicit, warm=warmstart)
     kernel_min_d = als._KERNEL_MIN_D
     kernel_rows = als._kernel_rows_default()
+    cg_tol = als._cg_tol_env()
+
+    def fused_for(dtype):
+        if not use_kernel:
+            return (False, False)
+        return als._fused_sides(n_users, n_items, implicit, warmstart,
+                                dtype, rank)
+
     lo = 0 if implicit else min(max(bf16_sweeps, 0), iterations)
     sweeps = 0
     delta = float("inf")
     bf16_used = 0
-    if lo:
-        state, n, delta = _converge_leg(
-            state, u_tree, i_tree, l2, 0.0, tol, lo, min(floor, lo),
-            reg_nnz, jnp.bfloat16, jax.lax.Precision.DEFAULT, False,
-            u_hv, i_hv, min(als._CG_ITERS_BF16, als._CG_ITERS),
-            use_kernel, kernel_min_d, kernel_rows, warmstart)
-        sweeps += n
-        bf16_used = n
-    if iterations - lo > 0:
-        state, n, delta = _converge_leg(
-            state, u_tree, i_tree, l2, alpha, tol, iterations - lo,
-            max(floor - sweeps, 1), reg_nnz, compute_dtype, precision,
-            implicit, u_hv, i_hv, als._CG_ITERS, use_kernel,
-            kernel_min_d, kernel_rows, warmstart)
-        sweeps += n
+    counter = {"n": 0}
+    spliced = splices is not None
+    try:
+        if lo:
+            state, n, delta, u_tree, i_tree = _converge_leg(
+                state, u_tree, i_tree, l2, 0.0, tol, lo, min(floor, lo),
+                reg_nnz, jnp.bfloat16, jax.lax.Precision.DEFAULT, False,
+                u_hv, i_hv, min(als._CG_ITERS_BF16, als._CG_ITERS),
+                use_kernel, kernel_min_d, kernel_rows, warmstart,
+                use_fused=fused_for(jnp.bfloat16), cg_tol=cg_tol,
+                splices=splices, counter=counter)
+            splices = None
+            sweeps += n
+            bf16_used = n
+        if iterations - lo > 0:
+            state, n, delta, u_tree, i_tree = _converge_leg(
+                state, u_tree, i_tree, l2, alpha, tol, iterations - lo,
+                max(floor - sweeps, 1), reg_nnz, compute_dtype, precision,
+                implicit, u_hv, i_hv, als._CG_ITERS, use_kernel,
+                kernel_min_d, kernel_rows, warmstart,
+                use_fused=fused_for(compute_dtype), cg_tol=cg_tol,
+                splices=splices, counter=counter)
+            splices = None
+            sweeps += n
+        if splices is not None:
+            # no training leg consumed the deferred splice (a
+            # zero-iteration call) — apply it now, or the commit below
+            # would adopt PRE-splice trees while the plan's digest
+            # already covers the tail, silently dropping the tail's
+            # interactions from every future reuse
+            u_tree = _apply_splices(u_tree, splices[0])
+            i_tree = _apply_splices(i_tree, splices[1])
+            counter["n"] += 2
+            splices = None
+        if spliced and plan_key:
+            # the splice ran inside the training dispatch — adopt its
+            # output trees as the plan's residents for the next retrain
+            commit_spliced_trees(plan_key, u_tree, i_tree)
+    except BaseException:
+        # a failure between the deferred host-mirror update and the
+        # device-tree adoption leaves the plan split-brained — drop it
+        # (the next retrain rebuilds fresh; reuse is an optimization)
+        if plan_key:
+            _PLAN_CACHE.pop(plan_key, None)
+        raise
     if _prof_t0 is not None and sweeps:
         # PIO_PROFILE=1: device-time/MFU attribution over the sweeps
         # actually run (the early stop makes the count data-dependent;
         # nnz is in hand here — no device mask sums needed)
         _profile.record(
-            _prof_t0, "train", "als_retrain",
+            _prof_t0, "train", "als_fused" if use_kernel else "als_retrain",
             als.train_flops(len(vals), n_users, n_items, rank, sweeps,
                             bf16_used, warmstart=warmstart),
             state)
-    stats.update(sweeps_used=sweeps, mode=mode, final_delta=delta)
+    stats.update(sweeps_used=sweeps, mode=mode, final_delta=delta,
+                 train_dispatches=counter["n"],
+                 one_dispatch=counter["n"] == 1)
     _book_sweeps(mode, sweeps)
     return state
 

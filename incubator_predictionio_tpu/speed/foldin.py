@@ -91,12 +91,45 @@ def _solve_rows(
                            yty=yty, cg_iters=cg_iters)
 
 
+@functools.partial(jax.jit, static_argnames=("reg_nnz", "implicit",
+                                             "cg_iters"))
+def _solve_rows_kernel(
+    other_factors: jax.Array,   # [M, K] f32 — frozen other-side table
+    yty: Optional[jax.Array],   # [K, K] shared Gram (implicit) or None
+    cols: jax.Array,
+    vals: jax.Array,
+    mask: jax.Array,
+    l2: jax.Array,
+    alpha: jax.Array,
+    reg_nnz: bool,
+    implicit: bool,
+    cg_iters: int,
+) -> jax.Array:
+    """Kernel-path twin of :func:`_solve_rows`: one ladder bucket through
+    the fused gather+Gram+CG Pallas kernel (ops/pallas_kernels
+    ``als_fused_solve_cg_pallas``) — the SAME kernel the training sweeps
+    dispatch, so fold-in and training share one fused code path end to
+    end. Implicit rides the precomputed YᵗY and the training path's
+    doubled CG budget. Same jit-cache discipline: one compiled variant
+    per ladder bucket, counted by :func:`foldin_compile_cache_size`."""
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_fused_solve_cg_pallas,
+    )
+
+    return als_fused_solve_cg_pallas(
+        other_factors, cols, vals, mask, l2, reg_nnz=reg_nnz,
+        iters=cg_iters * (2 if implicit else 1), implicit=implicit,
+        alpha=alpha, yty=yty)
+
+
 def foldin_compile_cache_size() -> int:
     """Number of compiled fold-in variants in this process — the
     no-per-query-recompilation contract's counter. Bounded by the bucket
     ladder (widths × batch sizes × param-flag combinations actually
-    used); tests assert it stops growing once the ladder is warm."""
-    return int(_solve_rows._cache_size())
+    used, across BOTH the XLA and the fused-kernel solve paths); tests
+    assert it stops growing once the ladder is warm."""
+    return int(_solve_rows._cache_size()) \
+        + int(_solve_rows_kernel._cache_size())
 
 
 class FoldInSolver:
@@ -117,6 +150,7 @@ class FoldInSolver:
         implicit: bool = False,
         alpha: float = 1.0,
         cg_iters: Optional[int] = None,
+        use_kernel: Optional[bool] = None,
     ) -> None:
         self.other_factors = jnp.asarray(other_factors, jnp.float32)
         self.rank = int(self.other_factors.shape[1])
@@ -126,6 +160,22 @@ class FoldInSolver:
         self.alpha = float(alpha)
         self.cg_iters = int(cg_iters if cg_iters is not None
                             else _als._CG_ITERS)
+        # fused-kernel routing, resolved ONCE per deploy (the Mosaic
+        # probe compiles a real kernel — never per fold-in): the ladder
+        # buckets dispatch the SAME fused gather+Gram+CG kernel training
+        # uses, when the frozen table fits its VMEM budget. None = auto
+        # (PIO_ALS_FUSED_GRAM + per-variant probe); tests force True,
+        # which serves via interpret on Mosaic-less backends.
+        from incubator_predictionio_tpu.ops.pallas_kernels import (
+            als_fused_fits,
+        )
+
+        fits = als_fused_fits(self.other_factors.shape[0], self.rank,
+                              jnp.float32)
+        if use_kernel is None:
+            use_kernel = fits and _als._fused_enabled(self.implicit,
+                                                      warm=False)
+        self.use_kernel = bool(use_kernel) and fits
         # the batch-shared YᵗY of implicit ALS: computed ONCE per deploy
         # (it only depends on the frozen table), not once per fold-in
         self._yty = (_als._gram_all(self.other_factors,
@@ -180,7 +230,9 @@ class FoldInSolver:
                     vals[r, :len(v)] = v
                     mask[r, :len(c)] = 1.0
                 _pt0 = _profile.t0()
-                sol = np.asarray(_solve_rows(
+                solve_fn = (_solve_rows_kernel if self.use_kernel
+                            else _solve_rows)
+                sol = np.asarray(solve_fn(
                     self.other_factors, self._yty,
                     jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
                     jnp.float32(self.l2), jnp.float32(self.alpha),

@@ -90,10 +90,19 @@ def main() -> int:
     # probe that exact variant (warm adds the x0 operand — a different
     # kernel)
     kernel_ok = als._kernel_enabled(False, warm=als._CG_WARMSTART)
-    # each leg: (use_kernel, min-D routing cut, rows per program).
-    # PIO_TUNE_MIN_DS × PIO_TUNE_ROWS sweep both knobs so one chip window
-    # yields the whole layout picture
-    legs = [(False, 0, 1)]
+    # the fused gather+Gram+CG generation probes its own variant, and
+    # only the VMEM-fitting side routes through it (als._fused_sides:
+    # at ML-20M shape the user half-sweep, whose gather table is the
+    # small item side)
+    fused_sides = (als._fused_sides(n_users, n_items, False,
+                                    als._CG_WARMSTART, jnp.bfloat16,
+                                    rank)
+                   if kernel_ok else (False, False))
+    # each leg: (use_kernel, min-D routing cut, rows per program,
+    # use_fused). PIO_TUNE_MIN_DS × PIO_TUNE_ROWS sweep both knobs so
+    # one chip window yields the whole layout picture; the fused-gather
+    # leg rides along when its probe passes and a side fits the budget
+    legs = [(False, 0, 1, (False, False))]
     if kernel_ok:
         min_ds = [int(v) for v in os.environ.get(
             "PIO_TUNE_MIN_DS", "0,64").split(",") if v.strip()]
@@ -104,13 +113,22 @@ def main() -> int:
                               "skipped": "PIO_TUNE_MIN_DS or "
                                          "PIO_TUNE_ROWS is empty"}),
                   flush=True)
-        legs += [(True, d, r) for r in rows_l for d in min_ds]
+        legs += [(True, d, r, (False, False))
+                 for r in rows_l for d in min_ds]
+        if any(fused_sides):
+            legs += [(True, d, 1, fused_sides) for d in min_ds]
+        else:
+            print(json.dumps({"fused": True,
+                              "skipped": "fused-gather probe failed or "
+                                         "no side fits "
+                                         "PIO_ALS_FUSED_VMEM_MB"}),
+                  flush=True)
     else:
         print(json.dumps({"kernel": True,
                           "skipped": "als_kernel_available() is False on "
                                      "this backend (or PIO_ALS_KERNEL=off)"
                           }), flush=True)
-    for use_kernel, min_d, rows in legs:
+    for use_kernel, min_d, rows, fused in legs:
         def train():
             out = als._mixed_run(
                 als.als_init(jax.random.key(0), n_users, n_items, rank),
@@ -118,7 +136,7 @@ def main() -> int:
                 jnp.float32, jax.lax.Precision.HIGHEST,
                 user_heavy=u_hv, item_heavy=i_hv,
                 use_kernel=use_kernel, kernel_min_d=min_d,
-                kernel_rows=rows)
+                kernel_rows=rows, use_fused=fused)
             np.asarray(out.user_factors[0:1, 0:1])
             np.asarray(out.item_factors[0:1, 0:1])
             return out
@@ -133,6 +151,8 @@ def main() -> int:
             "kernel": use_kernel,
             "kernel_min_d": min_d,
             "kernel_rows": rows,
+            "fused_user_sweep": fused[0],
+            "fused_item_sweep": fused[1],
             "warm_s": round(warm, 3),
             "compile_s": round(max(first - warm, 0.0), 1),
             "mfu_f32_peak": round(flops / warm / peak_f32, 4),

@@ -48,6 +48,10 @@ REQUIRED_FIELDS = {
     "retrain_heldout_rmse_fresh": float,
     "retrain_heldout_rmse_continue": float,
     "retrain_speedup": float,
+    # one-dispatch continuation retrain (fused Gram+solve PR): splice +
+    # sweeps + early-stop measured as a single device dispatch
+    "retrain_one_dispatch": bool,
+    "retrain_train_dispatches": int,
     # speed-layer leg (docs/production.md "Freshness between retrains"):
     # device fold-in under concurrent ingest + serve
     "speed_foldin_p50_ms": float,
@@ -58,6 +62,12 @@ REQUIRED_FIELDS = {
     # end-to-end freshness and the live device-time MFU attribution
     "obs_freshness_p95_s": float,
     "obs_mfu_train": float,
+    # per-op pio_device_seconds cross-check over the timed warm train
+    "obs_device_train_s": float,
+    "obs_device_train_dispatches": int,
+    # warm train wall via the fused kernel path; None on backends where
+    # the selector kept the XLA assembly (the CPU CI mesh)
+    "train_fused_wall_s": (float, type(None)),
 }
 
 
@@ -146,3 +156,16 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
     assert rec["obs_mfu_train"] > 0
     assert 0.90 <= rec["obs_mfu_vs_offline"] <= 1.10, (
         rec["obs_mfu_train"], rec["obs_mfu_vs_offline"], rec["mfu"])
+    # per-op device-seconds cross-check: the profiler's block-until-ready
+    # wall over the SAME timed warm run must bracket the bench's own
+    # wall (generous band — CI boxes are noisy), and the whole training
+    # run must have been ONE attributed dispatch
+    assert rec["obs_device_train_s"] > 0
+    assert 0.5 <= rec["obs_device_train_s"] / rec["value"] <= 1.5, (
+        rec["obs_device_train_s"], rec["value"])
+    assert rec["obs_device_train_dispatches"] == 1
+    # one-dispatch continuation retrain: the timed continue leg ran
+    # splice + sweeps + early-stop as a single device dispatch
+    assert rec["retrain_one_dispatch"] is True, (
+        rec["retrain_train_dispatches"])
+    assert rec["retrain_train_dispatches"] == 1

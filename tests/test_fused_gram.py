@@ -1,0 +1,564 @@
+"""Fused Gram+solve training kernel: parity, routing, one-dispatch.
+
+Contracts under test (the perf-opt PR's safety net, all in Pallas
+interpret mode on the CPU tier-1 mesh):
+
+- the fused gather+Gram+CG kernel (`ops/pallas_kernels.
+  als_fused_solve_cg_pallas`) is a drop-in for the unfused
+  `_gram_rhs_nnz` → `_reg_solve` assembly at EVERY fold-in ladder
+  bucket width, explicit AND implicit, warm-start on and off — and
+  through the `_solve_bucket_chunked` fallback boundary;
+- routing: `PIO_ALS_FUSED_GRAM` + the VMEM table budget decide, per
+  half-sweep side, fused-gather vs two-stage kernel vs XLA — resolved
+  outside every trace;
+- full-training parity: als_train / the implicit sweep with the fused
+  kernel forced on reach the XLA path's fit (planted recovery);
+- the one-dispatch continuation retrain: deferred plan splices are
+  scattered INSIDE the training dispatch, bitwise-identical to the
+  eager splice path, with the dispatch count == 1 pinned by
+  `stats["train_dispatches"]` and the jit cache stable across
+  same-shape retrains;
+- `_cg_solve_spd`'s device-side residual early exit stops early on
+  well-conditioned systems and is bit-identical to the fixed-budget
+  path when it cannot trigger;
+- the fold-in solver's ladder buckets route through the SAME fused
+  kernel and still match the dense numpy reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_predictionio_tpu.ops import als, retrain
+from incubator_predictionio_tpu.ops.pallas_kernels import (
+    als_fused_fits,
+    als_fused_solve_cg_pallas,
+)
+
+#: the speed layer's default fold-in bucket ladder (speed/foldin.py)
+LADDER = (8, 32, 128, 512)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plans():
+    retrain.drop_plans()
+    yield
+    retrain.drop_plans()
+
+
+def _problem(seed, M, K, B, D, density=0.8):
+    rng = np.random.default_rng(seed)
+    table = rng.normal(0, 0.3, (M, K)).astype(np.float32)
+    cols = rng.integers(0, M, (B, D)).astype(np.int32)
+    vals = rng.normal(3.5, 1.0, (B, D)).astype(np.float32)
+    mask = (rng.random((B, D)) < density).astype(np.float32)
+    mask[min(3, B - 1)] = 0.0  # an empty row must solve to exactly 0
+    x0 = rng.normal(0, 0.3, (B, K)).astype(np.float32)
+    return table, cols, vals, mask, x0
+
+
+def _unfused_reference(table, cols, vals, mask, l2, implicit, alpha,
+                       cg_iters, x0):
+    """THE unfused path: _gram_rhs_nnz → _reg_solve, f32 HIGHEST."""
+    t = jnp.asarray(table)
+    yty = (als._gram_all(t, jax.lax.Precision.HIGHEST)
+           if implicit else None)
+    gram, rhs, nnz = als._gram_rhs_nnz(
+        t, jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
+        jnp.float32, jax.lax.Precision.HIGHEST, implicit=implicit,
+        alpha=alpha)
+    return yty, als._reg_solve(
+        gram, rhs, nnz, l2, True, implicit=implicit, yty=yty,
+        cg_iters=cg_iters, x0=None if x0 is None else jnp.asarray(x0))
+
+
+class TestFusedKernelDifferential:
+    """Fused gather+Gram+CG vs the unfused assembly, every ladder width."""
+
+    @pytest.mark.parametrize("width", LADDER)
+    @pytest.mark.parametrize("implicit", [False, True])
+    @pytest.mark.parametrize("warm", [False, True])
+    def test_matches_unfused_path(self, width, implicit, warm):
+        table, cols, vals, mask, x0 = _problem(
+            seed=width + implicit * 7 + warm, M=150, K=24, B=9, D=width)
+        yty, ref = _unfused_reference(
+            table, cols, vals, mask, 0.05, implicit, 2.0, 16,
+            x0 if warm else None)
+        got = als_fused_solve_cg_pallas(
+            jnp.asarray(table), jnp.asarray(cols), jnp.asarray(vals),
+            jnp.asarray(mask), 0.05, reg_nnz=True,
+            iters=16 * (2 if implicit else 1), implicit=implicit,
+            alpha=2.0, yty=yty,
+            x0=jnp.asarray(x0) if warm else None, interpret=True)
+        rel = float(jnp.max(jnp.abs(ref - got))
+                    / (jnp.max(jnp.abs(ref)) + 1e-9))
+        assert rel < 2e-5, (width, implicit, warm, rel)
+        # the empty row is EXACTLY zero, warm start or not (the
+        # _reg_solve where-guard parity)
+        assert bool(jnp.all(got[3] == 0.0))
+
+    def test_no_reg_nnz_and_rank_128_no_pad(self):
+        """Plain-λ ridge + an already-lane-aligned rank (the production
+        shape: no padding copies at all). With D=32 < K=128 the Gram is
+        rank-deficient and only the λ ridge conditions it, so the two
+        CG orderings legitimately diverge more — a stout λ keeps the
+        comparison about the assembly, not the conditioning."""
+        table, cols, vals, mask, _ = _problem(seed=2, M=160, K=128, B=8,
+                                              D=32)
+        t = jnp.asarray(table)
+        gram, rhs, nnz = als._gram_rhs_nnz(
+            t, jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
+            jnp.float32, jax.lax.Precision.HIGHEST, implicit=False,
+            alpha=0.0)
+        ref = als._reg_solve(gram, rhs, nnz, 0.5, False, implicit=False,
+                             yty=None, cg_iters=32)
+        got = als_fused_solve_cg_pallas(
+            t, jnp.asarray(cols), jnp.asarray(vals), jnp.asarray(mask),
+            0.5, reg_nnz=False, iters=32, interpret=True)
+        rel = float(jnp.max(jnp.abs(ref - got))
+                    / (jnp.max(jnp.abs(ref)) + 1e-9))
+        assert rel < 1e-4, rel
+
+    def test_chunked_fallback_boundary(self, monkeypatch):
+        """Buckets past the chunk budget split under lax.map and must
+        agree with the single-shot fused solve (the VMEM-budget
+        fallback the sweep relies on for huge buckets)."""
+        table, cols, vals, mask, x0 = _problem(seed=3, M=120, K=16, B=26,
+                                               D=32)
+        t = jnp.asarray(table)
+
+        def solver(tt):
+            return als._solve_bucket_fused(
+                t, None, tt[0], tt[1], tt[2], 0.05, reg_nnz=True,
+                cg_iters=8, x0=tt[3] if len(tt) > 3 else None)
+
+        one_shot = als._solve_bucket_chunked(
+            solver, jnp.asarray(cols), jnp.asarray(vals),
+            jnp.asarray(mask), 16, x0=jnp.asarray(x0))
+        monkeypatch.setattr(als, "_CHUNK_ELEMS", 1)  # force row chunks
+        chunked = als._solve_bucket_chunked(
+            solver, jnp.asarray(cols), jnp.asarray(vals),
+            jnp.asarray(mask), 16, x0=jnp.asarray(x0))
+        np.testing.assert_array_equal(np.asarray(one_shot),
+                                      np.asarray(chunked))
+
+
+class TestFusedRouting:
+    def test_vmem_budget_gates_fused_sides(self, monkeypatch):
+        monkeypatch.setenv("PIO_ALS_FUSED_GRAM", "on")
+        monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+        # generous budget: both sides fit at this tiny shape
+        monkeypatch.setenv("PIO_ALS_FUSED_VMEM_MB", "10")
+        assert als._fused_sides(50, 40, False, False, jnp.float32, 8) \
+            == (True, True)
+        # a budget smaller than any table: nothing routes fused
+        monkeypatch.setenv("PIO_ALS_FUSED_VMEM_MB", "0.000001")
+        assert als._fused_sides(50, 40, False, False, jnp.float32, 8) \
+            == (False, False)
+        assert not als_fused_fits(26744, 128, jnp.float32) or \
+            als_fused_fits(26744, 128, jnp.bfloat16)
+
+    def test_ml20m_shape_budget_math(self):
+        """The documented routing at the bench shape: the item table
+        (26.7k × 128 bf16 ≈ 6.9 MB) fits the 10 MB default budget, the
+        user table (138k × 128) does not — so the user half-sweep runs
+        fully fused and the item half-sweep keeps the two-stage path."""
+        assert als_fused_fits(26744, 128, jnp.bfloat16)
+        assert not als_fused_fits(138493, 128, jnp.bfloat16)
+        assert not als_fused_fits(138493, 128, jnp.float32)
+
+    def test_over_budget_side_falls_back_to_two_stage(self, monkeypatch):
+        """With fused enabled but the table over budget, wide explicit
+        buckets still route through the two-stage kernel."""
+        calls = {"fused": 0, "two_stage": 0}
+        real_fused = als._solve_bucket_fused
+        real_two = als._solve_bucket_kernel
+
+        def spy_fused(*a, **k):
+            calls["fused"] += 1
+            return real_fused(*a, **k)
+
+        def spy_two(*a, **k):
+            calls["two_stage"] += 1
+            return real_two(*a, **k)
+
+        monkeypatch.setattr(als, "_solve_bucket_fused", spy_fused)
+        monkeypatch.setattr(als, "_solve_bucket_kernel", spy_two)
+        monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+        monkeypatch.setattr(als, "_KERNEL_MIN_D", 0)
+        monkeypatch.setenv("PIO_ALS_FUSED_GRAM", "on")
+        monkeypatch.setenv("PIO_ALS_FUSED_VMEM_MB", "0.000001")
+        rng = np.random.default_rng(0)
+        users = rng.integers(0, 30, 400).astype(np.int32)
+        items = rng.integers(0, 20, 400).astype(np.int32)
+        vals = rng.normal(3, 1, 400).astype(np.float32)
+        als.als_train(users, items, vals, 30, 20, rank=4, iterations=1,
+                      l2=0.05)
+        assert calls["two_stage"] > 0 and calls["fused"] == 0
+        jax.clear_caches()  # the spies are baked into this trace
+
+
+class TestFusedTrainingParity:
+    def test_als_train_fused_reaches_xla_fit(self, monkeypatch):
+        rng = np.random.default_rng(7)
+        n_u, n_i, k_true, nnz = 80, 50, 4, 3000
+        u = rng.normal(0, 1, (n_u, k_true)).astype(np.float32)
+        v = rng.normal(0, 1, (n_i, k_true)).astype(np.float32)
+        users = rng.integers(0, n_u, nnz).astype(np.int32)
+        items = rng.integers(0, n_i, nnz).astype(np.int32)
+        ratings = np.einsum("nk,nk->n", u[users], v[items]).astype(
+            np.float32)
+        kw = dict(n_users=n_u, n_items=n_i, rank=8, iterations=6,
+                  l2=0.02, bf16_sweeps=3)
+        monkeypatch.setattr(als, "_ALS_KERNEL", "off")
+        st_xla, _ = als.als_train(users, items, ratings, **kw)
+        monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+        monkeypatch.setattr(als, "_KERNEL_MIN_D", 0)
+        monkeypatch.setenv("PIO_ALS_FUSED_GRAM", "on")
+        st_fused, _ = als.als_train(users, items, ratings, **kw)
+        r_xla = als.rmse(st_xla, users, items, ratings)
+        r_fused = als.rmse(st_fused, users, items, ratings)
+        assert r_fused < max(1.15 * r_xla, r_xla + 0.02), (r_fused, r_xla)
+        assert r_fused < 0.1, r_fused
+
+    def test_implicit_half_sweep_matches_xla(self, monkeypatch):
+        """One implicit half-sweep, fused kernel vs XLA assembly —
+        implicit mode is kernel-eligible ONLY in the fused generation
+        (the shared-YᵗY operand), so this is its first kernel parity
+        pin."""
+        rng = np.random.default_rng(9)
+        n_rows, n_other, rank = 40, 30, 8
+        other = jnp.asarray(
+            rng.normal(0, 0.3, (n_other, rank)).astype(np.float32))
+        users = rng.integers(0, n_rows, 600).astype(np.int64)
+        items = rng.integers(0, n_other, 600).astype(np.int64)
+        w = np.abs(rng.normal(1, 1, 600)).astype(np.float32)
+        from incubator_predictionio_tpu.ops.sparse import build_both_sides
+
+        (light, heavy), _ = build_both_sides(users, items, w, n_rows,
+                                             n_other)
+        tree = als._buckets_tree(light)
+        hv = als._heavy_tree(heavy)
+        kw = dict(l2=0.05, alpha=2.0, reg_nnz=True,
+                  compute_dtype=jnp.float32,
+                  precision=jax.lax.Precision.HIGHEST, implicit=True,
+                  cg_iters=16)
+        ref = als._sweep_side(n_rows, other, tree, hv, **kw)
+        monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+        got = als._sweep_side(n_rows, other, tree, hv, use_kernel=True,
+                              use_fused=True, kernel_min_d=0, **kw)
+        rel = float(jnp.max(jnp.abs(ref - got))
+                    / (jnp.max(jnp.abs(ref)) + 1e-9))
+        assert rel < 2e-5, rel
+
+    def test_als_train_implicit_fused_finite_and_ranks(self, monkeypatch):
+        monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+        monkeypatch.setattr(als, "_KERNEL_MIN_D", 0)
+        monkeypatch.setenv("PIO_ALS_FUSED_GRAM", "on")
+        rng = np.random.default_rng(11)
+        users = rng.integers(0, 30, 800).astype(np.int32)
+        items = rng.integers(0, 20, 800).astype(np.int32)
+        w = np.abs(rng.normal(1, 1, 800)).astype(np.float32)
+        st = als.als_train_implicit(users, items, w, 30, 20, rank=4,
+                                    iterations=3, l2=0.05, alpha=2.0)
+        uf = np.asarray(st.user_factors)
+        assert uf.shape == (30, 4) and np.all(np.isfinite(uf))
+
+
+class TestCgEarlyExit:
+    def _spd(self, seed=0, B=6, K=12):
+        rng = np.random.default_rng(seed)
+        a = rng.normal(0, 1, (B, K, K)).astype(np.float32)
+        a = np.einsum("bik,bjk->bij", a, a) + 0.0  # SPD-ish
+        b = rng.normal(0, 1, (B, K)).astype(np.float32)
+        lam = np.full(B, 2.0, np.float32)
+        return jnp.asarray(a), jnp.asarray(b), jnp.asarray(lam)
+
+    def test_early_exit_stops_and_matches_full_budget(self):
+        a, b, lam = self._spd()
+        full = als._cg_solve_spd(a, b, 64, lam=lam)
+        x, iters = als._cg_solve_spd(a, b, 64, lam=lam, tol=1e-6,
+                                     return_iters=True)
+        assert int(iters) < 64, int(iters)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(full),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_untriggered_tol_is_bitwise_fixed_budget(self):
+        """The while_loop path with a tolerance too small to fire runs
+        the exact fixed budget — bit-identical to the fori_loop path
+        (the parity pin the satellite asks for)."""
+        a, b, lam = self._spd(seed=1)
+        fixed = als._cg_solve_spd(a, b, 8, lam=lam, tol=0.0)
+        # tol² underflows to 0 → the exit can only fire at rz == 0.0,
+        # where the division guards freeze x anyway
+        loose = als._cg_solve_spd(a, b, 8, lam=lam, tol=1e-300)
+        np.testing.assert_array_equal(np.asarray(fixed),
+                                      np.asarray(loose))
+
+    def test_env_knob_threads_through_training(self, monkeypatch):
+        monkeypatch.setenv("PIO_ALS_CG_TOL", "1e-5")
+        rng = np.random.default_rng(4)
+        users = rng.integers(0, 25, 500).astype(np.int32)
+        items = rng.integers(0, 15, 500).astype(np.int32)
+        vals = rng.normal(3, 1, 500).astype(np.float32)
+        st, _ = als.als_train(users, items, vals, 25, 15, rank=4,
+                              iterations=3, l2=0.05)
+        assert np.all(np.isfinite(np.asarray(st.user_factors)))
+
+
+class TestOneDispatchRetrain:
+    def _coo(self, rng, n, nu=40, ni=25):
+        return (rng.integers(0, nu, n), rng.integers(0, ni, n),
+                rng.normal(3, 1, n).astype(np.float32))
+
+    def test_steady_state_retrain_is_one_dispatch(self):
+        rng = np.random.default_rng(6)
+        users, items, vals = self._coo(rng, 1200)
+        base = retrain.als_retrain(users, items, vals, 40, 25, rank=4,
+                                   iterations=4, l2=0.05, seed=0,
+                                   tol=0.0, plan_key="od")
+        t_u, t_i, t_v = self._coo(rng, 90)
+        u2 = np.concatenate([users, t_u])
+        i2 = np.concatenate([items, t_i])
+        v2 = np.concatenate([vals, t_v])
+        stats: dict = {}
+        retrain.als_retrain(u2, i2, v2, 40, 25, rank=4, iterations=4,
+                            l2=0.05, seed=0, prev_state=base, tol=0.0,
+                            plan_key="od", stats=stats)
+        assert stats["prep_plan"] == "reused"
+        assert stats["mode"] == "continue"
+        assert stats["train_dispatches"] == 1, stats
+        assert stats["one_dispatch"] is True
+
+    def test_zero_iteration_retrain_still_applies_splice(self):
+        """A deferred splice produced by prep must reach the plan's
+        resident trees even when NO training leg runs (iterations=0):
+        committing pre-splice trees while the plan digest already
+        covers the tail would silently drop the tail's interactions
+        from every future reuse."""
+        rng = np.random.default_rng(11)
+        users, items, vals = self._coo(rng, 1200)
+        retrain.als_retrain(users, items, vals, 40, 25, rank=4,
+                            iterations=4, l2=0.05, seed=0, tol=0.0,
+                            plan_key="od0")
+        t_u, t_i, t_v = self._coo(rng, 90)
+        u2 = np.concatenate([users, t_u])
+        i2 = np.concatenate([items, t_i])
+        v2 = np.concatenate([vals, t_v])
+        stats: dict = {}
+        retrain.als_retrain(u2, i2, v2, 40, 25, rank=4, iterations=0,
+                            l2=0.05, seed=0, tol=0.0, plan_key="od0",
+                            stats=stats)
+        assert stats["prep_plan"] == "reused"
+        # the committed residents (re-fetched via a zero-delta reuse)
+        # must be bitwise-identical to an eager-splice reuse of the
+        # same base plan + tail
+        stats2: dict = {}
+        u_res, i_res, _, _ = retrain.prepare_with_reuse(
+            u2, i2, v2, 40, 25, plan_key="od0", stats=stats2)
+        assert stats2["prep_plan"] == "reused"
+        retrain.prepare_with_reuse(users, items, vals, 40, 25,
+                                   plan_key="od0e", stats={})
+        u_eag, i_eag, _, _ = retrain.prepare_with_reuse(
+            u2, i2, v2, 40, 25, plan_key="od0e", stats={})
+        for side_a, side_b in ((u_res, u_eag), (i_res, i_eag)):
+            assert len(side_a) == len(side_b)
+            for bucket_a, bucket_b in zip(side_a, side_b):
+                for arr_a, arr_b in zip(bucket_a, bucket_b):
+                    np.testing.assert_array_equal(np.asarray(arr_a),
+                                                  np.asarray(arr_b))
+
+    def test_mixed_precision_retrain_is_two_dispatches(self):
+        """bf16 leg + f32 polish = two fused dispatches; the splice
+        rides the FIRST, never both."""
+        rng = np.random.default_rng(7)
+        users, items, vals = self._coo(rng, 1000)
+        base = retrain.als_retrain(users, items, vals, 40, 25, rank=4,
+                                   iterations=4, l2=0.05, seed=0,
+                                   tol=0.0, plan_key="od2",
+                                   bf16_sweeps=2)
+        t = self._coo(rng, 80)
+        u2 = np.concatenate([users, t[0]])
+        i2 = np.concatenate([items, t[1]])
+        v2 = np.concatenate([vals, t[2]])
+        stats: dict = {}
+        retrain.als_retrain(u2, i2, v2, 40, 25, rank=4, iterations=4,
+                            l2=0.05, seed=0, prev_state=base, tol=0.0,
+                            plan_key="od2", bf16_sweeps=2, stats=stats)
+        assert stats["prep_plan"] == "reused"
+        assert stats["train_dispatches"] == 2
+        assert stats["one_dispatch"] is False
+
+    def test_deferred_splice_bitwise_matches_eager_splice(self):
+        """The in-dispatch `_splice_tree` scatters must produce trees
+        bitwise-identical to apply_tail's eager `_set_entries`/
+        `_clear_rows` path — including moved rows, cleared slots and
+        appended delta buckets."""
+        rng = np.random.default_rng(8)
+        users, items, vals = self._coo(rng, 700, nu=30, ni=20)
+        # tail with brand-new users → moved rows + delta buckets
+        t_u = np.concatenate([rng.integers(0, 30, 50),
+                              np.asarray([30, 31, 31])])
+        t_i = np.concatenate([rng.integers(0, 20, 50),
+                              np.asarray([3, 4, 19])])
+        t_v = rng.normal(3, 1, 53).astype(np.float32)
+        u2 = np.concatenate([users, t_u])
+        i2 = np.concatenate([items, t_i])
+        v2 = np.concatenate([vals, t_v])
+
+        def trees_via(defer):
+            retrain.drop_plans()
+            retrain.prepare_with_reuse(users, items, vals, 30, 20,
+                                       plan_key="bw")
+            stats: dict = {}
+            ut, it, _, _ = retrain.prepare_with_reuse(
+                u2, i2, v2, 32, 20, plan_key="bw", stats=stats,
+                defer_splice=defer)
+            assert stats["prep_plan"] == "reused"
+            if defer:
+                sp = stats.get("pending_splices")
+                assert sp is not None, "no deferred splice produced"
+                ut = retrain._apply_splices(ut, sp[0])
+                it = retrain._apply_splices(it, sp[1])
+            return ut, it
+
+        deferred, eager = trees_via(True), trees_via(False)
+        for side_a, side_b in zip(deferred, eager):
+            assert len(side_a) == len(side_b)
+            for bucket_a, bucket_b in zip(side_a, side_b):
+                for arr_a, arr_b in zip(bucket_a, bucket_b):
+                    np.testing.assert_array_equal(np.asarray(arr_a),
+                                                  np.asarray(arr_b))
+
+    def test_jit_cache_stable_across_same_shape_retrains(self):
+        """Same-size tails touching only resident rows → the spliced
+        converge reuses its compiled program (the jit cache/dispatch
+        pin of the acceptance criteria)."""
+        rng = np.random.default_rng(9)
+        # every user has degree 10 and every item degree 15 (both width
+        # class 16 with headroom), and the tails below touch each
+        # entity at most once per retrain — no width class ever moves,
+        # so the splice pytree structure is identical across retrains
+        users = np.repeat(np.arange(30, dtype=np.int64), 10)
+        items = np.resize(np.arange(20, dtype=np.int64), len(users))
+        vals = rng.normal(3, 1, len(users)).astype(np.float32)
+        state = retrain.als_retrain(users, items, vals, 30, 20, rank=4,
+                                    iterations=2, l2=0.05, seed=0,
+                                    tol=0.0, plan_key="cache")
+
+        def grow(u, i, v, seed):
+            r = np.random.default_rng(seed)
+            t_u = np.arange(8, dtype=np.int64)          # same 8 rows
+            t_i = np.arange(8, dtype=np.int64) + 8     # same 8 items
+            t_v = r.normal(3, 1, 8).astype(np.float32)
+            return (np.concatenate([u, t_u]), np.concatenate([i, t_i]),
+                    np.concatenate([v, t_v]))
+
+        u2, i2, v2 = grow(users, items, vals, 1)
+        s2: dict = {}
+        state = retrain.als_retrain(u2, i2, v2, 30, 20, rank=4,
+                                    iterations=2, l2=0.05, seed=0,
+                                    prev_state=state, tol=0.0,
+                                    plan_key="cache", stats=s2)
+        assert s2["train_dispatches"] == 1
+        cache_after_second = retrain._converge_spliced._cache_size()
+        u3, i3, v3 = grow(u2, i2, v2, 2)
+        s3: dict = {}
+        retrain.als_retrain(u3, i3, v3, 30, 20, rank=4, iterations=2,
+                            l2=0.05, seed=0, prev_state=state, tol=0.0,
+                            plan_key="cache", stats=s3)
+        assert s3["train_dispatches"] == 1
+        assert retrain._converge_spliced._cache_size() \
+            == cache_after_second, "same-shape retrain recompiled"
+
+    def test_unfused_probe_path_applies_splice_eagerly(self, monkeypatch):
+        monkeypatch.setenv("PIO_RETRAIN_FUSED", "0")
+        rng = np.random.default_rng(10)
+        users, items, vals = self._coo(rng, 900)
+        base = retrain.als_retrain(users, items, vals, 40, 25, rank=4,
+                                   iterations=4, l2=0.05, seed=0,
+                                   tol=0.0, plan_key="uf")
+        t = self._coo(rng, 70)
+        u2 = np.concatenate([users, t[0]])
+        i2 = np.concatenate([items, t[1]])
+        v2 = np.concatenate([vals, t[2]])
+        stats: dict = {}
+        cont = retrain.als_retrain(u2, i2, v2, 40, 25, rank=4,
+                                   iterations=4, l2=0.05, seed=0,
+                                   prev_state=base, tol=0.0,
+                                   plan_key="uf", stats=stats)
+        assert stats["prep_plan"] == "reused"
+        assert stats["train_dispatches"] > 1  # 2 splice + probe chunks
+        assert np.all(np.isfinite(np.asarray(cont.user_factors)))
+
+
+class TestFoldInFusedRouting:
+    def test_ladder_buckets_match_dense_reference(self):
+        from incubator_predictionio_tpu.speed.foldin import (
+            FoldInSolver,
+            dense_reference_solve,
+        )
+
+        rng = np.random.default_rng(12)
+        other = rng.normal(0, 0.4, (60, 8)).astype(np.float32)
+        solver = FoldInSolver(other, l2=0.05, reg_nnz=True,
+                              use_kernel=True)
+        assert solver.use_kernel
+        rows = []
+        for width in LADDER:
+            d = width - 1 if width > 8 else width
+            cols = rng.integers(0, 60, d).astype(np.int32)
+            vals = rng.normal(3.5, 1.0, d).astype(np.float32)
+            rows.append((cols, vals))
+        out = solver.solve(rows)
+        for k, (cols, vals) in enumerate(rows):
+            ref = dense_reference_solve(other, cols, vals, 0.05)
+            np.testing.assert_allclose(out[k], ref, atol=2e-4)
+
+    def test_implicit_ladder_matches_dense_reference(self):
+        from incubator_predictionio_tpu.speed.foldin import (
+            FoldInSolver,
+            dense_reference_solve,
+        )
+
+        rng = np.random.default_rng(13)
+        other = rng.normal(0, 0.4, (50, 8)).astype(np.float32)
+        solver = FoldInSolver(other, l2=0.05, implicit=True, alpha=2.0,
+                              use_kernel=True)
+        assert solver.use_kernel
+        for width in (8, 32):
+            cols = rng.integers(0, 50, width).astype(np.int32)
+            vals = np.abs(rng.normal(1, 1, width)).astype(np.float32)
+            out = solver.solve([(cols, vals)])
+            ref = dense_reference_solve(other, cols, vals, 0.05,
+                                        implicit=True, alpha=2.0)
+            np.testing.assert_allclose(out[0], ref, atol=2e-4)
+
+    def test_kernel_path_compile_cache_is_bounded(self):
+        from incubator_predictionio_tpu.speed.foldin import (
+            FoldInSolver,
+            foldin_compile_cache_size,
+        )
+
+        rng = np.random.default_rng(14)
+        other = rng.normal(0, 0.4, (40, 8)).astype(np.float32)
+        solver = FoldInSolver(other, l2=0.1, use_kernel=True)
+        solver.warmup()
+        warm = foldin_compile_cache_size()
+        for _ in range(3):
+            d = int(rng.integers(1, 8))
+            solver.solve([(rng.integers(0, 40, d).astype(np.int32),
+                           rng.normal(3, 1, d).astype(np.float32))])
+        assert foldin_compile_cache_size() == warm, (
+            "steady-state fold-in recompiled on the kernel path")
+
+    def test_over_budget_table_disables_kernel(self, monkeypatch):
+        from incubator_predictionio_tpu.speed.foldin import FoldInSolver
+
+        monkeypatch.setenv("PIO_ALS_FUSED_VMEM_MB", "0.000001")
+        rng = np.random.default_rng(15)
+        other = rng.normal(0, 0.4, (40, 8)).astype(np.float32)
+        solver = FoldInSolver(other, l2=0.1, use_kernel=True)
+        assert not solver.use_kernel  # budget overrides the forced flag

@@ -97,20 +97,25 @@ def warm(prev, row_ids):
         """
 import jax
 
-def solve(a: jax.Array, x0: "Optional[jax.Array]" = None) -> jax.Array:
-    return a if x0 is None else a + x0
+def solve(a: jax.Array, x0: "Optional[jax.Array]" = None,
+          yty: "Optional[jax.Array]" = None) -> jax.Array:
+    out = a if x0 is None else a + x0
+    return out if yty is None else out + yty
 
 def solve_kernel_available():
-    return bool(solve(jax.numpy.zeros((2,))))
+    return bool(solve(jax.numpy.zeros((2,)), x0=jax.numpy.zeros((2,))))
 """,
         """
 import jax
 
-def solve(a: jax.Array, x0: "Optional[jax.Array]" = None) -> jax.Array:
-    return a if x0 is None else a + x0
+def solve(a: jax.Array, x0: "Optional[jax.Array]" = None,
+          yty: "Optional[jax.Array]" = None) -> jax.Array:
+    out = a if x0 is None else a + x0
+    return out if yty is None else out + yty
 
 def solve_kernel_available():
-    return bool(solve(jax.numpy.zeros((2,)), x0=jax.numpy.zeros((2,))))
+    return bool(solve(jax.numpy.zeros((2,)), x0=jax.numpy.zeros((2,)),
+                      yty=jax.numpy.zeros((2,))))
 """,
     ),
     "tracer-branch": (

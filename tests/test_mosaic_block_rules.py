@@ -123,6 +123,40 @@ def test_als_kernel_warmstart_blocks_are_mosaic_legal(capture, rows, B, D, K):
     _check_pairs(capture)
 
 
+@pytest.mark.parametrize("implicit,warm", [
+    (False, False), (False, True), (True, False), (True, True),
+])
+@pytest.mark.parametrize("B,D,K", [
+    (9, 48, 24),       # lane-padded D and K, non-sublane table rows
+    (5, 1024, 32),     # multi-tile D streaming
+])
+def test_als_fused_kernel_blocks_are_mosaic_legal(capture, implicit, warm,
+                                                  B, D, K):
+    """The fused gather+Gram+CG kernel in all four production variants
+    (explicit/implicit × cold/warm — each a DIFFERENT kernel: the yty
+    and x0 operands add BlockSpecs). The whole-table block is legal by
+    block == array; every per-row aux rides the proven [B, 1, x]
+    layout."""
+    from incubator_predictionio_tpu.ops import als
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_fused_solve_cg_pallas,
+    )
+
+    rng = np.random.default_rng(2)
+    table = jnp.asarray(rng.normal(0, 0.3, (150, K)).astype(np.float32))
+    cols = jnp.asarray(rng.integers(0, 150, (B, D)).astype(np.int32))
+    vals = jnp.asarray(rng.normal(3.5, 1.0, (B, D)).astype(np.float32))
+    mask = jnp.asarray((rng.random((B, D)) < 0.8).astype(np.float32))
+    yty = (als._gram_all(table, jax.lax.Precision.HIGHEST)
+           if implicit else None)
+    x0 = (jnp.asarray(rng.normal(0, 0.3, (B, K)).astype(np.float32))
+          if warm else None)
+    als_fused_solve_cg_pallas(table, cols, vals, mask, 0.1, True, 4,
+                              implicit=implicit, alpha=1.5, yty=yty,
+                              x0=x0, interpret=True)
+    _check_pairs(capture)
+
+
 @pytest.mark.parametrize("S", [512, 2048])
 def test_flash_attention_blocks_are_mosaic_legal(capture, S):
     from incubator_predictionio_tpu.ops.pallas_kernels import (

@@ -264,23 +264,36 @@ class TestAlsCgKernel:
         assert r_krn < max(1.15 * r_xla, r_xla + 0.02), (r_krn, r_xla)
         assert r_krn < 0.1, r_krn
 
-    def test_min_d_routing(self, monkeypatch):
+    @pytest.mark.parametrize("fused_mode", ["on", "off"])
+    def test_min_d_routing(self, monkeypatch, fused_mode):
         """With the kernel enabled, buckets narrower than _KERNEL_MIN_D
         stay on the XLA path (the padding tax region) while wide buckets
         route through the fused solve — decided per bucket at trace
-        time."""
+        time, in BOTH kernel generations (fused gather vs two-stage)."""
         from incubator_predictionio_tpu.ops import als
 
+        monkeypatch.setenv("PIO_ALS_FUSED_GRAM", fused_mode)
         widths = []
         real = als._solve_bucket_kernel
+        real_fused = als._solve_bucket_fused
 
         def spy(gsrc, cols, vals, mask, l2, reg_nnz, cg_iters,
                 kernel_rows=1, x0=None):
+            assert fused_mode == "off", "two-stage kernel ran in fused mode"
             widths.append(cols.shape[1])
             return real(gsrc, cols, vals, mask, l2, reg_nnz=reg_nnz,
                         cg_iters=cg_iters, kernel_rows=kernel_rows, x0=x0)
 
+        def spy_fused(gsrc, yty, cols, vals, mask, l2, reg_nnz, cg_iters,
+                      implicit=False, alpha=0.0, x0=None):
+            assert fused_mode == "on", "fused kernel ran while forced off"
+            widths.append(cols.shape[1])
+            return real_fused(gsrc, yty, cols, vals, mask, l2,
+                              reg_nnz=reg_nnz, cg_iters=cg_iters,
+                              implicit=implicit, alpha=alpha, x0=x0)
+
         monkeypatch.setattr(als, "_solve_bucket_kernel", spy)
+        monkeypatch.setattr(als, "_solve_bucket_fused", spy_fused)
         monkeypatch.setattr(als, "_ALS_KERNEL", "on")
         monkeypatch.setattr(als, "_KERNEL_MIN_D", 64)
 
@@ -341,3 +354,14 @@ def test_als_probe_compiles_the_variant_the_caller_runs(monkeypatch):
     assert pk.als_kernel_available(warm=True)   # cached, no new probe
     assert probed == ["ALS bucket CG solve (warm)",
                       "ALS bucket CG solve (cold)"]
+    # the fused-gather generation is a DIFFERENT kernel family again
+    # (in-kernel jnp.take gather; implicit adds the yty operand) — each
+    # (warm, fused, implicit) variant probes and caches separately, so
+    # production can never run a fused/implicit kernel the probe only
+    # green-lit in its two-stage/explicit form
+    assert pk.als_kernel_available(warm=True, fused=True)
+    assert pk.als_kernel_available(warm=False, fused=True, implicit=True)
+    assert pk.als_kernel_available(warm=True, fused=True)  # cached
+    assert probed[2:] == [
+        "ALS fused gather+Gram CG solve (warm)",
+        "ALS fused gather+Gram CG solve (cold, implicit)"]

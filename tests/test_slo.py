@@ -477,6 +477,45 @@ def test_train_flops_matches_bench_convention():
     assert als.train_flops(2000, 50, 40, 8, 4, 0) > f
 
 
+def test_fused_train_books_under_its_own_op_label(monkeypatch):
+    """Kernel-path training attributes under op="als_fused", the XLA
+    assembly under op="als_train" — separate trajectories in /metrics —
+    while both book the SAME als.train_flops formula, so
+    pio_mfu{phase="train"} stays comparable across the split (the
+    bench's obs_mfu_train cross-check relies on it)."""
+    from incubator_predictionio_tpu.ops import als
+
+    monkeypatch.setenv("PIO_PROFILE", "1")
+    rng = np.random.default_rng(3)
+    users = rng.integers(0, 24, 400).astype(np.int32)
+    items = rng.integers(0, 16, 400).astype(np.int32)
+    ratings = rng.normal(3.5, 1.0, 400).astype(np.float32)
+    kw = dict(n_users=24, n_items=16, rank=4, iterations=2, l2=0.1)
+
+    def booked(op):
+        return (obs_profile.DEVICE_DISPATCHES.labels(op=op).value,
+                obs_profile.DEVICE_FLOPS.labels(op=op).value)
+
+    monkeypatch.setattr(als, "_ALS_KERNEL", "off")
+    d0, f0 = booked("als_train")
+    als.als_train(users, items, ratings, **kw)
+    d1, f1 = booked("als_train")
+    assert d1 == d0 + 1 and f1 > f0
+
+    monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+    monkeypatch.setattr(als, "_KERNEL_MIN_D", 0)
+    monkeypatch.setenv("PIO_ALS_FUSED_GRAM", "on")  # interpret-mode hook
+    k0, g0 = booked("als_fused")
+    als.als_train(users, items, ratings, **kw)
+    k1, g1 = booked("als_fused")
+    assert k1 == k0 + 1
+    # ONE FLOP formula across the op split: identical workload, identical
+    # booked FLOPs
+    assert g1 - g0 == pytest.approx(f1 - f0)
+    # the XLA label did not absorb the kernel run
+    assert booked("als_train")[0] == d1
+
+
 def test_profile_route_validation():
     from incubator_predictionio_tpu.data.storage import Storage
     from incubator_predictionio_tpu.servers.admin import AdminServer

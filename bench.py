@@ -918,6 +918,169 @@ def obs_snapshot() -> dict:
     return out
 
 
+#: mesh-sharded training leg (docs/performance.md "Sharded ALS"): the
+#: placed-train wall over the forced-host-device mesh, the analytic
+#: collective volume, and the fused-kernel routing story at ML-20M —
+#: per-shard slice residency is what re-enables the fused kernel on the
+#: big-table side (ROADMAP items 1/5)
+SHARD_KEYS = (
+    "shard_train_wall_s", "shard_mesh_shape", "shard_devices",
+    "shard_backend", "shard_allgather_bytes", "shard_mfu_train",
+    "shard_gather_modes", "shard_fused_user_sweep",
+    "shard_fused_item_sweep", "shard_fused_fits_ml20m_user_sweep",
+    "shard_fused_fits_ml20m_item_sweep",
+)
+
+#: the true MovieLens-20M catalog shape + rank: the fused-VMEM routing
+#: keys are computed at THIS shape regardless of any smoke-run
+#: PIO_BENCH_* overrides — they are the headline claim, not a sample
+ML20M_SHAPE = (138_493, 26_744, 128)
+
+
+def run_shard_child() -> None:
+    """``--shard-child``: the mesh-sharded training leg, in its own
+    process so the forced-host-device backend (the parent exports
+    ``--xla_force_host_platform_device_count``) never perturbs the main
+    bench's single-device timings. Prints ONE JSON line on stdout."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+    from incubator_predictionio_tpu.ops import als
+    from incubator_predictionio_tpu.ops.pallas_kernels import (
+        als_fused_fits,
+    )
+    from incubator_predictionio_tpu.parallel.mesh import make_mesh
+    from incubator_predictionio_tpu.parallel.placement import (
+        make_placement,
+    )
+
+    nnz = int(os.environ.get("PIO_BENCH_SHARD_NNZ",
+                             str(min(NNZ, 1_000_000))))
+    sweeps = int(os.environ.get("PIO_BENCH_SHARD_SWEEPS", "2"))
+    bf16 = min(BF16_SWEEPS, sweeps)
+    rng = np.random.default_rng(17)
+    users = rng.integers(0, N_USERS, nnz).astype(np.int32)
+    items = rng.integers(0, N_ITEMS, nnz).astype(np.int32)
+    vals = rng.uniform(1, 5, nnz).astype(np.float32)
+    mesh = make_mesh()
+    placement = make_placement(mesh, N_USERS, N_ITEMS)
+    # mirror als_train_placed's leg structure explicitly so the timed
+    # window covers ONLY the training dispatches (the host-side bucket
+    # prep would otherwise dominate the CPU-sim wall and make
+    # shard_mfu_train incomparable to the main leg's MFU keys), and so
+    # the reported routing comes from the cfg the timed sweeps actually
+    # run (all-bf16 schedules route at bfloat16, not f32)
+    modes = als._shard_gather_modes(placement, RANK, jnp.float32, False)
+    u_data, i_data = als.build_placed_sides(
+        users, items, vals, placement, modes)
+    cfg_lo = als._placed_cfg(
+        placement, RANK, False, True, L2, 0.0, jnp.bfloat16,
+        jax.lax.Precision.DEFAULT,
+        min(als._CG_ITERS_BF16, als._CG_ITERS), modes=modes)
+    cfg_f32 = als._placed_cfg(
+        placement, RANK, False, True, L2, 1.0, jnp.float32,
+        jax.lax.Precision.HIGHEST, als._CG_ITERS, modes=modes)
+    cfg = cfg_lo if bf16 >= sweeps else cfg_f32
+
+    state = placement.place_state(
+        als.als_init(jax.random.key(0), N_USERS, N_ITEMS, RANK))
+
+    def run():
+        uf, vf = state.user_factors, state.item_factors
+        if bf16:
+            uf, vf = als._als_run_placed(
+                uf, vf, u_data, i_data, placement=placement,
+                cfg=cfg_lo, iterations=bf16)
+        if sweeps - bf16:
+            uf, vf = als._als_run_placed(
+                uf, vf, u_data, i_data, placement=placement,
+                cfg=cfg_f32, iterations=sweeps - bf16)
+        jax.block_until_ready((uf, vf))
+        return uf, vf
+
+    run()                                    # compile
+
+    def gather_bytes() -> int:
+        gb = obs_metrics.REGISTRY.get("pio_shard_gather_bytes_total")
+        if gb is None:
+            return 0
+        return int(sum(gb.labels(strategy=s).value
+                       for s in ("allgather", "ring")))
+
+    t0 = time.perf_counter()
+    run()                                    # warm, dispatches only
+    wall = time.perf_counter() - t0
+    # the analytic per-leg collective volume the trainer books
+    before = gather_bytes()
+    if bf16:
+        als._book_shard_metrics(placement, cfg_lo, RANK, bf16)
+    if sweeps - bf16:
+        als._book_shard_metrics(placement, cfg_f32, RANK, sweeps - bf16)
+    flops = als.train_flops(nnz, N_USERS, N_ITEMS, RANK, sweeps, bf16)
+    mfu = flops / wall / PEAK_FLOPS_F32
+
+    # fused-kernel routing at the TRUE ML-20M shape under this mesh:
+    # the VMEM math alone (deterministic on every backend — the
+    # per-run shard_fused_* keys additionally carry the Mosaic probe)
+    mu, mi, mr = ML20M_SHAPE
+    p20 = make_placement(mesh, mu, mi)
+    modes20 = als._shard_gather_modes(p20, mr, jnp.bfloat16, False)
+    out = {
+        "shard_train_wall_s": round(wall, 3),
+        "shard_mesh_shape": placement.describe(),
+        "shard_devices": placement.n_shards,
+        "shard_backend": jax.devices()[0].platform,
+        "shard_allgather_bytes": gather_bytes() - before,
+        "shard_mfu_train": float(f"{mfu:.6g}"),
+        "shard_gather_modes": "+".join((cfg.u_mode, cfg.i_mode)),
+        "shard_fused_user_sweep": bool(cfg.fused_u),
+        "shard_fused_item_sweep": bool(cfg.fused_i),
+        "shard_fused_fits_ml20m_user_sweep": bool(als_fused_fits(
+            als.gather_source_rows(p20, "item", modes20[0]),
+            mr, jnp.bfloat16)),
+        "shard_fused_fits_ml20m_item_sweep": bool(als_fused_fits(
+            als.gather_source_rows(p20, "user", modes20[1]),
+            mr, jnp.bfloat16)),
+    }
+    sys.stdout.write(json.dumps(out) + "\n")
+    sys.stdout.flush()
+
+
+def bench_shard(budget_s: float) -> dict:
+    """Parent-side mesh-sharded leg: spawn ``--shard-child`` with the
+    CPU backend forced to ``PIO_BENCH_SHARD_DEVICES`` (default 8)
+    virtual host devices — the sharded path measured without hardware,
+    and without perturbing this process's single-device jax. Guarded:
+    any failure nulls the shard_* keys, never the record."""
+    out = dict.fromkeys(SHARD_KEYS)
+    if budget_s < 20.0:
+        log("shard leg skipped: bench deadline too close")
+        return out
+    ndev = int(os.environ.get("PIO_BENCH_SHARD_DEVICES", "8"))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={ndev}").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--shard-child"],
+        env=env, capture_output=True, text=True,
+        timeout=min(budget_s, float(
+            os.environ.get("PIO_BENCH_SHARD_TIMEOUT_S", "300"))))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"shard child rc={proc.returncode}: {proc.stderr[-500:]}")
+    out.update(json.loads(proc.stdout.splitlines()[-1]))
+    log(f"shard: mesh={out['shard_mesh_shape']} "
+        f"({out['shard_backend']}) warm={out['shard_train_wall_s']}s "
+        f"gather={out['shard_gather_modes']} "
+        f"bytes={out['shard_allgather_bytes']} "
+        f"fused_ml20m=({out['shard_fused_fits_ml20m_user_sweep']}, "
+        f"{out['shard_fused_fits_ml20m_item_sweep']})")
+    return out
+
+
 def bench_scan_probe(store_dir: str) -> dict:
     """Sequential vs sharded event-log scan at bench scale, projection
     cache bypassed, plus the pipelined scan→prep leg — the host-pipeline
@@ -1494,6 +1657,9 @@ def run_orchestrator() -> None:
         # speed-layer leg (child-only; docs/production.md "Freshness
         # between retrains")
         **dict.fromkeys(SPEED_KEYS),
+        # mesh-sharded training leg (parent-side subprocess on the
+        # forced-host-device CPU sim; docs/performance.md "Sharded ALS")
+        **dict.fromkeys(SHARD_KEYS),
         "accel_waited_s": None,
         "accel_outcome": "never_available",
         "sasrec_epoch_s": None,
@@ -1594,6 +1760,13 @@ def run_orchestrator() -> None:
 
     # -- 6b. REAL-DATA QUALITY BOUND (host CPU; tiny) ----------------------
     record.update(bench_movielens_quality())
+
+    # -- 6c. MESH-SHARDED TRAINING LEG (host CPU, own subprocess with
+    #        the backend forced to 8 virtual devices) ----------------------
+    try:
+        record.update(bench_shard(emit_by - time.monotonic()))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"shard leg failed ({e!r}); shard_* keys null this round")
 
     # -- 4/5/7. TRAIN + ATTENTION + SERVE: supervised TPU child ------------
     # (started after the host stages so parent CPU load never perturbs the
@@ -2165,6 +2338,8 @@ def bench_serving(state, inter):
 if __name__ == "__main__":
     if "--cpu" in sys.argv:
         run_cpu_baseline()
+    elif "--shard-child" in sys.argv:
+        run_shard_child()
     elif "--tpu-child" in sys.argv:
         i = sys.argv.index("--tpu-child")
         run_tpu_child(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3],

@@ -826,6 +826,68 @@ class BlockingProfiler(Rule):
                         "t0()/record() instead")
 
 
+# ---------------------------------------------------------------------------
+# 13. host gathers inside an active mesh context
+# ---------------------------------------------------------------------------
+
+#: `with mesh:` / `with Mesh(...):` / `with placement.mesh:` context
+#: expressions — the lexical scope in which factor tables and sweep
+#: outputs are mesh-distributed
+_MESH_CTX_RE = re.compile(r"(?i)(^|[^\w])mesh\b|[^\w]Mesh\(|^Mesh\(")
+_HOST_GATHER_CALLS = {"jax.device_get", "numpy.asarray", "numpy.array"}
+_HOST_GATHER_ATTRS = {"tolist", "item"}
+
+
+class HostGatherInMesh(Rule):
+    name = "host-gather-in-mesh"
+    severity = "error"
+    doc = ("jax.device_get / np.asarray / .tolist() / .item() on a "
+           "value inside an active mesh context (`with mesh:` body) — "
+           "on mesh-sharded values each fetch is a cross-device "
+           "gather + host round trip in the middle of the training "
+           "loop, exactly the anti-pattern the sharded ALS sweep "
+           "forbids (ROADMAP item 1: no host round-trips between "
+           "dispatches); keep the loop device-side and fetch once "
+           "after the mesh context closes (obs/profile.py's gated "
+           "attribution is the one sanctioned exception)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        # obs/profile.py is the sanctioned sync point: its record() is
+        # env-gated and a wall measurement IS a host sync
+        path = str(mod.path).replace("\\", "/")
+        if path.endswith("obs/profile.py"):
+            return
+        seen: Set[Tuple[int, int]] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            ctx = " ".join(
+                ast.unparse(item.context_expr) for item in node.items)
+            if not _MESH_CTX_RE.search(ctx):
+                continue
+            # reuse the lock rule's body walk: nested function DEFS are
+            # exempt (host-sync already covers shard_map-traced bodies)
+            for call in LockNativeScan._calls_in_body(node):
+                rname = mod.resolved(call.func)
+                if rname in _HOST_GATHER_CALLS:
+                    what = f"{rname}()"
+                elif (isinstance(call.func, ast.Attribute)
+                        and call.func.attr in _HOST_GATHER_ATTRS
+                        and rname not in _HOST_GATHER_CALLS):
+                    what = f".{call.func.attr}()"
+                else:
+                    continue
+                key = (call.lineno, call.col_offset)
+                if key in seen:  # nested mesh withs walk the call twice
+                    continue
+                seen.add(key)
+                yield mod.finding(
+                    self, call,
+                    f"{what} inside active mesh context `{ctx}` — a "
+                    "cross-shard gather + host round trip mid-loop; "
+                    "fetch after the mesh context closes")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -839,6 +901,7 @@ ALL_RULES: Sequence[Rule] = (
     MetricInTrace(),
     ServeBlockingIO(),
     BlockingProfiler(),
+    HostGatherInMesh(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -267,14 +267,33 @@ class ECommAlgorithm(Algorithm):
 
     def train(self, ctx: RuntimeContext, pd: PreparedData) -> ECommModel:
         from incubator_predictionio_tpu.ops.als import als_train_implicit
+        from incubator_predictionio_tpu.parallel.placement import (
+            placement_for_ctx,
+        )
 
         seed = self.params.seed if self.params.seed is not None else ctx.seed
-        state = als_train_implicit(
-            pd.users, pd.items, pd.weights,
-            n_users=len(pd.user_bimap), n_items=len(pd.item_bimap),
-            rank=self.params.rank, iterations=self.params.num_iterations,
-            l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
-        )
+        n_users, n_items = len(pd.user_bimap), len(pd.item_bimap)
+        placement = placement_for_ctx(ctx, n_users, n_items)
+        if placement is not None:
+            # mesh-sharded implicit training (ops/als.py als_train_placed)
+            from incubator_predictionio_tpu.ops.als import als_train_placed
+
+            state = placement.unplace_state(als_train_placed(
+                pd.users, pd.items, pd.weights,
+                n_users=n_users, n_items=n_items, placement=placement,
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                l2=self.params.lambda_, alpha=self.params.alpha,
+                seed=seed, implicit=True))
+        else:
+            state = als_train_implicit(
+                pd.users, pd.items, pd.weights,
+                n_users=n_users, n_items=n_items,
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                l2=self.params.lambda_, alpha=self.params.alpha,
+                seed=seed,
+            )
         return self._assemble_model(pd, state)
 
     def train_with_previous(
@@ -300,18 +319,26 @@ class ECommAlgorithm(Algorithm):
             _plan_key,
         )
 
+        from incubator_predictionio_tpu.parallel.placement import (
+            placement_for_ctx,
+        )
+
         seed = self.params.seed if self.params.seed is not None else ctx.seed
+        n_users, n_items = len(pd.user_bimap), len(pd.item_bimap)
+        placement = placement_for_ctx(ctx, n_users, n_items)
         stats: Dict[str, Any] = {}
         state = als_retrain(
             pd.users, pd.items, pd.weights,
-            n_users=len(pd.user_bimap), n_items=len(pd.item_bimap),
+            n_users=n_users, n_items=n_items,
             rank=self.params.rank, iterations=self.params.num_iterations,
             l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
             implicit=True, plan_key=_plan_key("ecomm", pd),
             prev_state=ALSState(
                 user_factors=np.asarray(prev_model.user_factors),
                 item_factors=np.asarray(prev_model.item_factors)),
-            stats=stats)
+            stats=stats, placement=placement)
+        if placement is not None:
+            state = placement.unplace_state(state)
         logger.info("ecommerce continuation retrain: %s sweeps (mode=%s)",
                     stats.get("sweeps_used"), stats.get("mode"))
         return self._assemble_model(pd, state)

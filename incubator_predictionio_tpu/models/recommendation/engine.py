@@ -366,29 +366,37 @@ class ALSAlgorithm(Algorithm):
         super().__init__(params)
 
     def train(self, ctx: RuntimeContext, pd: PreparedData) -> ALSModel:
-        import jax
-
         from incubator_predictionio_tpu.ops import als_train
 
         n_users, n_items = len(pd.user_bimap), len(pd.item_bimap)
         if n_users == 0 or n_items == 0:
             raise ValueError("No ratings to train on")
         seed = self.params.seed if self.params.seed is not None else ctx.seed
-        if ctx.model_parallelism > 1 and jax.device_count() > 1:
-            # `pio train --model-parallelism N`: shard the factor tables
-            # over the mp mesh axis (the ALX layout, ops/als.py
-            # als_train_sharded); buckets shard over the whole mesh.
-            # ctx.mesh is the context's (possibly caller-supplied) mesh.
-            from incubator_predictionio_tpu.ops.als import als_train_sharded
+        from incubator_predictionio_tpu.parallel.placement import (
+            placement_for_ctx,
+        )
 
-            state = als_train_sharded(
-                pd.users, pd.items, pd.ratings, n_users, n_items, ctx.mesh,
+        placement = placement_for_ctx(ctx, n_users, n_items)
+        if placement is not None:
+            # `pio train --model-parallelism N` (or PIO_SHARD_TABLES=1):
+            # BOTH factor tables shard on rows over the mesh (the ALX
+            # layout, ops/als.py als_train_placed) and each device
+            # solves the row buckets it owns under shard_map. The model
+            # keeps host-shaped (unplaced) factors — serving re-routes
+            # to the sharded top-k merge whenever placed tables are
+            # handed to it directly.
+            from incubator_predictionio_tpu.ops.als import als_train_placed
+
+            state = als_train_placed(
+                pd.users, pd.items, pd.ratings, n_users, n_items,
+                placement=placement,
                 rank=self.params.rank,
                 iterations=self.params.num_iterations,
                 l2=self.params.lambda_,
                 seed=seed,
                 bf16_sweeps=self.params.bf16_sweeps,
             )
+            state = placement.unplace_state(state)
         else:
             state, _ = als_train(
                 pd.users, pd.items, pd.ratings,
@@ -412,17 +420,21 @@ class ALSAlgorithm(Algorithm):
         model's factors when its id space is an exact prefix of this
         PreparedData's, and let the convergence early-stop turn the warm
         start into fewer sweeps. Any incompatibility (rank change, index
-        space rebuilt, sharded run) falls back to a fresh train."""
-        import jax
-
+        space rebuilt) falls back to a fresh train. Under a mesh
+        placement the retrain runs the sharded one-dispatch path —
+        ``continue_state`` + ``place_state`` re-distribute a previous
+        model even when it was trained at a different mesh shape."""
         seed = self.params.seed if self.params.seed is not None else ctx.seed
         prev_state = self._continuation_seed(pd, prev_model)
-        if prev_state is None or (
-                ctx.model_parallelism > 1 and jax.device_count() > 1):
+        if prev_state is None:
             return self.train(ctx, pd)
         from incubator_predictionio_tpu.ops.retrain import als_retrain
+        from incubator_predictionio_tpu.parallel.placement import (
+            placement_for_ctx,
+        )
 
         n_users, n_items = len(pd.user_bimap), len(pd.item_bimap)
+        placement = placement_for_ctx(ctx, n_users, n_items)
         stats: Dict[str, Any] = {}
         state = als_retrain(
             pd.users, pd.items, pd.ratings, n_users, n_items,
@@ -430,7 +442,9 @@ class ALSAlgorithm(Algorithm):
             l2=self.params.lambda_, seed=seed,
             bf16_sweeps=self.params.bf16_sweeps,
             prev_state=prev_state, plan_key=_plan_key("rec", pd),
-            stats=stats)
+            stats=stats, placement=placement)
+        if placement is not None:
+            state = placement.unplace_state(state)
         logger.info(
             "ALS continuation retrain: %d users × %d items, rank %d, "
             "%s sweeps (mode=%s, delta=%.3e)", n_users, n_items,

@@ -214,14 +214,35 @@ class SimilarProductAlgorithm(Algorithm):
         import jax.numpy as jnp
 
         from incubator_predictionio_tpu.ops.als import als_train_implicit
+        from incubator_predictionio_tpu.parallel.placement import (
+            placement_for_ctx,
+        )
 
         seed = self.params.seed if self.params.seed is not None else ctx.seed
-        state = als_train_implicit(
-            pd.users, pd.items, pd.weights,
-            n_users=len(pd.user_bimap), n_items=len(pd.item_bimap),
-            rank=self.params.rank, iterations=self.params.num_iterations,
-            l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
-        )
+        n_users, n_items = len(pd.user_bimap), len(pd.item_bimap)
+        placement = placement_for_ctx(ctx, n_users, n_items)
+        if placement is not None:
+            # mesh-sharded implicit training (ALX layout): both tables
+            # row-sharded, each device solves its own rows (ops/als.py
+            # als_train_placed); model factors are unplaced for storage
+            from incubator_predictionio_tpu.ops.als import als_train_placed
+
+            state = placement.unplace_state(als_train_placed(
+                pd.users, pd.items, pd.weights,
+                n_users=n_users, n_items=n_items, placement=placement,
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                l2=self.params.lambda_, alpha=self.params.alpha,
+                seed=seed, implicit=True))
+        else:
+            state = als_train_implicit(
+                pd.users, pd.items, pd.weights,
+                n_users=n_users, n_items=n_items,
+                rank=self.params.rank,
+                iterations=self.params.num_iterations,
+                l2=self.params.lambda_, alpha=self.params.alpha,
+                seed=seed,
+            )
         factors = state.item_factors
         norm = jnp.linalg.norm(factors, axis=1, keepdims=True)
         factors_norm = factors / jnp.maximum(norm, 1e-9)
@@ -260,18 +281,26 @@ class SimilarProductAlgorithm(Algorithm):
             _plan_key,
         )
 
+        from incubator_predictionio_tpu.parallel.placement import (
+            placement_for_ctx,
+        )
+
         seed = self.params.seed if self.params.seed is not None else ctx.seed
+        n_users, n_items = len(pd.user_bimap), len(pd.item_bimap)
+        placement = placement_for_ctx(ctx, n_users, n_items)
         stats: Dict[str, Any] = {}
         state = als_retrain(
             pd.users, pd.items, pd.weights,
-            n_users=len(pd.user_bimap), n_items=len(pd.item_bimap),
+            n_users=n_users, n_items=n_items,
             rank=self.params.rank, iterations=self.params.num_iterations,
             l2=self.params.lambda_, alpha=self.params.alpha, seed=seed,
             implicit=True, plan_key=_plan_key("simprod", pd),
             prev_state=ALSState(
                 user_factors=np.zeros((0, self.params.rank), np.float32),
                 item_factors=prev_items),
-            stats=stats)
+            stats=stats, placement=placement)
+        if placement is not None:
+            state = placement.unplace_state(state)
         logger.info("similarproduct continuation retrain: %s sweeps "
                     "(mode=%s)", stats.get("sweeps_used"),
                     stats.get("mode"))

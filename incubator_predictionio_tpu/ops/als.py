@@ -36,16 +36,29 @@ import jax.numpy as jnp
 from incubator_predictionio_tpu.ops.sparse import (
     PaddedRows,
     build_both_sides,
+    build_padded_rows,
+    split_heavy,
 )
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class ALSState:
-    """Factor matrices (a pytree — checkpoints via workflow.checkpoint)."""
+    """Factor matrices (a pytree — checkpoints via workflow.checkpoint).
 
-    user_factors: Any  # [n_users, rank] f32
-    item_factors: Any  # [n_items, rank] f32
+    ``placement`` (STATIC pytree metadata, never a leaf) carries the
+    mesh-sharded layout when the tables are distributed — a
+    :class:`~incubator_predictionio_tpu.parallel.placement.FactorPlacement`
+    recording the mesh, per-table shardings and the padded sizes. None
+    (the default) is the single-chip layout; every existing constructor
+    site is unchanged. Being static, a placement change is a different
+    jit cache key: resharded programs recompile, same-placement
+    steady-state retrains never do."""
+
+    user_factors: Any  # [n_users, rank] f32 (padded when placed)
+    item_factors: Any  # [n_items, rank] f32 (padded when placed)
+    placement: Optional[Any] = dataclasses.field(
+        default=None, metadata=dict(static=True))
 
 
 def als_init(
@@ -1010,6 +1023,604 @@ def als_train_implicit(
     return out
 
 
+# ---------------------------------------------------------------------------
+# Mesh-sharded (placed) training — the full ALX layout (PAPERS.md: ALX §4).
+#
+# A FactorPlacement (parallel/placement.py) shards BOTH factor tables on
+# rows over the flattened mesh; interaction buckets are shard-blocked so
+# each device solves exactly the rows it owns; the other side's factors
+# move by explicit collectives inside shard_map (parallel/collectives.py):
+# an all-gather for tables narrow enough to replicate transiently, a
+# ppermute ring over table SLICES for wide ones — each device only ever
+# holds one slice of the wide table, which is what re-enables the fused
+# Gram+solve kernel's VMEM residency at big-table shapes. Updates are
+# shard-local by construction (each device scatters only its own rows:
+# the cross-replica weight-update-sharding pattern, arxiv 2004.13336).
+# The whole multi-sweep run is ONE dispatch; nothing crosses to the host.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _ShardCfg:
+    """Hashable static config of one placed run (jit cache key)."""
+
+    u_mode: str                 # gather strategy of the USER half-sweep
+    i_mode: str                 # ... and the item half-sweep
+    implicit: bool
+    reg_nnz: bool
+    l2: float
+    alpha: float
+    compute_dtype: Any
+    precision: Any
+    cg_iters: int
+    cg_tol: float
+    use_kernel: bool
+    kernel_min_d: int
+    kernel_rows: int
+    warmstart: bool
+    fused_u: bool
+    fused_i: bool
+
+
+def _shard_gather_modes(placement, rank: int, dtype: Any,
+                        implicit: bool) -> Tuple[str, str]:
+    """Per-half-sweep gather strategy → (user_sweep, item_sweep).
+
+    `PIO_SHARD_GATHER` = allgather | ring | auto (default). Auto keeps
+    the transient full-table all-gather while the gathered table stays
+    under `PIO_SHARD_ALLGATHER_MB` (default 64) AND inside the fused
+    kernel's VMEM table budget; it switches to the slice-resident ring
+    when the full table would blow either bound but its per-shard slice
+    still fits the VMEM budget — ring residency is what re-enables the
+    fused Gram+solve kernel on big-table sides (at ML-20M the 35 MB
+    user table routes ring and each ~4.4 MB bf16 slice pins in VMEM;
+    docs/performance.md "Sharded ALS"). The decision is per gather
+    SOURCE (user sweep gathers the item table and vice versa), resolved
+    here outside any trace."""
+    mode = os.environ.get("PIO_SHARD_GATHER", "auto")
+    if mode in ("allgather", "ring"):
+        return mode, mode
+    try:
+        cap_mb = float(os.environ.get("PIO_SHARD_ALLGATHER_MB", "64"))
+    except ValueError:
+        cap_mb = 64.0
+    item = jnp.dtype(jnp.float32 if implicit else dtype).itemsize
+    n = placement.n_shards
+
+    def one(table_rows: int) -> str:
+        from incubator_predictionio_tpu.ops.pallas_kernels import (
+            als_fused_fits,
+        )
+
+        dt = jnp.float32 if implicit else dtype
+        if table_rows * rank * item > cap_mb * (1 << 20):
+            return "ring"
+        if (n > 1 and not als_fused_fits(table_rows, rank, dt)
+                and als_fused_fits(-(-table_rows // n), rank, dt)):
+            return "ring"
+        return "allgather"
+
+    return one(placement.n_items_padded), one(placement.n_users_padded)
+
+
+def gather_source_rows(placement, side_gathered: str, mode: str) -> int:
+    """Rows of the array a half-sweep's gather hands the solve — the
+    FULL padded table under allgather, ONE slice under ring. This is
+    the shape the fused kernel pins in VMEM, and the ONE rule shared by
+    :func:`_fused_sides_placed` and bench_shard's ``shard_fused_fits_*``
+    acceptance keys (a second copy of this math could silently drift
+    from what the trainer actually routes)."""
+    full = (placement.n_users_padded if side_gathered == "user"
+            else placement.n_items_padded)
+    return (placement.shard_rows(side_gathered) if mode == "ring"
+            else full)
+
+
+def _fused_sides_placed(placement, modes: Tuple[str, str], implicit: bool,
+                        warm: bool, dtype: Any,
+                        rank: int) -> Tuple[bool, bool]:
+    """Sharded twin of :func:`_fused_sides`: the fused kernel pins the
+    gather source in VMEM, and under a placement that source is either
+    the transiently gathered FULL table (allgather mode) or one SLICE of
+    it (ring mode) — so `als_fused_fits` is checked against the
+    shard-local shape the kernel will actually pin (see
+    :func:`gather_source_rows`). Sharding is the MFU unlock: a table
+    over budget on one chip routes fused again once its slice fits."""
+    use_kernel = _kernel_enabled(implicit, warm=warm)
+    if not use_kernel:
+        return False, False
+    dt = jnp.float32 if implicit else dtype
+    return (
+        _fused_one(True, implicit, warm,
+                   gather_source_rows(placement, "item", modes[0]),
+                   rank, dt),
+        _fused_one(True, implicit, warm,
+                   gather_source_rows(placement, "user", modes[1]),
+                   rank, dt),
+    )
+
+
+def build_placed_sides(
+    users: np.ndarray,
+    items: np.ndarray,
+    vals: np.ndarray,
+    placement,
+    modes: Tuple[str, str],
+    max_width: int = 1 << 16,
+):
+    """Host-side prep of both orientations in their placed layouts →
+    (u_data, i_data), every leaf device-put sharded on axis 0.
+
+    allgather sides are shard-blocked single-chip buckets (cols global,
+    row ids localized per device; heavy split rows partitioned to their
+    owner so the partial-Gram reduction stays shard-local); ring sides
+    are the per-step pure/mixed layout of
+    :func:`~...parallel.sharding.build_ring_side`."""
+    from incubator_predictionio_tpu.parallel.sharding import (
+        build_ring_side,
+        localize_tree,
+        shard_block_buckets,
+        shard_block_heavy,
+    )
+
+    n = placement.n_shards
+    sharding = placement.table_sharding()
+
+    def put(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a), sharding), tree)
+
+    def one_side(side, rows, cols, other_side, mode):
+        sr_self = placement.shard_rows(side)
+        sr_other = placement.shard_rows(other_side)
+        if mode == "ring":
+            pure, mixed = build_ring_side(
+                rows, cols, vals, n, sr_self, sr_other,
+                max_width=max_width)
+            return put((pure, mixed))
+        light, heavy = split_heavy(build_padded_rows(
+            rows, cols, vals, sr_self * n, max_width=max_width))
+        tree = localize_tree(
+            shard_block_buckets(light, n, sr_self), n, sr_self)
+        return put((tree, shard_block_heavy(heavy, n, sr_self)))
+
+    return (one_side("user", users, items, "item", modes[0]),
+            one_side("item", items, users, "user", modes[1]))
+
+
+def _ring_sweep_side(
+    shard_rows_self: int,
+    other_local: jax.Array,     # [rows_other/n, K] — this device's slice
+    data,                       # (pure, mixed) local views
+    cfg: _ShardCfg,
+    placement,
+    prev_local: Optional[jax.Array],
+    fused: bool,
+) -> jax.Array:
+    """One placed half-sweep in ring mode (traced, inside shard_map).
+
+    The other table's slices rotate around the mesh ring (``ppermute``,
+    n−1 hops); at each step this device solves the PURE rows whose cols
+    all live in the currently held slice — complete systems, so the
+    fused gather+Gram+CG kernel applies with only the slice resident —
+    and accumulates partial Gram/RHS for MIXED rows (cols spanning
+    slices), which solve once after the ring via the same
+    partial-Gram-combining path as split rows (`_reg_solve` over the
+    segment sums). Peak residency is exactly two slices (current +
+    in-flight), never the full table."""
+    from incubator_predictionio_tpu.parallel.collectives import (
+        all_reduce_sum,
+        ppermute_next,
+    )
+
+    axes = placement.axes
+    n = placement.n_shards
+    pure, mixed = data
+    rank = other_local.shape[1]
+    out = jnp.zeros((shard_rows_self, rank), jnp.float32)
+    implicit = cfg.implicit
+    yty = (all_reduce_sum(_gram_all(other_local, cfg.precision), axes)
+           if implicit else None)
+    gsrc = other_local
+    if not implicit and other_local.dtype != cfg.compute_dtype:
+        gsrc = other_local.astype(cfg.compute_dtype)
+    if fused and cfg.use_kernel:
+        mp8 = -(-gsrc.shape[0] // 8) * 8
+        if mp8 != gsrc.shape[0]:
+            gsrc = jnp.pad(gsrc, ((0, mp8 - gsrc.shape[0]), (0, 0)))
+    h = mixed[0].shape[0] if mixed is not None else 0
+    mg = jnp.zeros((h + 1, rank, rank), jnp.float32)
+    mr = jnp.zeros((h + 1, rank), jnp.float32)
+    mn = jnp.zeros(h + 1, jnp.float32)
+    cur = gsrc
+    for s in range(n):
+        for rid_a, col_a, val_a, msk_a in pure:
+            rid, c, v, m = rid_a[s], col_a[s], val_a[s], msk_a[s]
+            x0 = (_gather_x0(prev_local, rid)
+                  if prev_local is not None else None)
+            # same solver dispatch as _sweep_side, and the same
+            # _solve_bucket_chunked streaming: ring mode exists for the
+            # catalog scale where a one-shot [B, D, K] gather temp would
+            # OOM, so pure buckets must keep the bounded-chunk guarantee
+            row_elems = None
+            if cfg.use_kernel and fused and c.shape[1] >= cfg.kernel_min_d:
+                from incubator_predictionio_tpu.ops.pallas_kernels import (
+                    als_fused_row_elems,
+                )
+
+                row_elems = als_fused_row_elems(c.shape[1], rank)
+
+                def solver(t, _cur=cur, _yty=yty):
+                    return _solve_bucket_fused(
+                        _cur, _yty, t[0], t[1], t[2], cfg.l2,
+                        reg_nnz=cfg.reg_nnz,
+                        cg_iters=cfg.cg_iters * (2 if implicit else 1),
+                        implicit=implicit, alpha=cfg.alpha,
+                        x0=t[3] if len(t) > 3 else None)
+            elif implicit:
+                def solver(t, _cur=cur, _yty=yty):
+                    return _solve_bucket_implicit(
+                        _cur, _yty, t[0], t[1], t[2], cfg.l2, cfg.alpha,
+                        precision=cfg.precision, cg_iters=cfg.cg_iters,
+                        x0=t[3] if len(t) > 3 else None,
+                        cg_tol=cfg.cg_tol)
+            elif cfg.use_kernel and c.shape[1] >= cfg.kernel_min_d:
+                from incubator_predictionio_tpu.ops.pallas_kernels import (
+                    als_padded_row_elems,
+                )
+
+                row_elems = als_padded_row_elems(c.shape[1], rank)
+
+                def solver(t, _cur=cur):
+                    return _solve_bucket_kernel(
+                        _cur, t[0], t[1], t[2], cfg.l2,
+                        reg_nnz=cfg.reg_nnz, cg_iters=cfg.cg_iters,
+                        kernel_rows=cfg.kernel_rows,
+                        x0=t[3] if len(t) > 3 else None)
+            else:
+                def solver(t, _cur=cur):
+                    return _solve_bucket(
+                        _cur, t[0], t[1], t[2], cfg.l2,
+                        reg_nnz=cfg.reg_nnz,
+                        compute_dtype=cfg.compute_dtype,
+                        precision=cfg.precision, cg_iters=cfg.cg_iters,
+                        x0=t[3] if len(t) > 3 else None,
+                        cg_tol=cfg.cg_tol)
+            sol = _solve_bucket_chunked(solver, c, v, m, rank,
+                                        row_elems=row_elems, x0=x0)
+            out = _scatter_rows_impl(out, rid, sol)
+        if mixed is not None:
+            _rid_m, sid_a, mc_a, mv_a, mm_a = mixed
+            pg, pr, pn = _gram_rhs_nnz(
+                cur, mc_a[s], mv_a[s], mm_a[s], cfg.compute_dtype,
+                cfg.precision, implicit, cfg.alpha)
+            sid = sid_a[s]
+            mg = mg + jax.ops.segment_sum(pg, sid, num_segments=h + 1)
+            mr = mr + jax.ops.segment_sum(pr, sid, num_segments=h + 1)
+            mn = mn + jax.ops.segment_sum(pn, sid, num_segments=h + 1)
+        if s < n - 1:
+            cur = ppermute_next(cur, axes)
+    if mixed is not None:
+        rid_m = mixed[0]
+        x0 = (_gather_x0(prev_local, rid_m)
+              if prev_local is not None else None)
+        sol = _reg_solve(
+            mg[:h], mr[:h], mn[:h], cfg.l2, cfg.reg_nnz, implicit, yty,
+            cg_iters=cfg.cg_iters,
+            cg_matvec_dtype=(jnp.float32 if implicit
+                             else cfg.compute_dtype),
+            x0=x0, cg_tol=cfg.cg_tol)
+        out = _scatter_rows_impl(out, rid_m, sol)
+    return out
+
+
+def _placed_half_sweep(side: str, other_local: jax.Array, data,
+                       cfg: _ShardCfg, placement,
+                       prev_local: Optional[jax.Array]) -> jax.Array:
+    """One half-sweep of the placed program (traced, inside shard_map):
+    solve the rows THIS device owns on ``side`` against the other
+    side's factors, moved by the side's gather strategy."""
+    from incubator_predictionio_tpu.parallel.collectives import all_gather
+
+    mode = cfg.u_mode if side == "user" else cfg.i_mode
+    fused = cfg.fused_u if side == "user" else cfg.fused_i
+    rows_local = placement.shard_rows(side)
+    if mode == "ring":
+        return _ring_sweep_side(rows_local, other_local, data, cfg,
+                                placement, prev_local, fused)
+    others = all_gather(other_local, placement.axes, axis=0, tiled=True)
+    tree, heavy = data
+    return _sweep_side(
+        rows_local, others, tree, heavy, cfg.l2, cfg.alpha, cfg.reg_nnz,
+        cfg.compute_dtype, cfg.precision, cfg.implicit,
+        cg_iters=cfg.cg_iters, use_kernel=cfg.use_kernel,
+        kernel_min_d=cfg.kernel_min_d, kernel_rows=cfg.kernel_rows,
+        prev_factors=prev_local, use_fused=fused, cg_tol=cfg.cg_tol)
+
+
+def _squeeze_ring(data, mode: str):
+    """Drop the sharded leading axis of a ring side's local views (the
+    allgather layout is flat — each device already sees its block)."""
+    if mode != "ring" or data is None:
+        return data
+    return jax.tree_util.tree_map(lambda a: a[0], data)
+
+
+def _placed_specs(placement, u_data, i_data):
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(placement.axes)
+    mk = functools.partial(jax.tree_util.tree_map, lambda _: spec)
+    return mk(u_data), mk(i_data)
+
+
+def _placed_sweep_pair(u_loc, i_loc, u_d, i_d, cfg, placement):
+    nu = _placed_half_sweep(
+        "user", i_loc, u_d, cfg, placement,
+        u_loc if cfg.warmstart else None)
+    nv = _placed_half_sweep(
+        "item", nu, i_d, cfg, placement,
+        i_loc if cfg.warmstart else None)
+    return nu, nv
+
+
+@functools.partial(
+    jax.jit, static_argnames=("placement", "cfg", "iterations"))
+def _als_run_placed(uf, vf, u_data, i_data, *, placement, cfg,
+                    iterations: int):
+    """Fixed-budget placed training: every sweep of every shard in ONE
+    dispatch (shard_map inside jit; collectives only, no host)."""
+    from jax.sharding import PartitionSpec as P
+
+    from incubator_predictionio_tpu.parallel.collectives import shard_map
+
+    spec = P(placement.axes)
+
+    def run(u_loc, i_loc, u_d, i_d):
+        u_d = _squeeze_ring(u_d, cfg.u_mode)
+        i_d = _squeeze_ring(i_d, cfg.i_mode)
+
+        def body(_, st):
+            return _placed_sweep_pair(st[0], st[1], u_d, i_d, cfg,
+                                      placement)
+
+        return jax.lax.fori_loop(0, iterations, body, (u_loc, i_loc))
+
+    specs_u, specs_i = _placed_specs(placement, u_data, i_data)
+    return shard_map(
+        run, mesh=placement.mesh,
+        in_specs=(spec, spec, specs_u, specs_i),
+        out_specs=(spec, spec), check_rep=False,
+    )(uf, vf, u_data, i_data)
+
+
+def _converge_placed_impl(uf, vf, u_data, i_data, tol, placement, cfg,
+                          max_sweeps: int, min_sweeps: int):
+    """Traceable early-stopping placed run → (uf, vf, sweeps, delta).
+
+    The plateau criterion is evaluated DEVICE-SIDE per sweep with the
+    partial factor-delta sums reduced across shards by one psum — the
+    sharded twin of :func:`_converge_impl`, still zero host syncs. Split
+    out un-jitted so ops/retrain.py can fuse the O(delta) splice
+    scatters into the SAME dispatch (`_converge_spliced_placed`)."""
+    from jax.sharding import PartitionSpec as P
+
+    from incubator_predictionio_tpu.parallel.collectives import (
+        all_reduce_sum,
+        shard_map,
+    )
+
+    spec = P(placement.axes)
+
+    def run(u_loc, i_loc, u_d, i_d):
+        u_d = _squeeze_ring(u_d, cfg.u_mode)
+        i_d = _squeeze_ring(i_d, cfg.i_mode)
+
+        def cond(carry):
+            i, _u, _v, d = carry
+            return jnp.logical_and(
+                i < max_sweeps,
+                jnp.logical_or(i < max(min_sweeps, 1), d >= tol))
+
+        def body(carry):
+            i, u, v, _d = carry
+            nu, nv = _placed_sweep_pair(u, v, u_d, i_d, cfg, placement)
+            num = (jnp.sum((nu - u) ** 2) + jnp.sum((nv - v) ** 2))
+            den = jnp.sum(u ** 2) + jnp.sum(v ** 2)
+            num = all_reduce_sum(num, placement.axes)
+            den = all_reduce_sum(den, placement.axes)
+            d = jnp.sqrt(num / jnp.maximum(den, 1e-30))
+            return i + 1, nu, nv, d
+
+        i, u, v, d = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), u_loc, i_loc, jnp.float32(jnp.inf)))
+        return u, v, i, d
+
+    specs_u, specs_i = _placed_specs(placement, u_data, i_data)
+    return shard_map(
+        run, mesh=placement.mesh,
+        in_specs=(spec, spec, specs_u, specs_i),
+        out_specs=(spec, spec, P(), P()), check_rep=False,
+    )(uf, vf, u_data, i_data)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("placement", "cfg", "max_sweeps", "min_sweeps"))
+def _als_converge_placed(uf, vf, u_data, i_data, tol, *, placement, cfg,
+                         max_sweeps: int, min_sweeps: int):
+    return _converge_placed_impl(uf, vf, u_data, i_data, tol, placement,
+                                 cfg, max_sweeps, min_sweeps)
+
+
+def _placed_cfg(placement, rank: int, implicit: bool, reg_nnz: bool,
+                l2: float, alpha: float, compute_dtype: Any,
+                precision: Any, cg_iters: int,
+                modes: Optional[Tuple[str, str]] = None) -> _ShardCfg:
+    """Resolve every env-dependent selector OUTSIDE the trace (kernel
+    probe, fused routing vs shard-local shapes, gather strategy) into
+    the hashable static config of one placed run."""
+    warm = _CG_WARMSTART
+    if modes is None:
+        modes = _shard_gather_modes(placement, rank, compute_dtype,
+                                    implicit)
+    fused_u, fused_i = _fused_sides_placed(
+        placement, modes, implicit, warm, compute_dtype, rank)
+    return _ShardCfg(
+        u_mode=modes[0], i_mode=modes[1], implicit=implicit,
+        reg_nnz=reg_nnz, l2=float(l2), alpha=float(alpha),
+        compute_dtype=compute_dtype, precision=precision,
+        cg_iters=int(cg_iters), cg_tol=_cg_tol_env(),
+        use_kernel=_kernel_enabled(implicit, warm=warm),
+        kernel_min_d=_KERNEL_MIN_D, kernel_rows=_kernel_rows_default(),
+        warmstart=warm, fused_u=fused_u, fused_i=fused_i)
+
+
+@functools.lru_cache(maxsize=32)
+def _replicate_jit(sharding):
+    """One compiled gather-to-replicated program per target sharding —
+    cached so the profiler's collective sample never re-traces."""
+    return jax.jit(
+        lambda a: jax.lax.with_sharding_constraint(a, sharding))
+
+
+def _profile_placed_collectives(placement, uf, vf,
+                                modes: Tuple[str, str]) -> None:
+    """PIO_PROFILE=1: sample the factor-gather collective under its own
+    op label ``als_allgather``. The sweep's gathers execute inside the
+    ONE training dispatch and cannot be timed there without breaking the
+    zero-host-sync contract; this times one standalone all-gather of
+    each gathered table on the same mesh (block-until-ready) — the
+    per-half-sweep unit collective cost, separable in /metrics next to
+    ``als_fused``/``als_sharded``. Off (the default) costs one enabled()
+    check."""
+    from incubator_predictionio_tpu.obs import profile as _profile
+
+    if placement.n_shards <= 1 or not _profile.enabled():
+        return
+    gather = _replicate_jit(placement.replicated())
+    for arr in (vf, uf):  # user sweep gathers items, item sweep users
+        # untimed warm run: compile/trace cost must not book as the
+        # collective's device time
+        jax.block_until_ready(gather(arr))
+        t0 = _profile.t0()
+        out = gather(arr)
+        _profile.record(t0, "train", "als_allgather", result=out)
+
+
+def _book_shard_metrics(placement, cfg: _ShardCfg, rank: int,
+                        sweeps: int) -> None:
+    """pio_shard_* observability (booked OUTSIDE any trace)."""
+    try:
+        from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+        reg = obs_metrics.REGISTRY
+        reg.gauge(
+            "pio_shard_mesh_devices",
+            "devices in the active factor-table mesh",
+        ).set(placement.n_shards)
+        rows = reg.gauge(
+            "pio_shard_rows", "factor-table rows per shard", labels=("side",))
+        rows.labels(side="user").set(placement.shard_rows("user"))
+        rows.labels(side="item").set(placement.shard_rows("item"))
+        gb = reg.counter(
+            "pio_shard_gather_bytes_total",
+            "bytes moved by factor-shard collectives, by strategy",
+            labels=("strategy",))
+        for side, mode in (("item", cfg.u_mode), ("user", cfg.i_mode)):
+            n = placement.n_shards
+            if n <= 1 or not sweeps:
+                continue
+            if mode == "allgather":
+                gb.labels(strategy="allgather").inc(
+                    placement.allgather_bytes(side, sweeps, rank))
+            else:
+                # ring: every slice visits every device once per sweep,
+                # rotated at the sweep's compute dtype (bf16 slices move
+                # half the bytes of f32; implicit always rotates f32)
+                rows_p = (placement.n_users_padded if side == "user"
+                          else placement.n_items_padded)
+                item = jnp.dtype(jnp.float32 if cfg.implicit
+                                 else cfg.compute_dtype).itemsize
+                gb.labels(strategy="ring").inc(
+                    rows_p * rank * item * (n - 1) * sweeps)
+    except Exception:  # pragma: no cover — telemetry must never fail a train
+        pass
+
+
+def als_train_placed(
+    users: np.ndarray,
+    items: np.ndarray,
+    ratings: np.ndarray,
+    n_users: int,
+    n_items: int,
+    mesh=None,
+    placement=None,
+    rank: int = 64,
+    iterations: int = 10,
+    l2: float = 0.1,
+    alpha: float = 1.0,
+    seed: int = 0,
+    reg_nnz: bool = True,
+    implicit: bool = False,
+    compute_dtype: Any = jnp.float32,
+    precision: Any = jax.lax.Precision.HIGHEST,
+    max_width: int = 1 << 16,
+    bf16_sweeps: int = 0,
+) -> ALSState:
+    """Placement-aware training over the mesh → a PLACED ALSState
+    (padded, tables sharded, ``state.placement`` set).
+
+    The returned tables stay distributed for sharded serving
+    (ops/topk.py per-shard merge) and sharded retrain; slice with
+    ``placement.unplace_state`` when a host-shaped model is needed."""
+    from incubator_predictionio_tpu.obs import profile as _profile
+    from incubator_predictionio_tpu.parallel.placement import (
+        make_placement,
+    )
+
+    if placement is None:
+        placement = make_placement(mesh, n_users, n_items)
+    modes = _shard_gather_modes(placement, rank, compute_dtype, implicit)
+    u_data, i_data = build_placed_sides(
+        users, items, ratings, placement, modes, max_width=max_width)
+    state0 = als_init(jax.random.key(seed), n_users, n_items, rank)
+    state = placement.place_state(state0)
+
+    _prof_t0 = _profile.t0()
+    lo = 0 if implicit else min(max(bf16_sweeps, 0), iterations)
+    uf, vf = state.user_factors, state.item_factors
+    if lo:
+        cfg_lo = _placed_cfg(
+            placement, rank, False, reg_nnz, l2, 0.0, jnp.bfloat16,
+            jax.lax.Precision.DEFAULT,
+            min(_CG_ITERS_BF16, _CG_ITERS), modes=modes)
+        uf, vf = _als_run_placed(uf, vf, u_data, i_data,
+                                 placement=placement, cfg=cfg_lo,
+                                 iterations=lo)
+    cfg = _placed_cfg(placement, rank, implicit, reg_nnz, l2, alpha,
+                      compute_dtype, precision, _CG_ITERS, modes=modes)
+    if iterations - lo:
+        uf, vf = _als_run_placed(uf, vf, u_data, i_data,
+                                 placement=placement, cfg=cfg,
+                                 iterations=iterations - lo)
+    out = ALSState(user_factors=uf, item_factors=vf, placement=placement)
+    if _prof_t0 is not None:
+        _profile.record(
+            _prof_t0, "train", "als_sharded", result=out,
+            flops_fn=lambda: train_flops(
+                len(ratings), n_users, n_items, rank, iterations, lo))
+    _profile_placed_collectives(placement, uf, vf, modes)
+    # book each leg at ITS dtype: bf16 sweeps rotate bf16 ring slices
+    # (half the bytes of the f32 leg)
+    if lo:
+        _book_shard_metrics(placement, cfg_lo, rank, lo)
+    _book_shard_metrics(placement, cfg, rank, iterations - lo)
+    from incubator_predictionio_tpu.ops.retrain import _book_sweeps
+
+    _book_sweeps("fresh", iterations)
+    return out
+
+
 def als_train_sharded(
     users: np.ndarray,
     items: np.ndarray,
@@ -1028,96 +1639,29 @@ def als_train_sharded(
     precision: Any = jax.lax.Precision.HIGHEST,
     max_width: int = 1 << 16,
     bf16_sweeps: int = 0,
+    keep_placed: bool = False,
 ) -> ALSState:
-    """Mesh-sharded training — the full ALX layout (PAPERS.md: ALX §4).
+    """Mesh-sharded training (the ALX layout) — the historical entry,
+    now a thin wrapper over :func:`als_train_placed`.
 
-    Placement is the whole parallelization (scaling-book recipe: annotate,
-    let GSPMD insert collectives): interaction buckets shard on rows over
-    the flattened (dp × mp) mesh; factor tables shard on rows over ``mp``
-    (halving per-device HBM at mp=2, etc.). The SAME traced program as the
-    single-chip fused run (:func:`_als_run_fused`) then compiles with an
-    all-gather of the other side's factor shards per half-sweep and a
-    sharded scatter of the solved rows — exactly the cross-device data flow
-    ALX schedules by hand. Numerics are identical to the unsharded run up
-    to floating-point reduction order.
-
-    Factor tables are padded to a multiple of the ``mp`` axis size; padding
-    rows are zero and never referenced, and the returned state is sliced
-    back to the true sizes.
-    """
-    from incubator_predictionio_tpu.parallel.mesh import MODEL_AXIS
-    from incubator_predictionio_tpu.parallel.sharding import (
-        model_sharding,
-        replicated,
+    Both factor tables shard on rows over the flattened mesh via a
+    :class:`~...parallel.placement.FactorPlacement`; half-sweeps run
+    under shard_map with each device solving the row buckets it owns.
+    Numerics match the unsharded run up to floating-point reduction
+    order. ``keep_placed=False`` (the historical contract) slices the
+    result back to the true sizes; ``keep_placed=True`` returns the
+    distributed state for sharded serving/retrain."""
+    from incubator_predictionio_tpu.parallel.placement import (
+        make_placement,
     )
 
-    n_dev = mesh.devices.size
-    mp = mesh.shape[MODEL_AXIS]
-
-    def round_up(x, m):
-        return -(-x // m) * m
-
-    n_users_p = round_up(n_users, mp)
-    n_items_p = round_up(n_items, mp)
-
-    (user_light, user_heavy), (item_light, item_heavy) = build_both_sides(
-        users, items, ratings, n_users, n_items, max_width=max_width,
-        row_multiple=n_dev, split_row_multiple=n_dev)
-
-    repl = replicated(mesh)
-    tables = model_sharding(mesh)
-
-    def place_tree(light):
-        # the ONE bucket-placement recipe (parallel/sharding.py) + the ONE
-        # tree conversion
-        from incubator_predictionio_tpu.parallel.sharding import (
-            shard_buckets,
-        )
-        return _buckets_tree(shard_buckets(light, mesh))
-
-    def place_heavy(heavy):
-        if heavy is None:
-            return None
-        # split segments are few; replicate them so the per-row
-        # segment-sum needs no cross-device reduction
-        return tuple(
-            jax.device_put(jnp.asarray(a), repl)
-            for a in (heavy.seg_ids, heavy.row_ids, heavy.cols, heavy.vals,
-                      heavy.mask)
-        )
-
-    state0 = als_init(jax.random.key(seed), n_users, n_items, rank)
-    state = ALSState(
-        user_factors=jax.device_put(
-            jnp.pad(state0.user_factors, ((0, n_users_p - n_users), (0, 0))),
-            tables),
-        item_factors=jax.device_put(
-            jnp.pad(state0.item_factors, ((0, n_items_p - n_items), (0, 0))),
-            tables),
-    )
-    u_tree, i_tree = place_tree(user_light), place_tree(item_light)
-    u_hv, i_hv = place_heavy(user_heavy), place_heavy(item_heavy)
-    if implicit:
-        out = _als_run_fused(
-            state, u_tree, i_tree, l2, alpha, iterations, reg_nnz,
-            compute_dtype, precision, implicit=True,
-            user_heavy=u_hv, item_heavy=i_hv,
-            # resolved HERE (outside the trace — a mid-trace global read
-            # would bake into the static cache key); the explicit branch
-            # gets the same default via _mixed_run's resolver
-            warmstart=_CG_WARMSTART,
-        )
-    else:
-        out = _mixed_run(
-            state, u_tree, i_tree, l2, iterations, bf16_sweeps,
-            reg_nnz, compute_dtype, precision,
-            user_heavy=u_hv, item_heavy=i_hv,
-            # pallas_call does not auto-partition under GSPMD — the
-            # sharded program keeps the XLA bucket assembly
-            use_kernel=False,
-        )
-    return ALSState(user_factors=out.user_factors[:n_users],
-                    item_factors=out.item_factors[:n_items])
+    placement = make_placement(mesh, n_users, n_items)
+    out = als_train_placed(
+        users, items, ratings, n_users, n_items, placement=placement,
+        rank=rank, iterations=iterations, l2=l2, alpha=alpha, seed=seed,
+        reg_nnz=reg_nnz, implicit=implicit, compute_dtype=compute_dtype,
+        precision=precision, max_width=max_width, bf16_sweeps=bf16_sweeps)
+    return out if keep_placed else placement.unplace_state(out)
 
 
 @jax.jit

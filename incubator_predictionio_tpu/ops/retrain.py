@@ -169,6 +169,17 @@ class _SidePlan:
     row_bucket: np.ndarray              # int32[n_rows], -1 = absent
     row_pos: np.ndarray                 # int32[n_rows]
     min_width: int = 8
+    #: mesh-sharded plans (FactorPlacement layout): buckets are
+    #: shard-blocked (rows grouped into n_shards equal contiguous
+    #: blocks, parallel/sharding.py), device trees carry SHARD-LOCAL row
+    #: ids and are device_put with the table sharding. All the splice
+    #: bookkeeping is flat-position based and therefore layout-blind:
+    #: a stay-row's (pos, slot) scatter lands on the owning shard by
+    #: construction (GSPMD routes the pointwise update to the device
+    #: holding that block).
+    n_shards: int = 1
+    shard_rows: int = 0
+    put_sharding: Any = None            # NamedSharding | None
     #: compaction bookkeeping: cleared (moved-away) slots never shrink a
     #: bucket and every retrain may append delta buckets — past these
     #: thresholds apply_tail refuses and the caller rebuilds a compact
@@ -181,9 +192,39 @@ class _SidePlan:
     #: double-buffered H2D), consumed by retrain's spliced converge
     pending: Optional[List[Any]] = None
 
+    def _tree_of(self, b: PaddedRows):
+        """Host bucket → device tree: shard-local ids + table sharding
+        for sharded plans, the plain single-chip tree otherwise."""
+        if self.n_shards > 1:
+            from incubator_predictionio_tpu.parallel.sharding import (
+                localize_tree,
+            )
+
+            t = localize_tree([b], self.n_shards, self.shard_rows)[0]
+        else:
+            t = als._buckets_tree([b])[0]
+        if self.put_sharding is not None:
+            t = tuple(jax.device_put(a, self.put_sharding) for a in t)
+        return t
+
+    def _build_delta(self, rows, cols, vals, n_rows, max_width,
+                     row_multiple) -> List[PaddedRows]:
+        delta = build_padded_rows(
+            rows, cols, vals, n_rows, min_width=self.min_width,
+            max_width=max_width, row_multiple=row_multiple)
+        if self.n_shards > 1:
+            from incubator_predictionio_tpu.parallel.sharding import (
+                shard_block_buckets,
+            )
+
+            delta = shard_block_buckets(delta, self.n_shards,
+                                        self.shard_rows)
+        return delta
+
     @staticmethod
     def build(buckets: List[PaddedRows], degrees: np.ndarray,
-              n_rows: int, min_width: int = 8) -> "_SidePlan":
+              n_rows: int, min_width: int = 8, n_shards: int = 1,
+              shard_rows: int = 0, put_sharding: Any = None) -> "_SidePlan":
         row_bucket = np.full(n_rows, -1, np.int32)
         row_pos = np.full(n_rows, -1, np.int32)
         for bi, b in enumerate(buckets):
@@ -191,12 +232,14 @@ class _SidePlan:
             live = np.flatnonzero(ids >= 0)
             row_bucket[ids[live]] = bi
             row_pos[ids[live]] = live.astype(np.int32)
-        return _SidePlan(
+        plan = _SidePlan(
             n_rows=n_rows, degrees=np.asarray(degrees, np.int64),
-            buckets=list(buckets),
-            trees=[als._buckets_tree([b])[0] for b in buckets],
+            buckets=list(buckets), trees=[],
             row_bucket=row_bucket, row_pos=row_pos, min_width=min_width,
-            init_buckets=len(buckets))
+            init_buckets=len(buckets), n_shards=n_shards,
+            shard_rows=shard_rows, put_sharding=put_sharding)
+        plan.trees = [plan._tree_of(b) for b in buckets]
+        return plan
 
     def _grow_to(self, n_rows: int) -> None:
         if n_rows > self.n_rows:
@@ -328,14 +371,13 @@ class _SidePlan:
             lut = np.zeros(n_rows, bool)
             lut[moved] = True
             sel = lut[full_rows]
-            delta = build_padded_rows(
+            delta = self._build_delta(
                 full_rows[sel], full_cols[sel], full_vals[sel], n_rows,
-                min_width=self.min_width, max_width=max_width,
-                row_multiple=row_multiple)
+                max_width, row_multiple)
             for b in delta:
                 bi = len(self.buckets)
                 self.buckets.append(b)
-                self.trees.append(als._buckets_tree([b])[0])
+                self.trees.append(self._tree_of(b))
                 if defer:
                     pending.append(None)  # fresh upload, nothing to splice
                 ids = np.asarray(b.row_ids)
@@ -367,6 +409,11 @@ class PrepPlan:
     row_multiple: int
     user: _SidePlan
     item: _SidePlan
+    #: FactorPlacement.cache_key() of the mesh geometry this plan's
+    #: buckets are blocked for (None = single-chip). A retrain under a
+    #: DIFFERENT placement (resharding) invalidates rather than splices:
+    #: correctness survives the reshard, the plan rebuilds once.
+    placement_key: Optional[str] = None
 
     def trees(self):
         """→ (u_tree, i_tree) in the ops/als fused-run format."""
@@ -398,9 +445,16 @@ def prepare_with_reuse(
     item_degrees: Optional[np.ndarray] = None,
     stats: Optional[Dict[str, Any]] = None,
     defer_splice: bool = False,
+    placement=None,
 ):
     """Degree-bucketed padded trees, reusing a resident plan when only a
     tail was appended → (u_tree, i_tree, u_heavy, i_heavy).
+
+    ``placement`` (a FactorPlacement) switches every structure to the
+    mesh-sharded layout: shard-blocked buckets with shard-local device
+    ids, sharded heavy segments, and a plan keyed on the placement's
+    shard geometry — a retrain at a different mesh shape invalidates
+    (rebuild once) instead of splicing into a stale layout.
 
     ``plan_key`` names the training stream (e.g. the event-log path);
     None disables reuse entirely (byte-identical to the fresh path).
@@ -419,13 +473,15 @@ def prepare_with_reuse(
     items = np.asarray(items)
     vals = np.asarray(vals, np.float32)
     nnz = len(vals)
+    pkey = placement.cache_key() if placement is not None else None
     plan = _PLAN_CACHE.get(plan_key) if (
         plan_key and plan_reuse_enabled()) else None
     if plan is not None:
         ok = (nnz >= plan.nnz and n_users >= plan.n_users
               and n_items >= plan.n_items
               and plan.max_width == max_width
-              and plan.row_multiple == row_multiple)
+              and plan.row_multiple == row_multiple
+              and plan.placement_key == pkey)
         if ok and verify_prefix:
             ok = _coo_digest(users, items, vals, plan.nnz) == plan.digest
         if ok:
@@ -470,26 +526,77 @@ def prepare_with_reuse(
         # ``plan_user_degrees``/``plan_item_degrees``) skip the native
         # degree pass; a wrong histogram is detected natively and redone
         user_degrees=user_degrees, item_degrees=item_degrees)
-    if plan_key and plan_reuse_enabled() and u_heavy is None \
-            and i_heavy is None:
+
+    def _adopt_plan(u_buckets, i_buckets, u_side_kw=None, i_side_kw=None):
+        """Insert a fresh PrepPlan (cap eviction, prefix digest, side
+        plans) and hand back its resident trees — ONE insert shared by
+        the placed and unplaced paths so the eviction/digest/field
+        logic cannot drift between them."""
         while len(_PLAN_CACHE) >= _PLAN_CACHE_CAP:
             _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
         new_plan = PrepPlan(
             key=plan_key, nnz=nnz,
             digest=_coo_digest(users, items, vals, nnz),
             n_users=n_users, n_items=n_items, max_width=max_width,
-            row_multiple=row_multiple,
+            row_multiple=row_multiple, placement_key=pkey,
             user=_SidePlan.build(
-                u_light,
+                u_buckets,
                 (user_degrees if user_degrees is not None
-                 else np.bincount(users, minlength=n_users)), n_users),
+                 else np.bincount(users, minlength=n_users)), n_users,
+                **(u_side_kw or {})),
             item=_SidePlan.build(
-                i_light,
+                i_buckets,
                 (item_degrees if item_degrees is not None
-                 else np.bincount(items, minlength=n_items)), n_items),
+                 else np.bincount(items, minlength=n_items)), n_items,
+                **(i_side_kw or {})),
         )
         _PLAN_CACHE[plan_key] = new_plan
-        u_tree, i_tree = new_plan.trees()
+        return new_plan.trees()
+
+    if placement is not None:
+        from incubator_predictionio_tpu.parallel.sharding import (
+            shard_block_buckets,
+            shard_block_heavy,
+        )
+
+        n_sh = placement.n_shards
+        sr_u = placement.shard_rows("user")
+        sr_i = placement.shard_rows("item")
+        sharding = placement.table_sharding()
+        u_blocks = shard_block_buckets(u_light, n_sh, sr_u)
+        i_blocks = shard_block_buckets(i_light, n_sh, sr_i)
+
+        def put_hv(hv):
+            if hv is None:
+                return None
+            return tuple(jax.device_put(jnp.asarray(a), sharding)
+                         for a in hv)
+
+        if plan_key and plan_reuse_enabled() and u_heavy is None \
+                and i_heavy is None:
+            u_tree, i_tree = _adopt_plan(
+                u_blocks, i_blocks,
+                dict(n_shards=n_sh, shard_rows=sr_u,
+                     put_sharding=sharding),
+                dict(n_shards=n_sh, shard_rows=sr_i,
+                     put_sharding=sharding))
+            return u_tree, i_tree, None, None
+        from incubator_predictionio_tpu.parallel.sharding import (
+            localize_tree,
+        )
+
+        def put_tree(tree):
+            return tuple(
+                tuple(jax.device_put(a, sharding) for a in b)
+                for b in tree)
+
+        return (put_tree(localize_tree(u_blocks, n_sh, sr_u)),
+                put_tree(localize_tree(i_blocks, n_sh, sr_i)),
+                put_hv(shard_block_heavy(u_heavy, n_sh, sr_u)),
+                put_hv(shard_block_heavy(i_heavy, n_sh, sr_i)))
+    if plan_key and plan_reuse_enabled() and u_heavy is None \
+            and i_heavy is None:
+        u_tree, i_tree = _adopt_plan(u_light, i_light)
         return u_tree, i_tree, None, None
     return (als._buckets_tree(u_light), als._buckets_tree(i_light),
             als._heavy_tree(u_heavy), als._heavy_tree(i_heavy))
@@ -640,6 +747,174 @@ def _converge_leg(state, u_tree, i_tree, l2, alpha, tol, budget, floor,
     return state, done, d, u_tree, i_tree
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("placement", "cfg", "max_sweeps", "min_sweeps"),
+)
+def _converge_spliced_placed(
+    uf, vf, u_tree, i_tree, u_splice, i_splice, u_hv, i_hv, tol, *,
+    placement, cfg, max_sweeps, min_sweeps,
+):
+    """THE one-dispatch continuation retrain under a mesh placement:
+    scatter the O(delta) splice vectors into the resident SHARDED trees
+    (GSPMD routes each pointwise update to the owning shard — the flat
+    positions live in that shard's block by construction), then run the
+    early-stopping shard_map sweep loop — all one jit, one dispatch per
+    shard group, zero host crossings. Returns the spliced trees so the
+    caller re-adopts them as the plan's residents."""
+    from jax.sharding import NamedSharding
+
+    u_tree = _splice_tree(u_tree, u_splice)
+    i_tree = _splice_tree(i_tree, i_splice)
+    sharding = NamedSharding(placement.mesh, placement.table_spec)
+    constrain = functools.partial(
+        jax.tree_util.tree_map,
+        lambda a: jax.lax.with_sharding_constraint(a, sharding))
+    u_tree, i_tree = constrain(u_tree), constrain(i_tree)
+    uf, vf, n, d = als._converge_placed_impl(
+        uf, vf, (u_tree, u_hv), (i_tree, i_hv), tol, placement, cfg,
+        max_sweeps, min_sweeps)
+    return uf, vf, n, d, u_tree, i_tree
+
+
+def _als_retrain_placed(
+    users, items, vals, n_users, n_items, rank, iterations, l2, alpha,
+    seed, reg_nnz, implicit, bf16_sweeps, compute_dtype, precision,
+    max_width, prev_state, tol, floor, plan_key, verify_prefix, stats,
+    placement,
+):
+    """Continuation retrain with mesh-sharded factor tables → a PLACED
+    ALSState. The sharded twin of the ``als_retrain`` body: plan-reuse
+    prep in the shard-blocked layout, deferred splices scattered inside
+    the training dispatch, device-side early stop with the factor-delta
+    plateau psum'd across shards. A previous model trained at ANY mesh
+    shape (including single-chip) seeds the continuation —
+    ``place_state`` re-distributes its true-size prefix under this
+    placement. The sharded path always runs the fused while_loop
+    schedule (the chunked ``PIO_RETRAIN_FUSED=0`` probe would cost one
+    sync per chunk per shard group).
+
+    Gather strategy: the plan-reuse splice layout is allgather-only
+    (splices scatter into resident shard-blocked trees). When the auto
+    strategy resolves RING for either half-sweep — the table too wide
+    to replicate transiently, exactly the catalog scale sharding exists
+    for — a full-table all-gather here would undo slice residency, so
+    the retrain preps fresh placed sides in the ring layout instead:
+    still the continuation warm start, still one dispatch, only the
+    O(delta) splice reuse is traded away."""
+    import time
+
+    modes = als._shard_gather_modes(placement, rank, compute_dtype,
+                                    implicit)
+    ring = "ring" in modes
+    t_prep = time.perf_counter()
+    if ring:
+        u_data, i_data = als.build_placed_sides(
+            users, items, vals, placement, modes, max_width=max_width)
+        (u_tree, u_hv), (i_tree, i_hv) = u_data, i_data
+        splices = None
+        stats["prep_plan"] = "ring-fresh"
+    else:
+        u_tree, i_tree, u_hv, i_hv = prepare_with_reuse(
+            users, items, vals, n_users, n_items, max_width=max_width,
+            plan_key=plan_key, verify_prefix=verify_prefix, stats=stats,
+            defer_splice=True, placement=placement)
+        splices = stats.pop("pending_splices", None)
+    stats["prep_wall_s"] = time.perf_counter() - t_prep
+
+    state = None
+    if prev_state is not None:
+        state = als.continue_state(
+            prev_state.user_factors, prev_state.item_factors,
+            n_users, n_items, seed=seed)
+        if state is not None and state.user_factors.shape[1] != rank:
+            state = None
+    mode = "continue" if state is not None else "fresh"
+    if state is None:
+        state = als.als_init(jax.random.key(seed), n_users, n_items, rank)
+    state = placement.place_state(state)
+
+    from incubator_predictionio_tpu.obs import profile as _profile
+
+    lo = 0 if implicit else min(max(bf16_sweeps, 0), iterations)
+    counter = {"n": 0}
+    sweeps, delta, bf16_used = 0, float("inf"), 0
+    uf, vf = state.user_factors, state.item_factors
+    spliced = splices is not None
+    # the last leg's cfg doubles as the metrics-booking cfg (no third
+    # gather-strategy/VMEM/probe resolution just to book telemetry)
+    cfg_book = als._placed_cfg(
+        placement, rank, implicit, reg_nnz, l2, alpha, compute_dtype,
+        precision, als._CG_ITERS, modes=modes)
+    _prof_t0 = _profile.t0()
+    try:
+        def leg(uf, vf, u_tree, i_tree, budget, leg_floor, cfg, splices):
+            if splices is not None:
+                uf, vf, n, d, u_tree, i_tree = _converge_spliced_placed(
+                    uf, vf, u_tree, i_tree, splices[0], splices[1],
+                    u_hv, i_hv, jnp.float32(tol), placement=placement,
+                    cfg=cfg, max_sweeps=budget, min_sweeps=leg_floor)
+            else:
+                uf, vf, n, d = als._als_converge_placed(
+                    uf, vf, (u_tree, u_hv), (i_tree, i_hv),
+                    jnp.float32(tol), placement=placement, cfg=cfg,
+                    max_sweeps=budget, min_sweeps=leg_floor)
+            counter["n"] += 1
+            return uf, vf, u_tree, i_tree, int(n), float(d)
+
+        if lo:
+            cfg_lo = als._placed_cfg(
+                placement, rank, False, reg_nnz, l2, 0.0, jnp.bfloat16,
+                jax.lax.Precision.DEFAULT,
+                min(als._CG_ITERS_BF16, als._CG_ITERS),
+                modes=modes)
+            uf, vf, u_tree, i_tree, n, delta = leg(
+                uf, vf, u_tree, i_tree, lo, min(floor, lo), cfg_lo,
+                splices)
+            splices = None
+            sweeps += n
+            bf16_used = n
+        if iterations - lo > 0:
+            uf, vf, u_tree, i_tree, n, delta = leg(
+                uf, vf, u_tree, i_tree, iterations - lo,
+                max(floor - sweeps, 1), cfg_book, splices)
+            splices = None
+            sweeps += n
+        if splices is not None:
+            u_tree = _apply_splices(u_tree, splices[0])
+            i_tree = _apply_splices(i_tree, splices[1])
+            counter["n"] += 2
+            splices = None
+        if spliced and plan_key:
+            commit_spliced_trees(plan_key, u_tree, i_tree)
+    except BaseException:
+        if plan_key:
+            _PLAN_CACHE.pop(plan_key, None)
+        raise
+    if _prof_t0 is not None and sweeps:
+        # PIO_PROFILE=1: device-time/MFU attribution over the sweeps
+        # actually run, under the SAME op label as als_train_placed so
+        # sharded training stays separable in /metrics next to the
+        # single-chip als_retrain/als_fused labels
+        _profile.record(
+            _prof_t0, "train", "als_sharded",
+            als.train_flops(len(vals), n_users, n_items, rank, sweeps,
+                            bf16_used, warmstart=cfg_book.warmstart),
+            uf)
+    stats.update(sweeps_used=sweeps, mode=mode, final_delta=delta,
+                 train_dispatches=counter["n"],
+                 one_dispatch=counter["n"] == 1)
+    _book_sweeps(mode, sweeps)
+    als._profile_placed_collectives(placement, uf, vf, modes)
+    # book each leg at ITS dtype (bf16 ring slices move half the bytes)
+    if bf16_used:
+        als._book_shard_metrics(placement, cfg_lo, rank, bf16_used)
+    als._book_shard_metrics(placement, cfg_book, rank,
+                            sweeps - bf16_used)
+    return als.ALSState(user_factors=uf, item_factors=vf,
+                        placement=placement)
+
+
 def als_retrain(
     users: np.ndarray,
     items: np.ndarray,
@@ -663,12 +938,18 @@ def als_retrain(
     plan_key: Optional[str] = None,
     verify_prefix: bool = True,
     stats: Optional[Dict[str, Any]] = None,
+    placement=None,
 ) -> als.ALSState:
     """Continuation-aware training: warm factors + early stop + plan
     reuse. With ``prev_state=None``, ``tol=0`` and ``plan_key=None``
     this runs exactly the fixed-budget schedule of ``als_train`` /
     ``als_train_implicit`` (their fresh paths stay byte-stable — this
     entry point exists so they don't have to change).
+
+    ``placement`` (a FactorPlacement) routes the whole retrain through
+    the mesh-sharded path (:func:`_als_retrain_placed`): sharded plan,
+    in-dispatch splices on the owning shards, psum'd early stop — and
+    returns a PLACED state.
 
     ``stats`` (a dict) receives ``sweeps_used``, ``mode``
     ("fresh"|"continue"), ``final_delta``, the prep-reuse counters, and
@@ -681,6 +962,12 @@ def als_retrain(
     tol = retrain_tol() if tol is None else float(tol)
     floor = retrain_min_sweeps() if min_sweeps is None else max(
         int(min_sweeps), 1)
+    if placement is not None:
+        return _als_retrain_placed(
+            users, items, vals, n_users, n_items, rank, iterations, l2,
+            alpha, seed, reg_nnz, implicit, bf16_sweeps, compute_dtype,
+            precision, max_width, prev_state, tol, floor, plan_key,
+            verify_prefix, stats, placement)
     t_prep = time.perf_counter()
     u_tree, i_tree, u_hv, i_hv = prepare_with_reuse(
         users, items, vals, n_users, n_items, max_width=max_width,

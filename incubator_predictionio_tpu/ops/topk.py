@@ -57,6 +57,142 @@ def _score_and_top_k_xla(
 PALLAS_MIN_ITEMS = 500_000
 
 
+# ---------------------------------------------------------------------------
+# Sharded serving: per-shard partial top-k + all-gather merge.
+#
+# With the item table row-sharded over the mesh (FactorPlacement), each
+# device scores ONLY its slice and ranks a local top-k; one [n, k]
+# all-gather then merges — the collective moves k rows per shard instead
+# of the full score vector, and the full [I] score vector never exists
+# anywhere. Serving routes here automatically when the table is actually
+# distributed (parallel/placement.py is_distributed).
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "valid_items", "mesh", "gather_user"))
+def _sharded_topk_jit(
+    user_vector,                # [K] or (user_factors, user_idx)
+    item_factors: jax.Array,    # [I_pad, K] row-sharded over mesh
+    exclude,                    # [E] int32 global ids or None
+    allowed_mask,               # [I_pad] bool or None
+    *,
+    k: int,
+    valid_items: int,
+    mesh,
+    gather_user: bool,
+):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from incubator_predictionio_tpu.parallel.collectives import (
+        all_gather,
+        axis_index,
+        shard_map,
+    )
+
+    axes = tuple(mesh.axis_names)
+    n = int(mesh.devices.size)
+    i_pad = item_factors.shape[0]
+    local_rows = i_pad // n
+    k_local = min(k, local_rows)
+    if gather_user:
+        uf, user_idx = user_vector
+        # one GSPMD gather from the sharded user table — the owning
+        # shard serves the row; no host crossing
+        uv = uf[user_idx]
+    else:
+        uv = user_vector
+    uv = jax.lax.with_sharding_constraint(
+        uv, NamedSharding(mesh, P()))
+
+    spec = P(axes)
+    args = [uv, item_factors]
+    specs = [P(), spec]
+    has_ex = exclude is not None
+    has_mask = allowed_mask is not None
+    if has_ex:
+        args.append(exclude)
+        specs.append(P())
+    if has_mask:
+        args.append(allowed_mask)
+        specs.append(spec)
+
+    def shard(uv_l, items_l, *rest):
+        rest = list(rest)
+        ex_l = rest.pop(0) if has_ex else None
+        mask_l = rest.pop(0) if has_mask else None
+        offset = axis_index(axes) * local_rows
+        scores = items_l @ uv_l                      # [local_rows]
+        rows_g = offset + jnp.arange(local_rows)
+        scores = jnp.where(rows_g < valid_items, scores, NEG_INF)
+        if mask_l is not None:
+            scores = jnp.where(mask_l, scores, NEG_INF)
+        if ex_l is not None:
+            loc = ex_l - offset
+            safe = jnp.where(
+                (loc >= 0) & (loc < local_rows), loc, local_rows)
+            scores = scores.at[safe].set(NEG_INF, mode="drop")
+        s_l, i_l = jax.lax.top_k(scores, k_local)    # partial top-k
+        merged_s = all_gather(s_l, axes, axis=0, tiled=True)
+        merged_i = all_gather(
+            (i_l + offset).astype(jnp.int32), axes, axis=0, tiled=True)
+        top_s, pos = jax.lax.top_k(merged_s, k)      # merge n·k → k
+        top_i = merged_i[pos]
+        return jnp.stack([top_s, top_i.astype(jnp.float32)])
+
+    return shard_map(
+        shard, mesh=mesh, in_specs=tuple(specs),
+        out_specs=P(), check_rep=False,
+    )(*args)
+
+
+def _fold_valid_mask(
+    allowed_mask: Optional[jax.Array],
+    item_factors: jax.Array,
+    valid_items: Optional[int],
+) -> Optional[jax.Array]:
+    """Fold a ``valid_items`` bound into the allowed mask for the
+    single-device paths (the sharded path masks by row offset instead,
+    without materializing an [I] array)."""
+    if valid_items is None or valid_items >= item_factors.shape[0]:
+        return allowed_mask
+    vm = jnp.arange(item_factors.shape[0]) < valid_items
+    if allowed_mask is None:
+        return vm
+    return jnp.asarray(allowed_mask, bool) & vm
+
+
+def sharded_top_k(
+    user_vector,                 # [K] vector OR (user_factors, user_idx)
+    item_factors: jax.Array,     # row-sharded [I_pad, K]
+    k: int,
+    exclude: Optional[jax.Array] = None,
+    allowed_mask: Optional[jax.Array] = None,
+    valid_items: Optional[int] = None,
+) -> jax.Array:
+    """Top-k over a mesh-sharded item table → packed [2, k] (replicated).
+
+    ``valid_items`` masks the placement's padding rows (zero factors
+    would otherwise outrank negative real scores); default = the full
+    padded table. ``allowed_mask`` shorter than the padded table is
+    padded False (padding is never servable)."""
+    _pt0 = _profile.t0()  # None on the PIO_PROFILE=0 default hot path
+    mesh = item_factors.sharding.mesh
+    i_pad = int(item_factors.shape[0])
+    valid = int(valid_items) if valid_items is not None else i_pad
+    gather_user = isinstance(user_vector, tuple)
+    if allowed_mask is not None and allowed_mask.shape[0] < i_pad:
+        allowed_mask = jnp.pad(
+            jnp.asarray(allowed_mask, bool),
+            (0, i_pad - allowed_mask.shape[0]))
+    kk = min(int(k), i_pad)
+    out = _sharded_topk_jit(
+        user_vector, item_factors, exclude, allowed_mask,
+        k=kk, valid_items=valid, mesh=mesh, gather_user=gather_user)
+    _profile.record(_pt0, "serve", "serve_topk_sharded",
+                    2.0 * i_pad * item_factors.shape[1], out)
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _score_user_top_k_xla(
     user_factors: jax.Array,        # [U, K]
@@ -78,6 +214,7 @@ def score_user_and_top_k(
     k: int,
     exclude: Optional[jax.Array] = None,
     allowed_mask: Optional[jax.Array] = None,
+    valid_items: Optional[int] = None,
 ) -> jax.Array:
     """Serving fast path: user-row gather + full-catalog scoring + top-k in
     ONE device call, packed [2, k].
@@ -85,7 +222,20 @@ def score_user_and_top_k(
     On a tunneled/remote TPU every separate op is a host round trip;
     indexing ``user_factors[user_idx]`` outside the jit would double the
     per-query latency. Callers fetch the packed result with one
-    ``np.asarray``."""
+    ``np.asarray``. ``valid_items`` masks trailing padding rows — a
+    PLACED table's pow2 capacity tail has zero factors, and score 0
+    would outrank genuinely negative real items — so any caller serving
+    a padded table directly must pass the true item count."""
+    from incubator_predictionio_tpu.parallel.placement import (
+        is_distributed,
+    )
+
+    if is_distributed(item_factors):
+        return sharded_top_k((user_factors, user_idx), item_factors, k,
+                             exclude=exclude, allowed_mask=allowed_mask,
+                             valid_items=valid_items)
+    allowed_mask = _fold_valid_mask(allowed_mask, item_factors,
+                                    valid_items)
     _pt0 = _profile.t0()
     if item_factors.shape[0] >= PALLAS_MIN_ITEMS and k <= 128:
         from incubator_predictionio_tpu.ops.pallas_kernels import (
@@ -110,14 +260,21 @@ def score_user_and_top_k(
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k", "valid_items"))
 def _batch_score_top_k_xla(
     user_factors: jax.Array,        # [U, K]
     item_factors: jax.Array,        # [I, K]
     rows: jax.Array,                # [B] int32 user indices
     k: int,
+    valid_items: Optional[int] = None,
 ) -> jax.Array:
     scores = user_factors[rows] @ item_factors.T          # [B, I] — MXU
+    if valid_items is not None and valid_items < item_factors.shape[0]:
+        # placed tables carry zero-factor padding rows; mask them out
+        # (score 0 would outrank genuinely negative real items). Under
+        # sharded operands GSPMD partitions the matmul + mask + top_k.
+        cols = jnp.arange(item_factors.shape[0])
+        scores = jnp.where(cols[None, :] < valid_items, scores, NEG_INF)
     top_s, top_i = jax.lax.top_k(scores, k)
     return jnp.stack([top_s, top_i.astype(jnp.float32)])  # [2, B, k]
 
@@ -134,6 +291,7 @@ def batch_score_top_k(
     item_factors: jax.Array,
     rows,                           # [B] int array of user indices
     k: int,
+    valid_items: Optional[int] = None,
 ) -> jax.Array:
     """Score B users against the whole catalog and rank, in ONE dispatch.
 
@@ -164,7 +322,8 @@ def batch_score_top_k(
             [rows_np, np.full(pad - B, rows_np[0], np.int32)])
     _pt0 = _profile.t0()  # None on the PIO_PROFILE=0 default hot path
     out = _batch_score_top_k_xla(user_factors, item_factors,
-                                 jnp.asarray(rows_np), k_pad)
+                                 jnp.asarray(rows_np), k_pad,
+                                 valid_items=valid_items)
     _profile.record(
         _pt0, "serve", "serve_topk_batch",
         2.0 * B * user_factors.shape[1] * item_factors.shape[0], out)
@@ -177,6 +336,7 @@ def score_and_top_k(
     k: int,
     exclude: Optional[jax.Array] = None,
     allowed_mask: Optional[jax.Array] = None,
+    valid_items: Optional[int] = None,
 ) -> jax.Array:
     """Full-catalog scoring + ranking in one fused device call.
 
@@ -185,8 +345,20 @@ def score_and_top_k(
     tunneled/remote TPU each fetch is a full round trip, so fetch count, not
     FLOPs, dominates query latency. Large catalogs on real TPU route to the
     Pallas blocked-candidate kernel (ops/pallas_kernels.py), which never
-    writes the full score vector to HBM.
+    writes the full score vector to HBM. ``valid_items`` masks a placed
+    table's zero-factor padding tail (see :func:`score_user_and_top_k`).
     """
+    from incubator_predictionio_tpu.parallel.placement import (
+        is_distributed,
+    )
+
+    if is_distributed(item_factors):
+        # placed serving: per-shard partial top-k + all-gather merge
+        return sharded_top_k(user_vector, item_factors, k,
+                             exclude=exclude, allowed_mask=allowed_mask,
+                             valid_items=valid_items)
+    allowed_mask = _fold_valid_mask(allowed_mask, item_factors,
+                                    valid_items)
     _pt0 = _profile.t0()  # None on the PIO_PROFILE=0 default hot path
     if item_factors.shape[0] >= PALLAS_MIN_ITEMS and k <= 128:
         from incubator_predictionio_tpu.ops.pallas_kernels import (

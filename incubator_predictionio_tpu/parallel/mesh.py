@@ -25,8 +25,27 @@ MODEL_AXIS = "mp"
 SEQ_AXIS = "sp"
 
 
+def forced_device_count() -> Optional[int]:
+    """`PIO_MESH_DEVICES` — cap the devices the standard mesh uses.
+
+    The multi-device simulation seam: the test fixture (and any
+    operator pinning a sub-mesh) sets this to run sharded paths on a
+    subset of the visible devices — e.g. mesh shapes {1, 2, 4, 8} on
+    the 8-device forced-host-platform CPU sim — without constructing
+    meshes by hand. Read per call (the env-import lint contract)."""
+    import os
+
+    raw = os.environ.get("PIO_MESH_DEVICES", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
 def device_count() -> int:
-    return jax.device_count()
+    n = forced_device_count()
+    return min(jax.device_count(), n) if n else jax.device_count()
 
 
 def mesh_shape_for(
@@ -56,6 +75,10 @@ def make_mesh(
     ALS sweep) on the innermost, fastest rings.
     """
     devs = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        forced = forced_device_count()
+        if forced:
+            devs = devs[:forced]
     dp, mp = mesh_shape_for(len(devs), model_parallelism)
     import numpy as np
 

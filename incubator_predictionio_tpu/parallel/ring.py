@@ -28,7 +28,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from incubator_predictionio_tpu.parallel.collectives import (
+    axis_size as _axis_size,
+    shard_map,
+)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from incubator_predictionio_tpu.ops.attention import (
@@ -42,7 +45,7 @@ from incubator_predictionio_tpu.parallel.mesh import SEQ_AXIS
 
 def _ring_attention_local(q, k, v, kv_valid, axis_name, causal, scale):
     """Per-shard body: q stays put, (k, v, kv_valid) rotate around the ring."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     sc = _scale(q, scale)

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import numpy as np
+
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -30,6 +32,267 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def model_sharding(mesh: Mesh) -> NamedSharding:
     """Parameter tables sharded on rows over the model axis (ALX layout)."""
     return NamedSharding(mesh, P(MODEL_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# shard-blocked bucket layout (the FactorPlacement training-data layout)
+# ---------------------------------------------------------------------------
+
+def shard_block_bucket(bucket: PaddedRows, n_shards: int,
+                       shard_rows: int) -> PaddedRows:
+    """Regroup one padded bucket into ``n_shards`` equal contiguous row
+    blocks ordered by owning shard (owner = row_id // shard_rows).
+
+    The flat result shards on axis 0 over the mesh: device ``s`` sees
+    exactly the rows it owns. ``row_ids`` stay GLOBAL here (the host
+    mirror / prep-plan convention); :func:`localize_tree` converts to
+    shard-local ids for the device trees. Padding rows (-1) fill each
+    block to the common size.
+    """
+    ids = np.asarray(bucket.row_ids)
+    live = np.flatnonzero(ids >= 0)
+    owner = ids[live] // shard_rows
+    counts = np.bincount(owner, minlength=n_shards)
+    b = max(int(counts.max()) if len(live) else 0, 1)
+    width = bucket.width
+    row_ids = np.full(n_shards * b, -1, np.int32)
+    cols = np.zeros((n_shards * b, width), np.int32)
+    vals = np.zeros((n_shards * b, width), np.float32)
+    mask = np.zeros((n_shards * b, width), np.float32)
+    order = np.argsort(owner, kind="stable")
+    src = live[order]
+    # positions: contiguous within each owner's block
+    within = np.arange(len(src)) - np.repeat(
+        np.cumsum(counts) - counts, counts)
+    pos = owner[order] * b + within
+    row_ids[pos] = ids[src]
+    cols[pos] = bucket.cols[src]
+    vals[pos] = bucket.vals[src]
+    mask[pos] = bucket.mask[src]
+    return PaddedRows(row_ids=row_ids, cols=cols, vals=vals, mask=mask)
+
+
+def shard_block_buckets(buckets: Sequence[PaddedRows], n_shards: int,
+                        shard_rows: int) -> list[PaddedRows]:
+    return [shard_block_bucket(b, n_shards, shard_rows) for b in buckets]
+
+
+def shard_block_heavy(heavy, n_shards: int, shard_rows: int):
+    """Shard-block :class:`~...ops.sparse.HeavySegments`: split-row
+    segments regroup by the owning shard of their row, padded to common
+    per-shard segment/row counts, ids localized.
+
+    Per-device view inside shard_map: ``(seg_ids [S], row_ids [H],
+    cols/vals/mask [S, W])`` — exactly the single-chip heavy tuple, so
+    ``ops.als._solve_heavy`` runs verbatim per shard (the partial-Gram
+    reduction stays shard-local: a row's segments all live with its
+    owner). Padding segments point at segment 0 with zero mask; padding
+    row slots carry id −1 (solved to 0, dropped at scatter).
+    """
+    if heavy is None:
+        return None
+    seg_ids = np.asarray(heavy.seg_ids)
+    row_ids = np.asarray(heavy.row_ids)
+    owner_row = row_ids // shard_rows
+    # host-side numpy over sparse.split_heavy output: every seg_id maps
+    # a real split segment to its row slot, never a -1 padding sentinel
+    owner_seg = owner_row[seg_ids]  # pio-lint: disable=neg-gather
+    h_counts = np.bincount(owner_row, minlength=n_shards)
+    s_counts = np.bincount(owner_seg, minlength=n_shards)
+    h = max(int(h_counts.max()), 1)
+    s = max(int(s_counts.max()), 1)
+    w = heavy.cols.shape[1]
+    out_rows = np.full(n_shards * h, -1, np.int32)
+    out_seg = np.zeros((n_shards, s), np.int32)
+    out_cols = np.zeros((n_shards * s, w), np.int32)
+    out_vals = np.zeros((n_shards * s, w), np.float32)
+    out_mask = np.zeros((n_shards * s, w), np.float32)
+    # heavy rows: contiguous per owner block, LOCAL ids
+    new_slot = np.empty(len(row_ids), np.int64)
+    for sh in range(n_shards):
+        rows_here = np.flatnonzero(owner_row == sh)
+        new_slot[rows_here] = np.arange(len(rows_here))
+        out_rows[sh * h + np.arange(len(rows_here))] = (
+            row_ids[rows_here] - sh * shard_rows)
+        segs_here = np.flatnonzero(owner_seg == sh)
+        out_seg[sh, : len(segs_here)] = new_slot[seg_ids[segs_here]]
+        dst = sh * s + np.arange(len(segs_here))
+        out_cols[dst] = heavy.cols[segs_here]
+        out_vals[dst] = heavy.vals[segs_here]
+        out_mask[dst] = heavy.mask[segs_here]
+    return (out_seg.reshape(n_shards * s), out_rows,
+            out_cols, out_vals, out_mask)
+
+
+def localize_tree(buckets: Sequence[PaddedRows], n_shards: int,
+                  shard_rows: int):
+    """Shard-blocked buckets → device trees with SHARD-LOCAL row ids
+    (``ops.als._buckets_tree`` format). The owner of flat position ``p``
+    is ``p // block`` by construction, so localization is arithmetic."""
+    import jax.numpy as jnp
+
+    out = []
+    for b in buckets:
+        ids = np.asarray(b.row_ids)
+        block = len(ids) // n_shards
+        owner = np.arange(len(ids)) // block
+        local = np.where(ids >= 0, ids - owner * shard_rows, -1)
+        out.append((jnp.asarray(local.astype(np.int32)),
+                    jnp.asarray(b.cols), jnp.asarray(b.vals),
+                    jnp.asarray(b.mask)))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# ring layout: wide-table half-sweeps against rotating table slices
+# ---------------------------------------------------------------------------
+
+def build_ring_side(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_shards: int,
+    shard_rows_self: int,
+    shard_rows_other: int,
+    min_width: int = 8,
+    max_width: int = 1 << 16,
+):
+    """One orientation's interactions in the ring ragged-gather layout.
+
+    At ring step ``s`` device ``r`` holds the other table's slice
+    ``c = (r − s) mod n`` (``ppermute_next`` rotation), so every
+    interaction is assigned to step ``s = (owner(row) − owner(col)) mod
+    n`` and its col id is localized to that slice. Rows whose cols all
+    live in ONE slice ("pure") solve completely at their step — the
+    fused Gram+solve kernel applies with only the slice resident; rows
+    spanning slices ("mixed") accumulate partial Grams across steps and
+    solve once after the ring (the ALX cross-shard reduction,
+    shard-local per owner).
+
+    Returns ``(pure, mixed)``:
+
+    - ``pure``: tuple per width class of ``(row_ids [n, steps, B],
+      cols/vals/mask [n, steps, B, w])`` — dim 0 shards over the mesh,
+      row ids local to the owner, col ids local to the step's slice.
+    - ``mixed``: ``None`` or ``(row_ids [n, H], seg_ids [n, steps, S],
+      cols/vals/mask [n, steps, S, W])`` with ``seg_ids`` indexing the
+      local row list (padding → H, dropped after the segment sum).
+    """
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    vals = np.asarray(vals, np.float32)
+    n = n_shards
+    owner_r = rows // shard_rows_self
+    owner_c = cols // shard_rows_other
+    step = (owner_r - owner_c) % n
+    # stable sort by (row, step): contiguous (row, step) segments
+    order = np.lexsort((step, rows))
+    rows_s, vals_s, step_s = rows[order], vals[order], step[order]
+    loc_cols = (cols - owner_c * shard_rows_other)[order]
+
+    uniq_rows, row_start, row_deg = np.unique(
+        rows_s, return_index=True, return_counts=True)
+    # distinct steps per row: count boundaries of (row, step) pairs
+    pair_key = rows_s * n + step_s
+    pair_uniq, pair_start, pair_cnt = np.unique(
+        pair_key, return_index=True, return_counts=True)
+    pair_row = pair_uniq // n
+    pair_step = (pair_uniq % n).astype(np.int64)
+    steps_per_row = np.bincount(
+        np.searchsorted(uniq_rows, pair_row), minlength=len(uniq_rows))
+    pure_mask_row = (steps_per_row == 1) & (row_deg <= max_width)
+    row_is_pure = dict(
+        zip(uniq_rows.tolist(), pure_mask_row.tolist()))
+
+    # -- pure rows: bucket by (owner, step, width class) --------------------
+    classes: dict[int, list] = {}
+    mixed_pairs: list = []
+    for pi in range(len(pair_uniq)):
+        rid = int(pair_row[pi])
+        if row_is_pure[rid]:
+            d = int(pair_cnt[pi])
+            w = min_width
+            while w < d:
+                w *= 2
+            classes.setdefault(w, []).append(pi)
+        else:
+            mixed_pairs.append(pi)
+
+    def _pair_block(pi):
+        a, c = int(pair_start[pi]), int(pair_cnt[pi])
+        return loc_cols[a:a + c], vals_s[a:a + c]
+
+    pure = []
+    for w in sorted(classes):
+        members = classes[w]
+        counts = np.zeros((n, n), np.int64)
+        for pi in members:
+            counts[int(pair_row[pi]) // shard_rows_self,
+                   int(pair_step[pi])] += 1
+        b = max(int(counts.max()), 1)
+        rid_a = np.full((n, n, b), -1, np.int32)
+        col_a = np.zeros((n, n, b, w), np.int32)
+        val_a = np.zeros((n, n, b, w), np.float32)
+        msk_a = np.zeros((n, n, b, w), np.float32)
+        fill = np.zeros((n, n), np.int64)
+        for pi in members:
+            rid = int(pair_row[pi])
+            sh, st = rid // shard_rows_self, int(pair_step[pi])
+            k = int(fill[sh, st]); fill[sh, st] += 1
+            c, v = _pair_block(pi)
+            rid_a[sh, st, k] = rid - sh * shard_rows_self
+            col_a[sh, st, k, : len(c)] = c
+            val_a[sh, st, k, : len(v)] = v
+            msk_a[sh, st, k, : len(c)] = 1.0
+        pure.append((rid_a, col_a, val_a, msk_a))
+
+    # -- mixed rows: per-step segments + shard-local row lists --------------
+    mixed = None
+    if mixed_pairs:
+        mixed_rows = np.unique(pair_row[mixed_pairs])
+        owner_m = mixed_rows // shard_rows_self
+        h_counts = np.bincount(owner_m, minlength=n)
+        h = max(int(h_counts.max()), 1)
+        slot_of: dict[int, int] = {}
+        rid_m = np.full((n, h), -1, np.int32)
+        fill_h = np.zeros(n, np.int64)
+        for rid in mixed_rows.tolist():
+            sh = rid // shard_rows_self
+            k = int(fill_h[sh]); fill_h[sh] += 1
+            slot_of[rid] = k
+            rid_m[sh, k] = rid - sh * shard_rows_self
+        # split over-wide (row, step) groups into ≤ seg_w chunks
+        segs: list = []  # (shard, step, slot, cols, vals)
+        seg_w = 0
+        for pi in mixed_pairs:
+            rid = int(pair_row[pi])
+            sh, st = rid // shard_rows_self, int(pair_step[pi])
+            c, v = _pair_block(pi)
+            cap = max_width
+            for off in range(0, len(c), cap):
+                cc, vv = c[off:off + cap], v[off:off + cap]
+                segs.append((sh, st, slot_of[rid], cc, vv))
+                seg_w = max(seg_w, len(cc))
+        w = min_width
+        while w < seg_w:
+            w *= 2
+        s_counts = np.zeros((n, n), np.int64)
+        for sh, st, *_ in segs:
+            s_counts[sh, st] += 1
+        s_max = max(int(s_counts.max()), 1)
+        sid_a = np.full((n, n, s_max), h, np.int32)  # sentinel → dropped
+        col_a = np.zeros((n, n, s_max, w), np.int32)
+        val_a = np.zeros((n, n, s_max, w), np.float32)
+        msk_a = np.zeros((n, n, s_max, w), np.float32)
+        fill = np.zeros((n, n), np.int64)
+        for sh, st, slot, cc, vv in segs:
+            k = int(fill[sh, st]); fill[sh, st] += 1
+            sid_a[sh, st, k] = slot
+            col_a[sh, st, k, : len(cc)] = cc
+            val_a[sh, st, k, : len(vv)] = vv
+            msk_a[sh, st, k, : len(cc)] = 1.0
+        mixed = (rid_m, sid_a, col_a, val_a, msk_a)
+    return tuple(pure), mixed
 
 
 def shard_bucket(bucket: PaddedRows, mesh: Mesh) -> PaddedRows:

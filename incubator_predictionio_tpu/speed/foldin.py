@@ -152,7 +152,17 @@ class FoldInSolver:
         cg_iters: Optional[int] = None,
         use_kernel: Optional[bool] = None,
     ) -> None:
+        from incubator_predictionio_tpu.parallel.placement import (
+            is_distributed,
+        )
+
+        # a mesh-sharded frozen table (a placed model's factors) is
+        # served AS-IS: jnp.asarray keeps the sharding, the ladder
+        # solves run under plain jit and GSPMD routes each history's
+        # gathers to the owning shard — no host round trip, no
+        # full-table replication on the serving host
         self.other_factors = jnp.asarray(other_factors, jnp.float32)
+        self.sharded = is_distributed(self.other_factors)
         self.rank = int(self.other_factors.shape[1])
         self.l2 = float(l2)
         self.reg_nnz = bool(reg_nnz)
@@ -175,7 +185,9 @@ class FoldInSolver:
         if use_kernel is None:
             use_kernel = fits and _als._fused_enabled(self.implicit,
                                                       warm=False)
-        self.use_kernel = bool(use_kernel) and fits
+        # pallas_call does not auto-partition under GSPMD: a sharded
+        # frozen table always serves through the XLA assembly
+        self.use_kernel = bool(use_kernel) and fits and not self.sharded
         # the batch-shared YᵗY of implicit ALS: computed ONCE per deploy
         # (it only depends on the frozen table), not once per fold-in
         self._yty = (_als._gram_all(self.other_factors,

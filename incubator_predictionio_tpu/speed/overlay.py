@@ -116,6 +116,14 @@ class SpeedOverlay:
         clock: Optional[Callable[[], float]] = None,
     ) -> None:
         self.config = config
+        # the frozen table may be a MESH-SHARDED placed table
+        # (parallel/placement.py): the solver serves it as-is — ladder
+        # solves run under plain jit with GSPMD routing each history's
+        # gathers to the owning shard, and only the tiny [K] fold-in
+        # vectors ever reach this host (`solver.sharded` surfaces the
+        # layout in /status). No full-table replication on the serving
+        # box — the property that lets the speed layer ride a catalog
+        # no single chip could hold.
         self.solver = FoldInSolver(
             other_factors, l2=config.l2, reg_nnz=config.reg_nnz,
             implicit=config.implicit, alpha=config.alpha)
@@ -235,6 +243,7 @@ class SpeedOverlay:
                 "foldins": self.foldins,
                 "cursor": self.cursor,
                 "cursorLagEvents": self.last_lag,
+                "shardedTable": self.solver.sharded,
             }
 
     # -- lifecycle ----------------------------------------------------------

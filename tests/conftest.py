@@ -24,6 +24,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Multi-device simulation guard: if some plugin imported + initialized
+# jax BEFORE this conftest could set XLA_FLAGS, the force-host-device
+# flag never took and every sharded-path test would silently run on one
+# device. Re-exec the test process ONCE with the flag exported so the
+# backend initializes at 8 virtual devices (opt out: PIO_TEST_REEXEC=0).
+import sys  # noqa: E402
+
+if (os.environ.get("PIO_TEST_REEXEC", "1") != "0"
+        and not os.environ.get("_PIO_TEST_REEXECED")
+        and jax.device_count() == 1):
+    os.environ["_PIO_TEST_REEXECED"] = "1"
+    os.execv(sys.executable,
+             [sys.executable, "-m", "pytest", *sys.argv[1:]])
+
 import pytest  # noqa: E402
 
 
@@ -32,3 +46,19 @@ def tmp_home(tmp_path, monkeypatch):
     """Isolated PIO home directory for storage-backed tests."""
     monkeypatch.setenv("PIO_HOME", str(tmp_path))
     return tmp_path
+
+
+@pytest.fixture
+def sub_mesh():
+    """Mesh over the first N virtual devices — the sharded-path tests'
+    seam for exercising mesh shapes {1, 2, 4, 8} on the CPU sim
+    (parallel/mesh.py ``make_mesh``/``forced_device_count``)."""
+    from incubator_predictionio_tpu.parallel.mesh import make_mesh
+
+    def make(n: int, model_parallelism: int = 1):
+        if jax.device_count() < n:
+            pytest.skip(f"needs {n} devices")
+        return make_mesh(devices=jax.devices()[:n],
+                         model_parallelism=model_parallelism)
+
+    return make

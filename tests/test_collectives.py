@@ -6,10 +6,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from incubator_predictionio_tpu.parallel import collectives as C
+from incubator_predictionio_tpu.parallel.collectives import shard_map
 from incubator_predictionio_tpu.parallel.distributed import (
     ensure_initialized,
     host_local_batch_slice,

@@ -253,6 +253,35 @@ def serve(x, ids):
     return out
 """,
     ),
+    "host-gather-in-mesh": (
+        """
+import numpy as np
+import jax
+
+def train_loop(mesh, step, xs):
+    with mesh:
+        out = step(xs)
+        host = np.asarray(out)
+        ids = out.tolist()
+        return jax.device_get(host), ids
+""",
+        """
+import numpy as np
+import jax
+
+def train_loop(mesh, step, xs):
+    with mesh:
+        out = step(xs)
+
+    def fetch(v):
+        # a function DEFINED under a mesh elsewhere is not a gather;
+        # shard_map-traced bodies are host-sync's jurisdiction
+        return np.asarray(v)
+
+    # the sanctioned pattern: one fetch after the mesh context closes
+    return fetch(out)
+""",
+    ),
     "blocking-profiler": (
         """
 import jax

@@ -1,0 +1,543 @@
+"""Mesh-sharded factor tables: parity, resharding, fold-in, top-k merge.
+
+The ALX-style placement refactor's safety net, on the 8-virtual-device
+CPU sim (conftest forces ``--xla_force_host_platform_device_count=8``):
+
+- **factor parity** — ``als_train_placed`` matches the single-chip
+  trainer at mesh shapes {1, 2, 4, 8}, explicit AND implicit, fused
+  kernel on and off, over BOTH gather strategies (transient all-gather
+  and the slice-resident ppermute ring); allgather × fused is bitwise
+  against the single-chip fused run (same per-bucket systems, same
+  reduction order), everything else ≤ 1e-5 relative;
+- **continuation retrain under a placement** — matches the single-chip
+  retrain, stays ONE device dispatch (splice scatters inside the
+  training jit), reuses the sharded plan on a same-geometry retrain,
+  and *invalidates* (rebuild once, correct results) when the mesh shape
+  changes under a live plan key — the resharding contract;
+- **continue_state across mesh shapes** — a model trained at one mesh
+  shape re-distributes under another via ``place_state``;
+- **fold-in on a sharded frozen table** — the speed layer's ladder
+  solves against a distributed other-side table match the replicated
+  solver (GSPMD routes each history's gathers to the owning shard);
+- **sharded top-k** — per-shard partial top-k + all-gather merge is
+  equivalent to the dense reference, including exclusions, allowed
+  masks and placement-padding masking, and serving auto-routes to it
+  whenever the item table is actually distributed;
+- **seams** — ``PIO_MESH_DEVICES`` caps the standard mesh (the
+  sub-mesh test seam) and ``PIO_SHARD_TABLES``/``model_parallelism``
+  gate ``placement_for_ctx``; ``pio_shard_*`` gauges are booked by
+  placed training.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.ops import als, retrain, topk
+from incubator_predictionio_tpu.parallel.mesh import make_mesh
+from incubator_predictionio_tpu.parallel.placement import (
+    FactorPlacement,
+    is_distributed,
+    make_placement,
+    placement_for_ctx,
+)
+from incubator_predictionio_tpu.speed.foldin import FoldInSolver
+
+N_USERS, N_ITEMS, NNZ, RANK = 50, 37, 600, 8
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plans():
+    retrain.drop_plans()
+    yield
+    retrain.drop_plans()
+
+
+def _need(n: int):
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices, have {jax.device_count()}")
+
+
+def _mesh(n: int):
+    _need(n)
+    return make_mesh(devices=jax.devices()[:n])
+
+
+def _data(seed=0, nnz=NNZ, n_users=N_USERS, n_items=N_ITEMS):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, n_users, nnz).astype(np.int32),
+            rng.integers(0, n_items, nnz).astype(np.int32),
+            rng.uniform(1, 5, nnz).astype(np.float32))
+
+
+def _rel(got, ref):
+    got, ref = np.asarray(got), np.asarray(ref)
+    return float(np.max(np.abs(got - ref))
+                 / max(float(np.max(np.abs(ref))), 1e-9))
+
+
+def _force_fused(monkeypatch):
+    """Interpret-mode hook: route every bucket through the fused
+    gather+Gram+CG Pallas kernel (the PR 7 test convention)."""
+    monkeypatch.setattr(als, "_ALS_KERNEL", "on")
+    monkeypatch.setattr(als, "_KERNEL_MIN_D", 0)
+    monkeypatch.setenv("PIO_ALS_FUSED_GRAM", "on")
+
+
+# ---------------------------------------------------------------------------
+# training parity: sharded vs single-chip
+# ---------------------------------------------------------------------------
+
+class TestPlacedTrainingParity:
+    """als_train_placed ≡ the single-chip path at every mesh shape."""
+
+    def _reference(self, users, items, vals, implicit):
+        if implicit:
+            return als.als_train_implicit(
+                users, items, vals, n_users=N_USERS, n_items=N_ITEMS,
+                rank=RANK, iterations=2, l2=0.1, seed=0)
+        state, _ = als.als_train(
+            users, items, vals, n_users=N_USERS, n_items=N_ITEMS,
+            rank=RANK, iterations=2, l2=0.1, seed=0)
+        return state
+
+    def _placed(self, n, gather, implicit, monkeypatch):
+        users, items, vals = _data()
+        monkeypatch.setenv("PIO_SHARD_GATHER", gather)
+        placement = make_placement(_mesh(n), N_USERS, N_ITEMS)
+        out = als.als_train_placed(
+            users, items, vals, N_USERS, N_ITEMS, placement=placement,
+            rank=RANK, iterations=2, l2=0.1, seed=0, implicit=implicit)
+        assert out.placement is placement
+        if n > 1:
+            assert is_distributed(out.user_factors)
+            assert is_distributed(out.item_factors)
+        ref = self._reference(users, items, vals, implicit)
+        return placement.unplace_state(out), ref
+
+    # gather strategy alternates with the mesh shape so both the
+    # transient all-gather and the ppermute ring cover multi-shard
+    # meshes; a dedicated test below pins allgather ≡ ring at n=4
+    @pytest.mark.parametrize("implicit", [False, True])
+    @pytest.mark.parametrize("n,gather", [
+        (1, "allgather"), (2, "ring"), (4, "allgather"), (8, "ring"),
+    ])
+    def test_unfused_parity(self, n, gather, implicit, monkeypatch):
+        monkeypatch.setattr(als, "_ALS_KERNEL", "off")
+        got, ref = self._placed(n, gather, implicit, monkeypatch)
+        assert _rel(got.user_factors, ref.user_factors) < 1e-5
+        assert _rel(got.item_factors, ref.item_factors) < 1e-5
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    @pytest.mark.parametrize("n,gather", [
+        (2, "allgather"), (4, "ring"), (8, "allgather"),
+    ])
+    def test_fused_parity(self, n, gather, implicit, monkeypatch):
+        """The fused Pallas kernel runs per shard INSIDE shard_map on
+        shard-local table slices; allgather mode solves the identical
+        per-bucket systems in the identical order as the single-chip
+        fused run, so parity there is BITWISE."""
+        _force_fused(monkeypatch)
+        cfg = als._placed_cfg(
+            make_placement(_mesh(n), N_USERS, N_ITEMS), RANK, implicit,
+            True, 0.1, 1.0, jnp.float32, jax.lax.Precision.HIGHEST,
+            als._CG_ITERS)
+        assert cfg.fused_u and cfg.fused_i  # routing actually engaged
+        got, ref = self._placed(n, gather, implicit, monkeypatch)
+        if gather == "allgather":
+            assert np.array_equal(np.asarray(got.user_factors),
+                                  np.asarray(ref.user_factors))
+            assert np.array_equal(np.asarray(got.item_factors),
+                                  np.asarray(ref.item_factors))
+        else:
+            assert _rel(got.user_factors, ref.user_factors) < 1e-5
+            assert _rel(got.item_factors, ref.item_factors) < 1e-5
+
+    def test_allgather_matches_ring(self, monkeypatch):
+        users, items, vals = _data(7)
+        outs = {}
+        for gather in ("allgather", "ring"):
+            monkeypatch.setenv("PIO_SHARD_GATHER", gather)
+            placement = make_placement(_mesh(4), N_USERS, N_ITEMS)
+            outs[gather] = placement.unplace_state(als.als_train_placed(
+                users, items, vals, N_USERS, N_ITEMS,
+                placement=placement, rank=RANK, iterations=2, l2=0.1,
+                seed=0))
+        assert _rel(outs["ring"].user_factors,
+                    outs["allgather"].user_factors) < 1e-5
+
+    def test_legacy_sharded_entry_still_host_shaped(self, monkeypatch):
+        """als_train_sharded keeps its historical contract: true-size
+        host-shaped factors (now via the placement wrapper)."""
+        users, items, vals = _data()
+        state = als.als_train_sharded(
+            users, items, vals, N_USERS, N_ITEMS, _mesh(2),
+            rank=RANK, iterations=2, l2=0.1, seed=0)
+        assert state.user_factors.shape == (N_USERS, RANK)
+        assert state.item_factors.shape == (N_ITEMS, RANK)
+        assert state.placement is None
+
+
+# ---------------------------------------------------------------------------
+# continuation retrain under a placement
+# ---------------------------------------------------------------------------
+
+def _tail_data():
+    """Base COO + a tail shaped for the splice fast path: 8 touched
+    rows that KEEP their padded width class (entries land in their
+    existing slots) and 2 brand-new rows (degree 0 → delta buckets),
+    comfortably under apply_tail's compaction bound."""
+    rng = np.random.default_rng(0)
+    users = rng.integers(0, N_USERS - 2, NNZ).astype(np.int32)
+    items = rng.integers(0, N_ITEMS, NNZ).astype(np.int32)
+    vals = rng.uniform(1, 5, NNZ).astype(np.float32)
+    deg = np.bincount(users, minlength=N_USERS)
+    widths = np.maximum(8, np.exp2(np.ceil(
+        np.log2(np.maximum(deg, 1)))).astype(np.int64))
+    stay = np.where((deg > 0) & (deg < widths))[0][:8].astype(np.int32)
+    assert len(stay) == 8
+    tu = np.concatenate([stay, np.repeat(
+        np.asarray([N_USERS - 2, N_USERS - 1], np.int32), 5)])
+    trng = np.random.default_rng(99)
+    ti = trng.integers(0, N_ITEMS, len(tu)).astype(np.int32)
+    tv = trng.uniform(1, 5, len(tu)).astype(np.float32)
+    return ((users, items, vals),
+            (np.concatenate([users, tu]), np.concatenate([items, ti]),
+             np.concatenate([vals, tv])))
+
+
+class TestPlacedRetrain:
+
+    def _prev(self, base):
+        state, _ = als.als_train(
+            *base, n_users=N_USERS, n_items=N_ITEMS, rank=RANK,
+            iterations=2, l2=0.1, seed=0)
+        return als.ALSState(
+            user_factors=np.asarray(state.user_factors),
+            item_factors=np.asarray(state.item_factors))
+
+    def test_placed_retrain_matches_single_chip(self):
+        base, full = _tail_data()
+        prev = self._prev(base)
+        ref = retrain.als_retrain(
+            *full, N_USERS, N_ITEMS, rank=RANK, iterations=3, l2=0.1,
+            seed=0, prev_state=prev, tol=0.0)
+        placement = make_placement(_mesh(4), N_USERS, N_ITEMS)
+        stats: dict = {}
+        got = retrain.als_retrain(
+            *full, N_USERS, N_ITEMS, rank=RANK, iterations=3, l2=0.1,
+            seed=0, prev_state=prev, tol=0.0, placement=placement,
+            stats=stats)
+        assert got.placement is placement
+        assert stats["mode"] == "continue"
+        got = placement.unplace_state(got)
+        assert _rel(got.user_factors, ref.user_factors) < 1e-5
+        assert _rel(got.item_factors, ref.item_factors) < 1e-5
+
+    def test_placed_retrain_ring_fallback_parity(self, monkeypatch):
+        """When the gather strategy resolves RING (table too wide to
+        all-gather — the scale sharding exists for), the retrain must
+        NOT fall back to full-table replication via the allgather-only
+        splice plan: it preps fresh ring-layout sides, keeps the
+        continuation warm start, stays one dispatch, and matches the
+        single-chip retrain."""
+        monkeypatch.setenv("PIO_SHARD_GATHER", "ring")
+        base, full = _tail_data()
+        prev = self._prev(base)
+        ref = retrain.als_retrain(
+            *full, N_USERS, N_ITEMS, rank=RANK, iterations=3, l2=0.1,
+            seed=0, prev_state=prev, tol=0.0)
+        placement = make_placement(_mesh(4), N_USERS, N_ITEMS)
+        stats: dict = {}
+        got = retrain.als_retrain(
+            *full, N_USERS, N_ITEMS, rank=RANK, iterations=3, l2=0.1,
+            seed=0, prev_state=prev, tol=0.0, placement=placement,
+            plan_key="ring-retrain", stats=stats)
+        assert stats["prep_plan"] == "ring-fresh"
+        assert stats["mode"] == "continue"
+        assert stats["train_dispatches"] == 1
+        assert stats["one_dispatch"] is True
+        got = placement.unplace_state(got)
+        assert _rel(got.user_factors, ref.user_factors) < 1e-5
+        assert _rel(got.item_factors, ref.item_factors) < 1e-5
+
+    def test_placed_splice_one_dispatch_and_parity(self):
+        """Same-geometry steady state: the O(delta) splice scatters run
+        INSIDE the training jit — plan reused, train_dispatches == 1 —
+        and the spliced result matches the fresh-prep result."""
+        base, full = _tail_data()
+        prev = self._prev(base)
+        placement = make_placement(_mesh(4), N_USERS, N_ITEMS)
+
+        def run(plan_key, seed_plan, stats):
+            if seed_plan:
+                retrain.drop_plans()
+                retrain.prepare_with_reuse(
+                    *base, N_USERS, N_ITEMS, plan_key=plan_key,
+                    placement=placement)
+            return retrain.als_retrain(
+                *full, N_USERS, N_ITEMS, rank=RANK, iterations=3,
+                l2=0.1, seed=0, prev_state=prev, tol=0.0,
+                placement=placement, plan_key=plan_key, stats=stats)
+
+        fresh_stats: dict = {}
+        fresh = placement.unplace_state(
+            run(None, seed_plan=False, stats=fresh_stats))
+        spliced_stats: dict = {}
+        spliced = placement.unplace_state(
+            run("shard-splice", seed_plan=True, stats=spliced_stats))
+        assert spliced_stats["prep_plan"] == "reused"
+        # acceptance: one device dispatch per shard group, under
+        # sharding exactly as on one chip
+        assert spliced_stats["train_dispatches"] == 1
+        assert spliced_stats["one_dispatch"] is True
+        assert _rel(spliced.user_factors, fresh.user_factors) < 1e-5
+        assert _rel(spliced.item_factors, fresh.item_factors) < 1e-5
+
+    def test_reshard_invalidates_plan_and_stays_correct(self):
+        """A live plan built at one mesh shape must NOT be spliced into
+        at another: the placement key invalidates, the plan rebuilds
+        once, and the factors still match the single-chip retrain."""
+        base, full = _tail_data()
+        prev = self._prev(base)
+        p2 = make_placement(_mesh(2), N_USERS, N_ITEMS)
+        p4 = make_placement(_mesh(4), N_USERS, N_ITEMS)
+        assert p2.cache_key() != p4.cache_key()
+        retrain.prepare_with_reuse(
+            *base, N_USERS, N_ITEMS, plan_key="reshard", placement=p2)
+        ref = retrain.als_retrain(
+            *full, N_USERS, N_ITEMS, rank=RANK, iterations=3, l2=0.1,
+            seed=0, prev_state=prev, tol=0.0)
+        stats: dict = {}
+        got = retrain.als_retrain(
+            *full, N_USERS, N_ITEMS, rank=RANK, iterations=3, l2=0.1,
+            seed=0, prev_state=prev, tol=0.0, placement=p4,
+            plan_key="reshard", stats=stats)
+        assert stats["prep_plan"] != "reused"
+        got = p4.unplace_state(got)
+        assert _rel(got.user_factors, ref.user_factors) < 1e-5
+        assert _rel(got.item_factors, ref.item_factors) < 1e-5
+
+    def test_place_state_redistributes_across_mesh_shapes(self):
+        """continue_state's placement leg: a state placed at mesh shape
+        A re-places under mesh shape B with the true-size prefix intact
+        (the continuation-after-reshard seed path)."""
+        users, items, vals = _data()
+        p2 = make_placement(_mesh(2), N_USERS, N_ITEMS)
+        at2 = als.als_train_placed(
+            users, items, vals, N_USERS, N_ITEMS, placement=p2,
+            rank=RANK, iterations=2, l2=0.1, seed=0)
+        p8 = make_placement(_mesh(8), N_USERS, N_ITEMS)
+        at8 = p8.place_state(at2)
+        assert at8.placement is p8
+        assert at8.user_factors.shape[0] == p8.n_users_padded
+        np.testing.assert_array_equal(
+            np.asarray(at8.user_factors)[:N_USERS],
+            np.asarray(at2.user_factors)[:N_USERS])
+
+    def test_grow_capacity_keeps_geometry_stable(self):
+        """make_placement(grow=True) pow2-pads per-shard rows: ids
+        appending within capacity keep the cache key AND placement
+        equality/hash — the actual jit static-arg key, so steady-state
+        retrains never recompile — while crossing capacity doubles."""
+        mesh = _mesh(4)
+        a = make_placement(mesh, 100, 60, grow=True)
+        b = make_placement(mesh, 101, 61, grow=True)
+        assert a.cache_key() == b.cache_key()
+        assert a == b and hash(a) == hash(b)
+        c = make_placement(mesh, 2 * a.n_users_padded, 60, grow=True)
+        assert c.cache_key() != a.cache_key()
+        assert c != a
+
+
+# ---------------------------------------------------------------------------
+# fold-in on a sharded frozen table
+# ---------------------------------------------------------------------------
+
+class TestShardedFoldIn:
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_foldin_matches_replicated_solver(self, implicit):
+        rng = np.random.default_rng(3)
+        M, K = 64, 8
+        table = rng.normal(0, 0.3, (M, K)).astype(np.float32)
+        placement = make_placement(_mesh(4), 32, M)
+        placed = placement.place_table(table, "item")[:M]
+        assert is_distributed(placed)
+        ref_solver = FoldInSolver(table, l2=0.05, implicit=implicit,
+                                  alpha=2.0)
+        sharded = FoldInSolver(placed, l2=0.05, implicit=implicit,
+                               alpha=2.0)
+        assert sharded.sharded
+        assert not sharded.use_kernel  # pallas never auto-partitions
+        rows = []
+        for d in (1, 7, 8, 33, 128):  # every ladder bucket class
+            cols = rng.integers(0, M, d).astype(np.int32)
+            vals = np.abs(rng.normal(2.0, 0.8, d)).astype(np.float32)
+            rows.append((cols, vals))
+        got = sharded.solve(rows)
+        ref = ref_solver.solve(rows)
+        for g, r in zip(got, ref):
+            assert _rel(g, r) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# sharded serving: partial top-k + all-gather merge
+# ---------------------------------------------------------------------------
+
+class TestShardedTopK:
+
+    def _placed_items(self, n_shards, n_items=N_ITEMS, k=RANK, seed=5):
+        rng = np.random.default_rng(seed)
+        table = rng.normal(0, 1.0, (n_items, k)).astype(np.float32)
+        placement = make_placement(_mesh(n_shards), 16, n_items)
+        return table, placement.place_table(table, "item"), placement
+
+    def test_planted_merge_equivalence(self):
+        """Per-shard partial top-k + merge ≡ dense ranking, with planted
+        winners scattered across every shard's slice."""
+        table, placed, placement = self._placed_items(8)
+        rng = np.random.default_rng(6)
+        uv = rng.normal(0, 1.0, RANK).astype(np.float32)
+        # plant extreme winners on specific shards (incl. the last)
+        winners = [1, 11, 21, 36]
+        for w, boost in zip(winners, (40.0, 30.0, 20.0, 10.0)):
+            table[w] = boost * uv / np.linalg.norm(uv) ** 2
+        placed = placement.place_table(table, "item")
+        out = np.asarray(topk.sharded_top_k(
+            jnp.asarray(uv), placed, 10,
+            valid_items=placement.n_items))
+        ref_scores = table @ uv
+        ref_ids = np.argsort(-ref_scores)[:10]
+        assert list(out[1].astype(int)[:4]) == winners
+        assert set(out[1].astype(int)) == set(ref_ids)
+        np.testing.assert_allclose(
+            out[0], np.sort(ref_scores)[::-1][:10], rtol=1e-5)
+
+    def test_padding_rows_never_served(self):
+        """Placement padding rows hold zero factors — without the
+        valid_items mask they would outrank genuinely negative items."""
+        rng = np.random.default_rng(7)
+        table = rng.normal(0, 1.0, (N_ITEMS, RANK)).astype(np.float32)
+        placement = make_placement(_mesh(8), 16, N_ITEMS)
+        placed = placement.place_table(table, "item")
+        assert placement.n_items_padded > N_ITEMS
+        uv = rng.normal(0, 1.0, RANK).astype(np.float32)
+        out = np.asarray(topk.sharded_top_k(
+            jnp.asarray(uv), placed, placement.n_items,
+            valid_items=placement.n_items))
+        ids = set(out[1].astype(int))
+        assert all(i < N_ITEMS for i in ids)
+
+    def test_exclude_and_allowed_mask(self):
+        table, placed, placement = self._placed_items(4)
+        rng = np.random.default_rng(8)
+        uv = rng.normal(0, 1.0, RANK).astype(np.float32)
+        scores = table @ uv
+        order = np.argsort(-scores)
+        exclude = order[:3].astype(np.int32)         # knock out the top 3
+        allowed = np.ones(N_ITEMS, bool)
+        allowed[order[3]] = False                    # ... and the 4th
+        out = np.asarray(topk.sharded_top_k(
+            jnp.asarray(uv), placed, 5, exclude=jnp.asarray(exclude),
+            allowed_mask=jnp.asarray(allowed),
+            valid_items=placement.n_items))
+        assert list(out[1].astype(int)) == list(order[4:9])
+
+    def test_serving_entry_auto_routes_distributed(self):
+        """score_and_top_k / score_user_and_top_k detect an actually-
+        distributed item table and take the sharded merge path; with
+        ``valid_items`` the padding tail is masked and the result
+        matches the replicated entry exactly — padding ids are NEVER
+        servable (the make_placement contract)."""
+        table, placed, placement = self._placed_items(4)
+        rng = np.random.default_rng(9)
+        uv = rng.normal(0, 1.0, RANK).astype(np.float32)
+        got = np.asarray(topk.score_and_top_k(
+            jnp.asarray(uv), placed, 5, valid_items=N_ITEMS))
+        ref = np.asarray(topk.score_and_top_k(
+            jnp.asarray(uv), jnp.asarray(table), 5))
+        assert (got[1] < N_ITEMS).all()
+        assert set(got[1].astype(int)) == set(ref[1].astype(int))
+        uf = rng.normal(0, 1.0, (16, RANK)).astype(np.float32)
+        got_u = np.asarray(topk.score_user_and_top_k(
+            jnp.asarray(uf), placed, jnp.asarray(3), 5,
+            valid_items=N_ITEMS))
+        ref_u = np.asarray(topk.score_user_and_top_k(
+            jnp.asarray(uf), jnp.asarray(table), jnp.asarray(3), 5))
+        assert (got_u[1] < N_ITEMS).all()
+        assert set(got_u[1].astype(int)) == set(ref_u[1].astype(int))
+
+    def test_batch_topk_valid_items_masks_padding(self):
+        rng = np.random.default_rng(10)
+        uf = rng.normal(0, 1.0, (8, RANK)).astype(np.float32)
+        items = rng.normal(-1.0, 0.2, (40, RANK)).astype(np.float32)
+        items[N_ITEMS:] = 0.0  # placement-style zero padding
+        out = np.asarray(topk.batch_score_top_k(
+            jnp.asarray(uf), jnp.asarray(items),
+            np.arange(8, dtype=np.int32), 10, valid_items=N_ITEMS))
+        assert (out[1] < N_ITEMS).all()
+
+
+# ---------------------------------------------------------------------------
+# seams: forced device count, context gating, shard telemetry
+# ---------------------------------------------------------------------------
+
+class TestSeams:
+
+    def test_pio_mesh_devices_caps_standard_mesh(self, monkeypatch):
+        _need(4)
+        from incubator_predictionio_tpu.parallel import mesh as pmesh
+
+        monkeypatch.setenv("PIO_MESH_DEVICES", "4")
+        assert pmesh.device_count() == 4
+        assert make_mesh().devices.size == 4
+        monkeypatch.setenv("PIO_MESH_DEVICES", "junk")
+        assert pmesh.forced_device_count() is None
+
+    def test_placement_for_ctx_gating(self, monkeypatch):
+        _need(2)
+
+        class Ctx:
+            model_parallelism = 1
+            mesh = None
+
+        monkeypatch.delenv("PIO_SHARD_TABLES", raising=False)
+        assert placement_for_ctx(Ctx(), 10, 10) is None
+        monkeypatch.setenv("PIO_SHARD_TABLES", "1")
+        p = placement_for_ctx(Ctx(), 10, 10)
+        assert isinstance(p, FactorPlacement)
+        # grow policy: per-shard capacity is pow2 → stable geometry
+        assert p.users_capacity % p.n_shards == 0
+        # the gate honors the PIO_MESH_DEVICES cap: a capped 1-device
+        # mesh is the single-chip path, whatever jax.device_count() is
+        monkeypatch.setenv("PIO_MESH_DEVICES", "1")
+        assert placement_for_ctx(Ctx(), 10, 10) is None
+
+    def test_shard_metrics_booked(self, monkeypatch):
+        users, items, vals = _data()
+        monkeypatch.setenv("PIO_SHARD_GATHER", "allgather")
+        placement = make_placement(_mesh(2), N_USERS, N_ITEMS)
+        before = obs_metrics.REGISTRY.get("pio_shard_gather_bytes_total")
+        before = (before.labels(strategy="allgather").value
+                  if before is not None else 0.0)
+        als.als_train_placed(
+            users, items, vals, N_USERS, N_ITEMS, placement=placement,
+            rank=RANK, iterations=2, l2=0.1, seed=0)
+        assert obs_metrics.REGISTRY.get(
+            "pio_shard_mesh_devices").value == 2
+        rows = obs_metrics.REGISTRY.get("pio_shard_rows")
+        assert rows.labels(side="user").value == placement.shard_rows(
+            "user")
+        assert rows.labels(side="item").value == placement.shard_rows(
+            "item")
+        after = obs_metrics.REGISTRY.get(
+            "pio_shard_gather_bytes_total").labels(
+                strategy="allgather").value
+        # 2 sweeps × both half-sweeps' analytic all-gather volume
+        expect = (placement.allgather_bytes("item", 2, RANK)
+                  + placement.allgather_bytes("user", 2, RANK))
+        assert after - before == expect

@@ -13,6 +13,9 @@ HBase/JDBC/ES drivers).
 from datetime import timedelta
 
 import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property-based differential needs hypothesis")
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 

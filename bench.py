@@ -137,6 +137,72 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+_BENCH_TRACE_ID = None
+
+
+def _bench_trace_id() -> str:
+    """One trace ID per bench process (``bench-<8 hex>``): every HTTP
+    request the load generators send carries it, so the servers' span
+    logs attribute bench traffic to this run (the bench→servers hop of
+    the cross-process trace contract)."""
+    global _BENCH_TRACE_ID
+    if _BENCH_TRACE_ID is None:
+        import secrets
+
+        _BENCH_TRACE_ID = f"bench-{secrets.token_hex(4)}"
+    return _BENCH_TRACE_ID
+
+
+def bench_env() -> dict:
+    """Provenance block for the record: enough to answer "what machine,
+    what software, what code" about any row of the trajectory without
+    archaeology. Every field is best-effort — a missing git binary or
+    an uninitialized jax must never cost the round its record."""
+    import platform
+    import socket
+
+    env = {
+        "backend": os.environ.get("JAX_PLATFORMS") or "default",
+        "device_count": None,
+        "jax_version": None,
+        "git_sha": None,
+        "hostname": None,
+        "python": platform.python_version(),
+        "wall_ts": None,
+    }
+    try:
+        env["hostname"] = socket.gethostname()
+    except OSError:
+        pass
+    env["wall_ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    mod = sys.modules.get("jax")
+    if mod is not None:
+        env["jax_version"] = getattr(mod, "__version__", None)
+        try:
+            env["device_count"] = len(mod.devices())
+            # the LIVE backend beats the env var: the TPU child never
+            # sets JAX_PLATFORMS, it dials the chip
+            env["backend"] = mod.default_backend()
+        except Exception:  # backend not initialized / unavailable
+            pass
+    else:
+        try:
+            from importlib.metadata import version
+
+            env["jax_version"] = version("jax")
+        except Exception:
+            pass
+    try:
+        env["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        pass
+    return env
+
+
 #: planted ground truth: ratings = 3.5 + U·Vᵀ + N(0, NOISE_SIGMA) with a
 #: rank-PLANT_RANK U, V. The solver (rank 128 ⊇ 16) can recover the
 #: structure, so heldout RMSE has a KNOWN floor (= NOISE_SIGMA) and
@@ -925,6 +991,7 @@ def obs_snapshot() -> dict:
 #: big-table side (ROADMAP items 1/5)
 SHARD_KEYS = (
     "shard_train_wall_s", "shard_mesh_shape", "shard_devices",
+    "shard_nnz", "shard_sweeps",
     "shard_backend", "shard_allgather_bytes", "shard_mfu_train",
     "shard_gather_modes", "shard_fused_user_sweep",
     "shard_fused_item_sweep", "shard_fused_fits_ml20m_user_sweep",
@@ -1030,6 +1097,11 @@ def run_shard_child() -> None:
         "shard_train_wall_s": round(wall, 3),
         "shard_mesh_shape": placement.describe(),
         "shard_devices": placement.n_shards,
+        # the leg's own workload shape: the capacity model
+        # (obs/capacity.py) needs rows+sweeps next to the wall to turn
+        # shard timings into a rows/chip rate
+        "shard_nnz": nnz,
+        "shard_sweeps": sweeps,
         "shard_backend": jax.devices()[0].platform,
         "shard_allgather_bytes": gather_bytes() - before,
         "shard_mfu_train": float(f"{mfu:.6g}"),
@@ -1333,6 +1405,10 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
         log(f"speed leg failed ({e!r}); speed_* keys null this round")
 
     fragment = {
+        # the CHILD's provenance overrides the parent's: the child is
+        # the process that actually touched the accelerator, so its
+        # backend/device view is the one the trajectory should carry
+        "bench_env": bench_env(),
         "value": round(train_s, 3),
         "vs_baseline": round(CPU_BASELINE_TRAIN_S / train_s, 1),
         "train_rmse": round(float(fit), 3),
@@ -1380,7 +1456,8 @@ def run_tpu_child(store_dir: str, out_path: str, claim_path: str,
 
 
 def supervise_tpu_child(store_dir: str, out_path: str,
-                        claim_event=None, deadline_mono=None) -> bool:
+                        claim_event=None, deadline_mono=None,
+                        last_rc=None) -> bool:
     """Spawn/recycle the TPU child until it lands a fragment or the
     ACCEL_WAIT_S budget runs out. Returns True iff `out_path` exists
     (checked on every exit path — an abandoned SIGTERM-ignoring child
@@ -1398,7 +1475,11 @@ def supervise_tpu_child(store_dir: str, out_path: str,
     a waiter cannot wedge the chip; killing a holder can, which is why a
     claimed child gets the long run window and is never force-killed
     while healthy) and respawned with a doubled window: only a fresh
-    process gets a fresh PJRT dial."""
+    process gets a fresh PJRT dial.
+
+    ``last_rc``: optional single-slot list; the most recent child exit
+    code observed lands in it, so the record's ``skipped_reason`` can
+    carry the REAL rc instead of a guessed one."""
     deadline = time.monotonic() + ACCEL_WAIT_S
     if deadline_mono is not None:
         deadline = min(deadline, deadline_mono)
@@ -1434,6 +1515,8 @@ def supervise_tpu_child(store_dir: str, out_path: str,
                 return True
             rc = proc.poll()
             if rc is not None:
+                if last_rc is not None:
+                    last_rc[:] = [rc]
                 if rc == 0 and os.path.exists(out_path):
                     return True
                 log(f"tpu child attempt {attempt} exited rc={rc} "
@@ -1606,6 +1689,12 @@ def run_orchestrator() -> None:
         "unit": "s",
         "vs_baseline": None,
         "degraded": True,
+        # provenance (obs/capacity.py reads these): what machine/software
+        # produced this row of the trajectory, and — when the round could
+        # not measure the accelerator — a STRUCTURED reason, so no record
+        # is ever unexplainable (the BENCH_r04/r05 parsed:null class)
+        "bench_env": bench_env(),
+        "skipped_reason": None,
         "train_rmse": None,
         "heldout_rmse": None,
         "noise_floor": NOISE_SIGMA,
@@ -1710,8 +1799,17 @@ def run_orchestrator() -> None:
                 with open(frag_path) as f:
                     record.update(json.load(f))
                 record["degraded"] = False
+                record["skipped_reason"] = None
         except Exception:
             pass
+        if record.get("degraded") and record.get("skipped_reason") is None:
+            record["skipped_reason"] = {
+                "class": "driver_deadline",
+                "stage": "tpu_child",
+                "detail": "driver SIGTERM before the bench's own emit "
+                          "point; best-available degraded record flushed",
+                "rc": 124,
+            }
         log("SIGTERM before the bench's own emit point: flushing the "
             "best-available record")
         _emit_record(from_signal=True)
@@ -1780,12 +1878,14 @@ def run_orchestrator() -> None:
     sup_done = threading.Event()
     claim_seen = threading.Event()
     sup_ok: list = []
+    child_last_rc: list = []
 
     def _supervise() -> None:
         try:
             sup_ok.append(
                 supervise_tpu_child(store_dir, frag_path, claim_seen,
-                                    deadline_mono=emit_by - 5.0))
+                                    deadline_mono=emit_by - 5.0,
+                                    last_rc=child_last_rc))
         finally:
             sup_done.set()
 
@@ -1852,6 +1952,7 @@ def run_orchestrator() -> None:
         with open(frag_path) as f:
             record.update(json.load(f))
         record["degraded"] = False
+        record["skipped_reason"] = None
         record["bf16_sweeps"] = BF16_SWEEPS
         # a degraded fallback may have folded in before the child landed
         # — the fragment overrode every shared key; drop its marker
@@ -1861,6 +1962,21 @@ def run_orchestrator() -> None:
             + record["value"], 1)
     else:
         record["degraded"] = True
+        # the structured why (satellite of the capacity model): this
+        # round's accelerator story, machine-readable — the r04 class
+        # ("accelerator init still blocked") ends up here instead of an
+        # unexplained parsed:null; rc is the last child exit actually
+        # observed, null when no child ever exited in view
+        record["skipped_reason"] = {
+            "class": ("accelerator_unavailable"
+                      if record["accel_outcome"] == "never_available"
+                      else "tpu_child_failed"),
+            "stage": "tpu_child",
+            "detail": (f"accel_outcome={record['accel_outcome']} after "
+                       f"{record['accel_waited_s']}s wait; degraded CPU "
+                       "record emitted in its place"),
+            "rc": child_last_rc[0] if child_last_rc else None,
+        }
         record["bf16_sweeps"] = 0  # degraded runs the all-f32 CPU schedule
         if degraded_result and degraded_result[0]:
             pass  # already folded into the record by the fallback thread
@@ -2045,7 +2161,11 @@ def bench_attention():
 
 async def _http_post_loop(port, path, bodies) -> None:
     """One async keep-alive connection POSTing each body in turn — the
-    shared load-generator leg of the ingest and serving benches."""
+    shared load-generator leg of the ingest and serving benches. Every
+    request carries the bench's trace ID (one per process, prefixed
+    ``bench-``) so the servers' span logs attribute the load to this
+    bench run — the bench→servers hop of the cross-process trace
+    contract (docs/observability.md "Fleet")."""
     import asyncio
 
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
@@ -2054,6 +2174,7 @@ async def _http_post_loop(port, path, bodies) -> None:
             writer.write(
                 f"POST {path} HTTP/1.1\r\nHost: bench\r\n"
                 "Content-Type: application/json\r\n"
+                f"X-PIO-Trace-Id: {_bench_trace_id()}\r\n"
                 f"Content-Length: {len(body)}\r\n\r\n".encode()
                 + body)
             await writer.drain()

@@ -888,6 +888,101 @@ class HostGatherInMesh(Rule):
                     "fetch after the mesh context closes")
 
 
+# ---------------------------------------------------------------------------
+# 14. unbounded metric label values
+# ---------------------------------------------------------------------------
+
+#: value names that smell like per-entity/per-request data — one time
+#: series per distinct value, which is how a registry (and every scraper
+#: behind it) OOMs. Terminal name of the expression (Name id / Attribute
+#: attr) is matched; bounded-set names (route patterns, status codes,
+#: phases, modes) deliberately absent.
+_UNBOUNDED_LABEL_NAME_RE = re.compile(
+    r"(?:^|_)(id|ids|uuid|guid|key|token|path|url|uri|query|entity|"
+    r"user|item|session|trace|span|instance|host|hostname|addr|"
+    r"address|exc|exception|err|error|message|detail)s?$",
+    re.IGNORECASE)
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class MetricLabelCardinality(Rule):
+    name = "metric-label-cardinality"
+    severity = "error"
+    doc = ("unbounded value (id / raw path / exception string / "
+           "interpolated f-string) used as a metric label value in a "
+           "``.labels(...)`` call — every distinct value mints a new "
+           "time series, so wire-derived label values grow the registry "
+           "(and every scrape) without bound until the process OOMs; "
+           "label values must come from BOUNDED sets (route PATTERNS, "
+           "status codes, enum/phase names — obs/metrics.py's "
+           "cardinality contract), or carry a boundedness justification "
+           "in the baseline")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        exc_names: Set[str] = {
+            h.name for h in ast.walk(mod.tree)
+            if isinstance(h, ast.ExceptHandler) and h.name
+        }
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue  # **kwargs: opaque, other rules' problem
+                reason = self._unbounded(kw.value, exc_names)
+                if reason:
+                    yield mod.finding(
+                        self, kw.value,
+                        f"label {kw.arg!r} value {reason} — one time "
+                        "series per distinct value; use a bounded set "
+                        "(pattern/code/enum), bucket the value, or "
+                        "baseline it with a boundedness justification")
+
+    def _unbounded(self, v: ast.AST,
+                   exc_names: "Set[str]") -> Optional[str]:
+        if isinstance(v, ast.JoinedStr) and any(
+                isinstance(x, ast.FormattedValue) for x in v.values):
+            return "is an interpolated f-string"
+        if isinstance(v, ast.BinOp) and isinstance(
+                v.op, (ast.Add, ast.Mod)) and not (
+                isinstance(v.left, ast.Constant)
+                and isinstance(v.right, ast.Constant)):
+            return "is built by string concatenation/%-formatting"
+        if isinstance(v, ast.Call):
+            f = v.func
+            if isinstance(f, ast.Attribute) and f.attr == "format":
+                return "is built by .format()"
+            if (isinstance(f, ast.Name) and f.id in ("str", "repr")
+                    and len(v.args) == 1):
+                arg = v.args[0]
+                if (isinstance(arg, ast.Name) and arg.id in exc_names):
+                    return (f"stringifies caught exception "
+                            f"{ast.unparse(arg)!r}")
+                nm = _terminal_name(arg)
+                if nm and _UNBOUNDED_LABEL_NAME_RE.search(nm):
+                    return f"stringifies {ast.unparse(arg)!r}"
+            return None
+        if isinstance(v, ast.Name) and v.id in exc_names:
+            return f"is the caught exception {v.id!r}"
+        nm = _terminal_name(v)
+        if nm and _UNBOUNDED_LABEL_NAME_RE.search(nm):
+            try:
+                text = ast.unparse(v)
+            except Exception:
+                text = nm
+            return f"reads {text!r} (unbounded-looking name)"
+        return None
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -902,6 +997,7 @@ ALL_RULES: Sequence[Rule] = (
     ServeBlockingIO(),
     BlockingProfiler(),
     HostGatherInMesh(),
+    MetricLabelCardinality(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

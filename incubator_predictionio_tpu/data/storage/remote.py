@@ -27,6 +27,7 @@ from urllib.parse import urlsplit
 from incubator_predictionio_tpu.data.event import EventValidationError
 from incubator_predictionio_tpu.data.storage import base, wire
 from incubator_predictionio_tpu.data.storage.base import StorageClientConfig
+from incubator_predictionio_tpu.obs import trace as obs_trace
 
 #: typed errors re-raised client-side; anything else maps to StorageError
 _ERROR_TYPES: Dict[str, type] = {
@@ -92,6 +93,10 @@ class StorageClient(base.BaseStorageClient):
             "args": list(args), "kwargs": kwargs,
         })
         headers = {"Content-Type": "application/x-msgpack"}
+        # cross-process trace propagation: a storage RPC issued while
+        # serving a request forwards the ambient trace ID + this hop's
+        # parent span, so the storage server's span line joins the tree
+        headers.update(obs_trace.client_headers())
         if self.auth_key:
             headers["X-Pio-Storage-Key"] = self.auth_key
         conn = self._conn()

@@ -15,12 +15,21 @@ read). This package is the one coherent layer over all of them:
 - :mod:`.exposition` (via :func:`metrics.Registry.expose`) —
   Prometheus text format, served at ``GET /metrics`` on every server
   (:func:`.http.add_metrics_route`);
-- :mod:`.trace` — per-request trace IDs: accepted from an incoming
-  ``X-PIO-Trace-Id`` header, generated otherwise, propagated into the
-  structured JSON span log and echoed on the response.
+- :mod:`.trace` — per-request trace IDs and span parenting: accepted
+  from an incoming ``X-PIO-Trace-Id`` header, generated otherwise,
+  propagated into the structured JSON span log and echoed on the
+  response; in-repo client hops forward ``X-PIO-Parent-Span`` so span
+  lines from multiple processes link into one tree
+  (``scripts/trace_stitch.py``);
+- :mod:`.expofmt` — the exposition grammar parser (promoted from the
+  test oracle) that :mod:`.federate` uses to scrape and merge worker
+  ``/metrics`` under an ``instance`` label (admin ``GET /federate``,
+  fleet-mode SLOs);
+- :mod:`.capacity` — the offline capacity/regression model over the
+  checked-in bench trajectory (``scripts/capacity_report.py``).
 
 See ``docs/observability.md`` for the metric catalog and the scrape /
-trace-propagation contracts.
+trace-propagation / fleet contracts.
 """
 
 from incubator_predictionio_tpu.obs.metrics import (  # noqa: F401

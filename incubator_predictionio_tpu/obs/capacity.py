@@ -1,0 +1,498 @@
+"""Capacity + regression model over the repo's bench trajectory.
+
+The driver leaves one ``BENCH_rNN.json`` / ``MULTICHIP_rNN.json`` per
+round at the repo root; ROADMAP item 1 asks for those walls to become a
+**capacity model** — rows/chip at fixed staleness — and the serving
+numbers to become a sizing rule (QPS per worker), the way ALX (arxiv
+2112.02194) sizes sharded-MF deployments from measured per-chip
+throughput and the pjit/TPUv4 scaling work (arxiv 2204.06514) treats
+continuously-measured MFU as the regression gate.
+
+Three jobs, all offline and dependency-free:
+
+1. **Normalize** the trajectory. Records come in three shapes (the
+   driver wrapper ``{n, cmd, rc, tail, parsed}``, the flat builder
+   record, the MULTICHIP ``{n_devices, rc, ok, skipped, tail}``); every
+   one normalizes to a :class:`NormalizedRecord`, and a record with no
+   parsed payload gets a STRUCTURED ``skipped_reason`` classified from
+   its tail/rc (the BENCH_r04 "accelerator init still blocked" rc=3 and
+   BENCH_r05 rc=124 driver-kill classes) — no record in the trajectory
+   is ever unexplainable.
+2. **Fit capacity**: rows-per-chip-per-second from the newest
+   non-degraded training wall, projected to
+   rows-per-chip-at-fixed-staleness (the retrain bound from
+   ``PIO_SLO_STALENESS_S``), plus QPS-per-worker from the measured
+   concurrent serving rate — with worker/chip projections for target
+   loads.
+3. **Regression verdict**: key-by-key tolerance compare of the newest
+   parsed record against the pinned baseline
+   (``CAPACITY_BASELINE.json`` at the repo root), skipping keys whose
+   record value is null; keys are classified lower-is-better
+   (walls, latencies, RMSE) vs higher-is-better (QPS, MFU, rates), and
+   shape keys (nnz/rank/sweeps) must match or the compare is honestly
+   ``incomparable_shape`` rather than silently green.
+
+``scripts/capacity_report.py`` is the CLI; ``--check`` gates CI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import math
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: the pinned regression baseline at the repo root
+BASELINE_FILENAME = "CAPACITY_BASELINE.json"
+
+#: trajectory record globs, repo-root relative
+RECORD_GLOBS = ("BENCH_*.json", "MULTICHIP_*.json")
+
+#: keys that define the measured shape — a compare across different
+#: shapes is not a regression signal, it is a different experiment
+SHAPE_KEYS = ("nnz", "rank", "sweeps")
+
+#: key-direction classification for the tolerance compare. First match
+#: wins; keys matching neither class are informational and skipped.
+_LOWER_IS_BETTER_RE = re.compile(
+    r"(_wall_s$|_s$|_ms$|rmse|^value$|_ns$|staleness)")
+_HIGHER_IS_BETTER_RE = re.compile(
+    r"(qps|eps$|_eps_|mfu|precision|vs_baseline|hit_rate|speedup|"
+    r"flops)")
+
+
+def key_direction(key: str) -> Optional[str]:
+    """"lower" | "higher" | None (informational)."""
+    if key in SHAPE_KEYS:
+        return None
+    if _HIGHER_IS_BETTER_RE.search(key):
+        return "higher"
+    if _LOWER_IS_BETTER_RE.search(key):
+        return "lower"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# record normalization + failure classification
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NormalizedRecord:
+    name: str                       # file stem, e.g. "BENCH_r04"
+    kind: str                       # "bench" | "multichip"
+    round: Optional[int]            # rNN from the filename when present
+    rc: Optional[int]
+    parsed: Optional[Dict[str, Any]]
+    degraded: Optional[bool]
+    bench_env: Optional[Dict[str, Any]]
+    skipped_reason: Optional[Dict[str, Any]]
+    ok: Optional[bool] = None       # multichip pass/fail
+    path: Optional[str] = None
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "round": self.round,
+            "rc": self.rc,
+            "degraded": self.degraded,
+            "parsed": self.parsed is not None,
+            "bench_env": self.bench_env,
+            "skipped_reason": self.skipped_reason,
+        }
+
+
+#: (regex over the tail, failure class, human detail) — first match that
+#: survives the rc-priority rules below names the class
+_TAIL_CLASSES: Tuple[Tuple[re.Pattern, str, str], ...] = (
+    (re.compile(r"accelerator init still blocked|"
+                r"accelerator unavailable|"
+                r"did not claim|no accelerator claim"),
+     "accelerator_unavailable",
+     "the accelerator never became claimable (stale chip lease class)"),
+    (re.compile(r"Traceback \(most recent call last\)"),
+     "harness_exception",
+     "the run died on an unhandled exception"),
+)
+
+
+def classify_failure(tail: str, rc: Optional[int]) -> Dict[str, Any]:
+    """Structured reason for a record with no parsed payload. Never
+    returns None: the whole point is that every unparsed record carries
+    an explanation (the acceptance contract of this module)."""
+    tail = tail or ""
+    matched: List[str] = []
+    cls: Optional[str] = None
+    detail: Optional[str] = None
+    for pattern, klass, why in _TAIL_CLASSES:
+        m = pattern.search(tail)
+        if m:
+            matched.append(m.group(0))
+            if cls is None:
+                cls, detail = klass, why
+    if rc == 124:
+        # the driver's timeout kill pre-empts everything else: whatever
+        # was going wrong, the record is null because the kill landed
+        # before the emit point (the BENCH_r05 class)
+        return {
+            "class": "driver_deadline",
+            "detail": "driver timeout (rc=124) killed the run before a "
+                      "record was emitted"
+                      + (f"; while: {detail}" if detail else ""),
+            "rc": rc,
+            "matched": matched,
+        }
+    if cls is not None:
+        return {"class": cls, "detail": detail, "rc": rc,
+                "matched": matched}
+    if rc not in (0, None):
+        last = next((ln for ln in reversed(tail.splitlines())
+                     if ln.strip()), "")
+        return {"class": "error_exit",
+                "detail": f"nonzero exit ({rc}); last line: {last[-200:]}",
+                "rc": rc, "matched": matched}
+    return {"class": "no_record",
+            "detail": "exited clean but emitted no parsed record",
+            "rc": rc, "matched": matched}
+
+
+_ROUND_RE = re.compile(r"_r(\d+)")
+
+
+def normalize_record(path: str) -> NormalizedRecord:
+    """One trajectory file → :class:`NormalizedRecord`, whatever its
+    era's shape. Unreadable/unparseable files normalize to a
+    ``skipped_reason`` of class ``unreadable`` — the trajectory walker
+    must never die on one bad file."""
+    name = os.path.splitext(os.path.basename(path))[0]
+    kind = "multichip" if name.upper().startswith("MULTICHIP") else "bench"
+    m = _ROUND_RE.search(name)
+    rnd = int(m.group(1)) if m else None
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return NormalizedRecord(
+            name=name, kind=kind, round=rnd, rc=None, parsed=None,
+            degraded=None, bench_env=None,
+            skipped_reason={"class": "unreadable", "detail": str(e),
+                            "rc": None, "matched": []},
+            path=path)
+    if not isinstance(raw, dict):
+        return NormalizedRecord(
+            name=name, kind=kind, round=rnd, rc=None, parsed=None,
+            degraded=None, bench_env=None,
+            skipped_reason={"class": "unreadable",
+                            "detail": "not a JSON object", "rc": None,
+                            "matched": []},
+            path=path)
+
+    if kind == "multichip":
+        ok = raw.get("ok")
+        rc = raw.get("rc")
+        reason = None
+        if not ok:
+            reason = classify_failure(raw.get("tail", ""), rc)
+        return NormalizedRecord(
+            name=name, kind=kind, round=rnd, rc=rc, parsed=None,
+            degraded=None, bench_env=raw.get("bench_env"),
+            skipped_reason=reason, ok=bool(ok), path=path)
+
+    if "parsed" in raw or "tail" in raw or "cmd" in raw:
+        # driver wrapper shape
+        parsed = raw.get("parsed")
+        rc = raw.get("rc")
+        reason = None
+        if parsed is None:
+            reason = classify_failure(raw.get("tail", ""), rc)
+        elif isinstance(parsed, dict) and parsed.get("skipped_reason"):
+            # the bench itself emitted a structured reason (post-PR-9
+            # degraded rounds)
+            reason = parsed["skipped_reason"]
+        return NormalizedRecord(
+            name=name, kind=kind, round=rnd, rc=rc,
+            parsed=parsed if isinstance(parsed, dict) else None,
+            degraded=(parsed or {}).get("degraded")
+            if isinstance(parsed, dict) else None,
+            bench_env=(parsed or {}).get("bench_env")
+            if isinstance(parsed, dict) else None,
+            skipped_reason=reason, path=path)
+
+    # flat builder-style record: the parsed payload IS the file
+    return NormalizedRecord(
+        name=name, kind=kind, round=rnd, rc=raw.get("rc"),
+        parsed=raw, degraded=raw.get("degraded"),
+        bench_env=raw.get("bench_env"),
+        skipped_reason=raw.get("skipped_reason"), path=path)
+
+
+def load_trajectory(repo_dir: str) -> List[NormalizedRecord]:
+    """Every trajectory record under ``repo_dir``, normalized, in
+    (kind, round, name) order — benches first, oldest first."""
+    paths: List[str] = []
+    for pat in RECORD_GLOBS:
+        paths.extend(glob.glob(os.path.join(repo_dir, pat)))
+    records = [normalize_record(p) for p in sorted(set(paths))]
+    records.sort(key=lambda r: (r.kind, r.round if r.round is not None
+                                else -1, r.name))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# capacity fit
+# ---------------------------------------------------------------------------
+
+def staleness_bound_s() -> float:
+    try:
+        return float(os.environ.get("PIO_SLO_STALENESS_S", "") or 3600.0)
+    except ValueError:
+        return 3600.0
+
+
+def _num(parsed: Optional[Dict], key: str) -> Optional[float]:
+    v = (parsed or {}).get(key)
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def fit_capacity(records: Sequence[NormalizedRecord],
+                 staleness_s: Optional[float] = None) -> Dict[str, Any]:
+    """The rows/chip + QPS/worker model, fitted from the newest records
+    that actually measured each quantity (degraded rounds measured a
+    CPU fallback, not a chip — they never feed the chip-rate fit).
+    Every estimate names its source record; absent inputs yield null
+    estimates, never fabricated ones."""
+    S = staleness_s if staleness_s is not None else staleness_bound_s()
+    out: Dict[str, Any] = {
+        "staleness_bound_s": S,
+        "rows_per_chip_per_s": None,
+        "rows_per_chip_at_staleness": None,
+        "train_source_record": None,
+        "qps_per_worker": None,
+        "qps_source_record": None,
+        "serve_p99_ms": None,
+        "mfu": None,
+        "shard": None,
+        "projections": {},
+    }
+    benches = [r for r in records if r.kind == "bench"
+               and r.parsed is not None]
+    # newest-first for "the current capability"
+    for rec in reversed(benches):
+        if out["train_source_record"] is None and not rec.degraded:
+            nnz = _num(rec.parsed, "nnz")
+            wall = _num(rec.parsed, "value")
+            if nnz and wall and wall > 0:
+                rate = nnz / wall  # single-chip training leg
+                out["rows_per_chip_per_s"] = round(rate, 1)
+                out["rows_per_chip_at_staleness"] = round(rate * S)
+                out["train_source_record"] = rec.name
+                out["mfu"] = _num(rec.parsed, "mfu")
+        # degraded rounds serve a reduced-nnz CPU fallback — their QPS
+        # would size the fleet from a measurement no production worker
+        # resembles (same guard as the train-rate fit above)
+        if out["qps_source_record"] is None and not rec.degraded:
+            qps = _num(rec.parsed, "serve_qps_concurrent")
+            if qps and qps > 0:
+                out["qps_per_worker"] = round(qps, 1)
+                out["qps_source_record"] = rec.name
+                out["serve_p99_ms"] = _num(rec.parsed, "serve_p99_ms")
+        if out["shard"] is None:
+            devs = _num(rec.parsed, "shard_devices")
+            if devs:
+                out["shard"] = {
+                    "source_record": rec.name,
+                    "devices": int(devs),
+                    "mesh_shape": rec.parsed.get("shard_mesh_shape"),
+                    "train_wall_s": _num(rec.parsed,
+                                         "shard_train_wall_s"),
+                    "nnz": _num(rec.parsed, "shard_nnz"),
+                    "mfu": _num(rec.parsed, "shard_mfu_train"),
+                    "gather_modes": rec.parsed.get("shard_gather_modes"),
+                }
+    rate = out["rows_per_chip_per_s"]
+    qps = out["qps_per_worker"]
+    projections: Dict[str, Any] = {}
+    if rate:
+        projections["chips_for_rows_at_staleness"] = {
+            str(rows): math.ceil(rows / (rate * S))
+            for rows in (100_000_000, 1_000_000_000, 10_000_000_000)
+        }
+    if qps:
+        projections["workers_for_qps"] = {
+            str(q): math.ceil(q / qps)
+            for q in (10_000, 100_000, 1_000_000)
+        }
+    out["projections"] = projections
+    return out
+
+
+# ---------------------------------------------------------------------------
+# regression verdicts
+# ---------------------------------------------------------------------------
+
+#: default relative tolerance for the key-by-key compare; walls on
+#: shared CI boxes are noisy, so the gate is a tripwire for real
+#: regressions (2x walls, halved QPS), not a 5% perf police
+DEFAULT_TOLERANCE = 0.25
+
+
+def load_baseline(repo_dir: str,
+                  path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """The pinned baseline: ``{"record": name, "tolerance": float,
+    "keys": {key: value}}``. None when the file is absent (the compare
+    then pins against the OLDEST fully-parsed bench record, honestly
+    labeled)."""
+    p = path or os.path.join(repo_dir, BASELINE_FILENAME)
+    try:
+        with open(p, encoding="utf-8") as f:
+            base = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return base if isinstance(base, dict) and "keys" in base else None
+
+
+def compare_record(parsed: Dict[str, Any],
+                   baseline_keys: Dict[str, Any],
+                   tolerance: float = DEFAULT_TOLERANCE
+                   ) -> Dict[str, Any]:
+    """Key-by-key tolerance compare. Keys whose record value is null
+    (or missing, or non-numeric) are SKIPPED — a degraded round's
+    honest nulls are not regressions. Shape keys must agree or the
+    whole compare is ``incomparable_shape``."""
+    for k in SHAPE_KEYS:
+        b, v = baseline_keys.get(k), parsed.get(k)
+        if b is not None and v is not None and b != v:
+            return {"status": "incomparable_shape",
+                    "detail": f"{k}: baseline {b} vs record {v}",
+                    "compared": 0, "skipped": [], "regressed": [],
+                    "improved": []}
+    regressed: List[Dict[str, Any]] = []
+    improved: List[str] = []
+    skipped: List[str] = []
+    compared = 0
+    for key, base_v in baseline_keys.items():
+        direction = key_direction(key)
+        if direction is None or not isinstance(
+                base_v, (int, float)) or isinstance(base_v, bool):
+            continue
+        v = parsed.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            skipped.append(key)
+            continue
+        compared += 1
+        if base_v == 0:
+            continue  # a zero baseline has no relative band
+        ratio = v / base_v
+        if direction == "lower":
+            if ratio > 1.0 + tolerance:
+                regressed.append({"key": key, "baseline": base_v,
+                                  "value": v,
+                                  "ratio": round(ratio, 3)})
+            elif ratio < 1.0 - tolerance:
+                improved.append(key)
+        else:
+            if ratio < 1.0 - tolerance:
+                regressed.append({"key": key, "baseline": base_v,
+                                  "value": v,
+                                  "ratio": round(ratio, 3)})
+            elif ratio > 1.0 + tolerance:
+                improved.append(key)
+    return {
+        "status": "regressed" if regressed else "ok",
+        "compared": compared,
+        "skipped": skipped,
+        "regressed": regressed,
+        "improved": improved,
+    }
+
+
+def record_verdicts(records: Sequence[NormalizedRecord],
+                    baseline: Optional[Dict[str, Any]],
+                    tolerance: float = DEFAULT_TOLERANCE
+                    ) -> List[Dict[str, Any]]:
+    """One NON-NULL verdict per record: parsed bench records compare
+    against the baseline, unparsed ones carry their structured
+    ``skipped_reason``, MULTICHIP records report pass/fail."""
+    base_keys = (baseline or {}).get("keys") or {}
+    base_name = (baseline or {}).get("record")
+    tol = float((baseline or {}).get("tolerance", tolerance))
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        entry = rec.summary()
+        if rec.kind == "multichip":
+            if rec.ok:
+                entry["verdict"] = {"status": "ok"}
+            else:
+                entry["verdict"] = {"status": "skipped",
+                                    "reason": rec.skipped_reason}
+        elif rec.parsed is None:
+            entry["verdict"] = {"status": "skipped",
+                                "reason": rec.skipped_reason}
+        elif rec.name == base_name:
+            entry["verdict"] = {"status": "baseline"}
+        elif not base_keys:
+            entry["verdict"] = {"status": "no_baseline"}
+        else:
+            v = compare_record(rec.parsed, base_keys, tol)
+            v["baseline"] = base_name
+            entry["verdict"] = v
+        out.append(entry)
+    return out
+
+
+def capacity_report(repo_dir: str,
+                    baseline_path: Optional[str] = None,
+                    staleness_s: Optional[float] = None
+                    ) -> Dict[str, Any]:
+    """The whole ``capacity.json`` payload: normalized trajectory with
+    per-record verdicts, the fitted capacity model, and the newest
+    record's regression compare."""
+    records = load_trajectory(repo_dir)
+    baseline = load_baseline(repo_dir, baseline_path)
+    if baseline is None:
+        # honest fallback: pin against the oldest fully-parsed bench
+        oldest = next((r for r in records
+                       if r.kind == "bench" and r.parsed is not None),
+                      None)
+        if oldest is not None:
+            baseline = {"record": oldest.name,
+                        "tolerance": DEFAULT_TOLERANCE,
+                        "keys": oldest.parsed,
+                        "provenance": "fallback:oldest_parsed"}
+    verdicts = record_verdicts(records, baseline)
+    newest = next((r for r in reversed(records)
+                   if r.kind == "bench" and r.parsed is not None), None)
+    regression: Dict[str, Any] = {
+        "baseline": (baseline or {}).get("record"),
+        "baseline_provenance": (baseline or {}).get(
+            "provenance", "pinned"),
+        "newest": newest.name if newest else None,
+        "status": "no_data",
+    }
+    if newest is not None and baseline is not None:
+        cmp = compare_record(
+            newest.parsed, baseline.get("keys") or {},
+            float(baseline.get("tolerance", DEFAULT_TOLERANCE)))
+        regression.update(cmp)
+        if newest.name == baseline.get("record"):
+            regression["status"] = "baseline"
+    return {
+        "staleness_bound_s": (staleness_s if staleness_s is not None
+                              else staleness_bound_s()),
+        "records": verdicts,
+        "capacity": fit_capacity(records, staleness_s),
+        "regression": regression,
+    }
+
+
+__all__ = [
+    "BASELINE_FILENAME", "DEFAULT_TOLERANCE", "NormalizedRecord",
+    "RECORD_GLOBS", "capacity_report", "classify_failure",
+    "compare_record", "fit_capacity", "key_direction", "load_baseline",
+    "load_trajectory", "normalize_record", "record_verdicts",
+    "staleness_bound_s",
+]

@@ -84,19 +84,59 @@ def add_metrics_route(router) -> None:
 
 def add_slo_route(router) -> None:
     """Register ``GET /slo`` — the burn-rate engine's JSON evaluation.
-    Unauthenticated like /metrics (operational state only)."""
+    Unauthenticated like /metrics (operational state only).
+    ``?fleet=1`` evaluates the SAME objectives over the federated
+    registry (obs/federate.py) instead of this process's — the fleet
+    p99 promise, not one worker's."""
     from incubator_predictionio_tpu.obs import slo as obs_slo
     from incubator_predictionio_tpu.utils.http import Request, Response
 
     def slo_route(request: Request) -> Response:
-        engine = obs_slo.get_engine()
+        fleet = request.query.get("fleet", "") not in ("", "0", "false")
+        if fleet:
+            from incubator_predictionio_tpu.obs import federate
+
+            engine = federate.fleet_slo_engine()
+            try:
+                slos = engine.evaluate()
+            except ValueError as e:  # no PIO_FLEET_TARGETS configured
+                return Response(400, {"message": str(e)})
+        else:
+            engine = obs_slo.get_engine()
+            slos = engine.evaluate()
         return Response(200, {
-            "slos": engine.evaluate(),
+            "scope": "fleet" if fleet else "process",
+            "slos": slos,
             "windows": {"fastSeconds": engine.fast_window_s,
                         "slowSeconds": engine.slow_window_s},
         })
 
     router.add("GET", "/slo", slo_route)
+
+
+def add_federate_route(router) -> None:
+    """Register ``GET /federate`` — scrape every ``PIO_FLEET_TARGETS``
+    worker's ``/metrics``, merge the families under an ``instance``
+    label and re-expose the fleet as ONE text exposition
+    (obs/federate.py). The handler is synchronous, so the HTTP layer
+    runs it on the executor: N worker scrapes never block the admin's
+    event loop. 503 when no targets are configured — an empty
+    federation is a misconfiguration, not an empty healthy fleet."""
+    from incubator_predictionio_tpu.obs import federate
+    from incubator_predictionio_tpu.utils.http import Request, Response
+
+    def federate_route(request: Request) -> Response:
+        try:
+            snapshot = federate.federate()
+        except ValueError as e:
+            return Response(503, {"message": str(e)})
+        return Response(
+            200,
+            body=snapshot.expose().encode("utf-8"),
+            content_type=metrics.CONTENT_TYPE,
+        )
+
+    router.add("GET", "/federate", federate_route)
 
 
 def add_profile_route(router) -> None:
@@ -188,6 +228,6 @@ def render_slo_panel() -> str:
 
 
 __all__ = [
-    "add_metrics_route", "add_slo_route", "add_profile_route",
-    "render_latency_panels", "render_slo_panel",
+    "add_federate_route", "add_metrics_route", "add_slo_route",
+    "add_profile_route", "render_latency_panels", "render_slo_panel",
 ]

@@ -278,6 +278,21 @@ class _Metric:
             children = list(self._children.values())
         return sum(c.value for c in children)
 
+    def max_value(self) -> float:
+        """Max over every labeled child (counter/gauge families) — the
+        worst-of reading gauge SLOs evaluate (obs/slo.py): on a fleet-
+        federated registry the stalest worker governs, and on the
+        single-child process gauge this equals the value. Children
+        never written don't vote (a registered-but-unset gauge must
+        not read as a healthy 0)."""
+        if self.kind == "histogram":
+            raise ValueError("max_value() is for counter/gauge")
+        with self._lock:
+            children = list(self._children.values())
+        written = [c.value for c in children
+                   if getattr(c, "_touched", True)]
+        return max(written) if written else 0.0
+
     def has_samples(self) -> bool:
         """Gauge families: True when any child was ever written.
         Registration alone creates a 0.0-valued child, and a consumer

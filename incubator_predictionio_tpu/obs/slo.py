@@ -120,7 +120,8 @@ class SLOEngine:
                  fast_window_s: Optional[float] = None,
                  slow_window_s: Optional[float] = None,
                  min_tick_interval_s: float = 1.0,
-                 max_snapshots: int = 8192) -> None:
+                 max_snapshots: int = 8192,
+                 export_gauges: bool = True) -> None:
         self.specs = tuple(specs if specs is not None else default_specs())
         self.registry = registry if registry is not None \
             else obs_metrics.REGISTRY
@@ -131,6 +132,13 @@ class SLOEngine:
         self.slow_window_s = (slow_window_s if slow_window_s is not None
                               else _env_float("PIO_SLO_SLOW_WINDOW_S",
                                               3600.0))
+        #: whether evaluate() refreshes the process-registry burn/budget
+        #: gauges. The FLEET engine (obs/federate.py) passes False: it
+        #: evaluates a different population over the same gauge names,
+        #: and letting it write pio_slo_burn_rate{slo,window} would make
+        #: the exported series flip meaning between fleet and process
+        #: depending on which endpoint ran last
+        self._export_gauges = bool(export_gauges)
         self._min_tick = float(min_tick_interval_s)
         self._lock = threading.Lock()
         #: ring of (t, {slo_name: (good, bad)}) CUMULATIVE counts
@@ -158,7 +166,11 @@ class SLOEngine:
                     # report a green staleness budget
                     continue
                 good, bad = self._gauge_counts.get(spec.name, (0, 0))
-                if metric.total() <= spec.threshold:
+                # worst-of, not sum-of: a gauge objective holds only
+                # when EVERY child (and, on a federated registry, every
+                # instance) is under the bound — the stalest worker
+                # governs the fleet's staleness SLO
+                if metric.max_value() <= spec.threshold:
                     good += 1
                 else:
                     bad += 1
@@ -246,14 +258,16 @@ class SLOEngine:
                     "badFraction": round(bad_frac, 6),
                     "burnRate": round(burn, 4),
                 }
-                BURN_RATE.labels(slo=spec.name, window=wname).set(burn)
+                if self._export_gauges:
+                    BURN_RATE.labels(slo=spec.name, window=wname).set(burn)
             remaining = max(1.0 - burns["slow"], 0.0)
             entry["errorBudgetRemaining"] = round(remaining, 4)
             # page-worthy breach: budget burning faster than allowed in
             # the fast window (the slow window confirms sustained burns
             # via errorBudgetRemaining)
             entry["breached"] = bool(burns["fast"] > 1.0)
-            BUDGET_REMAINING.labels(slo=spec.name).set(remaining)
+            if self._export_gauges:
+                BUDGET_REMAINING.labels(slo=spec.name).set(remaining)
             out.append(entry)
         return out
 

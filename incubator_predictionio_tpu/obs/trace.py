@@ -27,16 +27,30 @@ import os
 import random
 import re
 import secrets
+import time
 from typing import Any, Optional, Tuple
 
 #: the propagation header, request and response side
 TRACE_HEADER = "X-PIO-Trace-Id"
+#: the cross-process PARENT link: an in-repo HTTP client stamps its own
+#: span ID here so the downstream server's span line carries
+#: ``parentSpanId`` and the two processes' spans join into one tree
+#: (scripts/trace_stitch.py reconstructs the timeline)
+PARENT_SPAN_HEADER = "X-PIO-Parent-Span"
+#: response-side: the span ID the server assigned to THIS request, so
+#: an external client can reference the server-side span in its own logs
+SPAN_HEADER = "X-PIO-Span-Id"
 
 _TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+#: span IDs share the trace-ID charset (locally generated ones are 8
+#: hex chars, but a foreign tracer's IDs must survive the hop too)
+_SPAN_ID_RE = _TRACE_ID_RE
 
 _current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "pio_trace_id", default=None
 )
+_current_span: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("pio_span_id", default=None)
 
 #: one JSON object per line; operators point this at their log shipper
 span_logger = logging.getLogger("pio.trace")
@@ -67,6 +81,50 @@ def set_current(trace_id: Optional[str]) -> contextvars.Token:
 
 def reset_current(token: contextvars.Token) -> None:
     _current.reset(token)
+
+
+def new_span_id() -> str:
+    """8 hex chars — unique within one trace's fan-out."""
+    return secrets.token_hex(4)
+
+
+def accept_parent_span(incoming: Optional[str]) -> Optional[str]:
+    """The incoming parent-span header when well-formed, else None.
+    Unlike trace IDs a malformed parent is DROPPED, not replaced: a
+    fabricated parent would invent linkage that never happened."""
+    if incoming and _SPAN_ID_RE.match(incoming):
+        return incoming
+    return None
+
+
+def current_span_id() -> Optional[str]:
+    """The ambient request's server-side span ID (None outside one)."""
+    return _current_span.get()
+
+
+def set_current_span(span_id: Optional[str]) -> contextvars.Token:
+    return _current_span.set(span_id)
+
+
+def reset_current_span(token: contextvars.Token) -> None:
+    _current_span.reset(token)
+
+
+def client_headers() -> dict:
+    """Headers an in-repo HTTP client attaches to a downstream hop
+    (prediction/event server → storage server, admin → workers,
+    bench → servers): the ambient trace ID plus this request's span ID
+    as the downstream parent. Empty outside a request — a client with
+    no ambient trace forwards nothing and the server starts a fresh
+    trace, exactly as before."""
+    tid = _current.get()
+    if tid is None:
+        return {}
+    out = {TRACE_HEADER: tid}
+    sid = _current_span.get()
+    if sid is not None:
+        out[PARENT_SPAN_HEADER] = sid
+    return out
 
 
 def enable_span_logging() -> None:
@@ -125,9 +183,15 @@ def span_sampled() -> bool:
 
 
 def log_span(server: str, method: str, route: str, status: int,
-             duration_s: float, trace_id: str, **extra: Any) -> None:
+             duration_s: float, trace_id: str,
+             span_id: Optional[str] = None,
+             parent_span_id: Optional[str] = None,
+             **extra: Any) -> None:
     """Emit the per-request JSON span line. Pre-gated on the logger
-    level so a silenced logger costs one attribute read per request."""
+    level so a silenced logger costs one attribute read per request.
+    ``span_id``/``parent_span_id`` carry the cross-process parenting
+    contract: the downstream hop's line names the upstream span, so
+    span lines from multiple processes link into one request tree."""
     if not span_logger.isEnabledFor(logging.INFO):
         return
     record = {
@@ -136,9 +200,17 @@ def log_span(server: str, method: str, route: str, status: int,
         "method": method,
         "route": route,
         "status": status,
+        # wall stamp (epoch s, ms precision): cross-PROCESS span lines
+        # have no shared log stream, so the stitcher orders them by
+        # wall clock — NTP-grade skew is fine at request granularity
+        "ts": round(time.time(), 3),
         "durationMs": round(duration_s * 1e3, 3),
         "traceId": trace_id,
     }
+    if span_id is not None:
+        record["spanId"] = span_id
+    if parent_span_id is not None:
+        record["parentSpanId"] = parent_span_id
     if extra:
         record.update(extra)
     span_logger.info("%s", json.dumps(record, separators=(",", ":")))
@@ -155,6 +227,7 @@ def log_stage_span(span: str, trace_id: str, duration_s: float,
         return
     record = {
         "span": span,
+        "ts": round(time.time(), 3),
         "durationMs": round(duration_s * 1e3, 3),
         "traceId": trace_id,
     }

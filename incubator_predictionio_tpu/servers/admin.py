@@ -13,6 +13,7 @@ from typing import Optional
 
 from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
 from incubator_predictionio_tpu.obs.http import (
+    add_federate_route,
     add_metrics_route,
     add_profile_route,
     add_slo_route,
@@ -108,8 +109,15 @@ class AdminServer:
             return Response(200, {"message": f"App {app.name} data deleted."})
 
         add_metrics_route(r)
+        # GET /federate: scrape the PIO_FLEET_TARGETS workers' /metrics
+        # and re-expose the merged fleet series under an `instance`
+        # label — the one-scrape fleet truth the ROADMAP-2 load-shedder
+        # and ROADMAP-3 controller consume (docs/observability.md
+        # "Fleet")
+        add_federate_route(r)
         # GET /slo: the burn-rate engine's JSON evaluation — the signal
-        # the autonomous retrain controller (ROADMAP-3) will consume
+        # the autonomous retrain controller (ROADMAP-3) will consume;
+        # ?fleet=1 evaluates the same objectives over the federation
         add_slo_route(r)
         # POST /profile?seconds=N: on-demand jax.profiler xplane capture
         # for the kernel/MFU work (ROADMAP-5); runs on the executor so

@@ -36,6 +36,7 @@ from incubator_predictionio_tpu.core.engine import Engine
 from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
 from incubator_predictionio_tpu.data.storage import EngineInstance, Storage
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs import trace as obs_trace
 from incubator_predictionio_tpu.obs.http import add_metrics_route
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 from incubator_predictionio_tpu.servers.plugins import PluginContext
@@ -624,11 +625,16 @@ class PredictionServer:
             "message": message,
         })
 
+        # trace headers captured HERE: the poster runs on its own daemon
+        # thread where the request's contextvars are gone
+        trace_headers = obs_trace.client_headers()
+
         def post() -> None:
             try:
                 req = urllib.request.Request(
                     self.config.log_url, data=payload.encode(),
-                    headers={"Content-Type": "application/json"},
+                    headers={"Content-Type": "application/json",
+                             **trace_headers},
                     method="POST")
                 with urllib.request.urlopen(req, timeout=10):
                     pass
@@ -664,11 +670,15 @@ class PredictionServer:
             f"?accessKey={self.config.access_key or ''}"
         )
 
+        # trace headers captured before the executor hop (see _remote_log)
+        trace_headers = obs_trace.client_headers()
+
         def post() -> None:
             try:
                 req = urllib.request.Request(
                     url, data=json.dumps(data).encode(),
-                    headers={"Content-Type": "application/json"}, method="POST",
+                    headers={"Content-Type": "application/json",
+                             **trace_headers}, method="POST",
                 )
                 with urllib.request.urlopen(req, timeout=10) as resp:
                     if resp.status != 201:

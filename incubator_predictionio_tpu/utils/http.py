@@ -378,10 +378,18 @@ class HttpServer:
         t0 = time.perf_counter()
         trace_id = obs_trace.accept_trace_id(
             request.headers.get("x-pio-trace-id"))
+        # cross-process parenting: an in-repo client hop stamps its own
+        # span ID in X-PIO-Parent-Span (obs_trace.client_headers), so
+        # this request's span line links under the upstream span
+        parent_span = obs_trace.accept_parent_span(
+            request.headers.get("x-pio-parent-span"))
+        span_id = obs_trace.new_span_id()
         token = obs_trace.set_current(trace_id)
+        span_token = obs_trace.set_current_span(span_id)
         try:
             response, route = await self._dispatch_routed(request)
         finally:
+            obs_trace.reset_current_span(span_token)
             obs_trace.reset_current(token)
         dt = time.perf_counter() - t0
         route_label = route or _UNMATCHED_ROUTE
@@ -389,13 +397,20 @@ class HttpServer:
             server=self.name, method=request.method, route=route_label,
             status=str(response.status)).inc()
         _HTTP_LATENCY.labels(server=self.name, route=route_label).observe(dt)
+        # the propagation contract is unconditional and status-blind:
+        # error responses (4xx/5xx) echo the trace ID and emit their
+        # span line exactly like the happy path — a failing hop is the
+        # one an operator most needs to find in the tree
         response.headers.setdefault(obs_trace.TRACE_HEADER, trace_id)
+        response.headers.setdefault(obs_trace.SPAN_HEADER, span_id)
         # span sampling (PIO_TRACE_SAMPLE): the JSON line is the one
         # per-request cost that scales with QPS; sampled-out requests
         # still got their trace ID stamped and echoed above
         if obs_trace.span_sampled():
             obs_trace.log_span(self.name, request.method, route_label,
-                               response.status, dt, trace_id)
+                               response.status, dt, trace_id,
+                               span_id=span_id,
+                               parent_span_id=parent_span)
         return response
 
     async def _dispatch_routed(

@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""capacity_report — the bench trajectory as a capacity model + CI gate.
+
+Ingests the repo's ``BENCH_*.json`` / ``MULTICHIP_*.json`` records
+(obs/capacity.py normalizes every era's record shape and classifies
+unparsed rounds into structured skip reasons), fits the
+rows-per-chip-at-fixed-staleness and QPS-per-worker estimates, compares
+the newest parsed record against the pinned ``CAPACITY_BASELINE.json``,
+and writes the whole thing as machine-readable ``capacity.json``.
+
+    python scripts/capacity_report.py                  # report + write
+    python scripts/capacity_report.py --check          # CI gate: exit 1
+                                                       # on a regression
+    python scripts/capacity_report.py --json -         # payload → stdout
+
+``--check`` fails only on a REGRESSED newest record (or an unreadable
+trajectory) — skipped/degraded rounds carry their structured reasons
+and pass, because an explained absence is not a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from incubator_predictionio_tpu.obs import capacity  # noqa: E402
+
+
+def _fmt_capacity(cap: dict) -> str:
+    lines = []
+    rate = cap.get("rows_per_chip_per_s")
+    if rate:
+        lines.append(
+            f"  rows/chip/s       : {rate:,.0f}  "
+            f"(from {cap['train_source_record']}, mfu={cap.get('mfu')})")
+        lines.append(
+            f"  rows/chip @ {cap['staleness_bound_s']:.0f}s staleness: "
+            f"{cap['rows_per_chip_at_staleness']:,}")
+    else:
+        lines.append("  rows/chip/s       : no non-degraded training "
+                     "record in the trajectory")
+    qps = cap.get("qps_per_worker")
+    if qps:
+        lines.append(f"  QPS/worker        : {qps:,.0f}  "
+                     f"(from {cap['qps_source_record']}, "
+                     f"p99={cap.get('serve_p99_ms')}ms)")
+    for title, proj in (cap.get("projections") or {}).items():
+        lines.append(f"  {title}: "
+                     + ", ".join(f"{k}→{v}" for k, v in proj.items()))
+    shard = cap.get("shard")
+    if shard:
+        lines.append(f"  shard leg         : {shard['devices']} devices "
+                     f"({shard.get('mesh_shape')}), "
+                     f"wall={shard.get('train_wall_s')}s, "
+                     f"mfu={shard.get('mfu')} "
+                     f"(from {shard['source_record']})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="capacity + regression model over the checked-in "
+                    "bench records")
+    ap.add_argument("--repo-dir", default=_REPO,
+                    help="directory holding BENCH_*/MULTICHIP_* records")
+    ap.add_argument("--baseline",
+                    help=f"baseline file (default: "
+                         f"<repo>/{capacity.BASELINE_FILENAME})")
+    ap.add_argument("--out", default="capacity.json",
+                    help="output path ('-' to skip writing)")
+    ap.add_argument("--staleness-s", type=float, default=None,
+                    help="staleness bound for the rows/chip projection "
+                         "(default: PIO_SLO_STALENESS_S or 3600)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full payload as JSON on stdout")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: exit 1 when the newest parsed record "
+                         "regressed vs the baseline")
+    args = ap.parse_args(argv)
+
+    report = capacity.capacity_report(
+        args.repo_dir, baseline_path=args.baseline,
+        staleness_s=args.staleness_s)
+    report["generated_at"] = round(time.time(), 3)
+
+    if args.out and args.out != "-":
+        out_path = (args.out if os.path.isabs(args.out)
+                    else os.path.join(os.getcwd(), args.out))
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out_path}", file=sys.stderr)
+
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        print(f"trajectory: {len(report['records'])} records")
+        for rec in report["records"]:
+            v = rec["verdict"]
+            status = v["status"]
+            extra = ""
+            if status == "skipped" and rec.get("skipped_reason"):
+                extra = f" ({rec['skipped_reason']['class']})"
+            elif status == "regressed":
+                keys = ",".join(r["key"] for r in v["regressed"])
+                extra = f" ({keys})"
+            print(f"  {rec['name']:<24} {status}{extra}")
+        print("capacity:")
+        print(_fmt_capacity(report["capacity"]))
+        reg = report["regression"]
+        print(f"regression: newest={reg.get('newest')} vs "
+              f"baseline={reg.get('baseline')} -> {reg['status']}")
+
+    if args.check:
+        reg = report["regression"]
+        if reg["status"] == "regressed":
+            print("CHECK FAILED: newest record regressed vs baseline: "
+                  + ", ".join(f"{r['key']} {r['baseline']}→{r['value']}"
+                              for r in reg["regressed"]),
+                  file=sys.stderr)
+            return 1
+        missing = [r["name"] for r in report["records"]
+                   if r["verdict"].get("status") == "skipped"
+                   and not (r["verdict"].get("reason") or {}).get("class")]
+        if missing:
+            print(f"CHECK FAILED: unexplained records: {missing}",
+                  file=sys.stderr)
+            return 1
+        print("CHECK OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""trace_stitch — reconstruct a request's cross-server timeline from
+``pio.trace`` span logs.
+
+Every server emits one JSON span line per request (obs/trace.py), and
+every in-repo HTTP client hop forwards ``X-PIO-Trace-Id`` plus its own
+span ID as ``X-PIO-Parent-Span`` — so the span lines from a prediction
+server, the storage server it calls, and the event server a feedback
+POST lands on all carry one trace ID and parent-span links. This tool
+joins them back into one tree:
+
+    # all spans of one request, across every process's log
+    cat prediction.log storage.log | python scripts/trace_stitch.py \
+        --trace e2e-trace-0042
+
+    # summarize every trace seen in the logs
+    python scripts/trace_stitch.py logs/*.log --list
+
+Lines that are not JSON span objects (ordinary log output) are skipped,
+so the tool can eat raw mixed stderr streams. Ordering inside a trace
+uses the per-line wall stamp (``ts``); cross-process skew at request
+granularity is NTP-bounded and only affects sibling order, never the
+parent/child structure (that comes from the span IDs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List, Optional, TextIO
+
+
+def parse_span_lines(lines: Iterable[str]) -> List[dict]:
+    """Extract the JSON span records from a mixed log stream: any line
+    whose JSON object carries a ``traceId`` counts; everything else —
+    non-JSON, JSON without a trace — is silently skipped."""
+    spans: List[dict] = []
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and obj.get("traceId"):
+            spans.append(obj)
+    return spans
+
+
+def group_by_trace(spans: List[dict]) -> Dict[str, List[dict]]:
+    out: Dict[str, List[dict]] = {}
+    for s in spans:
+        out.setdefault(s["traceId"], []).append(s)
+    return out
+
+
+def build_tree(spans: List[dict]) -> List[dict]:
+    """Link one trace's spans into a forest on spanId/parentSpanId.
+    Returns the roots; every span gains a ``children`` list. A span
+    whose parent never logged (sampled out, foreign process, crashed
+    mid-request) becomes a root — an orphan is still evidence."""
+    by_id: Dict[str, dict] = {}
+    for s in spans:
+        s.setdefault("children", [])
+        sid = s.get("spanId")
+        if sid:
+            by_id[sid] = s
+    roots: List[dict] = []
+    for s in spans:
+        parent = by_id.get(s.get("parentSpanId") or "")
+        if parent is not None and parent is not s:
+            parent["children"].append(s)
+        else:
+            roots.append(s)
+    def ts(s: dict) -> float:
+        return float(s.get("ts") or 0.0)
+    for s in spans:
+        s["children"].sort(key=ts)
+    roots.sort(key=ts)
+    return roots
+
+
+def _span_label(s: dict) -> str:
+    if s.get("span") == "http.request":
+        core = (f"{s.get('server', '?')} {s.get('method', '?')} "
+                f"{s.get('route', '?')} -> {s.get('status', '?')}")
+    else:
+        core = str(s.get("span", "?"))
+    dur = s.get("durationMs")
+    dur_s = f" {dur:.3f}ms" if isinstance(dur, (int, float)) else ""
+    sid = s.get("spanId")
+    sid_s = f" [{sid}]" if sid else ""
+    return core + dur_s + sid_s
+
+
+def render_trace(trace_id: str, spans: List[dict],
+                 out: Optional[TextIO] = None) -> str:
+    """Indented cross-server timeline of one trace; offsets are
+    relative to the trace's earliest stamped span."""
+    lines: List[str] = [f"trace {trace_id} ({len(spans)} spans)"]
+    stamped = [float(s["ts"]) for s in spans if s.get("ts")]
+    t0 = min(stamped) if stamped else 0.0
+
+    def emit(span: dict, depth: int) -> None:
+        ts = span.get("ts")
+        off = f"+{(float(ts) - t0) * 1e3:9.1f}ms" if ts else " " * 12
+        lines.append(f"  {off} {'  ' * depth}{_span_label(span)}")
+        for child in span["children"]:
+            emit(child, depth + 1)
+
+    for root in build_tree(spans):
+        emit(root, 0)
+    text = "\n".join(lines)
+    if out is not None:
+        print(text, file=out)
+    return text
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="stitch pio.trace span logs into per-trace "
+                    "cross-server timelines")
+    ap.add_argument("files", nargs="*",
+                    help="log files to read (default: stdin)")
+    ap.add_argument("--trace", help="only this trace ID")
+    ap.add_argument("--list", action="store_true",
+                    help="one summary line per trace instead of trees")
+    args = ap.parse_args(argv)
+
+    lines: List[str] = []
+    if args.files:
+        for path in args.files:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                lines.extend(f)
+    else:
+        lines.extend(sys.stdin)
+
+    traces = group_by_trace(parse_span_lines(lines))
+    if args.trace:
+        traces = {k: v for k, v in traces.items() if k == args.trace}
+        if not traces:
+            print(f"no spans for trace {args.trace!r}", file=sys.stderr)
+            return 1
+    if args.list:
+        for tid, spans in sorted(traces.items()):
+            servers = sorted({s.get("server", s.get("span", "?"))
+                              for s in spans})
+            print(f"{tid}  {len(spans)} spans  {','.join(servers)}")
+        return 0
+    first = True
+    for tid, spans in sorted(traces.items()):
+        if not first:
+            print()
+        render_trace(tid, spans, out=sys.stdout)
+        first = False
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

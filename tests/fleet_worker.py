@@ -1,0 +1,91 @@
+"""Worker program for the fleet-observability two-process tests.
+
+Run by tests/test_federation.py as a REAL second (and third) process:
+
+- ``--mode metrics``: an HttpServer exposing ``GET /metrics`` from its
+  own process registry, with a planted query-latency histogram and
+  queue-depth gauge — one "serving worker" for the admin's
+  ``GET /federate`` to scrape.
+- ``--mode storage``: a memory-backed StorageServer with span logging
+  enabled — the downstream hop of the cross-process trace test: the
+  parent's event server forwards ``X-PIO-Trace-Id``/``X-PIO-Parent-
+  Span`` on its storage RPCs, and THIS process's ``pio.trace`` span
+  lines (on stderr) must link under the parent's spans.
+
+Prints ``PORT <n>`` on stdout once bound, then serves until stdin
+closes (the parent owns the lifetime; no signals needed).
+"""
+
+import argparse
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("metrics", "storage"),
+                    required=True)
+    ap.add_argument("--observe", default="",
+                    help="comma-separated seconds planted into "
+                         "pio_query_latency_seconds (metrics mode)")
+    ap.add_argument("--depth", type=float, default=0.0,
+                    help="pio_serve_queue_depth value (metrics mode)")
+    ap.add_argument("--staleness", type=float, default=None,
+                    help="pio_model_staleness_seconds value "
+                         "(metrics mode)")
+    args = ap.parse_args()
+
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+    from incubator_predictionio_tpu.obs import trace as obs_trace
+
+    obs_trace.enable_span_logging()
+
+    if args.mode == "metrics":
+        from incubator_predictionio_tpu.obs.http import add_metrics_route
+        from incubator_predictionio_tpu.utils.http import (
+            HttpServer,
+            Router,
+        )
+
+        h = obs_metrics.REGISTRY.histogram(
+            "pio_query_latency_seconds",
+            "per-query serving wall")
+        for raw in args.observe.split(","):
+            raw = raw.strip()
+            if raw:
+                h.observe(float(raw))
+        obs_metrics.REGISTRY.gauge(
+            "pio_serve_queue_depth", "micro-batcher backlog").set(
+            args.depth)
+        if args.staleness is not None:
+            obs_metrics.REGISTRY.gauge(
+                "pio_model_staleness_seconds",
+                "age of the served engine instance").set(args.staleness)
+        r = Router()
+        add_metrics_route(r)
+        srv = HttpServer(r, "127.0.0.1", 0, name="worker")
+        port = srv.start_background()
+    else:
+        from incubator_predictionio_tpu.data.storage import (
+            StorageClientConfig,
+        )
+        from incubator_predictionio_tpu.data.storage import (
+            memory as memory_backend,
+        )
+        from incubator_predictionio_tpu.data.storage.server import (
+            StorageServer,
+        )
+
+        config = StorageClientConfig(test=True, properties={})
+        client = memory_backend.StorageClient(config)
+        srv = StorageServer(memory_backend, client, config,
+                            host="127.0.0.1", port=0)
+        port = srv.start_background()
+
+    print(f"PORT {port}", flush=True)
+    # serve until the parent closes our stdin (its process exit does)
+    sys.stdin.read()
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

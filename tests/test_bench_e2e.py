@@ -76,6 +76,12 @@ REQUIRED_FIELDS = {
     "shard_train_wall_s": (float, type(None)),
     "shard_mesh_shape": (str, type(None)),
     "shard_devices": (int, type(None)),
+    "shard_nnz": (int, type(None)),
+    "shard_sweeps": (int, type(None)),
+    # provenance (obs/capacity.py): every record explains its origin,
+    # and a record whose child landed carries no skip reason
+    "bench_env": dict,
+    "skipped_reason": type(None),
     "shard_allgather_bytes": (int, type(None)),
     "shard_mfu_train": (float, type(None)),
     "shard_gather_modes": (str, type(None)),
@@ -191,9 +197,18 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
     # here means the leg's designed degraded outcome fired (deadline too
     # close on a loaded box) — the record stays valid, the pins apply
     # whenever the leg actually ran.
+    # bench_env provenance block: the trajectory's "what produced this
+    # row" answer (backend/devices from the process that measured)
+    env_block = rec["bench_env"]
+    for key in ("backend", "device_count", "jax_version", "git_sha",
+                "hostname", "wall_ts", "python"):
+        assert key in env_block, key
+    assert env_block["backend"] == "cpu"
+    assert env_block["device_count"] >= 1
     if rec["shard_devices"] is not None:
         assert rec["shard_devices"] == 8
         assert rec["shard_mesh_shape"] == "8x1"
+        assert rec["shard_nnz"] > 0 and rec["shard_sweeps"] >= 1
         assert rec["shard_train_wall_s"] > 0
         assert rec["shard_allgather_bytes"] > 0
         assert rec["shard_mfu_train"] > 0

@@ -1,0 +1,432 @@
+"""Fleet observability: metrics federation + cross-process traces.
+
+Three layers of proof:
+
+1. **Planted merge math** — two in-process registries with known
+   observations, merged through the real parse→federate path, with the
+   federated histogram quantiles verified against HAND-computed merged
+   bucket sums (the acceptance oracle for the federation math).
+2. **Two-process /federate e2e** — two real worker processes
+   (tests/fleet_worker.py) scraped through the admin server's
+   ``GET /federate``: both workers' ``pio_query_latency_seconds`` come
+   back under distinct ``instance`` labels, the fleet quantile matches
+   the hand-merged bucket math, and ``GET /slo?fleet=1`` evaluates the
+   shipped objectives over the federation.
+3. **Two-process trace e2e** — an event server in THIS process backed
+   by a remote StorageServer in a child process: one trace ID produces
+   linked span lines in both processes (the storage span's
+   ``parentSpanId`` is the event span's ``spanId``), and
+   scripts/trace_stitch.py reassembles them into one tree.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from incubator_predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    Storage,
+)
+from incubator_predictionio_tpu.obs import expofmt, federate
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs.metrics import Registry
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+WORKER = os.path.join(TESTS_DIR, "fleet_worker.py")
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import trace_stitch  # noqa: E402
+
+
+# -- target grammar ---------------------------------------------------------
+
+def test_parse_targets_grammar():
+    ts = federate.parse_targets(
+        "10.0.0.1:8000, b=10.0.0.2:8000 ,http://h:9/custom,")
+    assert [(t.instance, t.url) for t in ts] == [
+        ("10.0.0.1:8000", "http://10.0.0.1:8000/metrics"),
+        ("b", "http://10.0.0.2:8000/metrics"),
+        ("h:9", "http://h:9/custom"),
+    ]
+    assert federate.parse_targets("") == []
+
+
+# -- planted two-registry merge vs hand bucket math -------------------------
+
+def _snapshot_from_registries(named_registries):
+    results = []
+    for instance, reg in named_registries:
+        fams = expofmt.parse_families(reg.expose())
+        results.append(federate.ScrapeResult(
+            target=federate.Target(instance, f"http://{instance}"),
+            ok=True, wall_s=0.0, families=fams))
+    return federate.FederatedSnapshot(results)
+
+
+def test_planted_merge_quantiles_match_hand_bucket_math():
+    r1, r2 = Registry(), Registry()
+    h1 = r1.histogram("pio_query_latency_seconds", "lat",
+                      buckets=(0.1, 1.0))
+    for _ in range(4):
+        h1.observe(0.05)
+    h2 = r2.histogram("pio_query_latency_seconds", "lat",
+                      buckets=(0.1, 1.0))
+    h2.observe(0.5)
+    h2.observe(0.5)
+    h2.observe(5.0)
+    h2.observe(5.0)
+    r1.gauge("pio_serve_queue_depth", "d").set(3)
+    r2.gauge("pio_serve_queue_depth", "d").set(7)
+    r1.counter("pio_http_requests_total", "r").inc(5)
+    r2.counter("pio_http_requests_total", "r").inc(5)
+
+    snap = _snapshot_from_registries((("w1", r1), ("w2", r2)))
+    m = snap.get("pio_query_latency_seconds")
+    # hand-merged buckets: le0.1=4, le1.0=2, overflow=2, total=8
+    assert m.count == 8
+    assert m.cumulative_below(0.1) == (4, 8)
+    assert m.cumulative_below(1.0) == (6, 8)
+    # p50: rank 4 lands exactly on the first bucket's cumulative 4 →
+    # linear interpolation to the bucket's upper bound: 0 + 0.1·(4/4)
+    assert m.quantile(0.50) == pytest.approx(0.1)
+    # p75: rank 6 → second bucket [0.1, 1.0] holding 2, needs both:
+    # 0.1 + 0.9·(2/2) = 1.0
+    assert m.quantile(0.75) == pytest.approx(1.0)
+    # p99: rank 7.92 is in the overflow — clamps to the last finite
+    # bound (the honest fixed-bucket answer, same as the registry)
+    assert m.quantile(0.99) == pytest.approx(1.0)
+    # gauges: fleet SUM for load-style gauges, MAX for worst-of
+    depth = snap.get("pio_serve_queue_depth")
+    assert depth.total() == 10 and depth.max_value() == 7
+    assert snap.get("pio_http_requests_total").total() == 10
+
+
+def test_federated_exposition_round_trips_and_labels_instances():
+    r1, r2 = Registry(), Registry()
+    for reg, val in ((r1, 1), (r2, 2)):
+        reg.counter("t_reqs_total", "x", labels=("route",)).labels(
+            route="/a").inc(val)
+        reg.histogram("t_lat_seconds", "x", buckets=(1.0,)).observe(0.5)
+    snap = _snapshot_from_registries((("w1", r1), ("w2", r2)))
+    text = snap.expose()
+    # the output round-trips through the SAME grammar parser that read
+    # the inputs
+    types, samples = expofmt.parse_exposition(text)
+    assert types["t_reqs_total"] == "counter"
+    assert samples[("t_reqs_total", frozenset(
+        {("instance", "w1"), ("route", "/a")}))] == 1
+    assert samples[("t_reqs_total", frozenset(
+        {("instance", "w2"), ("route", "/a")}))] == 2
+    assert samples[("pio_federate_up", frozenset(
+        {("instance", "w1")}))] == 1
+    # histogram children keep per-instance identity
+    b, s, tot = expofmt.histogram_series(
+        samples, "t_lat_seconds", frozenset({("instance", "w2")}))
+    assert tot == 1 and s == pytest.approx(0.5)
+
+
+def test_down_instance_degrades_per_instance_not_per_fleet():
+    res_ok = federate.ScrapeResult(
+        target=federate.Target("up", "http://up"), ok=True, wall_s=0.0,
+        families=expofmt.parse_families(Registry().expose()))
+    res_down = federate.ScrapeResult(
+        target=federate.Target("down", "http://down"), ok=False,
+        wall_s=0.1, families={}, error="connection refused")
+    snap = federate.FederatedSnapshot([res_ok, res_down])
+    _types, samples = expofmt.parse_exposition(snap.expose())
+    assert samples[("pio_federate_up", frozenset(
+        {("instance", "up")}))] == 1
+    assert samples[("pio_federate_up", frozenset(
+        {("instance", "down")}))] == 0
+
+
+# -- two-process /federate e2e ----------------------------------------------
+
+def _spawn_worker(*args):
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, *args],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, cwd=TESTS_DIR,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+    port_holder = []
+
+    def read_port():
+        line = proc.stdout.readline()
+        if line.startswith("PORT "):
+            port_holder.append(int(line.split()[1]))
+
+    t = threading.Thread(target=read_port, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    if not port_holder:
+        proc.kill()
+        _out, err = proc.communicate(timeout=30)
+        raise RuntimeError(f"worker never bound: {err[-2000:]}")
+    return proc, port_holder[0]
+
+
+def _stop_worker(proc):
+    # communicate() closes the worker's stdin (its exit signal), then
+    # drains stdout/stderr until the process ends
+    try:
+        return proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.communicate(timeout=30)
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+@pytest.fixture
+def mem_storage():
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    yield
+    Storage.reset()
+
+
+def test_federate_e2e_two_worker_processes(mem_storage, monkeypatch):
+    from incubator_predictionio_tpu.servers.admin import AdminServer
+
+    w1, p1 = _spawn_worker("--mode", "metrics",
+                           "--observe", "0.004,0.004,0.004",
+                           "--depth", "3", "--staleness", "100")
+    w2, p2 = _spawn_worker("--mode", "metrics",
+                           "--observe", "0.1,3.0",
+                           "--depth", "5", "--staleness", "9000")
+    admin = None
+    try:
+        monkeypatch.setenv(
+            "PIO_FLEET_TARGETS",
+            f"w1=127.0.0.1:{p1},w2=127.0.0.1:{p2}")
+        federate.reset_fleet_engine()
+        admin = AdminServer(ip="127.0.0.1", port=0)
+        aport = admin.start_background()
+
+        status, headers, body = _get(aport, "/federate")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        types, samples = expofmt.parse_exposition(body.decode())
+        # both workers' scrapes landed
+        assert samples[("pio_federate_up", frozenset(
+            {("instance", "w1")}))] == 1
+        assert samples[("pio_federate_up", frozenset(
+            {("instance", "w2")}))] == 1
+        # the latency histogram exists under DISTINCT instance labels
+        b1, _s1, t1 = expofmt.histogram_series(
+            samples, "pio_query_latency_seconds",
+            frozenset({("instance", "w1")}))
+        b2, _s2, t2 = expofmt.histogram_series(
+            samples, "pio_query_latency_seconds",
+            frozenset({("instance", "w2")}))
+        assert t1 == 3 and t2 == 2
+        # fleet-merged quantiles match HAND-merged bucket sums: merge
+        # the two children's cumulative buckets by bound, then run the
+        # standard interpolation — computed here independently of
+        # obs/federate.py's own math
+        merged = {}
+        for buckets, total in ((b1, t1), (b2, t2)):
+            prev = 0.0
+            for le, cum in buckets:
+                if le == float("inf"):
+                    continue
+                merged[le] = merged.get(le, 0.0) + (cum - prev)
+                prev = cum
+        total = t1 + t2
+
+        def hand_quantile(q):
+            rank = q * total
+            cum, prev_le = 0.0, 0.0
+            for le, c in sorted(merged.items()):
+                if c > 0 and cum + c >= rank:
+                    return prev_le + (le - prev_le) * (rank - cum) / c
+                cum += c
+                prev_le = le
+            return max(merged)
+
+        snap = federate.federate()
+        m = snap.get("pio_query_latency_seconds")
+        for q in (0.5, 0.95, 0.99):
+            assert m.quantile(q) == pytest.approx(hand_quantile(q)), q
+        # summed queue depth exists as one scrape
+        assert m.count == total
+        assert snap.get("pio_serve_queue_depth").total() == 8
+
+        # fleet SLO mode: same objectives, federated registry
+        status, _h, body = _get(aport, "/slo?fleet=1")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["scope"] == "fleet"
+        by_name = {s["name"]: s for s in payload["slos"]}
+        assert by_name["serve_p99"]["totalObservations"] == total
+        # staleness is worst-of: w2's 9000 s breaches the 3600 s bound
+        # even though w1 is fresh — the gauge objective saw ONE bad tick
+        assert not by_name["staleness"]["noData"]
+        assert by_name["staleness"]["totalObservations"] >= 1
+        eng = federate.fleet_slo_engine()
+        assert eng.registry.get(
+            "pio_model_staleness_seconds").max_value() == 9000
+    finally:
+        if admin is not None:
+            admin.stop()
+        federate.reset_fleet_engine()
+        _stop_worker(w1)
+        _stop_worker(w2)
+
+
+def test_federate_unconfigured_is_explicit(mem_storage, monkeypatch):
+    from incubator_predictionio_tpu.servers.admin import AdminServer
+
+    monkeypatch.delenv("PIO_FLEET_TARGETS", raising=False)
+    federate.reset_fleet_engine()
+    admin = AdminServer(ip="127.0.0.1", port=0)
+    aport = admin.start_background()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(aport, "/federate")
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(aport, "/slo?fleet=1")
+        assert ei.value.code == 400
+        # the process-scoped /slo still answers
+        status, _h, body = _get(aport, "/slo")
+        assert status == 200
+        assert json.loads(body)["scope"] == "process"
+    finally:
+        admin.stop()
+        federate.reset_fleet_engine()
+
+
+# -- two-process trace propagation e2e --------------------------------------
+
+def test_cross_process_trace_links_span_lines(monkeypatch, caplog):
+    """One trace ID through two REAL processes: event server (here) →
+    storage server (child process). Both emit span lines with the same
+    trace ID, and the storage span's parentSpanId is the event span's
+    spanId — the cross-process parenting contract."""
+    from incubator_predictionio_tpu.servers.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+
+    worker, sport = _spawn_worker("--mode", "storage")
+    es = None
+    try:
+        Storage.configure({
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_REM_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_REM_URL": f"http://127.0.0.1:{sport}",
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "REM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+        app_id = Storage.get_meta_data_apps().insert(App(0, "fleet-app"))
+        Storage.get_meta_data_access_keys().insert(
+            AccessKey("fleetkey", app_id))
+        Storage.get_events().init(app_id)
+        es = EventServer(EventServerConfig(ip="127.0.0.1", port=0))
+        eport = es.start_background()
+
+        tid = "fleet-trace-0001"
+        body = json.dumps({
+            "event": "rate", "entityType": "user", "entityId": "u1",
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 5},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{eport}/events.json?accessKey=fleetkey",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-PIO-Trace-Id": tid})
+        with caplog.at_level(logging.INFO, logger="pio.trace"):
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                assert resp.status == 201
+                assert resp.headers["X-PIO-Trace-Id"] == tid
+    finally:
+        if es is not None:
+            es.stop()
+        Storage.reset()
+        _out, worker_err = _stop_worker(worker)
+
+    local_lines = [r.getMessage() for r in caplog.records
+                   if r.name == "pio.trace"]
+    local_spans = trace_stitch.parse_span_lines(local_lines)
+    remote_spans = trace_stitch.parse_span_lines(
+        worker_err.splitlines())
+
+    event_spans = [s for s in local_spans
+                   if s["traceId"] == tid and s.get("server") == "event"]
+    assert event_spans, local_spans
+    event_span = event_spans[0]
+    assert event_span["spanId"]
+
+    storage_spans = [s for s in remote_spans if s["traceId"] == tid]
+    assert storage_spans, worker_err[-2000:]
+    # every storage hop of this request is parented under the event
+    # server's span — the linkage crossed the process boundary
+    for s in storage_spans:
+        assert s["server"] == "storage"
+        assert s["route"] == "/rpc"
+        assert s["parentSpanId"] == event_span["spanId"], s
+
+    # and the stitcher reassembles the cross-process tree
+    roots = trace_stitch.build_tree(event_spans + storage_spans)
+    assert len(roots) == 1
+    assert roots[0] is event_span
+    child_ids = {c.get("spanId") for c in roots[0]["children"]}
+    assert {s.get("spanId") for s in storage_spans} <= child_ids
+    rendered = trace_stitch.render_trace(tid, event_spans + storage_spans)
+    assert "event POST /events.json" in rendered
+    assert "storage POST /rpc" in rendered
+
+
+def test_trace_stitch_cli_filters_and_lists(tmp_path, capsys):
+    lines = [
+        json.dumps({"span": "http.request", "server": "a", "method": "GET",
+                    "route": "/x", "status": 200, "ts": 10.0,
+                    "durationMs": 1.0, "traceId": "t1", "spanId": "aa"}),
+        json.dumps({"span": "http.request", "server": "b", "method": "GET",
+                    "route": "/y", "status": 200, "ts": 10.1,
+                    "durationMs": 0.5, "traceId": "t1", "spanId": "bb",
+                    "parentSpanId": "aa"}),
+        "not json at all",
+        json.dumps({"span": "http.request", "server": "a", "method": "GET",
+                    "route": "/z", "status": 404, "ts": 11.0,
+                    "durationMs": 0.2, "traceId": "t2", "spanId": "cc"}),
+    ]
+    log = tmp_path / "spans.log"
+    log.write_text("\n".join(lines) + "\n")
+    assert trace_stitch.main([str(log), "--trace", "t1"]) == 0
+    out = capsys.readouterr().out
+    assert "trace t1 (2 spans)" in out
+    assert "t2" not in out
+    assert trace_stitch.main([str(log), "--list"]) == 0
+    out = capsys.readouterr().out
+    assert "t1  2 spans" in out and "t2  1 spans" in out
+    assert trace_stitch.main([str(log), "--trace", "missing"]) == 1

@@ -359,6 +359,34 @@ class Handler:
         return self.count
 """,
     ),
+    "metric-label-cardinality": (
+        """
+from incubator_predictionio_tpu.obs import metrics
+
+REQS = metrics.REGISTRY.counter("t_total", "x", labels=("who", "why"))
+
+def handle(request, user_id):
+    # every distinct user/path/exception mints a new time series
+    REQS.labels(who=user_id, why=request.path).inc()
+    REQS.labels(who=f"user-{user_id}", why="x").inc()
+    try:
+        run(request)
+    except Exception as e:
+        REQS.labels(who="x", why=str(e)).inc()
+""",
+        """
+from incubator_predictionio_tpu.obs import metrics
+
+REQS = metrics.REGISTRY.counter("t_total", "x", labels=("route", "status"))
+
+def handle(request, route_label, response):
+    # bounded sets: the route PATTERN, the status code, enum names
+    REQS.labels(route=route_label, status=str(response.status)).inc()
+    REQS.labels(route="/events.json", status="201").inc()
+    for phase, secs in timings.items():
+        PHASES.labels(phase=phase).set(secs)
+""",
+    ),
 }
 
 

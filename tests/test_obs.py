@@ -37,62 +37,26 @@ from incubator_predictionio_tpu.servers.prediction_server import (
 from incubator_predictionio_tpu.workflow import CoreWorkflow
 
 # -- exposition mini-parser (the conformance oracle) ------------------------
-
-_SAMPLE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
-    # optional label set; quoted values may hold ANY escaped content,
-    # including braces (route patterns like /cmd/app/{name})
-    r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
-    r" (-?(?:[0-9]*\.?[0-9]+(?:e[+-]?[0-9]+)?)|[+-]Inf|NaN)$")
-_LABEL_ITEM_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
-
-
-def parse_exposition(text):
-    """Validate + parse: returns (types, samples) where samples maps
-    (name, frozenset(label items)) -> float. Raises AssertionError on
-    any line that violates the text-format grammar."""
-    types, helps, samples = {}, {}, {}
-    for line in text.splitlines():
-        if not line.strip():
-            continue
-        if line.startswith("# HELP "):
-            _, _, rest = line.partition("# HELP ")
-            name, _, h = rest.partition(" ")
-            helps[name] = h
-            continue
-        if line.startswith("# TYPE "):
-            _, _, rest = line.partition("# TYPE ")
-            name, _, t = rest.partition(" ")
-            assert t in ("counter", "gauge", "histogram"), line
-            types[name] = t
-            continue
-        assert not line.startswith("#"), f"unknown comment: {line}"
-        m = _SAMPLE_RE.match(line)
-        assert m, f"malformed sample line: {line!r}"
-        name, labelblob, value = m.groups()
-        labels = frozenset(
-            _LABEL_ITEM_RE.findall(labelblob or ""))
-        v = float("inf") if value == "+Inf" else float(value)
-        samples[(name, labels)] = v
-    # every sample's family must be declared (histogram children map to
-    # their family name)
-    for (name, _), _v in samples.items():
-        family = re.sub(r"_(bucket|sum|count)$", "", name)
-        assert name in types or family in types, name
-    return types, samples
+# PROMOTED into obs/expofmt.py when the federation layer needed to
+# consume worker scrapes: one strict parser is now both the test oracle
+# and the production ingest path (obs/federate.py), so the emitter and
+# parser cannot drift apart silently. Malformed input raises
+# MalformedExposition (an AssertionError subclass — same failure signal
+# the inlined oracle produced).
+from incubator_predictionio_tpu.obs.expofmt import (  # noqa: E402
+    MalformedExposition,
+    histogram_series,
+    parse_exposition,
+)
 
 
-def histogram_series(samples, name, labels=frozenset()):
-    """(sorted [(le, cumulative)], sum, count) for one histogram child."""
-    buckets = []
-    for (n, ls), v in samples.items():
-        if n == f"{name}_bucket" and labels <= ls:
-            le = dict(ls)["le"]
-            buckets.append((float("inf") if le == "+Inf" else float(le), v))
-    buckets.sort()
-    total = samples[(f"{name}_count", labels)]
-    s = samples[(f"{name}_sum", labels)]
-    return buckets, s, total
+def test_promoted_parser_rejects_malformed_lines():
+    with pytest.raises(MalformedExposition):
+        parse_exposition("no_type_declared 1")
+    with pytest.raises(MalformedExposition):
+        parse_exposition("# TYPE t gauge\nt{bad 1")
+    with pytest.raises(MalformedExposition):
+        parse_exposition("# TYPE t nonsense\nt 1")
 
 
 def scrape(port):
@@ -258,14 +222,32 @@ def stack():
         ip="127.0.0.1", port=0, engine_variant="obs"))
     ad = AdminServer(ip="127.0.0.1", port=0)
     db = DashboardServer(ip="127.0.0.1", port=0)
+    # the FIFTH server: a storage server over its own memory backend, so
+    # the trace/metrics contracts are pinned on every server this repo
+    # runs (the cross-process hop target of data/storage/remote.py)
+    from incubator_predictionio_tpu.data.storage import (
+        StorageClientConfig,
+    )
+    from incubator_predictionio_tpu.data.storage import (
+        memory as memory_backend,
+    )
+    from incubator_predictionio_tpu.data.storage.server import (
+        StorageServer,
+    )
+
+    st_config = StorageClientConfig(test=True, properties={})
+    st = StorageServer(memory_backend,
+                       memory_backend.StorageClient(st_config), st_config,
+                       host="127.0.0.1", port=0)
     ports = {
         "event": es.start_background(),
         "prediction": ps.start_background(),
         "admin": ad.start_background(),
         "dashboard": db.start_background(),
+        "storage": st.start_background(),
     }
     yield ports
-    for srv in (es, ps, ad, db):
+    for srv in (es, ps, ad, db, st):
         srv.stop()
     Storage.reset()
 
@@ -376,6 +358,94 @@ def test_trace_id_e2e_response_and_span_log(stack, caplog):
         assert s["span"] == "http.request"
         assert s["durationMs"] >= 0
         assert s["status"] in (200, 201)
+        # every span line carries its own span ID + wall stamp (the
+        # cross-process stitching contract, scripts/trace_stitch.py)
+        assert re.fullmatch(r"[0-9a-f]{8}", s["spanId"])
+        assert s["ts"] > 0
+
+
+def test_parent_span_header_links_spans(stack, caplog):
+    """A hop that forwards X-PIO-Parent-Span gets a span line whose
+    parentSpanId is the upstream span — the in-repo client contract
+    (obs_trace.client_headers)."""
+    tid = "parent-span-e2e-01"
+    with caplog.at_level(logging.INFO, logger="pio.trace"):
+        status, headers, _b = post(
+            stack["event"], "/events.json?accessKey=obskey", EV,
+            headers={"X-PIO-Trace-Id": tid})
+        assert status == 201
+        parent = headers["X-PIO-Span-Id"]      # echoed server-side span
+        status, headers2, _b = post(
+            stack["prediction"], "/queries.json", {"qx": 1},
+            headers={"X-PIO-Trace-Id": tid, "X-PIO-Parent-Span": parent})
+        assert status == 200
+    spans = [json.loads(r.getMessage()) for r in caplog.records
+             if r.name == "pio.trace" and
+             json.loads(r.getMessage()).get("traceId") == tid]
+    child = [s for s in spans if s.get("parentSpanId")]
+    assert child and child[0]["parentSpanId"] == parent
+    assert child[0]["server"] == "prediction"
+    # malformed parent headers are DROPPED, never echoed into linkage
+    with caplog.at_level(logging.INFO, logger="pio.trace"):
+        post(stack["prediction"], "/queries.json", {"qx": 1},
+             headers={"X-PIO-Trace-Id": "parent-span-e2e-02",
+                      "X-PIO-Parent-Span": "bad parent!"})
+    bad = [json.loads(r.getMessage()) for r in caplog.records
+           if r.name == "pio.trace"
+           and json.loads(r.getMessage()).get("traceId")
+           == "parent-span-e2e-02"]
+    assert bad and "parentSpanId" not in bad[0]
+
+
+def test_trace_echo_and_span_on_error_paths(stack, caplog):
+    """4xx/5xx responses from ALL FIVE servers still echo
+    X-PIO-Trace-Id and emit a span line — a failing hop is the one an
+    operator most needs to find in the trace tree. (Until this test the
+    contract was only pinned on the happy path.)"""
+    def get_err(port, path, tid):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            headers={"X-PIO-Trace-Id": tid})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers)
+
+    cases = {
+        # (server, how to provoke an error) → expected status family
+        "event": lambda p: post(p, "/events.json?accessKey=obskey",
+                                {"not": "an event"},
+                                headers={"X-PIO-Trace-Id": "err-event"})[:2],
+        "prediction": lambda p: post(
+            p, "/nope.json", {},
+            headers={"X-PIO-Trace-Id": "err-prediction"})[:2],
+        "admin": lambda p: post(
+            p, "/cmd/app", {},
+            headers={"X-PIO-Trace-Id": "err-admin"})[:2],
+        "dashboard": lambda p: post(
+            p, "/no/such/page", {},
+            headers={"X-PIO-Trace-Id": "err-dashboard"})[:2],
+        # /rpc reports DAO errors in-band (msgpack envelope, 200) by
+        # design — the HTTP-layer error path is an unrouted 404
+        "storage": lambda p: get_err(p, "/no/such/route", "err-storage"),
+    }
+    with caplog.at_level(logging.INFO, logger="pio.trace"):
+        for name, provoke in cases.items():
+            status, headers = provoke(stack[name])
+            assert 400 <= status < 600, (name, status)
+            # the error response STILL echoes the trace ID...
+            assert headers["X-PIO-Trace-Id"] == f"err-{name}", name
+            assert headers["X-PIO-Span-Id"], name
+    spans = [json.loads(r.getMessage()) for r in caplog.records
+             if r.name == "pio.trace"]
+    by_trace = {s["traceId"]: s for s in spans}
+    for name in cases:
+        # ...and the span line was emitted, status included
+        s = by_trace.get(f"err-{name}")
+        assert s is not None, (name, sorted(by_trace))
+        assert s["server"] == name
+        assert 400 <= s["status"] < 600
 
 
 def test_trace_id_generated_when_absent(stack):

@@ -983,6 +983,70 @@ class MetricLabelCardinality(Rule):
         return None
 
 
+# ---------------------------------------------------------------------------
+# 15. unbatched device dispatch from server modules
+# ---------------------------------------------------------------------------
+
+#: device-dispatch entry points the serving scheduler exists to front:
+#: direct top-k/fold-in calls from a server module bypass the queue →
+#: ladder → shed plane entirely
+_DISPATCH_ENTRY_POINTS = {
+    "score_and_top_k", "score_user_and_top_k", "batch_score_top_k",
+    "sharded_top_k", "top_k_with_exclusions", "FoldInSolver",
+    "als_fused_solve_cg_pallas", "score_and_top_k_pallas",
+}
+#: algorithm methods that reach the device — sanctioned ONLY from the
+#: scheduler's handle_batch callback (whose calls carry baseline
+#: justifications) and the deploy-time warmup cold path
+_DISPATCH_METHODS = {"predict", "batch_predict", "batch_serve_json",
+                     "warmup"}
+
+
+class UnbatchedDispatch(Rule):
+    name = "unbatched-dispatch"
+    severity = "warning"
+    doc = ("direct solver/top-k device dispatch (ops/topk entries, "
+           "FoldInSolver, or an algorithm predict/batch_predict/"
+           "batch_serve_json/warmup call) in a server module "
+           "(servers/*.py) — query-path device work must route through "
+           "the continuous-batching scheduler seam "
+           "(serving/scheduler.py) so queue-depth coalescing and SLO "
+           "shedding apply; the scheduler's own handle_batch callback "
+           "and deploy-time warmup are the sanctioned baseline-"
+           "justified exceptions")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if "/servers/" not in f"/{mod.relpath}":
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            rname = mod.resolved(node.func) or ""
+            tail = rname.rsplit(".", 1)[-1] if rname else ""
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else (node.func.id
+                          if isinstance(node.func, ast.Name) else ""))
+            if tail in _DISPATCH_ENTRY_POINTS \
+                    or attr in _DISPATCH_ENTRY_POINTS:
+                what = rname or attr
+                yield mod.finding(
+                    self, node,
+                    f"direct device dispatch `{what}()` in a server "
+                    "module bypasses the continuous-batching scheduler "
+                    "seam (serving/scheduler.py) — no queue coalescing, "
+                    "no shed policy")
+            elif attr in _DISPATCH_METHODS and isinstance(
+                    node.func, ast.Attribute):
+                yield mod.finding(
+                    self, node,
+                    f"device-dispatching `{attr}()` call in a server "
+                    "module outside the scheduler seam — route query "
+                    "work through BatchScheduler.submit (the scheduler's "
+                    "handle_batch callback and deploy warmup belong in "
+                    "the baseline)")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -998,6 +1062,7 @@ ALL_RULES: Sequence[Rule] = (
     BlockingProfiler(),
     HostGatherInMesh(),
     MetricLabelCardinality(),
+    UnbatchedDispatch(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

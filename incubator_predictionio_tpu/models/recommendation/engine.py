@@ -746,15 +746,17 @@ class ALSAlgorithm(Algorithm):
         self.predict(model, q)
         if int(max_batch) <= 0:
             return  # micro-batching disabled: the batched path never runs
-        from incubator_predictionio_tpu.ops.topk import next_pow2
+        from incubator_predictionio_tpu.ops.topk import ladder_rungs
 
-        # start at 2: the micro-batcher routes singleton queries through
-        # predict(), so B=1 is a shape live traffic never produces
-        size = 2
-        cap = next_pow2(int(max_batch))
-        while size <= cap:
+        # the SAME ladder the scheduler can dispatch (ops/topk
+        # ladder_rungs — one rule, shared, so warmed shapes cannot
+        # drift from dispatchable shapes). Rung 1 is skipped: the
+        # scheduler routes singleton batches through predict(), so B=1
+        # is a batched shape live traffic never produces
+        for size in ladder_rungs(int(max_batch)):
+            if size < 2:
+                continue
             self.batch_predict(model, [(i, q) for i in range(size)])
-            size *= 2
 
     def _pack_scores(self, model: ALSModel, scores, indices) -> PredictedResult:
         inv = model.item_bimap.inverse

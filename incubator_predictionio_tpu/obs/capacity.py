@@ -273,9 +273,11 @@ def fit_capacity(records: Sequence[NormalizedRecord],
         "train_source_record": None,
         "qps_per_worker": None,
         "qps_source_record": None,
+        "qps_source_key": None,
         "serve_p99_ms": None,
         "mfu": None,
         "shard": None,
+        "fleet": None,
         "projections": {},
     }
     benches = [r for r in records if r.kind == "bench"
@@ -295,11 +297,36 @@ def fit_capacity(records: Sequence[NormalizedRecord],
         # would size the fleet from a measurement no production worker
         # resembles (same guard as the train-rate fit above)
         if out["qps_source_record"] is None and not rec.degraded:
-            qps = _num(rec.parsed, "serve_qps_concurrent")
+            # prefer the fleet leg's per-worker goodput (bench_fleet's
+            # no-floor burst: real kernels through the scheduler across
+            # N worker processes — the figure a fleet is actually sized
+            # from); the single-process serve_qps_concurrent remains
+            # the fallback for records predating the leg
+            fleet_qps = _num(rec.parsed, "fleet_qps_per_worker")
+            qps = fleet_qps or _num(rec.parsed, "serve_qps_concurrent")
             if qps and qps > 0:
                 out["qps_per_worker"] = round(qps, 1)
                 out["qps_source_record"] = rec.name
+                out["qps_source_key"] = (
+                    "fleet_qps_per_worker" if fleet_qps
+                    else "serve_qps_concurrent")
                 out["serve_p99_ms"] = _num(rec.parsed, "serve_p99_ms")
+        # same degraded-round guard as the qps fit above: a degraded
+        # round's fleet leg ran on a box no production worker resembles
+        if out.get("fleet") is None and not rec.degraded:
+            fw = _num(rec.parsed, "fleet_workers")
+            if fw:
+                out["fleet"] = {
+                    "source_record": rec.name,
+                    "workers": int(fw),
+                    "qps": _num(rec.parsed, "fleet_qps"),
+                    "p99_s": _num(rec.parsed, "fleet_p99_s"),
+                    "batch_p50": _num(rec.parsed, "fleet_batch_p50"),
+                    "shed_rate": _num(rec.parsed, "fleet_shed_rate"),
+                    "p99_flat_x": _num(rec.parsed, "fleet_p99_flat_x"),
+                    "dispatch_floor_ms": _num(
+                        rec.parsed, "fleet_dispatch_floor_ms"),
+                }
         if out["shard"] is None:
             devs = _num(rec.parsed, "shard_devices")
             if devs:

@@ -1146,6 +1146,8 @@ def build_placed_sides(
     placement,
     modes: Tuple[str, str],
     max_width: int = 1 << 16,
+    ring_layouts: Tuple[Any, Any] = (None, None),
+    ring_host_out: Optional[dict] = None,
 ):
     """Host-side prep of both orientations in their placed layouts →
     (u_data, i_data), every leaf device-put sharded on axis 0.
@@ -1154,7 +1156,14 @@ def build_placed_sides(
     row ids localized per device; heavy split rows partitioned to their
     owner so the partial-Gram reduction stays shard-local); ring sides
     are the per-step pure/mixed layout of
-    :func:`~...parallel.sharding.build_ring_side`."""
+    :func:`~...parallel.sharding.build_ring_side`.
+
+    ``ring_layouts`` lets the ring-plan cache (ops/retrain.py
+    ``_ring_sides_with_reuse``) hand in an already-merged HOST
+    (pure, mixed) layout per side — the side then skips the full-COO
+    build and only pays the device put. ``ring_host_out`` (a dict)
+    receives each ring side's host layout under its side name, so the
+    cache can adopt what was built without a second construction."""
     from incubator_predictionio_tpu.parallel.sharding import (
         build_ring_side,
         localize_tree,
@@ -1169,13 +1178,18 @@ def build_placed_sides(
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(jnp.asarray(a), sharding), tree)
 
-    def one_side(side, rows, cols, other_side, mode):
+    def one_side(side, rows, cols, other_side, mode, prebuilt):
         sr_self = placement.shard_rows(side)
         sr_other = placement.shard_rows(other_side)
         if mode == "ring":
-            pure, mixed = build_ring_side(
-                rows, cols, vals, n, sr_self, sr_other,
-                max_width=max_width)
+            if prebuilt is not None:
+                pure, mixed = prebuilt
+            else:
+                pure, mixed = build_ring_side(
+                    rows, cols, vals, n, sr_self, sr_other,
+                    max_width=max_width)
+            if ring_host_out is not None:
+                ring_host_out[side] = (pure, mixed)
             return put((pure, mixed))
         light, heavy = split_heavy(build_padded_rows(
             rows, cols, vals, sr_self * n, max_width=max_width))
@@ -1183,8 +1197,10 @@ def build_placed_sides(
             shard_block_buckets(light, n, sr_self), n, sr_self)
         return put((tree, shard_block_heavy(heavy, n, sr_self)))
 
-    return (one_side("user", users, items, "item", modes[0]),
-            one_side("item", items, users, "user", modes[1]))
+    return (one_side("user", users, items, "item", modes[0],
+                     ring_layouts[0]),
+            one_side("item", items, users, "user", modes[1],
+                     ring_layouts[1]))
 
 
 def _ring_sweep_side(

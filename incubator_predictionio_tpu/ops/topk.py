@@ -286,6 +286,32 @@ def next_pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
 
 
+def ladder_rungs(cap: int) -> Tuple[int, ...]:
+    """The pow2 batch-width ladder up to ``cap`` — exactly the shapes
+    :func:`batch_score_top_k` can dispatch (its ``B`` pads to the next
+    power of two) and therefore exactly what the continuous-batching
+    scheduler (serving/scheduler.py) may pick. Deploy-time warmup
+    (``ALSAlgorithm.warmup``) and the zero-recompile test walk THIS
+    ladder, so warmed shapes track dispatchable shapes through one
+    rule."""
+    cap = next_pow2(max(int(cap), 1))
+    return tuple(1 << i for i in range(cap.bit_length()))
+
+
+def serve_compile_cache_size() -> int:
+    """Compiled serving-dispatch variants resident in this process —
+    the scheduler's zero-steady-state-recompile contract counter (the
+    serving twin of ``speed.foldin.foldin_compile_cache_size``).
+    Bounded by the pow2 ladder × the distinct (k, catalog) shapes
+    served; tests pin that a warm ladder stops growing it."""
+    return sum(
+        int(fn._cache_size())
+        for fn in (top_k_with_exclusions, _score_and_top_k_xla,
+                   _score_user_top_k_xla, _batch_score_top_k_xla,
+                   _sharded_topk_jit)
+    )
+
+
 def batch_score_top_k(
     user_factors: jax.Array,
     item_factors: jax.Array,

@@ -147,7 +147,43 @@ def localize_tree(buckets: Sequence[PaddedRows], n_shards: int,
 # ring layout: wide-table half-sweeps against rotating table slices
 # ---------------------------------------------------------------------------
 
-def build_ring_side(
+def _next_pow2_arr(m: np.ndarray) -> np.ndarray:
+    """Elementwise smallest power of two ≥ m (m ≥ 1), integer bit-smear
+    (no float log2 — exact at any width)."""
+    v = np.asarray(m, np.int64) - 1
+    for shift in (1, 2, 4, 8, 16, 32):
+        v |= v >> shift
+    return v + 1
+
+
+def _width_class(d, min_width: int) -> np.ndarray:
+    """The loop builder's width ladder, vectorized: ``w = min_width;
+    while w < d: w *= 2`` ≡ ``min_width · next_pow2(ceil(d / min_width))``
+    (the smallest min_width·2^k ≥ d)."""
+    d = np.asarray(d, np.int64)
+    m = np.maximum((d + min_width - 1) // min_width, 1)
+    return min_width * _next_pow2_arr(m)
+
+
+def _cumcount(key: np.ndarray) -> np.ndarray:
+    """Occurrence index of each element within its key group, in input
+    order — the vectorized twin of the loop builder's per-cell fill
+    counters."""
+    if len(key) == 0:
+        return np.zeros(0, np.int64)
+    o = np.argsort(key, kind="stable")
+    sk = key[o]
+    new_run = np.r_[True, sk[1:] != sk[:-1]]
+    starts = np.flatnonzero(new_run)
+    run_id = np.cumsum(new_run) - 1
+    out = np.empty(len(key), np.int64)
+    # run_id = cumsum(bool) - 1 ≥ 0 always (first element is True) —
+    # no -1 padding sentinel can reach this host-side gather
+    out[o] = np.arange(len(key)) - starts[run_id]  # pio-lint: disable=neg-gather
+    return out
+
+
+def build_ring_side_reference(
     rows: np.ndarray,
     cols: np.ndarray,
     vals: np.ndarray,
@@ -157,7 +193,12 @@ def build_ring_side(
     min_width: int = 8,
     max_width: int = 1 << 16,
 ):
-    """One orientation's interactions in the ring ragged-gather layout.
+    """The original per-(row, step) Python-loop ring builder, kept as
+    the bitwise-parity oracle for :func:`build_ring_side` (the
+    vectorized production path). O(pairs) Python-interpreter work —
+    minutes at the 100M-row scale ring mode targets, which is exactly
+    why the vectorized twin replaced it on the hot path
+    (tests/test_sharded_als.py pins their outputs identical).
 
     At ring step ``s`` device ``r`` holds the other table's slice
     ``c = (r − s) mod n`` (``ppermute_next`` rotation), so every
@@ -292,6 +333,154 @@ def build_ring_side(
             val_a[sh, st, k, : len(vv)] = vv
             msk_a[sh, st, k, : len(cc)] = 1.0
         mixed = (rid_m, sid_a, col_a, val_a, msk_a)
+    return tuple(pure), mixed
+
+
+def build_ring_side(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n_shards: int,
+    shard_rows_self: int,
+    shard_rows_other: int,
+    min_width: int = 8,
+    max_width: int = 1 << 16,
+):
+    """One orientation's interactions in the ring ragged-gather layout —
+    the VECTORIZED host prep (ROADMAP item 1's flagged hot spot): every
+    stage is numpy bucketing (sort → unique pairs → grouped cumcount →
+    flat fancy-index scatters), no per-(row, step) Python iteration, and
+    the output is BITWISE-IDENTICAL to
+    :func:`build_ring_side_reference` (pinned in
+    tests/test_sharded_als.py — same cell fill order, same padding).
+
+    Layout semantics (see the reference's docstring for the full
+    story): step ``s = (owner(row) − owner(col)) mod n``; rows whose
+    cols all land in one slice are "pure" (solve at their step, fused
+    kernel eligible), the rest are "mixed" (partial Grams across steps,
+    solved post-ring). Returns ``(pure, mixed)`` in the shapes
+    ``_ring_sweep_side`` consumes.
+    """
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    vals = np.asarray(vals, np.float32)
+    n = n_shards
+    owner_r = rows // shard_rows_self
+    owner_c = cols // shard_rows_other
+    step = (owner_r - owner_c) % n
+    order = np.lexsort((step, rows))
+    rows_s, vals_s, step_s = rows[order], vals[order], step[order]
+    loc_cols = (cols - owner_c * shard_rows_other)[order]
+
+    uniq_rows, _row_start, row_deg = np.unique(
+        rows_s, return_index=True, return_counts=True)
+    pair_key = rows_s * n + step_s
+    pair_uniq, pair_start, pair_cnt = np.unique(
+        pair_key, return_index=True, return_counts=True)
+    pair_row = pair_uniq // n
+    pair_step = (pair_uniq % n).astype(np.int64)
+    row_of_pair = np.searchsorted(uniq_rows, pair_row)
+    steps_per_row = np.bincount(row_of_pair, minlength=len(uniq_rows))
+    pure_mask_row = (steps_per_row == 1) & (row_deg <= max_width)
+    pair_pure = pure_mask_row[row_of_pair]
+
+    def _scatter_elems(pis, dst_cell, width, total_cells):
+        """Element-level scatter of each pair's contiguous (col, val)
+        block into its cell row → (cols, vals, mask) flat [cells*width]
+        arrays. ``dst_cell`` is each pair's flat cell index."""
+        cnt = pair_cnt[pis]
+        total = int(cnt.sum())
+        rep = np.repeat(np.arange(len(pis)), cnt)
+        within = np.arange(total) - np.repeat(
+            np.cumsum(cnt) - cnt, cnt)
+        src = pair_start[pis][rep] + within
+        dst = dst_cell[rep] * width + within
+        col_f = np.zeros(total_cells * width, np.int32)
+        val_f = np.zeros(total_cells * width, np.float32)
+        msk_f = np.zeros(total_cells * width, np.float32)
+        col_f[dst] = loc_cols[src]
+        val_f[dst] = vals_s[src]
+        msk_f[dst] = 1.0
+        return col_f, val_f, msk_f
+
+    # -- pure rows: bucket by (owner, step, width class) --------------------
+    pure_pis = np.flatnonzero(pair_pure)
+    pure = []
+    if len(pure_pis):
+        wclass = _width_class(pair_cnt[pure_pis], min_width)
+        for w in np.unique(wclass):
+            pis = pure_pis[wclass == w]  # ascending pair order
+            w = int(w)
+            sh = pair_row[pis] // shard_rows_self
+            st = pair_step[pis]
+            cell = sh * n + st
+            counts = np.bincount(cell, minlength=n * n)
+            b = max(int(counts.max()), 1)
+            k = _cumcount(cell)
+            flat = cell * b + k
+            rid_a = np.full(n * n * b, -1, np.int32)
+            rid_a[flat] = (pair_row[pis]
+                           - sh * shard_rows_self).astype(np.int32)
+            col_f, val_f, msk_f = _scatter_elems(pis, flat, w, n * n * b)
+            pure.append((rid_a.reshape(n, n, b),
+                         col_f.reshape(n, n, b, w),
+                         val_f.reshape(n, n, b, w),
+                         msk_f.reshape(n, n, b, w)))
+
+    # -- mixed rows: per-step segments + shard-local row lists --------------
+    mixed = None
+    mixed_pis = np.flatnonzero(~pair_pure)
+    if len(mixed_pis):
+        mixed_rows = np.unique(pair_row[mixed_pis])  # ascending
+        owner_m = mixed_rows // shard_rows_self      # nondecreasing
+        h_counts = np.bincount(owner_m, minlength=n)
+        h = max(int(h_counts.max()), 1)
+        slot = _cumcount(owner_m)  # ascending-rid fill per shard
+        rid_m = np.full((n, h), -1, np.int32)
+        rid_m[owner_m, slot] = (mixed_rows
+                                - owner_m * shard_rows_self).astype(
+            np.int32)
+        slot_of_row = np.zeros(len(uniq_rows), np.int64)
+        slot_of_row[np.searchsorted(uniq_rows, mixed_rows)] = slot
+        # split over-wide (row, step) groups into ≤ max_width chunks,
+        # in pair-then-chunk order (the loop builder's segment order)
+        cap = max_width
+        d_m = pair_cnt[mixed_pis]
+        nchunks = (d_m + cap - 1) // cap
+        total_segs = int(nchunks.sum())
+        seg_pair = np.repeat(np.arange(len(mixed_pis)), nchunks)
+        chunk_idx = np.arange(total_segs) - np.repeat(
+            np.cumsum(nchunks) - nchunks, nchunks)
+        seg_len = np.minimum(d_m[seg_pair] - chunk_idx * cap, cap)
+        w = int(_width_class(np.array([int(seg_len.max())]),
+                             min_width)[0])
+        seg_pi = mixed_pis[seg_pair]
+        sh = pair_row[seg_pi] // shard_rows_self
+        st = pair_step[seg_pi]
+        cell = sh * n + st
+        s_counts = np.bincount(cell, minlength=n * n)
+        s_max = max(int(s_counts.max()), 1)
+        k = _cumcount(cell)
+        flat = cell * s_max + k
+        sid_a = np.full(n * n * s_max, h, np.int32)  # sentinel → dropped
+        sid_a[flat] = slot_of_row[
+            np.searchsorted(uniq_rows, pair_row[seg_pi])].astype(np.int32)
+        # element scatter, chunk-offset into each pair's block
+        rep = np.repeat(np.arange(total_segs), seg_len)
+        within = np.arange(int(seg_len.sum())) - np.repeat(
+            np.cumsum(seg_len) - seg_len, seg_len)
+        src = (pair_start[seg_pi][rep] + chunk_idx[rep] * cap + within)
+        dst = flat[rep] * w + within
+        col_f = np.zeros(n * n * s_max * w, np.int32)
+        val_f = np.zeros(n * n * s_max * w, np.float32)
+        msk_f = np.zeros(n * n * s_max * w, np.float32)
+        col_f[dst] = loc_cols[src]
+        val_f[dst] = vals_s[src]
+        msk_f[dst] = 1.0
+        mixed = (rid_m, sid_a.reshape(n, n, s_max),
+                 col_f.reshape(n, n, s_max, w),
+                 val_f.reshape(n, n, s_max, w),
+                 msk_f.reshape(n, n, s_max, w))
     return tuple(pure), mixed
 
 

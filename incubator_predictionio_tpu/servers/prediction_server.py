@@ -40,6 +40,10 @@ from incubator_predictionio_tpu.obs import trace as obs_trace
 from incubator_predictionio_tpu.obs.http import add_metrics_route
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 from incubator_predictionio_tpu.servers.plugins import PluginContext
+from incubator_predictionio_tpu.serving.scheduler import (
+    BatchScheduler,
+    ladder_cap,
+)
 from incubator_predictionio_tpu.utils import json_codec
 from incubator_predictionio_tpu.utils.http import (
     HttpError,
@@ -96,12 +100,16 @@ class ServerConfig:
     feedback: bool = False
     server_key: Optional[str] = None  # auth for /stop and /reload
     verbose: bool = False
-    #: max concurrent queries fused into one batch_predict device dispatch
-    #: (0 disables micro-batching; the reference serves queries one at a
-    #: time — CreateServer.scala:523 "TODO: Parallelize"). 64 measured best
-    #: on v5e at ML-20M scale: 397 QPS vs 210 at 32 and 366 at 128 (the
-    #: per-dispatch overhead amortizes until padding waste wins)
-    micro_batch: int = 64
+    #: LADDER CAP for the continuous-batching scheduler (0 disables
+    #: batching; the reference serves queries one at a time —
+    #: CreateServer.scala:523 "TODO: Parallelize"). This is no longer a
+    #: fixed fuse width: the scheduler (serving/scheduler.py) picks the
+    #: batch per dispatch from live queue depth on a pow2 rung ladder
+    #: and only reaches the cap under sustained pressure, so a large
+    #: cap costs idle traffic nothing. Default PIO_SERVE_MAX_BATCH
+    #: (512) — the old fixed 64 capped concurrent QPS exactly when the
+    #: queue was deepest
+    micro_batch: int = dataclasses.field(default_factory=ladder_cap)
     #: micro-batch dispatcher threads. 1 measured best on the host-mirror
     #: path at ML-20M shape (3.8k QPS vs 3.3k at 2 and 2.8k at 4: extra
     #: workers fragment the natural batches and fight the BLAS pool for
@@ -115,73 +123,13 @@ class ServerConfig:
     log_prefix: str = ""
 
 
-class _MicroBatcher:
-    """Natural (queue-depth) micro-batching for the query path.
-
-    Requests enqueue; a single dispatcher thread drains whatever is queued
-    (up to ``max_batch``) into ONE ``_handle_batch`` call. Under sequential
-    load every batch has size 1 — zero added latency; under concurrent load
-    batches form automatically while the previous dispatch is in flight, so
-    the device cost is amortized without any timer. This replaces the
-    per-query actor ask the reference serves with (CreateServer.scala:523
-    "TODO: Parallelize" — here it IS parallelized, MXU-style)."""
-
-    def __init__(self, handle_batch, max_batch: int = 32,
-                 workers: int = 1):
-        import concurrent.futures as cf
-
-        self._cf = cf
-        self._handle_batch = handle_batch
-        self.max_batch = max(int(max_batch), 1)
-        self._cv = threading.Condition()
-        self._queue: List[Any] = []
-        self._stopped = False
-        # >1 worker overlaps independent batches: the scoring core's BLAS
-        # matmul releases the GIL, so a second dispatcher lifts concurrent
-        # throughput even on one interpreter (batches are independent —
-        # each request resolves its own Future; no cross-batch ordering)
-        self._threads = [
-            threading.Thread(target=self._run, daemon=True,
-                             name=f"pio-microbatch-{i}")
-            for i in range(max(int(workers), 1))
-        ]
-        for t in self._threads:
-            t.start()
-
-    def submit(self, body: bytes) -> "Any":
-        """Enqueue one query body → concurrent Future of its result."""
-        fut = self._cf.Future()
-        with self._cv:
-            if self._stopped:
-                fut.set_exception(HttpError(503, "Server is shutting down."))
-                return fut
-            self._queue.append((body, fut))
-            self._cv.notify()
-        return fut
-
-    def stop(self) -> None:
-        with self._cv:
-            self._stopped = True
-            self._cv.notify_all()
-
-    def _run(self) -> None:
-        while True:
-            with self._cv:
-                while not self._queue and not self._stopped:
-                    self._cv.wait(0.5)
-                if self._stopped and not self._queue:
-                    return
-                batch = self._queue[: self.max_batch]
-                del self._queue[: len(batch)]
-            try:
-                results = self._handle_batch([b for b, _f in batch])
-            except Exception as exc:  # catastrophic: fail the whole batch
-                results = [exc] * len(batch)
-            for (_b, fut), res in zip(batch, results):
-                if isinstance(res, Exception):
-                    fut.set_exception(res)
-                else:
-                    fut.set_result(res)
+#: compat alias — the fixed micro-batcher grew into the continuous-
+#: batching scheduler (serving/scheduler.py): per-engine admission
+#: queues, queue-depth-adaptive pow2 batch widths, the
+#: PIO_SERVE_MAX_WAIT_MS age bound, and SLO-driven load shedding. The
+#: constructor signature is unchanged (handle_batch, max_batch,
+#: workers=…); ``max_batch`` is now the ladder CAP.
+_MicroBatcher = BatchScheduler
 
 
 class _AsyncPoster:
@@ -288,16 +236,17 @@ class PredictionServer:
                                          config.port, bind_retries=3,
                                          name="prediction")
         self._batcher = (
-            _MicroBatcher(self._handle_batch, config.micro_batch,
-                          workers=config.serve_workers)
+            BatchScheduler(self._handle_batch, config.micro_batch,
+                           workers=config.serve_workers,
+                           p99_fn=lambda: _QUERY_LATENCY.quantile(0.99))
             if config.micro_batch > 0 else None
         )
         if self._batcher is not None:
-            # scrape-time queue-depth read (len() is GIL-atomic); the
-            # named collector replaces any prior server's hook so
-            # re-deploys never accumulate dead closures, and the
-            # weakref keeps a stopped server (engine + loaded models)
-            # collectable — the registry must never pin model memory
+            # scrape-time queue-depth read; the named collector replaces
+            # any prior server's hook so re-deploys never accumulate
+            # dead closures, and the weakref keeps a stopped server
+            # (engine + loaded models) collectable — the registry must
+            # never pin model memory
             import weakref
 
             batcher_ref = weakref.ref(self._batcher)
@@ -305,7 +254,7 @@ class PredictionServer:
             def _collect_queue_depth() -> None:
                 b = batcher_ref()
                 if b is not None:
-                    _QUEUE_DEPTH.set(len(b._queue))
+                    _QUEUE_DEPTH.set(b.depth())
 
             obs_metrics.REGISTRY.register_collector(
                 "prediction_queue_depth", _collect_queue_depth)
@@ -769,6 +718,12 @@ class PredictionServer:
                             .total_seconds(), 0.0)
                         if instance is not None else None),
                     "speedOverlay": self._speed_status_locked(),
+                    # continuous-batching scheduler state: per-engine
+                    # queue depth + live ladder rung + shed count
+                    # (serving/scheduler.py; docs/production.md
+                    # "Serving fleet")
+                    "scheduler": (self._batcher.stats()
+                                  if self._batcher is not None else None),
                 }
             accept = request.headers.get("accept", "")
             if "text/html" in accept:
@@ -794,8 +749,18 @@ class PredictionServer:
 
             try:
                 if self._batcher is not None:
+                    # priority orders only the scheduler's SHED decision
+                    # (higher survives an overload longer) — admitted
+                    # requests stay FIFO; malformed values mean 0
+                    try:
+                        prio = int(request.headers.get(
+                            "x-pio-priority", "0"))
+                    except ValueError:
+                        prio = 0
                     result = await asyncio.wrap_future(
-                        self._batcher.submit(request.body))
+                        self._batcher.submit(
+                            request.body, priority=prio,
+                            engine=self.config.engine_id))
                 else:
                     result = await sync(self._handle_query, request.body)
             except HttpError:

@@ -1,0 +1,16 @@
+"""Request plane between the HTTP servers and the device kernels.
+
+``serving.scheduler`` is THE sanctioned seam for query-path device
+dispatch: server request handlers enqueue, the scheduler coalesces
+(queue-depth-adaptive pow2 batching onto the compile-cached kernel
+ladders) and sheds (SLO-projected 503 + Retry-After) — the pio-lint
+rule ``unbatched-dispatch`` flags handlers that bypass it.
+"""
+
+from incubator_predictionio_tpu.serving.scheduler import (  # noqa: F401
+    BatchScheduler,
+    ShedError,
+    ladder_cap,
+    max_wait_s,
+    plan_dispatch,
+)

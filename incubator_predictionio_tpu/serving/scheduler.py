@@ -1,0 +1,429 @@
+"""Continuous-batching serving scheduler — the socket→kernel request plane.
+
+The fixed micro-batcher this replaces fused at most ``max_batch=64``
+queries per device dispatch regardless of queue pressure, so concurrent
+serving topped out when the per-dispatch overhead stopped amortizing
+(BENCH_r04/r05: ~1.8–2.5k QPS/process) and overload had no exit but
+rising latency. This module is the queue-aware plane ROADMAP item 2
+names:
+
+- **Admission queues, per engine.** Every in-flight query lands in its
+  engine's FIFO queue (recommendation / ecommerce / similarproduct
+  traffic fuses independently — one engine's burst never pads another's
+  batches), and dispatcher threads drain whole batches into ONE
+  ``handle_batch`` call — which routes to the existing padded device
+  kernels (``ops/topk.batch_score_top_k``, the ``speed/foldin`` bucket
+  ladder, ``sharded_top_k`` under a placed table: all pad to the same
+  pow2 ladder, so every batch width this scheduler can choose is
+  already compile-cached after warmup — zero steady-state recompiles,
+  pinned by ``tests/test_scheduler.py``).
+
+- **Queue-depth-adaptive batch width.** Each queue carries a pow2
+  *rung*: the batch width the next dispatch drains. Deeper queue than
+  the rung → grow to the next ladder rung (up to :func:`ladder_cap`);
+  queue at half the rung or less → collapse one rung. Idle traffic
+  serves at rung 1 with zero added latency; a burst walks up the ladder
+  in log2 steps and walks back down when it passes
+  (:func:`plan_dispatch` is the pure decision rule the tests drive).
+
+- **Age bound** (``PIO_SERVE_MAX_WAIT_MS``): a query must never wait
+  past the bound just because the rung is small — when the oldest
+  queued request's age crosses it, the dispatch takes the whole backlog
+  (up to the cap) regardless of the rung. This is the starvation fix
+  for the old batcher, where a request arriving behind a full batch
+  could wait multiple full dispatch cycles.
+
+- **Load shedding** against the declared ``serve_p99`` objective
+  (obs/slo.py): at admission, the projected completion time — queue
+  depth over the rung, times the EWMA dispatch wall, plus the live p99
+  estimate from ``pio_query_latency_seconds`` — is compared to the SLO
+  threshold. A request that cannot make it sheds with 503 +
+  ``Retry-After`` (:class:`ShedError`) instead of poisoning the p99 for
+  everyone admitted behind it; a higher-priority arrival evicts the
+  lowest-priority queued request rather than shedding itself. Sheds
+  book ``pio_serve_shed_total{reason}``.
+
+Exported series: ``pio_serve_batch_size`` (pow2 buckets — the fused
+width distribution, the fleet bench's ``fleet_batch_p50`` source),
+``pio_serve_queue_wait_seconds``, ``pio_serve_shed_total{reason}``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import inspect
+import math
+import os
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.utils import times
+from incubator_predictionio_tpu.utils.http import HttpError
+
+#: fused batch width per dispatch, on pow2 buckets matching the ladder
+#: the padded kernels compile (1..8192 covers any sane cap)
+_BATCH_SIZE = obs_metrics.REGISTRY.histogram(
+    "pio_serve_batch_size",
+    "queries fused into one scheduler dispatch (pow2 ladder buckets)",
+    buckets=tuple(float(1 << i) for i in range(14)))
+_QUEUE_WAIT = obs_metrics.REGISTRY.histogram(
+    "pio_serve_queue_wait_seconds",
+    "admission-queue wait before a query's batch dispatched")
+_SHED = obs_metrics.REGISTRY.counter(
+    "pio_serve_shed_total",
+    "requests shed by the scheduler, by reason (overload = projected "
+    "past the serve_p99 objective; evicted = displaced by a higher-"
+    "priority arrival; shutdown = scheduler stopping)",
+    labels=("reason",))
+_COMPILE_CACHE = obs_metrics.REGISTRY.gauge(
+    "pio_serve_compile_cache_size",
+    "compiled serving-dispatch variants resident (ops/topk ladder) — "
+    "flat in steady state, the zero-recompile contract's counter")
+
+
+def _collect_compile_cache() -> None:
+    # scrape-time: only report when the serving kernels were actually
+    # imported — never drag jax into a process that scrapes but does
+    # not serve (storage/event servers share this registry module)
+    import sys as _sys
+
+    mod = _sys.modules.get("incubator_predictionio_tpu.ops.topk")
+    if mod is not None:
+        _COMPILE_CACHE.set(float(mod.serve_compile_cache_size()))
+
+
+obs_metrics.REGISTRY.register_collector("serve_compile_cache",
+                                        _collect_compile_cache)
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two ≥ n (≥1) — the ladder's rung spacing, the
+    same policy ``ops/topk.next_pow2`` pads dispatch shapes with."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def ladder_cap() -> int:
+    """Largest batch width the scheduler may fuse (pow2-rounded).
+
+    ``PIO_SERVE_MAX_BATCH`` is the LADDER CAP, not a fixed batch size:
+    dispatches use the adaptive rung and only reach the cap under
+    sustained queue pressure (docs/production.md "Serving fleet")."""
+    try:
+        n = int(os.environ.get("PIO_SERVE_MAX_BATCH", "512"))
+    except ValueError:
+        n = 512
+    return next_pow2(max(n, 1))
+
+
+def max_wait_s() -> float:
+    """Age bound: no admitted query waits longer than this for its
+    dispatch just because the rung is small (``PIO_SERVE_MAX_WAIT_MS``,
+    default 250 ms; ≤0 disables the bound)."""
+    try:
+        ms = float(os.environ.get("PIO_SERVE_MAX_WAIT_MS", "250"))
+    except ValueError:
+        ms = 250.0
+    return ms / 1000.0
+
+
+def serve_objective_s() -> float:
+    """The serve_p99 SLO threshold the shed projection tests against —
+    read from the SAME declared objective the burn-rate engine
+    evaluates (obs/slo.py, ``PIO_SLO_SERVE_P99_S``), so shedding and
+    the SLO can never disagree about the promise."""
+    from incubator_predictionio_tpu.obs import slo as obs_slo
+
+    for spec in obs_slo.default_specs():
+        if spec.name == "serve_p99":
+            return float(spec.threshold)
+    return 0.25
+
+
+def shed_enabled() -> bool:
+    return os.environ.get("PIO_SERVE_SHED", "1").lower() not in (
+        "0", "off", "false")
+
+
+class ShedError(HttpError):
+    """503 with a Retry-After contract: the scheduler projected this
+    request past the serve_p99 objective. Clients back off for
+    ``retry_after_s`` and retry; the header rides the error response
+    (utils/http.py forwards ``HttpError.headers``)."""
+
+    def __init__(self, retry_after_s: float, reason: str = "overload"):
+        retry = max(int(math.ceil(retry_after_s)), 1)
+        super().__init__(
+            503,
+            "Serving overloaded: request projected past the latency "
+            f"objective; retry after {retry}s.")
+        self.headers = {"Retry-After": str(retry)}
+        self.reason = reason
+        self.retry_after_s = retry
+
+
+def plan_dispatch(depth: int, rung: int, oldest_age_s: float,
+                  cap: int, wait_bound_s: float) -> Tuple[int, int]:
+    """The pure dispatch decision: ``(take, next_rung)``.
+
+    - take ``min(depth, rung)`` normally; the WHOLE backlog (up to
+      ``cap``) when the oldest waiter's age crossed the bound — the
+      scheduler never holds a query past ``PIO_SERVE_MAX_WAIT_MS``.
+    - grow the rung one ladder step when the queue outran it, collapse
+      one step when the queue sits at half the rung or less; steady
+      traffic keeps its rung (hysteresis band (rung/2, rung]).
+    """
+    depth = max(int(depth), 0)
+    rung = min(max(int(rung), 1), cap)
+    if depth == 0:
+        return 0, rung
+    if wait_bound_s > 0 and oldest_age_s >= wait_bound_s:
+        take = min(depth, cap)
+    else:
+        take = min(depth, rung)
+    if depth > rung:
+        rung = min(rung * 2, cap)
+    elif 2 * depth <= rung:
+        rung = max(rung // 2, 1)
+    return take, rung
+
+
+@dataclasses.dataclass
+class _Pending:
+    body: Any
+    fut: "concurrent.futures.Future"
+    t_enq: float
+    priority: int
+
+
+class _EngineQueue:
+    """One engine's admission queue + its ladder/latency state."""
+
+    __slots__ = ("items", "rung", "ewma_wall", "in_flight")
+
+    def __init__(self) -> None:
+        self.items: Deque[_Pending] = deque()
+        self.rung = 1
+        #: EWMA of one dispatch's wall — the shed projection's cycle
+        #: cost. 0.0 until the first dispatch lands (never shed on a
+        #: cold queue: there is no evidence of overload yet).
+        self.ewma_wall = 0.0
+        self.in_flight = 0
+
+    def note_wall(self, wall: float) -> None:
+        self.ewma_wall = (wall if self.ewma_wall == 0.0
+                          else 0.7 * self.ewma_wall + 0.3 * wall)
+
+    def projected_wait_s(self, cap: int) -> float:
+        """Queue wait a NEW arrival would see: full dispatch cycles
+        ahead of it plus the in-flight dispatch, each at the EWMA wall.
+
+        The cycle width is the rung THIS depth will drive the ladder
+        to — not the current rung: a burst against a cold (rung-1)
+        queue is exactly what adaptive batching absorbs, and
+        projecting it as depth-many singleton dispatches would shed
+        the load the ladder was about to fuse (a metastable shed
+        spiral: shedding holds the queue short, the rung never grows,
+        the projection never recovers)."""
+        if self.ewma_wall <= 0.0:
+            return 0.0
+        depth = len(self.items) + 1
+        width = min(max(self.rung, next_pow2(depth)), cap)
+        cycles = math.ceil(depth / width)
+        return (cycles + (1 if self.in_flight else 0)) * self.ewma_wall
+
+
+class BatchScheduler:
+    """Continuous-batching scheduler over one ``handle_batch`` callable.
+
+    ``handle_batch(bodies) -> results`` serves a whole batch in one
+    device dispatch (results list aligned with bodies; an Exception
+    entry fails just that member). A two-parameter handler —
+    ``handle_batch(bodies, engine)`` — additionally receives the queue
+    key, for multi-engine hosts. Construction-time signature stays
+    compatible with the old ``_MicroBatcher(handle, max_batch,
+    workers=…)``; ``max_batch`` is now the LADDER CAP the adaptive rung
+    grows toward, not the fixed fuse width.
+    """
+
+    def __init__(
+        self,
+        handle_batch: Callable[..., List[Any]],
+        max_batch: Optional[int] = None,
+        workers: int = 1,
+        *,
+        clock: Optional[Callable[[], float]] = None,
+        wait_bound_s: Optional[float] = None,
+        slo_s: Optional[float] = None,
+        p99_fn: Optional[Callable[[], Optional[float]]] = None,
+        shed: Optional[bool] = None,
+    ) -> None:
+        self._handle_batch = handle_batch
+        try:
+            params = [
+                p for p in inspect.signature(handle_batch).parameters
+                .values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                and p.default is p.empty  # a defaulted slot is NOT an
+                # engine parameter (closure-style wrappers default-bind)
+            ]
+            self._pass_engine = len(params) >= 2
+        except (TypeError, ValueError):
+            self._pass_engine = False
+        self.cap = (ladder_cap() if max_batch is None
+                    else next_pow2(max(int(max_batch), 1)))
+        #: compat: old callers read ``max_batch`` as the fuse bound
+        self.max_batch = self.cap
+        self._clock = clock if clock is not None else times.monotonic
+        self.wait_bound_s = (max_wait_s() if wait_bound_s is None
+                             else float(wait_bound_s))
+        self.slo_s = serve_objective_s() if slo_s is None else float(slo_s)
+        self._p99_fn = p99_fn
+        self._shed = shed_enabled() if shed is None else bool(shed)
+        self._cv = threading.Condition()
+        self._queues: "OrderedDict[str, _EngineQueue]" = OrderedDict()
+        self._stopped = False
+        self.shed_count = 0
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"pio-serve-sched-{i}")
+            for i in range(max(int(workers), 1))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, body: Any, priority: int = 0,
+               engine: str = "default") -> "concurrent.futures.Future":
+        """Enqueue one query body → Future of its result. ``priority``
+        orders only the SHED decision (higher survives longer), never
+        dispatch order — admitted requests stay FIFO so no admitted
+        query starves behind a later high-priority one."""
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        now = self._clock()
+        shed_exc: Optional[ShedError] = None
+        victim: Optional[_Pending] = None
+        with self._cv:
+            if self._stopped:
+                fut.set_exception(
+                    HttpError(503, "Server is shutting down."))
+                return fut
+            q = self._queues.get(engine)
+            if q is None:
+                q = self._queues[engine] = _EngineQueue()
+            if self._shed and q.items:
+                projected = q.projected_wait_s(self.cap)
+                p99 = self._p99_fn() if self._p99_fn is not None else None
+                if projected > 0 and \
+                        projected + float(p99 or 0.0) > self.slo_s:
+                    lowest = min(q.items, key=lambda p: p.priority)
+                    if lowest.priority < priority:
+                        # evict the lowest-priority waiter in favor of
+                        # this higher-priority arrival — fleet QoS: paid
+                        # traffic rides through an overload
+                        q.items.remove(lowest)
+                        victim = lowest
+                    else:
+                        shed_exc = ShedError(projected, reason="overload")
+            if shed_exc is None:
+                q.items.append(_Pending(body, fut, now, int(priority)))
+                self._cv.notify()
+            retry_hint = q.projected_wait_s(self.cap)
+            # counted under the lock: submit runs on the HTTP thread
+            # pool, and a bare += from two shedding threads can lose
+            # an increment (the /status figure must track the counter)
+            if victim is not None or shed_exc is not None:
+                self.shed_count += 1
+        if victim is not None:
+            _SHED.labels(reason="evicted").inc()
+            victim.fut.set_exception(
+                ShedError(retry_hint, reason="evicted"))
+        if shed_exc is not None:
+            _SHED.labels(reason="overload").inc()
+            fut.set_exception(shed_exc)
+        return fut
+
+    # -- introspection ------------------------------------------------------
+    def depth(self, engine: Optional[str] = None) -> int:
+        with self._cv:
+            if engine is not None:
+                q = self._queues.get(engine)
+                return len(q.items) if q is not None else 0
+            return sum(len(q.items) for q in self._queues.values())
+
+    def rung(self, engine: str = "default") -> int:
+        with self._cv:
+            q = self._queues.get(engine)
+            return q.rung if q is not None else 1
+
+    def stats(self) -> Dict[str, Any]:
+        """Per-engine scheduler state for /status and the tests."""
+        with self._cv:
+            return {
+                "cap": self.cap,
+                "shed": self.shed_count,
+                "engines": {
+                    name: {"depth": len(q.items), "rung": q.rung,
+                           "ewmaWallS": round(q.ewma_wall, 6)}
+                    for name, q in self._queues.items()
+                },
+            }
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    # -- dispatch loop ------------------------------------------------------
+    def _pick_locked(self) -> Optional[Tuple[str, _EngineQueue]]:
+        """The engine whose head request has waited longest — FIFO
+        across queues, so no engine starves behind a busier one."""
+        best: Optional[Tuple[str, _EngineQueue]] = None
+        for name, q in self._queues.items():
+            if not q.items:
+                continue
+            if best is None or q.items[0].t_enq < best[1].items[0].t_enq:
+                best = (name, q)
+        return best
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopped and self._pick_locked() is None:
+                    self._cv.wait(0.5)
+                picked = self._pick_locked()
+                if picked is None:
+                    if self._stopped:
+                        return
+                    continue
+                engine, q = picked
+                now = self._clock()
+                oldest_age = now - q.items[0].t_enq
+                take, q.rung = plan_dispatch(
+                    len(q.items), q.rung, oldest_age, self.cap,
+                    self.wait_bound_s)
+                batch = [q.items.popleft() for _ in range(take)]
+                q.in_flight += 1
+            t0 = self._clock()
+            for p in batch:
+                _QUEUE_WAIT.observe(max(t0 - p.t_enq, 0.0))
+            _BATCH_SIZE.observe(float(len(batch)))
+            try:
+                if self._pass_engine:
+                    results = self._handle_batch(
+                        [p.body for p in batch], engine)
+                else:
+                    results = self._handle_batch([p.body for p in batch])
+            except Exception as exc:  # catastrophic: fail the whole batch
+                results = [exc] * len(batch)
+            wall = self._clock() - t0
+            with self._cv:
+                q.note_wall(wall)
+                q.in_flight -= 1
+            for p, res in zip(batch, results):
+                if isinstance(res, Exception):
+                    p.fut.set_exception(res)
+                else:
+                    p.fut.set_result(res)

@@ -56,13 +56,21 @@ def _width_ladder() -> Tuple[int, ...]:
     return tuple(widths) or (8, 32, 128, 512)
 
 
-def _max_batch() -> int:
-    """Largest rows-per-dispatch bucket (power of two)."""
+def max_batch() -> int:
+    """Largest rows-per-dispatch bucket (power of two). Public: the
+    overlay's queue-depth-adaptive fold-in budget (speed/overlay.py)
+    sizes its per-poll rungs in multiples of this, so every full
+    dispatch it requests is a full ladder bucket with zero padding
+    waste."""
     try:
         n = int(os.environ.get("PIO_SPEED_MAX_BATCH", "64"))
     except ValueError:
         n = 64
     return 1 << max(n - 1, 0).bit_length()
+
+
+#: original private name, kept for callers/tests that grew against it
+_max_batch = max_batch
 
 
 @functools.partial(jax.jit, static_argnames=("reg_nnz", "implicit",

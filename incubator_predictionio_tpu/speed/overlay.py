@@ -98,9 +98,18 @@ class SpeedOverlayConfig:
     alpha: float = 1.0
     #: post-solve transform (similarproduct normalizes to unit vectors)
     transform: Optional[Callable[[np.ndarray], np.ndarray]] = None
-    #: history cap per key (newest kept) and per-poll fold-in budget
+    #: history cap per key (newest kept) and per-poll fold-in budget.
+    #: ``max_keys_per_poll`` is the budget LADDER BASE, not a fixed
+    #: cap: under dirty backlog the overlay doubles its per-poll budget
+    #: up to ``max_keys_per_poll × max_keys_growth`` and collapses back
+    #: when the backlog drains — the fold-in plane's twin of the
+    #: serving scheduler's queue-depth-adaptive batching
+    #: (serving/scheduler.py; docs/production.md "Serving fleet")
     max_history: int = 512
     max_keys_per_poll: int = 256
+    #: backlog growth headroom: the adaptive budget's cap as a multiple
+    #: of the base (16 → a 256 base may reach 4096 keys/poll)
+    max_keys_growth: int = 16
     ttl_s: float = 300.0
 
 
@@ -163,6 +172,10 @@ class SpeedOverlay:
         #: ride the tail read in, fold-in publishes hand them over, and
         #: the first serving HIT closes the pio_freshness_seconds loop
         self.freshness = FreshnessTracker(engine=config.engine)
+        #: queue-depth-adaptive per-poll fold-in budget: doubles from
+        #: the configured base while dirty keys outpace it, collapses
+        #: when the backlog drains (see SpeedOverlayConfig)
+        self._budget_rung = max(int(config.max_keys_per_poll), 1)
         self.cursor = self._initial_cursor()
         _LIVE_OVERLAYS.add(self)
         self.hits = 0
@@ -244,6 +257,7 @@ class SpeedOverlay:
                 "cursor": self.cursor,
                 "cursorLagEvents": self.last_lag,
                 "shardedTable": self.solver.sharded,
+                "foldinBudget": self._budget_rung,
             }
 
     # -- lifecycle ----------------------------------------------------------
@@ -408,10 +422,35 @@ class SpeedOverlay:
                        if now >= exp]
             for k in expired:
                 del self._vectors[k]
-            budget = (cfg.max_keys_per_poll if max_keys is None
+            budget = (self._budget_rung if max_keys is None
                       else int(max_keys))
+            backlog = len(self._dirty)
             pending = list(self._dirty.items())[:budget]
         solved = self._fold_in(pending, new_cursor) if pending else 0
+        # adapt the per-poll budget to the observed backlog: grow one
+        # rung while dirty keys outpace it (a cold-start flood folds in
+        # O(log) polls instead of O(backlog/base)), collapse one rung
+        # when the backlog sits at half the budget or less — the same
+        # grow/collapse hysteresis as the serving scheduler's rung.
+        # GROWN rungs round up to full fold-in dispatch buckets
+        # (foldin.max_batch) so a grown budget never ends on a padded
+        # partial batch; the configured base (the idle/collapse floor)
+        # and the cap are never exceeded by the rounding. Explicit
+        # max_keys overrides (tests, operators) bypassed the rung, so
+        # they must not train it either.
+        if max_keys is None:
+            from incubator_predictionio_tpu.speed import foldin as _foldin
+
+            bucket = max(_foldin.max_batch(), 1)
+            base = max(int(cfg.max_keys_per_poll), 1)
+            cap = base * max(int(cfg.max_keys_growth), 1)
+            if backlog > self._budget_rung:
+                grown = min(self._budget_rung * 2, cap)
+                if grown > base:
+                    grown = min(-(-grown // bucket) * bucket, cap)
+                self._budget_rung = grown
+            elif 2 * backlog <= self._budget_rung:
+                self._budget_rung = max(self._budget_rung // 2, base)
         with self._lock:
             size = len(self._vectors)
             still_dirty = len(self._dirty)

@@ -62,12 +62,19 @@ STATUS_TEXT = {
 
 
 class HttpError(Exception):
-    """Raise from a handler to produce a JSON error response."""
+    """Raise from a handler to produce a JSON error response.
+
+    ``headers`` (an attribute, default empty) ride the error response —
+    the scheduler's 503 shed carries its ``Retry-After`` contract this
+    way (serving/scheduler.py ShedError)."""
 
     def __init__(self, status: int, message: str):
         super().__init__(message)
         self.status = status
         self.message = message
+        # per-instance, never a class-level dict: an in-place mutation
+        # must not leak the header onto every other error response
+        self.headers: Dict[str, str] = {}
 
 
 class Request:
@@ -454,7 +461,8 @@ class HttpServer:
             return self._with_cors(result), route
         except HttpError as e:
             return self._with_cors(
-                Response(e.status, {"message": e.message})), route
+                Response(e.status, {"message": e.message},
+                         headers=dict(e.headers))), route
         except Exception as e:
             logger.exception("handler error for %s %s", request.method,
                              request.path)

@@ -1,6 +1,7 @@
-"""Worker program for the fleet-observability two-process tests.
+"""Worker program for the fleet tests and the fleet serving bench.
 
-Run by tests/test_federation.py as a REAL second (and third) process:
+Run as a REAL separate process by tests/test_federation.py and by
+``bench.py bench_fleet``:
 
 - ``--mode metrics``: an HttpServer exposing ``GET /metrics`` from its
   own process registry, with a planted query-latency histogram and
@@ -11,18 +12,136 @@ Run by tests/test_federation.py as a REAL second (and third) process:
   parent's event server forwards ``X-PIO-Trace-Id``/``X-PIO-Parent-
   Span`` on its storage RPCs, and THIS process's ``pio.trace`` span
   lines (on stderr) must link under the parent's spans.
+- ``--mode serve``: a full PredictionServer over a planted ALS model
+  (random factors, synthetic catalog), serving ``/queries.json``
+  through the continuous-batching scheduler (serving/scheduler.py) with
+  the pow2 ladder pre-warmed before the port is announced — one worker
+  of the ``bench_fleet`` leg. ``/metrics`` on the same port exposes
+  ``pio_serve_batch_size`` / ``pio_serve_shed_total`` /
+  ``pio_serve_compile_cache_size`` for the bench's scrapes.
 
-Prints ``PORT <n>`` on stdout once bound, then serves until stdin
-closes (the parent owns the lifetime; no signals needed).
+Prints ``PORT <n>`` on stdout once bound (serve mode: once WARM), then
+serves until stdin closes (the parent owns the lifetime; no signals
+needed).
 """
 
 import argparse
 import sys
 
 
+def _serve_worker(args) -> int:
+    """Planted-model serving worker → bound port (ladder pre-warmed)."""
+    import threading
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.data.bimap import BiMap
+    from incubator_predictionio_tpu.data.storage import EngineInstance
+    from incubator_predictionio_tpu.models.recommendation.engine import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        ALSModel,
+        Query,
+        RecommendationServing,
+    )
+    from incubator_predictionio_tpu.servers.plugins import PluginContext
+    from incubator_predictionio_tpu.servers.prediction_server import (
+        PredictionServer,
+        ServerConfig,
+        _AsyncPoster,
+    )
+    from incubator_predictionio_tpu.serving.scheduler import BatchScheduler
+    from incubator_predictionio_tpu.utils.http import HttpServer
+    from incubator_predictionio_tpu.utils.times import now_utc
+    from incubator_predictionio_tpu.workflow.workflow import (
+        make_runtime_context,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    n_users, n_items, rank = args.users, args.items, args.rank
+    model = ALSModel(
+        user_factors=jnp.asarray(
+            rng.normal(0, 0.3, (n_users, rank)).astype(np.float32)),
+        item_factors=jnp.asarray(
+            rng.normal(0, 0.3, (n_items, rank)).astype(np.float32)),
+        user_bimap=BiMap({f"u{i}": i for i in range(n_users)}),
+        item_bimap=BiMap({f"i{i}": i for i in range(n_items)}),
+        item_years={}, item_categories={},
+    )
+    algo = ALSAlgorithm(ALSAlgorithmParams(rank=rank))
+    now = now_utc()
+    server = PredictionServer.__new__(PredictionServer)
+    # direct state injection (the bench_serving pattern): this worker
+    # measures the serving plane, not checkpoint restore
+    server.engine = None
+    server.config = ServerConfig(ip="127.0.0.1", port=0,
+                                 micro_batch=args.max_batch)
+    server.plugin_context = PluginContext()
+    server.ctx = make_runtime_context(None)
+    server._lock = threading.Lock()
+    server.engine_instance = EngineInstance(
+        id="fleet", status="COMPLETED", start_time=now, end_time=now,
+        engine_id="fleet", engine_version="1", engine_variant="fleet",
+        engine_factory="fleet")
+    server.engine_params = None
+    server.algorithms = [algo]
+    server.serving = RecommendationServing()
+    server.models = [model]
+    server.start_time = now
+    server.request_count = 0
+    server.avg_serving_sec = 0.0
+    server.last_serving_sec = 0.0
+    server.max_batch_served = 0
+    server._conf_server_key = None
+    server.http = HttpServer(server._build_router(), "127.0.0.1", 0,
+                             name="prediction")
+    server._speed_overlays = []
+    handle = server._handle_batch
+    if args.dispatch_floor_ms > 0:
+        # CPU-sim stand-in for an accelerator's fixed per-dispatch wall
+        # (compile-cache lookup + launch + result fetch — on a real TPU
+        # this floor exists regardless of batch width, and it is WHY
+        # fusing a deeper queue into one dispatch wins): pad every
+        # dispatch to the floor. time.sleep releases the GIL, so the
+        # HTTP plane keeps admitting — queue depth builds exactly as it
+        # would behind a busy device.
+        import time as _time
+
+        floor_s = args.dispatch_floor_ms / 1000.0
+        inner = server._handle_batch
+
+        def handle(bodies):
+            t0 = _time.perf_counter()
+            out = inner(bodies)
+            left = floor_s - (_time.perf_counter() - t0)
+            if left > 0:
+                _time.sleep(left)
+            return out
+
+    from incubator_predictionio_tpu.servers import (
+        prediction_server as ps_mod,
+    )
+
+    server._batcher = BatchScheduler(
+        handle, server.config.micro_batch,
+        workers=server.config.serve_workers,
+        # same live-p99 feed the real PredictionServer wires in
+        p99_fn=lambda: ps_mod._QUERY_LATENCY.quantile(0.99))
+    server._feedback_poster = _AsyncPoster("feedback")
+    server._log_poster = _AsyncPoster("log", workers=1)
+    # pre-warm EVERY pow2 ladder rung (plus the singleton path) so the
+    # load ramp measures serving, not XLA compiles — the zero-steady-
+    # state-recompile contract starts from here
+    algo.warmup(model, max_batch=server.config.micro_batch)
+    port = server.http.start_background()
+    return port
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=("metrics", "storage"),
+    ap.add_argument("--mode", choices=("metrics", "storage", "serve"),
                     required=True)
     ap.add_argument("--observe", default="",
                     help="comma-separated seconds planted into "
@@ -32,6 +151,16 @@ def main() -> None:
     ap.add_argument("--staleness", type=float, default=None,
                     help="pio_model_staleness_seconds value "
                          "(metrics mode)")
+    ap.add_argument("--users", type=int, default=2000)
+    ap.add_argument("--items", type=int, default=1000)
+    ap.add_argument("--rank", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=512,
+                    help="scheduler ladder cap (serve mode)")
+    ap.add_argument("--dispatch-floor-ms", type=float, default=0.0,
+                    help="pad every scheduler dispatch to this wall — "
+                         "the CPU sim's stand-in for an accelerator's "
+                         "fixed per-dispatch cost (serve mode)")
+    ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
     from incubator_predictionio_tpu.obs import metrics as obs_metrics
@@ -39,6 +168,7 @@ def main() -> None:
 
     obs_trace.enable_span_logging()
 
+    srv = None
     if args.mode == "metrics":
         from incubator_predictionio_tpu.obs.http import add_metrics_route
         from incubator_predictionio_tpu.utils.http import (
@@ -64,6 +194,8 @@ def main() -> None:
         add_metrics_route(r)
         srv = HttpServer(r, "127.0.0.1", 0, name="worker")
         port = srv.start_background()
+    elif args.mode == "serve":
+        port = _serve_worker(args)
     else:
         from incubator_predictionio_tpu.data.storage import (
             StorageClientConfig,
@@ -84,7 +216,8 @@ def main() -> None:
     print(f"PORT {port}", flush=True)
     # serve until the parent closes our stdin (its process exit does)
     sys.stdin.read()
-    srv.stop()
+    if srv is not None:
+        srv.stop()
 
 
 if __name__ == "__main__":

@@ -78,6 +78,18 @@ REQUIRED_FIELDS = {
     "shard_devices": (int, type(None)),
     "shard_nnz": (int, type(None)),
     "shard_sweeps": (int, type(None)),
+    # serving-fleet leg (docs/production.md "Serving fleet"): the
+    # continuous-batching scheduler measured across real worker
+    # processes. None = the leg's designed deadline-skip (same contract
+    # as the shard_* keys)
+    "fleet_workers": (int, type(None)),
+    "fleet_qps": (float, type(None)),
+    "fleet_qps_per_worker": (float, type(None)),
+    "fleet_p99_s": (float, type(None)),
+    "fleet_batch_p50": (float, type(None)),
+    "fleet_shed_rate": (float, type(None)),
+    "fleet_p99_flat_x": (float, type(None)),
+    "fleet_recompiles_steady": (int, type(None)),
     # provenance (obs/capacity.py): every record explains its origin,
     # and a record whose child landed carries no skip reason
     "bench_env": dict,
@@ -205,6 +217,30 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
         assert key in env_block, key
     assert env_block["backend"] == "cpu"
     assert env_block["device_count"] >= 1
+    # serving-fleet leg: queue-depth-adaptive batching demonstrably
+    # engaged (the fused width's p50 under peak offered load beats the
+    # old fixed max_batch=64), p99 stayed flat (≤1.5×) across the
+    # offered-load ramp, and the peak stage compiled NOTHING new (the
+    # zero-steady-state-recompile contract, fleet edition). None =
+    # the leg's designed deadline-skip.
+    if rec["fleet_workers"] is not None:
+        # every key individually null-guarded: fleet_workers is set
+        # before the load runs, so a stage that produced no serves
+        # leaves later keys None — that must read as a clear assertion,
+        # not a NoneType comparison TypeError
+        assert rec["fleet_workers"] >= 2
+        assert rec["fleet_qps"] is not None and rec["fleet_qps"] > 0
+        assert rec["fleet_qps_per_worker"] is not None \
+            and rec["fleet_qps_per_worker"] > 0
+        assert rec["fleet_p99_s"] is not None \
+            and rec["fleet_p99_s"] > 0, rec["fleet_p99_s"]
+        assert rec["fleet_batch_p50"] is not None \
+            and rec["fleet_batch_p50"] > 64, rec["fleet_batch_p50"]
+        assert rec["fleet_p99_flat_x"] is not None \
+            and rec["fleet_p99_flat_x"] <= 1.5, rec["fleet_p99_flat_x"]
+        assert rec["fleet_recompiles_steady"] == 0
+        assert rec["fleet_shed_rate"] is not None \
+            and 0.0 <= rec["fleet_shed_rate"] <= 1.0
     if rec["shard_devices"] is not None:
         assert rec["shard_devices"] == 8
         assert rec["shard_mesh_shape"] == "8x1"

@@ -359,6 +359,29 @@ class Handler:
         return self.count
 """,
     ),
+    "unbatched-dispatch": (
+        """
+from incubator_predictionio_tpu.ops.topk import score_and_top_k
+
+class Server:
+    async def handle_query(self, request):
+        # direct device dispatch from a request handler: no queue
+        # coalescing, no shed policy
+        packed = score_and_top_k(self.user_vec, self.item_factors, 10)
+        preds = self.algo.predict(self.model, request)
+        return packed, preds
+""",
+        """
+import asyncio
+
+class Server:
+    async def handle_query(self, request):
+        # the sanctioned seam: enqueue, let the scheduler coalesce the
+        # in-flight queries into one fused dispatch
+        return await asyncio.wrap_future(
+            self.batcher.submit(request.body))
+""",
+    ),
     "metric-label-cardinality": (
         """
 from incubator_predictionio_tpu.obs import metrics
@@ -391,8 +414,10 @@ def handle(request, route_label, response):
 
 
 def _lint_source(tmp_path: Path, source: str, rule: str, name="fixture.py"):
-    # server-state only applies under a servers/ directory
-    target_dir = tmp_path / "servers" if rule == "server-state" else tmp_path
+    # server-state / unbatched-dispatch only apply under servers/
+    target_dir = (tmp_path / "servers"
+                  if rule in ("server-state", "unbatched-dispatch")
+                  else tmp_path)
     target_dir.mkdir(exist_ok=True)
     target = target_dir / name
     target.write_text(source, encoding="utf-8")

@@ -585,7 +585,14 @@ def test_concurrent_scrape_during_server_shutdown():
     def scraper():
         while not stop.is_set():
             try:
-                text = scrape(port)
+                # short socket timeout: a connection the dying server
+                # accepted but never services must resolve well inside
+                # the join window below, or a loaded box reads the
+                # normal timeout as a "hang"
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=5) as resp:
+                    text = resp.read().decode("utf-8")
                 parse_exposition(text)
             except AssertionError as e:      # malformed exposition
                 errors.append(e)

@@ -1,0 +1,352 @@
+"""Continuous-batching scheduler behavior under load.
+
+The serving plane's contracts, pinned without sleeps wherever a
+decision is involved (the FakeClock seam drives every age/wall/shed
+decision):
+
+- ladder-rung growth/collapse (``plan_dispatch`` — the pure rule)
+- the PIO_SERVE_MAX_WAIT_MS age bound: a query is never held past it
+  (the _MicroBatcher starvation regression)
+- per-engine queue isolation: batches never mix engines, rungs adapt
+  independently
+- SLO-projected load shedding: overload sheds 503 + Retry-After,
+  priority evicts, recovery re-admits (the shed-then-recover flip)
+- zero steady-state recompiles: a warm pow2 ladder serves every batch
+  width the scheduler can choose from the jit cache
+  (``ops.topk.serve_compile_cache_size`` — the foldin-cache pin's
+  serving twin)
+"""
+
+import threading
+
+import pytest
+
+from incubator_predictionio_tpu.serving.scheduler import (
+    BatchScheduler,
+    ShedError,
+    ladder_cap,
+    plan_dispatch,
+)
+from incubator_predictionio_tpu.utils.times import FakeClock
+
+
+# ---------------------------------------------------------------------------
+# plan_dispatch: the pure ladder rule
+# ---------------------------------------------------------------------------
+
+def test_rung_grows_one_ladder_step_under_load():
+    # queue deeper than the rung: take the rung now, grow for next time
+    assert plan_dispatch(10, 4, 0.0, 512, 0.25) == (4, 8)
+    assert plan_dispatch(100, 8, 0.0, 512, 0.25) == (8, 16)
+    # growth saturates at the cap
+    assert plan_dispatch(1000, 512, 0.0, 512, 0.25) == (512, 512)
+
+
+def test_rung_collapses_when_idle():
+    assert plan_dispatch(1, 8, 0.0, 512, 0.25) == (1, 4)
+    assert plan_dispatch(0, 8, 0.0, 512, 0.25) == (0, 8)  # no dispatch
+    # floor is rung 1
+    assert plan_dispatch(1, 1, 0.0, 512, 0.25) == (1, 1)
+
+
+def test_rung_hysteresis_band_holds_steady():
+    # depth in (rung/2, rung]: no thrash
+    assert plan_dispatch(3, 4, 0.0, 512, 0.25) == (3, 4)
+    assert plan_dispatch(4, 4, 0.0, 512, 0.25) == (4, 4)
+
+
+def test_age_breach_drains_whole_backlog():
+    # the oldest waiter crossed the bound: take EVERYTHING (up to cap),
+    # rung still only steps one ladder rung
+    assert plan_dispatch(100, 4, 0.3, 512, 0.25) == (100, 8)
+    assert plan_dispatch(1000, 4, 0.3, 512, 0.25) == (512, 8)
+    # bound disabled (<=0): never triggers
+    assert plan_dispatch(100, 4, 99.0, 512, 0.0) == (4, 8)
+
+
+def test_ladder_cap_is_pow2(monkeypatch):
+    monkeypatch.setenv("PIO_SERVE_MAX_BATCH", "100")
+    assert ladder_cap() == 128
+    monkeypatch.setenv("PIO_SERVE_MAX_BATCH", "512")
+    assert ladder_cap() == 512
+
+
+# ---------------------------------------------------------------------------
+# threaded scheduler behavior
+# ---------------------------------------------------------------------------
+
+def _drain(futs, timeout=10.0):
+    return [f.result(timeout) for f in futs]
+
+
+def test_ladder_walkup_batch_sizes():
+    """A prefilled queue drains in pow2 ladder steps: 1 (the in-flight
+    singleton), then 2, 4, 8, ... — the fused width follows queue
+    depth, not a fixed cap."""
+    gate = threading.Event()
+    first_in = threading.Event()
+    batches = []
+
+    def handler(bodies):
+        first_in.set()
+        gate.wait(10)
+        batches.append(len(bodies))
+        return bodies
+
+    s = BatchScheduler(handler, 64, shed=False, wait_bound_s=0.0)
+    try:
+        futs = [s.submit(b"0")]
+        assert first_in.wait(5)           # singleton dispatch in flight
+        futs += [s.submit(b"%d" % i) for i in range(1, 64)]
+        gate.set()
+        _drain(futs)
+        # the in-flight singleton, then one rung-1 dispatch (the rung
+        # only grows AFTER a dispatch observed the deep queue), then
+        # the pow2 walk-up
+        assert batches == [1, 1, 2, 4, 8, 16, 32], batches
+    finally:
+        s.stop()
+
+
+def test_age_bound_never_holds_a_query_past_it():
+    """The starvation regression: requests arriving while a full batch
+    dispatches must NOT wait multiple rung-limited dispatch cycles —
+    once their age crosses the bound, the next dispatch takes the whole
+    backlog."""
+    clock = FakeClock()
+    gate = threading.Event()
+    first_in = threading.Event()
+    batches = []
+
+    def handler(bodies):
+        first_in.set()
+        gate.wait(10)
+        batches.append(len(bodies))
+        return bodies
+
+    s = BatchScheduler(handler, 64, clock=clock, shed=False,
+                       wait_bound_s=0.25)
+    try:
+        futs = [s.submit(b"a")]
+        assert first_in.wait(5)
+        # ten requests land while the dispatch is in flight (rung is
+        # still 1 — without the age bound they would drain one per
+        # cycle, the last waiting TEN cycles)
+        futs += [s.submit(b"%d" % i) for i in range(10)]
+        clock.advance(1.0)                # all ten now exceed the bound
+        gate.set()
+        _drain(futs)
+        assert batches == [1, 10], batches
+    finally:
+        s.stop()
+
+
+def test_per_engine_queues_fuse_independently():
+    """Batches never mix engines, and each engine's rung adapts to ITS
+    queue depth only."""
+    gate = threading.Event()
+    first_in = threading.Event()
+    batches = []
+
+    def handler(bodies, engine):
+        first_in.set()
+        gate.wait(10)
+        batches.append((engine, len(bodies)))
+        return bodies
+
+    s = BatchScheduler(handler, 64, shed=False, wait_bound_s=0.0)
+    try:
+        futs = [s.submit(b"x", engine="reco")]
+        assert first_in.wait(5)
+        futs += [s.submit(b"%d" % i, engine="reco") for i in range(32)]
+        futs += [s.submit(b"e%d" % i, engine="ecom") for i in range(2)]
+        gate.set()
+        _drain(futs)
+        for engine, n in batches:
+            assert engine in ("reco", "ecom")
+        # totals per engine add up — no cross-engine leakage
+        assert sum(n for e, n in batches if e == "reco") == 33
+        assert sum(n for e, n in batches if e == "ecom") == 2
+        # the busy engine's rung grew; the idle one's never left 1
+        assert s.rung("reco") > s.rung("ecom")
+        assert s.rung("ecom") == 1
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# load shedding
+# ---------------------------------------------------------------------------
+
+class _GatedHandler:
+    """Handler whose first call advances the fake clock (planting the
+    EWMA dispatch wall) and whose later calls block on a gate."""
+
+    def __init__(self, clock, wall_s):
+        self.clock = clock
+        self.wall_s = wall_s
+        self.gate = threading.Event()
+        self.in_handler = threading.Event()
+        self.calls = 0
+
+    def __call__(self, bodies):
+        self.calls += 1
+        if self.calls == 1:
+            self.clock.advance(self.wall_s)  # plants ewma_wall
+        else:
+            self.in_handler.set()
+            self.gate.wait(10)
+        return bodies
+
+
+def test_shed_then_recover_flip():
+    clock = FakeClock()
+    handler = _GatedHandler(clock, wall_s=0.2)
+    s = BatchScheduler(handler, 4, clock=clock, shed=True, slo_s=0.5,
+                       p99_fn=lambda: 0.1, wait_bound_s=0.0)
+    try:
+        # dispatch one to plant ewma_wall=0.2
+        s.submit(b"w").result(10)
+        # block the dispatcher with an in-flight singleton
+        inflight = s.submit(b"0")
+        assert handler.in_handler.wait(5)
+        # queue depth grows; projection = (cycles + in-flight)·0.2 +
+        # p99 0.1 against slo 0.5: with cap 4, depth 4 → 1 cycle →
+        # (1+1)·0.2 + 0.1 = 0.5, NOT > slo; depth 5 → 2 cycles → 0.7 →
+        # SHED. So 4 queued admit, the 5th sheds.
+        admitted = [s.submit(b"%d" % i) for i in range(4)]
+        shed = s.submit(b"last")
+        assert shed.done()
+        with pytest.raises(ShedError) as ei:
+            shed.result()
+        assert ei.value.status == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert ei.value.reason == "overload"
+        # recovery: the queue drains, projections fall, admission resumes
+        handler.gate.set()
+        _drain([inflight] + admitted)
+        ok = s.submit(b"again")
+        assert ok.result(10) == b"again"
+        assert s.shed_count == 1
+    finally:
+        s.stop()
+
+
+def test_priority_evicts_lowest_not_highest():
+    clock = FakeClock()
+    handler = _GatedHandler(clock, wall_s=0.2)
+    s = BatchScheduler(handler, 4, clock=clock, shed=True, slo_s=0.5,
+                       p99_fn=lambda: 0.1, wait_bound_s=0.0)
+    try:
+        s.submit(b"w").result(10)
+        inflight = s.submit(b"0")
+        assert handler.in_handler.wait(5)
+        low = [s.submit(b"%d" % i, priority=0) for i in range(4)]
+        # overload point reached — a HIGHER-priority arrival evicts the
+        # lowest-priority waiter instead of shedding itself
+        vip = s.submit(b"vip", priority=5)
+        assert not vip.done()
+        evicted = [f for f in low if f.done()]
+        assert len(evicted) == 1
+        with pytest.raises(ShedError) as ei:
+            evicted[0].result()
+        assert ei.value.reason == "evicted"
+        # an equal-priority arrival at the same depth sheds itself
+        shed = s.submit(b"eq", priority=0)
+        with pytest.raises(ShedError):
+            shed.result()
+        handler.gate.set()
+        _drain([inflight, vip] + [f for f in low if f is not evicted[0]])
+        assert s.shed_count == 2
+    finally:
+        s.stop()
+
+
+def test_cold_queue_never_sheds():
+    """No EWMA evidence (no dispatch yet) → no shedding, whatever the
+    depth: admission control must never fire on a cold start."""
+    gate = threading.Event()
+
+    def handler(bodies):
+        gate.wait(10)
+        return bodies
+
+    s = BatchScheduler(handler, 4, shed=True, slo_s=0.01,
+                       p99_fn=lambda: 10.0, wait_bound_s=0.0)
+    try:
+        futs = [s.submit(b"%d" % i) for i in range(20)]
+        assert not any(f.done() and f.exception() for f in futs)
+        gate.set()
+        _drain(futs)
+        assert s.shed_count == 0
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero steady-state recompiles (real jit ladder)
+# ---------------------------------------------------------------------------
+
+def test_warm_ladder_serves_with_zero_recompiles():
+    """Once every pow2 rung the scheduler can pick has compiled, any
+    mixture of live batch widths serves entirely from the jit cache —
+    the serving twin of foldin_compile_cache_size's pin."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops import topk
+
+    uf = jnp.asarray(np.random.default_rng(0).normal(
+        size=(64, 8)).astype(np.float32))
+    itf = jnp.asarray(np.random.default_rng(1).normal(
+        size=(48, 8)).astype(np.float32))
+
+    def handler(bodies):
+        rows = [int(b) % 64 for b in bodies]
+        out = topk.batch_score_top_k(uf, itf, rows, k=8)
+        assert out.shape[1] >= len(bodies)
+        return bodies
+
+    cap = 16
+    # warm every pow2 ladder rung directly — exactly what the deploy-
+    # time warmup hook (Algorithm.warmup) compiles before traffic lands
+    for rung in topk.ladder_rungs(cap):
+        handler([b"%d" % i for i in range(rung)])
+    warm = topk.serve_compile_cache_size()
+    assert warm > 0
+    s = BatchScheduler(handler, cap, shed=False, wait_bound_s=0.0)
+    try:
+        # steady state through the scheduler: arbitrary live widths,
+        # every one padding onto an already-compiled rung
+        for width in (3, 7, 11, 16, 5, 13):
+            futs = [s.submit(b"%d" % i) for i in range(width)]
+            _drain(futs)
+        assert topk.serve_compile_cache_size() == warm, \
+            "steady-state serving recompiled"
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_batch_size_and_queue_wait_booked():
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+    size_h = obs_metrics.REGISTRY.get("pio_serve_batch_size")
+    wait_h = obs_metrics.REGISTRY.get("pio_serve_queue_wait_seconds")
+    assert size_h is not None and wait_h is not None
+    _n0, t0 = size_h.cumulative_below(float("inf"))
+    _w0, w0 = wait_h.cumulative_below(float("inf"))
+
+    s = BatchScheduler(lambda bodies: bodies, 8, shed=False)
+    try:
+        _drain([s.submit(b"x") for _ in range(5)])
+    finally:
+        s.stop()
+    _n1, t1 = size_h.cumulative_below(float("inf"))
+    _w1, w1 = wait_h.cumulative_below(float("inf"))
+    assert t1 > t0          # ≥1 dispatch booked its fused width
+    assert w1 - w0 == 5     # every query booked its queue wait

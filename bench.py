@@ -1153,6 +1153,154 @@ def bench_shard(budget_s: float) -> dict:
     return out
 
 
+#: two-stage MIPS serving leg (docs/performance.md "Two-stage MIPS
+#: serving"): exhaustive-vs-two-stage per-query device wall and the
+#: candidates-scanned fraction on the planted large catalogue, plus the
+#: recall@20-vs-exact gate figure. ``mips_sweep`` carries the whole
+#: {27k, 256k, 1M} size ladder; the scalar keys are the GATE size (the
+#: largest completed ≥ 128k, where the two-stage win must hold). None =
+#: the leg's designed deadline-skip (same contract as shard_*/fleet_*).
+MIPS_KEYS = (
+    "mips_items", "mips_build_s", "mips_exhaustive_per_query_ms",
+    "mips_exhaustive_p99_ms", "mips_two_stage_per_query_ms",
+    "mips_two_stage_p99_ms", "mips_speedup", "mips_candidates_frac",
+    "mips_recall_at_20", "mips_recompiles_steady", "mips_serve_qps",
+    "mips_exhaustive_27k_p99_ms", "mips_sweep",
+)
+
+
+def bench_mips(budget_s: float) -> dict:
+    """Planted-catalogue MIPS leg, in-process (single device suffices —
+    the sharded merge is pinned by tier-1 tests/test_mips.py at mesh
+    {1,2,4,8}). Per size: build the index, measure exhaustive and
+    two-stage per-query walls through the REAL ops/topk auto-router
+    (PIO_SERVE_MIPS=off vs =on), the recall@20 against the exhaustive
+    oracle, and the steady-state recompile count. Budget-guarded like
+    bench_shard: any failure or deadline squeeze nulls keys, never the
+    record."""
+    out = dict.fromkeys(MIPS_KEYS)
+    if budget_s < 45.0:
+        log("mips leg skipped: bench deadline too close")
+        return out
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops import mips as mips_mod
+    from incubator_predictionio_tpu.ops import topk
+    from incubator_predictionio_tpu.utils.planted import (
+        exhaustive_top_k,
+        planted_item_factors,
+        planted_queries,
+        recall_against_oracle,
+    )
+
+    sizes = [int(s) for s in os.environ.get(
+        "PIO_BENCH_MIPS_ITEMS", "27000,262144,1048576").split(",") if s]
+    rank = int(os.environ.get("PIO_BENCH_MIPS_RANK", "64"))
+    n_q = int(os.environ.get("PIO_BENCH_MIPS_QUERIES", "32"))
+    leg_deadline = time.monotonic() + min(
+        budget_s - 15.0,
+        float(os.environ.get("PIO_BENCH_MIPS_TIMEOUT_S", "300")))
+    prev_mode = os.environ.get("PIO_SERVE_MIPS")
+
+    def _restore_mode() -> None:
+        if prev_mode is None:
+            os.environ.pop("PIO_SERVE_MIPS", None)
+        else:
+            os.environ["PIO_SERVE_MIPS"] = prev_mode
+
+    def _per_query_ms(queries) -> tuple:
+        """(p50, p99) wall over the real router, one fetch per query."""
+        np.asarray(topk.score_and_top_k(queries[0], table, k=20))  # warm
+        walls = []
+        for q in queries:
+            t0 = time.perf_counter()
+            np.asarray(topk.score_and_top_k(q, table, k=20))
+            walls.append((time.perf_counter() - t0) * 1e3)
+        walls = np.asarray(walls)
+        return (float(np.quantile(walls, 0.5)),
+                float(np.quantile(walls, 0.99)))
+
+    sweep: dict = {}
+    try:
+        for n_items in sizes:
+            # rough leg cost model (measured on the CI box): build +
+            # queries scale ~linearly with the catalogue
+            est_s = 8.0 + 30.0 * n_items / 262144.0
+            if time.monotonic() + est_s * 1.3 > leg_deadline:
+                log(f"mips leg: skipping {n_items} items "
+                    "(deadline too close)")
+                break
+            vf = planted_item_factors(n_items, rank, seed=11)
+            queries = [jnp.asarray(q) for q in
+                       planted_queries(vf, n_q, seed=5)]
+            oracle = exhaustive_top_k(
+                vf, np.stack([np.asarray(q) for q in queries]), 20)
+            table = jax.device_put(vf)
+            os.environ["PIO_SERVE_MIPS"] = "off"
+            ex_p50, ex_p99 = _per_query_ms(queries)
+            t0 = time.perf_counter()
+            index = mips_mod.build_index(table, n_items, seed=11,
+                                         host_factors=vf)
+            build_s = time.perf_counter() - t0
+            os.environ["PIO_SERVE_MIPS"] = "on"
+            two_p50, two_p99 = _per_query_ms(queries)
+            # steady state: repeat the warmed shapes — the compile
+            # cache must not move (the pow2-ladder contract)
+            cache0 = topk.serve_compile_cache_size()
+            got = np.stack([
+                np.asarray(topk.score_and_top_k(q, table, k=20))[1]
+                .astype(np.int64) for q in queries])
+            recompiles = topk.serve_compile_cache_size() - cache0
+            recall, _worst = recall_against_oracle(got, oracle, 20)
+            _nprobe, coarse, rerank = mips_mod.scan_budget(index, 20)
+            frac = (coarse + rerank) / n_items
+            mips_mod.recall_probe(table, index, host_factors=vf)
+            sweep[str(n_items)] = {
+                "exhaustive_p50_ms": round(ex_p50, 3),
+                "exhaustive_p99_ms": round(ex_p99, 3),
+                "two_stage_p50_ms": round(two_p50, 3),
+                "two_stage_p99_ms": round(two_p99, 3),
+                "build_s": round(build_s, 2),
+                "candidates_frac": round(frac, 4),
+                "recall_at_20": round(recall, 4),
+                "recompiles_steady": int(recompiles),
+            }
+            log(f"mips {n_items}: exhaustive {ex_p50:.2f}ms vs "
+                f"two-stage {two_p50:.2f}ms (recall {recall:.3f}, "
+                f"frac {frac:.3f}, build {build_s:.1f}s)")
+            if n_items <= 32768:
+                out["mips_exhaustive_27k_p99_ms"] = round(ex_p99, 3)
+            mips_mod.unregister_index(table)
+            del table, vf, queries, index
+    finally:
+        _restore_mode()
+    gate_sizes = [int(s) for s in sweep if int(s) >= 131072]
+    if gate_sizes:
+        gate = sweep[str(max(gate_sizes))]
+        out.update({
+            "mips_items": max(gate_sizes),
+            "mips_build_s": gate["build_s"],
+            "mips_exhaustive_per_query_ms": gate["exhaustive_p50_ms"],
+            "mips_exhaustive_p99_ms": gate["exhaustive_p99_ms"],
+            "mips_two_stage_per_query_ms": gate["two_stage_p50_ms"],
+            "mips_two_stage_p99_ms": gate["two_stage_p99_ms"],
+            "mips_speedup": round(
+                gate["exhaustive_p50_ms"]
+                / max(gate["two_stage_p50_ms"], 1e-9), 3),
+            "mips_candidates_frac": gate["candidates_frac"],
+            "mips_recall_at_20": gate["recall_at_20"],
+            "mips_recompiles_steady": gate["recompiles_steady"],
+            # the capacity model's device-bound QPS projection
+            # (obs/capacity.py qps_source_key="mips_serve_qps")
+            "mips_serve_qps": round(
+                1000.0 / max(gate["two_stage_p50_ms"], 1e-9), 1),
+        })
+    if sweep:
+        out["mips_sweep"] = sweep
+    return out
+
+
 #: serving-fleet leg (docs/production.md "Serving fleet"): the
 #: continuous-batching request plane measured across REAL worker
 #: processes — goodput burst (real kernels, no floor) for the capacity
@@ -2152,6 +2300,7 @@ def run_orchestrator() -> None:
         # mesh-sharded training leg (parent-side subprocess on the
         # forced-host-device CPU sim; docs/performance.md "Sharded ALS")
         **dict.fromkeys(SHARD_KEYS),
+        **dict.fromkeys(MIPS_KEYS),
         # serving-fleet leg (parent-side worker subprocesses;
         # docs/production.md "Serving fleet")
         **dict.fromkeys(FLEET_KEYS),
@@ -2278,6 +2427,13 @@ def run_orchestrator() -> None:
         record.update(bench_fleet(emit_by - time.monotonic()))
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"fleet leg failed ({e!r}); fleet_* keys null this round")
+
+    # -- 6e. TWO-STAGE MIPS SERVING LEG (in-process; planted catalogue
+    #        past ML-20M scale, exhaustive stays the oracle) ---------------
+    try:
+        record.update(bench_mips(emit_by - time.monotonic()))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"mips leg failed ({e!r}); mips_* keys null this round")
 
     # -- 4/5/7. TRAIN + ATTENTION + SERVE: supervised TPU child ------------
     # (started after the host stages so parent CPU load never perturbs the

@@ -1047,6 +1047,66 @@ class UnbatchedDispatch(Rule):
                     "the baseline)")
 
 
+# ---------------------------------------------------------------------------
+# 16. exhaustive full-table scans that bypass the MIPS auto-router
+# ---------------------------------------------------------------------------
+
+#: scoring entries BELOW the auto-router seam: calling one of these
+#: directly pins the query to the exhaustive full-table scan even when
+#: a two-stage MIPS index is registered (ops/mips.py). The public
+#: routers (score_and_top_k / score_user_and_top_k / batch_score_top_k)
+#: are the sanctioned entries — they fall back to exhaustive themselves
+#: when the index/mode says so.
+_EXHAUSTIVE_BYPASS = {
+    "_score_and_top_k_xla", "_score_user_top_k_xla",
+    "_batch_score_top_k_xla", "score_and_top_k_pallas",
+    "sharded_top_k", "top_k_with_exclusions",
+}
+
+
+class ExhaustiveScan(Rule):
+    name = "exhaustive-scan"
+    severity = "warning"
+    doc = ("direct full-table scoring call in a server/serving module "
+           "(servers/*.py, serving/*.py) below the MIPS auto-router "
+           "seam — sharded_top_k / top_k_with_exclusions / the private "
+           "XLA+Pallas scoring entries, or a raw jax.lax.top_k over "
+           "catalogue scores. These pin the query to the exhaustive "
+           "scan even when a registered two-stage index (ops/mips.py) "
+           "could serve it at a fraction of the device wall; route "
+           "through score_and_top_k / score_user_and_top_k / "
+           "batch_score_top_k, which auto-route and keep exhaustive as "
+           "the fallback")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        rel = f"/{mod.relpath}"
+        if "/servers/" not in rel and "/serving/" not in rel:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            rname = mod.resolved(node.func) or ""
+            tail = rname.rsplit(".", 1)[-1] if rname else ""
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else (node.func.id
+                          if isinstance(node.func, ast.Name) else ""))
+            if tail in _EXHAUSTIVE_BYPASS or attr in _EXHAUSTIVE_BYPASS:
+                what = rname or attr
+                yield mod.finding(
+                    self, node,
+                    f"`{what}()` scores the FULL catalogue from a "
+                    "server/serving module, bypassing the MIPS "
+                    "auto-router — use the ops/topk router entries so "
+                    "a registered two-stage index can serve the query")
+            elif rname == "jax.lax.top_k":
+                yield mod.finding(
+                    self, node,
+                    "raw `jax.lax.top_k()` in a server/serving module "
+                    "— full-score ranking belongs behind the ops/topk "
+                    "auto-routers (exhaustive stays their fallback)")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -1063,6 +1123,7 @@ ALL_RULES: Sequence[Rule] = (
     HostGatherInMesh(),
     MetricLabelCardinality(),
     UnbatchedDispatch(),
+    ExhaustiveScan(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -411,7 +411,9 @@ class ALSAlgorithm(Algorithm):
             "ALS trained: %d users × %d items, rank %d",
             n_users, n_items, self.params.rank,
         )
-        return self._assemble_model(pd, state)
+        model = self._assemble_model(pd, state)
+        self._refresh_mips_index(model)
+        return model
 
     def train_with_previous(
         self, ctx: RuntimeContext, pd: PreparedData, prev_model: Any
@@ -450,7 +452,10 @@ class ALSAlgorithm(Algorithm):
             "%s sweeps (mode=%s, delta=%.3e)", n_users, n_items,
             self.params.rank, stats.get("sweeps_used"),
             stats.get("mode"), stats.get("final_delta", float("nan")))
-        return self._assemble_model(pd, state)
+        model = self._assemble_model(pd, state)
+        self._refresh_mips_index(model, prev_model=prev_model,
+                                 retrain_stats=stats)
+        return model
 
     def _continuation_seed(self, pd: PreparedData, prev_model: Any):
         """Prior factors as an (ungrown) ALSState, or None when they
@@ -497,6 +502,9 @@ class ALSAlgorithm(Algorithm):
             warm_host_arrays,
         )
 
+        from incubator_predictionio_tpu.ops import mips
+
+        prev_table = model.item_factors
         np_users = np.asarray(model.user_factors)
         np_items = np.asarray(model.item_factors)
         model = dataclasses.replace(
@@ -508,7 +516,51 @@ class ALSAlgorithm(Algorithm):
         # sites) — the first query never pays a device→host factor fetch
         warm_host_arrays(
             model, user_factors=np_users, item_factors=np_items)
+        # deploy-time MIPS index: a just-trained-in-this-process model
+        # already carries one — ADOPT it onto the re-device_put table
+        # (same values, new object) instead of paying a second full
+        # build; disk-restored models build fresh from the host copy
+        # already in hand
+        if mips.adopt_index(prev_table, model.item_factors) is None:
+            self._refresh_mips_index(model, host_factors=np_items)
         return model
+
+    def _refresh_mips_index(self, model: ALSModel, prev_model=None,
+                            retrain_stats=None,
+                            host_factors=None) -> None:
+        """Keep the two-stage MIPS serving index (ops/mips.py) riding
+        the model's item table: O(delta) splice on a plan-reusing
+        continuation retrain (only the touched rows re-quantize and
+        re-home), full rebuild otherwise. Gated by PIO_SERVE_MIPS +
+        the auto-mode catalogue floor; never fatal — exhaustive
+        serving is always a correct fallback."""
+        from incubator_predictionio_tpu.ops import mips
+
+        n_items = len(model.item_bimap)
+        if not mips.build_enabled(n_items):
+            return
+        try:
+            if prev_model is not None and retrain_stats is not None:
+                touched = retrain_stats.get("touched_item_rows")
+                if touched is not None and mips.update_index(
+                        prev_model.item_factors, model.item_factors,
+                        n_items, touched) is not None:
+                    # the splice only re-quantizes the delta rows while
+                    # a retrain nudges EVERY factor row — re-probe so
+                    # pio_serve_mips_recall reads the post-splice truth
+                    # (the runbook's recall-sag trigger). The probe's
+                    # one table fetch + tiny host oracle is O(I·K),
+                    # bounded by the retrain that triggered it (each
+                    # ALS sweep already streams ≥ nnz·K ≫ I·K).
+                    mips.recall_probe(model.item_factors)
+                    return
+            mips.build_index(model.item_factors, n_items,
+                             seed=self.params.seed or 0,
+                             host_factors=host_factors,
+                             probe_recall=True)
+        except Exception:  # index is an optimization, never a failure
+            logger.exception("MIPS index build failed; serving stays "
+                             "exhaustive")
 
     # -- speed layer -------------------------------------------------------
     def make_speed_overlay(self, model: ALSModel, app_name, channel_name,
@@ -627,34 +679,23 @@ class ALSAlgorithm(Algorithm):
             top_s, top_i = host_top_k(scores, k, allowed_mask=mask)
             packed = np.stack([top_s, top_i.astype(np.float64)])
         elif ov_vec is not None:
-            from incubator_predictionio_tpu.ops.topk import score_and_top_k
+            from incubator_predictionio_tpu.ops.topk import (
+                pad_exclude,
+                score_and_top_k,
+            )
 
-            exclude = None
-            if seen is not None:
-                from incubator_predictionio_tpu.ops.topk import next_pow2
-
-                width = next_pow2(len(seen))
-                exclude = np.full(width, -1, np.int32)
-                exclude[:len(seen)] = seen
-                exclude = jnp.asarray(exclude)
+            exclude = pad_exclude(seen) if seen is not None else None
             packed = np.asarray(score_and_top_k(
                 jnp.asarray(np.asarray(ov_vec, np.float32)),
                 model.item_factors, k=k, exclude=exclude,
                 allowed_mask=None if mask is None else jnp.asarray(mask),
             ))
         else:
-            exclude = None
-            if seen is not None:
-                # pad to the next power of two (-1 = no-op slots) so the
-                # jitted serve call compiles O(log max-seen) times total
-                from incubator_predictionio_tpu.ops.topk import (
-                    next_pow2,
-                )
+            from incubator_predictionio_tpu.ops.topk import pad_exclude
 
-                width = next_pow2(len(seen))
-                exclude = np.full(width, -1, np.int32)
-                exclude[:len(seen)] = seen
-                exclude = jnp.asarray(exclude)
+            # pow2-padded (-1 = no-op slots) so the jitted serve call
+            # compiles O(log max-seen) times total
+            exclude = pad_exclude(seen) if seen is not None else None
             packed = np.asarray(score_user_and_top_k(  # ONE dispatch+fetch
                 model.user_factors,
                 model.item_factors,

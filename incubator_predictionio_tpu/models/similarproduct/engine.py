@@ -246,13 +246,35 @@ class SimilarProductAlgorithm(Algorithm):
         factors = state.item_factors
         norm = jnp.linalg.norm(factors, axis=1, keepdims=True)
         factors_norm = factors / jnp.maximum(norm, 1e-9)
-        return SimilarProductModel(
+        model = SimilarProductModel(
             item_factors_norm=factors_norm,
             item_bimap=pd.item_bimap,
             item_categories=pd.item_categories,
             user_factors=np.asarray(state.user_factors),
             user_bimap=pd.user_bimap,
         )
+        self._refresh_mips_index(model)
+        return model
+
+    def _refresh_mips_index(self, model: SimilarProductModel) -> None:
+        """Two-stage MIPS index over the UNIT-NORMALIZED serving table
+        (ops/mips.py) — cosine ranking is inner product on this table,
+        so the same coarse-scan + exact-rerank path serves it. Always a
+        full rebuild: normalization rescales every row each retrain, so
+        there is no O(delta) splice to keep honest here. Gated by
+        PIO_SERVE_MIPS; never fatal."""
+        from incubator_predictionio_tpu.ops import mips
+
+        n_items = len(model.item_bimap)
+        if not mips.build_enabled(n_items):
+            return
+        try:
+            mips.build_index(model.item_factors_norm, n_items,
+                             seed=self.params.seed or 0,
+                             probe_recall=True)
+        except Exception:  # index is an optimization, never a failure
+            logger.exception("MIPS index build failed; similarproduct "
+                             "serving stays exhaustive")
 
     def train_with_previous(
         self, ctx: RuntimeContext, pd: PreparedData, prev_model: Any
@@ -306,23 +328,35 @@ class SimilarProductAlgorithm(Algorithm):
                     stats.get("mode"))
         factors = state.item_factors
         norm = jnp.linalg.norm(factors, axis=1, keepdims=True)
-        return SimilarProductModel(
+        model = SimilarProductModel(
             item_factors_norm=factors / jnp.maximum(norm, 1e-9),
             item_bimap=pd.item_bimap,
             item_categories=pd.item_categories,
             user_factors=np.asarray(state.user_factors),
             user_bimap=pd.user_bimap,
         )
+        self._refresh_mips_index(model)
+        return model
 
     def prepare_model(self, ctx, model: SimilarProductModel) -> SimilarProductModel:
         import jax
 
-        return dataclasses.replace(
+        from incubator_predictionio_tpu.ops import mips
+
+        prev_table = model.item_factors_norm
+        model = dataclasses.replace(
             model,
             item_factors_norm=jax.device_put(
                 np.asarray(model.item_factors_norm)
             ),
         )
+        # deploy-time index: adopt a just-trained one onto the
+        # re-device_put table (same values, new object); restored
+        # models build fresh
+        if mips.adopt_index(prev_table,
+                            model.item_factors_norm) is None:
+            self._refresh_mips_index(model)
+        return model
 
     def make_speed_overlay(self, model: SimilarProductModel, app_name,
                            channel_name, data_source_params=None):
@@ -348,6 +382,36 @@ class SimilarProductAlgorithm(Algorithm):
             n = float(np.linalg.norm(vec))
             return vec / max(n, 1e-9)
 
+        item_bimap = model.item_bimap
+        serving_table = getattr(model, "item_factors_norm", None)
+        #: virtual tail id <-> item key, for results the base bimap has
+        #: never heard of (brand-new items published by the overlay);
+        #: the by-key direction excludes a query item's own tail entry
+        virtual_ids = self._mips_virtual_ids = {}
+        virtual_by_key = self._mips_virtual_by_key = {}
+
+        def index_sink(keys, vecs):
+            # two-stage MIPS seam: item-side fold-ins enter the serving
+            # index the moment they publish — known rows re-quantize in
+            # place + override exactly via the tail, unknown (brand-new)
+            # items ride the tail under virtual ids until the next
+            # rebuild folds them in (predict resolves them through
+            # _mips_virtual_ids). No-op unless an index is registered
+            # for the serving table.
+            from incubator_predictionio_tpu.ops import mips
+
+            if (serving_table is None
+                    or mips.index_for(serving_table) is None):
+                return
+            rows = [item_bimap.get(k, -1) for k in keys]
+            gids = mips.publish_rows(serving_table, np.stack(vecs),
+                                     rows=rows)
+            if gids is not None:
+                for key, row, gid in zip(keys, rows, gids):
+                    if row < 0:
+                        virtual_ids[int(gid)] = key
+                        virtual_by_key[key] = int(gid)
+
         return SpeedOverlay(
             SpeedOverlayConfig(
                 app_name=app_name, channel_name=channel_name,
@@ -363,6 +427,7 @@ class SimilarProductAlgorithm(Algorithm):
             other_factors=np.asarray(user_factors),
             other_index=user_bimap,
             key_index=model.item_bimap,
+            index_sink=index_sink,
         )
 
     def _allowed_mask(self, model: SimilarProductModel,
@@ -441,7 +506,8 @@ class SimilarProductAlgorithm(Algorithm):
             import jax.numpy as jnp
 
             from incubator_predictionio_tpu.ops.topk import (
-                top_k_with_exclusions,
+                pad_exclude,
+                score_and_top_k,
             )
 
             factors = jnp.asarray(model.item_factors_norm)
@@ -456,16 +522,44 @@ class SimilarProductAlgorithm(Algorithm):
             query_vec = query_vec / (len(indices) + len(extra_vecs))
             qnorm = jnp.linalg.norm(query_vec)
             query_vec = query_vec / jnp.maximum(qnorm, 1e-9)
-            scores = factors @ query_vec  # cosine (pre-normalized factors)
-            top_s, top_i = top_k_with_exclusions(
-                scores, k=k, allowed_mask=jnp.asarray(mask),
-            )
+            # cosine ranking through the top-k AUTO-ROUTER (the
+            # pre-normalized table makes it an inner product): plain
+            # queries express the query-item exclusion as a pow2-padded
+            # id list so a registered two-stage MIPS index can serve
+            # them; filtered queries keep the mask (→ exhaustive, the
+            # router's designed fallback)
+            if (query.categories or query.white_list
+                    or query.black_list):
+                packed = np.asarray(score_and_top_k(
+                    query_vec, factors, k=k,
+                    allowed_mask=jnp.asarray(mask)))
+            else:
+                virtual_by_key = getattr(self, "_mips_virtual_by_key",
+                                         None) or {}
+                # query items exclude by id — base rows AND the virtual
+                # tail ids of overlay-published query items (else a
+                # just-folded item comes back as its own best match)
+                seen = [model.item_bimap[i] for i in query.items
+                        if i in model.item_bimap]
+                seen += [virtual_by_key[i] for i in query.items
+                         if i in virtual_by_key]
+                packed = np.asarray(score_and_top_k(
+                    query_vec, factors, k=k, exclude=pad_exclude(seen)))
+            top_s, top_i = packed[0], packed[1]
         inv = model.item_bimap.inverse
+        n_known = len(model.item_bimap)
+        virtual_ids = getattr(self, "_mips_virtual_ids", None) or {}
         out = []
         for s, i in zip(np.asarray(top_s), np.asarray(top_i)):
             if s <= -1e37:
                 continue
-            out.append(ItemScore(item=inv[int(i)], score=float(s)))
+            # ids past the base bimap are overlay-published brand-new
+            # items served from the index's exact tail
+            item = (inv[int(i)] if int(i) < n_known
+                    else virtual_ids.get(int(i)))
+            if item is None:
+                continue
+            out.append(ItemScore(item=item, score=float(s)))
         return PredictedResult(item_scores=tuple(out))
 
 

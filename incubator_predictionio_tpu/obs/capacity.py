@@ -278,6 +278,7 @@ def fit_capacity(records: Sequence[NormalizedRecord],
         "mfu": None,
         "shard": None,
         "fleet": None,
+        "mips": None,
         "projections": {},
     }
     benches = [r for r in records if r.kind == "bench"
@@ -302,15 +303,42 @@ def fit_capacity(records: Sequence[NormalizedRecord],
             # N worker processes — the figure a fleet is actually sized
             # from); the single-process serve_qps_concurrent remains
             # the fallback for records predating the leg
+            # measured goodput first (fleet leg, then the single-
+            # process concurrent rate); the two-stage MIPS device
+            # bound (1000 / per-query wall at the 256k planted
+            # catalogue) is the projection of last resort — a device
+            # ceiling, not a measured worker rate, and qps_source_key
+            # says so
             fleet_qps = _num(rec.parsed, "fleet_qps_per_worker")
-            qps = fleet_qps or _num(rec.parsed, "serve_qps_concurrent")
+            qps = (fleet_qps
+                   or _num(rec.parsed, "serve_qps_concurrent")
+                   or _num(rec.parsed, "mips_serve_qps"))
             if qps and qps > 0:
                 out["qps_per_worker"] = round(qps, 1)
                 out["qps_source_record"] = rec.name
                 out["qps_source_key"] = (
                     "fleet_qps_per_worker" if fleet_qps
-                    else "serve_qps_concurrent")
+                    else ("serve_qps_concurrent"
+                          if _num(rec.parsed, "serve_qps_concurrent")
+                          else "mips_serve_qps"))
                 out["serve_p99_ms"] = _num(rec.parsed, "serve_p99_ms")
+        if out.get("mips") is None and not rec.degraded:
+            mq = _num(rec.parsed, "mips_two_stage_per_query_ms")
+            if mq:
+                out["mips"] = {
+                    "source_record": rec.name,
+                    "items": _num(rec.parsed, "mips_items"),
+                    "two_stage_per_query_ms": mq,
+                    "exhaustive_per_query_ms": _num(
+                        rec.parsed, "mips_exhaustive_per_query_ms"),
+                    "speedup": _num(rec.parsed, "mips_speedup"),
+                    "candidates_frac": _num(
+                        rec.parsed, "mips_candidates_frac"),
+                    "recall_at_20": _num(
+                        rec.parsed, "mips_recall_at_20"),
+                    "serve_qps_bound": _num(
+                        rec.parsed, "mips_serve_qps"),
+                }
         # same degraded-round guard as the qps fit above: a degraded
         # round's fleet leg ran on a box no production worker resembles
         if out.get("fleet") is None and not rec.degraded:

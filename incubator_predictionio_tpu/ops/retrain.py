@@ -638,6 +638,11 @@ def _ring_sides_with_reuse(
             if any(p is not None for p in prebuilt.values()):
                 stats["prep_plan"] = "ring-reused"
                 stats["prep_delta_rows"] = int(nnz - plan.nnz)
+                # the O(delta) seam of the serving MIPS index
+                # (ops/mips.update_index): exactly the factor rows
+                # whose interactions changed this retrain
+                stats["touched_item_rows"] = np.unique(
+                    np.asarray(items[plan.nnz:], np.int64))
         if stats.get("prep_plan") != "ring-reused":
             _RING_CACHE.pop(plan_key, None)
             stats["prep_plan"] = "ring-fresh"
@@ -740,6 +745,12 @@ def prepare_with_reuse(
                 plan.digest = _coo_digest(users, items, vals, nnz)
                 stats["prep_plan"] = "reused"
                 stats["prep_delta_rows"] = int(len(tr))
+                # the O(delta) seam of the serving MIPS index
+                # (ops/mips.update_index): rows whose interactions the
+                # tail touched re-quantize/re-assign, everything else
+                # keeps its bucket
+                stats["touched_item_rows"] = np.unique(
+                    np.asarray(tc, np.int64))
                 if defer_splice:
                     u_pend = plan.user.pending or []
                     i_pend = plan.item.pending or []

@@ -13,10 +13,13 @@ from __future__ import annotations
 import functools
 from typing import Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
 from incubator_predictionio_tpu.obs import profile as _profile
+from incubator_predictionio_tpu.ops import mips as _mips
 
 NEG_INF = jnp.float32(-3.4e38)
 
@@ -230,6 +233,19 @@ def score_user_and_top_k(
         is_distributed,
     )
 
+    # auto-route: a registered MIPS index serves the query two-stage
+    # (coarse bucket scan + exact rerank, ops/mips.py) unless the mode,
+    # a filter mask, or the catalogue size says exhaustive; exhaustive
+    # stays the fallback AND the recall oracle (valid_items is moot on
+    # the MIPS path — buckets only ever hold true rows)
+    mips_index = _mips.route(item_factors, k=k,
+                             allowed_mask=allowed_mask, exclude=exclude)
+    if mips_index is not None:
+        return _mips.mips_score_user_and_top_k(
+            user_factors, item_factors, mips_index, user_idx, k,
+            exclude=exclude)
+    _mips.book_exhaustive(int(item_factors.shape[0]))
+
     if is_distributed(item_factors):
         return sharded_top_k((user_factors, user_idx), item_factors, k,
                              exclude=exclude, allowed_mask=allowed_mask,
@@ -286,6 +302,21 @@ def next_pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
 
 
+def pad_exclude(ids) -> Optional[jax.Array]:
+    """Exclusion ids → pow2-padded int32 device array (-1 = no-op
+    slots), or None for an empty list — THE serve-time exclusion
+    shape. One copy of the padding policy: it bounds the jitted serve
+    variants to O(log max-seen) compiles, so every call site must pad
+    by the same rule."""
+    ids = list(ids)
+    if not ids:
+        return None
+    width = next_pow2(len(ids))
+    out = np.full(width, -1, np.int32)
+    out[:len(ids)] = ids
+    return jnp.asarray(out)
+
+
 def ladder_rungs(cap: int) -> Tuple[int, ...]:
     """The pow2 batch-width ladder up to ``cap`` — exactly the shapes
     :func:`batch_score_top_k` can dispatch (its ``B`` pads to the next
@@ -309,7 +340,7 @@ def serve_compile_cache_size() -> int:
         for fn in (top_k_with_exclusions, _score_and_top_k_xla,
                    _score_user_top_k_xla, _batch_score_top_k_xla,
                    _sharded_topk_jit)
-    )
+    ) + _mips.mips_compile_cache_size()
 
 
 def batch_score_top_k(
@@ -346,6 +377,15 @@ def batch_score_top_k(
     if pad > B:
         rows_np = np.concatenate(
             [rows_np, np.full(pad - B, rows_np[0], np.int32)])
+    # the scheduler's fused dispatch rides the same MIPS auto-route as
+    # the per-query paths (padded rows keep the pow2 ladder; the
+    # two-stage stage widths are static, so steady state still never
+    # recompiles)
+    mips_index = _mips.route(item_factors, k=k_pad)
+    if mips_index is not None:
+        return _mips.mips_batch_score_top_k(
+            user_factors, item_factors, mips_index, rows_np, k_pad)
+    _mips.book_exhaustive(int(pad) * int(item_factors.shape[0]))
     _pt0 = _profile.t0()  # None on the PIO_PROFILE=0 default hot path
     out = _batch_score_top_k_xla(user_factors, item_factors,
                                  jnp.asarray(rows_np), k_pad,
@@ -377,6 +417,16 @@ def score_and_top_k(
     from incubator_predictionio_tpu.parallel.placement import (
         is_distributed,
     )
+
+    # auto-route to the two-stage MIPS path (ops/mips.py) when an index
+    # is registered for this table; filters/off/small catalogues keep
+    # the exhaustive path below, which is also the recall oracle
+    mips_index = _mips.route(item_factors, k=k,
+                             allowed_mask=allowed_mask, exclude=exclude)
+    if mips_index is not None:
+        return _mips.mips_score_and_top_k(
+            user_vector, item_factors, mips_index, k, exclude=exclude)
+    _mips.book_exhaustive(int(item_factors.shape[0]))
 
     if is_distributed(item_factors):
         # placed serving: per-shard partial top-k + all-gather merge

@@ -123,8 +123,17 @@ class SpeedOverlay:
         other_index,                   # id -> column index (BiMap/dict)
         key_index=None,                # id -> row index of the KEY side
         clock: Optional[Callable[[], float]] = None,
+        index_sink: Optional[
+            Callable[[List[str], List[np.ndarray]], None]] = None,
     ) -> None:
         self.config = config
+        #: publish hook for KEY-side serving indexes (the two-stage
+        #: MIPS seam, ops/mips.publish_rows): called with every batch
+        #: of (keys, solved vectors) the moment they publish, so a
+        #: fold-in row is findable as a RESULT — exactly scored and
+        #: merged — before the index's next rebuild. Telemetry-grade:
+        #: a sink failure never fails the fold-in.
+        self.index_sink = index_sink
         # the frozen table may be a MESH-SHARDED placed table
         # (parallel/placement.py): the solver serves it as-is — ladder
         # solves run under plain jit with GSPMD routing each history's
@@ -546,6 +555,7 @@ class SpeedOverlay:
         expires = self._clock() + cfg.ttl_s
         solved = 0
         published: List[str] = []
+        published_vecs: List[np.ndarray] = []
         unpublished: List[str] = []
         with self._lock:
             for key, (cols, _vals), vec in zip(keys, rows, vectors):
@@ -559,15 +569,23 @@ class SpeedOverlay:
                     continue
                 if cfg.transform is not None:
                     vec = cfg.transform(vec)
-                self._vectors[key] = (np.asarray(vec, np.float32),
-                                      cursor, expires)
+                vec32 = np.asarray(vec, np.float32)
+                self._vectors[key] = (vec32, cursor, expires)
                 self._vectors.move_to_end(key)
                 published.append(key)
+                published_vecs.append(vec32)
                 solved += 1
             while len(self._vectors) > self._max_vectors:
                 self._vectors.popitem(last=False)
             self.foldins += solved
         dt = _time.perf_counter() - t0
+        if self.index_sink is not None and published:
+            # outside the lock: the sink re-quantizes serving-index
+            # rows / extends the exact tail (ops/mips.publish_rows)
+            try:
+                self.index_sink(published, published_vecs)
+            except Exception:
+                logger.exception("speed overlay: index sink failed")
         # freshness stage 2: published keys now await their first serve;
         # keys with nothing foldable stop being traced (no vector can
         # ever serve their events until the next retrain)

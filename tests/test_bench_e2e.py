@@ -90,6 +90,21 @@ REQUIRED_FIELDS = {
     "fleet_shed_rate": (float, type(None)),
     "fleet_p99_flat_x": (float, type(None)),
     "fleet_recompiles_steady": (int, type(None)),
+    # two-stage MIPS serving leg (docs/performance.md "Two-stage MIPS
+    # serving"): exhaustive-vs-two-stage per-query walls, candidates-
+    # scanned fraction and the recall@20 gate at the planted large
+    # catalogue. None = the leg's designed deadline-skip.
+    "mips_items": (int, type(None)),
+    "mips_build_s": (float, type(None)),
+    "mips_exhaustive_per_query_ms": (float, type(None)),
+    "mips_two_stage_per_query_ms": (float, type(None)),
+    "mips_speedup": (float, type(None)),
+    "mips_candidates_frac": (float, type(None)),
+    "mips_recall_at_20": (float, type(None)),
+    "mips_recompiles_steady": (int, type(None)),
+    "mips_serve_qps": (float, type(None)),
+    "mips_exhaustive_27k_p99_ms": (float, type(None)),
+    "mips_sweep": (dict, type(None)),
     # provenance (obs/capacity.py): every record explains its origin,
     # and a record whose child landed carries no skip reason
     "bench_env": dict,
@@ -127,6 +142,10 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
         "PIO_BENCH_INGEST_BATCHES": "20",
         "PIO_BENCH_MOVIELENS": str(sample),
         "PIO_BENCH_MOVIELENS_BOUND": "10.0",  # synthetic data, shape only
+        # MIPS leg at CI shape: the 256k gate size runs, the 1M rung is
+        # left to real bench rounds (CI wall budget)
+        "PIO_BENCH_MIPS_ITEMS": "27000,262144",
+        "PIO_BENCH_MIPS_QUERIES": "24",
     })
     # own session so a timeout kill reaps the whole tree — otherwise the
     # claimed child outlives the parent and keeps burning CPU
@@ -241,6 +260,30 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
         assert rec["fleet_recompiles_steady"] == 0
         assert rec["fleet_shed_rate"] is not None \
             and 0.0 <= rec["fleet_shed_rate"] <= 1.0
+    # two-stage MIPS leg: at the ≥128k planted gate size the two-stage
+    # path must beat exhaustive per query while scanning ≤ 25% of the
+    # catalogue at recall@20 ≥ 0.95, with ZERO steady-state recompiles;
+    # the exhaustive path itself stays measured (the 27k p99 key) so
+    # the capacity trajectory can pin it. None = designed deadline-skip.
+    if rec["mips_items"] is not None:
+        assert rec["mips_items"] >= 131072
+        assert rec["mips_recall_at_20"] is not None \
+            and rec["mips_recall_at_20"] >= 0.95, rec["mips_recall_at_20"]
+        assert rec["mips_candidates_frac"] is not None \
+            and rec["mips_candidates_frac"] <= 0.25, \
+            rec["mips_candidates_frac"]
+        assert rec["mips_two_stage_per_query_ms"] is not None \
+            and rec["mips_exhaustive_per_query_ms"] is not None \
+            and rec["mips_two_stage_per_query_ms"] \
+            < rec["mips_exhaustive_per_query_ms"], (
+                rec["mips_two_stage_per_query_ms"],
+                rec["mips_exhaustive_per_query_ms"])
+        assert rec["mips_recompiles_steady"] == 0
+        assert rec["mips_serve_qps"] is not None \
+            and rec["mips_serve_qps"] > 0
+        assert rec["mips_exhaustive_27k_p99_ms"] is not None \
+            and rec["mips_exhaustive_27k_p99_ms"] > 0
+        assert rec["mips_sweep"], rec["mips_sweep"]
     if rec["shard_devices"] is not None:
         assert rec["shard_devices"] == 8
         assert rec["shard_mesh_shape"] == "8x1"

@@ -382,6 +382,33 @@ class Server:
             self.batcher.submit(request.body))
 """,
     ),
+    "exhaustive-scan": (
+        """
+import jax
+from incubator_predictionio_tpu.ops.topk import (
+    sharded_top_k,
+    top_k_with_exclusions,
+)
+
+class Server:
+    async def handle_query(self, request):
+        # full-table scoring below the MIPS auto-router: even with a
+        # registered two-stage index the query pays the linear scan
+        scores = self.item_factors @ self.user_vec
+        top = jax.lax.top_k(scores, 10)
+        packed = sharded_top_k(self.user_vec, self.item_factors, 10)
+        return top_k_with_exclusions(scores, 10), packed, top
+""",
+        """
+from incubator_predictionio_tpu.ops.topk import score_and_top_k
+
+class Server:
+    async def handle_query(self, request):
+        # the sanctioned entry: the auto-router serves two-stage when
+        # an index is registered and falls back to exhaustive itself
+        return score_and_top_k(self.user_vec, self.item_factors, 10)
+""",
+    ),
     "metric-label-cardinality": (
         """
 from incubator_predictionio_tpu.obs import metrics
@@ -414,9 +441,11 @@ def handle(request, route_label, response):
 
 
 def _lint_source(tmp_path: Path, source: str, rule: str, name="fixture.py"):
-    # server-state / unbatched-dispatch only apply under servers/
+    # server-state / unbatched-dispatch / exhaustive-scan only apply
+    # under servers/ (exhaustive-scan also covers serving/)
     target_dir = (tmp_path / "servers"
-                  if rule in ("server-state", "unbatched-dispatch")
+                  if rule in ("server-state", "unbatched-dispatch",
+                              "exhaustive-scan")
                   else tmp_path)
     target_dir.mkdir(exist_ok=True)
     target = target_dir / name

@@ -1316,14 +1316,11 @@ FLEET_KEYS = (
 )
 
 
-def _fleet_spawn(n: int, floor_ms: float, max_batch: int = 512):
-    """Spawn ``n`` serve-mode fleet workers (tests/fleet_worker.py) →
-    list of (proc, port). CPU backend forced; the floored workers get a
-    proportionally relaxed serve_p99 objective so the simulated
-    dispatch wall itself is not read as an overload."""
-    import select
-
-    workers = []
+def _fleet_worker_env(floor_ms: float) -> dict:
+    """Environment for a serve-mode fleet worker subprocess: CPU backend
+    forced; floored workers get a proportionally relaxed serve_p99
+    objective so the simulated dispatch wall itself is not read as an
+    overload."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
@@ -1338,6 +1335,31 @@ def _fleet_spawn(n: int, floor_ms: float, max_batch: int = 512):
         # over-saturation stage still crosses it
         env["PIO_SLO_SERVE_P99_S"] = str(max(8.0 * floor_ms / 1000.0,
                                              0.25))
+    return env
+
+
+def _await_port(proc, deadline: float) -> tuple:
+    """Bounded wait for a worker's ``PORT <n> [WARM_S <s>]`` line →
+    (port, warm_s): a worker that dies during jax import or ladder
+    warmup must fail the leg (nulling its keys), never hang the bench
+    past the driver's deadline."""
+    import select
+
+    ready, _w, _x = select.select(
+        [proc.stdout], [], [], max(deadline - time.monotonic(), 1.0))
+    line = proc.stdout.readline() if ready else ""
+    if not line.startswith("PORT"):
+        raise RuntimeError("fleet worker failed to start")
+    parts = line.split()
+    warm_s = float(parts[3]) if len(parts) >= 4 else 0.0
+    return int(parts[1]), warm_s
+
+
+def _fleet_spawn(n: int, floor_ms: float, max_batch: int = 512):
+    """Spawn ``n`` serve-mode fleet workers (tests/fleet_worker.py) →
+    list of (proc, port)."""
+    workers = []
+    env = _fleet_worker_env(floor_ms)
     worker_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "tests", "fleet_worker.py")
     for i in range(n):
@@ -1351,16 +1373,12 @@ def _fleet_spawn(n: int, floor_ms: float, max_batch: int = 512):
     out = []
     deadline = time.monotonic() + 120.0
     for proc in workers:
-        # bounded PORT wait: a worker that dies during jax import or
-        # ladder warmup must fail the leg (nulling the fleet_* keys),
-        # never hang the bench past the driver's deadline
-        ready, _w, _x = select.select(
-            [proc.stdout], [], [], max(deadline - time.monotonic(), 1.0))
-        line = proc.stdout.readline() if ready else ""
-        if not line.startswith("PORT"):
+        try:
+            port, _warm = _await_port(proc, deadline)
+        except RuntimeError:
             _fleet_teardown([(p, None) for p in workers])
-            raise RuntimeError("fleet worker failed to start")
-        out.append((proc, int(line.split()[1])))
+            raise
+        out.append((proc, port))
     return out
 
 
@@ -1701,6 +1719,240 @@ def bench_fleet(budget_s: float) -> dict:
         f"p99_flat={out['fleet_p99_flat_x']}x "
         f"shed_rate={out['fleet_shed_rate']} "
         f"recompiles={out['fleet_recompiles_steady']}")
+    return out
+
+
+#: fleet front-door leg (docs/production.md "Fleet front door"): the
+#: health-checked router proven ADVERSARIALLY — a worker killed
+#: mid-ramp, a warm-cache worker joined mid-ramp, and one rolling
+#: fleet-wide reload mid-traffic, with zero non-shed 5xx and zero
+#: drain drops as the acceptance bars
+FRONTDOOR_KEYS = (
+    "frontdoor_workers", "frontdoor_qps", "frontdoor_p99_ramp_s",
+    "frontdoor_offered_rps_ramp", "frontdoor_p99_flat_x",
+    "frontdoor_nonshed_5xx", "frontdoor_shed_total",
+    "frontdoor_retries", "frontdoor_reloaded", "frontdoor_drain_dropped",
+    "frontdoor_join_cold_s", "frontdoor_join_warm_s",
+    "frontdoor_join_to_first_dispatch_s",
+)
+
+
+def _frontdoor_spawn(seed: int, cache_dir: str, chaos: str = "",
+                     max_batch: int = 512, floor_ms: float = 0.0):
+    """One serve-mode worker wired to the FLEET-SHARED persistent XLA
+    compile cache → (proc, port, warm_s). The min-compile-time floor is
+    zeroed so even the CPU sim's fast ladder compiles populate the
+    cache — the join pre-warm delta stays measurable off-TPU."""
+    env = _fleet_worker_env(floor_ms)
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.0"
+    worker_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests", "fleet_worker.py")
+    cmd = [sys.executable, worker_py, "--mode", "serve",
+           "--seed", str(seed), "--max-batch", str(max_batch),
+           "--dispatch-floor-ms", str(floor_ms),
+           "--compile-cache", cache_dir]
+    if chaos:
+        cmd += ["--chaos", chaos]
+    proc = subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    try:
+        port, warm_s = _await_port(proc, time.monotonic() + 120.0)
+    except RuntimeError:
+        _fleet_teardown([(proc, None)])
+        raise
+    return proc, port, warm_s
+
+
+def bench_frontdoor(budget_s: float) -> dict:
+    """Fleet front-door leg: one address over real worker processes,
+    chaos-proven. The ramp runs THROUGH the front door while the leg
+    injects every fault the router must absorb:
+
+    - stage 1: steady baseline (the p99 denominator);
+    - stage 2: a rolling fleet-wide ``/reload`` fires mid-traffic
+      (drain → warm-before-swap → re-admit, one worker at a time), and
+      the victim worker hard-exits on its own ``--chaos kill-after``
+      timer (in-flight connection resets — the single-retry path);
+      the moment the victim dies a REPLACEMENT worker is spawned
+      against the fleet-shared compile cache and joined mid-traffic
+      (``frontdoor_join_to_first_dispatch_s`` = spawn → its first
+      routed query);
+    - stage 3: the healed fleet at the same offered rate (recovery
+      must hold, not just survive the transient).
+
+    Bars: ``frontdoor_nonshed_5xx`` == 0 (every failure either retried
+    to a healthy peer or shed with the 503 + Retry-After contract),
+    ``frontdoor_drain_dropped`` == 0 (rolling reload drops nothing),
+    ``frontdoor_p99_flat_x`` ≤ 1.5 across the chaos. The cold/warm
+    ladder-warmup delta off the shared cache is recorded
+    (``frontdoor_join_cold_s`` vs ``frontdoor_join_warm_s``).
+
+    Guarded like bench_fleet: any failure nulls the frontdoor_* keys,
+    never the record."""
+    import asyncio
+    import shutil
+    import tempfile
+    import threading
+
+    from incubator_predictionio_tpu.serving.frontdoor import (
+        FrontDoor,
+        FrontDoorConfig,
+    )
+
+    out = dict.fromkeys(FRONTDOOR_KEYS)
+    if budget_s < 120.0:
+        log("frontdoor leg skipped: bench deadline too close")
+        return out
+    leg_deadline = time.monotonic() + min(
+        budget_s - 45.0,
+        float(os.environ.get("PIO_BENCH_FRONTDOOR_TIMEOUT_S", "240")))
+
+    def left(cap: float) -> float:
+        return max(min(cap, leg_deadline - time.monotonic()), 5.0)
+
+    # a FLAT offered rate across the stages: bench_fleet already pins
+    # p99-vs-load, so holding load constant makes the flatness ratio
+    # measure CHAOS alone (stage 1 = quiet baseline, stages 2-3 =
+    # kill + join + rolling reload at the same offered rate)
+    ramp = [float(r) for r in os.environ.get(
+        "PIO_BENCH_FRONTDOOR_RAMP_RPS", "100,100,100").split(",") if r]
+    stage_s = float(os.environ.get("PIO_BENCH_FRONTDOOR_STAGE_S", "8"))
+    # a small simulated dispatch floor makes per-query latency
+    # deterministic (floor-dominated) instead of scheduler-jitter-
+    # dominated, so the p99 ratio resolves chaos, not CPU noise
+    floor_ms = float(os.environ.get("PIO_BENCH_FRONTDOOR_FLOOR_MS", "25"))
+    cache_dir = tempfile.mkdtemp(prefix="pio-frontdoor-cache-")
+    workers = []   # (proc, port) for teardown
+    fd = None
+    # join_thread races the finally-block teardown: the replacement
+    # worker must either land in `workers` BEFORE teardown iterates it
+    # or not spawn at all — otherwise an early stage failure leaks a
+    # jax subprocess into the rest of the bench run
+    spawn_lock = threading.Lock()
+    leg_done = threading.Event()
+    try:
+        # worker A cold (fresh shared cache), worker B warm from A's
+        # compiles; B is the VICTIM — its kill-after timer (armed at
+        # its own serving start) lands ~0.6 into stage 2
+        kill_after = 3.0 + 1.6 * stage_s + 1.0
+        proc_a, port_a, warm_cold = _frontdoor_spawn(
+            0, cache_dir, floor_ms=floor_ms)
+        workers.append((proc_a, port_a))
+        proc_b, port_b, warm_warm = _frontdoor_spawn(
+            1, cache_dir, chaos=f"kill-after={kill_after:.1f}",
+            floor_ms=floor_ms)
+        workers.append((proc_b, port_b))
+        out["frontdoor_join_cold_s"] = round(warm_cold, 3)
+        out["frontdoor_join_warm_s"] = round(warm_warm, 3)
+        out["frontdoor_workers"] = 2
+
+        fd = FrontDoor(
+            [("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+            FrontDoorConfig(request_timeout_s=8.0, attempt_timeout_s=3.0,
+                            probe_interval_s=0.5, open_cooldown_s=1.0))
+        fport = fd.start_background()
+
+        results: list = []
+        reload_out: dict = {}
+        join_out: dict = {}
+
+        def reload_thread() -> None:
+            time.sleep(0.5)  # let stage 2 traffic establish first
+            try:
+                reload_out.update(fd.rolling_reload(timeout=left(120.0)))
+            except Exception as e:  # noqa: BLE001 — nulls the keys
+                log(f"frontdoor rolling reload failed ({e!r})")
+
+        def join_thread() -> None:
+            # the elasticity path: the moment the victim dies, spawn a
+            # replacement against the WARM shared cache and measure
+            # spawn → first query the front door routes to it
+            proc_b.wait()
+            t0 = time.perf_counter()
+            with spawn_lock:
+                if leg_done.is_set():
+                    return  # teardown already ran; don't leak a worker
+                try:
+                    proc_c, port_c, _w = _frontdoor_spawn(
+                        2, cache_dir, floor_ms=floor_ms)
+                except Exception as e:  # noqa: BLE001
+                    log(f"frontdoor join worker failed to spawn ({e!r})")
+                    return
+                workers.append((proc_c, port_c))
+            name = fd.add_worker("127.0.0.1", port_c)
+            while time.monotonic() < leg_deadline:
+                served = next(
+                    (w["requests"] for w in fd.stats()["workers"]
+                     if w["name"] == name), 0)
+                if served > 0:
+                    join_out["join_s"] = time.perf_counter() - t0
+                    return
+                time.sleep(0.05)
+
+        # untimed warm pass: ladder rungs + EWMA walls settle before
+        # the measured baseline (every response still counts toward
+        # the 5xx/shed tallies — chaos accounting is total)
+        async def run_stage(rate: float, dur: float) -> None:
+            await _fleet_open_loop(fport, rate, dur, results,
+                                   period_s=2.0)
+
+        asyncio.run(asyncio.wait_for(run_stage(ramp[0], 3.0),
+                                     timeout=left(60.0)))
+        warm_end = len(results)  # qps counts measured stages only
+        stage_p99: list = []
+        chaos_threads: list = []
+        stage_walls = 0.0
+        for si, rate in enumerate(ramp):
+            if si == 1:
+                for fn in (reload_thread, join_thread):
+                    t = threading.Thread(target=fn, daemon=True)
+                    t.start()
+                    chaos_threads.append(t)
+            stage_results_start = len(results)
+            t_stage = time.perf_counter()
+            asyncio.run(asyncio.wait_for(
+                run_stage(rate, stage_s),
+                timeout=left(max(6 * stage_s, 60.0))))
+            stage_walls += time.perf_counter() - t_stage
+            served = [d for s, d, f in results[stage_results_start:]
+                      if s == 200 and not f]
+            if served:
+                stage_p99.append(_stage_p99(served))
+        for t in chaos_threads:
+            t.join(timeout=left(60.0))
+
+        ok_total = sum(1 for s, _d, _f in results[warm_end:] if s == 200)
+        out["frontdoor_qps"] = round(ok_total / max(stage_walls, 1e-9), 1)
+        out["frontdoor_p99_ramp_s"] = [round(p, 4) for p in stage_p99]
+        out["frontdoor_offered_rps_ramp"] = ramp
+        if len(stage_p99) >= 2 and stage_p99[0] > 0:
+            out["frontdoor_p99_flat_x"] = round(
+                max(stage_p99[1:]) / stage_p99[0], 3)
+        out["frontdoor_nonshed_5xx"] = sum(
+            1 for s, _d, _f in results if s >= 500 and s != 503)
+        out["frontdoor_shed_total"] = sum(
+            1 for s, _d, _f in results if s == 503)
+        out["frontdoor_retries"] = fd.counts["retries"]
+        out["frontdoor_reloaded"] = reload_out.get("reloaded")
+        out["frontdoor_drain_dropped"] = reload_out.get("dropped")
+        if "join_s" in join_out:
+            out["frontdoor_join_to_first_dispatch_s"] = round(
+                join_out["join_s"], 2)
+    finally:
+        with spawn_lock:
+            leg_done.set()
+        if fd is not None:
+            fd.stop()
+        _fleet_teardown(workers)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    log(f"frontdoor: p99_flat={out['frontdoor_p99_flat_x']}x "
+        f"nonshed_5xx={out['frontdoor_nonshed_5xx']} "
+        f"drain_dropped={out['frontdoor_drain_dropped']} "
+        f"retries={out['frontdoor_retries']} "
+        f"join={out['frontdoor_join_to_first_dispatch_s']}s "
+        f"(warmup cold={out['frontdoor_join_cold_s']}s "
+        f"warm={out['frontdoor_join_warm_s']}s)")
     return out
 
 
@@ -2304,6 +2556,9 @@ def run_orchestrator() -> None:
         # serving-fleet leg (parent-side worker subprocesses;
         # docs/production.md "Serving fleet")
         **dict.fromkeys(FLEET_KEYS),
+        # fleet front-door leg (parent-side router over worker
+        # subprocesses; docs/production.md "Fleet front door")
+        **dict.fromkeys(FRONTDOOR_KEYS),
         "accel_waited_s": None,
         "accel_outcome": "never_available",
         "sasrec_epoch_s": None,
@@ -2427,6 +2682,14 @@ def run_orchestrator() -> None:
         record.update(bench_fleet(emit_by - time.monotonic()))
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"fleet leg failed ({e!r}); fleet_* keys null this round")
+
+    # -- 6d2. FLEET FRONT-DOOR LEG (host CPU, in-process router over
+    #         worker subprocesses; chaos-injected) ------------------------
+    try:
+        record.update(bench_frontdoor(emit_by - time.monotonic()))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"frontdoor leg failed ({e!r}); frontdoor_* keys null "
+            "this round")
 
     # -- 6e. TWO-STAGE MIPS SERVING LEG (in-process; planted catalogue
     #        past ML-20M scale, exhaustive stays the oracle) ---------------

@@ -1107,6 +1107,69 @@ class ExhaustiveScan(Rule):
                     "auto-routers (exhaustive stays their fallback)")
 
 
+# ---------------------------------------------------------------------------
+# 17. ad-hoc retry loops outside the shared RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class UnboundedRetry(Rule):
+    name = "unbounded-retry"
+    severity = "warning"
+    doc = ("retry loop swallowing exceptions with a bare fixed-delay "
+           "time.sleep (no backoff, no deadline) outside utils/http.py "
+           "— fixed delays herd every client back onto a struggling "
+           "server in lockstep and the loop never gives up; route "
+           "client retries through utils/http.RetryPolicy (jittered "
+           "exponential backoff under an overall deadline, Retry-After "
+           "honored, idempotent-only by default)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        rel = f"/{mod.relpath}".replace("\\", "/")
+        if rel.endswith("/utils/http.py"):  # RetryPolicy's own home
+            return
+        seen: Set[Tuple[int, int]] = set()
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            # a retry loop = a loop that both swallows a failure (an
+            # except handler in its own body) and sleeps a CONSTANT
+            # delay anywhere in that body. Computed delays (backoff
+            # expressions) and sleeps outside failure loops stay silent
+            # — this is a drift detector, not a sleep ban.
+            if not any(isinstance(n, ast.ExceptHandler)
+                       for n in self._body_nodes(loop)):
+                continue
+            for call in self._body_nodes(loop):
+                if not (isinstance(call, ast.Call)
+                        and mod.resolved(call.func) == "time.sleep"
+                        and len(call.args) == 1
+                        and isinstance(call.args[0], ast.Constant)):
+                    continue
+                key = (call.lineno, call.col_offset)
+                if key in seen:  # nested loops walk the call twice
+                    continue
+                seen.add(key)
+                yield mod.finding(
+                    self, call,
+                    "fixed-delay time.sleep() in a retry loop — no "
+                    "backoff, no deadline, no jitter; use "
+                    "utils/http.RetryPolicy")
+
+    @staticmethod
+    def _body_nodes(loop: ast.AST) -> Iterator[ast.AST]:
+        """Nodes lexically inside the loop body, excluding nested
+        function bodies (a function DEFINED in a loop is not the loop
+        retrying) and the loop's else clause."""
+        stack: List[ast.AST] = list(loop.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -1124,6 +1187,7 @@ ALL_RULES: Sequence[Rule] = (
     MetricLabelCardinality(),
     UnbatchedDispatch(),
     ExhaustiveScan(),
+    UnboundedRetry(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

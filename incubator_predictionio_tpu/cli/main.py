@@ -566,8 +566,15 @@ def dispatch(args: argparse.Namespace) -> int:  # noqa: C901
         server = StorageServer.from_env(
             source=args.source, host=args.ip, port=args.port,
             auth_key=args.auth_key)
-        print(f"Storage Server running on http://{args.ip}:{args.port}")
-        asyncio.run(server.serve_forever())
+
+        def announce(port: int) -> None:
+            # announced AFTER the bind with the KERNEL-assigned port:
+            # `--port 0` (ephemeral bind) makes parents stop racing the
+            # box for a pre-picked "free" port — they parse this line
+            print(f"Storage Server running on http://{args.ip}:{port}",
+                  flush=True)
+
+        asyncio.run(server.serve_forever(on_started=announce))
         return 0
 
     if cmd == "export":

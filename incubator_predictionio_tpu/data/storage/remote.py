@@ -78,10 +78,19 @@ class StorageClient(base.BaseStorageClient):
         self.timeout = float(config.properties.get("TIMEOUT", "60"))
         from incubator_predictionio_tpu.utils.http import (
             ClientConnectionPool,
+            RetryPolicy,
         )
 
         self._pool = ClientConnectionPool(self.host, self.port,
                                           self.timeout)
+        # the shared client retry choreography (utils/http.RetryPolicy):
+        # one re-send over a fresh connection after a short jittered
+        # backoff, bounded by the channel timeout as the overall
+        # deadline. WHICH failures are safe to re-send stays decided in
+        # rpc() below — only it knows whether the body reached the wire.
+        self._retry = RetryPolicy(attempts=2, base_delay_s=0.05,
+                                  max_delay_s=0.5,
+                                  deadline_s=self.timeout)
 
     def _conn(self) -> http.client.HTTPConnection:
         return self._pool.get()
@@ -99,8 +108,9 @@ class StorageClient(base.BaseStorageClient):
         headers.update(obs_trace.client_headers())
         if self.auth_key:
             headers["X-Pio-Storage-Key"] = self.auth_key
-        conn = self._conn()
-        # Retry policy after a connection failure. Failures BEFORE the
+        from incubator_predictionio_tpu.utils.http import RetryableError
+
+        # Retryability after a connection failure. Failures BEFORE the
         # request body went out (sent=False: connect error, send error on a
         # stale keep-alive) provably never executed server-side, so any
         # method retries once. After the body was sent, only idempotent
@@ -109,33 +119,39 @@ class StorageClient(base.BaseStorageClient):
         # would commit the payload twice. A timeout after send is never
         # retried even for reads: the server is likely still executing the
         # call, and re-sending would run the same work twice concurrently.
-        for attempt in (0, 1):
+        # The backoff/deadline choreography itself is the shared
+        # RetryPolicy (utils/http.py); this closure only CLASSIFIES.
+        def attempt() -> bytes:
+            conn = self._conn()
             sent = False
             try:
                 conn.request("POST", "/rpc", body=body, headers=headers)
                 sent = True
                 resp = conn.getresponse()
-                payload = resp.read()
-                break
+                return resp.read()
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 conn.close()
                 retryable = (not sent) or (
                     method in _IDEMPOTENT
                     and not isinstance(e, TimeoutError))
-                if attempt == 1 or not retryable:
-                    ambiguous = sent and method not in _IDEMPOTENT
-                    if not sent:
-                        state = "; the request was never sent — it was NOT applied"
-                    elif method in _IDEMPOTENT:
-                        state = ""
-                    else:
-                        state = ("; the call is not idempotent — it may or "
-                                 "may not have been applied")
-                    err_cls = (_ambiguous_error() if ambiguous
-                               else _storage_error())
-                    raise err_cls(
-                        f"storage server {self.host}:{self.port} failed "
-                        f"during {iface}.{method} ({e!r})" + state)
+                ambiguous = sent and method not in _IDEMPOTENT
+                if not sent:
+                    state = "; the request was never sent — it was NOT applied"
+                elif method in _IDEMPOTENT:
+                    state = ""
+                else:
+                    state = ("; the call is not idempotent — it may or "
+                             "may not have been applied")
+                err_cls = (_ambiguous_error() if ambiguous
+                           else _storage_error())
+                err = err_cls(
+                    f"storage server {self.host}:{self.port} failed "
+                    f"during {iface}.{method} ({e!r})" + state)
+                if retryable:
+                    raise RetryableError(err) from e
+                raise err from e
+
+        payload = self._retry.call(attempt)
         msg = wire.unpack(payload)
         if msg.get("ok"):
             return msg.get("value")

@@ -342,8 +342,10 @@ class StorageServer:
                     else "repository-routed")
         return port
 
-    async def serve_forever(self) -> None:
-        await self.http.serve_forever()
+    async def serve_forever(self, on_started=None) -> None:
+        """``on_started(port)`` fires after the bind — the ephemeral-
+        bind announcement hook the CLI uses with ``--port 0``."""
+        await self.http.serve_forever(on_started=on_started)
 
     def stop(self) -> None:
         self.http.stop()

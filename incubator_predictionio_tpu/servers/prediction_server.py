@@ -50,7 +50,10 @@ from incubator_predictionio_tpu.utils.http import (
     HttpServer,
     Request,
     Response,
+    RetryableError,
+    RetryPolicy,
     Router,
+    parse_retry_after,
 )
 from incubator_predictionio_tpu.utils.times import (
     ensure_aware,
@@ -130,6 +133,53 @@ class ServerConfig:
 #: constructor signature is unchanged (handle_batch, max_batch,
 #: workers=…); ``max_batch`` is now the ladder CAP.
 _MicroBatcher = BatchScheduler
+
+#: retry choreography for the fire-and-forget posters (feedback events,
+#: --log-url shipping): the shared utils/http.RetryPolicy — jittered
+#: exponential backoff under a hard deadline, honoring Retry-After on a
+#: 503 shed. Only failures that provably never executed server-side
+#: (connection refused before send) or that the server explicitly
+#: deferred (503) are wrapped retryable — see _post_with_retries.
+_POST_RETRY = RetryPolicy(attempts=3, base_delay_s=0.5, max_delay_s=5.0,
+                          deadline_s=20.0)
+
+
+def _post_with_retries(url: str, payload: bytes,
+                       headers: Dict[str, str], what: str,
+                       expect_status: Optional[int] = None) -> None:
+    """One JSON POST under _POST_RETRY; runs on a poster worker thread.
+
+    Retry classification: a refused connection never carried the body
+    (safe for any payload), and a 503 is the receiving server's own
+    shed contract — it did NOT process the event and told us when to
+    come back (Retry-After floors the backoff). Anything else — 4xx,
+    non-503 5xx, a timeout mid-flight — fails after one try: the event
+    may have been applied, and these posters must never double-apply
+    training data. Failures only ever log; posters are fire-and-forget.
+    """
+    def attempt() -> None:
+        req = urllib.request.Request(url, data=payload, headers=headers,
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                if expect_status is not None and resp.status != expect_status:
+                    logger.error("%s POST returned status %d", what,
+                                 resp.status)
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                raise RetryableError(
+                    e, retry_after_s=parse_retry_after(
+                        e.headers.get("Retry-After"))) from e
+            raise
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, ConnectionRefusedError):
+                raise RetryableError(e) from e
+            raise
+
+    try:
+        _POST_RETRY.call(attempt)
+    except Exception as e:
+        logger.error("%s failed: %s", what, e)
 
 
 class _AsyncPoster:
@@ -577,20 +627,12 @@ class PredictionServer:
         # trace headers captured HERE: the poster runs on its own daemon
         # thread where the request's contextvars are gone
         trace_headers = obs_trace.client_headers()
-
-        def post() -> None:
-            try:
-                req = urllib.request.Request(
-                    self.config.log_url, data=payload.encode(),
-                    headers={"Content-Type": "application/json",
-                             **trace_headers},
-                    method="POST")
-                with urllib.request.urlopen(req, timeout=10):
-                    pass
-            except Exception as e:
-                logger.error("Unable to send remote log: %s", e)
-
-        self._log_poster.submit(post, "remote log")
+        self._log_poster.submit(
+            lambda: _post_with_retries(
+                self.config.log_url, payload.encode(),
+                {"Content-Type": "application/json", **trace_headers},
+                "remote log"),
+            "remote log")
 
     def _feedback(
         self, instance: EngineInstance, query_json: Any, prediction_json: Any
@@ -621,24 +663,12 @@ class PredictionServer:
 
         # trace headers captured before the executor hop (see _remote_log)
         trace_headers = obs_trace.client_headers()
-
-        def post() -> None:
-            try:
-                req = urllib.request.Request(
-                    url, data=json.dumps(data).encode(),
-                    headers={"Content-Type": "application/json",
-                             **trace_headers}, method="POST",
-                )
-                with urllib.request.urlopen(req, timeout=10) as resp:
-                    if resp.status != 201:
-                        logger.error(
-                            "Feedback event failed. Status code: %d. Data: %s",
-                            resp.status, data,
-                        )
-            except Exception as e:
-                logger.error("Feedback event failed: %s", e)
-
-        self._feedback_poster.submit(post, "feedback event")
+        self._feedback_poster.submit(
+            lambda: _post_with_retries(
+                url, json.dumps(data).encode(),
+                {"Content-Type": "application/json", **trace_headers},
+                "feedback event", expect_status=201),
+            "feedback event")
         # inject prId into the served result when the prediction carries one
         if isinstance(prediction_json, dict) and "prId" in prediction_json:
             prediction_json = dict(prediction_json, prId=pr_id)
@@ -763,14 +793,28 @@ class PredictionServer:
                             engine=self.config.engine_id))
                 else:
                     result = await sync(self._handle_query, request.body)
-            except HttpError:
+            except HttpError as e:
+                # the depth signal matters MOST on a shed: without it
+                # the front door would keep the overloaded worker's
+                # last (pre-overload) low reading and keep routing to
+                # it (serving/frontdoor.py placement)
+                if self._batcher is not None:
+                    e.headers.setdefault("X-PIO-Queue-Depth",
+                                         str(self._batcher.depth()))
                 raise
             except (ValueError, KeyError) as e:
                 return Response(400, {"message": str(e)})
+            # queue-depth piggyback: the front door's placement signal,
+            # refreshed for free on every response instead of waiting
+            # for its next /metrics scrape (serving/frontdoor.py)
+            depth_headers = (
+                {"X-PIO-Queue-Depth": str(self._batcher.depth())}
+                if self._batcher is not None else {})
             if isinstance(result, (bytes, bytearray)):
                 # batch_serve_json fast path: body already rendered
-                return Response(200, body=bytes(result))
-            return Response(200, result)
+                return Response(200, body=bytes(result),
+                                headers=depth_headers)
+            return Response(200, result, headers=depth_headers)
 
         @r.post("/reload")
         def reload(request: Request) -> Response:

@@ -5,6 +5,11 @@ dispatch: server request handlers enqueue, the scheduler coalesces
 (queue-depth-adaptive pow2 batching onto the compile-cached kernel
 ladders) and sheds (SLO-projected 503 + Retry-After) — the pio-lint
 rule ``unbatched-dispatch`` flags handlers that bypass it.
+
+``serving.frontdoor`` is the layer above: ONE address fanned across N
+worker processes with queue-depth-aware placement, circuit-breaker
+health, budgeted retry, and rolling drain-reload choreography
+(docs/production.md "Fleet front door").
 """
 
 from incubator_predictionio_tpu.serving.scheduler import (  # noqa: F401
@@ -14,3 +19,15 @@ from incubator_predictionio_tpu.serving.scheduler import (  # noqa: F401
     max_wait_s,
     plan_dispatch,
 )
+
+
+def __getattr__(name: str):
+    """Lazy ``FrontDoor``/``FrontDoorConfig`` re-export: importing the
+    frontdoor module registers the pio_frontdoor_* metric families, and
+    a plain prediction WORKER (which imports serving.scheduler) must
+    not grow empty front-door series on its /metrics."""
+    if name in ("FrontDoor", "FrontDoorConfig"):
+        from incubator_predictionio_tpu.serving import frontdoor
+
+        return getattr(frontdoor, name)
+    raise AttributeError(name)

@@ -18,10 +18,12 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import dataclasses
 import errno
 import inspect
 import json
 import logging
+import random
 import re
 import socket
 import ssl
@@ -57,7 +59,8 @@ STATUS_TEXT = {
     301: "Moved Permanently", 302: "Found", 400: "Bad Request",
     401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
     405: "Method Not Allowed", 413: "Payload Too Large",
-    500: "Internal Server Error", 503: "Service Unavailable",
+    500: "Internal Server Error", 502: "Bad Gateway",
+    503: "Service Unavailable", 504: "Gateway Timeout",
 }
 
 
@@ -75,6 +78,100 @@ class HttpError(Exception):
         # per-instance, never a class-level dict: an in-place mutation
         # must not leak the header onto every other error response
         self.headers: Dict[str, str] = {}
+
+
+class RetryableError(Exception):
+    """Wraps a failure that is safe to retry under a :class:`RetryPolicy`.
+
+    The CALLER decides retryability (it knows whether the request body
+    ever reached the wire, whether the verb is idempotent, whether a 503
+    shed said come back later) and wraps only those failures; everything
+    else propagates immediately. ``retry_after_s`` carries a
+    server-directed minimum delay (the ``Retry-After`` contract the
+    scheduler's shed responses ride)."""
+
+    def __init__(self, cause: BaseException,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(str(cause))
+        self.cause = cause
+        self.retry_after_s = retry_after_s
+
+
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """``Retry-After`` header → seconds (delta-seconds form only; the
+    HTTP-date form is ignored — nothing in this repo emits it)."""
+    if not value:
+        return None
+    try:
+        return max(float(value.strip()), 0.0)
+    except ValueError:
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """THE one copy of HTTP-client retry choreography: jittered
+    exponential backoff under an overall deadline, honoring a
+    server-directed ``Retry-After``, idempotent-only by default.
+
+    Before this existed every client grew its own loop (the remote
+    storage RPC channel, the GCS driver, the prediction server's
+    feedback POSTs) and they drifted — fixed delays, no deadline, no
+    Retry-After. The ``unbounded-retry`` pio-lint rule now flags new
+    ad-hoc loops outside this module; adopters call :meth:`call` with a
+    closure that wraps retry-SAFE failures in :class:`RetryableError`
+    (see data/storage/remote.py for the sent/idempotent discipline).
+    """
+
+    #: total tries (1 = no retry)
+    attempts: int = 3
+    base_delay_s: float = 0.2
+    multiplier: float = 2.0
+    max_delay_s: float = 5.0
+    #: overall budget across every attempt AND backoff sleep — a retry
+    #: that cannot finish before the deadline is not attempted
+    deadline_s: float = 30.0
+    #: fraction of each delay randomized away (decorrelates a thundering
+    #: herd of clients retrying the same outage in lockstep)
+    jitter_frac: float = 0.5
+
+    def backoff_s(self, attempt: int,
+                  retry_after_s: Optional[float] = None,
+                  rand: Callable[[], float] = random.random) -> float:
+        """Delay before retry number ``attempt+1`` (attempt is 0-based).
+        A server-directed ``Retry-After`` sets the floor — backing off
+        LESS than the server asked would re-offer load it just shed."""
+        delay = min(self.base_delay_s * (self.multiplier ** attempt),
+                    self.max_delay_s)
+        delay *= 1.0 - self.jitter_frac * rand()
+        if retry_after_s is not None:
+            delay = max(delay, retry_after_s)
+        return delay
+
+    def call(self, fn: Callable[[], Any], *, idempotent: bool = True,
+             clock: Callable[[], float] = time.monotonic,
+             sleep: Callable[[float], None] = time.sleep) -> Any:
+        """Run ``fn()`` under this policy.
+
+        ``fn`` raises :class:`RetryableError` around failures it judged
+        safe to re-send; any other exception propagates unretried. With
+        ``idempotent=False`` nothing retries (the wrap is ignored) —
+        the policy is idempotent-only by default, because a lost
+        RESPONSE never proves the request was not applied. On
+        exhaustion the ORIGINAL cause is re-raised, so callers keep
+        their typed errors."""
+        deadline = clock() + self.deadline_s
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except RetryableError as e:
+                delay = self.backoff_s(attempt, e.retry_after_s)
+                attempt += 1
+                if (not idempotent or attempt >= self.attempts
+                        or clock() + delay > deadline):
+                    raise e.cause
+                sleep(delay)
 
 
 class Request:
@@ -223,8 +320,10 @@ class ClientConnectionPool:
 
     The single copy of client connection lifecycle shared by the
     remote-storage RPC channel (data/storage/remote.py) and the GCS
-    driver (data/storage/gcs.py) — each layers its own retry policy on
-    top. ``get()`` returns this thread's connection (created on first
+    driver (data/storage/gcs.py) — retry choreography layers on top via
+    :class:`RetryPolicy` (the callers still own retryABILITY: only they
+    know whether a given failure left the request unsent).
+    ``get()`` returns this thread's connection (created on first
     use; ``http.client`` transparently reconnects a closed one on the
     next request), ``drop()`` discards this thread's connection so the
     next ``get()`` builds a fresh object, ``close_all()`` closes every
@@ -500,9 +599,18 @@ class HttpServer:
         logger.info("http%s server listening on %s:%d",
                     "s" if self.ssl_context else "", self.host, self.port)
 
-    async def serve_forever(self) -> None:
+    async def serve_forever(
+        self, on_started: Optional[Callable[[int], None]] = None
+    ) -> None:
+        """Bind, then serve until cancelled. ``on_started`` (if given)
+        runs once with the KERNEL-assigned port after the bind — the
+        ephemeral-bind (`port=0`) announcement hook: a parent that
+        pre-picks a "free" port instead is racing every other process
+        on the box for it."""
         await self.start()
         assert self._server is not None
+        if on_started is not None:
+            on_started(self.port)
         async with self._server:
             await self._server.serve_forever()
 

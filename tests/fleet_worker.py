@@ -1,7 +1,7 @@
 """Worker program for the fleet tests and the fleet serving bench.
 
 Run as a REAL separate process by tests/test_federation.py and by
-``bench.py bench_fleet``:
+``bench.py bench_fleet`` / ``bench_frontdoor``:
 
 - ``--mode metrics``: an HttpServer exposing ``GET /metrics`` from its
   own process registry, with a planted query-latency histogram and
@@ -16,22 +16,93 @@ Run as a REAL separate process by tests/test_federation.py and by
   (random factors, synthetic catalog), serving ``/queries.json``
   through the continuous-batching scheduler (serving/scheduler.py) with
   the pow2 ladder pre-warmed before the port is announced — one worker
-  of the ``bench_fleet`` leg. ``/metrics`` on the same port exposes
-  ``pio_serve_batch_size`` / ``pio_serve_shed_total`` /
-  ``pio_serve_compile_cache_size`` for the bench's scrapes.
+  of the ``bench_fleet`` / ``bench_frontdoor`` legs. ``/metrics`` on
+  the same port exposes ``pio_serve_batch_size`` /
+  ``pio_serve_shed_total`` / ``pio_serve_compile_cache_size`` for the
+  bench's scrapes, and ``POST /reload`` hot-swaps to a freshly planted
+  model through the real warm-before-swap route (what the front door's
+  rolling reload drives).
 
-Prints ``PORT <n>`` on stdout once bound (serve mode: once WARM), then
-serves until stdin closes (the parent owns the lifetime; no signals
-needed).
+``--compile-cache DIR`` points the persistent XLA compile cache at a
+FLEET-SHARED directory (utils/compile_cache.py) before any jax work, so
+a joining worker pre-warms its pow2 ladder from disk instead of paying
+the cold compile wall — the elasticity story bench_frontdoor measures.
+
+``--chaos SPEC`` arms fault injection (comma-separated; serve mode):
+
+- ``kill-after=S``   — hard-exit the process S seconds after serving
+  starts (the in-flight-connection-reset class a crashed worker causes)
+- ``stall-after=S``  — after S seconds every dispatch wedges (the
+  accepted-but-never-answers class: queue grows, callers time out)
+- ``latency-spike=MS:P`` — each dispatch pays +MS ms with probability P
+  (tail-latency injection)
+- ``refuse-after=S`` — close the listener after S seconds (new
+  connections refused; already-open keep-alives keep serving)
+
+Prints ``PORT <n> WARM_S <seconds>`` on stdout once bound (serve mode:
+once WARM; WARM_S is the ladder warmup wall — the cold/warm
+compile-cache delta the bench records), then serves until stdin closes
+(the parent owns the lifetime; no signals needed).
 """
 
 import argparse
 import sys
 
 
-def _serve_worker(args) -> int:
-    """Planted-model serving worker → bound port (ladder pre-warmed)."""
+def _parse_chaos(spec: str) -> dict:
+    """``--chaos`` grammar → {kill_after_s, stall_after_s, refuse_after_s,
+    latency_ms, latency_prob} (absent hooks None)."""
+    out = {"kill_after_s": None, "stall_after_s": None,
+           "refuse_after_s": None, "latency_ms": None,
+           "latency_prob": None}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, value = part.partition("=")
+        if name == "kill-after":
+            out["kill_after_s"] = float(value)
+        elif name == "stall-after":
+            out["stall_after_s"] = float(value)
+        elif name == "refuse-after":
+            out["refuse_after_s"] = float(value)
+        elif name == "latency-spike":
+            ms, _, prob = value.partition(":")
+            out["latency_ms"] = float(ms)
+            out["latency_prob"] = float(prob) if prob else 1.0
+        else:
+            raise ValueError(f"unknown chaos hook {name!r}")
+    return out
+
+
+def _chaos_wrap(handle, chaos: dict, rng, clock):
+    """Wrap the scheduler's handle_batch with the dispatch-level chaos
+    hooks (stall / latency-spike). ``clock()`` is seconds since serving
+    started; process-level hooks (kill/refuse) arm in _serve_worker."""
+    import time as _time
+
+    stall_after = chaos.get("stall_after_s")
+    latency_ms = chaos.get("latency_ms")
+    latency_prob = chaos.get("latency_prob") or 0.0
+
+    def wrapped(bodies):
+        if stall_after is not None and clock() >= stall_after:
+            # wedged worker: accepted the work, never answers — the
+            # front door's attempt timeout is what rescues the query
+            _time.sleep(3600.0)
+        if latency_ms is not None and rng.random() < latency_prob:
+            _time.sleep(latency_ms / 1000.0)
+        return handle(bodies)
+
+    return wrapped
+
+
+def _serve_worker(args) -> tuple:
+    """Planted-model serving worker → (bound port, ladder warmup wall
+    seconds). The port is announced only after warmup: a worker is not
+    IN the fleet until it can serve without compiling."""
     import threading
+    import time
 
     import numpy as np
 
@@ -61,15 +132,20 @@ def _serve_worker(args) -> int:
 
     rng = np.random.default_rng(args.seed)
     n_users, n_items, rank = args.users, args.items, args.rank
-    model = ALSModel(
-        user_factors=jnp.asarray(
-            rng.normal(0, 0.3, (n_users, rank)).astype(np.float32)),
-        item_factors=jnp.asarray(
-            rng.normal(0, 0.3, (n_items, rank)).astype(np.float32)),
-        user_bimap=BiMap({f"u{i}": i for i in range(n_users)}),
-        item_bimap=BiMap({f"i{i}": i for i in range(n_items)}),
-        item_years={}, item_categories={},
-    )
+
+    def plant_model(seed: int) -> ALSModel:
+        r = np.random.default_rng(seed)
+        return ALSModel(
+            user_factors=jnp.asarray(
+                r.normal(0, 0.3, (n_users, rank)).astype(np.float32)),
+            item_factors=jnp.asarray(
+                r.normal(0, 0.3, (n_items, rank)).astype(np.float32)),
+            user_bimap=BiMap({f"u{i}": i for i in range(n_users)}),
+            item_bimap=BiMap({f"i{i}": i for i in range(n_items)}),
+            item_years={}, item_categories={},
+        )
+
+    model = plant_model(args.seed)
     algo = ALSAlgorithm(ALSAlgorithmParams(rank=rank))
     now = now_utc()
     server = PredictionServer.__new__(PredictionServer)
@@ -81,6 +157,7 @@ def _serve_worker(args) -> int:
     server.plugin_context = PluginContext()
     server.ctx = make_runtime_context(None)
     server._lock = threading.Lock()
+    server._reload_lock = threading.Lock()
     server.engine_instance = EngineInstance(
         id="fleet", status="COMPLETED", start_time=now, end_time=now,
         engine_id="fleet", engine_version="1", engine_variant="fleet",
@@ -120,6 +197,18 @@ def _serve_worker(args) -> int:
                 _time.sleep(left)
             return out
 
+    chaos = _parse_chaos(args.chaos)
+    serve_t0 = [None]
+
+    def chaos_clock() -> float:
+        return 0.0 if serve_t0[0] is None else \
+            time.monotonic() - serve_t0[0]
+
+    if chaos["stall_after_s"] is not None or chaos["latency_ms"] is not None:
+        handle = _chaos_wrap(handle, chaos,
+                             np.random.default_rng(args.seed + 7),
+                             chaos_clock)
+
     from incubator_predictionio_tpu.servers import (
         prediction_server as ps_mod,
     )
@@ -131,12 +220,54 @@ def _serve_worker(args) -> int:
         p99_fn=lambda: ps_mod._QUERY_LATENCY.quantile(0.99))
     server._feedback_poster = _AsyncPoster("feedback")
     server._log_poster = _AsyncPoster("log", workers=1)
+
+    # POST /reload support: the real route runs self.load_models(
+    # warm_before_swap=True) under _reload_lock — the planted stand-in
+    # re-plants fresh factors, warms the NEW model's ladder while the
+    # old one keeps serving (compile-cache hits: same shapes), then
+    # swaps under the serving lock. Bumped end_time resets staleness,
+    # exactly like a real instance swap.
+    reload_seq = [0]
+
+    def load_models(warm_before_swap: bool = False) -> None:
+        reload_seq[0] += 1
+        new_model = plant_model(args.seed + 1000 + reload_seq[0])
+        if warm_before_swap:
+            algo.warmup(new_model, max_batch=server.config.micro_batch)
+        with server._lock:
+            server.models = [new_model]
+            server.engine_instance = EngineInstance(
+                id=f"fleet-r{reload_seq[0]}", status="COMPLETED",
+                start_time=now_utc(), end_time=now_utc(),
+                engine_id="fleet", engine_version="1",
+                engine_variant="fleet", engine_factory="fleet")
+
+    server.load_models = load_models
     # pre-warm EVERY pow2 ladder rung (plus the singleton path) so the
     # load ramp measures serving, not XLA compiles — the zero-steady-
-    # state-recompile contract starts from here
+    # state-recompile contract starts from here. With a shared
+    # persistent compile cache (--compile-cache) the rungs load from
+    # disk and this wall collapses — the measured WARM_S delta.
+    t_warm = time.perf_counter()
     algo.warmup(model, max_batch=server.config.micro_batch)
+    warm_s = time.perf_counter() - t_warm
     port = server.http.start_background()
-    return port
+    serve_t0[0] = time.monotonic()
+    # daemon timers: a worker torn down (stdin closed) before its
+    # chaos fires must still exit promptly — a pending non-daemon
+    # Timer would pin the process until the timer ran
+    if chaos["kill_after_s"] is not None:
+        import os as _os
+
+        t = threading.Timer(chaos["kill_after_s"],
+                            lambda: _os._exit(137))
+        t.daemon = True
+        t.start()
+    if chaos["refuse_after_s"] is not None:
+        t = threading.Timer(chaos["refuse_after_s"], server.http.stop)
+        t.daemon = True
+        t.start()
+    return port, warm_s
 
 
 def main() -> None:
@@ -160,8 +291,22 @@ def main() -> None:
                     help="pad every scheduler dispatch to this wall — "
                          "the CPU sim's stand-in for an accelerator's "
                          "fixed per-dispatch cost (serve mode)")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="fleet-shared persistent XLA compile cache "
+                         "directory (serve mode join pre-warm)")
+    ap.add_argument("--chaos", default="",
+                    help="fault injection: kill-after=S, stall-after=S, "
+                         "latency-spike=MS:P, refuse-after=S "
+                         "(comma-separated; serve mode)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+
+    if args.compile_cache:
+        # before any jax work: the join pre-warm reads compiled rungs
+        # from the fleet-shared directory instead of re-compiling
+        from incubator_predictionio_tpu.utils import compile_cache
+
+        compile_cache.enable(args.compile_cache)
 
     from incubator_predictionio_tpu.obs import metrics as obs_metrics
     from incubator_predictionio_tpu.obs import trace as obs_trace
@@ -169,6 +314,7 @@ def main() -> None:
     obs_trace.enable_span_logging()
 
     srv = None
+    warm_s = 0.0
     if args.mode == "metrics":
         from incubator_predictionio_tpu.obs.http import add_metrics_route
         from incubator_predictionio_tpu.utils.http import (
@@ -195,7 +341,7 @@ def main() -> None:
         srv = HttpServer(r, "127.0.0.1", 0, name="worker")
         port = srv.start_background()
     elif args.mode == "serve":
-        port = _serve_worker(args)
+        port, warm_s = _serve_worker(args)
     else:
         from incubator_predictionio_tpu.data.storage import (
             StorageClientConfig,
@@ -213,7 +359,8 @@ def main() -> None:
                             host="127.0.0.1", port=0)
         port = srv.start_background()
 
-    print(f"PORT {port}", flush=True)
+    # extra tokens ride behind the port: existing parsers split()[1]
+    print(f"PORT {port} WARM_S {warm_s:.3f}", flush=True)
     # serve until the parent closes our stdin (its process exit does)
     sys.stdin.read()
     if srv is not None:

@@ -90,6 +90,21 @@ REQUIRED_FIELDS = {
     "fleet_shed_rate": (float, type(None)),
     "fleet_p99_flat_x": (float, type(None)),
     "fleet_recompiles_steady": (int, type(None)),
+    # fleet front-door leg (docs/production.md "Fleet front door"):
+    # the health-checked router under injected chaos — a worker killed
+    # AND a worker added mid-ramp AND a rolling fleet reload
+    # mid-traffic. None = the leg's designed deadline-skip.
+    "frontdoor_workers": (int, type(None)),
+    "frontdoor_qps": (float, type(None)),
+    "frontdoor_p99_flat_x": (float, type(None)),
+    "frontdoor_nonshed_5xx": (int, type(None)),
+    "frontdoor_shed_total": (int, type(None)),
+    "frontdoor_retries": (int, type(None)),
+    "frontdoor_reloaded": (int, type(None)),
+    "frontdoor_drain_dropped": (int, type(None)),
+    "frontdoor_join_cold_s": (float, type(None)),
+    "frontdoor_join_warm_s": (float, type(None)),
+    "frontdoor_join_to_first_dispatch_s": (float, type(None)),
     # two-stage MIPS serving leg (docs/performance.md "Two-stage MIPS
     # serving"): exhaustive-vs-two-stage per-query walls, candidates-
     # scanned fraction and the recall@20 gate at the planted large
@@ -146,6 +161,10 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
         # left to real bench rounds (CI wall budget)
         "PIO_BENCH_MIPS_ITEMS": "27000,262144",
         "PIO_BENCH_MIPS_QUERIES": "24",
+        # front-door chaos leg at CI shape: shorter stages, same chaos
+        # choreography (kill + join + rolling reload all still fire)
+        "PIO_BENCH_FRONTDOOR_STAGE_S": "5",
+        "PIO_BENCH_FRONTDOOR_RAMP_RPS": "80,80,80",
     })
     # own session so a timeout kill reaps the whole tree — otherwise the
     # claimed child outlives the parent and keeps burning CPU
@@ -260,6 +279,23 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
         assert rec["fleet_recompiles_steady"] == 0
         assert rec["fleet_shed_rate"] is not None \
             and 0.0 <= rec["fleet_shed_rate"] <= 1.0
+    # fleet front-door leg: when the leg ran, its two hard bars hold
+    # under the injected chaos — every 5xx a client saw carried the
+    # 503 + Retry-After shed contract (kills were retried to healthy
+    # peers, never leaked), and the rolling reload dropped nothing.
+    # The p99-flatness and join-speed figures are recorded for the
+    # capacity trajectory but asserted only on real bench rounds (a
+    # loaded CI box can blur sub-100ms tails).
+    if rec["frontdoor_workers"] is not None:
+        assert rec["frontdoor_workers"] >= 2
+        if rec["frontdoor_nonshed_5xx"] is not None:
+            assert rec["frontdoor_nonshed_5xx"] == 0
+        if rec["frontdoor_drain_dropped"] is not None:
+            assert rec["frontdoor_drain_dropped"] == 0
+        if rec["frontdoor_join_to_first_dispatch_s"] is not None:
+            assert rec["frontdoor_join_to_first_dispatch_s"] > 0
+        if rec["frontdoor_join_cold_s"] is not None:
+            assert rec["frontdoor_join_cold_s"] > 0
     # two-stage MIPS leg: at the ≥128k planted gate size the two-stage
     # path must beat exhaustive per query while scanning ≤ 25% of the
     # catalogue at recall@20 ≥ 0.95, with ZERO steady-state recompiles;

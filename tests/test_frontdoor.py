@@ -1,0 +1,418 @@
+"""Fleet front door: the failure state machine on FakeClock, the drain
+choreography over real sockets, and the ephemeral-bind contract.
+
+The unit layer drives ``FrontDoor.handle_query`` / ``_probe_pass``
+directly with a scripted transport (no sockets, FakeClock time), so
+every circuit/retry/shed decision is pinned deterministically:
+
+- a worker killed mid-flight retries ONCE to a healthy peer,
+- the circuit opens after N consecutive transport failures and a
+  half-open probe re-admits (with cooldown doubling on probe failure),
+- a shedding (503) worker is NOT ejected — shed ≠ unhealthy,
+- placement follows reported queue depth.
+
+The integration layer runs a real front door over real in-process
+HttpServers: rolling reload drains with ZERO dropped queries while
+client threads hammer, and priority/trace headers survive the hop.
+"""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import fleet_worker
+from incubator_predictionio_tpu.serving.frontdoor import (
+    DRAINING,
+    HALF_OPEN,
+    HEALTHY,
+    OPEN,
+    FrontDoor,
+    FrontDoorConfig,
+)
+from incubator_predictionio_tpu.utils.http import (
+    HttpServer,
+    Request,
+    Response,
+    Router,
+)
+from incubator_predictionio_tpu.utils.times import FakeClock
+
+# -- scripted-transport unit layer ------------------------------------------
+
+_STATUS_BODY = json.dumps({
+    "status": "alive",
+    "scheduler": {"engines": {"default": {"depth": 0}}}}).encode()
+
+
+def make_fd(n_workers: int, clock, script, **cfg_kw):
+    """FrontDoor with a scripted transport.
+
+    ``script(worker, method, path, headers)`` returns
+    ``(status, headers, body)`` or raises — exactly the real
+    ``_roundtrip`` contract, minus the sockets."""
+    cfg = FrontDoorConfig(**cfg_kw)
+    fd = FrontDoor([("127.0.0.1", 10000 + i) for i in range(n_workers)],
+                   cfg, clock=clock)
+
+    async def roundtrip(w, method, path, headers, body, timeout):
+        return script(w, method, path, headers)
+
+    fd._roundtrip = roundtrip
+    return fd
+
+
+def query(fd, headers=None) -> Response:
+    req = Request("POST", "/queries.json", {}, headers or {}, b"{}")
+    return asyncio.run(fd.handle_query(req))
+
+
+def test_midflight_kill_retries_once_to_healthy_peer():
+    clock = FakeClock()
+    seen = []
+
+    def script(w, method, path, headers):
+        seen.append((w.name, path))
+        if w.name == "w0":
+            raise ConnectionResetError("worker died mid-flight")
+        return 200, {"x-pio-queue-depth": "1"}, b'{"who": "w1"}'
+
+    # w0 wins the first pick (equal load, lower sequence)
+    fd = make_fd(2, clock, script)
+    resp = query(fd)
+    assert resp.status == 200 and resp.body == b'{"who": "w1"}'
+    assert fd.counts["retries"] == 1 and fd.counts["ok"] == 1
+    assert [s for s in seen if s[1] == "/queries.json"] == [
+        ("w0", "/queries.json"), ("w1", "/queries.json")]
+    w0 = fd._worker("w0")
+    assert w0.fails == 1 and w0.state == HEALTHY  # 1 < eject_failures
+
+
+def test_no_retry_when_no_healthy_peer_exists():
+    clock = FakeClock()
+
+    def script(w, method, path, headers):
+        raise ConnectionResetError("down")
+
+    fd = make_fd(1, clock, script)
+    resp = query(fd)
+    assert resp.status == 502
+    assert fd.counts["retries"] == 0 and fd.counts["failed"] == 1
+
+
+def test_circuit_opens_after_n_failures_and_half_open_readmits():
+    clock = FakeClock()
+    probe_answer = {"ok": False}
+
+    def script(w, method, path, headers):
+        if method == "GET":
+            if not probe_answer["ok"]:
+                raise ConnectionRefusedError("still down")
+            return 200, {}, _STATUS_BODY
+        raise ConnectionResetError("down")
+
+    fd = make_fd(1, clock, script, eject_failures=3, open_cooldown_s=2.0)
+    w = fd._worker("w0")
+    for _ in range(3):
+        assert query(fd).status == 502
+    assert w.state == OPEN and w.cooldown_s == 2.0
+    # ejected: placement refuses, the shed contract answers
+    resp = query(fd)
+    assert resp.status == 503 and resp.headers["Retry-After"]
+    # cooldown not elapsed: the probe pass leaves the circuit open
+    clock.advance(1.0)
+    asyncio.run(fd._probe_pass())
+    assert w.state == OPEN
+    # elapsed, but the half-open probe fails → re-open, cooldown doubles
+    clock.advance(1.5)
+    asyncio.run(fd._probe_pass())
+    assert w.state == OPEN and w.cooldown_s == 4.0
+    # next half-open probe succeeds → re-admitted, counters reset
+    probe_answer["ok"] = True
+    clock.advance(4.5)
+    asyncio.run(fd._probe_pass())
+    assert w.state == HEALTHY and w.fails == 0 and w.cooldown_s == 0.0
+
+
+def test_shedding_worker_is_not_ejected():
+    clock = FakeClock()
+
+    def script(w, method, path, headers):
+        return 503, {"retry-after": "2", "x-pio-queue-depth": "7"}, \
+            b'{"message": "Serving overloaded"}'
+
+    fd = make_fd(1, clock, script, eject_failures=3)
+    for _ in range(5):  # way past eject_failures: shed is NOT a failure
+        resp = query(fd)
+        assert resp.status == 503
+        assert resp.headers["Retry-After"] == "2"  # contract passthrough
+    w = fd._worker("w0")
+    assert w.state == HEALTHY and w.fails == 0
+    assert fd.counts["shed"] == 5 and fd.counts["retries"] == 0
+    assert w.depth == 7.0  # piggybacked depth was learned anyway
+
+
+def test_placement_follows_reported_queue_depth():
+    clock = FakeClock()
+
+    def script(w, method, path, headers):
+        return 200, {}, b"{}"
+
+    fd = make_fd(3, clock, script)
+    fd._worker("w0").depth = 5.0
+    fd._worker("w2").depth = 2.0
+    assert fd._pick().name == "w1"          # depth 0 wins
+    fd._worker("w1").in_flight = 9          # front-door in-flight counts
+    assert fd._pick().name == "w2"
+    # draining and open workers never take placements
+    fd._worker("w2").state = DRAINING
+    fd._worker("w0").state = OPEN
+    assert fd._pick().name == "w1"
+
+
+def test_retry_budget_bounds_amplification():
+    clock = FakeClock()
+
+    def script(w, method, path, headers):
+        raise ConnectionResetError("down")
+
+    # budget of 1 token and no refill income: exactly one retry total
+    fd = make_fd(2, clock, script, eject_failures=100, retry_budget=1.0)
+    assert query(fd).status == 502
+    assert query(fd).status == 502
+    assert fd.counts["retries"] == 1  # second request found no budget
+
+
+def test_rolling_reload_skips_rather_than_darkening_the_fleet():
+    """With no healthy PEER to carry traffic, the rolling reload skips
+    the worker (reported in `failed`, still serving the old model)
+    instead of draining the fleet dark."""
+    sent = []
+
+    def script(w, method, path, headers):
+        sent.append((w.name, method, path))
+        return 200, {}, _STATUS_BODY
+
+    # clock=None → real monotonic: the capacity wait must actually
+    # expire (FakeClock would spin the wait loop forever)
+    fd = make_fd(2, None, script, drain_capacity_wait_s=0.2)
+    fd._worker("w1").state = OPEN
+    out = asyncio.run(fd.rolling_reload_async())
+    assert out["reloaded"] == 0 and out["failed"] == ["w0", "w1"]
+    assert fd._worker("w0").state == HEALTHY  # never went dark
+    assert ("w0", "POST", "/reload") not in sent
+
+
+def test_importing_serving_package_registers_no_frontdoor_metrics():
+    """The lazy re-export contract: a plain prediction worker (which
+    imports serving.scheduler) must not grow empty pio_frontdoor_*
+    series on its /metrics — the families register only when the
+    frontdoor module itself is imported."""
+    import subprocess
+    import sys
+
+    code = (
+        "import incubator_predictionio_tpu.serving as s\n"
+        "from incubator_predictionio_tpu.obs.metrics import REGISTRY\n"
+        "assert 'pio_frontdoor' not in REGISTRY.expose()\n"
+        "assert s.FrontDoorConfig().eject_failures == 3\n"  # lazy path
+        "assert 'pio_frontdoor' in REGISTRY.expose()\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+# -- chaos hook grammar (tests/fleet_worker.py) -----------------------------
+
+def test_chaos_spec_grammar():
+    c = fleet_worker._parse_chaos(
+        "kill-after=5, latency-spike=50:0.25,refuse-after=9")
+    assert c["kill_after_s"] == 5.0
+    assert c["latency_ms"] == 50.0 and c["latency_prob"] == 0.25
+    assert c["refuse_after_s"] == 9.0 and c["stall_after_s"] is None
+    assert fleet_worker._parse_chaos("")["kill_after_s"] is None
+    with pytest.raises(ValueError):
+        fleet_worker._parse_chaos("explode=1")
+
+
+def test_chaos_latency_spike_wrapper_injects():
+    calls = []
+
+    class Rng:
+        def random(self):
+            return 0.0  # always below prob → always spikes
+
+    wrapped = fleet_worker._chaos_wrap(
+        lambda bodies: calls.append(bodies) or ["ok"] * len(bodies),
+        {"stall_after_s": None, "latency_ms": 5.0, "latency_prob": 0.5},
+        Rng(), lambda: 0.0)
+    t0 = time.perf_counter()
+    assert wrapped([b"{}"]) == ["ok"]
+    assert time.perf_counter() - t0 >= 0.005
+    assert calls == [[b"{}"]]
+
+
+# -- real-socket integration layer ------------------------------------------
+
+def _fake_worker(tag: str, serve_delay_s: float = 0.0):
+    """An in-process stand-in for a prediction worker: /queries.json
+    echoes the headers it saw, /reload records and succeeds, GET /
+    answers the status shape the prober parses."""
+    r = Router()
+    state = {"reloads": 0, "served": 0}
+
+    @r.post("/queries.json")
+    def q(req: Request) -> Response:
+        if serve_delay_s:
+            time.sleep(serve_delay_s)
+        state["served"] += 1
+        return Response(200, {
+            "who": tag,
+            "sawPriority": req.headers.get("x-pio-priority"),
+            "sawTrace": req.headers.get("x-pio-trace-id"),
+        }, headers={"X-PIO-Queue-Depth": "0"})
+
+    @r.get("/")
+    def status(req: Request) -> Response:
+        return Response(200, {"status": "alive", "scheduler": {
+            "engines": {"default": {"depth": 0}}}})
+
+    @r.post("/reload")
+    def reload_route(req: Request) -> Response:
+        time.sleep(0.05)  # a warm-before-swap takes real time
+        state["reloads"] += 1
+        return Response(200, {"message": "Reloaded."})
+
+    srv = HttpServer(r, "127.0.0.1", 0, name=f"fake-{tag}")
+    port = srv.start_background()
+    return srv, port, state
+
+
+@pytest.fixture
+def fleet():
+    servers = []
+
+    def build(n: int, serve_delay_s: float = 0.0):
+        for i in range(n):
+            servers.append(_fake_worker(f"t{i}", serve_delay_s))
+        fd = FrontDoor([("127.0.0.1", p) for _s, p, _st in servers],
+                       FrontDoorConfig(probe_interval_s=0.2))
+        servers.append((fd.http, None, None))  # stopped via fd.stop()
+        fd.start_background()
+        return fd, servers[:-1]
+
+    yield build
+    for srv, _p, _st in servers:
+        srv.stop()
+
+
+def test_priority_and_trace_headers_survive_the_hop(fleet):
+    fd, workers = fleet(1)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{fd.http.port}/queries.json", data=b"{}",
+        headers={"X-PIO-Priority": "7", "X-PIO-Trace-Id": "trace-pin"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        out = json.load(resp)
+        echoed = resp.headers.get("X-PIO-Trace-Id")
+    assert out["sawPriority"] == "7"
+    assert out["sawTrace"] == "trace-pin"  # worker joined the trace
+    assert echoed == "trace-pin"           # and the client got it back
+
+
+def test_rolling_reload_drains_with_zero_dropped_queries(fleet):
+    fd, workers = fleet(2, serve_delay_s=0.01)
+    port = fd.http.port
+    statuses: list = []
+    stop = threading.Event()
+
+    def client() -> None:
+        while not stop.is_set():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json", data=b"{}")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                statuses.append(resp.status)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.1)  # traffic established before the swap begins
+        out = fd.rolling_reload(timeout=60)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    # the reload swept the WHOLE fleet, dropped nothing, and every
+    # query that ran concurrently succeeded
+    assert out["reloaded"] == 2 and out["dropped"] == 0
+    assert not out["failed"] and len(out["drainS"]) == 2
+    assert all(st["reloads"] == 1 for _s, _p, st in workers)
+    assert statuses and all(s == 200 for s in statuses)
+    # the fleet is fully re-admitted
+    assert all(w["state"] == HEALTHY for w in fd.stats()["workers"])
+
+
+def test_real_kill_fails_over_and_circuit_recovers(fleet):
+    fd, workers = fleet(2)
+    port = fd.http.port
+
+    def ask() -> int:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/queries.json", data=b"{}")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            json.load(resp)
+            return resp.status
+
+    assert ask() == 200
+    workers[0][0].stop()  # hard-kill one worker's listener
+    time.sleep(0.2)
+    # every query still answers 200 (retry path), and the dead worker's
+    # circuit opens from passive failures / probes
+    for _ in range(8):
+        assert ask() == 200
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        states = {w["name"]: w["state"] for w in fd.stats()["workers"]}
+        if OPEN in states.values() or HALF_OPEN in states.values():
+            break
+        time.sleep(0.05)
+    assert OPEN in states.values() or HALF_OPEN in states.values()
+    assert fd.counts["failed"] == 0  # nothing leaked a 5xx to a client
+
+
+# -- ephemeral bind (the spawn-path contract) -------------------------------
+
+def test_ephemeral_bind_reports_kernel_assigned_port():
+    """port=0 must bind and REPORT the kernel's choice — the fleet
+    worker and front-door spawn paths key on this instead of racing
+    other processes for a pre-picked 'free' port."""
+    r = Router()
+    a = HttpServer(r, "127.0.0.1", 0)
+    b = HttpServer(r, "127.0.0.1", 0)
+    pa, pb = a.start_background(), b.start_background()
+    try:
+        assert pa != 0 and pb != 0 and pa != pb
+        assert a.port == pa and b.port == pb
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_bind_retries_remain_the_fallback_for_fixed_ports():
+    """bind_retries still rescues a FIXED port whose holder is on the
+    way out (the MasterActor 3×/1 s parity) — the fallback when an
+    operator pins ports instead of using ephemeral bind."""
+    r = Router()
+    holder = HttpServer(r, "127.0.0.1", 0)
+    port = holder.start_background()
+    contender = HttpServer(r, "127.0.0.1", port,
+                           bind_retries=10, bind_retry_delay=0.2)
+    threading.Timer(0.3, holder.stop).start()
+    try:
+        assert contender.start_background() == port
+    finally:
+        holder.stop()
+        contender.stop()

@@ -409,6 +409,47 @@ class Server:
         return score_and_top_k(self.user_vec, self.item_factors, 10)
 """,
     ),
+    "unbounded-retry": (
+        """
+import time
+
+def post_event(conn, body):
+    while True:
+        try:
+            return conn.post(body)
+        except ConnectionError:
+            # fixed delay, no deadline: every client re-offers the
+            # same load in lockstep, forever
+            time.sleep(1.0)
+""",
+        """
+import time
+from incubator_predictionio_tpu.utils.http import (
+    RetryableError,
+    RetryPolicy,
+)
+
+_POLICY = RetryPolicy(attempts=3, deadline_s=10.0)
+
+def post_event(conn, body):
+    def attempt():
+        try:
+            return conn.post(body)
+        except ConnectionError as e:
+            raise RetryableError(e) from e
+    return _POLICY.call(attempt)
+
+def poll_until_ready(probe, budget_s=10.0):
+    # a sleep with a COMPUTED delay in a loop that swallows nothing is
+    # a poll, not a retry loop; and backoff expressions stay silent
+    delay = 0.05
+    for _ in range(int(budget_s / delay)):
+        if probe():
+            return True
+        time.sleep(delay)
+    return False
+""",
+    ),
     "metric-label-cardinality": (
         """
 from incubator_predictionio_tpu.obs import metrics

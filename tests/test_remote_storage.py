@@ -13,6 +13,7 @@ process — the actual two-process topology, not a loopback simulation.
 import os
 import subprocess
 import sys
+import threading
 import time
 from datetime import timedelta
 
@@ -189,8 +190,10 @@ def test_typed_errors_cross_the_wire(shared_server):
 
 def test_real_two_process_topology(tmp_path):
     """Spawn `pio storageserver` as a CHILD PROCESS (sqlite-backed) and run
-    a client from this process — the actual box-A/box-B deployment."""
-    port = _free_port()
+    a client from this process — the actual box-A/box-B deployment.
+    ``--port 0``: the CHILD binds an ephemeral port and announces the
+    kernel's choice — no pre-picked "free" port to race other
+    processes for (utils/http.py HttpServer ephemeral-bind contract)."""
     env = dict(
         os.environ,
         PIO_STORAGE_SOURCES_DISK_TYPE="sqlite",
@@ -199,10 +202,11 @@ def test_real_two_process_topology(tmp_path):
     )
     proc = subprocess.Popen(
         [sys.executable, "-m", "incubator_predictionio_tpu.cli.main",
-         "storageserver", "--ip", "127.0.0.1", "--port", str(port),
+         "storageserver", "--ip", "127.0.0.1", "--port", "0",
          "--source", "DISK"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     try:
+        port = _parse_announced_port(proc)
         _wait_alive(port, proc)
         client = _client(port)
         events = remote_backend.RemoteEvents(client, client.config,
@@ -217,12 +221,29 @@ def test_real_two_process_topology(tmp_path):
         proc.wait(timeout=10)
 
 
-def _free_port() -> int:
-    import socket
+def _parse_announced_port(proc, timeout: float = 60.0) -> int:
+    """Read the storageserver CLI's post-bind announcement line →
+    the kernel-assigned port (the ephemeral-bind handshake)."""
+    holder: list = []
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    def read() -> None:
+        # stderr is merged into stdout: skip any warning/log chatter
+        # until the announcement line (or EOF) shows up
+        while True:
+            line = proc.stdout.readline().decode(errors="replace")
+            if not line:
+                return
+            if "http://" in line:
+                holder.append(int(line.rsplit(":", 1)[1]))
+                return
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout=timeout)
+    if not holder:
+        proc.kill()
+        raise AssertionError("storageserver never announced its port")
+    return holder[0]
 
 
 def _wait_alive(port: int, proc, timeout: float = 30.0) -> None:

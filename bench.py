@@ -1956,6 +1956,278 @@ def bench_frontdoor(budget_s: float) -> dict:
     return out
 
 
+#: self-driving freshness leg (docs/production.md "Self-driving
+#: freshness"): the SLO-burn controller alone — zero human retrains —
+#: holds fleet staleness under the declared bound across a compressed
+#: serve-while-aging ramp, every action audit-trailed under a trace ID
+#: that reaches the rolling-reload spans
+CONTROLLER_KEYS = (
+    "controller_workers", "controller_staleness_bound_s",
+    "controller_staleness_max_s", "controller_staleness_held",
+    "controller_actions", "controller_decision_to_fresh_s",
+    "controller_false_triggers", "controller_trace_linked",
+    "controller_evaluations",
+)
+
+
+def _controller_staleness(port: int):
+    """One worker /metrics scrape → its pio_model_staleness_seconds
+    reading (None when unscrapeable — a draining worker mid-reload)."""
+    import urllib.request
+
+    from incubator_predictionio_tpu.obs import expofmt
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+    except Exception:
+        return None
+    _meta, samples = expofmt.parse_exposition(text)
+    v = samples.get(("pio_model_staleness_seconds", frozenset()))
+    return float(v) if v is not None else None
+
+
+def bench_controller(budget_s: float) -> dict:
+    """Self-driving freshness leg: two planted fleet workers behind the
+    front door, the freshness controller (obs/controller.py) in ``act``
+    mode over a COMPRESSED staleness bound, and NO human retrains. The
+    controller consumes the fleet staleness gauge through the federated
+    SLO engine, projects headroom, and must trigger its continuation-
+    retrain + rolling-hot-swap choreography early enough that the
+    sampled fleet-max staleness never crosses the bound
+    (``controller_staleness_held``). Each action's decision record
+    carries a trace ID; the leg verifies it reached the front door's
+    reload hop (``controller_trace_linked``) — the audit-trail
+    acceptance bar. ``controller_false_triggers`` counts actions fired
+    while staleness was still under half the bound (none expected:
+    hysteresis + the horizon rule exist to prevent exactly that).
+
+    The retrain actuator here is a planted stand-in (the O(delta)
+    continuation-retrain wall is bench_retrain's claim; this leg
+    measures the CONTROL LOOP) and the model swap is the workers' real
+    warm-before-swap ``/reload`` through the front door's rolling
+    choreography. Guarded like the other fleet legs: any failure nulls
+    the controller_* keys, never the record."""
+    import asyncio
+    import logging as _logging
+    import threading
+
+    from incubator_predictionio_tpu.obs import federate
+    from incubator_predictionio_tpu.obs import slo as obs_slo
+    from incubator_predictionio_tpu.obs.controller import (
+        ControllerConfig,
+        FreshnessController,
+        http_reload_fn,
+    )
+    from incubator_predictionio_tpu.serving.frontdoor import (
+        FrontDoor,
+        FrontDoorConfig,
+    )
+
+    out = dict.fromkeys(CONTROLLER_KEYS)
+    if budget_s < 120.0:
+        log("controller leg skipped: bench deadline too close")
+        return out
+    leg_deadline = time.monotonic() + min(
+        budget_s - 45.0,
+        float(os.environ.get("PIO_BENCH_CONTROLLER_TIMEOUT_S", "180")))
+
+    def left(cap: float) -> float:
+        return max(min(cap, leg_deadline - time.monotonic()), 5.0)
+
+    bound_s = float(os.environ.get("PIO_BENCH_CONTROLLER_BOUND_S", "10"))
+    run_s = float(os.environ.get("PIO_BENCH_CONTROLLER_RUN_S", "30"))
+    rate = float(os.environ.get("PIO_BENCH_CONTROLLER_RPS", "30"))
+    out["controller_staleness_bound_s"] = bound_s
+
+    workers = _fleet_spawn(2, floor_ms=0.0)
+    fd = None
+    ctl = None
+    # defined before the try so the finally can always stop the
+    # sampler: a mid-leg failure must not leak a daemon thread
+    # scraping dead worker ports for the rest of the bench run
+    sample_stop = threading.Event()
+    sampler_t = None
+    # in-process span capture: the trace-linkage bar needs the front
+    # door's /reload span lines, which land on the pio.trace logger of
+    # THIS process (the workers' spans live in their own stderr)
+    spans: list = []
+
+    class _SpanTap(_logging.Handler):
+        def emit(self, record: _logging.LogRecord) -> None:
+            try:
+                spans.append(json.loads(record.getMessage()))
+            except Exception:
+                pass
+
+    tap = _SpanTap()
+    span_logger = _logging.getLogger("pio.trace")
+    prev_level = span_logger.level
+    span_logger.addHandler(tap)
+    span_logger.setLevel(_logging.INFO)
+    try:
+        fd = FrontDoor(
+            [("127.0.0.1", p) for _proc, p in workers],
+            FrontDoorConfig(request_timeout_s=8.0,
+                            attempt_timeout_s=3.0,
+                            probe_interval_s=0.25,
+                            drain_timeout_s=10.0,
+                            reload_timeout_s=60.0))
+        fport = fd.start_background()
+        # initial deploy: the workers have been aging since their spawn
+        # walls (ladder warmup), so swap in a fresh model before the
+        # measured ramp — the run then starts the way a real deploy
+        # does, and every staleness excursion the sampler sees is the
+        # CONTROLLER's to prevent
+        fd.rolling_reload(timeout=left(60.0))
+
+        # the controller's fleet view: the two workers (staleness
+        # gauge) plus the front door itself (client-observed
+        # pio_query_latency_seconds — the serve_p99 objective evaluates
+        # what clients saw through the door)
+        targets = [federate.Target(f"w{i}",
+                                   f"http://127.0.0.1:{p}/metrics")
+                   for i, (_proc, p) in enumerate(workers)]
+        targets.append(federate.Target(
+            "frontdoor", f"http://127.0.0.1:{fport}/metrics"))
+        engine = obs_slo.SLOEngine(
+            specs=(
+                obs_slo.SLOSpec(
+                    name="staleness",
+                    metric="pio_model_staleness_seconds",
+                    threshold=bound_s, target=0.99, kind="gauge",
+                    description="compressed bench staleness bound"),
+                obs_slo.SLOSpec(
+                    name="serve_p99",
+                    metric="pio_query_latency_seconds",
+                    threshold=0.25, target=0.99,
+                    description="front-door-observed serving wall"),
+            ),
+            registry=federate.FleetRegistry(
+                targets_fn=lambda: targets, max_age_s=0.1),
+            min_tick_interval_s=0.0, export_gauges=False)
+
+        def planted_retrain() -> str:
+            # continuation-retrain stand-in: the O(delta) retrain wall
+            # is bench_retrain's pinned claim; this leg measures the
+            # control loop + swap choreography around it
+            time.sleep(0.2)
+            return "planted-continuation"
+
+        ctl = FreshnessController(
+            engine=engine,
+            retrain_fn=planted_retrain,
+            reload_fn=http_reload_fn(
+                f"http://127.0.0.1:{fport}/reload", timeout_s=60.0),
+            config=ControllerConfig(
+                interval_s=0.5, breach_evals=2,
+                cooldown_s=4.0, horizon_s=0.4 * bound_s, ring=1024),
+            mode="act")
+        ctl.start()
+
+        # serve-while-aging ramp: open-loop load through the front door
+        # while a sampler tracks the fleet-max staleness the whole time
+        samples: list = []
+
+        def sampler() -> None:
+            while not sample_stop.is_set():
+                vals = [_controller_staleness(p)
+                        for _proc, p in workers]
+                vals = [v for v in vals if v is not None]
+                if vals:
+                    samples.append((time.time(), max(vals)))
+                sample_stop.wait(0.25)
+
+        sampler_t = threading.Thread(target=sampler, daemon=True)
+        sampler_t.start()
+        results: list = []
+
+        async def load() -> None:
+            await _fleet_open_loop(fport, rate, run_s, results,
+                                   period_s=2.0)
+
+        asyncio.run(asyncio.wait_for(load(),
+                                     timeout=left(max(4 * run_s, 60.0))))
+        sample_stop.set()
+        sampler_t.join(timeout=10)
+        ctl.stop()
+
+        stats = ctl.stats()
+        actions = [d for d in ctl.decisions(limit=1024)
+                   if d.get("kind") == "evaluation"
+                   and (d.get("outcome") or {}).get("actuated")]
+        out["controller_workers"] = len(workers)
+        out["controller_actions"] = stats["actions"]
+        out["controller_evaluations"] = sum(
+            1 for d in ctl.decisions(limit=1024)
+            if d.get("kind") == "evaluation")
+        if samples:
+            peak = max(v for _t, v in samples)
+            out["controller_staleness_max_s"] = round(peak, 2)
+            out["controller_staleness_held"] = bool(peak <= bound_s)
+        # false trigger = an action fired while the fleet was
+        # MEASURABLY still comfortably fresh (under half the bound) —
+        # hysteresis and the horizon rule exist to make this zero. An
+        # unscrapeable gauge (None: both workers mid-drain) is not
+        # evidence of freshness, so it never counts as false
+        out["controller_false_triggers"] = sum(
+            1 for d in actions
+            if (d.get("inputs") or {}).get("stalenessMaxS") is not None
+            and d["inputs"]["stalenessMaxS"] < 0.5 * bound_s)
+        # decision → fresh: decision wall stamp to the first staleness
+        # sample showing the swap landed (fleet max back under the
+        # trigger point)
+        walls = []
+        for d in actions:
+            t0 = d["ts"]
+            trigger_level = (d.get("inputs") or {}).get(
+                "stalenessMaxS") or bound_s
+            after = [(t, v) for t, v in samples if t > t0]
+            for t, v in after:
+                if v < min(trigger_level, 0.5 * bound_s):
+                    walls.append(t - t0)
+                    break
+        if walls:
+            out["controller_decision_to_fresh_s"] = round(
+                float(np.median(walls)), 2)
+        # audit-trail bar: every action's trace ID shows up on the
+        # front door's /reload HTTP span — the CROSS-HOP evidence (the
+        # controller's own controller.reload span would be emitted even
+        # if header forwarding broke, so it deliberately does not
+        # count; worker-side propagation is pinned in
+        # tests/test_controller.py)
+        if actions:
+            linked = []
+            for d in actions:
+                tid = d["traceId"]
+                linked.append(any(
+                    s.get("traceId") == tid
+                    and s.get("span") == "http.request"
+                    and s.get("server") == "frontdoor"
+                    and s.get("route") == "/reload"
+                    for s in spans))
+            out["controller_trace_linked"] = all(linked)
+    finally:
+        sample_stop.set()
+        if sampler_t is not None:
+            sampler_t.join(timeout=10)
+        span_logger.removeHandler(tap)
+        span_logger.setLevel(prev_level)
+        if ctl is not None:
+            ctl.stop()
+        if fd is not None:
+            fd.stop()
+        _fleet_teardown(workers)
+    log(f"controller: actions={out['controller_actions']} "
+        f"staleness_max={out['controller_staleness_max_s']}s "
+        f"(bound {bound_s}s, held={out['controller_staleness_held']}) "
+        f"decision_to_fresh={out['controller_decision_to_fresh_s']}s "
+        f"false_triggers={out['controller_false_triggers']} "
+        f"trace_linked={out['controller_trace_linked']}")
+    return out
+
+
 def bench_scan_probe(store_dir: str) -> dict:
     """Sequential vs sharded event-log scan at bench scale, projection
     cache bypassed, plus the pipelined scan→prep leg — the host-pipeline
@@ -2559,6 +2831,9 @@ def run_orchestrator() -> None:
         # fleet front-door leg (parent-side router over worker
         # subprocesses; docs/production.md "Fleet front door")
         **dict.fromkeys(FRONTDOOR_KEYS),
+        # self-driving freshness leg (controller over fleet workers +
+        # front door; docs/production.md "Self-driving freshness")
+        **dict.fromkeys(CONTROLLER_KEYS),
         "accel_waited_s": None,
         "accel_outcome": "never_available",
         "sasrec_epoch_s": None,
@@ -2689,6 +2964,14 @@ def run_orchestrator() -> None:
         record.update(bench_frontdoor(emit_by - time.monotonic()))
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"frontdoor leg failed ({e!r}); frontdoor_* keys null "
+            "this round")
+
+    # -- 6d3. SELF-DRIVING FRESHNESS LEG (host CPU, controller over
+    #         fleet workers + front door; zero human retrains) ------------
+    try:
+        record.update(bench_controller(emit_by - time.monotonic()))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"controller leg failed ({e!r}); controller_* keys null "
             "this round")
 
     # -- 6e. TWO-STAGE MIPS SERVING LEG (in-process; planted catalogue

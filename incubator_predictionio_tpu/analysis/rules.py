@@ -19,7 +19,7 @@ from __future__ import annotations
 import ast
 import re
 from pathlib import Path
-from typing import Iterator, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from incubator_predictionio_tpu.analysis.engine import (
     CONFIG_MODULE_RE,
@@ -1170,6 +1170,79 @@ class UnboundedRetry(Rule):
             stack.extend(ast.iter_child_nodes(n))
 
 
+# ---------------------------------------------------------------------------
+# 18. fleet actuation outside the decision-record emitter
+# ---------------------------------------------------------------------------
+
+#: the retrain/reload actuator surface reachable from the freshness
+#: controller: the workflow's training entry, the front door's rolling
+#: reload, and the controller's own injected actuator callables
+_ACTUATION_CALLS = {
+    "run_train", "rolling_reload", "rolling_reload_async",
+    "retrain_fn", "reload_fn", "_retrain_fn", "_reload_fn",
+}
+
+
+class UnauditedActuation(Rule):
+    name = "unaudited-actuation"
+    severity = "error"
+    doc = ("call into a retrain/reload actuator (CoreWorkflow."
+           "run_train, FrontDoor.rolling_reload, or the controller's "
+           "injected retrain_fn/reload_fn callables) from "
+           "obs/controller.py OUTSIDE the decision-record emitter — "
+           "every fleet actuation must flow through "
+           "FreshnessController._actuate, which runs it inside the "
+           "decision's trace context and writes the outcome into the "
+           "audit ring; an actuation anywhere else is a fleet mutation "
+           "nothing audited (actuator FACTORIES — functions named "
+           "*_fn building the callables the emitter later invokes — "
+           "are the sanctioned construction sites)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        rel = f"/{mod.relpath}".replace("\\", "/")
+        if not rel.endswith("/obs/controller.py"):
+            return
+        # map every call to its enclosing function-def stack
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else (node.func.id
+                          if isinstance(node.func, ast.Name) else ""))
+            rname = mod.resolved(node.func) or ""
+            tail = rname.rsplit(".", 1)[-1] if rname else ""
+            if attr not in _ACTUATION_CALLS \
+                    and tail not in _ACTUATION_CALLS:
+                continue
+            # sanctioned scopes: the emitter itself (_actuate, nested
+            # defs included) and actuator factories (*_fn) whose
+            # closures the emitter invokes later
+            sanctioned = False
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    if cur.name == "_actuate" \
+                            or cur.name.endswith("_fn"):
+                        sanctioned = True
+                        break
+                cur = parents.get(cur)
+            if sanctioned:
+                continue
+            what = rname or attr
+            yield mod.finding(
+                self, node,
+                f"actuator call `{what}()` outside the decision-record "
+                "emitter — route fleet retrain/reload through "
+                "FreshnessController._actuate so the action lands in "
+                "the audit ring under its decision's trace ID")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -1188,6 +1261,7 @@ ALL_RULES: Sequence[Rule] = (
     UnbatchedDispatch(),
     ExhaustiveScan(),
     UnboundedRetry(),
+    UnauditedActuation(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -26,7 +26,11 @@ read). This package is the one coherent layer over all of them:
   ``/metrics`` under an ``instance`` label (admin ``GET /federate``,
   fleet-mode SLOs);
 - :mod:`.capacity` — the offline capacity/regression model over the
-  checked-in bench trajectory (``scripts/capacity_report.py``).
+  checked-in bench trajectory (``scripts/capacity_report.py``);
+- :mod:`.controller` — the self-driving freshness controller: consumes
+  the fleet SLO burn rates, projects error-budget exhaustion, and
+  autonomously triggers continuation retrain + rolling hot swap with a
+  trace-linked decision audit trail (admin ``GET/POST /controller``).
 
 See ``docs/observability.md`` for the metric catalog and the scrape /
 trace-propagation / fleet contracts.

@@ -55,6 +55,11 @@ def _fmt(v: float) -> str:
         return str(v)
     if math.isinf(v):
         return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        # Prometheus's explicit no-data sample value (the controller's
+        # projection gauge goes NaN when no driving signal projects) —
+        # int() on it would raise and take down the whole scrape
+        return "NaN"
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return repr(v)
@@ -452,10 +457,11 @@ class Registry:
         with self._lock:
             self._collectors.pop(name, None)
 
-    def expose(self) -> str:
-        """Prometheus text exposition of every metric, after running
-        the collectors (a failing collector logs and is skipped — a
-        broken bridge must never take down the scrape)."""
+    def run_collectors(self) -> None:
+        """Run the scrape-time collectors without rendering (the
+        freshness controller reads collector-fed gauges — model
+        staleness, queue depth — between scrapes; a failing collector
+        logs and is skipped, same contract as ``expose``)."""
         with self._lock:
             collectors = list(self._collectors.items())
         for cname, fn in collectors:
@@ -463,6 +469,12 @@ class Registry:
                 fn()
             except Exception:
                 logger.exception("metrics collector %r failed", cname)
+
+    def expose(self) -> str:
+        """Prometheus text exposition of every metric, after running
+        the collectors (a failing collector logs and is skipped — a
+        broken bridge must never take down the scrape)."""
+        self.run_collectors()
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         out: List[str] = []

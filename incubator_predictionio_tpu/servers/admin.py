@@ -4,12 +4,20 @@ Parity: tools/.../admin/AdminAPI.scala:38-160 + CommandClient.scala on
 :7071 — ``GET /`` status, ``GET /cmd/app`` list, ``POST /cmd/app`` create
 (generates a default access key like the CLI), ``DELETE /cmd/app/{name}``,
 ``DELETE /cmd/app/{name}/data``.
+
+Beyond parity, the admin process is the fleet's control-plane brain: it
+hosts the self-driving freshness controller (obs/controller.py) —
+``GET /controller`` serves the decision audit trail, ``POST
+/controller`` is the live kill switch — alongside ``/federate``,
+``/slo`` and ``/profile``.
 """
 
 from __future__ import annotations
 
 import logging
 from typing import Optional
+
+from typing import TYPE_CHECKING
 
 from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
 from incubator_predictionio_tpu.obs.http import (
@@ -18,6 +26,11 @@ from incubator_predictionio_tpu.obs.http import (
     add_profile_route,
     add_slo_route,
 )
+
+if TYPE_CHECKING:  # pragma: no cover
+    from incubator_predictionio_tpu.obs.controller import (
+        FreshnessController,
+    )
 from incubator_predictionio_tpu.utils.annotations import experimental
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
@@ -31,11 +44,24 @@ logger = logging.getLogger(__name__)
 
 @experimental
 class AdminServer:
-    def __init__(self, ip: str = "127.0.0.1", port: int = 7071):
+    def __init__(self, ip: str = "127.0.0.1", port: int = 7071,
+                 controller: "FreshnessController" = None):
         self.apps = Storage.get_meta_data_apps()
         self.access_keys = Storage.get_meta_data_access_keys()
         self.channels = Storage.get_meta_data_channels()
         self.events = Storage.get_events()
+        # the self-driving freshness controller (obs/controller.py):
+        # the admin process hosts its evaluation loop and exposes its
+        # decision audit trail. A custom-wired instance (retrain/reload
+        # actuators, bench harnesses) can be injected; the default is
+        # the env-wired process controller.
+        if controller is None:
+            from incubator_predictionio_tpu.obs.controller import (
+                get_controller,
+            )
+
+            controller = get_controller()
+        self.controller = controller
         self.http = HttpServer.from_conf(self._build_router(), ip, port,
                                          name="admin")
 
@@ -108,6 +134,39 @@ class AdminServer:
             self.events.init(app.id)
             return Response(200, {"message": f"App {app.name} data deleted."})
 
+        @r.get("/controller")
+        def controller_state(request: Request) -> Response:
+            # the decision audit trail: current state + the bounded
+            # ring, newest first (?limit=N, default 50)
+            try:
+                limit = int(request.query.get("limit", "50"))
+            except ValueError:
+                return Response(400,
+                                {"message": "limit must be an integer"})
+            return Response(200, {
+                **self.controller.stats(),
+                "decisions": self.controller.decisions(limit=limit),
+            })
+
+        @r.post("/controller")
+        def controller_mode(request: Request) -> Response:
+            # the LIVE kill switch: {"mode": "off"|"observe"|"act"}
+            # takes effect within one evaluation interval
+            try:
+                body = request.json()
+            except ValueError as e:
+                return Response(400, {"message": str(e)})
+            if not isinstance(body, dict):
+                return Response(400, {
+                    "message": 'body must be a JSON object like '
+                               '{"mode": "off"|"observe"|"act"}'})
+            try:
+                mode = self.controller.set_mode(body.get("mode", ""))
+            except ValueError as e:
+                return Response(400, {"message": str(e)})
+            return Response(200, {"mode": mode,
+                                  **self.controller.stats()})
+
         add_metrics_route(r)
         # GET /federate: scrape the PIO_FLEET_TARGETS workers' /metrics
         # and re-expose the merged fleet series under an `instance`
@@ -126,10 +185,17 @@ class AdminServer:
         return r
 
     def start_background(self) -> int:
-        return self.http.start_background()
+        port = self.http.start_background()
+        # the loop runs in every mode (an off controller idles its
+        # tick), so a live POST /controller flip to act resumes
+        # actuation within one interval with no restart
+        self.controller.start()
+        return port
 
     async def serve_forever(self) -> None:
+        self.controller.start()
         await self.http.serve_forever()
 
     def stop(self) -> None:
+        self.controller.stop()
         self.http.stop()

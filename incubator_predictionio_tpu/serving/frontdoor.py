@@ -51,7 +51,11 @@ workers:
 
 Exported series: ``pio_frontdoor_requests_total{worker,outcome}``,
 ``pio_frontdoor_retries_total``, ``pio_frontdoor_worker_healthy{worker}``,
-``pio_frontdoor_drain_seconds`` (docs/observability.md;
+``pio_frontdoor_drain_seconds``, plus the client-observed
+``pio_query_latency_seconds`` — list the front door in
+``PIO_FLEET_TARGETS`` and the fleet ``/slo`` serve_p99 objective
+evaluates what clients actually saw through the door, not just
+per-worker dispatch walls (docs/observability.md;
 docs/production.md "Fleet front door").
 """
 
@@ -98,6 +102,14 @@ _HEALTHY = obs_metrics.REGISTRY.gauge(
 _DRAIN_SECONDS = obs_metrics.REGISTRY.histogram(
     "pio_frontdoor_drain_seconds",
     "wall from placement stop to in-flight zero during a rolling reload")
+#: the CLIENT-OBSERVED per-query wall: placement + worker roundtrip +
+#: any retry, booked into the same family the workers book their batch
+#: walls into — so a front door listed in PIO_FLEET_TARGETS makes the
+#: fleet /slo serve_p99 objective evaluate what clients actually saw
+#: (queueing at the door included), not just per-worker dispatch walls
+_FD_LATENCY = obs_metrics.REGISTRY.histogram(
+    "pio_query_latency_seconds",
+    "per-query serving wall (micro-batch members share the batch wall)")
 
 #: health states (module constants, not enum — they serialize into
 #: /status JSON and tests compare strings)
@@ -468,7 +480,8 @@ class FrontDoor:
         """Place /queries.json on a worker; bounded single retry to a
         DIFFERENT worker on transport failure (idempotent — a query
         reads model state), under the overall request deadline."""
-        deadline = self._clock() + self.config.request_timeout_s
+        t_start = self._clock()
+        deadline = t_start + self.config.request_timeout_s
         fwd_headers = {"Content-Type": request.headers.get(
             "content-type", "application/json")}
         prio = request.headers.get("x-pio-priority")
@@ -543,6 +556,12 @@ class FrontDoor:
             else:
                 self.counts["ok"] += 1
                 _REQUESTS.labels(worker=w.name, outcome="ok").inc()
+                # served queries only: a shed answers in microseconds
+                # and booking it would deflate the very p99 the shed
+                # exists to protect (same rule as the workers, whose
+                # scheduler books served batches only)
+                _FD_LATENCY.observe(
+                    max(self._clock() - t_start, 0.0))
             out_headers = {}
             for h in ("retry-after", "x-pio-queue-depth"):
                 if h in hdrs:
@@ -579,6 +598,14 @@ class FrontDoor:
             key = self.config.server_key
             path = "/reload" + (
                 f"?accessKey={quote(key, safe='')}" if key else "")
+            # trace contract: a reload triggered by a traced request
+            # (the freshness controller's POST /reload, an operator's
+            # curl with a trace header) forwards its trace ID + this
+            # hop's span to every worker reload — the decision →
+            # rolling-swap tree scripts/trace_stitch.py --decisions
+            # reconstructs. Captured once here: every worker's swap
+            # belongs to the ONE choreography that caused it.
+            reload_headers = dict(obs_trace.client_headers())
             for name in [w.name for w in list(self.workers)]:
                 w = self._worker(name)
                 if w is None or w.state not in (HEALTHY, HALF_OPEN):
@@ -606,7 +633,7 @@ class FrontDoor:
                 out["dropped"] += stuck
                 try:
                     status, _hdrs, _body = await self._roundtrip(
-                        w, "POST", path, {}, b"",
+                        w, "POST", path, reload_headers, b"",
                         self.config.reload_timeout_s)
                 except (OSError, asyncio.TimeoutError,
                         asyncio.IncompleteReadError) as e:

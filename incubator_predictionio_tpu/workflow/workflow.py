@@ -119,8 +119,18 @@ class CoreWorkflow:
         params: Optional[WorkflowParams] = None,
         ctx: Optional[RuntimeContext] = None,
         env: Optional[dict] = None,
+        prev_models: Optional[List[Any]] = None,
     ) -> str:
         """Train, checkpoint, register. Returns the engine instance ID.
+
+        ``prev_models`` is the explicit continuation seam: when given,
+        those models seed the O(delta) continuation retrain directly —
+        for callers that already hold (and vouch for) a compatible
+        model, bypassing the instance lookup AND its strict
+        params-equality auto-disable; when None — the normal path, and
+        what the freshness controller's retrain actuator uses — the
+        last COMPLETED instance's models are loaded, guarded by the
+        auto-disable (:func:`_continuation_models`).
 
         In a multi-process pod (`pio train --hosts`, or an
         externally-provisioned jax.distributed runtime) every process
@@ -135,6 +145,16 @@ class CoreWorkflow:
         from incubator_predictionio_tpu.parallel import distributed
 
         pod = distributed.is_multihost()
+        if pod and prev_models is not None:
+            # pod models are sharded and the continuation prefix
+            # mapping is per-host — the seed cannot apply. Say so
+            # loudly: the caller (the freshness controller's retrain
+            # actuator) budgeted for an O(delta) wall and is getting a
+            # cold full train instead.
+            logger.warning(
+                "prev_models ignored on the multi-host pod path "
+                "(continuation retrain is single-host); training fresh")
+            prev_models = None
         pre_trained = _UNSET
         # captured before the (possibly hours-long) pod training leg so the
         # persisted instance's start→end span covers training even though
@@ -218,8 +238,7 @@ class CoreWorkflow:
             # the first tracer.activate(); don't start the profiler again
             # over the cached models — it would emit an empty extra trace
             with tracer.activate(profile=pre_trained is _UNSET):
-                prev_models = None
-                if pre_trained is _UNSET:
+                if pre_trained is _UNSET and prev_models is None:
                     # continuation seed (single-host only — pod models are
                     # sharded and the prefix mapping is per-host): timed as
                     # its own phase so /metrics shows the seed-load leg

@@ -16,6 +16,15 @@ joins them back into one tree:
     # summarize every trace seen in the logs
     python scripts/trace_stitch.py logs/*.log --list
 
+``--decisions`` is the freshness controller's audit view
+(obs/controller.py): one tree per ``controller.decision`` root span,
+stitched to the cross-process retrain/reload subtree its trace ID
+reached — "burn spike → decision → retrain → rolling swap" as one
+timeline. Actuation spans (``controller.retrain`` /
+``controller.reload``) whose trace carries NO decision root are
+**orphans** — an actuation nothing audited — and surface loudly on
+stderr with exit code 1.
+
 Lines that are not JSON span objects (ordinary log output) are skipped,
 so the tool can eat raw mixed stderr streams. Ordering inside a trace
 uses the per-line wall stamp (``ts``); cross-process skew at request
@@ -28,7 +37,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, Iterable, List, Optional, TextIO
+from typing import Dict, Iterable, List, Optional, TextIO, Tuple
 
 
 def parse_span_lines(lines: Iterable[str]) -> List[dict]:
@@ -118,6 +127,77 @@ def render_trace(trace_id: str, spans: List[dict],
     return text
 
 
+#: span names the freshness controller emits around an actuation —
+#: these must never appear in a trace without a controller.decision
+#: root (the decision-record contract, obs/controller.py)
+DECISION_SPAN = "controller.decision"
+ACTUATION_SPAN_PREFIX = "controller."
+
+
+def find_decisions(traces: Dict[str, List[dict]]
+                   ) -> List[Tuple[str, dict]]:
+    """(trace_id, decision span) for every controller.decision span,
+    oldest first."""
+    out: List[Tuple[str, dict]] = []
+    for tid, spans in traces.items():
+        for s in spans:
+            if s.get("span") == DECISION_SPAN:
+                out.append((tid, s))
+    out.sort(key=lambda p: float(p[1].get("ts") or 0.0))
+    return out
+
+
+def find_orphan_actuations(traces: Dict[str, List[dict]]) -> List[dict]:
+    """Actuation spans (controller.retrain / controller.reload / any
+    controller.* that is not the decision itself) in traces with NO
+    controller.decision span: an actuation record nothing audited."""
+    orphans: List[dict] = []
+    for _tid, spans in traces.items():
+        has_decision = any(s.get("span") == DECISION_SPAN for s in spans)
+        if has_decision:
+            continue
+        orphans.extend(
+            s for s in spans
+            if str(s.get("span", "")).startswith(ACTUATION_SPAN_PREFIX))
+    orphans.sort(key=lambda s: float(s.get("ts") or 0.0))
+    return orphans
+
+
+def render_decisions(traces: Dict[str, List[dict]],
+                     out: Optional[TextIO] = None,
+                     err: Optional[TextIO] = None) -> int:
+    """The --decisions view: one stitched tree per decision root (the
+    whole trace — the decision span plus every retrain/reload/HTTP hop
+    its trace ID reached), then the orphan report. Returns the exit
+    code: 0 clean, 1 when orphan actuations exist."""
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    decisions = find_decisions(traces)
+    if not decisions:
+        print("no controller decisions in the input", file=out)
+    first = True
+    for tid, d in decisions:
+        if not first:
+            print(file=out)
+        first = False
+        head = (f"decision #{d.get('decisionId', '?')} "
+                f"action={d.get('action', '?')} "
+                f"reason={d.get('reason', '?')}")
+        print(head, file=out)
+        render_trace(tid, traces[tid], out=out)
+    orphans = find_orphan_actuations(traces)
+    if orphans:
+        print(f"\n!! {len(orphans)} ORPHAN ACTUATION SPAN(S) — "
+              "controller.* spans whose trace has NO decision root; "
+              "an actuation happened that nothing audited:", file=err)
+        for s in orphans:
+            print(f"!!   trace={s.get('traceId')} span={s.get('span')} "
+                  f"ts={s.get('ts')} "
+                  f"decisionId={s.get('decisionId', '?')}", file=err)
+        return 1
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="stitch pio.trace span logs into per-trace "
@@ -127,6 +207,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--trace", help="only this trace ID")
     ap.add_argument("--list", action="store_true",
                     help="one summary line per trace instead of trees")
+    ap.add_argument("--decisions", action="store_true",
+                    help="freshness-controller audit view: one stitched "
+                         "tree per controller.decision root; orphan "
+                         "actuation spans (controller.* with no "
+                         "decision in their trace) surface on stderr "
+                         "with exit code 1")
     args = ap.parse_args(argv)
 
     lines: List[str] = []
@@ -143,6 +229,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not traces:
             print(f"no spans for trace {args.trace!r}", file=sys.stderr)
             return 1
+    if args.decisions:
+        return render_decisions(traces)
     if args.list:
         for tid, spans in sorted(traces.items()):
             servers = sorted({s.get("server", s.get("span", "?"))

@@ -243,6 +243,32 @@ def _serve_worker(args) -> tuple:
                 engine_variant="fleet", engine_factory="fleet")
 
     server.load_models = load_models
+
+    # the staleness gauge the fleet /slo (and the freshness controller
+    # behind it) evaluates: the real PredictionServer registers this
+    # collector in __init__, which the __new__ state-injection path
+    # above bypasses — re-plant it here so a fleet-worker /metrics
+    # scrape reports the served instance's age, and the planted
+    # /reload's end_time bump resets it exactly like a real hot swap
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+    from incubator_predictionio_tpu.utils.times import ensure_aware
+
+    staleness_gauge = obs_metrics.REGISTRY.gauge(
+        "pio_model_staleness_seconds",
+        "seconds since the served engine instance finished training "
+        "(scrape-time snapshot)")
+
+    def _collect_staleness() -> None:
+        with server._lock:
+            instance = server.engine_instance
+        if instance is not None:
+            staleness_gauge.set(max(
+                (now_utc() - ensure_aware(instance.end_time))
+                .total_seconds(), 0.0))
+
+    obs_metrics.REGISTRY.register_collector(
+        "fleet_worker_staleness", _collect_staleness)
+
     # pre-warm EVERY pow2 ladder rung (plus the singleton path) so the
     # load ramp measures serving, not XLA compiles — the zero-steady-
     # state-recompile contract starts from here. With a shared

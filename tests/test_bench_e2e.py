@@ -105,6 +105,20 @@ REQUIRED_FIELDS = {
     "frontdoor_join_cold_s": (float, type(None)),
     "frontdoor_join_warm_s": (float, type(None)),
     "frontdoor_join_to_first_dispatch_s": (float, type(None)),
+    # self-driving freshness leg (docs/production.md "Self-driving
+    # freshness"): the SLO-burn controller alone holds fleet staleness
+    # under the compressed bound — zero human retrains — with every
+    # action trace-linked to its rolling-reload spans. None = the
+    # leg's designed deadline-skip.
+    "controller_workers": (int, type(None)),
+    "controller_staleness_bound_s": (float, type(None)),
+    "controller_staleness_max_s": (float, type(None)),
+    "controller_staleness_held": (bool, type(None)),
+    "controller_actions": (int, type(None)),
+    "controller_decision_to_fresh_s": (float, type(None)),
+    "controller_false_triggers": (int, type(None)),
+    "controller_trace_linked": (bool, type(None)),
+    "controller_evaluations": (int, type(None)),
     # two-stage MIPS serving leg (docs/performance.md "Two-stage MIPS
     # serving"): exhaustive-vs-two-stage per-query walls, candidates-
     # scanned fraction and the recall@20 gate at the planted large
@@ -165,6 +179,12 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
         # choreography (kill + join + rolling reload all still fire)
         "PIO_BENCH_FRONTDOOR_STAGE_S": "5",
         "PIO_BENCH_FRONTDOOR_RAMP_RPS": "80,80,80",
+        # controller leg at CI shape: tighter staleness bound + shorter
+        # ramp — the full trigger→retrain→rolling-swap choreography
+        # still fires at least once
+        "PIO_BENCH_CONTROLLER_BOUND_S": "6",
+        "PIO_BENCH_CONTROLLER_RUN_S": "18",
+        "PIO_BENCH_CONTROLLER_RPS": "25",
     })
     # own session so a timeout kill reaps the whole tree — otherwise the
     # claimed child outlives the parent and keeps burning CPU
@@ -296,6 +316,25 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
             assert rec["frontdoor_join_to_first_dispatch_s"] > 0
         if rec["frontdoor_join_cold_s"] is not None:
             assert rec["frontdoor_join_cold_s"] > 0
+    # self-driving freshness leg: when the leg ran, the controller —
+    # acting alone, zero human retrains — kept the sampled fleet-max
+    # staleness under the compressed bound, fired at least one
+    # retrain+swap, fired NO false triggers (the hysteresis/horizon
+    # promise), and every action's decision trace ID reached the
+    # rolling-reload hop (the audit-trail acceptance bar).
+    if rec["controller_workers"] is not None:
+        assert rec["controller_workers"] >= 2
+        assert rec["controller_actions"] is not None \
+            and rec["controller_actions"] >= 1, rec["controller_actions"]
+        if rec["controller_staleness_held"] is not None:
+            assert rec["controller_staleness_held"] is True, \
+                rec["controller_staleness_max_s"]
+        if rec["controller_false_triggers"] is not None:
+            assert rec["controller_false_triggers"] == 0
+        if rec["controller_trace_linked"] is not None:
+            assert rec["controller_trace_linked"] is True
+        if rec["controller_decision_to_fresh_s"] is not None:
+            assert rec["controller_decision_to_fresh_s"] > 0
     # two-stage MIPS leg: at the ≥128k planted gate size the two-stage
     # path must beat exhaustive per query while scanning ≤ 25% of the
     # catalogue at recall@20 ≥ 0.95, with ZERO steady-state recompiles;

@@ -297,6 +297,84 @@ def test_federate_e2e_two_worker_processes(mem_storage, monkeypatch):
         _stop_worker(w2)
 
 
+def test_frontdoor_joins_the_observability_fleet(monkeypatch):
+    """Satellite: the front door is a first-class federation target.
+    Its ``/metrics`` carries the ``pio_frontdoor_*`` series AND the
+    client-observed ``pio_query_latency_seconds`` it books per served
+    query — so a fleet ``/slo`` whose targets include the door
+    evaluates serve_p99 over what clients actually saw through it, not
+    just per-worker dispatch histograms."""
+    from incubator_predictionio_tpu.obs import slo as obs_slo
+    from incubator_predictionio_tpu.serving.frontdoor import (
+        FrontDoor,
+        FrontDoorConfig,
+    )
+    from incubator_predictionio_tpu.utils.http import (
+        HttpServer,
+        Request,
+        Response,
+        Router,
+    )
+
+    r = Router()
+
+    @r.post("/queries.json")
+    def queries(request: Request) -> Response:
+        return Response(200, {"itemScores": []})
+
+    @r.get("/")
+    def status_route(request: Request) -> Response:
+        return Response(200, {"status": "alive"})
+
+    worker = HttpServer(r, "127.0.0.1", 0, name="miniworker")
+    wport = worker.start_background()
+    fd = FrontDoor([("127.0.0.1", wport)],
+                   FrontDoorConfig(probe_interval_s=5.0))
+    fport = fd.start_background()
+    lat = obs_metrics.REGISTRY.get("pio_query_latency_seconds")
+    before = lat.count if lat is not None else 0
+    try:
+        for _ in range(5):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fport}/queries.json",
+                data=b'{"user": "u1", "num": 1}', method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+        # the door booked the client-observed walls into the SAME
+        # family the workers use
+        lat = obs_metrics.REGISTRY.get("pio_query_latency_seconds")
+        assert lat.count >= before + 5
+        # federate the door like any worker: its exposition merges
+        # under an instance label with the frontdoor series present
+        target = federate.Target(
+            instance="door", url=f"http://127.0.0.1:{fport}/metrics")
+        snap = federate.FederatedSnapshot(
+            [federate.scrape_target(target)])
+        assert snap.up_instances() == ["door"]
+        reqs = snap.get("pio_frontdoor_requests_total")
+        assert reqs is not None and reqs.total() >= 5
+        fleet_lat = snap.get("pio_query_latency_seconds")
+        assert fleet_lat is not None
+        below, total = fleet_lat.cumulative_below(0.25)
+        assert total >= 5
+        # the fleet SLO engine evaluates serve_p99 over the door's view
+        eng = obs_slo.SLOEngine(
+            specs=(obs_slo.SLOSpec(
+                name="serve_p99",
+                metric="pio_query_latency_seconds",
+                threshold=0.25, target=0.99),),
+            registry=federate.FleetRegistry(
+                targets_fn=lambda: [target], max_age_s=0.0),
+            min_tick_interval_s=0.0, export_gauges=False)
+        out = eng.evaluate()[0]
+        assert out["noData"] is False
+        assert out["totalObservations"] >= 5
+    finally:
+        fd.stop()
+        worker.stop()
+
+
 def test_federate_unconfigured_is_explicit(mem_storage, monkeypatch):
     from incubator_predictionio_tpu.servers.admin import AdminServer
 

@@ -450,6 +450,44 @@ def poll_until_ready(probe, budget_s=10.0):
     return False
 """,
     ),
+    "unaudited-actuation": (
+        """
+class FreshnessController:
+    def evaluate_once(self):
+        # actuation OUTSIDE the decision-record emitter: the fleet
+        # mutates with no audit-ring entry and no trace context
+        if self.breached():
+            self._retrain_fn()
+            self._reload_fn()
+
+    def panic_reload(self, fd):
+        fd.rolling_reload(timeout=30)
+""",
+        """
+class FreshnessController:
+    def evaluate_once(self):
+        if self.breached():
+            self._actuate(self.new_decision())
+
+    def _actuate(self, decision):
+        # THE emitter: trace context + outcome into the audit ring
+        self._retrain_fn()
+        self._reload_fn()
+        decision["outcome"] = {"actuated": True}
+
+
+def workflow_retrain_fn(engine, engine_params):
+    # actuator FACTORY (*_fn): builds the callable _actuate invokes
+    def retrain():
+        from incubator_predictionio_tpu.workflow.workflow import (
+            CoreWorkflow,
+        )
+
+        return CoreWorkflow.run_train(engine, engine_params)
+
+    return retrain
+""",
+    ),
     "metric-label-cardinality": (
         """
 from incubator_predictionio_tpu.obs import metrics
@@ -483,11 +521,16 @@ def handle(request, route_label, response):
 
 def _lint_source(tmp_path: Path, source: str, rule: str, name="fixture.py"):
     # server-state / unbatched-dispatch / exhaustive-scan only apply
-    # under servers/ (exhaustive-scan also covers serving/)
-    target_dir = (tmp_path / "servers"
-                  if rule in ("server-state", "unbatched-dispatch",
-                              "exhaustive-scan")
-                  else tmp_path)
+    # under servers/ (exhaustive-scan also covers serving/);
+    # unaudited-actuation only applies to obs/controller.py itself
+    if rule == "unaudited-actuation":
+        target_dir = tmp_path / "obs"
+        name = "controller.py"
+    elif rule in ("server-state", "unbatched-dispatch",
+                  "exhaustive-scan"):
+        target_dir = tmp_path / "servers"
+    else:
+        target_dir = tmp_path
     target_dir.mkdir(exist_ok=True)
     target = target_dir / name
     target.write_text(source, encoding="utf-8")

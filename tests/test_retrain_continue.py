@@ -411,6 +411,32 @@ def _rec_params(lambda_=0.05):
     )
 
 
+def test_workflow_explicit_prev_models_seam(rec_app):
+    """The explicit ``run_train(prev_models=)`` override: a caller
+    that already holds models seeds the continuation directly, even
+    where the implicit lookup could not help (a variant with no prior
+    COMPLETED instance)."""
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine,
+    )
+    from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+    engine = RecommendationEngine().apply()
+    iid1 = CoreWorkflow.run_train(engine, _rec_params(),
+                                  engine_variant="seam-a")
+    models = CoreWorkflow.load_models(iid1)
+    # a FRESH variant: the implicit continuation has nothing to find
+    before = _sweep_counter("continue")
+    CoreWorkflow.run_train(engine, _rec_params(),
+                           engine_variant="seam-b")
+    assert _sweep_counter("continue") == before
+    # the explicit seam seeds anyway — the caller owns compatibility
+    CoreWorkflow.run_train(engine, _rec_params(),
+                           engine_variant="seam-c",
+                           prev_models=models)
+    assert _sweep_counter("continue") > before
+
+
 def test_workflow_continuation_and_spec_change_auto_disable(rec_app):
     from incubator_predictionio_tpu.data.datamap import DataMap
     from incubator_predictionio_tpu.data.event import Event

@@ -167,6 +167,93 @@ def test_exported_gauges_update_at_evaluate():
     assert obs_slo.BUDGET_REMAINING.labels(slo="t_exp").value < 1.0
 
 
+def test_counter_reset_clamps_process_mode():
+    """A worker restart mid-window zeroes its cumulative counters. The
+    snapshot ring's window delta must CLAMP at zero — a head snapshot
+    below the base must never become negative good/bad deltas (negative
+    burn, or a breach computed from nonsense fractions)."""
+    reg = Registry()
+    clock = FakeClock()
+    h = reg.histogram("t_reset_seconds", "x", buckets=(1.0, 2.0))
+    eng = make_engine(reg, clock, metric="t_reset_seconds")
+    eng.tick(force=True)                     # zero baseline snapshot
+    h.observe(5.0, 100)                      # 100 bad pre-restart
+    clock.advance(10)
+    out = eng.evaluate()[0]
+    assert out["windows"]["fast"]["burnRate"] > 1.0
+    # the restart: a fresh process re-registers the family from zero
+    # and has seen LESS traffic than the old cumulative counts
+    reg2 = Registry()
+    h2 = reg2.histogram("t_reset_seconds", "x", buckets=(1.0, 2.0))
+    h2.observe(0.5, 10)                      # 10 good, post-restart
+    eng.registry = reg2
+    clock.advance(10)
+    out = eng.evaluate()[0]
+    for w in ("fast", "slow"):
+        win = out["windows"][w]
+        assert win["burnRate"] >= 0.0, win
+        assert win["badFraction"] >= 0.0, win
+        assert win["observations"] >= 0, win
+    # the clamped window sees no NEW bad observations (the 100 old bad
+    # must not re-count, and certainly not count negatively)
+    assert out["windows"]["fast"]["burnRate"] == 0.0
+    assert 0.0 <= out["errorBudgetRemaining"] <= 1.0
+
+
+class _ShrinkingFleet:
+    """Registry-shaped fleet stub whose histogram family RESETS between
+    reads (a worker restart between two controller/engine ticks):
+    second and later reads report lower cumulative counts."""
+
+    def __init__(self):
+        self.reads = 0
+
+    def get(self, name):
+        from incubator_predictionio_tpu.obs import expofmt, federate
+
+        self.reads += 1
+        m = federate.FederatedMetric(name, "histogram")
+        if self.reads == 1:
+            child = expofmt.HistogramChild(
+                buckets=[(1.0, 50.0), (2.0, 50.0)], sum=500.0,
+                count=150.0)                 # 100 past the last bound
+        else:
+            # post-restart: counters re-grew from zero, still below
+            # the pre-restart cumulative state
+            child = expofmt.HistogramChild(
+                buckets=[(1.0, 10.0), (2.0, 10.0)], sum=5.0,
+                count=10.0)
+        m.absorb("w0", expofmt.Family(
+            name=name, kind="histogram",
+            histograms={frozenset(): child}))
+        return m
+
+
+def test_counter_reset_clamps_fleet_mode():
+    """Same clamp through the FEDERATED registry shape: a restarted
+    worker's re-scraped exposition carries lower cumulative buckets,
+    and the fleet engine's ring must clamp rather than emit negative
+    burn (the fleet /slo the freshness controller keys on)."""
+    clock = FakeClock()
+    fleet = _ShrinkingFleet()
+    spec = SLOSpec(name="t", metric="t_fleet_seconds", threshold=1.0,
+                   target=0.99)
+    eng = SLOEngine(specs=(spec,), registry=fleet, clock=clock,
+                    fast_window_s=60.0, slow_window_s=600.0,
+                    min_tick_interval_s=0.0, export_gauges=False)
+    eng.tick(force=True)                     # sees 150 obs, 100 bad
+    clock.advance(10)
+    out = eng.evaluate()[0]                  # post-restart read: 10/0
+    for w in ("fast", "slow"):
+        win = out["windows"][w]
+        assert win["burnRate"] >= 0.0, win
+        assert win["badFraction"] >= 0.0, win
+        assert win["observations"] >= 0, win
+    assert out["windows"]["fast"]["burnRate"] == 0.0
+    assert out["breached"] is False
+    assert 0.0 <= out["errorBudgetRemaining"] <= 1.0
+
+
 # ---------------------------------------------------------------------------
 # GET /slo end to end (admin + dashboard), planted breach flip
 # ---------------------------------------------------------------------------

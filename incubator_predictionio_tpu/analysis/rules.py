@@ -1243,6 +1243,98 @@ class UnauditedActuation(Rule):
                 "the audit ring under its decision's trace ID")
 
 
+# ---------------------------------------------------------------------------
+# 19. flight-recorder snapshot/capture on the serving hot path
+# ---------------------------------------------------------------------------
+
+#: recorder snapshot/capture entry points (obs/recorder.py): each one
+#: walks the whole registry (sample_now), replays the delta ring
+#: (dump), or writes a multi-worker JSON bundle to disk (capture_now) —
+#: milliseconds-to-seconds of work that must only ever run on the
+#: recorder/capture module's OWN threads and the admin/debug HTTP
+#: executor, never where a query dispatch can reach it
+_RECORDER_CAPTURE_ATTRS = {"sample_now", "capture_now"}
+_RECORDER_GATEWAYS = {
+    "incubator_predictionio_tpu.obs.recorder.get_recorder",
+    "incubator_predictionio_tpu.obs.recorder.get_capture",
+}
+#: serve-path roots for this rule: the predict-family entries the other
+#: serve rules guard PLUS the scheduler's admission/dispatch methods
+#: (serving/scheduler.py) — incident capture must never block serving
+_RECORDER_SERVE_ENTRY_POINTS = _SERVE_ENTRY_POINTS | {
+    "submit", "_run", "_handle_batch", "handle_batch",
+}
+
+
+class RecorderInServePath(Rule):
+    name = "recorder-in-serve-path"
+    severity = "error"
+    doc = ("flight-recorder snapshot/capture call (sample_now / "
+           "capture_now / a get_recorder()/get_capture() gateway) "
+           "reachable from a predict/batch_predict/scheduler-dispatch "
+           "path outside obs/recorder.py — a registry walk, ring "
+           "replay or bundle write inline with a query dispatch stalls "
+           "serving exactly when an incident fires; the serve path's "
+           "only sanctioned recorder exposure is the exemplar "
+           "reservoir inside Histogram.observe(), everything else runs "
+           "on the recorder's own sampler/capture threads "
+           "(IncidentCapture.trigger() is the non-blocking hook)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        # obs/recorder.py owns the sampler/capture threads these calls
+        # are FOR
+        path = str(mod.path).replace("\\", "/")
+        if path.endswith("obs/recorder.py"):
+            return
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {
+                n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            edges: dict = {}
+            for name, fn in methods.items():
+                callees = set()
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self"
+                            and node.func.attr in methods):
+                        callees.add(node.func.attr)
+                edges[name] = callees
+            reachable: Set[str] = set()
+            stack = [m for m in _RECORDER_SERVE_ENTRY_POINTS
+                     if m in methods]
+            while stack:
+                m = stack.pop()
+                if m in reachable:
+                    continue
+                reachable.add(m)
+                stack.extend(edges.get(m, ()))
+            for name in sorted(reachable):
+                for node in ast.walk(methods[name]):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    rname = mod.resolved(node.func) or ""
+                    hit = rname in _RECORDER_GATEWAYS or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _RECORDER_CAPTURE_ATTRS)
+                    if not hit:
+                        continue
+                    what = (f"{rname}()" if rname
+                            else f".{node.func.attr}()")
+                    yield mod.finding(
+                        self, node,
+                        f"{what} reachable from the serving/dispatch "
+                        f"hot path (via {name!r}) — recorder snapshots "
+                        "and incident captures run on obs/recorder.py's "
+                        "own threads; from a serve path use the "
+                        "non-blocking IncidentCapture.trigger() hook "
+                        "(or nothing: the sampler already records)")
+
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -1262,6 +1354,7 @@ ALL_RULES: Sequence[Rule] = (
     ExhaustiveScan(),
     UnboundedRetry(),
     UnauditedActuation(),
+    RecorderInServePath(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

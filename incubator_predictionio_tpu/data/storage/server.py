@@ -252,9 +252,15 @@ class StorageServer:
                 return _packed({"ok": False, "etype": etype,
                                 "error": str(e)})
 
-        from incubator_predictionio_tpu.obs.http import add_metrics_route
+        from incubator_predictionio_tpu.obs.http import (
+            add_metrics_route,
+            add_recorder_route,
+        )
 
         add_metrics_route(r)
+        # GET /recorder: the flight recorder's metric-history window
+        # (obs/recorder.py) — every server records
+        add_recorder_route(r)
         return r
 
     # -- find cursor protocol ---------------------------------------------

@@ -30,7 +30,13 @@ read). This package is the one coherent layer over all of them:
 - :mod:`.controller` — the self-driving freshness controller: consumes
   the fleet SLO burn rates, projects error-budget exhaustion, and
   autonomously triggers continuation retrain + rolling hot swap with a
-  trace-linked decision audit trail (admin ``GET/POST /controller``).
+  trace-linked decision audit trail (admin ``GET/POST /controller``);
+- :mod:`.recorder` — the flight recorder: a bounded delta-encoded
+  metric-history ring on every server (``GET /recorder``), histogram
+  trace exemplars, and SLO-breach-triggered incident bundles that
+  freeze the fleet-merged pre-breach window + exemplar trace IDs +
+  scheduler state + controller decisions under ``PIO_INCIDENT_DIR``
+  (admin ``GET /incidents`` / ``POST /incident``).
 
 See ``docs/observability.md`` for the metric catalog and the scrape /
 trace-propagation / fleet contracts.

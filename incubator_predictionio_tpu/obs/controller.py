@@ -785,9 +785,34 @@ def reset_controller() -> None:
         _controller = None
 
 
+def peek_decisions(limit: int = 256) -> List[Dict[str, Any]]:
+    """The decision ring WITHOUT creating a controller — the incident
+    capture's export seam (obs/recorder.py): a bundle frozen on a
+    process that never ran a controller records an empty audit trail
+    rather than instantiating one as a side effect. An embedder that
+    wired a custom controller (the admin server's injected instance)
+    points the capture at it via :func:`export_ring_fn`."""
+    with _controller_lock:
+        c = _controller
+    return c.decisions(limit=limit) if c is not None else []
+
+
+def export_ring_fn(controller: "FreshnessController",
+                   limit: int = 256) -> Callable[[], List[Dict[str, Any]]]:
+    """Bind one controller's decision ring as an incident-capture
+    ``decisions_fn`` (the admin server wires its hosted — possibly
+    injected — controller through this)."""
+
+    def export() -> List[Dict[str, Any]]:
+        return controller.decisions(limit=limit)
+
+    return export
+
+
 __all__ = [
     "ACTION_REASONS", "ControllerConfig", "DRIVING_SLOS",
     "FreshnessController", "MODES", "SKIP_REASONS",
-    "capacity_budget_fn", "controller_mode", "get_controller",
-    "http_reload_fn", "reset_controller", "workflow_retrain_fn",
+    "capacity_budget_fn", "controller_mode", "export_ring_fn",
+    "get_controller", "http_reload_fn", "peek_decisions",
+    "reset_controller", "workflow_retrain_fn",
 ]

@@ -32,8 +32,20 @@ _SAMPLE_RE = re.compile(
     # optional label set; quoted values may hold ANY escaped content,
     # including braces (route patterns like /cmd/app/{name})
     r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
-    r" (-?(?:[0-9]*\.?[0-9]+(?:e[+-]?[0-9]+)?)|[+-]Inf|NaN)$")
+    r" (-?(?:[0-9]*\.?[0-9]+(?:e[+-]?[0-9]+)?)|[+-]Inf|NaN)"
+    # optional OpenMetrics-style exemplar annotation. Captured RAW and
+    # passed through verbatim: a future emitter's richer annotation
+    # must survive a federate round trip byte-stable even when this
+    # parser cannot interpret it (docs/observability.md exemplars)
+    r"(?: (# \{.*))?$")
 _LABEL_ITEM_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+#: the annotation grammar THIS repo emits (obs/metrics.format_exemplar):
+#: ``# {labels} value [timestamp]`` — anything else stays raw-only
+_EXEMPLAR_RE = re.compile(
+    r'^# (\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})'
+    r" (-?(?:[0-9]*\.?[0-9]+(?:e[+-]?[0-9]+)?)|[+-]Inf|NaN)"
+    r"(?: ([0-9]+(?:\.[0-9]+)?))?$")
 
 _SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
 
@@ -70,16 +82,24 @@ def parse_exposition(text: str) -> Tuple[Dict[str, str], Samples]:
     ``(name, frozenset(label items))`` → float. Raises
     :class:`MalformedExposition` on any line that violates the
     text-format grammar. Label values stay in their ESCAPED wire form
-    (oracle compatibility); :func:`parse_families` unescapes."""
-    types, _helps, samples = _parse(text)
+    (oracle compatibility); :func:`parse_families` unescapes. Exemplar
+    annotations are accepted and dropped here (the flat oracle view);
+    :func:`parse_families` carries them."""
+    types, _helps, samples, _ex = _parse(text)
     return types, samples
 
 
-def _parse(text: str) -> Tuple[Dict[str, str], Dict[str, str], Samples]:
-    """The one line-level pass: ``(types, helps, samples)``."""
+#: raw exemplar annotations by sample: (name, ESCAPED labelset) → raw
+RawExemplars = Dict[Tuple[str, FrozenSet[Tuple[str, str]]], str]
+
+
+def _parse(text: str) -> Tuple[Dict[str, str], Dict[str, str], Samples,
+                               RawExemplars]:
+    """The one line-level pass: ``(types, helps, samples, exemplars)``."""
     types: Dict[str, str] = {}
     helps: Dict[str, str] = {}
     samples: Samples = {}
+    exemplars: RawExemplars = {}
     for line in text.splitlines():
         if not line.strip():
             continue
@@ -97,16 +117,37 @@ def _parse(text: str) -> Tuple[Dict[str, str], Dict[str, str], Samples]:
         _require(not line.startswith("#"), f"unknown comment: {line}")
         m = _SAMPLE_RE.match(line)
         _require(m is not None, f"malformed sample line: {line!r}")
-        name, labelblob, value = m.groups()
+        name, labelblob, value, raw_ex = m.groups()
         labels = frozenset(_LABEL_ITEM_RE.findall(labelblob or ""))
         v = float("inf") if value == "+Inf" else float(value)
         samples[(name, labels)] = v
+        if raw_ex is not None:
+            # stored RAW, understood or not — pass-through is the
+            # contract (an exemplar this parser cannot interpret must
+            # still survive re-exposition byte-stable)
+            exemplars[(name, labels)] = raw_ex
     # every sample's family must be declared (histogram children map to
     # their family name)
     for (name, _), _v in samples.items():
         family = _SUFFIX_RE.sub("", name)
         _require(name in types or family in types, name)
-    return types, helps, samples
+    return types, helps, samples, exemplars
+
+
+def parse_exemplar(raw: str) -> Optional[Tuple[Dict[str, str], float,
+                                               Optional[float]]]:
+    """Structured view of one raw exemplar annotation:
+    ``({label: value}, exemplar value, wall ts or None)`` when it
+    matches the grammar this repo emits, None otherwise (the caller
+    keeps the raw string either way — pass-through survives)."""
+    m = _EXEMPLAR_RE.match(raw)
+    if m is None:
+        return None
+    labelblob, value, ts = m.groups()
+    labels = {k: unescape_label_value(v)
+              for k, v in _LABEL_ITEM_RE.findall(labelblob)}
+    v = float("inf") if value == "+Inf" else float(value)
+    return labels, v, (float(ts) if ts is not None else None)
 
 
 def histogram_series(
@@ -137,11 +178,26 @@ LabelSet = FrozenSet[Tuple[str, str]]
 @dataclasses.dataclass
 class HistogramChild:
     """One histogram time series: ascending ``(le, cumulative)`` pairs
-    (the +Inf bucket implied by ``count``), plus sum and count."""
+    (the +Inf bucket implied by ``count``), plus sum and count.
+    ``exemplars`` maps a bucket's ``le`` bound to the RAW annotation
+    string that rode its exposition line (pass-through contract);
+    :func:`parse_exemplar` gives the structured view of each."""
 
     buckets: List[Tuple[float, float]]
     sum: float
     count: float
+    exemplars: Dict[float, str] = dataclasses.field(default_factory=dict)
+
+    def exemplar_trace_ids(self) -> List[Tuple[float, str]]:
+        """``(le, trace_id)`` for every exemplar whose annotation this
+        repo's grammar understands — the incident bundle's "which
+        queries were the p99" linkage."""
+        out: List[Tuple[float, str]] = []
+        for le, raw in sorted(self.exemplars.items()):
+            parsed = parse_exemplar(raw)
+            if parsed is not None and "trace_id" in parsed[0]:
+                out.append((le, parsed[0]["trace_id"]))
+        return out
 
     def per_bucket(self) -> List[Tuple[float, float]]:
         """De-cumulated ``(le, count-in-bucket)`` pairs, finite bounds
@@ -172,6 +228,11 @@ class Family:
     #: histogram children: labelset (without ``le``) → HistogramChild
     histograms: Dict[LabelSet, HistogramChild] = dataclasses.field(
         default_factory=dict)
+    #: raw exemplar annotations on counter/gauge samples (labelset →
+    #: raw) — nothing in-repo emits these today, but a foreign scrape's
+    #: annotations must pass through, not crash the federation
+    exemplars: Dict[LabelSet, str] = dataclasses.field(
+        default_factory=dict)
 
 
 def _unescaped(labels: FrozenSet[Tuple[str, str]]) -> LabelSet:
@@ -183,7 +244,7 @@ def parse_families(text: str) -> Dict[str, Family]:
     histogram children. Raises :class:`MalformedExposition` like
     :func:`parse_exposition`; additionally requires every histogram
     child to carry its ``_sum``/``_count`` series."""
-    types, helps, samples = _parse(text)
+    types, helps, samples, raw_ex = _parse(text)
 
     out: Dict[str, Family] = {}
     for name, kind in types.items():
@@ -192,6 +253,7 @@ def parse_families(text: str) -> Dict[str, Family]:
     hist_buckets: Dict[str, Dict[LabelSet, Dict[float, float]]] = {}
     hist_sums: Dict[str, Dict[LabelSet, float]] = {}
     hist_counts: Dict[str, Dict[LabelSet, float]] = {}
+    hist_ex: Dict[str, Dict[LabelSet, Dict[float, str]]] = {}
     for (name, labels), v in samples.items():
         if name in types:
             fam = out[name]
@@ -201,6 +263,9 @@ def parse_families(text: str) -> Dict[str, Family]:
                 raise MalformedExposition(
                     f"bare sample {name!r} under histogram family")
             fam.values[_unescaped(labels)] = v
+            ex = raw_ex.get((name, labels))
+            if ex is not None:
+                fam.exemplars[_unescaped(labels)] = ex
             continue
         family = _SUFFIX_RE.sub("", name)
         suffix = name[len(family) + 1:]
@@ -215,6 +280,10 @@ def parse_families(text: str) -> Dict[str, Family]:
                 (k, v2) for k, v2 in labels if k != "le"))
             hist_buckets.setdefault(family, {}).setdefault(
                 child, {})[le] = v
+            ex = raw_ex.get((name, labels))
+            if ex is not None:
+                hist_ex.setdefault(family, {}).setdefault(
+                    child, {})[le] = ex
         elif suffix == "sum":
             hist_sums.setdefault(family, {})[_unescaped(labels)] = v
         else:  # count
@@ -231,12 +300,13 @@ def parse_families(text: str) -> Dict[str, Family]:
             _require(all(a <= b for a, b in zip(cums, cums[1:])),
                      f"non-monotone buckets in {family!r}")
             out[family].histograms[child] = HistogramChild(
-                buckets=buckets, sum=s, count=c)
+                buckets=buckets, sum=s, count=c,
+                exemplars=hist_ex.get(family, {}).get(child, {}))
     return out
 
 
 __all__ = [
     "Family", "HistogramChild", "MalformedExposition", "Samples",
-    "histogram_series", "parse_exposition", "parse_families",
-    "unescape_label_value",
+    "histogram_series", "parse_exemplar", "parse_exposition",
+    "parse_families", "unescape_label_value",
 ]

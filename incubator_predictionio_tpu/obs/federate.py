@@ -174,6 +174,11 @@ class FederatedMetric:
         #: (instance, labelset) → HistogramChild
         self.histograms: Dict[Tuple[str, expofmt.LabelSet],
                               expofmt.HistogramChild] = {}
+        #: raw exemplar annotations on counter/gauge samples, carried
+        #: for re-exposition (histogram exemplars ride their
+        #: HistogramChild). Pass-through: never interpreted here.
+        self.value_exemplars: Dict[Tuple[str, expofmt.LabelSet],
+                                   str] = {}
 
     # -- merge ------------------------------------------------------------
     def absorb(self, instance: str, fam: expofmt.Family) -> None:
@@ -181,6 +186,18 @@ class FederatedMetric:
             self.values[(instance, labels)] = v
         for labels, child in fam.histograms.items():
             self.histograms[(instance, labels)] = child
+        for labels, raw in fam.exemplars.items():
+            self.value_exemplars[(instance, labels)] = raw
+
+    def exemplar_trace_ids(self) -> List[Tuple[str, float, str]]:
+        """``(instance, le, trace_id)`` across every instance's
+        histogram children — an exemplar-free old worker simply
+        contributes nothing (clean degradation, pinned in tests)."""
+        out: List[Tuple[str, float, str]] = []
+        for (inst, _labels), child in sorted(self.histograms.items()):
+            for le, tid in child.exemplar_trace_ids():
+                out.append((inst, le, tid))
+        return out
 
     # -- counter/gauge math ------------------------------------------------
     def total(self) -> float:
@@ -341,23 +358,39 @@ class FederatedSnapshot:
             out.append(f"# TYPE {name} {m.kind}")
             if m.kind in ("counter", "gauge"):
                 for (inst, labels), v in sorted(m.values.items()):
-                    out.append(f"{name}{label_str(inst, labels)} "
-                               f"{obs_metrics._fmt(v)}")
+                    line = (f"{name}{label_str(inst, labels)} "
+                            f"{obs_metrics._fmt(v)}")
+                    raw_ex = m.value_exemplars.get((inst, labels))
+                    if raw_ex is not None:
+                        line += " " + raw_ex
+                    out.append(line)
             else:
                 for (inst, labels), child in sorted(m.histograms.items()):
                     for le, cum in child.buckets:
                         if le == float("inf"):
                             continue
                         le_s = 'le="' + obs_metrics._fmt(le) + '"'
-                        out.append(
+                        line = (
                             f"{name}_bucket"
                             f"{label_str(inst, labels, le_s)} "
                             f"{obs_metrics._fmt(cum)}")
+                        # exemplars ride federation VERBATIM — the raw
+                        # annotation string, understood or not, so a
+                        # fleet /federate scrape still names the
+                        # breaching workers' trace IDs byte-stable
+                        raw_ex = child.exemplars.get(le)
+                        if raw_ex is not None:
+                            line += " " + raw_ex
+                        out.append(line)
                     inf_s = 'le="+Inf"'
-                    out.append(
+                    line = (
                         f"{name}_bucket"
                         f"{label_str(inst, labels, inf_s)} "
                         f"{obs_metrics._fmt(child.count)}")
+                    raw_ex = child.exemplars.get(float("inf"))
+                    if raw_ex is not None:
+                        line += " " + raw_ex
+                    out.append(line)
                     out.append(f"{name}_sum{label_str(inst, labels)} "
                                f"{obs_metrics._fmt(child.sum)}")
                     out.append(f"{name}_count{label_str(inst, labels)} "

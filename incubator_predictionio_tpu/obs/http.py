@@ -139,6 +139,118 @@ def add_federate_route(router) -> None:
     router.add("GET", "/federate", federate_route)
 
 
+def add_recorder_route(router) -> None:
+    """Register ``GET /recorder`` — the flight recorder's reconstructed
+    metric-history window (obs/recorder.py). Starts the background
+    sampler as a side effect (the route IS the "this server records"
+    declaration); with ``PIO_RECORDER=0`` no thread exists and the
+    route answers 503. Query params:
+
+    - ``series=<name>[,<name>...]`` — those families' windows;
+    - ``window=<seconds>`` — trailing window (≤ the ring bound);
+    - ``all=1`` — the full self-describing dump (every series +
+      current exemplars + state-provider blocks) an incident bundle
+      freezes;
+    - none of the above — the cheap index (series list, cadence, ring
+      size).
+
+    Handlers are synchronous, so the HTTP layer runs them on the
+    executor — a window reconstruction never blocks the event loop
+    (and never touches the serving path: the ``recorder-in-serve-path``
+    lint rule pins that direction too)."""
+    from incubator_predictionio_tpu.obs import recorder as obs_recorder
+    from incubator_predictionio_tpu.utils.http import Request, Response
+
+    # starting the sampler at route-registration time (not first
+    # request) makes the window already warm when an operator first
+    # looks — an incident's pre-breach history must predate the breach.
+    # The capture engine arms alongside it when PIO_INCIDENT_DIR names
+    # a destination (no-op otherwise), so a lone worker captures its
+    # own breaches without an admin in the loop.
+    obs_recorder.get_recorder()
+    obs_recorder.get_capture()
+
+    def recorder_route(request: Request) -> Response:
+        rec = obs_recorder.get_recorder()
+        if rec is None:
+            return Response(503, {
+                "message": "flight recorder disabled (PIO_RECORDER=0)"})
+        window = None
+        raw_window = request.query.get("window", "")
+        if raw_window:
+            try:
+                window = float(raw_window)
+            except ValueError:
+                return Response(400,
+                                {"message": "window must be seconds"})
+        if request.query.get("all", "") not in ("", "0", "false"):
+            return Response(200, rec.dump(window_s=window))
+        series = [s for s in request.query.get("series", "").split(",")
+                  if s.strip()]
+        if series:
+            return Response(200, rec.window(series=series,
+                                            window_s=window))
+        return Response(200, rec.index())
+
+    router.add("GET", "/recorder", recorder_route)
+
+
+def add_incident_routes(router) -> None:
+    """Register the incident-capture endpoints (admin server):
+
+    - ``GET /incidents`` — newest-first bundle summaries from
+      ``PIO_INCIDENT_DIR``;
+    - ``GET /incidents/{id}`` — one full bundle;
+    - ``POST /incident`` — manual capture (trigger="manual"), answers
+      the new bundle's id + path.
+
+    503 when ``PIO_INCIDENT_DIR`` is unset — like ``/federate``, a
+    capture plane with no destination is a misconfiguration, not an
+    empty healthy state."""
+    from incubator_predictionio_tpu.obs import recorder as obs_recorder
+    from incubator_predictionio_tpu.utils.http import Request, Response
+
+    def _capture_or_503():
+        cap = obs_recorder.get_capture()
+        if cap is None:
+            return None, Response(503, {
+                "message": "incident capture disabled: set "
+                           "PIO_INCIDENT_DIR"})
+        return cap, None
+
+    def list_incidents(request: Request) -> Response:
+        cap, err = _capture_or_503()
+        if err is not None:
+            return err
+        return Response(200, {"incidents": cap.list_incidents(),
+                              "directory": cap.directory,
+                              "cooldownS": cap.cooldown_s})
+
+    def get_incident(request: Request) -> Response:
+        cap, err = _capture_or_503()
+        if err is not None:
+            return err
+        bundle = cap.read_incident(request.path_params["inc_id"])
+        if bundle is None:
+            return Response(404, {"message": "no such incident"})
+        return Response(200, bundle)
+
+    def post_incident(request: Request) -> Response:
+        cap, err = _capture_or_503()
+        if err is not None:
+            return err
+        # manual captures bypass the breach cooldown (an operator
+        # asking for a bundle NOW is the authority) but still run on
+        # this handler synchronously — it's the admin's executor, not
+        # a serving path
+        out = cap.capture_now(cap.MANUAL_TRIGGER)
+        return Response(200, out)
+
+    router.add("GET", "/incidents", list_incidents)
+    router.add("GET", "/incidents/{inc_id}", get_incident)
+    router.add("POST", "/incident", post_incident)
+
+
 def add_profile_route(router) -> None:
     """Register ``POST /profile?seconds=N`` — on-demand jax.profiler
     xplane capture (obs/profile.py). The handler is synchronous, so the
@@ -228,6 +340,7 @@ def render_slo_panel() -> str:
 
 
 __all__ = [
-    "add_federate_route", "add_metrics_route", "add_slo_route",
-    "add_profile_route", "render_latency_panels", "render_slo_panel",
+    "add_federate_route", "add_incident_routes", "add_metrics_route",
+    "add_recorder_route", "add_slo_route", "add_profile_route",
+    "render_latency_panels", "render_slo_panel",
 ]

@@ -23,8 +23,11 @@ from __future__ import annotations
 import bisect
 import logging
 import math
+import os
+import random
 import re
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
@@ -67,6 +70,80 @@ def _fmt(v: float) -> str:
 
 def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+# ---------------------------------------------------------------------------
+# trace exemplars (OpenMetrics-style) — the "WHICH query was the p99"
+# link between a histogram bucket and the distributed-tracing plane.
+# Each bucket keeps at most ONE reservoir-sampled exemplar per
+# PIO_EXEMPLAR_WINDOW_S window: (ambient trace ID, observed value, wall
+# ts), emitted as a `# {trace_id="..."} value ts` suffix on the bucket's
+# exposition line. Hot-path cost when no ambient trace exists is one
+# contextvar read; PIO_EXEMPLARS=0 turns even that off.
+# ---------------------------------------------------------------------------
+
+#: reservoir RNG — module-level and reseedable so tests can pin which
+#: observation survives a window (tests/test_recorder.py determinism)
+_exemplar_rng = random.Random()
+
+
+def seed_exemplar_rng(seed: int) -> None:
+    """Reseed the exemplar reservoir (tests only — determinism pins)."""
+    _exemplar_rng.seed(seed)
+
+
+#: parsed PIO_EXEMPLARS cache keyed on the raw env string (same idiom as
+#: obs/trace.sample_rate: live-retunable, no per-observe dict churn)
+_exemplar_cache: Tuple[Optional[str], bool] = ("\0unset", True)
+
+
+def exemplars_enabled() -> bool:
+    global _exemplar_cache
+    raw = os.environ.get("PIO_EXEMPLARS")
+    cached_raw, cached = _exemplar_cache
+    if raw == cached_raw:
+        return cached
+    enabled = (raw or "1").strip().lower() not in ("0", "off", "false")
+    _exemplar_cache = (raw, enabled)
+    return enabled
+
+
+#: parsed PIO_EXEMPLAR_WINDOW_S cache keyed on the raw env string —
+#: observe() reads this under the histogram child lock, so the steady
+#: state must pay one string compare, not an env parse
+_exemplar_window_cache: Tuple[Optional[str], float] = ("\0unset", 60.0)
+
+
+def exemplar_window_s() -> float:
+    """Reservoir window: at most one exemplar survives per bucket per
+    window, so a sustained burst cannot pin one early trace forever."""
+    global _exemplar_window_cache
+    raw = os.environ.get("PIO_EXEMPLAR_WINDOW_S")
+    cached_raw, cached = _exemplar_window_cache
+    if raw == cached_raw:
+        return cached
+    try:
+        window = float(raw) if raw else 60.0
+    except ValueError:
+        window = 60.0
+    _exemplar_window_cache = (raw, window)
+    return window
+
+
+def _ambient_trace_id() -> Optional[str]:
+    """The ambient request's trace ID, imported lazily — obs.trace has
+    no import back into this module, but the late bind keeps metrics
+    importable absolutely first."""
+    from incubator_predictionio_tpu.obs import trace as obs_trace
+
+    return obs_trace.current_trace_id()
+
+
+def format_exemplar(trace_id: str, value: float, ts: float) -> str:
+    """The OpenMetrics exemplar annotation this registry emits (and
+    obs/expofmt.py parses back): ``# {trace_id="..."} value ts``."""
+    return (f'# {{trace_id="{_escape_label(trace_id)}"}} '
+            f"{_fmt(value)} {ts:.3f}")
 
 
 def _escape_help(v: str) -> str:
@@ -135,7 +212,8 @@ class _HistogramChild:
     wall) at per-BATCH bookkeeping cost.
     """
 
-    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count",
+                 "_ex", "_ex_seen", "_ex_win")
 
     def __init__(self, bounds: Sequence[float]) -> None:
         self._lock = threading.Lock()
@@ -143,13 +221,51 @@ class _HistogramChild:
         self._counts = [0] * (len(self._bounds) + 1)  # + overflow
         self._sum = 0.0
         self._count = 0
+        #: per-bucket exemplar (trace_id, value, wall_ts) or None
+        self._ex: List[Optional[Tuple[str, float, float]]] = \
+            [None] * (len(self._bounds) + 1)
+        #: traced observations seen in the bucket's CURRENT window (the
+        #: reservoir denominator) + that window's start wall
+        self._ex_seen = [0] * (len(self._bounds) + 1)
+        self._ex_win = [0.0] * (len(self._bounds) + 1)
 
     def observe(self, v: float, n: int = 1) -> None:
         i = bisect.bisect_left(self._bounds, v)
+        trace_id = (_ambient_trace_id() if exemplars_enabled() else None)
         with self._lock:
             self._counts[i] += n
             self._sum += v * n
             self._count += n
+            if trace_id is not None:
+                # ≤1 exemplar per bucket per window, reservoir-sampled:
+                # every traced observation in the window has an equal
+                # chance of being THE exemplar, so the survivor is a
+                # fair draw rather than first- or last-wins
+                now = time.time()
+                if now - self._ex_win[i] >= exemplar_window_s():
+                    self._ex_win[i] = now
+                    self._ex_seen[i] = 0
+                self._ex_seen[i] += 1
+                if (self._ex[i] is None
+                        or self._ex[i][2] < self._ex_win[i]
+                        or _exemplar_rng.random()
+                        < 1.0 / self._ex_seen[i]):
+                    self._ex[i] = (trace_id, v, now)
+
+    def exemplars(self) -> List[Tuple[float, str, float, float]]:
+        """``(le bound, trace_id, value, wall_ts)`` for every bucket
+        holding an exemplar (+Inf rendered as math.inf) — the incident
+        bundle's "which queries were the p99" payload."""
+        with self._lock:
+            snap = list(self._ex)
+        out: List[Tuple[float, str, float, float]] = []
+        for i, ex in enumerate(snap):
+            if ex is None:
+                continue
+            le = (self._bounds[i] if i < len(self._bounds)
+                  else float("inf"))
+            out.append((le, ex[0], ex[1], ex[2]))
+        return out
 
     @property
     def sum(self) -> float:
@@ -349,6 +465,26 @@ class _Metric:
             merged._count += count
         return merged.quantile(q)
 
+    def exemplars(self) -> List[Dict]:
+        """Histogram families only: every child's current exemplars as
+        JSON-ready dicts (the flight recorder's full-dump block and the
+        incident bundle's trace links read this)."""
+        if self.kind != "histogram":
+            raise ValueError("exemplars() is for histograms")
+        with self._lock:
+            items = sorted(self._children.items())
+        out: List[Dict] = []
+        for key, child in items:
+            for le, tid, v, ts in child.exemplars():
+                out.append({
+                    "labels": dict(zip(self.labelnames, key)),
+                    "le": ("+Inf" if math.isinf(le) else le),
+                    "traceId": tid,
+                    "value": v,
+                    "ts": round(ts, 3),
+                })
+        return out
+
     # -- exposition ---------------------------------------------------------
     def _label_str(self, key: Tuple[str, ...],
                    extra: str = "") -> str:
@@ -370,17 +506,27 @@ class _Metric:
                     f"{_fmt(child.value)}")
             else:
                 counts, total_sum, total = child.snapshot()
+                # exemplar annotations ride the bucket lines they
+                # belong to (OpenMetrics syntax; docs/observability.md)
+                ex_by_le = {le: (tid, v, ts)
+                            for le, tid, v, ts in child.exemplars()}
                 cum = 0
                 for bound, c in zip(self._buckets, counts):
                     cum += c
                     le = 'le="' + _fmt(bound) + '"'
-                    out.append(
-                        f"{self.name}_bucket"
-                        f"{self._label_str(key, le)} {cum}")
+                    line = (f"{self.name}_bucket"
+                            f"{self._label_str(key, le)} {cum}")
+                    ex = ex_by_le.get(bound)
+                    if ex is not None:
+                        line += " " + format_exemplar(*ex)
+                    out.append(line)
                 inf = 'le="+Inf"'
-                out.append(
-                    f"{self.name}_bucket"
-                    f"{self._label_str(key, inf)} {total}")
+                line = (f"{self.name}_bucket"
+                        f"{self._label_str(key, inf)} {total}")
+                ex = ex_by_le.get(float("inf"))
+                if ex is not None:
+                    line += " " + format_exemplar(*ex)
+                out.append(line)
                 out.append(
                     f"{self.name}_sum{self._label_str(key)} "
                     f"{_fmt(total_sum)}")

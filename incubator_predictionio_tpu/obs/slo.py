@@ -33,6 +33,7 @@ consumes next: *trigger when the staleness/freshness burn rate exceeds
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import threading
 from collections import deque
@@ -40,6 +41,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
 from incubator_predictionio_tpu.utils import times
+
+logger = logging.getLogger(__name__)
 
 BURN_RATE = obs_metrics.REGISTRY.gauge(
     "pio_slo_burn_rate",
@@ -147,6 +150,25 @@ class SLOEngine:
         #: gauge SLOs have no native event stream — the engine counts
         #: its own per-tick good/bad observations here
         self._gauge_counts: Dict[str, Tuple[int, int]] = {}
+        #: fast-burn-crossing hooks (the incident-capture seam,
+        #: obs/recorder.py): called with the breaching objective's
+        #: evaluation entry on EVERY breached evaluation — listeners own
+        #: their own dedup/cooldown, and they must never block (the
+        #: capture engine enqueues to its own thread)
+        self._breach_listeners: List[Callable[[Dict], None]] = []
+
+    def add_breach_listener(self, fn: Callable[[Dict], None]) -> None:
+        """Register a fast-burn-breach hook (idempotent per callable).
+        This is the same signal the freshness controller consumes —
+        ``breached`` = fast-window burn rate > 1."""
+        with self._lock:
+            if fn not in self._breach_listeners:
+                self._breach_listeners.append(fn)
+
+    def remove_breach_listener(self, fn: Callable[[Dict], None]) -> None:
+        with self._lock:
+            if fn in self._breach_listeners:
+                self._breach_listeners.remove(fn)
 
     # -- sampling -----------------------------------------------------------
     def _counts_now(self) -> Dict[str, Tuple[int, int]]:
@@ -269,6 +291,19 @@ class SLOEngine:
             if self._export_gauges:
                 BUDGET_REMAINING.labels(slo=spec.name).set(remaining)
             out.append(entry)
+        with self._lock:
+            listeners = list(self._breach_listeners)
+        if listeners:
+            for entry in out:
+                if not entry["breached"]:
+                    continue
+                for fn in listeners:
+                    try:
+                        fn(entry)
+                    except Exception:
+                        logger.exception(
+                            "SLO breach listener failed for %s",
+                            entry["name"])
         return out
 
 

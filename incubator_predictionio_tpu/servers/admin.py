@@ -22,8 +22,10 @@ from typing import TYPE_CHECKING
 from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
 from incubator_predictionio_tpu.obs.http import (
     add_federate_route,
+    add_incident_routes,
     add_metrics_route,
     add_profile_route,
+    add_recorder_route,
     add_slo_route,
 )
 
@@ -168,6 +170,14 @@ class AdminServer:
                                   **self.controller.stats()})
 
         add_metrics_route(r)
+        # GET /recorder: the admin's own flight-recorder window
+        # (obs/recorder.py); the fleet-merged pre-breach history lives
+        # in the incident bundles, which pull every WORKER's /recorder
+        add_recorder_route(r)
+        # GET /incidents + POST /incident: SLO-breach-frozen bundles
+        # under PIO_INCIDENT_DIR (docs/observability.md "Flight
+        # recorder & incidents")
+        add_incident_routes(r)
         # GET /federate: scrape the PIO_FLEET_TARGETS workers' /metrics
         # and re-expose the merged fleet series under an `instance`
         # label — the one-scrape fleet truth the ROADMAP-2 load-shedder
@@ -184,16 +194,32 @@ class AdminServer:
         add_profile_route(r)
         return r
 
+    def _wire_capture(self) -> None:
+        """Point the incident-capture engine (if PIO_INCIDENT_DIR
+        enables one) at THIS admin's hosted controller ring — an
+        injected controller's decisions must land in the bundles, not
+        the env-wired singleton's empty ring."""
+        from incubator_predictionio_tpu.obs.controller import (
+            export_ring_fn,
+        )
+        from incubator_predictionio_tpu.obs.recorder import get_capture
+
+        capture = get_capture()
+        if capture is not None:
+            capture.decisions_fn = export_ring_fn(self.controller)
+
     def start_background(self) -> int:
         port = self.http.start_background()
         # the loop runs in every mode (an off controller idles its
         # tick), so a live POST /controller flip to act resumes
         # actuation within one interval with no restart
         self.controller.start()
+        self._wire_capture()
         return port
 
     async def serve_forever(self) -> None:
         self.controller.start()
+        self._wire_capture()
         await self.http.serve_forever()
 
     def stop(self) -> None:

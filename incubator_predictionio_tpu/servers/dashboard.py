@@ -23,6 +23,7 @@ import logging
 from incubator_predictionio_tpu.data.storage import Storage
 from incubator_predictionio_tpu.obs.http import (
     add_metrics_route,
+    add_recorder_route,
     add_slo_route,
     render_latency_panels,
     render_slo_panel,
@@ -96,6 +97,8 @@ class DashboardServer:
             )
 
         add_metrics_route(r)
+        # GET /recorder: flight-recorder window (obs/recorder.py)
+        add_recorder_route(r)
         add_slo_route(r)
         return r
 

@@ -37,7 +37,10 @@ from incubator_predictionio_tpu.data.storage import (
 )
 from incubator_predictionio_tpu.data.webhooks import ConnectorError
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
-from incubator_predictionio_tpu.obs.http import add_metrics_route
+from incubator_predictionio_tpu.obs.http import (
+    add_metrics_route,
+    add_recorder_route,
+)
 from incubator_predictionio_tpu.servers.plugins import EventInfo, PluginContext
 from incubator_predictionio_tpu.servers.stats import Stats
 from incubator_predictionio_tpu.data.storage.base import UNSET as _UNSET_Q
@@ -617,6 +620,8 @@ class EventServer:
             )
 
         add_metrics_route(r)
+        # GET /recorder: flight-recorder window (obs/recorder.py)
+        add_recorder_route(r)
         return r
 
     # -- lifecycle ----------------------------------------------------------

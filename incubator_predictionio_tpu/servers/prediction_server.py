@@ -37,7 +37,10 @@ from incubator_predictionio_tpu.core.params import EngineParams, WorkflowParams
 from incubator_predictionio_tpu.data.storage import EngineInstance, Storage
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
 from incubator_predictionio_tpu.obs import trace as obs_trace
-from incubator_predictionio_tpu.obs.http import add_metrics_route
+from incubator_predictionio_tpu.obs.http import (
+    add_metrics_route,
+    add_recorder_route,
+)
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 from incubator_predictionio_tpu.servers.plugins import PluginContext
 from incubator_predictionio_tpu.serving.scheduler import (
@@ -859,6 +862,10 @@ class PredictionServer:
             )
 
         add_metrics_route(r)
+        # GET /recorder: pre-breach metric history on the worker itself —
+        # the admin's incident capture pulls this (docs/observability.md
+        # "Flight recorder & incidents")
+        add_recorder_route(r)
         return r
 
     # -- lifecycle ----------------------------------------------------------

@@ -678,7 +678,10 @@ class FrontDoor:
 
     # -- routes -------------------------------------------------------------
     def _build_router(self) -> Router:
-        from incubator_predictionio_tpu.obs.http import add_metrics_route
+        from incubator_predictionio_tpu.obs.http import (
+            add_metrics_route,
+            add_recorder_route,
+        )
 
         r = Router()
         r.add("POST", "/queries.json", self.handle_query)
@@ -714,6 +717,9 @@ class FrontDoor:
             return Response(200 if ok else 404, {"removed": bool(ok)})
 
         add_metrics_route(r)
+        # GET /recorder: the door's own pre-breach history (its client-
+        # observed latency histogram is the fleet serve_p99 signal)
+        add_recorder_route(r)
         return r
 
     # -- lifecycle ----------------------------------------------------------

@@ -56,10 +56,13 @@ import inspect
 import math
 import os
 import threading
+import weakref
 from collections import OrderedDict, deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs import recorder as obs_recorder
+from incubator_predictionio_tpu.obs import trace as obs_trace
 from incubator_predictionio_tpu.utils import times
 from incubator_predictionio_tpu.utils.http import HttpError
 
@@ -196,6 +199,12 @@ class _Pending:
     fut: "concurrent.futures.Future"
     t_enq: float
     priority: int
+    #: the submitting request's ambient trace ID (None outside a
+    #: request) — the dispatch loop re-installs ONE member's trace
+    #: around handle_batch so the latency histogram's exemplar
+    #: reservoir (obs/metrics.py) can name a concrete query for the
+    #: batch's shared wall
+    trace_id: Optional[str] = None
 
 
 class _EngineQueue:
@@ -293,6 +302,19 @@ class BatchScheduler:
         ]
         for t in self._threads:
             t.start()
+        # the flight recorder's state-snapshot seam: incident bundles
+        # freeze this scheduler's queue/rung/shed state alongside the
+        # metric window. Weakref-bound with named replace semantics so
+        # a hot-swapped server's new scheduler takes over the slot and
+        # the old one can be collected (the registry-collector idiom).
+        ref = weakref.ref(self)
+
+        def _snapshot_provider():
+            sched = ref()
+            return sched.snapshot() if sched is not None else None
+
+        obs_recorder.register_state_provider("scheduler",
+                                             _snapshot_provider)
 
     # -- admission ----------------------------------------------------------
     def submit(self, body: Any, priority: int = 0,
@@ -328,7 +350,8 @@ class BatchScheduler:
                     else:
                         shed_exc = ShedError(projected, reason="overload")
             if shed_exc is None:
-                q.items.append(_Pending(body, fut, now, int(priority)))
+                q.items.append(_Pending(body, fut, now, int(priority),
+                                        obs_trace.current_trace_id()))
                 self._cv.notify()
             retry_hint = q.projected_wait_s(self.cap)
             # counted under the lock: submit runs on the HTTP thread
@@ -371,6 +394,32 @@ class BatchScheduler:
                 },
             }
 
+    def snapshot(self) -> Dict[str, Any]:
+        """The incident-capture state block: :meth:`stats` plus the
+        admission policy and each queue's oldest-waiter age — what an
+        operator needs to read a frozen bundle without the process."""
+        now = self._clock()
+        with self._cv:
+            out: Dict[str, Any] = {
+                "cap": self.cap,
+                "shed": self.shed_count,
+                "waitBoundS": self.wait_bound_s,
+                "sloS": self.slo_s,
+                "shedEnabled": self._shed,
+                "stopped": self._stopped,
+                "engines": {},
+            }
+            for name, q in self._queues.items():
+                out["engines"][name] = {
+                    "depth": len(q.items),
+                    "rung": q.rung,
+                    "ewmaWallS": round(q.ewma_wall, 6),
+                    "inFlight": q.in_flight,
+                    "oldestAgeS": (round(now - q.items[0].t_enq, 4)
+                                   if q.items else None),
+                }
+            return out
+
     def stop(self) -> None:
         with self._cv:
             self._stopped = True
@@ -410,6 +459,16 @@ class BatchScheduler:
             for p in batch:
                 _QUEUE_WAIT.observe(max(t0 - p.t_enq, 0.0))
             _BATCH_SIZE.observe(float(len(batch)))
+            # exemplar seam: the dispatcher thread has no request
+            # context, so re-install the OLDEST traced member's trace
+            # ID for the duration of the dispatch — every histogram
+            # observation the batch handler books (the per-query
+            # latency histogram above all) can then carry a concrete
+            # trace exemplar naming one real query of this batch
+            ex_trace = next((p.trace_id for p in batch
+                             if p.trace_id is not None), None)
+            token = (obs_trace.set_current(ex_trace)
+                     if ex_trace is not None else None)
             try:
                 if self._pass_engine:
                     results = self._handle_batch(
@@ -418,6 +477,9 @@ class BatchScheduler:
                     results = self._handle_batch([p.body for p in batch])
             except Exception as exc:  # catastrophic: fail the whole batch
                 results = [exc] * len(batch)
+            finally:
+                if token is not None:
+                    obs_trace.reset_current(token)
             wall = self._clock() - t0
             with self._cv:
                 q.note_wall(wall)

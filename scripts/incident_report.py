@@ -1,0 +1,257 @@
+#!/usr/bin/env python3
+"""incident_report — render a flight-recorder incident bundle for humans.
+
+An incident bundle (obs/recorder.py IncidentCapture, captured under
+``PIO_INCIDENT_DIR`` on an SLO fast-burn breach or ``POST /incident``)
+is one self-contained JSON artifact: the fleet-merged pre-breach metric
+window, the breaching histogram's exemplar trace IDs, each worker's
+scheduler state and the in-window controller decisions. This tool turns
+it into the post-incident narrative:
+
+    # the human summary: breach header, per-instance timeline of the
+    # breaching series around T0, scheduler state, decisions in-window
+    python scripts/incident_report.py incidents/inc-...-serve_p99.json
+
+    # plus the exemplar TRACE TREES, stitched from span logs through
+    # the trace_stitch machinery (the bundle names WHICH traces to pull)
+    python scripts/incident_report.py bundle.json --spans worker0.log \
+        --spans worker1.log
+
+    # CI / runbook gate: exit 1 when the bundle is malformed
+    python scripts/incident_report.py bundle.json --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+# trace_stitch lives beside this script (scripts/ is not a package);
+# its parse/group/render machinery is the one copy of span stitching
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import trace_stitch  # noqa: E402
+
+
+class MalformedBundle(Exception):
+    """The bundle violates the pio-incident-v1 schema."""
+
+
+def check_bundle(bundle: Any) -> List[str]:
+    """Schema validation → list of problems (empty = well-formed).
+    Collected, not fail-fast: a --check failure should name everything
+    wrong with the artifact at once."""
+    problems: List[str] = []
+    if not isinstance(bundle, dict):
+        return ["bundle is not a JSON object"]
+    if bundle.get("schema") != "pio-incident-v1":
+        problems.append(
+            f"unknown schema {bundle.get('schema')!r} "
+            "(expected pio-incident-v1)")
+    for field, typ in (("id", str), ("trigger", str), ("scope", str),
+                      ("ts", (int, float)), ("windowS", (int, float))):
+        if not isinstance(bundle.get(field), typ):
+            problems.append(f"missing/mistyped field {field!r}")
+    rec = bundle.get("recorder")
+    instances = (rec or {}).get("instances")
+    if not isinstance(instances, dict) or not instances:
+        problems.append("recorder.instances missing or empty")
+        instances = {}
+    ok_instances = 0
+    for name, dump in instances.items():
+        if not isinstance(dump, dict):
+            problems.append(f"instance {name!r}: dump is not an object")
+            continue
+        if "error" in dump:
+            continue  # a degraded pull is recorded, not malformed
+        if not isinstance(dump.get("series"), dict):
+            problems.append(f"instance {name!r}: no series block")
+            continue
+        ok_instances += 1
+    if instances and ok_instances == 0:
+        problems.append("every instance pull failed — the bundle holds "
+                        "no metric window at all")
+    ex = bundle.get("exemplars")
+    if not isinstance(ex, dict) or not isinstance(
+            ex.get("traceIds"), list):
+        problems.append("exemplars block missing/mistyped")
+    if not isinstance(bundle.get("decisions"), list):
+        problems.append("decisions block missing/mistyped")
+    slo = bundle.get("slo")
+    if bundle.get("trigger") not in (None, "manual") and slo is not None \
+            and not isinstance(slo, dict):
+        problems.append("slo block mistyped")
+    return problems
+
+
+def _fmt_ts(ts: Optional[float], t0: Optional[float]) -> str:
+    if not isinstance(ts, (int, float)) or not isinstance(
+            t0, (int, float)):
+        return "        ?"
+    return f"{ts - t0:+8.1f}s"
+
+
+def _series_children(dump: Dict[str, Any],
+                     name: str) -> List[Dict[str, Any]]:
+    fam = (dump.get("series") or {}).get(name)
+    return list(fam.get("children", [])) if isinstance(fam, dict) else []
+
+
+def render_timeline(bundle: Dict[str, Any], metric: Optional[str],
+                    tail_points: int = 20) -> List[str]:
+    """Per-instance tail of the breaching series around T0 (histogram
+    points carry per-interval p50/p99 — the recorder's "what did p99
+    look like" answer), plus the queue-depth/shed context series when
+    recorded."""
+    t0 = bundle.get("ts")
+    lines: List[str] = []
+    instances = (bundle.get("recorder") or {}).get("instances", {})
+    context = ("pio_serve_queue_depth", "pio_serve_shed_total")
+    for inst in sorted(instances):
+        dump = instances[inst]
+        if not isinstance(dump, dict):
+            continue
+        if "error" in dump:
+            lines.append(f"  [{inst}] PULL FAILED: {dump['error']}")
+            continue
+        lines.append(f"  [{inst}]")
+        names = [metric] if metric else []
+        names += [c for c in context if c in (dump.get("series") or {})]
+        for name in names:
+            for child in _series_children(dump, name):
+                pts = child.get("points", [])[-tail_points:]
+                if not pts:
+                    continue
+                label = json.dumps(child.get("labels", {}),
+                                   sort_keys=True)
+                lines.append(f"    {name} {label}")
+                for p in pts:
+                    off = _fmt_ts(p[0] if p else None, t0)
+                    if len(p) >= 6:  # histogram point
+                        p99 = ("-" if p[5] is None
+                               else f"{p[5] * 1e3:9.2f}ms")
+                        p50 = ("-" if p[4] is None
+                               else f"{p[4] * 1e3:9.2f}ms")
+                        lines.append(
+                            f"      {off}  n={p[3]:<6} p50={p50} "
+                            f"p99={p99}")
+                    else:
+                        lines.append(f"      {off}  {p[1]}")
+        state = dump.get("state") or {}
+        if state:
+            lines.append(f"    state: {json.dumps(state, sort_keys=True)}")
+    return lines
+
+
+def render_decisions(bundle: Dict[str, Any]) -> List[str]:
+    t0 = bundle.get("ts")
+    out: List[str] = []
+    for d in bundle.get("decisions", []):
+        off = _fmt_ts(d.get("ts"), t0)
+        out.append(
+            f"  {off}  #{d.get('id', '?')} {d.get('kind', '?')} "
+            f"mode={d.get('mode', '?')} action={d.get('action', '-')} "
+            f"reason={d.get('reason', '-')} "
+            f"trace={d.get('traceId', '-')}")
+    if not out:
+        out.append("  (no controller decisions in the window)")
+    return out
+
+
+def render_exemplar_trees(bundle: Dict[str, Any],
+                          span_files: List[str]) -> List[str]:
+    """The exemplar trace trees: every span log line whose trace ID the
+    bundle names, stitched through trace_stitch.build_tree — the
+    cross-process "this WAS the p99 query" reconstruction."""
+    trace_ids = set((bundle.get("exemplars") or {}).get("traceIds", []))
+    out: List[str] = []
+    if not trace_ids:
+        out.append("  (bundle names no exemplar trace IDs — check the "
+                   "sampling floor, see the runbook)")
+        return out
+    lines: List[str] = []
+    for path in span_files:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines.extend(f)
+    traces = trace_stitch.group_by_trace(
+        trace_stitch.parse_span_lines(lines))
+    for tid in sorted(trace_ids):
+        spans = traces.get(tid)
+        if not spans:
+            out.append(f"  trace {tid}: no spans in the supplied logs")
+            continue
+        out.append(trace_stitch.render_trace(tid, spans))
+    return out
+
+
+def render(bundle: Dict[str, Any],
+           span_files: Optional[List[str]] = None) -> str:
+    slo = bundle.get("slo") or {}
+    metric = (slo.get("objective") or {}).get("metric")
+    header = [
+        f"incident {bundle.get('id', '?')}",
+        f"  trigger: {bundle.get('trigger', '?')}   "
+        f"scope: {bundle.get('scope', '?')}   "
+        f"T0: {bundle.get('ts', '?')} (epoch s)",
+    ]
+    if slo:
+        fast = ((slo.get("windows") or {}).get("fast") or {})
+        header.append(
+            f"  slo: {slo.get('name', '?')} fast burn "
+            f"{fast.get('burnRate', '?')} over "
+            f"{fast.get('observations', '?')} obs; budget remaining "
+            f"{slo.get('errorBudgetRemaining', '?')}")
+    ex_ids = (bundle.get("exemplars") or {}).get("traceIds", [])
+    header.append(f"  exemplar traces: {', '.join(ex_ids) or '(none)'}")
+    parts = header
+    parts.append("")
+    parts.append("timeline (pre-breach window tail):")
+    parts.extend(render_timeline(bundle, metric))
+    parts.append("")
+    parts.append("controller decisions in-window:")
+    parts.extend(render_decisions(bundle))
+    if span_files:
+        parts.append("")
+        parts.append("exemplar trace trees:")
+        parts.extend(render_exemplar_trees(bundle, span_files))
+    return "\n".join(parts)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a flight-recorder incident bundle "
+                    "(obs/recorder.py) to a human summary")
+    ap.add_argument("bundle", help="incident bundle JSON path")
+    ap.add_argument("--spans", action="append", default=[],
+                    metavar="LOG",
+                    help="span log file(s) to stitch the exemplar "
+                         "trace trees from (repeatable)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate only: exit 1 on a malformed bundle")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.bundle, encoding="utf-8") as f:
+            bundle = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"MALFORMED: cannot read bundle: {e}", file=sys.stderr)
+        return 1
+    problems = check_bundle(bundle)
+    if problems:
+        for p in problems:
+            print(f"MALFORMED: {p}", file=sys.stderr)
+        return 1
+    if args.check:
+        print(f"ok: {bundle['id']} (trigger={bundle['trigger']}, "
+              f"scope={bundle['scope']}, "
+              f"{len((bundle['recorder'] or {}).get('instances', {}))} "
+              "instance(s))")
+        return 0
+    print(render(bundle, span_files=args.spans))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -342,7 +342,10 @@ def main() -> None:
     srv = None
     warm_s = 0.0
     if args.mode == "metrics":
-        from incubator_predictionio_tpu.obs.http import add_metrics_route
+        from incubator_predictionio_tpu.obs.http import (
+            add_metrics_route,
+            add_recorder_route,
+        )
         from incubator_predictionio_tpu.utils.http import (
             HttpServer,
             Router,
@@ -364,6 +367,7 @@ def main() -> None:
                 "age of the served engine instance").set(args.staleness)
         r = Router()
         add_metrics_route(r)
+        add_recorder_route(r)
         srv = HttpServer(r, "127.0.0.1", 0, name="worker")
         port = srv.start_background()
     elif args.mode == "serve":

@@ -90,6 +90,12 @@ REQUIRED_FIELDS = {
     "fleet_shed_rate": (float, type(None)),
     "fleet_p99_flat_x": (float, type(None)),
     "fleet_recompiles_steady": (int, type(None)),
+    # flight-recorder leg (docs/observability.md "Flight recorder &
+    # incidents"): serving p99 with recorder+exemplars on vs off, and
+    # the over-saturation breach's autonomous validated bundle. None =
+    # the stage's designed deadline-skip.
+    "recorder_overhead_p99_x": (float, type(None)),
+    "fleet_incident_captured": (bool, type(None)),
     # fleet front-door leg (docs/production.md "Fleet front door"):
     # the health-checked router under injected chaos — a worker killed
     # AND a worker added mid-ramp AND a rolling fleet reload
@@ -299,6 +305,15 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
         assert rec["fleet_recompiles_steady"] == 0
         assert rec["fleet_shed_rate"] is not None \
             and 0.0 <= rec["fleet_shed_rate"] <= 1.0
+        # flight recorder: always-on history + exemplars must not move
+        # serving p99 (the ≤1.1× overhead pin), and the planted
+        # over-saturation breach must have frozen ONE bundle that
+        # passes incident_report --check — autonomously, worker-side
+        if rec["recorder_overhead_p99_x"] is not None:
+            assert rec["recorder_overhead_p99_x"] <= 1.1, \
+                rec["recorder_overhead_p99_x"]
+        if rec["fleet_incident_captured"] is not None:
+            assert rec["fleet_incident_captured"] is True
     # fleet front-door leg: when the leg ran, its two hard bars hold
     # under the injected chaos — every 5xx a client saw carried the
     # 503 + Retry-After shed contract (kills were retried to healthy

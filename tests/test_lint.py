@@ -488,6 +488,45 @@ def workflow_retrain_fn(engine, engine_params):
     return retrain
 """,
     ),
+    "recorder-in-serve-path": (
+        """
+from incubator_predictionio_tpu.obs import recorder as obs_recorder
+
+class Server:
+    def _freeze(self):
+        # registry walk + bundle write inline with the dispatch: the
+        # incident stalls the very queries it is diagnosing
+        obs_recorder.get_recorder().sample_now()
+        cap = obs_recorder.get_capture()
+        cap.capture_now("serve_p99")
+
+    def _handle_batch(self, bodies):
+        out = [self.score(b) for b in bodies]
+        self._freeze()
+        return out
+""",
+        """
+from incubator_predictionio_tpu.obs import recorder as obs_recorder
+
+class Server:
+    def __init__(self):
+        # registering a state provider is not a snapshot — the
+        # recorder's OWN thread calls it later
+        obs_recorder.register_state_provider(
+            "server", lambda: {"ok": True})
+
+    def _handle_batch(self, bodies):
+        out = [self.score(b) for b in bodies]
+        if self.overloaded():
+            # the sanctioned serve-path hook: non-blocking enqueue
+            self._capture.trigger("serve_p99")
+        return out
+
+    def admin_dump(self, request):
+        # admin/debug handlers are not the serving hot path
+        return obs_recorder.get_recorder().dump()
+""",
+    ),
     "metric-label-cardinality": (
         """
 from incubator_predictionio_tpu.obs import metrics

@@ -1,0 +1,862 @@
+"""Flight recorder + exemplars + incident capture (obs/recorder.py).
+
+The PR's contract, pinned end to end:
+
+- ring retention/eviction math and delta-encode/decode identity on a
+  FakeClock (the history is exact, not approximate);
+- exemplar reservoir determinism under seeded load, the emit→parse→
+  re-emit pass-through (byte-stable through federation, unknown
+  annotations included), and federation's kind-mismatch / exemplar-free
+  -worker degradation with exemplars present;
+- a planted SLO breach autonomously produces EXACTLY one bundle
+  (cooldown pinned), and a two-REAL-worker fleet breach produces one
+  bundle holding both instances' pre-breach windows, ≥1 exemplar trace
+  ID the trace_stitch machinery reconstructs cross-process, and the
+  in-window controller decisions (the acceptance bar);
+- /recorder + /incidents + POST /incident e2e over a real HttpServer;
+- recorder-off zero overhead (PIO_RECORDER=0 → no sampler thread) and
+  the p99-unchanged-with-recorder-on bound on the observe hot path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from incubator_predictionio_tpu.obs import expofmt, federate
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.obs import recorder as obs_recorder
+from incubator_predictionio_tpu.obs import slo as obs_slo
+from incubator_predictionio_tpu.obs import trace as obs_trace
+from incubator_predictionio_tpu.obs.metrics import Registry
+from incubator_predictionio_tpu.obs.recorder import (
+    FlightRecorder,
+    IncidentCapture,
+)
+from incubator_predictionio_tpu.utils.times import FakeClock
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(TESTS_DIR, "fleet_worker.py")
+REPORT = os.path.join(REPO, "scripts", "incident_report.py")
+
+
+@pytest.fixture
+def clean_singletons():
+    """Recorder/capture/SLO singletons re-read env on next use."""
+    obs_recorder.reset_recorder()
+    obs_slo.reset_engine()
+    yield
+    obs_recorder.reset_recorder()
+    obs_slo.reset_engine()
+
+
+def _recorder(reg, clock, wall, hz=1.0, window_s=10.0, keyframe_every=4):
+    return FlightRecorder(registry=reg, hz=hz, window_s=window_s,
+                          clock=clock, wall=wall,
+                          keyframe_every=keyframe_every)
+
+
+# ---------------------------------------------------------------------------
+# ring math
+# ---------------------------------------------------------------------------
+
+def test_ring_retention_and_eviction_math():
+    reg = Registry()
+    g = reg.gauge("t_gauge", "g")
+    clock = FakeClock(100.0)
+    rec = _recorder(reg, clock, clock, hz=1.0, window_s=10.0,
+                    keyframe_every=4)
+    # slots = window*hz + keyframe_every + 1
+    assert rec.slots == 15
+    for i in range(40):
+        g.set(float(i))
+        rec.sample_now()
+        clock.advance(1.0)
+    # only `slots` entries retained; the full window is reconstructable
+    assert rec.index()["samples"] == rec.slots
+    win = rec.window(series=["t_gauge"], window_s=10.0)
+    pts = win["series"]["t_gauge"]["children"][0]["points"]
+    # 11 points cover a 10 s window at 1 Hz (inclusive bounds)
+    assert len(pts) == 11
+    # values are the exact gauge settings of the last 11 ticks
+    assert [p[1] for p in pts] == [float(i) for i in range(29, 40)]
+    # a narrower window narrows the reconstruction
+    win3 = rec.window(series=["t_gauge"], window_s=3.0)
+    assert [p[1] for p in
+            win3["series"]["t_gauge"]["children"][0]["points"]] == \
+        [36.0, 37.0, 38.0, 39.0]
+    # ring bytes accounting stays positive and bounded
+    assert 0 < rec._ring_bytes < 10_000_000
+
+
+def test_delta_encode_decode_identity():
+    """Randomized mutations, reconstruction must equal the directly
+    recorded truth for every retained sample — including across ring
+    wrap (keyframe reachability) and for histogram bucket state."""
+    import random as _random
+
+    rng = _random.Random(7)
+    reg = Registry()
+    c = reg.counter("t_total", "c", labels=("route",))
+    g = reg.gauge("t_depth", "g")
+    h = reg.histogram("t_lat_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    clock = FakeClock(0.0)
+    rec = _recorder(reg, clock, clock, hz=1.0, window_s=20.0,
+                    keyframe_every=5)
+    truth = []  # per tick: (counter a, counter b, gauge, hist count)
+    for i in range(60):
+        if rng.random() < 0.7:
+            c.labels(route="/a").inc(rng.randint(1, 3))
+        if rng.random() < 0.4:
+            c.labels(route="/b").inc()
+        if rng.random() < 0.8:
+            g.set(rng.uniform(0, 50))
+        for _ in range(rng.randint(0, 3)):
+            h.observe(rng.choice([0.05, 0.5, 5.0, 50.0]))
+        rec.sample_now()
+        truth.append((c.labels(route="/a").value,
+                      c.labels(route="/b").value,
+                      g.value, h.count, h.sum))
+        clock.advance(1.0)
+    win = rec.window(window_s=20.0)
+    n = len(win["series"]["t_depth"]["children"][0]["points"])
+    assert n == 21
+    expected = truth[-n:]
+    by_route = {json.dumps(ch["labels"], sort_keys=True): ch["points"]
+                for ch in win["series"]["t_total"]["children"]}
+    pts_a = by_route['{"route": "/a"}']
+    pts_b = by_route['{"route": "/b"}']
+    pts_g = win["series"]["t_depth"]["children"][0]["points"]
+    pts_h = win["series"]["t_lat_seconds"]["children"][0]["points"]
+    for i, (va, vb, vg, hc, hs) in enumerate(expected):
+        assert pts_a[i][1] == va
+        assert pts_b[i][1] == vb
+        assert pts_g[i][1] == pytest.approx(vg)
+        assert pts_h[i][1] == hc           # cumulative count
+        assert pts_h[i][2] == pytest.approx(hs, abs=1e-6)
+    # interval counts sum back to the cumulative delta over the window
+    interval_total = sum(p[3] for p in pts_h[1:])
+    assert interval_total == pts_h[-1][1] - pts_h[0][1]
+
+
+def test_histogram_interval_quantiles_reflect_that_second():
+    """The recorder's histogram points answer "what did p99 look like
+    THEN": interval quantiles over the per-sample bucket deltas, not
+    the cumulative-forever distribution."""
+    reg = Registry()
+    h = reg.histogram("t_q_seconds", "h", buckets=(0.01, 0.1, 1.0))
+    clock = FakeClock(0.0)
+    rec = _recorder(reg, clock, clock, window_s=10.0)
+    for _ in range(100):
+        h.observe(0.005)               # a fast baseline second
+    rec.sample_now()
+    clock.advance(1.0)
+    for _ in range(100):
+        h.observe(0.5)                 # then a slow second
+    rec.sample_now()
+    win = rec.window(series=["t_q_seconds"], window_s=10.0)
+    pts = win["series"]["t_q_seconds"]["children"][0]["points"]
+    # first point has no interval base -> quantile over cumulative-so-far
+    assert pts[0][5] <= 0.01
+    # second point's interval p99 sits in the slow bucket even though
+    # cumulatively half the observations were fast
+    assert 0.1 < pts[1][5] <= 1.0
+
+
+def test_recorder_sampler_thread_and_off_mode(monkeypatch,
+                                              clean_singletons):
+    monkeypatch.setenv("PIO_RECORDER", "0")
+    assert obs_recorder.get_recorder() is None
+    assert not [t for t in threading.enumerate()
+                if t.name == "pio-flight-recorder"]
+    monkeypatch.setenv("PIO_RECORDER", "1")
+    monkeypatch.setenv("PIO_RECORDER_HZ", "50")
+    rec = obs_recorder.get_recorder()
+    assert rec is not None
+    assert [t for t in threading.enumerate()
+            if t.name == "pio-flight-recorder"]
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and rec.index()["samples"] < 3:
+        time.sleep(0.02)
+    assert rec.index()["samples"] >= 3
+    # the bookkeeping series are exported
+    assert rec.registry.get("pio_recorder_samples_total").value >= 3
+    assert rec.registry.get("pio_recorder_ring_bytes").value > 0
+
+
+def test_observe_p99_unchanged_with_recorder_on():
+    """The tentpole overhead pin: a hot observe() loop's p99 wall stays
+    microseconds-scale while the recorder samples concurrently — the
+    sampler holds no lock the observe path waits on (generous absolute
+    bound; the assertion is "no stall", not a micro-benchmark)."""
+    reg = Registry()
+    h = reg.histogram("t_hot_seconds", "h")
+    clock = FakeClock(0.0)
+    rec = FlightRecorder(registry=reg, hz=100.0, window_s=5.0,
+                         clock=time.monotonic, wall=time.time)
+    rec.start()
+    try:
+        time.sleep(0.05)  # sampler running
+        walls = []
+        tok = obs_trace.set_current("hot-trace")
+        try:
+            for i in range(20000):
+                t0 = time.perf_counter()
+                h.observe(0.001 * (i % 7))
+                walls.append(time.perf_counter() - t0)
+        finally:
+            obs_trace.reset_current(tok)
+        walls.sort()
+        p99 = walls[int(len(walls) * 0.99)]
+        assert p99 < 0.005, f"observe p99 {p99 * 1e6:.0f}us with " \
+            "recorder on — the sampler is stalling the hot path"
+    finally:
+        rec.stop()
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+def test_exemplar_reservoir_determinism_under_seeded_load():
+    def run():
+        obs_metrics.seed_exemplar_rng(42)
+        reg = Registry()
+        h = reg.histogram("t_ex_seconds", "h", buckets=(0.1, 1.0))
+        for i in range(200):
+            tok = obs_trace.set_current(f"trace-{i}")
+            try:
+                h.observe(0.05 if i % 2 == 0 else 0.5)
+            finally:
+                obs_trace.reset_current(tok)
+        return h.exemplars()
+
+    def key(exs):
+        # the wall stamp is real time; determinism is about WHICH
+        # observation survived the reservoir
+        return [(e["le"], e["traceId"], e["value"]) for e in exs]
+
+    a, b = run(), run()
+    assert key(a) == key(b)
+    # one exemplar per touched bucket, each naming a real trace
+    assert len(a) == 2
+    for rec_ in a:
+        assert rec_["traceId"].startswith("trace-")
+
+
+def test_exemplar_window_reset_and_untraced_observations(monkeypatch):
+    monkeypatch.setenv("PIO_EXEMPLAR_WINDOW_S", "0.05")
+    reg = Registry()
+    h = reg.histogram("t_w_seconds", "h", buckets=(1.0,))
+    h.observe(0.5)                     # no ambient trace: no exemplar
+    assert h.exemplars() == []
+    tok = obs_trace.set_current("first-window")
+    try:
+        h.observe(0.5)
+    finally:
+        obs_trace.reset_current(tok)
+    time.sleep(0.1)                    # window expires
+    tok = obs_trace.set_current("second-window")
+    try:
+        h.observe(0.5)
+    finally:
+        obs_trace.reset_current(tok)
+    ex = h.exemplars()
+    assert len(ex) == 1 and ex[0]["traceId"] == "second-window"
+
+
+def test_exemplars_off_switch(monkeypatch):
+    monkeypatch.setenv("PIO_EXEMPLARS", "0")
+    reg = Registry()
+    h = reg.histogram("t_off_seconds", "h", buckets=(1.0,))
+    tok = obs_trace.set_current("should-not-appear")
+    try:
+        h.observe(0.5)
+    finally:
+        obs_trace.reset_current(tok)
+    assert h.exemplars() == []
+    assert "# {" not in reg.expose()
+
+
+def _scrape_result(instance, text, ok=True):
+    return federate.ScrapeResult(
+        target=federate.Target(instance, f"http://{instance}"),
+        ok=ok, wall_s=0.0,
+        families=expofmt.parse_families(text) if ok else {})
+
+
+def test_exemplar_emit_parse_reemit_byte_stable():
+    """The round-trip satellite: raw exemplar annotations survive
+    registry exposition → parse → federated re-exposition → parse,
+    byte-for-byte."""
+    reg = Registry()
+    h = reg.histogram("t_rt_seconds", "h", buckets=(0.1, 1.0))
+    tok = obs_trace.set_current("rt-trace")
+    try:
+        h.observe(0.05)
+        h.observe(0.7)
+    finally:
+        obs_trace.reset_current(tok)
+    text = reg.expose()
+    raw_annotations = sorted(
+        line.split(" # ", 1)[1] for line in text.splitlines()
+        if " # {" in line)
+    assert len(raw_annotations) == 2
+    snap = federate.FederatedSnapshot([_scrape_result("w1", text)])
+    fleet_text = snap.expose()
+    fleet_annotations = sorted(
+        line.split(" # ", 1)[1] for line in fleet_text.splitlines()
+        if " # {" in line)
+    assert ["# " + a for a in fleet_annotations] == \
+        ["# " + a for a in raw_annotations]
+    # and the fleet exposition itself re-parses with exemplars intact
+    fams = expofmt.parse_families(fleet_text)
+    child = list(fams["t_rt_seconds"].histograms.values())[0]
+    assert [tid for _le, tid in child.exemplar_trace_ids()] == \
+        ["rt-trace", "rt-trace"]
+
+
+def test_unknown_exemplar_annotation_passes_through():
+    """An annotation this parser does NOT understand must survive a
+    federation round trip verbatim — pass-through, not validation."""
+    weird = ('# TYPE t_f_seconds histogram\n'
+             't_f_seconds_bucket{le="1"} 3 '
+             '# {span_id="zz",weird="yes"} 0.5 not-a-ts extra\n'
+             't_f_seconds_bucket{le="+Inf"} 3\n'
+             't_f_seconds_sum 1.5\n'
+             't_f_seconds_count 3\n'
+             '# TYPE t_c_total counter\n'
+             't_c_total 5 # {foo="bar"} 1\n')
+    fams = expofmt.parse_families(weird)
+    child = list(fams["t_f_seconds"].histograms.values())[0]
+    raw = child.exemplars[1.0]
+    assert raw == '# {span_id="zz",weird="yes"} 0.5 not-a-ts extra'
+    assert expofmt.parse_exemplar(raw) is None   # not understood
+    assert child.exemplar_trace_ids() == []      # and not invented
+    snap = federate.FederatedSnapshot([_scrape_result("w1", weird)])
+    fleet_text = snap.expose()
+    assert '# {span_id="zz",weird="yes"} 0.5 not-a-ts extra' in fleet_text
+    assert '# {foo="bar"} 1' in fleet_text       # counter exemplar too
+    expofmt.parse_families(fleet_text)           # still well-formed
+
+
+def test_federation_kind_mismatch_drop_with_exemplars_present():
+    reg = Registry()
+    h = reg.histogram("t_km_seconds", "h", buckets=(1.0,))
+    tok = obs_trace.set_current("keep-me")
+    try:
+        h.observe(0.5)
+    finally:
+        obs_trace.reset_current(tok)
+    good = reg.expose()
+    bad = ('# TYPE t_km_seconds counter\n'
+           't_km_seconds 7\n')
+    snap = federate.FederatedSnapshot([
+        _scrape_result("new", good), _scrape_result("old", bad)])
+    m = snap.get("t_km_seconds")
+    # the dissenting kind's children were dropped; the exemplar-bearing
+    # histogram child survived with its annotation
+    assert m.kind == "histogram"
+    assert [(inst, tid) for inst, _le, tid
+            in m.exemplar_trace_ids()] == [("new", "keep-me")]
+    assert not [k for k in m.values if k[0] == "old"]
+
+
+def test_exemplar_free_old_worker_federates_cleanly():
+    reg_new = Registry()
+    h = reg_new.histogram("t_mix_seconds", "h", buckets=(1.0,))
+    tok = obs_trace.set_current("new-only")
+    try:
+        h.observe(0.5)
+    finally:
+        obs_trace.reset_current(tok)
+    reg_old = Registry()
+    reg_old.histogram("t_mix_seconds", "h", buckets=(1.0,)).observe(0.5)
+    snap = federate.FederatedSnapshot([
+        _scrape_result("new", reg_new.expose()),
+        _scrape_result("old", reg_old.expose())])
+    m = snap.get("t_mix_seconds")
+    assert m.count == 2                          # both instances merged
+    assert [(inst, tid) for inst, _le, tid
+            in m.exemplar_trace_ids()] == [("new", "new-only")]
+    expofmt.parse_families(snap.expose())
+
+
+def test_scheduler_dispatch_carries_exemplar_trace():
+    """The scheduler seam: the dispatcher thread re-installs the oldest
+    traced member's trace ID around handle_batch, so the per-query
+    latency histogram books exemplars for fused batches too."""
+    from incubator_predictionio_tpu.serving.scheduler import (
+        BatchScheduler,
+    )
+
+    reg = Registry()
+    h = reg.histogram("t_sched_seconds", "h", buckets=(1.0,))
+
+    def handle(bodies):
+        h.observe(0.5, len(bodies))
+        return bodies
+
+    sched = BatchScheduler(handle, 8, shed=False)
+    try:
+        tok = obs_trace.set_current("sched-trace-1")
+        try:
+            fut = sched.submit({"q": 1})
+        finally:
+            obs_trace.reset_current(tok)
+        assert fut.result(timeout=10) == {"q": 1}
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not h.exemplars():
+            time.sleep(0.01)
+        ex = h.exemplars()
+        assert ex and ex[0]["traceId"] == "sched-trace-1"
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# incident capture: planted breach, cooldown, bundles
+# ---------------------------------------------------------------------------
+
+def _serve_spec(threshold=0.001):
+    return (obs_slo.SLOSpec(
+        name="serve_p99", metric="pio_query_latency_seconds",
+        threshold=threshold, target=0.99,
+        description="test objective"),)
+
+
+def test_planted_breach_exactly_one_bundle_cooldown_pinned(tmp_path):
+    reg = Registry()
+    h = reg.histogram("pio_query_latency_seconds", "q")
+    clock = FakeClock(1000.0)
+    engine = obs_slo.SLOEngine(specs=_serve_spec(), registry=reg,
+                               clock=clock, export_gauges=False,
+                               min_tick_interval_s=0.0)
+    rec = _recorder(reg, clock, clock, hz=1.0, window_s=30.0)
+    cap = IncidentCapture(directory=str(tmp_path), recorder=rec,
+                          cooldown_s=120.0, clock=clock, wall=clock,
+                          targets_fn=lambda: [],
+                          decisions_fn=lambda: [
+                              {"id": 1, "kind": "evaluation",
+                               "ts": 995.0, "action": "none"}])
+    cap.install(engine)
+
+    def bundles():
+        return sorted(p.name for p in tmp_path.glob("inc-*.json"))
+
+    engine.evaluate()                       # baseline snapshot: no data
+    assert bundles() == []
+    for step in range(5):
+        clock.advance(2.0)
+        for _ in range(20):
+            tok = obs_trace.set_current(f"bad-{step}")
+            try:
+                h.observe(0.5)              # every observation is bad
+            finally:
+                obs_trace.reset_current(tok)
+        rec.sample_now()
+        engine.evaluate()                   # breached on every pass
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and not bundles():
+        time.sleep(0.05)
+    # a SUSTAINED burn (5 breached evaluations) yielded ONE bundle
+    cap.stop()
+    assert len(bundles()) == 1, bundles()
+    bundle = json.loads((tmp_path / bundles()[0]).read_text())
+    assert bundle["trigger"] == "serve_p99"
+    assert bundle["scope"] == "process"
+    assert bundle["slo"]["windows"]["fast"]["burnRate"] > 1.0
+    # the local recorder window + the planted decision rode along
+    assert "pio_query_latency_seconds" in \
+        bundle["recorder"]["instances"]["local"]["series"]
+    assert bundle["exemplars"]["traceIds"]
+    assert bundle["decisions"] == [{"id": 1, "kind": "evaluation",
+                                    "ts": 995.0, "action": "none"}]
+    # cooldown expiry re-arms: the next breach captures again
+    clock.advance(200.0)
+    for _ in range(20):
+        h.observe(0.5)
+    engine.evaluate()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and len(bundles()) < 2:
+        time.sleep(0.05)
+    assert len(bundles()) == 2, bundles()
+    # and the artifact passes the report tool's --check gate
+    proc = subprocess.run(
+        [sys.executable, REPORT, str(tmp_path / bundles()[0]),
+         "--check"], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_incident_report_check_rejects_malformed(tmp_path):
+    bad = tmp_path / "inc-bad.json"
+    bad.write_text(json.dumps({"schema": "pio-incident-v1",
+                               "id": "inc-bad"}))
+    proc = subprocess.run(
+        [sys.executable, REPORT, str(bad), "--check"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "MALFORMED" in proc.stderr
+    notjson = tmp_path / "inc-notjson.json"
+    notjson.write_text("{truncated")
+    proc = subprocess.run(
+        [sys.executable, REPORT, str(notjson), "--check"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+
+
+def test_manual_trigger_bypasses_cooldown(tmp_path):
+    reg = Registry()
+    clock = FakeClock(0.0)
+    rec = _recorder(reg, clock, clock)
+    cap = IncidentCapture(directory=str(tmp_path), recorder=rec,
+                          cooldown_s=3600.0, clock=clock, wall=clock,
+                          targets_fn=lambda: [],
+                          decisions_fn=lambda: [])
+    out1 = cap.capture_now(cap.MANUAL_TRIGGER)
+    # SAME wall second (FakeClock unmoved): the id must uniquify, not
+    # silently clobber the first bundle
+    out2 = cap.capture_now(cap.MANUAL_TRIGGER)
+    assert out1["id"] != out2["id"]
+    assert len(list(tmp_path.glob("inc-*.json"))) == 2
+    # trigger() (the breach path) still honors cooldown per reason
+    assert cap.trigger("serve_p99") is True
+    assert cap.trigger("serve_p99") is False
+    cap.stop()
+
+
+def test_failed_capture_does_not_consume_cooldown(tmp_path):
+    """A transient bundle-write failure must re-arm the trigger: the
+    incident's ring evidence is aging out, and a 300 s blind window
+    after ENOSPC would lose it."""
+    reg = Registry()
+    clock = FakeClock(0.0)
+    rec = _recorder(reg, clock, clock)
+    cap = IncidentCapture(directory=str(tmp_path), recorder=rec,
+                          cooldown_s=3600.0, clock=clock, wall=clock,
+                          targets_fn=lambda: [],
+                          decisions_fn=lambda: [])
+    boom = {"fail": True}
+    real_capture = cap.capture_now
+
+    def flaky(reason, slo_entry=None):
+        if boom["fail"]:
+            raise OSError("disk full")
+        return real_capture(reason, slo_entry)
+
+    cap.capture_now = flaky
+    assert cap.trigger("serve_p99") is True
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and "serve_p99" in cap._queued:
+        time.sleep(0.02)
+    assert not list(tmp_path.glob("inc-*.json"))
+    # the failure rolled the cooldown stamp back: the next breach
+    # (storage fixed) captures immediately
+    boom["fail"] = False
+    assert cap.trigger("serve_p99") is True
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and \
+            not list(tmp_path.glob("inc-*.json")):
+        time.sleep(0.02)
+    assert len(list(tmp_path.glob("inc-*.json"))) == 1
+    cap.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP e2e: /recorder, /incidents, POST /incident
+# ---------------------------------------------------------------------------
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_recorder_and_incident_routes_e2e(monkeypatch, tmp_path,
+                                          clean_singletons):
+    from incubator_predictionio_tpu.obs.http import (
+        add_incident_routes,
+        add_metrics_route,
+        add_recorder_route,
+    )
+    from incubator_predictionio_tpu.utils.http import HttpServer, Router
+
+    monkeypatch.setenv("PIO_RECORDER", "1")
+    monkeypatch.setenv("PIO_RECORDER_HZ", "20")
+    monkeypatch.setenv("PIO_INCIDENT_DIR", str(tmp_path))
+    h = obs_metrics.REGISTRY.histogram(
+        "pio_query_latency_seconds",
+        "per-query serving wall (micro-batch members share the batch "
+        "wall)")
+    tok = obs_trace.set_current("e2e-trace")
+    try:
+        for _ in range(10):
+            h.observe(0.02)
+    finally:
+        obs_trace.reset_current(tok)
+    r = Router()
+    add_metrics_route(r)
+    add_recorder_route(r)
+    add_incident_routes(r)
+    srv = HttpServer(r, "127.0.0.1", 0, name="admin")
+    port = srv.start_background()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                _get_json(port, "/recorder")["samples"] < 3:
+            time.sleep(0.05)
+        idx = _get_json(port, "/recorder")
+        assert idx["samples"] >= 3
+        assert "pio_query_latency_seconds" in idx["series"]
+        win = _get_json(
+            port, "/recorder?series=pio_query_latency_seconds&window=60")
+        pts = win["series"]["pio_query_latency_seconds"][
+            "children"][0]["points"]
+        assert pts and pts[-1][1] >= 10
+        full = _get_json(port, "/recorder?all=1")
+        assert any(e["traceId"] == "e2e-trace"
+                   for e in full["exemplars"])
+        # /metrics carries the exemplar syntax end to end
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert '# {trace_id="e2e-trace"}' in text
+        # manual capture + listing + fetch
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/incident", data=b"",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            created = json.loads(resp.read().decode())
+        assert created["id"].startswith("inc-")
+        listing = _get_json(port, "/incidents")
+        assert [i["id"] for i in listing["incidents"]] == [created["id"]]
+        bundle = _get_json(port, f"/incidents/{created['id']}")
+        assert bundle["trigger"] == "manual"
+        assert "local" in bundle["recorder"]["instances"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(port, "/incidents/inc-nope")
+        assert err.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_recorder_route_503_when_disabled(monkeypatch, clean_singletons):
+    from incubator_predictionio_tpu.obs.http import add_recorder_route
+    from incubator_predictionio_tpu.utils.http import HttpServer, Router
+
+    monkeypatch.setenv("PIO_RECORDER", "0")
+    r = Router()
+    add_recorder_route(r)
+    srv = HttpServer(r, "127.0.0.1", 0, name="worker")
+    port = srv.start_background()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(port, "/recorder")
+        assert err.value.code == 503
+    finally:
+        srv.stop()
+
+
+def test_incident_routes_503_without_dir(monkeypatch, clean_singletons):
+    from incubator_predictionio_tpu.obs.http import add_incident_routes
+    from incubator_predictionio_tpu.utils.http import HttpServer, Router
+
+    monkeypatch.delenv("PIO_INCIDENT_DIR", raising=False)
+    r = Router()
+    add_incident_routes(r)
+    srv = HttpServer(r, "127.0.0.1", 0, name="admin")
+    port = srv.start_background()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(port, "/incidents")
+        assert err.value.code == 503
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: two REAL workers, fleet breach -> ONE bundle with
+# instance-labeled windows, exemplar trace IDs, stitched cross-process
+# ---------------------------------------------------------------------------
+
+def _spawn_serve_worker(seed, stderr_sink):
+    """Launch one serve-mode worker (returns immediately; pair with
+    :func:`_await_worker_port` so two workers pay their jax imports in
+    parallel). The worker's stderr — its span log — drains live into
+    ``stderr_sink`` so the pipe can never fill."""
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "PIO_RECORDER": "1",
+           "PIO_RECORDER_HZ": "10",
+           "PIO_SPEED_LAYER": "0"}
+    env.pop("PIO_INCIDENT_DIR", None)  # workers record, the TEST captures
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, "--mode", "serve", "--seed", str(seed),
+         "--users", "60", "--items", "40", "--rank", "8",
+         "--max-batch", "8"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, cwd=TESTS_DIR, env=env)
+
+    def drain():
+        for line in proc.stderr:
+            stderr_sink.append(line)
+
+    threading.Thread(target=drain, daemon=True).start()
+    return proc
+
+
+def _await_worker_port(proc, stderr_sink):
+    port_holder = []
+
+    def read_port():
+        line = proc.stdout.readline()
+        if line.startswith("PORT "):
+            port_holder.append(int(line.split()[1]))
+
+    t = threading.Thread(target=read_port, daemon=True)
+    t.start()
+    t.join(timeout=120)
+    if not port_holder:
+        proc.kill()
+        raise RuntimeError(
+            "worker never bound: " + "".join(stderr_sink)[-2000:])
+    return port_holder[0]
+
+
+def _load_trace_stitch():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import trace_stitch
+    return trace_stitch
+
+
+def test_two_worker_fleet_breach_acceptance(tmp_path):
+    """Planted two-REAL-worker fleet SLO breach → autonomously ONE
+    incident bundle with the fleet-merged pre-breach window (instance
+    labels), ≥1 exemplar trace ID for the breaching histogram that the
+    trace_stitch machinery reconstructs cross-process, and the
+    in-window controller decisions — the PR's acceptance bar."""
+    spans0, spans1 = [], []
+    p0 = _spawn_serve_worker(0, spans0)
+    p1 = _spawn_serve_worker(1, spans1)
+    port0 = _await_worker_port(p0, spans0)
+    port1 = _await_worker_port(p1, spans1)
+    sent_traces = []
+    try:
+        targets = [
+            federate.Target("w0", f"http://127.0.0.1:{port0}/metrics"),
+            federate.Target("w1", f"http://127.0.0.1:{port1}/metrics"),
+        ]
+        fleet_reg = federate.FleetRegistry(
+            targets_fn=lambda: targets, max_age_s=0.0)
+        engine = obs_slo.SLOEngine(
+            specs=_serve_spec(threshold=1e-6),  # every real serve is bad
+            registry=fleet_reg, export_gauges=False,
+            min_tick_interval_s=0.0)
+        decisions = [{"id": 7, "kind": "evaluation", "mode": "act",
+                      "ts": time.time(), "action": "retrain+reload",
+                      "reason": "staleness_projection",
+                      "traceId": "ctl-deadbeef"}]
+        cap = IncidentCapture(
+            directory=str(tmp_path), cooldown_s=3600.0,
+            targets_fn=lambda: targets,
+            decisions_fn=lambda: decisions)
+        cap.install(engine)
+
+        def query(port, i):
+            tid = f"fleet-q-{port}-{i}"
+            sent_traces.append(tid)
+            body = json.dumps({"user": f"u{i % 60}", "num": 5}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-PIO-Trace-Id": tid,
+                         "X-PIO-Parent-Span": "cafe0001"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+
+        engine.evaluate()                 # baseline fleet snapshot
+        for i in range(25):
+            query(port0, i)
+            query(port1, i)
+        time.sleep(1.0)                   # worker recorders tick (10 Hz)
+        engine.evaluate()                 # burn > 1 -> breach -> capture
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and \
+                not list(tmp_path.glob("inc-*.json")):
+            time.sleep(0.1)
+        bundles = sorted(tmp_path.glob("inc-*.json"))
+        assert len(bundles) == 1, [b.name for b in bundles]
+        # sustained burn: further breached evaluations add NO bundle
+        for i in range(25, 35):
+            query(port0, i)
+        engine.evaluate()
+        time.sleep(1.0)
+        assert len(list(tmp_path.glob("inc-*.json"))) == 1
+        cap.stop()
+
+        bundle = json.loads(bundles[0].read_text())
+        assert bundle["trigger"] == "serve_p99"
+        assert bundle["scope"] == "fleet"
+        insts = bundle["recorder"]["instances"]
+        # the fleet-merged pre-breach window: BOTH instances, each with
+        # the breaching histogram's recorded history + scheduler state
+        assert sorted(insts) == ["w0", "w1"]
+        for name in ("w0", "w1"):
+            dump = insts[name]
+            assert "error" not in dump, dump.get("error")
+            assert "pio_query_latency_seconds" in dump["series"]
+            assert "scheduler" in dump["state"]
+            assert "engines" in dump["state"]["scheduler"]
+        # >=1 exemplar trace ID for the breaching histogram, and it is
+        # one of the trace IDs the load generator actually sent
+        ex_ids = bundle["exemplars"]["traceIds"]
+        assert ex_ids and set(ex_ids) <= set(sent_traces)
+        # the in-window controller decisions rode along
+        assert bundle["decisions"] == decisions
+        # incident_report --check accepts the artifact
+        proc = subprocess.run(
+            [sys.executable, REPORT, str(bundles[0]), "--check"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        for p in (p0, p1):
+            try:
+                p.stdin.close()
+            except Exception:
+                pass
+        for p in (p0, p1):
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                p.kill()
+    # cross-process reconstruction: the workers' span logs (their
+    # stderr, drained live) hold the exemplar traces; the stitcher
+    # rebuilds each as a tree whose worker span links under the
+    # client-side parent span the generator stamped
+    trace_stitch = _load_trace_stitch()
+    spans = trace_stitch.parse_span_lines(spans0 + spans1)
+    traces = trace_stitch.group_by_trace(spans)
+    ex_ids = json.loads(
+        sorted(tmp_path.glob("inc-*.json"))[0].read_text())[
+        "exemplars"]["traceIds"]
+    stitched = 0
+    for tid in ex_ids:
+        if tid not in traces:
+            continue
+        rendered = trace_stitch.render_trace(tid, traces[tid])
+        assert "prediction POST /queries.json" in rendered
+        # the worker's span named the client's parent span id
+        assert any(s.get("parentSpanId") == "cafe0001"
+                   for s in traces[tid])
+        stitched += 1
+    assert stitched >= 1, (ex_ids, list(traces)[:5])

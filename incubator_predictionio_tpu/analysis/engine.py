@@ -32,6 +32,14 @@ SEVERITIES = ("warning", "error")
 
 _SUPPRESS_RE = re.compile(r"#\s*pio-lint:\s*disable=([\w,\- ]+)")
 _SUPPRESS_FILE_RE = re.compile(r"#\s*pio-lint:\s*disable-file=([\w,\- ]+)")
+#: concurrency-contract annotations (docs/lint.md "Concurrency
+#: contract"): ``# pio-lint: guarded-by(<lock>)`` declares the lock
+#: attribute that must be held for every write of the annotated
+#: attribute; ``# pio-lint: publish-only`` declares a single-writer
+#: immutable-publish attribute (the recorder ring idiom). Both are
+#: VERIFIED by analysis/concur.py, not trusted.
+_ANNOTATION_RE = re.compile(
+    r"#\s*pio-lint:\s*(publish-only|guarded-by\(\s*[\w.]+\s*\))")
 
 #: modules allowed to read os.environ at import time by name
 CONFIG_MODULE_RE = re.compile(r"(config|settings|conftest)")
@@ -67,7 +75,8 @@ class Module:
         self.tree = ast.parse(source, filename=str(path))
         self.aliases = _import_aliases(self.tree)
         self.traced_roots = _traced_roots(self.tree, self.aliases)
-        self.line_disables, self.file_disables = _suppressions(source)
+        (self.line_disables, self.file_disables,
+         self.line_annotations) = _suppressions(source)
 
     # -- shared helpers -----------------------------------------------------
 
@@ -84,9 +93,24 @@ class Module:
 
     def finding(self, rule: "object", node: ast.AST, message: str) -> Finding:
         line = getattr(node, "lineno", 1)
+        return self.finding_at(rule, line, message)
+
+    def finding_at(self, rule: "object", line: int, message: str) -> Finding:
+        """Finding anchored at a line number — package rules report from
+        index records, not live AST nodes."""
         return Finding(rule=rule.name, severity=rule.severity,
                        path=self.relpath, line=line, message=message,
                        snippet=self.snippet_at(line))
+
+    def annotations_at(self, line: int) -> Set[str]:
+        """Concurrency-contract annotations attached to ``line``: a
+        trailing ``# pio-lint: ...`` comment on the line itself, or one
+        on its own comment line directly above (same attachment rule as
+        suppressions)."""
+        out = set(self.line_annotations.get(line, ()))
+        if _is_comment_line(self.lines, line - 1):
+            out |= self.line_annotations.get(line - 1, set())
+        return out
 
     def is_suppressed(self, f: Finding) -> bool:
         for rules in (self.file_disables,
@@ -104,17 +128,23 @@ def _is_comment_line(lines: List[str], line: int) -> bool:
     return 1 <= line <= len(lines) and lines[line - 1].lstrip().startswith("#")
 
 
-def _suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+def _suppressions(
+    source: str,
+) -> Tuple[Dict[int, Set[str]], Set[str], Dict[int, Set[str]]]:
     """Directive parsing over COMMENT tokens only — a docstring that
     *documents* the ``# pio-lint: disable=...`` syntax must not disable
     anything (the module already parsed, so tokenize cannot fail on
-    syntax; be permissive about anything else)."""
+    syntax; be permissive about anything else). Returns
+    ``(line disables, file disables, line annotations)`` — annotations
+    are the concurrency-contract directives (publish-only /
+    guarded-by(<lock>)), normalized with whitespace stripped."""
     per_line: Dict[int, Set[str]] = {}
     whole_file: Set[str] = set()
+    annotations: Dict[int, Set[str]] = {}
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
     except (tokenize.TokenError, IndentationError):
-        return per_line, whole_file
+        return per_line, whole_file, annotations
     for tok in tokens:
         if tok.type != tokenize.COMMENT:
             continue
@@ -126,7 +156,11 @@ def _suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
         if m:
             per_line.setdefault(tok.start[0], set()).update(
                 _split_rules(m.group(1)))
-    return per_line, whole_file
+            continue
+        for m in _ANNOTATION_RE.finditer(tok.string):
+            annotations.setdefault(tok.start[0], set()).add(
+                re.sub(r"\s+", "", m.group(1)))
+    return per_line, whole_file, annotations
 
 
 def _split_rules(raw: str) -> Set[str]:
@@ -314,14 +348,59 @@ def _relpath(path: Path) -> str:
         return path.as_posix()
 
 
+class Package:
+    """Whole-program view handed to rule API v2 (``check_package``):
+    every parsed :class:`Module` of the run, plus a shared scratch
+    cache so several package rules can split one expensive index
+    (analysis/concur.py builds its class/thread index once here)."""
+
+    def __init__(self, modules: Sequence[Module]) -> None:
+        self.modules = list(modules)
+        self.by_relpath: Dict[str, Module] = {
+            m.relpath: m for m in self.modules}
+        #: shared per-run scratch space for package-rule indexes
+        self.cache: Dict[str, object] = {}
+
+
 def lint_paths(
     paths: Sequence[Path],
     rules: Sequence[object],
     on_parse_error: Optional[List[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
+    suppressed_out: Optional[List[Finding]] = None,
 ) -> List[Finding]:
     """Run every rule over every file; inline suppressions applied,
-    baseline NOT applied (see :func:`apply_baseline`)."""
+    baseline NOT applied (see :func:`apply_baseline`).
+
+    Two-phase protocol: per-file rules (``check(mod)``) run module by
+    module exactly as before; whole-program rules (``whole_program =
+    True`` + ``check_package(package)``) run once afterwards over the
+    full :class:`Package`. ``timings`` (if given) is filled with
+    per-rule wall-clock seconds — the ``--timings`` report and the
+    tier-1 lint-budget test read it. ``suppressed_out`` (if given)
+    collects findings silenced by inline directives instead of
+    dropping them (the ``--format json`` report marks them)."""
+    import time as _time
+
     findings: List[Finding] = []
+    modules: List[Module] = []
+    per_file = [r for r in rules
+                if not getattr(r, "whole_program", False)]
+    package_rules = [r for r in rules
+                     if getattr(r, "whole_program", False)]
+
+    def _book(rule: object, t0: float) -> None:
+        if timings is not None:
+            timings[rule.name] = (timings.get(rule.name, 0.0)
+                                  + _time.perf_counter() - t0)
+
+    def _emit(mod: Module, finding: Finding) -> None:
+        if mod.is_suppressed(finding):
+            if suppressed_out is not None:
+                suppressed_out.append(finding)
+        else:
+            findings.append(finding)
+
     for f in iter_py_files(paths):
         try:
             mod = Module(f, _relpath(f), f.read_text(encoding="utf-8"))
@@ -329,11 +408,26 @@ def lint_paths(
             if on_parse_error is not None:
                 on_parse_error.append(f"{f}: {exc}")
             continue
-        for rule in rules:
+        modules.append(mod)
+        for rule in per_file:
+            t0 = _time.perf_counter()
             for finding in rule.check(mod):
-                if not mod.is_suppressed(finding):
+                _emit(mod, finding)
+            _book(rule, t0)
+    if package_rules and modules:
+        package = Package(modules)
+        for rule in package_rules:
+            t0 = _time.perf_counter()
+            for finding in rule.check_package(package):
+                mod = package.by_relpath.get(finding.path)
+                if mod is None:
                     findings.append(finding)
+                else:
+                    _emit(mod, finding)
+            _book(rule, t0)
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
+    if suppressed_out is not None:
+        suppressed_out.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings
 
 
@@ -401,10 +495,19 @@ def write_baseline(path: Path, findings: Sequence[Finding],
             entry["justification"] = old.pop(0)
         entries.append(entry)
     entries.sort(key=lambda e: (e["path"], e["rule"], e["snippet"]))
+    save_baseline_entries(path, entries)
+
+
+def save_baseline_entries(path: Path, entries: Sequence[dict]) -> None:
+    """Write ``entries`` as the baseline file verbatim (sorted) — the
+    --prune-baseline path, which must drop stale entries WITHOUT
+    touching the surviving hand-written justifications."""
+    entries = sorted(entries,
+                     key=lambda e: (e["path"], e["rule"], e["snippet"]))
     payload = {
         "comment": ("pio-lint baseline: deliberate exceptions, one "
                     "justification each. Regenerate with --write-baseline "
                     "(see docs/lint.md) and re-justify every entry."),
-        "entries": entries,
+        "entries": list(entries),
     }
     path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")

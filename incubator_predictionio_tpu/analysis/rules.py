@@ -1335,6 +1335,13 @@ class RecorderInServePath(Rule):
                         "(or nothing: the sampler already records)")
 
 
+# whole-program (rule API v2) passes live in their own module — they
+# consume the package index, not a single Module
+from incubator_predictionio_tpu.analysis.concur import (  # noqa: E402
+    ThreadLifecycle,
+    UnguardedSharedState,
+)
+
 ALL_RULES: Sequence[Rule] = (
     HostSyncInTrace(),
     NegativeGather(),
@@ -1355,6 +1362,8 @@ ALL_RULES: Sequence[Rule] = (
     UnboundedRetry(),
     UnauditedActuation(),
     RecorderInServePath(),
+    UnguardedSharedState(),
+    ThreadLifecycle(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -282,7 +282,8 @@ class FreshnessController:
     # -- mode (the kill switch) ---------------------------------------------
     @property
     def mode(self) -> str:
-        return self._mode_override or controller_mode()
+        with self._lock:
+            return self._mode_override or controller_mode()
 
     def set_mode(self, mode: str) -> str:
         """Live flip (POST /controller). Takes effect at the next
@@ -294,7 +295,8 @@ class FreshnessController:
             raise ValueError(
                 f"mode must be one of {MODES}, got {mode!r}")
         with self._lock:
-            prev = self.mode
+            # inline (not the property): self._lock is not reentrant
+            prev = self._mode_override or controller_mode()
             self._mode_override = mode
             self._seq += 1
             self._ring.append({
@@ -501,10 +503,12 @@ class FreshnessController:
             _SKIPS.labels(reason="hysteresis").inc()
             self._append(decision)
             return decision
-        if now < self._cooldown_until:
+        with self._lock:
+            cooldown_until = self._cooldown_until
+        if now < cooldown_until:
             decision["reason"] = "cooldown"
             decision["cooldownRemainingS"] = round(
-                self._cooldown_until - now, 3)
+                cooldown_until - now, 3)
             _SKIPS.labels(reason="cooldown").inc()
             self._append(decision)
             return decision
@@ -557,11 +561,11 @@ class FreshnessController:
             self._last_action = decision
         self._append(decision)
         self._actuate(decision)
-        with self._lock:
-            self._streak = 0
         # cooldown counts from actuation COMPLETION: a long retrain
         # must not eat its own cooldown
-        self._cooldown_until = self._clock() + self.config.cooldown_s
+        with self._lock:
+            self._streak = 0
+            self._cooldown_until = self._clock() + self.config.cooldown_s
         return decision
 
     # -- the decision-record emitter (the ONE sanctioned actuation site) ----
@@ -664,7 +668,8 @@ class FreshnessController:
         now = self._clock()
         with self._lock:
             return {
-                "mode": self.mode,
+                # inline (not the property): self._lock is not reentrant
+                "mode": self._mode_override or controller_mode(),
                 "running": self._thread is not None
                 and self._thread.is_alive(),
                 "intervalS": self.config.interval_s,

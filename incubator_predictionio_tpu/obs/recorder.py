@@ -203,12 +203,17 @@ class FlightRecorder:
         #: reachable keyframe always precedes the oldest window sample
         self.slots = int(self.window_s * self.hz) + self._keyframe_every + 1
         #: ring entries: (idx, wall_ts, kind, data, byte_est) — kind
-        #: "key" (full snapshot) or "delta" (changed series only)
-        self._ring: List[Optional[tuple]] = [None] * self.slots
-        self._head = 0            # next sample index (monotonic)
-        self._ring_bytes = 0
+        #: "key" (full snapshot) or "delta" (changed series only).
+        #: All four ring fields below are single-writer (the sampler
+        #: loop) immutable-publishes read lock-free by window()/index();
+        #: the annotations are VERIFIED by pio-lint's
+        #: unguarded-shared-state pass (docs/lint.md).
+        self._ring: List[Optional[tuple]] = [None] * self.slots  # pio-lint: publish-only
+        self._head = 0  # pio-lint: publish-only — next sample index (monotonic)
+        self._ring_bytes = 0  # pio-lint: publish-only
         self._last: Dict[_SeriesKey, Any] = {}
         #: family meta discovered at snapshot time: name → (kind, bounds)
+        # pio-lint: publish-only
         self._meta: Dict[str, Tuple[str, Optional[Tuple[float, ...]]]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -833,7 +833,11 @@ class PredictionServer:
         @r.post("/stop")
         def stop_route(request: Request) -> Response:
             self._check_server_key(request)
-            threading.Timer(0.2, self.stop).start()
+            # daemonized: if the process is torn down some other way
+            # first, a pending non-daemon timer would block exit
+            timer = threading.Timer(0.2, self.stop)
+            timer.daemon = True
+            timer.start()
             return Response(200, {"message": "Shutting down."})
 
         @r.get("/plugins.json")

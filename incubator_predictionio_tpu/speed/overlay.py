@@ -206,7 +206,8 @@ class SpeedOverlay:
 
     @property
     def enabled(self) -> bool:
-        return self.cursor >= 0
+        with self._lock:
+            return self.cursor >= 0
 
     # -- serving-side API (hot path: dict probes only) ----------------------
     def lookup(self, key_id: str) -> Optional[np.ndarray]:
@@ -337,11 +338,16 @@ class SpeedOverlay:
         from incubator_predictionio_tpu.data.store import EventStore
 
         cfg = self.config
-        if not self.enabled:
+        # snapshot the cursor once: it is written under the lock by the
+        # reset branch below and by _fold_chunks, and read by stats()
+        # scrapes on other threads
+        with self._lock:
+            cursor = self.cursor
+        if cursor < 0:
             return {"enabled": False}
         inter, _times, append_ms, new_cursor, reset = \
             EventStore.read_interactions_since(
-                self.cursor, cfg.app_name, cfg.channel_name,
+                cursor, cfg.app_name, cfg.channel_name,
                 entity_type=cfg.entity_type,
                 target_entity_type=cfg.target_entity_type,
                 event_names=cfg.event_names,
@@ -349,18 +355,18 @@ class SpeedOverlay:
                 event_values=cfg.event_values,
                 default_value=cfg.default_value,
             )
-        if reset or new_cursor < self.cursor:
+        if reset or new_cursor < cursor:
             # log rewrite (compaction/drop): every derived fact is
             # suspect — invalidate and resynchronize
             logger.warning(
                 "speed overlay: cursor reset (%d -> %d); invalidating",
-                self.cursor, new_cursor)
+                cursor, new_cursor)
             with self._lock:
                 self._vectors.clear()
                 self._dirty.clear()
                 self._tail_hist.clear()
+                self.cursor = new_cursor
             self.freshness.invalidate()
-            self.cursor = new_cursor
             return {"reset": True, "cursor": new_cursor}
         if cfg.key_side == "entity":
             tail_keys = inter.user_ids
@@ -453,13 +459,16 @@ class SpeedOverlay:
             bucket = max(_foldin.max_batch(), 1)
             base = max(int(cfg.max_keys_per_poll), 1)
             cap = base * max(int(cfg.max_keys_growth), 1)
-            if backlog > self._budget_rung:
-                grown = min(self._budget_rung * 2, cap)
-                if grown > base:
-                    grown = min(-(-grown // bucket) * bucket, cap)
-                self._budget_rung = grown
-            elif 2 * backlog <= self._budget_rung:
-                self._budget_rung = max(self._budget_rung // 2, base)
+            # the rung is read by stats() scrapes and the budget slice
+            # above, both under the lock
+            with self._lock:
+                if backlog > self._budget_rung:
+                    grown = min(self._budget_rung * 2, cap)
+                    if grown > base:
+                        grown = min(-(-grown // bucket) * bucket, cap)
+                    self._budget_rung = grown
+                elif 2 * backlog <= self._budget_rung:
+                    self._budget_rung = max(self._budget_rung // 2, base)
         with self._lock:
             size = len(self._vectors)
             still_dirty = len(self._dirty)
@@ -471,11 +480,12 @@ class SpeedOverlay:
         lag = int(end_cursor) - int(new_cursor)
         if not 0 <= lag < (1 << 40):
             lag = 0  # log generation changed mid-poll; next poll resets
-        self.last_lag = lag
-        _CURSOR_LAG.set(self.last_lag)
+        with self._lock:
+            self.last_lag = lag
+        _CURSOR_LAG.set(lag)
         return {"tail_rows": int(len(inter)), "solved": solved,
                 "size": size, "dirty": still_dirty,
-                "cursor": new_cursor, "lag": self.last_lag}
+                "cursor": new_cursor, "lag": lag}
 
     # -- history + solve ----------------------------------------------------
     def _history(self, key_id: str) -> Tuple[np.ndarray, np.ndarray]:

@@ -381,7 +381,10 @@ class HttpServer:
                  name: str = "http"):
         self.router = router
         self.host = host
-        self.port = port
+        # written once by the loop thread (the bound port) before the
+        # `_started` Event publishes it to waiters; verified by
+        # pio-lint's unguarded-shared-state pass (docs/lint.md)
+        self.port = port  # pio-lint: publish-only
         #: `server` label on the shared request metrics + span logs
         self.name = name
         self.ssl_context = ssl_context
@@ -390,8 +393,9 @@ class HttpServer:
         #: (CreateServer.scala:371-381)
         self.bind_retries = bind_retries
         self.bind_retry_delay = bind_retry_delay
-        self._server: Optional[asyncio.AbstractServer] = None
-        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # single-writer (the loop thread), `_started`-Event-sequenced
+        self._server: Optional[asyncio.AbstractServer] = None  # pio-lint: publish-only
+        self._loop: Optional[asyncio.AbstractEventLoop] = None  # pio-lint: publish-only
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
 
@@ -624,7 +628,8 @@ class HttpServer:
 
     def start_background(self) -> int:
         """Run the server on a daemon thread; returns the bound port."""
-        self._start_error: Optional[BaseException] = None
+        # loop-thread writes sequenced by the `_started` Event
+        self._start_error: Optional[BaseException] = None  # pio-lint: publish-only
 
         def _run() -> None:
             try:

@@ -3,6 +3,17 @@
 # baseline (docs/lint.md). Extra args pass through, e.g.:
 #   scripts/lint.sh --select host-sync,probe-arity
 #   scripts/lint.sh --write-baseline   # then hand-justify every entry
+#
+# CI artifact mode: set PIO_LINT_OUT=<dir> to also drop the
+# machine-readable report (lint-report.json) and the text transcript
+# (lint-report.txt) there, exit code preserved.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [[ -n "${PIO_LINT_OUT:-}" ]]; then
+  mkdir -p "$PIO_LINT_OUT"
+  python -m incubator_predictionio_tpu.analysis --baseline \
+    --json-out "$PIO_LINT_OUT/lint-report.json" "$@" \
+    | tee "$PIO_LINT_OUT/lint-report.txt"
+  exit "${PIPESTATUS[0]}"
+fi
 exec python -m incubator_predictionio_tpu.analysis --baseline "$@"

@@ -9,6 +9,7 @@ tree stays clean modulo the checked-in baseline.
 
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 import pytest
@@ -555,6 +556,67 @@ def handle(request, route_label, response):
         PHASES.labels(phase=phase).set(secs)
 """,
     ),
+    "unguarded-shared-state": (
+        """
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.count += 1      # poller write, no lock
+
+    def stats(self):
+        with self._lock:         # scrape read, under the lock
+            return {"count": self.count}
+""",
+        """
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self.count += 1
+
+    def stats(self):
+        with self._lock:
+            return {"count": self.count}
+""",
+    ),
+    "thread-lifecycle": (
+        """
+import threading
+
+
+def kick(fn):
+    threading.Thread(target=fn).start()
+""",
+        """
+import threading
+
+
+def kick(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+""",
+    ),
 }
 
 
@@ -794,3 +856,381 @@ def test_cli_exit_one_on_findings(tmp_path):
         cwd=repo_root(), capture_output=True, text=True, timeout=120)
     assert proc.returncode == 1
     assert "[env-import]" in proc.stdout
+
+# ---------------------------------------------------------------------------
+# whole-program concurrency pass (unguarded-shared-state / thread-lifecycle)
+# ---------------------------------------------------------------------------
+
+def test_timer_spawn_without_daemon_flagged(tmp_path):
+    src = """
+import threading
+
+
+class Refresher:
+    def kick(self):
+        t = threading.Timer(5.0, self._tick)
+        t.start()
+
+    def _tick(self):
+        pass
+"""
+    findings = _lint_source(tmp_path, src, "thread-lifecycle")
+    assert len(findings) == 1 and "timer" in findings[0].message.lower()
+
+
+def test_timer_daemonized_on_local_is_silent(tmp_path):
+    src = """
+import threading
+
+
+class Refresher:
+    def kick(self):
+        t = threading.Timer(5.0, self._tick)
+        t.daemon = True
+        t.start()
+
+    def _tick(self):
+        pass
+"""
+    assert not _lint_source(tmp_path, src, "thread-lifecycle")
+
+
+def test_executor_without_shutdown_flagged_with_block_silent(tmp_path):
+    bad = """
+from concurrent.futures import ThreadPoolExecutor
+
+
+def fan_out(items, fn):
+    ex = ThreadPoolExecutor(max_workers=4)
+    return [ex.submit(fn, it) for it in items]
+"""
+    good = """
+from concurrent.futures import ThreadPoolExecutor
+
+
+def fan_out(items, fn):
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        return [f.result() for f in [ex.submit(fn, it) for it in items]]
+"""
+    findings = _lint_source(tmp_path, bad, "thread-lifecycle")
+    assert len(findings) == 1 and "executor" in findings[0].message.lower()
+    assert not _lint_source(tmp_path, good, "thread-lifecycle")
+
+
+def test_nested_and_aliased_lock_regions_count_as_guarded(tmp_path):
+    src = """
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self.total = 0
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        lk = self._lock
+        with lk:
+            with self._io_lock:
+                self.total += 1
+
+    def read(self):
+        with self._lock:
+            return self.total
+"""
+    assert not _lint_source(tmp_path, src, "unguarded-shared-state")
+
+
+def test_publish_only_annotation_honored_for_single_writer(tmp_path):
+    src = """
+import threading
+
+
+class Sampler:
+    def __init__(self):
+        self.snapshot = ()  # pio-lint: publish-only
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        while True:
+            self.snapshot = (1, 2, 3)
+
+    def read(self):
+        return self.snapshot
+"""
+    assert not _lint_source(tmp_path, src, "unguarded-shared-state")
+
+
+def test_publish_only_annotation_verified_multi_writer_flagged(tmp_path):
+    src = """
+import threading
+
+
+class Sampler:
+    def __init__(self):
+        self.snapshot = ()  # pio-lint: publish-only
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        while True:
+            self.snapshot = (1, 2, 3)
+
+    def reset(self):
+        self.snapshot = ()
+
+    def read(self):
+        return self.snapshot
+"""
+    findings = _lint_source(tmp_path, src, "unguarded-shared-state")
+    assert len(findings) == 1
+    assert "publish-only" in findings[0].message
+
+
+def test_guarded_by_annotation_honored(tmp_path):
+    src = """
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # pio-lint: guarded-by(_lock)
+        self.count = 0
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def read(self):
+        return self.count
+"""
+    assert not _lint_source(tmp_path, src, "unguarded-shared-state")
+
+
+def test_guarded_by_annotation_verified_bare_write_flagged(tmp_path):
+    src = """
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # pio-lint: guarded-by(_lock)
+        self.count = 0
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self.count += 1
+
+    def read(self):
+        with self._lock:
+            return self.count
+"""
+    findings = _lint_source(tmp_path, src, "unguarded-shared-state")
+    assert len(findings) == 1
+    assert "guarded-by" in findings[0].message
+
+
+def test_queue_handoff_is_sanctioned(tmp_path):
+    src = """
+import queue
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._q = queue.Queue()
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        while True:
+            self._q.put(1)
+
+    def drain(self):
+        return self._q.get()
+"""
+    assert not _lint_source(tmp_path, src, "unguarded-shared-state")
+
+
+def test_cross_method_reachability_through_call_graph(tmp_path):
+    """A write two hops away from the thread entry (entry -> helper) is
+    still on a thread-side path and must be flagged."""
+    src = """
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        while True:
+            self._step()
+
+    def _step(self):
+        self.total += 1
+
+    def report(self):
+        with self._lock:
+            return self.total
+"""
+    findings = _lint_source(tmp_path, src, "unguarded-shared-state")
+    assert len(findings) == 1
+    assert findings[0].line and "total" in findings[0].message
+
+
+def test_caller_held_lock_propagates_into_private_helper(tmp_path):
+    """A `_locked`-style helper whose every call site holds the lock is
+    effectively guarded — no finding."""
+    src = """
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._bump()
+
+    def _bump(self):
+        self.n += 1
+
+    def get(self):
+        with self._lock:
+            return self.n
+"""
+    assert not _lint_source(tmp_path, src, "unguarded-shared-state")
+
+
+def test_fully_unguarded_conflict_reported_once_per_attr(tmp_path):
+    """Tier B: no lock anywhere, but a genuine cross-domain conflict —
+    one finding anchored at the thread-side write, not one per access."""
+    src = """
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self.hits = 0
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        while True:
+            self.hits += 1
+
+    def read(self):
+        return self.hits
+
+    def read_again(self):
+        return self.hits
+"""
+    findings = _lint_source(tmp_path, src, "unguarded-shared-state")
+    assert len(findings) == 1 and "hits" in findings[0].message
+
+
+def test_single_domain_state_never_flagged(tmp_path):
+    """No spawn, or all accesses on one side: no conflict, no finding."""
+    src = """
+class Plain:
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+
+    def read(self):
+        return self.count
+"""
+    assert not _lint_source(tmp_path, src, "unguarded-shared-state")
+
+
+def test_cli_format_json(tmp_path):
+    import json
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["env-import"][0], encoding="utf-8")
+    proc = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.analysis",
+         str(bad), "--format", "json"],
+        cwd=repo_root(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 1
+    assert (doc["summary"]["errors"] + doc["summary"]["warnings"] >= 1
+            and not doc["summary"]["clean"])
+    assert any(f["rule"] == "env-import" and not f["suppressed"]
+               for f in doc["findings"])
+    assert "ruleTimingsMs" in doc
+
+
+def test_cli_json_out_artifact(tmp_path):
+    import json
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["env-import"][0], encoding="utf-8")
+    out = tmp_path / "artifacts" / "lint-report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.analysis",
+         str(bad), "--json-out", str(out)],
+        cwd=repo_root(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "[env-import]" in proc.stdout  # stdout stays text format
+    doc = json.loads(out.read_text(encoding="utf-8"))
+    assert doc["version"] == 1
+    assert doc["summary"]["errors"] + doc["summary"]["warnings"] >= 1
+
+
+def test_cli_prune_baseline_drops_stale_keeps_live(tmp_path):
+    import json
+    target = tmp_path / "code.py"
+    target.write_text(FIXTURES["env-import"][0] + FIXTURES["wallclock"][0],
+                      encoding="utf-8")
+    bl = tmp_path / "bl.json"
+    base = [sys.executable, "-m", "incubator_predictionio_tpu.analysis",
+            str(target)]
+    subprocess.run(base + ["--write-baseline", str(bl)], cwd=repo_root(),
+                   check=True, capture_output=True, timeout=120)
+    for e in json.loads(bl.read_text())["entries"]:
+        assert e["rule"] in ("env-import", "wallclock")
+    # fix only the env-import half; its entry goes stale
+    target.write_text(FIXTURES["env-import"][1] + FIXTURES["wallclock"][0],
+                      encoding="utf-8")
+    proc = subprocess.run(
+        base + ["--baseline-path", str(bl), "--prune-baseline"],
+        cwd=repo_root(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned 1 stale entry" in proc.stderr
+    left = json.loads(bl.read_text())["entries"]
+    assert [e["rule"] for e in left] == ["wallclock"]
+
+
+def test_timings_within_tier1_budget():
+    """--timings reports every rule, and the whole-program pass keeps the
+    full-package lint inside a tier-1-friendly wall-clock budget."""
+    start = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.analysis",
+         "--baseline", "--timings"],
+        cwd=repo_root(), capture_output=True, text=True, timeout=180)
+    elapsed = time.monotonic() - start
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "rule timings" in proc.stderr
+    for rule in ("unguarded-shared-state", "thread-lifecycle"):
+        assert rule in proc.stderr
+    assert elapsed < 90.0, f"full-package lint took {elapsed:.1f}s"

@@ -168,6 +168,42 @@ def test_stop_authed_and_shuts_down(stack):
     assert not undeploy("127.0.0.1", port)  # already down
 
 
+def test_stop_timer_is_daemonized(stack, monkeypatch):
+    """Lifecycle regression (pio-lint thread-lifecycle): the /stop
+    route's deferred-shutdown Timer must be a daemon — if the process
+    is torn down some other way first, a pending non-daemon timer
+    would block interpreter exit."""
+    import threading
+
+    captured = []
+
+    class FakeTimer:
+        def __init__(self, interval, function, *a, **kw):
+            self.interval = interval
+            self.function = function
+            self.daemon = False
+            self.started = False
+            captured.append(self)
+
+        def start(self):
+            self.started = True
+
+        def cancel(self):
+            pass
+
+    monkeypatch.setattr(threading, "Timer", FakeTimer)
+    ps, port, _es, _esp = stack
+    status, _ = call(port, "POST", "/stop?accessKey=sekrit")
+    assert status == 200
+    assert len(captured) == 1
+    timer = captured[0]
+    assert timer.started
+    assert timer.daemon is True
+    assert timer.function == ps.stop
+    # the fake never fired, so the server is still up for teardown
+    assert call(port, "GET", "/")[0] == 200
+
+
 def test_plugins_listing(stack):
     ps, port, _es, _esp = stack
     status, body = call(port, "GET", "/plugins.json")

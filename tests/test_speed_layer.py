@@ -643,3 +643,50 @@ def test_cold_start_recall_beats_averaged_recent_views():
             acc.append(len(set(top) & set(truth_top)) / k)
     fold_r, avg_r = float(np.mean(fold_recall)), float(np.mean(avg_recall))
     assert fold_r > avg_r, (fold_r, avg_r)
+
+
+# ---------------------------------------------------------------------------
+# overlay lock discipline (regressions for the races pio-lint's
+# unguarded-shared-state pass surfaced: cursor written outside the lock
+# on the reset path and read unlocked by enabled/poll, last_lag and the
+# fold-in budget rung written by the poller but read by stats() scrapes)
+# ---------------------------------------------------------------------------
+
+class _AuditedOverlay(SpeedOverlay):
+    """Asserts the overlay lock is held for every post-init write of the
+    attributes the race fix moved under it."""
+
+    _AUDITED = frozenset({"cursor", "last_lag", "_budget_rung"})
+
+    def __setattr__(self, name, value):
+        if name in self._AUDITED and getattr(self, "_audit_on", False):
+            assert self._lock.locked(), (
+                f"write of {name} without the overlay lock")
+        object.__setattr__(self, name, value)
+
+
+def test_overlay_guarded_write_discipline(mem_store):
+    app = mem_store
+    other = np.eye(4, dtype=np.float32)
+    idx = {f"i{k}": k for k in range(4)}
+    ov = _AuditedOverlay(
+        SpeedOverlayConfig(app_name=app, event_names=("rate",),
+                           value_prop="rating", l2=0.05, ttl_s=30.0),
+        other, idx, clock=FakeClock())
+    ov._audit_on = True
+    assert ov.enabled                  # cursor read takes the lock now
+    _rate(app, "zoe", "i1", 3.0)
+    s = ov.poll()   # normal path: cursor advance + lag + rung adapt
+    assert s["solved"] == 1
+    st = ov.stats()
+    assert st["cursor"] == s["cursor"]
+    assert st["cursorLagEvents"] == s["lag"]
+    assert st["foldinBudget"] >= 1
+    # reset path (log rewrite): the cursor rewind must also land under
+    # the lock, atomically with the derived-state invalidation
+    app_id = Storage.get_meta_data_apps().get_by_name(app).id
+    Storage.get_events().remove(app_id)
+    Storage.get_events().init(app_id)
+    s2 = ov.poll()
+    assert s2.get("reset") is True
+    assert ov.stats()["cursor"] == s2["cursor"]

@@ -2344,6 +2344,360 @@ def bench_controller(budget_s: float) -> dict:
     return out
 
 
+INGEST_KEYS = (
+    "ingest_qps_single", "ingest_qps_sharded", "ingest_shards",
+    "ingest_host_cpus",
+    "ingest_replication_lag_p99_events",
+    "ingest_soak_dropped_events", "ingest_soak_staleness_held",
+)
+
+
+def _ingest_append_qps(shards: int, n_threads: int = 4,
+                       batches_per_thread: int = 10,
+                       batch_events: int = 10_000) -> float:
+    """Concurrent columnar append throughput (events/s) into a fresh
+    cpplog store with ``shards`` writer shards. The same DAO call the
+    REST batch fast path lands on; with >1 shard the per-shard native
+    appends overlap because ctypes releases the GIL for the write."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.data.storage import StorageClientConfig
+    from incubator_predictionio_tpu.data.storage import cpplog
+    from incubator_predictionio_tpu.data.storage.base import (
+        IdTable,
+        Interactions,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="pio_bench_shingest_") as tmp:
+        prev = os.environ.get("PIO_LOG_SHARDS")
+        os.environ["PIO_LOG_SHARDS"] = str(shards)
+        try:
+            cfg = StorageClientConfig(parallel=False,
+                                      properties={"PATH": tmp})
+            client = cpplog.StorageClient(cfg)
+            dao = cpplog.CppLogEvents(client, cfg, prefix="b_")
+            dao.init(1)
+        finally:
+            if prev is None:
+                os.environ.pop("PIO_LOG_SHARDS", None)
+            else:
+                os.environ["PIO_LOG_SHARDS"] = prev
+        # pre-build every batch OUTSIDE the timed window (the generator
+        # shares the core with the appends). Distinct users per thread
+        # keep the key-hash spray busy on every shard.
+        item_tab = IdTable.from_list([f"i{k}" for k in range(512)])
+        rng = np.random.default_rng(7)
+        work = []
+        for t in range(n_threads):
+            batches = []
+            for b in range(batches_per_thread):
+                users = [f"u{t}_{b}_{k}" for k in range(batch_events)]
+                batches.append(Interactions(
+                    user_idx=np.arange(batch_events, dtype=np.int32),
+                    item_idx=rng.integers(
+                        0, 512, batch_events).astype(np.int32),
+                    values=np.ones(batch_events, np.float32),
+                    user_ids=IdTable.from_list(users),
+                    item_ids=item_tab))
+            work.append(batches)
+
+        errors: list = []
+
+        def pump(batches) -> None:
+            try:
+                for inter in batches:
+                    dao.insert_interactions(inter, 1)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=pump, args=(w,))
+                   for w in work]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        total = n_threads * batches_per_thread * batch_events
+        got = dao.scan_interactions(
+            app_id=1, entity_type="user", target_entity_type="item",
+            event_names=("rate",), value_prop="rating")
+        assert len(got) == total, (len(got), total)
+        client.close()
+        return total / wall
+
+
+def bench_ingest(budget_s: float) -> dict:
+    """Planet-scale ingest leg (docs/production.md "Planet-scale
+    ingest"): multi-writer sharded append throughput vs the single-
+    writer baseline in the SAME run, follower replication lag under
+    sustained leader writes, and an ingest soak — event POSTs sprayed
+    by the IngestFrontDoor across two live event-server writers over a
+    sharded log, with a rolling zero-downtime writer reload mid-stream
+    and a tail subscriber holding the freshness bound. Guarded like the
+    other fleet legs: any failure nulls the ingest_* keys, never the
+    record."""
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    out = dict.fromkeys(INGEST_KEYS)
+    if budget_s < 90.0:
+        log("ingest leg skipped: bench deadline too close")
+        return out
+    shards = int(os.environ.get("PIO_BENCH_INGEST_SHARDS", "4"))
+    out["ingest_shards"] = shards
+    # the sharded-vs-single ratio is a PARALLELISM measurement: on a
+    # 1-core host the fan-out has no headroom by construction, so the
+    # record carries the host's core count for honest downstream bars
+    out["ingest_host_cpus"] = os.cpu_count() or 1
+
+    # -- A. sharded vs single-writer append throughput --------------------
+    out["ingest_qps_single"] = round(_ingest_append_qps(1), 1)
+    out["ingest_qps_sharded"] = round(_ingest_append_qps(shards), 1)
+    log(f"ingest append: single={out['ingest_qps_single']:.0f} ev/s "
+        f"sharded({shards})={out['ingest_qps_sharded']:.0f} ev/s "
+        f"({out['ingest_qps_sharded'] / out['ingest_qps_single']:.2f}x)")
+
+    # -- B. async replication lag under sustained leader writes -----------
+    from incubator_predictionio_tpu.data.storage import StorageClientConfig
+    from incubator_predictionio_tpu.data.storage import cpplog
+    from incubator_predictionio_tpu.data.storage.base import (
+        IdTable,
+        Interactions,
+    )
+    from incubator_predictionio_tpu.data.storage.server import (
+        ReplicationTail,
+        StorageServer,
+    )
+
+    with tempfile.TemporaryDirectory(prefix="pio_bench_repl_") as tmp:
+        prev = os.environ.get("PIO_LOG_SHARDS")
+        os.environ["PIO_LOG_SHARDS"] = str(shards)
+        try:
+            lcfg = StorageClientConfig(parallel=False,
+                                       properties={"PATH": tmp + "/lead"})
+            lclient = cpplog.StorageClient(lcfg)
+            ldao = cpplog.CppLogEvents(lclient, lcfg, prefix="b_")
+            ldao.init(1)
+            fcfg = StorageClientConfig(parallel=False,
+                                       properties={"PATH": tmp + "/foll"})
+            fclient = cpplog.StorageClient(fcfg)
+            fdao = cpplog.CppLogEvents(fclient, fcfg, prefix="b_")
+        finally:
+            if prev is None:
+                os.environ.pop("PIO_LOG_SHARDS", None)
+            else:
+                os.environ["PIO_LOG_SHARDS"] = prev
+        leader_srv = StorageServer(cpplog, lclient, lcfg,
+                                   host="127.0.0.1", port=0)
+        lport = leader_srv.start_background()
+        tail = ReplicationTail(f"http://127.0.0.1:{lport}", fdao, [1],
+                               interval_s=0.05, prefix="b_")
+        tail.start()
+        item_tab = IdTable.from_list([f"i{k}" for k in range(128)])
+        stop_w = threading.Event()
+
+        def writer() -> None:
+            b = 0
+            rng = np.random.default_rng(11)
+            while not stop_w.is_set():
+                n = 5_000
+                ldao.insert_interactions(Interactions(
+                    user_idx=np.arange(n, dtype=np.int32),
+                    item_idx=rng.integers(0, 128, n).astype(np.int32),
+                    values=np.ones(n, np.float32),
+                    user_ids=IdTable.from_list(
+                        [f"r{b}_{k}" for k in range(n)]),
+                    item_ids=item_tab), 1)
+                b += 1
+                time.sleep(0.01)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        lags: list = []
+        t_end = time.monotonic() + 5.0
+        try:
+            while time.monotonic() < t_end:
+                try:
+                    lags.append(tail._lag_total(1))
+                except Exception:
+                    pass
+                time.sleep(0.05)
+        finally:
+            stop_w.set()
+            wt.join(timeout=10)
+        caught = tail.wait_caught_up(timeout_s=30.0)
+        tail.stop()
+        leader_srv.stop()
+        fclient.close()
+        if lags and caught:
+            out["ingest_replication_lag_p99_events"] = int(
+                np.percentile(np.asarray(lags, np.float64), 99))
+        log(f"ingest replication: lag_p99="
+            f"{out['ingest_replication_lag_p99_events']} events "
+            f"over {len(lags)} samples, caught_up={caught}")
+
+    # -- C. front-door ingest soak with rolling writer reload -------------
+    from incubator_predictionio_tpu.data.storage import (
+        AccessKey,
+        App,
+        Storage,
+    )
+    from incubator_predictionio_tpu.servers.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from incubator_predictionio_tpu.serving.frontdoor import (
+        FrontDoorConfig,
+        IngestFrontDoor,
+    )
+
+    run_s = float(os.environ.get("PIO_BENCH_INGEST_SOAK_S", "8"))
+    stale_bound_s = 5.0
+    with tempfile.TemporaryDirectory(prefix="pio_bench_soak_") as tmp:
+        prev = os.environ.get("PIO_LOG_SHARDS")
+        os.environ["PIO_LOG_SHARDS"] = str(shards)
+        door = None
+        writers = []
+        try:
+            Storage.configure({
+                "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+                "PIO_STORAGE_SOURCES_EV_TYPE": "cpplog",
+                "PIO_STORAGE_SOURCES_EV_PATH": tmp,
+                "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            })
+            app_id = Storage.get_meta_data_apps().insert(
+                App(0, "bench-soak"))
+            Storage.get_meta_data_access_keys().insert(
+                AccessKey("soakkey", app_id))
+            Storage.get_events().init(app_id)
+            writers = [EventServer(EventServerConfig(ip="127.0.0.1",
+                                                     port=0))
+                       for _ in range(2)]
+            ports = [w.start_background() for w in writers]
+            door = IngestFrontDoor(
+                [("127.0.0.1", p) for p in ports],
+                FrontDoorConfig(server_key="soakkey",
+                                request_timeout_s=15.0,
+                                attempt_timeout_s=8.0,
+                                drain_timeout_s=10.0,
+                                reload_timeout_s=30.0))
+            dport = door.start_background()
+            url = (f"http://127.0.0.1:{dport}/batch/events.json"
+                   "?accessKey=soakkey")
+            accepted: list = []
+            pump_errors: list = []
+            stop_p = threading.Event()
+
+            def pump(tid: int) -> None:
+                b = 0
+                while not stop_p.is_set():
+                    body = json.dumps([
+                        {"event": "rate", "entityType": "user",
+                         "entityId": f"s{tid}_{b}_{k}",
+                         "targetEntityType": "item",
+                         "targetEntityId": f"i{k % 64}",
+                         "properties": {"rating": 1.0}}
+                        for k in range(50)]).encode()
+                    try:
+                        req = urllib.request.Request(
+                            url, body,
+                            {"Content-Type": "application/json"})
+                        with urllib.request.urlopen(
+                                req, timeout=20) as resp:
+                            res = json.loads(resp.read())
+                        accepted.append(sum(
+                            1 for r in res if r.get("status") == 201))
+                    except Exception as e:  # noqa: BLE001
+                        pump_errors.append(repr(e))
+                        return
+                    b += 1
+
+            # tail subscriber: append→visibility staleness across the
+            # rolling reload (one poll's rows bound by oldest append)
+            events_dao = Storage.get_events()
+            stale_max = [0.0]
+            stop_s = threading.Event()
+
+            def subscriber() -> None:
+                cursor = events_dao.tail_cursor(app_id=app_id)
+                while not stop_s.is_set():
+                    stop_s.wait(0.25)
+                    try:
+                        _i, _t, ams, cursor, reset = \
+                            events_dao.read_interactions_since(
+                                cursor, app_id=app_id,
+                                event_names=("rate",),
+                                value_prop="rating")
+                    except Exception:
+                        continue
+                    if reset or not len(ams):
+                        continue
+                    oldest = int(ams.min())
+                    if oldest > 0:
+                        stale_max[0] = max(
+                            stale_max[0],
+                            time.time() - oldest / 1000.0)
+
+            pumps = [threading.Thread(target=pump, args=(t,))
+                     for t in range(3)]
+            sub = threading.Thread(target=subscriber, daemon=True)
+            for t in pumps:
+                t.start()
+            sub.start()
+            t_half = time.monotonic() + run_s / 2
+            while time.monotonic() < t_half:
+                time.sleep(0.1)
+            reload_out = door.rolling_reload(timeout=60)
+            time.sleep(max(run_s / 2 - 0.1, 0.1))
+            stop_p.set()
+            for t in pumps:
+                t.join(timeout=30)
+            stop_s.set()
+            sub.join(timeout=10)
+            if pump_errors:
+                raise RuntimeError(
+                    f"soak pump failed: {pump_errors[0]}")
+            sent = sum(accepted)
+            landed = len(Storage.get_events().scan_interactions(
+                app_id=app_id, entity_type="user",
+                target_entity_type="item", event_names=("rate",),
+                value_prop="rating"))
+            out["ingest_soak_dropped_events"] = sent - landed
+            out["ingest_soak_staleness_held"] = bool(
+                stale_max[0] <= stale_bound_s)
+            log(f"ingest soak: {sent} accepted, {landed} landed "
+                f"(dropped={out['ingest_soak_dropped_events']}), "
+                f"reloaded={reload_out['reloaded']}/2, "
+                f"staleness_max={stale_max[0]:.2f}s "
+                f"(bound {stale_bound_s}s, "
+                f"held={out['ingest_soak_staleness_held']})")
+        finally:
+            if door is not None:
+                door.stop()
+            for w in writers:
+                w.stop()
+            Storage.reset()
+            if prev is None:
+                os.environ.pop("PIO_LOG_SHARDS", None)
+            else:
+                os.environ["PIO_LOG_SHARDS"] = prev
+    return out
+
+
 def bench_scan_probe(store_dir: str) -> dict:
     """Sequential vs sharded event-log scan at bench scale, projection
     cache bypassed, plus the pipelined scan→prep leg — the host-pipeline
@@ -2950,6 +3304,9 @@ def run_orchestrator() -> None:
         # self-driving freshness leg (controller over fleet workers +
         # front door; docs/production.md "Self-driving freshness")
         **dict.fromkeys(CONTROLLER_KEYS),
+        # planet-scale ingest leg (sharded writers + replication +
+        # front-door soak; docs/production.md "Planet-scale ingest")
+        **dict.fromkeys(INGEST_KEYS),
         "accel_waited_s": None,
         "accel_outcome": "never_available",
         "sasrec_epoch_s": None,
@@ -3096,6 +3453,17 @@ def run_orchestrator() -> None:
         record.update(bench_mips(emit_by - time.monotonic()))
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"mips leg failed ({e!r}); mips_* keys null this round")
+
+    # -- 6f. PLANET-SCALE INGEST LEG (host CPU; sharded writers vs
+    #        single-writer in the same run, replication lag, front-door
+    #        soak with a rolling zero-downtime writer reload). LAST of
+    #        the host legs: its soak saturates the CPU, and the timed
+    #        legs before it must not inherit that heat or lose budget
+    #        to it (it budget-skips to null keys gracefully). ------------
+    try:
+        record.update(bench_ingest(emit_by - time.monotonic()))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"ingest leg failed ({e!r}); ingest_* keys null this round")
 
     # -- 4/5/7. TRAIN + ATTENTION + SERVE: supervised TPU child ------------
     # (started after the host stages so parent CPU load never perturbs the

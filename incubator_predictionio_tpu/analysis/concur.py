@@ -93,6 +93,21 @@ _CONTAINER_LITERALS = (ast.Dict, ast.Set, ast.List, ast.DictComp,
 _LOCK_NAME_RE = re.compile(r"lock", re.IGNORECASE)
 _GUARDED_BY_RE = re.compile(r"^guarded-by\((?:self\.)?([\w.]+)\)$")
 
+
+def _self_dotted(node: ast.AST) -> Optional[str]:
+    """Dotted attribute path rooted at ``self`` — ``self.lock`` →
+    ``"lock"``, ``self.client.lock`` → ``"client.lock"``; None for
+    anything else. Lock guards routinely live on a collaborator (a DAO
+    synchronizing on its client's lock), so lock identity must be the
+    whole path, not just the first hop."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and parts:
+        return ".".join(reversed(parts))
+    return None
+
 _INDEX_CACHE_KEY = "concur.index"
 
 
@@ -122,8 +137,17 @@ class SpawnSite:
     target: Optional[str] = None   # entry node key, when resolvable
     daemon: bool = False           # daemon=True kwarg at the ctor
     bound: Optional[Tuple[str, str]] = None  # ("self", attr)|("local", n)
+    #: every name the spawn is bound to — a chained assignment
+    #: (``pool = self._pool = Executor(...)``) yields several live
+    #: handles, and a lifecycle seam through ANY of them counts
+    bounds: List[Tuple[str, str]] = dataclasses.field(
+        default_factory=list)
     structured: bool = False       # executor opened by a with-block
     ctor: str = ""                 # resolved constructor name
+
+    def bind(self, scope: str, name: str) -> None:
+        self.bound = (scope, name)
+        self.bounds.append((scope, name))
 
 
 class NodeInfo:
@@ -336,13 +360,17 @@ def _collect_lock_and_safe_attrs(cls: ClassInfo, mod: Module) -> None:
                         cls.attr_types[tgt.attr] = rname
         elif isinstance(sub, (ast.With, ast.AsyncWith)):
             for item in sub.items:
-                ctx = item.context_expr
-                if (isinstance(ctx, ast.Attribute)
-                        and isinstance(ctx.value, ast.Name)
-                        and ctx.value.id == "self"
-                        and _LOCK_NAME_RE.search(ctx.attr)):
-                    cls.lock_attrs.add(ctx.attr)
+                path = _self_dotted(item.context_expr)
+                if (path is not None
+                        and _LOCK_NAME_RE.search(path.rsplit(".", 1)[-1])):
+                    cls.lock_attrs.add(path)
     cls.safe_attrs |= cls.lock_attrs
+    # the root of a dotted lock path (``client`` in ``client.lock``) is
+    # reached in order to TAKE the lock, so it cannot itself be guarded
+    # by it — it must be stably published (Java's final-field rule for
+    # @GuardedBy paths); exempt it from inference
+    cls.safe_attrs |= {p.split(".", 1)[0] for p in cls.lock_attrs
+                       if "." in p}
 
 
 class _FunctionScanner:
@@ -447,9 +475,9 @@ class _FunctionScanner:
 
     def _lock_of(self, expr: ast.AST, aliases: Dict[str, str]
                  ) -> Optional[str]:
-        attr = self._self_attr(expr)
-        if attr is not None and attr in self.cls.lock_attrs:
-            return attr
+        path = _self_dotted(expr)
+        if path is not None and path in self.cls.lock_attrs:
+            return path
         if isinstance(expr, ast.Name):
             return aliases.get(expr.id)
         return None
@@ -478,8 +506,8 @@ class _FunctionScanner:
                         if (item.optional_vars is not None
                                 and isinstance(item.optional_vars,
                                                ast.Name)):
-                            site.bound = ("local",
-                                          item.optional_vars.id)
+                            site.bind("local",
+                                      item.optional_vars.id)
             inner_f = frozenset(inner)
             for stmt in node.body:
                 self._visit(stmt, inner_f, aliases)
@@ -553,10 +581,14 @@ class _FunctionScanner:
     def _post_assign(self, targets: Sequence[ast.AST], value: ast.AST,
                      aliases: Dict[str, str]) -> None:
         """Track lock aliases (``lk = self._lock``), spawn bindings
-        (``self._thread = threading.Thread(...)``), and daemon flags."""
-        if len(targets) != 1:
-            return
-        tgt = targets[0]
+        (``self._thread = threading.Thread(...)``), and daemon flags.
+        Chained assignments (``pool = self._pool = Executor(...)``)
+        bind every target — each one is a live handle to the spawn."""
+        for tgt in targets:
+            self._post_assign_one(tgt, value, aliases)
+
+    def _post_assign_one(self, tgt: ast.AST, value: ast.AST,
+                         aliases: Dict[str, str]) -> None:
         if isinstance(tgt, ast.Name):
             lk = self._lock_of(value, aliases)
             if lk is not None:
@@ -565,13 +597,13 @@ class _FunctionScanner:
                 aliases.pop(tgt.id, None)
             site = self._spawned_calls.get(id(value))
             if site is not None:
-                site.bound = ("local", tgt.id)
+                site.bind("local", tgt.id)
         else:
             attr = self._self_attr(tgt)
             if attr is not None:
                 site = self._spawned_calls.get(id(value))
                 if site is not None:
-                    site.bound = ("self", attr)
+                    site.bind("self", attr)
                 if (isinstance(value, ast.Constant)
                         and value.value is True and attr == "daemon"):
                     pass  # self.daemon = True is not a thread handle
@@ -887,15 +919,18 @@ class ThreadLifecycle:
             if site.ctor == "submit" or site.structured:
                 return
             shut = False
-            if site.bound is not None and cls is not None:
-                scope, name = site.bound
-                if scope == "self":
-                    shut = "shutdown" in cls.attr_calls.get(name, set())
-                else:
-                    shut = any(name in n.local_shutdowns
-                               for n in cls.nodes.values())
-            elif site.bound is not None and fn_info is not None:
-                shut = site.bound[1] in fn_info.local_shutdowns
+            for scope, name in site.bounds:
+                if cls is not None:
+                    if scope == "self":
+                        shut = "shutdown" in cls.attr_calls.get(
+                            name, set())
+                    else:
+                        shut = any(name in n.local_shutdowns
+                                   for n in cls.nodes.values())
+                elif fn_info is not None:
+                    shut = name in fn_info.local_shutdowns
+                if shut:
+                    break
             if not shut:
                 yield mod.finding_at(
                     self, site.line,
@@ -925,30 +960,34 @@ class ThreadLifecycle:
     @staticmethod
     def _daemon_set_later(site: SpawnSite, cls: Optional[ClassInfo],
                           fn_info: Optional[NodeInfo]) -> bool:
-        if site.bound is None:
-            return False
-        scope, name = site.bound
-        if scope == "self":
-            return cls is not None and name in cls.daemon_attrs
-        if cls is not None:
-            node = cls.nodes.get(site.node)
-            if node is not None and name in node.local_daemons:
+        for scope, name in site.bounds:
+            if scope == "self":
+                if cls is not None and name in cls.daemon_attrs:
+                    return True
+                continue
+            if cls is not None:
+                node = cls.nodes.get(site.node)
+                if node is not None and name in node.local_daemons:
+                    return True
+            if fn_info is not None and name in fn_info.local_daemons:
                 return True
-        return fn_info is not None and name in fn_info.local_daemons
+        return False
 
     @staticmethod
     def _join_seam(site: SpawnSite, cls: Optional[ClassInfo],
                    fn_info: Optional[NodeInfo]) -> bool:
-        if site.bound is None:
-            return False
-        scope, name = site.bound
-        if scope == "self":
-            return cls is not None and name in cls.joined_attrs
-        if cls is not None:
-            node = cls.nodes.get(site.node)
-            if node is not None and name in node.local_joins:
+        for scope, name in site.bounds:
+            if scope == "self":
+                if cls is not None and name in cls.joined_attrs:
+                    return True
+                continue
+            if cls is not None:
+                node = cls.nodes.get(site.node)
+                if node is not None and name in node.local_joins:
+                    return True
+            if fn_info is not None and name in fn_info.local_joins:
                 return True
-        return fn_info is not None and name in fn_info.local_joins
+        return False
 
 
 __all__ = [

@@ -40,6 +40,7 @@ from incubator_predictionio_tpu.data.storage.base import (  # re-export
     Model,
     Models,
     StorageClientConfig,
+    StorageError,
     UNSET,
     is_valid_channel_name,
 )
@@ -69,10 +70,6 @@ _BACKENDS: Dict[str, str] = {
 MetaDataRepository = "METADATA"
 EventDataRepository = "EVENTDATA"
 ModelDataRepository = "MODELDATA"
-
-
-class StorageError(Exception):
-    """Storage.scala:55 StorageException."""
 
 
 class UnsupportedMethodError(StorageError):

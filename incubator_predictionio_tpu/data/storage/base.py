@@ -38,6 +38,12 @@ logger = logging.getLogger(__name__)
 UNSET: Any = type("_Unset", (), {"__repr__": lambda s: "UNSET"})()
 
 
+class StorageError(Exception):
+    """Storage.scala:55 StorageException. Lives here (not the package
+    ``__init__``) so backend modules that import ``base`` can raise it —
+    the package re-exports it for external callers."""
+
+
 # ---------------------------------------------------------------------------
 # Metadata records
 # ---------------------------------------------------------------------------
@@ -558,6 +564,88 @@ def uniform_interactions_from_docs(docs):
         user_ids=IdTable.from_list(list(u_intern)),
         item_ids=IdTable.from_list(list(i_intern)))
     return inter, etype, tetype, name, vprop, times
+
+
+class VectorCursor(tuple):
+    """Multi-writer tail cursor: one ``(generation << TAIL_GEN_SHIFT) |
+    count`` component per writer shard.
+
+    Speed-layer subscribers (speed/overlay.py, speed/cache.py) treat the
+    cursor as an opaque monotonic token, but they DO compare it against
+    plain ints (``cursor < 0`` enablement checks, ``-1`` sentinels) and
+    format it with ``%d`` — so this tuple subclass answers the scalar
+    protocol with the TOTAL entry count (generation bits masked off):
+    progress comparisons against ints keep working unchanged, while
+    cursor-vs-cursor comparisons are component-wise, which is the only
+    ordering that is meaningful across shards:
+
+    - ``a < b`` (both vectors, same length): some shard of ``a`` is
+      behind ``b`` — the "went backwards" reset trigger.
+    - ``a <= b``: every shard of ``a`` is at or behind ``b`` — the
+      "dirty-mark covered by solve cursor" check.
+    - different lengths (shard-count change) compare unequal and never
+      ``<=``/``>=`` — subscribers fall into their reset path.
+    """
+
+    __slots__ = ()
+
+    _COUNT_MASK = (1 << 48) - 1
+
+    def __int__(self) -> int:
+        return sum(int(c) & self._COUNT_MASK for c in self)
+
+    __index__ = __int__
+
+    def total(self) -> int:
+        return int(self)
+
+    def _cmp(self, other, op, scalar_op):
+        if isinstance(other, VectorCursor) or (
+                isinstance(other, tuple) and not isinstance(other, str)):
+            if len(self) != len(other):
+                return False
+            return op(self, other)
+        if isinstance(other, (int, float)):
+            return scalar_op(int(self), other)
+        return NotImplemented
+
+    def __lt__(self, other):
+        # "some shard is behind" — deliberately NOT a total order: both
+        # a < b and b < a hold for cursors that diverged across shards,
+        # and either direction means the subscriber must resync
+        return self._cmp(other,
+                         lambda a, b: any(x < y for x, y in zip(a, b)),
+                         lambda a, b: a < b)
+
+    def __le__(self, other):
+        return self._cmp(other,
+                         lambda a, b: all(x <= y for x, y in zip(a, b)),
+                         lambda a, b: a <= b)
+
+    def __gt__(self, other):
+        return self._cmp(other,
+                         lambda a, b: any(x > y for x, y in zip(a, b)),
+                         lambda a, b: a > b)
+
+    def __ge__(self, other):
+        return self._cmp(other,
+                         lambda a, b: all(x >= y for x, y in zip(a, b)),
+                         lambda a, b: a >= b)
+
+    def __eq__(self, other):
+        if isinstance(other, tuple):
+            return tuple(self) == tuple(other)
+        return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    def __hash__(self):
+        return tuple.__hash__(self)
+
+    def __repr__(self) -> str:
+        return f"VectorCursor({tuple(int(c) for c in self)})"
 
 
 class Events(abc.ABC):

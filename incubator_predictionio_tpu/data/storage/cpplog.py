@@ -114,6 +114,21 @@ class StorageClient(base.BaseStorageClient):
         # before the rewrite are detectable even after the entry count
         # grows past its old value (speed-layer resync contract)
         self._generations: dict[str, int] = {}
+        # multi-writer layout state (all guarded by self.lock; the
+        # annotations are VERIFIED by pio-lint's unguarded-shared-state
+        # pass, docs/lint.md): resolved shard counts per meta file,
+        # per-shard append locks, and the cold-tier existence cache
+        self._shard_counts: dict[str, int] = {}  # pio-lint: guarded-by(lock)
+        self._shard_locks: dict[str, threading.Lock] = {}  # pio-lint: guarded-by(lock)
+        self._has_cold: dict[str, bool] = {}  # pio-lint: guarded-by(lock)
+        # per-shard REWRITE epochs (replication): bumped only when a
+        # segment file's existing bytes are rewritten (roll/compact/
+        # drop) — append-only growth (including tombstone markers) does
+        # NOT bump it, so a follower tailing the file byte-level keeps
+        # its prefix valid across deletes and resyncs only on rewrites.
+        # In-memory only: a leader restart reads as an epoch change,
+        # which conservatively triggers a follower resync.
+        self._repl_epochs: dict[str, int] = {}  # pio-lint: guarded-by(lock)
         # per-log COUNT OBSERVATIONS: (entry_count, wall_ms) snapshots —
         # "at wall w this process saw the log hold c entries". Pushed by
         # appends (exact: the count just before/after the write) AND by
@@ -140,6 +155,16 @@ class StorageClient(base.BaseStorageClient):
         self._generations[key] = self._generations.get(key, 0) + 1
         # entries renumber: every count observation is now meaningless
         self._count_marks.pop(key, None)
+
+    def bump_epoch_locked(self, hot_path) -> None:
+        """Mark a shard's segment files as REWRITTEN (roll/compact/
+        drop): replication followers discard their byte-level prefix
+        and resync the shard."""
+        key = str(hot_path)
+        self._repl_epochs[key] = self._repl_epochs.get(key, 0) + 1
+
+    def epoch_locked(self, hot_path) -> int:
+        return self._repl_epochs.get(str(hot_path), 0)
 
     def note_count_locked(self, path, count: int) -> None:
         """Record one count observation ("the log held ``count`` entries
@@ -203,12 +228,126 @@ class StorageClient(base.BaseStorageClient):
             while self._pins.get(key, 0) > 0:
                 self._pins_cv.wait()
 
-    def _file(self, ns: str, app_id: int, channel_id: Optional[int]) -> Path:
+    def _file(self, ns: str, app_id: int, channel_id: Optional[int],
+              shard: int = 0) -> Path:
+        """Hot segment of writer shard ``shard``. Shard 0 keeps the
+        legacy single-writer name, so existing logs ARE shard 0 of a
+        1-shard layout — no migration."""
         chan = 0 if channel_id is None else channel_id
-        return self.dir / f"{ns}app{app_id}_ch{chan}.log"
+        stem = f"{ns}app{app_id}_ch{chan}"
+        if shard:
+            return self.dir / f"{stem}.w{shard}.log"
+        return self.dir / f"{stem}.log"
 
-    def handle(self, ns: str, app_id: int, channel_id: Optional[int]) -> int:
-        key = str(self._file(ns, app_id, channel_id))
+    def _meta_file(self, ns: str, app_id: int,
+                   channel_id: Optional[int]) -> Path:
+        chan = 0 if channel_id is None else channel_id
+        return self.dir / f"{ns}app{app_id}_ch{chan}.shards"
+
+    @staticmethod
+    def _cold(path: Path) -> Path:
+        """Cold-tier segment of a hot file (sealed rolls accumulate
+        here; background compaction only ever rewrites this file)."""
+        return path.with_name(path.name + ".cold")
+
+    def shards(self, ns: str, app_id: int,
+               channel_id: Optional[int]) -> int:
+        """Writer-shard count for this (ns, app, channel) log. Fixed at
+        log creation: a ``<stem>.shards`` meta file pins it; a NEW log
+        (no meta, no legacy file) takes ``PIO_LOG_SHARDS`` and persists
+        it, so readers and writers of an existing log can never disagree
+        with the layout on disk."""
+        import os
+
+        mkey = str(self._meta_file(ns, app_id, channel_id))
+        with self.lock:
+            n = self._shard_counts.get(mkey)
+            if n is not None:
+                return n
+            meta = Path(mkey)
+            if meta.exists():
+                try:
+                    n = max(int(json.loads(meta.read_text())["shards"]), 1)
+                except (ValueError, KeyError, OSError):
+                    n = 1
+            elif self._file(ns, app_id, channel_id).exists():
+                n = 1  # legacy single-writer log predating the meta
+            else:
+                try:
+                    n = max(int(os.environ.get("PIO_LOG_SHARDS", "1")), 1)
+                except ValueError:
+                    n = 1
+                if n > 1:
+                    meta.write_text(json.dumps({"shards": n}))
+            self._shard_counts[mkey] = n
+            return n
+
+    def set_shards(self, ns: str, app_id: int, channel_id: Optional[int],
+                   n: int) -> None:
+        """Pin the shard count (replication followers mirror the
+        leader's layout before the first apply). Refuses to change the
+        layout of a log that already has data."""
+        n = max(int(n), 1)
+        with self.lock:
+            cur = self.shards(ns, app_id, channel_id)
+            if cur == n:
+                return
+            # only DATA pins the layout: a status probe on a follower
+            # that hasn't been configured yet materializes empty
+            # segment files (handle_path creates on open), and those
+            # must not wedge the follower on its first configure
+            empties = []
+            for k in range(cur):
+                hot = self._file(ns, app_id, channel_id, k)
+                for path in (self._cold(hot), hot):
+                    if not path.exists():
+                        continue
+                    h = self.handle_path(path)
+                    if int(self.lib.pio_evlog_entry_count(h)) > 0:
+                        raise base.StorageError(
+                            f"cannot reshape an existing log from {cur} "
+                            f"to {n} writer shards")
+                    empties.append((hot, path))
+            for hot, path in empties:
+                key = str(path)
+                self._wait_unpinned_locked(key)
+                h = self._handles.pop(key, None)
+                if h is not None:
+                    self.lib.pio_evlog_close(h)
+                path.unlink(missing_ok=True)
+                self._has_cold.pop(str(hot), None)
+            meta = self._meta_file(ns, app_id, channel_id)
+            if n > 1:
+                meta.write_text(json.dumps({"shards": n}))
+            else:
+                meta.unlink(missing_ok=True)
+            self._shard_counts[str(meta)] = n
+
+    def has_cold(self, path: Path) -> bool:
+        key = str(path)
+        with self.lock:
+            v = self._has_cold.get(key)
+            if v is None:
+                v = self._has_cold[key] = self._cold(path).exists()
+            return v
+
+    def shard_lock(self, path) -> threading.Lock:
+        """Per-shard append lock: writers to DIFFERENT shards never
+        contend on it, which is the whole multi-writer point (the native
+        per-handle mutex is the last line of defense, not the
+        serialization point)."""
+        key = str(path)
+        with self.lock:
+            lk = self._shard_locks.get(key)
+            if lk is None:
+                lk = self._shard_locks[key] = threading.Lock()
+            return lk
+
+    def handle_path(self, path) -> int:
+        """Open (or return the cached) native handle for an explicit
+        segment file — shard hots and cold tiers share one handle
+        table."""
+        key = str(path)
         with self.lock:
             h = self._handles.get(key)
             if h is None:
@@ -218,18 +357,42 @@ class StorageClient(base.BaseStorageClient):
                 self._handles[key] = h
             return h
 
+    def handle(self, ns: str, app_id: int, channel_id: Optional[int]) -> int:
+        # resolve (and persist) the shard count BEFORE the open creates
+        # the shard-0 file: a bare legacy .log with no meta pins the log
+        # to one writer forever, so the meta must hit disk first
+        self.shards(ns, app_id, channel_id)
+        return self.handle_path(self._file(ns, app_id, channel_id))
+
+    def close_path_locked(self, path) -> None:
+        """Close one segment's cached handle (caller holds the lock and
+        has waited out pins) — the reload/roll seam."""
+        h = self._handles.pop(str(path), None)
+        if h is not None:
+            self.lib.pio_evlog_close(h)
+
     def drop(self, ns: str, app_id: int, channel_id: Optional[int]) -> bool:
-        path = self._file(ns, app_id, channel_id)
-        key = str(path)
+        nsh = self.shards(ns, app_id, channel_id)
         with self.lock:
-            self._wait_unpinned_locked(key)
-            h = self._handles.pop(key, None)
-            if h is not None:
-                self.lib.pio_evlog_close(h)
-            path.unlink(missing_ok=True)
-            from incubator_predictionio_tpu.data.storage import traincache
-            traincache.invalidate(path)
-            self.bump_generation_locked(path)
+            for k in range(nsh):
+                hot = self._file(ns, app_id, channel_id, k)
+                for path in (self._cold(hot), hot):
+                    key = str(path)
+                    self._wait_unpinned_locked(key)
+                    h = self._handles.pop(key, None)
+                    if h is not None:
+                        self.lib.pio_evlog_close(h)
+                    path.unlink(missing_ok=True)
+                    self._has_cold.pop(str(hot), None)
+                from incubator_predictionio_tpu.data.storage import (
+                    traincache,
+                )
+                traincache.invalidate(hot)
+                self.bump_generation_locked(hot)
+                self.bump_epoch_locked(hot)
+            meta = self._meta_file(ns, app_id, channel_id)
+            meta.unlink(missing_ok=True)
+            self._shard_counts.pop(str(meta), None)
         return True
 
     def sync(self) -> None:
@@ -275,6 +438,10 @@ class CppLogEvents(base.Events):
         # post concurrently, because the client lock serializes appends.
         self._gc_mu = threading.Lock()
         self._gc_pending: list = []
+        # persistent fan-out pool for sharded appends (spawning threads
+        # per append costs more than a small native append itself);
+        # created lazily under the client lock  # pio-lint: guarded-by(client.lock)
+        self._fanout_pool = None
         # observability (served under /stats.json "groupCommit"): how
         # well concurrent callers coalesce — appends vs caller batches
         # is the amortization factor operators tune client counts by
@@ -282,6 +449,9 @@ class CppLogEvents(base.Events):
         self._gc_caller_batches = 0  # caller batches those appends carried
         self._gc_events = 0        # events written through group commit
         self._gc_max_merge = 0     # largest events-per-append seen
+        # events landed per writer shard (sharded layouts only) — the
+        # skew signal behind pio_ingest_shard_events{shard}
+        self._shard_events: dict[int, int] = {}  # pio-lint: guarded-by(_gc_mu)
         # sub-metrics of the last full sharded scan (shard count, native
         # lock-held wall, merge/total walls — _merge_shards fills the
         # same dict the bench reads), exported as gauges at scrape time
@@ -340,6 +510,16 @@ class CppLogEvents(base.Events):
             reg.gauge("pio_scan_rows",
                       "interaction rows the last full scan returned"
                       ).set(scan.get("scan_rows", 0))
+        with self._gc_mu:
+            shard_events = dict(self._shard_events)
+        if shard_events:
+            g = reg.gauge(
+                "pio_ingest_shard_events",
+                "events landed per writer shard since server start "
+                "(watch the spread for writer-shard skew)",
+                labels=("shard",))
+            for k, v in shard_events.items():
+                g.labels(shard=str(k)).set(v)
 
     def _export_retrain_delta(self, tail_rows: int) -> None:
         """pio_retrain_delta_rows — the event delta the last cache-served
@@ -359,6 +539,110 @@ class CppLogEvents(base.Events):
     def _handle(self, app_id: int, channel_id: Optional[int]) -> int:
         return self.client.handle(self.ns, app_id, channel_id)
 
+    # -- multi-writer layout ----------------------------------------------
+    def _nshards(self, app_id: int, channel_id: Optional[int]) -> int:
+        return self.client.shards(self.ns, app_id, channel_id)
+
+    def _is_plain(self, app_id: int, channel_id: Optional[int]) -> bool:
+        """True for the legacy layout (one writer, no cold tier) —
+        every method keeps its original single-file code path then,
+        byte-for-byte."""
+        if self._nshards(app_id, channel_id) != 1:
+            return False
+        return not self.client.has_cold(
+            self.client._file(self.ns, app_id, channel_id))
+
+    def _hot_path(self, app_id, channel_id, shard: int) -> Path:
+        return self.client._file(self.ns, app_id, channel_id, shard)
+
+    def _unit_paths(self, app_id, channel_id) -> list:
+        """Segment files in merge order: for each shard, cold tier first
+        (entries there precede every hot entry of the shard), then hot.
+        → [(shard, path, is_hot)]."""
+        out = []
+        for k in range(self._nshards(app_id, channel_id)):
+            hot = self._hot_path(app_id, channel_id, k)
+            if self.client.has_cold(hot):
+                out.append((k, self.client._cold(hot), False))
+            out.append((k, hot, True))
+        return out
+
+    def _snapshot_shards_locked(self, app_id, channel_id) -> list:
+        """Under the client lock: per-shard layout snapshot →
+        [(shard, hot_path, gen, [(path, handle, count)], total)]."""
+        lib = self.client.lib
+        shards: dict[int, list] = {}
+        order: list[int] = []
+        for k, path, _hot in self._unit_paths(app_id, channel_id):
+            h = self.client.handle_path(path)
+            cnt = int(lib.pio_evlog_entry_count(h))
+            if k not in shards:
+                shards[k] = []
+                order.append(k)
+            shards[k].append((path, h, cnt))
+        out = []
+        for k in order:
+            hot = self._hot_path(app_id, channel_id, k)
+            gen = self.client._generations.get(str(hot), 0)
+            units = shards[k]
+            out.append((k, hot, gen, units, sum(c for _, _, c in units)))
+        return out
+
+    def _pin_units_locked(self, snap) -> list:
+        pins = []
+        for _k, _hot, _gen, units, _tot in snap:
+            for path, _h, _cnt in units:
+                key = str(path)
+                self.client._pins[key] = self.client._pins.get(key, 0) + 1
+                pins.append(key)
+        return pins
+
+    def _spray(self, uidx, utab, nshards: int):
+        """Per-row writer shard from the FNV-1a hash of the user entity
+        id — an entity's whole history lands in one shard, so per-entity
+        event order survives sharding."""
+        import numpy as np
+
+        hashes = native.fnv1a64_table(utab.blob, utab.offsets)
+        tab_shard = (hashes % np.uint64(nshards)).astype(np.int64)
+        return tab_shard[uidx]
+
+    def _scan_units(self, units, start_time, until_time, entity_type,
+                    target_entity_type, names, fixed, value_prop,
+                    default_value, stats=None, shard_sink=None):
+        """Fan the native scan out over SEGMENT FILES (shard hots and
+        cold tiers) instead of entry ranges of one file — the
+        multi-writer generalization of :meth:`_scan_sharded`. ``units``
+        is [(handle, lo, hi)] in merge order; the merge itself is the
+        same TableMerger discipline (global first-seen interning in unit
+        order, one stable time sort when an inversion exists), so the
+        result is byte-identical to a single-writer scan of the same
+        events whenever event times are distinct. Caller must have
+        pinned every unit's path."""
+        import time as _time
+        from concurrent.futures import ThreadPoolExecutor
+
+        t_all0 = _time.perf_counter()
+
+        def run(u):
+            h, lo, hi = u
+            t0 = _time.perf_counter()
+            out = self._scan_native(
+                h, start_time, until_time, entity_type,
+                target_entity_type, names, fixed, value_prop,
+                default_value, min_entry_idx=lo, max_entry_idx=hi,
+                with_times=True, n_threads=1 if len(units) > 1 else 0)
+            return out, _time.perf_counter() - t0
+
+        if len(units) == 1:
+            return self._merge_shards(iter([run(units[0])]), 1, t_all0,
+                                      stats, shard_sink)
+        with ThreadPoolExecutor(max_workers=len(units)) as pool:
+            futs = [pool.submit(run, u) for u in units]
+            return self._merge_shards(
+                iter(f.result() for f in futs), len(units), t_all0,
+                stats, shard_sink)
+
     # -- lifecycle ---------------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         self._handle(app_id, channel_id)
@@ -368,7 +652,10 @@ class CppLogEvents(base.Events):
         return self.client.drop(self.ns, app_id, channel_id)
 
     def close(self) -> None:  # client-owned handles stay for other DAOs
-        pass
+        with self.client.lock:
+            pool, self._fanout_pool = self._fanout_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     # -- record io ---------------------------------------------------------
     def _read_raw(self, h: int, index: int) -> Optional[bytes]:
@@ -419,7 +706,17 @@ class CppLogEvents(base.Events):
             s = np.uint64(seed)
             ida = mix(s ^ k)
             idb = mix(s + np.uint64(0x9E3779B97F4A7C15) + k)
-        return [f"{a:016x}{b:016x}" for a, b in zip(ida, idb)]
+        # render all n ids with ONE hexlify over a packed big-endian
+        # buffer: per-id f-string formatting was the ingest hot path's
+        # largest single Python cost (~1 us/id dwarfs the ~0.2 us/row
+        # native append at batch scale)
+        import binascii
+
+        buf = np.empty((n, 2), dtype=">u8")
+        buf[:, 0] = ida
+        buf[:, 1] = idb
+        hexstr = binascii.hexlify(buf.tobytes()).decode("ascii")
+        return [hexstr[i:i + 32] for i in range(0, 32 * n, 32)]
 
     def _uniform_batch(self, events: Sequence[Event]):
         """events → (Interactions, etype, tetype, name, vprop, times_ms)
@@ -463,20 +760,21 @@ class CppLogEvents(base.Events):
             if fast is not None:
                 inter, etype, tetype, name, vprop, times = fast
                 seed = int.from_bytes(secrets.token_bytes(8), "little")
+                key = (app_id, channel_id, etype, tetype, name, vprop)
                 try:
-                    wrote = self.import_interactions(
-                        inter, app_id, channel_id, entity_type=etype,
-                        target_entity_type=tetype, event_name=name,
-                        value_prop=vprop, times=times, id_seed=seed)
+                    prep = self._prep_columnar(inter, times)
+                    with self.client.lock:
+                        rc, ids = self._append_columnar_any(
+                            key, n, *prep, seed=seed)
                 except base.StorageError:
                     # safe to fall through to the generic path: the -2
-                    # (sidecar-limits) case raises BEFORE any write, and a
+                    # (sidecar-limits) case rejects BEFORE any write, and a
                     # write failure truncates the log back to the batch
                     # start (eventlog.cc append_interactions is
                     # all-or-nothing), so nothing partial remains
-                    wrote = 0
-                if wrote == n:
-                    return self._derive_event_ids(seed, n)
+                    rc, ids = 0, None
+                if rc == n:
+                    return ids
         # last-wins for duplicate explicit ids WITHIN the batch too (sqlite
         # INSERT OR REPLACE parity): earlier occurrences are dropped from
         # the write set, since the per-event tombstone scan below can only
@@ -484,6 +782,9 @@ class CppLogEvents(base.Events):
         last_pos: dict[str, int] = {
             e.event_id: k for k, e in enumerate(events) if e.event_id
         }
+        if not self._is_plain(app_id, channel_id):
+            return self._insert_batch_sharded(events, app_id, channel_id,
+                                              last_pos)
         with self.client.lock:
             h = self._handle(app_id, channel_id)
             ids: list[str] = []
@@ -576,24 +877,171 @@ class CppLogEvents(base.Events):
                 self.client.note_count_locked(path, end)
         return ids
 
+    def _insert_batch_sharded(self, events: Sequence[Event], app_id: int,
+                              channel_id: Optional[int],
+                              last_pos: dict) -> list:
+        """Generic (per-Event) insert for sharded/tiered layouts:
+        events spray to writer shards by entity-id hash (the same
+        policy as the columnar path, so an entity's history stays in
+        one shard) and each shard takes ONE bulk append. Explicit-id
+        upserts probe EVERY segment of every shard — the prior record
+        may live anywhere when the entity id changed between writes —
+        and a tombstone landing in a COLD segment bumps that shard's
+        generation (the marker shifts the shard's merged entry
+        numbering, so tail cursors must resync)."""
+        import struct
+
+        import numpy as np
+
+        nsh = self._nshards(app_id, channel_id)
+        n = len(events)
+        ids: list = [None] * n
+        with self.client.lock:
+            units = [(k, path, self.client.handle_path(path), is_hot)
+                     for k, path, is_hot in
+                     self._unit_paths(app_id, channel_id)]
+
+            def probe_tombstone(eid: str) -> None:
+                for uk, _upath, uh, u_hot in units:
+                    for idx in self._candidates_by_id(uh, eid):
+                        obj = self._read(uh, idx)
+                        if obj is not None and obj.get("eventId") == eid:
+                            self.client.lib.pio_evlog_tombstone(uh, idx)
+                            if not u_hot:
+                                self.client.bump_generation_locked(
+                                    self._hot_path(app_id, channel_id,
+                                                   uk))
+
+            write_rows: dict[int, list] = {}  # shard -> [(event, eid)]
+            for i, event in enumerate(events):
+                validate_event(event)
+                if event.event_id:
+                    eid = event.event_id
+                    ids[i] = eid
+                    if last_pos[eid] != i:  # superseded later in batch
+                        continue
+                    probe_tombstone(eid)
+                else:
+                    eid = new_event_id()
+                    ids[i] = eid
+                shard = native.fnv1a64(
+                    event.entity_id.encode("utf-8")) % nsh
+                write_rows.setdefault(shard, []).append((event, eid))
+            for shard in sorted(write_rows):
+                rows = write_rows[shard]
+                path = self._hot_path(app_id, channel_id, shard)
+                h = self.client.handle_path(path)
+                m = len(rows)
+                times = np.empty(m, np.int64)
+                offs = np.empty(7 * m + 1, np.int64)
+                meta = bytearray(8 * m)
+                chunks: list[bytes] = []
+                pos = 0
+                offs[0] = 0
+                j = 0
+                for w, (event, eid) in enumerate(rows):
+                    payload = json.dumps(
+                        event.with_id(eid).to_jsonable(),
+                        separators=(",", ":")).encode("utf-8")
+                    times[w] = to_millis(event.event_time)
+                    etype_b = event.entity_type.encode("utf-8")
+                    ent_b = event.entity_id.encode("utf-8")
+                    name_b = event.event.encode("utf-8")
+                    tet_b = (event.target_entity_type or ""
+                             ).encode("utf-8")
+                    tei_b = (event.target_entity_id or ""
+                             ).encode("utf-8")
+                    has_target = event.target_entity_id is not None
+                    props_blob = b""
+                    n_props = 0
+                    sidecar_ok = max(
+                        len(etype_b), len(ent_b), len(name_b),
+                        len(tet_b), len(tei_b)) < 0xFFFF
+                    if sidecar_ok:
+                        parts = []
+                        for pkey, v in \
+                                event.properties.to_jsonable().items():
+                            if isinstance(v, bool) or \
+                                    not isinstance(v, (int, float)):
+                                continue
+                            kb = pkey.encode("utf-8")
+                            if len(kb) > 255 or n_props == 255:
+                                sidecar_ok = False
+                                break
+                            parts.append(
+                                struct.pack("<B", len(kb)) + kb
+                                + struct.pack("<d", float(v)))
+                            n_props += 1
+                        if sidecar_ok:
+                            props_blob = b"".join(parts)
+                        else:
+                            n_props = 0
+                    struct.pack_into("<BBBBI", meta, 8 * w,
+                                     1 if has_target else 0,
+                                     1 if sidecar_ok else 0,
+                                     n_props, 0, len(props_blob))
+                    for field in (etype_b, ent_b, name_b,
+                                  eid.encode("utf-8"), tet_b, tei_b,
+                                  props_blob + payload):
+                        chunks.append(field)
+                        pos += len(field)
+                        j += 1
+                        offs[j] = pos
+                buf = b"".join(chunks)
+                rc = self.client.lib.pio_evlog_append_bulk(
+                    h, m,
+                    times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    buf,
+                    offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    bytes(meta))
+                if rc != m:
+                    raise base.StorageError("bulk event append failed")
+                end = self.client.lib.pio_evlog_entry_count(h)
+                self.client.note_count_locked(path, end - m)
+                self.client.note_count_locked(path, end)
+        return ids
+
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         with self.client.lock:
-            h = self._handle(app_id, channel_id)
-            for idx in self._candidates_by_id(h, event_id):
-                obj = self._read(h, idx)
-                if obj is not None and obj.get("eventId") == event_id:
-                    return Event.from_jsonable(obj)
+            if self._is_plain(app_id, channel_id):
+                handles = [self._handle(app_id, channel_id)]
+            else:
+                handles = [self.client.handle_path(p) for _k, p, _hot
+                           in self._unit_paths(app_id, channel_id)]
+            for h in handles:
+                for idx in self._candidates_by_id(h, event_id):
+                    obj = self._read(h, idx)
+                    if obj is not None and obj.get("eventId") == event_id:
+                        return Event.from_jsonable(obj)
             return None
 
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         with self.client.lock:
-            h = self._handle(app_id, channel_id)
-            for idx in self._candidates_by_id(h, event_id):
-                obj = self._read(h, idx)
-                if obj is not None and obj.get("eventId") == event_id:
-                    return self.client.lib.pio_evlog_tombstone(h, idx) == 0
+            if self._is_plain(app_id, channel_id):
+                h = self._handle(app_id, channel_id)
+                for idx in self._candidates_by_id(h, event_id):
+                    obj = self._read(h, idx)
+                    if obj is not None and obj.get("eventId") == event_id:
+                        return self.client.lib.pio_evlog_tombstone(
+                            h, idx) == 0
+                return False
+            for k, path, is_hot in self._unit_paths(app_id, channel_id):
+                h = self.client.handle_path(path)
+                for idx in self._candidates_by_id(h, event_id):
+                    obj = self._read(h, idx)
+                    if obj is not None and obj.get("eventId") == event_id:
+                        ok = self.client.lib.pio_evlog_tombstone(
+                            h, idx) == 0
+                        if ok and not is_hot:
+                            # the marker appended to the COLD tier sits
+                            # between cold and hot in merge order, so
+                            # the shard's merged entry numbering shifts:
+                            # tail cursors must resync
+                            self.client.bump_generation_locked(
+                                self._hot_path(app_id, channel_id, k))
+                        return ok
             return False
 
     # -- query -------------------------------------------------------------
@@ -617,6 +1065,11 @@ class CppLogEvents(base.Events):
         want = -1 if limit is None or limit < 0 else limit
         if want == 0:
             return iter(())
+        if not self._is_plain(app_id, channel_id):
+            return self._find_units(
+                app_id, channel_id, start_time, until_time, entity_type,
+                entity_id, names, target_entity_type, target_entity_id,
+                want, reversed)
         n_names = 0 if names is None else len(names)
         name_arr = ((ctypes.c_uint64 * n_names)(*map(_h, names))
                     if n_names else None)
@@ -665,6 +1118,49 @@ class CppLogEvents(base.Events):
         results = self._filter_parsed(
             iter(raw), entity_type, entity_id, names,
             target_entity_type, target_entity_id, want)
+        return iter(results)
+
+    def _find_units(self, app_id, channel_id, start_time, until_time,
+                    entity_type, entity_id, names, target_entity_type,
+                    target_entity_id, want: int, rev: bool):
+        """find() over a sharded/tiered layout: one native query per
+        segment file, per-unit parse, then a merge on (time, unit
+        order). Within a unit the native query's (time, append) order
+        is preserved; across units, equal timestamps order by unit
+        index — cross-shard append-order ties were never defined (the
+        writers race on the wire too)."""
+        n_names = 0 if names is None else len(names)
+        name_arr = ((ctypes.c_uint64 * n_names)(*map(_h, names))
+                    if n_names else None)
+        parsed: list = []  # (time_ms, unit_idx, seq, Event)
+        with self.client.lock:
+            lib = self.client.lib
+            for u, (_k, path, _hot) in enumerate(
+                    self._unit_paths(app_id, channel_id)):
+                h = self.client.handle_path(path)
+                total = lib.pio_evlog_count(h)
+                out = (ctypes.c_int64 * max(total, 1))()
+                m = lib.pio_evlog_query(
+                    h,
+                    _I64_MIN if start_time is None
+                    else to_millis(start_time),
+                    _I64_MAX if until_time is None
+                    else to_millis(until_time),
+                    _h(entity_type) if entity_type is not None else 0,
+                    _h(entity_id) if entity_id is not None else 0,
+                    name_arr, n_names, 1 if rev else 0, -1, out, total,
+                )
+                evs = self._filter_parsed(
+                    (self._read_raw(h, out[i]) for i in range(m)),
+                    entity_type, entity_id, names,
+                    target_entity_type, target_entity_id, -1)
+                for seq, ev in enumerate(evs):
+                    parsed.append((to_millis(ev.event_time), u, seq, ev))
+        parsed.sort(key=(lambda t: (-t[0], t[1], t[2])) if rev
+                    else (lambda t: (t[0], t[1], t[2])))
+        results = [t[3] for t in parsed]
+        if want >= 0:
+            results = results[:want]
         return iter(results)
 
     def scan_interactions(
@@ -719,6 +1215,11 @@ class CppLogEvents(base.Events):
 
         names = [str(n) for n in event_names]
         fixed = event_values or {}
+        if not self._is_plain(app_id, channel_id):
+            return self._scan_interactions_units(
+                app_id, channel_id, entity_type, target_entity_type,
+                names, fixed, value_prop, default_value, start_time,
+                until_time, stats, shard_sink)
         servable = (
             len(names) == 1 and value_prop is not None
             and names[0] not in fixed
@@ -779,6 +1280,39 @@ class CppLogEvents(base.Events):
         finally:
             self.client.unpin(pin)
 
+    def _scan_interactions_units(self, app_id, channel_id, entity_type,
+                                 target_entity_type, names, fixed,
+                                 value_prop, default_value, start_time,
+                                 until_time, stats, shard_sink):
+        """Training scan over a sharded/tiered layout: every segment
+        (cold tier before hot, shard order) scans CONCURRENTLY and the
+        results merge under the TableMerger discipline — byte-identical
+        to the single-writer scan of the same events whenever event
+        times are distinct (_merge_shards restores global time order;
+        equal-time ties across writer shards order by segment, an order
+        a single writer never defined either). The projection cache
+        stays plain-layout-only: a sharded training scan always runs
+        the full fan-out, which IS the parallel fast path."""
+        with self.client.lock:
+            snap = self._snapshot_shards_locked(app_id, channel_id)
+            pins = self._pin_units_locked(snap)
+        try:
+            units = []
+            for _k, _hot, _gen, segs, _tot in snap:
+                for _path, h, cnt in segs:
+                    units.append((h, 0, cnt))
+            stats = {} if stats is None else stats
+            inter, _times = self._scan_units(
+                units, start_time, until_time, entity_type,
+                target_entity_type, names, fixed, value_prop,
+                default_value, stats=stats, shard_sink=shard_sink)
+            self._last_scan_stats = stats
+            stats.setdefault("scan_source", "scan")
+            return inter
+        finally:
+            for key in pins:
+                self.client.unpin(key)
+
     # -- speed-layer tail cursor -------------------------------------------
     def tail_cursor(self, app_id: int,
                     channel_id: Optional[int] = None) -> int:
@@ -786,16 +1320,31 @@ class CppLogEvents(base.Events):
         raw entry count. Compaction/drop renumber entries and bump the
         generation, which read_interactions_since surfaces as a RESET —
         a bare count comparison would miss "compacted, then appended
-        past the old count before the next poll"."""
+        past the old count before the next poll".
+
+        Sharded/tiered layouts return a :class:`base.VectorCursor` —
+        one component per writer shard, each (generation <<
+        TAIL_GEN_SHIFT) | merged (cold + hot) count — whose comparison
+        semantics make every overlay/controller predicate behave: any
+        component behind reads as "behind", any generation mismatch
+        resets."""
         with self.client.lock:
-            h = self._handle(app_id, channel_id)
-            path = self.client._file(self.ns, app_id, channel_id)
-            gen = self.client._generations.get(str(path), 0)
-            count = int(self.client.lib.pio_evlog_entry_count(h))
-            # count observation: anchors the freshness bound for a pure
-            # READER process (the subscriber calls this at startup)
-            self.client.note_count_locked(path, count)
-            return (gen << self.TAIL_GEN_SHIFT) | count
+            if self._is_plain(app_id, channel_id):
+                h = self._handle(app_id, channel_id)
+                path = self.client._file(self.ns, app_id, channel_id)
+                gen = self.client._generations.get(str(path), 0)
+                count = int(self.client.lib.pio_evlog_entry_count(h))
+                # count observation: anchors the freshness bound for a
+                # pure READER process (the subscriber calls this at
+                # startup)
+                self.client.note_count_locked(path, count)
+                return (gen << self.TAIL_GEN_SHIFT) | count
+            snap = self._snapshot_shards_locked(app_id, channel_id)
+            comps = []
+            for _k, hot, gen, _segs, total in snap:
+                self.client.note_count_locked(hot, total)
+                comps.append((gen << self.TAIL_GEN_SHIFT) | total)
+            return base.VectorCursor(comps)
 
     def read_interactions_since(
         self,
@@ -832,6 +1381,11 @@ class CppLogEvents(base.Events):
 
         names = [str(n) for n in event_names]
         fixed = event_values or {}
+        if not self._is_plain(app_id, channel_id):
+            return self._read_tail_units(
+                cursor, app_id, channel_id, entity_type,
+                target_entity_type, names, fixed, value_prop,
+                default_value)
         gen_mask = (1 << self.TAIL_GEN_SHIFT) - 1
         with self.client.lock:
             h = self._handle(app_id, channel_id)
@@ -861,14 +1415,100 @@ class CppLogEvents(base.Events):
                     path, lo)
                 # this read's own observation bounds the NEXT delta
                 self.client.note_count_locked(path, raw)
+            # tail reads book their scan sub-metrics too (scan_source
+            # "tail"): between retrains the controller's staleness
+            # inputs come from exactly these polls, so /metrics must
+            # not freeze at the last FULL scan's numbers
+            stats: dict = {}
             inter, times = self._scan_sharded(
                 h, raw, None, None, entity_type, target_entity_type,
                 names, fixed, value_prop, default_value,
-                min_entry_idx=lo)
+                min_entry_idx=lo, stats=stats)
+            stats["scan_source"] = "tail"
+            self._last_scan_stats = stats
             append_ms = np.full(len(inter), append_wall, np.int64)
             return inter, times, append_ms, new_cursor, False
         finally:
             self.client.unpin(pin)
+
+    def _read_tail_units(self, cursor, app_id, channel_id, entity_type,
+                         target_entity_type, names, fixed, value_prop,
+                         default_value):
+        """Vector-cursor tail read for sharded/tiered layouts: one
+        cursor component per writer shard, each (gen << SHIFT) | merged
+        (cold + hot) count. Any component's generation mismatch — or a
+        scalar/mis-shaped cursor, e.g. one minted before the layout
+        changed — resets the WHOLE tail (the merged stream renumbers).
+        Append stamps take the MIN over the contributing shards'
+        observations: ages stay conservatively overstated, exactly the
+        base.py contract."""
+        import numpy as np
+
+        gen_mask = (1 << self.TAIL_GEN_SHIFT) - 1
+        with self.client.lock:
+            snap = self._snapshot_shards_locked(app_id, channel_id)
+            pins = self._pin_units_locked(snap)
+        try:
+            new_cursor = base.VectorCursor(
+                (gen << self.TAIL_GEN_SHIFT) | total
+                for _k, _hot, gen, _segs, total in snap)
+            comps = None
+            if isinstance(cursor, (tuple, list)) \
+                    and len(cursor) == len(snap):
+                comps = [max(int(c), 0) for c in cursor]
+            reset = comps is None
+            units = []
+            if not reset:
+                for (_k, _hot, gen, segs, total), comp in zip(snap,
+                                                              comps):
+                    cgen = comp >> self.TAIL_GEN_SHIFT
+                    lo = comp & gen_mask
+                    if cgen != gen or lo > total:
+                        reset = True
+                        break
+                    # map the shard-merged lo across its cold/hot split
+                    off = 0
+                    for _path, h, cnt in segs:
+                        seg_lo = min(max(lo - off, 0), cnt)
+                        if seg_lo < cnt:
+                            units.append((h, seg_lo, cnt))
+                        off += cnt
+            if reset or not units:
+                with self.client.lock:
+                    if not reset:
+                        for _k, hot, _gen, _segs, total in snap:
+                            self.client.note_count_locked(hot, total)
+                empty = base.Interactions(
+                    user_idx=np.empty(0, np.int32),
+                    item_idx=np.empty(0, np.int32),
+                    values=np.empty(0, np.float32),
+                    user_ids=base.IdTable(b"", np.zeros(1, np.int64)),
+                    item_ids=base.IdTable(b"", np.zeros(1, np.int64)))
+                return (empty, np.empty(0, np.int64),
+                        np.empty(0, np.int64), new_cursor, reset)
+            with self.client.lock:
+                walls = []
+                for (_k, hot, _gen, _segs, total), comp in zip(snap,
+                                                               comps):
+                    lo = comp & gen_mask
+                    if total > lo:  # this shard contributes rows
+                        walls.append(
+                            self.client.append_wall_since_locked(hot,
+                                                                 lo))
+                    self.client.note_count_locked(hot, total)
+                append_wall = (-1 if not walls or min(walls) < 0
+                               else min(walls))
+            stats: dict = {}
+            inter, times = self._scan_units(
+                units, None, None, entity_type, target_entity_type,
+                names, fixed, value_prop, default_value, stats=stats)
+            stats["scan_source"] = "tail"
+            self._last_scan_stats = stats
+            append_ms = np.full(len(inter), append_wall, np.int64)
+            return inter, times, append_ms, new_cursor, False
+        finally:
+            for key in pins:
+                self.client.unpin(key)
 
     def _seed_cache_revalidated(self, h, cpath, cache, dead: int,
                                 plan=None) -> None:
@@ -1332,7 +1972,7 @@ class CppLogEvents(base.Events):
 
         seed = int.from_bytes(secrets.token_bytes(8), "little")
         with self.client.lock:
-            rc = self._append_columnar_locked(
+            rc, ids = self._append_columnar_any(
                 key, n, times_arr, uidx, iidx, vals, utab, itab, seed)
         if rc == -2:
             raise base.StorageError(
@@ -1340,7 +1980,7 @@ class CppLogEvents(base.Events):
                 "long or non-finite value)")
         if rc != n:
             raise base.StorageError("columnar interaction import failed")
-        return self._derive_event_ids(seed, n)
+        return ids
 
     def _commit_pending_locked(self, batch: list) -> None:
         """Leader leg of the group commit: append every drained batch,
@@ -1361,14 +2001,14 @@ class CppLogEvents(base.Events):
                 else:
                     n, merged = self._merge_pending(items)
                 seed = int.from_bytes(secrets.token_bytes(8), "little")
-                rc = self._append_columnar_locked(key, n, *merged, seed)
+                rc, ids = self._append_columnar_any(key, n, *merged,
+                                                    seed=seed)
                 if rc == n:
                     with self._gc_mu:
                         self._gc_appends += 1
                         self._gc_caller_batches += len(items)
                         self._gc_events += n
                         self._gc_max_merge = max(self._gc_max_merge, n)
-                    ids = self._derive_event_ids(seed, n)
                     off = 0
                     for it in items:
                         it.ids = ids[off:off + it.n]
@@ -1537,6 +2177,163 @@ class CppLogEvents(base.Events):
                     "successful import (next scan rebuilds it)")
         return rc
 
+    @staticmethod
+    def _columnar_rejected(key, n, uidx, iidx, vals, utab, itab) -> bool:
+        """True when the native columnar append would return -2 —
+        mirrors the exact reject conditions of eventlog.cc
+        pio_evlog_append_interactions (scalar field lengths, id
+        lengths, finite values, index ranges), evaluated BEFORE any
+        write so a sharded fan-out stays all-or-nothing across shards
+        (a single-file append is natively all-or-nothing; N per-shard
+        appends are not, unless nothing can reject mid-flight)."""
+        import numpy as np
+
+        (_a, _c, etype, tetype, name, vprop) = key
+        if (len(etype.encode("utf-8")) >= 0xFFFF
+                or len(tetype.encode("utf-8")) >= 0xFFFF
+                or len(name.encode("utf-8")) >= 0xFFFF
+                or len(vprop.encode("utf-8")) > 255):
+            return True
+        for tab in (utab, itab):
+            if len(tab) and int(np.diff(tab.offsets).max()) >= 0xFFFF:
+                return True
+        if n and not np.isfinite(vals).all():
+            return True
+        if n and (int(uidx.min()) < 0 or int(uidx.max()) >= len(utab)
+                  or int(iidx.min()) < 0 or int(iidx.max()) >= len(itab)):
+            return True
+        return False
+
+    def _append_columnar_any(self, key, n, times_arr, uidx, iidx, vals,
+                             utab, itab, seed: int):
+        """Columnar append dispatch → (rc, ids | None). Caller holds
+        the client lock. The plain layout takes the original
+        single-writer path (ids from the shared seed formula); sharded
+        layouts spray rows by user-id hash and append to every target
+        shard concurrently."""
+        app_id, channel_id = key[0], key[1]
+        if self._is_plain(app_id, channel_id):
+            rc = self._append_columnar_locked(
+                key, n, times_arr, uidx, iidx, vals, utab, itab, seed)
+            return rc, (self._derive_event_ids(seed, n) if rc == n
+                        else None)
+        return self._append_columnar_sharded(
+            key, n, times_arr, uidx, iidx, vals, utab, itab, seed)
+
+    def _append_columnar_sharded(self, key, n, times_arr, uidx, iidx,
+                                 vals, utab, itab, seed: int):
+        """Spray one columnar batch across the writer shards and append
+        to each target shard CONCURRENTLY — ctypes releases the GIL, so
+        the per-shard native appends (hashing + record rendering + the
+        buffered write, all in C++) really overlap; this fan-out is the
+        multi-writer throughput win the bench measures. Returns
+        (rc, ids) with ids in CALLER order (derived per shard from a
+        shard-mixed seed). Caller holds the client lock; workers touch
+        only pre-resolved handles and per-shard locks (lock order:
+        client lock → shard lock, same as replication_apply).
+
+        All-or-nothing: the -2 screen runs up front (mirroring the
+        native conditions), so per-shard appends cannot reject
+        mid-fan-out; a residual IO failure raises StorageError loudly
+        rather than reporting a partial write."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        import numpy as np
+
+        from incubator_predictionio_tpu.utils.times import now_utc
+
+        app_id, channel_id = key[0], key[1]
+        (_a, _c, etype, tetype, name, vprop) = key
+        if self._columnar_rejected(key, n, uidx, iidx, vals, utab, itab):
+            return -2, None
+        if times_arr is None:
+            times_arr = to_millis(now_utc()) + np.arange(n,
+                                                         dtype=np.int64)
+        nsh = self._nshards(app_id, channel_id)
+        row_shard = self._spray(uidx, utab, nsh)
+        golden = 0x9E3779B97F4A7C15
+        plan = []
+        for k in range(nsh):
+            rows = np.nonzero(row_shard == k)[0]
+            if not len(rows):
+                continue
+            path = self._hot_path(app_id, channel_id, k)
+            seed_k = (seed ^ (golden * (k + 1))) & 0xFFFFFFFFFFFFFFFF
+            # handles, locks, and counts resolve HERE, under the client
+            # lock — the workers must never take it (they'd deadlock
+            # against this thread waiting on their results)
+            plan.append((k, rows, path,
+                         self.client.handle_path(path),
+                         self.client.shard_lock(path), seed_k,
+                         (np.ascontiguousarray(times_arr[rows]),
+                          np.ascontiguousarray(uidx[rows]),
+                          np.ascontiguousarray(iidx[rows]),
+                          np.ascontiguousarray(vals[rows]))))
+        uoffs = np.ascontiguousarray(utab.offsets, np.int64)
+        ioffs = np.ascontiguousarray(itab.offsets, np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        etype_b = etype.encode("utf-8")
+        tetype_b = tetype.encode("utf-8")
+        name_b = name.encode("utf-8")
+        vprop_b = vprop.encode("utf-8")
+        lib = self.client.lib
+
+        def commit(entry):
+            _k, rows, _path, h, lk, seed_k, arrs = entry
+            t_arr, s_uidx, s_iidx, s_vals = arrs
+            with lk:
+                return lib.pio_evlog_append_interactions(
+                    h, len(rows), t_arr.ctypes.data_as(i64p),
+                    s_uidx.ctypes.data_as(i32p),
+                    s_iidx.ctypes.data_as(i32p),
+                    s_vals.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)),
+                    utab.blob, uoffs.ctypes.data_as(i64p), len(utab),
+                    itab.blob, ioffs.ctypes.data_as(i64p), len(itab),
+                    etype_b, tetype_b, name_b, vprop_b, seed_k)
+
+        import os as _os
+
+        if len(plan) == 1 or (_os.cpu_count() or 1) == 1:
+            # one target shard — or one core, where fan-out threads can
+            # only add scheduling overhead to CPU-bound native renders
+            rcs = [commit(entry) for entry in plan]
+        else:
+            with self.client.lock:  # reentrant: the append path holds it
+                pool = self._fanout_pool
+                if pool is None or pool._max_workers < len(plan):
+                    if pool is not None:
+                        pool.shutdown(wait=False)
+                    pool = self._fanout_pool = ThreadPoolExecutor(
+                        max_workers=max(len(plan), 4),
+                        thread_name_prefix="cpplog-fanout")
+            rcs = list(pool.map(commit, plan))
+        failed = [entry[0] for entry, rc in zip(plan, rcs)
+                  if rc != len(entry[1])]
+        if failed:
+            raise base.StorageError(
+                f"sharded columnar append failed on shard(s) {failed} "
+                "(pre-screened batch: IO error, not a reject)")
+        ids_arr = np.empty(n, dtype=object)
+        for (k, rows, path, h, _lk, seed_k, _arrs), rc in zip(plan, rcs):
+            end = int(lib.pio_evlog_entry_count(h))
+            self.client.note_count_locked(path, end - len(rows))
+            self.client.note_count_locked(path, end)
+            ids_arr[rows] = self._derive_event_ids(seed_k, len(rows))
+        self._book_shard_events(plan)
+        self.maybe_roll(app_id, channel_id)
+        return n, ids_arr.tolist()
+
+    def _book_shard_events(self, plan) -> None:
+        """Per-shard ingest accounting for /metrics
+        (pio_ingest_shard_events{shard}): operators watch the spread
+        for writer-shard skew (observability.md runbook)."""
+        with self._gc_mu:
+            for k, rows, *_rest in plan:
+                self._shard_events[k] = (
+                    self._shard_events.get(k, 0) + len(rows))
+
     def import_interactions(
         self,
         inter: base.Interactions,
@@ -1568,7 +2365,7 @@ class CppLogEvents(base.Events):
         seed = (int.from_bytes(secrets.token_bytes(8), "little")
                 if id_seed is None else (id_seed & 0xFFFFFFFFFFFFFFFF))
         with self.client.lock:
-            rc = self._append_columnar_locked(
+            rc, _ids = self._append_columnar_any(
                 key, n, times_arr, uidx, iidx, vals, utab, itab, seed)
         if rc == -2:  # sidecar limits exceeded: generic per-Event path
             if id_seed is not None:
@@ -1674,36 +2471,257 @@ class CppLogEvents(base.Events):
         on this path, ids/times/bytes are preserved exactly, and log
         (append) order survives — the equal-time tie-break contract. The
         training projection is invalidated (entry numbering changes).
-        Returns ``{"events", "bytes_before", "bytes_after"}``."""
+
+        Sharded/tiered layouts compact PER SEGMENT — each cold tier and
+        each hot segment rewrites independently (small files, bounded
+        pause), with one generation bump per shard so pinned readers
+        and speed-overlay cursors resync exactly as on the plain
+        layout. Returns ``{"events", "bytes_before", "bytes_after"}``
+        aggregated over every segment."""
         import os
 
         from incubator_predictionio_tpu.data.storage import traincache
 
+        events = bytes_before = bytes_after = 0
         with self.client.lock:
-            path = self.client._file(self.ns, app_id, channel_id)
-            # compaction renumbers entries and swaps the handle: wait out
-            # any lock-narrowed scan still reading the old one
-            self.client._wait_unpinned_locked(str(path))
-            h = self._handle(app_id, channel_id)
-            bytes_before = path.stat().st_size if path.exists() else 0
-            tmp_path = path.with_name(path.name + ".compact")
-            live = self.client.lib.pio_evlog_compact_copy(
-                h, str(tmp_path).encode("utf-8"))
-            if live < 0:
-                tmp_path.unlink(missing_ok=True)
-                raise base.StorageError(
-                    f"compaction failed for {path.name}")
-            old = self.client._handles.pop(str(path), None)
-            if old is not None:
-                self.client.lib.pio_evlog_close(old)
-            os.replace(tmp_path, path)
-            traincache.invalidate(path)
-            # entry numbering may have changed (tombstones dropped):
-            # tail cursors minted before this compaction are now invalid
-            self.client.bump_generation_locked(path)
-            bytes_after = path.stat().st_size if path.exists() else 0
-        return {"events": int(live), "bytes_before": bytes_before,
+            by_shard: dict[int, list] = {}
+            for k, path, _hot in self._unit_paths(app_id, channel_id):
+                by_shard.setdefault(k, []).append(path)
+            for k, paths in by_shard.items():
+                hot = self._hot_path(app_id, channel_id, k)
+                for path in paths:
+                    # compaction renumbers entries and swaps the handle:
+                    # wait out any lock-narrowed scan still reading it
+                    self.client._wait_unpinned_locked(str(path))
+                    h = self.client.handle_path(path)
+                    bytes_before += (path.stat().st_size
+                                     if path.exists() else 0)
+                    tmp_path = path.with_name(path.name + ".compact")
+                    live = self.client.lib.pio_evlog_compact_copy(
+                        h, str(tmp_path).encode("utf-8"))
+                    if live < 0:
+                        tmp_path.unlink(missing_ok=True)
+                        raise base.StorageError(
+                            f"compaction failed for {path.name}")
+                    self.client.close_path_locked(path)
+                    os.replace(tmp_path, path)
+                    events += int(live)
+                    bytes_after += (path.stat().st_size
+                                    if path.exists() else 0)
+                traincache.invalidate(hot)
+                # entry numbering may have changed (tombstones
+                # dropped): tail cursors minted before this compaction
+                # are now invalid, and replication followers must
+                # resync the rewritten segment bytes
+                self.client.bump_generation_locked(hot)
+                self.client.bump_epoch_locked(hot)
+        return {"events": events, "bytes_before": bytes_before,
                 "bytes_after": bytes_after}
+
+    def maybe_roll(self, app_id: int, channel_id: Optional[int] = None,
+                   limit_bytes: Optional[int] = None) -> int:
+        """Segment tiering: seal every hot segment that outgrew the
+        limit by folding its LIVE records onto the shard's cold tier
+        (via the native compact copy, which also resolves hot-internal
+        tombstones — a raw byte concat would carry tombstone target
+        indices local to the old hot file) and truncating the hot file
+        to empty. The hot segment stays small, so appends and tail
+        polls touch a small file and compaction rewrites bounded
+        segments instead of one monolith. The cold file is the
+        concatenation of sealed hots in seal order, so the shard's
+        merged (cold-then-hot) stream keeps its order; the roll still
+        BUMPS the shard's generation and rewrite epoch — entry
+        numbering changed, cursors resync exactly as on compaction and
+        followers resync the shard.
+
+        ``limit_bytes``: explicit threshold; default reads
+        ``PIO_LOG_HOT_BYTES`` per call (unset/0 = tiering off — the
+        opportunistic call on every sharded append is then a single
+        getenv). Returns the number of shards rolled."""
+        import os
+
+        from incubator_predictionio_tpu.data.storage import traincache
+
+        if limit_bytes is None:
+            try:
+                limit_bytes = int(
+                    os.environ.get("PIO_LOG_HOT_BYTES", "0"))
+            except ValueError:
+                limit_bytes = 0
+        if limit_bytes <= 0:
+            return 0
+        rolled = 0
+        with self.client.lock:
+            for k in range(self._nshards(app_id, channel_id)):
+                hot = self._hot_path(app_id, channel_id, k)
+                try:
+                    if (not hot.exists()
+                            or hot.stat().st_size < limit_bytes):
+                        continue
+                except OSError:
+                    continue
+                cold = self.client._cold(hot)
+                if (self.client._pins.get(str(hot), 0)
+                        or self.client._pins.get(str(cold), 0)):
+                    # a lock-narrowed scan is reading this shard: the
+                    # roll is opportunistic (appends call it inline),
+                    # so SKIP rather than stall the append path behind
+                    # a training scan — the next append retries
+                    continue
+                h = self.client.handle_path(hot)
+                tmp = hot.with_name(hot.name + ".roll")
+                live = self.client.lib.pio_evlog_compact_copy(
+                    h, str(tmp).encode("utf-8"))
+                if live < 0:
+                    tmp.unlink(missing_ok=True)
+                    raise base.StorageError(
+                        f"segment roll failed for {hot.name}")
+                self.client.close_path_locked(hot)
+                self.client.close_path_locked(cold)
+                with open(cold, "ab") as dst, open(tmp, "rb") as src:
+                    import shutil
+
+                    shutil.copyfileobj(src, dst)
+                    dst.flush()
+                    os.fsync(dst.fileno())
+                tmp.unlink(missing_ok=True)
+                with open(hot, "r+b") as f:
+                    f.truncate(0)
+                self.client._has_cold[str(hot)] = True
+                traincache.invalidate(hot)
+                self.client.bump_generation_locked(hot)
+                self.client.bump_epoch_locked(hot)
+                rolled += 1
+        return rolled
+
+    # -- async replication (leader side + follower apply) -----------------
+    def replication_status(self, app_id: int,
+                           channel_id: Optional[int] = None) -> dict:
+        """Leader-side layout snapshot for a follower's tail loop:
+        per-shard generation, rewrite epoch, and per-tier entry counts.
+        The epoch is the follower's resync signal — it moves only when
+        segment bytes were REWRITTEN (roll/compact/drop/restart), never
+        on append-only growth, so deletes replicate as plain frames."""
+        with self.client.lock:
+            snap = self._snapshot_shards_locked(app_id, channel_id)
+            out = []
+            for k, hot, gen, segs, total in snap:
+                cold_cnt = hot_cnt = 0
+                for path, _h, cnt in segs:
+                    if str(path) == str(hot):
+                        hot_cnt = cnt
+                    else:
+                        cold_cnt = cnt
+                out.append({
+                    "shard": k, "gen": gen,
+                    "epoch": self.client.epoch_locked(hot),
+                    "cold": cold_cnt, "hot": hot_cnt, "total": total,
+                })
+            return {"shards": len(snap), "status": out}
+
+    def replication_read(self, app_id: int,
+                         channel_id: Optional[int] = None,
+                         shard: int = 0, tier: str = "hot",
+                         from_entry: int = 0, epoch: int = 0,
+                         max_bytes: int = 4 << 20) -> dict:
+        """Read whole record frames from one segment file for byte-level
+        log shipping: the follower's copy stays bit-identical to the
+        leader's prefix, so tombstone target indices, sidecars, and
+        hashes all carry over. Raises when the segment's rewrite epoch
+        moved past the follower's view (stale frames must not land)."""
+        with self.client.lock:
+            hot = self._hot_path(app_id, channel_id, shard)
+            if int(epoch) != self.client.epoch_locked(hot):
+                raise base.StorageError(
+                    f"replication epoch moved for shard {shard} "
+                    "(segment rewritten); resync required")
+            path = hot if tier == "hot" else self.client._cold(hot)
+            h = self.client.handle_path(path)
+            lib = self.client.lib
+            cap = max(int(max_bytes), 1 << 16)
+            n_out = ctypes.c_int64(0)
+            for _attempt in range(2):
+                buf = ctypes.create_string_buffer(cap)
+                got = lib.pio_evlog_read_frames(
+                    h, int(from_entry), cap, buf,
+                    ctypes.byref(n_out))
+                if got >= 0:
+                    return {"epoch": int(epoch),
+                            "from_entry": int(from_entry),
+                            "n_entries": int(n_out.value),
+                            "frames": buf.raw[:got]}
+                if got == -1:
+                    raise base.StorageError(
+                        f"replication read failed for {path.name} at "
+                        f"entry {from_entry}")
+                cap = -got  # one frame alone exceeds the budget
+            raise base.StorageError(
+                f"replication frame exceeds retry budget on {path.name}")
+
+    def replication_apply(self, app_id: int,
+                          channel_id: Optional[int] = None,
+                          shard: int = 0, tier: str = "hot",
+                          from_entry: int = 0,
+                          frames: bytes = b"") -> int:
+        """Follower-side apply: append shipped frames to the local
+        segment at exactly ``from_entry``. Idempotent on replay (local
+        count already past from_entry → no-op), loud on gaps. Returns
+        the local entry count after the apply."""
+        with self.client.lock:
+            hot = self._hot_path(app_id, channel_id, shard)
+            path = hot if tier == "hot" else self.client._cold(hot)
+            lk = self.client.shard_lock(path)
+            h = self.client.handle_path(path)
+            lib = self.client.lib
+            with lk:
+                local = int(lib.pio_evlog_entry_count(h))
+                if local > int(from_entry):
+                    return local  # replayed frames: already applied
+                if local < int(from_entry):
+                    raise base.StorageError(
+                        f"replication gap on shard {shard} ({tier}): "
+                        f"local count {local} < leader from_entry "
+                        f"{from_entry}")
+                if not frames:
+                    return local
+                new_count = lib.pio_evlog_append_frames(
+                    h, frames, len(frames))
+                if new_count < 0:
+                    raise base.StorageError(
+                        f"replication apply failed on {path.name}")
+            if tier == "cold":
+                self.client._has_cold[str(hot)] = True
+            else:
+                self.client.note_count_locked(hot, int(new_count))
+            return int(new_count)
+
+    def replication_configure(self, app_id: int,
+                              channel_id: Optional[int] = None,
+                              shards: int = 1) -> int:
+        """Mirror the leader's writer-shard layout on a follower before
+        the first apply."""
+        self.client.set_shards(self.ns, app_id, channel_id, int(shards))
+        return self._nshards(app_id, channel_id)
+
+    def replication_reset(self, app_id: int,
+                          channel_id: Optional[int] = None,
+                          shard: int = 0) -> bool:
+        """Drop one local shard's segment files (follower resync after
+        a leader rewrite-epoch change): cursors minted from this
+        follower bump exactly as on a local compaction."""
+        from incubator_predictionio_tpu.data.storage import traincache
+
+        with self.client.lock:
+            hot = self._hot_path(app_id, channel_id, shard)
+            for path in (self.client._cold(hot), hot):
+                key = str(path)
+                self.client._wait_unpinned_locked(key)
+                self.client.close_path_locked(path)
+                path.unlink(missing_ok=True)
+            self.client._has_cold.pop(str(hot), None)
+            traincache.invalidate(hot)
+            self.client.bump_generation_locked(hot)
+        return True
 
     @staticmethod
     def _filter_parsed(payloads, entity_type, entity_id, names,

@@ -235,6 +235,28 @@ def _events_close(self) -> None:  # connection is client-owned
     return None
 
 
+def _events_tail_cursor(self, *args: Any, **kwargs: Any) -> Any:
+    """Sharded backends return a VectorCursor (a tuple subclass); msgpack
+    flattens it to a plain list, so rewrap sequences client-side — the
+    freshness controller's ``cursor < last`` reset trigger depends on the
+    vector comparison semantics, not just the int() sum."""
+    cur = self._call("tail_cursor", *args, **kwargs)
+    if isinstance(cur, (list, tuple)):
+        return base.VectorCursor(cur)
+    return cur
+
+
+def _events_read_interactions_since(self, cursor, *args: Any,
+                                    **kwargs: Any) -> Any:
+    if isinstance(cursor, tuple):  # VectorCursor → wire-safe list
+        cursor = list(cursor)
+    inter, times, append_ms, new_cursor, reset = self._call(
+        "read_interactions_since", cursor, *args, **kwargs)
+    if isinstance(new_cursor, (list, tuple)):
+        new_cursor = base.VectorCursor(new_cursor)
+    return inter, times, append_ms, new_cursor, reset
+
+
 def _events_insert_interactions(self, *args: Any, **kwargs: Any) -> Any:
     """Columnar id-returning insert over the wire, with the capability
     answer cached: a box backed by a store without a columnar write path
@@ -255,9 +277,13 @@ def _events_insert_interactions(self, *args: Any, **kwargs: Any) -> Any:
 RemoteEvents = _proxy(
     "Events", base.Events,
     ("init", "remove", "insert", "insert_batch", "get", "delete",
-     "aggregate_properties", "scan_interactions", "import_interactions"),
+     "aggregate_properties", "scan_interactions", "import_interactions",
+     "replication_status", "replication_read", "replication_apply",
+     "replication_configure", "replication_reset"),
     extra={"find": _events_find, "close": _events_close,
-           "insert_interactions": _events_insert_interactions},
+           "insert_interactions": _events_insert_interactions,
+           "tail_cursor": _events_tail_cursor,
+           "read_interactions_since": _events_read_interactions_since},
 )
 #: find_close retries safely (popping a cursor twice is a no-op). find_open
 #: retries too: a stale keep-alive connection otherwise fails the *first*
@@ -265,7 +291,15 @@ RemoteEvents = _proxy(
 #: the server, and the worst case — a lost response orphaning one server
 #: cursor — is already bounded by the server's idle-age cursor eviction.
 #: find_next is stateful by design — a lost pull loses its chunk.
-_IDEMPOTENT = _IDEMPOTENT | {"find_close", "find_open"}
+#: Replication verbs are position-keyed: replication_apply carries its
+#: from_entry, so a replayed apply whose first send landed is a server-side
+#: no-op (local count already past from_entry) — safe to re-send. The rest
+#: are reads or idempotent configuration.
+_IDEMPOTENT = _IDEMPOTENT | {
+    "find_close", "find_open", "tail_cursor", "read_interactions_since",
+    "replication_status", "replication_read", "replication_apply",
+    "replication_configure", "replication_reset",
+}
 RemoteApps = _proxy(
     "Apps", base.Apps,
     ("insert", "get", "get_by_name", "get_all", "update", "delete"))

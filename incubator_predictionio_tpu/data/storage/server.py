@@ -53,6 +53,12 @@ _ALLOWED: Dict[str, Tuple[str, ...]] = {
         "find_open", "find_next", "find_close",
         "aggregate_properties", "scan_interactions",
         "import_interactions", "insert_interactions",
+        # speed-layer tail (vector cursors cross the wire as arrays)
+        "tail_cursor", "read_interactions_since",
+        # async replication verbs (leader: status/read; follower:
+        # configure/apply/reset — see cpplog.py and ReplicationTail)
+        "replication_status", "replication_read", "replication_apply",
+        "replication_configure", "replication_reset",
     ),
     "Apps": ("insert", "get", "get_by_name", "get_all", "update", "delete"),
     "AccessKeys": ("insert", "get", "get_all", "get_by_appid", "update",
@@ -105,6 +111,163 @@ _IFACE_REPOSITORY: Dict[str, str] = {
 }
 
 
+class ReplicationTail:
+    """Follower-side async replication loop (the read scale-out /
+    failover leg of the planet-scale ingest path, docs/production.md).
+
+    Tails a leader StorageServer per (app, writer shard) with
+    byte-level frame shipping — the cpplog ``replication_*`` verbs —
+    so the follower's segment files stay bit-identical prefixes of the
+    leader's: tombstone target indices, sidecars, and hashes all carry
+    over, and a training scan on the follower returns exactly what the
+    leader's would (read parity). The leader's per-shard REWRITE EPOCH
+    is the resync signal: it moves only when segment bytes were
+    rewritten (roll/compact/drop/leader restart after a rewrite), never
+    on append-only growth, so deletes replicate as ordinary frames and
+    a follower resyncs only when it must. Leader-unreachable polls log
+    and retry — catch-up after a leader restart is the normal path, not
+    an error. Exposes ``pio_replication_lag_events{shard}``."""
+
+    def __init__(self, leader_url: str, local_events: Any, apps,
+                 interval_s: float = 0.5, auth_key: Optional[str] = None,
+                 prefix: str = "", max_bytes: int = 4 << 20):
+        from incubator_predictionio_tpu.data.storage import (
+            remote as remote_mod,
+        )
+
+        props = {"URL": leader_url}
+        if auth_key:
+            props["AUTHKEY"] = auth_key
+        cfg = base.StorageClientConfig(parallel=False, test=False,
+                                       properties=props)
+        self._rclient = remote_mod.StorageClient(cfg)
+        self.remote = remote_mod.RemoteEvents(self._rclient, cfg,
+                                              prefix=prefix)
+        self.local = local_events
+        self.apps = list(apps)
+        self.interval_s = float(interval_s)
+        self.max_bytes = int(max_bytes)
+        # leader epochs as of the last successful sync, keyed
+        # (app, shard); written by the tail thread, read by
+        # wait_caught_up callers judging divergence
+        self._epochs_mu = threading.Lock()
+        self._epochs: Dict[Tuple[int, int], int] = {}  # pio-lint: guarded-by(_epochs_mu)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="pio-replication-tail", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._rclient.close()
+
+    def wait_caught_up(self, timeout_s: float = 30.0) -> bool:
+        """Block until every app's follower counts match the leader's
+        (tests and failover drills); False on timeout or unreachable
+        leader."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                if all(self._lag_total(a) == 0 for a in self.apps):
+                    return True
+            except Exception:
+                pass
+            if self._stop.wait(0.05):
+                return False
+        return False
+
+    def _lag_total(self, app_id: int) -> int:
+        st = self.remote.replication_status(app_id)
+        lst = {s["shard"]: s
+               for s in self.local.replication_status(app_id)["status"]}
+        lag = 0
+        with self._epochs_mu:
+            epochs = dict(self._epochs)
+        for rs in st["status"]:
+            k = rs["shard"]
+            ls = lst.get(k, {"cold": 0, "hot": 0, "total": 0})
+            if (epochs.get((app_id, k)) != rs["epoch"]
+                    or int(ls["cold"]) > int(rs["cold"])
+                    or int(ls["hot"]) > int(rs["hot"])):
+                # divergent prefix (leader compacted/restarted under
+                # us): counts can COINCIDE while the bytes differ, so
+                # the shard is behind until the next pass resets and
+                # re-pulls it — never report 0 here
+                lag += max(int(rs["total"]), 1)
+                continue
+            lag += max(int(rs["total"]) - int(ls["total"]), 0)
+        return lag
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            for app_id in self.apps:
+                if self._stop.is_set():
+                    break
+                try:
+                    self._sync_app(app_id)
+                except Exception:
+                    # leader down / restarting: catch-up is the normal
+                    # path — keep polling
+                    logger.warning(
+                        "replication poll failed for app %s (leader "
+                        "unreachable? retrying)", app_id, exc_info=True)
+            self._stop.wait(self.interval_s)
+
+    def _sync_app(self, app_id: int) -> None:
+        from incubator_predictionio_tpu.data.storage import StorageError
+        from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+        st = self.remote.replication_status(app_id)
+        self.local.replication_configure(app_id, shards=st["shards"])
+        lst = {s["shard"]: s
+               for s in self.local.replication_status(app_id)["status"]}
+        gauge = obs_metrics.REGISTRY.gauge(
+            "pio_replication_lag_events",
+            "events the follower trails the leader by, per writer shard",
+            labels=("shard",))
+        for rs in st["status"]:
+            k = rs["shard"]
+            ls = lst.get(k, {"cold": 0, "hot": 0})
+            key = (app_id, k)
+            # resync on a rewrite-epoch move, or when the leader's file
+            # went BACKWARDS past our prefix (restart with a torn tail)
+            with self._epochs_mu:
+                epoch_seen = self._epochs.get(key)
+            if (epoch_seen != rs["epoch"]
+                    or int(ls["cold"]) > int(rs["cold"])
+                    or int(ls["hot"]) > int(rs["hot"])):
+                self.local.replication_reset(app_id, shard=k)
+                with self._epochs_mu:
+                    self._epochs[key] = rs["epoch"]
+                ls = {"cold": 0, "hot": 0}
+            applied = 0
+            try:
+                for tier in ("cold", "hot"):
+                    at = int(ls[tier])
+                    want = int(rs[tier])
+                    while at < want and not self._stop.is_set():
+                        chunk = self.remote.replication_read(
+                            app_id, shard=k, tier=tier, from_entry=at,
+                            epoch=rs["epoch"],
+                            max_bytes=self.max_bytes)
+                        if not chunk["n_entries"]:
+                            break
+                        at = int(self.local.replication_apply(
+                            app_id, shard=k, tier=tier, from_entry=at,
+                            frames=chunk["frames"]))
+                    applied += at
+            except StorageError:
+                # epoch moved mid-pull: next poll resyncs cleanly
+                logger.info("replication epoch moved mid-pull "
+                            "(app %s shard %d)", app_id, k)
+                continue
+            gauge.labels(shard=str(k)).set(
+                max(int(rs["total"]) - applied, 0))
+
+
 class StorageServer:
     """A storage source exported over HTTP.
 
@@ -130,6 +293,7 @@ class StorageServer:
         self.client = client
         self.config = config
         self.auth_key = auth_key
+        self.replication: Optional[ReplicationTail] = None
         self._daos: Dict[Tuple[str, str], Any] = {}
         self._lock = threading.Lock()
         self._cursors: Dict[str, Any] = {}   # insertion-ordered
@@ -148,14 +312,55 @@ class StorageServer:
 
         if source:
             client, module, config = Storage._get_client(source)
-            return cls(module, client, config, host, port, auth_key)
+            srv = cls(module, client, config, host, port, auth_key)
+            srv.maybe_start_replication()
+            return srv
         # routed mode: resolve every repository's source NOW so a
         # misconfigured box refuses to start instead of failing
         # per-request after printing a healthy banner
         for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
             _ns, source_name = Storage.repository(repo)
             Storage._get_client(source_name)
-        return cls(None, None, None, host, port, auth_key)
+        srv = cls(None, None, None, host, port, auth_key)
+        srv.maybe_start_replication()
+        return srv
+
+    def maybe_start_replication(self) -> None:
+        """``PIO_REPLICATE_FROM=<leader url>`` turns this storage
+        server into an async replication FOLLOWER of that leader:
+        a daemon tail thread ships frames for the apps listed in
+        ``PIO_REPLICATE_APPS`` (comma-separated, default "1") every
+        ``PIO_REPLICATE_INTERVAL_S`` (default 0.5s), and this server
+        keeps serving reads — the scale-out/failover replica."""
+        import os
+
+        leader = os.environ.get("PIO_REPLICATE_FROM")
+        if not leader:
+            return
+        try:
+            apps = [int(a) for a in
+                    os.environ.get("PIO_REPLICATE_APPS", "1").split(",")
+                    if a.strip()]
+        except ValueError:
+            logger.error("bad PIO_REPLICATE_APPS; replication disabled")
+            return
+        try:
+            interval = float(
+                os.environ.get("PIO_REPLICATE_INTERVAL_S", "0.5"))
+        except ValueError:
+            interval = 0.5
+        # the DAO table-name prefix of the event namespace being
+        # replicated — must match the leader's EVENTDATA repository
+        # name + "_" (the default mirrors the standard repository
+        # config, PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME=pio_event)
+        prefix = os.environ.get("PIO_REPLICATE_PREFIX", "pio_event_")
+        self.replication = ReplicationTail(
+            leader, self._dao("Events", prefix), apps,
+            interval_s=interval, prefix=prefix,
+            auth_key=os.environ.get("PIO_REPLICATE_AUTHKEY"))
+        self.replication.start()
+        logger.info("replication follower: tailing %s for apps %s",
+                    leader, apps)
 
     def _dao(self, iface: str, prefix: str) -> Any:
         with self._lock:
@@ -355,6 +560,9 @@ class StorageServer:
 
     def stop(self) -> None:
         self.http.stop()
+        if self.replication is not None:
+            self.replication.stop()
+            self.replication = None
         if self.client is not None:
             self.client.close()
         # routed-mode backend clients belong to the process-global

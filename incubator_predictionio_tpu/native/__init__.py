@@ -110,6 +110,17 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pio_evlog_entry_count.argtypes = [c.c_void_p]
     lib.pio_evlog_dead_count.restype = c.c_int64
     lib.pio_evlog_dead_count.argtypes = [c.c_void_p]
+    lib.pio_evlog_file_size.restype = c.c_int64
+    lib.pio_evlog_file_size.argtypes = [c.c_void_p]
+    lib.pio_evlog_read_frames.restype = c.c_int64
+    lib.pio_evlog_read_frames.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int64, c.c_char_p, i64p]
+    lib.pio_evlog_append_frames.restype = c.c_int64
+    lib.pio_evlog_append_frames.argtypes = [c.c_void_p, c.c_char_p,
+                                            c.c_int64]
+    lib.pio_evlog_hash_ids.restype = c.c_int64
+    lib.pio_evlog_hash_ids.argtypes = [c.c_char_p, i64p, c.c_int64,
+                                       c.POINTER(c.c_uint64)]
     # columnar interaction scan ([min, max) entry range + thread count; the
     # mutex is held only for the header snapshot — see eventlog.cc)
     lib.pio_evlog_scan_interactions.restype = c.c_void_p
@@ -207,3 +218,27 @@ def fnv1a64(data: bytes) -> int:
         h ^= byte
         h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
     return h or 1
+
+
+def fnv1a64_table(blob: bytes, offsets):
+    """FNV-1a of every entry of an interned id table (blob + int64
+    offsets, the IdTable layout) in ONE native crossing — the
+    writer-shard spray hashes whole tables per batch, and a per-id
+    Python loop is ~1000x the cost of the hash itself. Returns a
+    uint64 array of len(offsets)-1; falls back to pure Python when the
+    native library is unavailable."""
+    import numpy as np
+
+    n = max(len(offsets) - 1, 0)
+    offs = np.ascontiguousarray(offsets, np.int64)
+    out = np.empty(n, np.uint64)
+    lib = load()
+    if lib is not None:
+        rc = lib.pio_evlog_hash_ids(
+            blob, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        if rc == n:
+            return out
+    for i in range(n):
+        out[i] = fnv1a64(blob[offs[i]:offs[i + 1]])
+    return out

@@ -1810,4 +1810,137 @@ int32_t pio_evlog_read(void* handle, int64_t index, uint8_t* buf,
   return (int32_t)len;
 }
 
+// ---------------------------------------------------------------------------
+// Replication frame IO: byte-level log shipping. A follower tails the
+// leader's framed byte stream — whole records only, never split — and
+// appends them verbatim, so the follower's file is bit-identical to the
+// leader's prefix: entry numbering, tombstone target indices, sidecars
+// and hashes all carry over with no re-derivation.
+
+int64_t pio_evlog_file_size(void* handle) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  fflush(log->f);
+  fseeko(log->f, 0, SEEK_END);
+  return (int64_t)ftello(log->f);
+}
+
+// Copy whole frames for entries [from_entry, ...] into buf, up to
+// max_bytes. Returns bytes copied (0 = already at the tail) and sets
+// *out_entries to the frame count. When even the FIRST frame exceeds
+// max_bytes, returns -(needed bytes) so the caller can retry with a
+// bigger buffer instead of stalling the stream forever.
+int64_t pio_evlog_read_frames(void* handle, int64_t from_entry,
+                              int64_t max_bytes, uint8_t* buf,
+                              int64_t* out_entries) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  *out_entries = 0;
+  const int64_t total = (int64_t)log->entries.size();
+  if (from_entry < 0 || from_entry > total) return -1;
+  if (from_entry == total) return 0;
+  const off_t start = (off_t)log->entries[from_entry].offset
+                      - (off_t)sizeof(RecHeader);
+  int64_t end = start;
+  int64_t n = 0;
+  for (int64_t i = from_entry; i < total; ++i) {
+    const Entry& e = log->entries[i];
+    const int64_t frame_end = (int64_t)e.offset + e.payload_len;
+    if (frame_end - start > max_bytes) break;
+    end = frame_end;
+    ++n;
+  }
+  if (n == 0) {  // first frame alone is larger than the caller's buffer
+    const Entry& e = log->entries[from_entry];
+    return -((int64_t)e.offset + e.payload_len - start);
+  }
+  fflush(log->f);
+  fseeko(log->f, start, SEEK_SET);
+  const size_t want = (size_t)(end - start);
+  const bool ok = fread(buf, 1, want, log->f) == want;
+  fseeko(log->f, 0, SEEK_END);
+  if (!ok) return -1;
+  *out_entries = n;
+  return (int64_t)want;
+}
+
+// Append a validated run of whole frames (as produced by read_frames) and
+// index them exactly as the reopen scan would. All-or-nothing: a malformed
+// buffer is rejected before any write; a failed write truncates back.
+// Returns the new entry count, or -1.
+int64_t pio_evlog_append_frames(void* handle, const uint8_t* buf,
+                                int64_t nbytes) {
+  auto* log = (EventLog*)handle;
+  std::lock_guard<std::mutex> g(log->mu);
+  // validation pass: every frame extent must land exactly on nbytes
+  int64_t pos = 0;
+  while (pos < nbytes) {
+    if (pos + (int64_t)sizeof(RecHeader) > nbytes) return -1;
+    RecHeader h;
+    memcpy(&h, buf + pos, sizeof(h));
+    pos += (int64_t)sizeof(h) + h.payload_len;
+    if (pos > nbytes) return -1;
+  }
+  if (pos != nbytes) return -1;
+  fseeko(log->f, 0, SEEK_END);
+  const off_t rec_start = ftello(log->f);
+  if (nbytes &&
+      fwrite(buf, 1, (size_t)nbytes, log->f) != (size_t)nbytes) {
+    fflush(log->f);
+    (void)!ftruncate(fileno(log->f), rec_start);
+    clearerr(log->f);
+    fseeko(log->f, 0, SEEK_END);
+    return -1;
+  }
+  fflush(log->f);
+  // index pass: mirrors the pio_evlog_open scan (tombstone targets are
+  // indices into the stream the frames came from — identical here by
+  // construction, since the follower only ever appends the leader's
+  // prefix in order)
+  pos = 0;
+  uint64_t off_base = (uint64_t)rec_start;
+  while (pos < nbytes) {
+    RecHeader h;
+    memcpy(&h, buf + pos, sizeof(h));
+    const uint64_t off = off_base + (uint64_t)pos + sizeof(h);
+    if (h.flags & kTombstone) {
+      int64_t target = -1;
+      if (h.payload_len == 8) {
+        memcpy(&target, buf + pos + sizeof(h), 8);
+        if (target >= 0 && (size_t)target < log->entries.size() &&
+            !log->entries[target].dead) {
+          log->entries[target].dead = true;
+          ++log->dead_count;
+        }
+      }
+      ++log->dead_count;  // the marker entry itself
+      log->entries.push_back({0, 0, 0, 0, 0, off, h.payload_len, h.flags,
+                              true});
+    } else {
+      log->last_time = std::max(log->last_time, h.time_ms);
+      log->entries.push_back({h.time_ms, h.etype_hash, h.eid_hash,
+                              h.name_hash, h.id_hash, off, h.payload_len,
+                              h.flags, false});
+      index_new_entry(log, (int64_t)log->entries.size() - 1);
+    }
+    pos += (int64_t)sizeof(h) + h.payload_len;
+  }
+  log->sorted_dirty = true;
+  return (int64_t)log->entries.size();
+}
+
+int64_t pio_evlog_hash_ids(const char* blob, const int64_t* offsets,
+                           int64_t n, uint64_t* out) {
+  // Batched FNV-1a over an interned id table (blob + offsets, the
+  // IdTable layout): one crossing for the whole table instead of a
+  // per-id Python hash — the writer-shard spray's hot loop.
+  if (!blob || !offsets || !out || n < 0) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t len = offsets[i + 1] - offsets[i];
+    if (len < 0) return -1;
+    out[i] = fnv1a64(blob + offsets[i], (size_t)len);
+  }
+  return n;
+}
+
 }  // extern "C"

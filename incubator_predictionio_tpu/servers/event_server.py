@@ -528,6 +528,33 @@ class EventServer:
 
         _register_post("/batch/events.json", batch_events,
                        prefer_pool=pool_ingest)
+        # the SDKs' pluralized spelling of the batch route — the SAME
+        # handler, so both spellings ride the native one-parse-per-batch
+        # fast path and book pio_ingest_batch_size identically
+        _register_post("/batches/events.json", batch_events,
+                       prefer_pool=pool_ingest)
+
+        @r.post("/reload")
+        def reload_route(request: Request) -> Response:
+            # the rolling-writer-reload seam (serving/frontdoor.py
+            # IngestFrontDoor drains this writer, POSTs here, probes,
+            # re-admits): push every buffered append to a durability
+            # point so the reloaded writer rejoins with nothing only it
+            # knows about. Safe under concurrent traffic — sync takes
+            # the storage client's own lock.
+            self._authenticate(request)
+            client = getattr(self.events, "client", None)
+            sync = getattr(client, "sync", None)
+            if sync is None:
+                # remote/memory backends: durability is the storage
+                # server's concern; the drain itself was the reload
+                return Response(200, {"message": "Reloaded",
+                                      "synced": False})
+            try:
+                sync()
+            except Exception as e:
+                return Response(500, {"message": f"sync failed: {e}"})
+            return Response(200, {"message": "Reloaded", "synced": True})
 
         @r.get("/stats.json")
         def stats_route(request: Request) -> Response:

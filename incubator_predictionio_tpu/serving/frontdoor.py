@@ -68,7 +68,7 @@ import logging
 import threading
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
-from urllib.parse import quote
+from urllib.parse import quote, urlencode
 
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
 from incubator_predictionio_tpu.obs import trace as obs_trace
@@ -480,8 +480,20 @@ class FrontDoor:
         """Place /queries.json on a worker; bounded single retry to a
         DIFFERENT worker on transport failure (idempotent — a query
         reads model state), under the overall request deadline."""
+        return await self.forward(request, "/queries.json")
+
+    async def forward(self, request: Request,
+                      upstream_path: Optional[str] = None) -> Response:
+        """Place one request on a worker under the full door
+        discipline — least-loaded pick, circuit breaker, bounded
+        token-bucket retry to a DIFFERENT worker, overall deadline.
+        The client's query string travels verbatim (accessKey auth at
+        the workers depends on it)."""
         t_start = self._clock()
         deadline = t_start + self.config.request_timeout_s
+        path = upstream_path if upstream_path is not None else request.path
+        if request.query:
+            path += "?" + urlencode(request.query)
         fwd_headers = {"Content-Type": request.headers.get(
             "content-type", "application/json")}
         prio = request.headers.get("x-pio-priority")
@@ -508,7 +520,7 @@ class FrontDoor:
             w.in_flight += 1
             try:
                 status, hdrs, body = await self._roundtrip(
-                    w, "POST", "/queries.json", fwd_headers,
+                    w, request.method, path, fwd_headers,
                     request.body, timeout)
             except (OSError, asyncio.TimeoutError,
                     asyncio.IncompleteReadError) as e:
@@ -739,3 +751,41 @@ class FrontDoor:
     def stop(self) -> None:
         self._stopping = True
         self.http.stop()
+
+
+class IngestFrontDoor(FrontDoor):
+    """The WRITE-side front door: one address spraying event POSTs
+    across N event-server writer processes (each with its own writer
+    shards in the shared log) under the exact same door discipline the
+    query door gives the read path — health-checked least-loaded
+    placement, circuit breaker, token-bucket-bounded single retry, and
+    zero-downtime rolling writer reload (``POST /reload`` drains one
+    writer at a time while its peers absorb the stream, the planet-
+    scale-ingest soak's zero-dropped-events leg).
+
+    Delivery is AT-LEAST-ONCE under retry: a transport failure after
+    the request body went out may retry an event that the dead writer
+    already committed. That is the standard ingest-pipeline contract —
+    a duplicate interaction row nudges a count, a dropped one silently
+    loses signal — and the retry budget bounds the amplification.
+    Clients that need exactly-once send their own event ids and
+    deduplicate downstream."""
+
+    #: event-ingest routes forwarded verbatim (path + query string —
+    #: accessKey auth happens at the workers). ``/batches/events.json``
+    #: is the reference's batch alias; both spellings land on the same
+    #: native one-parse-per-batch path at the event server.
+    INGEST_PATHS = ("/events.json", "/batch/events.json",
+                    "/batches/events.json")
+
+    def _build_router(self) -> Router:
+        r = super()._build_router()
+        for p in self.INGEST_PATHS:
+            r.add("POST", p, self._ingest_handler(p))
+        return r
+
+    def _ingest_handler(self, upstream_path: str):
+        async def handle(request: Request) -> Response:
+            return await self.forward(request, upstream_path)
+
+        return handle

@@ -125,6 +125,18 @@ REQUIRED_FIELDS = {
     "controller_false_triggers": (int, type(None)),
     "controller_trace_linked": (bool, type(None)),
     "controller_evaluations": (int, type(None)),
+    # planet-scale ingest leg (docs/production.md "Planet-scale
+    # ingest"): multi-writer sharded append vs single-writer in the
+    # same run, follower replication lag under sustained writes, and
+    # the front-door soak with a rolling zero-downtime writer reload.
+    # None = the leg's designed deadline-skip.
+    "ingest_qps_single": (float, type(None)),
+    "ingest_qps_sharded": (float, type(None)),
+    "ingest_shards": (int, type(None)),
+    "ingest_host_cpus": (int, type(None)),
+    "ingest_replication_lag_p99_events": (int, type(None)),
+    "ingest_soak_dropped_events": (int, type(None)),
+    "ingest_soak_staleness_held": (bool, type(None)),
     # two-stage MIPS serving leg (docs/performance.md "Two-stage MIPS
     # serving"): exhaustive-vs-two-stage per-query walls, candidates-
     # scanned fraction and the recall@20 gate at the planted large
@@ -350,6 +362,33 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
             assert rec["controller_trace_linked"] is True
         if rec["controller_decision_to_fresh_s"] is not None:
             assert rec["controller_decision_to_fresh_s"] > 0
+    # planet-scale ingest leg: when the leg ran, the sharded append is
+    # a real measurement (both qps keys positive, shard count > 1), the
+    # soak dropped ZERO events across the rolling writer reload and
+    # held the staleness bound, and the follower caught the leader. The
+    # sharded-vs-single ratio is a PARALLELISM bar: the fan-out
+    # overlaps per-shard native appends on distinct cores, so it is
+    # asserted only when the recording host had at least one core per
+    # writer shard (a 1-core CI box has no parallel headroom by
+    # construction — the record still carries both figures).
+    if rec["ingest_qps_single"] is not None:
+        assert rec["ingest_qps_single"] > 0
+        assert rec["ingest_qps_sharded"] is not None \
+            and rec["ingest_qps_sharded"] > 0
+        assert rec["ingest_shards"] is not None \
+            and rec["ingest_shards"] >= 2
+        assert rec["ingest_host_cpus"] is not None \
+            and rec["ingest_host_cpus"] >= 1
+        if rec["ingest_host_cpus"] >= rec["ingest_shards"]:
+            assert rec["ingest_qps_sharded"] \
+                >= 2.0 * rec["ingest_qps_single"], (
+                rec["ingest_qps_sharded"], rec["ingest_qps_single"])
+        if rec["ingest_soak_dropped_events"] is not None:
+            assert rec["ingest_soak_dropped_events"] == 0
+        if rec["ingest_soak_staleness_held"] is not None:
+            assert rec["ingest_soak_staleness_held"] is True
+        if rec["ingest_replication_lag_p99_events"] is not None:
+            assert rec["ingest_replication_lag_p99_events"] >= 0
     # two-stage MIPS leg: at the ≥128k planted gate size the two-stage
     # path must beat exhaustive per query while scanning ≤ 25% of the
     # catalogue at recall@20 ≥ 0.95, with ZERO steady-state recompiles;

@@ -1,0 +1,287 @@
+"""Multi-writer sharded event log (data/storage/cpplog.py).
+
+The contracts under test, per docs/production.md "Planet-scale ingest":
+
+- **Differential**: a log written through N writer shards scans
+  byte-identical to the same events written through the single-writer
+  layout — same rows, same order, same first-seen id-table blobs — at
+  shard counts {1, 2, 7}, including deletes/tombstones, and through the
+  traincache tail fold.
+- **Vector cursor**: ``tail_cursor``/``read_interactions_since`` keep
+  the 5-tuple freshness-stamp contract on sharded layouts — cursors
+  grow monotonically under appends, and segment roll, compaction, and a
+  writer reload surface as a RESET (or a cursor that compares behind),
+  exactly the speed-overlay resync trigger.
+"""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data.datamap import DataMap
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage import (
+    StorageClientConfig,
+    base,
+    cpplog,
+    traincache,
+)
+from incubator_predictionio_tpu.data.storage.base import Interactions
+from incubator_predictionio_tpu.utils.times import from_millis
+
+pytestmark = pytest.mark.skipif(
+    __import__("incubator_predictionio_tpu.native", fromlist=["load"]).load()
+    is None,
+    reason="native library unavailable",
+)
+
+SHARD_COUNTS = (1, 2, 7)
+
+SCAN_KW = dict(entity_type="user", target_entity_type="item",
+               event_names=("rate",), value_prop="rating")
+
+
+@pytest.fixture
+def make_store(tmp_path, monkeypatch):
+    """Factory: a fresh cpplog Events DAO with ``shards`` writer
+    shards under its own directory. PIO_LOG_SHARDS only applies to NEW
+    logs, so it is set around client creation per store."""
+    monkeypatch.setattr(traincache, "MIN_NNZ", 4)
+    clients = []
+
+    def build(shards: int, sub: str):
+        monkeypatch.setenv("PIO_LOG_SHARDS", str(shards))
+        d = tmp_path / sub
+        d.mkdir(exist_ok=True)
+        client = cpplog.StorageClient(
+            StorageClientConfig(properties={"PATH": str(d)}))
+        clients.append(client)
+        dao = cpplog.CppLogEvents(client, None, prefix="t_")
+        dao.init(1)
+        monkeypatch.delenv("PIO_LOG_SHARDS")
+        return dao
+
+    yield build
+    for c in clients:
+        c.close()
+
+
+def _build_log(dao, seed: int = 0, n: int = 240):
+    """Same logical stream into any layout: a columnar bulk import with
+    DISTINCT times (the byte-identity precondition — equal-time ties
+    break by unit order, which legitimately differs across layouts),
+    per-event inserts with an explicit-id pool (upsert tombstones), and
+    deletes."""
+    rng = np.random.default_rng(seed)
+    # disjoint time range per seed — repeat builds must not collide
+    times = 1000 + seed * 10_000_000 + 7 * rng.permutation(n).astype(
+        np.int64)
+    inter = Interactions(
+        user_idx=rng.integers(0, 23, n).astype(np.int32),
+        item_idx=rng.integers(0, 11, n).astype(np.int32),
+        values=(1.0 + rng.integers(0, 5, n)).astype(np.float32),
+        user_ids=[f"u{k}" for k in range(23)],
+        item_ids=[f"i{k}" for k in range(11)],
+    )
+    assert dao.import_interactions(inter, 1, times=times,
+                                   id_seed=seed + 17) == n
+    ids = []
+    for k in range(30):
+        ids.append(dao.insert(Event(
+            event="rate", entity_type="user", entity_id=f"x{k % 5}",
+            target_entity_type="item", target_entity_id=f"i{k % 4}",
+            properties=DataMap({"rating": float(k)}),
+            event_time=from_millis(900_000_000 + seed * 10_000 + 3 * k),
+            event_id=f"{k % 9:032d}",  # small pool → upsert tombstones
+        ), 1))
+    for eid in ids[::4]:
+        assert dao.delete(eid, 1)
+
+
+def _assert_byte_identical(a, b):
+    assert np.array_equal(a.user_idx, b.user_idx)
+    assert np.array_equal(a.item_idx, b.item_idx)
+    assert np.array_equal(a.values, b.values)
+    for ta, tb in ((a.user_ids, b.user_ids), (a.item_ids, b.item_ids)):
+        assert bytes(ta.blob) == bytes(tb.blob)
+        assert np.array_equal(ta.offsets, tb.offsets)
+
+
+def _scan(dao, **kw):
+    kw = {**SCAN_KW, **kw}
+    return dao.scan_interactions(app_id=1, **kw)
+
+
+# -- differential: multi-writer merge vs single-writer ---------------------
+
+@pytest.mark.parametrize("shards", SHARD_COUNTS)
+def test_multiwriter_scan_byte_identical(make_store, shards):
+    ref = make_store(1, "ref")
+    got = make_store(shards, f"sh{shards}")
+    _build_log(ref)
+    _build_log(got)
+    assert got.client.shards(got.ns, 1, None) == shards
+    _assert_byte_identical(_scan(ref, use_cache=False, seed_cache=False),
+                           _scan(got, use_cache=False, seed_cache=False))
+
+
+@pytest.mark.parametrize("shards", (2, 7))
+def test_multiwriter_identical_across_roll_and_compact(make_store, shards):
+    """Tiering (hot→cold roll) and per-segment compaction renumber
+    entries and move bytes between files — the merged scan must not
+    change by a byte relative to the plain single-writer layout."""
+    ref = make_store(1, "ref")
+    got = make_store(shards, f"sh{shards}")
+    _build_log(ref)
+    _build_log(got)
+    assert got.maybe_roll(1, limit_bytes=1) >= 1  # every hot seals
+    got.compact(1)
+    _build_log(got, seed=1, n=60)   # post-roll appends land in new hots
+    _build_log(ref, seed=1, n=60)
+    _assert_byte_identical(_scan(ref, use_cache=False, seed_cache=False),
+                           _scan(got, use_cache=False, seed_cache=False))
+
+
+@pytest.mark.parametrize("shards", (2, 7))
+def test_multiwriter_traincache_tail_fold_identical(make_store, shards):
+    """Cache seeded at import, tail appended afterwards: the warm scan
+    (cache + tail fold through the merged-cursor path) must equal the
+    cold full scan on a sharded layout."""
+    dao = make_store(shards, "warm")
+    n = 12
+    inter = Interactions(
+        user_idx=(np.arange(n, dtype=np.int32) % 5),
+        item_idx=(np.arange(n, dtype=np.int32) % 3),
+        values=np.arange(1, n + 1, dtype=np.float32),
+        user_ids=[f"u{k}" for k in range(5)],
+        item_ids=[f"i{k}" for k in range(3)],
+    )
+    assert dao.import_interactions(
+        inter, 1, times=1000 + np.arange(n, dtype=np.int64)) == n
+    for k in range(5):
+        dao.insert(Event(
+            event="rate", entity_type="user", entity_id=f"tail{k}",
+            target_entity_type="item", target_entity_id="i0",
+            properties=DataMap({"rating": 9.0 + k}),
+            event_time=from_millis(5000 + k)), 1)
+    warm = _scan(dao)
+    assert len(warm) == n + 5
+    cold = _scan(dao, use_cache=False, seed_cache=False)
+    _assert_byte_identical(warm, cold)
+
+
+# -- vector cursor contract ------------------------------------------------
+
+def _read_since(dao, cursor):
+    return dao.read_interactions_since(cursor, app_id=1, **SCAN_KW)
+
+
+def test_vector_cursor_monotonic_under_appends(make_store):
+    dao = make_store(3, "cur")
+    cur = dao.tail_cursor(app_id=1)
+    assert isinstance(cur, base.VectorCursor)
+    assert int(cur) == 0
+    seen = 0
+    for step in range(4):
+        _build_log(dao, seed=step, n=30)
+        inter, _times, append_ms, new_cur, reset = _read_since(dao, cur)
+        assert not reset
+        assert isinstance(new_cur, base.VectorCursor)
+        assert len(inter) > 0
+        assert len(append_ms) == len(inter)
+        # vector order: strictly ahead on at least one shard, behind on
+        # none (the any-behind comparison is the overlay's reset trigger)
+        assert not (new_cur < cur)
+        assert int(new_cur) > int(cur)
+        seen += len(inter)
+        cur = new_cur
+    # drained: nothing new, cursor stable
+    inter, _t, _a, again, reset = _read_since(dao, cur)
+    assert len(inter) == 0 and not reset and again == cur
+
+
+def test_vector_cursor_resets_on_compaction(make_store):
+    dao = make_store(3, "cur")
+    _build_log(dao, n=60)
+    first = _read_since(dao, base.VectorCursor(
+        (0,) * dao.client.shards(dao.ns, 1, None)))
+    cur = first[3]
+    dao.compact(1)  # tombstones drop → entries renumber → gen bumps
+    inter, _t, _a, new_cur, reset = _read_since(dao, cur)
+    assert reset, "compaction must surface as a reset"
+    assert len(inter) == 0
+    # the overlay protocol after a reset: full scan + fresh tail cursor
+    assert len(_scan(dao, use_cache=False, seed_cache=False)) > 0
+    fresh = dao.tail_cursor(app_id=1)
+    inter2, _t2, _a2, cur2, reset2 = _read_since(dao, fresh)
+    assert not reset2 and len(inter2) == 0 and cur2 == fresh
+
+
+def test_vector_cursor_resets_on_roll(make_store):
+    dao = make_store(2, "cur")
+    _build_log(dao, n=60)
+    cur = _read_since(dao, base.VectorCursor((0, 0)))[3]
+    assert dao.maybe_roll(1, limit_bytes=1) >= 1
+    _inter, _t, _a, _nc, reset = _read_since(dao, cur)
+    assert reset, "a hot→cold seal renumbers the shard; cursors resync"
+
+
+def test_writer_reload_preserves_layout_and_data(make_store, tmp_path,
+                                                 monkeypatch):
+    """A writer restart (close + reopen on the same directory) keeps
+    the shard layout pinned by the meta file, scans identically, and a
+    pre-reload cursor never silently skips events: the post-reload read
+    either resets or replays from a cursor that compares behind."""
+    dao = make_store(3, "reload")
+    _build_log(dao, n=90)
+    before = _scan(dao, use_cache=False, seed_cache=False)
+    cur = _read_since(dao, base.VectorCursor((0, 0, 0)))[3]
+    dao.client.close()
+
+    # NO PIO_LOG_SHARDS this time: the .shards meta must pin 3
+    client2 = cpplog.StorageClient(
+        StorageClientConfig(properties={"PATH": str(tmp_path / "reload")}))
+    try:
+        dao2 = cpplog.CppLogEvents(client2, None, prefix="t_")
+        assert client2.shards("t_", 1, None) == 3
+        after = _scan(dao2, use_cache=False, seed_cache=False)
+        _assert_byte_identical(before, after)
+        inter, _t, _a, new_cur, reset = _read_since(dao2, cur)
+        if not reset and len(inter) == 0:
+            # no events may be lost between the old cursor and the tail:
+            # replaying from zero must not exceed what the old cursor
+            # plus the (empty) incremental read accounts for
+            assert int(new_cur) >= int(cur) or new_cur < cur
+        full = _read_since(dao2, base.VectorCursor((0, 0, 0)))
+        assert len(full[0]) == len(after)
+    finally:
+        client2.close()
+
+
+def test_shard_spray_is_stable_per_entity(make_store):
+    """An entity's whole history lands on ONE shard (per-entity order
+    survives sharding): re-inserting the same user always routes to the
+    same segment file."""
+    dao = make_store(5, "spray")
+    sizes = {}
+    for rounds in range(3):
+        for k in range(40):
+            dao.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{k}",
+                target_entity_type="item", target_entity_id="i0",
+                properties=DataMap({"rating": 1.0}),
+                event_time=from_millis(1000 + rounds * 100 + k)), 1)
+        counts = tuple(
+            int(dao.client.lib.pio_evlog_entry_count(
+                dao.client.handle_path(dao._hot_path(1, None, s))))
+            for s in range(5))
+        if sizes:
+            prev_total = sum(sizes["counts"])
+            # growth is proportional per shard: a shard that had p% of
+            # the keys keeps getting exactly those keys
+            grown = [c - p for c, p in zip(counts, sizes["counts"])]
+            assert grown == list(sizes["delta"]), (grown, sizes)
+        else:
+            sizes["delta"] = counts
+        sizes["counts"] = counts
+    assert sum(1 for c in sizes["counts"] if c) >= 2, (
+        "40 keys over 5 shards should hit at least 2 shards")

@@ -2344,6 +2344,281 @@ def bench_controller(budget_s: float) -> dict:
     return out
 
 
+KNOB_KEYS = (
+    "knob_workers", "knob_evaluations", "knob_steps",
+    "knob_converged", "knob_recall_final", "knob_false_adjustments",
+    "knob_rollbacks", "knob_incident_ring", "knob_trace_linked",
+)
+
+
+def bench_knobs(budget_s: float) -> dict:
+    """Self-tuning serving leg (docs/production.md "Self-tuning
+    serving"): the knob controller (obs/knobs.py) in ``act`` mode over
+    a COMPRESSED timeline, actuating through the REAL fleet seam — a
+    front door fanning ``POST /knobs`` to two real worker
+    subprocesses — while a planted world model drives the signals it
+    reads.
+
+    The planted scenario, in order:
+
+    1. catalogue-growth ramp: the recall gauge sags as the planted
+       catalogue "grows" under a fixed nprobe; every doubling the
+       controller actuates claws part of it back. The controller must
+       hill-climb ``PIO_SERVE_MIPS_NPROBE`` until recall clears the
+       target again (``knob_converged``);
+    2. traffic-mix flip: queue wait jumps while latency stays under
+       the objective — the batch ladder cap must climb, and no knob
+       may reverse a direction it committed to during the ramp
+       (``knob_false_adjustments`` counts same-knob direction
+       reversals: hysteresis + cooldown exist to make this zero);
+    3. planted SLO breach INSIDE the newest step's cooldown: the burn
+       engine's breach listener must trigger the audited rollback to
+       last-known-good (``knob_rollbacks`` — exactly one), and the
+       incident bundle frozen by the same breach must carry the knob
+       decision ring (``knob_incident_ring``).
+
+    The world model reads the controller's BELIEVED vector
+    (``ctl.values()`` — belief commits only when the fan-out
+    succeeded), so the feedback loop only closes through the real
+    door→worker actuation path. ``knob_trace_linked`` holds when every
+    actuated decision's trace ID shows up on the front door's /knobs
+    HTTP span — the same cross-hop audit bar as the freshness leg.
+    Guarded like the other fleet legs: any failure nulls the knob_*
+    keys, never the record."""
+    import logging as _logging
+    import math
+    import shutil
+    import tempfile
+    import threading
+
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+    from incubator_predictionio_tpu.obs import slo as obs_slo
+    from incubator_predictionio_tpu.obs.controller import export_ring_fn
+    from incubator_predictionio_tpu.obs.knobs import (
+        KnobConfig,
+        KnobController,
+        default_knobs,
+        http_knobs_fn,
+    )
+    from incubator_predictionio_tpu.obs.recorder import (
+        FlightRecorder,
+        IncidentCapture,
+    )
+    from incubator_predictionio_tpu.serving.frontdoor import (
+        FrontDoor,
+        FrontDoorConfig,
+    )
+
+    out = dict.fromkeys(KNOB_KEYS)
+    if budget_s < 120.0:
+        log("knobs leg skipped: bench deadline too close")
+        return out
+    leg_deadline = time.monotonic() + min(
+        budget_s - 45.0,
+        float(os.environ.get("PIO_BENCH_KNOBS_TIMEOUT_S", "120")))
+
+    workers = _fleet_spawn(2, floor_ms=0.0)
+    fd = None
+    cap = None
+    inc_dir = tempfile.mkdtemp(prefix="pio_bench_knobinc_")
+    spans: list = []
+
+    class _SpanTap(_logging.Handler):
+        def emit(self, record: _logging.LogRecord) -> None:
+            try:
+                spans.append(json.loads(record.getMessage()))
+            except Exception:
+                pass
+
+    tap = _SpanTap()
+    span_logger = _logging.getLogger("pio.trace")
+    prev_level = span_logger.level
+    span_logger.addHandler(tap)
+    span_logger.setLevel(_logging.INFO)
+    try:
+        fd = FrontDoor(
+            [("127.0.0.1", p) for _proc, p in workers],
+            FrontDoorConfig(request_timeout_s=8.0,
+                            attempt_timeout_s=3.0,
+                            probe_interval_s=0.25))
+        fport = fd.start_background()
+
+        # the planted signal plane: a LOCAL registry + flight recorder
+        # carrying exactly the input series the controller consumes in
+        # production — the world model writes them, the controller only
+        # ever reads them back through the recorder's window API
+        reg = obs_metrics.Registry()
+        lat_h = reg.histogram("pio_query_latency_seconds", "planted",
+                              buckets=(0.05, 0.1, 0.25, 0.5, 1.0))
+        queue_h = reg.histogram("pio_serve_queue_wait_seconds",
+                                "planted",
+                                buckets=(0.01, 0.05, 0.1, 0.25))
+        reg.counter("pio_serve_shed_total", "planted")
+        recall_g = reg.gauge("pio_serve_mips_recall", "planted")
+        rec = FlightRecorder(registry=reg, hz=4.0, window_s=60.0)
+
+        target, margin = 0.95, 0.02
+        cooldown_s = 2.5
+        ctl = KnobController(
+            specs=default_knobs(),
+            apply_fn=http_knobs_fn(f"http://127.0.0.1:{fport}/knobs",
+                                   timeout_s=15.0),
+            recorder_fn=lambda: rec,
+            config=KnobConfig(interval_s=0.25, hysteresis_evals=2,
+                              cooldown_s=cooldown_s, window_s=8.0,
+                              ring=1024, recall_target=target,
+                              recall_margin=margin),
+            mode="act")
+
+        engine = obs_slo.SLOEngine(
+            specs=(obs_slo.SLOSpec(
+                name="serve_p99",
+                metric="pio_query_latency_seconds",
+                threshold=0.25, target=0.99,
+                description="compressed bench serving wall"),),
+            registry=reg, min_tick_interval_s=0.0,
+            export_gauges=False)
+        ctl.install(engine)
+        cap = IncidentCapture(directory=inc_dir, recorder=rec,
+                              window_s=60.0, targets_fn=lambda: [],
+                              knobs_fn=export_ring_fn(ctl))
+        cap.install(engine)
+
+        def world(phase: str, ramp: float) -> float:
+            """One tick of the planted world → current recall. The
+            catalogue ramp costs up to 0.12 recall at the default
+            nprobe; every actuated doubling buys 0.04 back (capped
+            under target+margin so a converged run never invites a
+            step-down — a reversal would be a REAL flapping bug)."""
+            nprobe = ctl.values()["PIO_SERVE_MIPS_NPROBE"]
+            recall = min(target + 0.5 * margin,
+                         0.97 - 0.12 * ramp
+                         + 0.04 * math.log2(max(nprobe, 64) / 64.0))
+            recall_g.set(recall)
+            lat_h.observe(0.4 if phase == "breach" else 0.2, 50)
+            queue_h.observe(0.15 if phase == "flip" else 0.01, 50)
+            rec.sample_now()
+            return recall
+
+        def left() -> float:
+            return leg_deadline - time.monotonic()
+
+        # phase 1: catalogue-growth ramp (6 s), then hold until the
+        # climb converges
+        recall = 0.0
+        t0 = time.monotonic()
+        while left() > 30.0:
+            recall = world("ramp", min((time.monotonic() - t0) / 6.0,
+                                       1.0))
+            ctl.evaluate_once()
+            if time.monotonic() - t0 > 7.0 and recall >= target:
+                break
+            time.sleep(0.12)
+        out["knob_recall_final"] = round(recall, 4)
+        out["knob_converged"] = bool(
+            recall >= target
+            and ctl.values()["PIO_SERVE_MIPS_NPROBE"] > 64)
+
+        # phase 2: traffic-mix flip — queue pressure with latency
+        # still under the objective; exit on the ladder-cap step
+        cap_before = ctl.values()["PIO_SERVE_MAX_BATCH"]
+        t0 = time.monotonic()
+        stepped = False
+        while left() > 20.0 and time.monotonic() - t0 < 10.0:
+            world("flip", 1.0)
+            d = ctl.evaluate_once()
+            if d.get("knob") == "max_batch" \
+                    and (d.get("outcome") or {}).get("actuated"):
+                stepped = True
+                break
+            time.sleep(0.12)
+        # a baseline burn-engine snapshot BEFORE the planted breach:
+        # the fast-window delta is measured against it
+        engine.evaluate()
+
+        # phase 3: planted breach INSIDE the fresh step's cooldown
+        if stepped:
+            t0 = time.monotonic()
+            while left() > 10.0 and time.monotonic() - t0 < 5.0:
+                world("breach", 1.0)
+                engine.evaluate()      # breach → on_breach listeners
+                d = ctl.evaluate_once()
+                if d.get("action") == "rollback":
+                    break
+                time.sleep(0.12)
+        stats = ctl.stats()
+        out["knob_workers"] = len(workers)
+        out["knob_rollbacks"] = stats["rollbacks"]
+        if stepped and stats["rollbacks"] == 1:
+            # the rollback restored the pre-step ladder cap but kept
+            # the converged MIPS climb (last-known-good is the vector
+            # the newest step departed from)
+            assert ctl.values()["PIO_SERVE_MAX_BATCH"] == cap_before
+
+        ring = list(reversed(ctl.decisions(limit=1024)))  # oldest first
+        evaluations = [d for d in ring if d.get("kind") == "evaluation"]
+        out["knob_evaluations"] = len(evaluations)
+        acted = [d for d in evaluations
+                 if (d.get("outcome") or {}).get("actuated")]
+        steps = [d for d in acted
+                 if d.get("action") in ("step_up", "step_down")]
+        out["knob_steps"] = len(steps)
+        # false adjustment = a knob stepping back against a direction
+        # it committed to earlier in the SAME run (audited rollbacks
+        # are deliberate reversals, so they don't count)
+        reversals = 0
+        last_dir: dict = {}
+        for d in steps:
+            sign = 1 if d["action"] == "step_up" else -1
+            if last_dir.get(d["knob"], sign) != sign:
+                reversals += 1
+            last_dir[d["knob"]] = sign
+        out["knob_false_adjustments"] = reversals
+        # cross-hop audit bar: every actuated decision's trace ID on
+        # the front door's /knobs HTTP span
+        if acted:
+            out["knob_trace_linked"] = all(
+                any(s.get("traceId") == d["traceId"]
+                    and s.get("span") == "http.request"
+                    and s.get("server") == "frontdoor"
+                    and s.get("route") == "/knobs"
+                    for s in spans)
+                for d in acted)
+        # the breach-frozen bundle must carry the knob decision ring
+        deadline = time.monotonic() + 10.0
+        bundle = None
+        while time.monotonic() < deadline:
+            names = [n for n in os.listdir(inc_dir)
+                     if n.endswith(".json")]
+            if names:
+                with open(os.path.join(inc_dir, sorted(names)[-1]),
+                          encoding="utf-8") as f:
+                    bundle = json.load(f)
+                break
+            time.sleep(0.25)
+        if bundle is not None:
+            out["knob_incident_ring"] = bool(
+                any(d.get("action") in ("step_up", "step_down")
+                    for d in bundle.get("knobs") or []))
+    finally:
+        span_logger.removeHandler(tap)
+        span_logger.setLevel(prev_level)
+        if cap is not None:
+            cap.stop()
+        if fd is not None:
+            fd.stop()
+        _fleet_teardown(workers)
+        shutil.rmtree(inc_dir, ignore_errors=True)
+    log(f"knobs: steps={out['knob_steps']} "
+        f"converged={out['knob_converged']} "
+        f"(recall_final={out['knob_recall_final']}) "
+        f"false_adjustments={out['knob_false_adjustments']} "
+        f"rollbacks={out['knob_rollbacks']} "
+        f"incident_ring={out['knob_incident_ring']} "
+        f"trace_linked={out['knob_trace_linked']}")
+    return out
+
+
 INGEST_KEYS = (
     "ingest_qps_single", "ingest_qps_sharded", "ingest_shards",
     "ingest_host_cpus",
@@ -3304,6 +3579,9 @@ def run_orchestrator() -> None:
         # self-driving freshness leg (controller over fleet workers +
         # front door; docs/production.md "Self-driving freshness")
         **dict.fromkeys(CONTROLLER_KEYS),
+        # self-tuning serving leg (knob controller over fleet workers +
+        # front door; docs/production.md "Self-tuning serving")
+        **dict.fromkeys(KNOB_KEYS),
         # planet-scale ingest leg (sharded writers + replication +
         # front-door soak; docs/production.md "Planet-scale ingest")
         **dict.fromkeys(INGEST_KEYS),
@@ -3446,6 +3724,13 @@ def run_orchestrator() -> None:
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"controller leg failed ({e!r}); controller_* keys null "
             "this round")
+
+    # -- 6d4. SELF-TUNING SERVING LEG (host CPU, knob controller over
+    #         fleet workers + front door; planted world model) ----------
+    try:
+        record.update(bench_knobs(emit_by - time.monotonic()))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"knobs leg failed ({e!r}); knob_* keys null this round")
 
     # -- 6e. TWO-STAGE MIPS SERVING LEG (in-process; planted catalogue
     #        past ML-20M scale, exhaustive stays the oracle) ---------------

@@ -1335,6 +1335,121 @@ class RecorderInServePath(Rule):
                         "(or nothing: the sampler already records)")
 
 
+# ---------------------------------------------------------------------------
+# 20. serving-knob mutation outside the audited apply seam
+# ---------------------------------------------------------------------------
+
+#: the registered serving-knob env surface — a LITERAL copy of
+#: obs/knobs.KNOB_ENV_VARS (rules must not import runtime modules;
+#: tests/test_knobs.py pins the two sets equal so they cannot drift)
+_KNOB_ENV_VARS = {
+    "PIO_SERVE_MIPS_NPROBE",
+    "PIO_SERVE_MIPS_CANDIDATES",
+    "PIO_SERVE_MAX_BATCH",
+    "PIO_SERVE_MAX_WAIT_MS",
+    "PIO_SERVE_SHED",
+    "PIO_SPEED_MAX_BATCH",
+}
+#: knob-backed scheduler fields (serving/scheduler.py) — assigning them
+#: on ANOTHER object's scheduler bypasses both the env seam and
+#: apply_knobs()'s lock; writes on `self` are the scheduler's own
+_KNOB_SCHED_FIELDS = {"cap", "max_batch", "wait_bound_s", "_shed"}
+#: sanctioned writer scopes: the knob controller's single audited seam
+#: (KnobController._apply), the worker/front-door /knobs handlers
+#: (both deliberately named post_knobs), and actuator factories (*_fn)
+_KNOB_SANCTIONED_DEFS = ("_apply", "post_knobs")
+
+
+class UnauditedKnobWrite(Rule):
+    name = "unaudited-knob-write"
+    severity = "error"
+    doc = ("mutation of a registered serving knob (a PIO_SERVE_*/"
+           "PIO_SPEED_MAX_BATCH env write via os.environ assignment/"
+           "setdefault/putenv, or a knob-backed scheduler field poked "
+           "on another object) outside the audited apply seam — every "
+           "knob change must flow through KnobController._apply or the "
+           "POST /knobs route handlers (post_knobs), which run it "
+           "inside a knob.decision trace and record it in the audit "
+           "ring; a knob write anywhere else is a serving-behavior "
+           "mutation nothing audited and incident rollback cannot "
+           "undo (actuator factories — *_fn functions building the "
+           "callables _apply later invokes — are the sanctioned "
+           "construction sites)")
+
+    @staticmethod
+    def _is_os_environ(mod: Module, expr: ast.AST) -> bool:
+        if (isinstance(expr, ast.Attribute) and expr.attr == "environ"
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "os"):
+            return True
+        rname = mod.resolved(expr) or ""
+        return rname == "os.environ" or rname.endswith(".os.environ")
+
+    @staticmethod
+    def _literal_knob(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Index):  # py<3.9 slice wrapper
+            expr = expr.value
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+                and expr.value in _KNOB_ENV_VARS:
+            return expr.value
+        return None
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def sanctioned(node: ast.AST) -> bool:
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    if cur.name in _KNOB_SANCTIONED_DEFS \
+                            or cur.name.endswith("_fn"):
+                        return True
+                cur = parents.get(cur)
+            return False
+
+        for node in ast.walk(mod.tree):
+            hit: Optional[str] = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and self._is_os_environ(mod, t.value):
+                        env = self._literal_knob(t.slice)
+                        if env:
+                            hit = (f"os.environ[{env!r}] write")
+                    elif isinstance(t, ast.Attribute) \
+                            and t.attr in _KNOB_SCHED_FIELDS \
+                            and not (isinstance(t.value, ast.Name)
+                                     and t.value.id == "self"):
+                        hit = (f"scheduler knob field `.{t.attr}` "
+                               "assigned on another object")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.args:
+                env = self._literal_knob(node.args[0])
+                if env and node.func.attr == "setdefault" \
+                        and self._is_os_environ(mod, node.func.value):
+                    hit = f"os.environ.setdefault({env!r}, ...)"
+                elif env and node.func.attr == "putenv":
+                    hit = f"os.putenv({env!r}, ...)"
+            if hit is None or sanctioned(node):
+                continue
+            yield mod.finding(
+                self, node,
+                f"{hit} outside the audited knob seam — route serving-"
+                "knob changes through KnobController._apply or the "
+                "POST /knobs handlers (post_knobs) so the change lands "
+                "in the audit ring under a knob.decision trace and "
+                "incident rollback can restore the last-known-good "
+                "vector")
+
+
 # whole-program (rule API v2) passes live in their own module — they
 # consume the package index, not a single Module
 from incubator_predictionio_tpu.analysis.concur import (  # noqa: E402
@@ -1361,6 +1476,7 @@ ALL_RULES: Sequence[Rule] = (
     ExhaustiveScan(),
     UnboundedRetry(),
     UnauditedActuation(),
+    UnauditedKnobWrite(),
     RecorderInServePath(),
     UnguardedSharedState(),
     ThreadLifecycle(),

@@ -368,6 +368,39 @@ def fit_capacity(records: Sequence[NormalizedRecord],
                     "mfu": _num(rec.parsed, "shard_mfu_train"),
                     "gather_modes": rec.parsed.get("shard_gather_modes"),
                 }
+    # measured ceilings for the self-tuning serving knobs
+    # (obs/knobs.py capacity_caps_fn): how far the effort knobs may
+    # climb before capacity — not tuning — becomes binding. Derived
+    # only from real measurements, with the same honesty rule as every
+    # other estimate: no usable input, no ceiling (an absent knob is
+    # simply unguarded, never guarded by a fabricated number).
+    knobs: Dict[str, int] = {}
+    mips = out.get("mips")
+    if mips and mips.get("items") and mips.get("candidates_frac") \
+            and mips.get("two_stage_per_query_ms"):
+        items = float(mips["items"])
+        measured_cand = items * float(mips["candidates_frac"])
+        per_ms = float(mips["two_stage_per_query_ms"])
+        if measured_cand > 0 and per_ms > 0:
+            # stage-2 wall scales ~linearly with the candidate count;
+            # the ceiling is the count at which the measured per-query
+            # wall would eat the whole serving objective, clamped to
+            # the catalogue itself
+            slo_ms = 1000.0 * float(
+                os.environ.get("PIO_SLO_SERVE_P99_S", "") or 0.25)
+            cap = measured_cand * (slo_ms / per_ms)
+            knobs["mips_candidates"] = int(min(cap, items))
+    fleet = out.get("fleet")
+    if fleet and fleet.get("qps") and fleet.get("workers"):
+        # Little's law: a batch larger than one worker's arrivals per
+        # objective window can never fill before its deadline
+        slo_s = float(os.environ.get("PIO_SLO_SERVE_P99_S", "") or 0.25)
+        per_worker = float(fleet["qps"]) / max(int(fleet["workers"]), 1)
+        cap = per_worker * slo_s
+        if cap >= 1:
+            knobs["max_batch"] = int(cap)
+    out["knobs"] = knobs or None
+
     rate = out["rows_per_chip_per_s"]
     qps = out["qps_per_worker"]
     projections: Dict[str, Any] = {}

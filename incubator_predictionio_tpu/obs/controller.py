@@ -806,7 +806,10 @@ def export_ring_fn(controller: "FreshnessController",
                    limit: int = 256) -> Callable[[], List[Dict[str, Any]]]:
     """Bind one controller's decision ring as an incident-capture
     ``decisions_fn`` (the admin server wires its hosted — possibly
-    injected — controller through this)."""
+    injected — controller through this). Duck-typed on
+    ``decisions(limit=)``, so the same binder also exports the knob
+    controller's ring (obs/knobs.KnobController) as the capture's
+    ``knobs_fn`` — the two control loops share one audit machinery."""
 
     def export() -> List[Dict[str, Any]]:
         return controller.decisions(limit=limit)

@@ -483,6 +483,14 @@ def _peek_controller_decisions(limit: int = 256) -> List[Dict[str, Any]]:
     return obs_controller.peek_decisions(limit=limit)
 
 
+def _peek_knob_decisions(limit: int = 256) -> List[Dict[str, Any]]:
+    """Same contract for the knob controller's ring (obs/knobs.py):
+    peek, never instantiate."""
+    from incubator_predictionio_tpu.obs import knobs as obs_knobs
+
+    return obs_knobs.peek_knob_decisions(limit=limit)
+
+
 def _recorder_url(metrics_url: str) -> str:
     """A federation target's ``/metrics`` URL → its ``/recorder`` full
     dump (same host/port; the route rides every server)."""
@@ -511,6 +519,8 @@ class IncidentCapture:
                  targets_fn: Optional[Callable[[], Sequence[Any]]] = None,
                  decisions_fn: Optional[
                      Callable[[], List[Dict[str, Any]]]] = None,
+                 knobs_fn: Optional[
+                     Callable[[], List[Dict[str, Any]]]] = None,
                  registry: Optional[obs_metrics.Registry] = None) -> None:
         d = directory if directory is not None else incident_dir()
         if not d:
@@ -535,6 +545,11 @@ class IncidentCapture:
         self._targets_fn = targets_fn
         self.decisions_fn = (decisions_fn if decisions_fn is not None
                              else _peek_controller_decisions)
+        #: the knob controller's ring (obs/knobs.py) — a second audit
+        #: trail the bundle freezes; the admin server rebinds it to its
+        #: hosted instance exactly like decisions_fn
+        self.knobs_fn = (knobs_fn if knobs_fn is not None
+                         else _peek_knob_decisions)
         reg = registry if registry is not None else obs_metrics.REGISTRY
         self._incidents_total = reg.counter(
             "pio_incidents_total",
@@ -716,6 +731,14 @@ class IncidentCapture:
         in_window = [d for d in decisions
                      if isinstance(d.get("ts"), (int, float))
                      and d["ts"] >= wall - self.window_s]
+        knob_decisions = []
+        try:
+            knob_decisions = list(self.knobs_fn() or [])
+        except Exception:
+            logger.exception("incident capture: knob ring unavailable")
+        knobs_in_window = [d for d in knob_decisions
+                           if isinstance(d.get("ts"), (int, float))
+                           and d["ts"] >= wall - self.window_s]
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(wall))
         inc_id = f"inc-{stamp}-{reason}"
         # the stamp has second resolution: two captures of one trigger
@@ -742,6 +765,11 @@ class IncidentCapture:
                 metric, threshold),
             "decisions": in_window,
             "decisionsTotal": len(decisions),
+            # the knob controller's audit trail (obs/knobs.py): what
+            # the self-tuner did in the pre-breach window — the first
+            # thing to read when a rollback fired
+            "knobs": knobs_in_window,
+            "knobsTotal": len(knob_decisions),
         }
         path = os.path.join(self.directory, f"{inc_id}.json")
         tmp = path + ".tmp"

@@ -110,6 +110,19 @@ def default_specs() -> Tuple[SLOSpec, ...]:
                 0.9999),
             kind="gauge",
             description="deployed model age under the retrain bound"),
+        SLOSpec(
+            name="repl_lag",
+            metric="pio_replication_lag_events",
+            # threshold is in EVENTS, not seconds: the worst follower
+            # of any shard may trail the primary by at most this many
+            # acked events before the promise is breached
+            threshold=_env_float("PIO_SLO_REPL_LAG", 10000.0),
+            target=min(max(
+                _env_float("PIO_SLO_REPL_LAG_TARGET", 0.99), 0.0),
+                0.9999),
+            kind="gauge",
+            description="worst-of-shard follower replication lag "
+                        "under the bound"),
     )
 
 

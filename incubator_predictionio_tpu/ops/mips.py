@@ -157,7 +157,12 @@ def _nprobe_for(index: "MIPSIndex") -> int:
     """Buckets probed per query across the whole index (the sharded
     path splits it evenly, with a small per-shard floor). The default
     1/16 of the buckets — with the balanced bucket cap (≤ 2× the mean)
-    — bounds the coarse gather at ~1/8 of the catalogue."""
+    — bounds the coarse gather at ~1/8 of the catalogue.
+
+    Knob seam: ``PIO_SERVE_MIPS_NPROBE`` is a REGISTERED serving knob
+    (obs/knobs.py) — read per call, so the knob controller's audited
+    ``POST /knobs`` env rewrite takes effect on the very next query;
+    the unaudited-knob-write lint rule pins who may write it."""
     n = _env_int("PIO_SERVE_MIPS_NPROBE", 0)
     if n <= 0:
         # 1/16 of the buckets, with a ~2048-coarse-slot floor: small
@@ -171,7 +176,12 @@ def _candidates_for(index: "MIPSIndex", k: int) -> int:
     """Exact-rerank width (pow2): wide enough that the int8 coarse
     ranking essentially never drops a true top-k row, narrow enough
     that the rerank gather + the coarse top-k cut stay a small
-    fraction of a full scan."""
+    fraction of a full scan.
+
+    Knob seam: ``PIO_SERVE_MIPS_CANDIDATES`` is a REGISTERED serving
+    knob (obs/knobs.py), read per call like nprobe — the recall/latency
+    trade the knob controller's hill-climb works against the live
+    ``pio_serve_mips_recall`` probe."""
     n = _env_int("PIO_SERVE_MIPS_CANDIDATES", 0)
     if n <= 0:
         n = 1024

@@ -8,8 +8,11 @@ Parity: tools/.../admin/AdminAPI.scala:38-160 + CommandClient.scala on
 Beyond parity, the admin process is the fleet's control-plane brain: it
 hosts the self-driving freshness controller (obs/controller.py) —
 ``GET /controller`` serves the decision audit trail, ``POST
-/controller`` is the live kill switch — alongside ``/federate``,
-``/slo`` and ``/profile``.
+/controller`` is the live kill switch — and the self-tuning knob
+controller (obs/knobs.py) behind the same pair on ``/knobs``, alongside
+``/federate``, ``/slo`` and ``/profile``. Both GET responses carry the
+``recorder``/``incident`` armed-state, so one status call shows the
+whole control plane.
 """
 
 from __future__ import annotations
@@ -33,6 +36,7 @@ if TYPE_CHECKING:  # pragma: no cover
     from incubator_predictionio_tpu.obs.controller import (
         FreshnessController,
     )
+    from incubator_predictionio_tpu.obs.knobs import KnobController
 from incubator_predictionio_tpu.utils.annotations import experimental
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
@@ -47,7 +51,8 @@ logger = logging.getLogger(__name__)
 @experimental
 class AdminServer:
     def __init__(self, ip: str = "127.0.0.1", port: int = 7071,
-                 controller: "FreshnessController" = None):
+                 controller: "FreshnessController" = None,
+                 knobs: "KnobController" = None):
         self.apps = Storage.get_meta_data_apps()
         self.access_keys = Storage.get_meta_data_access_keys()
         self.channels = Storage.get_meta_data_channels()
@@ -64,8 +69,43 @@ class AdminServer:
 
             controller = get_controller()
         self.controller = controller
+        # the self-tuning knob controller (obs/knobs.py): same hosting
+        # contract — injectable for bench harnesses, env-wired default
+        if knobs is None:
+            from incubator_predictionio_tpu.obs.knobs import (
+                get_knob_controller,
+            )
+
+            knobs = get_knob_controller()
+        self.knobs = knobs
         self.http = HttpServer.from_conf(self._build_router(), ip, port,
                                          name="admin")
+
+    @staticmethod
+    def _armed_state() -> dict:
+        """The rest of the control plane, in one glance: is the flight
+        recorder sampling, is incident capture armed? Folded into both
+        controllers' GET responses so an operator never has to infer
+        "would a breach actually freeze a bundle?" from env vars."""
+        from incubator_predictionio_tpu.obs.recorder import (
+            get_capture,
+            get_recorder,
+        )
+
+        recorder = get_recorder()
+        capture = get_capture()
+        return {
+            "recorder": {
+                "armed": recorder is not None,
+                "samples": (recorder.index()["samples"]
+                            if recorder is not None else None),
+            },
+            "incident": {
+                "armed": capture is not None,
+                "directory": (capture.directory
+                              if capture is not None else None),
+            },
+        }
 
     def _build_router(self) -> Router:
         r = Router()
@@ -147,6 +187,7 @@ class AdminServer:
                                 {"message": "limit must be an integer"})
             return Response(200, {
                 **self.controller.stats(),
+                **self._armed_state(),
                 "decisions": self.controller.decisions(limit=limit),
             })
 
@@ -168,6 +209,39 @@ class AdminServer:
                 return Response(400, {"message": str(e)})
             return Response(200, {"mode": mode,
                                   **self.controller.stats()})
+
+        @r.get("/knobs")
+        def knobs_state(request: Request) -> Response:
+            # the knob audit trail: registry state + live vector + the
+            # bounded decision ring, newest first (?limit=N)
+            try:
+                limit = int(request.query.get("limit", "50"))
+            except ValueError:
+                return Response(400,
+                                {"message": "limit must be an integer"})
+            return Response(200, {
+                **self.knobs.stats(),
+                **self._armed_state(),
+                "values": self.knobs.values(),
+                "decisions": self.knobs.decisions(limit=limit),
+            })
+
+        @r.post("/knobs")
+        def knobs_mode_route(request: Request) -> Response:
+            # the LIVE kill switch for the knob loop: {"mode": ...}
+            try:
+                body = request.json()
+            except ValueError as e:
+                return Response(400, {"message": str(e)})
+            if not isinstance(body, dict):
+                return Response(400, {
+                    "message": 'body must be a JSON object like '
+                               '{"mode": "off"|"observe"|"act"}'})
+            try:
+                mode = self.knobs.set_mode(body.get("mode", ""))
+            except ValueError as e:
+                return Response(400, {"message": str(e)})
+            return Response(200, {"mode": mode, **self.knobs.stats()})
 
         add_metrics_route(r)
         # GET /recorder: the admin's own flight-recorder window
@@ -194,6 +268,17 @@ class AdminServer:
         add_profile_route(r)
         return r
 
+    def _wire_breach_listeners(self) -> None:
+        """Arm the knob controller's incident rollback on the same
+        burn engine(s) the incident capture rides: a breach inside the
+        newest knob step's cooldown rolls the vector back."""
+        from incubator_predictionio_tpu.obs import slo as obs_slo
+
+        try:
+            self.knobs.install(obs_slo.get_engine())
+        except Exception:
+            logger.exception("knob breach listener wiring failed")
+
     def _wire_capture(self) -> None:
         """Point the incident-capture engine (if PIO_INCIDENT_DIR
         enables one) at THIS admin's hosted controller ring — an
@@ -207,21 +292,30 @@ class AdminServer:
         capture = get_capture()
         if capture is not None:
             capture.decisions_fn = export_ring_fn(self.controller)
+            # the knob ring rides the same duck-typed export seam: the
+            # bundle's "knobs" block must show the hosted controller's
+            # decisions (obs/recorder.py capture_now)
+            capture.knobs_fn = export_ring_fn(self.knobs)
 
     def start_background(self) -> int:
         port = self.http.start_background()
-        # the loop runs in every mode (an off controller idles its
-        # tick), so a live POST /controller flip to act resumes
-        # actuation within one interval with no restart
+        # the loops run in every mode (an off controller idles its
+        # tick), so a live POST /controller or /knobs flip to act
+        # resumes actuation within one interval with no restart
         self.controller.start()
+        self.knobs.start()
         self._wire_capture()
+        self._wire_breach_listeners()
         return port
 
     async def serve_forever(self) -> None:
         self.controller.start()
+        self.knobs.start()
         self._wire_capture()
+        self._wire_breach_listeners()
         await self.http.serve_forever()
 
     def stop(self) -> None:
         self.controller.stop()
+        self.knobs.stop()
         self.http.stop()

@@ -830,6 +830,41 @@ class PredictionServer:
                 self.load_models(warm_before_swap=True)
             return Response(200, {"message": "Reloaded."})
 
+        @r.post("/knobs")
+        def post_knobs(request: Request) -> Response:
+            # the worker half of the audited knob seam (obs/knobs.py):
+            # the knob controller's front-door fan-out lands here with
+            # the decision's trace headers. Every registered knob is a
+            # call-time env read, so writing the env + one scheduler
+            # refresh applies the vector without restart or drain. The
+            # unaudited-knob-write lint rule sanctions knob env writes
+            # in exactly this route (and KnobController._apply).
+            self._check_server_key(request)
+            from incubator_predictionio_tpu.obs import knobs as obs_knobs
+
+            try:
+                payload = json.loads(request.body or b"{}")
+                values = payload.get("values") or {}
+                items = {str(k): int(v) for k, v in values.items()}
+            except (ValueError, TypeError, AttributeError) as e:
+                return Response(400, {"message": f"bad knob body: {e}"})
+            unknown = sorted(set(items) - obs_knobs.KNOB_ENV_VARS)
+            if unknown:
+                # reject the WHOLE vector: a partial apply would leave
+                # the fleet on a vector no decision record describes
+                return Response(400, {
+                    "message": "unregistered knob env vars",
+                    "unknown": unknown,
+                })
+            applied = {}
+            for env, v in sorted(items.items()):
+                os.environ[env] = str(v)
+                applied[env] = v
+            scheduler = (self._batcher.apply_knobs()
+                         if self._batcher is not None else None)
+            return Response(200, {"applied": applied,
+                                  "scheduler": scheduler})
+
         @r.post("/stop")
         def stop_route(request: Request) -> Response:
             self._check_server_key(request)

@@ -664,6 +664,61 @@ class FrontDoor:
                     out["failed"].append(name)
             return out
 
+    # -- fleet knob fan-out -------------------------------------------------
+    async def knobs_fanout_async(self, body: bytes) -> Dict[str, Any]:
+        """Fan the knob controller's vector (obs/knobs.py) to every
+        worker's ``POST /knobs``, one at a time under the rolling-
+        reload serialization (the same ``_reload_lock`` — a vector
+        landing mid-swap would leave half the fleet on each setting).
+        Unlike a reload, no drain is needed: every registered knob is a
+        call-time env read, so a worker applies the vector between two
+        dispatches without dropping a query. Trace headers are captured
+        once so every worker hop lands under the ONE decision span that
+        caused the fan-out."""
+        async with self._reload_lock:
+            out: Dict[str, Any] = {"workers": len(self.workers),
+                                   "applied": 0, "failed": []}
+            key = self.config.server_key
+            path = "/knobs" + (
+                f"?accessKey={quote(key, safe='')}" if key else "")
+            knob_headers = {**obs_trace.client_headers(),
+                            "Content-Type": "application/json"}
+            results: Dict[str, Any] = {}
+            for name in [w.name for w in list(self.workers)]:
+                w = self._worker(name)
+                if w is None:
+                    out["failed"].append(name)
+                    continue
+                try:
+                    status, _hdrs, resp = await self._roundtrip(
+                        w, "POST", path, knob_headers, body,
+                        self.config.attempt_timeout_s)
+                except (OSError, asyncio.TimeoutError,
+                        asyncio.IncompleteReadError) as e:
+                    logger.warning(
+                        "front door: knob apply on %s failed (%r)",
+                        name, e)
+                    out["failed"].append(name)
+                    continue
+                if status == 200:
+                    out["applied"] += 1
+                    try:
+                        results[name] = json.loads(
+                            resp.decode("utf-8"))
+                    except ValueError:
+                        results[name] = None
+                else:
+                    # a worker that rejects the vector (bad key,
+                    # unregistered env) fails the fan-out entry but
+                    # never the door: the controller reads the outcome
+                    # and keeps its old belief
+                    logger.warning(
+                        "front door: knob apply on %s rejected "
+                        "(HTTP %s)", name, status)
+                    out["failed"].append(name)
+            out["results"] = results
+            return out
+
     def rolling_reload(self, timeout: Optional[float] = None
                        ) -> Dict[str, Any]:
         """Synchronous wrapper for callers off the loop (bench, CLI)."""
@@ -708,6 +763,14 @@ class FrontDoor:
             if denied is not None:
                 return denied
             return Response(200, await self.rolling_reload_async())
+
+        @r.post("/knobs")
+        async def post_knobs(request: Request) -> Response:
+            denied = self._check_key(request)
+            if denied is not None:
+                return denied
+            return Response(
+                200, await self.knobs_fanout_async(request.body or b""))
 
         @r.post("/fleet/join")
         async def join(request: Request) -> Response:

@@ -382,11 +382,21 @@ class BatchScheduler:
             return q.rung if q is not None else 1
 
     def stats(self) -> Dict[str, Any]:
-        """Per-engine scheduler state for /status and the tests."""
+        """Per-engine scheduler state for /status and the tests. The
+        ``knobs`` block is the worker's announcement that it honors
+        ``POST /knobs`` live refreshes (obs/knobs.py): the knob
+        controller's front-door fan-out reads it to confirm support,
+        and it carries the values currently in force."""
         with self._cv:
             return {
                 "cap": self.cap,
                 "shed": self.shed_count,
+                "knobs": {
+                    "supported": True,
+                    "waitBoundS": self.wait_bound_s,
+                    "sloS": self.slo_s,
+                    "shedEnabled": self._shed,
+                },
                 "engines": {
                     name: {"depth": len(q.items), "rung": q.rung,
                            "ewmaWallS": round(q.ewma_wall, 6)}
@@ -419,6 +429,31 @@ class BatchScheduler:
                                    if q.items else None),
                 }
             return out
+
+    def apply_knobs(self) -> Dict[str, Any]:
+        """Re-read the env-declared knobs captured at construction —
+        the ladder cap, the wait bound, the serve objective, the shed
+        toggle — and adopt them live. This is the worker-side half of
+        the audited knob seam: only the ``POST /knobs`` route
+        (servers/prediction_server.py) calls it, right after the knob
+        controller's fan-out rewrites the env, so a running scheduler
+        takes a new vector without restart. Rungs are clamped into the
+        new cap; a shrunken cap therefore takes effect on the very next
+        dispatch plan."""
+        with self._cv:
+            self.cap = ladder_cap()
+            self.max_batch = self.cap
+            self.wait_bound_s = max_wait_s()
+            self.slo_s = serve_objective_s()
+            self._shed = shed_enabled()
+            for q in self._queues.values():
+                q.rung = min(max(q.rung, 1), self.cap)
+            return {
+                "cap": self.cap,
+                "waitBoundS": self.wait_bound_s,
+                "sloS": self.slo_s,
+                "shedEnabled": self._shed,
+            }
 
     def stop(self) -> None:
         with self._cv:

@@ -127,38 +127,54 @@ def render_trace(trace_id: str, spans: List[dict],
     return text
 
 
-#: span names the freshness controller emits around an actuation —
-#: these must never appear in a trace without a controller.decision
-#: root (the decision-record contract, obs/controller.py)
-DECISION_SPAN = "controller.decision"
-ACTUATION_SPAN_PREFIX = "controller."
+#: span names the control plane emits around an actuation — an
+#: actuation-family span (``controller.*`` from the freshness
+#: controller, ``knob.*`` from the knob controller) must never appear
+#: in a trace without that family's decision root (the decision-record
+#: contract, obs/controller.py / obs/knobs.py)
+DECISION_SPANS = ("controller.decision", "knob.decision")
+ACTUATION_SPAN_PREFIXES = ("controller.", "knob.")
+
+
+def _decision_root_for(span_name: str) -> Optional[str]:
+    """The decision-root span name that sanctions ``span_name``, or
+    None when it is not an actuation-family span at all."""
+    for prefix, root in zip(ACTUATION_SPAN_PREFIXES, DECISION_SPANS):
+        if span_name.startswith(prefix):
+            return root
+    return None
 
 
 def find_decisions(traces: Dict[str, List[dict]]
                    ) -> List[Tuple[str, dict]]:
-    """(trace_id, decision span) for every controller.decision span,
-    oldest first."""
+    """(trace_id, decision span) for every controller.decision /
+    knob.decision span, oldest first."""
     out: List[Tuple[str, dict]] = []
     for tid, spans in traces.items():
         for s in spans:
-            if s.get("span") == DECISION_SPAN:
+            if s.get("span") in DECISION_SPANS:
                 out.append((tid, s))
     out.sort(key=lambda p: float(p[1].get("ts") or 0.0))
     return out
 
 
 def find_orphan_actuations(traces: Dict[str, List[dict]]) -> List[dict]:
-    """Actuation spans (controller.retrain / controller.reload / any
-    controller.* that is not the decision itself) in traces with NO
-    controller.decision span: an actuation record nothing audited."""
+    """Actuation spans (controller.retrain / controller.reload /
+    knob.apply / any controller.* or knob.* that is not the decision
+    itself) in traces with NO decision root OF THEIR OWN FAMILY: an
+    actuation record nothing audited. A knob.apply span is only
+    sanctioned by a knob.decision root — a controller.decision in the
+    same trace does not cover it."""
     orphans: List[dict] = []
     for _tid, spans in traces.items():
-        has_decision = any(s.get("span") == DECISION_SPAN for s in spans)
-        if has_decision:
-            continue
-        orphans.extend(
-            s for s in spans
-            if str(s.get("span", "")).startswith(ACTUATION_SPAN_PREFIX))
+        roots = {s.get("span") for s in spans} & set(DECISION_SPANS)
+        for s in spans:
+            name = str(s.get("span", ""))
+            root = _decision_root_for(name)
+            if root is None or name in DECISION_SPANS:
+                continue
+            if root not in roots:
+                orphans.append(s)
     orphans.sort(key=lambda s: float(s.get("ts") or 0.0))
     return orphans
 
@@ -183,13 +199,16 @@ def render_decisions(traces: Dict[str, List[dict]],
         head = (f"decision #{d.get('decisionId', '?')} "
                 f"action={d.get('action', '?')} "
                 f"reason={d.get('reason', '?')}")
+        if d.get("knob"):
+            head += f" knob={d['knob']}"
         print(head, file=out)
         render_trace(tid, traces[tid], out=out)
     orphans = find_orphan_actuations(traces)
     if orphans:
         print(f"\n!! {len(orphans)} ORPHAN ACTUATION SPAN(S) — "
-              "controller.* spans whose trace has NO decision root; "
-              "an actuation happened that nothing audited:", file=err)
+              "controller.*/knob.* spans whose trace has NO decision "
+              "root of their family; an actuation happened that "
+              "nothing audited:", file=err)
         for s in orphans:
             print(f"!!   trace={s.get('traceId')} span={s.get('span')} "
                   f"ts={s.get('ts')} "
@@ -208,11 +227,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--list", action="store_true",
                     help="one summary line per trace instead of trees")
     ap.add_argument("--decisions", action="store_true",
-                    help="freshness-controller audit view: one stitched "
-                         "tree per controller.decision root; orphan "
-                         "actuation spans (controller.* with no "
-                         "decision in their trace) surface on stderr "
-                         "with exit code 1")
+                    help="control-plane audit view: one stitched tree "
+                         "per controller.decision / knob.decision "
+                         "root; orphan actuation spans (controller.* "
+                         "or knob.* with no decision of their family "
+                         "in their trace) surface on stderr with exit "
+                         "code 1")
     args = ap.parse_args(argv)
 
     lines: List[str] = []

@@ -125,6 +125,23 @@ REQUIRED_FIELDS = {
     "controller_false_triggers": (int, type(None)),
     "controller_trace_linked": (bool, type(None)),
     "controller_evaluations": (int, type(None)),
+    # self-tuning serving leg (docs/production.md "Self-tuning
+    # serving"): the knob controller hill-climbs the MIPS effort back
+    # to the recall target under a planted catalogue-growth ramp, lifts
+    # the batch ladder under a traffic-mix flip without reversing any
+    # committed direction, and a planted breach inside the newest
+    # step's cooldown fires exactly one audited rollback whose incident
+    # bundle froze the knob decision ring. None = the leg's designed
+    # deadline-skip.
+    "knob_workers": (int, type(None)),
+    "knob_evaluations": (int, type(None)),
+    "knob_steps": (int, type(None)),
+    "knob_converged": (bool, type(None)),
+    "knob_recall_final": (float, type(None)),
+    "knob_false_adjustments": (int, type(None)),
+    "knob_rollbacks": (int, type(None)),
+    "knob_incident_ring": (bool, type(None)),
+    "knob_trace_linked": (bool, type(None)),
     # planet-scale ingest leg (docs/production.md "Planet-scale
     # ingest"): multi-writer sharded append vs single-writer in the
     # same run, follower replication lag under sustained writes, and
@@ -362,6 +379,28 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
             assert rec["controller_trace_linked"] is True
         if rec["controller_decision_to_fresh_s"] is not None:
             assert rec["controller_decision_to_fresh_s"] > 0
+    # self-tuning serving leg: when the leg ran, the knob controller
+    # converged the planted recall sag back over the target (the
+    # hill-climb promise), never reversed a committed direction (the
+    # hysteresis/cooldown promise), rolled back EXACTLY once on the
+    # planted breach with the knob ring frozen into the incident
+    # bundle, and every actuated decision's trace reached the front
+    # door's /knobs hop (the audit-trail acceptance bar).
+    if rec["knob_workers"] is not None:
+        assert rec["knob_workers"] >= 2
+        assert rec["knob_steps"] is not None \
+            and rec["knob_steps"] >= 1, rec["knob_steps"]
+        if rec["knob_converged"] is not None:
+            assert rec["knob_converged"] is True, \
+                rec["knob_recall_final"]
+        if rec["knob_false_adjustments"] is not None:
+            assert rec["knob_false_adjustments"] == 0
+        if rec["knob_trace_linked"] is not None:
+            assert rec["knob_trace_linked"] is True
+        if rec["knob_rollbacks"] is not None:
+            assert rec["knob_rollbacks"] == 1, rec["knob_rollbacks"]
+        if rec["knob_incident_ring"] is not None:
+            assert rec["knob_incident_ring"] is True
     # planet-scale ingest leg: when the leg ran, the sharded append is
     # a real measurement (both qps keys positive, shard count > 1), the
     # soak dropped ZERO events across the rolling writer reload and

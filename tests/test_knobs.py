@@ -1,0 +1,747 @@
+"""Self-tuning serving — the knob controller (obs/knobs.py).
+
+The pins, in the order the ISSUE promises them:
+
+- convergence mechanics on a FakeClock: recall-low climbs the MIPS
+  effort, hysteresis gates single noisy windows, cooldown holds a
+  stepped knob still, bounds and the capacity guard veto with named
+  reasons, one knob steps per evaluation;
+- observe-vs-act: observe records the would-step decision and touches
+  nothing;
+- incident rollback: a breach inside the newest step's cooldown rolls
+  the whole vector back to last-known-good as an audited decision,
+  then re-arms (streaks cleared, every knob cooled) so a second climb
+  + second breach produces a second rollback;
+- the audit trail: knob.decision/knob.apply spans under the decision's
+  own ``knb-`` trace ID, ``trace_stitch --decisions`` learns knob
+  roots and flags family-scoped orphans;
+- the fleet seam: ``POST /knobs`` on a REAL worker applies the vector
+  without restart (env + scheduler refresh), the front door fans the
+  vector to both real workers under the decision's trace;
+- GET/POST /knobs on the admin server with recorder/incident
+  armed-state; bounded ring; exported pio_knob_* metrics; the lint
+  rule's literal env set cannot drift from the registry's.
+"""
+
+import json
+import logging
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from incubator_predictionio_tpu.obs import knobs as knb_mod
+from incubator_predictionio_tpu.obs.knobs import (
+    KNOB_ENV_VARS,
+    KnobConfig,
+    KnobController,
+    default_knobs,
+    http_knobs_fn,
+    local_knobs_fn,
+)
+from incubator_predictionio_tpu.obs.metrics import Registry
+from incubator_predictionio_tpu.obs.recorder import FlightRecorder
+from incubator_predictionio_tpu.utils.times import FakeClock
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import trace_stitch  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# harness: a planted flight recorder (real Registry + FlightRecorder on
+# a fake clock — the controller reads exactly the window API production
+# reads) and a spied local actuator
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clean_knob_env():
+    """The local actuator writes the REAL process env (that is its
+    job); restore every registered knob env afterwards."""
+    watched = tuple(KNOB_ENV_VARS) + ("PIO_KNOBS",)
+    saved = {e: os.environ.get(e) for e in watched}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def planted_recorder(clock):
+    reg = Registry()
+    met = {
+        "lat": reg.histogram("pio_query_latency_seconds", "x",
+                             buckets=(0.05, 0.1, 0.25, 0.5, 1.0)),
+        "queue": reg.histogram("pio_serve_queue_wait_seconds", "x",
+                               buckets=(0.01, 0.05, 0.1, 0.25)),
+        "shed": reg.counter("pio_serve_shed_total", "x"),
+        "recall": reg.gauge("pio_serve_mips_recall", "x"),
+        "fold": reg.histogram("pio_freshness_fold_seconds", "x",
+                              buckets=(0.5, 1.0, 2.0, 5.0)),
+    }
+    rec = FlightRecorder(registry=reg, hz=1.0, window_s=60.0,
+                        clock=clock, wall=clock)
+    return rec, met
+
+
+def plant(rec, clock, met, lat=0.2, recall=0.97, samples=3):
+    """Write a steady window: per-interval latency observations + the
+    recall gauge, one recorder sample per simulated second.
+
+    ``lat=0.2`` is the NEUTRAL point on the planted bucket grid: its
+    per-interval p99 (~0.248s) sits under the 0.25s objective but well
+    above the 0.25*objective headroom deadband, so no latency rule
+    (tighten OR relax) fires and only the planted recall signal moves
+    knobs."""
+    met["recall"].set(recall)
+    for _ in range(samples):
+        met["lat"].observe(lat, 50)
+        rec.sample_now()
+        clock.advance(1.0)
+
+
+def make_knobs(clock, rec, hysteresis=2, cooldown=0.0, mode="act",
+               ring=64, **kw):
+    applies = []
+    local = local_knobs_fn()
+
+    def spy_apply(vector):
+        applies.append(dict(vector))
+        return local(vector)
+
+    ctl = KnobController(
+        specs=kw.pop("specs", None),
+        apply_fn=kw.pop("apply_fn", spy_apply),
+        capacity_fn=kw.pop("capacity_fn", None),
+        recorder_fn=lambda: rec,
+        config=KnobConfig(interval_s=0.05, hysteresis_evals=hysteresis,
+                          cooldown_s=cooldown, window_s=30.0,
+                          ring=ring),
+        clock=clock, mode=mode, **kw)
+    return ctl, applies
+
+
+# ---------------------------------------------------------------------------
+# the registry itself
+# ---------------------------------------------------------------------------
+
+def test_knob_env_set_matches_the_lint_rules_literal_copy():
+    """analysis/rules.py carries a literal copy of KNOB_ENV_VARS (a
+    rule must not import the runtime it audits) — pin the two sets so a
+    knob added to the registry cannot silently escape the audit."""
+    from incubator_predictionio_tpu.analysis import rules
+
+    assert set(rules._KNOB_ENV_VARS) == set(KNOB_ENV_VARS)
+    # and the registry's specs cover exactly the declared env surface
+    assert {s.env for s in default_knobs()} == set(KNOB_ENV_VARS)
+
+
+def test_spec_step_is_bounded_pow2_and_binary_toggle():
+    nprobe = default_knobs()[0]
+    assert nprobe.step(64, 1) == 128
+    assert nprobe.step(64, -1) == 32
+    assert nprobe.step(nprobe.hi, 1) == nprobe.hi        # clamped
+    assert nprobe.step(nprobe.lo, -1) == nprobe.lo
+    shed = [s for s in default_knobs() if s.scale == "binary"][0]
+    assert shed.step(0, 1) == 1
+    assert shed.step(1, -1) == 0
+
+
+# ---------------------------------------------------------------------------
+# convergence / hysteresis / cooldown / bounds / capacity
+# ---------------------------------------------------------------------------
+
+def test_healthy_window_never_steps():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec)
+    plant(rec, clock, met, recall=0.97)
+    for _ in range(5):
+        d = ctl.evaluate_once()
+        assert d["action"] == "none"
+        assert d["reason"] == "healthy"
+    assert applies == []
+    assert ctl.stats()["adjustments"] == 0
+
+
+def test_no_data_is_a_skip_not_a_step():
+    clock = FakeClock(100.0)
+    rec, _met = planted_recorder(clock)           # zero samples
+    ctl, applies = make_knobs(clock, rec)
+    d = ctl.evaluate_once()
+    assert d["reason"] == "no_data"
+    assert applies == []
+
+
+def test_recall_low_climbs_nprobe_behind_hysteresis():
+    """The convergence opening move: a recall sag desires +1 on the
+    MIPS knobs; hysteresis eats the first window, the second steps
+    mips_nprobe one pow2 rung through the audited seam."""
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec)
+    plant(rec, clock, met, recall=0.80)
+    d1 = ctl.evaluate_once()
+    assert d1["action"] == "none"
+    assert d1["reason"] == "hysteresis"
+    assert d1["knobs"]["mips_nprobe"] == {
+        "value": 64, "desire": 1, "why": "recall_low", "streak": 1,
+        "gate": "hysteresis"}
+    assert applies == []
+    d2 = ctl.evaluate_once()
+    assert d2["knob"] == "mips_nprobe"
+    assert d2["action"] == "step_up"
+    assert d2["reason"] == "recall_low"
+    assert (d2["from"], d2["to"]) == (64, 128)
+    assert d2["outcome"]["actuated"] is True
+    assert d2["outcome"]["apply"]["ok"] is True
+    # the actuator pushed the FULL vector (rollback consistency), and
+    # the call-time env seam took it live
+    assert applies == [{**ctl.values()}]
+    assert applies[0]["PIO_SERVE_MIPS_NPROBE"] == 128
+    assert os.environ["PIO_SERVE_MIPS_NPROBE"] == "128"
+    assert knb_mod._VALUE.labels(knob="mips_nprobe").value == 128.0
+    # recovery converges: recall back over target = healthy, no flap
+    plant(rec, clock, met, recall=0.97)
+    assert ctl.evaluate_once()["reason"] == "healthy"
+    assert ctl.values()["PIO_SERVE_MIPS_NPROBE"] == 128
+
+
+def test_cooldown_holds_a_stepped_knob_still():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    # single-spec registry: otherwise mips_candidates (same desire,
+    # not cooling) would rightly take the next step — coordinate
+    # descent — and mask the cooldown gate this test pins
+    ctl, applies = make_knobs(clock, rec, cooldown=120.0,
+                              specs=default_knobs()[:1])
+    plant(rec, clock, met, recall=0.80)
+    ctl.evaluate_once()                            # hysteresis
+    assert ctl.evaluate_once()["action"] == "step_up"   # 64 -> 128
+    # the sag persists: streak rebuilds, then cooldown gates the step
+    ctl.evaluate_once()                            # streak 1 again
+    d = ctl.evaluate_once()
+    assert d["reason"] == "cooldown"
+    assert d["knobs"]["mips_nprobe"]["gate"] == "cooldown"
+    assert d["knobs"]["mips_nprobe"]["cooldownRemainingS"] > 0
+    assert len(applies) == 1
+    clock.advance(121.0)
+    d = ctl.evaluate_once()                        # cooldown expired
+    assert (d["knob"], d["from"], d["to"]) == ("mips_nprobe", 128, 256)
+    assert len(applies) == 2
+
+
+def test_bound_gate_never_saturates_silently(monkeypatch):
+    monkeypatch.setenv("PIO_SERVE_MIPS_NPROBE", "4096")   # at hi
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec, hysteresis=1,
+                              specs=default_knobs()[:1])
+    plant(rec, clock, met, recall=0.80)
+    d = ctl.evaluate_once()
+    assert d["action"] == "none"
+    assert d["reason"] == "bound"
+    assert d["knobs"]["mips_nprobe"]["gate"] == "bound"
+    assert applies == []
+
+
+def test_capacity_guard_vetoes_per_knob():
+    """A fitted ceiling below the proposed step vetoes THAT knob with
+    gate="capacity"; an unguarded sibling still steps — the guard is
+    per-knob, not a global freeze."""
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec, hysteresis=1,
+                              capacity_fn=lambda: {"mips_nprobe": 100})
+    plant(rec, clock, met, recall=0.80)
+    d = ctl.evaluate_once()
+    assert d["knobs"]["mips_nprobe"]["gate"] == "capacity"
+    assert d["knobs"]["mips_nprobe"]["capacityMax"] == 100
+    # the candidate pool (same desire, no ceiling) took the step
+    assert d["knob"] == "mips_candidates"
+    assert d["action"] == "step_up"
+    assert ctl.stats()["actuators"]["capacityGuard"] is True
+    # everything capacity-gated -> reason="capacity" (runbook: add
+    # chips, the knob cannot climb its way out)
+    ctl2, applies2 = make_knobs(
+        clock, rec, hysteresis=1, specs=default_knobs()[:1],
+        capacity_fn=lambda: {"mips_nprobe": 100})
+    d = ctl2.evaluate_once()
+    assert d["reason"] == "capacity"
+    assert applies2 == []
+
+
+def test_one_knob_steps_per_evaluation():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec, hysteresis=1)
+    plant(rec, clock, met, recall=0.80)            # both MIPS knobs +1
+    d = ctl.evaluate_once()
+    assert d["knob"] == "mips_nprobe"              # registry priority
+    assert d["knobs"]["mips_nprobe"]["gate"] == "selected"
+    assert d["knobs"]["mips_candidates"]["gate"] == "queued"
+    assert len(applies) == 1
+
+
+def test_observe_mode_is_a_dry_run_and_act_resumes():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec, mode="observe")
+    plant(rec, clock, met, recall=0.80)
+    ctl.evaluate_once()
+    d = ctl.evaluate_once()
+    assert d["action"] == "step_up"                # WOULD have stepped
+    assert d["outcome"] == {"actuated": False, "dryRun": True}
+    assert applies == []
+    assert os.environ.get("PIO_SERVE_MIPS_NPROBE") is None
+    # the live flip (admin POST /knobs): the sustained desire acts on
+    # the very next evaluation — observe never reset the streak
+    ctl.set_mode("act")
+    d = ctl.evaluate_once()
+    assert d["outcome"]["actuated"] is True
+    assert len(applies) == 1
+    # both the flip and the step are in the ring
+    kinds = [r.get("kind") for r in ctl.decisions(limit=8)]
+    assert "mode_change" in kinds
+
+
+def test_apply_failure_keeps_the_old_vector_authoritative():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+
+    def bad_apply(vector):
+        raise RuntimeError("fan-out died")
+
+    ctl, _ = make_knobs(clock, rec, hysteresis=1, apply_fn=bad_apply)
+    plant(rec, clock, met, recall=0.80)
+    d = ctl.evaluate_once()
+    assert d["outcome"]["actuated"] is False
+    assert d["outcome"]["apply"]["ok"] is False
+    assert ctl.values()["PIO_SERVE_MIPS_NPROBE"] == 64   # belief held
+    # a step that never landed must not arm the rollback window
+    assert ctl.stats()["rollbackArmed"] is False
+
+
+# ---------------------------------------------------------------------------
+# incident rollback
+# ---------------------------------------------------------------------------
+
+def _climb_once(ctl, rec, clock, met):
+    plant(rec, clock, met, recall=0.80)
+    d = ctl.evaluate_once()
+    assert d["outcome"]["actuated"] is True
+    return d
+
+
+def test_breach_inside_cooldown_rolls_back_and_rearms():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec, hysteresis=1, cooldown=120.0)
+    step1 = _climb_once(ctl, rec, clock, met)      # nprobe 64 -> 128
+    assert ctl.stats()["rollbackArmed"] is True
+    before = knb_mod._ROLLBACKS.value
+    # the SLO engine's breach listener fires INSIDE the cooldown
+    ctl.on_breach({"name": "serve_p99", "objective": {}})
+    assert ctl.stats()["rollbackPending"] is True
+    d = ctl.evaluate_once()                        # the audited rollback
+    assert d["action"] == "rollback"
+    assert d["reason"] == "incident"
+    assert d["incident"] == {"slo": "serve_p99",
+                             "steppedBy": step1["id"]}
+    assert d["toVector"]["PIO_SERVE_MIPS_NPROBE"] == 64
+    assert d["outcome"]["actuated"] is True
+    assert applies[-1]["PIO_SERVE_MIPS_NPROBE"] == 64
+    assert os.environ["PIO_SERVE_MIPS_NPROBE"] == "64"
+    assert knb_mod._ROLLBACKS.value == before + 1
+    st = ctl.stats()
+    assert st["rollbacks"] == 1
+    assert st["rollbackPending"] is False
+    assert st["rollbackArmed"] is False
+    # re-arm: every knob cooled down; past the cooldown the climb
+    # restarts from scratch, and a second breach inside the SECOND
+    # step's cooldown rolls back again
+    assert ctl.evaluate_once()["reason"] == "cooldown"
+    clock.advance(121.0)
+    step2 = _climb_once(ctl, rec, clock, met)
+    assert (step2["from"], step2["to"]) == (64, 128)
+    ctl.on_breach({"name": "serve_p99"})
+    d = ctl.evaluate_once()
+    assert d["action"] == "rollback"
+    assert ctl.stats()["rollbacks"] == 2
+    assert os.environ["PIO_SERVE_MIPS_NPROBE"] == "64"
+
+
+def test_breach_outside_cooldown_is_ignored():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, _ = make_knobs(clock, rec, hysteresis=1, cooldown=30.0)
+    _climb_once(ctl, rec, clock, met)
+    clock.advance(31.0)                            # cooldown expired
+    ctl.on_breach({"name": "serve_p99"})
+    assert ctl.stats()["rollbackPending"] is False
+    # and a breach with no step at all is a no-op too
+    ctl2, _ = make_knobs(clock, rec, hysteresis=1)
+    ctl2.on_breach({"name": "serve_p99"})
+    assert ctl2.stats()["rollbackPending"] is False
+
+
+def test_rollback_in_observe_mode_is_a_dry_run():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec, hysteresis=1, cooldown=120.0)
+    _climb_once(ctl, rec, clock, met)
+    ctl.on_breach({"name": "serve_p99"})
+    ctl.set_mode("observe")
+    d = ctl.evaluate_once()
+    assert d["action"] == "rollback"
+    assert d["outcome"] == {"actuated": False, "dryRun": True}
+    assert len(applies) == 1                       # only the step
+    assert ctl.stats()["rollbackPending"] is False
+
+
+def test_incident_bundle_carries_the_knob_ring(tmp_path):
+    """IncidentCapture's knobs_fn seam: a frozen bundle records the
+    knob decisions that preceded the breach."""
+    from incubator_predictionio_tpu.obs.controller import export_ring_fn
+    from incubator_predictionio_tpu.obs.recorder import IncidentCapture
+
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, _ = make_knobs(clock, rec, hysteresis=1)
+    _climb_once(ctl, rec, clock, met)
+    cap = IncidentCapture(directory=str(tmp_path), recorder=rec,
+                          window_s=60.0, clock=clock, wall=clock,
+                          knobs_fn=export_ring_fn(ctl))
+    path = cap.capture_now("serve_p99")["path"]
+    bundle = json.loads((tmp_path / os.path.basename(path)).read_text())
+    assert bundle["knobsTotal"] >= 1               # the step decision
+    actions = [d["action"] for d in bundle["knobs"]]
+    assert "step_up" in actions
+
+
+# ---------------------------------------------------------------------------
+# the audit trail: spans + the stitcher
+# ---------------------------------------------------------------------------
+
+def _captured_spans(caplog):
+    return [json.loads(r.getMessage()) for r in caplog.records
+            if r.name == "pio.trace"]
+
+
+def test_apply_spans_land_under_the_decision_trace(caplog):
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, _ = make_knobs(clock, rec, hysteresis=1)
+    plant(rec, clock, met, recall=0.80)
+    with caplog.at_level(logging.INFO, logger="pio.trace"):
+        d = ctl.evaluate_once()
+    assert d["outcome"]["actuated"] is True
+    assert d["traceId"].startswith("knb-")
+    spans = [s for s in _captured_spans(caplog)
+             if str(s.get("span", "")).startswith("knob.")]
+    by_name = {s["span"]: s for s in spans}
+    assert set(by_name) == {"knob.decision", "knob.apply"}
+    root = by_name["knob.decision"]
+    assert root["traceId"] == d["traceId"]
+    assert root["spanId"] == d["spanId"]
+    assert root["decisionId"] == d["id"]
+    assert root["knob"] == "mips_nprobe"
+    assert by_name["knob.apply"]["traceId"] == d["traceId"]
+    assert by_name["knob.apply"]["parentSpanId"] == root["spanId"]
+
+
+def test_trace_stitch_learns_knob_decision_roots(tmp_path, caplog,
+                                                 capsys):
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, _ = make_knobs(clock, rec, hysteresis=1)
+    plant(rec, clock, met, recall=0.80)
+    with caplog.at_level(logging.INFO, logger="pio.trace"):
+        d = ctl.evaluate_once()
+    log = tmp_path / "spans.log"
+    log.write_text("\n".join(
+        r.getMessage() for r in caplog.records if r.name == "pio.trace")
+        + "\n")
+    assert trace_stitch.main([str(log), "--decisions"]) == 0
+    out = capsys.readouterr().out
+    assert f"decision #{d['id']}" in out
+    assert "knob=mips_nprobe" in out
+    assert "knob.apply" in out
+    assert d["traceId"] in out
+
+
+def test_trace_stitch_orphan_knob_span_exits_1(tmp_path, capsys):
+    log = tmp_path / "orphan.log"
+    log.write_text(json.dumps({
+        "span": "knob.apply", "traceId": "knb-orphan",
+        "spanId": "ab12cd34", "ts": 1000.0, "durationMs": 5.0,
+    }) + "\n")
+    assert trace_stitch.main([str(log), "--decisions"]) == 1
+    err = capsys.readouterr().err
+    assert "ORPHAN ACTUATION" in err
+    assert "knb-orphan" in err
+
+
+def test_trace_stitch_orphans_are_family_scoped(tmp_path, capsys):
+    """A controller.decision root does NOT sanction a knob.* span in
+    the same trace — each family needs its own decision root."""
+    log = tmp_path / "mixed.log"
+    log.write_text("\n".join(json.dumps(s) for s in (
+        {"span": "controller.decision", "traceId": "ctl-x",
+         "spanId": "aa00", "ts": 1000.0, "durationMs": 1.0,
+         "decisionId": 1, "action": "retrain+reload", "reason": "r"},
+        {"span": "knob.apply", "traceId": "ctl-x", "spanId": "bb11",
+         "ts": 1000.5, "durationMs": 1.0},
+    )) + "\n")
+    assert trace_stitch.main([str(log), "--decisions"]) == 1
+    assert "knob.apply" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the ring + metrics
+# ---------------------------------------------------------------------------
+
+def test_decision_ring_is_bounded_and_newest_first():
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, _ = make_knobs(clock, rec, ring=16)
+    plant(rec, clock, met)                         # healthy
+    for _ in range(40):
+        ctl.evaluate_once()
+    ds = ctl.decisions(limit=1000)
+    assert len(ds) == 16
+    assert ds[0]["id"] > ds[-1]["id"]
+
+
+def test_knob_metrics_exported():
+    from incubator_predictionio_tpu.obs import metrics as obs_metrics
+
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, _ = make_knobs(clock, rec, hysteresis=1)
+    before = knb_mod._EVALUATIONS.value
+    adj_before = knb_mod._ADJUSTMENTS.labels(knob="mips_nprobe").value
+    plant(rec, clock, met, recall=0.80)
+    ctl.evaluate_once()
+    assert knb_mod._EVALUATIONS.value == before + 1
+    assert knb_mod._ADJUSTMENTS.labels(knob="mips_nprobe").value == \
+        adj_before + 1
+    text = obs_metrics.REGISTRY.expose()
+    for name in ("pio_knob_evaluations_total",
+                 "pio_knob_adjustments_total",
+                 "pio_knob_rollbacks_total",
+                 "pio_knob_value"):
+        assert name in text
+
+
+# ---------------------------------------------------------------------------
+# the fleet seam: a REAL worker's POST /knobs, fanned by the front door
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def served_workers():
+    """Memory storage + trained engine + TWO real prediction servers —
+    the fleet the knob fan-out must reach."""
+    from fake_engine import AP, make_engine, params
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.servers.prediction_server import (
+        PredictionServer,
+        ServerConfig,
+    )
+    from incubator_predictionio_tpu.workflow import CoreWorkflow
+
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    engine = make_engine()
+    CoreWorkflow.run_train(engine, params(ds=9, algos=[("algo0", AP(1))]),
+                           engine_variant="knobs")
+    servers = []
+    ports = []
+    for _ in range(2):
+        ps = PredictionServer(engine, ServerConfig(
+            ip="127.0.0.1", port=0, engine_variant="knobs"))
+        servers.append(ps)
+        ports.append(ps.start_background())
+    yield servers, ports
+    for ps in servers:
+        ps.stop()
+    Storage.reset()
+
+
+def _post_json(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_worker_knobs_route_applies_without_restart(served_workers):
+    _servers, ports = served_workers
+    port = ports[0]
+    # the worker announces knob support in its /status scheduler block
+    status, info = _get_json(port, "/")
+    assert info["scheduler"]["knobs"]["supported"] is True
+    status, body = _post_json(port, "/knobs", {"values": {
+        "PIO_SERVE_MAX_BATCH": 64, "PIO_SERVE_MAX_WAIT_MS": 50}})
+    assert status == 200
+    assert body["applied"] == {"PIO_SERVE_MAX_BATCH": 64,
+                               "PIO_SERVE_MAX_WAIT_MS": 50}
+    # the scheduler refreshed live (call-time env + apply_knobs)
+    assert body["scheduler"]["cap"] == 64
+    assert body["scheduler"]["waitBoundS"] == pytest.approx(0.05)
+    assert os.environ["PIO_SERVE_MAX_BATCH"] == "64"
+    # an unregistered env rejects the WHOLE vector
+    status, body = _post_json(port, "/knobs", {"values": {
+        "PIO_SERVE_MAX_BATCH": 32, "PIO_EVIL": 1}})
+    assert status == 400
+    assert body["unknown"] == ["PIO_EVIL"]
+    assert os.environ["PIO_SERVE_MAX_BATCH"] == "64"   # untouched
+    # malformed body -> 400, not a crash
+    assert _post_json(port, "/knobs",
+                      {"values": {"PIO_SERVE_MAX_BATCH": "lots"}})[0] \
+        == 400
+
+
+def test_frontdoor_fans_the_vector_to_both_real_workers(
+        served_workers, caplog):
+    from incubator_predictionio_tpu.serving.frontdoor import (
+        FrontDoor,
+        FrontDoorConfig,
+    )
+
+    _servers, ports = served_workers
+    fd = FrontDoor([("127.0.0.1", p) for p in ports],
+                   FrontDoorConfig(probe_interval_s=0.2))
+    fport = fd.start_background()
+    try:
+        clock = FakeClock(100.0)
+        rec, met = planted_recorder(clock)
+        ctl, _ = make_knobs(
+            clock, rec, hysteresis=1,
+            apply_fn=http_knobs_fn(f"http://127.0.0.1:{fport}/knobs"))
+        plant(rec, clock, met, recall=0.80)
+        with caplog.at_level(logging.INFO, logger="pio.trace"):
+            d = ctl.evaluate_once()
+        assert d["outcome"]["actuated"] is True
+        result = d["outcome"]["apply"]["result"]
+        assert result["workers"] == 2
+        assert result["applied"] == 2
+        assert result["failed"] == []
+        # every worker applied the full vector and refreshed its
+        # scheduler — the per-worker result carries the proof
+        for res in result["results"].values():
+            assert res["applied"]["PIO_SERVE_MIPS_NPROBE"] == 128
+            assert res["scheduler"]["cap"] == 512
+        # the decision's trace crossed the door onto both workers:
+        # the door's /knobs hop + each worker's /knobs hop all carry
+        # the knb- trace ID
+        hops = [s for s in _captured_spans(caplog)
+                if s.get("traceId") == d["traceId"]
+                and s.get("route") == "/knobs"]
+        servers = {s.get("server") for s in hops}
+        assert "frontdoor" in servers
+        assert len(hops) >= 3                      # door + 2 workers
+    finally:
+        fd.stop()
+
+
+# ---------------------------------------------------------------------------
+# admin hosting: GET/POST /knobs + armed-state
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def admin_with_knobs():
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.servers.admin import AdminServer
+
+    Storage.configure({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "m",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "e",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "d",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    # a long interval: the admin-hosted loop evaluates once at start,
+    # then the tests drive evaluate_once explicitly
+    applies = []
+
+    def spy(vector):
+        applies.append(dict(vector))
+        return {"ok": True}
+
+    ctl = KnobController(
+        apply_fn=spy, recorder_fn=lambda: rec,
+        config=KnobConfig(interval_s=60.0, hysteresis_evals=2,
+                          cooldown_s=0.0, ring=64),
+        clock=clock, mode="observe")
+    ad = AdminServer(ip="127.0.0.1", port=0, knobs=ctl)
+    port = ad.start_background()
+    try:
+        yield {"port": port, "ctl": ctl, "rec": rec, "met": met,
+               "clock": clock, "applies": applies}
+    finally:
+        ad.stop()
+        knb_mod.reset_knob_controller()
+        from incubator_predictionio_tpu.obs.controller import (
+            reset_controller,
+        )
+
+        reset_controller()
+        Storage.reset()
+
+
+def test_knobs_routes_on_admin(admin_with_knobs):
+    port = admin_with_knobs["port"]
+    plant(admin_with_knobs["rec"], admin_with_knobs["clock"],
+          admin_with_knobs["met"], recall=0.80)
+    admin_with_knobs["ctl"].evaluate_once()
+    status, body = _get_json(port, "/knobs?limit=10")
+    assert status == 200
+    assert body["mode"] == "observe"
+    assert body["running"] is True         # the admin started the loop
+    assert body["values"]["PIO_SERVE_MIPS_NPROBE"] == 64
+    assert body["knobs"]["mips_nprobe"]["env"] == \
+        "PIO_SERVE_MIPS_NPROBE"
+    # the armed-state rides both controllers' GET responses
+    assert set(body["recorder"]) == {"armed", "samples"}
+    assert set(body["incident"]) == {"armed", "directory"}
+    decisions = body["decisions"]
+    assert decisions and decisions[0]["kind"] == "evaluation"
+    assert decisions[0]["traceId"].startswith("knb-")
+    status, cbody = _get_json(port, "/controller")
+    assert "recorder" in cbody and "incident" in cbody
+    # the LIVE mode flip
+    status, body = _post_json(port, "/knobs", {"mode": "act"})
+    assert status == 200 and body["mode"] == "act"
+    assert _post_json(port, "/knobs", {"mode": "sideways"})[0] == 400
+    status, body = _get_json(port, "/knobs")
+    assert body["mode"] == "act"
+    assert any(d.get("kind") == "mode_change" and d["to"] == "act"
+               for d in body["decisions"])

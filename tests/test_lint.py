@@ -489,6 +489,54 @@ def workflow_retrain_fn(engine, engine_params):
     return retrain
 """,
     ),
+    "unaudited-knob-write": (
+        """
+import os
+
+
+def emergency_widen(scheduler):
+    # knob writes OUTSIDE the audited seam: serving behavior mutates
+    # with no knob.decision record and nothing to roll back to
+    os.environ["PIO_SERVE_MIPS_NPROBE"] = "4096"
+    os.environ.setdefault("PIO_SERVE_MAX_WAIT_MS", "1000")
+    os.putenv("PIO_SERVE_SHED", "0")
+    scheduler.cap = 4096
+    scheduler.max_batch = 4096
+""",
+        """
+import os
+
+
+class KnobController:
+    def _apply(self, decision, vector):
+        # THE audited seam: trace context + ring entry wrap the write
+        for env, v in sorted(vector.items()):
+            os.environ[env] = str(v)
+
+
+def post_knobs(request, batcher):
+    # the /knobs route handlers share the sanction by name
+    os.environ["PIO_SERVE_MIPS_NPROBE"] = "128"
+    batcher.apply_knobs()
+
+
+def local_knobs_fn():
+    # actuator FACTORY (*_fn): builds the callable _apply invokes
+    def apply(vector):
+        os.environ["PIO_SERVE_MAX_BATCH"] = "512"
+        return {"local": True}
+
+    return apply
+
+
+class Batcher:
+    def apply_knobs(self):
+        # the scheduler re-reading its OWN fields on self is the
+        # refresh seam, not a bypass
+        self.cap = 512
+        self.max_batch = self.cap
+""",
+    ),
     "recorder-in-serve-path": (
         """
 from incubator_predictionio_tpu.obs import recorder as obs_recorder

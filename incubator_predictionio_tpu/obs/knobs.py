@@ -441,6 +441,28 @@ def local_knobs_fn() -> Callable[[Dict[str, int]], Dict]:
     return apply
 
 
+def _fanout_failures(result: Any) -> List[str]:
+    """Per-worker failures hidden inside a "successful" actuator call.
+    The front door's fleet ``/knobs`` answers HTTP 200 even when some
+    (or all) workers fail or reject the vector — the real outcome
+    lives in the body's ``failed`` list and ``applied`` count
+    (serving/frontdoor.py knobs_fanout_async). Any failed entry means
+    part of the fleet still serves the OLD vector, so the apply did
+    NOT succeed and the controller's belief must not advance."""
+    if not isinstance(result, dict):
+        return []
+    failed = result.get("failed")
+    if isinstance(failed, (list, tuple)) and failed:
+        return [str(w) for w in failed]
+    workers, applied = result.get("workers"), result.get("applied")
+    # local_knobs_fn reports ``applied`` as a dict — only the fleet
+    # door's int/int pair is a coverage count worth comparing
+    if isinstance(workers, int) and isinstance(applied, int) \
+            and applied < workers:
+        return [f"{workers - applied} worker(s) unapplied"]
+    return []
+
+
 def capacity_caps_fn(repo_dir: str = ".") -> Callable[
         [], Optional[Dict[str, int]]]:
     """Capacity guard from the measured fit (obs/capacity.py): the
@@ -488,12 +510,17 @@ class KnobController:
                  recorder_fn: Optional[Callable[[], Any]] = None,
                  config: Optional[KnobConfig] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 mode: Optional[str] = None) -> None:
+                 mode: Optional[str] = None,
+                 apply_scope: Optional[str] = None) -> None:
         self.specs = tuple(specs) if specs is not None \
             else default_knobs()
         self.config = config or KnobConfig.from_env()
         self._clock = clock if clock is not None else times.monotonic
         self._apply_fn = apply_fn
+        #: "fleet" | "local" | None — how far the actuator reaches
+        #: (stats() surfaces it so one status call shows whether
+        #: adjustments leave this process)
+        self._apply_scope = apply_scope
         self._capacity_fn = capacity_fn
         self._recorder_fn = recorder_fn
         self._mode_override: Optional[str] = mode
@@ -793,29 +820,36 @@ class KnobController:
         # in-flight) and is updated in place on completion, same
         # contract as the freshness controller's ring
         decision["outcome"] = {"actuated": True, "inFlight": True}
-        _ADJUSTMENTS.labels(knob=spec.name).inc()
         with self._lock:
-            self._adjustments += 1
             self._last_action = decision
             previous = dict(self._vector)
         vector = dict(previous)
         vector[spec.env] = proposed
         self._append(decision)
         self._apply(decision, vector)
+        if decision["outcome"].get("actuated"):
+            # counted AFTER the fan-out: the metric means steps that
+            # actually landed, never attempts
+            _ADJUSTMENTS.labels(knob=spec.name).inc()
         with self._lock:
-            # cooldown counts from actuation COMPLETION; the rollback
-            # arming window is the same wall, so a breach during the
-            # fan-out itself already indicts this step
-            self._streaks[spec.name] = 0
-            self._cooldowns[spec.name] = \
-                self._clock() + self.config.cooldown_s
             if decision["outcome"].get("actuated"):
+                self._adjustments += 1
+                # cooldown counts from actuation COMPLETION; the
+                # rollback arming window is the same wall, so a breach
+                # during the fan-out itself already indicts this step
+                self._streaks[spec.name] = 0
+                self._cooldowns[spec.name] = \
+                    self._clock() + self.config.cooldown_s
                 self._last_good = previous
                 self._last_change = {
                     "knob": spec.name,
                     "decisionId": decision["id"],
                     "cooldownUntil": self._cooldowns[spec.name],
                 }
+            # a FAILED apply leaves streak and cooldown untouched:
+            # the knob stays eligible and the next evaluation
+            # re-proposes the same step instead of freezing for a
+            # cooldown the fleet never earned
         return decision
 
     def _rollback(self, decision: Dict[str, Any],
@@ -844,14 +878,17 @@ class KnobController:
             self._append(decision)
             return decision
         decision["outcome"] = {"actuated": True, "inFlight": True}
-        _ROLLBACKS.inc()
         with self._lock:
-            self._rollbacks += 1
             self._last_action = decision
         self._append(decision)
         self._apply(decision, target)
+        if decision["outcome"].get("actuated"):
+            # counted on completion only — a pending rollback retried
+            # across ticks is ONE rollback, not one per attempt
+            _ROLLBACKS.inc()
         with self._lock:
             if decision["outcome"].get("actuated"):
+                self._rollbacks += 1
                 self._rollback_pending = None
                 self._last_change = None
                 self._last_good = None
@@ -883,24 +920,41 @@ class KnobController:
             t_a = time.perf_counter()
             try:
                 result = self._apply_fn(dict(vector))
-                outcome["apply"] = {
-                    "ok": True,
-                    "result": result,
-                    "wallS": round(time.perf_counter() - t_a, 3),
-                }
-                obs_trace.log_stage_span(
-                    "knob.apply", decision["traceId"],
-                    time.perf_counter() - t_a,
-                    spanId=obs_trace.new_span_id(),
-                    parentSpanId=span_id,
-                    decisionId=decision["id"],
-                    knob=decision.get("knob"))
-                with self._lock:
-                    self._vector = dict(vector)
-                for spec in self.specs:
-                    if spec.env in vector:
-                        _VALUE.labels(knob=spec.name).set(
-                            float(vector[spec.env]))
+                failed = _fanout_failures(result)
+                if failed:
+                    # a 200 from the door with workers in its
+                    # ``failed`` list is a split fleet, not a success:
+                    # keep the old belief exactly as if the call had
+                    # raised, so the next evaluation re-proposes
+                    logger.warning(
+                        "knob apply rejected by part of the fleet "
+                        "(%s) — belief held", ", ".join(failed))
+                    outcome["actuated"] = False
+                    outcome["apply"] = {
+                        "ok": False,
+                        "failed": failed,
+                        "result": result,
+                        "wallS": round(time.perf_counter() - t_a, 3),
+                    }
+                else:
+                    outcome["apply"] = {
+                        "ok": True,
+                        "result": result,
+                        "wallS": round(time.perf_counter() - t_a, 3),
+                    }
+                    obs_trace.log_stage_span(
+                        "knob.apply", decision["traceId"],
+                        time.perf_counter() - t_a,
+                        spanId=obs_trace.new_span_id(),
+                        parentSpanId=span_id,
+                        decisionId=decision["id"],
+                        knob=decision.get("knob"))
+                    with self._lock:
+                        self._vector = dict(vector)
+                    for spec in self.specs:
+                        if spec.env in vector:
+                            _VALUE.labels(knob=spec.name).set(
+                                float(vector[spec.env]))
             except Exception as e:
                 logger.exception("knob apply failed")
                 # a failed fan-out leaves the OLD vector authoritative:
@@ -979,6 +1033,7 @@ class KnobController:
                 "lastAction": self._last_action,
                 "actuators": {
                     "apply": self._apply_fn is not None,
+                    "scope": self._apply_scope,
                     "capacityGuard": self._capacity_fn is not None,
                 },
             }
@@ -1040,6 +1095,16 @@ def get_knob_controller() -> KnobController:
     with _knob_lock:
         if _knob_controller is None:
             url = os.environ.get("PIO_KNOBS_URL", "").strip()
+            if not url and knobs_mode() == "act":
+                # a forgotten URL in act mode silently tunes ONE
+                # process while the fleet serves the old vector —
+                # loud here, and visible in stats() actuators.scope
+                logger.warning(
+                    "PIO_KNOBS=act with PIO_KNOBS_URL unset: the "
+                    "knob actuator writes only THIS process's env; "
+                    "no fleet worker will see adjustments. Set "
+                    "PIO_KNOBS_URL to the front door's /knobs for "
+                    "multi-worker deployments.")
             cap_fn = capacity_caps_fn()
             if cap_fn() is None:
                 # inert guard reported honestly as absent (stats()'
@@ -1050,6 +1115,7 @@ def get_knob_controller() -> KnobController:
                     url, os.environ.get("PIO_KNOBS_KEY") or None)
                     if url else local_knobs_fn()),
                 capacity_fn=cap_fn,
+                apply_scope="fleet" if url else "local",
             )
         return _knob_controller
 

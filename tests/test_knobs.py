@@ -327,6 +327,61 @@ def test_apply_failure_keeps_the_old_vector_authoritative():
     assert ctl.stats()["rollbackArmed"] is False
 
 
+def test_fleet_200_with_failures_is_an_apply_failure():
+    """The front door's fleet /knobs answers HTTP 200 even when
+    workers fail or reject the vector — the real outcome lives in the
+    body's 'failed' list and 'applied' count. The controller must
+    read it: a partial fan-out is a split fleet, so belief, the
+    rollback baseline, cooldown and the applied-steps counters all
+    hold, and the very next evaluation re-proposes the same step."""
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    responses = [
+        # one worker explicitly failed
+        {"workers": 2, "applied": 1, "failed": ["w1"], "results": {}},
+        # coverage short of the fleet even with an empty failed list
+        {"workers": 2, "applied": 1, "failed": [], "results": {}},
+        # full coverage: the real success shape
+        {"workers": 2, "applied": 2, "failed": [], "results": {}},
+    ]
+    applies = []
+
+    def fleet_apply(vector):
+        applies.append(dict(vector))
+        return responses[len(applies) - 1]
+
+    ctl, _ = make_knobs(clock, rec, hysteresis=1, cooldown=120.0,
+                        apply_fn=fleet_apply)
+    metric_before = knb_mod._ADJUSTMENTS.labels(knob="mips_nprobe").value
+    plant(rec, clock, met, recall=0.80)
+    d = ctl.evaluate_once()
+    assert d["outcome"]["actuated"] is False
+    assert d["outcome"]["apply"]["ok"] is False
+    assert d["outcome"]["apply"]["failed"] == ["w1"]
+    assert ctl.values()["PIO_SERVE_MIPS_NPROBE"] == 64   # belief held
+    st = ctl.stats()
+    assert st["adjustments"] == 0                        # not counted
+    assert st["rollbackArmed"] is False                  # no baseline
+    assert knb_mod._ADJUSTMENTS.labels(knob="mips_nprobe").value \
+        == metric_before
+    assert knb_mod._VALUE.labels(knob="mips_nprobe").value == 64.0
+    # NO cooldown from the failed attempt: the next pass re-proposes
+    # immediately (applied < workers is also a failure) …
+    d2 = ctl.evaluate_once()
+    assert (d2["knob"], d2["to"]) == ("mips_nprobe", 128)
+    assert d2["outcome"]["actuated"] is False
+    # … and the first full-coverage fan-out commits the belief
+    d3 = ctl.evaluate_once()
+    assert d3["outcome"]["actuated"] is True
+    assert d3["outcome"]["apply"]["ok"] is True
+    assert ctl.values()["PIO_SERVE_MIPS_NPROBE"] == 128
+    st = ctl.stats()
+    assert st["adjustments"] == 1
+    assert st["rollbackArmed"] is True
+    assert knb_mod._ADJUSTMENTS.labels(knob="mips_nprobe").value \
+        == metric_before + 1
+
+
 # ---------------------------------------------------------------------------
 # incident rollback
 # ---------------------------------------------------------------------------
@@ -388,6 +443,46 @@ def test_breach_outside_cooldown_is_ignored():
     ctl2, _ = make_knobs(clock, rec, hysteresis=1)
     ctl2.on_breach({"name": "serve_p99"})
     assert ctl2.stats()["rollbackPending"] is False
+
+
+def test_failed_rollback_stays_pending_and_counts_once():
+    """A fan-out failure during the rollback itself leaves the
+    rollback PENDING (the fleet is on a known-bad vector — the next
+    tick must retry, not abandon), and the rollback counters advance
+    only when the restore actually lands — one rollback, however many
+    attempts it took."""
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    local = local_knobs_fn()
+    failing = {"on": False}
+
+    def flaky_apply(vector):
+        if failing["on"]:
+            raise RuntimeError("fan-out died")
+        return local(vector)
+
+    ctl, _ = make_knobs(clock, rec, hysteresis=1, cooldown=120.0,
+                        apply_fn=flaky_apply)
+    _climb_once(ctl, rec, clock, met)              # nprobe 64 -> 128
+    before = knb_mod._ROLLBACKS.value
+    ctl.on_breach({"name": "serve_p99"})
+    failing["on"] = True
+    d = ctl.evaluate_once()
+    assert d["action"] == "rollback"
+    assert d["outcome"]["actuated"] is False
+    st = ctl.stats()
+    assert st["rollbackPending"] is True           # retried next tick
+    assert st["rollbacks"] == 0                    # attempt ≠ rollback
+    assert knb_mod._ROLLBACKS.value == before
+    failing["on"] = False
+    d = ctl.evaluate_once()                        # the retry lands
+    assert d["action"] == "rollback"
+    assert d["outcome"]["actuated"] is True
+    assert os.environ["PIO_SERVE_MIPS_NPROBE"] == "64"
+    st = ctl.stats()
+    assert st["rollbackPending"] is False
+    assert st["rollbacks"] == 1
+    assert knb_mod._ROLLBACKS.value == before + 1
 
 
 def test_rollback_in_observe_mode_is_a_dry_run():
@@ -666,6 +761,38 @@ def test_frontdoor_fans_the_vector_to_both_real_workers(
         assert len(hops) >= 3                      # door + 2 workers
     finally:
         fd.stop()
+
+
+def test_local_fallback_in_act_mode_warns_and_names_its_scope(caplog):
+    """PIO_KNOBS=act with PIO_KNOBS_URL unset tunes only the admin
+    process's own env — the factory warns loudly at wire-up and
+    stats() names the actuator scope, so one status call shows
+    whether adjustments ever leave the process."""
+    saved_url = os.environ.pop("PIO_KNOBS_URL", None)
+    knb_mod.reset_knob_controller()
+    os.environ["PIO_KNOBS"] = "act"
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="incubator_predictionio_tpu"
+                                    ".obs.knobs"):
+            ctl = knb_mod.get_knob_controller()
+        assert ctl.stats()["actuators"]["scope"] == "local"
+        assert any("PIO_KNOBS_URL" in r.getMessage()
+                   for r in caplog.records)
+        # with the URL set, the scope is the fleet and nothing warns
+        knb_mod.reset_knob_controller()
+        caplog.clear()
+        os.environ["PIO_KNOBS_URL"] = "http://127.0.0.1:1/knobs"
+        ctl = knb_mod.get_knob_controller()
+        assert ctl.stats()["actuators"]["scope"] == "fleet"
+        assert not any("PIO_KNOBS_URL" in r.getMessage()
+                       for r in caplog.records)
+    finally:
+        knb_mod.reset_knob_controller()
+        if saved_url is None:
+            os.environ.pop("PIO_KNOBS_URL", None)
+        else:
+            os.environ["PIO_KNOBS_URL"] = saved_url
 
 
 # ---------------------------------------------------------------------------

@@ -1301,6 +1301,155 @@ def bench_mips(budget_s: float) -> dict:
     return out
 
 
+#: catalogue-at-tens-of-millions leg (docs/performance.md "Catalogue at
+#: tens of millions"): the ≥10M-item lifecycle under PQ residual codes.
+#: The recall@20 gate must hold at PQ bytes-per-item, the serving p99
+#: measured WHILE a background rebuild-and-swap folds a planted churn
+#: tail must stay ≤1.5× the quiet baseline (``mips_rebuild_p99_flat_x``),
+#: ``mips_index_age_max_s`` is the worst index age observed across that
+#: churn cycle, and ``mips_device_bytes_per_item`` is the capacity
+#: model's sizing key (table f32 rerank rows + quantized coarse views +
+#: index bookkeeping). None = deadline/budget skip — the default cost
+#: model always skips on the 1-core CI box; give the leg a real box via
+#: PIO_BENCH_MIPS_BIG_ITEMS / PIO_BENCH_MIPS_BIG_TIMEOUT_S.
+MIPS_BIG_KEYS = (
+    "mips_big_items", "mips_big_build_s", "mips_big_recall_at_20",
+    "mips_big_two_stage_p50_ms", "mips_rebuild_p99_flat_x",
+    "mips_index_age_max_s", "mips_device_bytes_per_item",
+)
+
+
+def bench_mips_big(budget_s: float) -> dict:
+    """≥10M-item MIPS lifecycle leg: PQ build, recall gate, then serve
+    a query loop WHILE ``rebuild_index`` re-clusters and swaps under a
+    planted churn tail — the flat-p99-through-rebuild claim. Budget-
+    guarded like every host leg: a squeeze nulls keys, never the
+    record."""
+    out = dict.fromkeys(MIPS_BIG_KEYS)
+    n_big = int(os.environ.get("PIO_BENCH_MIPS_BIG_ITEMS", "10000000"))
+    rank = int(os.environ.get("PIO_BENCH_MIPS_RANK", "64"))
+    n_q = int(os.environ.get("PIO_BENCH_MIPS_QUERIES", "32"))
+    if n_big < 1_000_000:
+        log("mips big leg disabled (PIO_BENCH_MIPS_BIG_ITEMS < 1M)")
+        return out
+    # cost model for the CI box: sample-kmeans + chunked assignment +
+    # PQ train/encode scale ~linearly with the catalogue, and the
+    # rebuild pays it a second time
+    est_s = 90.0 + 180.0 * n_big / 1_000_000.0
+    leg_deadline = time.monotonic() + min(
+        budget_s - 20.0,
+        float(os.environ.get("PIO_BENCH_MIPS_BIG_TIMEOUT_S", "300")))
+    if time.monotonic() + est_s > leg_deadline:
+        log(f"mips big leg skipped: needs ~{est_s:.0f}s, "
+            "deadline too close")
+        return out
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops import mips as mips_mod
+    from incubator_predictionio_tpu.ops import topk
+    from incubator_predictionio_tpu.utils.planted import (
+        exhaustive_top_k,
+        planted_item_factors,
+        planted_queries,
+        recall_against_oracle,
+    )
+
+    saved = {k: os.environ.get(k)
+             for k in ("PIO_SERVE_MIPS", "PIO_SERVE_MIPS_QUANT")}
+    os.environ["PIO_SERVE_MIPS"] = "on"
+    os.environ["PIO_SERVE_MIPS_QUANT"] = "pq"
+
+    def _timed(q) -> float:
+        t0 = time.perf_counter()
+        np.asarray(topk.score_and_top_k(q, table, k=20))
+        return (time.perf_counter() - t0) * 1e3
+
+    try:
+        vf = planted_item_factors(n_big, rank, seed=11)
+        queries = [jnp.asarray(q) for q in
+                   planted_queries(vf, n_q, seed=5)]
+        oracle = exhaustive_top_k(
+            vf, np.stack([np.asarray(q) for q in queries]), 20)
+        table = jax.device_put(vf)
+        t0 = time.perf_counter()
+        index = mips_mod.build_index(table, n_big, seed=11,
+                                     host_factors=vf)
+        build_s = time.perf_counter() - t0
+        log(f"mips big: built {n_big} items (pq m={index.pq_m}) "
+            f"in {build_s:.1f}s")
+
+        _timed(queries[0])                          # warm
+        base = np.asarray([_timed(q) for q in queries])
+        got = np.stack([
+            np.asarray(topk.score_and_top_k(q, table, k=20))[1]
+            .astype(np.int64) for q in queries])
+        recall, _worst = recall_against_oracle(got, oracle, 20)
+
+        # planted churn past the fold-out point, then serve THROUGH the
+        # background rebuild-and-swap
+        churn = planted_queries(vf, 256, seed=9)
+        mips_mod.publish_rows(table, churn)
+        walls: list = []
+        ages: list = []
+
+        def _sample_age() -> None:
+            idx = mips_mod.index_for(table)
+            if idx is not None:
+                ages.append(mips_mod._now() - idx.built_at)
+
+        reb = threading.Thread(
+            target=lambda: mips_mod.rebuild_index(table, trigger="tail"),
+            daemon=True)
+        reb.start()
+        i = 0
+        while reb.is_alive() and time.monotonic() < leg_deadline:
+            walls.append(_timed(queries[i % n_q]))
+            _sample_age()
+            i += 1
+        reb.join(timeout=max(leg_deadline - time.monotonic(), 1.0))
+        for j in range(8):                          # post-swap tail
+            walls.append(_timed(queries[j % n_q]))
+            _sample_age()
+
+        p99_base = float(np.quantile(base, 0.99))
+        p99_reb = (float(np.quantile(np.asarray(walls), 0.99))
+                   if walls else p99_base)
+        new = mips_mod.index_for(table)
+        dev_bytes = int(np.asarray(table).nbytes)
+        for arr in (new.codes, new.scales, new.bf16, new.pq_codes,
+                    new.pq_books, new.centroids, new.cmax,
+                    new.crad_cos, new.crad_sin, new.members, new.ext):
+            if arr is not None:
+                dev_bytes += int(arr.nbytes)
+        out.update({
+            "mips_big_items": n_big,
+            "mips_big_build_s": round(build_s, 2),
+            "mips_big_recall_at_20": round(recall, 4),
+            "mips_big_two_stage_p50_ms": round(
+                float(np.quantile(base, 0.5)), 3),
+            "mips_rebuild_p99_flat_x": round(
+                p99_reb / max(p99_base, 1e-9), 3),
+            "mips_index_age_max_s": (round(float(max(ages)), 3)
+                                     if ages else None),
+            "mips_device_bytes_per_item": round(dev_bytes / n_big, 2),
+        })
+        log(f"mips big {n_big}: recall {recall:.3f}, rebuild p99 "
+            f"{out['mips_rebuild_p99_flat_x']}x flat, "
+            f"{out['mips_device_bytes_per_item']} device B/item")
+        mips_mod.unregister_index(table)
+        del table, vf, queries, index
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 #: serving-fleet leg (docs/production.md "Serving fleet"): the
 #: continuous-batching request plane measured across REAL worker
 #: processes — goodput burst (real kernels, no floor) for the capacity
@@ -3570,6 +3719,10 @@ def run_orchestrator() -> None:
         # forced-host-device CPU sim; docs/performance.md "Sharded ALS")
         **dict.fromkeys(SHARD_KEYS),
         **dict.fromkeys(MIPS_KEYS),
+        # ≥10M-item MIPS lifecycle leg (in-process; PQ + background
+        # rebuild-and-swap; docs/performance.md "Catalogue at tens of
+        # millions")
+        **dict.fromkeys(MIPS_BIG_KEYS),
         # serving-fleet leg (parent-side worker subprocesses;
         # docs/production.md "Serving fleet")
         **dict.fromkeys(FLEET_KEYS),
@@ -3738,6 +3891,15 @@ def run_orchestrator() -> None:
         record.update(bench_mips(emit_by - time.monotonic()))
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"mips leg failed ({e!r}); mips_* keys null this round")
+
+    # -- 6e2. MIPS CATALOGUE-AT-SCALE LEG (in-process; ≥10M items under
+    #         PQ with a background rebuild-and-swap mid-serve; skips on
+    #         budget via its own cost model — the 1-core box never pays
+    #         for it by accident) --------------------------------------
+    try:
+        record.update(bench_mips_big(emit_by - time.monotonic()))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"mips big leg failed ({e!r}); mips_big_* keys null")
 
     # -- 6f. PLANET-SCALE INGEST LEG (host CPU; sharded writers vs
     #        single-writer in the same run, replication lag, front-door

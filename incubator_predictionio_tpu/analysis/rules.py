@@ -1349,6 +1349,10 @@ _KNOB_ENV_VARS = {
     "PIO_SERVE_MAX_WAIT_MS",
     "PIO_SERVE_SHED",
     "PIO_SPEED_MAX_BATCH",
+    "PIO_SERVE_MIPS_PQ_M",
+    "PIO_SERVE_MIPS_PQ_CANDIDATES",
+    "PIO_MIPS_REBUILD_TAIL",
+    "PIO_MIPS_REBUILD_AGE_S",
 }
 #: knob-backed scheduler fields (serving/scheduler.py) — assigning them
 #: on ANOTHER object's scheduler bypasses both the env seam and

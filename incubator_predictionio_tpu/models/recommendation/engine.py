@@ -557,7 +557,8 @@ class ALSAlgorithm(Algorithm):
             mips.build_index(model.item_factors, n_items,
                              seed=self.params.seed or 0,
                              host_factors=host_factors,
-                             probe_recall=True)
+                             probe_recall=True,
+                             engine="recommendation")
         except Exception:  # index is an optimization, never a failure
             logger.exception("MIPS index build failed; serving stays "
                              "exhaustive")

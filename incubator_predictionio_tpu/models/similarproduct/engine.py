@@ -271,7 +271,8 @@ class SimilarProductAlgorithm(Algorithm):
         try:
             mips.build_index(model.item_factors_norm, n_items,
                              seed=self.params.seed or 0,
-                             probe_recall=True)
+                             probe_recall=True,
+                             engine="similarproduct")
         except Exception:  # index is an optimization, never a failure
             logger.exception("MIPS index build failed; similarproduct "
                              "serving stays exhaustive")

@@ -279,6 +279,7 @@ def fit_capacity(records: Sequence[NormalizedRecord],
         "shard": None,
         "fleet": None,
         "mips": None,
+        "mips_big": None,
         "projections": {},
     }
     benches = [r for r in records if r.kind == "bench"
@@ -339,6 +340,28 @@ def fit_capacity(records: Sequence[NormalizedRecord],
                     "serve_qps_bound": _num(
                         rec.parsed, "mips_serve_qps"),
                 }
+        # catalogue-at-scale leg (≥10M items under PQ + background
+        # rebuild): the sizing figures a tens-of-millions catalogue is
+        # planned from — device bytes/item and the flat-p99-through-
+        # rebuild ratio (docs/performance.md "Catalogue at tens of
+        # millions")
+        if out.get("mips_big") is None and not rec.degraded:
+            bi = _num(rec.parsed, "mips_big_items")
+            if bi:
+                out["mips_big"] = {
+                    "source_record": rec.name,
+                    "items": int(bi),
+                    "recall_at_20": _num(
+                        rec.parsed, "mips_big_recall_at_20"),
+                    "two_stage_per_query_ms": _num(
+                        rec.parsed, "mips_big_two_stage_p50_ms"),
+                    "rebuild_p99_flat_x": _num(
+                        rec.parsed, "mips_rebuild_p99_flat_x"),
+                    "index_age_max_s": _num(
+                        rec.parsed, "mips_index_age_max_s"),
+                    "device_bytes_per_item": _num(
+                        rec.parsed, "mips_device_bytes_per_item"),
+                }
         # same degraded-round guard as the qps fit above: a degraded
         # round's fleet leg ran on a box no production worker resembles
         if out.get("fleet") is None and not rec.degraded:
@@ -390,6 +413,19 @@ def fit_capacity(records: Sequence[NormalizedRecord],
                 os.environ.get("PIO_SLO_SERVE_P99_S", "") or 0.25)
             cap = measured_cand * (slo_ms / per_ms)
             knobs["mips_candidates"] = int(min(cap, items))
+    big = out.get("mips_big")
+    if big and big.get("items") and big.get("two_stage_per_query_ms"):
+        # PQ exact-rerank width ceiling: the big leg measures the
+        # per-query wall at the DEFAULT PQ width (2048), and the
+        # stage-2 wall scales ~linearly with it — same model as
+        # mips_candidates, but measured at catalogue scale under PQ
+        per_ms = float(big["two_stage_per_query_ms"])
+        if per_ms > 0:
+            slo_ms = 1000.0 * float(
+                os.environ.get("PIO_SLO_SERVE_P99_S", "") or 0.25)
+            cap = 2048.0 * (slo_ms / per_ms)
+            knobs["mips_pq_candidates"] = int(
+                min(cap, float(big["items"])))
     fleet = out.get("fleet")
     if fleet and fleet.get("qps") and fleet.get("workers"):
         # Little's law: a batch larger than one worker's arrivals per

@@ -87,6 +87,10 @@ KNOB_ENV_VARS = frozenset((
     "PIO_SERVE_MAX_WAIT_MS",
     "PIO_SERVE_SHED",
     "PIO_SPEED_MAX_BATCH",
+    "PIO_SERVE_MIPS_PQ_M",
+    "PIO_SERVE_MIPS_PQ_CANDIDATES",
+    "PIO_MIPS_REBUILD_TAIL",
+    "PIO_MIPS_REBUILD_AGE_S",
 ))
 
 #: bounded reason enums — decision records and docs draw from these
@@ -96,7 +100,7 @@ SKIP_REASONS = ("off", "observe", "healthy", "no_data", "hysteresis",
                 "inputs_error")
 ACTION_REASONS = ("recall_low", "latency_high", "queue_high",
                   "latency_headroom", "shed_active", "fold_lag",
-                  "incident")
+                  "incident", "tail_high", "index_stale")
 
 _EVALUATIONS = obs_metrics.REGISTRY.counter(
     "pio_knob_evaluations_total",
@@ -121,6 +125,8 @@ INPUT_SERIES = (
     "pio_serve_shed_total",
     "pio_serve_mips_recall",
     "pio_freshness_fold_seconds",
+    "pio_mips_tail_size",
+    "pio_mips_index_age_seconds",
 )
 
 
@@ -312,10 +318,61 @@ def _decide_foldin(value: int, inputs: Dict[str, Any],
     return 0, None
 
 
+def _decide_pq_m(value: int, inputs: Dict[str, Any],
+                 ctx: Dict[str, float]) -> Tuple[int, Optional[str]]:
+    """PQ subquantizer count: more subspaces = finer residual codes =
+    better coarse ranking, at M bytes/item. Defend the recall floor
+    only — M is a BUILD-time knob (takes effect at the next daemon
+    rebuild), so it never trades recall away autonomously; shrinking M
+    for memory is the capacity guard's veto territory, not a climb."""
+    recall = inputs.get("recall")
+    if recall is not None and recall < ctx["recallTarget"]:
+        return 1, "recall_low"
+    return 0, None
+
+
+def _decide_rebuild_tail(value: int, inputs: Dict[str, Any],
+                         ctx: Dict[str, float]
+                         ) -> Tuple[int, Optional[str]]:
+    """Rebuild tail trigger: the exact tail is an O(tail·K) host scan
+    on EVERY query, so a tail sustained above the trigger means fold-in
+    outruns the rebuild cadence — tighten the trigger. Relax it only
+    when serving breaches while the tail is nearly empty (rebuild
+    clustering competes with serving for the same cores)."""
+    tail = inputs.get("tailRows")
+    p99 = inputs.get("p99S")
+    if tail is not None and tail > value:
+        return -1, "tail_high"
+    if p99 is not None and p99 > ctx["p99ObjectiveS"] \
+            and tail is not None and tail < value // 8:
+        return 1, "latency_high"
+    return 0, None
+
+
+def _decide_rebuild_age(value: int, inputs: Dict[str, Any],
+                        ctx: Dict[str, float]
+                        ) -> Tuple[int, Optional[str]]:
+    """Rebuild age trigger: an index aging past its own trigger while
+    churn keeps arriving means the cadence is too loose (or the daemon
+    is drowning) — tighten. Relax when serving breaches and the index
+    is comfortably fresh."""
+    age = inputs.get("indexAgeS")
+    tail = inputs.get("tailRows")
+    p99 = inputs.get("p99S")
+    if age is not None and age > value and tail is not None and tail > 0:
+        return -1, "index_stale"
+    if p99 is not None and p99 > ctx["p99ObjectiveS"] \
+            and age is not None and age < value // 4:
+        return 1, "latency_high"
+    return 0, None
+
+
 def default_knobs() -> Tuple[KnobSpec, ...]:
-    """The four knob families, in adjustment priority order (one step
+    """The knob families, in adjustment priority order (one step
     per evaluation: quality defense first, then scheduler relief, then
-    background-work budget)."""
+    background-work budget, then the MIPS lifecycle knobs added with
+    the PQ/rebuild-daemon work — appended last so the established
+    priority order is unchanged)."""
     return (
         KnobSpec("mips_nprobe", "PIO_SERVE_MIPS_NPROBE",
                  default=64, lo=4, hi=4096, decide=_decide_mips),
@@ -330,6 +387,16 @@ def default_knobs() -> Tuple[KnobSpec, ...]:
                  scale="binary"),
         KnobSpec("foldin_budget", "PIO_SPEED_MAX_BATCH",
                  default=64, lo=8, hi=1024, decide=_decide_foldin),
+        KnobSpec("mips_pq_candidates", "PIO_SERVE_MIPS_PQ_CANDIDATES",
+                 default=2048, lo=256, hi=32768, decide=_decide_mips),
+        KnobSpec("mips_pq_m", "PIO_SERVE_MIPS_PQ_M",
+                 default=16, lo=4, hi=64, decide=_decide_pq_m),
+        KnobSpec("mips_rebuild_tail", "PIO_MIPS_REBUILD_TAIL",
+                 default=4096, lo=256, hi=65536,
+                 decide=_decide_rebuild_tail),
+        KnobSpec("mips_rebuild_age_s", "PIO_MIPS_REBUILD_AGE_S",
+                 default=900, lo=60, hi=14400,
+                 decide=_decide_rebuild_age),
     )
 
 
@@ -655,6 +722,12 @@ class KnobController:
                 ser.get("pio_serve_mips_recall"), worst=min),
             "foldP99S": _hist_window_p99(
                 ser.get("pio_freshness_fold_seconds")),
+            # MIPS lifecycle gauges (worst = max: the most-lagged
+            # engine/index is what the rebuild knobs defend)
+            "tailRows": _gauge_window_last(
+                ser.get("pio_mips_tail_size"), worst=max),
+            "indexAgeS": _gauge_window_last(
+                ser.get("pio_mips_index_age_seconds"), worst=max),
             "samples": win.get("samples", 0),
             "windowS": win.get("windowS"),
         }

@@ -38,10 +38,28 @@ Continuation-retrain seam: :func:`update_index` re-quantizes and
 re-assigns only the touched rows (O(delta)); a geometry change (reshard
 / capacity growth) rebuilds.
 
+Catalogue-scale seams (ops/mips_daemon.py drives them):
+
+- ``PIO_SERVE_MIPS_QUANT=pq`` materializes **product-quantized
+  residual codes** instead of a dense per-row view: M subquantizers ×
+  256 codewords (``PIO_SERVE_MIPS_PQ_M``) over residuals from the
+  assigned centroid, scored asymmetrically via per-query LUTs computed
+  once per dispatch — rank/M bytes per row (8–16× vs f32), with the
+  exact f32 rerank stage unchanged in kind.
+- :func:`rebuild_index` is the background-rebuild entry: re-clusters
+  off the serving path, folds the virtual-id tail into a dense **ext
+  block** (ids stay stable — the overlay's key→id map survives), and
+  atomically swaps the registry entry with zero serving downtime.
+- Cold buckets can be **tiered to host memory** at rebuild time from
+  probe-hit statistics: demoted buckets leave the device arrays
+  entirely and are served by an exact host-side scan when probed —
+  never a serving-path blocking transfer.
+
 Knobs (all read at call time): ``PIO_SERVE_MIPS`` (off|auto|on),
 ``PIO_SERVE_MIPS_NPROBE``, ``PIO_SERVE_MIPS_CANDIDATES``,
 ``PIO_SERVE_MIPS_MIN_ITEMS``, ``PIO_SERVE_MIPS_CENTROIDS``,
-``PIO_SERVE_MIPS_QUANT`` (int8|bf16).
+``PIO_SERVE_MIPS_QUANT`` (int8|bf16|pq), ``PIO_SERVE_MIPS_PQ_M``,
+``PIO_SERVE_MIPS_PQ_CANDIDATES``, ``PIO_MIPS_TIER`` (off|auto|on).
 """
 
 from __future__ import annotations
@@ -89,14 +107,50 @@ _RECALL = obs_metrics.REGISTRY.gauge(
 _INDEX_AGE = obs_metrics.REGISTRY.gauge(
     "pio_mips_index_age_seconds",
     "age of the OLDEST live MIPS index since its last build/update/"
-    "publish — climbing without bound means retrain/fold-in is not "
-    "republishing the index")
+    "publish/daemon-swap — climbing without bound means retrain, "
+    "fold-in AND the rebuild daemon are all failing to republish")
+_TAIL_SIZE = obs_metrics.REGISTRY.gauge(
+    "pio_mips_tail_size",
+    "exact-tail entries awaiting fold-out, per serving engine — "
+    "climbing past the rebuild-tail trigger means the rebuild daemon "
+    "is dead or churn outruns its cadence (docs/observability.md "
+    "runbook)", labels=("engine",))
+_TIER_ROWS = obs_metrics.REGISTRY.gauge(
+    "pio_mips_tier_rows",
+    "catalogue rows by residence tier: device (quantized coarse "
+    "views in HBM) vs host (cold buckets + exact tail served from "
+    "host memory)", labels=("tier",))
+_REBUILDS = obs_metrics.REGISTRY.counter(
+    "pio_mips_rebuilds_total",
+    "background index rebuild-and-swaps by trigger "
+    "(tail|age|churn|promote|manual)", labels=("trigger",))
+
+
+def _now() -> float:
+    """THE clock for index freshness: every ``built_at`` stamp and the
+    age collector read this seam, so a FakeClock patch sees exactly the
+    ages production would (tests pin the adopt/swap reset through it)."""
+    return time.time()
 
 
 def _collect_index_age() -> None:
-    ages = [time.time() - e.index.built_at for e in list(_REGISTRY.values())]
+    ages = []
+    tails: Dict[str, int] = {}
+    dev_rows = host_rows = 0
+    for e in list(_REGISTRY.values()):
+        idx = e.index
+        ages.append(_now() - idx.built_at)
+        tail = idx.tail_size()
+        tails[idx.engine] = tails.get(idx.engine, 0) + tail
+        d, h = idx.tier_rows()
+        dev_rows += d
+        host_rows += h + tail
     if ages:
         _INDEX_AGE.set(max(ages))
+    for engine, t in tails.items():
+        _TAIL_SIZE.labels(engine=engine).set(t)
+    _TIER_ROWS.labels(tier="device").set(dev_rows)
+    _TIER_ROWS.labels(tier="host").set(host_rows)
 
 
 obs_metrics.REGISTRY.register_collector("mips_index_age",
@@ -181,22 +235,64 @@ def _candidates_for(index: "MIPSIndex", k: int) -> int:
     Knob seam: ``PIO_SERVE_MIPS_CANDIDATES`` is a REGISTERED serving
     knob (obs/knobs.py), read per call like nprobe — the recall/latency
     trade the knob controller's hill-climb works against the live
-    ``pio_serve_mips_recall`` probe."""
-    n = _env_int("PIO_SERVE_MIPS_CANDIDATES", 0)
-    if n <= 0:
-        n = 1024
+    ``pio_serve_mips_recall`` probe. A PQ index reads its OWN width
+    knob (``PIO_SERVE_MIPS_PQ_CANDIDATES``, default 2× the dense
+    default): the lossier coarse ranking needs a wider exact rerank to
+    hold the same recall gate, and tying the two modes to one knob
+    would make the controller's hill-climb fight itself across a
+    quant flip."""
+    if index.quant == "pq":
+        n = _env_int("PIO_SERVE_MIPS_PQ_CANDIDATES", 0)
+        if n <= 0:
+            n = 2048
+    else:
+        n = _env_int("PIO_SERVE_MIPS_CANDIDATES", 0)
+        if n <= 0:
+            n = 1024
     n = max(_next_pow2(n), _next_pow2(max(k, 1)))
     return min(n, _next_pow2(index.n_items))
 
 
 def _quant_mode() -> str:
     q = os.environ.get("PIO_SERVE_MIPS_QUANT", "int8").strip().lower()
-    return q if q in ("int8", "bf16") else "int8"
+    return q if q in ("int8", "bf16", "pq") else "int8"
+
+
+def _pq_m(rank: int) -> int:
+    """Subquantizer count for PQ builds: ``PIO_SERVE_MIPS_PQ_M``
+    (default 16, ~rank/16 bytes per row at rank 128) snapped DOWN to a
+    divisor of the rank so every subspace gets the same width. A knob
+    step lands at the next rebuild, like a quant flip."""
+    m = _env_int("PIO_SERVE_MIPS_PQ_M", 16)
+    m = max(1, min(m, rank))
+    while rank % m:
+        m -= 1
+    return m
 
 
 # ---------------------------------------------------------------------------
 # index structure + registry
 # ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ColdTier:
+    """Host-memory residence for cold buckets (a rebuild-daemon
+    decision — see :func:`rebuild_index`). Demoted rows leave the
+    device arrays entirely; they are clustered into their OWN host
+    mini-index and served by an exact f32 numpy scan of the probed
+    buckets, merged after the device stage like the tail. ``hits`` is
+    the promotion signal: probe pressure on a cold bucket sends its
+    rows back to the device at the next rebuild."""
+
+    centroids: np.ndarray       # [Cc, K] f32 unit centroids
+    cmax: np.ndarray            # [Cc] f32 probe bound norms
+    crad_cos: np.ndarray        # [Cc] f32 ball radius (cos)
+    crad_sin: np.ndarray        # [Cc] f32 ball radius (sin)
+    member_ids: List[np.ndarray]    # per-bucket global ids
+    member_vecs: List[np.ndarray]   # per-bucket exact f32 rows
+    rows: int                   # total demoted rows
+    hits: np.ndarray            # [Cc] int64 probe-hit counters
+
 
 @dataclasses.dataclass
 class MIPSIndex:
@@ -239,16 +335,62 @@ class MIPSIndex:
     built_at: float = 0.0     # wall ts of last build/update/publish
     rebuilds: int = 0         # full builds that produced this index
     delta_updates: int = 0    # O(delta) update_index applications
+    #: PQ residual codes (quant == "pq"): bucket-major [C, cap, M]
+    #: uint8 codes + [M, 256, rank/M] f32 codebooks, host mirrors for
+    #: the O(delta) splice path. Placeholder-shaped under int8/bf16.
+    pq_codes: Optional[jax.Array] = None
+    pq_books: Optional[jax.Array] = None
+    pq_codes_np: Optional[np.ndarray] = None
+    pq_books_np: Optional[np.ndarray] = None
+    pq_m: int = 0
+    #: daemon-rebuild ext block: folded virtual-id rows [E_pad, K] f32
+    #: at ids [capacity, capacity + n_ext) — the published id space
+    #: stays stable across a swap, so the overlay's key→id map and any
+    #: in-flight exclusion list survive unchanged
+    ext: Optional[jax.Array] = None
+    ext_np: Optional[np.ndarray] = None
+    n_ext: int = 0
+    #: true table capacity (padded row count). Under PQ every dense
+    #: view is a placeholder, so the ``capacity`` property can no
+    #: longer derive it from a view shape.
+    capacity_rows: int = 0
+    #: host cold tier (rebuild-daemon decision) — None when every
+    #: bucket is device-resident
+    cold: Optional[ColdTier] = None
+    #: serving-engine label for the pio_mips_tail_size gauge
+    engine: str = "default"
+    #: host mirrors of the probe-bound arrays (the host-side probe
+    #: used by cold-tier serving and the probe-hit sampler)
+    cmax_np: Optional[np.ndarray] = None
+    crad_cos_np: Optional[np.ndarray] = None
+    crad_sin_np: Optional[np.ndarray] = None
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
         #: exact tail: global/virtual id -> fresh f32 vector (host)
         self._tail: "Dict[int, np.ndarray]" = {}
         self._tail_pack: Optional[Tuple[np.ndarray, np.ndarray]] = None
-        self._next_virtual = self.capacity
+        #: publish sequence numbers per tail id: the rebuild daemon
+        #: snapshots a watermark, folds everything at-or-below it, and
+        #: the swap carries newer entries into the successor's tail —
+        #: a key published DURING a rebuild is never lost
+        self._tail_seqs: Dict[int, int] = {}
+        self._tail_seq = 0
+        #: set under ``_lock`` at swap time: a publisher that raced the
+        #: swap re-routes its entries to the successor index
+        self._superseded: Optional["MIPSIndex"] = None
+        self._next_virtual = self.capacity + self.n_ext
         self._table_ref: Optional[weakref.ref] = None
+        #: per-bucket probe-hit counters (host, sampled) — the tiering
+        #: daemon's demotion signal
+        self.probe_hits = np.zeros(self.c_total, np.int64)
+        self._probe_samples = 0
+        self._dispatches = 0
+        #: rows churned (published / delta-updated) since this index
+        #: was built — a rebuild-daemon trigger input
+        self.churn_rows = 0
         if not self.built_at:
-            self.built_at = time.time()
+            self.built_at = _now()
 
     @property
     def c_total(self) -> int:
@@ -256,8 +398,10 @@ class MIPSIndex:
 
     @property
     def capacity(self) -> int:
-        # the MATERIALIZED view carries the padded table shape (the
-        # unselected view is a placeholder — see ``quant``)
+        if self.capacity_rows:
+            return self.capacity_rows
+        # legacy derivation: the MATERIALIZED view carries the padded
+        # table shape (the unselected view is a placeholder)
         view = self.bf16 if self.quant == "bf16" else self.codes
         return int(view.shape[0])
 
@@ -265,6 +409,12 @@ class MIPSIndex:
         """What must match for an O(delta) update to splice in place —
         a change here is a reshard/regrow and means full rebuild."""
         return (self.capacity, self.rank, self.n_shards, self.cap)
+
+    def tier_rows(self) -> Tuple[int, int]:
+        """(device rows, host cold rows) — the pio_mips_tier_rows
+        split (the exact tail is counted by the collector)."""
+        host = self.cold.rows if self.cold is not None else 0
+        return (self.n_items + self.n_ext - host, host)
 
     # -- exact tail ---------------------------------------------------------
     def tail_arrays(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
@@ -284,7 +434,16 @@ class MIPSIndex:
         with self._lock:
             return len(self._tail)
 
+    def tail_virtual_size(self) -> int:
+        """Virtual-id tail entries (new keys not yet folded into the
+        index) — the rebuild daemon's tail trigger input. Known-row
+        overrides are excluded: they live in the tail until the next
+        retrain by design and must not force rebuilds forever."""
+        with self._lock:
+            return sum(1 for g in self._tail if g >= self.capacity)
+
     def stats(self) -> Dict[str, Any]:
+        dev, host = self.tier_rows()
         return {
             "items": self.n_items,
             "capacity": self.capacity,
@@ -292,9 +451,17 @@ class MIPSIndex:
             "bucketCap": self.cap,
             "shards": self.n_shards,
             "tail": self.tail_size(),
-            "ageSec": round(time.time() - self.built_at, 1),
+            "tailVirtual": self.tail_virtual_size(),
+            "ageSec": round(_now() - self.built_at, 1),
             "rebuilds": self.rebuilds,
             "deltaUpdates": self.delta_updates,
+            "quant": self.quant,
+            "pqM": self.pq_m,
+            "ext": self.n_ext,
+            "deviceRows": dev,
+            "hostRows": host,
+            "churnRows": self.churn_rows,
+            "engine": self.engine,
         }
 
 
@@ -339,6 +506,17 @@ def registered_index_count() -> int:
     return len(_REGISTRY)
 
 
+def registered_tables() -> List[Tuple[Any, MIPSIndex]]:
+    """Live (table, index) pairs — the rebuild daemon's scan set.
+    Holding the returned table reference pins it for the rebuild."""
+    out = []
+    for entry in list(_REGISTRY.values()):
+        table = entry.ref()
+        if table is not None:
+            out.append((table, entry.index))
+    return out
+
+
 def adopt_index(prev_table: Any, new_table: Any) -> Optional[MIPSIndex]:
     """Move a registered index onto a VALUE-IDENTICAL replacement table
     (the deploy-time ``prepare_model`` re-device_put of factors that
@@ -352,7 +530,26 @@ def adopt_index(prev_table: Any, new_table: Any) -> Optional[MIPSIndex]:
         return None
     unregister_index(prev_table)
     register_index(new_table, index)
+    # an adoption IS a swap: the index now serves a freshly deployed
+    # table, so the age collector's baseline resets exactly like a
+    # retrain build/update would reset it (pio_mips_index_age_seconds
+    # must never report a hot-swapped index as stale)
+    index.built_at = _now()
     return index
+
+
+def status_snapshot() -> List[Dict[str, Any]]:
+    """One ``stats()`` dict per live registered index — the ``mips``
+    block of the prediction server's ``/status``."""
+    out = []
+    for e in list(_REGISTRY.values()):
+        if e.ref() is None:
+            continue
+        try:
+            out.append(e.index.stats())
+        except Exception:     # a racing swap must never break /status
+            logger.exception("mips status snapshot failed")
+    return out
 
 
 def route(table: Any, *, k: int,
@@ -411,6 +608,78 @@ def _bf16(vf: np.ndarray) -> np.ndarray:
     import ml_dtypes
 
     return vf.astype(ml_dtypes.bfloat16)
+
+
+#: PQ training budget: 256 codewords per subspace, Lloyd on a bounded
+#: residual sample — build cost stays O(sample · 256 · K) however
+#: large the catalogue is (the 10M-item build trains on the same 16k
+#: rows a 100k build would)
+_PQ_CODEBOOK = 256
+_PQ_TRAIN_SAMPLE = 16384
+_PQ_ITERS = 6
+
+
+def _pq_train_books(res: np.ndarray, m: int, seed: int) -> np.ndarray:
+    """[M, 256, rank/M] euclidean Lloyd codebooks over the residual
+    subspaces. Residuals (row − assigned centroid) are what the codes
+    must reconstruct — the centroid part of the score is exact (the
+    probe stage already computed q·c for every bucket)."""
+    n, rank = res.shape
+    d = rank // m
+    rng = np.random.default_rng(seed + 17)
+    fit = (res if n <= _PQ_TRAIN_SAMPLE
+           else res[rng.choice(n, _PQ_TRAIN_SAMPLE, replace=False)])
+    books = np.zeros((m, _PQ_CODEBOOK, d), np.float32)
+    if len(fit) == 0:
+        return books
+    for mi in range(m):
+        sub = fit[:, mi * d:(mi + 1) * d].astype(np.float32)
+        c = sub[rng.choice(len(sub), _PQ_CODEBOOK,
+                           replace=len(sub) < _PQ_CODEBOOK)].copy()
+        for _ in range(_PQ_ITERS):
+            # nearest codeword by euclidean distance, via the
+            # BLAS-shaped argmax(2·x·c − |c|²) expansion
+            sc = 2.0 * sub @ c.T - (c * c).sum(axis=1)[None, :]
+            a = np.argmax(sc, axis=1)
+            sums = np.zeros((_PQ_CODEBOOK, d), np.float64)
+            np.add.at(sums, a, sub)
+            cnt = np.bincount(a, minlength=_PQ_CODEBOOK)
+            nz = cnt > 0
+            c[nz] = (sums[nz] / cnt[nz, None]).astype(np.float32)
+        books[mi] = c
+    return books
+
+
+def _pq_encode(res: np.ndarray, books: np.ndarray,
+               chunk: int = 65536) -> np.ndarray:
+    """[n, M] uint8 nearest-codeword ids per subspace, chunked so the
+    [chunk, 256] score block stays cache-sized."""
+    n = len(res)
+    m, _cb, d = books.shape
+    codes = np.empty((n, m), np.uint8)
+    for mi in range(m):
+        sub = res[:, mi * d:(mi + 1) * d].astype(np.float32)
+        bt = books[mi]
+        pen = (bt * bt).sum(axis=1)[None, :]
+        for s in range(0, n, chunk):
+            sc = 2.0 * sub[s:s + chunk] @ bt.T - pen
+            codes[s:s + chunk, mi] = np.argmax(sc, axis=1).astype(
+                np.uint8)
+    return codes
+
+
+def _pq_pack(assign: np.ndarray, codes: np.ndarray, c: int,
+             cap: int) -> np.ndarray:
+    """Bucket-major [c, cap, M] uint8 code slots, laid out with the
+    SAME stable-argsort slot order as :func:`_pack_members` — slot i of
+    bucket b in ``members`` and in the PQ codes is the same row."""
+    out = np.zeros((c, cap, codes.shape[1]), np.uint8)
+    counts = np.bincount(assign, minlength=c)
+    order = np.argsort(assign, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(order)) - starts[assign[order]]
+    out[assign[order], pos] = codes[order]
+    return out
 
 
 def _spherical_kmeans(rows: np.ndarray, c: int, seed: int,
@@ -539,6 +808,22 @@ def _device_put_index(arr: np.ndarray, table: Any) -> jax.Array:
     return jax.device_put(arr)
 
 
+def _device_put_replicated(arr: np.ndarray, table: Any) -> jax.Array:
+    """Replicated placement (PQ codebooks: [M, 256, d] is KB-scale and
+    every shard needs the full set — axis-0 sharding would split the
+    subquantizers)."""
+    from incubator_predictionio_tpu.parallel.placement import (
+        is_distributed,
+    )
+
+    if is_distributed(table):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(
+            arr, NamedSharding(table.sharding.mesh, P()))
+    return jax.device_put(arr)
+
+
 def build_index(
     table: Any,                # [I_pad, K] f32 device table (maybe sharded)
     n_items: int,
@@ -548,6 +833,7 @@ def build_index(
     host_factors: Optional[np.ndarray] = None,
     register: bool = True,
     probe_recall: bool = False,
+    engine: str = "default",
 ) -> MIPSIndex:
     """Full build at train/retrain/publish time (host k-means + one
     assignment pass + quantization, then device placement). Per-shard
@@ -625,11 +911,26 @@ def build_index(
     crad_cos = np.clip(crad_cos, -1.0, 1.0)
     crad_sin = np.sqrt(1.0 - crad_cos * crad_cos).astype(np.float32)
 
-    # materialize ONLY the selected quantized view (the other would pin
-    # table-scale HBM nothing reads); 1-row placeholders keep the jit
+    # materialize ONLY the selected quantized view (the others would
+    # pin table-scale HBM nothing reads); placeholders keep the jit
     # signatures uniform — the static `quant` branch never touches them
     quant = _quant_mode()
-    if quant == "bf16":
+    pq_m = 0
+    pq_codes_np = np.zeros((n_shards, 1, 1), np.uint8)
+    pq_books_np = np.zeros((1, _PQ_CODEBOOK, 1), np.float32)
+    if quant == "pq":
+        # residuals vs the ASSIGNED centroid: the probe stage computes
+        # q·c exactly for every bucket, so the codes only need to
+        # carry the residual part of the inner product
+        pq_m = _pq_m(rank)
+        res = vf - cent[assign]
+        pq_books_np = _pq_train_books(res, pq_m, int(seed))
+        pq_codes_np = _pq_pack(assign, _pq_encode(res, pq_books_np),
+                               c_total, cap)
+        codes = np.zeros((n_shards, rank), np.int8)
+        scales = np.zeros(n_shards, np.float32)
+        bf16_view = _bf16(np.zeros((n_shards, rank), np.float32))
+    elif quant == "bf16":
         vf_pad = (np.concatenate(
             [vf, np.zeros((i_pad - n_items, rank), np.float32)])
             if i_pad > n_items else vf)
@@ -660,6 +961,12 @@ def build_index(
         counts=counts, n_items=n_items, n_shards=n_shards,
         c_local=c_local, cap=cap, rank=rank, seed=int(seed),
         quant=quant, rebuilds=1,
+        pq_codes=_device_put_index(pq_codes_np, table),
+        pq_books=_device_put_replicated(pq_books_np, table),
+        pq_codes_np=pq_codes_np, pq_books_np=pq_books_np, pq_m=pq_m,
+        capacity_rows=i_pad, engine=engine,
+        cmax_np=cmax.copy(), crad_cos_np=crad_cos.copy(),
+        crad_sin_np=crad_sin.copy(),
     )
     if register:
         register_index(table, index)
@@ -701,6 +1008,12 @@ def update_index(
     if (i_pad, rank, n_shards) != (index.capacity, index.rank,
                                    index.n_shards):
         return None
+    if index.n_ext or index.cold is not None:
+        # a daemon-rebuilt index carries folded virtual rows (ext) or
+        # a host cold tier keyed to a probe-stats window the retrain
+        # invalidates — the splice contract doesn't cover either, so
+        # the caller full-rebuilds (which also re-homes the ext rows)
+        return None
     n_items = int(n_items)
     if n_items > index.capacity:
         return None
@@ -712,11 +1025,19 @@ def update_index(
     if len(touched):
         tj = jnp.asarray(touched.astype(np.int32))
         vt = np.asarray(new_table[tj], np.float32)
-        _requantize_rows(index, tj, vt)
-        _reassign_rows(index, touched, vt)
+        if index.quant == "pq":
+            # PQ codes live bucket-major and encode residuals vs the
+            # ASSIGNED centroid — re-home first, then encode against
+            # the final (bucket, slot) home
+            _reassign_rows(index, touched, vt)
+            _requantize_rows(index, tj, vt)
+        else:
+            _requantize_rows(index, tj, vt)
+            _reassign_rows(index, touched, vt)
     index.n_items = n_items
     index.delta_updates += 1
-    index.built_at = time.time()
+    index.churn_rows += len(touched)
+    index.built_at = _now()
     with index._lock:
         # republished rows supersede their tail overrides; genuinely
         # new virtual entries (ids past capacity) survive the splice
@@ -731,7 +1052,32 @@ def update_index(
 def _requantize_rows(index: MIPSIndex, rows_j: jax.Array,
                      vecs: np.ndarray) -> None:
     """Splice fresh vectors into the MATERIALIZED quantized view (the
-    other view is a placeholder — see ``MIPSIndex.quant``)."""
+    other views are placeholders — see ``MIPSIndex.quant``). Under PQ
+    the codes are bucket-major: each row re-encodes against its
+    CURRENT bucket's centroid into its member slot (call after any
+    re-assignment); rows not in any device bucket (cold/tail-only) are
+    skipped — their exact tail entry serves them."""
+    if index.quant == "pq":
+        rows_np = np.asarray(rows_j, np.int64)
+        changed: set = set()
+        for pos, row in enumerate(rows_np):
+            b = (int(index.assign[row])
+                 if row < len(index.assign) else -1)
+            if b < 0:
+                continue
+            slots = np.nonzero(index.members_np[b] == row)[0]
+            if not len(slots):
+                continue
+            res = (vecs[pos].astype(np.float32)
+                   - index.centroids_np[b])
+            index.pq_codes_np[b, slots[0]] = _pq_encode(
+                res[None, :], index.pq_books_np)[0]
+            changed.add(b)
+        if changed:
+            bids = np.asarray(sorted(changed), np.int32)
+            index.pq_codes = index.pq_codes.at[jnp.asarray(bids)].set(
+                jnp.asarray(index.pq_codes_np[bids]))
+        return
     if index.quant == "bf16":
         index.bf16 = index.bf16.at[rows_j].set(
             jnp.asarray(vecs).astype(jnp.bfloat16))
@@ -802,6 +1148,8 @@ def _reassign_rows(index: MIPSIndex, rows: np.ndarray,
                 with index._lock:
                     index._tail[int(row)] = np.asarray(
                         vecs[pos], np.float32)
+                    index._tail_seq += 1
+                    index._tail_seqs[int(row)] = index._tail_seq
                     index._tail_pack = None
                 continue
             if norms[pos] > cmax_np[new_b]:
@@ -814,6 +1162,12 @@ def _reassign_rows(index: MIPSIndex, rows: np.ndarray,
                 last = int(index.counts[old_b]) - 1
                 slots[hit[0]] = slots[last]
                 slots[last] = -1
+                if index.quant == "pq":
+                    # the compaction moved the LAST member into the
+                    # vacated slot — its PQ code moves with it (the
+                    # slot layouts of members and pq_codes are one)
+                    index.pq_codes_np[old_b, hit[0]] = (
+                        index.pq_codes_np[old_b, last])
                 index.counts[old_b] = last
                 changed_buckets.add(old_b)
         index.members_np[new_b, int(index.counts[new_b])] = row
@@ -825,6 +1179,10 @@ def _reassign_rows(index: MIPSIndex, rows: np.ndarray,
         buckets = np.asarray(sorted(changed_buckets), np.int32)
         index.members = index.members.at[jnp.asarray(buckets)].set(
             jnp.asarray(index.members_np[buckets]))
+        if index.quant == "pq":
+            index.pq_codes = index.pq_codes.at[
+                jnp.asarray(buckets)].set(
+                jnp.asarray(index.pq_codes_np[buckets]))
     if changed_cmax:
         # per-bucket .at[] splice (never a fresh jnp.asarray) so a
         # sharded cmax keeps its placement through the update
@@ -833,6 +1191,8 @@ def _reassign_rows(index: MIPSIndex, rows: np.ndarray,
                           np.float32)
         index.cmax = index.cmax.at[jnp.asarray(bids)].set(
             jnp.asarray(vals))
+        if index.cmax_np is not None:
+            index.cmax_np[bids] = vals
     if changed_crad:
         bids = jnp.asarray(np.asarray(sorted(changed_crad), np.int32))
         vals = jnp.asarray(np.asarray(
@@ -842,6 +1202,12 @@ def _reassign_rows(index: MIPSIndex, rows: np.ndarray,
         cos_b = index.crad_cos[bids]
         index.crad_sin = index.crad_sin.at[bids].set(
             jnp.sqrt(jnp.maximum(1.0 - cos_b * cos_b, 0.0)))
+        if index.crad_cos_np is not None:
+            bnp = np.asarray(bids)
+            index.crad_cos_np[bnp] = np.minimum(
+                index.crad_cos_np[bnp], np.asarray(vals))
+            index.crad_sin_np[bnp] = np.sqrt(np.maximum(
+                1.0 - index.crad_cos_np[bnp] ** 2, 0.0))
 
 
 def publish_rows(
@@ -876,18 +1242,311 @@ def publish_rows(
         _requantize_rows(index, rj, vecs[known])
     out_ids = np.empty(len(vecs), np.int64)
     known_set = set(known.tolist())
-    with index._lock:
-        for pos in range(len(vecs)):
-            if pos in known_set:
-                gid = int(rows_arr[pos])
-            else:
-                gid = index._next_virtual
-                index._next_virtual += 1
-            index._tail[gid] = vecs[pos]
-            out_ids[pos] = gid
-        index._tail_pack = None
-    index.built_at = time.time()
+    while True:
+        with index._lock:
+            successor = index._superseded
+            if successor is None:
+                for pos in range(len(vecs)):
+                    if pos in known_set:
+                        gid = int(rows_arr[pos])
+                    else:
+                        gid = index._next_virtual
+                        index._next_virtual += 1
+                    index._tail[gid] = vecs[pos]
+                    index._tail_seq += 1
+                    index._tail_seqs[gid] = index._tail_seq
+                    out_ids[pos] = gid
+                index._tail_pack = None
+                index.churn_rows += len(vecs)
+        if successor is None:
+            break
+        # a daemon swap raced this publish: the successor is already
+        # registered, so record the entries there (the swap's tail
+        # carry-over only covers entries that existed under the OLD
+        # lock — re-routing here closes the window)
+        index = successor
+    index.built_at = _now()
     return out_ids
+
+
+# ---------------------------------------------------------------------------
+# background rebuild (ops/mips_daemon.py drives this off-path)
+# ---------------------------------------------------------------------------
+
+def _tier_mode() -> str:
+    """off | auto | on: host-tiering of cold buckets at rebuild time.
+    ``auto`` (default) demotes only with enough probe-hit samples;
+    ``on`` trusts whatever counters exist (tests plant them)."""
+    m = os.environ.get("PIO_MIPS_TIER", "auto").strip().lower()
+    return m if m in ("off", "auto", "on") else "auto"
+
+
+def _tier_min_samples() -> int:
+    return _env_int("PIO_MIPS_TIER_MIN_SAMPLES", 32)
+
+
+def _tier_max_frac() -> float:
+    try:
+        return min(max(float(os.environ.get(
+            "PIO_MIPS_TIER_MAX_FRAC", "") or 0.5), 0.0), 0.9)
+    except ValueError:
+        return 0.5
+
+
+def _build_cold(vecs: np.ndarray, ids: np.ndarray,
+                seed: int) -> ColdTier:
+    """Cluster the demoted rows into their own host mini-index (same
+    probe-bound geometry as the device index, numpy arrays only)."""
+    n = len(ids)
+    cc = min(max(_next_pow2(int(np.sqrt(max(n, 1)))), 16), 1024)
+    cent = _spherical_kmeans(vecs, cc, seed + 31)
+    assign = _assign_chunked(vecs, cent)
+    norms = np.linalg.norm(vecs, axis=1).astype(np.float32)
+    cmax = np.zeros(cc, np.float32)
+    np.maximum.at(cmax, assign, norms)
+    unit = vecs / np.maximum(norms[:, None], 1e-9)
+    row_cos = np.einsum("ik,ik->i", unit, cent[assign])
+    crad_cos = np.ones(cc, np.float32)
+    high = norms >= _RADIUS_NORM_FRAC * cmax[assign]
+    np.minimum.at(crad_cos, assign[high],
+                  row_cos[high].astype(np.float32))
+    crad_cos = np.clip(crad_cos, -1.0, 1.0)
+    member_ids = []
+    member_vecs = []
+    for b in range(cc):
+        sel = assign == b
+        member_ids.append(ids[sel].astype(np.int64))
+        member_vecs.append(vecs[sel].astype(np.float32))
+    return ColdTier(
+        centroids=cent, cmax=cmax, crad_cos=crad_cos,
+        crad_sin=np.sqrt(1.0 - crad_cos * crad_cos).astype(np.float32),
+        member_ids=member_ids, member_vecs=member_vecs, rows=n,
+        hits=np.zeros(cc, np.int64))
+
+
+def rebuild_index(table: Any, *, trigger: str = "manual",
+                  probe_recall: bool = False) -> Optional[MIPSIndex]:
+    """Background rebuild-and-swap for a SINGLE-DEVICE table (the
+    rebuild daemon's workhorse — ops/mips_daemon.py books the trigger,
+    trace span and metrics around this call).
+
+    Off the serving path it: (1) snapshots the exact tail under a
+    sequence watermark, (2) re-clusters the catalogue WITH the
+    virtual-id tail folded into a dense **ext block** at its existing
+    ids (the overlay's key→id map survives the swap untouched — this
+    is the ``adopt_keys`` choreography applied to the index), (3)
+    decides bucket tiering from the probe-hit window, then (4)
+    atomically replaces the registry entry. Entries published after
+    the watermark are carried into the successor's tail under the OLD
+    index's lock, and a publisher that raced the swap re-routes via
+    ``_superseded`` — a published key is findable at recall 1.0
+    before, during and after the swap. The old index object keeps
+    serving in-flight queries until their references drop."""
+    old = index_for(table)
+    if old is None or _maybe_sharded(table):
+        return None
+    t0 = time.perf_counter()
+    i_pad, rank = int(table.shape[0]), int(table.shape[1])
+    n_items = old.n_items
+    cap_rows = old.capacity
+    with old._lock:
+        watermark = old._tail_seq
+        tail_snap = {g: np.asarray(v, np.float32)
+                     for g, v in old._tail.items()}
+        next_virtual = old._next_virtual
+
+    # -- assemble the full servable row set -------------------------------
+    vf = np.asarray(table[:n_items], np.float32).copy()
+    for gid, vec in tail_snap.items():
+        if gid < n_items:
+            # known-row override: cluster/encode the PUBLISHED solve
+            # (the tail entry stays live for the exact final score)
+            vf[gid] = vec
+    n_ext = max(int(next_virtual) - cap_rows, 0)
+    ext_np = np.zeros((n_ext, rank), np.float32)
+    have = np.zeros(n_ext, bool)
+    if old.ext_np is not None and old.n_ext:
+        ext_np[:old.n_ext] = old.ext_np[:old.n_ext]
+        have[:old.n_ext] = True
+    for gid, vec in tail_snap.items():
+        j = gid - cap_rows
+        if 0 <= j < n_ext:
+            ext_np[j] = vec
+            have[j] = True
+    ext_ids = cap_rows + np.nonzero(have)[0].astype(np.int64)
+    ids_all = np.concatenate(
+        [np.arange(n_items, dtype=np.int64), ext_ids])
+    rows_all = (np.concatenate([vf, ext_np[have]])
+                if len(ext_ids) else vf)
+
+    # -- tier decision from the probe-hit window --------------------------
+    cold_mask = np.zeros(len(ids_all), bool)
+    tier = _tier_mode()
+    enough = (tier == "on"
+              or old._probe_samples >= _tier_min_samples())
+    if tier != "off" and enough and n_items > 2:
+        bucket_cold = old.probe_hits <= 0
+        # demotion: rows whose device bucket drew no probes over the
+        # window; promotion: rows whose COLD bucket drew probes come
+        # back (pressure), quiet cold buckets stay demoted
+        row_cold = np.zeros(len(ids_all), bool)
+        real = ids_all < n_items
+        a_old = np.full(len(ids_all), -1, np.int64)
+        in_assign = ids_all[real] < len(old.assign)
+        a_idx = ids_all[real][in_assign]
+        a_old_real = np.full(int(real.sum()), -1, np.int64)
+        a_old_real[in_assign] = old.assign[a_idx]
+        a_old[real] = a_old_real
+        valid = a_old >= 0
+        row_cold[valid] = bucket_cold[a_old[valid]]
+        if old.cold is not None:
+            still_cold = set()
+            for cb in np.nonzero(old.cold.hits <= 0)[0]:
+                still_cold.update(
+                    int(g) for g in old.cold.member_ids[int(cb)])
+            if still_cold:
+                row_cold |= np.isin(
+                    ids_all, np.fromiter(still_cold, np.int64,
+                                         len(still_cold)))
+        # published overrides and ext rows are fresh by definition
+        fresh = np.fromiter(tail_snap, np.int64, len(tail_snap))
+        if len(fresh):
+            row_cold &= ~np.isin(ids_all, fresh)
+        max_cold = int(_tier_max_frac() * len(ids_all))
+        if row_cold.sum() > max_cold:
+            keep_hot = np.nonzero(row_cold)[0][max_cold:]
+            row_cold[keep_hot] = False
+        if row_cold.sum() >= 8:        # below that tiering is noise
+            cold_mask = row_cold
+
+    hot_ids = ids_all[~cold_mask]
+    hot_vecs = np.ascontiguousarray(rows_all[~cold_mask])
+    n_hot = len(hot_ids)
+
+    # -- re-cluster the hot set (single shard) ----------------------------
+    seed = old.seed + old.rebuilds
+    c_local = default_centroids(max(n_hot, 1))
+    cent = _spherical_kmeans(hot_vecs, c_local, seed)
+    mean_bucket = -(-max(n_hot, 1) // c_local)
+    cap = max(-(-int(mean_bucket * 1.25) // 8) * 8, 8)
+    a_hot = _balanced_assign(hot_vecs, cent, cap)
+    members_np, counts = _pack_members(a_hot, hot_ids, c_local, cap)
+    norms = np.linalg.norm(hot_vecs, axis=1).astype(np.float32)
+    cmax = np.zeros(c_local, np.float32)
+    np.maximum.at(cmax, a_hot, norms)
+    unit = hot_vecs / np.maximum(norms[:, None], 1e-9)
+    row_cos = np.einsum("ik,ik->i", unit, cent[a_hot])
+    crad_cos = np.ones(c_local, np.float32)
+    high = norms >= _RADIUS_NORM_FRAC * cmax[a_hot]
+    np.minimum.at(crad_cos, a_hot[high],
+                  row_cos[high].astype(np.float32))
+    crad_cos = np.clip(crad_cos, -1.0, 1.0)
+    crad_sin = np.sqrt(1.0 - crad_cos * crad_cos).astype(np.float32)
+
+    # assign is indexed by GLOBAL id (update/publish splices): size it
+    # over the whole id space, -1 for pad/cold/tail-only rows
+    e_pad = _next_pow2(max(n_ext, 8))
+    assign = np.full(cap_rows + e_pad, -1, np.int32)
+    assign[hot_ids] = a_hot
+
+    # -- quantized views over the extended id space -----------------------
+    quant = _quant_mode()
+    pq_m = 0
+    pq_codes_np = np.zeros((1, 1, 1), np.uint8)
+    pq_books_np = np.zeros((1, _PQ_CODEBOOK, 1), np.float32)
+    codes = np.zeros((1, rank), np.int8)
+    scales = np.zeros(1, np.float32)
+    bf16_view = _bf16(np.zeros((1, rank), np.float32))
+    if quant == "pq":
+        pq_m = _pq_m(rank)
+        res = hot_vecs - cent[a_hot]
+        pq_books_np = _pq_train_books(res, pq_m, seed)
+        pq_codes_np = _pq_pack(a_hot, _pq_encode(res, pq_books_np),
+                               c_local, cap)
+    elif quant == "bf16":
+        full = np.zeros((cap_rows + e_pad, rank), np.float32)
+        full[hot_ids] = hot_vecs
+        bf16_view = _bf16(full)
+    else:
+        c_h, s_h = _quantize_int8(hot_vecs)
+        codes = np.zeros((cap_rows + e_pad, rank), np.int8)
+        scales = np.zeros(cap_rows + e_pad, np.float32)
+        codes[hot_ids] = c_h
+        scales[hot_ids] = s_h
+
+    cold_tier = None
+    if cold_mask.any():
+        cold_tier = _build_cold(
+            np.ascontiguousarray(rows_all[cold_mask]),
+            ids_all[cold_mask], seed)
+
+    ext_dev = None
+    ext_full = None
+    if n_ext:
+        ext_full = np.zeros((e_pad, rank), np.float32)
+        ext_full[:n_ext] = ext_np
+        ext_dev = jax.device_put(ext_full)
+
+    new = MIPSIndex(
+        codes=jax.device_put(codes),
+        scales=jax.device_put(scales),
+        bf16=jax.device_put(bf16_view),
+        centroids=jax.device_put(cent),
+        cmax=jax.device_put(cmax),
+        crad_cos=jax.device_put(crad_cos),
+        crad_sin=jax.device_put(crad_sin),
+        members=jax.device_put(members_np),
+        assign=assign, members_np=members_np, centroids_np=cent,
+        counts=counts, n_items=n_items, n_shards=1, c_local=c_local,
+        cap=cap, rank=rank, seed=old.seed, quant=quant,
+        rebuilds=old.rebuilds + 1, delta_updates=old.delta_updates,
+        pq_codes=jax.device_put(pq_codes_np),
+        pq_books=jax.device_put(pq_books_np),
+        pq_codes_np=pq_codes_np, pq_books_np=pq_books_np, pq_m=pq_m,
+        ext=ext_dev, ext_np=ext_full, n_ext=n_ext,
+        capacity_rows=cap_rows, cold=cold_tier, engine=old.engine,
+        cmax_np=cmax.copy(), crad_cos_np=crad_cos.copy(),
+        crad_sin_np=crad_sin.copy(),
+    )
+
+    # warm the serving compile BEFORE the swap (ext-block shapes are
+    # pow2-rung stable, so consecutive rebuilds usually reuse it): the
+    # first post-swap query must not eat a compile
+    try:
+        if n_items > 1:
+            mips_score_and_top_k(vf[0], table, new,
+                                 min(10, n_items - 1))
+    except Exception:
+        logger.exception("mips rebuild warmup failed (serving anyway)")
+
+    # -- the atomic swap --------------------------------------------------
+    with old._lock:
+        new._next_virtual = old._next_virtual
+        for gid, vec in old._tail.items():
+            if gid < n_items or old._tail_seqs.get(gid, 0) > watermark:
+                # known-row overrides stay (the exact final score);
+                # virtual entries published after the watermark carry
+                # over — nothing published is ever lost to a swap
+                new._tail[gid] = np.asarray(vec, np.float32)
+                new._tail_seq += 1
+                new._tail_seqs[gid] = new._tail_seq
+        new._tail_pack = None
+        old._superseded = new
+        register_index(table, new)
+    _REBUILDS.labels(trigger=trigger).inc()
+    if probe_recall:
+        try:
+            recall_probe(table, new, host_factors=vf)
+        except Exception:
+            logger.exception("mips recall probe failed at rebuild")
+    logger.info(
+        "mips index rebuilt (%s): %d items + %d ext, %d centroids "
+        "(cap %d), %d cold rows, folded %d tail entries in %.2fs",
+        trigger, n_items, n_ext, c_local, cap,
+        cold_tier.rows if cold_tier else 0,
+        sum(1 for g in tail_snap if g >= cap_rows),
+        time.perf_counter() - t0)
+    return new
 
 
 # ---------------------------------------------------------------------------
@@ -903,12 +1562,22 @@ def _coarse_cut(coarse, cand, n_cand):
     return jnp.take_along_axis(cand, pos, axis=1)
 
 
-def _exact_rerank(uv, rows_g, table, exclude, offset, k):
+def _exact_rerank(uv, rows_g, table, exclude, offset, k, ext=None,
+                  ext_base=0):
     """Exact f32 rerank of the candidate slice → ([B, kk] scores,
-    [B, kk] GLOBAL ids)."""
+    [B, kk] GLOBAL ids). ``ext`` (daemon-rebuilt indexes) holds the
+    folded virtual-id rows at ids ``>= ext_base`` — those never exist
+    in ``table``, so the rerank gathers them from the ext block."""
     rows_l = jnp.maximum(rows_g - offset, 0)
+    if ext is not None:
+        in_ext = rows_g >= ext_base
+        tab_v = table[jnp.where(in_ext, 0, rows_l)].astype(jnp.float32)
+        ext_v = ext[jnp.clip(rows_g - ext_base, 0, ext.shape[0] - 1)]
+        vecs = jnp.where(in_ext[:, :, None], ext_v, tab_v)
+    else:
+        vecs = table[rows_l].astype(jnp.float32)
     exact = jnp.einsum(
-        "bnk,bk->bn", table[rows_l].astype(jnp.float32), uv,
+        "bnk,bk->bn", vecs, uv,
         preferred_element_type=jnp.float32)
     exact = jnp.where(rows_g >= 0, exact, NEG_INF)
     if exclude is not None:
@@ -921,39 +1590,69 @@ def _exact_rerank(uv, rows_g, table, exclude, offset, k):
 
 
 def _probe_bound(uv, centroids, cmax, crad_cos, crad_sin):
-    """[B, C] upper bound on each bucket's best inner product:
+    """([B, C] upper bound, [B, C] raw centroid scores). The bound is
     cmax·|q|·cos(θ_qc − r) with r the bucket's ball radius — valid for
-    every member, including spilled/off-centroid rows."""
+    every member, including spilled/off-centroid rows. The raw q·c
+    scores ride along because the PQ path reuses them as the exact
+    centroid part of its residual decomposition."""
     s = jnp.einsum("bk,ck->bc", uv, centroids,
                    preferred_element_type=jnp.float32)
     qn2 = jnp.sum(uv * uv, axis=1, keepdims=True)
     ortho = jnp.sqrt(jnp.maximum(qn2 - s * s, 0.0))
-    return cmax[None, :] * (s * crad_cos[None, :]
-                            + ortho * crad_sin[None, :])
+    return (cmax[None, :] * (s * crad_cos[None, :]
+                             + ortho * crad_sin[None, :]), s)
 
 
-def _two_stage(uv, codes, scales, bf16, centroids, cmax, crad_cos,
-               crad_sin, members, table, exclude, offset, *, k, nprobe,
-               n_cand, quant):
+def _pq_coarse(uv, s, probe, pq_codes, pq_books):
+    """[B, P, cap] asymmetric PQ scores for the probed buckets' member
+    slots: q·v ≈ q·c_b (exact, from the probe stage's raw centroid
+    scores) + Σ_m LUT[m, code_m] with LUT = q_sub·codebook — one
+    [B, M, 256] einsum per dispatch, then pure integer gathers."""
+    B = uv.shape[0]
+    m, _cb, d = pq_books.shape
+    base = jnp.take_along_axis(s, probe, axis=1)          # [B, P]
+    lut = jnp.einsum(
+        "bmd,mjd->bmj", uv.reshape(B, m, d), pq_books,
+        preferred_element_type=jnp.float32)               # [B, M, 256]
+    codes_g = pq_codes[probe].astype(jnp.int32)           # [B,P,cap,M]
+
+    def gather_res(lut_b, codes_b):   # [M, 256], [P, cap, M]
+        return lut_b[jnp.arange(m)[None, None, :], codes_b]
+
+    res = jax.vmap(gather_res)(lut, codes_g).sum(-1)      # [B, P, cap]
+    return base[:, :, None] + res
+
+
+def _two_stage(uv, codes, scales, bf16, pq_codes, pq_books, centroids,
+               cmax, crad_cos, crad_sin, members, table, exclude,
+               offset, *, k, nprobe, n_cand, quant):
     """Fused traced core over (possibly shard-local) slices: [B, K]
     queries → ([B, kk] scores, [B, kk] GLOBAL ids). ``offset`` maps the
     global ids in ``members`` onto this slice's row space. Used by the
     shard_map path, where the whole two-stage must be one program; the
     single-device wrappers run the STAGED pair below instead."""
     B = uv.shape[0]
-    cs = _probe_bound(uv, centroids, cmax, crad_cos, crad_sin)
+    cs, s = _probe_bound(uv, centroids, cmax, crad_cos, crad_sin)
     nprobe = min(nprobe, centroids.shape[0])
     _, probe = jax.lax.top_k(cs, nprobe)             # [B, P]
-    cand = members[probe].reshape(B, -1)             # [B, P*cap] global
-    safe = jnp.maximum(cand - offset, 0)
-    if quant == "bf16":
-        coarse = jnp.einsum(
-            "bnk,bk->bn", bf16[safe].astype(jnp.float32), uv,
-            preferred_element_type=jnp.float32)
+    if quant == "pq":
+        # bucket-major codes: gathered by LOCAL probe index, no row
+        # offset involved (the slot layout mirrors ``members``)
+        cand = members[probe]                        # [B, P, cap]
+        coarse = _pq_coarse(uv, s, probe, pq_codes,
+                            pq_books).reshape(B, -1)
+        cand = cand.reshape(B, -1)
     else:
-        coarse = jnp.einsum(
-            "bnk,bk->bn", codes[safe].astype(jnp.float32), uv,
-            preferred_element_type=jnp.float32) * scales[safe]
+        cand = members[probe].reshape(B, -1)         # [B, P*cap] global
+        safe = jnp.maximum(cand - offset, 0)
+        if quant == "bf16":
+            coarse = jnp.einsum(
+                "bnk,bk->bn", bf16[safe].astype(jnp.float32), uv,
+                preferred_element_type=jnp.float32)
+        else:
+            coarse = jnp.einsum(
+                "bnk,bk->bn", codes[safe].astype(jnp.float32), uv,
+                preferred_element_type=jnp.float32) * scales[safe]
     coarse = jnp.where(cand >= 0, coarse, NEG_INF)
     rows_g = _coarse_cut(coarse, cand, n_cand)
     return _exact_rerank(uv, rows_g, table, exclude, offset, k)
@@ -975,7 +1674,7 @@ def _mips_probe_jit(uv, centroids, cmax, crad_cos, crad_sin, members,
     MATERIALIZED f32 view of their quantized rows (gather + convert
     only — nothing downstream may fuse into it)."""
     B = uv.shape[0]
-    cs = _probe_bound(uv, centroids, cmax, crad_cos, crad_sin)
+    cs, _s = _probe_bound(uv, centroids, cmax, crad_cos, crad_sin)
     _, probe = jax.lax.top_k(cs, min(nprobe, centroids.shape[0]))
     cand = members[probe].reshape(B, -1)
     safe = jnp.maximum(cand, 0).reshape(-1)
@@ -1004,9 +1703,10 @@ def _mips_probe_rows_jit(user_factors, rows, centroids, cmax, crad_cos,
     return uv, cand, g, sg
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_cand", "quant"))
-def _mips_rank_jit(uv, cand, g, sg, table, exclude, *, k, n_cand,
-                   quant):
+@functools.partial(jax.jit, static_argnames=("k", "n_cand", "quant",
+                                             "ext_base"))
+def _mips_rank_jit(uv, cand, g, sg, table, ext, exclude, *, k, n_cand,
+                   quant, ext_base=0):
     """Stage 2: coarse score over the materialized quantized rows
     (BLAS-shaped), top-k cut, exact f32 rerank, final top-k."""
     B, n = cand.shape
@@ -1021,16 +1721,62 @@ def _mips_rank_jit(uv, cand, g, sg, table, exclude, *, k, n_cand,
         coarse = coarse * sg
     coarse = jnp.where(cand >= 0, coarse, NEG_INF)
     rows_g = _coarse_cut(coarse, cand, n_cand)
-    top_s, top_i = _exact_rerank(uv, rows_g, table, exclude, 0, k)
+    top_s, top_i = _exact_rerank(uv, rows_g, table, exclude, 0, k,
+                                 ext=ext, ext_base=ext_base)
+    return jnp.stack([top_s, top_i.astype(jnp.float32)])
+
+
+# -- staged PQ pair (single-device) ------------------------------------------
+# The PQ coarse stage is integer gathers + a LUT einsum — no int8→f32
+# convert for XLA CPU to mis-fuse — but the staged split is kept so
+# both quant families dispatch identically (two programs, one
+# device→host fetch) and share the rank-stage compile ladder shape.
+
+@functools.partial(jax.jit, static_argnames=("nprobe",))
+def _mips_pq_probe_jit(uv, centroids, cmax, crad_cos, crad_sin,
+                       members, pq_codes, pq_books, *, nprobe):
+    """PQ stage 1: centroid scan → probed buckets → candidate ids +
+    asymmetric coarse scores (base q·c + residual LUT sums)."""
+    B = uv.shape[0]
+    cs, s = _probe_bound(uv, centroids, cmax, crad_cos, crad_sin)
+    _, probe = jax.lax.top_k(cs, min(nprobe, centroids.shape[0]))
+    cand = members[probe]                             # [B, P, cap]
+    coarse = _pq_coarse(uv, s, probe, pq_codes,
+                        pq_books).reshape(B, -1)
+    cand = cand.reshape(B, -1)
+    return cand, jnp.where(cand >= 0, coarse, NEG_INF)
+
+
+@functools.partial(jax.jit, static_argnames=("nprobe",))
+def _mips_pq_probe_rows_jit(user_factors, rows, centroids, cmax,
+                            crad_cos, crad_sin, members, pq_codes,
+                            pq_books, *, nprobe):
+    """PQ stage 1 with the user-row gather inside the dispatch."""
+    uv = user_factors[rows]
+    cand, coarse = _mips_pq_probe_jit(
+        uv, centroids, cmax, crad_cos, crad_sin, members, pq_codes,
+        pq_books, nprobe=nprobe)
+    return uv, cand, coarse
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_cand",
+                                             "ext_base"))
+def _mips_pq_rank_jit(uv, cand, coarse, table, ext, exclude, *, k,
+                      n_cand, ext_base=0):
+    """PQ stage 2: coarse top-k cut, exact f32 rerank (table + ext
+    block), final top-k."""
+    rows_g = _coarse_cut(coarse, cand, n_cand)
+    top_s, top_i = _exact_rerank(uv, rows_g, table, exclude, 0, k,
+                                 ext=ext, ext_base=ext_base)
     return jnp.stack([top_s, top_i.astype(jnp.float32)])
 
 
 @functools.partial(jax.jit, static_argnames=(
     "k", "nprobe", "n_cand", "quant", "mesh", "gather_user"))
-def _mips_sharded_jit(user_vector, codes, scales, bf16, centroids,
-                      cmax, crad_cos, crad_sin, members, table,
-                      exclude, *, k, nprobe, n_cand, quant, mesh,
-                      gather_user):
+def _mips_sharded_jit(user_vector, codes, scales, bf16, pq_codes,
+                      pq_books, centroids, cmax, crad_cos, crad_sin,
+                      members, table, exclude, *, k, nprobe, n_cand,
+                      quant, mesh, gather_user):
     """Placed tables: per-shard coarse scan + candidate gather + exact
     rerank over the rows the shard owns (everything stays shard-local),
     then the same [n, k_local] all-gather merge as the exhaustive
@@ -1057,22 +1803,25 @@ def _mips_sharded_jit(user_vector, codes, scales, bf16, centroids,
         uv = user_vector
     uv = jax.lax.with_sharding_constraint(uv, NamedSharding(mesh, P()))
     spec = P(axes)
-    args = [uv, codes, scales, bf16, centroids, cmax, crad_cos,
-            crad_sin, members, table]
-    specs = [P()] + [spec] * 9
+    args = [uv, codes, scales, bf16, pq_codes, pq_books, centroids,
+            cmax, crad_cos, crad_sin, members, table]
+    # pq_books is replicated (every shard scores with the full
+    # codebook set); everything else row/bucket-shards on axis 0
+    specs = [P(), spec, spec, spec, spec, P(), spec, spec, spec, spec,
+             spec, spec]
     has_ex = exclude is not None
     if has_ex:
         args.append(exclude)
         specs.append(P())
 
-    def shard(uv_l, codes_l, scales_l, bf_l, cent_l, cmax_l, ccos_l,
-              csin_l, mem_l, tab_l, *rest):
+    def shard(uv_l, codes_l, scales_l, bf_l, pqc_l, pqb_l, cent_l,
+              cmax_l, ccos_l, csin_l, mem_l, tab_l, *rest):
         ex_l = rest[0] if has_ex else None
         offset = axis_index(axes) * local_rows
         top_s, top_i = _two_stage(
-            uv_l, codes_l, scales_l, bf_l, cent_l, cmax_l, ccos_l,
-            csin_l, mem_l, tab_l, ex_l, offset, k=k_l, nprobe=nprobe_l,
-            n_cand=n_cand_l, quant=quant)
+            uv_l, codes_l, scales_l, bf_l, pqc_l, pqb_l, cent_l,
+            cmax_l, ccos_l, csin_l, mem_l, tab_l, ex_l, offset,
+            k=k_l, nprobe=nprobe_l, n_cand=n_cand_l, quant=quant)
         merged_s = all_gather(top_s, axes, axis=1, tiled=True)
         merged_i = all_gather(top_i.astype(jnp.int32), axes, axis=1,
                               tiled=True)
@@ -1094,7 +1843,9 @@ def mips_compile_cache_size() -> int:
     return sum(
         int(fn._cache_size())
         for fn in (_mips_probe_jit, _mips_probe_rows_jit,
-                   _mips_rank_jit, _mips_sharded_jit)
+                   _mips_rank_jit, _mips_pq_probe_jit,
+                   _mips_pq_probe_rows_jit, _mips_pq_rank_jit,
+                   _mips_sharded_jit)
     )
 
 
@@ -1192,12 +1943,135 @@ def _merge_tail(index: MIPSIndex, packed, uv_host: np.ndarray, k: int,
     return out[:, 0, :] if single else out
 
 
+def merge_published_fallback(table: Any, packed: Any, uv_host_fn,
+                             k: int,
+                             exclude: Optional[Any] = None) -> Any:
+    """Exhaustive-fallback parity seam (ops/topk.py): a query routed
+    AROUND the two-stage path — oversized exclusion list, top-
+    everything k, serving mode off — must still see overlay-published
+    rows, which live only in the index's exact tail (virtual ids are
+    not table rows, and a known-row override is fresher than the table
+    row the exhaustive scan just scored). Cold-tiered rows need no
+    help: demotion shrinks the INDEX views, never the table. No-op
+    without a registered index or with an empty tail; ``uv_host_fn``
+    is only called when there is something to merge."""
+    index = index_for(table)
+    if index is None or index.tail_size() == 0:
+        return packed
+    return _merge_tail(index, np.asarray(packed, np.float32),
+                       np.asarray(uv_host_fn(), np.float32), k,
+                       exclude)
+
+
 def _maybe_sharded(table: Any) -> bool:
     from incubator_predictionio_tpu.parallel.placement import (
         is_distributed,
     )
 
     return is_distributed(table)
+
+
+#: probe-hit sampling period: every Nth dispatch recomputes the probe
+#: bound on the host to credit the probed buckets' hit counters (the
+#: tiering daemon's demotion signal). 1/8 keeps the [B, C] numpy
+#: matmul amortized to noise; when a cold tier is live the bound is
+#: computed every dispatch anyway (the cold merge needs it).
+_PROBE_SAMPLE_EVERY = 8
+
+
+def _host_probe_bound(uv: np.ndarray, centroids: np.ndarray,
+                      cmax: np.ndarray, crad_cos: np.ndarray,
+                      crad_sin: np.ndarray) -> np.ndarray:
+    """numpy mirror of :func:`_probe_bound` → [B, C] bound."""
+    s = uv @ centroids.T
+    qn2 = np.sum(uv * uv, axis=1, keepdims=True)
+    ortho = np.sqrt(np.maximum(qn2 - s * s, 0.0))
+    return cmax[None, :] * (s * crad_cos[None, :]
+                            + ortho * crad_sin[None, :])
+
+
+def _top_buckets(bound: np.ndarray, nprobe: int) -> np.ndarray:
+    """[B, P] host top-nprobe bucket ids per query."""
+    nprobe = min(nprobe, bound.shape[1])
+    if nprobe >= bound.shape[1]:
+        return np.tile(np.arange(bound.shape[1]), (len(bound), 1))
+    return np.argpartition(-bound, nprobe - 1, axis=1)[:, :nprobe]
+
+
+def _merge_cold(index: MIPSIndex, packed: np.ndarray,
+                uv_host: np.ndarray, k: int, exclude,
+                nprobe: int) -> np.ndarray:
+    """Exact host-side serve of the probed COLD buckets, merged into
+    the device result like the tail. Cold rows are exact f32 — recall
+    for a demoted row is oracle-grade, the trade is host CPU on the
+    (by construction rare) queries that probe a cold bucket."""
+    cold = index.cold
+    single = packed.ndim == 2
+    if single:
+        packed = packed[:, None, :]
+        uv_host = np.asarray(uv_host, np.float32)[None, :]
+    ex = (np.asarray(exclude).astype(np.int64)
+          if exclude is not None else None)
+    bound = _host_probe_bound(uv_host, cold.centroids, cold.cmax,
+                              cold.crad_cos, cold.crad_sin)
+    top = _top_buckets(bound, min(nprobe, len(cold.cmax)))
+    np.add.at(cold.hits, top.ravel(), 1)
+    out = np.empty((2, packed.shape[1], k), np.float32)
+    for b in range(packed.shape[1]):
+        ids_l: List[np.ndarray] = [packed[1, b].astype(np.int64)]
+        sc_l: List[np.ndarray] = [packed[0, b].astype(np.float32)]
+        for cb in top[b]:
+            cids = cold.member_ids[int(cb)]
+            if not len(cids):
+                continue
+            sc = cold.member_vecs[int(cb)] @ uv_host[b]
+            if ex is not None:
+                keep = ~np.isin(cids, ex)
+                cids, sc = cids[keep], sc[keep]
+            ids_l.append(cids)
+            sc_l.append(sc.astype(np.float32))
+        all_i = np.concatenate(ids_l)
+        all_s = np.concatenate(sc_l)
+        order = np.argsort(-all_s, kind="stable")[:k]
+        ns = len(order)
+        out[0, b, :ns] = all_s[order]
+        out[1, b, :ns] = all_i[order].astype(np.float32)
+        if ns < k:
+            out[0, b, ns:] = float(NEG_INF)
+            out[1, b, ns:] = -1.0
+    return out[:, 0, :] if single else out
+
+
+def _host_stage(index: MIPSIndex, packed, uv_host_fn, k: int, exclude,
+                nprobe: int) -> np.ndarray:
+    """Post-device host work shared by the serving wrappers: probe-hit
+    sampling (demotion signal), the cold-tier exact merge, then the
+    exact-tail merge (override semantics — tail last, so a republished
+    id always serves its freshest vector). ``uv_host_fn`` defers the
+    query fetch: the common no-tail/no-cold steady state pays nothing."""
+    packed = _pad_k(np.asarray(packed), k)
+    index._dispatches += 1
+    cold = index.cold
+    sample = (index._dispatches % _PROBE_SAMPLE_EVERY == 0
+              and index.cmax_np is not None)
+    uv_host = None
+    if cold is not None or sample or index.tail_size():
+        uv_host = np.asarray(uv_host_fn(), np.float32)
+    if sample and uv_host is not None:
+        uv2 = uv_host if uv_host.ndim == 2 else uv_host[None, :]
+        bound = _host_probe_bound(uv2, index.centroids_np,
+                                  index.cmax_np, index.crad_cos_np,
+                                  index.crad_sin_np)
+        np.add.at(index.probe_hits,
+                  _top_buckets(bound, nprobe).ravel(), 1)
+        index._probe_samples += 1
+    if cold is not None:
+        packed = _merge_cold(index, packed, uv_host, k, exclude,
+                             nprobe)
+    if index.tail_size():
+        packed = _merge_tail(index, _pad_k(np.asarray(packed), k),
+                             uv_host, k, exclude)
+    return _pad_k(np.asarray(packed), k)
 
 
 def mips_score_and_top_k(
@@ -1215,11 +2089,20 @@ def mips_score_and_top_k(
     uv = jnp.asarray(user_vector, jnp.float32).reshape(1, -1)
     if _maybe_sharded(table):
         packed = _mips_sharded_jit(
-            uv, index.codes, index.scales, index.bf16, index.centroids,
-            index.cmax, index.crad_cos, index.crad_sin,
-            index.members, table, exclude, k=k,
-            nprobe=nprobe_l, n_cand=n_cand_l, quant=index.quant,
-            mesh=table.sharding.mesh, gather_user=False)[:, 0, :]
+            uv, index.codes, index.scales, index.bf16, index.pq_codes,
+            index.pq_books, index.centroids, index.cmax,
+            index.crad_cos, index.crad_sin, index.members, table,
+            exclude, k=k, nprobe=nprobe_l, n_cand=n_cand_l,
+            quant=index.quant, mesh=table.sharding.mesh,
+            gather_user=False)[:, 0, :]
+    elif index.quant == "pq":
+        cand, coarse_s = _mips_pq_probe_jit(
+            uv, index.centroids, index.cmax, index.crad_cos,
+            index.crad_sin, index.members, index.pq_codes,
+            index.pq_books, nprobe=nprobe_l)
+        packed = _mips_pq_rank_jit(
+            uv, cand, coarse_s, table, index.ext, exclude, k=k,
+            n_cand=n_cand_l, ext_base=index.capacity)[:, 0, :]
     else:
         q = index.quant
         cand, g, sg = _mips_probe_jit(
@@ -1227,17 +2110,17 @@ def mips_score_and_top_k(
             index.crad_sin, index.members, index.codes, index.scales,
             index.bf16, nprobe=nprobe_l, quant=q)
         packed = _mips_rank_jit(
-            uv, cand, g, sg, table, exclude, k=k, n_cand=n_cand_l,
-            quant=q)[:, 0, :]
+            uv, cand, g, sg, table, index.ext, exclude, k=k,
+            n_cand=n_cand_l, quant=q,
+            ext_base=index.capacity)[:, 0, :]
     _profile.record(_pt0, "serve", "serve_topk_mips",
                     2.0 * (index.c_total + coarse + rerank)
                     * index.rank, packed)
     _book_scan(index, 1, coarse, rerank)
-    if index.tail_size():
-        packed = _merge_tail(index, _pad_k(packed, k),
-                             np.asarray(user_vector, np.float32), k,
-                             exclude)
-    return _pad_k(np.asarray(packed), k)
+    return _host_stage(
+        index, packed,
+        lambda: np.asarray(user_vector, np.float32), k, exclude,
+        nprobe_l * index.n_shards)
 
 
 def mips_score_user_and_top_k(
@@ -1258,10 +2141,19 @@ def mips_score_user_and_top_k(
     if _maybe_sharded(table):
         packed = _mips_sharded_jit(
             (user_factors, rows), index.codes, index.scales, index.bf16,
-            index.centroids, index.cmax, index.crad_cos, index.crad_sin,
-            index.members, table, exclude,
-            k=k, nprobe=nprobe_l, n_cand=n_cand_l, quant=index.quant,
-            mesh=table.sharding.mesh, gather_user=True)[:, 0, :]
+            index.pq_codes, index.pq_books, index.centroids, index.cmax,
+            index.crad_cos, index.crad_sin, index.members, table,
+            exclude, k=k, nprobe=nprobe_l, n_cand=n_cand_l,
+            quant=index.quant, mesh=table.sharding.mesh,
+            gather_user=True)[:, 0, :]
+    elif index.quant == "pq":
+        uv, cand, coarse_s = _mips_pq_probe_rows_jit(
+            user_factors, rows, index.centroids, index.cmax,
+            index.crad_cos, index.crad_sin, index.members,
+            index.pq_codes, index.pq_books, nprobe=nprobe_l)
+        packed = _mips_pq_rank_jit(
+            uv, cand, coarse_s, table, index.ext, exclude, k=k,
+            n_cand=n_cand_l, ext_base=index.capacity)[:, 0, :]
     else:
         q = index.quant
         uv, cand, g, sg = _mips_probe_rows_jit(
@@ -1269,17 +2161,17 @@ def mips_score_user_and_top_k(
             index.crad_cos, index.crad_sin, index.members, index.codes,
             index.scales, index.bf16, nprobe=nprobe_l, quant=q)
         packed = _mips_rank_jit(
-            uv, cand, g, sg, table, exclude, k=k, n_cand=n_cand_l,
-            quant=q)[:, 0, :]
+            uv, cand, g, sg, table, index.ext, exclude, k=k,
+            n_cand=n_cand_l, quant=q,
+            ext_base=index.capacity)[:, 0, :]
     _profile.record(_pt0, "serve", "serve_topk_mips",
                     2.0 * (index.c_total + coarse + rerank)
                     * index.rank, packed)
     _book_scan(index, 1, coarse, rerank)
-    if index.tail_size():
-        uv_host = np.asarray(user_factors[user_idx], np.float32)
-        packed = _merge_tail(index, _pad_k(packed, k), uv_host, k,
-                             exclude)
-    return _pad_k(np.asarray(packed), k)
+    return _host_stage(
+        index, packed,
+        lambda: np.asarray(user_factors[user_idx], np.float32), k,
+        exclude, nprobe_l * index.n_shards)
 
 
 #: batched two-stage dispatch width cap: the [B, nprobe·cap, K]
@@ -1309,11 +2201,19 @@ def mips_batch_score_top_k(
         if _maybe_sharded(table):
             part = _mips_sharded_jit(
                 (user_factors, rj), index.codes, index.scales,
-                index.bf16, index.centroids, index.cmax,
+                index.bf16, index.pq_codes, index.pq_books,
+                index.centroids, index.cmax, index.crad_cos,
+                index.crad_sin, index.members, table, None, k=k,
+                nprobe=nprobe_l, n_cand=n_cand_l, quant=index.quant,
+                mesh=table.sharding.mesh, gather_user=True)
+        elif index.quant == "pq":
+            uv, cand, coarse_s = _mips_pq_probe_rows_jit(
+                user_factors, rj, index.centroids, index.cmax,
                 index.crad_cos, index.crad_sin, index.members,
-                table, None, k=k, nprobe=nprobe_l, n_cand=n_cand_l,
-                quant=index.quant, mesh=table.sharding.mesh,
-                gather_user=True)
+                index.pq_codes, index.pq_books, nprobe=nprobe_l)
+            part = _mips_pq_rank_jit(
+                uv, cand, coarse_s, table, index.ext, None, k=k,
+                n_cand=n_cand_l, ext_base=index.capacity)
         else:
             q = index.quant
             uv, cand, g, sg = _mips_probe_rows_jit(
@@ -1322,8 +2222,8 @@ def mips_batch_score_top_k(
                 index.codes, index.scales, index.bf16,
                 nprobe=nprobe_l, quant=q)
             part = _mips_rank_jit(
-                uv, cand, g, sg, table, None, k=k, n_cand=n_cand_l,
-                quant=q)
+                uv, cand, g, sg, table, index.ext, None, k=k,
+                n_cand=n_cand_l, quant=q, ext_base=index.capacity)
         chunks.append(_pad_k(np.asarray(part), k))
     packed = (chunks[0] if len(chunks) == 1
               else np.concatenate(chunks, axis=1))
@@ -1331,11 +2231,11 @@ def mips_batch_score_top_k(
                     2.0 * B * (index.c_total + coarse + rerank)
                     * index.rank, packed)
     _book_scan(index, B, coarse, rerank)
-    if index.tail_size():
-        uv_host = np.asarray(user_factors[jnp.asarray(rows_np)],
-                             np.float32)
-        packed = _merge_tail(index, packed, uv_host, k, None)
-    return packed
+    return _host_stage(
+        index, packed,
+        lambda: np.asarray(user_factors[jnp.asarray(rows_np)],
+                           np.float32), k, None,
+        nprobe_l * index.n_shards)
 
 
 # ---------------------------------------------------------------------------

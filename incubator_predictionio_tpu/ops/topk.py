@@ -245,11 +245,22 @@ def score_user_and_top_k(
             user_factors, item_factors, mips_index, user_idx, k,
             exclude=exclude)
     _mips.book_exhaustive(int(item_factors.shape[0]))
+    # fallback parity with the published tail — see score_and_top_k
+    masked = allowed_mask is not None
+
+    def _fold(out):
+        if masked:
+            return out
+        return _mips.merge_published_fallback(
+            item_factors, out,
+            lambda: np.asarray(user_factors[user_idx], np.float32), k,
+            exclude)
 
     if is_distributed(item_factors):
-        return sharded_top_k((user_factors, user_idx), item_factors, k,
-                             exclude=exclude, allowed_mask=allowed_mask,
-                             valid_items=valid_items)
+        return _fold(sharded_top_k((user_factors, user_idx),
+                                   item_factors, k, exclude=exclude,
+                                   allowed_mask=allowed_mask,
+                                   valid_items=valid_items))
     allowed_mask = _fold_valid_mask(allowed_mask, item_factors,
                                     valid_items)
     _pt0 = _profile.t0()
@@ -267,13 +278,13 @@ def score_user_and_top_k(
             _profile.record(
                 _pt0, "serve", "serve_topk",
                 2.0 * item_factors.shape[0] * item_factors.shape[1], out)
-            return out
+            return _fold(out)
     out = _score_user_top_k_xla(user_factors, item_factors, user_idx, k,
                                 exclude, allowed_mask)
     _profile.record(_pt0, "serve", "serve_topk",
                     2.0 * item_factors.shape[0] * item_factors.shape[1],
                     out)
-    return out
+    return _fold(out)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "valid_items"))
@@ -393,7 +404,11 @@ def batch_score_top_k(
     _profile.record(
         _pt0, "serve", "serve_topk_batch",
         2.0 * B * user_factors.shape[1] * item_factors.shape[0], out)
-    return out
+    # fallback parity with the published tail — see score_and_top_k
+    return _mips.merge_published_fallback(
+        item_factors, out,
+        lambda: np.asarray(user_factors[jnp.asarray(rows_np)],
+                           np.float32), k_pad, None)
 
 
 def score_and_top_k(
@@ -427,12 +442,27 @@ def score_and_top_k(
         return _mips.mips_score_and_top_k(
             user_vector, item_factors, mips_index, k, exclude=exclude)
     _mips.book_exhaustive(int(item_factors.shape[0]))
+    # fallback parity: overlay-published rows live only in the index's
+    # exact tail (virtual ids are NOT table rows), so a query routed
+    # around the two-stage path — oversized exclusion list, mode off —
+    # must merge them or published keys silently vanish. Filtered
+    # queries skip the merge (a virtual id cannot honor an item mask);
+    # no-op without a registered index or with an empty tail.
+    masked = allowed_mask is not None
+
+    def _fold(out):
+        if masked:
+            return out
+        return _mips.merge_published_fallback(
+            item_factors, out,
+            lambda: np.asarray(user_vector, np.float32), k, exclude)
 
     if is_distributed(item_factors):
         # placed serving: per-shard partial top-k + all-gather merge
-        return sharded_top_k(user_vector, item_factors, k,
-                             exclude=exclude, allowed_mask=allowed_mask,
-                             valid_items=valid_items)
+        return _fold(sharded_top_k(user_vector, item_factors, k,
+                                   exclude=exclude,
+                                   allowed_mask=allowed_mask,
+                                   valid_items=valid_items))
     allowed_mask = _fold_valid_mask(allowed_mask, item_factors,
                                     valid_items)
     _pt0 = _profile.t0()  # None on the PIO_PROFILE=0 default hot path
@@ -448,10 +478,10 @@ def score_and_top_k(
             _profile.record(
                 _pt0, "serve", "serve_topk",
                 2.0 * item_factors.shape[0] * item_factors.shape[1], out)
-            return out
+            return _fold(out)
     out = _score_and_top_k_xla(user_vector, item_factors, k,
                                exclude, allowed_mask)
     _profile.record(_pt0, "serve", "serve_topk",
                     2.0 * item_factors.shape[0] * item_factors.shape[1],
                     out)
-    return out
+    return _fold(out)

@@ -424,6 +424,23 @@ class PredictionServer:
         for ov in overlays:
             if ov is not None:
                 ov.start()
+        # host the MIPS rebuild daemon next to the overlay pollers: it
+        # folds published virtual-id tails, re-tiers cold buckets and
+        # swaps indexes off the serving path (ops/mips_daemon.py).
+        # Acquired ONCE per server — a /reload must not stack refs.
+        with self._lock:
+            want_daemon = not getattr(self, "_mips_daemon_held", False)
+            if want_daemon:
+                self._mips_daemon_held = True
+        if want_daemon:
+            try:
+                from incubator_predictionio_tpu.ops import mips_daemon
+
+                mips_daemon.acquire()
+            except Exception:
+                logger.exception("mips rebuild daemon start failed")
+                with self._lock:
+                    self._mips_daemon_held = False
         logger.info(
             "Engine instance %s deployed (%d algorithms, %d speed "
             "overlays)", instance.id, len(self.algorithms),
@@ -698,6 +715,23 @@ class PredictionServer:
                                          s["cursorLagEvents"])
         return out
 
+    @staticmethod
+    def _mips_status() -> Dict[str, Any]:
+        """MIPS index lifecycle block for /status: one stats() dict per
+        registered index plus the rebuild daemon's state. Never raises
+        — /status must survive a racing swap."""
+        try:
+            from incubator_predictionio_tpu.ops import (
+                mips,
+                mips_daemon,
+            )
+
+            return {"indexes": mips.status_snapshot(),
+                    "daemon": mips_daemon.stats()}
+        except Exception:
+            logger.exception("mips status block failed")
+            return {"indexes": [], "daemon": None}
+
     # -- auth for /stop, /reload (common/.../KeyAuthentication.scala:34) ----
     def _check_server_key(self, request: Request) -> None:
         provided = request.query.get("accessKey")
@@ -751,6 +785,12 @@ class PredictionServer:
                             .total_seconds(), 0.0)
                         if instance is not None else None),
                     "speedOverlay": self._speed_status_locked(),
+                    # per-index MIPS lifecycle state (tail, ext block,
+                    # tiering split, age) + the rebuild daemon's trigger
+                    # thresholds and recent swaps — the operator's view
+                    # of "is churn outrunning the rebuild cadence"
+                    # (docs/observability.md runbook)
+                    "mips": self._mips_status(),
                     # continuous-batching scheduler state: per-engine
                     # queue depth + live ladder rung + shed count
                     # (serving/scheduler.py; docs/production.md
@@ -1009,6 +1049,16 @@ class PredictionServer:
     def stop(self) -> None:
         if self._batcher is not None:
             self._batcher.stop()
+        with self._lock:
+            held = getattr(self, "_mips_daemon_held", False)
+            self._mips_daemon_held = False
+        if held:
+            try:
+                from incubator_predictionio_tpu.ops import mips_daemon
+
+                mips_daemon.release()
+            except Exception:
+                logger.exception("mips rebuild daemon stop failed")
         for ov in getattr(self, "_speed_overlays", []):
             if ov is None:
                 continue

@@ -161,7 +161,7 @@ class Worker:
 
     __slots__ = ("name", "host", "port", "state", "fails", "open_until",
                  "cooldown_s", "in_flight", "depth", "requests",
-                 "last_picked", "conns")
+                 "last_picked", "conns", "mips_tail", "mips_age_s")
 
     def __init__(self, name: str, host: str, port: int):
         self.name = name
@@ -175,6 +175,12 @@ class Worker:
         self.depth = 0.0          # last reported pio_serve_queue_depth
         self.requests = 0         # successful placements (any response)
         self.last_picked = 0      # placement tie-break: LRU wins
+        #: worker's MIPS lifecycle as of the last probe: virtual-id
+        #: tail rows awaiting a daemon rebuild + oldest index age —
+        #: the fleet-level "is churn outrunning the rebuild cadence"
+        #: signal (docs/observability.md runbook)
+        self.mips_tail = 0
+        self.mips_age_s = 0.0
         #: idle keep-alive connections (reader, writer)
         self.conns: Deque[Tuple[asyncio.StreamReader,
                                 asyncio.StreamWriter]] = deque()
@@ -186,7 +192,9 @@ class Worker:
         return {"name": self.name, "host": self.host, "port": self.port,
                 "state": self.state, "inFlight": self.in_flight,
                 "depth": self.depth, "requests": self.requests,
-                "consecutiveFails": self.fails}
+                "consecutiveFails": self.fails,
+                "mipsTailVirtual": self.mips_tail,
+                "mipsIndexAgeSec": self.mips_age_s}
 
 
 class FrontDoor:
@@ -371,12 +379,22 @@ class FrontDoor:
         if status != 200:
             return False
         try:
-            sched = json.loads(body).get("scheduler") or {}
+            info = json.loads(body)
+            sched = info.get("scheduler") or {}
             w.depth = float(sum(
                 e.get("depth", 0) for e in
                 (sched.get("engines") or {}).values()))
         except (ValueError, AttributeError, TypeError):
             w.depth = 0.0
+            return True
+        try:
+            indexes = (info.get("mips") or {}).get("indexes") or []
+            w.mips_tail = int(sum(
+                i.get("tailVirtual", 0) for i in indexes))
+            w.mips_age_s = float(max(
+                (i.get("ageSec", 0.0) for i in indexes), default=0.0))
+        except (ValueError, AttributeError, TypeError):
+            w.mips_tail, w.mips_age_s = 0, 0.0
         return True
 
     async def _probe_loop(self) -> None:

@@ -596,6 +596,20 @@ class SpeedOverlay:
                 self.index_sink(published, published_vecs)
             except Exception:
                 logger.exception("speed overlay: index sink failed")
+            else:
+                # fold-in → tail → daemon handoff: the publish may have
+                # pushed the virtual-id tail past its rebuild trigger;
+                # nudge the rebuild daemon instead of waiting out its
+                # poll tick (no-op when the daemon isn't hosted here)
+                try:
+                    from incubator_predictionio_tpu.ops import (
+                        mips_daemon,
+                    )
+
+                    mips_daemon.notify_publish()
+                except Exception:
+                    logger.exception(
+                        "speed overlay: rebuild daemon nudge failed")
         # freshness stage 2: published keys now await their first serve;
         # keys with nothing foldable stop being traced (no vector can
         # ever serve their events until the next retrain)

@@ -83,6 +83,9 @@ def planted_recorder(clock):
         "recall": reg.gauge("pio_serve_mips_recall", "x"),
         "fold": reg.histogram("pio_freshness_fold_seconds", "x",
                               buckets=(0.5, 1.0, 2.0, 5.0)),
+        "tail": reg.gauge("pio_mips_tail_size", "x",
+                          labels=("engine",)),
+        "age": reg.gauge("pio_mips_index_age_seconds", "x"),
     }
     rec = FlightRecorder(registry=reg, hz=1.0, window_s=60.0,
                         clock=clock, wall=clock)
@@ -150,6 +153,63 @@ def test_spec_step_is_bounded_pow2_and_binary_toggle():
     shed = [s for s in default_knobs() if s.scale == "binary"][0]
     assert shed.step(0, 1) == 1
     assert shed.step(1, -1) == 0
+
+
+def test_tail_high_tightens_the_rebuild_trigger():
+    """A tail sustained above the rebuild trigger means fold-in outruns
+    the rebuild cadence — the controller tightens the trigger one rung
+    through the audited seam (the daemon only ever READS this env)."""
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec, hysteresis=1)
+    met["tail"].labels(engine="recommendation").set(9000.0)
+    plant(rec, clock, met, recall=0.97)
+    d = ctl.evaluate_once()
+    assert (d["knob"], d["action"], d["reason"]) == \
+        ("mips_rebuild_tail", "step_down", "tail_high")
+    assert (d["from"], d["to"]) == (4096, 2048)
+    assert applies[0]["PIO_MIPS_REBUILD_TAIL"] == 2048
+    assert os.environ["PIO_MIPS_REBUILD_TAIL"] == "2048"
+    # ...and the daemon's trigger reader sees the step immediately
+    from incubator_predictionio_tpu.ops import mips_daemon
+
+    assert mips_daemon.tail_trigger_rows() == 2048
+
+
+def test_stale_index_tightens_the_age_trigger():
+    """An index aging past its own trigger while a tail keeps arriving:
+    the cadence is too loose (or the daemon is drowning) — tighten.
+    The worst reading across the fleet (max) is what counts."""
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    ctl, applies = make_knobs(clock, rec, hysteresis=1)
+    met["age"].set(3000.0)
+    met["tail"].labels(engine="recommendation").set(10.0)  # < trigger
+    plant(rec, clock, met, recall=0.97)
+    d = ctl.evaluate_once()
+    assert (d["knob"], d["action"], d["reason"]) == \
+        ("mips_rebuild_age_s", "step_down", "index_stale")
+    assert (d["from"], d["to"]) == (900, 450)
+    assert os.environ["PIO_MIPS_REBUILD_AGE_S"] == "450"
+
+
+def test_recall_sag_climbs_pq_m_one_rung():
+    """PQ subquantizer count defends the recall floor only (a BUILD
+    time knob: the step lands at the next daemon rebuild); it never
+    trades recall away for latency on its own."""
+    clock = FakeClock(100.0)
+    rec, met = planted_recorder(clock)
+    pq_m = [s for s in default_knobs() if s.name == "mips_pq_m"]
+    ctl, applies = make_knobs(clock, rec, hysteresis=1, specs=pq_m)
+    plant(rec, clock, met, recall=0.80)
+    d = ctl.evaluate_once()
+    assert (d["knob"], d["action"], d["reason"]) == \
+        ("mips_pq_m", "step_up", "recall_low")
+    assert (d["from"], d["to"]) == (16, 32)
+    assert os.environ["PIO_SERVE_MIPS_PQ_M"] == "32"
+    # a latency breach with healthy recall never shrinks M
+    plant(rec, clock, met, lat=0.6, recall=0.97)
+    assert ctl.evaluate_once()["action"] == "none"
 
 
 # ---------------------------------------------------------------------------
@@ -718,6 +778,30 @@ def test_worker_knobs_route_applies_without_restart(served_workers):
     assert _post_json(port, "/knobs",
                       {"values": {"PIO_SERVE_MAX_BATCH": "lots"}})[0] \
         == 400
+
+
+def test_mips_lifecycle_knobs_roundtrip_the_worker_seam(served_workers):
+    """Act-mode round trip for the PQ/daemon knobs: POST /knobs on a
+    REAL worker applies the vector, and the call-time readers the
+    rebuild daemon and the PQ build path use see the applied values
+    with no restart."""
+    from incubator_predictionio_tpu.ops import mips_daemon
+
+    _servers, ports = served_workers
+    vector = {"PIO_SERVE_MIPS_PQ_M": 8,
+              "PIO_SERVE_MIPS_PQ_CANDIDATES": 4096,
+              "PIO_MIPS_REBUILD_TAIL": 1024,
+              "PIO_MIPS_REBUILD_AGE_S": 300}
+    status, body = _post_json(ports[0], "/knobs", {"values": vector})
+    assert status == 200
+    assert body["applied"] == vector
+    for env, want in vector.items():
+        assert os.environ[env] == str(want)
+    assert mips_daemon.tail_trigger_rows() == 1024
+    assert mips_daemon.age_trigger_s() == 300.0
+    from incubator_predictionio_tpu.ops import mips as mips_mod
+
+    assert mips_mod._pq_m(32) == 8
 
 
 def test_frontdoor_fans_the_vector_to_both_real_workers(

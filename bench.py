@@ -1611,15 +1611,17 @@ def _bucket_quantile(cum: dict, q: float):
     return lo
 
 
-async def _fleet_request(reader, writer, body: bytes):
+async def _fleet_request(reader, writer, body: bytes,
+                         path: bytes = b"/queries.json"):
     """One framed query request/response on a kept-alive connection →
     (status, wall seconds). The ONE copy of the fleet generators' HTTP
     framing (closed-loop burst and open-loop ramp share it); 503 sheds
     are results, not errors — the Retry-After contract is part of the
-    plane under test."""
+    plane under test. ``path`` carries a per-tenant ``?accessKey=`` in
+    the multi-tenant leg."""
     t0 = time.perf_counter()
     writer.write(
-        b"POST /queries.json HTTP/1.1\r\nHost: bench\r\n"
+        b"POST " + path + b" HTTP/1.1\r\nHost: bench\r\n"
         b"Content-Type: application/json\r\n"
         + f"X-PIO-Trace-Id: {_bench_trace_id()}\r\n"
           f"Content-Length: {len(body)}\r\n\r\n".encode()
@@ -1636,7 +1638,8 @@ async def _fleet_request(reader, writer, body: bytes):
 
 
 async def _fleet_closed_loop(port: int, n_clients: int, per_client: int,
-                             results: list) -> None:
+                             results: list,
+                             path: bytes = b"/queries.json") -> None:
     """Closed-loop burst: every client fires its next query the moment
     the previous answers (the max-goodput shape)."""
     import asyncio
@@ -1648,7 +1651,8 @@ async def _fleet_closed_loop(port: int, n_clients: int, per_client: int,
                 body = json.dumps({
                     "user": f"u{(cid * per_client + j) % 2000}",
                     "num": 10}).encode()
-                status, dt = await _fleet_request(reader, writer, body)
+                status, dt = await _fleet_request(reader, writer, body,
+                                                 path=path)
                 results.append((status, dt, False))
         finally:
             writer.close()
@@ -1657,8 +1661,8 @@ async def _fleet_closed_loop(port: int, n_clients: int, per_client: int,
 
 
 async def _fleet_open_loop(port: int, rate_rps: float, duration_s: float,
-                           results: list,
-                           period_s: float = 2.0) -> None:
+                           results: list, period_s: float = 2.0,
+                           path: bytes = b"/queries.json") -> None:
     """Open-loop stage: connections send on a fixed schedule (offered
     load is the independent variable), so below saturation the latency
     distribution reflects the serving plane, not Little's-law queueing
@@ -1689,7 +1693,8 @@ async def _fleet_open_loop(port: int, rate_rps: float, duration_s: float,
                 body = json.dumps({
                     "user": f"u{(cid * per_conn + j) % 2000}",
                     "num": 10}).encode()
-                status, dt = await _fleet_request(reader, writer, body)
+                status, dt = await _fleet_request(reader, writer, body,
+                                                 path=path)
                 # EVERY response is recorded (shed/offered accounting
                 # must see first requests too — the stage-boundary herd
                 # is exactly when sheds happen); the True flag marks a
@@ -2218,6 +2223,335 @@ def bench_frontdoor(budget_s: float) -> dict:
         f"join={out['frontdoor_join_to_first_dispatch_s']}s "
         f"(warmup cold={out['frontdoor_join_cold_s']}s "
         f"warm={out['frontdoor_join_warm_s']}s)")
+    return out
+
+
+TENANT_KEYS = (
+    "tenant_workers", "tenant_victim_solo_p99_s",
+    "tenant_victim_flood_p99_s", "tenant_victim_p99_x",
+    "tenant_victim_shed_rate", "tenant_aggressor_shed_total",
+    "tenant_aggressor_shed_rate", "tenant_isolation",
+    "tenant_reload_nonshed_5xx", "tenant_reloaded",
+)
+
+
+#: stage-B aggressor flood driver for bench_tenants — run as a
+#: SEPARATE stdlib-only subprocess (``python -c``) so the flood
+#: generator never shares an event loop, a GIL, or an import graph
+#: with the victim's timing loop. Params via env (FLOOD_TARGETS,
+#: FLOOD_PATH, FLOOD_CLIENTS, FLOOD_BACKOFF_S); floods keep-alive
+#: closed-loop with a shed backoff until SIGTERM, then prints its
+#: {total, shed, other} counts as one JSON line and exits.
+_TENANT_FLOOD_SRC = r"""
+import asyncio, json, os, signal, sys
+
+targets = [t.rsplit(":", 1)
+           for t in os.environ["FLOOD_TARGETS"].split(",")]
+path = os.environ["FLOOD_PATH"]
+clients = int(os.environ["FLOOD_CLIENTS"])
+backoff = float(os.environ["FLOOD_BACKOFF_S"])
+counts = {"total": 0, "shed": 0, "other": 0}
+
+
+async def one(cid, stop):
+    host, port = targets[cid % len(targets)]
+    reader = writer = None
+    j = 0
+    while not stop.is_set():
+        try:
+            if writer is None:
+                reader, writer = await asyncio.open_connection(
+                    host, int(port))
+            body = json.dumps({"user": "u%d" % ((cid * 977 + j) % 2000),
+                               "num": 10}).encode()
+            j += 1
+            writer.write(("POST %s HTTP/1.1\r\nHost: bench\r\n"
+                          "Content-Type: application/json\r\n"
+                          "Content-Length: %d\r\n\r\n"
+                          % (path, len(body))).encode() + body)
+            await writer.drain()
+            line = await reader.readline()
+            if not line:
+                raise ConnectionError("closed")
+            status = int(line.split()[1])
+            clen = 0
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"", b"\n"):
+                    break
+                if h.lower().startswith(b"content-length:"):
+                    clen = int(h.split(b":", 1)[1])
+            if clen:
+                await reader.readexactly(clen)
+            counts["total"] += 1
+            if status == 503:
+                counts["shed"] += 1
+                await asyncio.sleep(backoff)
+            elif status != 200:
+                counts["other"] += 1
+        except asyncio.CancelledError:
+            break
+        except Exception:
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            reader = writer = None
+            await asyncio.sleep(0.1)
+
+
+async def main():
+    stop = asyncio.Event()
+    asyncio.get_running_loop().add_signal_handler(
+        signal.SIGTERM, stop.set)
+    tasks = [asyncio.create_task(one(c, stop)) for c in range(clients)]
+    await stop.wait()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    print(json.dumps(counts))
+    sys.stdout.flush()
+
+
+asyncio.run(main())
+"""
+
+
+def bench_tenants(budget_s: float) -> dict:
+    """Multi-tenant noisy-neighbor leg: two co-resident tenants on a
+    real 2-worker fleet behind the front door, per-tenant accessKey
+    auth end to end (serving/tenancy.py).
+
+    - stage A: the VICTIM tenant alone at a modest open-loop rate —
+      its solo p99 is the denominator;
+    - stage B: the same victim rate while the AGGRESSOR tenant floods
+      closed-loop past its admission quota. Weighted-fair dispatch +
+      per-tenant quota shedding mean the aggressor sheds ITS OWN
+      traffic (503 + Retry-After) while the victim's p99 stays inside
+      its own objective;
+    - stage C: a TENANT-SCOPED rolling reload of the aggressor's
+      deploy fires mid-victim-traffic (``/reload?tenant=aggressor``
+      through the front door's drain choreography) — the victim keeps
+      serving with zero non-shed 5xx.
+
+    Bars (tests/test_bench_e2e.py): ``tenant_victim_p99_x`` ≤ 1.5,
+    ``tenant_victim_shed_rate`` == 0, ``tenant_isolation`` is True
+    (aggressor shed > 0 AND victim shed == 0, from the workers' own
+    per-tenant /status blocks), ``tenant_reload_nonshed_5xx`` == 0.
+    Guarded like bench_fleet: any failure nulls the tenant_* keys,
+    never the record."""
+    import asyncio
+    import threading
+    import urllib.request
+
+    from incubator_predictionio_tpu.serving import tenancy
+    from incubator_predictionio_tpu.serving.frontdoor import (
+        FrontDoor,
+        FrontDoorConfig,
+    )
+
+    out = dict.fromkeys(TENANT_KEYS)
+    if budget_s < 120.0:
+        log("tenants leg skipped: bench deadline too close")
+        return out
+    leg_deadline = time.monotonic() + min(
+        budget_s - 45.0,
+        float(os.environ.get("PIO_BENCH_TENANT_TIMEOUT_S", "240")))
+
+    def left(cap: float) -> float:
+        return max(min(cap, leg_deadline - time.monotonic()), 5.0)
+
+    stage_s = float(os.environ.get("PIO_BENCH_TENANT_STAGE_S", "8"))
+    # same rationale as bench_frontdoor: a simulated dispatch floor
+    # makes per-query latency floor-dominated, so the victim's p99
+    # ratio resolves ISOLATION, not CPU scheduling noise
+    floor_ms = float(os.environ.get("PIO_BENCH_TENANT_FLOOR_MS", "25"))
+    victim_rps = float(os.environ.get(
+        "PIO_BENCH_TENANT_VICTIM_RPS", "60"))
+    flood_clients = int(os.environ.get(
+        "PIO_BENCH_TENANT_FLOOD_CLIENTS", "12"))
+    # the tenant registry BOTH planes parse: the workers admit/shed by
+    # it, and the in-process front door authenticates against it. The
+    # aggressor's quota is far below its closed-loop concurrency so
+    # the flood sheds at admission; the victim's weight buys it the
+    # dispatch tie-break under contention.
+    spec = ("victim:bench-victim-key:weight=8;"
+            "aggressor:bench-aggressor-key:weight=1,quota=2")
+    vpath = b"/queries.json?accessKey=bench-victim-key"
+    apath = b"/queries.json?accessKey=bench-aggressor-key"
+
+    prev_spec = os.environ.get("PIO_TENANTS")
+    os.environ["PIO_TENANTS"] = spec
+    tenancy.reset_registry()
+    workers = []
+    fd = None
+    try:
+        # 3 dispatcher threads per worker: the floor-padded dispatches
+        # sleep, so extra threads hide a victim dispatch behind the
+        # aggressor's in-flight one (the documented device-path use of
+        # the knob). With the scheduler's weighted slot caps the
+        # aggressor holds at most ceil(3·1/9)=1 slot, so the victim
+        # keeps ≥2 concurrent slots under flood — the same headroom
+        # its solo baseline enjoys — instead of eating a full
+        # in-flight flood dispatch before its own turn
+        workers = _fleet_spawn(2, floor_ms,
+                               extra_env={"PIO_TENANTS": spec,
+                                          "PIO_SERVE_WORKERS": "3"})
+        out["tenant_workers"] = len(workers)
+        fd = FrontDoor(
+            [("127.0.0.1", port) for _proc, port in workers],
+            FrontDoorConfig(request_timeout_s=8.0, attempt_timeout_s=3.0,
+                            probe_interval_s=0.5, open_cooldown_s=1.0))
+        fport = fd.start_background()
+
+        # untimed warm pass: ladder rungs + EWMA walls settle before
+        # the measured solo baseline
+        asyncio.run(asyncio.wait_for(
+            _fleet_open_loop(fport, victim_rps, 3.0, [], path=vpath),
+            timeout=left(60.0)))
+
+        # stage A: victim solo baseline
+        solo: list = []
+        asyncio.run(asyncio.wait_for(
+            _fleet_open_loop(fport, victim_rps, stage_s, solo,
+                             path=vpath),
+            timeout=left(max(6 * stage_s, 60.0))))
+
+        # stage B: victim at the same rate + aggressor flood. The
+        # flood runs in a SEPARATE dependency-free subprocess aimed
+        # straight at the workers (not the in-process front door): on
+        # a small box, flood coroutines sharing the bench event loop
+        # would bill their own scheduling delay to the victim's
+        # measured tail — the victim's p99 must resolve SERVER-side
+        # isolation, not generator contention. The flood still crosses
+        # the workers' accessKey auth and per-tenant quota admission;
+        # stage B waits for shed evidence in the workers' /status
+        # tenants blocks before the victim's measured pass begins.
+        flood_v: list = []
+        flood_counts: dict = {}
+        flood_env = dict(os.environ)
+        flood_env.update({
+            "FLOOD_TARGETS": ",".join(
+                f"127.0.0.1:{port}" for _proc, port in workers),
+            "FLOOD_PATH": apath.decode("ascii"),
+            "FLOOD_CLIENTS": str(flood_clients),
+            "FLOOD_BACKOFF_S": "0.5",
+        })
+        flood_proc = subprocess.Popen(
+            [sys.executable, "-c", _TENANT_FLOOD_SRC],
+            env=flood_env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        try:
+            ramp_deadline = time.monotonic() + left(20.0)
+            while time.monotonic() < ramp_deadline:
+                shed = 0
+                for _proc, port in workers:
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{port}/",
+                                timeout=5) as resp:
+                            info = json.loads(resp.read())
+                        shed += int(((info.get("tenants") or {})
+                                     .get("aggressor") or {})
+                                    .get("shed") or 0)
+                    except Exception:  # noqa: BLE001 — still ramping
+                        pass
+                if shed > 0:
+                    break
+                time.sleep(0.25)
+            asyncio.run(asyncio.wait_for(
+                _fleet_open_loop(fport, victim_rps, stage_s, flood_v,
+                                 path=vpath),
+                timeout=left(max(6 * stage_s, 60.0))))
+        finally:
+            flood_proc.terminate()
+            try:
+                flood_stdout, _ = flood_proc.communicate(timeout=15)
+                flood_counts = json.loads(flood_stdout or b"{}")
+            except Exception:  # noqa: BLE001 — counts are best-effort
+                flood_proc.kill()
+                flood_proc.wait(timeout=10)
+
+        # stage C: tenant-scoped rolling reload of the AGGRESSOR mid-
+        # victim-traffic — only the aggressor's co-resident deploy is
+        # swapped; the victim rides the drain choreography untouched
+        reload_out: dict = {}
+
+        def reload_thread() -> None:
+            time.sleep(0.5)  # let stage C traffic establish first
+            try:
+                reload_out.update(fd.rolling_reload(
+                    timeout=left(120.0), tenant="aggressor"))
+            except Exception as e:  # noqa: BLE001 — nulls the keys
+                log(f"tenant rolling reload failed ({e!r})")
+
+        reload_v: list = []
+        t = threading.Thread(target=reload_thread, daemon=True)
+        t.start()
+        asyncio.run(asyncio.wait_for(
+            _fleet_open_loop(fport, victim_rps, stage_s, reload_v,
+                             path=vpath),
+            timeout=left(max(6 * stage_s, 60.0))))
+        t.join(timeout=left(60.0))
+
+        solo_served = [d for s, d, f in solo if s == 200 and not f]
+        flood_served = [d for s, d, f in flood_v
+                        if s == 200 and not f]
+        if solo_served and flood_served:
+            p_solo = _stage_p99(solo_served)
+            p_flood = _stage_p99(flood_served)
+            out["tenant_victim_solo_p99_s"] = round(p_solo, 4)
+            out["tenant_victim_flood_p99_s"] = round(p_flood, 4)
+            if p_solo > 0:
+                out["tenant_victim_p99_x"] = round(p_flood / p_solo, 3)
+        vic_all = solo + flood_v + reload_v
+        if vic_all:
+            out["tenant_victim_shed_rate"] = round(
+                sum(1 for s, _d, _f in vic_all if s == 503)
+                / len(vic_all), 4)
+        if flood_counts.get("total"):
+            out["tenant_aggressor_shed_rate"] = round(
+                flood_counts.get("shed", 0) / flood_counts["total"], 4)
+        out["tenant_reload_nonshed_5xx"] = sum(
+            1 for s, _d, _f in reload_v if s >= 500 and s != 503)
+        out["tenant_reloaded"] = reload_out.get("reloaded")
+
+        # scheduler-side isolation evidence: per-tenant shed totals
+        # from each worker's own /status tenants block (the bounded-
+        # registry figures the dashboard renders) — the aggressor shed,
+        # the victim never did
+        agg_shed = vic_shed = 0
+        for _proc, port in workers:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=10) as resp:
+                info = json.loads(resp.read())
+            blocks = info.get("tenants") or {}
+            agg_shed += int((blocks.get("aggressor") or {})
+                            .get("shed") or 0)
+            vic_shed += int((blocks.get("victim") or {})
+                            .get("shed") or 0)
+        out["tenant_aggressor_shed_total"] = agg_shed
+        if out["tenant_victim_shed_rate"] is not None:
+            out["tenant_isolation"] = bool(
+                agg_shed > 0 and vic_shed == 0
+                and out["tenant_victim_shed_rate"] == 0)
+    finally:
+        if fd is not None:
+            fd.stop()
+        _fleet_teardown(workers)
+        if prev_spec is None:
+            os.environ.pop("PIO_TENANTS", None)
+        else:
+            os.environ["PIO_TENANTS"] = prev_spec
+        tenancy.reset_registry()
+    log(f"tenants: victim p99 {out['tenant_victim_solo_p99_s']}s solo "
+        f"-> {out['tenant_victim_flood_p99_s']}s flooded "
+        f"({out['tenant_victim_p99_x']}x), "
+        f"victim shed_rate={out['tenant_victim_shed_rate']} "
+        f"aggressor shed={out['tenant_aggressor_shed_total']} "
+        f"isolation={out['tenant_isolation']} "
+        f"reload 5xx={out['tenant_reload_nonshed_5xx']}")
     return out
 
 
@@ -3729,6 +4063,9 @@ def run_orchestrator() -> None:
         # fleet front-door leg (parent-side router over worker
         # subprocesses; docs/production.md "Fleet front door")
         **dict.fromkeys(FRONTDOOR_KEYS),
+        # multi-tenant noisy-neighbor leg (two tenants on a real
+        # 2-worker fleet; docs/production.md "Multi-tenant platform")
+        **dict.fromkeys(TENANT_KEYS),
         # self-driving freshness leg (controller over fleet workers +
         # front door; docs/production.md "Self-driving freshness")
         **dict.fromkeys(CONTROLLER_KEYS),
@@ -3884,6 +4221,13 @@ def run_orchestrator() -> None:
         record.update(bench_knobs(emit_by - time.monotonic()))
     except Exception as e:  # noqa: BLE001 — sub-metrics are optional
         log(f"knobs leg failed ({e!r}); knob_* keys null this round")
+
+    # -- 6d5. MULTI-TENANT NOISY-NEIGHBOR LEG (host CPU, two tenants on
+    #         a real 2-worker fleet behind the front door) ---------------
+    try:
+        record.update(bench_tenants(emit_by - time.monotonic()))
+    except Exception as e:  # noqa: BLE001 — sub-metrics are optional
+        log(f"tenants leg failed ({e!r}); tenant_* keys null this round")
 
     # -- 6e. TWO-STAGE MIPS SERVING LEG (in-process; planted catalogue
     #        past ML-20M scale, exhaustive stays the oracle) ---------------

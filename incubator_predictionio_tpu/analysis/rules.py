@@ -1454,6 +1454,101 @@ class UnauditedKnobWrite(Rule):
                 "vector")
 
 
+# ---------------------------------------------------------------------------
+# 21. tenant-attributable serving metrics booked without a bounded
+#     tenant label
+# ---------------------------------------------------------------------------
+
+#: the serving-plane metric families the multi-tenant platform
+#: attributes per tenant (serving/tenancy.py) — booking one of these
+#: without a ``tenant`` label silently merges every tenant's traffic
+#: into one series, and booking it with a WIRE value (raw accessKey,
+#: raw tenant parameter) mints unbounded series
+_TENANT_SCOPED_METRICS = {
+    "pio_query_latency_seconds",
+    "pio_serve_shed_total",
+    "pio_serve_queue_depth",
+}
+#: registry constructor attributes whose first argument names the family
+_METRIC_CTOR_ATTRS = {"histogram", "counter", "gauge"}
+
+
+class UnscopedTenantMetric(Rule):
+    name = "unscoped-tenant-metric"
+    severity = "error"
+    doc = ("serving-path ``.labels(...)`` call on a tenant-attributable "
+           "metric family (pio_query_latency_seconds / "
+           "pio_serve_shed_total / pio_serve_queue_depth) without a "
+           "``tenant=`` label, or with a tenant value that is not a "
+           "string literal or a bounded-registry ``.label(...)`` "
+           "gateway call — an unlabeled booking merges every tenant's "
+           "traffic into one series (per-tenant SLOs and the "
+           "noisy-neighbor evidence go blind), and a raw wire value "
+           "(the request's tenant/accessKey) mints one series per "
+           "distinct value; route every tenant label through "
+           "TenantRegistry.label(), which maps unknown ids to the "
+           "bounded 'default' child")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        path = str(mod.path).replace("\\", "/")
+        if "/serving/" not in path and "/servers/" not in path:
+            return
+        # module-level bindings of the scoped families: NAME =
+        # REGISTRY.histogram("pio_query_latency_seconds", ...)
+        scoped: Set[str] = set()
+        for stmt in mod.tree.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Attribute)
+                    and stmt.value.func.attr in _METRIC_CTOR_ATTRS
+                    and stmt.value.args
+                    and isinstance(stmt.value.args[0], ast.Constant)
+                    and stmt.value.args[0].value
+                    in _TENANT_SCOPED_METRICS):
+                continue
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    scoped.add(tgt.id)
+        if not scoped:
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in scoped):
+                continue
+            family = node.func.value.id
+            tenant_kw = next((kw for kw in node.keywords
+                              if kw.arg == "tenant"), None)
+            if tenant_kw is None:
+                yield mod.finding(
+                    self, node,
+                    f"{family}.labels(...) books a tenant-attributable "
+                    "series without a tenant= label — every tenant's "
+                    "traffic merges into one child and the per-tenant "
+                    "SLO/isolation evidence goes blind; pass "
+                    "tenant=<registry>.label(...)")
+                continue
+            v = tenant_kw.value
+            bounded = isinstance(v, ast.Constant) or (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "label")
+            if not bounded:
+                try:
+                    text = ast.unparse(v)
+                except Exception:  # pragma: no cover — unparse is total
+                    text = "<expr>"
+                yield mod.finding(
+                    self, v,
+                    f"{family}.labels(tenant={text}) passes a raw "
+                    "(wire-derived) tenant value — one series per "
+                    "distinct value until the registry OOMs; route it "
+                    "through the bounded TenantRegistry.label() "
+                    "gateway (unknown ids collapse to 'default')")
+
+
 # whole-program (rule API v2) passes live in their own module — they
 # consume the package index, not a single Module
 from incubator_predictionio_tpu.analysis.concur import (  # noqa: E402
@@ -1482,6 +1577,7 @@ ALL_RULES: Sequence[Rule] = (
     UnauditedActuation(),
     UnauditedKnobWrite(),
     RecorderInServePath(),
+    UnscopedTenantMetric(),
     UnguardedSharedState(),
     ThreadLifecycle(),
 )

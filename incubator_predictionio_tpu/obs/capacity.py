@@ -258,6 +258,57 @@ def _num(parsed: Optional[Dict], key: str) -> Optional[float]:
         and not isinstance(v, bool) else None
 
 
+def parse_tenant_demands(spec: Optional[str] = None) -> Dict[str, float]:
+    """``PIO_TENANT_DEMAND_QPS`` grammar: ``tenant=qps;tenant=qps``.
+    Malformed entries are dropped, not fatal — a sizing report must
+    never die on a typo'd env var."""
+    raw = (spec if spec is not None
+           else os.environ.get("PIO_TENANT_DEMAND_QPS", ""))
+    demands: Dict[str, float] = {}
+    for part in raw.split(";"):
+        name, sep, val = part.strip().partition("=")
+        if not sep:
+            continue
+        try:
+            q = float(val)
+        except ValueError:
+            continue
+        if name.strip() and q > 0:
+            demands[name.strip()] = q
+    return demands
+
+
+def bin_pack_tenants(demands: Dict[str, float],
+                     qps_per_worker: float) -> Dict[str, Any]:
+    """First-fit pack of tenant QPS demands onto workers of capacity
+    ``qps_per_worker``. A tenant bigger than one worker is split into
+    worker-sized chunks; each chunk lands in the first worker with
+    room (insertion order — deterministic). Returns the per-tenant
+    worker assignment and the packed fleet size, which is ≥ the naive
+    ``ceil(sum/qps)`` because co-residency never splits a chunk."""
+    cap = float(qps_per_worker)
+    if cap <= 0:
+        return {"workers": 0, "assignment": {}}
+    free: List[float] = []          # remaining capacity per worker
+    assignment: Dict[str, List[int]] = {}
+    for tenant, demand in demands.items():
+        placed: List[int] = []
+        remaining = float(demand)
+        while remaining > 1e-9:
+            chunk = min(remaining, cap)
+            for i, room in enumerate(free):
+                if room >= chunk - 1e-9:
+                    free[i] = room - chunk
+                    placed.append(i)
+                    break
+            else:
+                free.append(cap - chunk)
+                placed.append(len(free) - 1)
+            remaining -= chunk
+        assignment[tenant] = sorted(set(placed))
+    return {"workers": len(free), "assignment": assignment}
+
+
 def fit_capacity(records: Sequence[NormalizedRecord],
                  staleness_s: Optional[float] = None) -> Dict[str, Any]:
     """The rows/chip + QPS/worker model, fitted from the newest records
@@ -280,6 +331,7 @@ def fit_capacity(records: Sequence[NormalizedRecord],
         "fleet": None,
         "mips": None,
         "mips_big": None,
+        "tenants": None,
         "projections": {},
     }
     benches = [r for r in records if r.kind == "bench"
@@ -451,6 +503,22 @@ def fit_capacity(records: Sequence[NormalizedRecord],
             for q in (10_000, 100_000, 1_000_000)
         }
     out["projections"] = projections
+    # multi-tenant sizing: per-tenant worker counts plus a first-fit
+    # bin-pack of the declared tenant demands onto the fleet. Demands
+    # come from PIO_TENANT_DEMAND_QPS ("tenant=qps;..."); no declared
+    # demand or no measured per-worker rate → null block, same honesty
+    # rule as every other estimate.
+    demands = parse_tenant_demands()
+    if demands and qps:
+        out["tenants"] = {
+            "source_record": out["qps_source_record"],
+            "qps_per_worker": qps,
+            "demand_qps": demands,
+            "workers_for_qps": {
+                t: math.ceil(d / qps) for t, d in demands.items()
+            },
+            "binpack": bin_pack_tenants(demands, qps),
+        }
     return out
 
 
@@ -618,5 +686,5 @@ __all__ = [
     "RECORD_GLOBS", "capacity_report", "classify_failure",
     "compare_record", "fit_capacity", "key_direction", "load_baseline",
     "load_trajectory", "normalize_record", "record_verdicts",
-    "staleness_bound_s",
+    "staleness_bound_s", "parse_tenant_demands", "bin_pack_tenants",
 ]

@@ -221,28 +221,38 @@ class FederatedMetric:
         return bool(self.values)
 
     # -- histogram math ----------------------------------------------------
-    def _merged_buckets(self) -> Tuple[List[Tuple[float, float]], float,
-                                       float]:
+    def _merged_buckets(
+            self, labels: Optional[Dict[str, str]] = None
+    ) -> Tuple[List[Tuple[float, float]], float, float]:
         """(ascending per-bucket [(le, count)], overflow, total) summed
-        over every instance/child — the fleet histogram."""
+        over every instance/child — the fleet histogram. ``labels``
+        restricts the merge to children carrying every given label
+        pair (the per-tenant SLO slice; a pre-tenancy worker's
+        unlabeled children simply don't match)."""
+        want = frozenset((k, str(v)) for k, v in labels.items()) \
+            if labels else None
         by_le: Dict[float, float] = {}
         overflow = 0.0
         total = 0.0
-        for child in self.histograms.values():
+        for (_inst, ls), child in self.histograms.items():
+            if want is not None and not want <= ls:
+                continue
             for le, c in child.per_bucket():
                 by_le[le] = by_le.get(le, 0.0) + c
             overflow += child.overflow()
             total += child.count
         return sorted(by_le.items()), overflow, total
 
-    def cumulative_below(self, bound: float) -> Tuple[int, int]:
+    def cumulative_below(
+            self, bound: float,
+            labels: Optional[Dict[str, str]] = None) -> Tuple[int, int]:
         """(observations ≤ the largest bucket bound ≤ ``bound``, total)
         over the merged fleet buckets — same round-DOWN contract as
         ``obs.metrics._Metric.cumulative_below`` (never overstate the
-        good count)."""
+        good count). ``labels`` slices to matching children."""
         if self.kind != "histogram":
             raise ValueError("cumulative_below() is for histograms")
-        buckets, _overflow, total = self._merged_buckets()
+        buckets, _overflow, total = self._merged_buckets(labels)
         below = 0.0
         for le, c in buckets:
             if le <= bound:

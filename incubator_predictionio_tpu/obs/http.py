@@ -339,8 +339,61 @@ def render_slo_panel() -> str:
     )
 
 
+def render_tenant_panel() -> str:
+    """HTML per-tenant table for the dashboard: policy (weight/quota),
+    queue depth + shed totals from the scheduler, and the tenant's
+    own p99 read from the tenant-labeled latency family. Returns ""
+    in single-tenant mode (empty registry) so the index stays clean."""
+    from incubator_predictionio_tpu.serving import tenancy
+
+    reg = tenancy.get_registry()
+    if not reg:
+        return ""
+    m = metrics.REGISTRY.get("pio_query_latency_seconds")
+    shed = metrics.REGISTRY.get("pio_serve_shed_total")
+    depth = metrics.REGISTRY.get("pio_serve_queue_depth")
+    rows = []
+    for t in reg.tenants():
+        label = reg.label(t.tenant_id)
+        p99 = None
+        if m is not None and m.kind == "histogram":
+            try:
+                p99 = m.labels(tenant=label).quantile(0.99)
+            except Exception:
+                p99 = None
+        shed_n = 0.0
+        if shed is not None and "tenant" in shed.labelnames:
+            ti = shed.labelnames.index("tenant")
+            for key, child in getattr(shed, "_children", {}).items():
+                if len(key) > ti and key[ti] == label:
+                    shed_n += child.value
+        d = None
+        if depth is not None:
+            try:
+                d = depth.labels(tenant=label).value
+            except Exception:
+                d = None
+        rows.append(
+            "<tr>"
+            f"<td>{t.tenant_id}</td>"
+            f"<td>{t.weight}</td>"
+            f"<td>{'&mdash;' if t.quota is None else t.quota}</td>"
+            f"<td>{'&mdash;' if d is None else int(d)}</td>"
+            f"<td>{int(shed_n)}</td>"
+            f"<td>{'&mdash;' if p99 is None else f'{p99 * 1e3:.2f}ms'}"
+            f"</td>"
+            f"<td>{'enabled' if t.enabled else 'disabled'}</td></tr>")
+    return (
+        "<h2>Tenants</h2>"
+        "<table border=1><tr><th>Tenant</th><th>Weight</th>"
+        "<th>Quota</th><th>Queue depth</th><th>Shed</th>"
+        "<th>p99</th><th>State</th></tr>"
+        + "".join(rows) + "</table>"
+    )
+
+
 __all__ = [
     "add_federate_route", "add_incident_routes", "add_metrics_route",
     "add_recorder_route", "add_slo_route", "add_profile_route",
-    "render_latency_panels", "render_slo_panel",
+    "render_latency_panels", "render_slo_panel", "render_tenant_panel",
 ]

@@ -379,15 +379,32 @@ class _Metric:
     def value(self):
         return self._solo().value
 
+    # Family-level histogram READS aggregate over children: when a
+    # family gains a label (pio_query_latency_seconds grew ``tenant``
+    # for the multi-tenant platform), every read-side consumer of the
+    # whole family — /status quantiles, the scheduler's live-p99 shed
+    # feed, cross-process count asserts — keeps meaning "the family",
+    # not one child. WRITES on a labeled family still raise via
+    # ``_solo``: an observation must always name its child.
     @property
     def sum(self):
+        if self.labelnames and self.kind == "histogram":
+            with self._lock:
+                children = list(self._children.values())
+            return sum(c.sum for c in children)
         return self._solo().sum
 
     @property
     def count(self):
+        if self.labelnames and self.kind == "histogram":
+            with self._lock:
+                children = list(self._children.values())
+            return sum(c.count for c in children)
         return self._solo().count
 
     def quantile(self, q: float):
+        if self.labelnames and self.kind == "histogram":
+            return self.quantile_over_children(q)
         return self._solo().quantile(q)
 
     def total(self) -> float:
@@ -425,20 +442,38 @@ class _Metric:
             children = list(self._children.values())
         return any(c._touched for c in children)
 
-    def cumulative_below(self, bound: float) -> Tuple[int, int]:
+    def cumulative_below(
+            self, bound: float,
+            labels: Optional[Dict[str, str]] = None) -> Tuple[int, int]:
         """Histogram families only: ``(observations <= the largest bucket
         bound <= ``bound``, total observations)`` summed over every
         labeled child. The SLO engine's good/bad split reads this — a
         threshold between bucket bounds rounds DOWN to the next bound, so
         the good count is never overstated (an SLO can flag early, never
-        late)."""
+        late). ``labels`` restricts the sum to children matching every
+        given label value — per-tenant SLO specs (obs/slo.py) evaluate
+        ``{"tenant": <id>}`` slices of the shared latency family."""
         if self.kind != "histogram":
             raise ValueError("cumulative_below() is for histograms")
         # number of bucket counts at bounds <= bound (bisect_right: an
         # exact bound match includes its own le bucket)
         k = bisect.bisect_right(self._buckets, bound)
         with self._lock:
-            children = list(self._children.values())
+            if labels:
+                if any(ln not in self.labelnames for ln in labels):
+                    # an unlabeled (or differently-labeled) declaration
+                    # of the family has no matching slice — report NO
+                    # DATA (0, 0), never a crash: a per-tenant SLO spec
+                    # must degrade cleanly on a pre-tenancy process
+                    return 0, 0
+                idx = [self.labelnames.index(ln) for ln in labels]
+                want = [str(labels[ln]) for ln in labels]
+                children = [
+                    c for key, c in self._children.items()
+                    if all(key[i] == w for i, w in zip(idx, want))
+                ]
+            else:
+                children = list(self._children.values())
         below = total = 0
         for child in children:
             counts, _sum, count = child.snapshot()

@@ -491,6 +491,15 @@ def _peek_knob_decisions(limit: int = 256) -> List[Dict[str, Any]]:
     return obs_knobs.peek_knob_decisions(limit=limit)
 
 
+def _peek_tenants() -> Optional[Dict[str, Any]]:
+    """The tenant block WITHOUT side effects: None in single-tenant
+    mode (the bundle key stays absent-by-value, pre-tenancy bundles
+    unchanged in spirit)."""
+    from incubator_predictionio_tpu.serving import tenancy
+
+    return tenancy.export_tenants_fn()()
+
+
 def _recorder_url(metrics_url: str) -> str:
     """A federation target's ``/metrics`` URL → its ``/recorder`` full
     dump (same host/port; the route rides every server)."""
@@ -521,6 +530,8 @@ class IncidentCapture:
                      Callable[[], List[Dict[str, Any]]]] = None,
                  knobs_fn: Optional[
                      Callable[[], List[Dict[str, Any]]]] = None,
+                 tenants_fn: Optional[
+                     Callable[[], Optional[Dict[str, Any]]]] = None,
                  registry: Optional[obs_metrics.Registry] = None) -> None:
         d = directory if directory is not None else incident_dir()
         if not d:
@@ -550,6 +561,13 @@ class IncidentCapture:
         #: hosted instance exactly like decisions_fn
         self.knobs_fn = (knobs_fn if knobs_fn is not None
                          else _peek_knob_decisions)
+        #: the tenant block seam (serving/tenancy.export_tenants_fn):
+        #: registry policy + per-tenant SLO entries frozen into the
+        #: bundle so it answers "which tenant breached, and was the
+        #: fleet healthy" offline. Rebound by the admin like
+        #: decisions_fn; the default peeks the process registry.
+        self.tenants_fn = (tenants_fn if tenants_fn is not None
+                           else _peek_tenants)
         reg = registry if registry is not None else obs_metrics.REGISTRY
         self._incidents_total = reg.counter(
             "pio_incidents_total",
@@ -739,6 +757,12 @@ class IncidentCapture:
         knobs_in_window = [d for d in knob_decisions
                            if isinstance(d.get("ts"), (int, float))
                            and d["ts"] >= wall - self.window_s]
+        tenants_block = None
+        try:
+            tenants_block = self.tenants_fn()
+        except Exception:
+            logger.exception("incident capture: tenant block "
+                             "unavailable")
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(wall))
         inc_id = f"inc-{stamp}-{reason}"
         # the stamp has second resolution: two captures of one trigger
@@ -770,6 +794,9 @@ class IncidentCapture:
             # thing to read when a rollback fired
             "knobs": knobs_in_window,
             "knobsTotal": len(knob_decisions),
+            # per-tenant registry policy + SLO entries at capture time
+            # (serving/tenancy.py) — None in single-tenant mode
+            "tenants": tenants_block,
         }
         path = os.path.join(self.directory, f"{inc_id}.json")
         tmp = path + ".tmp"

@@ -70,7 +70,13 @@ class SLOSpec:
     named histogram family (children summed — cross-engine objectives
     collapse their label). ``kind="gauge"``: the engine synthesizes one
     observation per tick, good when the gauge ≤ ``threshold`` (the
-    staleness bound has no per-event stream to count)."""
+    staleness bound has no per-event stream to count).
+
+    ``labels`` (a frozen tuple of (name, value) pairs, so the spec
+    stays hashable) SLICES a labeled family to the matching children —
+    the per-tenant burn engines (:func:`tenant_specs`) are the serve
+    objective with ``labels=(("tenant", <id>),)``: a tenant can burn
+    its own budget while the fleet-wide objective stays green."""
 
     name: str
     metric: str
@@ -78,11 +84,48 @@ class SLOSpec:
     target: float             # required good fraction, e.g. 0.99
     kind: str = "histogram"   # "histogram" | "gauge"
     description: str = ""
+    labels: Tuple[Tuple[str, str], ...] = ()
+
+
+def tenant_specs() -> Tuple[SLOSpec, ...]:
+    """One serve_p99 objective per registered tenant (empty registry →
+    none). Spec names are ``serve_p99@<tenant>`` — the ``@`` grammar is
+    what tenant-labels incident-capture trigger dedup for free (the
+    capture engine dedups on entry name) and what the bundle's tenant
+    block parses back out. Each spec slices the shared latency family
+    to the tenant's own child, so one tenant's burn never reads a
+    neighbor's traffic."""
+    from incubator_predictionio_tpu.serving import tenancy
+
+    serve_threshold = _env_float("PIO_SLO_SERVE_P99_S", 0.25)
+    serve_target = min(max(
+        _env_float("PIO_SLO_SERVE_P99_TARGET", 0.99), 0.0), 0.9999)
+    return tuple(
+        SLOSpec(
+            name=f"serve_p99@{tid}",
+            metric="pio_query_latency_seconds",
+            threshold=serve_threshold,
+            target=serve_target,
+            description=f"tenant {tid} per-query serving wall under "
+                        "the bound",
+            labels=(("tenant", tid),),
+        )
+        for tid in tenancy.get_registry().tenant_ids()
+    )
 
 
 def default_specs() -> Tuple[SLOSpec, ...]:
     """The shipped objectives; every number has a PIO_SLO_* override so
-    operators declare THEIR promise without a code change."""
+    operators declare THEIR promise without a code change. With a
+    tenant registry configured (PIO_TENANTS), the per-tenant serve
+    objectives (:func:`tenant_specs`) ride along — same burn engine,
+    same breach-listener seam, tenant-named entries."""
+    fleet = _fleet_specs()
+    tenants = tenant_specs()
+    return fleet + tenants
+
+
+def _fleet_specs() -> Tuple[SLOSpec, ...]:
     return (
         SLOSpec(
             name="serve_p99",
@@ -191,7 +234,9 @@ class SLOEngine:
             if spec.kind == "histogram":
                 if metric is None or metric.kind != "histogram":
                     continue  # not registered yet: no data, not a breach
-                below, total = metric.cumulative_below(spec.threshold)
+                below, total = metric.cumulative_below(
+                    spec.threshold,
+                    labels=dict(spec.labels) if spec.labels else None)
                 out[spec.name] = (below, total - below)
             else:
                 if metric is None or metric.kind != "gauge" \
@@ -272,6 +317,7 @@ class SLOEngine:
                     "thresholdSeconds": spec.threshold,
                     "target": spec.target,
                     "description": spec.description,
+                    "labels": dict(spec.labels),
                 },
                 "noData": totals is None,
                 "totalObservations": (None if totals is None

@@ -296,6 +296,12 @@ class AdminServer:
             # bundle's "knobs" block must show the hosted controller's
             # decisions (obs/recorder.py capture_now)
             capture.knobs_fn = export_ring_fn(self.knobs)
+            # tenant block: freeze the registry's policy + per-tenant
+            # SLO state into bundles so a noisy-neighbor incident shows
+            # who shed and who was protected
+            from incubator_predictionio_tpu.serving import tenancy
+
+            capture.tenants_fn = tenancy.export_tenants_fn()
 
     def start_background(self) -> int:
         port = self.http.start_background()

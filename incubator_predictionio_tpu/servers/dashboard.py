@@ -27,6 +27,7 @@ from incubator_predictionio_tpu.obs.http import (
     add_slo_route,
     render_latency_panels,
     render_slo_panel,
+    render_tenant_panel,
 )
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
@@ -63,7 +64,8 @@ class DashboardServer:
                     "</tr>"
                 )
             try:
-                panels = render_latency_panels() + render_slo_panel()
+                panels = (render_latency_panels() + render_slo_panel()
+                          + render_tenant_panel())
             except Exception:
                 logger.exception("dashboard panels failed to render")
                 panels = "<p>panels unavailable</p>"

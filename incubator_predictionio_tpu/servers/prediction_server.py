@@ -43,6 +43,7 @@ from incubator_predictionio_tpu.obs.http import (
 )
 from incubator_predictionio_tpu.parallel.context import RuntimeContext
 from incubator_predictionio_tpu.servers.plugins import PluginContext
+from incubator_predictionio_tpu.serving import tenancy
 from incubator_predictionio_tpu.serving.scheduler import (
     BatchScheduler,
     ladder_cap,
@@ -74,13 +75,19 @@ logger = logging.getLogger(__name__)
 #: exponential buckets; /status reports them too (no scraper needed).
 #: Booked on the micro-batch dispatcher thread AFTER the device
 #: dispatch resolves — host-side ints only, never inside traced code.
+#: TENANT-LABELED (serving/tenancy.py): label values come only from the
+#: bounded registry (the unscoped-tenant-metric lint contract);
+#: unlabeled family reads (quantile/count/sum) aggregate the children.
 _QUERY_LATENCY = obs_metrics.REGISTRY.histogram(
     "pio_query_latency_seconds",
-    "per-query serving wall (micro-batch members share the batch wall)")
-#: instantaneous micro-batcher backlog, read at scrape time
+    "per-query serving wall (micro-batch members share the batch wall)",
+    labels=("tenant",))
+#: instantaneous micro-batcher backlog per tenant, read at scrape time
 _QUEUE_DEPTH = obs_metrics.REGISTRY.gauge(
     "pio_serve_queue_depth",
-    "queries waiting in the micro-batching queue (scrape-time snapshot)")
+    "queries waiting in the micro-batching queue (scrape-time "
+    "snapshot, per tenant)",
+    labels=("tenant",))
 #: age of the deployed instance, read at scrape time — the gauge the
 #: staleness SLO (obs/slo.py) evaluates its bound against; /status's
 #: modelStalenessSec reports the same figure
@@ -288,29 +295,27 @@ class PredictionServer:
         self.http = HttpServer.from_conf(self._build_router(), config.ip,
                                          config.port, bind_retries=3,
                                          name="prediction")
+        #: per-tenant deploys beyond the default one (tenant id →
+        #: {engine_instance, engine_params, algorithms, serving,
+        #: models}); a registered tenant with no entry here SHARES the
+        #: default deploy — co-resident deploys only materialize when a
+        #: tenant pins its own engine/variant or tenant-scoped-reloads
+        self._deploys: Dict[str, Dict[str, Any]] = {}
         self._batcher = (
+            # the p99 feed takes the tenant (non-defaulted — the
+            # scheduler arity-detects per-tenant feeds): the shed
+            # projection must read the tenant's OWN tail, never a noisy
+            # neighbor's
             BatchScheduler(self._handle_batch, config.micro_batch,
                            workers=config.serve_workers,
-                           p99_fn=lambda: _QUERY_LATENCY.quantile(0.99))
+                           p99_fn=lambda tenant: _QUERY_LATENCY.labels(
+                               tenant=tenancy.get_registry().label(tenant)
+                           ).quantile(0.99))
             if config.micro_batch > 0 else None
         )
+        self._sync_tenant_policy()
         if self._batcher is not None:
-            # scrape-time queue-depth read; the named collector replaces
-            # any prior server's hook so re-deploys never accumulate
-            # dead closures, and the weakref keeps a stopped server
-            # (engine + loaded models) collectable — the registry must
-            # never pin model memory
-            import weakref
-
-            batcher_ref = weakref.ref(self._batcher)
-
-            def _collect_queue_depth() -> None:
-                b = batcher_ref()
-                if b is not None:
-                    _QUEUE_DEPTH.set(b.depth())
-
-            obs_metrics.REGISTRY.register_collector(
-                "prediction_queue_depth", _collect_queue_depth)
+            self.register_queue_collector()
         # scrape-time model-staleness gauge (weakref for the same
         # reason as the queue collector: telemetry must never pin a
         # stopped server's models)
@@ -341,10 +346,55 @@ class PredictionServer:
         #: deploy/reload — the Lambda speed leg between retrains
         self._speed_overlays: List[Any] = []
 
+    # -- tenancy ------------------------------------------------------------
+    def register_queue_collector(self) -> None:
+        """Register the scrape-time ``pio_serve_queue_depth`` collector.
+
+        The named collector replaces any prior server's hook so
+        re-deploys never accumulate dead closures, and it weakrefs the
+        SERVER (not the batcher — harnesses and tests may swap
+        ``_batcher`` after construction; the collector must follow the
+        live one) so a stopped server's engine + models stay
+        collectable — the registry must never pin model memory.
+        Harnesses that build a server via ``__new__`` (tests/
+        fleet_worker.py) call this after wiring their own batcher."""
+        import weakref
+
+        server_ref = weakref.ref(self)
+
+        def _collect_queue_depth() -> None:
+            s = server_ref()
+            b = s._batcher if s is not None else None
+            if b is None:
+                return
+            depths = b.depths_by_tenant()
+            depths.setdefault(tenancy.DEFAULT_TENANT, 0)
+            reg = tenancy.get_registry()
+            for t in reg.tenant_ids():
+                depths.setdefault(t, 0)
+            for t, d in depths.items():
+                _QUEUE_DEPTH.labels(tenant=reg.label(t)).set(float(d))
+
+        obs_metrics.REGISTRY.register_collector(
+            "prediction_queue_depth", _collect_queue_depth)
+
+    def _sync_tenant_policy(self) -> None:
+        """Push the tenant registry's isolation policy (weights, quotas)
+        into the scheduler — at construction and after every /reload, so
+        a registry change lands without restart."""
+        batcher = getattr(self, "_batcher", None)
+        if batcher is None:
+            return
+        reg = tenancy.get_registry()
+        batcher.set_tenant_policy(reg.weights(), reg.quotas())
+
     # -- deploy lifecycle ---------------------------------------------------
-    def _resolve_instance(self) -> EngineInstance:
+    def _resolve_instance(
+            self, engine_id: Optional[str] = None,
+            engine_variant: Optional[str] = None) -> EngineInstance:
         instances = Storage.get_meta_data_engine_instances()
-        if self.config.engine_instance_id:
+        if engine_id is None and engine_variant is None \
+                and self.config.engine_instance_id:
             instance = instances.get(self.config.engine_instance_id)
             if instance is None:
                 raise ValueError(
@@ -352,9 +402,9 @@ class PredictionServer:
                 )
         else:
             instance = instances.get_latest_completed(
-                self.config.engine_id,
+                engine_id or self.config.engine_id,
                 self.config.engine_version,
-                self.config.engine_variant,
+                engine_variant or self.config.engine_variant,
             )
             if instance is None:
                 raise ValueError(
@@ -369,7 +419,8 @@ class PredictionServer:
                 )
         return instance
 
-    def load_models(self, warm_before_swap: bool = False) -> None:
+    def load_models(self, warm_before_swap: bool = False,
+                    tenant: Optional[str] = None) -> None:
         """createServerActorWithEngine (:207-266): restore + prepare_deploy.
 
         ``warm_before_swap`` is the /reload path's double-buffered
@@ -378,7 +429,14 @@ class PredictionServer:
         the swap happens only once they are query-ready — a reload never
         spikes live p50 with compiles or a tunnel-priced device→host
         fetch. Initial deploy keeps warmup async (nothing serves yet;
-        binding fast matters more)."""
+        binding fast matters more).
+
+        ``tenant`` scopes the refresh to ONE co-resident deploy
+        (``/reload?tenant=X``): only that tenant's state swaps, so
+        rolling-reloading one tenant never drains another's serving."""
+        if tenant is not None and tenant != tenancy.DEFAULT_TENANT:
+            self._load_tenant_models(tenant, warm_before_swap)
+            return
         instance = self._resolve_instance()
         engine_params = self.engine.engine_params_from_instance(instance)
         models = CoreWorkflow.load_models(
@@ -447,6 +505,41 @@ class PredictionServer:
             sum(1 for ov in overlays if ov is not None),
         )
 
+    def _load_tenant_models(self, tenant_id: str,
+                            warm_before_swap: bool) -> None:
+        """Load/refresh ONE tenant's co-resident deploy (the tenant-
+        scoped half of :meth:`load_models`). Rides the same warm-before-
+        swap discipline; the swap touches only ``self._deploys[tenant]``
+        so every other tenant — including the default deploy — keeps
+        serving untouched. Speed overlays stay a default-deploy feature
+        (tenant deploys serve the model-of-record)."""
+        reg = tenancy.get_registry()
+        t = reg.get(tenant_id)
+        if t is None:
+            raise HttpError(404, f"Unknown tenant {tenant_id!r}.")
+        instance = self._resolve_instance(
+            engine_id=t.engine_id or self.config.engine_id,
+            engine_variant=t.engine_variant or self.config.engine_variant)
+        engine_params = self.engine.engine_params_from_instance(instance)
+        models = CoreWorkflow.load_models(
+            instance.id, self.engine, engine_params, ctx=self.ctx
+        )
+        _ds, _prep, algorithms, serving = self.engine.components(
+            engine_params)
+        if warm_before_swap:
+            self._warm_models(algorithms, models)
+        with self._lock:
+            self._deploys[tenant_id] = {
+                "engine_instance": instance,
+                "engine_params": engine_params,
+                "algorithms": algorithms,
+                "serving": serving,
+                "models": models,
+            }
+        logger.info(
+            "Tenant %s deployed engine instance %s (%d algorithms)",
+            tenant_id, instance.id, len(algorithms))
+
     def _build_speed_overlays(self, engine_params, algorithms,
                               models) -> List[Any]:
         """One overlay per algorithm that offers a fold-in config
@@ -481,25 +574,42 @@ class PredictionServer:
         return overlays
 
     # -- query pipeline -----------------------------------------------------
-    def _handle_query(self, body: bytes) -> Any:
-        res = self._handle_batch([body])[0]
+    def _handle_query(self, body: bytes,
+                      tenant: str = tenancy.DEFAULT_TENANT) -> Any:
+        res = self._handle_batch([body], self.config.engine_id, tenant)[0]
         if isinstance(res, Exception):
             raise res
         return res
 
-    def _handle_batch(self, bodies: List[bytes]) -> List[Any]:
+    def _handle_batch(self, bodies: List[bytes], engine: str,
+                      tenant: str) -> List[Any]:
         """Serve a batch of query bodies in one pass: parse + supplement per
         query, then ONE ``batch_predict`` per algorithm (a single device
         dispatch for the whole batch, ops/topk.py batch_score_top_k), then
         per-query serve/feedback/plugins. Per-query failures become entries
         in the result list — one bad query never fails its batchmates.
-        A batch of one is the plain sequential path."""
+        A batch of one is the plain sequential path.
+
+        ``engine``/``tenant`` are non-defaulted so the scheduler's arity
+        detection routes each batch here with its queue's tenant — a
+        batch is single-tenant by construction, and serves from that
+        tenant's own deploy when one is resident."""
         t0 = time.perf_counter()
         with self._lock:
-            algorithms = self.algorithms
-            serving = self.serving
-            models = self.models
-            instance = self.engine_instance
+            # getattr: tests and the bench build servers via __new__
+            # with hand-injected state
+            dep = (getattr(self, "_deploys", {}).get(tenant)
+                   if tenant != tenancy.DEFAULT_TENANT else None)
+            if dep is not None:
+                algorithms = dep["algorithms"]
+                serving = dep["serving"]
+                models = dep["models"]
+                instance = dep["engine_instance"]
+            else:
+                algorithms = self.algorithms
+                serving = self.serving
+                models = self.models
+                instance = self.engine_instance
         n = len(bodies)
         if not algorithms or instance is None:
             return [HttpError(503, "No engine instance deployed.")] * n
@@ -624,8 +734,10 @@ class PredictionServer:
             self.last_serving_sec = dt
             self.max_batch_served = max(self.max_batch_served, n)
         # n same-valued observations in one bucket add: per-query tail
-        # latency (p50/p95/p99) at per-batch bookkeeping cost
-        _QUERY_LATENCY.observe(dt, n)
+        # latency (p50/p95/p99) at per-batch bookkeeping cost; the
+        # tenant child comes from the bounded registry (lint contract)
+        _QUERY_LATENCY.labels(
+            tenant=tenancy.get_registry().label(tenant)).observe(dt, n)
         return results
 
     def _remote_log(self, message: str) -> None:
@@ -732,6 +844,39 @@ class PredictionServer:
             logger.exception("mips status block failed")
             return {"indexes": [], "daemon": None}
 
+    def _tenant_status_locked(self) -> Optional[Dict[str, Any]]:
+        """The /status per-tenant block (caller holds ``self._lock``):
+        registry policy + which deploy each tenant serves from + its
+        queue depth / shed count / model staleness. ``None`` in
+        single-tenant mode so pre-tenancy status readers see nothing
+        new to misparse."""
+        reg = tenancy.get_registry()
+        deploys = getattr(self, "_deploys", {})
+        if not reg and not deploys:
+            return None
+        batcher = getattr(self, "_batcher", None)
+        sched = batcher.stats()["tenants"] if batcher is not None else {}
+        out: Dict[str, Any] = {}
+        for tid, desc in reg.describe().items():
+            dep = deploys.get(tid)
+            instance = (dep["engine_instance"] if dep is not None
+                        else self.engine_instance)
+            srow = sched.get(tid, {})
+            out[tid] = {
+                **desc,
+                "engineInstanceId": instance.id if instance else None,
+                "sharedDeploy": dep is None,
+                "modelStalenessSec": (
+                    max((now_utc() - ensure_aware(instance.end_time))
+                        .total_seconds(), 0.0)
+                    if instance is not None else None),
+                "queueDepth": srow.get("depth", 0),
+                "shed": srow.get("shed", 0),
+                "servingSecP99": _QUERY_LATENCY.labels(
+                    tenant=reg.label(tid)).quantile(0.99) or 0.0,
+            }
+        return out
+
     # -- auth for /stop, /reload (common/.../KeyAuthentication.scala:34) ----
     def _check_server_key(self, request: Request) -> None:
         provided = request.query.get("accessKey")
@@ -797,6 +942,11 @@ class PredictionServer:
                     # "Serving fleet")
                     "scheduler": (self._batcher.stats()
                                   if self._batcher is not None else None),
+                    # per-tenant block (deploys, queue depth, shed,
+                    # staleness) — one status call answers "which
+                    # tenant is hurting" (docs/production.md
+                    # "Multi-tenant platform")
+                    "tenants": self._tenant_status_locked(),
                 }
             accept = request.headers.get("accept", "")
             if "text/html" in accept:
@@ -821,6 +971,12 @@ class PredictionServer:
             from incubator_predictionio_tpu.utils.http import sync
 
             try:
+                # access-key auth (serving/tenancy.py): the same
+                # accessKey grammar as the event server, mapped to a
+                # tenant. Empty registry = single-tenant compatibility
+                # mode (unauthenticated, tenant "default"); unknown or
+                # disabled keys raise 401 here
+                tenant = tenancy.get_registry().authenticate(request)
                 if self._batcher is not None:
                     # priority orders only the scheduler's SHED decision
                     # (higher survives an overload longer) — admitted
@@ -833,9 +989,11 @@ class PredictionServer:
                     result = await asyncio.wrap_future(
                         self._batcher.submit(
                             request.body, priority=prio,
-                            engine=self.config.engine_id))
+                            engine=self.config.engine_id,
+                            tenant=tenant))
                 else:
-                    result = await sync(self._handle_query, request.body)
+                    result = await sync(self._handle_query, request.body,
+                                        tenant)
             except HttpError as e:
                 # the depth signal matters MOST on a shed: without it
                 # the front door would keep the overloaded worker's
@@ -866,9 +1024,15 @@ class PredictionServer:
             # shapes may differ — catalog size, rank) BEFORE the swap;
             # the old models serve every query until then. Serialized so
             # overlapping reloads cannot swap instances out of order.
+            # ?tenant=X scopes the refresh to one co-resident deploy —
+            # every other tenant keeps serving through it.
+            tenant = request.query.get("tenant") or None
             with self._reload_lock:
-                self.load_models(warm_before_swap=True)
-            return Response(200, {"message": "Reloaded."})
+                self.load_models(warm_before_swap=True, tenant=tenant)
+            self._sync_tenant_policy()
+            return Response(200, {
+                "message": (f"Reloaded tenant {tenant}." if tenant
+                            else "Reloaded.")})
 
         @r.post("/knobs")
         def post_knobs(request: Request) -> Response:

@@ -49,10 +49,11 @@ workers:
   ``bench.py bench_frontdoor`` as ``frontdoor_join_to_first_dispatch_s``
   with the cold/warm delta recorded.
 
-Exported series: ``pio_frontdoor_requests_total{worker,outcome}``,
+Exported series: ``pio_frontdoor_requests_total{worker,outcome}``
+(``outcome="unauthorized"`` = accessKey rejected at the door),
 ``pio_frontdoor_retries_total``, ``pio_frontdoor_worker_healthy{worker}``,
 ``pio_frontdoor_drain_seconds``, plus the client-observed
-``pio_query_latency_seconds`` — list the front door in
+``pio_query_latency_seconds{tenant}`` — list the front door in
 ``PIO_FLEET_TARGETS`` and the fleet ``/slo`` serve_p99 objective
 evaluates what clients actually saw through the door, not just
 per-worker dispatch walls (docs/observability.md;
@@ -72,6 +73,7 @@ from urllib.parse import quote, urlencode
 
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
 from incubator_predictionio_tpu.obs import trace as obs_trace
+from incubator_predictionio_tpu.serving import tenancy
 from incubator_predictionio_tpu.utils import times
 from incubator_predictionio_tpu.utils.http import (
     HttpServer,
@@ -90,7 +92,9 @@ _REQUESTS = obs_metrics.REGISTRY.counter(
     "front-door requests by worker and outcome (ok = 2xx/4xx "
     "passthrough; shed = worker 503 passthrough; upstream_error = "
     "worker 5xx passthrough; failed = transport failure not recovered; "
-    "no_worker = no healthy worker to place on)",
+    "no_worker = no healthy worker to place on; unauthorized = query "
+    "rejected at the door: unknown/disabled/missing accessKey while a "
+    "tenant registry is configured)",
     labels=("worker", "outcome"))
 _RETRIES = obs_metrics.REGISTRY.counter(
     "pio_frontdoor_retries_total",
@@ -107,9 +111,13 @@ _DRAIN_SECONDS = obs_metrics.REGISTRY.histogram(
 #: walls into — so a front door listed in PIO_FLEET_TARGETS makes the
 #: fleet /slo serve_p99 objective evaluate what clients actually saw
 #: (queueing at the door included), not just per-worker dispatch walls
+#: TENANT-LABELED in lockstep with the workers' declaration of the same
+#: family (servers/prediction_server.py — the shared registry raises on
+#: a labelnames mismatch); values come only from the bounded registry
 _FD_LATENCY = obs_metrics.REGISTRY.histogram(
     "pio_query_latency_seconds",
-    "per-query serving wall (micro-batch members share the batch wall)")
+    "per-query serving wall (micro-batch members share the batch wall)",
+    labels=("tenant",))
 
 #: health states (module constants, not enum — they serialize into
 #: /status JSON and tests compare strings)
@@ -218,7 +226,7 @@ class FrontDoor:
         self._retry_tokens = self.config.retry_budget
         self.counts: Dict[str, int] = {
             "ok": 0, "shed": 0, "upstream_error": 0, "failed": 0,
-            "no_worker": 0, "retries": 0}
+            "no_worker": 0, "retries": 0, "unauthorized": 0}
         self._reload_lock = asyncio.Lock()
         self._stopping = False
         self.http = HttpServer(self._build_router(), self.config.host,
@@ -497,11 +505,27 @@ class FrontDoor:
     async def handle_query(self, request: Request) -> Response:
         """Place /queries.json on a worker; bounded single retry to a
         DIFFERENT worker on transport failure (idempotent — a query
-        reads model state), under the overall request deadline."""
-        return await self.forward(request, "/queries.json")
+        reads model state), under the overall request deadline.
+
+        Tenancy: the door authenticates the accessKey against the same
+        bounded registry the workers read (serving/tenancy.py) and
+        ROUTES by tenant only in its bookkeeping — placement and
+        circuit state stay transport-scoped (a worker is healthy or
+        not; which tenant a query belongs to never changes where it can
+        run). The query string travels verbatim, so the worker re-
+        authenticates the same key."""
+        try:
+            tenant = tenancy.get_registry().authenticate(request)
+        except tenancy.TenantAuthError as e:
+            self.counts["unauthorized"] += 1
+            _REQUESTS.labels(worker="none", outcome="unauthorized").inc()
+            return Response(401, {"message": e.message})
+        return await self.forward(request, "/queries.json",
+                                  tenant=tenant)
 
     async def forward(self, request: Request,
-                      upstream_path: Optional[str] = None) -> Response:
+                      upstream_path: Optional[str] = None,
+                      tenant: Optional[str] = None) -> Response:
         """Place one request on a worker under the full door
         discipline — least-loaded pick, circuit breaker, bounded
         token-bucket retry to a DIFFERENT worker, overall deadline.
@@ -514,6 +538,11 @@ class FrontDoor:
             path += "?" + urlencode(request.query)
         fwd_headers = {"Content-Type": request.headers.get(
             "content-type", "application/json")}
+        auth = request.headers.get("authorization")
+        if auth is not None:
+            # a tenant key sent via HTTP Basic lives in this header,
+            # not the query string — the worker re-authenticates it
+            fwd_headers["Authorization"] = auth
         prio = request.headers.get("x-pio-priority")
         if prio is not None:
             fwd_headers["X-PIO-Priority"] = prio
@@ -589,9 +618,11 @@ class FrontDoor:
                 # served queries only: a shed answers in microseconds
                 # and booking it would deflate the very p99 the shed
                 # exists to protect (same rule as the workers, whose
-                # scheduler books served batches only)
-                _FD_LATENCY.observe(
-                    max(self._clock() - t_start, 0.0))
+                # scheduler books served batches only). The tenant
+                # child comes from the bounded registry (lint contract)
+                _FD_LATENCY.labels(
+                    tenant=tenancy.get_registry().label(tenant)
+                ).observe(max(self._clock() - t_start, 0.0))
             out_headers = {}
             for h in ("retry-after", "x-pio-queue-depth"):
                 if h in hdrs:
@@ -615,19 +646,31 @@ class FrontDoor:
         _DRAIN_SECONDS.observe(max(self._clock() - t0, 0.0))
         return w.in_flight
 
-    async def rolling_reload_async(self) -> Dict[str, Any]:
+    async def rolling_reload_async(
+            self, tenant: Optional[str] = None) -> Dict[str, Any]:
         """Drain → /reload → verify-warm → re-admit, one worker at a
         time. The per-worker /reload is the existing double-buffered
         warm-before-swap (prediction_server.load_models) — the old
         model serves its drained peers' traffic until the new one is
-        query-ready, so the fleet-wide swap drops zero queries."""
+        query-ready, so the fleet-wide swap drops zero queries.
+
+        ``tenant`` scopes each worker's reload to ONE co-resident
+        deploy (``/reload?tenant=X``): the other tenants' serving state
+        is never swapped, and the drain/readmit choreography is the
+        only cross-tenant effect (transport-scoped, as placement always
+        is)."""
         async with self._reload_lock:
             out: Dict[str, Any] = {"workers": len(self.workers),
                                    "reloaded": 0, "dropped": 0,
-                                   "failed": [], "drainS": []}
+                                   "failed": [], "drainS": [],
+                                   "tenant": tenant}
             key = self.config.server_key
-            path = "/reload" + (
-                f"?accessKey={quote(key, safe='')}" if key else "")
+            qs = []
+            if key:
+                qs.append(f"accessKey={quote(key, safe='')}")
+            if tenant:
+                qs.append(f"tenant={quote(tenant, safe='')}")
+            path = "/reload" + ("?" + "&".join(qs) if qs else "")
             # trace contract: a reload triggered by a traced request
             # (the freshness controller's POST /reload, an operator's
             # curl with a trace header) forwards its trace ID + this
@@ -737,14 +780,14 @@ class FrontDoor:
             out["results"] = results
             return out
 
-    def rolling_reload(self, timeout: Optional[float] = None
-                       ) -> Dict[str, Any]:
+    def rolling_reload(self, timeout: Optional[float] = None,
+                       tenant: Optional[str] = None) -> Dict[str, Any]:
         """Synchronous wrapper for callers off the loop (bench, CLI)."""
         loop = self.http._loop
         if loop is None or not loop.is_running():
             raise RuntimeError("front door is not running")
         fut = asyncio.run_coroutine_threadsafe(
-            self.rolling_reload_async(), loop)
+            self.rolling_reload_async(tenant=tenant), loop)
         return fut.result(timeout=timeout)
 
     # -- introspection ------------------------------------------------------
@@ -780,7 +823,8 @@ class FrontDoor:
             denied = self._check_key(request)
             if denied is not None:
                 return denied
-            return Response(200, await self.rolling_reload_async())
+            return Response(200, await self.rolling_reload_async(
+                tenant=request.query.get("tenant") or None))
 
         @r.post("/knobs")
         async def post_knobs(request: Request) -> Response:

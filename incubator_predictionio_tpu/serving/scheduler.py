@@ -41,11 +41,26 @@ names:
   ``Retry-After`` (:class:`ShedError`) instead of poisoning the p99 for
   everyone admitted behind it; a higher-priority arrival evicts the
   lowest-priority queued request rather than shedding itself. Sheds
-  book ``pio_serve_shed_total{reason}``.
+  book ``pio_serve_shed_total{tenant,reason}``.
+
+- **Tenant isolation** (ROADMAP item 4, serving/tenancy.py). Queues
+  are keyed ``(tenant, engine)``; dispatch is WEIGHTED-FAIR across
+  tenants (lowest virtual service — dispatched queries over weight —
+  goes next, FIFO within a tenant), replacing oldest-head-across-
+  queues, which a flooding tenant would monopolize. Per-tenant
+  admission QUOTAS bound a tenant's total backlog (shed reason
+  ``quota``); the shed projection reads the TENANT's own queue and the
+  TENANT's own live p99, so a noisy neighbor's backlog never sheds a
+  victim's traffic; and priority eviction is cross-tenant but
+  restricted to tenants AT OR OVER their weighted fair share of the
+  backlog — an under-share (victim) tenant's queued requests are never
+  evicted on an aggressor's behalf.
 
 Exported series: ``pio_serve_batch_size`` (pow2 buckets — the fused
 width distribution, the fleet bench's ``fleet_batch_p50`` source),
-``pio_serve_queue_wait_seconds``, ``pio_serve_shed_total{reason}``.
+``pio_serve_queue_wait_seconds``,
+``pio_serve_shed_total{tenant,reason}`` (tenant values come from the
+bounded registry — the ``unscoped-tenant-metric`` lint contract).
 """
 
 from __future__ import annotations
@@ -63,6 +78,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 from incubator_predictionio_tpu.obs import metrics as obs_metrics
 from incubator_predictionio_tpu.obs import recorder as obs_recorder
 from incubator_predictionio_tpu.obs import trace as obs_trace
+from incubator_predictionio_tpu.serving import tenancy
 from incubator_predictionio_tpu.utils import times
 from incubator_predictionio_tpu.utils.http import HttpError
 
@@ -77,10 +93,11 @@ _QUEUE_WAIT = obs_metrics.REGISTRY.histogram(
     "admission-queue wait before a query's batch dispatched")
 _SHED = obs_metrics.REGISTRY.counter(
     "pio_serve_shed_total",
-    "requests shed by the scheduler, by reason (overload = projected "
-    "past the serve_p99 objective; evicted = displaced by a higher-"
+    "requests shed by the scheduler, by tenant and reason (overload = "
+    "projected past the serve_p99 objective; quota = the tenant's "
+    "admission quota was full; evicted = displaced by a higher-"
     "priority arrival; shutdown = scheduler stopping)",
-    labels=("reason",))
+    labels=("tenant", "reason"))
 _COMPILE_CACHE = obs_metrics.REGISTRY.gauge(
     "pio_serve_compile_cache_size",
     "compiled serving-dispatch variants resident (ops/topk ladder) — "
@@ -251,8 +268,11 @@ class BatchScheduler:
     device dispatch (results list aligned with bodies; an Exception
     entry fails just that member). A two-parameter handler —
     ``handle_batch(bodies, engine)`` — additionally receives the queue
-    key, for multi-engine hosts. Construction-time signature stays
-    compatible with the old ``_MicroBatcher(handle, max_batch,
+    key, for multi-engine hosts; a three-parameter handler —
+    ``handle_batch(bodies, engine, tenant)`` — also receives the
+    tenant, for multi-deploy hosts (servers/prediction_server.py routes
+    each tenant's batch to its own deploy). Construction-time signature
+    stays compatible with the old ``_MicroBatcher(handle, max_batch,
     workers=…)``; ``max_batch`` is now the LADDER CAP the adaptive rung
     grows toward, not the fixed fuse width.
     """
@@ -266,8 +286,10 @@ class BatchScheduler:
         clock: Optional[Callable[[], float]] = None,
         wait_bound_s: Optional[float] = None,
         slo_s: Optional[float] = None,
-        p99_fn: Optional[Callable[[], Optional[float]]] = None,
+        p99_fn: Optional[Callable[..., Optional[float]]] = None,
         shed: Optional[bool] = None,
+        tenant_weights: Optional[Dict[str, int]] = None,
+        tenant_quotas: Optional[Dict[str, Optional[int]]] = None,
     ) -> None:
         self._handle_batch = handle_batch
         try:
@@ -279,8 +301,10 @@ class BatchScheduler:
                 # engine parameter (closure-style wrappers default-bind)
             ]
             self._pass_engine = len(params) >= 2
+            self._pass_tenant = len(params) >= 3
         except (TypeError, ValueError):
             self._pass_engine = False
+            self._pass_tenant = False
         self.cap = (ladder_cap() if max_batch is None
                     else next_pow2(max(int(max_batch), 1)))
         #: compat: old callers read ``max_batch`` as the fuse bound
@@ -290,15 +314,47 @@ class BatchScheduler:
                              else float(wait_bound_s))
         self.slo_s = serve_objective_s() if slo_s is None else float(slo_s)
         self._p99_fn = p99_fn
+        # a one-parameter p99 feed is per-tenant (the live latency
+        # estimate must slice the tenant's own child — a flooding
+        # neighbor's fat tail must not shed a healthy tenant's traffic)
+        self._p99_per_tenant = False
+        if p99_fn is not None:
+            try:
+                p99_params = [
+                    p for p in inspect.signature(p99_fn).parameters
+                    .values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)
+                    and p.default is p.empty
+                ]
+                self._p99_per_tenant = len(p99_params) >= 1
+            except (TypeError, ValueError):
+                self._p99_per_tenant = False
         self._shed = shed_enabled() if shed is None else bool(shed)
         self._cv = threading.Condition()
-        self._queues: "OrderedDict[str, _EngineQueue]" = OrderedDict()
+        #: queues keyed (tenant, engine) — one tenant's engines fuse
+        #: independently AND one tenant's flood stays its own problem
+        self._queues: "OrderedDict[Tuple[str, str], _EngineQueue]" = \
+            OrderedDict()
+        #: weighted-fair dispatch state: per-tenant NORMALIZED virtual
+        #: service (queries dispatched / weight) — the non-empty tenant
+        #: with the lowest value goes next
+        self._service: Dict[str, float] = {}
+        self._tenant_weights: Dict[str, int] = dict(tenant_weights or {})
+        self._tenant_quotas: Dict[str, Optional[int]] = dict(
+            tenant_quotas or {})
+        #: per-tenant last-admission clock — a tenant that submitted
+        #: within CONTEND_WINDOW_S is "contending" and the weighted
+        #: dispatch-slot caps bind (see _slot_caps_locked)
+        self._t_last_submit: Dict[str, float] = {}
         self._stopped = False
         self.shed_count = 0
+        self.shed_by_tenant: Dict[str, int] = {}
+        self._n_workers = max(int(workers), 1)
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"pio-serve-sched-{i}")
-            for i in range(max(int(workers), 1))
+            for i in range(self._n_workers)
         ]
         for t in self._threads:
             t.start()
@@ -316,40 +372,158 @@ class BatchScheduler:
         obs_recorder.register_state_provider("scheduler",
                                              _snapshot_provider)
 
+    # -- tenant helpers (call under self._cv) -------------------------------
+    def _weight(self, tenant: str) -> int:
+        return max(int(self._tenant_weights.get(tenant, 1)), 1)
+
+    def _tenant_depth_locked(self, tenant: str) -> int:
+        return sum(len(q.items) for (t, _e), q in self._queues.items()
+                   if t == tenant)
+
+    def _fair_share_tenants_locked(self) -> "set":
+        """Tenants AT OR OVER their weighted fair share of the queued
+        backlog — the only legal eviction victims. With one active
+        tenant the share test is an equality, so single-tenant priority
+        eviction behaves exactly as before tenancy existed."""
+        queued: Dict[str, int] = {}
+        for (t, _e), q in self._queues.items():
+            if q.items:
+                queued[t] = queued.get(t, 0) + len(q.items)
+        total = sum(queued.values())
+        total_weight = sum(self._weight(t) for t in queued)
+        return {
+            t for t, n in queued.items()
+            if n * total_weight >= self._weight(t) * total
+        }
+
+    def _tenant_inflight_locked(self, tenant: str) -> int:
+        return sum(q.in_flight for (t, _e), q in self._queues.items()
+                   if t == tenant)
+
+    #: a tenant that admitted a query this recently still counts as
+    #: contending for dispatch slots even if its queue is momentarily
+    #: empty — the whole point of the slot reservation is the NEXT
+    #: arrival of a light tenant, which by definition is not queued yet
+    CONTEND_WINDOW_S = 5.0
+
+    def _slot_caps_locked(self, now: float) -> Optional[Dict[str, int]]:
+        """Per-tenant caps on CONCURRENT dispatch slots, or None (no
+        caps). When ≥2 tenants are contending (submitted within
+        CONTEND_WINDOW_S, or still backlogged) and the scheduler runs
+        ≥2 dispatcher threads, each tenant's slots are bounded by its
+        weighted share ``ceil(workers * w / total_w)`` of the thread
+        pool: a low-weight flooder that would otherwise keep EVERY
+        thread inside its own floor-length dispatches is pinned below
+        the wall, so a light tenant's arrival never waits a full
+        in-flight dispatch. The caps are deliberately NOT
+        work-conserving while contention lasts — the reserved slot is
+        the isolation — but a tenant alone on the scheduler (no recent
+        traffic from anyone else) is never capped, so single-tenant
+        throughput is untouched."""
+        if self._n_workers < 2:
+            return None
+        contending = {t for t, ts in self._t_last_submit.items()
+                      if now - ts <= self.CONTEND_WINDOW_S}
+        contending |= {t for (t, _e), q in self._queues.items()
+                      if q.items}
+        if len(contending) < 2:
+            return None
+        total_w = sum(self._weight(t) for t in contending)
+        return {
+            t: max(1, math.ceil(
+                self._n_workers * self._weight(t) / total_w))
+            for t in contending
+        }
+
+    def set_tenant_policy(
+            self, weights: Optional[Dict[str, int]] = None,
+            quotas: Optional[Dict[str, Optional[int]]] = None) -> None:
+        """Adopt a tenant registry's isolation policy live (the server
+        calls this after a registry (re)parse — weights steer the
+        weighted-fair pick, quotas bound admissions)."""
+        with self._cv:
+            if weights is not None:
+                self._tenant_weights = dict(weights)
+            if quotas is not None:
+                self._tenant_quotas = dict(quotas)
+
     # -- admission ----------------------------------------------------------
     def submit(self, body: Any, priority: int = 0,
-               engine: str = "default") -> "concurrent.futures.Future":
+               engine: str = "default",
+               tenant: str = tenancy.DEFAULT_TENANT,
+               ) -> "concurrent.futures.Future":
         """Enqueue one query body → Future of its result. ``priority``
         orders only the SHED decision (higher survives longer), never
         dispatch order — admitted requests stay FIFO so no admitted
-        query starves behind a later high-priority one."""
+        query starves behind a later high-priority one. The shed
+        projection reads only THIS tenant's queue and p99, and eviction
+        victims come only from at-or-over-fair-share tenants: a noisy
+        neighbor sheds its own traffic, never a victim's."""
         fut: "concurrent.futures.Future" = concurrent.futures.Future()
         now = self._clock()
         shed_exc: Optional[ShedError] = None
         victim: Optional[_Pending] = None
+        victim_tenant = tenant
         with self._cv:
             if self._stopped:
                 fut.set_exception(
                     HttpError(503, "Server is shutting down."))
                 return fut
-            q = self._queues.get(engine)
+            key = (tenant, engine)
+            self._t_last_submit[tenant] = now
+            q = self._queues.get(key)
             if q is None:
-                q = self._queues[engine] = _EngineQueue()
-            if self._shed and q.items:
+                q = self._queues[key] = _EngineQueue()
+            tenant_depth = self._tenant_depth_locked(tenant)
+            quota = self._tenant_quotas.get(tenant)
+            if quota is not None and tenant_depth >= int(quota):
+                # the tenant's OWN admission bound — enforced even with
+                # SLO shedding off, and never answered by eviction: a
+                # quota is the tenant displacing itself, not others
+                shed_exc = ShedError(
+                    max(q.projected_wait_s(self.cap), 1.0),
+                    reason="quota")
+            elif self._shed and q.items:
                 projected = q.projected_wait_s(self.cap)
-                p99 = self._p99_fn() if self._p99_fn is not None else None
+                if self._p99_fn is None:
+                    p99 = None
+                elif self._p99_per_tenant:
+                    p99 = self._p99_fn(tenant)
+                else:
+                    p99 = self._p99_fn()
                 if projected > 0 and \
                         projected + float(p99 or 0.0) > self.slo_s:
-                    lowest = min(q.items, key=lambda p: p.priority)
-                    if lowest.priority < priority:
+                    eligible = self._fair_share_tenants_locked()
+                    lowest: Optional[_Pending] = None
+                    lowest_key: Optional[Tuple[str, str]] = None
+                    for (t, e), cand in self._queues.items():
+                        if t not in eligible or not cand.items:
+                            continue
+                        head = min(cand.items, key=lambda p: p.priority)
+                        if lowest is None or \
+                                (head.priority, head.t_enq) < \
+                                (lowest.priority, lowest.t_enq):
+                            lowest, lowest_key = head, (t, e)
+                    if lowest is not None and lowest.priority < priority:
                         # evict the lowest-priority waiter in favor of
                         # this higher-priority arrival — fleet QoS: paid
                         # traffic rides through an overload
-                        q.items.remove(lowest)
+                        self._queues[lowest_key].items.remove(lowest)
                         victim = lowest
+                        victim_tenant = lowest_key[0]
                     else:
                         shed_exc = ShedError(projected, reason="overload")
             if shed_exc is None:
+                if tenant_depth == 0:
+                    # empty→non-empty catch-up: an idle tenant must not
+                    # bank service credit and then burst ahead of
+                    # steadily-queued tenants
+                    active = [self._service.get(t, 0.0)
+                              for (t, _e), aq in self._queues.items()
+                              if aq.items and t != tenant]
+                    floor = min(active) if active else 0.0
+                    self._service[tenant] = max(
+                        self._service.get(tenant, 0.0), floor)
                 q.items.append(_Pending(body, fut, now, int(priority),
                                         obs_trace.current_trace_id()))
                 self._cv.notify()
@@ -359,26 +533,65 @@ class BatchScheduler:
             # an increment (the /status figure must track the counter)
             if victim is not None or shed_exc is not None:
                 self.shed_count += 1
+                shed_t = victim_tenant if victim is not None else tenant
+                self.shed_by_tenant[shed_t] = \
+                    self.shed_by_tenant.get(shed_t, 0) + 1
         if victim is not None:
-            _SHED.labels(reason="evicted").inc()
+            _SHED.labels(tenant=tenancy.get_registry().label(victim_tenant),
+                         reason="evicted").inc()
             victim.fut.set_exception(
                 ShedError(retry_hint, reason="evicted"))
         if shed_exc is not None:
-            _SHED.labels(reason="overload").inc()
+            _SHED.labels(tenant=tenancy.get_registry().label(tenant),
+                         reason=shed_exc.reason).inc()
             fut.set_exception(shed_exc)
         return fut
 
     # -- introspection ------------------------------------------------------
-    def depth(self, engine: Optional[str] = None) -> int:
-        with self._cv:
-            if engine is not None:
-                q = self._queues.get(engine)
-                return len(q.items) if q is not None else 0
-            return sum(len(q.items) for q in self._queues.values())
+    @staticmethod
+    def _engine_key(tenant: str, engine: str) -> str:
+        """Status/snapshot queue name: bare ``engine`` for the default
+        tenant (pre-tenancy readers keep their key), ``tenant/engine``
+        otherwise."""
+        return (engine if tenant == tenancy.DEFAULT_TENANT
+                else f"{tenant}/{engine}")
 
-    def rung(self, engine: str = "default") -> int:
+    def depth(self, engine: Optional[str] = None,
+              tenant: Optional[str] = None) -> int:
         with self._cv:
-            q = self._queues.get(engine)
+            return sum(
+                len(q.items) for (t, e), q in self._queues.items()
+                if (engine is None or e == engine)
+                and (tenant is None or t == tenant))
+
+    def depths_by_tenant(self) -> Dict[str, int]:
+        """Queued admissions per tenant — the tenant-labeled
+        ``pio_serve_queue_depth`` collector's feed.
+
+        Deliberately lock-free: the flight recorder runs registry
+        collectors at sampling Hz off its own thread, and taking the
+        dispatch cv for an advisory depth snapshot contends with the
+        serving hot path (it measurably moved the recorder-overhead
+        p99 pin). ``len(deque)`` is GIL-atomic, a racy read only
+        mis-states a depth by the in-flight delta, and the walk
+        retries if an admission resizes the queue registry mid-walk.
+        """
+        while True:
+            out: Dict[str, int] = {}
+            try:
+                # advisory scrape-time snapshot, racy by contract
+                # (see docstring for why no lock)
+                # pio-lint: disable=unguarded-shared-state
+                for (t, _e), q in list(self._queues.items()):
+                    out[t] = out.get(t, 0) + len(q.items)
+                return out
+            except RuntimeError:
+                continue
+
+    def rung(self, engine: str = "default",
+             tenant: str = tenancy.DEFAULT_TENANT) -> int:
+        with self._cv:
+            q = self._queues.get((tenant, engine))
             return q.rung if q is not None else 1
 
     def stats(self) -> Dict[str, Any]:
@@ -386,7 +599,8 @@ class BatchScheduler:
         ``knobs`` block is the worker's announcement that it honors
         ``POST /knobs`` live refreshes (obs/knobs.py): the knob
         controller's front-door fan-out reads it to confirm support,
-        and it carries the values currently in force."""
+        and it carries the values currently in force. The ``tenants``
+        block answers "which tenant is hurting" in one read."""
         with self._cv:
             return {
                 "cap": self.cap,
@@ -398,11 +612,26 @@ class BatchScheduler:
                     "shedEnabled": self._shed,
                 },
                 "engines": {
-                    name: {"depth": len(q.items), "rung": q.rung,
-                           "ewmaWallS": round(q.ewma_wall, 6)}
-                    for name, q in self._queues.items()
+                    self._engine_key(t, e): {
+                        "depth": len(q.items), "rung": q.rung,
+                        "ewmaWallS": round(q.ewma_wall, 6)}
+                    for (t, e), q in self._queues.items()
                 },
+                "tenants": self._tenants_block_locked(),
             }
+
+    def _tenants_block_locked(self) -> Dict[str, Any]:
+        tenants = set(self._tenant_weights) | set(self._tenant_quotas) \
+            | {t for (t, _e) in self._queues} | set(self.shed_by_tenant)
+        block: Dict[str, Any] = {}
+        for t in sorted(tenants):
+            block[t] = {
+                "depth": self._tenant_depth_locked(t),
+                "shed": self.shed_by_tenant.get(t, 0),
+                "weight": self._weight(t),
+                "quota": self._tenant_quotas.get(t),
+            }
+        return block
 
     def snapshot(self) -> Dict[str, Any]:
         """The incident-capture state block: :meth:`stats` plus the
@@ -418,9 +647,10 @@ class BatchScheduler:
                 "shedEnabled": self._shed,
                 "stopped": self._stopped,
                 "engines": {},
+                "tenants": self._tenants_block_locked(),
             }
-            for name, q in self._queues.items():
-                out["engines"][name] = {
+            for (t, e), q in self._queues.items():
+                out["engines"][self._engine_key(t, e)] = {
                     "depth": len(q.items),
                     "rung": q.rung,
                     "ewmaWallS": round(q.ewma_wall, 6),
@@ -461,16 +691,51 @@ class BatchScheduler:
             self._cv.notify_all()
 
     # -- dispatch loop ------------------------------------------------------
-    def _pick_locked(self) -> Optional[Tuple[str, _EngineQueue]]:
-        """The engine whose head request has waited longest — FIFO
-        across queues, so no engine starves behind a busier one."""
-        best: Optional[Tuple[str, _EngineQueue]] = None
-        for name, q in self._queues.items():
+    def _pick_locked(self) -> Optional[Tuple[Tuple[str, str],
+                                             _EngineQueue]]:
+        """Weighted-fair across tenants, FIFO within one.
+
+        Pick the non-empty tenant with the LOWEST virtual FINISH time
+        for its head (normalized service — queries dispatched over
+        weight — plus one head's worth of service, 1/weight), then that
+        tenant's oldest head across its engines — so a flooding tenant
+        advances its own service counter and yields the device back at
+        its weight share, instead of monopolizing oldest-head order.
+        The finish-time term breaks the post-catch-up tie in favor of
+        the heavier tenant: a light high-weight tenant whose service
+        was just floored to a flooder's pays one in-flight dispatch,
+        not a full extra turn behind the flood. AGE BOUND OVERRIDE: a
+        head that has waited past the wait bound is served first
+        regardless of fairness — the no-query-waits-past-the-bound
+        promise outranks the share schedule. SLOT CAPS: while ≥2
+        tenants are contending, a tenant already holding its weighted
+        share of dispatch slots is skipped entirely (even from the
+        overdue override) so one thread stays free for the others —
+        see _slot_caps_locked."""
+        best: Optional[Tuple[Tuple[str, str], _EngineQueue]] = None
+        overdue: Optional[Tuple[Tuple[str, str], _EngineQueue]] = None
+        best_finish = 0.0
+        now = self._clock()
+        caps = self._slot_caps_locked(now)
+        for key, q in self._queues.items():
             if not q.items:
                 continue
-            if best is None or q.items[0].t_enq < best[1].items[0].t_enq:
-                best = (name, q)
-        return best
+            if caps is not None:
+                cap = caps.get(key[0])
+                if cap is not None and \
+                        self._tenant_inflight_locked(key[0]) >= cap:
+                    continue
+            head_t = q.items[0].t_enq
+            if self.wait_bound_s > 0 and now - head_t >= self.wait_bound_s:
+                if overdue is None or head_t < overdue[1].items[0].t_enq:
+                    overdue = (key, q)
+            finish = (self._service.get(key[0], 0.0)
+                      + 1.0 / self._weight(key[0]))
+            if best is None or (finish, head_t) < \
+                    (best_finish, best[1].items[0].t_enq):
+                best = (key, q)
+                best_finish = finish
+        return overdue if overdue is not None else best
 
     def _run(self) -> None:
         while True:
@@ -482,7 +747,7 @@ class BatchScheduler:
                     if self._stopped:
                         return
                     continue
-                engine, q = picked
+                (tenant, engine), q = picked
                 now = self._clock()
                 oldest_age = now - q.items[0].t_enq
                 take, q.rung = plan_dispatch(
@@ -490,6 +755,8 @@ class BatchScheduler:
                     self.wait_bound_s)
                 batch = [q.items.popleft() for _ in range(take)]
                 q.in_flight += 1
+                self._service[tenant] = self._service.get(tenant, 0.0) \
+                    + take / self._weight(tenant)
             t0 = self._clock()
             for p in batch:
                 _QUEUE_WAIT.observe(max(t0 - p.t_enq, 0.0))
@@ -505,7 +772,10 @@ class BatchScheduler:
             token = (obs_trace.set_current(ex_trace)
                      if ex_trace is not None else None)
             try:
-                if self._pass_engine:
+                if self._pass_tenant:
+                    results = self._handle_batch(
+                        [p.body for p in batch], engine, tenant)
+                elif self._pass_engine:
                     results = self._handle_batch(
                         [p.body for p in batch], engine)
                 else:
@@ -519,6 +789,9 @@ class BatchScheduler:
             with self._cv:
                 q.note_wall(wall)
                 q.in_flight -= 1
+                # a slot-capped tenant just freed a slot: wake the idle
+                # dispatcher the cap reserved, or it stalls a cv.wait
+                self._cv.notify()
             for p, res in zip(batch, results):
                 if isinstance(res, Exception):
                     p.fut.set_exception(res)

@@ -54,6 +54,16 @@ def _fmt_capacity(cap: dict) -> str:
     for title, proj in (cap.get("projections") or {}).items():
         lines.append(f"  {title}: "
                      + ", ".join(f"{k}→{v}" for k, v in proj.items()))
+    tenants = cap.get("tenants")
+    if tenants:
+        pack = tenants.get("binpack") or {}
+        lines.append(
+            "  tenants           : "
+            + ", ".join(
+                f"{t}={int(q):,}qps→{tenants['workers_for_qps'][t]}w"
+                for t, q in tenants["demand_qps"].items())
+            + f"  (packed fleet: {pack.get('workers')} workers, "
+              f"from {tenants['source_record']})")
     shard = cap.get("shard")
     if shard:
         lines.append(f"  shard leg         : {shard['devices']} devices "

@@ -85,14 +85,14 @@ def _chaos_wrap(handle, chaos: dict, rng, clock):
     latency_ms = chaos.get("latency_ms")
     latency_prob = chaos.get("latency_prob") or 0.0
 
-    def wrapped(bodies):
+    def wrapped(bodies, engine, tenant):
         if stall_after is not None and clock() >= stall_after:
             # wedged worker: accepted the work, never answers — the
             # front door's attempt timeout is what rescues the query
             _time.sleep(3600.0)
         if latency_ms is not None and rng.random() < latency_prob:
             _time.sleep(latency_ms / 1000.0)
-        return handle(bodies)
+        return handle(bodies, engine, tenant)
 
     return wrapped
 
@@ -175,6 +175,7 @@ def _serve_worker(args) -> tuple:
     server.http = HttpServer(server._build_router(), "127.0.0.1", 0,
                              name="prediction")
     server._speed_overlays = []
+    server._deploys = {}
     handle = server._handle_batch
     if args.dispatch_floor_ms > 0:
         # CPU-sim stand-in for an accelerator's fixed per-dispatch wall
@@ -189,9 +190,9 @@ def _serve_worker(args) -> tuple:
         floor_s = args.dispatch_floor_ms / 1000.0
         inner = server._handle_batch
 
-        def handle(bodies):
+        def handle(bodies, engine, tenant):
             t0 = _time.perf_counter()
-            out = inner(bodies)
+            out = inner(bodies, engine, tenant)
             left = floor_s - (_time.perf_counter() - t0)
             if left > 0:
                 _time.sleep(left)
@@ -212,12 +213,23 @@ def _serve_worker(args) -> tuple:
     from incubator_predictionio_tpu.servers import (
         prediction_server as ps_mod,
     )
+    from incubator_predictionio_tpu.serving import tenancy
 
     server._batcher = BatchScheduler(
         handle, server.config.micro_batch,
         workers=server.config.serve_workers,
-        # same live-p99 feed the real PredictionServer wires in
-        p99_fn=lambda: ps_mod._QUERY_LATENCY.quantile(0.99))
+        # same live per-tenant p99 feed the real PredictionServer
+        # wires in (one positional param → the scheduler slices the
+        # SLO signal by tenant)
+        p99_fn=lambda tenant: ps_mod._QUERY_LATENCY.labels(
+            tenant=tenancy.get_registry().label(tenant)).quantile(0.99))
+    # PIO_TENANTS (bench_tenants sets it in the worker env) → weighted-
+    # fair weights + admission quotas pushed into the scheduler, same
+    # seam the real server syncs after construction and reloads
+    server._sync_tenant_policy()
+    # __new__-built server skipped __init__: wire the per-tenant
+    # pio_serve_queue_depth scrape collector onto OUR batcher
+    server.register_queue_collector()
     server._feedback_poster = _AsyncPoster("feedback")
     server._log_poster = _AsyncPoster("log", workers=1)
 
@@ -229,18 +241,40 @@ def _serve_worker(args) -> tuple:
     # exactly like a real instance swap.
     reload_seq = [0]
 
-    def load_models(warm_before_swap: bool = False) -> None:
+    def load_models(warm_before_swap: bool = False,
+                    tenant: str = None) -> None:
         reload_seq[0] += 1
         new_model = plant_model(args.seed + 1000 + reload_seq[0])
         if warm_before_swap:
             algo.warmup(new_model, max_batch=server.config.micro_batch)
+        instance = EngineInstance(
+            id=f"fleet-r{reload_seq[0]}", status="COMPLETED",
+            start_time=now_utc(), end_time=now_utc(),
+            engine_id="fleet", engine_version="1",
+            engine_variant="fleet", engine_factory="fleet")
+        if tenant is not None and tenant != tenancy.DEFAULT_TENANT:
+            # tenant-scoped reload: swap ONLY this tenant's co-resident
+            # deploy — the shared/default deploy (and every other
+            # tenant riding it) keeps serving the old model untouched,
+            # which is exactly what bench_tenants' reload stage proves
+            if tenancy.get_registry().get(tenant) is None:
+                from incubator_predictionio_tpu.utils.http import (
+                    HttpError,
+                )
+
+                raise HttpError(404, f"Unknown tenant {tenant!r}.")
+            with server._lock:
+                server._deploys[tenant] = {
+                    "engine_instance": instance,
+                    "engine_params": None,
+                    "algorithms": [algo],
+                    "serving": server.serving,
+                    "models": [new_model],
+                }
+            return
         with server._lock:
             server.models = [new_model]
-            server.engine_instance = EngineInstance(
-                id=f"fleet-r{reload_seq[0]}", status="COMPLETED",
-                start_time=now_utc(), end_time=now_utc(),
-                engine_id="fleet", engine_version="1",
-                engine_variant="fleet", engine_factory="fleet")
+            server.engine_instance = instance
 
     server.load_models = load_models
 

@@ -111,6 +111,23 @@ REQUIRED_FIELDS = {
     "frontdoor_join_cold_s": (float, type(None)),
     "frontdoor_join_warm_s": (float, type(None)),
     "frontdoor_join_to_first_dispatch_s": (float, type(None)),
+    # multi-tenant noisy-neighbor leg (docs/production.md "Multi-tenant
+    # platform"): two co-resident tenants on a real 2-worker fleet —
+    # the aggressor floods past its admission quota and sheds ITS OWN
+    # traffic while the victim's p99 stays inside its solo envelope,
+    # and a tenant-scoped rolling reload of the aggressor mid-traffic
+    # leaves the victim untouched. None = the leg's designed
+    # deadline-skip.
+    "tenant_workers": (int, type(None)),
+    "tenant_victim_solo_p99_s": (float, type(None)),
+    "tenant_victim_flood_p99_s": (float, type(None)),
+    "tenant_victim_p99_x": (float, type(None)),
+    "tenant_victim_shed_rate": (float, type(None)),
+    "tenant_aggressor_shed_total": (int, type(None)),
+    "tenant_aggressor_shed_rate": (float, type(None)),
+    "tenant_isolation": (bool, type(None)),
+    "tenant_reload_nonshed_5xx": (int, type(None)),
+    "tenant_reloaded": (int, type(None)),
     # self-driving freshness leg (docs/production.md "Self-driving
     # freshness"): the SLO-burn controller alone holds fleet staleness
     # under the compressed bound — zero human retrains — with every
@@ -373,6 +390,28 @@ def test_bench_emits_one_parsed_record_end_to_end(tmp_path):
             assert rec["frontdoor_join_to_first_dispatch_s"] > 0
         if rec["frontdoor_join_cold_s"] is not None:
             assert rec["frontdoor_join_cold_s"] > 0
+    # multi-tenant noisy-neighbor leg: when the leg ran, isolation held
+    # end to end — the victim's flooded p99 stayed inside 1.5× its own
+    # solo baseline, the victim shed NOTHING (the aggressor's quota
+    # displaced only aggressor traffic, per the workers' own per-tenant
+    # /status evidence), and the tenant-scoped rolling reload of the
+    # aggressor's deploy produced zero non-shed 5xx on the victim.
+    if rec["tenant_workers"] is not None:
+        assert rec["tenant_workers"] >= 2
+        if rec["tenant_victim_p99_x"] is not None:
+            assert rec["tenant_victim_p99_x"] <= 1.5, \
+                rec["tenant_victim_p99_x"]
+        if rec["tenant_victim_shed_rate"] is not None:
+            assert rec["tenant_victim_shed_rate"] == 0, \
+                rec["tenant_victim_shed_rate"]
+        if rec["tenant_isolation"] is not None:
+            assert rec["tenant_isolation"] is True, \
+                (rec["tenant_aggressor_shed_total"],
+                 rec["tenant_victim_shed_rate"])
+        if rec["tenant_reload_nonshed_5xx"] is not None:
+            assert rec["tenant_reload_nonshed_5xx"] == 0
+        if rec["tenant_reloaded"] is not None:
+            assert rec["tenant_reloaded"] >= 1
     # self-driving freshness leg: when the leg ran, the controller —
     # acting alone, zero human retrains — kept the sampled fleet-max
     # staleness under the compressed bound, fired at least one

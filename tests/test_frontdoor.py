@@ -246,11 +246,12 @@ def test_chaos_latency_spike_wrapper_injects():
             return 0.0  # always below prob → always spikes
 
     wrapped = fleet_worker._chaos_wrap(
-        lambda bodies: calls.append(bodies) or ["ok"] * len(bodies),
+        lambda bodies, engine, tenant:
+            calls.append(bodies) or ["ok"] * len(bodies),
         {"stall_after_s": None, "latency_ms": 5.0, "latency_prob": 0.5},
         Rng(), lambda: 0.0)
     t0 = time.perf_counter()
-    assert wrapped([b"{}"]) == ["ok"]
+    assert wrapped([b"{}"], "default", "default") == ["ok"]
     assert time.perf_counter() - t0 >= 0.005
     assert calls == [[b"{}"]]
 

@@ -604,6 +604,37 @@ def handle(request, route_label, response):
         PHASES.labels(phase=phase).set(secs)
 """,
     ),
+    "unscoped-tenant-metric": (
+        """
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.serving import tenancy
+
+LAT = obs_metrics.REGISTRY.histogram(
+    "pio_query_latency_seconds", "per-query wall", labels=("tenant",))
+SHED = obs_metrics.REGISTRY.counter(
+    "pio_serve_shed_total", "sheds", labels=("tenant", "reason"))
+
+
+def book(dt, tenant):
+    LAT.labels().observe(dt)                       # no tenant label
+    SHED.labels(tenant=tenant, reason="quota").inc()   # raw wire value
+""",
+        """
+from incubator_predictionio_tpu.obs import metrics as obs_metrics
+from incubator_predictionio_tpu.serving import tenancy
+
+LAT = obs_metrics.REGISTRY.histogram(
+    "pio_query_latency_seconds", "per-query wall", labels=("tenant",))
+SHED = obs_metrics.REGISTRY.counter(
+    "pio_serve_shed_total", "sheds", labels=("tenant", "reason"))
+
+
+def book(dt, tenant):
+    reg = tenancy.get_registry()
+    LAT.labels(tenant=reg.label(tenant)).observe(dt)
+    SHED.labels(tenant=reg.label(tenant), reason="quota").inc()
+""",
+    ),
     "unguarded-shared-state": (
         """
 import threading
@@ -678,6 +709,8 @@ def _lint_source(tmp_path: Path, source: str, rule: str, name="fixture.py"):
     elif rule in ("server-state", "unbatched-dispatch",
                   "exhaustive-scan"):
         target_dir = tmp_path / "servers"
+    elif rule == "unscoped-tenant-metric":
+        target_dir = tmp_path / "serving"
     else:
         target_dir = tmp_path
     target_dir.mkdir(exist_ok=True)

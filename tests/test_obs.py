@@ -299,11 +299,15 @@ def test_metrics_route_on_all_four_servers(stack):
     assert total >= 1
 
     types, samples = parse_exposition(scrape(stack["prediction"]))
-    # per-query latency histogram + queue-depth gauge
+    # per-query latency histogram + queue-depth gauge — both carry the
+    # tenant label now (serving/tenancy.py); unregistered traffic books
+    # under the bounded "default" child
     _buckets, lat_sum, lat_count = histogram_series(
-        samples, "pio_query_latency_seconds")
+        samples, "pio_query_latency_seconds",
+        frozenset({("tenant", "default")}))
     assert lat_count >= 1 and lat_sum > 0
-    assert ("pio_serve_queue_depth", frozenset()) in samples
+    assert ("pio_serve_queue_depth",
+            frozenset({("tenant", "default")})) in samples
     # workflow-phase gauges exported by run_train (one scrape sees the
     # whole process: serving AND the last training run)
     assert samples[("pio_workflow_phase_seconds", frozenset(

@@ -594,11 +594,11 @@ def test_recorder_and_incident_routes_e2e(monkeypatch, tmp_path,
     h = obs_metrics.REGISTRY.histogram(
         "pio_query_latency_seconds",
         "per-query serving wall (micro-batch members share the batch "
-        "wall)")
+        "wall)", labels=("tenant",))
     tok = obs_trace.set_current("e2e-trace")
     try:
         for _ in range(10):
-            h.observe(0.02)
+            h.labels(tenant="default").observe(0.02)
     finally:
         obs_trace.reset_current(tok)
     r = Router()

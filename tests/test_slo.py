@@ -317,7 +317,7 @@ def test_slo_e2e_planted_breach_flips_burn_rate(slo_stack):
     qlat = obs_metrics.REGISTRY.histogram(
         "pio_query_latency_seconds",
         "per-query serving wall (micro-batch members share the batch "
-        "wall)")
+        "wall)", labels=("tenant",)).labels(tenant="default")
     qlat.observe(0.001, 200)          # healthy traffic, under any bound
     body = get_json(slo_stack["admin"], "/slo")
     clock.advance(5)
